@@ -1,0 +1,32 @@
+"""Test harness: CPU-JAX with a faked 8-device mesh.
+
+Analog of the reference's TestSparkContext local[2] harness
+(utils/src/main/scala/com/salesforce/op/test/TestSparkContext.scala:31-77): distributed
+behavior (sharding, collectives) is exercised on 8 virtual CPU devices so suites run
+anywhere; the same code paths run on real TPU meshes.
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_uids():
+    from transmogrifai_tpu.utils import reset_uid_counter
+
+    reset_uid_counter()
+    yield

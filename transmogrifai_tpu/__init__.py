@@ -1,0 +1,13 @@
+"""transmogrifai_tpu — a TPU-native (JAX/XLA/pjit/pallas) AutoML framework for structured
+data with the capabilities of TransmogrifAI: a typed feature system, lineage-derived
+workflow DAG compiled to fused XLA programs, automated vectorization (transmogrify),
+automated feature validation (SanityChecker / RawFeatureFilter), automated model selection
+(CV x grid sharded over a TPU mesh), a JAX model zoo, evaluators, model insights, and a
+jit-exported serving path."""
+
+__version__ = "0.1.0"
+
+from . import types
+from .types import Column, Table, VectorSchema
+
+__all__ = ["types", "Column", "Table", "VectorSchema", "__version__"]

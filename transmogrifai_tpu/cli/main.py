@@ -1,0 +1,29 @@
+"""`op` command-line entry point (analog of the reference's OpWorkflowRunner CLI +
+`transmogrifai gen` codegen CLI; reference OpWorkflowRunner.scala:390-424,
+cli/.../CommandParser.scala:82-123). Subcommands land with the runner layer."""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    from transmogrifai_tpu import __version__
+
+    if not argv or argv[0] in ("-h", "--help"):
+        print(
+            "usage: op <command> [args]\n\n"
+            "commands:\n"
+            "  version   print framework version\n"
+            "  (train/score/evaluate/features/init arrive with the runner layer)"
+        )
+        return 0
+    if argv[0] == "version":
+        print(__version__)
+        return 0
+    print(f"op: unknown command {argv[0]!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,39 @@
+"""Feature type system: kind registry, (values, mask) columns, tables, vector schemas."""
+from . import kinds
+from .column import Column, concat_columns
+from .kinds import (
+    KINDS,
+    FeatureKind,
+    Storage,
+    kind_of,
+    PREDICTION_KEY,
+    PROBABILITY_KEY,
+    RAW_PREDICTION_KEY,
+)
+from .table import Table
+from .vector_schema import (
+    NULL_INDICATOR,
+    OTHER_INDICATOR,
+    SlotInfo,
+    VectorSchema,
+    slots_for,
+)
+
+__all__ = [
+    "kinds",
+    "Column",
+    "concat_columns",
+    "KINDS",
+    "FeatureKind",
+    "Storage",
+    "kind_of",
+    "Table",
+    "VectorSchema",
+    "SlotInfo",
+    "slots_for",
+    "NULL_INDICATOR",
+    "OTHER_INDICATOR",
+    "PREDICTION_KEY",
+    "PROBABILITY_KEY",
+    "RAW_PREDICTION_KEY",
+]

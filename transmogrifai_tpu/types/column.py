@@ -1,0 +1,314 @@
+"""Column: one feature's values for a batch of rows.
+
+The TPU-native replacement for the reference's Option-typed FeatureType values flowing
+through Spark Rows (reference FeatureType.scala:44-116 `Value`/`isEmpty`). Nullability is
+carried as a (values, validity-mask) pair of device arrays so every kernel — including
+correlation/statistics — can thread missingness without Python branching.
+
+Device-storage columns (numerics, dates, geolocation, vectors, predictions) are registered
+JAX pytrees: a whole layer of transform stages can be traced into ONE jit-compiled XLA
+program over Columns. Host-storage columns (text, lists, sets, maps) hold numpy object
+arrays and are consumed by host stages (tokenizers, parsers) whose hashed/counted output
+feeds the device.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kinds import (
+    KINDS,
+    FeatureKind,
+    Storage,
+    kind_of,
+    PREDICTION_KEY,
+    PROBABILITY_KEY,
+    RAW_PREDICTION_KEY,
+)
+from .vector_schema import VectorSchema
+
+@jax.tree_util.register_pytree_node_class
+class Column:
+    """(values, mask) pair plus static kind/schema metadata.
+
+    values:
+      - device scalar kinds: array [N]
+      - geolocation: array [N, 3]
+      - vector: array [N, D]
+      - prediction: dict {prediction [N], rawPrediction [N, C], probability [N, C]}
+      - host kinds: numpy object ndarray [N]
+    mask: bool array [N]; True = value present. None for vector/prediction/host storage.
+    """
+
+    __slots__ = ("kind", "values", "mask", "schema")
+
+    def __init__(
+        self,
+        kind: FeatureKind,
+        values: Any,
+        mask: Optional[Any] = None,
+        schema: Optional[VectorSchema] = None,
+    ):
+        self.kind = kind
+        self.values = values
+        self.mask = mask
+        self.schema = schema
+
+    # --- pytree protocol ------------------------------------------------------------
+    def tree_flatten(self):
+        return (self.values, self.mask), (self.kind, self.schema)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        kind, schema = aux
+        values, mask = children
+        return cls(kind, values, mask, schema)
+
+    # --- basics ---------------------------------------------------------------------
+    @property
+    def is_device(self) -> bool:
+        return self.kind.on_device
+
+    def __len__(self) -> int:
+        if self.kind.storage is Storage.PREDICTION:
+            return int(self.values[PREDICTION_KEY].shape[0])
+        return int(self.values.shape[0])
+
+    @property
+    def nrows(self) -> int:
+        return len(self)
+
+    @property
+    def width(self) -> int:
+        """Trailing dimension for vector columns; 1 for scalars."""
+        if self.kind.storage is Storage.VECTOR:
+            return int(self.values.shape[1])
+        return 1
+
+    def __repr__(self) -> str:
+        return f"Column({self.kind.name}, n={len(self)})"
+
+    # --- construction ----------------------------------------------------------------
+    @staticmethod
+    def build(kind: FeatureKind | str, data: Sequence[Any]) -> "Column":
+        """Build a Column from a python sequence with None = missing
+        (the FeatureTypeFactory analog, reference FeatureTypeFactory.scala)."""
+        if isinstance(kind, str):
+            kind = kind_of(kind)
+        st = kind.storage
+        n = len(data)
+        if st in (Storage.REAL, Storage.INTEGRAL, Storage.DATE, Storage.BINARY):
+            mask = np.array([d is not None for d in data], dtype=bool)
+            if st is Storage.REAL:
+                vals = np.array(
+                    [float(d) if d is not None else np.nan for d in data], dtype=np.float32
+                )
+            elif st is Storage.BINARY:
+                vals = np.array([bool(d) if d is not None else False for d in data], dtype=bool)
+            else:
+                vals = np.array([int(d) if d is not None else 0 for d in data], dtype=np.int64)
+            if not kind.nullable and not mask.all():
+                missing = int((~mask).sum())
+                raise ValueError(
+                    f"{kind.name} is non-nullable but {missing} of {n} values are missing"
+                )
+            if st in (Storage.INTEGRAL, Storage.DATE):
+                return Column(kind, vals, mask)  # host-exact int64
+            return Column(kind, jnp.asarray(vals), jnp.asarray(mask))
+        if st is Storage.GEOLOCATION:
+            mask = np.array([d is not None for d in data], dtype=bool)
+            vals = np.zeros((n, 3), dtype=np.float32)
+            for i, d in enumerate(data):
+                if d is not None:
+                    vals[i, :] = np.asarray(d, dtype=np.float32)
+            return Column(kind, jnp.asarray(vals), jnp.asarray(mask))
+        if st is Storage.VECTOR:
+            vals = np.asarray(data, dtype=np.float32)
+            if vals.ndim != 2:
+                raise ValueError(f"OPVector data must be [N, D], got shape {vals.shape}")
+            return Column(kind, jnp.asarray(vals), None, schema=None)
+        if st is Storage.PREDICTION:
+            raise ValueError("use Column.prediction(...) to build Prediction columns")
+        # host storage
+        arr = np.empty(n, dtype=object)
+        for i, d in enumerate(data):
+            if st is Storage.TEXT:
+                arr[i] = None if d is None else str(d)
+            elif st in (Storage.TEXT_LIST, Storage.DATE_LIST):
+                arr[i] = [] if d is None else list(d)
+            elif st is Storage.TEXT_SET:
+                arr[i] = frozenset() if d is None else frozenset(d)
+            elif st is Storage.MAP:
+                arr[i] = {} if d is None else dict(d)
+            else:  # pragma: no cover
+                raise NotImplementedError(st)
+        return Column(kind, arr, None)
+
+    @staticmethod
+    def vector(values, schema: Optional[VectorSchema] = None) -> "Column":
+        values = jnp.asarray(values, dtype=jnp.float32)
+        if values.ndim != 2:
+            raise ValueError(f"OPVector data must be [N, D], got shape {values.shape}")
+        if schema is not None and schema.size != values.shape[1]:
+            raise ValueError(
+                f"vector width {values.shape[1]} != schema size {schema.size}"
+            )
+        return Column(KINDS["OPVector"], values, None, schema=schema)
+
+    @staticmethod
+    def prediction(prediction, raw_prediction=None, probability=None) -> "Column":
+        """Build a Prediction column (reference Maps.scala:295-338: prediction scalar +
+        rawPrediction[] + probability[]). Omitted fields are derived consistently:
+        probability from softmax(rawPrediction), rawPrediction from log(probability)."""
+        pred = jnp.asarray(prediction, dtype=jnp.float32)
+
+        def _as_2d(x):
+            x = jnp.asarray(x, jnp.float32)
+            return x[:, None] if x.ndim == 1 else x
+
+        if raw_prediction is None and probability is None:
+            raw_prediction = probability = pred[:, None]
+        elif probability is None:
+            raw = _as_2d(raw_prediction)
+            raw_prediction = raw
+            probability = jax.nn.softmax(raw, axis=-1) if raw.shape[-1] > 1 else raw
+        elif raw_prediction is None:
+            prob = _as_2d(probability)
+            probability = prob
+            raw_prediction = jnp.log(jnp.clip(prob, 1e-12, None))
+        else:
+            raw_prediction = _as_2d(raw_prediction)
+            probability = _as_2d(probability)
+        vals = {
+            PREDICTION_KEY: pred,
+            RAW_PREDICTION_KEY: jnp.asarray(raw_prediction, dtype=jnp.float32),
+            PROBABILITY_KEY: jnp.asarray(probability, dtype=jnp.float32),
+        }
+        if vals[RAW_PREDICTION_KEY].shape != vals[PROBABILITY_KEY].shape:
+            raise ValueError(
+                f"rawPrediction shape {vals[RAW_PREDICTION_KEY].shape} != "
+                f"probability shape {vals[PROBABILITY_KEY].shape}"
+            )
+        return Column(KINDS["Prediction"], vals, None)
+
+    @staticmethod
+    def real(values, mask=None, kind: FeatureKind | str = "Real") -> "Column":
+        if isinstance(kind, str):
+            kind = kind_of(kind)
+        values = jnp.asarray(values, dtype=jnp.float32)
+        mask = jnp.ones(values.shape[0], bool) if mask is None else jnp.asarray(mask, bool)
+        return Column(kind, values, mask)
+
+    # --- accessors --------------------------------------------------------------------
+    @property
+    def pred(self):
+        return self.values[PREDICTION_KEY]
+
+    @property
+    def prob(self):
+        return self.values[PROBABILITY_KEY]
+
+    @property
+    def raw_pred(self):
+        return self.values[RAW_PREDICTION_KEY]
+
+    def effective_mask(self):
+        """Presence mask as a bool array for ANY storage. For host object columns the
+        reference's `isEmpty` semantics apply (FeatureType.scala:44-116): None text,
+        empty list/set/map are missing."""
+        if self.mask is not None:
+            return self.mask
+        st = self.kind.storage
+        if st in (Storage.VECTOR, Storage.PREDICTION):
+            return jnp.ones(len(self), dtype=bool)
+        if st is Storage.TEXT:
+            return np.array([v is not None for v in self.values], dtype=bool)
+        if st in (Storage.TEXT_LIST, Storage.DATE_LIST, Storage.TEXT_SET, Storage.MAP):
+            return np.array([bool(v) for v in self.values], dtype=bool)
+        return jnp.ones(len(self), dtype=bool)
+
+    def filled(self, default: float):
+        """values with missing entries replaced by `default`, as float32."""
+        vals = jnp.asarray(self.values, jnp.float32)
+        if self.mask is None:
+            return vals
+        mask = jnp.asarray(self.mask)
+        if vals.ndim == 2:
+            mask = mask[:, None]
+        return jnp.where(mask, vals, jnp.float32(default))
+
+    def to_list(self) -> list:
+        """Back to python values with None = missing (test/serving round-trip)."""
+        st = self.kind.storage
+        if st is Storage.PREDICTION:
+            pred = np.asarray(self.pred)
+            prob = np.asarray(self.prob)
+            raw = np.asarray(self.raw_pred)
+            return [
+                {
+                    PREDICTION_KEY: float(pred[i]),
+                    RAW_PREDICTION_KEY: [float(x) for x in raw[i]],
+                    PROBABILITY_KEY: [float(x) for x in prob[i]],
+                }
+                for i in range(pred.shape[0])
+            ]
+        if st is Storage.VECTOR:
+            return [list(map(float, row)) for row in np.asarray(self.values)]
+        if st in (Storage.INTEGRAL, Storage.DATE):
+            mask = self.mask if self.mask is not None else np.ones(len(self.values), bool)
+            return [int(v) if m else None for v, m in zip(self.values, mask)]
+        if not self.kind.on_device:
+            return list(self.values)
+        vals = np.asarray(self.values)
+        mask = np.asarray(self.mask) if self.mask is not None else np.ones(len(vals), bool)
+        out: list = []
+        for v, m in zip(vals, mask):
+            if not m:
+                out.append(None)
+            elif st is Storage.REAL:
+                out.append(float(v))
+            elif st is Storage.BINARY:
+                out.append(bool(v))
+            elif st is Storage.GEOLOCATION:
+                out.append([float(x) for x in v])
+            else:
+                out.append(int(v))
+        return out
+
+    def slice(self, idx) -> "Column":
+        """Row-subset (host or device indices)."""
+        if self.kind.storage is Storage.PREDICTION:
+            vals = {k: v[idx] for k, v in self.values.items()}
+            return Column(self.kind, vals, None)
+        if not self.kind.on_device:
+            idx = np.asarray(idx)
+            mask = None if self.mask is None else self.mask[idx]
+            return Column(self.kind, self.values[idx], mask)
+        mask = None if self.mask is None else self.mask[idx]
+        return Column(self.kind, self.values[idx], mask, schema=self.schema)
+
+
+def concat_columns(cols: Sequence[Column]) -> Column:
+    """Row-wise concatenation of same-kind columns."""
+    k = cols[0].kind
+    if not all(c.kind is k for c in cols):
+        raise ValueError("cannot concat columns of different kinds")
+    if k.storage is Storage.PREDICTION:
+        vals = {
+            key: jnp.concatenate([c.values[key] for c in cols]) for key in cols[0].values
+        }
+        return Column(k, vals, None)
+    if not k.on_device:
+        mask = None if cols[0].mask is None else np.concatenate([c.mask for c in cols])
+        return Column(k, np.concatenate([c.values for c in cols]), mask)
+    if k.storage is Storage.VECTOR and any(c.schema != cols[0].schema for c in cols):
+        raise ValueError("cannot row-concat vector columns with differing schemas")
+    vals = jnp.concatenate([c.values for c in cols])
+    if all(c.mask is None for c in cols):
+        mask = None
+    else:
+        mask = jnp.concatenate([jnp.asarray(c.effective_mask()) for c in cols])
+    return Column(k, vals, mask, schema=cols[0].schema)

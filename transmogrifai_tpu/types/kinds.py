@@ -1,0 +1,185 @@
+"""Feature-kind registry: the TPU-native analog of the reference's 45-type sealed
+FeatureType hierarchy (reference: features/src/main/scala/com/salesforce/op/features/types/
+FeatureType.scala:44-155, Numerics.scala, Text.scala, Lists.scala, Sets.scala, Maps.scala,
+Geolocation.scala, OPVector.scala).
+
+Instead of a class-per-type JVM hierarchy, kinds are immutable registry entries. Each kind
+declares its *storage class* — which decides whether the column lives on device as
+(values, validity-mask) arrays (numerics/dates/geo/vectors) or host-side as object arrays
+(strings, lists, sets, maps) feeding host stages whose hashed/counted output the TPU consumes.
+The `is_categorical` flag mirrors the reference's `Categorical` mixin and drives the
+Transmogrifier dispatch table; `nullable=False` mirrors `NonNullable` (RealNN).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class Storage(enum.Enum):
+    """Physical representation of a column batch."""
+
+    REAL = "real"              # device float32 values [N] + bool mask [N]
+    INTEGRAL = "integral"      # host np.int64 values [N] + bool mask [N] (exact; TPU has no i64 ALU)
+    BINARY = "binary"          # device bool values [N] + bool mask [N]
+    DATE = "date"              # host np.int64 epoch-millis [N] + bool mask [N]
+    TEXT = "text"              # host: object ndarray of str|None
+    TEXT_LIST = "text_list"    # host: object ndarray of list[str]
+    DATE_LIST = "date_list"    # host: object ndarray of list[int]
+    TEXT_SET = "text_set"      # host: object ndarray of frozenset[str]
+    MAP = "map"                # host: object ndarray of dict[str, value]
+    GEOLOCATION = "geo"        # device float32 [N, 3] (lat, lon, accuracy) + bool mask [N]; ~1m quantization
+    VECTOR = "vector"          # float32 [N, D] dense, schema-carrying, non-null
+    PREDICTION = "prediction"  # dict of arrays: prediction [N], rawPrediction [N,C], probability [N,C]
+
+    @property
+    def on_device(self) -> bool:
+        return self in _DEVICE_STORAGE
+
+
+# Integral/Date stay host-side as exact numpy int64 (epoch millis exceed int32, and TPUs
+# have no native 64-bit integer path); their vectorizers emit float32 device arrays.
+_DEVICE_STORAGE = {
+    Storage.REAL,
+    Storage.BINARY,
+    Storage.GEOLOCATION,
+    Storage.VECTOR,
+    Storage.PREDICTION,
+}
+
+
+@dataclass(frozen=True)
+class FeatureKind:
+    """One entry of the type registry (analog of one FeatureType subclass)."""
+
+    name: str
+    storage: Storage
+    nullable: bool = True
+    is_categorical: bool = False
+    #: for map kinds: the registry name of the per-key value kind (RealMap -> Real)
+    map_value: Optional[str] = None
+    #: extra tags, e.g. "location", "single_response", "multi_response"
+    tags: tuple = field(default_factory=tuple)
+
+    @property
+    def on_device(self) -> bool:
+        return self.storage.on_device
+
+    @property
+    def is_map(self) -> bool:
+        return self.storage is Storage.MAP
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.storage in (Storage.REAL, Storage.INTEGRAL, Storage.BINARY)
+
+    @property
+    def is_text(self) -> bool:
+        return self.storage is Storage.TEXT
+
+    @property
+    def is_location(self) -> bool:
+        return "location" in self.tags
+
+    def __repr__(self) -> str:  # keep graph dumps compact
+        return f"FeatureKind({self.name})"
+
+
+KINDS: dict[str, FeatureKind] = {}
+
+
+def _register(kind: FeatureKind) -> FeatureKind:
+    if kind.name in KINDS:
+        raise ValueError(f"duplicate feature kind {kind.name!r}")
+    KINDS[kind.name] = kind
+    return kind
+
+
+def kind_of(name: str) -> FeatureKind:
+    """Lookup by registry name (analog of FeatureType.typeName match,
+    reference FeatureType.scala:265-354)."""
+    try:
+        return KINDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown feature kind {name!r}; known: {sorted(KINDS)}"
+        ) from None
+
+
+# --- numerics (reference Numerics.scala) -------------------------------------------------
+Real = _register(FeatureKind("Real", Storage.REAL))
+RealNN = _register(FeatureKind("RealNN", Storage.REAL, nullable=False, tags=("single_response",)))
+Currency = _register(FeatureKind("Currency", Storage.REAL))
+Percent = _register(FeatureKind("Percent", Storage.REAL))
+Integral = _register(FeatureKind("Integral", Storage.INTEGRAL))
+Binary = _register(FeatureKind("Binary", Storage.BINARY, is_categorical=True, tags=("single_response",)))
+Date = _register(FeatureKind("Date", Storage.DATE))
+DateTime = _register(FeatureKind("DateTime", Storage.DATE))
+
+# --- text (reference Text.scala) ---------------------------------------------------------
+Text = _register(FeatureKind("Text", Storage.TEXT))
+TextArea = _register(FeatureKind("TextArea", Storage.TEXT))
+Email = _register(FeatureKind("Email", Storage.TEXT))
+URL = _register(FeatureKind("URL", Storage.TEXT))
+Phone = _register(FeatureKind("Phone", Storage.TEXT))
+ID = _register(FeatureKind("ID", Storage.TEXT))
+Base64 = _register(FeatureKind("Base64", Storage.TEXT))
+PickList = _register(FeatureKind("PickList", Storage.TEXT, is_categorical=True))
+ComboBox = _register(FeatureKind("ComboBox", Storage.TEXT, is_categorical=True))
+Country = _register(FeatureKind("Country", Storage.TEXT, tags=("location",)))
+State = _register(FeatureKind("State", Storage.TEXT, tags=("location",)))
+City = _register(FeatureKind("City", Storage.TEXT, tags=("location",)))
+PostalCode = _register(FeatureKind("PostalCode", Storage.TEXT, tags=("location",)))
+Street = _register(FeatureKind("Street", Storage.TEXT, tags=("location",)))
+
+# --- collections (reference Lists.scala, Sets.scala) -------------------------------------
+TextList = _register(FeatureKind("TextList", Storage.TEXT_LIST))
+DateList = _register(FeatureKind("DateList", Storage.DATE_LIST))
+DateTimeList = _register(FeatureKind("DateTimeList", Storage.DATE_LIST))
+MultiPickList = _register(FeatureKind("MultiPickList", Storage.TEXT_SET, is_categorical=True,
+                                      tags=("multi_response",)))
+
+# --- geolocation (reference Geolocation.scala) -------------------------------------------
+Geolocation = _register(FeatureKind("Geolocation", Storage.GEOLOCATION, tags=("location",)))
+
+# --- vector (reference OPVector.scala) ---------------------------------------------------
+OPVector = _register(FeatureKind("OPVector", Storage.VECTOR, nullable=False))
+
+# --- maps (reference Maps.scala incl. Prediction at Maps.scala:295-338) ------------------
+def _map_kind(name: str, value: FeatureKind, **kw) -> FeatureKind:
+    return _register(FeatureKind(name, Storage.MAP, map_value=value.name, **kw))
+
+
+TextMap = _map_kind("TextMap", Text)
+TextAreaMap = _map_kind("TextAreaMap", TextArea)
+EmailMap = _map_kind("EmailMap", Email)
+URLMap = _map_kind("URLMap", URL)
+PhoneMap = _map_kind("PhoneMap", Phone)
+IDMap = _map_kind("IDMap", ID)
+Base64Map = _map_kind("Base64Map", Base64)
+PickListMap = _map_kind("PickListMap", PickList, is_categorical=True)
+ComboBoxMap = _map_kind("ComboBoxMap", ComboBox, is_categorical=True)
+CountryMap = _map_kind("CountryMap", Country, tags=("location",))
+StateMap = _map_kind("StateMap", State, tags=("location",))
+CityMap = _map_kind("CityMap", City, tags=("location",))
+PostalCodeMap = _map_kind("PostalCodeMap", PostalCode, tags=("location",))
+StreetMap = _map_kind("StreetMap", Street, tags=("location",))
+RealMap = _map_kind("RealMap", Real)
+CurrencyMap = _map_kind("CurrencyMap", Currency)
+PercentMap = _map_kind("PercentMap", Percent)
+IntegralMap = _map_kind("IntegralMap", Integral)
+DateMap = _map_kind("DateMap", Date)
+DateTimeMap = _map_kind("DateTimeMap", DateTime)
+BinaryMap = _map_kind("BinaryMap", Binary, is_categorical=True)
+MultiPickListMap = _map_kind("MultiPickListMap", MultiPickList, is_categorical=True)
+GeolocationMap = _map_kind("GeolocationMap", Geolocation, tags=("location",))
+
+# Prediction is a specialized RealMap with reserved keys (reference Maps.scala:295-338),
+# but on TPU it is a first-class device struct of arrays.
+Prediction = _register(FeatureKind("Prediction", Storage.PREDICTION, nullable=False))
+
+#: Keys of the Prediction struct (reference Prediction.Keys)
+PREDICTION_KEY = "prediction"
+RAW_PREDICTION_KEY = "rawPrediction"
+PROBABILITY_KEY = "probability"

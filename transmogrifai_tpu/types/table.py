@@ -1,0 +1,95 @@
+"""Table: a named collection of Columns over the same rows — the framework's in-memory
+"DataFrame". Replaces the reference's Spark Dataset/DataFrame as the unit of data flowing
+between workflow layers (reference OpWorkflow.scala:222-246 generateRawData and
+FitStagesUtil.scala:96-119 bulk transform).
+
+A Table is a plain dict of Columns plus row count; the device-resident subset of a Table
+is a JAX pytree, so fused transform layers jit over it directly.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from .column import Column
+
+
+class Table:
+    def __init__(self, columns: Mapping[str, Column], nrows: Optional[int] = None):
+        self.columns: dict[str, Column] = dict(columns)
+        if nrows is None:
+            if not self.columns:
+                raise ValueError("empty table requires explicit nrows")
+            nrows = len(next(iter(self.columns.values())))
+        self.nrows = nrows
+        for name, col in self.columns.items():
+            if len(col) != nrows:
+                raise ValueError(
+                    f"column {name!r} has {len(col)} rows, expected {nrows}"
+                )
+
+    # --- dict-like --------------------------------------------------------------------
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    def names(self) -> list[str]:
+        return list(self.columns)
+
+    def items(self):
+        return self.columns.items()
+
+    # --- functional updates ------------------------------------------------------------
+    def with_column(self, name: str, col: Column) -> "Table":
+        cols = dict(self.columns)
+        cols[name] = col
+        return Table(cols, self.nrows)
+
+    def with_columns(self, new: Mapping[str, Column]) -> "Table":
+        cols = dict(self.columns)
+        cols.update(new)
+        return Table(cols, self.nrows)
+
+    def select(self, names: Iterable[str]) -> "Table":
+        return Table({n: self.columns[n] for n in names}, self.nrows)
+
+    def drop(self, names: Iterable[str]) -> "Table":
+        names = set(names)
+        return Table({n: c for n, c in self.columns.items() if n not in names}, self.nrows)
+
+    def slice(self, idx) -> "Table":
+        idx = np.asarray(idx)
+        if idx.dtype == bool:
+            idx = np.flatnonzero(idx)
+        return Table({n: c.slice(idx) for n, c in self.columns.items()}, int(idx.shape[0]))
+
+    # --- device/host split --------------------------------------------------------------
+    def device_part(self) -> dict[str, Column]:
+        return {n: c for n, c in self.columns.items() if c.is_device}
+
+    def host_part(self) -> dict[str, Column]:
+        return {n: c for n, c in self.columns.items() if not c.is_device}
+
+    def to_rows(self) -> list[dict]:
+        """Materialize python row dicts (tests / local serving)."""
+        lists = {n: c.to_list() for n, c in self.columns.items()}
+        return [{n: lists[n][i] for n in lists} for i in range(self.nrows)]
+
+    @staticmethod
+    def from_rows(rows: Sequence[Mapping], kinds: Mapping[str, object]) -> "Table":
+        """Build from python row dicts given {name: FeatureKind|kind-name}."""
+        cols = {
+            name: Column.build(kind, [r.get(name) for r in rows])
+            for name, kind in kinds.items()
+        }
+        return Table(cols, len(rows))
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.kind.name}" for n, c in self.columns.items())
+        return f"Table(n={self.nrows}, [{cols}])"

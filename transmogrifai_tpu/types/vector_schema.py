@@ -1,0 +1,137 @@
+"""VectorSchema: provenance of every slot of every feature vector.
+
+TPU-native analog of OpVectorMetadata / OpVectorColumnMetadata (reference:
+features/src/main/scala/com/salesforce/op/utils/spark/OpVectorMetadata.scala:49-86,
+OpVectorColumnMetadata.scala:67-204). The reference serializes this into Spark DataFrame
+column metadata; here it travels with Column objects as static (non-device) aux metadata
+and is consumed by the SanityChecker (feature-group dropping), ModelInsights and LOCO
+(naming contributions), and the descaler.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class SlotInfo:
+    """Describes one slot (column) of a feature vector
+    (analog of OpVectorColumnMetadata)."""
+
+    #: name of the raw parent feature(s) this slot was derived from
+    parent_feature: str
+    #: registry name of the parent feature's kind
+    parent_kind: str
+    #: grouping within the parent (e.g. map key, or pivot group); None for plain numerics
+    group: Optional[str] = None
+    #: the categorical value this slot indicates (pivot value, "OTHER", "NullIndicator"...)
+    indicator_value: Optional[str] = None
+    #: free-form descriptor for non-indicator slots (e.g. "x"/"y" of a date unit circle)
+    descriptor: Optional[str] = None
+
+    @property
+    def is_null_indicator(self) -> bool:
+        return self.indicator_value == NULL_INDICATOR
+
+    @property
+    def is_other_indicator(self) -> bool:
+        return self.indicator_value == OTHER_INDICATOR
+
+    def column_name(self) -> str:
+        """Human-readable slot name (analog of OpVectorColumnMetadata.makeColName)."""
+        parts = [self.parent_feature]
+        if self.group is not None:
+            parts.append(self.group)
+        if self.indicator_value is not None:
+            parts.append(self.indicator_value)
+        elif self.descriptor is not None:
+            parts.append(self.descriptor)
+        return "_".join(parts)
+
+    def grouping_key(self) -> tuple:
+        """Slots with the same grouping key form one mutually-exclusive indicator group
+        (used by SanityChecker group-wise drops)."""
+        return (self.parent_feature, self.group)
+
+
+#: reserved indicator values (reference OpVectorColumnMetadata.NullString / OtherString)
+NULL_INDICATOR = "NullIndicatorValue"
+OTHER_INDICATOR = "OTHER"
+
+
+@dataclass(frozen=True)
+class VectorSchema:
+    """Schema of a dense feature vector: an ordered tuple of SlotInfo."""
+
+    slots: tuple[SlotInfo, ...] = ()
+
+    @property
+    def size(self) -> int:
+        return len(self.slots)
+
+    def __len__(self) -> int:
+        return len(self.slots)
+
+    def __iter__(self):
+        return iter(self.slots)
+
+    def __getitem__(self, i):
+        return self.slots[i]
+
+    def column_names(self) -> list[str]:
+        return [s.column_name() for s in self.slots]
+
+    def concat(self, *others: "VectorSchema") -> "VectorSchema":
+        """Schema of the concatenation of vectors (analog of OpVectorMetadata flatten
+        used by VectorsCombiner)."""
+        slots = list(self.slots)
+        for o in others:
+            slots.extend(o.slots)
+        return VectorSchema(tuple(slots))
+
+    def select(self, indices: Sequence[int]) -> "VectorSchema":
+        """Schema after keeping only `indices` slots (SanityChecker / DropIndices)."""
+        return VectorSchema(tuple(self.slots[i] for i in indices))
+
+    def index_of_parent(self, parent_feature: str) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s.parent_feature == parent_feature]
+
+    def groups(self) -> dict[tuple, list[int]]:
+        """Map grouping_key -> slot indices (indicator groups)."""
+        out: dict[tuple, list[int]] = {}
+        for i, s in enumerate(self.slots):
+            out.setdefault(s.grouping_key(), []).append(i)
+        return out
+
+    def to_json(self) -> list[dict]:
+        return [
+            {
+                "parent_feature": s.parent_feature,
+                "parent_kind": s.parent_kind,
+                "group": s.group,
+                "indicator_value": s.indicator_value,
+                "descriptor": s.descriptor,
+            }
+            for s in self.slots
+        ]
+
+    @staticmethod
+    def from_json(data: Iterable[dict]) -> "VectorSchema":
+        return VectorSchema(tuple(SlotInfo(**d) for d in data))
+
+
+def slots_for(
+    parent_feature: str,
+    parent_kind: str,
+    *,
+    group: Optional[str] = None,
+    indicator_values: Sequence[Optional[str]] = (),
+    descriptors: Sequence[Optional[str]] = (),
+) -> VectorSchema:
+    """Convenience constructor for a run of slots from one parent feature."""
+    slots = []
+    for iv in indicator_values:
+        slots.append(SlotInfo(parent_feature, parent_kind, group=group, indicator_value=iv))
+    for d in descriptors:
+        slots.append(SlotInfo(parent_feature, parent_kind, group=group, descriptor=d))
+    return VectorSchema(tuple(slots))

@@ -1,0 +1,3 @@
+from .uid import reset_uid_counter, uid, uid_type
+
+__all__ = ["uid", "uid_type", "reset_uid_counter"]

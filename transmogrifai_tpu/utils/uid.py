@@ -1,0 +1,37 @@
+"""Stage/feature UID factory (analog of reference utils/.../UID.scala:42-63).
+
+UIDs are `<Type>_<12-hex>`; a process-local counter keeps them unique and (unlike the
+reference's random hex) deterministic within a run when seeded, which keeps graph
+manifests reproducible for tests.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+
+_counter = itertools.count(1)
+_lock = threading.Lock()
+
+_UID_RE = re.compile(r"^(\w+)_(\w{12})$")
+
+
+def uid(type_name: str) -> str:
+    with _lock:
+        n = next(_counter)
+    return f"{type_name}_{n:012x}"
+
+
+def reset_uid_counter(start: int = 1) -> None:
+    """Test hook: make UID sequences reproducible."""
+    global _counter
+    with _lock:
+        _counter = itertools.count(start)
+
+
+def uid_type(uid_str: str) -> str:
+    """Extract the type prefix (reference UID.fromString)."""
+    m = _UID_RE.match(uid_str)
+    if not m:
+        raise ValueError(f"invalid uid {uid_str!r}")
+    return m.group(1)
