@@ -1,0 +1,238 @@
+"""Feature graph + stage abstraction tests (mirror of reference FeatureTest /
+OpPipelineStagesTest / FitStagesUtil DAG specs)."""
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.graph import (
+    FeatureBuilder,
+    FeatureCycleError,
+    compute_dag,
+    features_from_schema,
+    split_layer_by_kind,
+    validate_dag,
+)
+from transmogrifai_tpu.stages import (
+    Estimator,
+    LambdaTransformer,
+    Stage,
+    Transformer,
+    adopt_wiring,
+    register_stage,
+)
+from transmogrifai_tpu.types import Column, Table, kind_of
+
+
+@register_stage
+class PlusOne(Transformer):
+    operation_name = "plusOne"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return kind_of("Real")
+
+    def transform_columns(self, cols):
+        c = cols[0]
+        return Column(kind_of("Real"), c.values + 1.0, c.mask)
+
+
+@register_stage
+class AddCols(Transformer):
+    operation_name = "add"
+    device_op = True
+    arity = (2, 2)
+
+    def out_kind(self, in_kinds):
+        return kind_of("Real")
+
+    def transform_columns(self, cols):
+        a, b = cols
+        return Column(kind_of("Real"), a.values + b.values,
+                      a.effective_mask() & b.effective_mask())
+
+
+@register_stage
+class MeanFill(Estimator):
+    operation_name = "meanFill"
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def fit_columns(self, cols):
+        c = cols[0]
+        mask = c.effective_mask()
+        mean = float((c.filled(0.0) * mask).sum() / jnp.maximum(mask.sum(), 1))
+        return MeanFillModel(mean=mean)
+
+
+@register_stage
+class MeanFillModel(Transformer):
+    operation_name = "meanFill"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def transform_columns(self, cols):
+        return Column(kind_of("RealNN"), cols[0].filled(self.params["mean"]),
+                      jnp.ones(len(cols[0]), bool))
+
+
+class TestFeatureBuilder:
+    def test_typed_builders_exist_per_kind(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        assert age.kind.name == "Real" and age.is_raw and not age.is_response
+        label = FeatureBuilder.RealNN("label").as_response()
+        assert label.is_response
+
+    def test_extract_fn(self):
+        f = FeatureBuilder.Real("age").extract(lambda r: r["a"] * 2).as_predictor()
+        assert f.origin_stage.extract({"a": 3}) == 6
+
+    def test_default_extract_by_name(self):
+        f = FeatureBuilder.Text("name").as_predictor()
+        assert f.origin_stage.extract({"name": "x"}) == "x"
+        assert f.origin_stage.extract({}) is None
+
+    def test_from_schema(self):
+        fs = features_from_schema({"a": "Real", "y": "RealNN"}, response="y")
+        assert fs["y"].is_response and not fs["a"].is_response
+        with pytest.raises(ValueError, match="response"):
+            features_from_schema({"a": "Real"}, response="nope")
+
+
+class TestStageWiring:
+    def test_call_returns_output_feature(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        out = PlusOne()(age)
+        assert out.parents == (age,)
+        assert out.kind.name == "Real"
+        assert out.origin_stage.operation_name == "plusOne"
+
+    def test_arity_enforced(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        with pytest.raises(ValueError, match="inputs"):
+            AddCols()(age)
+
+    def test_transform_table(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        stage = PlusOne()
+        out = stage(age)
+        t = Table.from_rows([{"age": 1.0}, {"age": None}], {"age": "Real"})
+        t2 = stage.transform_table(t)
+        assert t2[out.name].to_list() == [2.0, None]
+
+    def test_estimator_fit_swap(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        est = MeanFill()
+        out = est(age)
+        t = Table.from_rows([{"age": 2.0}, {"age": None}, {"age": 4.0}], {"age": "Real"})
+        model = est.fit_table(t)
+        assert model.inputs == est.inputs and model.get_output() is out
+        t2 = model.transform_table(t)
+        assert t2[out.name].to_list() == [2.0, 3.0, 4.0]
+
+    def test_stage_json_roundtrip(self):
+        m = MeanFillModel(mean=1.5)
+        data = m.to_json()
+        m2 = Stage.from_json(data)
+        assert isinstance(m2, MeanFillModel)
+        assert m2.params["mean"] == 1.5 and m2.uid == m.uid
+
+
+class TestDag:
+    def test_layering_by_max_distance(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        fare = FeatureBuilder.Real("fare").as_predictor()
+        p1 = PlusOne()
+        a1 = p1(age)                      # layer 0
+        add = AddCols()
+        total = add(a1, fare)             # layer 1
+        p2 = PlusOne()
+        out = p2(total)                   # layer 2
+        dag = compute_dag([out])
+        assert [set(type(s).__name__ for s in layer) for layer in dag] == [
+            {"PlusOne"}, {"AddCols"}, {"PlusOne"}]
+        validate_dag(dag)
+
+    def test_shared_stage_gets_max_distance(self):
+        # a1 feeds both layer-1 and layer-2 consumers; it must run in the earliest layer
+        age = FeatureBuilder.Real("age").as_predictor()
+        a1 = PlusOne()(age)
+        b = PlusOne()(a1)
+        c = AddCols()(a1, b)
+        dag = compute_dag([c])
+        flat = [[s.operation_name for s in layer] for layer in dag]
+        assert flat == [["plusOne"], ["plusOne"], ["add"]]
+
+    def test_multiple_results_dedupe(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        s = PlusOne()
+        a1 = s(age)
+        dag = compute_dag([a1, a1])
+        assert len(dag) == 1 and len(dag[0]) == 1
+
+    def test_rewire_raises(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        fare = FeatureBuilder.Real("fare").as_predictor()
+        s = PlusOne()
+        s(age)
+        with pytest.raises(ValueError, match="already wired"):
+            s(fare)
+
+    def test_diamond_chain_is_linear(self):
+        # 40 stacked diamonds would be 2^40 paths if lineage walk were path-wise
+        a = FeatureBuilder.Real("a").as_predictor()
+        for _ in range(40):
+            b = PlusOne()(a)
+            a = AddCols()(a, b)
+        stages = a.parent_stages()
+        assert len(stages) == 81  # 80 diamond stages + the raw feature generator
+        dag = compute_dag([a])
+        assert sum(len(layer) for layer in dag) == 80
+        # every stage must be layered after all stages it depends on
+        from transmogrifai_tpu.stages import FeatureGeneratorStage
+
+        seen = set()
+        for layer in dag:
+            for s in layer:
+                for f in s.inputs:
+                    origin = f.origin_stage
+                    if origin is not None and not isinstance(origin, FeatureGeneratorStage):
+                        assert id(origin) in seen
+            seen.update(id(s) for s in layer)
+
+    def test_cycle_detection(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        s = PlusOne()
+        out = s(age)
+        out.parents = (out,)  # force a cycle
+        with pytest.raises(FeatureCycleError):
+            out.parent_stages()
+
+    def test_split_layer(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        t1, e1 = PlusOne(), MeanFill()
+        t1(age)
+        e1(age)
+        est, dev, host = split_layer_by_kind([t1, e1])
+        assert est == [e1] and dev == [t1] and host == []
+
+    def test_raw_features_and_lineage(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        fare = FeatureBuilder.Real("fare").as_predictor()
+        out = AddCols()(PlusOne()(age), fare)
+        assert {f.name for f in out.raw_features()} == {"age", "fare"}
+        assert "add" in out.pretty_lineage()
+        h = out.history()
+        assert set(h["raw_features"]) == {"age", "fare"}
+
+
+class TestLambdaTransformer:
+    def test_map_shortcut(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        doubler = LambdaTransformer(
+            lambda c: Column(kind_of("Real"), c.values * 2, c.mask),
+            out="Real", device_op=True)
+        out = doubler(age)
+        t = Table.from_rows([{"age": 3.0}], {"age": "Real"})
+        assert doubler.transform_table(t)[out.name].to_list() == [6.0]

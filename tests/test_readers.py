@@ -1,0 +1,141 @@
+"""Reader tests (mirror of reference readers/src/test suites for simple readers +
+CSVAutoReaders schema inference)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.readers import (
+    CSVAutoReader,
+    CSVReader,
+    InMemoryReader,
+    TableReader,
+    infer_schema,
+)
+from transmogrifai_tpu.types import Table
+
+CSV = """id,age,fare,sex,survived
+1,22,7.25,male,0
+2,38,71.2833,female,1
+3,,7.925,female,1
+"""
+
+
+@pytest.fixture
+def csv_path(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text(CSV)
+    return str(p)
+
+
+SCHEMA = {"id": "ID", "age": "Real", "fare": "Real", "sex": "PickList", "survived": "Binary"}
+
+
+class TestCSVReader:
+    def test_typed_read(self, csv_path):
+        reader = CSVReader(csv_path, SCHEMA, key_field="id")
+        feats = features_from_schema(SCHEMA, response="survived")
+        t = reader.generate_table(list(feats.values()))
+        assert t.nrows == 3
+        assert t["age"].to_list() == [22.0, 38.0, None]
+        assert t["survived"].to_list() == [False, True, True]
+        assert t["sex"].to_list() == ["male", "female", "female"]
+        assert reader.keys() == ["1", "2", "3"]
+
+    def test_custom_extract_fn(self, csv_path):
+        reader = CSVReader(csv_path, SCHEMA)
+        age2 = (
+            FeatureBuilder.Real("age2")
+            .extract(lambda r: None if r["age"] is None else r["age"] * 2)
+            .as_predictor()
+        )
+        t = reader.generate_table([age2])
+        assert t["age2"].to_list() == [44.0, 76.0, None]
+
+    def test_headerless_with_field_names(self, tmp_path):
+        p = tmp_path / "nohead.csv"
+        p.write_text("1,22\n2,38\n")
+        reader = CSVReader(str(p), {"id": "ID", "age": "Real"},
+                           has_header=False, field_names=["id", "age"])
+        feats = features_from_schema({"id": "ID", "age": "Real"})
+        t = reader.generate_table(list(feats.values()))
+        assert t["age"].to_list() == [22.0, 38.0]
+
+    def test_missing_feature_raises(self, csv_path):
+        reader = CSVReader(csv_path, SCHEMA)
+        ghost = FeatureBuilder.Real("ghost").as_predictor()
+        with pytest.raises(KeyError, match="ghost"):
+            reader.generate_table([ghost])
+
+
+class TestSchemaInference:
+    def test_infer_kinds(self):
+        rows = [
+            {"i": "1", "f": "1.5", "b": "true", "t": f"text-{i}", "c": "ab"[i % 2]}
+            for i in range(50)
+        ]
+        s = infer_schema(rows)
+        assert s == {"i": "Integral", "f": "Real", "b": "Binary", "t": "Text", "c": "PickList"}
+
+    def test_auto_reader(self, csv_path):
+        reader = CSVAutoReader(csv_path, id_fields=["id"])
+        assert reader.schema["age"].name == "Integral"
+        assert reader.schema["fare"].name == "Real"
+        assert reader.schema["survived"].name == "Binary"
+        assert reader.schema["id"].name == "ID"
+        feats = features_from_schema({k: v.name for k, v in reader.schema.items()})
+        t = reader.generate_table(list(feats.values()))
+        assert t["age"].to_list() == [22, 38, None]
+
+    def test_empty_rows(self):
+        assert infer_schema([]) == {}
+
+    def test_integral_exactness_and_bad_values(self, tmp_path):
+        p = tmp_path / "big.csv"
+        big = 9007199254740993  # 2**53 + 1: not float64-representable
+        p.write_text(f"x\n{big}\n")
+        reader = CSVReader(str(p), {"x": "Integral"})
+        assert reader.read_records()[0]["x"] == big
+        p2 = tmp_path / "bad.csv"
+        p2.write_text("x\n7.25\n")
+        with pytest.raises(ValueError, match="not an integer"):
+            CSVReader(str(p2), {"x": "Integral"}).read_records()
+
+    def test_aggregator_without_aggregate_reader_raises(self):
+        agg = FeatureBuilder.Real("amount").aggregate(sum).as_predictor()
+        reader = InMemoryReader([{"amount": 1.0}])
+        with pytest.raises(NotImplementedError, match="aggregate"):
+            reader.generate_table([agg])
+
+
+class TestInMemoryAndTableReaders:
+    def test_records_reader(self):
+        reader = InMemoryReader([{"a": 1.0}, {"a": None}])
+        feats = features_from_schema({"a": "Real"})
+        t = reader.generate_table(list(feats.values()))
+        assert t["a"].to_list() == [1.0, None]
+
+    def test_table_reader_passthrough_and_missing(self):
+        t = Table.from_rows([{"a": 1.0, "b": 2.0}], {"a": "Real", "b": "Real"})
+        reader = TableReader(t)
+        feats = features_from_schema({"a": "Real"})
+        out = reader.generate_table(list(feats.values()))
+        assert out.names() == ["a"]
+        ghost = FeatureBuilder.Real("ghost").as_predictor()
+        with pytest.raises(KeyError):
+            reader.generate_table([ghost])
+
+
+class TestParquet:
+    def test_parquet_roundtrip(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        from transmogrifai_tpu.readers import ParquetReader
+
+        tbl = pa.table({"age": [22.0, None], "name": ["a", "b"]})
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(tbl, path)
+        feats = features_from_schema({"age": "Real", "name": "Text"})
+        out = ParquetReader(path).generate_table(list(feats.values()))
+        assert out["age"].to_list() == [22.0, None]
+        assert out["name"].to_list() == ["a", "b"]

@@ -1,0 +1,16 @@
+from .builder import FeatureBuilder, features_from_schema, features_from_table
+from .dag import compute_dag, dag_stages, split_layer_by_kind, validate_dag
+from .feature import Feature, FeatureCycleError, validate_distinct_names
+
+__all__ = [
+    "Feature",
+    "FeatureCycleError",
+    "FeatureBuilder",
+    "features_from_schema",
+    "features_from_table",
+    "compute_dag",
+    "dag_stages",
+    "split_layer_by_kind",
+    "validate_dag",
+    "validate_distinct_names",
+]

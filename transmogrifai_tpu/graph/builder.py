@@ -1,0 +1,92 @@
+"""FeatureBuilder: typed construction of raw features.
+
+Analog of reference FeatureBuilder (features/src/main/scala/com/salesforce/op/features/
+FeatureBuilder.scala:230-319): `FeatureBuilder.Real["row_type"]("age").extract(fn)
+.asPredictor` becomes `FeatureBuilder.Real("age").extract(fn).as_predictor()`; the macro
+codegen extract path becomes plain Python callables; `fromDataFrame` becomes
+`from_schema`/`from_table` (schema sniffing lives in readers.schema_inference).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+from ..stages.base import FeatureGeneratorStage
+from ..types import KINDS, FeatureKind, Table, kind_of
+from .feature import Feature
+
+
+class FeatureBuilder:
+    """Builder for one raw feature. Use `FeatureBuilder.<Kind>(name)` or
+    `FeatureBuilder.of(name, kind)`."""
+
+    def __init__(self, name: str, kind: FeatureKind | str):
+        self.name = name
+        self.kind = kind_of(kind) if isinstance(kind, str) else kind
+        self._extract: Optional[Callable[[Any], Any]] = None
+        self._aggregator = None
+        self._window_ms: Optional[int] = None
+
+    @staticmethod
+    def of(name: str, kind: FeatureKind | str) -> "FeatureBuilder":
+        return FeatureBuilder(name, kind)
+
+    def extract(self, fn: Callable[[Any], Any]) -> "FeatureBuilder":
+        """Record->value extractor (compile-time macro codegen in the reference,
+        FeatureBuilderMacros.scala, becomes a plain callable)."""
+        self._extract = fn
+        return self
+
+    def aggregate(self, aggregator) -> "FeatureBuilder":
+        """Monoid aggregator used by aggregate readers to roll up multi-row entities
+        (reference FeatureBuilder.aggregate, MonoidAggregatorDefaults)."""
+        self._aggregator = aggregator
+        return self
+
+    def window(self, window_ms: int) -> "FeatureBuilder":
+        """Time-window for aggregation (reference FeatureBuilder.window)."""
+        self._window_ms = window_ms
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        stage = FeatureGeneratorStage(self.name, self.kind.name)
+        stage.extract_fn = self._extract
+        stage.aggregator = self._aggregator
+        stage.params["window_ms"] = self._window_ms
+        feature = stage.set_input()
+        feature.is_response = is_response
+        return feature
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+
+# FeatureBuilder.Real("age"), FeatureBuilder.PickList("sex"), ... for every kind
+for _kind_name in KINDS:
+    setattr(
+        FeatureBuilder,
+        _kind_name,
+        staticmethod((lambda kn: lambda name: FeatureBuilder(name, kn))(_kind_name)),
+    )
+
+
+def features_from_schema(
+    schema: Mapping[str, FeatureKind | str],
+    response: Optional[str] = None,
+) -> dict[str, Feature]:
+    """Create raw features for every (name, kind) entry; `response` marks one of them
+    as the response (analog of FeatureBuilder.fromDataFrame, FeatureBuilder.scala:230)."""
+    out: dict[str, Feature] = {}
+    for name, kind in schema.items():
+        fb = FeatureBuilder(name, kind)
+        out[name] = fb.as_response() if name == response else fb.as_predictor()
+    if response is not None and response not in out:
+        raise ValueError(f"response {response!r} not in schema {sorted(schema)}")
+    return out
+
+
+def features_from_table(table: Table, response: Optional[str] = None) -> dict[str, Feature]:
+    """Raw features matching an existing Table's columns."""
+    return features_from_schema({n: c.kind for n, c in table.items()}, response)

@@ -1,0 +1,75 @@
+"""Lineage graph -> layered execution DAG.
+
+Port of the *algorithm* (not code) of FitStagesUtil.computeDAG (reference
+core/src/main/scala/com/salesforce/op/utils/stages/FitStagesUtil.scala:173-198): back-trace
+from result features collecting each origin stage's MAX distance-to-sink, then group
+stages into layers by distance (descending) so every stage runs after all its inputs.
+Within a layer, stages are independent — a layer of device transformers is traced into
+one XLA program; estimator layers are fit points.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..stages.base import Estimator, FeatureGeneratorStage, Stage, Transformer
+from .feature import Feature
+
+
+def compute_dag(result_features: Sequence[Feature]) -> list[list[Stage]]:
+    """Layered DAG: layers[0] runs first (raw generators excluded — readers own them).
+
+    Stages appearing on multiple paths get their maximum distance (dedup to the earliest
+    layer they are needed in is handled by max-distance layering, exactly as the
+    reference does)."""
+    distance: dict[int, int] = {}
+    stages: dict[int, Stage] = {}
+    for f in result_features:
+        for stage, d in f.parent_stages().items():
+            sid = id(stage)
+            if sid not in distance or distance[sid] < d:
+                distance[sid] = d
+                stages[sid] = stage
+    if not stages:
+        return []
+    layers: dict[int, list[Stage]] = {}
+    for sid, d in distance.items():
+        st = stages[sid]
+        if isinstance(st, FeatureGeneratorStage):
+            continue
+        layers.setdefault(d, []).append(st)
+    # larger distance = further from sink = runs earlier
+    return [layers[d] for d in sorted(layers, reverse=True)]
+
+
+def dag_stages(dag: list[list[Stage]]) -> list[Stage]:
+    return [s for layer in dag for s in layer]
+
+
+def validate_dag(dag: list[list[Stage]]) -> None:
+    """Uniqueness checks (analog of OpWorkflow.validateStages, OpWorkflow.scala:265-323)."""
+    seen_uids: set[str] = set()
+    seen_ids: set[int] = set()
+    for layer in dag:
+        for s in layer:
+            if id(s) in seen_ids:
+                raise ValueError(f"stage {s} appears twice in DAG")
+            if s.uid in seen_uids:
+                raise ValueError(f"duplicate stage uid {s.uid}")
+            seen_ids.add(id(s))
+            seen_uids.add(s.uid)
+
+
+def split_layer_by_kind(layer: Sequence[Stage]) -> tuple[list[Estimator], list[Transformer], list[Transformer]]:
+    """Partition a layer into (estimators, device transformers, host transformers) —
+    the unit structure of fitAndTransformLayer (FitStagesUtil.scala:254-293)."""
+    estimators: list[Estimator] = []
+    device_tf: list[Transformer] = []
+    host_tf: list[Transformer] = []
+    for s in layer:
+        if isinstance(s, Estimator):
+            estimators.append(s)
+        elif isinstance(s, Transformer):
+            (device_tf if s.device_op else host_tf).append(s)
+        else:
+            raise TypeError(f"stage {s} is neither Transformer nor Estimator")
+    return estimators, device_tf, host_tf

@@ -1,0 +1,152 @@
+"""Feature: a typed, lineage-carrying pointer to a (future) column.
+
+TPU-native analog of FeatureLike/Feature (reference features/src/main/scala/com/salesforce/
+op/features/FeatureLike.scala:48-103, Feature.scala:52). A Feature never holds data — it is
+a node in the expression graph: (name, kind, origin stage, parents, is_response). The graph
+rooted at result features is the compile target that lowers to XLA computations.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import TYPE_CHECKING, Iterable, Optional
+
+from ..types import FeatureKind, kind_of
+from ..utils import uid as make_uid
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..stages.base import Stage
+
+
+class FeatureCycleError(Exception):
+    """Raised when feature lineage contains a cycle
+    (analog of FeatureCycleException.scala)."""
+
+
+class Feature:
+    __slots__ = ("name", "kind", "is_response", "origin_stage", "parents", "uid")
+
+    def __init__(
+        self,
+        name: str,
+        kind: FeatureKind | str,
+        *,
+        is_response: bool = False,
+        origin_stage: Optional["Stage"] = None,
+        parents: tuple["Feature", ...] = (),
+    ):
+        self.name = name
+        self.kind = kind_of(kind) if isinstance(kind, str) else kind
+        self.is_response = is_response
+        self.origin_stage = origin_stage
+        self.parents = tuple(parents)
+        self.uid = make_uid("Feature")
+
+    # --- identity is object identity; uid for serialization ---------------------------
+    def __repr__(self) -> str:
+        return f"Feature({self.name}: {self.kind.name})"
+
+    @property
+    def is_raw(self) -> bool:
+        return not self.parents
+
+    # --- lineage walks (analog of FeatureLike.rawFeatures / parentStages) -------------
+    def raw_features(self) -> list["Feature"]:
+        """All raw (leaf) ancestors, de-duplicated, in first-visit order."""
+        seen: set[int] = set()
+        out: list[Feature] = []
+        stack = [self]
+        while stack:
+            f = stack.pop()
+            if id(f) in seen:
+                continue
+            seen.add(id(f))
+            if f.is_raw:
+                out.append(f)
+            else:
+                stack.extend(reversed(f.parents))
+        return out
+
+    def parent_stages(self) -> dict["Stage", int]:
+        """Origin stages with MAX distance from this feature (longest path), used to
+        layer the DAG (analog of FeatureLike.parentStages). Linear in V+E even on
+        diamond-shaped lineage: one DFS for cycle check + post-order, then a
+        longest-path DP over the reverse post-order."""
+        WHITE, GREY, BLACK = 0, 1, 2
+        color: dict[int, int] = {}
+        postorder: list[Feature] = []
+        stack: list[tuple[Feature, bool]] = [(self, False)]
+        while stack:
+            f, done = stack.pop()
+            fid = id(f)
+            if done:
+                color[fid] = BLACK
+                postorder.append(f)
+                continue
+            state = color.get(fid, WHITE)
+            if state != WHITE:
+                continue  # duplicate push from a sibling branch
+            color[fid] = GREY
+            stack.append((f, True))
+            for p in f.parents:
+                pstate = color.get(id(p), WHITE)
+                if pstate == GREY:
+                    # GREY = on the current DFS path -> back edge -> cycle
+                    raise FeatureCycleError(f"cycle through feature {p.name!r}")
+                if pstate == WHITE:
+                    stack.append((p, False))
+        # reverse post-order = topological order from self toward the leaves
+        depth: dict[int, int] = {id(self): 0}
+        stages: dict[int, tuple["Stage", int]] = {}
+        for f in reversed(postorder):
+            d = depth.get(id(f), 0)
+            if f.origin_stage is not None:
+                sid = id(f.origin_stage)
+                if sid not in stages or stages[sid][1] < d:
+                    stages[sid] = (f.origin_stage, d)
+            for p in f.parents:
+                pid = id(p)
+                if depth.get(pid, -1) < d + 1:
+                    depth[pid] = d + 1
+        return {stage: d for stage, d in stages.values()}
+
+    def all_features(self) -> list["Feature"]:
+        """Every feature in this feature's history (self included)."""
+        seen: set[int] = set()
+        out: list[Feature] = []
+        stack = [self]
+        while stack:
+            f = stack.pop()
+            if id(f) in seen:
+                continue
+            seen.add(id(f))
+            out.append(f)
+            stack.extend(f.parents)
+        return out
+
+    def pretty_lineage(self, indent: int = 0) -> str:
+        """Human-readable lineage tree (analog of prettyParentStages)."""
+        pad = "  " * indent
+        op = self.origin_stage.operation_name if self.origin_stage else "raw"
+        lines = [f"{pad}{self.name}: {self.kind.name} <- {op}"]
+        for p in self.parents:
+            lines.append(p.pretty_lineage(indent + 1))
+        return "\n".join(lines)
+
+    def history(self) -> dict:
+        """JSON-able lineage record (analog of FeatureHistory)."""
+        return {
+            "name": self.name,
+            "kind": self.kind.name,
+            "is_response": self.is_response,
+            "origin_stage": self.origin_stage.uid if self.origin_stage else None,
+            "parents": [p.name for p in self.parents],
+            "raw_features": [r.name for r in self.raw_features()],
+        }
+
+
+def validate_distinct_names(features: Iterable[Feature]) -> None:
+    seen: dict[str, Feature] = {}
+    for f in features:
+        if f.name in seen and seen[f.name] is not f:
+            raise ValueError(f"duplicate feature name {f.name!r} for distinct features")
+        seen[f.name] = f

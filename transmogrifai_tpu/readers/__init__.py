@@ -1,0 +1,27 @@
+"""Reader factories (analog of the reference DataReaders.Simple/Aggregate/Conditional
+factory surface, readers/.../DataReaders.scala:49-270). Aggregate/conditional/joined
+readers arrive with the segment-reduce aggregation layer."""
+from .base import DataReader, InMemoryReader, TableReader
+from .csv import CSVAutoReader, CSVReader, ParquetReader, infer_schema
+
+
+class Simple:
+    """Factory namespace mirroring DataReaders.Simple."""
+
+    csv = CSVReader
+    csv_auto = CSVAutoReader
+    parquet = ParquetReader
+    records = InMemoryReader
+    table = TableReader
+
+
+__all__ = [
+    "DataReader",
+    "InMemoryReader",
+    "TableReader",
+    "CSVReader",
+    "CSVAutoReader",
+    "ParquetReader",
+    "infer_schema",
+    "Simple",
+]

@@ -1,0 +1,122 @@
+"""Data readers: typed ingestion producing Tables.
+
+Analog of reference Reader/DataReader (readers/src/main/scala/com/salesforce/op/readers/
+DataReader.scala:173-197): `generate_table(raw_features)` maps records through every raw
+feature's extract function into typed Columns. The Spark RDD/Dataset plumbing is replaced
+by host-side columnar batches (numpy/pandas) that shard onto the device mesh downstream.
+
+A columnar fast path skips per-record Python when no custom extract functions are
+registered — the common case for file-backed schemas — so ingestion is vectorized
+numpy, not a Python loop.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..graph.feature import Feature
+from ..types import Column, Table
+
+
+class DataReader:
+    """Base reader: subclasses produce python records or columnar frames."""
+
+    #: set by aggregate/conditional readers that honor FeatureBuilder.aggregate
+    supports_aggregation = False
+
+    def __init__(self, key_fn: Optional[Callable[[Any], str]] = None):
+        self.key_fn = key_fn  # entity key (reference ReaderKey)
+
+    # --- subclass surface -------------------------------------------------------------
+    def read_records(self) -> list[Any]:
+        raise NotImplementedError
+
+    def read_columnar(self) -> Optional[dict[str, np.ndarray]]:
+        """Columnar fast path: name -> numpy array (object arrays allowed). Return None
+        if only record-wise reading is available."""
+        return None
+
+    # --- main entry (analog of DataReader.generateDataFrame) --------------------------
+    def generate_table(self, raw_features: Sequence[Feature]) -> Table:
+        gens = [f.origin_stage for f in raw_features]
+        aggregated = [f.name for f, g in zip(raw_features, gens) if g.aggregator is not None]
+        if aggregated and not self.supports_aggregation:
+            # loud failure instead of silently training on unaggregated rows
+            raise NotImplementedError(
+                f"features {aggregated} declare aggregators, but {type(self).__name__} "
+                "does not aggregate; use an aggregate reader"
+            )
+        custom = any(g.extract_fn is not None for g in gens)
+        columnar = None if custom else self.read_columnar()
+        if columnar is not None:
+            cols = {}
+            n = None
+            for f in raw_features:
+                name = f.name
+                if name not in columnar:
+                    raise KeyError(
+                        f"raw feature {name!r} missing from data; have {sorted(columnar)}"
+                    )
+                data = columnar[name]
+                n = len(data) if n is None else n
+                cols[name] = Column.build(f.kind, _np_to_values(data))
+            return Table(cols, n)
+        records = self.read_records()
+        cols = {}
+        for f, g in zip(raw_features, gens):
+            cols[f.name] = Column.build(f.kind, [g.extract(r) for r in records])
+        return Table(cols, len(records))
+
+    def keys(self) -> Optional[list[str]]:
+        if self.key_fn is None:
+            return None
+        return [str(self.key_fn(r)) for r in self.read_records()]
+
+
+def _np_to_values(arr: np.ndarray) -> list:
+    """numpy column -> python values with None for missing (NaN / pandas NA)."""
+    if arr.dtype == object:
+        out = []
+        for v in arr:
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                out.append(None)
+            else:
+                out.append(v)
+        return out
+    if np.issubdtype(arr.dtype, np.floating):
+        return [None if np.isnan(v) else float(v) for v in arr]
+    if np.issubdtype(arr.dtype, np.bool_):
+        return [bool(v) for v in arr]
+    if np.issubdtype(arr.dtype, np.integer):
+        return [int(v) for v in arr]
+    return list(arr)
+
+
+class InMemoryReader(DataReader):
+    """Reader over python records (analog of CustomReader wrapping an existing Dataset,
+    OpWorkflowCore.scala:146-160)."""
+
+    def __init__(self, records: Iterable[Any], key_fn=None):
+        super().__init__(key_fn)
+        self._records = list(records)
+
+    def read_records(self) -> list[Any]:
+        return self._records
+
+
+class TableReader(DataReader):
+    """Reader over an already-built Table (workflow.set_input_table path)."""
+
+    def __init__(self, table: Table):
+        super().__init__()
+        self.table = table
+
+    def read_records(self) -> list[Any]:
+        return self.table.to_rows()
+
+    def generate_table(self, raw_features: Sequence[Feature]) -> Table:
+        missing = [f.name for f in raw_features if f.name not in self.table]
+        if missing:
+            raise KeyError(f"raw features {missing} missing from input table")
+        return self.table.select([f.name for f in raw_features])

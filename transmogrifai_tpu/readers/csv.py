@@ -1,0 +1,197 @@
+"""CSV ingestion with explicit or auto-inferred schemas.
+
+Analog of reference CSVReaders.scala (explicit Avro schema) and CSVAutoReaders.scala:58-77
+(schema inference via CSVSchemaUtils.infer). Parquet support piggybacks on the same
+columnar path via pyarrow.
+"""
+from __future__ import annotations
+
+import csv as _csv
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..types import FeatureKind, kind_of
+from .base import DataReader
+
+_TRUE = {"true", "t", "yes", "y", "1"}
+_FALSE = {"false", "f", "no", "n", "0"}
+
+
+def infer_schema(
+    rows: Sequence[dict],
+    *,
+    max_categorical_cardinality: int = 100,
+    id_fields: Sequence[str] = (),
+) -> dict[str, str]:
+    """Infer a {name: kind-name} schema from sampled string records
+    (analog of CSVSchemaUtils.infer used by csvAuto / the codegen CLI)."""
+    if not rows:
+        return {}
+    names = list(rows[0].keys())
+    schema: dict[str, str] = {}
+    for name in names:
+        vals = [r.get(name) for r in rows]
+        present = [v for v in vals if v is not None and v != ""]
+        if not present:
+            schema[name] = "Text"
+            continue
+        if name in id_fields:
+            schema[name] = "ID"
+            continue
+        sv = [str(v) for v in present]
+        lower = set(s.lower() for s in sv)
+        word_bool = _TRUE.union(_FALSE) - {"0", "1"}
+        # word-booleans, or 0/1 with BOTH present (a constant 0/1 column stays Integral)
+        if lower <= word_bool or lower == {"0", "1"}:
+            schema[name] = "Binary"
+        elif all(_is_int(s) for s in sv):
+            schema[name] = "Integral"
+        elif all(_is_float(s) for s in sv):
+            schema[name] = "Real"
+        else:
+            distinct = len(set(sv))
+            if distinct <= max_categorical_cardinality and distinct < max(2, len(sv)) / 2:
+                schema[name] = "PickList"
+            else:
+                schema[name] = "Text"
+    return schema
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _is_float(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _parse(value: Optional[str], kind: FeatureKind):
+    if value is None or value == "":
+        return None
+    st = kind.storage.value
+    if st == "real":
+        return float(value)
+    if st in ("integral", "date"):
+        try:
+            return int(value)  # exact: no float round-trip (int64 IDs stay exact)
+        except ValueError:
+            f = float(value)
+            if not f.is_integer():
+                raise ValueError(
+                    f"cannot parse {value!r} as {kind.name}: not an integer"
+                ) from None
+            return int(f)
+    if st == "binary":
+        return value.strip().lower() in _TRUE
+    return value
+
+
+class CSVReader(DataReader):
+    """CSV file -> typed records/columns.
+
+    schema: {column-name: kind-name}; column order in the file maps to `field_names`
+    when the file is headerless (reference CSV readers take an Avro schema for this).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        schema: dict[str, str],
+        *,
+        has_header: bool = True,
+        field_names: Optional[Sequence[str]] = None,
+        key_field: Optional[str] = None,
+    ):
+        super().__init__(
+            key_fn=(lambda r: r[key_field]) if key_field else None
+        )
+        self.path = path
+        self.schema = {k: kind_of(v) if isinstance(v, str) else v for k, v in schema.items()}
+        self.has_header = has_header
+        self.field_names = list(field_names) if field_names else None
+        self._cache: Optional[list[dict]] = None
+
+    def _raw_rows(self, limit: Optional[int] = None) -> list[dict]:
+        from itertools import islice
+
+        with open(self.path, newline="") as fh:
+            if self.has_header:
+                reader = _csv.DictReader(fh)
+                rows = [dict(r) for r in islice(reader, limit)]
+            else:
+                names = self.field_names
+                if names is None:
+                    raise ValueError("headerless CSV requires field_names")
+                rows = [dict(zip(names, rec)) for rec in islice(_csv.reader(fh), limit)]
+        return rows
+
+    def read_records(self) -> list[dict]:
+        if self._cache is None:
+            self._cache = [
+                {name: _parse(r.get(name), kind) for name, kind in self.schema.items()}
+                for r in self._raw_rows()
+            ]
+        return self._cache
+
+    def read_columnar(self) -> dict[str, np.ndarray]:
+        records = self.read_records()
+        out = {}
+        for name in self.schema:
+            arr = np.empty(len(records), dtype=object)
+            for i, r in enumerate(records):
+                arr[i] = r[name]
+            out[name] = arr
+        return out
+
+
+class CSVAutoReader(CSVReader):
+    """CSV with auto-inferred schema (analog of CSVAutoReaders.scala:58-77)."""
+
+    def __init__(self, path: str, *, has_header: bool = True,
+                 field_names: Optional[Sequence[str]] = None,
+                 key_field: Optional[str] = None,
+                 sample_rows: int = 1000,
+                 id_fields: Sequence[str] = ()):
+        super().__init__(path, {}, has_header=has_header, field_names=field_names,
+                         key_field=key_field)
+        raw = self._raw_rows(limit=sample_rows)
+        inferred = infer_schema(
+            [{k: (None if v == "" else v) for k, v in r.items()} for r in raw],
+            id_fields=id_fields,
+        )
+        self.schema = {k: kind_of(v) for k, v in inferred.items()}
+
+
+class ParquetReader(DataReader):
+    """Parquet via pyarrow (analog of ParquetProductReader.scala)."""
+
+    def __init__(self, path: str, schema: Optional[dict[str, str]] = None,
+                 key_field: Optional[str] = None):
+        super().__init__(key_fn=(lambda r: r[key_field]) if key_field else None)
+        self.path = path
+        self.schema = {k: kind_of(v) for k, v in schema.items()} if schema else None
+
+    def _arrow_table(self):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(self.path)
+
+    def read_columnar(self) -> dict[str, np.ndarray]:
+        tbl = self._arrow_table()
+        out = {}
+        for name in tbl.column_names:
+            out[name] = np.asarray(tbl.column(name).to_pylist(), dtype=object)
+        return out
+
+    def read_records(self) -> list[dict]:
+        tbl = self._arrow_table()
+        return tbl.to_pylist()

@@ -1,0 +1,21 @@
+from .base import (
+    STAGE_REGISTRY,
+    Estimator,
+    FeatureGeneratorStage,
+    LambdaTransformer,
+    Stage,
+    Transformer,
+    adopt_wiring,
+    register_stage,
+)
+
+__all__ = [
+    "Stage",
+    "Transformer",
+    "Estimator",
+    "FeatureGeneratorStage",
+    "LambdaTransformer",
+    "STAGE_REGISTRY",
+    "register_stage",
+    "adopt_wiring",
+]

@@ -1,0 +1,227 @@
+"""Stage abstraction: pure-function transformers and fit-point estimators.
+
+TPU-native analog of OpPipelineStageBase and its arity-typed subclasses (reference
+features/src/main/scala/com/salesforce/op/stages/OpPipelineStages.scala:56-553,
+base/unary/UnaryTransformer.scala:104, base/sequence/SequenceEstimator.scala:57).
+
+Design mapping (SURVEY.md §2.3):
+  - Transformer = pure function (params, *input_columns) -> output_column. Stages whose
+    kernel is pure jnp on device columns set `device_op = True`; a workflow layer of such
+    stages is traced into ONE jit-compiled XLA program (no per-stage dispatch, no
+    persist-every-K — XLA fuses).
+  - Estimator = fit(columns) -> fitted params (a jnp reduction), producing a Model
+    transformer that replaces it in the DAG (the FitStagesUtil estimator->model swap).
+  - Arity is by input count validation, not type-level traits; `out_kind` is the
+    transformSchema analog so the graph type-checks before any tracing.
+  - `transform_columns` doubles as the row-level scoring path (OpTransformer.transformRow
+    analog): local serving jits the same kernels — no MLeap-style conversion layer.
+
+Serialization: every concrete stage class registers itself by name; to_json captures ctor
+params (no reflection — explicit `params` dict), fitted state is a jnp pytree checkpoint.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ..graph.feature import Feature
+from ..types import Column, FeatureKind, Table, kind_of
+from ..utils import uid as make_uid
+
+#: class-name -> stage class (replaces the reference's reflection-based loader,
+#: OpPipelineStageReader.scala:52+)
+STAGE_REGISTRY: dict[str, type] = {}
+
+
+def register_stage(cls):
+    """Class decorator: add to the serialization registry."""
+    STAGE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Stage:
+    """Base of all pipeline stages (analog of OpPipelineStageBase)."""
+
+    #: human-readable operation name (reference operationName)
+    operation_name: str = "stage"
+    #: kernel runs in pure jnp on device columns (eligible for layer fusion)
+    device_op: bool = False
+    #: (min, max) accepted input count; max None = unbounded (Sequence stages)
+    arity: tuple[int, Optional[int]] = (1, 1)
+
+    def __init__(self, **params):
+        self.uid = make_uid(type(self).__name__)
+        self.params: dict[str, Any] = dict(params)
+        self.inputs: tuple[Feature, ...] = ()
+        self._output: Optional[Feature] = None
+
+    # --- wiring (analog of setInput/getOutput) ----------------------------------------
+    def __call__(self, *features: Feature) -> Feature:
+        return self.set_input(*features)
+
+    def set_input(self, *features: Feature) -> Feature:
+        if self._output is not None:
+            # one stage instance = one DAG node; silent re-wiring would orphan the
+            # first output feature (the reference enforces distinct stage instances,
+            # OpWorkflow.scala:280-309)
+            raise ValueError(
+                f"{self} already wired to inputs; create a new stage instance"
+            )
+        lo, hi = self.arity
+        if len(features) < lo or (hi is not None and len(features) > hi):
+            raise ValueError(
+                f"{type(self).__name__} takes {lo}..{hi if hi is not None else 'N'} "
+                f"inputs, got {len(features)}"
+            )
+        self.inputs = tuple(features)
+        out_kind = self.out_kind([f.kind for f in features])
+        self._output = Feature(
+            self.make_output_name(),
+            out_kind,
+            is_response=self.is_response_out(),
+            origin_stage=self,
+            parents=self.inputs,
+        )
+        return self._output
+
+    def get_output(self) -> Feature:
+        if self._output is None:
+            raise ValueError(f"{self} has no inputs set")
+        return self._output
+
+    def is_response_out(self) -> bool:
+        return any(f.is_response for f in self.inputs)
+
+    def make_output_name(self) -> str:
+        base = self.inputs[0].name if self.inputs else self.operation_name
+        return f"{base}_{self.operation_name}_{self.uid.rsplit('_', 1)[1].lstrip('0') or '0'}"
+
+    # --- schema (analog of transformSchema / outputTypeTag) ---------------------------
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        """Output kind given input kinds; raise for invalid inputs. Runs at graph
+        construction, long before tracing."""
+        raise NotImplementedError
+
+    # --- serialization ----------------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "class": type(self).__name__,
+            "uid": self.uid,
+            "operation": self.operation_name,
+            "params": _jsonify(self.params),
+            "inputs": [f.name for f in self.inputs],
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "Stage":
+        klass = STAGE_REGISTRY[data["class"]]
+        stage = klass(**data["params"])
+        stage.uid = data["uid"]
+        return stage
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.uid})"
+
+
+class Transformer(Stage):
+    """A stage with no fit step (analog of OpTransformer concrete bases)."""
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        """Map input columns to the output column. For device_op stages this must be
+        pure jnp (traceable); host stages may use numpy/object arrays."""
+        raise NotImplementedError
+
+    def transform_table(self, table: Table) -> Table:
+        out = self.transform_columns([table[f.name] for f in self.inputs])
+        return table.with_column(self.get_output().name, out)
+
+
+class Estimator(Stage):
+    """A stage that learns parameters from data before transforming
+    (analog of UnaryEstimator/SequenceEstimator; fit = jnp reduction)."""
+
+    def fit_columns(self, cols: Sequence[Column]) -> Transformer:
+        """Fit and return the fitted Model transformer. The returned transformer's
+        inputs/output are re-pointed at this estimator's features so it can replace
+        the estimator in the DAG (FitStagesUtil.scala:254-293 swap)."""
+        raise NotImplementedError
+
+    def fit_table(self, table: Table) -> Transformer:
+        model = self.fit_columns([table[f.name] for f in self.inputs])
+        adopt_wiring(self, model)
+        return model
+
+
+def adopt_wiring(estimator: Stage, model: Stage) -> None:
+    """Point a fitted model at its estimator's graph wiring: same inputs, same output
+    feature (the DAG node keeps its identity across the estimator->model swap)."""
+    model.inputs = estimator.inputs
+    model._output = estimator._output
+
+
+class LambdaTransformer(Transformer):
+    """Ad-hoc unary..N-ary transformer from a plain function over Columns
+    (analog of the dsl `map`/`transformWith` shortcut, RichFeature.scala:61-215).
+    Not JSON-serializable unless the function is registered by name."""
+
+    operation_name = "lambda"
+
+    def __init__(self, fn: Callable, out: FeatureKind | str, *, device_op: bool = False,
+                 n_inputs: int = 1, fn_name: Optional[str] = None):
+        super().__init__(fn_name=fn_name)
+        self.fn = fn
+        self._out = kind_of(out) if isinstance(out, str) else out
+        self.device_op = device_op
+        self.arity = (n_inputs, n_inputs)
+
+    def out_kind(self, in_kinds):
+        return self._out
+
+    def transform_columns(self, cols):
+        return self.fn(*cols)
+
+
+class FeatureGeneratorStage(Stage):
+    """Stage 0 of every raw feature: holds the record->value extract function and the
+    optional monoid aggregator (reference stages/FeatureGeneratorStage.scala:61-94).
+    Readers invoke it during ingestion; it never runs on device."""
+
+    operation_name = "raw"
+    arity = (0, 0)
+
+    def __init__(self, feature_name: str, kind_name: str, **params):
+        super().__init__(feature_name=feature_name, kind_name=kind_name, **params)
+        self.extract_fn: Optional[Callable] = None
+        self.aggregator = None  # set by FeatureBuilder.aggregate
+
+    def out_kind(self, in_kinds):
+        return kind_of(self.params["kind_name"])
+
+    def make_output_name(self) -> str:
+        return self.params["feature_name"]
+
+    def extract(self, record: Any) -> Any:
+        name = self.params["feature_name"]
+        if self.extract_fn is not None:
+            return self.extract_fn(record)
+        if isinstance(record, dict):
+            return record.get(name)
+        return getattr(record, name, None)
+
+
+def _jsonify(obj):
+    """Best-effort conversion of stage params to JSON-able values."""
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if callable(obj) and not isinstance(obj, type):
+        return getattr(obj, "__name__", "<fn>")
+    return obj

@@ -126,10 +126,7 @@ class Column:
                     vals[i, :] = np.asarray(d, dtype=np.float32)
             return Column(kind, jnp.asarray(vals), jnp.asarray(mask))
         if st is Storage.VECTOR:
-            vals = np.asarray(data, dtype=np.float32)
-            if vals.ndim != 2:
-                raise ValueError(f"OPVector data must be [N, D], got shape {vals.shape}")
-            return Column(kind, jnp.asarray(vals), None, schema=None)
+            return Column.vector(np.asarray(data, dtype=np.float32))
         if st is Storage.PREDICTION:
             raise ValueError("use Column.prediction(...) to build Prediction columns")
         # host storage
@@ -174,7 +171,10 @@ class Column:
         elif probability is None:
             raw = _as_2d(raw_prediction)
             raw_prediction = raw
-            probability = jax.nn.softmax(raw, axis=-1) if raw.shape[-1] > 1 else raw
+            # multi-logit -> softmax; single logit -> sigmoid (binary margin)
+            probability = (
+                jax.nn.softmax(raw, axis=-1) if raw.shape[-1] > 1 else jax.nn.sigmoid(raw)
+            )
         elif raw_prediction is None:
             prob = _as_2d(probability)
             probability = prob
@@ -302,7 +302,10 @@ def concat_columns(cols: Sequence[Column]) -> Column:
         }
         return Column(k, vals, None)
     if not k.on_device:
-        mask = None if cols[0].mask is None else np.concatenate([c.mask for c in cols])
+        if all(c.mask is None for c in cols):
+            mask = None
+        else:
+            mask = np.concatenate([np.asarray(c.effective_mask()) for c in cols])
         return Column(k, np.concatenate([c.values for c in cols]), mask)
     if k.storage is Storage.VECTOR and any(c.schema != cols[0].schema for c in cols):
         raise ValueError("cannot row-concat vector columns with differing schemas")
