@@ -5,18 +5,33 @@ Analog of the reference's TestSparkContext local[2] harness
 behavior (sharding, collectives) is exercised on 8 virtual CPU devices so suites run
 anywhere; the same code paths run on real TPU meshes.
 
-Must set env vars BEFORE jax is imported anywhere.
+Env vars must be set BEFORE the first jax backend initialization. Note: a TPU relay
+plugin (sitecustomize) may force jax_platforms at import time via jax.config — an env
+var alone is NOT enough, so we update jax.config explicitly as well.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on CPU, got {devs}"
+    assert len(devs) == 8, f"expected 8 fake devices, got {len(devs)}"
+    yield
 
 
 @pytest.fixture

@@ -1,0 +1,385 @@
+"""Vectorizer tests (mirror of the reference's per-stage specs under
+core/src/test/.../impl/feature/)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.stages.feature import (
+    BinaryVectorizer,
+    DateListVectorizer,
+    DateToUnitCircleVectorizer,
+    DropIndicesTransformer,
+    FillMissingWithMean,
+    GeolocationVectorizer,
+    HashingVectorizer,
+    IndexToString,
+    IntegralVectorizer,
+    MapVectorizer,
+    MultiPickListVectorizer,
+    NumericBucketizer,
+    OneHotVectorizer,
+    RealVectorizer,
+    SmartTextVectorizer,
+    StandardScaler,
+    StringIndexer,
+    TextTokenizer,
+    VectorsCombiner,
+    transmogrify,
+)
+from transmogrifai_tpu.types import NULL_INDICATOR, OTHER_INDICATOR, Column, Table
+
+
+def tbl(rows, kinds):
+    return Table.from_rows(rows, kinds)
+
+
+class TestRealVectorizer:
+    def test_mean_fill_and_null_track(self):
+        f = FeatureBuilder.Real("x").as_predictor()
+        est = RealVectorizer()
+        out = est(f)
+        t = tbl([{"x": 1.0}, {"x": None}, {"x": 3.0}], {"x": "Real"})
+        model = est.fit_table(t)
+        vec = model.transform_table(t)[out.name]
+        assert vec.to_list() == [[1.0, 0.0], [2.0, 1.0], [3.0, 0.0]]
+        assert vec.schema.column_names() == ["x", f"x_{NULL_INDICATOR}"]
+
+    def test_multi_input_sequence(self):
+        fs = features_from_schema({"a": "Real", "b": "Currency"})
+        est = RealVectorizer(track_nulls=False)
+        out = est(fs["a"], fs["b"])
+        t = tbl([{"a": 1.0, "b": 10.0}], {"a": "Real", "b": "Currency"})
+        vec = est.fit_table(t).transform_table(t)[out.name]
+        assert vec.to_list() == [[1.0, 10.0]]
+
+    def test_rejects_wrong_kind(self):
+        f = FeatureBuilder.Text("t").as_predictor()
+        with pytest.raises(TypeError, match="accepts"):
+            RealVectorizer()(f)
+
+
+class TestIntegralVectorizer:
+    def test_mode_fill(self):
+        f = FeatureBuilder.Integral("n").as_predictor()
+        est = IntegralVectorizer()
+        out = est(f)
+        t = tbl([{"n": 5}, {"n": 5}, {"n": None}, {"n": 2}], {"n": "Integral"})
+        vec = est.fit_table(t).transform_table(t)[out.name]
+        assert vec.to_list() == [[5.0, 0.0], [5.0, 0.0], [5.0, 1.0], [2.0, 0.0]]
+
+
+class TestBinaryVectorizer:
+    def test_fill_false_and_track(self):
+        f = FeatureBuilder.Binary("b").as_predictor()
+        st = BinaryVectorizer()
+        out = st(f)
+        t = tbl([{"b": True}, {"b": None}, {"b": False}], {"b": "Binary"})
+        vec = st.transform_table(t)[out.name]
+        assert vec.to_list() == [[1.0, 0.0], [0.0, 1.0], [0.0, 0.0]]
+
+
+class TestOneHot:
+    def test_pivot_topk_other_null(self):
+        f = FeatureBuilder.PickList("c").as_predictor()
+        est = OneHotVectorizer(top_k=2, min_support=1)
+        out = est(f)
+        rows = [{"c": v} for v in ["a", "a", "a", "b", "b", "z", None]]
+        t = tbl(rows, {"c": "PickList"})
+        model = est.fit_table(t)
+        vec = model.transform_table(t)[out.name]
+        names = vec.schema.column_names()
+        assert names == ["c_a", "c_b", f"c_{OTHER_INDICATOR}", f"c_{NULL_INDICATOR}"]
+        arr = np.asarray(vec.values)
+        assert arr[0].tolist() == [1, 0, 0, 0]
+        assert arr[3].tolist() == [0, 1, 0, 0]
+        assert arr[5].tolist() == [0, 0, 1, 0]  # "z" -> OTHER
+        assert arr[6].tolist() == [0, 0, 0, 1]  # null
+
+    def test_min_support_filters(self):
+        f = FeatureBuilder.PickList("c").as_predictor()
+        est = OneHotVectorizer(top_k=10, min_support=3)
+        est(f)
+        rows = [{"c": v} for v in ["a"] * 3 + ["b"]]
+        model = est.fit_table(tbl(rows, {"c": "PickList"}))
+        assert model.params["categories"][0] == ["a"]
+
+
+class TestStringIndexer:
+    def test_frequency_order_and_roundtrip(self):
+        f = FeatureBuilder.PickList("c").as_predictor()
+        est = StringIndexer(handle_invalid="keep")
+        out = est(f)
+        rows = [{"c": v} for v in ["b", "a", "b", "b", "a", "c"]]
+        t = tbl(rows, {"c": "PickList"})
+        model = est.fit_table(t)
+        assert model.labels == ["b", "a", "c"]
+        idx = model.transform_table(t)[out.name]
+        assert idx.to_list() == [0.0, 1.0, 0.0, 0.0, 1.0, 2.0]
+        inv = IndexToString(labels=model.labels)
+        f2 = FeatureBuilder.RealNN("i").as_predictor()
+        out2 = inv(f2)
+        t2 = tbl([{"i": 0.0}, {"i": 2.0}], {"i": "RealNN"})
+        assert inv.transform_table(t2)[out2.name].to_list() == ["b", "c"]
+
+
+class TestText:
+    def test_tokenize(self):
+        f = FeatureBuilder.Text("t").as_predictor()
+        st = TextTokenizer()
+        out = st(f)
+        t = tbl([{"t": "Hello, TPU world!"}, {"t": None}], {"t": "Text"})
+        assert st.transform_table(t)[out.name].to_list() == [["hello", "tpu", "world"], []]
+
+    def test_hashing_deterministic_and_counts(self):
+        f = FeatureBuilder.Text("t").as_predictor()
+        st = HashingVectorizer(num_features=16)
+        out = st(f)
+        t = tbl([{"t": "a b a"}, {"t": "c"}], {"t": "Text"})
+        vec = np.asarray(st.transform_table(t)[out.name].values)
+        assert vec.shape == (2, 16)
+        assert vec[0].sum() == 3.0  # two 'a' + one 'b'
+        assert vec[0].max() == 2.0
+        # determinism
+        st2 = HashingVectorizer(num_features=16)
+        out2 = st2(FeatureBuilder.Text("t").as_predictor())
+        vec2 = np.asarray(st2.transform_table(t)[out2.name].values)
+        assert np.array_equal(vec, vec2)
+
+    def test_smart_text_pivots_low_cardinality(self):
+        f = FeatureBuilder.Text("t").as_predictor()
+        est = SmartTextVectorizer(max_cardinality=5, min_support=1, num_features=8)
+        est(f)
+        rows = [{"t": v} for v in ["x", "y", "x", "y"]]
+        model = est.fit_table(tbl(rows, {"t": "Text"}))
+        assert model.params["plans"][0]["mode"] == "pivot"
+
+    def test_smart_text_hashes_high_cardinality(self):
+        f = FeatureBuilder.Text("t").as_predictor()
+        est = SmartTextVectorizer(max_cardinality=3, num_features=8)
+        out = est(f)
+        rows = [{"t": f"val {i}"} for i in range(10)]
+        t = tbl(rows, {"t": "Text"})
+        model = est.fit_table(t)
+        assert model.params["plans"][0]["mode"] == "hash"
+        vec = model.transform_table(t)[out.name]
+        assert np.asarray(vec.values).shape == (10, 9)  # 8 hash + null indicator
+
+
+class TestDates:
+    def test_unit_circle(self):
+        f = FeatureBuilder.Date("d").as_predictor()
+        st = DateToUnitCircleVectorizer(time_periods=["HourOfDay"], track_nulls=True)
+        out = st(f)
+        # 1970-01-01T06:00 -> quarter day -> angle pi/2 -> (sin, cos) = (1, 0)
+        t = tbl([{"d": 6 * 3_600_000}, {"d": None}], {"d": "Date"})
+        vec = np.asarray(st.transform_table(t)[out.name].values)
+        assert vec[0, 0] == pytest.approx(1.0, abs=1e-5)
+        assert vec[0, 1] == pytest.approx(0.0, abs=1e-5)
+        assert vec[1].tolist() == [0.0, 0.0, 1.0]
+
+    def test_day_of_week(self):
+        f = FeatureBuilder.Date("d").as_predictor()
+        st = DateToUnitCircleVectorizer(time_periods=["DayOfWeek"], track_nulls=False)
+        out = st(f)
+        # 1970-01-05 was a Monday -> fraction 0 -> (sin,cos)=(0,1)
+        t = tbl([{"d": 4 * 86_400_000}], {"d": "Date"})
+        vec = np.asarray(st.transform_table(t)[out.name].values)
+        assert vec[0].tolist() == pytest.approx([0.0, 1.0], abs=1e-5)
+
+
+class TestCollections:
+    def test_multipicklist(self):
+        f = FeatureBuilder.MultiPickList("s").as_predictor()
+        est = MultiPickListVectorizer(top_k=2, min_support=1)
+        out = est(f)
+        rows = [{"s": {"a", "b"}}, {"s": {"a"}}, {"s": None}]
+        t = tbl(rows, {"s": "MultiPickList"})
+        vec = est.fit_table(t).transform_table(t)[out.name]
+        arr = np.asarray(vec.values)
+        names = vec.schema.column_names()
+        assert set(names) == {"s_a", "s_b", f"s_{OTHER_INDICATOR}", f"s_{NULL_INDICATOR}"}
+        assert arr[0, :2].sum() == 2.0
+        assert arr[2, 3] == 1.0
+
+    def test_geolocation(self):
+        f = FeatureBuilder.Geolocation("g").as_predictor()
+        est = GeolocationVectorizer()
+        out = est(f)
+        rows = [{"g": [10.0, 20.0, 1.0]}, {"g": None}]
+        t = tbl(rows, {"g": "Geolocation"})
+        vec = np.asarray(est.fit_table(t).transform_table(t)[out.name].values)
+        assert vec[1, :3].tolist() == [10.0, 20.0, 1.0]  # filled with mean of present
+        assert vec[1, 3] == 1.0
+
+
+class TestMaps:
+    def test_real_map(self):
+        f = FeatureBuilder.RealMap("m").as_predictor()
+        est = MapVectorizer()
+        out = est(f)
+        rows = [{"m": {"a": 1.0, "b": 2.0}}, {"m": {"a": 3.0}}]
+        t = tbl(rows, {"m": "RealMap"})
+        vec = est.fit_table(t).transform_table(t)[out.name]
+        names = vec.schema.column_names()
+        assert names == ["m_a", f"m_a_{NULL_INDICATOR}", "m_b", f"m_b_{NULL_INDICATOR}"]
+        arr = np.asarray(vec.values)
+        assert arr[1].tolist() == [3.0, 0.0, 2.0, 1.0]  # b missing -> mean fill 2.0 + null
+
+    def test_text_map_pivot(self):
+        f = FeatureBuilder.TextMap("m").as_predictor()
+        est = MapVectorizer(top_k=5, min_support=1)
+        out = est(f)
+        rows = [{"m": {"k": "x"}}, {"m": {"k": "y"}}, {"m": {}}]
+        t = tbl(rows, {"m": "TextMap"})
+        vec = est.fit_table(t).transform_table(t)[out.name]
+        arr = np.asarray(vec.values)
+        names = vec.schema.column_names()
+        assert "m_k_x" in names and "m_k_y" in names
+        assert arr[2, names.index(f"m_k_{NULL_INDICATOR}")] == 1.0
+
+    def test_binary_map_and_block_keys(self):
+        f = FeatureBuilder.BinaryMap("m").as_predictor()
+        est = MapVectorizer(block_keys=["secret"])
+        out = est(f)
+        rows = [{"m": {"ok": True, "secret": False}}, {"m": {"ok": False}}]
+        t = tbl(rows, {"m": "BinaryMap"})
+        vec = est.fit_table(t).transform_table(t)[out.name]
+        assert all("secret" not in n for n in vec.schema.column_names())
+        arr = np.asarray(vec.values)
+        assert arr[0, 0] == 1.0 and arr[1, 1] == 1.0
+
+
+class TestScalersAndBuckets:
+    def test_standard_scaler_vector(self):
+        f = FeatureBuilder.OPVector("v").as_predictor()
+        est = StandardScaler()
+        out = est(f)
+        t = Table({"v": Column.vector([[1.0, 10.0], [3.0, 30.0]])})
+        scaled = np.asarray(est.fit_table(t).transform_table(t)[out.name].values)
+        assert scaled.mean(axis=0) == pytest.approx([0.0, 0.0], abs=1e-6)
+        assert scaled[0].tolist() == pytest.approx([-1.0, -1.0])
+
+    def test_standard_scaler_masked_nulls(self):
+        f = FeatureBuilder.Real("x").as_predictor()
+        est = StandardScaler()
+        out = est(f)
+        t = tbl([{"x": 1.0}, {"x": None}, {"x": 3.0}], {"x": "Real"})
+        scaled = est.fit_table(t).transform_table(t)[out.name]
+        vals = np.asarray(scaled.values)
+        assert np.isfinite(vals).all()
+        assert vals[1] == pytest.approx(0.0)  # missing -> mean -> 0 after centering
+
+    def test_drop_all_indices(self):
+        v = FeatureBuilder.OPVector("v").as_predictor()
+        st = DropIndicesTransformer(drop_indices=[0, 1])
+        out = st(v)
+        t = Table({"v": Column.vector([[1.0, 2.0]])})
+        vec = st.transform_table(t)[out.name]
+        assert np.asarray(vec.values).shape == (1, 0)
+
+    def test_date_list_reference_fixed_at_fit(self):
+        f = FeatureBuilder.DateList("d").as_predictor()
+        est = DateListVectorizer()
+        out = est(f)
+        day = 86_400_000
+        train = tbl([{"d": [5 * day]}, {"d": [10 * day]}], {"d": "DateList"})
+        model = est.fit_table(train)
+        assert model.params["reference_date_ms"] == 10 * day
+        # scoring a batch with later events must still anchor to the FIT reference
+        score = tbl([{"d": [5 * day]}], {"d": "DateList"})
+        vec = np.asarray(model.transform_table(score)[out.name].values)
+        assert vec[0, 0] == pytest.approx(5.0)  # days since last vs fit ref
+
+    def test_fill_missing_with_mean(self):
+        f = FeatureBuilder.Real("x").as_predictor()
+        est = FillMissingWithMean()
+        out = est(f)
+        t = tbl([{"x": 2.0}, {"x": None}, {"x": 4.0}], {"x": "Real"})
+        filled = est.fit_table(t).transform_table(t)[out.name]
+        assert filled.to_list() == [2.0, 3.0, 4.0]
+        assert out.kind.name == "RealNN"
+
+    def test_bucketizer(self):
+        f = FeatureBuilder.Real("x").as_predictor()
+        st = NumericBucketizer(splits=[0.0, 10.0, 100.0], track_nulls=True)
+        out = st(f)
+        t = tbl([{"x": 5.0}, {"x": 50.0}, {"x": None}, {"x": -1.0}], {"x": "Real"})
+        arr = np.asarray(st.transform_table(t)[out.name].values)
+        assert arr[0].tolist() == [1, 0, 0]
+        assert arr[1].tolist() == [0, 1, 0]
+        assert arr[2].tolist() == [0, 0, 1]
+        assert arr[3].tolist() == [0, 0, 0]  # out of range, untracked
+
+    def test_bucketizer_validates_splits(self):
+        with pytest.raises(ValueError, match="ascending"):
+            NumericBucketizer(splits=[3.0, 1.0])
+
+
+class TestCombinerAndDrop:
+    def test_combine_schemas(self):
+        v1 = FeatureBuilder.OPVector("v1").as_predictor()
+        v2 = FeatureBuilder.OPVector("v2").as_predictor()
+        comb = VectorsCombiner()
+        out = comb(v1, v2)
+        t = Table({
+            "v1": Column.vector([[1.0], [2.0]]),
+            "v2": Column.vector([[3.0, 4.0], [5.0, 6.0]]),
+        })
+        vec = comb.transform_table(t)[out.name]
+        assert np.asarray(vec.values).tolist() == [[1, 3, 4], [2, 5, 6]]
+        assert vec.schema.size == 3
+
+    def test_drop_indices(self):
+        v = FeatureBuilder.OPVector("v").as_predictor()
+        st = DropIndicesTransformer(drop_indices=[1])
+        out = st(v)
+        t = Table({"v": Column.vector([[1.0, 2.0, 3.0]])})
+        vec = st.transform_table(t)[out.name]
+        assert np.asarray(vec.values).tolist() == [[1.0, 3.0]]
+
+
+class TestTransmogrify:
+    def test_mixed_features_end_to_end(self):
+        from transmogrifai_tpu.graph import compute_dag
+        from transmogrifai_tpu.stages import Estimator
+
+        schema = {
+            "age": "Real", "n": "Integral", "flag": "Binary", "cat": "PickList",
+            "txt": "Text", "d": "Date", "tags": "MultiPickList", "m": "RealMap",
+        }
+        fs = features_from_schema(schema)
+        vector = transmogrify(list(fs.values()))
+        assert vector.kind.name == "OPVector"
+        rows = [
+            {"age": 30.0, "n": 1, "flag": True, "cat": "a", "txt": "hello world",
+             "d": 10 * 86_400_000, "tags": {"t1"}, "m": {"k": 1.0}},
+            {"age": None, "n": None, "flag": None, "cat": None, "txt": None,
+             "d": None, "tags": None, "m": None},
+        ]
+        t = Table.from_rows(rows, schema)
+        # fit the two-layer dag by hand (workflow engine arrives next)
+        dag = compute_dag([vector])
+        for layer in dag:
+            for stage in layer:
+                if isinstance(stage, Estimator):
+                    model = stage.fit_table(t)
+                    t = model.transform_table(t)
+                else:
+                    t = stage.transform_table(t)
+        vec = t[vector.name]
+        arr = np.asarray(vec.values)
+        assert arr.shape[0] == 2
+        assert arr.shape[1] == vec.schema.size
+        assert arr.shape[1] > 10
+        parents = {s.parent_feature for s in vec.schema}
+        assert parents == set(schema)
+
+    def test_rejects_response(self):
+        fs = features_from_schema({"x": "Real", "y": "RealNN"}, response="y")
+        with pytest.raises(ValueError, match="response"):
+            transmogrify([fs["x"], fs["y"]])
+
+    def test_single_family_no_combiner(self):
+        fs = features_from_schema({"a": "Real", "b": "Real"})
+        v = transmogrify(list(fs.values()))
+        assert v.origin_stage.operation_name == "vecReal"

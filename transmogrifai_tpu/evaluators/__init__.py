@@ -1,0 +1,25 @@
+from .evaluators import (
+    BinaryClassificationEvaluator,
+    BinaryClassificationMetrics,
+    EvaluatorBase,
+    Evaluators,
+    MultiClassificationEvaluator,
+    MultiClassificationMetrics,
+    RegressionEvaluator,
+    RegressionMetrics,
+)
+from .metrics_ops import binary_curve_aucs, confusion_matrix, threshold_sweep
+
+__all__ = [
+    "Evaluators",
+    "EvaluatorBase",
+    "BinaryClassificationEvaluator",
+    "BinaryClassificationMetrics",
+    "MultiClassificationEvaluator",
+    "MultiClassificationMetrics",
+    "RegressionEvaluator",
+    "RegressionMetrics",
+    "binary_curve_aucs",
+    "confusion_matrix",
+    "threshold_sweep",
+]

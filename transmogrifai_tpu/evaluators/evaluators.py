@@ -1,0 +1,187 @@
+"""Evaluator stages: metrics over (label, Prediction) table columns.
+
+Analog of OpEvaluatorBase.evaluateAll + the three problem-type evaluators
+(core/.../evaluators/OpBinaryClassificationEvaluator.scala:56-180,
+OpMultiClassificationEvaluator.scala:89-269, OpRegressionEvaluator.scala:61-101,
+single-metric factories Evaluators.scala:40-310). Metrics are JSON-able dataclasses
+(EvaluationMetrics ADT analog).
+"""
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.feature import Feature
+from ..types import Table
+from .metrics_ops import (
+    binary_curve_aucs,
+    confusion_at,
+    confusion_matrix,
+    multiclass_prf,
+    prf,
+    regression_metrics_ops,
+    threshold_sweep,
+)
+
+
+@dataclass
+class BinaryClassificationMetrics:
+    """Reference BinaryClassificationMetrics fields (OpBinaryClassificationEvaluator)."""
+
+    AuROC: float
+    AuPR: float
+    Precision: float
+    Recall: float
+    F1: float
+    Error: float
+    TP: float
+    TN: float
+    FP: float
+    FN: float
+    thresholds: list = field(default_factory=list)
+    precision_by_threshold: list = field(default_factory=list)
+    recall_by_threshold: list = field(default_factory=list)
+    f1_by_threshold: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class MultiClassificationMetrics:
+    Precision: float
+    Recall: float
+    F1: float
+    Error: float
+    confusion: list = field(default_factory=list)
+    per_class_f1: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class RegressionMetrics:
+    RootMeanSquaredError: float
+    MeanSquaredError: float
+    MeanAbsoluteError: float
+    R2: float
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+class EvaluatorBase:
+    """Holds the (label, prediction) feature names to read from a scored Table."""
+
+    #: default metric used for model selection; sign says larger-is-better
+    default_metric: str = ""
+    larger_is_better: bool = True
+
+    def __init__(self, label: Feature | str, prediction: Feature | str):
+        self.label_col = label.name if isinstance(label, Feature) else label
+        self.pred_col = prediction.name if isinstance(prediction, Feature) else prediction
+
+    def _cols(self, table: Table):
+        if self.pred_col not in table:
+            raise KeyError(f"prediction column {self.pred_col!r} not in table")
+        if self.label_col not in table:
+            raise KeyError(f"label column {self.label_col!r} not in table")
+        return table[self.label_col], table[self.pred_col]
+
+    def evaluate_all(self, table: Table):
+        raise NotImplementedError
+
+    def metric_value(self, metrics) -> float:
+        return float(getattr(metrics, self.default_metric))
+
+
+class BinaryClassificationEvaluator(EvaluatorBase):
+    default_metric = "AuPR"  # the reference Titanic flow selects on AuPR
+
+    def __init__(self, label, prediction, threshold: float = 0.5,
+                 sweep_thresholds: Optional[Sequence[float]] = None):
+        super().__init__(label, prediction)
+        self.threshold = threshold
+        self.sweep = (np.linspace(0.0, 1.0, 101) if sweep_thresholds is None
+                      else np.asarray(sweep_thresholds))
+
+    def evaluate_all(self, table: Table) -> BinaryClassificationMetrics:
+        label, pred = self._cols(table)
+        y = jnp.asarray(np.asarray(label.values), jnp.float32)
+        scores = pred.prob[:, 1] if pred.prob.shape[1] > 1 else pred.prob[:, 0]
+        auroc, aupr = binary_curve_aucs(scores, y)
+        tn, fp, fn, tp = confusion_at(scores, y, self.threshold)
+        precision, recall, f1 = prf(tp, fp, fn)
+        n = tn + fp + fn + tp
+        error = (fp + fn) / jnp.maximum(n, 1.0)
+        p_th, r_th, f_th = threshold_sweep(scores, y, self.sweep)
+        return BinaryClassificationMetrics(
+            AuROC=float(auroc), AuPR=float(aupr),
+            Precision=float(precision), Recall=float(recall), F1=float(f1),
+            Error=float(error),
+            TP=float(tp), TN=float(tn), FP=float(fp), FN=float(fn),
+            thresholds=[float(t) for t in self.sweep],
+            precision_by_threshold=[float(x) for x in p_th],
+            recall_by_threshold=[float(x) for x in r_th],
+            f1_by_threshold=[float(x) for x in f_th],
+        )
+
+
+class MultiClassificationEvaluator(EvaluatorBase):
+    default_metric = "F1"
+
+    def __init__(self, label, prediction, num_classes: Optional[int] = None):
+        super().__init__(label, prediction)
+        self.num_classes = num_classes
+
+    def evaluate_all(self, table: Table) -> MultiClassificationMetrics:
+        label, pred = self._cols(table)
+        y = np.asarray(label.values, np.int32)
+        p = np.asarray(pred.pred, np.int32)
+        nc = self.num_classes or int(max(y.max(), p.max())) + 1
+        conf = confusion_matrix(p, y, nc)
+        stats = multiclass_prf(conf)
+        correct = float(jnp.diag(conf).sum())
+        total = max(float(conf.sum()), 1.0)
+        return MultiClassificationMetrics(
+            Precision=float(stats["weighted_precision"]),
+            Recall=float(stats["weighted_recall"]),
+            F1=float(stats["weighted_f1"]),
+            Error=1.0 - correct / total,
+            confusion=np.asarray(conf).tolist(),
+            per_class_f1=[float(x) for x in stats["per_class_f1"]],
+        )
+
+
+class RegressionEvaluator(EvaluatorBase):
+    default_metric = "RootMeanSquaredError"
+    larger_is_better = False
+
+    def evaluate_all(self, table: Table) -> RegressionMetrics:
+        label, pred = self._cols(table)
+        y = jnp.asarray(np.asarray(label.values), jnp.float32)
+        mse, rmse, mae, r2 = regression_metrics_ops(pred.pred, y)
+        return RegressionMetrics(
+            RootMeanSquaredError=float(rmse), MeanSquaredError=float(mse),
+            MeanAbsoluteError=float(mae), R2=float(r2),
+        )
+
+
+class Evaluators:
+    """Factory surface mirroring reference Evaluators.scala."""
+
+    @staticmethod
+    def binary_classification(label, prediction, **kw) -> BinaryClassificationEvaluator:
+        return BinaryClassificationEvaluator(label, prediction, **kw)
+
+    @staticmethod
+    def multi_classification(label, prediction, **kw) -> MultiClassificationEvaluator:
+        return MultiClassificationEvaluator(label, prediction, **kw)
+
+    @staticmethod
+    def regression(label, prediction, **kw) -> RegressionEvaluator:
+        return RegressionEvaluator(label, prediction, **kw)

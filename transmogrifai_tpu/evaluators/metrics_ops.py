@@ -1,0 +1,128 @@
+"""jnp metric kernels: threshold sweeps via sort + cumsum (no Python loops).
+
+Replaces Spark mllib BinaryClassificationMetrics / MulticlassMetrics behind the
+reference evaluators (core/.../evaluators/OpBinaryClassificationEvaluator.scala:56-180,
+OpMultiClassificationEvaluator.scala:89-269, OpRegressionEvaluator.scala:61-101).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _trapezoid_masked(x, y, boundary, x0, y0):
+    """Trapezoid area over the sub-sequence of (x, y) where boundary=True, starting
+    from (x0, y0). One lax.scan — handles tied-score runs exactly."""
+
+    def f(carry, inp):
+        lx, ly, acc = carry
+        xi, yi, mi = inp
+        contrib = jnp.where(mi, (xi - lx) * (yi + ly) * 0.5, 0.0)
+        lx = jnp.where(mi, xi, lx)
+        ly = jnp.where(mi, yi, ly)
+        return (lx, ly, acc + contrib), None
+
+    (_, _, acc), _ = lax.scan(
+        f, (jnp.float32(x0), jnp.float32(y0), jnp.float32(0.0)), (x, y, boundary)
+    )
+    return acc
+
+
+@jax.jit
+def binary_curve_aucs(scores: jnp.ndarray, labels: jnp.ndarray):
+    """(auROC, auPR) from probability scores and {0,1} labels.
+
+    Sort desc, cumsum TP/FP, evaluate curve only at the last point of each tied-score
+    run (exact tie semantics), trapezoid. PR curve starts at (0, first precision),
+    matching Spark's BinaryClassificationMetrics."""
+    scores = jnp.asarray(scores, jnp.float32)
+    labels = jnp.asarray(labels, jnp.float32)
+    order = jnp.argsort(-scores)
+    s = scores[order]
+    l = labels[order]
+    tp = jnp.cumsum(l)
+    fp = jnp.cumsum(1.0 - l)
+    P = jnp.maximum(tp[-1], 1.0)
+    N = jnp.maximum(fp[-1], 1.0)
+    boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
+    tpr = tp / P
+    fpr = fp / N
+    prec = tp / jnp.maximum(tp + fp, 1.0)
+    auroc = _trapezoid_masked(fpr, tpr, boundary, 0.0, 0.0)
+    first_prec = prec[jnp.argmax(boundary)]
+    aupr = _trapezoid_masked(tpr, prec, boundary, 0.0, first_prec)
+    return auroc, aupr
+
+
+@jax.jit
+def confusion_at(scores: jnp.ndarray, labels: jnp.ndarray, threshold: float = 0.5):
+    """(tn, fp, fn, tp) at a probability threshold."""
+    scores = jnp.asarray(scores, jnp.float32)
+    labels = jnp.asarray(labels, jnp.float32)
+    pred = (scores >= threshold).astype(jnp.float32)
+    tp = jnp.sum(pred * labels)
+    fp = jnp.sum(pred * (1 - labels))
+    fn = jnp.sum((1 - pred) * labels)
+    tn = jnp.sum((1 - pred) * (1 - labels))
+    return tn, fp, fn, tp
+
+
+def prf(tp, fp, fn):
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    recall = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return precision, recall, f1
+
+
+@jax.jit
+def threshold_sweep(scores: jnp.ndarray, labels: jnp.ndarray, thresholds: jnp.ndarray):
+    """Vectorized per-threshold (precision, recall, f1) — the reference's thresholded
+    rates table (OpBinaryClassificationEvaluator thresholds)."""
+    scores = jnp.asarray(scores, jnp.float32)[None, :]
+    labels = jnp.asarray(labels, jnp.float32)[None, :]
+    th = jnp.asarray(thresholds, jnp.float32)[:, None]
+    pred = (scores >= th).astype(jnp.float32)
+    tp = (pred * labels).sum(axis=1)
+    fp = (pred * (1 - labels)).sum(axis=1)
+    fn = ((1 - pred) * labels).sum(axis=1)
+    return prf(tp, fp, fn)
+
+
+def confusion_matrix(pred, labels, num_classes: int):
+    """[C, C] confusion (rows=label, cols=pred) via one-hot matmul — MXU-friendly."""
+    p = jax.nn.one_hot(jnp.asarray(pred, jnp.int32), num_classes)
+    l = jax.nn.one_hot(jnp.asarray(labels, jnp.int32), num_classes)
+    return l.T @ p
+
+
+def multiclass_prf(conf):
+    tp = jnp.diag(conf)
+    fp = conf.sum(axis=0) - tp
+    fn = conf.sum(axis=1) - tp
+    precision, recall, f1 = prf(tp, fp, fn)
+    support = conf.sum(axis=1)
+    wsum = jnp.maximum(support.sum(), 1.0)
+    return {
+        "per_class_precision": precision,
+        "per_class_recall": recall,
+        "per_class_f1": f1,
+        "weighted_precision": (precision * support).sum() / wsum,
+        "weighted_recall": (recall * support).sum() / wsum,
+        "weighted_f1": (f1 * support).sum() / wsum,
+        "macro_f1": f1.mean(),
+    }
+
+
+@jax.jit
+def regression_metrics_ops(pred: jnp.ndarray, labels: jnp.ndarray):
+    pred = jnp.asarray(pred, jnp.float32)
+    y = jnp.asarray(labels, jnp.float32)
+    err = pred - y
+    mse = jnp.mean(err ** 2)
+    rmse = jnp.sqrt(mse)
+    mae = jnp.mean(jnp.abs(err))
+    ss_res = jnp.sum(err ** 2)
+    ss_tot = jnp.maximum(jnp.sum((y - y.mean()) ** 2), 1e-12)
+    r2 = 1.0 - ss_res / ss_tot
+    return mse, rmse, mae, r2

@@ -1,0 +1,17 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    shard_batch,
+    shard_grid,
+    replicate,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "shard_batch",
+    "shard_grid",
+    "replicate",
+]

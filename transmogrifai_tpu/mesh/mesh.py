@@ -1,0 +1,72 @@
+"""Device mesh: the framework's entire distributed substrate.
+
+The reference outsources distribution to Spark (SURVEY.md §2.12): row parallelism =
+RDD maps, aggregation = treeAggregate, tuning parallelism = a thread pool. Here the
+substrate is a `jax.sharding.Mesh` with two named axes:
+
+  - DATA_AXIS ("data"): rows of the training matrix are sharded across chips; every
+    monoid aggregation (moments, correlations, gradients, histogram stats) becomes an
+    XLA reduction that lowers to psum over ICI — no hand-written collectives.
+  - MODEL_AXIS ("model"): the tuning axis — CV folds x hyperparameter grid points are
+    laid out here (vmapped fits with per-point params sharded over MODEL_AXIS), the
+    role Spark's thread-pool model-parallelism plays in OpCrossValidation.scala:102-118.
+
+On a single host this still works (mesh of 1..8 local devices); on multi-host TPU the
+same code spans slices via jax's global mesh — DCN collectives ride the same psum calls.
+Wide-feature sharding (this domain's "sequence parallelism", SURVEY §5.7) lays the
+feature axis of X over MODEL_AXIS when D is large: partial dot-products psum across it.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data x model) mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = max(1, len(devices) // n_model)
+    use = devices[: n_data * n_model]
+    arr = np.array(use).reshape(n_data, n_model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def shard_batch(mesh: Mesh, arr, batch_dim: int = 0):
+    """Place an array with its batch dim sharded over DATA_AXIS (rows across chips)."""
+    spec = [None] * np.ndim(arr)
+    spec[batch_dim] = DATA_AXIS
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def shard_grid(mesh: Mesh, arr, grid_dim: int = 0):
+    """Place a hyperparameter-grid axis over MODEL_AXIS."""
+    spec = [None] * np.ndim(arr)
+    spec[grid_dim] = MODEL_AXIS
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(mesh: Mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def pad_to_multiple(arr, multiple: int, axis: int = 0, fill=0):
+    """Pad a batch axis so it divides the mesh (XLA needs even shards); returns
+    (padded, original_length)."""
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, rem)
+    return np.pad(np.asarray(arr), widths, constant_values=fill), n

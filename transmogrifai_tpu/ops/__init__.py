@@ -1,0 +1,23 @@
+from .linear import (
+    LinearParams,
+    fit_linear,
+    fit_logistic,
+    fit_multinomial,
+    fit_svc,
+    predict_linear,
+    predict_logistic,
+    predict_multinomial,
+    predict_svc,
+)
+
+__all__ = [
+    "LinearParams",
+    "fit_logistic",
+    "predict_logistic",
+    "fit_multinomial",
+    "predict_multinomial",
+    "fit_linear",
+    "predict_linear",
+    "fit_svc",
+    "predict_svc",
+]

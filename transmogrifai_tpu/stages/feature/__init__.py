@@ -1,0 +1,66 @@
+from .categorical import (
+    IndexToString,
+    OneHotVectorizer,
+    OneHotVectorizerModel,
+    StringIndexer,
+    StringIndexerModel,
+)
+from .collections import (
+    GeolocationVectorizer,
+    MapVectorizer,
+    MultiPickListVectorizer,
+)
+from .combiner import VectorsCombiner
+from .common import SequenceVectorizer, SequenceVectorizerEstimator
+from .date import TIME_PERIODS, DateListVectorizer, DateToUnitCircleVectorizer
+from .numeric import (
+    BinaryVectorizer,
+    DropIndicesTransformer,
+    FillMissingWithMean,
+    IntegralVectorizer,
+    NumericBucketizer,
+    RealNNVectorizer,
+    RealVectorizer,
+    StandardScaler,
+)
+from .text import (
+    HashingVectorizer,
+    SmartTextVectorizer,
+    TextLenTransformer,
+    TextTokenizer,
+    hash_token,
+    tokenize,
+)
+from .transmogrify import DEFAULTS, TransmogrifierDefaults, transmogrify
+
+__all__ = [
+    "transmogrify",
+    "TransmogrifierDefaults",
+    "DEFAULTS",
+    "VectorsCombiner",
+    "RealVectorizer",
+    "RealNNVectorizer",
+    "IntegralVectorizer",
+    "BinaryVectorizer",
+    "NumericBucketizer",
+    "FillMissingWithMean",
+    "StandardScaler",
+    "DropIndicesTransformer",
+    "OneHotVectorizer",
+    "OneHotVectorizerModel",
+    "StringIndexer",
+    "StringIndexerModel",
+    "IndexToString",
+    "TextTokenizer",
+    "TextLenTransformer",
+    "HashingVectorizer",
+    "SmartTextVectorizer",
+    "DateToUnitCircleVectorizer",
+    "DateListVectorizer",
+    "TIME_PERIODS",
+    "MultiPickListVectorizer",
+    "GeolocationVectorizer",
+    "MapVectorizer",
+    "SequenceVectorizer",
+    "SequenceVectorizerEstimator",
+]

@@ -1,0 +1,205 @@
+"""Categorical vectorizers: one-hot pivot with topK/minSupport/OTHER/null tracking,
+string indexing.
+
+TPU-native equivalents of reference OpOneHotVectorizer (pivot semantics), OpStringIndexer,
+OpIndexToString (core/.../impl/feature/OpOneHotVectorizer.scala, OpStringIndexer.scala).
+Fit counts categories host-side (strings never go to device); the fitted transform maps
+string -> slot index with numpy, then emits a dense one-hot device matrix — on TPU the
+one-hot IS the hardware-friendly representation (feeds MXU matmuls downstream).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, SlotInfo, VectorSchema
+from ..base import register_stage
+from .common import (
+    SequenceVectorizer,
+    SequenceVectorizerEstimator,
+    clean_token,
+    null_slot,
+    other_slot,
+)
+
+_CATEGORICAL_TEXT = (
+    "Text", "TextArea", "PickList", "ComboBox", "ID", "Country", "State", "City",
+    "PostalCode", "Street", "Email", "URL", "Phone", "Base64",
+)
+
+
+def count_categories(col: Column, clean_text: bool) -> Counter:
+    c = Counter()
+    for v in col.values:
+        if v is not None:
+            c[clean_token(str(v), clean_text)] += 1
+    return c
+
+
+def pick_top_k(counts: Counter, top_k: int, min_support: int) -> list[str]:
+    """TopK by (count desc, value asc) with min-support filter (reference
+    OpOneHotVectorizer topK/minSupport semantics)."""
+    eligible = [(n, v) for v, n in counts.items() if n >= min_support]
+    eligible.sort(key=lambda t: (-t[0], t[1]))
+    return [v for _, v in eligible[:top_k]]
+
+
+@register_stage
+class OneHotVectorizer(SequenceVectorizerEstimator):
+    """Text-like categorical -> one-hot pivot [topK values..., OTHER, null?]
+    (reference OpOneHotVectorizer; Transmogrifier defaults TopK=20 MinSupport=10
+    TrackNulls=true, Transmogrifier.scala:52-90)."""
+
+    operation_name = "pivot"
+    accepts = _CATEGORICAL_TEXT + ("Binary",)
+
+    def __init__(self, top_k: int = 20, min_support: int = 10, clean_text: bool = True,
+                 track_nulls: bool = True):
+        super().__init__(top_k=top_k, min_support=min_support, clean_text=clean_text,
+                         track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        cats = []
+        for c in cols:
+            if c.kind.name == "Binary":
+                cats.append(["true", "false"])
+                continue
+            counts = count_categories(c, p["clean_text"])
+            cats.append(pick_top_k(counts, p["top_k"], p["min_support"]))
+        return OneHotVectorizerModel(
+            categories=cats,
+            clean_text=p["clean_text"],
+            track_nulls=p["track_nulls"],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class OneHotVectorizerModel(SequenceVectorizer):
+    operation_name = "pivot"
+    device_op = False  # consumes host strings
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        mats, slots = [], []
+        for c, cats, name, kind in zip(cols, p["categories"], p["names"], p["kinds"]):
+            index = {v: i for i, v in enumerate(cats)}
+            k = len(cats)
+            width = k + 1 + (1 if p["track_nulls"] else 0)  # values + OTHER (+ null)
+            mat = np.zeros((len(c), width), dtype=np.float32)
+            if c.kind.name == "Binary":
+                vals = np.asarray(c.values)
+                mask = np.asarray(c.effective_mask())
+                mat[:, 0] = vals & mask
+                mat[:, 1] = (~vals) & mask
+                if p["track_nulls"]:
+                    mat[:, k + 1] = ~mask
+            else:
+                for i, v in enumerate(c.values):
+                    if v is None:
+                        if p["track_nulls"]:
+                            mat[i, k + 1] = 1.0
+                        continue
+                    j = index.get(clean_token(str(v), p["clean_text"]))
+                    mat[i, j if j is not None else k] = 1.0
+            mats.append(mat)
+            slots.extend(SlotInfo(name, kind, indicator_value=v) for v in cats)
+            slots.append(other_slot(name, kind))
+            if p["track_nulls"]:
+                slots.append(null_slot(name, kind))
+        vec = jnp.asarray(np.concatenate(mats, axis=1))
+        return Column.vector(vec, VectorSchema(tuple(slots)))
+
+
+@register_stage
+class StringIndexer(SequenceVectorizerEstimator):
+    """Text -> integer label index as RealNN (reference OpStringIndexer; used for
+    response encoding). Unseen values map to the configured unseen index."""
+
+    operation_name = "strIdx"
+    accepts = _CATEGORICAL_TEXT
+    arity = (1, 1)
+
+    def __init__(self, handle_invalid: str = "error"):
+        if handle_invalid not in ("error", "skip", "keep"):
+            raise ValueError("handle_invalid must be error|skip|keep")
+        super().__init__(handle_invalid=handle_invalid)
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        super().out_kind(in_kinds)
+        return kind_of("RealNN")
+
+    def fit_columns(self, cols: Sequence[Column]):
+        counts = count_categories(cols[0], clean_text=False)
+        # ordered by frequency desc then value (Spark StringIndexer order)
+        labels = [v for v, _ in sorted(counts.items(), key=lambda t: (-t[1], t[0]))]
+        return StringIndexerModel(labels=labels, handle_invalid=self.params["handle_invalid"])
+
+
+@register_stage
+class StringIndexerModel(SequenceVectorizer):
+    operation_name = "strIdx"
+    device_op = False
+    arity = (1, 1)
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        return kind_of("RealNN")
+
+    @property
+    def labels(self) -> list[str]:
+        return self.params["labels"]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import kind_of
+
+        p = self.params
+        index = {v: float(i) for i, v in enumerate(p["labels"])}
+        unseen = float(len(p["labels"])) if p["handle_invalid"] == "keep" else np.nan
+        out = np.empty(len(cols[0]), dtype=np.float32)
+        for i, v in enumerate(cols[0].values):
+            if v is None:
+                out[i] = np.nan
+            else:
+                got = index.get(str(v))
+                if got is None and p["handle_invalid"] == "error":
+                    raise ValueError(f"unseen label {v!r} in StringIndexer")
+                out[i] = unseen if got is None else got
+        return Column(kind_of("RealNN"), jnp.asarray(out), jnp.asarray(~np.isnan(out)))
+
+
+@register_stage
+class IndexToString(SequenceVectorizer):
+    """Inverse of StringIndexer (reference OpIndexToString)."""
+
+    operation_name = "idxToStr"
+    device_op = False
+    arity = (1, 1)
+    accepts = None
+
+    def __init__(self, labels: Sequence[str] = ()):
+        super().__init__(labels=list(labels))
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        return kind_of("Text")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import kind_of
+
+        labels = self.params["labels"]
+        vals = np.asarray(cols[0].values)
+        out = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            iv = int(v)
+            out[i] = labels[iv] if 0 <= iv < len(labels) else None
+        return Column(kind_of("Text"), out, None)

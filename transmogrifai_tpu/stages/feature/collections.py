@@ -1,0 +1,303 @@
+"""Set/geolocation/map vectorizers.
+
+TPU-native equivalents of reference MultiPickList pivot (OpSetVectorizer),
+GeolocationVectorizer (GeolocationVectorizer.scala), and the OPMapVectorizer family
+(OPMapVectorizer.scala, TextMapPivotVectorizer.scala, MultiPickListMapVectorizer.scala):
+maps fit their key set + per-key stats host-side, then expand to fixed-width device
+vectors keyed by the fitted key order (dynamic vocab -> static shapes at transform time,
+the SURVEY §7 recompilation mitigation).
+"""
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, SlotInfo, VectorSchema, kind_of
+from ..base import register_stage
+from .categorical import pick_top_k
+from .common import (
+    SequenceVectorizer,
+    SequenceVectorizerEstimator,
+    clean_token,
+    null_slot,
+    other_slot,
+    stack_vector,
+    value_slot,
+)
+
+
+@register_stage
+class MultiPickListVectorizer(SequenceVectorizerEstimator):
+    """MultiPickList -> multi-hot over topK values + OTHER + null
+    (reference OpSetVectorizer pivot semantics)."""
+
+    operation_name = "multiPivot"
+    accepts = ("MultiPickList",)
+
+    def __init__(self, top_k: int = 20, min_support: int = 10, clean_text: bool = True,
+                 track_nulls: bool = True):
+        super().__init__(top_k=top_k, min_support=min_support, clean_text=clean_text,
+                         track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        cats = []
+        for c in cols:
+            counts: Counter = Counter()
+            for s in c.values:
+                for v in s or ():
+                    counts[clean_token(str(v), p["clean_text"])] += 1
+            cats.append(pick_top_k(counts, p["top_k"], p["min_support"]))
+        return MultiPickListVectorizerModel(
+            categories=cats, clean_text=p["clean_text"], track_nulls=p["track_nulls"],
+            names=[f.name for f in self.inputs], kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class MultiPickListVectorizerModel(SequenceVectorizer):
+    operation_name = "multiPivot"
+    device_op = False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        mats, slots = [], []
+        for c, cats, name, kind in zip(cols, p["categories"], p["names"], p["kinds"]):
+            index = {v: i for i, v in enumerate(cats)}
+            k = len(cats)
+            width = k + 1 + (1 if p["track_nulls"] else 0)
+            mat = np.zeros((len(c), width), dtype=np.float32)
+            for i, s in enumerate(c.values):
+                if not s:
+                    if p["track_nulls"]:
+                        mat[i, k + 1] = 1.0
+                    continue
+                for v in s:
+                    j = index.get(clean_token(str(v), p["clean_text"]))
+                    mat[i, j if j is not None else k] = 1.0
+            mats.append(mat)
+            slots.extend(SlotInfo(name, kind, indicator_value=v) for v in cats)
+            slots.append(other_slot(name, kind))
+            if p["track_nulls"]:
+                slots.append(null_slot(name, kind))
+        return Column.vector(jnp.asarray(np.concatenate(mats, axis=1)),
+                             VectorSchema(tuple(slots)))
+
+
+@register_stage
+class GeolocationVectorizer(SequenceVectorizerEstimator):
+    """Geolocation -> [lat, lon, accuracy](filled with training mean) + null
+    (reference GeolocationVectorizer fill-with-mean default)."""
+
+    operation_name = "vecGeo"
+    accepts = ("Geolocation",)
+
+    def __init__(self, track_nulls: bool = True):
+        super().__init__(track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        means = []
+        for c in cols:
+            vals = jnp.asarray(c.values, jnp.float32)
+            m = jnp.asarray(c.effective_mask(), jnp.float32)[:, None]
+            denom = jnp.maximum(m.sum(), 1.0)
+            means.append([float(x) for x in (vals * m).sum(axis=0) / denom])
+        return GeolocationVectorizerModel(
+            means=means, track_nulls=self.params["track_nulls"],
+            names=[f.name for f in self.inputs], kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class GeolocationVectorizerModel(SequenceVectorizer):
+    operation_name = "vecGeo"
+    device_op = True
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        parts, slots = [], []
+        for c, mean, name, kind in zip(cols, p["means"], p["names"], p["kinds"]):
+            vals = jnp.asarray(c.values, jnp.float32)
+            mask = jnp.asarray(c.effective_mask(), jnp.float32)[:, None]
+            filled = vals * mask + jnp.asarray(mean, jnp.float32)[None, :] * (1 - mask)
+            parts.append(filled)
+            slots.extend(
+                value_slot(name, kind, descriptor=d) for d in ("lat", "lon", "accuracy")
+            )
+            if p["track_nulls"]:
+                parts.append(1.0 - mask[:, 0])
+                slots.append(null_slot(name, kind))
+        return stack_vector(parts, slots)
+
+
+# ---------------------------------------------------------------------------------------
+# Map vectorizers: one fitted key-set per map feature; each key behaves like a scalar
+# feature of the map's value kind (reference OPMapVectorizer family).
+# ---------------------------------------------------------------------------------------
+
+_NUMERIC_MAPS = ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap")
+_CATEGORICAL_MAPS = ("TextMap", "TextAreaMap", "PickListMap", "ComboBoxMap", "IDMap",
+                     "EmailMap", "URLMap", "PhoneMap", "Base64Map", "CountryMap",
+                     "StateMap", "CityMap", "PostalCodeMap", "StreetMap")
+_BINARY_MAPS = ("BinaryMap",)
+_MULTI_MAPS = ("MultiPickListMap",)
+
+
+@register_stage
+class MapVectorizer(SequenceVectorizerEstimator):
+    """Generic map pivot: numeric maps -> per-key [value(fill mean), null]; categorical
+    maps -> per-(key, topK value) one-hot + OTHER + null; binary maps -> per-key
+    [true, false, null]; multipicklist maps -> per-(key, topK) multi-hot.
+    Keys are whitelisted/blacklisted via allow_keys/block_keys (reference FilterMap)."""
+
+    operation_name = "vecMap"
+    accepts = _NUMERIC_MAPS + _CATEGORICAL_MAPS + _BINARY_MAPS + _MULTI_MAPS
+
+    def __init__(self, top_k: int = 20, min_support: int = 10, clean_text: bool = True,
+                 track_nulls: bool = True, allow_keys: Sequence[str] = (),
+                 block_keys: Sequence[str] = ()):
+        super().__init__(top_k=top_k, min_support=min_support, clean_text=clean_text,
+                         track_nulls=track_nulls, allow_keys=list(allow_keys),
+                         block_keys=list(block_keys))
+
+    def _keys_of(self, col: Column) -> list[str]:
+        p = self.params
+        allow, block = set(p["allow_keys"]), set(p["block_keys"])
+        keys: dict[str, None] = {}
+        for m in col.values:
+            for k in (m or {}):
+                if (not allow or k in allow) and k not in block:
+                    keys[str(k)] = None
+        return sorted(keys)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        plans = []
+        for c, f in zip(cols, self.inputs):
+            keys = self._keys_of(c)
+            kind = c.kind.name
+            if kind in _NUMERIC_MAPS:
+                sums = defaultdict(float)
+                cnts = defaultdict(int)
+                for m in c.values:
+                    for k, v in (m or {}).items():
+                        if str(k) in keys and v is not None:
+                            sums[str(k)] += float(v)
+                            cnts[str(k)] += 1
+                fills = {k: (sums[k] / cnts[k] if cnts[k] else 0.0) for k in keys}
+                plans.append({"mode": "numeric", "keys": keys, "fills": fills})
+            elif kind in _BINARY_MAPS:
+                plans.append({"mode": "binary", "keys": keys})
+            elif kind in _MULTI_MAPS:
+                cats = {}
+                for key in keys:
+                    counts: Counter = Counter()
+                    for m in c.values:
+                        for v in (m or {}).get(key, ()) or ():
+                            counts[clean_token(str(v), p["clean_text"])] += 1
+                    cats[key] = pick_top_k(counts, p["top_k"], p["min_support"])
+                plans.append({"mode": "multi", "keys": keys, "categories": cats})
+            else:  # categorical text maps
+                cats = {}
+                for key in keys:
+                    counts = Counter()
+                    for m in c.values:
+                        v = (m or {}).get(key)
+                        if v is not None:
+                            counts[clean_token(str(v), p["clean_text"])] += 1
+                    cats[key] = pick_top_k(counts, p["top_k"], p["min_support"])
+                plans.append({"mode": "pivot", "keys": keys, "categories": cats})
+        return MapVectorizerModel(
+            plans=plans, clean_text=p["clean_text"], track_nulls=p["track_nulls"],
+            names=[f.name for f in self.inputs], kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class MapVectorizerModel(SequenceVectorizer):
+    operation_name = "vecMap"
+    device_op = False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        track = p["track_nulls"]
+        mats, slots = [], []
+        for c, plan, name, kind in zip(cols, p["plans"], p["names"], p["kinds"]):
+            n = len(c)
+            mode = plan["mode"]
+            keys = plan["keys"]
+            if mode == "numeric":
+                width = len(keys) * (2 if track else 1)
+                mat = np.zeros((n, width), dtype=np.float32)
+                for ki, key in enumerate(keys):
+                    base = ki * (2 if track else 1)
+                    fill = plan["fills"][key]
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if v is None:
+                            mat[i, base] = fill
+                            if track:
+                                mat[i, base + 1] = 1.0
+                        else:
+                            mat[i, base] = float(v)
+                    slots.append(value_slot(name, kind, group=key))
+                    if track:
+                        slots.append(null_slot(name, kind, group=key))
+            elif mode == "binary":
+                per = 2 + (1 if track else 0)
+                mat = np.zeros((n, len(keys) * per), dtype=np.float32)
+                for ki, key in enumerate(keys):
+                    base = ki * per
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if v is None:
+                            if track:
+                                mat[i, base + 2] = 1.0
+                        elif v:
+                            mat[i, base] = 1.0
+                        else:
+                            mat[i, base + 1] = 1.0
+                    slots.append(SlotInfo(name, kind, group=key, indicator_value="true"))
+                    slots.append(SlotInfo(name, kind, group=key, indicator_value="false"))
+                    if track:
+                        slots.append(null_slot(name, kind, group=key))
+            else:  # pivot / multi
+                cats = plan["categories"]
+                cols_out = []
+                for key in keys:
+                    kcats = cats[key]
+                    index = {v: i for i, v in enumerate(kcats)}
+                    width = len(kcats) + 1 + (1 if track else 0)
+                    sub = np.zeros((n, width), dtype=np.float32)
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if mode == "multi":
+                            if not v:
+                                if track:
+                                    sub[i, len(kcats) + 1] = 1.0
+                                continue
+                            for item in v:
+                                j = index.get(clean_token(str(item), p["clean_text"]))
+                                sub[i, j if j is not None else len(kcats)] = 1.0
+                        else:
+                            if v is None:
+                                if track:
+                                    sub[i, len(kcats) + 1] = 1.0
+                                continue
+                            j = index.get(clean_token(str(v), p["clean_text"]))
+                            sub[i, j if j is not None else len(kcats)] = 1.0
+                    cols_out.append(sub)
+                    slots.extend(
+                        SlotInfo(name, kind, group=key, indicator_value=v) for v in kcats
+                    )
+                    slots.append(other_slot(name, kind, group=key))
+                    if track:
+                        slots.append(null_slot(name, kind, group=key))
+                mat = (np.concatenate(cols_out, axis=1) if cols_out
+                       else np.zeros((n, 0), dtype=np.float32))
+            mats.append(mat)
+        return Column.vector(jnp.asarray(np.concatenate(mats, axis=1)),
+                             VectorSchema(tuple(slots)))

@@ -1,0 +1,33 @@
+"""VectorsCombiner: concatenate OPVectors + their schemas
+(reference VectorsCombiner.scala:51). Pure jnp -> fuses with neighbors under jit."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ...types import Column, VectorSchema
+from ..base import register_stage
+from .common import SequenceVectorizer
+
+
+@register_stage
+class VectorsCombiner(SequenceVectorizer):
+    operation_name = "combine"
+    device_op = True
+    accepts = ("OPVector",)
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        vec = jnp.concatenate([jnp.asarray(c.values, jnp.float32) for c in cols], axis=1)
+        schemas = [c.schema if c.schema is not None else _anonymous_schema(c, f)
+                   for c, f in zip(cols, self.inputs)]
+        return Column.vector(vec, schemas[0].concat(*schemas[1:]))
+
+
+def _anonymous_schema(col: Column, feature) -> VectorSchema:
+    from ...types import slots_for
+
+    return slots_for(
+        feature.name, feature.kind.name,
+        descriptors=[f"v{i}" for i in range(col.values.shape[1])],
+    )

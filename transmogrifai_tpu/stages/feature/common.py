@@ -1,0 +1,89 @@
+"""Shared vectorizer plumbing: sequence-arity bases and schema helpers.
+
+Vectorizers follow the reference's SequenceEstimator/SequenceTransformer shape
+(features/.../base/sequence/SequenceEstimator.scala:57): N same-kind input features ->
+ONE OPVector output whose schema records per-slot provenance.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import (
+    NULL_INDICATOR,
+    OTHER_INDICATOR,
+    Column,
+    FeatureKind,
+    SlotInfo,
+    VectorSchema,
+    kind_of,
+)
+from ..base import Estimator, Transformer
+
+VECTOR = "OPVector"
+
+
+class SequenceVectorizer(Transformer):
+    """N inputs -> one OPVector."""
+
+    arity = (1, None)
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        self.check_in_kinds(in_kinds)
+        return kind_of(VECTOR)
+
+    #: registry-names of accepted input kinds; None = any
+    accepts: Optional[tuple[str, ...]] = None
+
+    def check_in_kinds(self, in_kinds: Sequence[FeatureKind]) -> None:
+        if self.accepts is None:
+            return
+        bad = [k.name for k in in_kinds if k.name not in self.accepts]
+        if bad:
+            raise TypeError(
+                f"{type(self).__name__} accepts {self.accepts}, got {bad}"
+            )
+
+
+class SequenceVectorizerEstimator(Estimator):
+    """N inputs -> fitted model producing one OPVector."""
+
+    arity = (1, None)
+    accepts: Optional[tuple[str, ...]] = None
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        bad = None if self.accepts is None else [
+            k.name for k in in_kinds if k.name not in self.accepts
+        ]
+        if bad:
+            raise TypeError(f"{type(self).__name__} accepts {self.accepts}, got {bad}")
+        return kind_of(VECTOR)
+
+
+def null_slot(parent: str, kind: str, group: Optional[str] = None) -> SlotInfo:
+    return SlotInfo(parent, kind, group=group, indicator_value=NULL_INDICATOR)
+
+
+def other_slot(parent: str, kind: str, group: Optional[str] = None) -> SlotInfo:
+    return SlotInfo(parent, kind, group=group, indicator_value=OTHER_INDICATOR)
+
+
+def value_slot(parent: str, kind: str, descriptor: Optional[str] = None,
+               group: Optional[str] = None) -> SlotInfo:
+    return SlotInfo(parent, kind, group=group, descriptor=descriptor)
+
+
+def stack_vector(parts: list, schema_slots: list[SlotInfo]) -> Column:
+    """Column-stack float32 parts (each [N] or [N,k]) into one vector column."""
+    arrs = [p[:, None] if p.ndim == 1 else p for p in map(jnp.asarray, parts)]
+    vec = jnp.concatenate(arrs, axis=1).astype(jnp.float32)
+    return Column.vector(vec, VectorSchema(tuple(schema_slots)))
+
+
+def clean_token(s: str, clean: bool = True) -> str:
+    """Categorical value cleaning (reference OpOneHotVectorizer cleanText param)."""
+    if not clean:
+        return s
+    return "".join(ch for ch in s.strip() if ch.isalnum() or ch == " ")

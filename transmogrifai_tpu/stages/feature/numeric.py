@@ -1,0 +1,328 @@
+"""Numeric vectorizers & transformers.
+
+TPU-native equivalents of the reference numeric stages (core/.../impl/feature/):
+RealVectorizer (fill mean/constant + null indicators), IntegralVectorizer (fill mode),
+BinaryVectorizer, RealNNVectorizer, OpScalarStandardScaler, NumericBucketizer,
+FillMissingWithMean, ScalerTransformer/DescalerTransformer. All fitted models are pure
+jnp device transformers, so whole layers fuse into one XLA program.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, SlotInfo, VectorSchema, kind_of
+from ..base import Estimator, Transformer, adopt_wiring, register_stage
+from .common import (
+    SequenceVectorizer,
+    SequenceVectorizerEstimator,
+    null_slot,
+    stack_vector,
+    value_slot,
+)
+
+_REAL_KINDS = ("Real", "Currency", "Percent")
+
+
+@register_stage
+class RealVectorizer(SequenceVectorizerEstimator):
+    """Real/Currency/Percent -> [value(filled), isNull?] per input
+    (reference RealVectorizer + FillMissingWithMean, Transmogrifier defaults:
+    fill=mean, TrackNulls=true, Transmogrifier.scala:52-90)."""
+
+    operation_name = "vecReal"
+    accepts = _REAL_KINDS + ("RealNN",)
+
+    def __init__(self, fill_value: str | float = "mean", track_nulls: bool = True):
+        super().__init__(fill_value=fill_value, track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        fills = []
+        for c in cols:
+            if self.params["fill_value"] == "mean":
+                m = c.effective_mask()
+                denom = jnp.maximum(jnp.asarray(m).sum(), 1)
+                fills.append(float((c.filled(0.0) * m).sum() / denom))
+            else:
+                fills.append(float(self.params["fill_value"]))
+        return RealVectorizerModel(
+            fills=fills,
+            track_nulls=self.params["track_nulls"],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class RealVectorizerModel(SequenceVectorizer):
+    operation_name = "vecReal"
+    device_op = True
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        parts, slots = [], []
+        for c, fill, name, kind in zip(cols, p["fills"], p["names"], p["kinds"]):
+            parts.append(c.filled(fill))
+            slots.append(value_slot(name, kind))
+            if p["track_nulls"]:
+                parts.append(1.0 - jnp.asarray(c.effective_mask(), jnp.float32))
+                slots.append(null_slot(name, kind))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class RealNNVectorizer(SequenceVectorizer):
+    """Non-nullable reals -> raw values (reference RealNNVectorizer: no fill/no nulls)."""
+
+    operation_name = "vecRealNN"
+    device_op = True
+    accepts = ("RealNN",)
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        parts = [jnp.asarray(c.values, jnp.float32) for c in cols]
+        slots = [value_slot(f.name, f.kind.name) for f in self.inputs]
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class IntegralVectorizer(SequenceVectorizerEstimator):
+    """Integral -> [value(fill=mode), isNull?] (reference IntegralVectorizer;
+    mode fill is the reference default for integrals)."""
+
+    operation_name = "vecIntegral"
+    accepts = ("Integral",)
+
+    def __init__(self, fill_value: str | int = "mode", track_nulls: bool = True):
+        super().__init__(fill_value=fill_value, track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        fills = []
+        for c in cols:
+            if self.params["fill_value"] == "mode":
+                vals = np.asarray(c.values)[np.asarray(c.effective_mask())]
+                fills.append(int(Counter(vals.tolist()).most_common(1)[0][0]) if len(vals) else 0)
+            else:
+                fills.append(int(self.params["fill_value"]))
+        return IntegralVectorizerModel(
+            fills=fills,
+            track_nulls=self.params["track_nulls"],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class IntegralVectorizerModel(SequenceVectorizer):
+    operation_name = "vecIntegral"
+    # integral columns are host int64; conversion to float32 happens here, then device
+    device_op = False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        parts, slots = [], []
+        for c, fill, name, kind in zip(cols, p["fills"], p["names"], p["kinds"]):
+            mask = np.asarray(c.effective_mask())
+            vals = np.where(mask, np.asarray(c.values, np.float64), float(fill))
+            parts.append(jnp.asarray(vals, jnp.float32))
+            slots.append(value_slot(name, kind))
+            if p["track_nulls"]:
+                parts.append(jnp.asarray(~mask, jnp.float32))
+                slots.append(null_slot(name, kind))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class BinaryVectorizer(SequenceVectorizer):
+    """Binary -> [0/1(fill=false), isNull?] (reference BinaryVectorizer)."""
+
+    operation_name = "vecBinary"
+    device_op = True
+    accepts = ("Binary",)
+
+    def __init__(self, track_nulls: bool = True, fill_value: bool = False):
+        super().__init__(track_nulls=track_nulls, fill_value=fill_value)
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        parts, slots = [], []
+        fill = jnp.float32(1.0 if self.params["fill_value"] else 0.0)
+        for c, f in zip(cols, self.inputs):
+            mask = jnp.asarray(c.effective_mask(), jnp.float32)
+            vals = jnp.asarray(c.values, jnp.float32)
+            parts.append(vals * mask + fill * (1.0 - mask))
+            slots.append(value_slot(f.name, f.kind.name))
+            if self.params["track_nulls"]:
+                parts.append(1.0 - mask)
+                slots.append(null_slot(f.name, f.kind.name))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class FillMissingWithMean(Estimator):
+    """Real -> RealNN with nulls replaced by the training mean
+    (reference FillMissingWithMean.scala; dsl fillMissingWithMean
+    RichNumericFeature.scala:247)."""
+
+    operation_name = "fillWithMean"
+
+    def __init__(self, default: float = 0.0):
+        super().__init__(default=default)
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def fit_columns(self, cols: Sequence[Column]):
+        c = cols[0]
+        m = jnp.asarray(c.effective_mask())
+        n = jnp.asarray(m).sum()
+        mean = float((c.filled(0.0) * m).sum() / jnp.maximum(n, 1)) if int(n) else self.params["default"]
+        return FillMissingWithMeanModel(mean=mean)
+
+
+@register_stage
+class FillMissingWithMeanModel(Transformer):
+    operation_name = "fillWithMean"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        vals = cols[0].filled(self.params["mean"])
+        return Column(kind_of("RealNN"), vals, jnp.ones(vals.shape[0], bool))
+
+
+@register_stage
+class StandardScaler(Estimator):
+    """z-normalization of an OPVector or RealNN (reference OpScalarStandardScaler;
+    dsl zNormalize RichNumericFeature.scala:377). Fit = one jnp moment pass."""
+
+    operation_name = "stdScaler"
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True):
+        super().__init__(with_mean=with_mean, with_std=with_std)
+
+    def out_kind(self, in_kinds):
+        return kind_of("OPVector") if in_kinds[0].name == "OPVector" else kind_of("RealNN")
+
+    def fit_columns(self, cols: Sequence[Column]):
+        c = cols[0]
+        vals = c.filled(0.0)
+        if vals.ndim == 1:
+            vals = vals[:, None]
+        m = jnp.asarray(c.effective_mask(), jnp.float32)[:, None]
+        n = jnp.maximum(m.sum(axis=0), 1.0)
+        mean = (vals * m).sum(axis=0) / n
+        var = (((vals - mean) * m) ** 2).sum(axis=0) / n
+        std = jnp.sqrt(var)
+        return StandardScalerModel(
+            mean=[float(x) for x in mean],
+            std=[float(x) for x in std],
+            with_mean=self.params["with_mean"],
+            with_std=self.params["with_std"],
+        )
+
+
+@register_stage
+class StandardScalerModel(Transformer):
+    operation_name = "stdScaler"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return kind_of("OPVector") if in_kinds[0].name == "OPVector" else kind_of("RealNN")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        c = cols[0]
+        # missing values scale as the mean (-> 0 after centering)
+        vals = c.filled(float(self.params["mean"][0])) if c.mask is not None \
+            else jnp.asarray(c.values, jnp.float32)
+        squeeze = vals.ndim == 1
+        if squeeze:
+            vals = vals[:, None]
+        mean = jnp.asarray(self.params["mean"], jnp.float32)
+        std = jnp.asarray(self.params["std"], jnp.float32)
+        if self.params["with_mean"]:
+            vals = vals - mean
+        if self.params["with_std"]:
+            vals = vals / jnp.where(std > 0, std, 1.0)
+        if squeeze:
+            return Column(kind_of("RealNN"), vals[:, 0], jnp.ones(vals.shape[0], bool))
+        return Column.vector(vals, c.schema)
+
+
+@register_stage
+class NumericBucketizer(SequenceVectorizer):
+    """Bucketize reals by explicit split points into one-hot buckets + optional null
+    bucket (reference NumericBucketizer.scala; dsl bucketize
+    RichNumericFeature.scala:263-288)."""
+
+    operation_name = "bucketize"
+    # accepts host-side Integral columns -> needs np conversion, so not fuse-eligible
+    device_op = False
+    accepts = _REAL_KINDS + ("RealNN", "Integral")
+
+    def __init__(self, splits: Sequence[float], bucket_labels: Optional[Sequence[str]] = None,
+                 track_nulls: bool = True, track_invalid: bool = False):
+        splits = list(splits)
+        if sorted(splits) != splits or len(splits) < 2:
+            raise ValueError("splits must be ascending with at least 2 points")
+        labels = (list(bucket_labels) if bucket_labels
+                  else [f"{a}-{b}" for a, b in zip(splits, splits[1:])])
+        if len(labels) != len(splits) - 1:
+            raise ValueError("need len(splits)-1 bucket labels")
+        super().__init__(splits=splits, bucket_labels=labels, track_nulls=track_nulls,
+                         track_invalid=track_invalid)
+
+    # host integral inputs allowed -> not guaranteed pure-jnp; keep device for reals
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        splits = jnp.asarray(p["splits"], jnp.float32)
+        nb = len(p["bucket_labels"])
+        parts, slots = [], []
+        for c, f in zip(cols, self.inputs):
+            vals = jnp.asarray(np.asarray(c.values, np.float32))
+            mask = jnp.asarray(np.asarray(c.effective_mask()))
+            idx = jnp.clip(jnp.searchsorted(splits, vals, side="right") - 1, 0, nb - 1)
+            onehot = jax.nn.one_hot(idx, nb, dtype=jnp.float32)
+            in_range = (vals >= splits[0]) & (vals <= splits[-1]) & mask
+            onehot = onehot * in_range[:, None].astype(jnp.float32)
+            parts.append(onehot)
+            slots.extend(
+                SlotInfo(f.name, f.kind.name, indicator_value=lbl)
+                for lbl in p["bucket_labels"]
+            )
+            if p["track_invalid"]:
+                parts.append(jnp.asarray(~in_range & mask, jnp.float32))
+                slots.append(SlotInfo(f.name, f.kind.name, indicator_value="OutOfRange"))
+            if p["track_nulls"]:
+                parts.append(1.0 - jnp.asarray(mask, jnp.float32))
+                slots.append(null_slot(f.name, f.kind.name))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class DropIndicesTransformer(Transformer):
+    """Remove vector slots by index (reference DropIndicesByTransformer), used by the
+    SanityChecker to materialize its drop decisions."""
+
+    operation_name = "dropIndices"
+    device_op = True
+
+    def __init__(self, drop_indices: Sequence[int] = ()):
+        super().__init__(drop_indices=sorted(int(i) for i in drop_indices))
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "OPVector":
+            raise TypeError("DropIndicesTransformer takes an OPVector")
+        return kind_of("OPVector")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        c = cols[0]
+        drop = set(self.params["drop_indices"])
+        keep = [i for i in range(c.values.shape[1]) if i not in drop]
+        schema = c.schema.select(keep) if c.schema is not None else None
+        idx = jnp.asarray(keep, jnp.int32)  # explicit dtype: empty keep stays integer
+        return Column.vector(jnp.asarray(c.values)[:, idx], schema)

@@ -1,0 +1,237 @@
+"""Text stages: tokenization (host), hashing vectorization, smart text dispatch.
+
+TPU-native equivalents of reference TextTokenizer (Lucene), OPCollectionHashingVectorizer
+(core/.../impl/feature/OPCollectionHashingVectorizer.scala:59-109), OpHashingTF,
+SmartTextVectorizer (SmartTextVectorizer.scala:60-118), TextLenTransformer.
+
+Host/device boundary (SURVEY.md §7 hard parts): string ops are row-local host work; the
+device consumes their hashed/counted output. Hashing uses crc32 (stable, seedable) in
+place of the reference's MurMur3 — same bounded-feature-space role.
+"""
+from __future__ import annotations
+
+import re
+import zlib
+from collections import Counter
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, SlotInfo, VectorSchema, kind_of
+from ..base import Transformer, register_stage
+from .categorical import OneHotVectorizerModel, count_categories, pick_top_k
+from .common import (
+    SequenceVectorizer,
+    SequenceVectorizerEstimator,
+    null_slot,
+    value_slot,
+)
+
+_TOKEN_RE = re.compile(r"[^\w]+", re.UNICODE)
+_TEXT_KINDS = ("Text", "TextArea", "Email", "URL", "Phone", "ID", "Base64",
+               "Country", "State", "City", "PostalCode", "Street", "PickList", "ComboBox")
+
+
+def tokenize(text: Optional[str], *, to_lower: bool = True, min_token_len: int = 1) -> list[str]:
+    if text is None:
+        return []
+    s = text.lower() if to_lower else text
+    return [t for t in _TOKEN_RE.split(s) if len(t) >= min_token_len]
+
+
+def hash_token(token: str, num_features: int, seed: int = 0) -> int:
+    """Stable hash -> [0, num_features) (MurMur3 role in the reference)."""
+    h = zlib.crc32((token + ("" if not seed else f"#{seed}")).encode("utf-8"))
+    return h % num_features
+
+
+@register_stage
+class TextTokenizer(Transformer):
+    """Text -> TextList (reference TextTokenizer; Lucene analyzers replaced by a
+    unicode word splitter; language detection stays a separate stage)."""
+
+    operation_name = "tokenize"
+    device_op = False
+
+    def __init__(self, to_lower: bool = True, min_token_len: int = 1):
+        super().__init__(to_lower=to_lower, min_token_len=min_token_len)
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].storage.value != "text":
+            raise TypeError(f"TextTokenizer takes a text kind, got {in_kinds[0].name}")
+        return kind_of("TextList")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, v in enumerate(cols[0].values):
+            out[i] = tokenize(v, to_lower=p["to_lower"], min_token_len=p["min_token_len"])
+        return Column(kind_of("TextList"), out, None)
+
+
+@register_stage
+class TextLenTransformer(SequenceVectorizer):
+    """Text length vector (reference TextLenTransformer.scala)."""
+
+    operation_name = "textLen"
+    device_op = False
+    accepts = _TEXT_KINDS + ("TextList",)
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        parts, slots = [], []
+        for c, f in zip(cols, self.inputs):
+            if c.kind.storage.value == "text_list":
+                lens = np.array([sum(len(t) for t in v) for v in c.values], np.float32)
+            else:
+                lens = np.array([0.0 if v is None else len(v) for v in c.values], np.float32)
+            parts.append(jnp.asarray(lens))
+            slots.append(value_slot(f.name, f.kind.name, descriptor="textLen"))
+        from .common import stack_vector
+
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class HashingVectorizer(SequenceVectorizer):
+    """Token lists (or raw text) -> hashed counts [num_features] per input, or one
+    shared hash space (reference OPCollectionHashingVectorizer.scala:59-109 shared/
+    separate hash space semantics; OpHashingTF)."""
+
+    operation_name = "hashVec"
+    device_op = False
+    accepts = _TEXT_KINDS + ("TextList", "MultiPickList")
+
+    def __init__(self, num_features: int = 512, shared_hash_space: bool = False,
+                 binary_freq: bool = False, seed: int = 0):
+        super().__init__(num_features=num_features, shared_hash_space=shared_hash_space,
+                         binary_freq=binary_freq, seed=seed)
+
+    def _tokens(self, col: Column, i: int) -> list[str]:
+        v = col.values[i]
+        st = col.kind.storage.value
+        if st == "text":
+            return tokenize(v)
+        if v is None:
+            return []
+        return [str(t) for t in v]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        nf, shared = p["num_features"], p["shared_hash_space"]
+        n = len(cols[0])
+        width = nf if shared else nf * len(cols)
+        mat = np.zeros((n, width), dtype=np.float32)
+        for ci, c in enumerate(cols):
+            base = 0 if shared else ci * nf
+            for i in range(n):
+                for tok in self._tokens(c, i):
+                    j = base + hash_token(tok, nf, p["seed"])
+                    if p["binary_freq"]:
+                        mat[i, j] = 1.0
+                    else:
+                        mat[i, j] += 1.0
+        slots = []
+        if shared:
+            joint = "_".join(f.name for f in self.inputs)
+            slots.extend(
+                SlotInfo(joint, self.inputs[0].kind.name, descriptor=f"hash_{i}")
+                for i in range(nf)
+            )
+        else:
+            for f in self.inputs:
+                slots.extend(
+                    SlotInfo(f.name, f.kind.name, descriptor=f"hash_{i}")
+                    for i in range(nf)
+                )
+        return Column.vector(jnp.asarray(mat), VectorSchema(tuple(slots)))
+
+
+@register_stage
+class SmartTextVectorizer(SequenceVectorizerEstimator):
+    """Cardinality-driven per-feature choice between categorical pivot and hashing
+    (reference SmartTextVectorizer.scala:60-118: vocab small enough -> pivot like a
+    PickList; otherwise hash tokenized text)."""
+
+    operation_name = "smartText"
+    accepts = _TEXT_KINDS
+
+    def __init__(self, max_cardinality: int = 30, top_k: int = 20, min_support: int = 10,
+                 num_features: int = 512, clean_text: bool = True, track_nulls: bool = True,
+                 seed: int = 0):
+        super().__init__(max_cardinality=max_cardinality, top_k=top_k,
+                         min_support=min_support, num_features=num_features,
+                         clean_text=clean_text, track_nulls=track_nulls, seed=seed)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        plans = []
+        for c in cols:
+            counts = count_categories(c, p["clean_text"])
+            if 0 < len(counts) <= p["max_cardinality"]:
+                plans.append({
+                    "mode": "pivot",
+                    "categories": pick_top_k(counts, p["top_k"], p["min_support"]),
+                })
+            else:
+                plans.append({"mode": "hash"})
+        return SmartTextVectorizerModel(
+            plans=plans,
+            num_features=p["num_features"],
+            clean_text=p["clean_text"],
+            track_nulls=p["track_nulls"],
+            seed=p["seed"],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class SmartTextVectorizerModel(SequenceVectorizer):
+    operation_name = "smartText"
+    device_op = False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from .common import clean_token
+
+        p = self.params
+        nf = p["num_features"]
+        mats, slots = [], []
+        for c, plan, name, kind in zip(cols, p["plans"], p["names"], p["kinds"]):
+            n = len(c)
+            if plan["mode"] == "pivot":
+                cats = plan["categories"]
+                index = {v: i for i, v in enumerate(cats)}
+                k = len(cats)
+                width = k + 1 + (1 if p["track_nulls"] else 0)
+                mat = np.zeros((n, width), dtype=np.float32)
+                for i, v in enumerate(c.values):
+                    if v is None:
+                        if p["track_nulls"]:
+                            mat[i, k + 1] = 1.0
+                        continue
+                    j = index.get(clean_token(str(v), p["clean_text"]))
+                    mat[i, j if j is not None else k] = 1.0
+                slots.extend(SlotInfo(name, kind, indicator_value=v) for v in cats)
+                slots.append(SlotInfo(name, kind, indicator_value="OTHER"))
+                if p["track_nulls"]:
+                    slots.append(null_slot(name, kind))
+            else:
+                width = nf + (1 if p["track_nulls"] else 0)
+                mat = np.zeros((n, width), dtype=np.float32)
+                for i, v in enumerate(c.values):
+                    if v is None:
+                        if p["track_nulls"]:
+                            mat[i, nf] = 1.0
+                        continue
+                    for tok in tokenize(v):
+                        mat[i, hash_token(tok, nf, p["seed"])] += 1.0
+                slots.extend(
+                    SlotInfo(name, kind, descriptor=f"hash_{i}") for i in range(nf)
+                )
+                if p["track_nulls"]:
+                    slots.append(null_slot(name, kind))
+            mats.append(mat)
+        return Column.vector(
+            jnp.asarray(np.concatenate(mats, axis=1)), VectorSchema(tuple(slots))
+        )

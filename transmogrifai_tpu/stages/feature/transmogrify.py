@@ -1,0 +1,134 @@
+"""Transmogrifier: automated per-type default vectorization.
+
+TPU-native equivalent of reference Transmogrifier (core/.../impl/feature/
+Transmogrifier.scala:102-340; dsl entry RichFeaturesCollection.scala:69) with the
+reference's defaults (Transmogrifier.scala:52-90): TopK=20, MinSupport=10,
+TrackNulls=true, 512 hash features, MaxCategoricalCardinality=30, circular date
+encodings {HourOfDay, DayOfWeek, DayOfMonth, DayOfYear}.
+
+`transmogrify(features)` groups features by kind family, applies each family's default
+vectorizer (one sequence stage per family — N features in, one vector out), and combines
+everything with VectorsCombiner into the final feature vector.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from ...graph.feature import Feature
+from .categorical import OneHotVectorizer
+from .collections import GeolocationVectorizer, MapVectorizer, MultiPickListVectorizer
+from .combiner import VectorsCombiner
+from .date import DateListVectorizer, DateToUnitCircleVectorizer, TIME_PERIODS
+from .numeric import BinaryVectorizer, IntegralVectorizer, RealNNVectorizer, RealVectorizer
+from .text import HashingVectorizer, SmartTextVectorizer
+
+
+@dataclass(frozen=True)
+class TransmogrifierDefaults:
+    """Reference defaults (Transmogrifier.scala:52-90)."""
+
+    top_k: int = 20
+    min_support: int = 10
+    track_nulls: bool = True
+    clean_text: bool = True
+    num_hash_features: int = 512
+    max_categorical_cardinality: int = 30
+    fill_value: str | float = "mean"
+    time_periods: tuple = TIME_PERIODS
+    hash_seed: int = 0
+
+
+DEFAULTS = TransmogrifierDefaults()
+
+# kind-name -> family used for grouping in the dispatch table
+_FAMILIES: dict[str, str] = {}
+for _k in ("Real", "Currency", "Percent"):
+    _FAMILIES[_k] = "real"
+_FAMILIES["RealNN"] = "realnn"
+_FAMILIES["Integral"] = "integral"
+_FAMILIES["Binary"] = "binary"
+for _k in ("Date", "DateTime"):
+    _FAMILIES[_k] = "date"
+for _k in ("PickList", "ComboBox", "Country", "State", "City", "PostalCode", "Street"):
+    _FAMILIES[_k] = "categorical"
+for _k in ("Text", "TextArea", "Email", "URL", "Phone", "ID", "Base64"):
+    _FAMILIES[_k] = "smart_text"
+_FAMILIES["TextList"] = "text_list"
+for _k in ("DateList", "DateTimeList"):
+    _FAMILIES[_k] = "date_list"
+_FAMILIES["MultiPickList"] = "multi_pick_list"
+_FAMILIES["Geolocation"] = "geolocation"
+_FAMILIES["OPVector"] = "vector"
+for _k in ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap", "TextMap",
+           "TextAreaMap", "PickListMap", "ComboBoxMap", "IDMap", "EmailMap", "URLMap",
+           "PhoneMap", "Base64Map", "CountryMap", "StateMap", "CityMap",
+           "PostalCodeMap", "StreetMap", "BinaryMap", "MultiPickListMap"):
+    _FAMILIES[_k] = "map"
+
+
+def transmogrify(
+    features: Sequence[Feature],
+    defaults: TransmogrifierDefaults = DEFAULTS,
+) -> Feature:
+    """Auto-vectorize a mixed set of features into one OPVector feature."""
+    if not features:
+        raise ValueError("transmogrify needs at least one feature")
+    responses = [f for f in features if f.is_response]
+    if responses:
+        raise ValueError(
+            f"response features cannot be transmogrified: {[f.name for f in responses]}"
+        )
+    d = defaults
+    groups: dict[str, list[Feature]] = {}
+    for f in features:
+        fam = _FAMILIES.get(f.kind.name)
+        if fam is None:
+            raise TypeError(f"no default vectorizer for kind {f.kind.name}")
+        groups.setdefault(fam, []).append(f)
+
+    vectors: list[Feature] = []
+    for fam in sorted(groups):
+        feats = groups[fam]
+        if fam == "real":
+            stage = RealVectorizer(fill_value=d.fill_value, track_nulls=d.track_nulls)
+        elif fam == "realnn":
+            stage = RealNNVectorizer()
+        elif fam == "integral":
+            stage = IntegralVectorizer(track_nulls=d.track_nulls)
+        elif fam == "binary":
+            stage = BinaryVectorizer(track_nulls=d.track_nulls)
+        elif fam == "date":
+            stage = DateToUnitCircleVectorizer(
+                time_periods=list(d.time_periods), track_nulls=d.track_nulls)
+        elif fam == "categorical":
+            stage = OneHotVectorizer(
+                top_k=d.top_k, min_support=d.min_support,
+                clean_text=d.clean_text, track_nulls=d.track_nulls)
+        elif fam == "smart_text":
+            stage = SmartTextVectorizer(
+                max_cardinality=d.max_categorical_cardinality, top_k=d.top_k,
+                min_support=d.min_support, num_features=d.num_hash_features,
+                clean_text=d.clean_text, track_nulls=d.track_nulls, seed=d.hash_seed)
+        elif fam == "text_list":
+            stage = HashingVectorizer(num_features=d.num_hash_features, seed=d.hash_seed)
+        elif fam == "date_list":
+            stage = DateListVectorizer(track_nulls=d.track_nulls)
+        elif fam == "multi_pick_list":
+            stage = MultiPickListVectorizer(
+                top_k=d.top_k, min_support=d.min_support,
+                clean_text=d.clean_text, track_nulls=d.track_nulls)
+        elif fam == "geolocation":
+            stage = GeolocationVectorizer(track_nulls=d.track_nulls)
+        elif fam == "map":
+            stage = MapVectorizer(
+                top_k=d.top_k, min_support=d.min_support,
+                clean_text=d.clean_text, track_nulls=d.track_nulls)
+        elif fam == "vector":
+            vectors.extend(feats)
+            continue
+        vectors.append(stage(*feats))
+
+    if len(vectors) == 1:
+        return vectors[0]
+    return VectorsCombiner()(*vectors)

@@ -1,0 +1,24 @@
+from .base import PredictionModel, PredictorEstimator
+from .linear import (
+    LinearRegression,
+    LinearRegressionModel,
+    LinearSVC,
+    LinearSVCModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+    MultinomialLogisticRegression,
+    MultinomialLogisticRegressionModel,
+)
+
+__all__ = [
+    "PredictorEstimator",
+    "PredictionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "MultinomialLogisticRegression",
+    "MultinomialLogisticRegressionModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+    "LinearSVC",
+    "LinearSVCModel",
+]

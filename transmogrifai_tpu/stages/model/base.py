@@ -1,0 +1,66 @@
+"""Model-stage base: (response RealNN, features OPVector) -> Prediction.
+
+Analog of the reference's OpPredictorWrapper contract (core/.../sparkwrappers/specific/
+OpPredictorWrapper.scala:67-109): every predictor, whatever the family, is a stage from
+(label, feature-vector) to a Prediction struct {prediction, rawPrediction[], probability[]}.
+The fitted models are pure-jnp device transformers, so scoring fuses into the workflow's
+XLA program and the serving path is the same kernel (no MLeap conversion, SURVEY §2.11g).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, kind_of
+from ..base import Estimator, Transformer
+
+
+class PredictorEstimator(Estimator):
+    """Base for trainers: inputs (response, features)."""
+
+    arity = (2, 2)
+
+    def out_kind(self, in_kinds):
+        resp, feat = in_kinds
+        if feat.name != "OPVector":
+            raise TypeError(f"{type(self).__name__} features input must be OPVector, got {feat.name}")
+        if resp.name not in ("RealNN", "Real", "Binary", "Integral"):
+            raise TypeError(f"{type(self).__name__} response must be numeric, got {resp.name}")
+        return kind_of("Prediction")
+
+    def is_response_out(self) -> bool:
+        return False  # predictions are predictors downstream, not responses
+
+    @staticmethod
+    def label_and_matrix(cols: Sequence[Column]):
+        y = jnp.asarray(np.asarray(cols[0].values), jnp.float32)
+        X = jnp.asarray(cols[1].values, jnp.float32)
+        return y, X
+
+
+class PredictionModel(Transformer):
+    """Base for fitted models."""
+
+    arity = (2, 2)
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return kind_of("Prediction")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def predict(self, X):
+        """-> (pred [N], raw [N,C], prob [N,C]) in pure jnp."""
+        raise NotImplementedError
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        X = jnp.asarray(cols[1].values, jnp.float32)
+        pred, raw, prob = self.predict(X)
+        return Column.prediction(pred, raw, prob)
+
+
+def weights_to_params(w, b) -> dict:
+    return {"w": np.asarray(w).tolist(), "b": np.asarray(b).tolist()}

@@ -1,0 +1,130 @@
+"""Linear-family model stages: logistic regression, linear regression, linear SVC,
+multinomial logistic (the reference's OpLogisticRegression.scala:46,
+OpLinearRegression, OpLinearSVC, re-backed by the jnp trainers in ops/linear.py)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.linear import (
+    LinearParams,
+    fit_linear,
+    fit_logistic,
+    fit_multinomial,
+    fit_svc,
+    predict_linear,
+    predict_logistic,
+    predict_multinomial,
+    predict_svc,
+)
+from ...types import Column
+from ..base import register_stage
+from .base import PredictionModel, PredictorEstimator
+
+
+@register_stage
+class LogisticRegression(PredictorEstimator):
+    """Binary logistic regression via Newton-IRLS (analog of OpLogisticRegression;
+    regParam/elasticNet grid axis = l2 here)."""
+
+    operation_name = "logReg"
+
+    def __init__(self, l2: float = 0.0, max_iter: int = 25):
+        super().__init__(l2=float(l2), max_iter=int(max_iter))
+
+    def fit_columns(self, cols: Sequence[Column]):
+        y, X = self.label_and_matrix(cols)
+        params = fit_logistic(X, y, l2=self.params["l2"], max_iter=self.params["max_iter"])
+        return LogisticRegressionModel(
+            w=np.asarray(params.w).tolist(), b=float(params.b))
+
+
+@register_stage
+class LogisticRegressionModel(PredictionModel):
+    operation_name = "logReg"
+
+    def predict(self, X):
+        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
+                         jnp.asarray(self.params["b"], jnp.float32))
+        return predict_logistic(p, X)
+
+
+@register_stage
+class MultinomialLogisticRegression(PredictorEstimator):
+    """Softmax regression for multiclass (reference uses OpLogisticRegression with
+    family=multinomial)."""
+
+    operation_name = "mnLogReg"
+
+    def __init__(self, num_classes: int = 0, l2: float = 0.0, max_iter: int = 300):
+        super().__init__(num_classes=int(num_classes), l2=float(l2), max_iter=int(max_iter))
+
+    def fit_columns(self, cols: Sequence[Column]):
+        y, X = self.label_and_matrix(cols)
+        nc = self.params["num_classes"] or int(np.asarray(y).max()) + 1
+        params = fit_multinomial(X, y.astype(jnp.int32), num_classes=nc,
+                                 l2=self.params["l2"], max_iter=self.params["max_iter"])
+        return MultinomialLogisticRegressionModel(
+            w=np.asarray(params.w).tolist(), b=np.asarray(params.b).tolist())
+
+
+@register_stage
+class MultinomialLogisticRegressionModel(PredictionModel):
+    operation_name = "mnLogReg"
+
+    def predict(self, X):
+        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
+                         jnp.asarray(self.params["b"], jnp.float32))
+        return predict_multinomial(p, X)
+
+
+@register_stage
+class LinearRegression(PredictorEstimator):
+    """Weighted ridge regression, closed form (analog of OpLinearRegression)."""
+
+    operation_name = "linReg"
+
+    def __init__(self, l2: float = 0.0):
+        super().__init__(l2=float(l2))
+
+    def fit_columns(self, cols: Sequence[Column]):
+        y, X = self.label_and_matrix(cols)
+        params = fit_linear(X, y, l2=self.params["l2"])
+        return LinearRegressionModel(w=np.asarray(params.w).tolist(), b=float(params.b))
+
+
+@register_stage
+class LinearRegressionModel(PredictionModel):
+    operation_name = "linReg"
+
+    def predict(self, X):
+        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
+                         jnp.asarray(self.params["b"], jnp.float32))
+        return predict_linear(p, X)
+
+
+@register_stage
+class LinearSVC(PredictorEstimator):
+    """Linear SVM with squared hinge (analog of OpLinearSVC)."""
+
+    operation_name = "svc"
+
+    def __init__(self, reg: float = 1e-2, max_iter: int = 300):
+        super().__init__(reg=float(reg), max_iter=int(max_iter))
+
+    def fit_columns(self, cols: Sequence[Column]):
+        y, X = self.label_and_matrix(cols)
+        params = fit_svc(X, y, reg=self.params["reg"], max_iter=self.params["max_iter"])
+        return LinearSVCModel(w=np.asarray(params.w).tolist(), b=float(params.b))
+
+
+@register_stage
+class LinearSVCModel(PredictionModel):
+    operation_name = "svc"
+
+    def predict(self, X):
+        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
+                         jnp.asarray(self.params["b"], jnp.float32))
+        return predict_svc(p, X)

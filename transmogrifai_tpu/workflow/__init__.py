@@ -1,0 +1,3 @@
+from .workflow import Workflow, WorkflowModel
+
+__all__ = ["Workflow", "WorkflowModel"]

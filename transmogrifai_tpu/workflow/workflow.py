@@ -1,0 +1,295 @@
+"""Workflow engine: lineage DAG -> layered fit -> fused XLA transforms.
+
+TPU-native analog of OpWorkflow/OpWorkflowCore/OpWorkflowModel (reference
+core/src/main/scala/com/salesforce/op/OpWorkflow.scala:85-461, OpWorkflowModel.scala,
+FitStagesUtil.scala:213-293):
+
+  workflow = Workflow().set_reader(r).set_result_features(pred)
+  model = workflow.train()
+  scores = model.score()
+
+Key departure from the Spark design (SURVEY.md §2.8): transform-only stage runs are NOT
+applied one stage at a time with persist-every-K to break Catalyst — maximal runs of
+device stages are traced into ONE jit-compiled XLA program over the Column pytree, so
+XLA fuses the whole run into a handful of kernels. Host stages (string ops) break fusion
+naturally and run between device programs.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional, Sequence
+
+import jax
+
+from ..graph.dag import compute_dag, split_layer_by_kind, validate_dag
+from ..graph.feature import Feature, validate_distinct_names
+from ..readers.base import DataReader, TableReader
+from ..stages.base import Estimator, FeatureGeneratorStage, Stage, Transformer
+from ..types import Column, Table
+from ..utils import uid as make_uid
+
+
+def _fuse_device_run(stages: Sequence[Transformer]) -> Callable[[dict], dict]:
+    """One jit program applying a run of device transformers; input/output = dicts of
+    Columns (pytrees)."""
+    out_names = [s.get_output().name for s in stages]
+
+    def fn(cols: dict) -> dict:
+        cols = dict(cols)
+        for s in stages:
+            cols[s.get_output().name] = s.transform_columns(
+                [cols[f.name] for f in s.inputs]
+            )
+        return {n: cols[n] for n in out_names}
+
+    return jax.jit(fn)
+
+
+class _CompiledPlan:
+    """Topologically-ordered transform plan with maximal fused device runs."""
+
+    def __init__(self, stages_in_order: Sequence[Transformer]):
+        self.groups: list[tuple[str, list[Transformer]]] = []
+        for s in stages_in_order:
+            kind = "device" if s.device_op else "host"
+            if self.groups and self.groups[-1][0] == kind == "device":
+                self.groups[-1][1].append(s)
+            else:
+                self.groups.append((kind, [s]))
+        self._jitted: dict[int, Callable] = {}
+
+    def apply(self, table: Table, jit_fuse: bool = True) -> Table:
+        for gi, (kind, stages) in enumerate(self.groups):
+            if kind == "device" and jit_fuse:
+                fn = self._jitted.get(gi)
+                if fn is None:
+                    fn = self._jitted[gi] = _fuse_device_run(stages)
+                produced = {s.get_output().name for s in stages}
+                needed = {f.name for s in stages for f in s.inputs} - produced
+                outs = fn({n: table[n] for n in needed})
+                table = table.with_columns(outs)
+            else:
+                for s in stages:
+                    table = s.transform_table(table)
+        return table
+
+
+class WorkflowCore:
+    """Shared state of Workflow/WorkflowModel (analog of OpWorkflowCore.scala:57-358)."""
+
+    def __init__(self):
+        self.reader: Optional[DataReader] = None
+        self.result_features: tuple[Feature, ...] = ()
+        self.raw_features: tuple[Feature, ...] = ()
+        self.blacklisted: tuple[Feature, ...] = ()
+
+    def set_reader(self, reader: DataReader):
+        self.reader = reader
+        return self
+
+    def set_input_table(self, table: Table):
+        """Wrap an existing Table (analog of setInputDataset -> CustomReader,
+        OpWorkflowCore.scala:146-160)."""
+        self.reader = TableReader(table)
+        return self
+
+    def _generate_raw(self, reader: Optional[DataReader] = None) -> Table:
+        reader = reader or self.reader
+        if reader is None:
+            raise ValueError("no reader set; call set_reader or set_input_table")
+        return reader.generate_table(list(self.raw_features))
+
+
+class Workflow(WorkflowCore):
+    """Un-trained workflow (analog of OpWorkflow)."""
+
+    def __init__(self):
+        super().__init__()
+        self._raw_filter = None  # RawFeatureFilter, wired via with_raw_feature_filter
+
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        """Back-trace lineage into the layered DAG (OpWorkflow.scala:85-105)."""
+        if not features:
+            raise ValueError("need at least one result feature")
+        self.result_features = tuple(features)
+        raw: list[Feature] = []
+        seen = set()
+        for f in features:
+            for r in f.raw_features():
+                if id(r) not in seen:
+                    seen.add(id(r))
+                    raw.append(r)
+        self.raw_features = tuple(raw)
+        validate_distinct_names(
+            [f for feat in features for f in feat.all_features()]
+        )
+        dag = compute_dag(self.result_features)
+        validate_dag(dag)
+        self._dag = dag
+        return self
+
+    def with_raw_feature_filter(self, raw_filter) -> "Workflow":
+        """Attach a RawFeatureFilter (OpWorkflow.scala:524-563)."""
+        self._raw_filter = raw_filter
+        return self
+
+    def train(self, table: Optional[Table] = None) -> "WorkflowModel":
+        """Fit all estimator stages layer by layer; bulk-apply transformers between fit
+        points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG)."""
+        if not self.result_features:
+            raise ValueError("set_result_features first")
+        if table is not None:
+            self.set_input_table(table)
+        data = self._generate_raw()
+        blacklisted: tuple[Feature, ...] = ()
+        if self._raw_filter is not None:
+            data, blacklisted = self._raw_filter.filter_raw(self.raw_features, data)
+        fitted_stages: list[Transformer] = []
+        for layer in self._dag:
+            estimators, device_tf, host_tf = split_layer_by_kind(layer)
+            layer_transformers: list[Transformer] = list(device_tf) + list(host_tf)
+            for est in estimators:
+                model = est.fit_table(data)
+                layer_transformers.append(model)
+            # bulk-apply the whole layer once (fit points materialize new columns for
+            # the next layer's estimators)
+            plan = _CompiledPlan(_topo_within_layer(layer_transformers))
+            data = plan.apply(data)
+            fitted_stages.extend(_topo_within_layer(layer_transformers))
+        model = WorkflowModel(
+            result_features=self.result_features,
+            raw_features=self.raw_features,
+            stages=fitted_stages,
+            blacklisted=blacklisted,
+        )
+        model.reader = self.reader
+        return model
+
+
+def _topo_within_layer(stages: list[Transformer]) -> list[Transformer]:
+    """Stages inside one DAG layer are independent by construction; keep device stages
+    first so the fused run covers them in one program."""
+    return sorted(stages, key=lambda s: (not s.device_op,))
+
+
+class WorkflowModel(WorkflowCore):
+    """Fitted workflow (analog of OpWorkflowModel): scoring, evaluation, persistence."""
+
+    MANIFEST = "model.json"
+
+    def __init__(self, result_features: Sequence[Feature], raw_features: Sequence[Feature],
+                 stages: Sequence[Transformer], blacklisted: Sequence[Feature] = ()):
+        super().__init__()
+        self.result_features = tuple(result_features)
+        self.raw_features = tuple(raw_features)
+        self.stages = list(stages)
+        self.blacklisted = tuple(blacklisted)
+        self.uid = make_uid("WorkflowModel")
+        self._plan: Optional[_CompiledPlan] = None
+
+    # --- scoring (analog of OpWorkflowModel.score, scoreFn) ---------------------------
+    def transform(self, table: Table, keep_intermediate: bool = False) -> Table:
+        if self._plan is None:
+            self._plan = _CompiledPlan(self.stages)
+        out = self._plan.apply(table)
+        if keep_intermediate:
+            return out
+        keep = [f.name for f in self.result_features if f.name in out.columns]
+        raw_keep = [f.name for f in self.raw_features if f.is_response and f.name in out.columns]
+        return out.select(list(dict.fromkeys(raw_keep + keep)))
+
+    def score(
+        self,
+        table: Optional[Table] = None,
+        reader: Optional[DataReader] = None,
+        keep_intermediate: bool = False,
+    ) -> Table:
+        reader = TableReader(table) if table is not None else (reader or self.reader)
+        if reader is None:
+            raise ValueError("no reader set; pass table= or reader=")
+        raw = self._generate_raw_for_scoring(reader)
+        return self.transform(raw, keep_intermediate=keep_intermediate)
+
+    def _generate_raw_for_scoring(self, reader: DataReader) -> Table:
+        """Scoring data may lack response columns (unlabeled serving — the reference
+        scores without labels too, OpWorkflowModel.scala:254). Missing responses get
+        placeholder columns; predictors must be present."""
+        feats = list(self.raw_features)
+        try:
+            return reader.generate_table(feats)
+        except KeyError:
+            predictors = [f for f in feats if not f.is_response]
+            t = reader.generate_table(predictors)  # re-raises if a predictor is missing
+            for f in feats:
+                if f.is_response:
+                    t = t.with_column(f.name, Column.build(f.kind, [0] * t.nrows))
+            return t
+
+    def score_and_evaluate(self, evaluator, table: Optional[Table] = None,
+                           reader: Optional[DataReader] = None):
+        scores = self.score(table=table, reader=reader, keep_intermediate=True)
+        metrics = evaluator.evaluate_all(scores)
+        return self.transform_select(scores), metrics
+
+    def transform_select(self, out: Table) -> Table:
+        keep = [f.name for f in self.result_features if f.name in out.columns]
+        return out.select(keep)
+
+    def evaluate(self, evaluator, table: Optional[Table] = None,
+                 reader: Optional[DataReader] = None):
+        _, metrics = self.score_and_evaluate(evaluator, table=table, reader=reader)
+        return metrics
+
+    # --- persistence (analog of OpWorkflowModelWriter/Reader) -------------------------
+    def save(self, path: str, overwrite: bool = False) -> None:
+        os.makedirs(path, exist_ok=True)
+        target = os.path.join(path, self.MANIFEST)
+        if os.path.exists(target) and not overwrite:
+            raise FileExistsError(f"{target} exists; pass overwrite=True")
+        manifest = {
+            "version": 1,
+            "uid": self.uid,
+            "raw_features": [
+                {"name": f.name, "kind": f.kind.name, "is_response": f.is_response}
+                for f in self.raw_features
+            ],
+            "result_features": [f.name for f in self.result_features],
+            "blacklisted": [f.name for f in self.blacklisted],
+            "stages": [
+                {**s.to_json(), "output": s.get_output().name,
+                 "output_kind": s.get_output().kind.name}
+                for s in self.stages
+            ],
+        }
+        with open(target, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        with open(os.path.join(path, WorkflowModel.MANIFEST)) as fh:
+            manifest = json.load(fh)
+        from ..graph.builder import FeatureBuilder
+
+        features: dict[str, Feature] = {}
+        raw = []
+        for rf in manifest["raw_features"]:
+            fb = FeatureBuilder(rf["name"], rf["kind"])
+            f = fb.as_response() if rf["is_response"] else fb.as_predictor()
+            features[f.name] = f
+            raw.append(f)
+        stages: list[Transformer] = []
+        for sj in manifest["stages"]:
+            stage = Stage.from_json(sj)
+            ins = [features[n] for n in sj["inputs"]]
+            out = stage.set_input(*ins)
+            out.name = sj["output"]
+            features[out.name] = out
+            stages.append(stage)
+        model = WorkflowModel(
+            result_features=[features[n] for n in manifest["result_features"]],
+            raw_features=raw,
+            stages=stages,
+        )
+        model.uid = manifest["uid"]
+        return model
