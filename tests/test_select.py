@@ -1,0 +1,197 @@
+"""ModelSelector / tuning tests (mirror of reference ModelSelectorTest,
+OpCrossValidationTest, DataBalancerTest, DataCutterTest, RandomParamBuilderTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.select import (
+    BinaryClassificationModelSelector,
+    CrossValidation,
+    DataBalancer,
+    DataCutter,
+    DataSplitter,
+    ModelSelector,
+    MultiClassificationModelSelector,
+    ParamGridBuilder,
+    RandomParamBuilder,
+    RegressionModelSelector,
+    TrainValidationSplit,
+)
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.workflow import Workflow
+
+
+# --- grids ------------------------------------------------------------------------------
+def test_param_grid_builder_cartesian():
+    grid = ParamGridBuilder().add("l2", [0.1, 0.2]).add("max_iter", [5, 10]).build()
+    assert len(grid) == 4
+    assert {"l2": 0.1, "max_iter": 5} in grid
+
+
+def test_random_param_builder_deterministic():
+    b = RandomParamBuilder(seed=7).exponential("l2", 1e-4, 1e-1).choice("max_iter", [5, 10])
+    g1, g2 = b.build(5), b.build(5)
+    assert g1 == g2
+    assert all(1e-4 <= p["l2"] <= 1e-1 for p in g1)
+    assert all(p["max_iter"] in (5, 10) for p in g1)
+
+
+# --- splitters --------------------------------------------------------------------------
+def test_data_splitter_reserves_holdout():
+    y = np.zeros(100, np.float32)
+    tr, ho = DataSplitter(reserve_test_fraction=0.2, seed=1).split_indices(y)
+    assert len(ho) == 20 and len(tr) == 80
+    assert len(np.intersect1d(tr, ho)) == 0
+
+
+def test_data_balancer_weights_minority_to_target():
+    y = np.r_[np.ones(5), np.zeros(95)].astype(np.float32)
+    w, label_map, summary = DataBalancer(sample_fraction=0.3).prepare(y)
+    assert label_map is None
+    # weighted positive fraction == target
+    frac = w[y == 1].sum() / w.sum()
+    assert frac == pytest.approx(0.3, abs=1e-5)
+    assert summary.down_sample_fraction < 1.0
+
+
+def test_data_balancer_leaves_balanced_data_alone():
+    y = np.r_[np.ones(50), np.zeros(50)].astype(np.float32)
+    w, _, summary = DataBalancer(sample_fraction=0.1).prepare(y)
+    assert np.all(w == 1.0)
+    assert summary.down_sample_fraction == 1.0
+
+
+def test_data_cutter_drops_rare_labels():
+    y = np.r_[np.zeros(50), np.ones(45), np.full(5, 2.0)].astype(np.float32)
+    cutter = DataCutter(min_label_fraction=0.1)
+    w, label_map, summary = cutter.prepare(y)
+    assert summary.labels_dropped == [2.0]
+    assert sorted(label_map) == [0.0, 1.0]
+    assert w[y == 2.0].sum() == 0.0
+
+
+def test_data_cutter_max_categories():
+    y = np.repeat(np.arange(10.0), 10).astype(np.float32)
+    w, label_map, summary = DataCutter(max_label_categories=4).prepare(y)
+    assert len(label_map) == 4
+    assert len(summary.labels_dropped) == 6
+
+
+# --- validators -------------------------------------------------------------------------
+def test_cv_folds_partition_and_stratify():
+    y = np.r_[np.ones(30), np.zeros(90)].astype(np.float32)
+    keep = np.ones_like(y)
+    masks = CrossValidation(num_folds=3, seed=0).fold_masks(y, keep)
+    assert masks.shape == (3, 120)
+    assert np.all(masks.sum(axis=0) == 1.0)  # every row in exactly one fold
+    for k in range(3):
+        assert y[masks[k] == 1].sum() == 10  # positives evenly stratified
+
+
+def test_tv_split_single_fold():
+    y = np.r_[np.ones(40), np.zeros(40)].astype(np.float32)
+    masks = TrainValidationSplit(train_ratio=0.75, seed=0).fold_masks(y, np.ones_like(y))
+    assert masks.shape[0] == 1
+    frac = masks[0].mean()
+    assert 0.2 <= frac <= 0.3
+
+
+def _separable(n=200, d=8, seed=3, noise=0.3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (X @ w + noise * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+# --- end-to-end selector ----------------------------------------------------------------
+def _selector_fit(selector, X, y):
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    pred = selector(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    model = selector.fit_table(table)
+    return model, pred, table
+
+
+def test_binary_selector_picks_and_fits(rng):
+    X, y = _separable()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, validation_metric="AuPR", seed=5)
+    model, pred, table = _selector_fit(sel, X, y)
+    s = sel.summary_
+    assert s.best_model_name in ("LogisticRegression", "LinearSVC",
+                                 "RandomForestClassifier", "GBTClassifier")
+    # LR grid (4) + SVC grid (4) at minimum, each validated on 3 folds
+    assert s.models_evaluated >= 8 * 3
+    assert all(len(r.metric_values) == 3 for r in s.validation_results)
+    assert s.holdout_metrics is not None
+    assert s.holdout_metrics.AuROC > 0.7  # separable data must be learnable
+    out = model.transform_table(table)
+    assert out[pred.name].prob.shape[0] == len(y)
+
+
+def test_selector_train_validation_split():
+    X, y = _separable(n=150)
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        train_ratio=0.75, seed=2)
+    model, _, _ = _selector_fit(sel, X, y)
+    assert all(len(r.metric_values) == 1 for r in sel.summary_.validation_results)
+
+
+def test_multiclass_selector():
+    rng = np.random.default_rng(0)
+    n, d, c = 240, 6, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(c, d)).astype(np.float32)
+    y = np.argmax(X @ W.T + 0.1 * rng.normal(size=(n, c)), axis=1).astype(np.float32)
+    sel = MultiClassificationModelSelector.with_cross_validation(num_folds=2, seed=1)
+    model, pred, table = _selector_fit(sel, X, y)
+    s = sel.summary_
+    assert s.problem_type == "multiclass"
+    assert s.holdout_metrics.F1 > 0.5
+    out = model.transform_table(table)
+    assert out[pred.name].prob.shape[1] >= c
+
+
+def test_regression_selector():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(150, 5)).astype(np.float32)
+    w = rng.normal(size=5).astype(np.float32)
+    y = (X @ w + 0.05 * rng.normal(size=150)).astype(np.float32)
+    sel = RegressionModelSelector.with_cross_validation(num_folds=3, seed=1)
+    model, pred, table = _selector_fit(sel, X, y)
+    s = sel.summary_
+    assert s.larger_is_better is False
+    assert s.holdout_metrics.R2 > 0.9
+    assert s.best_model_name in ("LinearRegression", "RandomForestRegressor",
+                                 "GBTRegressor")
+
+
+def test_selector_custom_models_and_summary_json():
+    X, y = _separable(n=120)
+    grid = ParamGridBuilder().add("l2", [0.01, 0.1]).build()
+    sel = ModelSelector("binary", models=[(LogisticRegression(), grid)],
+                        validator=CrossValidation(num_folds=2, seed=0), seed=0)
+    _selector_fit(sel, X, y)
+    blob = sel.summary_.to_json()
+    assert blob["best_model_name"] == "LogisticRegression"
+    assert len(blob["validation_results"]) == 2
+    import json
+
+    json.dumps(blob)  # must be JSON-serializable end to end
+
+
+def test_selector_in_workflow_end_to_end():
+    """Selector as a DAG stage inside Workflow.train (the OpWorkflowCVTest shape)."""
+    X, y = _separable(n=160)
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    sel = BinaryClassificationModelSelector.with_cross_validation(num_folds=2, seed=4)
+    pred = sel(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    model = Workflow().set_result_features(pred).train(table=table)
+    scores = model.score(table=table, keep_intermediate=True)
+    assert scores[pred.name].prob.shape[0] == len(y)
+    assert sel.summary_ is not None

@@ -1,0 +1,30 @@
+"""Model selection & tuning: splitters, grids, batched CV, ModelSelector.
+
+TPU-native re-design of the reference's selector/tuning packages (SURVEY §2.11c):
+folds x grid-points ride vmap axes of one compiled program instead of a JVM thread
+pool over Spark jobs."""
+from .grids import ParamGridBuilder, RandomParamBuilder
+from .selector import (
+    BinaryClassificationModelSelector,
+    ModelSelector,
+    ModelSelectorSummary,
+    MultiClassificationModelSelector,
+    RegressionModelSelector,
+    default_models,
+)
+from .splitters import DataBalancer, DataCutter, DataSplitter, SplitterSummary
+from .validator import (
+    CrossValidation,
+    EvaluatedGridPoint,
+    TrainValidationSplit,
+    evaluate_candidates,
+)
+
+__all__ = [
+    "ParamGridBuilder", "RandomParamBuilder",
+    "BinaryClassificationModelSelector", "ModelSelector", "ModelSelectorSummary",
+    "MultiClassificationModelSelector", "RegressionModelSelector", "default_models",
+    "DataBalancer", "DataCutter", "DataSplitter", "SplitterSummary",
+    "CrossValidation", "EvaluatedGridPoint", "TrainValidationSplit",
+    "evaluate_candidates",
+]

@@ -1,0 +1,70 @@
+"""Hyperparameter grid construction: cartesian grids and random search.
+
+Analog of Spark's ParamGridBuilder usage in the selector factories plus the
+reference's RandomParamBuilder (core/.../selector/RandomParamBuilder.scala:52).
+A grid is just a list of dicts {param_name: value}; the validator later splits each
+grid by the family's vmap_params so continuous axes ride one compiled program.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class ParamGridBuilder:
+    """Cartesian product grid: `ParamGridBuilder().add("l2", [0.01, 0.1]).build()`."""
+
+    def __init__(self):
+        self._axes: list[tuple[str, list]] = []
+
+    def add(self, name: str, values: Sequence) -> "ParamGridBuilder":
+        self._axes.append((name, list(values)))
+        return self
+
+    def build(self) -> list[dict]:
+        grid = [{}]
+        for name, values in self._axes:
+            grid = [{**g, name: v} for g in grid for v in values]
+        return grid
+
+
+class RandomParamBuilder:
+    """Random-search grid (analog of RandomParamBuilder.scala:52): draw each param
+    from a uniform / log-uniform ("exponential") / choice distribution."""
+
+    def __init__(self, seed: int = 42):
+        self._draws: list[tuple[str, str, tuple]] = []
+        self.seed = seed
+
+    def uniform(self, name: str, lo: float, hi: float) -> "RandomParamBuilder":
+        self._draws.append((name, "uniform", (lo, hi)))
+        return self
+
+    def exponential(self, name: str, lo: float, hi: float) -> "RandomParamBuilder":
+        if lo <= 0 or hi <= 0:
+            raise ValueError("exponential bounds must be positive")
+        self._draws.append((name, "exponential", (lo, hi)))
+        return self
+
+    def choice(self, name: str, options: Sequence) -> "RandomParamBuilder":
+        self._draws.append((name, "choice", (list(options),)))
+        return self
+
+    def build(self, n: int, seed: Optional[int] = None) -> list[dict]:
+        rng = np.random.default_rng(self.seed if seed is None else seed)
+        out = []
+        for _ in range(n):
+            point = {}
+            for name, kind, args in self._draws:
+                if kind == "uniform":
+                    lo, hi = args
+                    point[name] = float(rng.uniform(lo, hi))
+                elif kind == "exponential":
+                    lo, hi = args
+                    point[name] = float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+                else:
+                    (options,) = args
+                    point[name] = options[int(rng.integers(len(options)))]
+            out.append(point)
+        return out

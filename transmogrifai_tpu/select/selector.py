@@ -1,0 +1,322 @@
+"""ModelSelector: automatic model selection as an estimator stage.
+
+Analog of ModelSelector/ModelSelectorFactory and the three problem-type factories
+(core/.../impl/selector/ModelSelector.scala:73-135, BinaryClassificationModelSelector.
+scala:52-128, MultiClassificationModelSelector.scala:59-61, RegressionModelSelector.
+scala:59-61). `fit` = reserve holdout -> prepare train (balance/cut) -> validate every
+(family, grid-point) over folds via the vmapped validator -> refit the winner on the
+full prepared train split -> report train + holdout metrics with the exact host
+evaluators. The search itself is device-batched (see validator.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..evaluators.evaluators import Evaluators
+from ..stages.base import register_stage
+from ..stages.model.base import PredictorEstimator
+from ..types import Column, Table
+from .grids import ParamGridBuilder
+from .splitters import DataBalancer, DataCutter, DataSplitter, SplitterSummary
+from .validator import (
+    CrossValidation,
+    EvaluatedGridPoint,
+    TrainValidationSplit,
+    ValidatorBase,
+    evaluate_candidates,
+)
+
+#: reference default regularization grid (DefaultSelectorParams.scala: Regularization)
+REGULARIZATION_GRID = [0.001, 0.01, 0.1, 0.2]
+
+
+@dataclass
+class ModelSelectorSummary:
+    """What the selector saw and decided (analog of ModelSelectorSummary.scala)."""
+
+    validation_type: str
+    problem_type: str
+    metric_name: str
+    larger_is_better: bool
+    best_model_name: str = ""
+    best_params: dict = field(default_factory=dict)
+    validation_results: list = field(default_factory=list)  # [EvaluatedGridPoint]
+    splitter_summary: Optional[SplitterSummary] = None
+    train_metrics: Optional[object] = None
+    holdout_metrics: Optional[object] = None
+    n_train: int = 0
+    n_holdout: int = 0
+    models_evaluated: int = 0  # grid points x folds (the bench.py throughput unit)
+
+    def to_json(self) -> dict:
+        return {
+            "validation_type": self.validation_type,
+            "problem_type": self.problem_type,
+            "metric_name": self.metric_name,
+            "larger_is_better": self.larger_is_better,
+            "best_model_name": self.best_model_name,
+            "best_params": self.best_params,
+            "validation_results": [r.to_json() for r in self.validation_results],
+            "splitter_summary": (self.splitter_summary.to_json()
+                                 if self.splitter_summary else None),
+            "train_metrics": (self.train_metrics.to_json()
+                              if self.train_metrics is not None else None),
+            "holdout_metrics": (self.holdout_metrics.to_json()
+                                if self.holdout_metrics is not None else None),
+            "n_train": self.n_train,
+            "n_holdout": self.n_holdout,
+            "models_evaluated": self.models_evaluated,
+        }
+
+    def pretty(self) -> str:
+        lines = [
+            f"Selected model: {self.best_model_name} {self.best_params}",
+            f"Validation ({self.validation_type}, metric={self.metric_name}):",
+        ]
+        ranked = sorted(self.validation_results, key=lambda r: r.metric_mean,
+                        reverse=self.larger_is_better)
+        for r in ranked[:10]:
+            lines.append(f"  {r.model_name} {r.grid_point}: "
+                         f"{r.metric_mean:.4f} (folds {['%.4f' % v for v in r.metric_values]})")
+        if self.holdout_metrics is not None:
+            lines.append(f"Holdout metrics: {self.holdout_metrics.to_json()}")
+        return "\n".join(lines)
+
+
+@register_stage
+class ModelSelector(PredictorEstimator):
+    """Estimator stage `(response, OPVector) -> Prediction` that picks and fits the
+    best model family x hyperparameters (ModelSelector.scala:73-135)."""
+
+    operation_name = "modelSelector"
+
+    def __init__(self, problem_type: str = "binary", metric: Optional[str] = None,
+                 models: Optional[Sequence] = None,
+                 validator: Optional[ValidatorBase] = None,
+                 splitter: Optional[DataSplitter] = None, seed: int = 42):
+        super().__init__(problem_type=problem_type, seed=seed)
+        if problem_type not in ("binary", "multiclass", "regression"):
+            raise ValueError(f"unknown problem_type {problem_type!r}")
+        self.problem_type = problem_type
+        self.metric = metric or {"binary": "AuPR", "multiclass": "F1",
+                                 "regression": "RootMeanSquaredError"}[problem_type]
+        self.models = list(models) if models is not None else default_models(problem_type)
+        self.validator = validator or CrossValidation(num_folds=3, seed=seed,
+                                                      stratify=problem_type != "regression")
+        self.splitter = splitter or default_splitter(problem_type, seed)
+        self.seed = seed
+        self.summary_: Optional[ModelSelectorSummary] = None
+
+    # the selector's own fit is the whole search; fit_fn/predict_fn are the winner's
+    def fit_columns(self, cols):
+        y_full, X_full = self.label_and_matrix(cols)
+        y_np = np.asarray(y_full, np.float32)
+        X_np = np.asarray(X_full, np.float32)
+
+        train_idx, holdout_idx = self.splitter.split_indices(y_np)
+        y_tr, X_tr = y_np[train_idx], X_np[train_idx]
+        weights, label_map, split_summary = self.splitter.prepare(y_tr)
+
+        num_classes = 0
+        y_used = y_tr
+        models = list(self.models)
+        if self.problem_type == "multiclass":
+            if label_map is None:
+                label_map = {float(c): i for i, c in enumerate(np.unique(y_tr))}
+            num_classes = len(label_map)
+            y_used = np.asarray([label_map.get(float(v), 0) for v in y_tr], np.float32)
+            models = [(t.with_params(num_classes=num_classes)
+                       if "num_classes" in t.params else t, g) for t, g in models]
+
+        keep = (weights > 0).astype(np.float32)
+        val_masks = self.validator.fold_masks(y_used, keep)
+        results = evaluate_candidates(
+            models, X_tr, y_used, weights, val_masks, keep,
+            self.problem_type, self.metric, num_classes=num_classes,
+        )
+        from .tuning_metrics import make_metric_fn
+
+        _, larger = make_metric_fn(self.problem_type, self.metric,
+                                   num_classes=max(num_classes, 2))
+        best = (max if larger else min)(results, key=lambda r: r.metric_mean)
+        template = models[best.candidate_index][0]
+        best_est = template.with_params(**best.grid_point)
+
+        import jax.numpy as jnp
+
+        params = best_est.fit_fn(jnp.asarray(X_tr), jnp.asarray(y_used),
+                                 sample_weight=jnp.asarray(weights),
+                                 **best_est.fit_kwargs())
+        model = best_est.make_model(params)
+
+        summary = ModelSelectorSummary(
+            validation_type=self.validator.validation_type,
+            problem_type=self.problem_type,
+            metric_name=self.metric,
+            larger_is_better=larger,
+            best_model_name=best.model_name,
+            best_params=dict(best.grid_point),
+            validation_results=results,
+            splitter_summary=split_summary,
+            n_train=len(train_idx),
+            n_holdout=len(holdout_idx),
+            models_evaluated=len(results) * val_masks.shape[0],
+        )
+        # train metrics over kept rows only — cutter-dropped rows carry weight 0 and
+        # were remapped to class 0, so including them would corrupt the report
+        kept_rows = weights > 0
+        summary.train_metrics = self._metrics_on(
+            model, X_tr[kept_rows], y_used[kept_rows])
+        if len(holdout_idx):
+            y_h = y_np[holdout_idx]
+            if label_map is not None:
+                keep_h = np.asarray([float(v) in label_map for v in y_h])
+                y_h = np.asarray([label_map.get(float(v), 0) for v in y_h], np.float32)
+                summary.holdout_metrics = self._metrics_on(
+                    model, X_np[holdout_idx][keep_h], y_h[keep_h])
+            else:
+                summary.holdout_metrics = self._metrics_on(
+                    model, X_np[holdout_idx], y_h)
+        self.summary_ = summary
+        model.selector_summary = summary
+        return model
+
+    def _metrics_on(self, model, X, y):
+        """Exact metrics via the host evaluators on an ad-hoc scored table."""
+        import jax.numpy as jnp
+
+        pred, raw, prob = model.predict(jnp.asarray(X, jnp.float32))
+        table = Table({
+            "label": Column.real(y, kind="Real"),
+            "pred": Column.prediction(pred, raw, prob),
+        })
+        ev = {
+            "binary": Evaluators.binary_classification,
+            "multiclass": Evaluators.multi_classification,
+            "regression": Evaluators.regression,
+        }[self.problem_type]("label", "pred")
+        return ev.evaluate_all(table)
+
+
+def default_splitter(problem_type: str, seed: int = 42) -> DataSplitter:
+    """Reference default splitters per problem type: balancer for binary, cutter for
+    multiclass, plain splitter for regression."""
+    if problem_type == "binary":
+        return DataBalancer(seed=seed)
+    if problem_type == "multiclass":
+        return DataCutter(seed=seed)
+    return DataSplitter(seed=seed)
+
+
+def default_models(problem_type: str):
+    """Default model families + grids per problem type, mirroring the reference
+    defaults (BinaryClassificationModelSelector.scala:52-128: LR/RF/GBT/SVC grids;
+    multiclass LR/RF; regression LinReg/RF/GBT/GLM) over the families implemented."""
+    from ..stages.model.linear import (
+        LinearRegression,
+        LinearSVC,
+        LogisticRegression,
+        MultinomialLogisticRegression,
+    )
+
+    reg_grid = ParamGridBuilder().add("l2", REGULARIZATION_GRID).build()
+    if problem_type == "binary":
+        models = [
+            (LogisticRegression(max_iter=25), reg_grid),
+            (LinearSVC(), ParamGridBuilder().add("reg", REGULARIZATION_GRID).build()),
+        ]
+        models.extend(_tree_models("binary"))
+        return models
+    if problem_type == "multiclass":
+        models = [(MultinomialLogisticRegression(), reg_grid)]
+        models.extend(_tree_models("multiclass"))
+        return models
+    models = [(LinearRegression(), reg_grid)]
+    models.extend(_tree_models("regression"))
+    return models
+
+
+def _tree_models(problem_type: str):
+    """Tree families once available (RandomForest/GBT; DefaultSelectorParams.scala
+    MaxDepth/MinInstancesPerNode grids). Empty until the tree ops module lands."""
+    try:
+        from ..stages.model.trees import default_tree_candidates
+    except ImportError:
+        return []
+    return default_tree_candidates(problem_type)
+
+
+class BinaryClassificationModelSelector:
+    """Factory surface mirroring BinaryClassificationModelSelector.scala."""
+
+    @staticmethod
+    def with_cross_validation(num_folds: int = 3, validation_metric: str = "AuPR",
+                              splitter: Optional[DataSplitter] = None,
+                              models: Optional[Sequence] = None, seed: int = 42,
+                              stratify: bool = True) -> ModelSelector:
+        return ModelSelector(
+            "binary", metric=validation_metric, models=models,
+            validator=CrossValidation(num_folds=num_folds, seed=seed, stratify=stratify),
+            splitter=splitter or DataBalancer(seed=seed), seed=seed)
+
+    @staticmethod
+    def with_train_validation_split(train_ratio: float = 0.75,
+                                    validation_metric: str = "AuPR",
+                                    splitter: Optional[DataSplitter] = None,
+                                    models: Optional[Sequence] = None,
+                                    seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            "binary", metric=validation_metric, models=models,
+            validator=TrainValidationSplit(train_ratio=train_ratio, seed=seed),
+            splitter=splitter or DataBalancer(seed=seed), seed=seed)
+
+
+class MultiClassificationModelSelector:
+    @staticmethod
+    def with_cross_validation(num_folds: int = 3, validation_metric: str = "F1",
+                              splitter: Optional[DataSplitter] = None,
+                              models: Optional[Sequence] = None,
+                              seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            "multiclass", metric=validation_metric, models=models,
+            validator=CrossValidation(num_folds=num_folds, seed=seed),
+            splitter=splitter or DataCutter(seed=seed), seed=seed)
+
+    @staticmethod
+    def with_train_validation_split(train_ratio: float = 0.75,
+                                    validation_metric: str = "F1",
+                                    splitter: Optional[DataSplitter] = None,
+                                    models: Optional[Sequence] = None,
+                                    seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            "multiclass", metric=validation_metric, models=models,
+            validator=TrainValidationSplit(train_ratio=train_ratio, seed=seed),
+            splitter=splitter or DataCutter(seed=seed), seed=seed)
+
+
+class RegressionModelSelector:
+    @staticmethod
+    def with_cross_validation(num_folds: int = 3,
+                              validation_metric: str = "RootMeanSquaredError",
+                              splitter: Optional[DataSplitter] = None,
+                              models: Optional[Sequence] = None,
+                              seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            "regression", metric=validation_metric, models=models,
+            validator=CrossValidation(num_folds=num_folds, seed=seed, stratify=False),
+            splitter=splitter or DataSplitter(seed=seed), seed=seed)
+
+    @staticmethod
+    def with_train_validation_split(train_ratio: float = 0.75,
+                                    validation_metric: str = "RootMeanSquaredError",
+                                    splitter: Optional[DataSplitter] = None,
+                                    models: Optional[Sequence] = None,
+                                    seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            "regression", metric=validation_metric, models=models,
+            validator=TrainValidationSplit(train_ratio=train_ratio, seed=seed,
+                                           stratify=False),
+            splitter=splitter or DataSplitter(seed=seed), seed=seed)

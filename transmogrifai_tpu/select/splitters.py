@@ -1,0 +1,160 @@
+"""Data splitters: train/holdout reservation, class balancing, rare-label cutting.
+
+Analogs of the reference tuning splitters (core/.../impl/tuning/Splitter.scala:47,
+DataSplitter.scala:62, DataBalancer.scala:73-238, DataCutter.scala:76) with one
+deliberate TPU-first change: the balancer does NOT materialize a resampled dataset
+(Spark `sample()` produces a new RDD with a different row count). Resampling changes
+array shapes, which would force recompilation per fold; instead balancing is expressed
+as per-row *sample weights* that every trainer threads through its loss (ops/linear.py
+`sample_weight`). Expected class contributions match the reference's up/down-sample
+fractions exactly, and shapes stay static so folds x grid ride vmap axes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# reference defaults: Splitter.scala:141-145
+RESERVE_TEST_FRACTION_DEFAULT = 0.1
+SAMPLE_FRACTION_DEFAULT = 0.1
+MAX_TRAINING_SAMPLE_DEFAULT = int(1e6)
+MAX_LABEL_CATEGORIES_DEFAULT = 100
+MIN_LABEL_FRACTION_DEFAULT = 0.0
+
+
+@dataclass
+class SplitterSummary:
+    """What the splitter decided (recorded into ModelSelectorSummary, the analog of
+    the reference's SplitterSummary metadata)."""
+
+    splitter: str = "DataSplitter"
+    reserve_test_fraction: float = RESERVE_TEST_FRACTION_DEFAULT
+    #: balancer: multiplier applied to the majority class weight (<= 1 means down-weight)
+    down_sample_fraction: Optional[float] = None
+    #: balancer: multiplier applied to the minority class weight (>= 1 means up-weight)
+    up_sample_fraction: Optional[float] = None
+    positive_fraction: Optional[float] = None
+    #: cutter: label values kept / dropped
+    labels_kept: list = field(default_factory=list)
+    labels_dropped: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+class DataSplitter:
+    """Random train/holdout reservation (analog of DataSplitter.scala:62)."""
+
+    def __init__(self, reserve_test_fraction: float = RESERVE_TEST_FRACTION_DEFAULT,
+                 max_training_sample: int = MAX_TRAINING_SAMPLE_DEFAULT,
+                 seed: int = 42):
+        if not 0.0 <= reserve_test_fraction < 1.0:
+            raise ValueError("reserve_test_fraction must be in [0, 1)")
+        self.reserve_test_fraction = reserve_test_fraction
+        self.max_training_sample = max_training_sample
+        self.seed = seed
+
+    def split_indices(self, y: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """-> (train_idx, holdout_idx), seeded permutation."""
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        n_test = int(round(n * self.reserve_test_fraction))
+        test, train = perm[:n_test], perm[n_test:]
+        if len(train) > self.max_training_sample:
+            train = train[: self.max_training_sample]
+        return np.sort(train), np.sort(test)
+
+    def prepare(self, y_train: np.ndarray) -> tuple[np.ndarray, Optional[dict],
+                                                    SplitterSummary]:
+        """Per-row training weights + optional label remap (identity here).
+        Subclasses (balancer/cutter) override. -> (weights [N], label_map, summary)."""
+        return (np.ones(len(y_train), np.float32), None,
+                SplitterSummary(splitter=type(self).__name__,
+                                reserve_test_fraction=self.reserve_test_fraction))
+
+
+class DataBalancer(DataSplitter):
+    """Binary-imbalance correction (analog of DataBalancer.scala:73-238).
+
+    Reference semantics (DataBalancer.scala:88-113): if the minority fraction is below
+    `sample_fraction`, down-sample the majority and/or up-sample the minority so the
+    post-balance minority fraction equals `sample_fraction`. Here both become class
+    weight multipliers with identical expected contributions."""
+
+    def __init__(self, sample_fraction: float = SAMPLE_FRACTION_DEFAULT,
+                 max_training_sample: int = MAX_TRAINING_SAMPLE_DEFAULT,
+                 reserve_test_fraction: float = RESERVE_TEST_FRACTION_DEFAULT,
+                 seed: int = 42):
+        super().__init__(reserve_test_fraction, max_training_sample, seed)
+        if not 0.0 < sample_fraction < 0.5:
+            raise ValueError("sample_fraction must be in (0, 0.5)")
+        self.sample_fraction = sample_fraction
+
+    def prepare(self, y_train: np.ndarray):
+        y = np.asarray(y_train, np.float32)
+        n = len(y)
+        pos = float((y == 1.0).sum())
+        neg = n - pos
+        small, big = (pos, neg) if pos <= neg else (neg, pos)
+        small_is_pos = pos <= neg
+        summary = SplitterSummary(
+            splitter="DataBalancer",
+            reserve_test_fraction=self.reserve_test_fraction,
+            positive_fraction=pos / max(n, 1),
+        )
+        w = np.ones(n, np.float32)
+        sf = self.sample_fraction
+        if small == 0 or big == 0 or small / n >= sf:
+            # already balanced enough (DataBalancer keeps data as-is)
+            summary.down_sample_fraction = 1.0
+            summary.up_sample_fraction = 1.0
+            return w, None, summary
+        # weight the majority down so minority carries `sf` of total weight:
+        # small / (small + down * big) = sf  =>  down = small (1 - sf) / (sf * big)
+        down = small * (1.0 - sf) / (sf * big)
+        summary.down_sample_fraction = down
+        summary.up_sample_fraction = 1.0
+        big_mask = (y == 1.0) if not small_is_pos else (y != 1.0)
+        w[big_mask] = down
+        return w, None, summary
+
+
+class DataCutter(DataSplitter):
+    """Multiclass rare-label dropping (analog of DataCutter.scala:76): keep at most
+    `max_label_categories` most frequent labels and only labels with frequency >=
+    `min_label_fraction`; dropped rows get weight 0 and kept labels are re-indexed
+    to contiguous class ids (the label_map) so trainers see a dense class axis."""
+
+    def __init__(self, max_label_categories: int = MAX_LABEL_CATEGORIES_DEFAULT,
+                 min_label_fraction: float = MIN_LABEL_FRACTION_DEFAULT,
+                 reserve_test_fraction: float = RESERVE_TEST_FRACTION_DEFAULT,
+                 seed: int = 42):
+        super().__init__(reserve_test_fraction, seed=seed)
+        if not 0.0 <= min_label_fraction < 0.5:
+            raise ValueError("min_label_fraction must be in [0, 0.5)")
+        self.max_label_categories = max_label_categories
+        self.min_label_fraction = min_label_fraction
+
+    def prepare(self, y_train: np.ndarray):
+        y = np.asarray(y_train)
+        labels, counts = np.unique(y, return_counts=True)
+        frac = counts / max(len(y), 1)
+        order = np.argsort(-counts)
+        kept = []
+        for i in order:
+            if frac[i] >= self.min_label_fraction and len(kept) < self.max_label_categories:
+                kept.append(labels[i])
+        kept_sorted = sorted(float(k) for k in kept)
+        label_map = {old: new for new, old in enumerate(kept_sorted)}
+        dropped = [float(l) for l in labels if float(l) not in label_map]
+        w = np.array([1.0 if float(v) in label_map else 0.0 for v in y], np.float32)
+        summary = SplitterSummary(
+            splitter="DataCutter",
+            reserve_test_fraction=self.reserve_test_fraction,
+            labels_kept=kept_sorted,
+            labels_dropped=sorted(dropped),
+        )
+        return w, label_map, summary

@@ -1,0 +1,132 @@
+"""Weighted, vmappable metric kernels for the tuning inner loop.
+
+The reference evaluates each CV fold with full Spark evaluators
+(OpCrossValidation.scala:102-118). Here the inner loop stays on device: every metric
+is a pure-jnp function of (pred, raw, prob, y, w) where `w` is the validation-fold
+row weight — so metrics for all folds x grid-points are computed by the same vmapped
+program that fit them. Weighted AUCs use sort+cumsum (one device sort per fold/grid
+cell); tie handling matches the step-curve convention, and the *final* train/holdout
+numbers reported in ModelSelectorSummary come from the exact host evaluators
+(evaluators/evaluators.py), so selection and reporting agree with the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _binary_scores(prob):
+    return prob[:, 1] if prob.shape[-1] > 1 else prob[:, 0]
+
+
+def _weighted_curve(scores, y, w):
+    """-> (tps, fps, P, N) cumulative weighted counts, scores descending."""
+    order = jnp.argsort(-scores)
+    ys = y[order]
+    ws = w[order]
+    tps = jnp.cumsum(ws * ys)
+    fps = jnp.cumsum(ws * (1.0 - ys))
+    return tps, fps, tps[-1], fps[-1]
+
+
+def weighted_auroc(scores, y, w):
+    tps, fps, P, N = _weighted_curve(scores, y, w)
+    tpr = tps / jnp.maximum(P, 1e-12)
+    fpr = fps / jnp.maximum(N, 1e-12)
+    tpr = jnp.concatenate([jnp.zeros(1), tpr])
+    fpr = jnp.concatenate([jnp.zeros(1), fpr])
+    return jnp.sum((fpr[1:] - fpr[:-1]) * 0.5 * (tpr[1:] + tpr[:-1]))
+
+
+def weighted_aupr(scores, y, w):
+    tps, fps, P, _ = _weighted_curve(scores, y, w)
+    precision = tps / jnp.maximum(tps + fps, 1e-12)
+    recall = tps / jnp.maximum(P, 1e-12)
+    recall = jnp.concatenate([jnp.zeros(1), recall])
+    # step interpolation (right-continuous), the average-precision convention
+    return jnp.sum((recall[1:] - recall[:-1]) * precision)
+
+
+def _weighted_confusion_binary(pred, y, w):
+    tp = jnp.sum(w * pred * y)
+    fp = jnp.sum(w * pred * (1.0 - y))
+    fn = jnp.sum(w * (1.0 - pred) * y)
+    tn = jnp.sum(w * (1.0 - pred) * (1.0 - y))
+    return tp, fp, fn, tn
+
+
+def weighted_f1(pred, y, w):
+    tp, fp, fn, _ = _weighted_confusion_binary(pred, y, w)
+    p = tp / jnp.maximum(tp + fp, 1e-12)
+    r = tp / jnp.maximum(tp + fn, 1e-12)
+    return 2 * p * r / jnp.maximum(p + r, 1e-12)
+
+
+def weighted_precision(pred, y, w):
+    tp, fp, _, _ = _weighted_confusion_binary(pred, y, w)
+    return tp / jnp.maximum(tp + fp, 1e-12)
+
+
+def weighted_recall(pred, y, w):
+    tp, _, fn, _ = _weighted_confusion_binary(pred, y, w)
+    return tp / jnp.maximum(tp + fn, 1e-12)
+
+
+def weighted_error(pred, y, w):
+    wrong = jnp.sum(w * (pred != y))
+    return wrong / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def weighted_multiclass_f1(pred, y, w, num_classes: int):
+    """Class-frequency-weighted F1 from a weighted confusion built by one-hot matmul."""
+    P = jnp.eye(num_classes)[pred.astype(jnp.int32)]  # [N, C]
+    Y = jnp.eye(num_classes)[y.astype(jnp.int32)]
+    conf = (Y * w[:, None]).T @ P  # [C true, C pred]
+    tp = jnp.diag(conf)
+    support = conf.sum(axis=1)
+    predicted = conf.sum(axis=0)
+    prec = tp / jnp.maximum(predicted, 1e-12)
+    rec = tp / jnp.maximum(support, 1e-12)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    return jnp.sum(f1 * support) / jnp.maximum(support.sum(), 1e-12)
+
+
+def weighted_rmse(pred, y, w):
+    return jnp.sqrt(jnp.sum(w * (pred - y) ** 2) / jnp.maximum(jnp.sum(w), 1e-12))
+
+
+def weighted_mae(pred, y, w):
+    return jnp.sum(w * jnp.abs(pred - y)) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def weighted_r2(pred, y, w):
+    wsum = jnp.maximum(jnp.sum(w), 1e-12)
+    ybar = jnp.sum(w * y) / wsum
+    ss_res = jnp.sum(w * (pred - y) ** 2)
+    ss_tot = jnp.maximum(jnp.sum(w * (y - ybar) ** 2), 1e-12)
+    return 1.0 - ss_res / ss_tot
+
+
+def make_metric_fn(problem_type: str, metric: str, num_classes: int = 0):
+    """-> (fn(pred, raw, prob, y, w) -> scalar, larger_is_better)."""
+    binary = {
+        "AuROC": (lambda p, r, pr, y, w: weighted_auroc(_binary_scores(pr), y, w), True),
+        "AuPR": (lambda p, r, pr, y, w: weighted_aupr(_binary_scores(pr), y, w), True),
+        "F1": (lambda p, r, pr, y, w: weighted_f1(p, y, w), True),
+        "Precision": (lambda p, r, pr, y, w: weighted_precision(p, y, w), True),
+        "Recall": (lambda p, r, pr, y, w: weighted_recall(p, y, w), True),
+        "Error": (lambda p, r, pr, y, w: weighted_error(p, y, w), False),
+    }
+    multi = {
+        "F1": (lambda p, r, pr, y, w: weighted_multiclass_f1(p, y, w, num_classes), True),
+        "Error": (lambda p, r, pr, y, w: weighted_error(p, y, w), False),
+    }
+    regression = {
+        "RootMeanSquaredError": (lambda p, r, pr, y, w: weighted_rmse(p, y, w), False),
+        "MeanAbsoluteError": (lambda p, r, pr, y, w: weighted_mae(p, y, w), False),
+        "R2": (lambda p, r, pr, y, w: weighted_r2(p, y, w), True),
+    }
+    table = {"binary": binary, "multiclass": multi, "regression": regression}[problem_type]
+    if metric not in table:
+        raise ValueError(f"unknown {problem_type} tuning metric {metric!r}; "
+                         f"known: {sorted(table)}")
+    return table[metric]

@@ -1,0 +1,215 @@
+"""Cross-validation / train-validation-split over a batched device axis.
+
+Analog of OpValidator/OpCrossValidation/OpTrainValidationSplit (core/.../impl/tuning/
+OpValidator.scala:129-256, OpCrossValidation.scala:41-118) with the central TPU-first
+re-design (SURVEY §2.11c): the reference runs k-folds x grid-points as JVM Futures over
+Spark jobs; here a fold is a {0,1} row-weight vector, so every (fold, grid-point) fit
+has identical static shapes and the whole search is TWO nested vmaps of one compiled
+fit+eval program — folds x grid becomes a batched axis that pjit can shard across the
+mesh's model axis, with row-sharded matmuls psum'ing over the data axis.
+
+Leakage control matches the reference: balancer weights apply to TRAINING rows only
+(validationPrepare, OpValidator.scala:250-253); cutter keep-masks apply to both.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tuning_metrics import make_metric_fn
+
+
+@dataclass
+class EvaluatedGridPoint:
+    """One (model family, grid point) validation result."""
+
+    model_name: str
+    grid_point: dict
+    metric_name: str
+    metric_values: list = field(default_factory=list)  # per fold
+    #: index into the candidates list (families can repeat with different static
+    #: params, so class name alone does not identify the template)
+    candidate_index: int = 0
+
+    @property
+    def metric_mean(self) -> float:
+        return float(np.mean(self.metric_values))
+
+    def to_json(self) -> dict:
+        return {
+            "model_name": self.model_name,
+            "grid_point": self.grid_point,
+            "metric_name": self.metric_name,
+            "metric_values": [float(v) for v in self.metric_values],
+            "metric_mean": self.metric_mean,
+        }
+
+
+class ValidatorBase:
+    validation_type = "base"
+
+    def __init__(self, seed: int = 42, stratify: bool = True):
+        self.seed = seed
+        self.stratify = stratify
+
+    def fold_masks(self, y: np.ndarray, keep: np.ndarray) -> np.ndarray:
+        """-> val_masks [K, N] in {0,1}: row i validates in fold k iff val_masks[k,i].
+        Rows with keep==0 (cutter-dropped) belong to no fold."""
+        raise NotImplementedError
+
+    def _assign_folds(self, y: np.ndarray, keep: np.ndarray, k: int) -> np.ndarray:
+        """Fold id per row (stratified round-robin per class when stratify=True,
+        mirroring prepareStratification, OpValidator.scala:203-226)."""
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        fold_of = np.full(n, -1, np.int64)
+        idx = np.nonzero(keep > 0)[0]
+        if self.stratify:
+            classes = np.unique(y[idx])
+            for c in classes:
+                rows = idx[y[idx] == c]
+                rows = rng.permutation(rows)
+                fold_of[rows] = np.arange(len(rows)) % k
+        else:
+            rows = rng.permutation(idx)
+            fold_of[rows] = np.arange(len(rows)) % k
+        return fold_of
+
+
+class CrossValidation(ValidatorBase):
+    """k-fold CV (OpCrossValidation.scala:41-118); folds stratified by class for
+    classification problems."""
+
+    validation_type = "CrossValidation"
+
+    def __init__(self, num_folds: int = 3, seed: int = 42, stratify: bool = True):
+        super().__init__(seed=seed, stratify=stratify)
+        if num_folds < 2:
+            raise ValueError("num_folds must be >= 2")
+        self.num_folds = num_folds
+
+    def fold_masks(self, y, keep):
+        fold_of = self._assign_folds(y, keep, self.num_folds)
+        return np.stack([(fold_of == k).astype(np.float32)
+                         for k in range(self.num_folds)])
+
+
+class TrainValidationSplit(ValidatorBase):
+    """Single stratified split (OpTrainValidationSplit.scala:34)."""
+
+    validation_type = "TrainValidationSplit"
+
+    def __init__(self, train_ratio: float = 0.75, seed: int = 42, stratify: bool = True):
+        super().__init__(seed=seed, stratify=stratify)
+        if not 0.0 < train_ratio < 1.0:
+            raise ValueError("train_ratio must be in (0, 1)")
+        self.train_ratio = train_ratio
+
+    def fold_masks(self, y, keep):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        mask = np.zeros(n, np.float32)
+        idx = np.nonzero(keep > 0)[0]
+        val_frac = 1.0 - self.train_ratio
+        if self.stratify:
+            for c in np.unique(y[idx]):
+                rows = rng.permutation(idx[y[idx] == c])
+                mask[rows[: int(round(len(rows) * val_frac))]] = 1.0
+        else:
+            rows = rng.permutation(idx)
+            mask[rows[: int(round(len(rows) * val_frac))]] = 1.0
+        return mask[None, :]
+
+
+def _group_grid(template, grid: Sequence[dict]):
+    """Split a grid by its static (non-vmappable) part. -> list of
+    (static_params dict, vmap_stacks dict[name, np.ndarray [G]], points list[dict])."""
+    vmappable = set(template.vmap_params)
+    groups: dict[tuple, dict] = {}
+    for point in grid or [{}]:
+        static = {k: v for k, v in point.items() if k not in vmappable}
+        key = tuple(sorted(static.items()))
+        g = groups.setdefault(key, {"static": static, "vmap": [], "points": []})
+        g["vmap"].append({k: v for k, v in point.items() if k in vmappable})
+        g["points"].append(point)
+    out = []
+    for g in groups.values():
+        names = sorted({k for d in g["vmap"] for k in d})
+        stacks = {
+            name: np.asarray(
+                [d.get(name, template.params.get(name, 0.0)) for d in g["vmap"]],
+                np.float32,
+            )
+            for name in names
+        }
+        out.append((g["static"], stacks, g["points"]))
+    return out
+
+
+def evaluate_candidates(
+    candidates,
+    X,
+    y,
+    train_weights: np.ndarray,
+    val_masks: np.ndarray,
+    keep: np.ndarray,
+    problem_type: str,
+    metric: str,
+    num_classes: int = 0,
+) -> list[EvaluatedGridPoint]:
+    """Validate every (family, grid-point) over every fold.
+
+    candidates: list of (PredictorEstimator template, grid list[dict]).
+    train_weights [N]: balancer/cutter weights applied when FITTING.
+    val_masks [K, N]: fold validation indicators. keep [N]: cutter keep-mask applied
+    when SCORING validation rows.
+    """
+    Xd = jnp.asarray(X, jnp.float32)
+    yd = jnp.asarray(y, jnp.float32)
+    tw = jnp.asarray(train_weights, jnp.float32)
+    vm = jnp.asarray(val_masks, jnp.float32)
+    keepd = jnp.asarray(keep, jnp.float32)
+    fold_train_w = tw[None, :] * (1.0 - vm)  # [K, N]
+    fold_val_w = keepd[None, :] * vm  # [K, N]
+    metric_fn, _ = make_metric_fn(problem_type, metric, num_classes=num_classes)
+
+    results: list[EvaluatedGridPoint] = []
+    for ci, (template, grid) in enumerate(candidates):
+        name = type(template).__name__
+        for static, stacks, points in _group_grid(template, grid):
+            static_kwargs = {**template.fit_kwargs(), **static}
+            for k in stacks:
+                static_kwargs.pop(k, None)
+
+            def fit_eval(train_w, val_w, hyper):
+                params = template.fit_fn(
+                    Xd, yd, sample_weight=train_w, **static_kwargs, **hyper
+                )
+                pred, raw, prob = template.predict_fn(params, Xd)
+                return metric_fn(pred, raw, prob, yd, val_w)
+
+            if stacks:  # vmap over the stacked grid axis, then over folds
+                inner = jax.vmap(fit_eval, in_axes=(None, None, 0))
+                outer = jax.vmap(inner, in_axes=(0, 0, None))
+                hyper = {k: jnp.asarray(v) for k, v in stacks.items()}
+                scores = np.asarray(outer(fold_train_w, fold_val_w, hyper))  # [K, G]
+            else:
+                outer = jax.vmap(lambda twk, vwk: fit_eval(twk, vwk, {}),
+                                 in_axes=(0, 0))
+                scores = np.asarray(outer(fold_train_w, fold_val_w))[:, None]
+
+            for gi, point in enumerate(points):
+                results.append(
+                    EvaluatedGridPoint(
+                        model_name=name,
+                        grid_point=dict(point),
+                        metric_name=metric,
+                        metric_values=[float(s) for s in scores[:, gi]],
+                        candidate_index=ci,
+                    )
+                )
+    return results

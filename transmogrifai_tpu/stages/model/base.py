@@ -18,9 +18,52 @@ from ..base import Estimator, Transformer
 
 
 class PredictorEstimator(Estimator):
-    """Base for trainers: inputs (response, features)."""
+    """Base for trainers: inputs (response, features).
+
+    Besides the Estimator interface, every family exposes a *functional* tuning
+    interface the ModelSelector's batched CV drives (SURVEY §2.11c "north-star"):
+      - `fit_fn(X, y, sample_weight=..., **hyper) -> params-pytree` — pure jnp,
+        static shapes, so folds x grid-points become vmap axes on the mesh;
+      - `predict_fn(params, X) -> (pred, raw, prob)` — pure jnp;
+      - `vmap_params` — hyperparameter names that may ride a vmap axis (traced
+        scalars); all other params are static per compile group;
+      - `make_model(params) -> PredictionModel` — wrap fitted params as a stage.
+    The reference achieves model-parallel tuning with a JVM thread pool over Spark
+    jobs (OpCrossValidation.scala:102-118); here the same concurrency is a batched
+    axis of one XLA program.
+    """
 
     arity = (2, 2)
+    #: hyperparams that can be vmapped (must be accepted as traced floats by fit_fn)
+    vmap_params: tuple = ()
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **hyper):
+        raise NotImplementedError
+
+    @staticmethod
+    def predict_fn(params, X):
+        raise NotImplementedError
+
+    def make_model(self, params) -> "PredictionModel":
+        raise NotImplementedError
+
+    def fit_kwargs(self) -> dict:
+        """Ctor params passed through to fit_fn (subclasses override to rename/augment)."""
+        return dict(self.params)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        y, X = self.label_and_matrix(cols)
+        return self.make_model(self.fit_fn(X, y, **self.fit_kwargs()))
+
+    def with_params(self, **overrides) -> "PredictorEstimator":
+        """New un-wired instance of this family with merged ctor params (the grid-point
+        instantiation used after best-model selection)."""
+        import inspect
+
+        merged = {**self.params, **overrides}
+        accepted = set(inspect.signature(type(self).__init__).parameters) - {"self"}
+        return type(self)(**{k: v for k, v in merged.items() if k in accepted})
 
     def out_kind(self, in_kinds):
         resp, feat = in_kinds

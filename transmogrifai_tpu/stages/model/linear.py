@@ -1,9 +1,10 @@
 """Linear-family model stages: logistic regression, linear regression, linear SVC,
 multinomial logistic (the reference's OpLogisticRegression.scala:46,
-OpLinearRegression, OpLinearSVC, re-backed by the jnp trainers in ops/linear.py)."""
-from __future__ import annotations
+OpLinearRegression, OpLinearSVC, re-backed by the jnp trainers in ops/linear.py).
 
-from typing import Sequence
+Each stage exposes the functional tuning interface (fit_fn/predict_fn/vmap_params)
+so the ModelSelector can vmap folds x regularization grids into one XLA program."""
+from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
@@ -19,9 +20,13 @@ from ...ops.linear import (
     predict_multinomial,
     predict_svc,
 )
-from ...types import Column
 from ..base import register_stage
 from .base import PredictionModel, PredictorEstimator
+
+
+def _linear_params(stage_params: dict) -> LinearParams:
+    return LinearParams(jnp.asarray(stage_params["w"], jnp.float32),
+                        jnp.asarray(stage_params["b"], jnp.float32))
 
 
 @register_stage
@@ -30,13 +35,14 @@ class LogisticRegression(PredictorEstimator):
     regParam/elasticNet grid axis = l2 here)."""
 
     operation_name = "logReg"
+    vmap_params = ("l2",)
+    fit_fn = staticmethod(fit_logistic)
+    predict_fn = staticmethod(predict_logistic)
 
     def __init__(self, l2: float = 0.0, max_iter: int = 25):
         super().__init__(l2=float(l2), max_iter=int(max_iter))
 
-    def fit_columns(self, cols: Sequence[Column]):
-        y, X = self.label_and_matrix(cols)
-        params = fit_logistic(X, y, l2=self.params["l2"], max_iter=self.params["max_iter"])
+    def make_model(self, params):
         return LogisticRegressionModel(
             w=np.asarray(params.w).tolist(), b=float(params.b))
 
@@ -46,9 +52,7 @@ class LogisticRegressionModel(PredictionModel):
     operation_name = "logReg"
 
     def predict(self, X):
-        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
-                         jnp.asarray(self.params["b"], jnp.float32))
-        return predict_logistic(p, X)
+        return predict_logistic(_linear_params(self.params), X)
 
 
 @register_stage
@@ -57,15 +61,25 @@ class MultinomialLogisticRegression(PredictorEstimator):
     family=multinomial)."""
 
     operation_name = "mnLogReg"
+    vmap_params = ("l2",)
 
     def __init__(self, num_classes: int = 0, l2: float = 0.0, max_iter: int = 300):
         super().__init__(num_classes=int(num_classes), l2=float(l2), max_iter=int(max_iter))
 
-    def fit_columns(self, cols: Sequence[Column]):
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, num_classes=0, l2=0.0, max_iter=300):
+        return fit_multinomial(X, jnp.asarray(y, jnp.int32), num_classes=num_classes,
+                               sample_weight=sample_weight, l2=l2, max_iter=max_iter)
+
+    predict_fn = staticmethod(predict_multinomial)
+
+    def fit_columns(self, cols):
         y, X = self.label_and_matrix(cols)
-        nc = self.params["num_classes"] or int(np.asarray(y).max()) + 1
-        params = fit_multinomial(X, y.astype(jnp.int32), num_classes=nc,
-                                 l2=self.params["l2"], max_iter=self.params["max_iter"])
+        kw = self.fit_kwargs()
+        kw["num_classes"] = kw["num_classes"] or int(np.asarray(y).max()) + 1
+        return self.make_model(self.fit_fn(X, y, **kw))
+
+    def make_model(self, params):
         return MultinomialLogisticRegressionModel(
             w=np.asarray(params.w).tolist(), b=np.asarray(params.b).tolist())
 
@@ -75,9 +89,7 @@ class MultinomialLogisticRegressionModel(PredictionModel):
     operation_name = "mnLogReg"
 
     def predict(self, X):
-        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
-                         jnp.asarray(self.params["b"], jnp.float32))
-        return predict_multinomial(p, X)
+        return predict_multinomial(_linear_params(self.params), X)
 
 
 @register_stage
@@ -85,13 +97,14 @@ class LinearRegression(PredictorEstimator):
     """Weighted ridge regression, closed form (analog of OpLinearRegression)."""
 
     operation_name = "linReg"
+    vmap_params = ("l2",)
+    fit_fn = staticmethod(fit_linear)
+    predict_fn = staticmethod(predict_linear)
 
     def __init__(self, l2: float = 0.0):
         super().__init__(l2=float(l2))
 
-    def fit_columns(self, cols: Sequence[Column]):
-        y, X = self.label_and_matrix(cols)
-        params = fit_linear(X, y, l2=self.params["l2"])
+    def make_model(self, params):
         return LinearRegressionModel(w=np.asarray(params.w).tolist(), b=float(params.b))
 
 
@@ -100,9 +113,7 @@ class LinearRegressionModel(PredictionModel):
     operation_name = "linReg"
 
     def predict(self, X):
-        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
-                         jnp.asarray(self.params["b"], jnp.float32))
-        return predict_linear(p, X)
+        return predict_linear(_linear_params(self.params), X)
 
 
 @register_stage
@@ -110,13 +121,14 @@ class LinearSVC(PredictorEstimator):
     """Linear SVM with squared hinge (analog of OpLinearSVC)."""
 
     operation_name = "svc"
+    vmap_params = ("reg",)
+    fit_fn = staticmethod(fit_svc)
+    predict_fn = staticmethod(predict_svc)
 
     def __init__(self, reg: float = 1e-2, max_iter: int = 300):
         super().__init__(reg=float(reg), max_iter=int(max_iter))
 
-    def fit_columns(self, cols: Sequence[Column]):
-        y, X = self.label_and_matrix(cols)
-        params = fit_svc(X, y, reg=self.params["reg"], max_iter=self.params["max_iter"])
+    def make_model(self, params):
         return LinearSVCModel(w=np.asarray(params.w).tolist(), b=float(params.b))
 
 
@@ -125,6 +137,4 @@ class LinearSVCModel(PredictionModel):
     operation_name = "svc"
 
     def predict(self, X):
-        p = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
-                         jnp.asarray(self.params["b"], jnp.float32))
-        return predict_svc(p, X)
+        return predict_svc(_linear_params(self.params), X)
