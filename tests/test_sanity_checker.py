@@ -1,0 +1,183 @@
+"""SanityChecker tests (mirror of reference SanityCheckerTest under
+core/src/test/.../impl/preparators/): stats correctness, leakage drops,
+low-variance drops, Cramér's V group drops, schema propagation."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.check import SanityChecker
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.ops.stats import (
+    column_stats,
+    contingency_table,
+    correlation_matrix,
+    cramers_v,
+    pearson_with_label,
+    pointwise_mutual_info,
+    rule_confidence,
+    spearman_with_label,
+)
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.types.vector_schema import SlotInfo, VectorSchema
+
+
+# --- stats kernels ---------------------------------------------------------------------
+def test_column_stats_match_numpy(rng):
+    X = rng.normal(size=(200, 5)).astype(np.float32)
+    s = column_stats(X)
+    np.testing.assert_allclose(np.asarray(s.mean), X.mean(0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s.variance), X.var(0), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s.min), X.min(0), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s.max), X.max(0), atol=1e-6)
+
+
+def test_pearson_matches_numpy(rng):
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + rng.normal(size=300) * 0.1).astype(np.float32)
+    got = np.asarray(pearson_with_label(X, y))
+    for d in range(4):
+        expect = np.corrcoef(X[:, d], y)[0, 1]
+        np.testing.assert_allclose(got[d], expect, atol=1e-4)
+
+
+def test_pearson_zero_variance_is_zero():
+    X = np.ones((50, 2), np.float32)
+    y = np.arange(50, dtype=np.float32)
+    got = np.asarray(pearson_with_label(X, y))
+    np.testing.assert_allclose(got, 0.0)
+
+
+def test_spearman_monotone_transform_invariant(rng):
+    x = rng.normal(size=400).astype(np.float32)
+    y = np.exp(x)  # monotone in x -> spearman ~ 1 even though pearson < 1
+    got = float(np.asarray(spearman_with_label(x[:, None], y))[0])
+    assert got > 0.99
+
+
+def test_correlation_matrix_diagonal(rng):
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    C = np.asarray(correlation_matrix(X))
+    np.testing.assert_allclose(np.diag(C), 1.0, atol=1e-4)
+    np.testing.assert_allclose(C, C.T, atol=1e-5)
+
+
+def test_cramers_v_perfect_association():
+    # indicator == class -> V = 1
+    table = np.array([[50.0, 0.0], [0.0, 50.0]])
+    assert float(cramers_v(table)) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_cramers_v_independence():
+    table = np.array([[25.0, 25.0], [25.0, 25.0]])
+    assert float(cramers_v(table)) == pytest.approx(0.0, abs=1e-4)
+
+
+def test_pmi_signs():
+    table = np.array([[40.0, 10.0], [10.0, 40.0]])
+    pmi = np.asarray(pointwise_mutual_info(table))
+    assert pmi[0, 0] > 0 and pmi[1, 1] > 0
+    assert pmi[0, 1] < 0 and pmi[1, 0] < 0
+
+
+def test_rule_confidence():
+    table = np.array([[30.0, 0.0], [10.0, 10.0]])
+    conf, support = rule_confidence(table)
+    np.testing.assert_allclose(np.asarray(conf), [1.0, 0.5], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(support), [0.6, 0.4], atol=1e-5)
+
+
+# --- the stage -------------------------------------------------------------------------
+def _fit_checker(X, y, schema=None, **kw):
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    checker = SanityChecker(**kw)
+    checker(label, vec)
+    table = Table({
+        "label": Column.real(y, kind="RealNN"),
+        "vec": Column.vector(X, schema=schema),
+    })
+    model = checker.fit_table(table)
+    return checker, model, table
+
+
+def test_drops_label_leakage(rng):
+    y = rng.integers(0, 2, 300).astype(np.float32)
+    X = np.stack([y, rng.normal(size=300)], axis=1).astype(np.float32)  # col 0 IS the label
+    _, model, table = _fit_checker(X, y)
+    assert model.params["keep_indices"] == [1]
+    assert "leakage" in model.summary_.dropped[0]["reason"]
+    out = model.transform_table(table)
+    assert out[model.get_output().name].width == 1
+
+
+def test_drops_zero_variance(rng):
+    y = rng.integers(0, 2, 200).astype(np.float32)
+    X = np.stack([np.full(200, 3.0), rng.normal(size=200)], axis=1).astype(np.float32)
+    _, model, _ = _fit_checker(X, y)
+    assert 0 not in model.params["keep_indices"]
+    assert "variance" in model.summary_.dropped[0]["reason"]
+
+
+def test_drops_cramers_v_group(rng):
+    # one-hot group perfectly aligned with the label -> whole group dropped
+    y = rng.integers(0, 2, 400).astype(np.float32)
+    onehot = np.stack([y, 1 - y], axis=1).astype(np.float32)
+    noise = rng.normal(size=(400, 1)).astype(np.float32)
+    X = np.concatenate([onehot, noise], axis=1)
+    schema = VectorSchema((
+        SlotInfo("cat", "PickList", group="cat", indicator_value="A"),
+        SlotInfo("cat", "PickList", group="cat", indicator_value="B"),
+        SlotInfo("num", "Real", descriptor="value"),
+    ))
+    _, model, _ = _fit_checker(X, y, schema=schema, max_correlation=2.0)
+    assert model.params["keep_indices"] == [2]
+    assert all("Cram" in d["reason"] for d in model.summary_.dropped)
+
+
+def test_keeps_good_features(rng):
+    y = rng.integers(0, 2, 300).astype(np.float32)
+    X = (rng.normal(size=(300, 4)) + y[:, None] * 0.5).astype(np.float32)
+    _, model, _ = _fit_checker(X, y)
+    assert model.params["keep_indices"] == [0, 1, 2, 3]
+    assert model.summary_.dropped == []
+
+
+def test_schema_propagates_through_drop(rng):
+    y = rng.integers(0, 2, 200).astype(np.float32)
+    X = np.stack([y, rng.normal(size=200), rng.normal(size=200)], axis=1).astype(np.float32)
+    schema = VectorSchema((
+        SlotInfo("leak", "Real", descriptor="v"),
+        SlotInfo("a", "Real", descriptor="v"),
+        SlotInfo("b", "Real", descriptor="v"),
+    ))
+    _, model, table = _fit_checker(X, y, schema=schema)
+    out_col = model.transform_table(table)[model.get_output().name]
+    assert out_col.schema.column_names() == ["a_v", "b_v"]
+
+
+def test_remove_bad_features_false_keeps_all(rng):
+    y = rng.integers(0, 2, 200).astype(np.float32)
+    X = np.stack([y, rng.normal(size=200)], axis=1).astype(np.float32)
+    _, model, _ = _fit_checker(X, y, remove_bad_features=False)
+    assert model.params["keep_indices"] == [0, 1]
+
+
+def test_raises_when_everything_drops(rng):
+    y = rng.integers(0, 2, 100).astype(np.float32)
+    X = y[:, None].astype(np.float32)  # single leaking column
+    with pytest.raises(ValueError, match="every feature"):
+        _fit_checker(X, y)
+
+
+def test_check_sample_subsamples(rng):
+    y = rng.integers(0, 2, 1000).astype(np.float32)
+    X = rng.normal(size=(1000, 2)).astype(np.float32)
+    _, model, _ = _fit_checker(X, y, check_sample=0.3)
+    assert model.summary_.n_sampled == 300
+    assert model.summary_.n_rows == 1000
+
+
+def test_regression_label_skips_categorical_tests(rng):
+    y = rng.normal(size=300).astype(np.float32)  # continuous: > 30 unique values
+    X = rng.normal(size=(300, 2)).astype(np.float32)
+    _, model, _ = _fit_checker(X, y)
+    assert model.summary_.categorical_groups == []

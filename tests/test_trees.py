@@ -1,0 +1,278 @@
+"""Tree-ensemble tests (mirror of reference OpRandomForest/GBT/DecisionTree/XGBoost
+classifier+regressor suites under core/src/test/.../impl/classification|regression/).
+
+Correctness focus: nonlinear learnability (XOR — unreachable by the linear zoo),
+variance-reduction splits, multiclass leaf distributions, determinism, (de)serialization
+round-trips, and ModelSelector integration of the tree grids.
+"""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.trees import (
+    bin_features,
+    fit_forest,
+    fit_gbt,
+    grow_tree,
+    predict_ensemble,
+    quantile_bins,
+)
+from transmogrifai_tpu.stages.base import Stage
+from transmogrifai_tpu.stages.model import (
+    DecisionTreeClassifier,
+    GBTClassifier,
+    GBTRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    XGBoostClassifier,
+    XGBoostRegressor,
+)
+
+
+def xor_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)
+    return X, y
+
+
+# --- binning ---------------------------------------------------------------------------
+def test_quantile_binning_roundtrip():
+    X = np.linspace(0, 1, 100, dtype=np.float32)[:, None]
+    edges = quantile_bins(X, n_bins=4)
+    assert edges.shape == (1, 3)
+    Xb = bin_features(X, edges)
+    counts = np.bincount(np.asarray(Xb[:, 0]), minlength=4)
+    assert Xb.min() >= 0 and Xb.max() <= 3
+    assert (counts > 15).all()  # roughly equal mass per quantile bucket
+
+def test_binning_split_consistency():
+    # "bin <= b" during growth must equal "x < edges[b]" at inference
+    X = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
+    edges = quantile_bins(X, n_bins=4)
+    Xb = np.asarray(bin_features(X, edges))
+    for b in range(3):
+        left_by_bin = Xb[:, 0] <= b
+        left_by_value = X[:, 0] < np.asarray(edges)[0, b]
+        assert (left_by_bin == left_by_value).all()
+
+
+# --- grow_tree -------------------------------------------------------------------------
+def test_grow_tree_single_split_recovers_threshold():
+    # y = 1[x >= 0]: a depth-1 tree must find the boundary and pure leaves
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-1, 1, 500).astype(np.float32)
+    y = (x >= 0).astype(np.float32)
+    X = x[:, None]
+    edges = quantile_bins(X, 32)
+    Xb = bin_features(X, edges)
+    g = -jnp.asarray(y)[:, None]
+    h = jnp.ones((500, 1), jnp.float32)
+    sf, st, leaves, leaf_of_row = grow_tree(
+        Xb, edges, g, h, max_depth=1, reg_lambda=0.0, min_child_weight=1.0, min_gain=0.0
+    )
+    assert sf.shape == (1,) and st.shape == (1,) and leaves.shape == (2, 1)
+    assert abs(float(st[0])) < 0.1  # threshold near the true boundary
+    vals = sorted([float(leaves[0, 0]), float(leaves[1, 0])])
+    assert vals[0] < 0.05 and vals[1] > 0.95  # leaf means ~ class purity
+
+
+def test_grow_tree_respects_min_child_weight():
+    # min_child_weight larger than any side -> dummy split (threshold inf, all left)
+    X = np.linspace(0, 1, 20, np.float32)[:, None]
+    edges = quantile_bins(X, 8)
+    Xb = bin_features(X, edges)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    g = -jnp.asarray(y)[:, None]
+    h = jnp.ones((20, 1), jnp.float32)
+    _, st, _, _ = grow_tree(Xb, edges, g, h, 1, 0.0, 50.0, 0.0)
+    assert np.isinf(np.asarray(st)[0])
+
+
+# --- GBT -------------------------------------------------------------------------------
+def test_gbt_learns_xor():
+    X, y = xor_data()
+    params = fit_gbt(X, y, objective="binary", n_trees=30, max_depth=3,
+                     learning_rate=0.3)
+    pred, raw, prob = __import__(
+        "transmogrifai_tpu.ops.trees", fromlist=["predict_gbt_binary"]
+    ).predict_gbt_binary(params, X)
+    acc = float((np.asarray(pred) == y).mean())
+    assert acc > 0.95
+    assert prob.shape == (400, 2)
+    np.testing.assert_allclose(np.asarray(prob).sum(1), 1.0, atol=1e-5)
+
+
+def test_gbt_regression_fits_piecewise():
+    rng = np.random.default_rng(3)
+    X = rng.uniform(-2, 2, (600, 1)).astype(np.float32)
+    y = np.where(X[:, 0] < 0, -1.0, np.where(X[:, 0] < 1, 2.0, 0.5)).astype(np.float32)
+    params = fit_gbt(X, y, objective="regression", n_trees=40, max_depth=3,
+                     learning_rate=0.3)
+    from transmogrifai_tpu.ops.trees import predict_gbt_regression
+
+    pred, _, _ = predict_gbt_regression(params, X)
+    mse = float(((np.asarray(pred) - y) ** 2).mean())
+    assert mse < 0.05
+
+
+def test_gbt_multiclass_softmax_tree():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(450, 2)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int) + 2 * (X[:, 1] > 0).astype(int)  # 4 quadrant classes
+    y = np.minimum(y, 2).astype(np.float32)  # 3 classes
+    params = fit_gbt(X, y, objective="multiclass", num_classes=3, n_trees=30,
+                     max_depth=3, learning_rate=0.3)
+    from transmogrifai_tpu.ops.trees import predict_gbt_multiclass
+
+    pred, logits, prob = predict_gbt_multiclass(params, X)
+    assert prob.shape == (450, 3)
+    assert float((np.asarray(pred) == y).mean()) > 0.9
+
+
+# --- forests ---------------------------------------------------------------------------
+def test_forest_classification_leaf_distributions():
+    X, y = xor_data(seed=5)
+    params = fit_forest(X, y, objective="classification", num_classes=2,
+                        n_trees=30, max_depth=4, min_child_weight=2.0)
+    from transmogrifai_tpu.ops.trees import predict_forest_classification
+
+    pred, raw, prob = predict_forest_classification(params, X)
+    assert float((np.asarray(pred) == y).mean()) > 0.9
+    np.testing.assert_allclose(np.asarray(prob).sum(1), 1.0, atol=1e-5)
+    assert (np.asarray(prob) >= 0).all()
+
+
+def test_forest_regression_is_target_mean():
+    # one constant region -> every prediction equals the target mean
+    X = np.ones((50, 2), np.float32)
+    y = np.full(50, 3.5, np.float32)
+    params = fit_forest(X, y, objective="regression", n_trees=5, max_depth=2,
+                        reg_lambda=0.0)
+    from transmogrifai_tpu.ops.trees import predict_forest_regression
+
+    pred, _, _ = predict_forest_regression(params, X)
+    np.testing.assert_allclose(np.asarray(pred), 3.5, atol=1e-3)
+
+
+def test_forest_deterministic_by_seed():
+    X, y = xor_data(seed=6)
+    p1 = fit_forest(X, y, objective="classification", num_classes=2, n_trees=5,
+                    max_depth=3, seed=11)
+    p2 = fit_forest(X, y, objective="classification", num_classes=2, n_trees=5,
+                    max_depth=3, seed=11)
+    np.testing.assert_array_equal(np.asarray(p1.split_feature), np.asarray(p2.split_feature))
+    np.testing.assert_allclose(np.asarray(p1.leaf_values), np.asarray(p2.leaf_values))
+
+
+def test_ensemble_param_shapes():
+    X, y = xor_data(seed=7)
+    params = fit_gbt(X, y, objective="binary", n_trees=4, max_depth=3)
+    assert params.split_feature.shape == (4, 7)
+    assert params.split_threshold.shape == (4, 7)
+    assert params.leaf_values.shape == (4, 8, 1)
+    out = predict_ensemble(params, X)
+    assert out.shape == (400, 1)
+
+
+# --- stages ----------------------------------------------------------------------------
+@pytest.mark.parametrize("est_cls,acc_floor", [
+    (RandomForestClassifier, 0.9),
+    (GBTClassifier, 0.95),
+    (XGBoostClassifier, 0.95),
+    (DecisionTreeClassifier, 0.85),
+])
+def test_classifier_stages_on_xor(est_cls, acc_floor):
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.types import Column, Table
+
+    X, y = xor_data(seed=8)
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    est = est_cls(max_depth=4) if est_cls is DecisionTreeClassifier else est_cls(
+        n_trees=20, max_depth=4)
+    est(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    model = est.fit_table(table)
+    out = model.transform_table(table)
+    pred = np.asarray(out[model.get_output().name].pred)
+    assert float((pred == y).mean()) > acc_floor
+
+
+@pytest.mark.parametrize("est_cls", [RandomForestRegressor, GBTRegressor,
+                                     XGBoostRegressor])
+def test_regressor_stages(est_cls):
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.types import Column, Table
+
+    rng = np.random.default_rng(9)
+    X = rng.uniform(-1, 1, (300, 2)).astype(np.float32)
+    y = (np.abs(X[:, 0]) + X[:, 1] ** 2).astype(np.float32)
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    est = est_cls(n_trees=30, max_depth=4)
+    est(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    model = est.fit_table(table)
+    out = model.transform_table(table)
+    pred = np.asarray(out[model.get_output().name].pred)
+    assert float(((pred - y) ** 2).mean()) < 0.05
+
+
+def test_tree_model_json_roundtrip():
+    X, y = xor_data(seed=10)
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.types import Column, Table
+
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    est = GBTClassifier(n_trees=5, max_depth=3)
+    est(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    model = est.fit_table(table)
+    blob = json.loads(json.dumps(model.to_json()))
+    rebuilt = Stage.from_json(blob)
+    rebuilt.set_input(label, vec)
+    p1 = np.asarray(model.predict(jnp.asarray(X))[0])
+    p2 = np.asarray(rebuilt.predict(jnp.asarray(X))[0])
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_selector_defaults_include_trees():
+    from transmogrifai_tpu.select.selector import default_models
+
+    names = [type(t).__name__ for t, _ in default_models("binary")]
+    assert "RandomForestClassifier" in names and "GBTClassifier" in names
+    names_mc = [type(t).__name__ for t, _ in default_models("multiclass")]
+    assert "RandomForestClassifier" in names_mc
+    names_rg = [type(t).__name__ for t, _ in default_models("regression")]
+    assert "RandomForestRegressor" in names_rg and "GBTRegressor" in names_rg
+
+
+def test_selector_picks_tree_on_nonlinear_data():
+    """On XOR the linear families fail and a tree family must win CV."""
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.select import BinaryClassificationModelSelector
+    from transmogrifai_tpu.select.grids import ParamGridBuilder
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.types import Column, Table
+
+    X, y = xor_data(n=300, seed=11)
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    models = [
+        (LogisticRegression(), ParamGridBuilder().add("l2", [0.01]).build()),
+        (GBTClassifier(n_trees=15, max_depth=3),
+         ParamGridBuilder().add("learning_rate", [0.3]).build()),
+    ]
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, models=models, seed=3)
+    sel(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    model = sel.fit_table(table)
+    assert sel.summary_.best_model_name == "GBTClassifier"
+    out = model.transform_table(table)
+    pred = np.asarray(out[model.get_output().name].pred)
+    assert float((pred == y).mean()) > 0.9

@@ -1,0 +1,3 @@
+from .sanity_checker import SanityChecker, SanityCheckerModel, SanityCheckerSummary
+
+__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary"]

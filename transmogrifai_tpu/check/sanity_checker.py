@@ -1,0 +1,264 @@
+"""SanityChecker: post-vectorization feature validation and automatic drop.
+
+TPU-native analog of the reference SanityChecker (core/src/main/scala/com/salesforce/
+op/stages/impl/preparators/SanityChecker.scala:236 class, :535 fitFn, :259/:366/:420
+stats + drop + categorical tests, defaults :720-733) — the estimator stage
+`(label RealNN, features OPVector) -> OPVector` that computes per-slot statistics and
+label associations, drops offending slots, and records the reasons.
+
+The reference runs MLlib colStats + Statistics.corr + per-group contingency jobs; here
+the whole pass is fused jnp (ops/stats.py): moments and label correlations are one
+X-sized reduction, categorical contingency tables are one-hot matmuls per indicator
+group. Drop decisions and metadata assembly stay host-side. Reasons land in
+SanityCheckerSummary (the SanityCheckerMetadata analog) carried by the fitted model.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.stats import (
+    column_stats,
+    contingency_table,
+    cramers_v,
+    pearson_with_label,
+    rule_confidence,
+    spearman_with_label,
+)
+from ..stages.base import Estimator, Transformer, register_stage
+from ..types import Column, kind_of
+from ..types.vector_schema import SlotInfo, VectorSchema
+
+
+@dataclass
+class SlotStats:
+    """Per-slot diagnostics (SanityCheckerMetadata column entries)."""
+
+    name: str
+    mean: float
+    variance: float
+    min: float
+    max: float
+    corr_with_label: float
+    cramers_v: Optional[float] = None
+    max_rule_confidence: Optional[float] = None
+    support: Optional[float] = None
+
+
+@dataclass
+class SanityCheckerSummary:
+    """The training-time report (analog of SanityCheckerMetadata.scala)."""
+
+    n_rows: int
+    n_sampled: int
+    slot_stats: list[SlotStats] = field(default_factory=list)
+    dropped: list[dict] = field(default_factory=list)  # {"name", "reason"}
+    categorical_groups: list[dict] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "n_rows": self.n_rows,
+            "n_sampled": self.n_sampled,
+            "slot_stats": [vars(s) for s in self.slot_stats],
+            "dropped": list(self.dropped),
+            "categorical_groups": list(self.categorical_groups),
+        }
+
+    def pretty(self) -> str:
+        lines = [f"SanityChecker: {len(self.dropped)} of {len(self.slot_stats)} slots dropped"]
+        for d in self.dropped:
+            lines.append(f"  - {d['name']}: {d['reason']}")
+        return "\n".join(lines)
+
+
+@register_stage
+class SanityChecker(Estimator):
+    """Estimator `(label, OPVector) -> OPVector` dropping low-signal / leaking slots.
+
+    Drop rules (reference defaults, SanityChecker.scala:720-733):
+      - variance < min_variance                      -> "zero/low variance"
+      - |corr(label)| > max_correlation              -> label leakage
+      - |corr(label)| < min_correlation              -> uninformative (off by default)
+      - group Cramér's V > max_cramers_v             -> categorical leakage (whole group)
+      - rule confidence > max_rule_confidence
+        with support >= min_required_rule_support    -> degenerate indicator (off by default)
+    """
+
+    operation_name = "sanityChecker"
+    arity = (2, 2)
+
+    def __init__(self, check_sample: float = 1.0, sample_seed: int = 42,
+                 max_correlation: float = 0.95, min_correlation: float = 0.0,
+                 min_variance: float = 1e-5, max_cramers_v: float = 0.95,
+                 remove_bad_features: bool = True, corr_type: str = "pearson",
+                 max_rule_confidence: float = 1.0,
+                 min_required_rule_support: float = 1.0,
+                 categorical_label_cardinality: int = 30):
+        if corr_type not in ("pearson", "spearman"):
+            raise ValueError("corr_type must be 'pearson' or 'spearman'")
+        super().__init__(check_sample=float(check_sample), sample_seed=int(sample_seed),
+                         max_correlation=float(max_correlation),
+                         min_correlation=float(min_correlation),
+                         min_variance=float(min_variance),
+                         max_cramers_v=float(max_cramers_v),
+                         remove_bad_features=bool(remove_bad_features),
+                         corr_type=corr_type,
+                         max_rule_confidence=float(max_rule_confidence),
+                         min_required_rule_support=float(min_required_rule_support),
+                         categorical_label_cardinality=int(categorical_label_cardinality))
+
+    def out_kind(self, in_kinds):
+        resp, feat = in_kinds
+        if feat.name != "OPVector":
+            raise TypeError(f"SanityChecker features input must be OPVector, got {feat.name}")
+        return kind_of("OPVector")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def fit_columns(self, cols: Sequence[Column]) -> Transformer:
+        p = self.params
+        y = np.asarray(cols[0].filled(0.0), np.float32)
+        X = np.asarray(cols[1].values, np.float32)
+        schema = cols[1].schema or VectorSchema(
+            tuple(SlotInfo(f"f{i}", "Real") for i in range(X.shape[1]))
+        )
+        n = X.shape[0]
+
+        # --- sample (checkSample) ----------------------------------------------------
+        if p["check_sample"] < 1.0:
+            rng = np.random.default_rng(p["sample_seed"])
+            take = max(2, int(round(n * p["check_sample"])))
+            idx = rng.choice(n, size=take, replace=False)
+            Xs, ys = X[idx], y[idx]
+        else:
+            Xs, ys = X, y
+
+        # --- fused stats pass --------------------------------------------------------
+        stats = column_stats(jnp.asarray(Xs))
+        if p["corr_type"] == "spearman":
+            corr = spearman_with_label(jnp.asarray(Xs), jnp.asarray(ys))
+        else:
+            corr = pearson_with_label(jnp.asarray(Xs), jnp.asarray(ys))
+        mean = np.asarray(stats.mean)
+        var = np.asarray(stats.variance)
+        mn, mx = np.asarray(stats.min), np.asarray(stats.max)
+        corr = np.asarray(corr)
+
+        # --- categorical tests: per indicator group ----------------------------------
+        uniq = np.unique(ys)
+        label_is_categorical = len(uniq) <= p["categorical_label_cardinality"]
+        group_cv: dict[tuple, float] = {}
+        slot_conf = np.full(X.shape[1], np.nan)
+        slot_support = np.full(X.shape[1], np.nan)
+        categorical_groups = []
+        groups = schema.groups()
+        if label_is_categorical:
+            lab_oh = (ys[:, None] == uniq[None, :]).astype(np.float32)
+            for key, idxs in groups.items():
+                # contingency stats are defined over 0/1 indicator slots only — a
+                # group can also carry continuous slots (e.g. a numeric value next
+                # to its null indicator), which must not enter the table
+                idxs = [i for i in idxs if schema[i].indicator_value is not None]
+                if not idxs:
+                    continue
+                ind = jnp.asarray(Xs[:, idxs])
+                table = contingency_table(ind, jnp.asarray(lab_oh))
+                cv = float(cramers_v(table))
+                conf, support = rule_confidence(table)
+                group_cv[key] = cv
+                for j, i in enumerate(idxs):
+                    slot_conf[i] = float(conf[j])
+                    slot_support[i] = float(support[j])
+                categorical_groups.append(
+                    {"group": "_".join(str(k) for k in key if k is not None),
+                     "cramers_v": cv, "slots": [schema[i].column_name() for i in idxs]}
+                )
+
+        # --- drop decisions ----------------------------------------------------------
+        names = schema.column_names()
+        reasons: dict[int, str] = {}
+        for i in range(X.shape[1]):
+            if var[i] < p["min_variance"]:
+                reasons[i] = f"variance {var[i]:.2e} < min_variance {p['min_variance']:.2e}"
+            elif abs(corr[i]) > p["max_correlation"]:
+                reasons[i] = (f"|corr| {abs(corr[i]):.3f} > max_correlation "
+                              f"{p['max_correlation']} (label leakage)")
+            elif p["min_correlation"] > 0.0 and abs(corr[i]) < p["min_correlation"]:
+                reasons[i] = f"|corr| {abs(corr[i]):.3f} < min_correlation {p['min_correlation']}"
+            elif (p["max_rule_confidence"] < 1.0 and not np.isnan(slot_conf[i])
+                  and slot_conf[i] > p["max_rule_confidence"]
+                  and slot_support[i] >= p["min_required_rule_support"]):
+                reasons[i] = (f"rule confidence {slot_conf[i]:.3f} > "
+                              f"{p['max_rule_confidence']} at support {slot_support[i]:.3f}")
+        for key, cv in group_cv.items():
+            if cv > p["max_cramers_v"]:
+                for i in groups[key]:
+                    if schema[i].indicator_value is None:
+                        continue
+                    reasons.setdefault(
+                        i, f"group Cramér's V {cv:.3f} > max_cramers_v {p['max_cramers_v']}"
+                    )
+
+        keep = [i for i in range(X.shape[1]) if i not in reasons]
+        if p["remove_bad_features"] and not keep:
+            raise ValueError(
+                "SanityChecker would drop every feature slot — check the label or relax "
+                "thresholds (reference throws the same way)"
+            )
+        if not p["remove_bad_features"]:
+            keep = list(range(X.shape[1]))
+
+        summary = SanityCheckerSummary(
+            n_rows=n,
+            n_sampled=Xs.shape[0],
+            slot_stats=[
+                SlotStats(
+                    name=names[i], mean=float(mean[i]), variance=float(var[i]),
+                    min=float(mn[i]), max=float(mx[i]), corr_with_label=float(corr[i]),
+                    cramers_v=group_cv.get(schema[i].grouping_key()),
+                    max_rule_confidence=(None if np.isnan(slot_conf[i]) else float(slot_conf[i])),
+                    support=(None if np.isnan(slot_support[i]) else float(slot_support[i])),
+                )
+                for i in range(X.shape[1])
+            ],
+            dropped=[{"name": names[i], "reason": reasons[i]} for i in sorted(reasons)]
+            if p["remove_bad_features"] else [],
+            categorical_groups=categorical_groups,
+        )
+        model = SanityCheckerModel(
+            keep_indices=keep,
+            dropped=[d["name"] for d in summary.dropped],
+        )
+        model.summary_ = summary
+        return model
+
+
+@register_stage
+class SanityCheckerModel(Transformer):
+    """Fitted column-subset transform: keep the surviving slots, re-derive the schema."""
+
+    operation_name = "sanityChecker"
+    arity = (2, 2)
+    device_op = True
+
+    def __init__(self, keep_indices: Sequence[int] = (), dropped: Sequence[str] = ()):
+        super().__init__(keep_indices=[int(i) for i in keep_indices],
+                         dropped=list(dropped))
+        self.summary_: Optional[SanityCheckerSummary] = None
+
+    def out_kind(self, in_kinds):
+        return kind_of("OPVector")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        vec = cols[1]
+        keep = jnp.asarray(self.params["keep_indices"], jnp.int32)
+        out = jnp.take(jnp.asarray(vec.values, jnp.float32), keep, axis=1)
+        schema = vec.schema.select(self.params["keep_indices"]) if vec.schema else None
+        return Column.vector(out, schema=schema)

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 from typing import Any, Callable, Mapping, Optional, Sequence
 
-from ..stages.base import FeatureGeneratorStage
 from ..types import KINDS, FeatureKind, Table, kind_of
 from .feature import Feature
 
@@ -48,6 +47,10 @@ class FeatureBuilder:
         return self
 
     def _build(self, is_response: bool) -> Feature:
+        # imported here, not at module top: stages.base itself imports graph.feature,
+        # so a module-level import would make `import transmogrifai_tpu.stages` fail
+        from ..stages.base import FeatureGeneratorStage
+
         stage = FeatureGeneratorStage(self.name, self.kind.name)
         stage.extract_fn = self._extract
         stage.aggregator = self._aggregator

@@ -10,6 +10,16 @@ from .linear import (
     predict_svc,
 )
 
+from .trees import (
+    TreeEnsembleParams,
+    bin_features,
+    fit_forest,
+    fit_gbt,
+    grow_tree,
+    predict_ensemble,
+    quantile_bins,
+)
+
 __all__ = [
     "LinearParams",
     "fit_logistic",
@@ -20,4 +30,11 @@ __all__ = [
     "predict_linear",
     "fit_svc",
     "predict_svc",
+    "TreeEnsembleParams",
+    "quantile_bins",
+    "bin_features",
+    "grow_tree",
+    "fit_gbt",
+    "fit_forest",
+    "predict_ensemble",
 ]

@@ -1,0 +1,153 @@
+"""Statistics kernels: moments, label correlations, contingency tables, Cramér's V.
+
+TPU-native analogs of the reference's stats substrate — OpStatistics
+(utils/src/main/scala/com/salesforce/op/utils/stats/OpStatistics.scala: contingency /
+PMI / Cramér's V) and the MLlib Statistics.colStats / Statistics.corr calls inside
+SanityChecker.fitFn (core/.../impl/preparators/SanityChecker.scala:535) and
+RawFeatureFilter (RawFeatureFilter.scala:180). Where Spark aggregates per-partition
+moments with treeAggregate, these are single fused jnp reductions: one X^T-style pass
+produces every moment and correlation, and contingency tables are one-hot matmuls on
+the MXU — sharded over a row mesh axis they psum over ICI.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+class ColumnStats(NamedTuple):
+    """Per-column moments of a feature matrix [D]."""
+
+    mean: jnp.ndarray
+    variance: jnp.ndarray
+    min: jnp.ndarray
+    max: jnp.ndarray
+    count_nonzero: jnp.ndarray
+
+
+@jax.jit
+def column_stats(X: jnp.ndarray, w: Optional[jnp.ndarray] = None) -> ColumnStats:
+    """Weighted per-column mean/variance/min/max/nnz in ONE pass over X [N, D]."""
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    w = jnp.ones(n, jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
+    wsum = w.sum() + _EPS
+    mean = (w[:, None] * X).sum(0) / wsum
+    var = (w[:, None] * (X - mean[None, :]) ** 2).sum(0) / wsum
+    return ColumnStats(
+        mean=mean,
+        variance=var,
+        min=X.min(axis=0),
+        max=X.max(axis=0),
+        count_nonzero=(w[:, None] * (X != 0)).sum(0),
+    )
+
+
+@jax.jit
+def pearson_with_label(X: jnp.ndarray, y: jnp.ndarray,
+                       w: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Pearson correlation of every column of X [N, D] with y [N] -> [D].
+    Zero-variance columns yield 0 (the reference reports NaN; 0 keeps downstream
+    drop logic branch-free)."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    n = X.shape[0]
+    w = jnp.ones(n, jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
+    wsum = w.sum() + _EPS
+    mx = (w[:, None] * X).sum(0) / wsum
+    my = (w * y).sum() / wsum
+    xc = X - mx[None, :]
+    yc = y - my
+    cov = (w[:, None] * xc * yc[:, None]).sum(0) / wsum
+    vx = (w[:, None] * xc ** 2).sum(0) / wsum
+    vy = (w * yc ** 2).sum() / wsum
+    denom = jnp.sqrt(vx * vy)
+    return jnp.where(denom > _EPS, cov / jnp.clip(denom, _EPS, None), 0.0)
+
+
+def _rank(v: jnp.ndarray) -> jnp.ndarray:
+    """Average-free dense ranks (argsort of argsort); ties get arbitrary order, which
+    matches MLlib's rank behavior closely enough for drop thresholds."""
+    order = jnp.argsort(v, axis=0)
+    n = v.shape[0]
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(n, dtype=order.dtype))
+    return ranks.astype(jnp.float32)
+
+
+@jax.jit
+def spearman_with_label(X: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Spearman correlation of each column with y: Pearson on ranks."""
+    Xr = jax.vmap(_rank, in_axes=1, out_axes=1)(jnp.asarray(X, jnp.float32))
+    yr = _rank(jnp.asarray(y, jnp.float32))
+    return pearson_with_label(Xr, yr)
+
+
+@jax.jit
+def correlation_matrix(X: jnp.ndarray) -> jnp.ndarray:
+    """Full feature-feature Pearson correlation [D, D] as one X^T X MXU pass."""
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    mx = X.mean(0)
+    xc = X - mx[None, :]
+    cov = xc.T @ xc / n
+    sd = jnp.sqrt(jnp.clip(jnp.diag(cov), _EPS, None))
+    corr = cov / (sd[:, None] * sd[None, :])
+    return jnp.clip(corr, -1.0, 1.0)
+
+
+@jax.jit
+def contingency_table(indicators: jnp.ndarray, label_onehot: jnp.ndarray,
+                      w: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Weighted contingency counts [K, C] = indicators^T @ diag(w) @ label_onehot.
+    `indicators` [N, K] are 0/1 slot columns of one categorical group
+    (OpStatistics.contingencyStats input, computed as a single matmul)."""
+    ind = jnp.asarray(indicators, jnp.float32)
+    lab = jnp.asarray(label_onehot, jnp.float32)
+    if w is not None:
+        ind = ind * jnp.asarray(w, jnp.float32)[:, None]
+    return ind.T @ lab
+
+
+@jax.jit
+def cramers_v(table: jnp.ndarray) -> jnp.ndarray:
+    """Bias-uncorrected Cramér's V of a contingency table [K, C]
+    (OpStatistics.cramersV): sqrt(chi2 / (n * (min(K, C) - 1)))."""
+    t = jnp.asarray(table, jnp.float32)
+    n = t.sum() + _EPS
+    rows = t.sum(1, keepdims=True)
+    cols = t.sum(0, keepdims=True)
+    expected = rows @ cols / n
+    chi2 = jnp.where(expected > _EPS, (t - expected) ** 2 / jnp.clip(expected, _EPS, None), 0.0).sum()
+    k = jnp.minimum((rows[:, 0] > 0).sum(), (cols[0] > 0).sum()).astype(jnp.float32)
+    dof = jnp.clip(k - 1.0, 1e-6, None)
+    return jnp.sqrt(chi2 / (n * dof))
+
+
+@jax.jit
+def pointwise_mutual_info(table: jnp.ndarray) -> jnp.ndarray:
+    """PMI matrix [K, C] in nats: log(p(x,y) / (p(x) p(y)))
+    (OpStatistics contingency PMI); empty cells yield 0."""
+    t = jnp.asarray(table, jnp.float32)
+    n = t.sum() + _EPS
+    pxy = t / n
+    px = pxy.sum(1, keepdims=True)
+    py = pxy.sum(0, keepdims=True)
+    safe = (pxy > _EPS) & (px > _EPS) & (py > _EPS)
+    return jnp.where(safe, jnp.log(jnp.clip(pxy, _EPS, None) / jnp.clip(px * py, _EPS, None)), 0.0)
+
+
+@jax.jit
+def rule_confidence(table: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Association-rule stats per indicator row of a contingency table [K, C]:
+    (max over classes of P(class | indicator) [K], support P(indicator) [K])
+    (SanityChecker maxRuleConfidence / minRequiredRuleSupport)."""
+    t = jnp.asarray(table, jnp.float32)
+    n = t.sum() + _EPS
+    row = t.sum(1)
+    conf = jnp.where(row[:, None] > _EPS, t / jnp.clip(row[:, None], _EPS, None), 0.0).max(1)
+    support = row / n
+    return conf, support

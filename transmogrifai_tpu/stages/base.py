@@ -21,13 +21,15 @@ params (no reflection — explicit `params` dict), fitted state is a jnp pytree 
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import TYPE_CHECKING, Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from ..graph.feature import Feature
 from ..types import Column, FeatureKind, Table, kind_of
 from ..utils import uid as make_uid
+
+if TYPE_CHECKING:  # graph imports stages at module level; keep the reverse edge lazy
+    from ..graph.feature import Feature
 
 #: class-name -> stage class (replaces the reference's reflection-based loader,
 #: OpPipelineStageReader.scala:52+)
@@ -60,7 +62,9 @@ class Stage:
     def __call__(self, *features: Feature) -> Feature:
         return self.set_input(*features)
 
-    def set_input(self, *features: Feature) -> Feature:
+    def set_input(self, *features: "Feature") -> "Feature":
+        from ..graph.feature import Feature
+
         if self._output is not None:
             # one stage instance = one DAG node; silent re-wiring would orphan the
             # first output feature (the reference enforces distinct stage instances,

@@ -1,0 +1,382 @@
+"""Tree-ensemble model stages: RF / GBT / DT / XGBoost-style, classifier + regressor.
+
+Analogs of the reference's tree wrappers (core/.../impl/classification/
+OpRandomForestClassifier.scala, OpGBTClassifier.scala, OpDecisionTreeClassifier.scala,
+OpXGBoostClassifier.scala:48 and the regression twins under impl/regression/) over the
+histogram tree ops in ops/trees.py. Default grids mirror DefaultSelectorParams.scala
+(MaxDepth {3, 6, 12}, MinInstancesPerNode {10, 100}, 50 trees for forests, 20 boosting
+rounds) — traced-arithmetic hyperparameters (learning_rate, reg_lambda,
+min_child_weight) ride the ModelSelector's vmapped grid axis; depth/tree-count are
+static per compile group.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.trees import (
+    TreeEnsembleParams,
+    fit_forest,
+    fit_gbt,
+    predict_forest_classification,
+    predict_forest_regression,
+    predict_gbt_binary,
+    predict_gbt_multiclass,
+    predict_gbt_regression,
+)
+from ...select.grids import ParamGridBuilder
+from ..base import register_stage
+from .base import PredictionModel, PredictorEstimator
+
+
+def _ensemble_params(stage_params: dict) -> TreeEnsembleParams:
+    return TreeEnsembleParams(
+        split_feature=jnp.asarray(stage_params["split_feature"], jnp.int32),
+        split_threshold=jnp.asarray(stage_params["split_threshold"], jnp.float32),
+        leaf_values=jnp.asarray(stage_params["leaf_values"], jnp.float32),
+        base=jnp.asarray(stage_params["base"], jnp.float32),
+    )
+
+
+def _params_json(params: TreeEnsembleParams) -> dict:
+    return {
+        "split_feature": np.asarray(params.split_feature).tolist(),
+        "split_threshold": np.asarray(params.split_threshold).tolist(),
+        "leaf_values": np.asarray(params.leaf_values).tolist(),
+        "base": np.asarray(params.base).tolist(),
+    }
+
+
+class _TreeModelBase(PredictionModel):
+    """Caches the device-array TreeEnsembleParams so repeated scoring calls do not
+    re-convert the JSON list params every time."""
+
+    def _ensemble(self) -> TreeEnsembleParams:
+        cached = getattr(self, "_ensemble_cache", None)
+        if cached is None:
+            cached = self._ensemble_cache = _ensemble_params(self.params)
+        return cached
+
+
+class _TreeClassifierBase(PredictorEstimator):
+    """Shared num_classes inference (0 = infer from labels at fit time)."""
+
+    def fit_columns(self, cols):
+        y, X = self.label_and_matrix(cols)
+        kw = self.fit_kwargs()
+        kw["num_classes"] = kw["num_classes"] or max(int(np.asarray(y).max()) + 1, 2)
+        return self.make_model(self.fit_fn(X, y, **kw))
+
+
+@register_stage
+class RandomForestClassifier(_TreeClassifierBase):
+    """Bagged histogram trees with class-distribution leaves (binary + multiclass)."""
+
+    operation_name = "randomForestClassifier"
+    vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, num_classes: int = 0, n_trees: int = 50, max_depth: int = 6,
+                 min_child_weight: float = 10.0, min_gain: float = 0.0,
+                 reg_lambda: float = 1e-3, colsample: float = 1.0, n_bins: int = 32,
+                 seed: int = 7):
+        super().__init__(num_classes=int(num_classes), n_trees=int(n_trees),
+                         max_depth=int(max_depth),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         colsample=float(colsample), n_bins=int(n_bins),
+                         seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, num_classes=0, **kw):
+        return fit_forest(X, y, sample_weight, objective="classification",
+                          num_classes=max(int(num_classes), 2), **kw)
+
+    predict_fn = staticmethod(predict_forest_classification)
+
+    def make_model(self, params):
+        return RandomForestClassifierModel(**_params_json(params))
+
+
+@register_stage
+class RandomForestClassifierModel(_TreeModelBase):
+    operation_name = "randomForestClassifier"
+
+    def predict(self, X):
+        return predict_forest_classification(self._ensemble(), X)
+
+
+@register_stage
+class RandomForestRegressor(PredictorEstimator):
+    operation_name = "randomForestRegressor"
+    vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, n_trees: int = 50, max_depth: int = 6,
+                 min_child_weight: float = 10.0, min_gain: float = 0.0,
+                 reg_lambda: float = 1e-3, colsample: float = 1.0, n_bins: int = 32,
+                 seed: int = 7):
+        super().__init__(n_trees=int(n_trees), max_depth=int(max_depth),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         colsample=float(colsample), n_bins=int(n_bins),
+                         seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **kw):
+        return fit_forest(X, y, sample_weight, objective="regression", **kw)
+
+    predict_fn = staticmethod(predict_forest_regression)
+
+    def make_model(self, params):
+        return RandomForestRegressorModel(**_params_json(params))
+
+
+@register_stage
+class RandomForestRegressorModel(_TreeModelBase):
+    operation_name = "randomForestRegressor"
+
+    def predict(self, X):
+        return predict_forest_regression(self._ensemble(), X)
+
+
+@register_stage
+class DecisionTreeClassifier(_TreeClassifierBase):
+    """Single un-bagged tree (n_trees=1, no bootstrap) — OpDecisionTreeClassifier."""
+
+    operation_name = "decisionTreeClassifier"
+    vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, num_classes: int = 0, max_depth: int = 6,
+                 min_child_weight: float = 10.0, min_gain: float = 0.0,
+                 reg_lambda: float = 1e-3, n_bins: int = 32):
+        super().__init__(num_classes=int(num_classes), max_depth=int(max_depth),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         n_bins=int(n_bins))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, num_classes=0, **kw):
+        return fit_forest(X, y, sample_weight, objective="classification",
+                          num_classes=max(int(num_classes), 2),
+                          n_trees=1, bootstrap=False, **kw)
+
+    predict_fn = staticmethod(predict_forest_classification)
+
+    def make_model(self, params):
+        return DecisionTreeClassifierModel(**_params_json(params))
+
+
+@register_stage
+class DecisionTreeClassifierModel(_TreeModelBase):
+    operation_name = "decisionTreeClassifier"
+
+    def predict(self, X):
+        return predict_forest_classification(self._ensemble(), X)
+
+
+@register_stage
+class DecisionTreeRegressor(PredictorEstimator):
+    operation_name = "decisionTreeRegressor"
+    vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, max_depth: int = 6, min_child_weight: float = 10.0,
+                 min_gain: float = 0.0, reg_lambda: float = 1e-3, n_bins: int = 32):
+        super().__init__(max_depth=int(max_depth),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         n_bins=int(n_bins))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **kw):
+        return fit_forest(X, y, sample_weight, objective="regression",
+                          n_trees=1, bootstrap=False, **kw)
+
+    predict_fn = staticmethod(predict_forest_regression)
+
+    def make_model(self, params):
+        return DecisionTreeRegressorModel(**_params_json(params))
+
+
+@register_stage
+class DecisionTreeRegressorModel(_TreeModelBase):
+    operation_name = "decisionTreeRegressor"
+
+    def predict(self, X):
+        return predict_forest_regression(self._ensemble(), X)
+
+
+@register_stage
+class GBTClassifier(PredictorEstimator):
+    """Binary gradient-boosted trees (OpGBTClassifier; Spark GBT is binary-only)."""
+
+    operation_name = "gbtClassifier"
+    vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, n_trees: int = 20, max_depth: int = 5,
+                 learning_rate: float = 0.1, min_child_weight: float = 1.0,
+                 min_gain: float = 0.0, reg_lambda: float = 1.0,
+                 subsample: float = 1.0, colsample: float = 1.0, n_bins: int = 32,
+                 seed: int = 7):
+        super().__init__(n_trees=int(n_trees), max_depth=int(max_depth),
+                         learning_rate=float(learning_rate),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         subsample=float(subsample), colsample=float(colsample),
+                         n_bins=int(n_bins), seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **kw):
+        return fit_gbt(X, y, sample_weight, objective="binary", **kw)
+
+    predict_fn = staticmethod(predict_gbt_binary)
+
+    def make_model(self, params):
+        return GBTClassifierModel(**_params_json(params))
+
+
+@register_stage
+class GBTClassifierModel(_TreeModelBase):
+    operation_name = "gbtClassifier"
+
+    def predict(self, X):
+        return predict_gbt_binary(self._ensemble(), X)
+
+
+@register_stage
+class GBTRegressor(PredictorEstimator):
+    operation_name = "gbtRegressor"
+    vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, n_trees: int = 20, max_depth: int = 5,
+                 learning_rate: float = 0.1, min_child_weight: float = 1.0,
+                 min_gain: float = 0.0, reg_lambda: float = 1.0,
+                 subsample: float = 1.0, colsample: float = 1.0, n_bins: int = 32,
+                 seed: int = 7):
+        super().__init__(n_trees=int(n_trees), max_depth=int(max_depth),
+                         learning_rate=float(learning_rate),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         subsample=float(subsample), colsample=float(colsample),
+                         n_bins=int(n_bins), seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **kw):
+        return fit_gbt(X, y, sample_weight, objective="regression", **kw)
+
+    predict_fn = staticmethod(predict_gbt_regression)
+
+    def make_model(self, params):
+        return GBTRegressorModel(**_params_json(params))
+
+
+@register_stage
+class GBTRegressorModel(_TreeModelBase):
+    operation_name = "gbtRegressor"
+
+    def predict(self, X):
+        return predict_gbt_regression(self._ensemble(), X)
+
+
+@register_stage
+class XGBoostClassifier(_TreeClassifierBase):
+    """Second-order boosting with XGBoost-style defaults; multiclass via one
+    multi-output softmax tree per round (TPU-friendly multi_strategy, no per-class
+    tree loops). Analog of OpXGBoostClassifier.scala:48."""
+
+    operation_name = "xgboostClassifier"
+    vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, num_classes: int = 0, n_trees: int = 50, max_depth: int = 6,
+                 learning_rate: float = 0.3, min_child_weight: float = 1.0,
+                 min_gain: float = 0.0, reg_lambda: float = 1.0,
+                 subsample: float = 1.0, colsample: float = 1.0, n_bins: int = 64,
+                 seed: int = 7):
+        super().__init__(num_classes=int(num_classes), n_trees=int(n_trees),
+                         max_depth=int(max_depth), learning_rate=float(learning_rate),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         subsample=float(subsample), colsample=float(colsample),
+                         n_bins=int(n_bins), seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, num_classes=0, **kw):
+        num_classes = max(int(num_classes), 2)
+        objective = "binary" if num_classes <= 2 else "multiclass"
+        return fit_gbt(X, y, sample_weight, objective=objective,
+                       num_classes=num_classes, **kw)
+
+    @staticmethod
+    def predict_fn(params, X):
+        if params.leaf_values.shape[-1] > 1:
+            return predict_gbt_multiclass(params, X)
+        return predict_gbt_binary(params, X)
+
+    def make_model(self, params):
+        return XGBoostClassifierModel(**_params_json(params))
+
+
+@register_stage
+class XGBoostClassifierModel(_TreeModelBase):
+    operation_name = "xgboostClassifier"
+
+    def predict(self, X):
+        return XGBoostClassifier.predict_fn(self._ensemble(), X)
+
+
+@register_stage
+class XGBoostRegressor(PredictorEstimator):
+    operation_name = "xgboostRegressor"
+    vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
+
+    def __init__(self, n_trees: int = 50, max_depth: int = 6,
+                 learning_rate: float = 0.3, min_child_weight: float = 1.0,
+                 min_gain: float = 0.0, reg_lambda: float = 1.0,
+                 subsample: float = 1.0, colsample: float = 1.0, n_bins: int = 64,
+                 seed: int = 7):
+        super().__init__(n_trees=int(n_trees), max_depth=int(max_depth),
+                         learning_rate=float(learning_rate),
+                         min_child_weight=float(min_child_weight),
+                         min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         subsample=float(subsample), colsample=float(colsample),
+                         n_bins=int(n_bins), seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **kw):
+        return fit_gbt(X, y, sample_weight, objective="regression", **kw)
+
+    predict_fn = staticmethod(predict_gbt_regression)
+
+    def make_model(self, params):
+        return XGBoostRegressorModel(**_params_json(params))
+
+
+@register_stage
+class XGBoostRegressorModel(_TreeModelBase):
+    operation_name = "xgboostRegressor"
+
+    def predict(self, X):
+        return predict_gbt_regression(self._ensemble(), X)
+
+
+def default_tree_candidates(problem_type: str):
+    """Tree families + grids for the ModelSelector defaults, mirroring the
+    reference's DefaultSelectorParams.scala grids (MaxDepth {3, 6, 12},
+    MinInstancesPerNode {10, 100}; binary adds GBT, multiclass is RF-only as in
+    MultiClassificationModelSelector.scala:59-61)."""
+    depth_grid = [3, 6, 12]
+    rf_grid = (
+        ParamGridBuilder()
+        .add("max_depth", depth_grid)
+        .add("min_child_weight", [10.0, 100.0])
+        .build()
+    )
+    gbt_grid = (
+        ParamGridBuilder()
+        .add("max_depth", [3, 6])
+        .add("learning_rate", [0.1, 0.3])
+        .build()
+    )
+    if problem_type == "binary":
+        return [(RandomForestClassifier(), rf_grid), (GBTClassifier(), gbt_grid)]
+    if problem_type == "multiclass":
+        return [(RandomForestClassifier(), rf_grid)]
+    return [(RandomForestRegressor(), rf_grid), (GBTRegressor(), gbt_grid)]
