@@ -1,0 +1,154 @@
+"""NaiveBayes / MLP / GLM / isotonic tests (mirror of reference OpNaiveBayesTest,
+OpMultilayerPerceptronClassifierTest, OpGeneralizedLinearRegressionTest,
+IsotonicRegressionCalibratorTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.ops.glm import fit_glm, fit_isotonic, predict_glm, predict_isotonic
+from transmogrifai_tpu.stages.model import (
+    GeneralizedLinearRegression,
+    IsotonicRegressionCalibrator,
+    MLPClassifier,
+    NaiveBayes,
+)
+from transmogrifai_tpu.types import Column, Table
+
+
+def _fit(est, X, y, label_kind="RealNN"):
+    label = FeatureBuilder("label", label_kind).as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    est(label, vec)
+    table = Table({"label": Column.real(y, kind=label_kind), "vec": Column.vector(X)})
+    model = est.fit_table(table)
+    out = model.transform_table(table)
+    return model, out[model.get_output().name]
+
+
+def test_naive_bayes_multinomial_separates_counts(rng):
+    # class 0 heavy on feature 0, class 1 heavy on feature 1 (count data)
+    n = 300
+    y = rng.integers(0, 2, n).astype(np.float32)
+    X = np.zeros((n, 2), np.float32)
+    X[:, 0] = rng.poisson(5, n) * (1 - y) + rng.poisson(1, n) * y
+    X[:, 1] = rng.poisson(1, n) * (1 - y) + rng.poisson(5, n) * y
+    model, out = _fit(NaiveBayes(), X, y)
+    acc = float((np.asarray(out.pred) == y).mean())
+    assert acc > 0.9
+    np.testing.assert_allclose(np.asarray(out.prob).sum(1), 1.0, atol=1e-5)
+
+
+def test_naive_bayes_gaussian(rng):
+    n = 400
+    y = rng.integers(0, 3, n).astype(np.float32)
+    X = rng.normal(size=(n, 2)).astype(np.float32) + y[:, None] * 3.0
+    model, out = _fit(NaiveBayes(model_type="gaussian"), X, y)
+    assert float((np.asarray(out.pred) == y).mean()) > 0.9
+    assert out.prob.shape == (n, 3)
+
+
+def test_mlp_learns_xor(rng):
+    n = 400
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)
+    model, out = _fit(MLPClassifier(hidden=(16, 16), max_iter=300, lr=0.02), X, y)
+    assert float((np.asarray(out.pred) == y).mean()) > 0.9
+
+
+def test_glm_poisson_log_link(rng):
+    n = 500
+    X = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+    rate = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1] + 1.0)
+    y = rng.poisson(rate).astype(np.float32)
+    params = fit_glm(X, y, family="poisson")
+    np.testing.assert_allclose(np.asarray(params.w), [0.8, -0.5], atol=0.1)
+    np.testing.assert_allclose(float(params.b), 1.0, atol=0.1)
+    mu, _, _ = predict_glm(params, X, family="poisson")
+    assert float(np.corrcoef(np.asarray(mu), rate)[0, 1]) > 0.97
+
+
+def test_glm_gaussian_matches_ols(rng):
+    n = 300
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] - 1.0 * X[:, 1] + 0.5).astype(np.float32)
+    model, out = _fit(GeneralizedLinearRegression(family="gaussian"), X, y)
+    np.testing.assert_allclose(np.asarray(out.pred), y, atol=0.05)
+
+
+def test_glm_binomial(rng):
+    n = 400
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    p = 1 / (1 + np.exp(-(2 * X[:, 0])))
+    y = (rng.random(n) < p).astype(np.float32)
+    model, out = _fit(GeneralizedLinearRegression(family="binomial"), X, y)
+    pred_class = (np.asarray(out.pred) > 0.5).astype(np.float32)
+    # Bayes-optimal accuracy for sigmoid(2x) labels is ~0.80; near-optimal passes
+    assert float((pred_class == y).mean()) > 0.75
+
+
+def test_glm_unknown_family_raises():
+    with pytest.raises(ValueError, match="family"):
+        fit_glm(np.zeros((4, 1), np.float32), np.zeros(4, np.float32), family="weird")
+
+
+# --- isotonic --------------------------------------------------------------------------
+def test_pav_monotone_and_fits_steps():
+    x = np.array([1, 2, 3, 4, 5, 6], np.float32)
+    y = np.array([1, 3, 2, 6, 5, 7], np.float32)  # violations at (2,3) and (4,5)
+    bounds, values = fit_isotonic(x, y)
+    assert (np.diff(values) >= -1e-9).all()
+    out = np.asarray(predict_isotonic(bounds, values, x))
+    assert (np.diff(out) >= -1e-9).all()
+    # pooled blocks average their members
+    np.testing.assert_allclose(out[1], 2.5, atol=1e-5)
+    np.testing.assert_allclose(out[2], 2.5, atol=1e-5)
+
+
+def test_pav_decreasing():
+    x = np.array([1, 2, 3, 4], np.float32)
+    y = np.array([4, 5, 2, 1], np.float32)
+    bounds, values = fit_isotonic(x, y, increasing=False)
+    out = np.asarray(predict_isotonic(bounds, values, x))
+    assert (np.diff(out) <= 1e-9).all()
+
+
+def test_isotonic_calibrator_stage(rng):
+    n = 500
+    raw_score = rng.uniform(0, 1, n).astype(np.float32)
+    y = (rng.random(n) < raw_score ** 2).astype(np.float32)  # miscalibrated scores
+    label = FeatureBuilder("label", "RealNN").as_response()
+    score = FeatureBuilder("score", "RealNN").as_predictor()
+    cal = IsotonicRegressionCalibrator()
+    cal(label, score)
+    table = Table({"label": Column.real(y, kind="RealNN"),
+                   "score": Column.real(raw_score, kind="RealNN")})
+    model = cal.fit_table(table)
+    out = model.transform_table(table)[model.get_output().name]
+    calibrated = np.asarray(out.values)
+    # calibrated scores should approximate the true probability curve x^2
+    err = np.abs(calibrated - raw_score ** 2).mean()
+    raw_err = np.abs(raw_score - raw_score ** 2).mean()
+    assert err < raw_err * 0.5
+
+
+def test_selector_scores_naive_bayes_with_configured_form(rng):
+    """CV scoring must use the configured model form (gaussian), not the default
+    multinomial path — regression test for instance-bound predict_fn."""
+    from transmogrifai_tpu.select import BinaryClassificationModelSelector
+    from transmogrifai_tpu.select.grids import ParamGridBuilder
+
+    n = 300
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    X[:, 0] -= 5.0  # negative-shifted: multinomial clipping would destroy the signal
+    models = [(NaiveBayes(model_type="gaussian"),
+               ParamGridBuilder().add("smoothing", [1.0]).build())]
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, models=models, seed=5)
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    sel(label, vec)
+    table = Table({"label": Column.real(y, kind="RealNN"), "vec": Column.vector(X)})
+    sel.fit_table(table)
+    best = sel.summary_.validation_results[0]
+    assert best.metric_mean > 0.9  # gaussian form separates; multinomial would not

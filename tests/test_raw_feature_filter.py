@@ -1,0 +1,165 @@
+"""RawFeatureFilter tests (mirror of reference RawFeatureFilterTest under
+core/src/test/.../filters/): distribution summaries, fill-rate / drift / leakage
+exclusions, and workflow DAG surgery after blacklisting."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.filter import FeatureDistribution, RawFeatureFilter
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _rows(n, fill_age=1.0, age_shift=0.0, seed=0, label_linked_null=False):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        y = float(rng.random() > 0.5)
+        age = float(rng.normal(30 + age_shift, 5))
+        if label_linked_null:
+            age_val = age if y > 0 else None  # missingness IS the label
+        else:
+            age_val = age if rng.random() < fill_age else None
+        rows.append({
+            "y": y,
+            "age": age_val,
+            "fare": float(rng.normal(50, 10)),
+            "sex": "m" if rng.random() > 0.4 else "f",
+        })
+    return rows
+
+
+SCHEMA = {"y": "RealNN", "age": "Real", "fare": "Real", "sex": "PickList"}
+
+
+def _features():
+    return features_from_schema(SCHEMA, response="y")
+
+
+def _run_filter(train_rows, rff, fs=None):
+    fs = fs or _features()
+    reader = InMemoryReader(train_rows)
+    table = reader.generate_table(list(fs.values()))
+    return rff.filter_raw(tuple(fs.values()), table)
+
+
+# --- distributions ---------------------------------------------------------------------
+def test_distribution_fill_rate_and_histogram():
+    rff = RawFeatureFilter(bins=10)
+    _, bl = _run_filter(_rows(200, fill_age=0.7, seed=1), rff)
+    d = rff.results_.train_distributions["age"]
+    assert isinstance(d, FeatureDistribution)
+    assert 0.6 < d.fill_rate < 0.8
+    assert d.histogram.sum() > 0 and len(d.histogram) == 10
+    # well-filled features survive default thresholds
+    assert bl == ()
+
+
+def test_js_divergence_identical_is_zero():
+    rff = RawFeatureFilter(bins=20)
+    _run_filter(_rows(300, seed=2), rff)
+    d = rff.results_.train_distributions["age"]
+    assert d.js_divergence(d) == pytest.approx(0.0, abs=1e-9)
+
+
+# --- exclusion rules -------------------------------------------------------------------
+def test_low_fill_rate_excluded():
+    rff = RawFeatureFilter(min_fill_rate=0.5)
+    _, bl = _run_filter(_rows(200, fill_age=0.1, seed=3), rff)
+    assert [f.name for f in bl] == ["age"]
+    assert "fill rate" in rff.results_.excluded[0]["reason"]
+
+
+def test_null_label_correlation_excluded():
+    rff = RawFeatureFilter(max_correlation=0.5)
+    _, bl = _run_filter(_rows(300, label_linked_null=True, seed=4), rff)
+    assert [f.name for f in bl] == ["age"]
+    assert "null-indicator" in rff.results_.excluded[0]["reason"]
+
+
+def test_scoring_drift_excluded():
+    fs = _features()
+    scoring_rows = _rows(300, age_shift=40.0, seed=6)  # age distribution shifted
+    rff = RawFeatureFilter(
+        scoring_reader=InMemoryReader(scoring_rows), max_js_divergence=0.5)
+    _, bl = _run_filter(_rows(300, seed=5), rff, fs=fs)
+    assert [f.name for f in bl] == ["age"]
+    assert "JS divergence" in rff.results_.excluded[0]["reason"]
+    assert "age" in rff.results_.scoring_distributions
+
+
+def test_scoring_fill_difference_excluded():
+    fs = _features()
+    scoring_rows = _rows(300, fill_age=0.05, seed=8)
+    rff = RawFeatureFilter(
+        scoring_reader=InMemoryReader(scoring_rows), max_fill_difference=0.5,
+        max_fill_ratio_diff=np.inf)
+    _, bl = _run_filter(_rows(300, fill_age=1.0, seed=7), rff, fs=fs)
+    assert [f.name for f in bl] == ["age"]
+    assert "fill difference" in rff.results_.excluded[0]["reason"]
+
+
+def test_protected_features_never_excluded():
+    rff = RawFeatureFilter(min_fill_rate=0.5, protected_features=("age",))
+    _, bl = _run_filter(_rows(200, fill_age=0.1, seed=9), rff)
+    assert bl == ()
+
+
+def test_response_never_excluded():
+    rff = RawFeatureFilter(min_fill_rate=2.0)  # impossible threshold
+    _, bl = _run_filter(_rows(100, seed=10), rff)
+    assert "y" not in [f.name for f in bl]
+
+
+# --- workflow integration --------------------------------------------------------------
+def test_workflow_blacklist_surgery_and_training():
+    fs = _features()
+    predictors = [fs["age"], fs["fare"], fs["sex"]]
+    vector = transmogrify(predictors)
+    pred = LogisticRegression()(fs["y"], vector)
+    rows = _rows(300, fill_age=0.05, seed=11)
+    wf = (Workflow().set_reader(InMemoryReader(rows))
+          .set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.5)))
+    model = wf.train()
+    assert [f.name for f in model.blacklisted] == ["age"]
+    assert all(f.name != "age" for f in model.raw_features)
+    # the trained model must score without the blacklisted raw column
+    out = model.score(reader=InMemoryReader(_rows(50, fill_age=0.0, seed=12)))
+    assert len(out[pred.name].to_list()) == 50
+
+
+def test_workflow_unreachable_result_errors():
+    fs = _features()
+    vector = transmogrify([fs["age"]])  # result depends ONLY on the bad feature
+    pred = LogisticRegression()(fs["y"], vector)
+    rows = _rows(200, fill_age=0.05, seed=13)
+    wf = (Workflow().set_reader(InMemoryReader(rows))
+          .set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.5)))
+    with pytest.raises(ValueError, match="blacklisted"):
+        wf.train()
+
+
+def test_failed_blacklist_leaves_graph_intact_for_retry():
+    """If the cascade reaches a result feature, train() must raise WITHOUT mutating
+    the DAG, so a retry with a relaxed filter still sees every input."""
+    fs = _features()
+    vector = transmogrify([fs["age"], fs["fare"], fs["sex"]])
+    pred = LogisticRegression()(fs["y"], vector)
+    rows = [dict(r, fare=None) for r in _rows(200, fill_age=0.05, seed=14)]
+    wf = (Workflow().set_reader(InMemoryReader(rows))
+          .set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=2.0)))  # drops ALL
+    n_inputs_before = len(vector.origin_stage.inputs)
+    with pytest.raises(ValueError, match="blacklisted"):
+        wf.train()
+    assert len(vector.origin_stage.inputs) == n_inputs_before
+    # retry with a permissive filter trains fine on the untouched graph
+    wf2 = (Workflow().set_reader(InMemoryReader(_rows(200, seed=15)))
+           .set_result_features(pred)
+           .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.0)))
+    model = wf2.train()
+    assert model.blacklisted == ()

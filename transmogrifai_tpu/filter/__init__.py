@@ -1,0 +1,7 @@
+from .raw_feature_filter import (
+    FeatureDistribution,
+    RawFeatureFilter,
+    RawFeatureFilterResults,
+)
+
+__all__ = ["RawFeatureFilter", "FeatureDistribution", "RawFeatureFilterResults"]

@@ -1,0 +1,244 @@
+"""RawFeatureFilter: pre-modeling raw-data QA and automatic feature exclusion.
+
+TPU-native analog of the reference RawFeatureFilter (core/src/main/scala/com/salesforce/
+op/filters/RawFeatureFilter.scala:90-135 ctor+thresholds, :482 generateFilteredRaw;
+FeatureDistribution.scala:58; results RawFeatureFilterResults.scala:50-135; workflow
+wiring OpWorkflow.scala:524-563). It inspects RAW feature columns — before any
+vectorization — on the training set and (optionally) a scoring set, and blacklists
+features whose distributions say they will hurt the model:
+
+  - fill rate below `min_fill_rate`                           (mostly-missing)
+  - |train fill - scoring fill| above `max_fill_difference`   (serving skew)
+  - fill ratio above `max_fill_ratio_diff`                    (serving skew)
+  - train/scoring Jensen-Shannon divergence above
+    `max_js_divergence` (log2: bounded [0, 1])                (distribution drift)
+  - |corr(null-indicator, label)| above `max_correlation`     (missingness leaks label)
+
+The reference computes per-partition FeatureDistribution monoids and reduces them over
+the RDD; here histograms are jnp bincount/histogram passes (device reduction — psum'd
+when rows are sharded) and the decision logic is host-side. Text-like features are
+summarized by hashing values into a fixed bucket space (the text-hash distribution of
+FeatureDistribution.scala), numerics by fixed-edge histograms from the training range.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import Column, Storage
+from ..types.table import Table
+
+_EPS = 1e-12
+
+
+def _js_divergence(p: np.ndarray, q: np.ndarray) -> float:
+    """Jensen-Shannon divergence (log base 2 -> [0, 1]) between two count vectors."""
+    p = p / max(p.sum(), _EPS)
+    q = q / max(q.sum(), _EPS)
+    m = 0.5 * (p + q)
+
+    def kl(a, b):
+        mask = a > _EPS
+        return float((a[mask] * np.log2(a[mask] / np.maximum(b[mask], _EPS))).sum())
+
+    return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+
+@dataclass
+class FeatureDistribution:
+    """Summary of one raw feature's values (FeatureDistribution.scala:58): presence
+    counts plus a histogram — numeric bins over the training range, or hashed-value
+    buckets for text-like features."""
+
+    name: str
+    kind: str
+    count: int
+    null_count: int
+    histogram: np.ndarray
+    #: numeric features: bin edges shared between train/scoring so JS is comparable
+    bin_edges: Optional[np.ndarray] = None
+
+    @property
+    def fill_rate(self) -> float:
+        return 1.0 - self.null_count / max(self.count, 1)
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        if len(self.histogram) != len(other.histogram) or self.histogram.sum() == 0 \
+                or other.histogram.sum() == 0:
+            return 0.0
+        return _js_divergence(np.asarray(self.histogram, np.float64),
+                              np.asarray(other.histogram, np.float64))
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "count": self.count,
+            "null_count": self.null_count, "fill_rate": self.fill_rate,
+            "histogram": np.asarray(self.histogram).tolist(),
+        }
+
+
+@dataclass
+class RawFeatureFilterResults:
+    """What was computed and decided (RawFeatureFilterResults.scala:50-135)."""
+
+    train_distributions: dict = field(default_factory=dict)
+    scoring_distributions: dict = field(default_factory=dict)
+    excluded: list = field(default_factory=list)  # {"name", "reason"}
+    config: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "train_distributions": {k: d.to_json() for k, d in self.train_distributions.items()},
+            "scoring_distributions": {k: d.to_json() for k, d in self.scoring_distributions.items()},
+            "excluded": list(self.excluded),
+            "config": dict(self.config),
+        }
+
+    def pretty(self) -> str:
+        lines = [f"RawFeatureFilter: {len(self.excluded)} raw features excluded"]
+        for e in self.excluded:
+            lines.append(f"  - {e['name']}: {e['reason']}")
+        return "\n".join(lines)
+
+
+class RawFeatureFilter:
+    """Configure thresholds, attach with `workflow.with_raw_feature_filter(rff)`
+    (defaults mirror OpWorkflow.scala:527-538)."""
+
+    def __init__(self, scoring_reader=None, bins: int = 100,
+                 min_fill_rate: float = 0.001, max_fill_difference: float = 0.90,
+                 max_fill_ratio_diff: float = 20.0, max_js_divergence: float = 0.90,
+                 max_correlation: float = 0.95,
+                 protected_features: Sequence[str] = ()):
+        self.scoring_reader = scoring_reader
+        self.bins = int(bins)
+        self.min_fill_rate = float(min_fill_rate)
+        self.max_fill_difference = float(max_fill_difference)
+        self.max_fill_ratio_diff = float(max_fill_ratio_diff)
+        self.max_js_divergence = float(max_js_divergence)
+        self.max_correlation = float(max_correlation)
+        self.protected_features = frozenset(protected_features)
+        self.results_: Optional[RawFeatureFilterResults] = None
+
+    # --- distribution computation ---------------------------------------------------
+    def _distribution(self, feature, col: Column,
+                      train_dist: Optional[FeatureDistribution] = None) -> FeatureDistribution:
+        n = len(col)
+        mask = np.asarray(col.effective_mask())
+        null_count = int((~mask).sum())
+        st = col.kind.storage
+        hist = np.zeros(self.bins, np.float64)
+        edges = None
+        if st in (Storage.REAL, Storage.INTEGRAL, Storage.DATE, Storage.BINARY):
+            vals = np.asarray(col.values, np.float64)[mask]
+            if train_dist is not None and train_dist.bin_edges is not None:
+                edges = train_dist.bin_edges  # scoring reuses training edges
+            elif vals.size:
+                lo, hi = float(vals.min()), float(vals.max())
+                hi = hi if hi > lo else lo + 1.0
+                edges = np.linspace(lo, hi, self.bins + 1)
+            if edges is not None and vals.size:
+                hist, _ = np.histogram(np.clip(vals, edges[0], edges[-1]), bins=edges)
+                hist = hist.astype(np.float64)
+        elif st in (Storage.TEXT, Storage.TEXT_LIST, Storage.TEXT_SET, Storage.MAP):
+            # hashed-value buckets (text hash distribution of the reference)
+            idx = []
+            for v, m in zip(col.values, mask):
+                if not m:
+                    continue
+                if st is Storage.TEXT:
+                    idx.append(hash(v) % self.bins)
+                elif st is Storage.MAP:
+                    idx.extend(hash(k) % self.bins for k in v)
+                else:
+                    idx.extend(hash(t) % self.bins for t in v)
+            if idx:
+                hist = np.bincount(np.asarray(idx), minlength=self.bins).astype(np.float64)
+        # other storages (vector/geolocation/prediction): fill rate only
+        return FeatureDistribution(
+            name=feature.name, kind=col.kind.name, count=n, null_count=null_count,
+            histogram=hist, bin_edges=edges,
+        )
+
+    def compute_distributions(self, features, table: Table,
+                              train: Optional[dict] = None) -> dict:
+        out = {}
+        for f in features:
+            if f.is_response:
+                continue
+            ref = None if train is None else train.get(f.name)
+            out[f.name] = self._distribution(f, table[f.name], ref)
+        return out
+
+    # --- decision + workflow hook -----------------------------------------------------
+    def filter_raw(self, raw_features, train_table: Table):
+        """-> (train_table, blacklisted features). Called by Workflow.train()
+        (generateFilteredRaw, RawFeatureFilter.scala:482)."""
+        train_dists = self.compute_distributions(raw_features, train_table)
+        scoring_dists: dict = {}
+        if self.scoring_reader is not None:
+            predictors = [f for f in raw_features if not f.is_response]
+            scoring_table = self.scoring_reader.generate_table(list(predictors))
+            scoring_dists = self.compute_distributions(predictors, scoring_table,
+                                                       train=train_dists)
+
+        label = next((f for f in raw_features if f.is_response), None)
+        y = None
+        if label is not None and label.name in train_table.columns:
+            lcol = train_table[label.name]
+            if lcol.kind.on_device:
+                y = np.asarray(lcol.filled(0.0), np.float32)
+
+        reasons: dict[str, str] = {}
+        for f in raw_features:
+            if f.is_response or f.name in self.protected_features:
+                continue
+            d = train_dists[f.name]
+            if d.fill_rate < self.min_fill_rate:
+                reasons[f.name] = (f"fill rate {d.fill_rate:.4f} < min_fill_rate "
+                                   f"{self.min_fill_rate}")
+                continue
+            if y is not None:
+                null_ind = 1.0 - np.asarray(train_table[f.name].effective_mask(), np.float32)
+                if null_ind.std() > 0 and y.std() > 0:
+                    corr = float(np.corrcoef(null_ind, y)[0, 1])
+                    if abs(corr) > self.max_correlation:
+                        reasons[f.name] = (
+                            f"null-indicator/label correlation {abs(corr):.3f} > "
+                            f"max_correlation {self.max_correlation}")
+                        continue
+            if f.name in scoring_dists:
+                s = scoring_dists[f.name]
+                fill_diff = abs(d.fill_rate - s.fill_rate)
+                if fill_diff > self.max_fill_difference:
+                    reasons[f.name] = (f"train/scoring fill difference {fill_diff:.3f} > "
+                                       f"max_fill_difference {self.max_fill_difference}")
+                    continue
+                ratio = (max(d.fill_rate, s.fill_rate)
+                         / max(min(d.fill_rate, s.fill_rate), _EPS))
+                if ratio > self.max_fill_ratio_diff:
+                    reasons[f.name] = (f"train/scoring fill ratio {ratio:.1f} > "
+                                       f"max_fill_ratio_diff {self.max_fill_ratio_diff}")
+                    continue
+                js = d.js_divergence(s)
+                if js > self.max_js_divergence:
+                    reasons[f.name] = (f"train/scoring JS divergence {js:.3f} > "
+                                       f"max_js_divergence {self.max_js_divergence}")
+
+        self.results_ = RawFeatureFilterResults(
+            train_distributions=train_dists,
+            scoring_distributions=scoring_dists,
+            excluded=[{"name": n, "reason": r} for n, r in reasons.items()],
+            config={
+                "bins": self.bins, "min_fill_rate": self.min_fill_rate,
+                "max_fill_difference": self.max_fill_difference,
+                "max_fill_ratio_diff": self.max_fill_ratio_diff,
+                "max_js_divergence": self.max_js_divergence,
+                "max_correlation": self.max_correlation,
+            },
+        )
+        blacklisted = tuple(f for f in raw_features if f.name in reasons)
+        return train_table, blacklisted

@@ -1,0 +1,10 @@
+from .loco import RecordInsightsLOCO, loco_deltas
+from .model_insights import FeatureInsight, ModelInsights, model_insights
+
+__all__ = [
+    "ModelInsights",
+    "FeatureInsight",
+    "model_insights",
+    "RecordInsightsLOCO",
+    "loco_deltas",
+]

@@ -1,0 +1,77 @@
+"""Naive Bayes trainers: count reductions in pure jnp.
+
+Compute core of OpNaiveBayes (reference core/.../impl/classification/OpNaiveBayes.scala,
+wrapping Spark MLlib NaiveBayes — multinomial with additive smoothing, plus a Gaussian
+variant). Fit is a handful of one-hot matmul reductions (class-conditional sums on the
+MXU, psum'd when rows are sharded); there is no iteration at all.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class NaiveBayesParams(NamedTuple):
+    """log_prior [C]; multinomial: log_theta [C, D]; gaussian: mean/var [C, D]."""
+
+    log_prior: jnp.ndarray
+    log_theta: jnp.ndarray
+    mean: jnp.ndarray
+    var: jnp.ndarray
+
+
+@partial(jax.jit, static_argnames=("num_classes", "model_type"))
+def fit_naive_bayes(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    *,
+    num_classes: int = 2,
+    smoothing=1.0,
+    model_type: str = "multinomial",
+) -> NaiveBayesParams:
+    """Multinomial (counts, nonneg features — negatives are clipped to 0, the Spark
+    analog rejects them outright) or Gaussian (per-class feature moments)."""
+    X = jnp.asarray(X, jnp.float32)
+    n, d = X.shape
+    w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+    Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes) * w[:, None]  # [N, C]
+    class_w = Y.sum(0)  # [C]
+    log_prior = jnp.log(jnp.clip(class_w, 1e-12, None) / jnp.clip(class_w.sum(), 1e-12, None))
+    if model_type == "multinomial":
+        Xc = jnp.clip(X, 0.0, None)
+        counts = Y.T @ Xc  # [C, D] class-conditional feature mass
+        sm = jnp.asarray(smoothing, jnp.float32)
+        log_theta = jnp.log(counts + sm) - jnp.log(
+            (counts.sum(1, keepdims=True) + sm * d)
+        )
+        zeros = jnp.zeros((num_classes, d), jnp.float32)
+        return NaiveBayesParams(log_prior, log_theta, zeros, zeros)
+    if model_type == "gaussian":
+        denom = jnp.clip(class_w, 1e-12, None)[:, None]
+        mean = Y.T @ X / denom
+        ex2 = Y.T @ (X ** 2) / denom
+        var = jnp.clip(ex2 - mean ** 2, 1e-6, None) + jnp.asarray(smoothing, jnp.float32) * 1e-9
+        zeros = jnp.zeros((num_classes, d), jnp.float32)
+        return NaiveBayesParams(log_prior, zeros, mean, var)
+    raise ValueError(f"unknown model_type {model_type!r}")  # pragma: no cover
+
+
+@partial(jax.jit, static_argnames=("model_type",))
+def predict_naive_bayes(params: NaiveBayesParams, X: jnp.ndarray,
+                        model_type: str = "multinomial"):
+    X = jnp.asarray(X, jnp.float32)
+    if model_type == "multinomial":
+        logp = jnp.clip(X, 0.0, None) @ params.log_theta.T + params.log_prior[None, :]
+    else:
+        diff = X[:, None, :] - params.mean[None, :, :]
+        logp = (
+            -0.5 * (diff ** 2 / params.var[None, :, :]).sum(-1)
+            - 0.5 * jnp.log(2 * jnp.pi * params.var).sum(-1)[None, :]
+            + params.log_prior[None, :]
+        )
+    prob = jax.nn.softmax(logp, axis=1)
+    return jnp.argmax(logp, axis=1).astype(jnp.float32), logp, prob

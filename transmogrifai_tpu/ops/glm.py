@@ -1,0 +1,132 @@
+"""Generalized linear models (gaussian / poisson / gamma / binomial, IRLS) and
+isotonic regression (pool-adjacent-violators).
+
+Compute cores of OpGeneralizedLinearRegression (reference core/.../impl/regression/
+OpGeneralizedLinearRegression.scala wrapping Spark GLR, families+links per MLlib) and
+IsotonicRegressionCalibrator (core/.../impl/regression/IsotonicRegressionCalibrator.scala).
+IRLS is a fixed-iteration Newton scheme: each step is one weighted X^T X matmul + DxD
+solve — MXU work with psum-able partials. PAV is inherently sequential, so isotonic
+fitting runs host-side (numpy) exactly once at fit time; prediction is a device
+searchsorted/interp.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .linear import LinearParams
+
+_FAMILIES = ("gaussian", "poisson", "gamma", "binomial")
+
+
+@partial(jax.jit, static_argnames=("family", "max_iter"))
+def fit_glm(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    *,
+    family: str = "gaussian",
+    l2=0.0,
+    max_iter: int = 25,
+) -> LinearParams:
+    """IRLS with canonical-ish links: gaussian=identity, poisson/gamma=log,
+    binomial=logit. Fixed iteration count -> one compiled program across folds/grids."""
+    if family not in _FAMILIES:
+        raise ValueError(f"unknown family {family!r}; one of {_FAMILIES}")
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    n, d = X.shape
+    w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+    Xa = jnp.concatenate([X, jnp.ones((n, 1), jnp.float32)], axis=1)
+    lam = jnp.asarray(l2, jnp.float32)
+    reg_eye = jnp.eye(d + 1).at[-1, -1].set(0.0)
+
+    def irls_step(theta, _):
+        eta = Xa @ theta
+        if family == "gaussian":
+            mu, dmu, var = eta, jnp.ones_like(eta), jnp.ones_like(eta)
+        elif family == "poisson":
+            mu = jnp.exp(jnp.clip(eta, -30.0, 30.0))
+            dmu, var = mu, jnp.clip(mu, 1e-6, None)
+        elif family == "gamma":
+            mu = jnp.exp(jnp.clip(eta, -30.0, 30.0))
+            dmu, var = mu, jnp.clip(mu ** 2, 1e-6, None)
+        else:  # binomial
+            mu = jax.nn.sigmoid(eta)
+            dmu = jnp.clip(mu * (1 - mu), 1e-6, None)
+            var = dmu
+        # working response and weights (standard IRLS)
+        z = eta + (y - mu) / jnp.clip(dmu, 1e-6, None)
+        ww = w * dmu ** 2 / jnp.clip(var, 1e-6, None)
+        A = (Xa.T * ww) @ Xa / jnp.clip(ww.sum(), 1e-6, None) + lam * reg_eye
+        A = A + 1e-6 * jnp.eye(d + 1)
+        g = (Xa.T * ww) @ z / jnp.clip(ww.sum(), 1e-6, None)
+        theta_new = jax.scipy.linalg.solve(A, g, assume_a="pos")
+        return theta_new, None
+
+    theta0 = jnp.zeros(d + 1, jnp.float32)
+    theta, _ = jax.lax.scan(irls_step, theta0, None, length=max_iter)
+    return LinearParams(w=theta[:-1], b=theta[-1])
+
+
+@partial(jax.jit, static_argnames=("family",))
+def predict_glm(params: LinearParams, X: jnp.ndarray, family: str = "gaussian"):
+    eta = jnp.asarray(X, jnp.float32) @ params.w + params.b
+    if family == "gaussian":
+        mu = eta
+    elif family in ("poisson", "gamma"):
+        mu = jnp.exp(jnp.clip(eta, -30.0, 30.0))
+    else:
+        mu = jax.nn.sigmoid(eta)
+    return mu, mu[:, None], mu[:, None]
+
+
+# --- isotonic regression ---------------------------------------------------------------
+def fit_isotonic(x: np.ndarray, y: np.ndarray,
+                 sample_weight: Optional[np.ndarray] = None,
+                 increasing: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """Pool-adjacent-violators on the host -> (boundaries, values) knots.
+    Sequential by nature (the reference runs Spark's parallel-PAV variant); at
+    calibration scale (one scalar feature) the host pass is negligible."""
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    w = np.ones_like(y) if sample_weight is None else np.asarray(sample_weight, np.float64)
+    order = np.argsort(x, kind="stable")
+    xs, ys, ws = x[order], y[order], w[order]
+    if not increasing:
+        ys = -ys
+    # pooled blocks: (weighted sum, weight, x-min, x-max)
+    vals: list[float] = []
+    wts: list[float] = []
+    lo: list[float] = []
+    hi: list[float] = []
+    for xi, yi, wi in zip(xs, ys, ws):
+        vals.append(yi * wi)
+        wts.append(wi)
+        lo.append(xi)
+        hi.append(xi)
+        while len(vals) > 1 and vals[-2] / wts[-2] >= vals[-1] / wts[-1]:
+            v, ww = vals.pop(), wts.pop()
+            h = hi.pop()
+            lo.pop()
+            vals[-1] += v
+            wts[-1] += ww
+            hi[-1] = h
+    knots_x = []
+    knots_y = []
+    for v, ww, l, h in zip(vals, wts, lo, hi):
+        mean = v / ww if increasing else -v / ww
+        knots_x.extend([l, h] if l != h else [l])
+        knots_y.extend([mean, mean] if l != h else [mean])
+    return np.asarray(knots_x, np.float32), np.asarray(knots_y, np.float32)
+
+
+@jax.jit
+def predict_isotonic(boundaries: jnp.ndarray, values: jnp.ndarray, x: jnp.ndarray):
+    """Linear interpolation between isotonic knots (Spark IsotonicRegressionModel
+    semantics), clamped at the ends."""
+    return jnp.interp(jnp.asarray(x, jnp.float32), boundaries, values)

@@ -83,6 +83,17 @@ class PredictorEstimator(Estimator):
         return y, X
 
 
+class ClassifierEstimator(PredictorEstimator):
+    """Predictor base with num_classes inference: 0 in the ctor means 'derive from the
+    labels at fit time' (the ModelSelector injects the real count for multiclass)."""
+
+    def fit_columns(self, cols: Sequence[Column]):
+        y, X = self.label_and_matrix(cols)
+        kw = self.fit_kwargs()
+        kw["num_classes"] = kw["num_classes"] or max(int(np.asarray(y).max()) + 1, 2)
+        return self.make_model(self.fit_fn(X, y, **kw))
+
+
 class PredictionModel(Transformer):
     """Base for fitted models."""
 

@@ -1,0 +1,196 @@
+"""Remaining model-zoo stages: NaiveBayes, MLP classifier, GLM, isotonic calibrator.
+
+Analogs of OpNaiveBayes.scala, OpMultilayerPerceptronClassifier.scala,
+OpGeneralizedLinearRegression.scala and IsotonicRegressionCalibrator.scala (reference
+core/.../impl/classification|regression/), over the jnp cores in ops/bayes.py,
+ops/mlp.py, ops/glm.py.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.bayes import NaiveBayesParams, fit_naive_bayes, predict_naive_bayes
+from ...ops.glm import fit_glm, fit_isotonic, predict_glm, predict_isotonic
+from ...ops.linear import LinearParams
+from ...ops.mlp import fit_mlp, predict_mlp
+from ...types import Column, kind_of
+from ..base import Estimator, Transformer, register_stage
+from .base import ClassifierEstimator, PredictionModel, PredictorEstimator
+
+
+@register_stage
+class NaiveBayes(ClassifierEstimator):
+    """Multinomial (default, as Spark's) or Gaussian naive Bayes; fit is a single
+    one-hot matmul reduction — no iteration."""
+
+    operation_name = "naiveBayes"
+    vmap_params = ("smoothing",)
+
+    def __init__(self, num_classes: int = 0, smoothing: float = 1.0,
+                 model_type: str = "multinomial"):
+        super().__init__(num_classes=int(num_classes), smoothing=float(smoothing),
+                         model_type=model_type)
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, num_classes=0, **kw):
+        return fit_naive_bayes(X, y, sample_weight,
+                               num_classes=max(int(num_classes), 2), **kw)
+
+    # instance-bound so the ModelSelector's `template.predict_fn(params, X)` call
+    # scores with the configured model form
+    def predict_fn(self, params, X):
+        return predict_naive_bayes(params, X, model_type=self.params["model_type"])
+
+    def make_model(self, params: NaiveBayesParams):
+        return NaiveBayesModel(
+            log_prior=np.asarray(params.log_prior).tolist(),
+            log_theta=np.asarray(params.log_theta).tolist(),
+            mean=np.asarray(params.mean).tolist(),
+            var=np.asarray(params.var).tolist(),
+            model_type=self.params["model_type"],
+        )
+
+
+@register_stage
+class NaiveBayesModel(PredictionModel):
+    operation_name = "naiveBayes"
+
+    def predict(self, X):
+        p = self.params
+        params = NaiveBayesParams(
+            jnp.asarray(p["log_prior"], jnp.float32),
+            jnp.asarray(p["log_theta"], jnp.float32),
+            jnp.asarray(p["mean"], jnp.float32),
+            jnp.asarray(p["var"], jnp.float32),
+        )
+        return predict_naive_bayes(params, X, model_type=p["model_type"])
+
+
+@register_stage
+class MLPClassifier(ClassifierEstimator):
+    """Feed-forward softmax classifier (OpMultilayerPerceptronClassifier analog);
+    hidden layer widths are static shapes, training is fixed-step full-batch Adam."""
+
+    operation_name = "mlpClassifier"
+    vmap_params = ("lr", "l2")
+
+    def __init__(self, num_classes: int = 0, hidden: Sequence[int] = (10,),
+                 max_iter: int = 200, lr: float = 0.01, l2: float = 0.0,
+                 seed: int = 0):
+        super().__init__(num_classes=int(num_classes),
+                         hidden=[int(h) for h in hidden], max_iter=int(max_iter),
+                         lr=float(lr), l2=float(l2), seed=int(seed))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, num_classes=0, hidden=(10,), **kw):
+        return fit_mlp(X, y, sample_weight, num_classes=max(int(num_classes), 2),
+                       hidden=tuple(int(h) for h in hidden), **kw)
+
+    predict_fn = staticmethod(predict_mlp)
+
+    def make_model(self, params):
+        return MLPClassifierModel(
+            layers=[[np.asarray(W).tolist(), np.asarray(b).tolist()]
+                    for W, b in params])
+
+
+@register_stage
+class MLPClassifierModel(PredictionModel):
+    operation_name = "mlpClassifier"
+
+    def predict(self, X):
+        params = [
+            (jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
+            for W, b in self.params["layers"]
+        ]
+        return predict_mlp(params, X)
+
+
+@register_stage
+class GeneralizedLinearRegression(PredictorEstimator):
+    """GLM via fixed-iteration IRLS: gaussian / poisson / gamma / binomial
+    (OpGeneralizedLinearRegression analog)."""
+
+    operation_name = "glm"
+    vmap_params = ("l2",)
+
+    def __init__(self, family: str = "gaussian", l2: float = 0.0, max_iter: int = 25):
+        super().__init__(family=family, l2=float(l2), max_iter=int(max_iter))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, **kw):
+        return fit_glm(X, y, sample_weight, **kw)
+
+    def predict_fn(self, params, X):
+        # instance-bound: CV scoring must apply the configured link, not the default
+        return predict_glm(params, X, family=self.params["family"])
+
+    def make_model(self, params: LinearParams):
+        return GeneralizedLinearRegressionModel(
+            w=np.asarray(params.w).tolist(), b=float(params.b),
+            family=self.params["family"])
+
+
+@register_stage
+class GeneralizedLinearRegressionModel(PredictionModel):
+    operation_name = "glm"
+
+    def predict(self, X):
+        params = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
+                              jnp.asarray(self.params["b"], jnp.float32))
+        return predict_glm(params, X, family=self.params["family"])
+
+
+@register_stage
+class IsotonicRegressionCalibrator(Estimator):
+    """Estimator `(label RealNN, score RealNN) -> RealNN`: monotone recalibration of
+    scores against observed labels (IsotonicRegressionCalibrator.scala analog; PAV on
+    the host at fit, device interp at transform)."""
+
+    operation_name = "isotonicCalibrator"
+    arity = (2, 2)
+
+    def __init__(self, increasing: bool = True):
+        super().__init__(increasing=bool(increasing))
+
+    def out_kind(self, in_kinds):
+        for k in in_kinds:
+            if k.name not in ("RealNN", "Real", "Binary"):
+                raise TypeError(f"IsotonicRegressionCalibrator needs numeric inputs, got {k.name}")
+        return kind_of("RealNN")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def fit_columns(self, cols: Sequence[Column]) -> Transformer:
+        y = np.asarray(cols[0].filled(0.0), np.float64)
+        x = np.asarray(cols[1].filled(0.0), np.float64)
+        bounds, values = fit_isotonic(x, y, increasing=self.params["increasing"])
+        return IsotonicRegressionCalibratorModel(
+            boundaries=bounds.tolist(), values=values.tolist())
+
+
+@register_stage
+class IsotonicRegressionCalibratorModel(Transformer):
+    operation_name = "isotonicCalibrator"
+    arity = (2, 2)
+    device_op = True
+
+    def __init__(self, boundaries: Sequence[float] = (), values: Sequence[float] = ()):
+        super().__init__(boundaries=list(boundaries), values=list(values))
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        x = cols[1].filled(0.0)
+        out = predict_isotonic(
+            jnp.asarray(self.params["boundaries"], jnp.float32),
+            jnp.asarray(self.params["values"], jnp.float32), x)
+        return Column.real(out, kind="RealNN")

@@ -26,7 +26,7 @@ from ...ops.trees import (
 )
 from ...select.grids import ParamGridBuilder
 from ..base import register_stage
-from .base import PredictionModel, PredictorEstimator
+from .base import ClassifierEstimator, PredictionModel, PredictorEstimator
 
 
 def _ensemble_params(stage_params: dict) -> TreeEnsembleParams:
@@ -58,18 +58,8 @@ class _TreeModelBase(PredictionModel):
         return cached
 
 
-class _TreeClassifierBase(PredictorEstimator):
-    """Shared num_classes inference (0 = infer from labels at fit time)."""
-
-    def fit_columns(self, cols):
-        y, X = self.label_and_matrix(cols)
-        kw = self.fit_kwargs()
-        kw["num_classes"] = kw["num_classes"] or max(int(np.asarray(y).max()) + 1, 2)
-        return self.make_model(self.fit_fn(X, y, **kw))
-
-
 @register_stage
-class RandomForestClassifier(_TreeClassifierBase):
+class RandomForestClassifier(ClassifierEstimator):
     """Bagged histogram trees with class-distribution leaves (binary + multiclass)."""
 
     operation_name = "randomForestClassifier"
@@ -139,7 +129,7 @@ class RandomForestRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class DecisionTreeClassifier(_TreeClassifierBase):
+class DecisionTreeClassifier(ClassifierEstimator):
     """Single un-bagged tree (n_trees=1, no bootstrap) — OpDecisionTreeClassifier."""
 
     operation_name = "decisionTreeClassifier"
@@ -277,7 +267,7 @@ class GBTRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class XGBoostClassifier(_TreeClassifierBase):
+class XGBoostClassifier(ClassifierEstimator):
     """Second-order boosting with XGBoost-style defaults; multiclass via one
     multi-output softmax tree per round (TPU-friendly multi_strategy, no per-class
     tree loops). Analog of OpXGBoostClassifier.scala:48."""

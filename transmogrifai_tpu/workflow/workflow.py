@@ -134,6 +134,41 @@ class Workflow(WorkflowCore):
         self._raw_filter = raw_filter
         return self
 
+    def _apply_blacklist(self, blacklisted: Sequence[Feature]) -> None:
+        """Surgically remove blacklisted raw features from the DAG (the reference's
+        setBlacklist, OpWorkflow.scala:108-135): variadic stages simply lose the
+        input; fixed-arity stages that depend on a blacklisted feature are dropped
+        and their outputs cascade. A result feature that becomes unreachable is an
+        error, as in the reference."""
+        bl_ids = {id(f) for f in blacklisted}
+        trims: list[tuple[Stage, tuple[Feature, ...]]] = []  # planned, applied below
+        for layer in self._dag:  # layers run earliest-first, so cascades propagate
+            for stage in layer:
+                if not any(id(p) in bl_ids for p in stage.inputs):
+                    continue
+                kept = tuple(p for p in stage.inputs if id(p) not in bl_ids)
+                lo, hi = stage.arity
+                if len(kept) < max(lo, 1) or (hi == lo and len(kept) != lo):
+                    bl_ids.add(id(stage.get_output()))  # cascade the drop
+                else:
+                    trims.append((stage, kept))
+        # validate reachability BEFORE mutating anything, so a failed train() leaves
+        # the workflow graph intact for a retry with a relaxed filter
+        for rf in self.result_features:
+            if id(rf) in bl_ids:
+                raise ValueError(
+                    f"result feature {rf.name!r} depends on blacklisted raw features "
+                    "and cannot be computed — protect them or relax the filter"
+                )
+        for stage, kept in trims:
+            stage.inputs = kept
+            stage.get_output().parents = kept
+        self.raw_features = tuple(
+            f for f in self.raw_features if id(f) not in bl_ids
+        )
+        self._dag = compute_dag(self.result_features)
+        validate_dag(self._dag)
+
     def train(self, table: Optional[Table] = None) -> "WorkflowModel":
         """Fit all estimator stages layer by layer; bulk-apply transformers between fit
         points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG)."""
@@ -145,6 +180,8 @@ class Workflow(WorkflowCore):
         blacklisted: tuple[Feature, ...] = ()
         if self._raw_filter is not None:
             data, blacklisted = self._raw_filter.filter_raw(self.raw_features, data)
+            if blacklisted:
+                self._apply_blacklist(blacklisted)
         fitted_stages: list[Transformer] = []
         for layer in self._dag:
             estimators, device_tf, host_tf = split_layer_by_kind(layer)
