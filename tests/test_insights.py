@@ -1,0 +1,67 @@
+"""ModelInsights report tests (mirror of reference ModelInsightsTest.scala)."""
+import numpy as np
+
+from transmogrifai_tpu.check import SanityChecker
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.insights import ModelInsights, model_insights
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import BinaryClassificationModelSelector, ParamGridBuilder
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _train(with_selector: bool):
+    fs = features_from_schema(
+        {"label": "RealNN", "a": "Real", "b": "Real", "cat": "PickList"},
+        response="label")
+    vec = transmogrify([fs["a"], fs["b"], fs["cat"]])
+    checked = SanityChecker(min_variance=1e-9)(fs["label"], vec)
+    if with_selector:
+        grid = ParamGridBuilder().add("l2", [0.0, 0.1]).build()
+        est = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), grid)])
+    else:
+        est = LogisticRegression()
+    pred = est(fs["label"], checked)
+    rng = np.random.default_rng(3)
+    rows = [{"label": float(i % 2), "a": float(i % 2) * 2 + rng.normal(),
+             "b": float(rng.normal()), "cat": "uv"[i % 2]} for i in range(80)]
+    wf = Workflow().set_reader(InMemoryReader(rows)).set_result_features(pred)
+    return wf.train(), pred
+
+
+class TestModelInsights:
+    def test_report_with_selector(self):
+        model, pred = _train(with_selector=True)
+        rep = model.model_insights(pred)
+        assert isinstance(rep, ModelInsights)
+        assert rep.label_name == "label"
+        assert rep.problem_type == "binary"
+        assert rep.selected_model["best_model_name"]
+        assert rep.selected_model["models_evaluated"] >= 2
+        # sanity checker stats present and slots grouped under raw features
+        assert rep.sanity_checker is not None
+        names = {f.feature_name for f in rep.features}
+        assert {"a", "b", "cat"} <= names
+        # informative feature 'a' should carry a contribution
+        a = next(f for f in rep.features if f.feature_name == "a")
+        assert a.max_contribution is not None
+
+    def test_report_plain_model_and_json(self, tmp_path):
+        model, pred = _train(with_selector=False)
+        rep = model_insights(model, pred)
+        assert rep.selected_model is None
+        assert rep.features  # stats still present from the checker
+        p = tmp_path / "insights.json"
+        rep.write(str(p))
+        import json
+
+        loaded = json.loads(p.read_text())
+        assert loaded["label"]["name"] == "label"
+        assert loaded["features"]
+
+    def test_pretty_prints(self):
+        model, pred = _train(with_selector=True)
+        text = model.summary_pretty(pred)
+        assert "Selected model" in text and "label" in text

@@ -152,3 +152,12 @@ def test_selector_scores_naive_bayes_with_configured_form(rng):
     sel.fit_table(table)
     best = sel.summary_.validation_results[0]
     assert best.metric_mean > 0.9  # gaussian form separates; multinomial would not
+
+
+def test_isotonic_ties_are_averaged():
+    # tied x values must pool to their mean before PAV (Spark semantics)
+    x = np.array([0.0, 0.0, 1.0], np.float32)
+    y = np.array([0.0, 1.0, 1.0], np.float32)
+    bounds, values = fit_isotonic(x, y)
+    out = np.asarray(predict_isotonic(bounds, values, np.array([0.0], np.float32)))
+    assert abs(out[0] - 0.5) < 1e-6

@@ -1,0 +1,67 @@
+"""Serving parity tests (mirror of reference local/ suites: scoreFunction output must
+match workflow scoring)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.types import Table
+from transmogrifai_tpu.workflow import Workflow
+
+KINDS = {"label": "RealNN", "a": "Real", "cat": "PickList", "t": "Text"}
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    fs = features_from_schema(KINDS, response="label")
+    vec = transmogrify([fs["a"], fs["cat"], fs["t"]])
+    pred = LogisticRegression(l2=0.01)(fs["label"], vec)
+    rng = np.random.default_rng(5)
+    rows = [{"label": float(i % 2), "a": float(i % 2) + rng.normal(0, 0.1),
+             "cat": "ab"[i % 2], "t": f"tok{i % 3} hello"} for i in range(60)]
+    model = Workflow().set_reader(InMemoryReader(rows)).set_result_features(pred).train()
+    return model, pred, rows
+
+
+class TestScoreFunction:
+    def test_single_record_matches_batch_scoring(self, fitted):
+        model, pred, rows = fitted
+        fn = model.score_fn()
+        serving = [{k: v for k, v in r.items() if k != "label"} for r in rows[:8]]
+        singles = [fn(r) for r in serving]
+        # parity vs the workflow's own scoring path
+        t = Table.from_rows(rows[:8], KINDS)
+        expected = model.score(table=t)[pred.name].to_list()
+        for got, exp in zip(singles, expected):
+            assert got[pred.name]["prediction"] == exp["prediction"]
+            np.testing.assert_allclose(got[pred.name]["probability"],
+                                       exp["probability"], rtol=1e-5)
+
+    def test_batch_api(self, fitted):
+        model, pred, rows = fitted
+        fn = model.score_fn()
+        out = fn.batch(rows[:5])
+        assert len(out) == 5
+        assert set(out[0].keys()) == {pred.name}
+
+    def test_missing_predictor_raises(self, fitted):
+        model, pred, _ = fitted
+        fn = model.score_fn()
+        with pytest.raises(KeyError, match="missing predictor"):
+            fn({"a": 1.0})
+
+    def test_pad_to_buckets(self, fitted):
+        model, pred, rows = fitted
+        fn = model.score_fn(pad_to=[8, 64])
+        out = fn.batch(rows[:3])  # padded to 8 internally, 3 returned
+        assert len(out) == 3
+        ref = model.score_fn().batch(rows[:3])
+        for a, b in zip(out, ref):
+            np.testing.assert_allclose(a[pred.name]["probability"],
+                                       b[pred.name]["probability"], rtol=1e-5)
+
+    def test_empty_batch(self, fitted):
+        model, _, _ = fitted
+        assert model.score_fn().batch([]) == []

@@ -10,4 +10,9 @@ __version__ = "0.1.0"
 from . import types
 from .types import Column, Table, VectorSchema
 
-__all__ = ["types", "Column", "Table", "VectorSchema", "__version__"]
+# attaches the feature-algebra methods/operators onto Feature (dsl enrichments)
+from . import dsl  # noqa: E402  (import for side effect)
+from .dsl import transmogrify
+
+__all__ = ["types", "Column", "Table", "VectorSchema", "transmogrify", "dsl",
+           "__version__"]

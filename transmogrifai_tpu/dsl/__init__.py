@@ -1,0 +1,194 @@
+"""Feature algebra: rich methods and operators on Feature.
+
+TPU-native analog of the reference dsl layer (core/src/main/scala/com/salesforce/op/dsl/:
+RichNumericFeature.scala:70-228,247,263-288,315,377,469; RichTextFeature.scala:58-747;
+RichFeature.scala:61-215; RichFeaturesCollection.scala:69). Scala implicit enrichments
+become methods attached to `Feature` at import time — `import transmogrifai_tpu` is all
+the user needs for `f1 + f2`, `f.tokenize()`, `transmogrify([...])` to work.
+
+Every method follows the reference's one-shortcut-per-stage convention: it instantiates
+the corresponding stage and wires this feature (plus any others) as inputs, returning
+the new output Feature.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+from ..graph.feature import Feature
+from ..stages.base import LambdaTransformer, Stage
+from ..stages.feature.categorical import IndexToString, OneHotVectorizer, StringIndexer
+from ..stages.feature.date import DateToUnitCircleVectorizer
+from ..stages.feature.math import (
+    BinaryMathTransformer,
+    ScalarMathTransformer,
+    UnaryMathTransformer,
+)
+from ..stages.feature.misc import AliasTransformer, ToOccurTransformer
+from ..stages.feature.numeric import (
+    FillMissingWithMean,
+    NumericBucketizer,
+    StandardScaler,
+)
+from ..stages.feature.text import (
+    HashingVectorizer,
+    SmartTextVectorizer,
+    TextLenTransformer,
+    TextTokenizer,
+)
+from ..stages.feature.transmogrify import DEFAULTS, transmogrify
+
+
+def _binary_op(op: str):
+    def method(self: Feature, other):
+        if isinstance(other, Feature):
+            return BinaryMathTransformer(op)(self, other)
+        if not isinstance(other, (int, float)):
+            return NotImplemented  # let Python try the other operand's reflected op
+        return ScalarMathTransformer(op, float(other))(self)
+
+    return method
+
+
+def _reverse_op(op: str):
+    def method(self: Feature, other):
+        # other is always a scalar here: Feature.op(Feature) resolves via _binary_op
+        if not isinstance(other, (int, float)):
+            return NotImplemented
+        return ScalarMathTransformer(op, float(other), reverse=True)(self)
+
+    return method
+
+
+# --- generic enrichments (RichFeature.scala:61-215) ---------------------------------------
+def alias(self: Feature, name: str) -> Feature:
+    return AliasTransformer(name)(self)
+
+
+def occurs(self: Feature, match_fn: Optional[Callable] = None) -> Feature:
+    return ToOccurTransformer(match_fn)(self)
+
+
+def map_via(self: Feature, fn: Callable, out_kind: str, *, device_op: bool = False,
+            fn_name: Optional[str] = None) -> Feature:
+    """Ad-hoc unary transform (reference `map`); fn: Column -> Column."""
+    return LambdaTransformer(fn, out_kind, device_op=device_op, n_inputs=1,
+                             fn_name=fn_name)(self)
+
+
+def transform_with(self: Feature, stage: Stage, *others: Feature) -> Feature:
+    """Apply an explicit stage instance to this feature (+ any extra inputs)
+    (reference `transformWith`)."""
+    return stage(self, *others)
+
+
+# --- numeric enrichments (RichNumericFeature.scala) ---------------------------------------
+def fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
+    return FillMissingWithMean(default=default)(self)
+
+
+def bucketize(self: Feature, splits: Sequence[float],
+              bucket_labels: Optional[Sequence[str]] = None,
+              track_nulls: bool = True, track_invalid: bool = False) -> Feature:
+    return NumericBucketizer(splits, bucket_labels=bucket_labels,
+                             track_nulls=track_nulls, track_invalid=track_invalid)(self)
+
+
+def auto_bucketize(self: Feature, label: Feature, track_nulls: bool = True,
+                   max_splits: int = 16, min_info_gain: float = 0.01) -> Feature:
+    """Label-aware decision-tree bucketization (reference
+    DecisionTreeNumericBucketizer.scala; dsl autoBucketize)."""
+    from ..stages.feature.calibration import DecisionTreeNumericBucketizer
+
+    return DecisionTreeNumericBucketizer(
+        track_nulls=track_nulls, max_splits=max_splits, min_info_gain=min_info_gain
+    )(label, self)
+
+
+def z_normalize(self: Feature, with_mean: bool = True, with_std: bool = True) -> Feature:
+    return StandardScaler(with_mean=with_mean, with_std=with_std)(self)
+
+
+def vectorize_feature(self: Feature, **overrides) -> Feature:
+    """Default per-kind vectorization of a single feature (dsl `vectorize`)."""
+    return transmogrify([self], **overrides)
+
+
+def sanity_check(self: Feature, label: Feature, **params) -> Feature:
+    """Feature-vector validation against the label (dsl sanityCheck
+    RichNumericFeature.scala:469). self must be an OPVector feature."""
+    from ..check.sanity_checker import SanityChecker
+
+    return SanityChecker(**params)(label, self)
+
+
+# --- text enrichments (RichTextFeature.scala) ---------------------------------------------
+def tokenize_feature(self: Feature, to_lower: bool = True, min_token_len: int = 1) -> Feature:
+    return TextTokenizer(to_lower=to_lower, min_token_len=min_token_len)(self)
+
+
+def pivot(self: Feature, top_k: int = DEFAULTS.top_k,
+          min_support: int = DEFAULTS.min_support, clean_text: bool = True,
+          track_nulls: bool = True) -> Feature:
+    return OneHotVectorizer(top_k=top_k, min_support=min_support, clean_text=clean_text,
+                            track_nulls=track_nulls)(self)
+
+
+def smart_vectorize(self: Feature, *others: Feature, **params) -> Feature:
+    return SmartTextVectorizer(**params)(self, *others)
+
+
+def index_string(self: Feature, handle_invalid: str = "error") -> Feature:
+    return StringIndexer(handle_invalid=handle_invalid)(self)
+
+
+def text_len(self: Feature, *others: Feature) -> Feature:
+    return TextLenTransformer()(self, *others)
+
+
+def hash_vectorize(self: Feature, *others: Feature, **params) -> Feature:
+    return HashingVectorizer(**params)(self, *others)
+
+
+# --- date enrichments (RichDateFeature.scala) ---------------------------------------------
+def to_unit_circle(self: Feature, time_periods: Optional[Sequence[str]] = None) -> Feature:
+    kw = {} if time_periods is None else {"time_periods": tuple(time_periods)}
+    return DateToUnitCircleVectorizer(**kw)(self)
+
+
+def _attach() -> None:
+    Feature.__add__ = _binary_op("+")
+    Feature.__sub__ = _binary_op("-")
+    Feature.__mul__ = _binary_op("*")
+    Feature.__truediv__ = _binary_op("/")
+    Feature.__radd__ = _reverse_op("+")
+    Feature.__rsub__ = _reverse_op("-")
+    Feature.__rmul__ = _reverse_op("*")
+    Feature.__rtruediv__ = _reverse_op("/")
+    Feature.__pow__ = lambda self, s: ScalarMathTransformer("**", float(s))(self)
+    Feature.__rpow__ = _reverse_op("**")
+    Feature.__neg__ = lambda self: UnaryMathTransformer("negate")(self)
+    Feature.__abs__ = lambda self: UnaryMathTransformer("abs")(self)
+    for fn in ("log", "sqrt", "exp", "floor", "ceil", "sigmoid"):
+        setattr(Feature, fn, (lambda name: lambda self: UnaryMathTransformer(name)(self))(fn))
+    Feature.alias = alias
+    Feature.occurs = occurs
+    Feature.map_via = map_via
+    Feature.transform_with = transform_with
+    Feature.fill_missing_with_mean = fill_missing_with_mean
+    Feature.bucketize = bucketize
+    Feature.auto_bucketize = auto_bucketize
+    Feature.z_normalize = z_normalize
+    Feature.vectorize = vectorize_feature
+    Feature.sanity_check = sanity_check
+    Feature.tokenize = tokenize_feature
+    Feature.pivot = pivot
+    Feature.smart_vectorize = smart_vectorize
+    Feature.index_string = index_string
+    Feature.text_len = text_len
+    Feature.hash_vectorize = hash_vectorize
+    Feature.to_unit_circle = to_unit_circle
+
+
+_attach()
+
+__all__ = ["transmogrify"]

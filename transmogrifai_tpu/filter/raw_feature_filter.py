@@ -34,6 +34,15 @@ from ..types.table import Table
 _EPS = 1e-12
 
 
+def _stable_hash(s: str) -> int:
+    """Process-independent string hash: persisted FeatureDistribution buckets must be
+    comparable across runs (python hash() is salted per process; the reference uses
+    MurmurHash3 for the same reason)."""
+    import zlib
+
+    return zlib.crc32(s.encode("utf-8"))
+
+
 def _js_divergence(p: np.ndarray, q: np.ndarray) -> float:
     """Jensen-Shannon divergence (log base 2 -> [0, 1]) between two count vectors."""
     p = p / max(p.sum(), _EPS)
@@ -150,11 +159,11 @@ class RawFeatureFilter:
                 if not m:
                     continue
                 if st is Storage.TEXT:
-                    idx.append(hash(v) % self.bins)
+                    idx.append(_stable_hash(v) % self.bins)
                 elif st is Storage.MAP:
-                    idx.extend(hash(k) % self.bins for k in v)
+                    idx.extend(_stable_hash(k) % self.bins for k in v)
                 else:
-                    idx.extend(hash(t) % self.bins for t in v)
+                    idx.extend(_stable_hash(t) % self.bins for t in v)
             if idx:
                 hist = np.bincount(np.asarray(idx), minlength=self.bins).astype(np.float64)
         # other storages (vector/geolocation/prediction): fill rate only

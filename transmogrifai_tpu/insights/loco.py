@@ -29,14 +29,21 @@ def loco_deltas(predict_fn, X: jnp.ndarray, slot_batch: int = 0) -> jnp.ndarray:
     bound memory at [slot_batch, N, D]."""
     X = jnp.asarray(X, jnp.float32)
     n, d = X.shape
-    _, _, base_prob = predict_fn(X)
+    base_pred, _, base_prob = predict_fn(X)
     c = base_prob.shape[1]
-    score_col = 1 if c == 2 else 0  # binary: positive-class prob; else first output
+    if c == 1:
+        score_col = jnp.zeros(n, jnp.int32)  # regression: the value
+    elif c == 2:
+        score_col = jnp.ones(n, jnp.int32)  # binary: positive-class prob
+    else:
+        # multiclass: each row's delta is on ITS predicted class's probability
+        score_col = jnp.asarray(base_pred, jnp.int32)
+    rows = jnp.arange(n)
 
     def masked_score(slot):
         Xm = X * (1.0 - jax.nn.one_hot(slot, d)[None, :])
         _, _, prob = predict_fn(Xm)
-        return prob[:, score_col]
+        return prob[rows, score_col]
 
     slots = jnp.arange(d)
     if slot_batch and slot_batch < d:
@@ -47,7 +54,7 @@ def loco_deltas(predict_fn, X: jnp.ndarray, slot_batch: int = 0) -> jnp.ndarray:
         masked = jnp.concatenate(chunks, axis=0)  # [D, N]
     else:
         masked = jax.vmap(masked_score)(slots)
-    return base_prob[:, score_col][:, None] - masked.T  # [N, D]
+    return base_prob[rows, score_col][:, None] - masked.T  # [N, D]
 
 
 @register_stage

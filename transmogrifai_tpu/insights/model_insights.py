@@ -1,0 +1,219 @@
+"""ModelInsights: the full training report assembled from the fitted DAG.
+
+TPU-native analog of reference ModelInsights (core/src/main/scala/com/salesforce/op/
+ModelInsights.scala:72-391) and OpWorkflowModel.summaryPretty (OpWorkflowModel.scala:
+195-217). The report is assembled by walking the fitted stages the same way the
+reference walks DataFrame metadata: SanityChecker summaries supply per-slot statistics,
+the ModelSelector summary supplies validation history and the winning model, and the
+winner's parameters supply per-slot contributions.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..graph.feature import Feature
+    from ..workflow.workflow import WorkflowModel
+
+
+@dataclass
+class SlotInsight:
+    """One vector slot's derived statistics (analog of the reference's Insights per
+    derived feature)."""
+
+    slot_name: str
+    corr_with_label: Optional[float] = None
+    variance: Optional[float] = None
+    mean: Optional[float] = None
+    cramers_v: Optional[float] = None
+    contribution: Optional[float] = None
+    dropped_reason: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {k: v for k, v in vars(self).items() if v is not None} | {
+            "slot_name": self.slot_name
+        }
+
+
+@dataclass
+class FeatureInsight:
+    """All derived slots of one raw feature (ModelInsights.features entries)."""
+
+    feature_name: str
+    kind: str
+    derived: list[SlotInsight] = field(default_factory=list)
+
+    @property
+    def max_contribution(self) -> Optional[float]:
+        vals = [s.contribution for s in self.derived if s.contribution is not None]
+        return max(vals) if vals else None
+
+    def to_json(self) -> dict:
+        return {
+            "feature_name": self.feature_name,
+            "kind": self.kind,
+            "derived": [s.to_json() for s in self.derived],
+        }
+
+
+@dataclass
+class ModelInsights:
+    label_name: str
+    label_kind: str
+    problem_type: Optional[str] = None
+    features: list[FeatureInsight] = field(default_factory=list)
+    selected_model: Optional[dict] = None       # ModelSelectorSummary.to_json()
+    sanity_checker: Optional[dict] = None       # SanityCheckerSummary.to_json()
+    blacklisted: list[str] = field(default_factory=list)
+    stages: list[dict] = field(default_factory=list)  # uid/op per fitted stage
+
+    def to_json(self) -> dict:
+        return {
+            "label": {"name": self.label_name, "kind": self.label_kind},
+            "problem_type": self.problem_type,
+            "features": [f.to_json() for f in self.features],
+            "selected_model": self.selected_model,
+            "sanity_checker": self.sanity_checker,
+            "blacklisted": list(self.blacklisted),
+            "stages": list(self.stages),
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh, indent=1)
+
+    def pretty(self) -> str:
+        """Human-readable report (analog of ModelInsights.prettyPrint /
+        summaryPretty)."""
+        lines = [f"Label: {self.label_name} ({self.label_kind})"]
+        if self.selected_model:
+            sm = self.selected_model
+            lines.append(
+                f"Selected model: {sm.get('best_model_name')} {sm.get('best_params')}"
+            )
+            lines.append(
+                f"Validation: {sm.get('validation_type')} on {sm.get('metric_name')}, "
+                f"{sm.get('models_evaluated')} models evaluated"
+            )
+            hm = sm.get("holdout_metrics")
+            if hm:
+                metrics = ", ".join(f"{k}={v:.4f}" for k, v in hm.items()
+                                    if isinstance(v, (int, float)))
+                lines.append(f"Holdout: {metrics}")
+        if self.blacklisted:
+            lines.append(f"Blacklisted raw features: {', '.join(self.blacklisted)}")
+        if self.sanity_checker:
+            dropped = self.sanity_checker.get("dropped", [])
+            lines.append(f"SanityChecker dropped {len(dropped)} slots")
+        ranked = sorted(
+            (f for f in self.features if f.max_contribution is not None),
+            key=lambda f: -(f.max_contribution or 0.0),
+        )
+        if ranked:
+            lines.append("Top feature contributions:")
+            for f in ranked[:20]:
+                lines.append(f"  {f.feature_name}: {f.max_contribution:.4f}")
+        return "\n".join(lines)
+
+
+def _slot_parent(slot_name: str, raw_names: list[str]) -> Optional[str]:
+    """Longest raw-feature-name prefix match (slot names are built as
+    '<parent>[_<indicator>]')."""
+    best = None
+    for rn in raw_names:
+        if slot_name == rn or slot_name.startswith(rn + "_"):
+            if best is None or len(rn) > len(best):
+                best = rn
+    return best
+
+
+def _contributions(stage, n_slots: int) -> Optional[np.ndarray]:
+    """Per-slot contribution from a fitted model's parameters: |w| for linear-family
+    models (norm over classes for multiclass), gain-style importances for trees if
+    the stage exposes them."""
+    imp = getattr(stage, "feature_importances_", None)
+    if imp is not None:
+        arr = np.asarray(imp, np.float64).ravel()
+        return arr if arr.size == n_slots else None
+    w = stage.params.get("w") if hasattr(stage, "params") else None
+    if w is None:
+        return None
+    arr = np.abs(np.asarray(w, np.float64))
+    if arr.ndim == 2:  # [C, D] multiclass (LinearParams layout) -> per-slot max
+        arr = arr.max(axis=0)
+    return arr if arr.size == n_slots else None
+
+
+def model_insights(model: "WorkflowModel", feature: "Feature") -> ModelInsights:
+    """Build the report for one result feature of a fitted WorkflowModel
+    (analog of OpWorkflowModel.modelInsights, OpWorkflowModel.scala:163)."""
+    label = next((f for f in model.raw_features if f.is_response), None)
+    report = ModelInsights(
+        label_name=label.name if label else "",
+        label_kind=label.kind.name if label else "",
+        blacklisted=[f.name for f in model.blacklisted],
+        stages=[{"uid": s.uid, "operation": s.operation_name} for s in model.stages],
+    )
+
+    # lineage of the requested feature, restricted to fitted stages
+    lineage_ids = {id(f) for f in feature.all_features()}
+    in_lineage = [s for s in model.stages
+                  if s._output is not None and id(s.get_output()) in lineage_ids]
+
+    selector_summary = None
+    predictor = None
+    for s in in_lineage:
+        summ = getattr(s, "selector_summary", None)
+        if summ is not None:
+            selector_summary = summ
+            predictor = s
+        elif hasattr(s, "predict") and predictor is None:
+            predictor = s
+    if selector_summary is not None:
+        report.selected_model = selector_summary.to_json()
+        report.problem_type = selector_summary.problem_type
+
+    checker_summary = None
+    for s in in_lineage:
+        summ = getattr(s, "summary_", None)
+        if summ is not None and hasattr(summ, "slot_stats"):
+            checker_summary = summ
+    if checker_summary is not None:
+        report.sanity_checker = checker_summary.to_json()
+
+    # per-slot insights: stats from the checker, contributions from the winner
+    raw_names = [f.name for f in model.raw_features if not f.is_response]
+    slots: dict[str, SlotInsight] = {}
+    surviving: list[str] = []
+    if checker_summary is not None:
+        dropped = {d["name"]: d["reason"] for d in checker_summary.dropped}
+        for st in checker_summary.slot_stats:
+            slots[st.name] = SlotInsight(
+                slot_name=st.name,
+                corr_with_label=st.corr_with_label,
+                variance=st.variance,
+                mean=st.mean,
+                cramers_v=st.cramers_v,
+                dropped_reason=dropped.get(st.name),
+            )
+            if st.name not in dropped:
+                surviving.append(st.name)
+    if predictor is not None and surviving:
+        contrib = _contributions(predictor, len(surviving))
+        if contrib is not None:
+            for name, c in zip(surviving, contrib):
+                slots[name].contribution = float(c)
+
+    by_feature: dict[str, FeatureInsight] = {}
+    kind_by_name = {f.name: f.kind.name for f in model.raw_features}
+    for name, insight in slots.items():
+        parent = _slot_parent(name, raw_names) or name
+        fi = by_feature.setdefault(
+            parent, FeatureInsight(parent, kind_by_name.get(parent, "?")))
+        fi.derived.append(insight)
+    report.features = list(by_feature.values())
+    return report

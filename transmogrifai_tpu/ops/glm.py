@@ -97,6 +97,14 @@ def fit_isotonic(x: np.ndarray, y: np.ndarray,
     w = np.ones_like(y) if sample_weight is None else np.asarray(sample_weight, np.float64)
     order = np.argsort(x, kind="stable")
     xs, ys, ws = x[order], y[order], w[order]
+    # pre-pool tied x values to their weighted mean (Spark averages ties before PAV;
+    # without this, tied inputs produce duplicate knots and predict the max label)
+    ux, inv = np.unique(xs, return_inverse=True)
+    if len(ux) < len(xs):
+        wsum = np.bincount(inv, weights=ws)
+        ysum = np.bincount(inv, weights=ys * ws)
+        xs, ws = ux, wsum
+        ys = ysum / wsum
     if not increasing:
         ys = -ys
     # pooled blocks: (weighted sum, weight, x-min, x-max)

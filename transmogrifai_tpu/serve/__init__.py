@@ -1,0 +1,3 @@
+from .scoring import ScoreFunction, score_function
+
+__all__ = ["ScoreFunction", "score_function"]

@@ -10,8 +10,15 @@ from .collections import (
     MapVectorizer,
     MultiPickListVectorizer,
 )
+from .calibration import (
+    DecisionTreeNumericBucketizer,
+    PercentileCalibrator,
+    find_splits,
+)
 from .combiner import VectorsCombiner
 from .common import SequenceVectorizer, SequenceVectorizerEstimator
+from .math import BinaryMathTransformer, ScalarMathTransformer, UnaryMathTransformer
+from .misc import AliasTransformer, ToOccurTransformer
 from .date import TIME_PERIODS, DateListVectorizer, DateToUnitCircleVectorizer
 from .numeric import (
     BinaryVectorizer,
@@ -63,4 +70,12 @@ __all__ = [
     "MapVectorizer",
     "SequenceVectorizer",
     "SequenceVectorizerEstimator",
+    "BinaryMathTransformer",
+    "ScalarMathTransformer",
+    "UnaryMathTransformer",
+    "AliasTransformer",
+    "ToOccurTransformer",
+    "DecisionTreeNumericBucketizer",
+    "PercentileCalibrator",
+    "find_splits",
 ]

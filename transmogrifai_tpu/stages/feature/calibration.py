@@ -1,0 +1,202 @@
+"""Label-aware bucketization and score calibration.
+
+TPU-native analog of reference DecisionTreeNumericBucketizer.scala (dsl autoBucketize,
+RichNumericFeature.scala:263-288) and PercentileCalibrator.scala. The decision-tree
+split search runs at fit time on a single column — a host-side exact entropy sweep
+replaces Spark's distributed DecisionTree; the resulting static splits lower to the
+same searchsorted/one-hot device kernel as NumericBucketizer.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, VectorSchema, kind_of
+from ..base import Estimator, Transformer, register_stage
+from .common import SlotInfo, null_slot, stack_vector
+
+_EPS = 1e-12
+
+
+def _entropy(counts: np.ndarray) -> float:
+    n = counts.sum()
+    if n <= 0:
+        return 0.0
+    p = counts / n
+    return float(-(p * np.log2(p + _EPS)).sum())
+
+
+def find_splits(x: np.ndarray, y: np.ndarray, max_splits: int = 16,
+                min_info_gain: float = 0.01, min_leaf: int = 1) -> list[float]:
+    """Greedy recursive binary partitioning by information gain over candidate
+    midpoints (the reference's DecisionTree(maxDepth) split discovery, exact on one
+    column). Returns interior split points, ascending."""
+    order = np.argsort(x, kind="stable")
+    x, y = x[order], y[order]
+    classes, y_idx = np.unique(y, return_inverse=True)
+    k = len(classes)
+    if k < 2 or len(x) < 2 * min_leaf:
+        return []
+    splits: list[float] = []
+
+    def recurse(lo: int, hi: int, budget: int) -> None:
+        if budget <= 0 or hi - lo < 2 * min_leaf:
+            return
+        seg_y = y_idx[lo:hi]
+        total = np.bincount(seg_y, minlength=k).astype(np.float64)
+        parent_h = _entropy(total)
+        if parent_h <= 0:
+            return
+        # prefix class counts at each candidate boundary (value changes only)
+        onehot = np.zeros((hi - lo, k))
+        onehot[np.arange(hi - lo), seg_y] = 1.0
+        prefix = onehot.cumsum(axis=0)
+        xs = x[lo:hi]
+        cand = np.nonzero(xs[1:] > xs[:-1])[0]  # split AFTER index i
+        cand = cand[(cand + 1 >= min_leaf) & (hi - lo - cand - 1 >= min_leaf)]
+        if len(cand) == 0:
+            return
+        n = float(hi - lo)
+        left = prefix[cand]                      # [n_cand, k]
+        right = total[None, :] - left
+        nl = left.sum(axis=1)
+        nr = n - nl
+        with np.errstate(divide="ignore", invalid="ignore"):
+            pl = left / np.maximum(nl, 1.0)[:, None]
+            pr = right / np.maximum(nr, 1.0)[:, None]
+            hl = -(pl * np.log2(pl + _EPS)).sum(axis=1)
+            hr = -(pr * np.log2(pr + _EPS)).sum(axis=1)
+        gains = parent_h - (nl / n) * hl - (nr / n) * hr
+        best = int(np.argmax(gains))
+        best_gain, best_i = float(gains[best]), int(cand[best])
+        if best_gain < min_info_gain:
+            return
+        split = float((xs[best_i] + xs[best_i + 1]) / 2.0)
+        splits.append(split)
+        half = (budget - 1) // 2
+        recurse(lo, lo + best_i + 1, half)
+        recurse(lo + best_i + 1, hi, budget - 1 - half)
+
+    recurse(0, len(x), max_splits)
+    return sorted(splits)
+
+
+@register_stage
+class DecisionTreeNumericBucketizer(Estimator):
+    """(label, numeric) -> one-hot buckets at tree-discovered splits; collapses to a
+    null-indicator-only vector when no informative split exists (the reference's
+    'shortcut' behavior)."""
+
+    operation_name = "autoBucketize"
+    arity = (2, 2)
+
+    def __init__(self, track_nulls: bool = True, max_splits: int = 16,
+                 min_info_gain: float = 0.01):
+        super().__init__(track_nulls=bool(track_nulls), max_splits=int(max_splits),
+                         min_info_gain=float(min_info_gain))
+
+    def out_kind(self, in_kinds):
+        if not in_kinds[1].is_numeric:
+            raise TypeError(f"autoBucketize needs a numeric feature, got {in_kinds[1].name}")
+        return kind_of("OPVector")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        y = np.asarray(cols[0].filled(0.0), np.float32)
+        feat = cols[1]
+        m = np.asarray(feat.effective_mask())
+        x = np.asarray(feat.values, np.float32) if not isinstance(feat.values, np.ndarray) \
+            else feat.values.astype(np.float32)
+        splits = find_splits(x[m], y[m], max_splits=p["max_splits"],
+                             min_info_gain=p["min_info_gain"])
+        name = self.inputs[1].name
+        kind = self.inputs[1].kind.name
+        return DecisionTreeNumericBucketizerModel(
+            splits=splits, track_nulls=p["track_nulls"], name=name, kind=kind)
+
+
+@register_stage
+class DecisionTreeNumericBucketizerModel(Transformer):
+    operation_name = "autoBucketize"
+    arity = (2, 2)
+    device_op = False  # integral inputs arrive as host int64
+
+    def out_kind(self, in_kinds):
+        return kind_of("OPVector")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        c = cols[1]
+        name, kind = p["name"], p["kind"]
+        m = jnp.asarray(np.asarray(c.effective_mask()))
+        parts, slots = [], []
+        splits = list(p["splits"])
+        if splits:
+            edges = jnp.asarray(splits, jnp.float32)
+            vals = c.values.astype(np.float32) if isinstance(c.values, np.ndarray) else c.values
+            vals = jnp.asarray(vals, jnp.float32)
+            nb = len(splits) + 1
+            idx = jnp.searchsorted(edges, vals, side="right")
+            onehot = jax.nn.one_hot(idx, nb, dtype=jnp.float32)
+            onehot = onehot * m[:, None].astype(jnp.float32)
+            parts.append(onehot)
+            bounds = ["-Inf"] + [str(s) for s in splits] + ["Inf"]
+            slots.extend(
+                SlotInfo(name, kind, indicator_value=f"{a}-{b}")
+                for a, b in zip(bounds, bounds[1:])
+            )
+        if p["track_nulls"] or not splits:
+            parts.append(1.0 - jnp.asarray(m, jnp.float32))
+            slots.append(null_slot(name, kind))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class PercentileCalibrator(Estimator):
+    """RealNN score -> percentile bucket in [0, buckets-1] via the training ECDF
+    (reference PercentileCalibrator.scala: spark QuantileDiscretizer + scaling)."""
+
+    operation_name = "percentileCalibrator"
+    arity = (1, 1)
+
+    def __init__(self, buckets: int = 100):
+        super().__init__(buckets=int(buckets))
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def fit_columns(self, cols: Sequence[Column]):
+        b = self.params["buckets"]
+        vals = np.asarray(cols[0].filled(0.0), np.float64)
+        qs = np.quantile(vals, np.linspace(0.0, 1.0, b + 1)[1:-1]) if len(vals) else []
+        return PercentileCalibratorModel(splits=[float(q) for q in np.unique(qs)],
+                                         buckets=b)
+
+
+@register_stage
+class PercentileCalibratorModel(Transformer):
+    operation_name = "percentileCalibrator"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return kind_of("RealNN")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        vals = cols[0].filled(0.0)
+        if not p["splits"]:
+            return Column.real(jnp.zeros_like(vals), kind="RealNN")
+        edges = jnp.asarray(p["splits"], jnp.float32)
+        idx = jnp.searchsorted(edges, vals, side="right").astype(jnp.float32)
+        # scale to [0, buckets-1] like the reference's min-max scaling of bucket ids
+        scale = (p["buckets"] - 1) / max(len(p["splits"]), 1)
+        return Column.real(idx * scale, kind="RealNN")

@@ -1,0 +1,138 @@
+"""Arithmetic feature stages: the feature-algebra kernels behind the dsl operators.
+
+TPU-native analog of the reference's binary math transformers and numeric enrichments
+(core/.../impl/feature/MathTransformers-style stages wired by dsl
+RichNumericFeature.scala:70-228). Null semantics follow the reference exactly:
+
+  - `+` / `-` : present if EITHER operand is present; a missing operand contributes
+    nothing (Some(x) + None = x, None - Some(y) = -y).
+  - `*` / `/` : present only when BOTH operands are present; division additionally
+    filters non-finite results (divide-by-zero -> missing).
+  - scalar ops: present iff the feature value is present.
+
+All kernels are pure jnp over (values, mask) arrays, so chains of arithmetic fuse into
+a single XLA computation inside a workflow layer.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, FeatureKind, kind_of
+from ..base import Transformer, register_stage
+
+_NUMERIC = ("Real", "RealNN", "Currency", "Percent", "Integral", "Binary")
+
+
+def _float_mask(col: Column) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(float32 values, bool mask) for any numeric column; host integrals are
+    converted, device columns pass through traceable."""
+    v = col.values
+    if isinstance(v, np.ndarray):
+        v = v.astype(np.float32)
+    v = jnp.asarray(v, jnp.float32)
+    m = jnp.asarray(col.effective_mask())
+    return jnp.where(m, v, jnp.float32(0.0)), m
+
+
+def _check_numeric(name: str, in_kinds: Sequence[FeatureKind]) -> None:
+    bad = [k.name for k in in_kinds if k.name not in _NUMERIC]
+    if bad:
+        raise TypeError(f"{name} requires numeric features, got {bad}")
+
+
+class _MathBase(Transformer):
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        _check_numeric(type(self).__name__, in_kinds)
+        # fuse-eligible only when every input column lives on device (Integral/Date
+        # are host int64 and need conversion first)
+        self.device_op = all(k.on_device for k in in_kinds)
+        return kind_of("Real")
+
+
+@register_stage
+class BinaryMathTransformer(_MathBase):
+    """Feature-feature arithmetic (+ - * /) with the reference's Option semantics."""
+
+    arity = (2, 2)
+
+    def __init__(self, op: str):
+        if op not in ("+", "-", "*", "/"):
+            raise ValueError(f"unsupported op {op!r}")
+        super().__init__(op=op)
+        self.operation_name = {"+": "plus", "-": "minus", "*": "multiply",
+                               "/": "divide"}[op]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        op = self.params["op"]
+        a, ma = _float_mask(cols[0])
+        b, mb = _float_mask(cols[1])
+        if op == "+":
+            return Column.real(a + b, ma | mb)
+        if op == "-":
+            return Column.real(a - b, ma | mb)
+        if op == "*":
+            return Column.real(a * b, ma & mb)
+        out = jnp.where(mb & (b != 0), a / jnp.where(b == 0, 1.0, b), 0.0)
+        mask = ma & mb & (b != 0) & jnp.isfinite(out)
+        return Column.real(jnp.where(mask, out, 0.0), mask)
+
+
+@register_stage
+class ScalarMathTransformer(_MathBase):
+    """Feature-scalar arithmetic; missing propagates (reference RichNumericFeature
+    scalar overloads)."""
+
+    arity = (1, 1)
+
+    def __init__(self, op: str, scalar: float, reverse: bool = False):
+        if op not in ("+", "-", "*", "/", "**"):
+            raise ValueError(f"unsupported op {op!r}")
+        super().__init__(op=op, scalar=float(scalar), reverse=bool(reverse))
+        self.operation_name = {"+": "plusS", "-": "minusS", "*": "multiplyS",
+                               "/": "divideS", "**": "powerS"}[op]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        v, m = _float_mask(cols[0])
+        s = jnp.float32(p["scalar"])
+        a, b = (s, v) if p["reverse"] else (v, s)
+        op = p["op"]
+        if op == "+":
+            out = a + b
+        elif op == "-":
+            out = a - b
+        elif op == "*":
+            out = a * b
+        elif op == "**":
+            out = jnp.power(a, b)
+        else:
+            out = jnp.where(b != 0, a / jnp.where(b == 0, 1.0, b), jnp.inf)
+        mask = m & jnp.isfinite(out)
+        return Column.real(jnp.where(mask, out, 0.0), mask)
+
+
+@register_stage
+class UnaryMathTransformer(_MathBase):
+    """Elementwise unary math (abs, log, sqrt, exp, floor, ceil, round, negate);
+    non-finite results become missing (log of negatives, etc.)."""
+
+    arity = (1, 1)
+    _FNS = {"abs": jnp.abs, "log": jnp.log, "sqrt": jnp.sqrt, "exp": jnp.exp,
+            "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+            "negate": jnp.negative, "sigmoid": lambda x: 1.0 / (1.0 + jnp.exp(-x))}
+
+    def __init__(self, fn: str):
+        if fn not in self._FNS:
+            raise ValueError(f"unsupported fn {fn!r}; one of {sorted(self._FNS)}")
+        super().__init__(fn=fn)
+        self.operation_name = fn
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        v, m = _float_mask(cols[0])
+        with np.errstate(all="ignore"):
+            out = self._FNS[self.params["fn"]](v)
+        mask = m & jnp.isfinite(out)
+        return Column.real(jnp.where(mask, out, 0.0), mask)

@@ -1,0 +1,82 @@
+"""Small generic stages: alias, occurs (reference AliasTransformer.scala,
+ToOccurTransformer.scala; dsl wiring RichFeature.scala:61-215)."""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...types import Column, FeatureKind, Storage, kind_of
+from ..base import Transformer, register_stage
+
+
+@register_stage
+class AliasTransformer(Transformer):
+    """Identity stage that renames its input feature (reference AliasTransformer).
+    Pure pass-through; fuses to nothing under XLA."""
+
+    operation_name = "alias"
+    arity = (1, 1)
+
+    def __init__(self, name: str):
+        super().__init__(name=name)
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        self.device_op = in_kinds[0].on_device
+        return in_kinds[0]
+
+    def make_output_name(self) -> str:
+        return self.params["name"]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        return cols[0]
+
+
+@register_stage
+class ToOccurTransformer(Transformer):
+    """Any feature -> RealNN 1.0/0.0 occurrence indicator (reference
+    ToOccurTransformer: default matchFn = non-empty, and non-zero for numerics,
+    non-blank for text)."""
+
+    operation_name = "occurs"
+    arity = (1, 1)
+
+    def __init__(self, match_fn: Optional[Callable] = None, fn_name: Optional[str] = None):
+        if fn_name is None and match_fn is not None:
+            fn_name = getattr(match_fn, "__name__", "<fn>")
+        super().__init__(fn_name=fn_name)
+        self.match_fn = match_fn
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        # custom python predicates force host execution; default path on device cols
+        self.device_op = in_kinds[0].on_device and self.match_fn is None
+        return kind_of("RealNN")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        c = cols[0]
+        if self.match_fn is None and self.params.get("fn_name"):
+            # the stage was JSON-restored: silently substituting the default
+            # predicate would change scores, so fail loudly (LambdaTransformer rule)
+            raise RuntimeError(
+                f"ToOccurTransformer was fitted with custom match_fn "
+                f"{self.params['fn_name']!r}, which cannot be restored from JSON; "
+                "re-wire the stage with the function before scoring"
+            )
+        if self.match_fn is not None:
+            hits = np.array([bool(self.match_fn(v)) for v in c.to_list()], np.float32)
+            return Column.real(hits, kind="RealNN")
+        st = c.kind.storage
+        if st is Storage.TEXT:
+            # non-blank, not just non-null (reference default matchFn for text)
+            hits = np.array([v is not None and bool(v.strip()) for v in c.values],
+                            np.float32)
+            return Column.real(hits, kind="RealNN")
+        m = jnp.asarray(c.effective_mask())
+        if st in (Storage.REAL, Storage.BINARY, Storage.INTEGRAL):
+            v = c.values.astype(np.float32) if isinstance(c.values, np.ndarray) else c.values
+            v = jnp.asarray(v, jnp.float32)
+            occurs = m & (v != 0)
+        else:
+            occurs = m
+        return Column.real(occurs.astype(jnp.float32), kind="RealNN")

@@ -278,6 +278,25 @@ class WorkflowModel(WorkflowCore):
         _, metrics = self.score_and_evaluate(evaluator, table=table, reader=reader)
         return metrics
 
+    # --- serving (analog of OpWorkflowModelLocal.scoreFunction) -----------------------
+    def score_fn(self, result_names: Optional[Sequence[str]] = None,
+                 pad_to: Optional[Sequence[int]] = None):
+        """Spark-free serving callable: dict -> dict for one record, .batch(rows) for
+        many; same stage kernels as training, jit-cached (no MLeap-style conversion)."""
+        from ..serve.scoring import score_function
+
+        return score_function(self, result_names=result_names, pad_to=pad_to)
+
+    # --- insights (analog of OpWorkflowModel.modelInsights / summaryPretty) -----------
+    def model_insights(self, feature: Optional[Feature] = None):
+        """Training report for one result feature (OpWorkflowModel.scala:163)."""
+        from ..insights.model_insights import model_insights
+
+        return model_insights(self, feature or self.result_features[0])
+
+    def summary_pretty(self, feature: Optional[Feature] = None) -> str:
+        return self.model_insights(feature).pretty()
+
     # --- persistence (analog of OpWorkflowModelWriter/Reader) -------------------------
     def save(self, path: str, overwrite: bool = False) -> None:
         os.makedirs(path, exist_ok=True)
