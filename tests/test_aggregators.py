@@ -1,0 +1,427 @@
+"""Aggregators + aggregate/conditional/joined readers (reference parity:
+features/.../aggregators/*, readers/.../DataReader.scala:206-351,
+JoinedDataReader.scala:54-251)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.aggregators import (
+    CustomMonoidAggregator,
+    CutOffTime,
+    FeatureAggregator,
+    default_aggregator,
+)
+from transmogrifai_tpu.graph.builder import FeatureBuilder
+from transmogrifai_tpu.ops.segment import factorize_keys, segment_reduce
+from transmogrifai_tpu.readers import (
+    Aggregate,
+    Conditional,
+    InMemoryReader,
+    JoinKeys,
+    TimeBasedFilter,
+    left_outer_join,
+    inner_join,
+    outer_join,
+)
+
+DAY = 24 * 3600 * 1000
+
+
+# ---------------------------------------------------------------------------------------
+# monoid defaults
+# ---------------------------------------------------------------------------------------
+def test_default_monoids_cover_all_kinds():
+    from transmogrifai_tpu.types import KINDS
+
+    for name, kind in KINDS.items():
+        if name == "Prediction":
+            continue
+        agg = default_aggregator(kind)
+        assert agg.fold([]) in (None, [], frozenset(), {}, 0) or agg.fold([]) is None
+
+
+@pytest.mark.parametrize(
+    "kind,values,expected",
+    [
+        ("Real", [1.0, None, 2.5], 3.5),
+        ("Integral", [1, 2, None], 3),
+        ("Binary", [False, None, True], True),
+        ("Date", [5, 9, 2], 9),
+        ("Text", ["ab", None, "cd"], "abcd"),
+        ("PickList", ["a", "b", "a"], "a"),
+        ("TextList", [["x"], None, ["y", "z"]], ["x", "y", "z"]),
+        ("MultiPickList", [{"a"}, {"b"}, None], frozenset({"a", "b"})),
+        ("RealMap", [{"a": 1.0}, {"a": 2.0, "b": 3.0}], {"a": 3.0, "b": 3.0}),
+    ],
+)
+def test_default_monoid_semantics(kind, values, expected):
+    assert default_aggregator(kind).fold(values) == expected
+
+
+def test_mode_ties_break_lexicographically():
+    assert default_aggregator("PickList").fold(["b", "a"]) == "a"
+
+
+def test_geolocation_midpoint():
+    agg = default_aggregator("Geolocation")
+    mid = agg.fold([(0.0, 0.0, 1.0), (0.0, 90.0, 3.0)])
+    assert mid[0] == pytest.approx(0.0, abs=1e-4)
+    assert mid[1] == pytest.approx(45.0, abs=1e-4)
+    assert mid[2] == pytest.approx(2.0)
+
+
+def test_geolocation_map_midpoint_is_order_independent():
+    agg = default_aggregator("GeolocationMap")
+    pts = [{"home": (0.0, 0.0, 1.0)}, {"home": (0.0, 10.0, 1.0)}, {"home": (0.0, 40.0, 1.0)}]
+    fwd = agg.fold(pts)["home"]
+    rev = agg.fold(list(reversed(pts)))["home"]
+    assert fwd[1] == pytest.approx(rev[1])
+    # matches the scalar Geolocation midpoint of the same three points
+    scalar = default_aggregator("Geolocation").fold([p["home"] for p in pts])
+    assert fwd[1] == pytest.approx(scalar[1], abs=1e-4)
+
+
+def test_aggregate_csv_factory_validates_args(tmp_path):
+    p = tmp_path / "ev.csv"
+    p.write_text("id,amount\na,1.0\na,2.0\nb,5.0\n")
+    with pytest.raises(ValueError, match="key_fn or key_field"):
+        Aggregate.csv(str(p))
+    amount = FeatureBuilder.Real("amount").extract(lambda r: r["amount"]).as_predictor()
+    t = Aggregate.csv(str(p), key_field="id").generate_table([amount])
+    assert t["amount"].to_list() == pytest.approx([3.0, 5.0])
+
+
+def test_time_filter_missing_column_raises():
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    left = InMemoryReader([{"k": "a", "age": 1.0}], key_fn=lambda r: r["k"])
+    right = InMemoryReader([{"k": "a", "spend": 1.0}], key_fn=lambda r: r["k"])
+    with pytest.raises(ValueError, match="TimeBasedFilter columns"):
+        left_outer_join(
+            left, right, ["spend"],
+            time_filter=TimeBasedFilter("no_such_t", "no_such_c"),
+        ).generate_table([age])
+
+
+def test_custom_monoid_aggregator():
+    agg = CustomMonoidAggregator(zero=0.0, combine=max, name="maxReal")
+    assert agg.fold([1.0, 5.0, 3.0]) == 5.0
+    assert agg.fold([None, 2.0]) == 2.0
+
+
+# ---------------------------------------------------------------------------------------
+# cutoff filter semantics (FeatureAggregator.scala:110-124)
+# ---------------------------------------------------------------------------------------
+def test_cutoff_predictor_before_response_after():
+    records = [
+        {"t": 10, "v": 1.0},
+        {"t": 20, "v": 2.0},
+        {"t": 30, "v": 4.0},
+    ]
+    cut = CutOffTime.unix_epoch(20)
+    pred = FeatureAggregator(lambda r: r["v"], default_aggregator("Real"), is_response=False)
+    resp = FeatureAggregator(lambda r: r["v"], default_aggregator("Real"), is_response=True)
+    ts = lambda r: r["t"]
+    assert pred.extract(records, ts, cut) == 1.0  # strictly before cutoff
+    assert resp.extract(records, ts, cut) == 6.0  # at/after cutoff
+
+
+def test_cutoff_windows():
+    records = [{"t": t, "v": 1.0} for t in (5, 15, 25, 35)]
+    cut = CutOffTime.unix_epoch(30)
+    ts = lambda r: r["t"]
+    pred = FeatureAggregator(lambda r: r["v"], default_aggregator("Real"))
+    # window of 10ms before the cutoff keeps only t=25
+    assert pred.extract(records, ts, cut, predictor_window_ms=10) == 1.0
+    resp = FeatureAggregator(lambda r: r["v"], default_aggregator("Real"), is_response=True)
+    assert resp.extract(records, ts, cut, response_window_ms=10) == 1.0  # only t=35 in [30, 40]
+
+
+def test_special_window_overrides_reader_window():
+    records = [{"t": t, "v": 1.0} for t in (5, 25)]
+    cut = CutOffTime.unix_epoch(30)
+    f = FeatureAggregator(
+        lambda r: r["v"], default_aggregator("Real"), special_window_ms=100
+    )
+    # reader window would keep only t=25; special window keeps both
+    assert f.extract(records, lambda r: r["t"], cut, predictor_window_ms=10) == 2.0
+
+
+# ---------------------------------------------------------------------------------------
+# device segment reduce
+# ---------------------------------------------------------------------------------------
+def test_segment_reduce_matches_numpy():
+    rng = np.random.default_rng(0)
+    keys = rng.choice(list("abcd"), size=200)
+    vals = rng.normal(size=200).astype(np.float32)
+    mask = rng.random(200) > 0.3
+    seg, uniq = factorize_keys(keys)
+    out, out_mask = segment_reduce(vals, seg, len(uniq), "sum", mask=mask)
+    for i, k in enumerate(uniq):
+        sel = (keys == k) & mask
+        assert np.asarray(out)[i] == pytest.approx(vals[sel].sum(), abs=1e-4)
+        assert bool(np.asarray(out_mask)[i]) == bool(sel.any())
+
+
+def test_segment_reduce_ops():
+    seg = np.array([0, 0, 1, 1, 2])
+    vals = np.array([1.0, 3.0, -2.0, 5.0, 7.0], np.float32)
+    s, _ = segment_reduce(vals, seg, 3, "max")
+    assert np.asarray(s).tolist() == [3.0, 5.0, 7.0]
+    s, _ = segment_reduce(vals, seg, 3, "min")
+    assert np.asarray(s).tolist() == [1.0, -2.0, 7.0]
+    c, _ = segment_reduce(vals, seg, 3, "count")
+    assert np.asarray(c).tolist() == [2, 2, 1]
+
+
+# ---------------------------------------------------------------------------------------
+# AggregateReader
+# ---------------------------------------------------------------------------------------
+def _event_features():
+    amount = (
+        FeatureBuilder.Real("amount").extract(lambda r: r["amount"]).as_predictor()
+    )
+    label = (
+        FeatureBuilder.Binary("churned")
+        .extract(lambda r: r["churned"])
+        .as_response()
+    )
+    city = FeatureBuilder.PickList("city").extract(lambda r: r["city"]).as_predictor()
+    return amount, label, city
+
+
+def _event_records():
+    return [
+        {"id": "u1", "t": 10, "amount": 1.0, "churned": False, "city": "sf"},
+        {"id": "u1", "t": 20, "amount": 2.0, "churned": False, "city": "sf"},
+        {"id": "u1", "t": 40, "amount": 9.0, "churned": True, "city": "la"},
+        {"id": "u2", "t": 15, "amount": 5.0, "churned": False, "city": "ny"},
+        {"id": "u2", "t": 50, "amount": 7.0, "churned": False, "city": "ny"},
+    ]
+
+
+def test_aggregate_reader_rollup_with_cutoff():
+    amount, label, city = _event_features()
+    reader = Aggregate.records(
+        _event_records(),
+        key_fn=lambda r: r["id"],
+        timestamp_fn=lambda r: r["t"],
+        cutoff=CutOffTime.unix_epoch(30),
+    )
+    t = reader.generate_table([amount, label, city])
+    assert t.nrows == 2
+    assert t["key"].to_list() == ["u1", "u2"]
+    # predictors: events before t=30; responses: events at/after
+    assert t["amount"].to_list() == pytest.approx([3.0, 5.0])
+    assert t["churned"].to_list() == [True, False]
+    assert t["city"].to_list() == ["sf", "ny"]
+
+
+def test_aggregate_reader_no_cutoff_sums_everything():
+    amount, label, city = _event_features()
+    reader = Aggregate.records(_event_records(), key_fn=lambda r: r["id"])
+    t = reader.generate_table([amount, label, city])
+    assert t["amount"].to_list() == pytest.approx([12.0, 12.0])
+
+
+def test_aggregate_reader_device_path_matches_host_fold():
+    """Real/Binary kinds lower to device segment_reduce; spot-check vs the host fold."""
+    amount, label, city = _event_features()
+    records = [
+        {"id": f"u{i % 7}", "t": i, "amount": float(i), "churned": i % 3 == 0,
+         "city": "x"}
+        for i in range(100)
+    ]
+    reader = Aggregate.records(
+        records, key_fn=lambda r: r["id"], timestamp_fn=lambda r: r["t"],
+        cutoff=CutOffTime.unix_epoch(60),
+    )
+    t = reader.generate_table([amount, label, city])
+    for key, got in zip(t["key"].to_list(), t["amount"].to_list()):
+        want = sum(r["amount"] for r in records if f"u{int(r['id'][1:])}" == key and r["t"] < 60)
+        assert got == pytest.approx(want)
+
+
+def test_aggregate_reader_custom_aggregator_and_window():
+    spend = (
+        FeatureBuilder.Real("amount")
+        .extract(lambda r: r["amount"])
+        .aggregate(CustomMonoidAggregator(0.0, max, name="maxSpend"))
+        .as_predictor()
+    )
+    reader = Aggregate.records(
+        _event_records(), key_fn=lambda r: r["id"],
+        timestamp_fn=lambda r: r["t"], cutoff=CutOffTime.unix_epoch(100),
+    )
+    t = reader.generate_table([spend])
+    assert t["amount"].to_list() == pytest.approx([9.0, 7.0])
+
+
+def test_cutoff_time_constructors():
+    now = 1000 * DAY
+    assert CutOffTime.days_ago(2, now_ms=now).time_ms == now - 2 * DAY
+    assert CutOffTime.weeks_ago(1, now_ms=now).time_ms == now - 7 * DAY
+    assert CutOffTime.ddmmyyyy("01011970").time_ms == 0
+    assert CutOffTime.no_cutoff().time_ms is None
+
+
+# ---------------------------------------------------------------------------------------
+# ConditionalReader
+# ---------------------------------------------------------------------------------------
+def test_conditional_reader_per_key_cutoff():
+    amount, label, _ = _event_features()
+    records = [
+        # u1 converts at t=40
+        {"id": "u1", "t": 10, "amount": 1.0, "churned": False, "convert": False},
+        {"id": "u1", "t": 40, "amount": 9.0, "churned": True, "convert": True},
+        # u2 converts at t=15
+        {"id": "u2", "t": 15, "amount": 5.0, "churned": False, "convert": True},
+        {"id": "u2", "t": 50, "amount": 7.0, "churned": True, "convert": False},
+        # u3 never converts
+        {"id": "u3", "t": 5, "amount": 2.0, "churned": False, "convert": False},
+    ]
+    reader = Conditional.records(
+        records,
+        key_fn=lambda r: r["id"],
+        timestamp_fn=lambda r: r["t"],
+        target_condition=lambda r: r["convert"],
+        response_window_ms=None,
+        drop_if_target_condition_not_met=True,
+        timestamp_to_keep="min",
+    )
+    t = reader.generate_table([amount, label])
+    assert t["key"].to_list() == ["u1", "u2"]  # u3 dropped
+    # u1 cutoff=40: predictors before -> 1.0; responses at/after -> True
+    # u2 cutoff=15: nothing before -> None; responses at/after -> False or True
+    assert t["amount"].to_list()[0] == pytest.approx(1.0)
+    assert t["amount"].to_list()[1] is None
+    assert t["churned"].to_list() == [True, True]
+
+
+def test_conditional_reader_random_is_seeded():
+    amount, label, _ = _event_features()
+    records = [
+        {"id": "u1", "t": t, "amount": 1.0, "churned": False, "convert": True}
+        for t in (10, 20, 30, 40)
+    ]
+    kw = dict(
+        key_fn=lambda r: r["id"],
+        timestamp_fn=lambda r: r["t"],
+        target_condition=lambda r: r["convert"],
+        timestamp_to_keep="random",
+        response_window_ms=None,
+    )
+    t1 = Conditional.records(records, **kw).generate_table([amount])
+    t2 = Conditional.records(records, **kw).generate_table([amount])
+    assert t1["amount"].to_list() == t2["amount"].to_list()
+
+
+# ---------------------------------------------------------------------------------------
+# JoinedReader
+# ---------------------------------------------------------------------------------------
+def _join_features():
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    spend = FeatureBuilder.Real("spend").extract(lambda r: r["spend"]).as_predictor()
+    return age, spend
+
+
+def test_left_outer_join():
+    age, spend = _join_features()
+    left = InMemoryReader(
+        [{"k": "a", "age": 30.0}, {"k": "b", "age": 40.0}], key_fn=lambda r: r["k"]
+    )
+    right = InMemoryReader([{"k": "a", "spend": 9.0}], key_fn=lambda r: r["k"])
+    t = left_outer_join(left, right, ["spend"]).generate_table([age, spend])
+    assert t["key"].to_list() == ["a", "b"]
+    assert t["age"].to_list() == pytest.approx([30.0, 40.0])
+    assert t["spend"].to_list()[0] == pytest.approx(9.0)
+    assert t["spend"].to_list()[1] is None
+
+
+def test_inner_and_outer_join():
+    age, spend = _join_features()
+    left = InMemoryReader(
+        [{"k": "a", "age": 30.0}, {"k": "b", "age": 40.0}], key_fn=lambda r: r["k"]
+    )
+    right = InMemoryReader(
+        [{"k": "a", "spend": 9.0}, {"k": "c", "spend": 1.0}], key_fn=lambda r: r["k"]
+    )
+    ti = inner_join(left, right, ["spend"]).generate_table([age, spend])
+    assert ti["key"].to_list() == ["a"]
+    to = outer_join(left, right, ["spend"]).generate_table([age, spend])
+    assert to["key"].to_list() == ["a", "b", "c"]
+    assert to["age"].to_list()[2] is None
+
+
+def test_join_right_duplicate_keys_rejected():
+    age, spend = _join_features()
+    left = InMemoryReader([{"k": "a", "age": 1.0}], key_fn=lambda r: r["k"])
+    right = InMemoryReader(
+        [{"k": "a", "spend": 1.0}, {"k": "a", "spend": 2.0}], key_fn=lambda r: r["k"]
+    )
+    with pytest.raises(ValueError, match="duplicate key"):
+        left_outer_join(left, right, ["spend"]).generate_table([age, spend])
+
+
+def test_join_with_aggregated_right_side():
+    age, spend = _join_features()
+    left = InMemoryReader(
+        [{"k": "a", "age": 30.0}, {"k": "b", "age": 40.0}], key_fn=lambda r: r["k"]
+    )
+    right_events = [
+        {"k": "a", "t": 1, "spend": 2.0},
+        {"k": "a", "t": 2, "spend": 3.0},
+        {"k": "b", "t": 1, "spend": 7.0},
+    ]
+    right = Aggregate.records(
+        right_events, key_fn=lambda r: r["k"], timestamp_fn=lambda r: r["t"]
+    )
+    t = left_outer_join(left, right, ["spend"]).generate_table([age, spend])
+    assert t["spend"].to_list() == pytest.approx([5.0, 7.0])
+
+
+def test_time_based_filter():
+    age, spend = _join_features()
+    ev = FeatureBuilder.Date("event_t").extract(lambda r: r["event_t"]).as_predictor()
+    cut = FeatureBuilder.Date("cut_t").extract(lambda r: r["cut_t"]).as_predictor()
+    left = InMemoryReader(
+        [
+            {"k": "a", "age": 30.0, "event_t": 10},
+            {"k": "b", "age": 40.0, "event_t": 99},
+        ],
+        key_fn=lambda r: r["k"],
+    )
+    right = InMemoryReader(
+        [{"k": "a", "cut_t": 50}, {"k": "b", "cut_t": 50}], key_fn=lambda r: r["k"]
+    )
+    t = left_outer_join(
+        left, right, ["cut_t"],
+        time_filter=TimeBasedFilter(time_column="event_t", cutoff_column="cut_t"),
+    ).generate_table([age, ev, cut])
+    assert t["key"].to_list() == ["a"]  # b's event is after its cutoff
+
+
+def test_workflow_trains_through_aggregate_reader():
+    """End-to-end: aggregate reader -> transmogrify -> LR."""
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(3)
+    records = []
+    for i in range(200):
+        uid = f"u{i}"
+        n_ev = rng.integers(1, 4)
+        tot = 0.0
+        for j in range(n_ev):
+            amt = float(rng.normal())
+            tot += amt
+            records.append(
+                {"id": uid, "t": j, "amount": amt, "churned": None, "city": "sf"}
+            )
+        records[-1]["churned"] = bool(tot > 0)
+    amount, label, city = _event_features()
+    reader = Aggregate.records(records, key_fn=lambda r: r["id"])
+    feats = transmogrify([amount, city])
+    pred = LogisticRegression(max_iter=30)(label, feats)
+    model = Workflow().set_reader(reader).set_result_features(pred).train()
+    out = model.score()
+    assert out.nrows == 200

@@ -1,0 +1,31 @@
+"""Monoid aggregators: rolling multi-row entities up to one training row.
+
+TPU-native analog of the reference's algebird aggregator layer
+(features/src/main/scala/com/salesforce/op/aggregators/): `MonoidAggregator` =
+(zero, prepare, combine, present) dataclass; per-kind defaults registry mirrors
+MonoidAggregatorDefaults.scala; `Event`/`CutOffTime` carry the leakage-control time
+semantics of Event.scala / CutOffTime.scala; `FeatureAggregator` applies the
+predictor-before-cutoff / response-after-cutoff filter of FeatureAggregator.scala:100.
+
+Bulk numeric aggregation lowers to device segment reductions (ops/segment.py) instead of
+Spark's reduceByKey shuffle (reference DataReader.scala:206-279).
+"""
+from .monoids import (
+    CutOffTime,
+    CustomMonoidAggregator,
+    Event,
+    FeatureAggregator,
+    MonoidAggregator,
+    default_aggregator,
+    MONOID_DEFAULTS,
+)
+
+__all__ = [
+    "CutOffTime",
+    "CustomMonoidAggregator",
+    "Event",
+    "FeatureAggregator",
+    "MonoidAggregator",
+    "default_aggregator",
+    "MONOID_DEFAULTS",
+]

@@ -1,0 +1,403 @@
+"""Monoid aggregators per feature kind + event-time cutoff semantics.
+
+Reference parity:
+  - MonoidAggregator ~ algebird MonoidAggregator (prepare/plus/present),
+    features/.../aggregators/MonoidAggregatorDefaults.scala:59-111 defaults table.
+  - Event ~ aggregators/Event.scala (timestamped value).
+  - CutOffTime ~ aggregators/CutOffTime.scala:42-69 (UnixEpoch/DaysAgo/WeeksAgo/
+    DDMMYYYY/NoCutoff).
+  - FeatureAggregator.extract ~ aggregators/FeatureAggregator.scala:61-103 with the
+    filterByDateWithCutoff rule (:110-124): predictors take events strictly BEFORE the
+    cutoff (optionally within `predictor_window` before it), responses take events AT or
+    AFTER the cutoff (optionally within `response_window` after it).
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..types import FeatureKind, Storage, kind_of
+
+_MS_PER_DAY = 24 * 3600 * 1000
+
+
+# --------------------------------------------------------------------------------------
+# Event + CutOffTime
+# --------------------------------------------------------------------------------------
+@dataclass(frozen=True)
+class Event:
+    """A timestamped raw value (reference Event.scala)."""
+
+    time: int
+    value: Any
+    is_response: bool = False
+
+
+@dataclass(frozen=True)
+class CutOffTime:
+    """Aggregation cutoff (reference CutOffTime.scala). `time_ms=None` = no cutoff."""
+
+    ctype: str
+    time_ms: Optional[int]
+
+    @staticmethod
+    def unix_epoch(since_epoch_ms: int) -> "CutOffTime":
+        return CutOffTime("UnixEpoch", int(since_epoch_ms))
+
+    @staticmethod
+    def days_ago(days: int, now_ms: Optional[int] = None) -> "CutOffTime":
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        return CutOffTime("DaysAgo", now_ms - days * _MS_PER_DAY)
+
+    @staticmethod
+    def weeks_ago(weeks: int, now_ms: Optional[int] = None) -> "CutOffTime":
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        return CutOffTime("WeeksAgo", now_ms - weeks * 7 * _MS_PER_DAY)
+
+    @staticmethod
+    def ddmmyyyy(s: str) -> "CutOffTime":
+        day, month, year = int(s[0:2]), int(s[2:4]), int(s[4:8])
+        import datetime
+
+        dt = datetime.datetime(year, month, day, tzinfo=datetime.timezone.utc)
+        return CutOffTime("DDMMYYYY", int(dt.timestamp() * 1000))
+
+    @staticmethod
+    def no_cutoff() -> "CutOffTime":
+        return CutOffTime("NoCutoff", None)
+
+
+# --------------------------------------------------------------------------------------
+# MonoidAggregator
+# --------------------------------------------------------------------------------------
+@dataclass(frozen=True)
+class MonoidAggregator:
+    """(zero, prepare, combine, present) — the aggregation algebra for one feature kind.
+
+    `zero` is a factory so mutable accumulators are never shared. `segment_op` names the
+    device segment-reduce this monoid lowers to for bulk numeric aggregation
+    ("sum" | "max" | "min" | "or" | None for host-only monoids) — see ops/segment.py.
+    """
+
+    name: str
+    zero: Callable[[], Any]
+    prepare: Callable[[Any], Any]
+    combine: Callable[[Any, Any], Any]
+    present: Callable[[Any], Any]
+    segment_op: Optional[str] = None
+
+    def fold(self, values) -> Any:
+        acc = self.zero()
+        for v in values:
+            acc = self.combine(acc, self.prepare(v))
+        return self.present(acc)
+
+
+def CustomMonoidAggregator(
+    zero: Any, combine: Callable[[Any, Any], Any], name: str = "custom"
+) -> MonoidAggregator:
+    """User-defined monoid over raw (non-None) values, None-lifted the way the
+    reference's CustomMonoidAggregator.scala:45 lifts into the Option monoid."""
+
+    def _combine(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return combine(a, b)
+
+    return MonoidAggregator(
+        name, zero=lambda: None, prepare=lambda v: zero if v is None else v,
+        combine=_combine, present=lambda a: a,
+    )
+
+
+# --- option-lifted numeric helpers -----------------------------------------------------
+def _opt(binop):
+    def _combine(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return binop(a, b)
+
+    return _combine
+
+
+def _sum_agg(name, cast=float, segment_op="sum") -> MonoidAggregator:
+    return MonoidAggregator(
+        name,
+        zero=lambda: None,
+        prepare=lambda v: None if v is None else cast(v),
+        combine=_opt(lambda a, b: a + b),
+        present=lambda a: a,
+        segment_op=segment_op,
+    )
+
+
+def _extreme_agg(name, fn, segment_op) -> MonoidAggregator:
+    return MonoidAggregator(
+        name,
+        zero=lambda: None,
+        prepare=lambda v: v,
+        combine=_opt(fn),
+        present=lambda a: a,
+        segment_op=segment_op,
+    )
+
+
+def _mode(counter: dict) -> Optional[Any]:
+    """Most frequent value; ties broken by lexicographic order (deterministic, matching
+    the reference ModePickList which takes the min of the maximal group)."""
+    if not counter:
+        return None
+    best = max(counter.items(), key=lambda kv: (kv[1], ))
+    top = best[1]
+    return min(str(k) for k, v in counter.items() if v == top)
+
+
+def _mode_agg(name) -> MonoidAggregator:
+    def prep(v):
+        return {} if v is None else {str(v): 1}
+
+    def comb(a, b):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = out.get(k, 0) + v
+        return out
+
+    return MonoidAggregator(name, zero=dict, prepare=prep, combine=comb, present=_mode)
+
+
+def _concat_text_agg(name) -> MonoidAggregator:
+    return MonoidAggregator(
+        name,
+        zero=lambda: None,
+        prepare=lambda v: None if v is None else str(v),
+        combine=_opt(lambda a, b: a + b),
+        present=lambda a: a,
+    )
+
+
+def _concat_list_agg(name) -> MonoidAggregator:
+    return MonoidAggregator(
+        name,
+        zero=list,
+        prepare=lambda v: [] if v is None else list(v),
+        combine=lambda a, b: a + b,
+        present=lambda a: a,
+    )
+
+
+def _union_set_agg(name) -> MonoidAggregator:
+    return MonoidAggregator(
+        name,
+        zero=frozenset,
+        prepare=lambda v: frozenset() if v is None else frozenset(v),
+        combine=lambda a, b: a | b,
+        present=lambda a: a,
+    )
+
+
+# --- geolocation midpoint --------------------------------------------------------------
+def _geo_prepare(v):
+    """(lat, lon, accuracy) -> unit-vector accumulator (x, y, z, acc_sum, count).
+    Midpoint of points on the sphere, matching Geolocation.scala:44-117's midpoint
+    aggregation (unit-vector mean, average accuracy)."""
+    if v is None or len(v) == 0:
+        return (0.0, 0.0, 0.0, 0.0, 0)
+    lat, lon, acc = float(v[0]), float(v[1]), float(v[2]) if len(v) > 2 else 0.0
+    la, lo = math.radians(lat), math.radians(lon)
+    return (
+        math.cos(la) * math.cos(lo),
+        math.cos(la) * math.sin(lo),
+        math.sin(la),
+        acc,
+        1,
+    )
+
+
+def _geo_present(acc):
+    x, y, z, acc_sum, n = acc
+    if n == 0:
+        return None
+    x, y, z = x / n, y / n, z / n
+    hyp = math.hypot(x, y)
+    lat = math.degrees(math.atan2(z, hyp))
+    lon = math.degrees(math.atan2(y, x))
+    return (lat, lon, acc_sum / n)
+
+
+_GEO_AGG = MonoidAggregator(
+    "GeolocationMidpoint",
+    zero=lambda: (0.0, 0.0, 0.0, 0.0, 0),
+    prepare=_geo_prepare,
+    combine=lambda a, b: tuple(ai + bi for ai, bi in zip(a, b)),
+    present=_geo_present,
+)
+
+
+# --- map monoids ------------------------------------------------------------------------
+def _union_map_agg(name, value_combine) -> MonoidAggregator:
+    def comb(a, b):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = value_combine(out[k], v) if k in out else v
+        return out
+
+    return MonoidAggregator(
+        name,
+        zero=dict,
+        prepare=lambda v: {} if v is None else dict(v),
+        combine=comb,
+        present=lambda a: a,
+    )
+
+
+def _vector_sum_agg() -> MonoidAggregator:
+    import numpy as np
+
+    return MonoidAggregator(
+        "SumVector",
+        zero=lambda: None,
+        prepare=lambda v: None if v is None else np.asarray(v, dtype=float),
+        combine=_opt(lambda a, b: a + b),
+        present=lambda a: a,
+        segment_op="sum",
+    )
+
+
+# --------------------------------------------------------------------------------------
+# Defaults registry — mirrors MonoidAggregatorDefaults.scala:59-111
+# --------------------------------------------------------------------------------------
+def _build_defaults() -> dict[str, MonoidAggregator]:
+    d: dict[str, MonoidAggregator] = {}
+    # numerics
+    for k in ("Real", "RealNN", "Currency", "Percent"):
+        d[k] = _sum_agg(f"Sum{k}")
+    d["Integral"] = _sum_agg("SumIntegral", cast=int)
+    d["Binary"] = MonoidAggregator(
+        "LogicalOr",
+        zero=lambda: None,
+        prepare=lambda v: v,
+        combine=_opt(lambda a, b: bool(a) or bool(b)),
+        present=lambda a: a,
+        segment_op="or",
+    )
+    d["Date"] = _extreme_agg("MaxDate", max, "max")
+    d["DateTime"] = _extreme_agg("MaxDateTime", max, "max")
+    # text: free text concatenates, categorical-ish takes the mode
+    for k in ("Text", "TextArea", "Base64"):
+        d[k] = _concat_text_agg(f"Concat{k}")
+    for k in ("PickList", "ComboBox", "ID", "Email", "Phone", "URL",
+              "Country", "State", "City", "PostalCode", "Street"):
+        d[k] = _mode_agg(f"Mode{k}")
+    # collections
+    d["TextList"] = _concat_list_agg("ConcatTextList")
+    d["DateList"] = _concat_list_agg("ConcatDateList")
+    d["DateTimeList"] = _concat_list_agg("ConcatDateTimeList")
+    d["MultiPickList"] = _union_set_agg("UnionMultiPickList")
+    d["Geolocation"] = _GEO_AGG
+    d["OPVector"] = _vector_sum_agg()
+    # maps: union with per-kind value combination (UnionRealMap / UnionConcatTextMap /
+    # UnionMultiPickListMap ... MonoidAggregatorDefaults.scala:66-87)
+    num_add = lambda a, b: a + b
+    d["RealMap"] = _union_map_agg("UnionRealMap", num_add)
+    d["CurrencyMap"] = _union_map_agg("UnionCurrencyMap", num_add)
+    d["PercentMap"] = _union_map_agg("UnionPercentMap", num_add)
+    d["IntegralMap"] = _union_map_agg("UnionIntegralMap", num_add)
+    d["BinaryMap"] = _union_map_agg("UnionBinaryMap", lambda a, b: bool(a) or bool(b))
+    d["DateMap"] = _union_map_agg("UnionMaxDateMap", max)
+    d["DateTimeMap"] = _union_map_agg("UnionMaxDateTimeMap", max)
+    d["MultiPickListMap"] = _union_map_agg(
+        "UnionMultiPickListMap", lambda a, b: frozenset(a) | frozenset(b)
+    )
+    for k in ("TextMap", "TextAreaMap", "PickListMap", "ComboBoxMap", "IDMap",
+              "EmailMap", "PhoneMap", "URLMap", "CountryMap", "StateMap", "CityMap",
+              "PostalCodeMap", "StreetMap", "NameMap", "Base64Map"):
+        d[k] = _union_map_agg(f"UnionConcat{k}", lambda a, b: str(a) + str(b))
+    # GeolocationMap: accumulate per-key unit-vector sums and only convert to a
+    # midpoint in present(), so the combine stays associative (combining presented
+    # midpoints would weight later events more)
+    def _geomap_prepare(v):
+        return {} if v is None else {k: _geo_prepare(p) for k, p in dict(v).items()}
+
+    def _geomap_combine(a, b):
+        out = dict(a)
+        for k, acc in b.items():
+            out[k] = (
+                tuple(x + y for x, y in zip(out[k], acc)) if k in out else acc
+            )
+        return out
+
+    d["GeolocationMap"] = MonoidAggregator(
+        "UnionGeolocationMidpointMap",
+        zero=dict,
+        prepare=_geomap_prepare,
+        combine=_geomap_combine,
+        present=lambda a: {k: _geo_present(acc) for k, acc in a.items()},
+    )
+    return d
+
+
+MONOID_DEFAULTS: dict[str, MonoidAggregator] = _build_defaults()
+
+
+def default_aggregator(kind: FeatureKind | str) -> MonoidAggregator:
+    """Default monoid for a feature kind (MonoidAggregatorDefaults.aggregatorOf)."""
+    name = kind if isinstance(kind, str) else kind.name
+    agg = MONOID_DEFAULTS.get(name)
+    if agg is None:
+        raise KeyError(f"no default aggregator for kind {name!r}")
+    return agg
+
+
+# --------------------------------------------------------------------------------------
+# FeatureAggregator — event filtering + fold
+# --------------------------------------------------------------------------------------
+@dataclass
+class FeatureAggregator:
+    """Aggregates one feature's events for one entity, honoring the cutoff rule
+    (reference FeatureAggregator.scala:61-124).
+
+    Predictors: event.time < cutoff (and >= cutoff - window if a window is set).
+    Responses:  event.time >= cutoff (and <= cutoff + window if a window is set).
+    """
+
+    extract_fn: Callable[[Any], Any]
+    aggregator: MonoidAggregator
+    is_response: bool = False
+    special_window_ms: Optional[int] = None  # per-feature override of the reader window
+
+    def event_in_window(
+        self,
+        event_time: int,
+        cutoff: CutOffTime,
+        window_ms: Optional[int],
+    ) -> bool:
+        if cutoff.time_ms is None:
+            return True
+        c = cutoff.time_ms
+        w = self.special_window_ms if self.special_window_ms is not None else window_ms
+        if self.is_response:
+            return event_time >= c and (w is None or event_time <= c + w)
+        return event_time < c and (w is None or event_time >= c - w)
+
+    def extract(
+        self,
+        records,
+        timestamp_fn: Optional[Callable[[Any], int]],
+        cutoff: CutOffTime,
+        response_window_ms: Optional[int] = None,
+        predictor_window_ms: Optional[int] = None,
+    ) -> Any:
+        agg = self.aggregator
+        window = response_window_ms if self.is_response else predictor_window_ms
+        acc = agg.zero()
+        for record in records:
+            t = timestamp_fn(record) if timestamp_fn is not None else 0
+            if self.event_in_window(int(t), cutoff, window):
+                acc = agg.combine(acc, agg.prepare(self.extract_fn(record)))
+        return agg.present(acc)

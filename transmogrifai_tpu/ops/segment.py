@@ -1,0 +1,74 @@
+"""Device segment reductions: the TPU-native replacement for Spark's reduceByKey /
+groupByKey shuffle in aggregate readers (reference DataReader.scala:206-279).
+
+Keys are factorized host-side (strings -> dense segment ids via np.unique); the actual
+per-key reduction runs on device as one `jax.ops.segment_*` call — an XLA scatter-reduce
+that tiles onto the VPU, replacing a network shuffle with on-chip memory traffic.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def factorize_keys(keys) -> tuple[np.ndarray, np.ndarray]:
+    """String/any keys -> (segment_ids [N] int32, unique_keys [K]) in sorted key order
+    (np.unique) — deterministic for any input order."""
+    keys = np.asarray(keys, dtype=object)
+    uniq, inv = np.unique(keys.astype(str), return_inverse=True)
+    return inv.astype(np.int32), uniq
+
+
+def segment_reduce(
+    values,
+    segment_ids,
+    num_segments: int,
+    op: str = "sum",
+    mask: Optional[jnp.ndarray] = None,
+):
+    """Masked per-segment reduction on device.
+
+    values: [N] or [N, D] float/bool array; segment_ids: [N] int; op in
+    {"sum", "max", "min", "or", "count", "mean"}. Returns (reduced [K,...],
+    out_mask [K] = segment had >=1 present row).
+    """
+    values = jnp.asarray(values)
+    segment_ids = jnp.asarray(segment_ids, jnp.int32)
+    present = (
+        jnp.ones(values.shape[0], bool) if mask is None else jnp.asarray(mask, bool)
+    )
+    counts = jax.ops.segment_sum(
+        present.astype(jnp.int32), segment_ids, num_segments=num_segments
+    )
+    out_mask = counts > 0
+    pm = present if values.ndim == 1 else present[:, None]
+
+    if op == "count":
+        return counts, out_mask
+    if op in ("sum", "mean"):
+        vals = jnp.where(pm, values.astype(jnp.float32), 0.0)
+        s = jax.ops.segment_sum(vals, segment_ids, num_segments=num_segments)
+        if op == "mean":
+            denom = jnp.maximum(counts, 1).astype(jnp.float32)
+            s = s / (denom if s.ndim == 1 else denom[:, None])
+        return s, out_mask
+    if op == "or":
+        vals = jnp.where(pm, values.astype(bool), False)
+        s = jax.ops.segment_max(
+            vals.astype(jnp.int32), segment_ids, num_segments=num_segments
+        )
+        return s > 0, out_mask
+    if op == "max":
+        neg = jnp.finfo(jnp.float32).min
+        vals = jnp.where(pm, values.astype(jnp.float32), neg)
+        s = jax.ops.segment_max(vals, segment_ids, num_segments=num_segments)
+        return jnp.where(out_mask if s.ndim == 1 else out_mask[:, None], s, 0.0), out_mask
+    if op == "min":
+        pos = jnp.finfo(jnp.float32).max
+        vals = jnp.where(pm, values.astype(jnp.float32), pos)
+        s = jax.ops.segment_min(vals, segment_ids, num_segments=num_segments)
+        return jnp.where(out_mask if s.ndim == 1 else out_mask[:, None], s, 0.0), out_mask
+    raise ValueError(f"unknown segment op {op!r}")

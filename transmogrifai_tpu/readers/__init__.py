@@ -1,8 +1,16 @@
 """Reader factories (analog of the reference DataReaders.Simple/Aggregate/Conditional
-factory surface, readers/.../DataReaders.scala:49-270). Aggregate/conditional/joined
-readers arrive with the segment-reduce aggregation layer."""
+factory surface, readers/.../DataReaders.scala:49-270)."""
+from .aggregates import KEY_COLUMN, AggregateReader, ConditionalReader
 from .base import DataReader, InMemoryReader, TableReader
 from .csv import CSVAutoReader, CSVReader, ParquetReader, infer_schema
+from .joined import (
+    JoinKeys,
+    JoinedReader,
+    TimeBasedFilter,
+    inner_join,
+    left_outer_join,
+    outer_join,
+)
 
 
 class Simple:
@@ -15,6 +23,48 @@ class Simple:
     table = TableReader
 
 
+def _csv_base(path, schema, key_fn, key_field):
+    """CSV base reader + entity-key fn for the aggregate factories: auto-infer the
+    schema when none is given; accept either key_fn or a key_field column name."""
+    reader = CSVReader(path, schema) if schema is not None else CSVAutoReader(path)
+    if key_fn is None:
+        if key_field is None:
+            raise ValueError("aggregate csv readers need key_fn or key_field")
+        key_fn = lambda r: r[key_field]
+    return reader, key_fn
+
+
+class Aggregate:
+    """Factory namespace mirroring DataReaders.Aggregate: wraps any simple reader with
+    the event-rollup semantics."""
+
+    @staticmethod
+    def records(records, key_fn, **kw) -> AggregateReader:
+        return AggregateReader(InMemoryReader(records), key_fn, **kw)
+
+    @staticmethod
+    def csv(path, schema=None, key_fn=None, key_field=None, **kw) -> AggregateReader:
+        base, key_fn = _csv_base(path, schema, key_fn, key_field)
+        return AggregateReader(base, key_fn, **kw)
+
+    reader = AggregateReader
+
+
+class Conditional:
+    """Factory namespace mirroring DataReaders.Conditional."""
+
+    @staticmethod
+    def records(records, key_fn, **kw) -> ConditionalReader:
+        return ConditionalReader(InMemoryReader(records), key_fn, **kw)
+
+    @staticmethod
+    def csv(path, schema=None, key_fn=None, key_field=None, **kw) -> ConditionalReader:
+        base, key_fn = _csv_base(path, schema, key_fn, key_field)
+        return ConditionalReader(base, key_fn, **kw)
+
+    reader = ConditionalReader
+
+
 __all__ = [
     "DataReader",
     "InMemoryReader",
@@ -24,4 +74,15 @@ __all__ = [
     "ParquetReader",
     "infer_schema",
     "Simple",
+    "Aggregate",
+    "Conditional",
+    "AggregateReader",
+    "ConditionalReader",
+    "JoinedReader",
+    "JoinKeys",
+    "TimeBasedFilter",
+    "left_outer_join",
+    "inner_join",
+    "outer_join",
+    "KEY_COLUMN",
 ]

@@ -1,0 +1,277 @@
+"""Aggregate & conditional readers: event data rolled up to one row per entity key.
+
+TPU-native analog of the reference's AggregatedReader family (readers/src/main/scala/com/
+salesforce/op/readers/DataReader.scala:206-351):
+
+  - AggregateReader ~ AggregateDataReader + AggregateParams: predictors aggregate events
+    BEFORE the cutoff, responses AFTER it (leakage control).
+  - ConditionalReader ~ ConditionalDataReader + ConditionalParams: each key's cutoff is
+    the time its target condition first (min) / last (max) / randomly held, with
+    response/predictor windows around it.
+
+Spark's groupByKey/reduceByKey shuffle becomes: host factorization of entity keys to
+dense segment ids + ONE device scatter-reduce per numeric feature (`ops/segment.py`);
+non-numeric monoids fold host-side. Output tables carry the entity key as an `ID` column
+named by `key_column` (default "key"), matching the reference's key-first Row layout.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ..aggregators import CutOffTime, FeatureAggregator, default_aggregator
+from ..graph.feature import Feature
+from ..ops.segment import segment_reduce
+from ..types import Column, Storage, Table
+from .base import DataReader
+
+KEY_COLUMN = "key"
+
+_DEVICE_SEGMENT_STORAGE = (Storage.REAL, Storage.BINARY)
+
+
+class _GroupedReader(DataReader):
+    """Shared machinery: read base records, group by key, aggregate per feature."""
+
+    supports_aggregation = True
+
+    def __init__(self, base: DataReader, key_fn: Callable[[Any], Any],
+                 key_column: str = KEY_COLUMN):
+        super().__init__(key_fn)
+        self.base = base
+        self.key_column = key_column
+
+    def read_records(self) -> list[Any]:
+        return self.base.read_records()
+
+    def _grouped(self) -> tuple[list[str], list[list[Any]]]:
+        groups: dict[str, list[Any]] = {}
+        for r in self.read_records():
+            groups.setdefault(str(self.key_fn(r)), []).append(r)
+        keys = sorted(groups)
+        return keys, [groups[k] for k in keys]
+
+    def _feature_aggregator(self, feature: Feature) -> FeatureAggregator:
+        gen = feature.origin_stage
+        agg = gen.aggregator if gen.aggregator is not None else default_aggregator(feature.kind)
+        return FeatureAggregator(
+            extract_fn=gen.extract,
+            aggregator=agg,
+            is_response=feature.is_response,
+            special_window_ms=gen.params.get("window_ms"),
+        )
+
+    def _aggregate_feature_device(
+        self, feature: Feature, fagg: FeatureAggregator, records: list[Any],
+        allowed: np.ndarray, segment_ids: np.ndarray, num_segments: int,
+    ) -> Optional[Column]:
+        """Bulk path: numeric monoid with a device segment op. Returns None when the
+        monoid/kind combination has no device lowering."""
+        kind = feature.kind
+        op = fagg.aggregator.segment_op
+        if op is None or kind.storage not in _DEVICE_SEGMENT_STORAGE:
+            return None
+        raw = [fagg.extract_fn(r) for r in records]
+        present = np.array([v is not None for v in raw], dtype=bool) & allowed
+        vals = np.array(
+            [0.0 if v is None else float(v) for v in raw], dtype=np.float32
+        )
+        reduced, out_mask = segment_reduce(
+            vals, segment_ids, num_segments, op=op, mask=present
+        )
+        reduced = np.asarray(reduced)
+        out_mask = np.asarray(out_mask)
+        data = [
+            (bool(v) if kind.storage is Storage.BINARY else float(v)) if m else None
+            for v, m in zip(reduced, out_mask)
+        ]
+        return Column.build(kind, data)
+
+    def _generate(
+        self,
+        raw_features: Sequence[Feature],
+        timestamp_fn: Optional[Callable[[Any], int]],
+        cutoff_for_key: Callable[[str, list[Any]], Optional[CutOffTime]],
+        response_window_ms: Optional[int],
+        predictor_window_ms: Optional[int],
+    ) -> Table:
+        all_keys, all_groups = self._grouped()
+        cutoffs: dict[str, CutOffTime] = {}
+        keys: list[str] = []
+        groups: list[list[Any]] = []
+        for k, g in zip(all_keys, all_groups):
+            co = cutoff_for_key(k, g)
+            if co is None:  # conditional reader drops keys whose condition never fired
+                continue
+            cutoffs[k] = co
+            keys.append(k)
+            groups.append(g)
+
+        faggs = {f.name: self._feature_aggregator(f) for f in raw_features}
+        cols: dict[str, Column] = {
+            self.key_column: Column.build("ID", list(keys))
+        }
+
+        # device bulk path is only valid when every key shares one global cutoff
+        distinct_cutoffs = set(cutoffs.values())
+        global_cutoff = distinct_cutoffs.pop() if len(distinct_cutoffs) == 1 else None
+
+        flat_records: list[Any] = [r for g in groups for r in g]
+        seg_ids = np.repeat(
+            np.arange(len(groups), dtype=np.int32), [len(g) for g in groups]
+        )
+        times = (
+            np.array([int(timestamp_fn(r)) for r in flat_records], dtype=np.int64)
+            if timestamp_fn is not None
+            else np.zeros(len(flat_records), dtype=np.int64)
+        )
+
+        # window masks depend only on (is_response, effective window) — vectorize on
+        # the times array once per distinct pair instead of per feature per record
+        mask_cache: dict[tuple, np.ndarray] = {}
+
+        def _allowed_mask(fagg: FeatureAggregator, is_response: bool) -> np.ndarray:
+            window = response_window_ms if is_response else predictor_window_ms
+            w = fagg.special_window_ms if fagg.special_window_ms is not None else window
+            key = (is_response, w)
+            if key not in mask_cache:
+                c = global_cutoff.time_ms
+                if c is None:
+                    m = np.ones(len(times), dtype=bool)
+                elif is_response:
+                    m = times >= c
+                    if w is not None:
+                        m &= times <= c + w
+                else:
+                    m = times < c
+                    if w is not None:
+                        m &= times >= c - w
+                mask_cache[key] = m
+            return mask_cache[key]
+
+        for f in raw_features:
+            fagg = faggs[f.name]
+            col = None
+            if global_cutoff is not None and flat_records:
+                col = self._aggregate_feature_device(
+                    f, fagg, flat_records, _allowed_mask(fagg, f.is_response),
+                    seg_ids, len(groups)
+                )
+            if col is None:  # host monoid fold
+                data = [
+                    fagg.extract(
+                        g, timestamp_fn, cutoffs[k],
+                        response_window_ms=response_window_ms,
+                        predictor_window_ms=predictor_window_ms,
+                    )
+                    for k, g in zip(keys, groups)
+                ]
+                col = Column.build(f.kind, data)
+            cols[f.name] = col
+        return Table(cols, len(keys))
+
+    def keys(self) -> Optional[list[str]]:
+        return self._grouped()[0]
+
+
+class AggregateReader(_GroupedReader):
+    """Event-data reader with a single global cutoff (AggregateDataReader,
+    reference DataReader.scala:252-279)."""
+
+    def __init__(
+        self,
+        base: DataReader,
+        key_fn: Callable[[Any], Any],
+        timestamp_fn: Optional[Callable[[Any], int]] = None,
+        cutoff: Optional[CutOffTime] = None,
+        response_window_ms: Optional[int] = None,
+        predictor_window_ms: Optional[int] = None,
+        key_column: str = KEY_COLUMN,
+    ):
+        super().__init__(base, key_fn, key_column)
+        self.timestamp_fn = timestamp_fn
+        self.cutoff = cutoff if cutoff is not None else CutOffTime.no_cutoff()
+        self.response_window_ms = response_window_ms
+        self.predictor_window_ms = predictor_window_ms
+
+    def generate_table(self, raw_features: Sequence[Feature]) -> Table:
+        return self._generate(
+            raw_features,
+            self.timestamp_fn,
+            lambda key, records: self.cutoff,
+            self.response_window_ms,
+            self.predictor_window_ms,
+        )
+
+
+_WEEK_MS = 7 * 24 * 3600 * 1000
+
+
+class ConditionalReader(_GroupedReader):
+    """Conditional-probability reader: per-key cutoff at the target condition's event
+    time (ConditionalDataReader, reference DataReader.scala:288-351).
+
+    timestamp_to_keep: which matching event time becomes the cutoff when a key matched
+    multiple times — "min" | "max" | "random" (seeded, unlike the reference's TODO).
+    """
+
+    def __init__(
+        self,
+        base: DataReader,
+        key_fn: Callable[[Any], Any],
+        timestamp_fn: Callable[[Any], int],
+        target_condition: Callable[[Any], bool],
+        response_window_ms: Optional[int] = _WEEK_MS,
+        predictor_window_ms: Optional[int] = None,
+        timestamp_to_keep: str = "random",
+        cutoff_fn: Optional[Callable[[str, list[Any]], CutOffTime]] = None,
+        drop_if_target_condition_not_met: bool = False,
+        seed: int = 42,
+        key_column: str = KEY_COLUMN,
+    ):
+        super().__init__(base, key_fn, key_column)
+        if timestamp_to_keep not in ("min", "max", "random"):
+            raise ValueError(f"timestamp_to_keep must be min|max|random, got {timestamp_to_keep!r}")
+        self.timestamp_fn = timestamp_fn
+        self.target_condition = target_condition
+        self.response_window_ms = response_window_ms
+        self.predictor_window_ms = predictor_window_ms
+        self.timestamp_to_keep = timestamp_to_keep
+        self.cutoff_fn = cutoff_fn
+        self.drop_if_target_condition_not_met = drop_if_target_condition_not_met
+        self.seed = seed
+
+    def _cutoff_for_key(
+        self, key: str, records: list[Any], now_ms: int
+    ) -> Optional[CutOffTime]:
+        target_times = [
+            int(self.timestamp_fn(r)) for r in records if self.target_condition(r)
+        ]
+        if not target_times and self.drop_if_target_condition_not_met:
+            return None
+        if self.cutoff_fn is not None:
+            return self.cutoff_fn(key, records)
+        if not target_times:
+            # one shared "now" per generate_table call: deterministic within a run and
+            # keeps the cutoff global when no key matched (device bulk path stays on)
+            return CutOffTime.unix_epoch(now_ms)
+        if self.timestamp_to_keep == "min":
+            t = min(target_times)
+        elif self.timestamp_to_keep == "max":
+            t = max(target_times)
+        else:
+            t = random.Random(f"{self.seed}:{key}").choice(target_times)
+        return CutOffTime.unix_epoch(t)
+
+    def generate_table(self, raw_features: Sequence[Feature]) -> Table:
+        now_ms = int(time.time() * 1000)
+        return self._generate(
+            raw_features,
+            self.timestamp_fn,
+            lambda k, g: self._cutoff_for_key(k, g, now_ms),
+            self.response_window_ms,
+            self.predictor_window_ms,
+        )

@@ -1,0 +1,169 @@
+"""Joined readers: combine two readers' outputs on entity keys.
+
+Analog of the reference JoinedDataReader (readers/src/main/scala/com/salesforce/op/
+readers/JoinedDataReader.scala:54-251): left-outer / inner / outer joins over `JoinKeys`,
+plus a `TimeBasedFilter` that keeps only left rows whose event time falls before the
+joined right row's cutoff. Spark's shuffle join becomes a host-side hash join over the
+two generated Tables (ingestion-scale data lives on host anyway); the joined Table then
+shards onto the device mesh downstream like any other.
+
+The right side must produce one row per key — aggregate it first (AggregateReader), the
+same constraint the reference enforces by requiring AggregatedReader on the right.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from ..graph.feature import Feature
+from ..types import Column, Table
+from .aggregates import KEY_COLUMN
+from .base import DataReader
+
+
+@dataclass(frozen=True)
+class JoinKeys:
+    """Key columns for the join (reference JoinKeys: leftKey/rightKey/resultKey)."""
+
+    left_key: str = KEY_COLUMN
+    right_key: str = KEY_COLUMN
+    result_key: str = KEY_COLUMN
+
+
+@dataclass(frozen=True)
+class TimeBasedFilter:
+    """Keep only left rows whose `time_column` value is before the right row's
+    `cutoff_column` value (reference TimeBasedFilter leakage guard)."""
+
+    time_column: str
+    cutoff_column: str
+    keep_if_right_missing: bool = True
+
+
+class JoinedReader(DataReader):
+    """Join of two readers. Feature ownership is explicit: `right_feature_names` lists
+    the raw features produced by the right reader; everything else comes from the left
+    (the reference partitions features by producing reader the same way, just implicitly
+    through its typed reader hierarchy)."""
+
+    supports_aggregation = True
+
+    def __init__(
+        self,
+        left: DataReader,
+        right: DataReader,
+        right_feature_names: Sequence[str],
+        join_type: str = "left-outer",
+        join_keys: JoinKeys = JoinKeys(),
+        time_filter: Optional[TimeBasedFilter] = None,
+        left_key_fn: Optional[Callable[[Any], Any]] = None,
+        right_key_fn: Optional[Callable[[Any], Any]] = None,
+    ):
+        super().__init__()
+        if join_type not in ("inner", "left-outer", "outer"):
+            raise ValueError(f"join_type must be inner|left-outer|outer, got {join_type!r}")
+        self.left = left
+        self.right = right
+        self.right_feature_names = set(right_feature_names)
+        self.join_type = join_type
+        self.join_keys = join_keys
+        self.time_filter = time_filter
+        self.left_key_fn = left_key_fn
+        self.right_key_fn = right_key_fn
+
+    def _side_table(self, reader: DataReader, feats: list[Feature], key_fn,
+                    key_col: str) -> tuple[Table, list[str]]:
+        table = reader.generate_table(feats)
+        if key_col in table:
+            keys = [str(v) for v in table[key_col].to_list()]
+        else:
+            fn = key_fn if key_fn is not None else reader.key_fn
+            if fn is None:
+                raise ValueError(
+                    f"join side produced no {key_col!r} column and has no key_fn"
+                )
+            keys = [str(fn(r)) for r in reader.read_records()]
+            if len(keys) != table.nrows:
+                raise ValueError("key_fn produced a different row count than the table")
+        return table, keys
+
+    def generate_table(self, raw_features: Sequence[Feature]) -> Table:
+        left_feats = [f for f in raw_features if f.name not in self.right_feature_names]
+        right_feats = [f for f in raw_features if f.name in self.right_feature_names]
+        lt, lkeys = self._side_table(
+            self.left, left_feats, self.left_key_fn, self.join_keys.left_key
+        )
+        rt, rkeys = self._side_table(
+            self.right, right_feats, self.right_key_fn, self.join_keys.right_key
+        )
+        if self.time_filter is not None:
+            available = set(lt.names()) | set(rt.names())
+            missing = {
+                self.time_filter.time_column, self.time_filter.cutoff_column
+            } - available
+            if missing:
+                raise ValueError(
+                    f"TimeBasedFilter columns {sorted(missing)} not in joined schema "
+                    f"{sorted(available)}; the leakage guard would silently no-op"
+                )
+        rindex: dict[str, int] = {}
+        for i, k in enumerate(rkeys):
+            if k in rindex:
+                raise ValueError(
+                    f"right side has duplicate key {k!r}; aggregate it first "
+                    "(wrap in AggregateReader)"
+                )
+            rindex[k] = i
+
+        lrows = lt.to_rows()
+        rrows = rt.to_rows()
+
+        out_rows: list[dict] = []
+        out_keys: list[str] = []
+        matched_right: set[str] = set()
+        for lk, lrow in zip(lkeys, lrows):
+            ri = rindex.get(lk)
+            if ri is not None:
+                matched_right.add(lk)
+            if ri is None and self.join_type == "inner":
+                continue
+            row = dict(lrow)
+            rrow = rrows[ri] if ri is not None else {f.name: None for f in right_feats}
+            row.update(rrow)
+            if self.time_filter is not None:
+                t = row.get(self.time_filter.time_column)
+                c = row.get(self.time_filter.cutoff_column)
+                if c is None or ri is None:
+                    if not self.time_filter.keep_if_right_missing:
+                        continue
+                elif t is not None and int(t) >= int(c):
+                    continue
+            out_rows.append(row)
+            out_keys.append(lk)
+        if self.join_type == "outer":
+            for rk, rrow in zip(rkeys, rrows):
+                if rk in matched_right:
+                    continue
+                row = {f.name: None for f in left_feats}
+                row.update(rrow)
+                out_rows.append(row)
+                out_keys.append(rk)
+
+        cols: dict[str, Column] = {
+            self.join_keys.result_key: Column.build("ID", out_keys)
+        }
+        for f in raw_features:
+            cols[f.name] = Column.build(f.kind, [r.get(f.name) for r in out_rows])
+        return Table(cols, len(out_rows))
+
+
+def left_outer_join(left, right, right_feature_names, **kw) -> JoinedReader:
+    return JoinedReader(left, right, right_feature_names, "left-outer", **kw)
+
+
+def inner_join(left, right, right_feature_names, **kw) -> JoinedReader:
+    return JoinedReader(left, right, right_feature_names, "inner", **kw)
+
+
+def outer_join(left, right, right_feature_names, **kw) -> JoinedReader:
+    return JoinedReader(left, right, right_feature_names, "outer", **kw)
