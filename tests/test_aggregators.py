@@ -425,3 +425,52 @@ def test_workflow_trains_through_aggregate_reader():
     model = Workflow().set_reader(reader).set_result_features(pred).train()
     out = model.score()
     assert out.nrows == 200
+
+
+def test_custom_monoid_missing_event_is_skipped_not_zeroed():
+    """A None event must not perturb the aggregate even when zero is not a combine
+    identity (max of negatives); all-missing falls back to zero."""
+    agg = CustomMonoidAggregator(zero=0.0, combine=max, name="maxReal")
+    assert agg.fold([-5.0]) == -5.0
+    assert agg.fold([-5.0, None]) == -5.0
+    assert agg.fold([None, None]) == 0.0
+
+
+def test_outer_join_time_filtered_left_keeps_right_only_row():
+    """A right row whose only left match is time-filtered out must survive an outer
+    join as a right-only row."""
+    age, spend = _join_features()
+    ev = FeatureBuilder.Date("event_t").extract(lambda r: r["event_t"]).as_predictor()
+    cut = FeatureBuilder.Date("cut_t").extract(lambda r: r["cut_t"]).as_predictor()
+    left = InMemoryReader(
+        [{"k": "a", "age": 30.0, "event_t": 99}], key_fn=lambda r: r["k"]
+    )
+    right = InMemoryReader(
+        [{"k": "a", "spend": 9.0, "cut_t": 50}], key_fn=lambda r: r["k"]
+    )
+    t = outer_join(
+        left, right, ["spend", "cut_t"],
+        time_filter=TimeBasedFilter("event_t", "cut_t"),
+    ).generate_table([age, ev, spend, cut])
+    assert t["key"].to_list() == ["a"]
+    assert t["age"].to_list() == [None]  # right-only row: left columns null
+    assert t["spend"].to_list() == pytest.approx([9.0])
+
+
+def test_conditional_keys_align_with_dropped_rows():
+    amount, label, _ = _event_features()
+    records = [
+        {"id": "u1", "t": 10, "amount": 1.0, "churned": False, "convert": True},
+        {"id": "u2", "t": 10, "amount": 2.0, "churned": False, "convert": False},
+        {"id": "u3", "t": 10, "amount": 3.0, "churned": False, "convert": True},
+    ]
+    r = Conditional.records(
+        records,
+        key_fn=lambda r: r["id"],
+        timestamp_fn=lambda r: r["t"],
+        target_condition=lambda r: r["convert"],
+        drop_if_target_condition_not_met=True,
+        response_window_ms=None,
+    )
+    t = r.generate_table([amount])
+    assert t["key"].to_list() == r.keys() == ["u1", "u3"]

@@ -1,0 +1,135 @@
+"""testkit generator tests (reference testkit/src/test/scala/com/salesforce/op/testkit/)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.testkit import (
+    RandomBinary,
+    RandomGeolocation,
+    RandomIntegral,
+    RandomList,
+    RandomMap,
+    RandomMultiPickList,
+    RandomReal,
+    RandomText,
+    RandomVector,
+    random_data,
+)
+from transmogrifai_tpu.types import Storage
+
+
+def test_real_deterministic_and_distributed():
+    s = RandomReal.normal(mean=5.0, sigma=2.0, seed=7)
+    a, b = s.limit(500), s.limit(500)
+    assert a == b  # restartable: same prefix every time
+    assert abs(np.mean(a) - 5.0) < 0.3
+    assert abs(np.std(a) - 2.0) < 0.3
+
+
+def test_probability_of_empty():
+    s = RandomReal.uniform(seed=3).with_probability_of_empty(0.3)
+    vals = s.limit(2000)
+    frac = sum(v is None for v in vals) / len(vals)
+    assert 0.25 < frac < 0.35
+    with pytest.raises(ValueError):
+        RandomReal.uniform().with_probability_of_empty(1.5)
+
+
+def test_integral_and_dates_monotone():
+    ints = RandomIntegral.integers(10, 20, seed=1).limit(100)
+    assert all(10 <= v < 20 for v in ints)
+    d = RandomIntegral.dates(seed=2)
+    a = d.limit(50)
+    assert a == d.limit(50)  # restartable despite the cursor
+    assert all(x < y for x, y in zip(a, a[1:]))
+
+
+def test_binary_probability():
+    vals = RandomBinary.of(0.8, seed=5).limit(1000)
+    assert 0.75 < sum(vals) / len(vals) < 0.85
+
+
+def test_text_families():
+    assert all("@" in e for e in RandomText.emails(seed=1).limit(20))
+    assert all(u.startswith("https://") for u in RandomText.urls(seed=1).limit(20))
+    assert all(p.startswith("+1") and len(p) == 12 for p in RandomText.phones(seed=1).limit(20))
+    assert all(len(z) == 5 and z.isdigit() for z in RandomText.postal_codes(seed=1).limit(20))
+    dom = ["a", "b", "c"]
+    assert set(RandomText.picklists(dom, seed=1).limit(100)) == set(dom)
+    assert set(RandomText.countries(seed=1).limit(200)) <= {
+        "USA", "Canada", "Mexico", "France", "Germany", "Japan", "Brazil"}
+    import base64
+    for v in RandomText.base64(seed=1).limit(10):
+        base64.b64decode(v)  # valid base64
+
+
+def test_collections_maps():
+    lists = RandomList.of_texts(1, 4, seed=1).limit(50)
+    assert all(1 <= len(l) <= 4 for l in lists)
+    dl = RandomList.of_dates(seed=1).limit(20)
+    assert all(list(x) == sorted(x) for x in dl)
+    sets = RandomMultiPickList.of(["x", "y", "z"], 1, 3, seed=1).limit(50)
+    assert all(isinstance(s, frozenset) and 1 <= len(s) <= 3 for s in sets)
+    maps = RandomMap.of(RandomReal.normal(), keys=["k1", "k2", "k3"], seed=1).limit(30)
+    assert all(isinstance(m, dict) and 1 <= len(m) <= 3 for m in maps)
+    assert maps[0].keys() <= {"k1", "k2", "k3"}
+
+
+def test_map_kind_inference():
+    s = RandomMap.of(RandomText.picklists(["u", "v"]), keys=["a", "b"])
+    assert s.kind_name == "PickListMap"
+    with pytest.raises(KeyError):
+        RandomMap.of(RandomVector.normal(3), keys=["a"])  # OPVectorMap doesn't exist
+
+
+def test_vector_geo():
+    vs = RandomVector.sparse(16, density=0.2, seed=1).limit(50)
+    assert all(v.shape == (16,) for v in vs)
+    density = np.mean([np.count_nonzero(v) / 16 for v in vs])
+    assert 0.1 < density < 0.3
+    geos = RandomGeolocation.of(seed=1).limit(50)
+    assert all(-90 <= g[0] <= 90 and -180 <= g[1] <= 180 for g in geos)
+
+
+def test_random_data_table():
+    t = random_data(
+        {
+            "age": RandomReal.normal(40, 10, seed=1).with_probability_of_empty(0.1),
+            "label": RandomBinary.of(0.5, seed=2),
+            "city": RandomText.cities(seed=3),
+            "tags": RandomMultiPickList.of(["a", "b"], seed=4),
+        },
+        n=64,
+    )
+    assert t.nrows == 64
+    assert t["age"].kind.storage is Storage.REAL
+    assert bool(t["age"].mask.all()) is False  # some empties
+    assert t["label"].kind.storage is Storage.BINARY
+    assert t["city"].kind.name == "City"
+    assert t["tags"].kind.name == "MultiPickList"
+
+
+def test_streams_feed_workflow():
+    """testkit tables drive an end-to-end train, like the reference's vectorizer tests."""
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    t = random_data(
+        {
+            "label": RandomBinary.of(0.4, seed=11).map(float, "RealNN"),
+            "x1": RandomReal.normal(seed=12),
+            "cat": RandomText.picklists(["p", "q", "r"], seed=13),
+        },
+        n=128,
+    )
+    fs = features_from_schema({"label": "RealNN", "x1": "Real", "cat": "PickList"},
+                              response="label")
+    vec = transmogrify([fs["x1"], fs["cat"]])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    model = Workflow().set_result_features(pred).train(table=t)
+    out = model.score(table=t)
+    assert out[pred.name].values[PREDICTION_KEY].shape[0] == 128
+
+
+from transmogrifai_tpu.types.kinds import PREDICTION_KEY  # noqa: E402

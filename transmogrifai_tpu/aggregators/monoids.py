@@ -109,8 +109,8 @@ def CustomMonoidAggregator(
         return combine(a, b)
 
     return MonoidAggregator(
-        name, zero=lambda: None, prepare=lambda v: zero if v is None else v,
-        combine=_combine, present=lambda a: a,
+        name, zero=lambda: None, prepare=lambda v: v,
+        combine=_combine, present=lambda a: zero if a is None else a,
     )
 
 

@@ -49,7 +49,7 @@ class _GroupedReader(DataReader):
 
     def _grouped(self) -> tuple[list[str], list[list[Any]]]:
         groups: dict[str, list[Any]] = {}
-        for r in self.read_records():
+        for r in self.cached_records():
             groups.setdefault(str(self.key_fn(r)), []).append(r)
         keys = sorted(groups)
         return keys, [groups[k] for k in keys]
@@ -115,19 +115,24 @@ class _GroupedReader(DataReader):
             self.key_column: Column.build("ID", list(keys))
         }
 
-        # device bulk path is only valid when every key shares one global cutoff
+        # device bulk path is only valid when every key shares one global cutoff;
+        # its inputs (flattened records, segment ids, timestamps) are only built then —
+        # per-key cutoffs skip the O(N) prep entirely
         distinct_cutoffs = set(cutoffs.values())
         global_cutoff = distinct_cutoffs.pop() if len(distinct_cutoffs) == 1 else None
 
-        flat_records: list[Any] = [r for g in groups for r in g]
-        seg_ids = np.repeat(
-            np.arange(len(groups), dtype=np.int32), [len(g) for g in groups]
-        )
-        times = (
-            np.array([int(timestamp_fn(r)) for r in flat_records], dtype=np.int64)
-            if timestamp_fn is not None
-            else np.zeros(len(flat_records), dtype=np.int64)
-        )
+        flat_records: list[Any] = []
+        seg_ids = times = None
+        if global_cutoff is not None:
+            flat_records = [r for g in groups for r in g]
+            seg_ids = np.repeat(
+                np.arange(len(groups), dtype=np.int32), [len(g) for g in groups]
+            )
+            times = (
+                np.array([int(timestamp_fn(r)) for r in flat_records], dtype=np.int64)
+                if timestamp_fn is not None
+                else np.zeros(len(flat_records), dtype=np.int64)
+            )
 
         # window masks depend only on (is_response, effective window) — vectorize on
         # the times array once per distinct pair instead of per feature per record
@@ -275,3 +280,14 @@ class ConditionalReader(_GroupedReader):
             self.response_window_ms,
             self.predictor_window_ms,
         )
+
+    def keys(self) -> Optional[list[str]]:
+        """Keys aligned with generate_table rows: keys whose target condition never
+        fired are dropped here too when drop_if_target_condition_not_met is set."""
+        all_keys, all_groups = self._grouped()
+        if not self.drop_if_target_condition_not_met:
+            return all_keys
+        return [
+            k for k, g in zip(all_keys, all_groups)
+            if any(self.target_condition(r) for r in g)
+        ]

@@ -32,6 +32,16 @@ class DataReader:
     def read_records(self) -> list[Any]:
         raise NotImplementedError
 
+    def cached_records(self) -> list[Any]:
+        """read_records() memoized per reader instance, so consumers that need both the
+        table and the raw records (joins extracting keys, grouped readers) parse
+        file-backed sources once. Sources are assumed immutable for the reader's life."""
+        cache = getattr(self, "_records_cache", None)
+        if cache is None:
+            cache = self.read_records()
+            self._records_cache = cache
+        return cache
+
     def read_columnar(self) -> Optional[dict[str, np.ndarray]]:
         """Columnar fast path: name -> numpy array (object arrays allowed). Return None
         if only record-wise reading is available."""
@@ -62,7 +72,7 @@ class DataReader:
                 n = len(data) if n is None else n
                 cols[name] = Column.build(f.kind, _np_to_values(data))
             return Table(cols, n)
-        records = self.read_records()
+        records = self.cached_records()
         cols = {}
         for f, g in zip(raw_features, gens):
             cols[f.name] = Column.build(f.kind, [g.extract(r) for r in records])
@@ -71,7 +81,7 @@ class DataReader:
     def keys(self) -> Optional[list[str]]:
         if self.key_fn is None:
             return None
-        return [str(self.key_fn(r)) for r in self.read_records()]
+        return [str(self.key_fn(r)) for r in self.cached_records()]
 
 
 def _np_to_values(arr: np.ndarray) -> list:

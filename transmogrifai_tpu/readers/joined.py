@@ -82,7 +82,7 @@ class JoinedReader(DataReader):
                 raise ValueError(
                     f"join side produced no {key_col!r} column and has no key_fn"
                 )
-            keys = [str(fn(r)) for r in reader.read_records()]
+            keys = [str(fn(r)) for r in reader.cached_records()]
             if len(keys) != table.nrows:
                 raise ValueError("key_fn produced a different row count than the table")
         return table, keys
@@ -123,8 +123,6 @@ class JoinedReader(DataReader):
         matched_right: set[str] = set()
         for lk, lrow in zip(lkeys, lrows):
             ri = rindex.get(lk)
-            if ri is not None:
-                matched_right.add(lk)
             if ri is None and self.join_type == "inner":
                 continue
             row = dict(lrow)
@@ -138,6 +136,10 @@ class JoinedReader(DataReader):
                         continue
                 elif t is not None and int(t) >= int(c):
                     continue
+            # mark only on emit: a right row whose every left match was time-filtered
+            # away must still survive an outer join as a right-only row
+            if ri is not None:
+                matched_right.add(lk)
             out_rows.append(row)
             out_keys.append(lk)
         if self.join_type == "outer":

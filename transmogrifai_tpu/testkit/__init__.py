@@ -1,0 +1,36 @@
+"""testkit: seeded random typed-data generators for every feature kind.
+
+TPU-native analog of the reference testkit module (testkit/src/main/scala/com/salesforce/
+op/testkit/ — RandomReal.scala, RandomIntegral.scala, RandomBinary.scala, RandomText.scala,
+RandomList.scala, RandomSet.scala, RandomMap.scala, RandomVector.scala, RandomData.scala,
+ProbabilityOfEmpty.scala, InfiniteStream.scala). Generators are deterministic given a seed,
+are conceptually infinite streams (`limit(n)` materializes a prefix), support
+`with_probability_of_empty(p)`, and assemble into Tables via `random_data(...)`.
+"""
+from .generators import (
+    RandomStream,
+    RandomBinary,
+    RandomGeolocation,
+    RandomIntegral,
+    RandomList,
+    RandomMap,
+    RandomMultiPickList,
+    RandomReal,
+    RandomText,
+    RandomVector,
+    random_data,
+)
+
+__all__ = [
+    "RandomStream",
+    "RandomBinary",
+    "RandomGeolocation",
+    "RandomIntegral",
+    "RandomList",
+    "RandomMap",
+    "RandomMultiPickList",
+    "RandomReal",
+    "RandomText",
+    "RandomVector",
+    "random_data",
+]

@@ -1,0 +1,411 @@
+"""Random typed-data generators (reference testkit/src/main/scala/com/salesforce/op/testkit/).
+
+Each generator is an infinite, seed-deterministic stream of python values in the shape
+`Column.build` expects for its feature kind (None = missing). `limit(n)` takes a prefix;
+`with_probability_of_empty(p)` mirrors the reference's ProbabilityOfEmpty mixin
+(ProbabilityOfEmpty.scala); `random_data` zips named streams into a Table the way
+RandomData/StandardRandomData do.
+
+Generators are *restartable*: each `limit`/iteration re-derives its rng from the seed, so
+the same generator yields the same prefix every time (the reference achieves this with
+reset-able scala Randoms seeded in the ctor).
+"""
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..types import Column, Table, kind_of
+
+
+class RandomStream:
+    """Infinite seeded stream of typed values (reference InfiniteStream.scala).
+
+    `producer(rng) -> value` draws one value; wrappers compose (empty-probability,
+    mapping). The feature-kind name travels along so `random_data` can build Columns.
+    """
+
+    def __init__(self, kind_name: str, producer: Callable[[np.random.Generator], Any],
+                 seed: int = 42):
+        self.kind_name = kind_name
+        self._factory = lambda: producer  # stateless producer reused across iterations
+        self.seed = seed
+
+    @classmethod
+    def stateful(cls, kind_name: str,
+                 factory: Callable[[], Callable[[np.random.Generator], Any]],
+                 seed: int = 42) -> "RandomStream":
+        """Stream whose producer carries per-iteration state (e.g. a date cursor);
+        factory() is called at the start of every iteration, so `limit` stays
+        deterministic and restartable."""
+        s = cls(kind_name, lambda rng: None, seed)
+        s._factory = factory
+        return s
+
+    @classmethod
+    def _from_factory(cls, kind_name, factory, seed) -> "RandomStream":
+        return cls.stateful(kind_name, factory, seed)
+
+    # --- configuration (reference ProbabilityOfEmpty.scala) ---------------------------
+    def with_probability_of_empty(self, p: float) -> "RandomStream":
+        """Each drawn value is independently replaced by None with probability p."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"probability of empty must be in [0, 1], got {p}")
+        inner_factory = self._factory
+
+        def factory():
+            inner = inner_factory()
+            return lambda rng: None if rng.random() < p else inner(rng)
+
+        return RandomStream._from_factory(self.kind_name, factory, self.seed)
+
+    def with_seed(self, seed: int) -> "RandomStream":
+        s = RandomStream(self.kind_name, lambda rng: None, seed)
+        s._factory = self._factory
+        return s
+
+    def map(self, fn: Callable[[Any], Any], kind_name: Optional[str] = None) -> "RandomStream":
+        inner_factory = self._factory
+
+        def factory():
+            inner = inner_factory()
+
+            def produce(rng):
+                v = inner(rng)
+                return None if v is None else fn(v)
+
+            return produce
+
+        return RandomStream._from_factory(kind_name or self.kind_name, factory, self.seed)
+
+    # --- consumption ------------------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        rng = np.random.default_rng(self.seed)
+        produce = self._factory()
+        while True:
+            yield produce(rng)
+
+    def limit(self, n: int) -> list:
+        """Materialize the first n values (reference `take(n)`); deterministic."""
+        it = iter(self)
+        return [next(it) for _ in range(n)]
+
+    def column(self, n: int) -> Column:
+        return Column.build(kind_of(self.kind_name), self.limit(n))
+
+
+# --- numerics (reference RandomReal.scala) -----------------------------------------------
+class RandomReal:
+    """Factories for Real-family streams; kind defaults to Real (use kind= for
+    Currency/Percent/RealNN)."""
+
+    @staticmethod
+    def normal(mean: float = 0.0, sigma: float = 1.0, kind: str = "Real",
+               seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.normal(mean, sigma)), seed)
+
+    @staticmethod
+    def uniform(low: float = 0.0, high: float = 1.0, kind: str = "Real",
+                seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.uniform(low, high)), seed)
+
+    @staticmethod
+    def poisson(lam: float = 1.0, kind: str = "Real", seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.poisson(lam)), seed)
+
+    @staticmethod
+    def exponential(scale: float = 1.0, kind: str = "Real", seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.exponential(scale)), seed)
+
+    @staticmethod
+    def gamma(shape: float = 2.0, scale: float = 1.0, kind: str = "Real",
+              seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.gamma(shape, scale)), seed)
+
+    @staticmethod
+    def lognormal(mean: float = 0.0, sigma: float = 1.0, kind: str = "Real",
+                  seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.lognormal(mean, sigma)), seed)
+
+    @staticmethod
+    def weibull(a: float = 1.5, kind: str = "Real", seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: float(rng.weibull(a)), seed)
+
+
+class RandomIntegral:
+    """Reference RandomIntegral.scala: integers and date streams."""
+
+    @staticmethod
+    def integers(low: int = 0, high: int = 100, kind: str = "Integral",
+                 seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: int(rng.integers(low, high)), seed)
+
+    @staticmethod
+    def dates(start_ms: int = 1_500_000_000_000, max_step_ms: int = 86_400_000,
+              kind: str = "Date", seed: int = 42) -> RandomStream:
+        """Monotone timestamps: start + cumulative random steps (reference
+        RandomIntegral.dates). The cursor lives in per-iteration producer state, so
+        every fresh iteration restarts the walk and `limit(n)` stays deterministic."""
+
+        def factory():
+            cursor = [start_ms]
+
+            def produce(rng: np.random.Generator):
+                cursor[0] += int(rng.integers(1, max_step_ms))
+                return cursor[0]
+
+            return produce
+
+        return RandomStream.stateful(kind, factory, seed)
+
+
+class RandomBinary:
+    """Reference RandomBinary.scala."""
+
+    @staticmethod
+    def of(probability_of_true: float = 0.5, kind: str = "Binary",
+           seed: int = 42) -> RandomStream:
+        return RandomStream(kind, lambda rng: bool(rng.random() < probability_of_true), seed)
+
+
+# --- text (reference RandomText.scala) ---------------------------------------------------
+_DOMAINS = ("example.com", "sample.org", "test.net", "mail.io")
+_COUNTRIES = ("USA", "Canada", "Mexico", "France", "Germany", "Japan", "Brazil")
+_STATES = ("CA", "NY", "TX", "WA", "OR", "FL", "IL")
+_CITIES = ("Springfield", "Rivertown", "Lakeside", "Hillview", "Georgetown")
+_STREETS = ("Main St", "Oak Ave", "Pine Rd", "Maple Dr", "Cedar Ln")
+
+
+def _rand_word(rng: np.random.Generator, lo: int = 3, hi: int = 10) -> str:
+    n = int(rng.integers(lo, hi + 1))
+    letters = rng.integers(0, 26, size=n)
+    return "".join(string.ascii_lowercase[i] for i in letters)
+
+
+class RandomText:
+    """Factories for the Text family (reference RandomText.scala: strings, emails, urls,
+    phones, postalCodes, ids, uniqueIds, picklists, comboBoxes, base64, countries,
+    states, cities, streets, textAreas)."""
+
+    @staticmethod
+    def strings(min_words: int = 1, max_words: int = 5, kind: str = "Text",
+                seed: int = 42) -> RandomStream:
+        return RandomStream(
+            kind,
+            lambda rng: " ".join(
+                _rand_word(rng) for _ in range(int(rng.integers(min_words, max_words + 1)))
+            ),
+            seed,
+        )
+
+    @staticmethod
+    def text_areas(min_words: int = 5, max_words: int = 30, seed: int = 42) -> RandomStream:
+        return RandomText.strings(min_words, max_words, kind="TextArea", seed=seed)
+
+    @staticmethod
+    def emails(domains: Sequence[str] = _DOMAINS, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "Email",
+            lambda rng: f"{_rand_word(rng)}.{_rand_word(rng)}@"
+                        f"{domains[int(rng.integers(0, len(domains)))]}",
+            seed,
+        )
+
+    @staticmethod
+    def urls(domains: Sequence[str] = _DOMAINS, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "URL",
+            lambda rng: f"https://{domains[int(rng.integers(0, len(domains)))]}/"
+                        f"{_rand_word(rng)}",
+            seed,
+        )
+
+    @staticmethod
+    def phones(seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "Phone",
+            lambda rng: "+1" + "".join(str(d) for d in rng.integers(0, 10, size=10)),
+            seed,
+        )
+
+    @staticmethod
+    def postal_codes(seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "PostalCode",
+            lambda rng: "".join(str(d) for d in rng.integers(0, 10, size=5)),
+            seed,
+        )
+
+    @staticmethod
+    def ids(seed: int = 42) -> RandomStream:
+        return RandomStream("ID", lambda rng: f"id_{int(rng.integers(0, 10**9)):09d}", seed)
+
+    @staticmethod
+    def unique_ids(seed: int = 42) -> RandomStream:
+        """Sequential unique ids (reference RandomText.uniqueIds): a random per-stream
+        prefix plus a per-iteration counter, so ids are unique and monotone."""
+
+        def factory():
+            counter = [0]
+
+            def produce(rng: np.random.Generator):
+                if counter[0] == 0:
+                    counter.append(int(rng.integers(0, 2**31)))  # stream prefix
+                counter[0] += 1
+                return f"uid_{counter[1]:010d}_{counter[0]:09d}"
+
+            return produce
+
+        return RandomStream.stateful("ID", factory, seed)
+
+    @staticmethod
+    def picklists(domain: Sequence[str], kind: str = "PickList",
+                  seed: int = 42) -> RandomStream:
+        if not domain:
+            raise ValueError("picklists need a non-empty domain")
+        return RandomStream(
+            kind, lambda rng: domain[int(rng.integers(0, len(domain)))], seed
+        )
+
+    @staticmethod
+    def combo_boxes(domain: Sequence[str], seed: int = 42) -> RandomStream:
+        return RandomText.picklists(domain, kind="ComboBox", seed=seed)
+
+    @staticmethod
+    def base64(min_len: int = 8, max_len: int = 32, seed: int = 42) -> RandomStream:
+        import base64 as b64
+
+        def produce(rng: np.random.Generator):
+            n = int(rng.integers(min_len, max_len + 1))
+            return b64.b64encode(rng.bytes(n)).decode("ascii")
+
+        return RandomStream("Base64", produce, seed)
+
+    @staticmethod
+    def countries(seed: int = 42) -> RandomStream:
+        return RandomText.picklists(_COUNTRIES, kind="Country", seed=seed)
+
+    @staticmethod
+    def states(seed: int = 42) -> RandomStream:
+        return RandomText.picklists(_STATES, kind="State", seed=seed)
+
+    @staticmethod
+    def cities(seed: int = 42) -> RandomStream:
+        return RandomText.picklists(_CITIES, kind="City", seed=seed)
+
+    @staticmethod
+    def streets(seed: int = 42) -> RandomStream:
+        return RandomText.picklists(_STREETS, kind="Street", seed=seed)
+
+
+# --- collections (reference RandomList.scala, RandomSet.scala) ---------------------------
+class RandomList:
+    @staticmethod
+    def of_texts(min_len: int = 0, max_len: int = 5, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "TextList",
+            lambda rng: [_rand_word(rng) for _ in range(int(rng.integers(min_len, max_len + 1)))],
+            seed,
+        )
+
+    @staticmethod
+    def of_dates(start_ms: int = 1_500_000_000_000, max_step_ms: int = 3_600_000,
+                 min_len: int = 0, max_len: int = 5, kind: str = "DateList",
+                 seed: int = 42) -> RandomStream:
+        def produce(rng: np.random.Generator):
+            n = int(rng.integers(min_len, max_len + 1))
+            steps = rng.integers(1, max_step_ms, size=n) if n else []
+            return list(start_ms + np.cumsum(steps).astype(np.int64)) if n else []
+
+        return RandomStream(kind, produce, seed)
+
+
+class RandomMultiPickList:
+    @staticmethod
+    def of(domain: Sequence[str], min_len: int = 0, max_len: int = 3,
+           seed: int = 42) -> RandomStream:
+        if not domain:
+            raise ValueError("multipicklists need a non-empty domain")
+
+        def produce(rng: np.random.Generator):
+            n = int(rng.integers(min_len, min(max_len, len(domain)) + 1))
+            idx = rng.choice(len(domain), size=n, replace=False)
+            return frozenset(domain[i] for i in idx)
+
+        return RandomStream("MultiPickList", produce, seed)
+
+
+# --- maps (reference RandomMap.scala) ----------------------------------------------------
+class RandomMap:
+    @staticmethod
+    def of(value_stream: RandomStream, keys: Sequence[str], kind: Optional[str] = None,
+           min_size: int = 1, seed: int = 42) -> RandomStream:
+        """Map stream drawing each value from value_stream's producer; kind defaults to
+        `<ValueKind>Map` (reference RandomMap.of)."""
+        map_kind = kind or f"{value_stream.kind_name}Map"
+        kind_of(map_kind)  # validate early
+        inner_factory = value_stream._factory
+
+        def factory():
+            inner = inner_factory()
+
+            def produce(rng: np.random.Generator):
+                n = int(rng.integers(min_size, len(keys) + 1))
+                idx = rng.choice(len(keys), size=n, replace=False)
+                return {keys[i]: inner(rng) for i in sorted(idx)}
+
+            return produce
+
+        return RandomStream.stateful(map_kind, factory, seed)
+
+
+# --- vectors / geolocation (reference RandomVector.scala, RandomList.ofGeolocations) -----
+class RandomVector:
+    @staticmethod
+    def normal(dim: int, mean: float = 0.0, sigma: float = 1.0,
+               seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "OPVector",
+            lambda rng: rng.normal(mean, sigma, size=dim).astype(np.float32),
+            seed,
+        )
+
+    @staticmethod
+    def dense(dim: int, low: float = 0.0, high: float = 1.0, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "OPVector",
+            lambda rng: rng.uniform(low, high, size=dim).astype(np.float32),
+            seed,
+        )
+
+    @staticmethod
+    def sparse(dim: int, density: float = 0.1, seed: int = 42) -> RandomStream:
+        def produce(rng: np.random.Generator):
+            v = rng.normal(size=dim).astype(np.float32)
+            return np.where(rng.random(dim) < density, v, 0.0).astype(np.float32)
+
+        return RandomStream("OPVector", produce, seed)
+
+
+class RandomGeolocation:
+    @staticmethod
+    def of(seed: int = 42) -> RandomStream:
+        return RandomStream(
+            "Geolocation",
+            lambda rng: (
+                float(rng.uniform(-90, 90)),
+                float(rng.uniform(-180, 180)),
+                float(rng.integers(1, 10)),
+            ),
+            seed,
+        )
+
+
+# --- table assembly (reference RandomData.scala / StandardRandomData.scala) --------------
+def random_data(streams: dict[str, RandomStream], n: int) -> Table:
+    """Zip named streams into an n-row Table; each stream draws independently from its
+    own seed, so tables are reproducible per (streams, n)."""
+    cols = {name: s.column(n) for name, s in streams.items()}
+    return Table(cols, n)
