@@ -1,0 +1,251 @@
+"""Runner / params / codegen / streaming tests (reference OpWorkflowRunnerTest.scala,
+OpParamsTest, cli gen tests)."""
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.params import OpParams, ReaderParams
+from transmogrifai_tpu.readers import BatchStreamingReader, CSVStreamingReader, InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+
+def _rows(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "label": float(rng.random() > 0.5),
+            "x1": float(rng.normal()),
+            "cat": "abc"[int(rng.integers(0, 3))],
+        }
+        for _ in range(n)
+    ]
+
+
+SCHEMA = {"label": "RealNN", "x1": "Real", "cat": "PickList"}
+
+
+def _runner(rows=None, with_eval=True):
+    fs = features_from_schema(SCHEMA, response="label")
+    vec = transmogrify([fs["x1"], fs["cat"]])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    wf = Workflow().set_result_features(pred)
+    reader = InMemoryReader(rows or _rows())
+    ev = Evaluators.binary_classification("label", pred) if with_eval else None
+    return WorkflowRunner(wf, train_reader=reader, score_reader=reader, evaluator=ev), pred
+
+
+# --- OpParams ---------------------------------------------------------------------------
+def test_params_json_roundtrip(tmp_path):
+    p = OpParams(
+        stage_params={"LogisticRegression": {"l2": 0.5}},
+        reader_params={"default": ReaderParams(path="/data/x.csv")},
+        model_location="/m",
+        custom_tags={"team": "ds"},
+    )
+    f = tmp_path / "p.json"
+    f.write_text(p.to_json())
+    q = OpParams.from_json(str(f))
+    assert q.stage_params == p.stage_params
+    assert q.reader_params["default"].path == "/data/x.csv"
+    assert q.model_location == "/m"
+    assert q.custom_tags == {"team": "ds"}
+
+
+def test_params_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown OpParams"):
+        OpParams.from_json('{"no_such_key": 1}')
+
+
+def test_stage_param_injection():
+    runner, _ = _runner()
+    stages = [
+        f.origin_stage
+        for rf in runner.workflow.result_features
+        for f in rf.all_features()
+        if f.origin_stage is not None
+    ]
+    params = OpParams(stage_params={"LogisticRegression": {"l2": 0.77}})
+    log = params.apply_to_stages(stages)
+    assert any("LogisticRegression" in e for e in log)
+    lr = [s for s in stages if type(s).__name__ == "LogisticRegression"]
+    assert lr and lr[0].params["l2"] == 0.77
+
+
+# --- run types --------------------------------------------------------------------------
+def test_train_then_score_and_evaluate(tmp_path):
+    runner, pred = _runner()
+    params = OpParams(
+        model_location=str(tmp_path / "model"),
+        metrics_location=str(tmp_path / "metrics.json"),
+        write_location=str(tmp_path / "scores.csv"),
+    )
+    tr = runner.run("train", params)
+    assert tr.run_type == "train"
+    assert os.path.exists(os.path.join(tr.model_location, "model.json"))
+    assert tr.metrics is not None and 0 <= tr.metrics.AuROC <= 1
+    assert json.load(open(params.metrics_location))["AuROC"] == pytest.approx(
+        tr.metrics.AuROC
+    )
+
+    sc = runner.run("score", params)
+    assert sc.n_rows == 160
+    with open(params.write_location) as fh:
+        rows = list(csv.DictReader(fh))
+    assert len(rows) == 160
+    assert any(k.endswith(".prediction") for k in rows[0])
+
+    ev = runner.run("evaluate", params)
+    assert ev.metrics.AuROC == pytest.approx(tr.metrics.AuROC)
+
+
+def test_features_run(tmp_path):
+    runner, _ = _runner()
+    params = OpParams(write_location=str(tmp_path / "features.csv"))
+    fr = runner.run("features", params)
+    assert fr.n_rows == 160
+    with open(params.write_location) as fh:
+        rows = list(csv.DictReader(fh))
+    assert set(rows[0]) == {"label", "x1", "cat"}
+
+
+def test_app_metrics_handler():
+    runner, _ = _runner()
+    seen = []
+    runner.add_application_end_handler(lambda m: seen.append(m))
+    runner.run("train", OpParams(custom_tags={"run": "t1"}))
+    assert len(seen) == 1
+    m = seen[0].to_dict()
+    assert m["run_type"] == "train"
+    assert m["custom_tags"] == {"run": "t1"}
+    assert any(s["name"] == "train" for s in m["stages"])
+    assert seen[0].app_duration_s > 0
+
+
+def test_streaming_score(tmp_path):
+    runner, _ = _runner()
+    runner.run("train", OpParams())
+    batches = [_rows(16, seed=i) for i in range(3)]
+    for b in batches:  # serving batches have no label
+        for r in b:
+            del r["label"]
+    runner.streaming_reader = BatchStreamingReader(batches)
+    params = OpParams(write_location=str(tmp_path / "stream"))
+    res = runner.run("streaming_score", params)
+    assert res.batches == 3
+    assert res.n_rows == 48
+    parts = sorted(os.listdir(tmp_path / "stream"))
+    assert parts == ["part-00000.csv", "part-00001.csv", "part-00002.csv"]
+
+
+def test_csv_streaming_reader(tmp_path):
+    for i in range(2):
+        with open(tmp_path / f"b{i}.csv", "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=["x1", "cat"])
+            w.writeheader()
+            for r in _rows(8, seed=i):
+                w.writerow({"x1": r["x1"], "cat": r["cat"]})
+    reader = CSVStreamingReader(str(tmp_path),
+                                transform=lambda r: {"x1": float(r["x1"]), "cat": r["cat"]})
+    batches = list(reader.stream())
+    assert [len(b) for b in batches] == [8, 8]
+    assert isinstance(batches[0][0]["x1"], float)
+
+
+# --- codegen ----------------------------------------------------------------------------
+def _write_titanic_like_csv(path, n=80):
+    rng = np.random.default_rng(1)
+    with open(path, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=["pid", "survived", "age", "sex", "fare"])
+        w.writeheader()
+        for i in range(n):
+            w.writerow({
+                "pid": i,
+                "survived": int(rng.random() > 0.6),
+                "age": round(float(rng.uniform(1, 80)), 1),
+                "sex": "male" if rng.random() > 0.4 else "female",
+                "fare": round(float(rng.uniform(5, 100)), 2),
+            })
+
+
+def test_infer_problem_kind():
+    from transmogrifai_tpu.cli.codegen import infer_problem_kind
+
+    assert infer_problem_kind(["0", "1", "0"]) == "binary"
+    assert infer_problem_kind(["a", "b", "c"]) == "multiclass"
+    assert infer_problem_kind(["1", "2", "3"]) == "multiclass"
+    assert infer_problem_kind(["1.5", "2.25", "3.75", "9.125"]) == "regression"
+
+
+def test_codegen_project_runs(tmp_path, monkeypatch):
+    data = tmp_path / "data.csv"
+    _write_titanic_like_csv(str(data))
+    from transmogrifai_tpu.cli.main import main
+
+    rc = main(["gen", "proj", "--input", str(data), "--id", "pid",
+               "--response", "survived", "--out", str(tmp_path)])
+    assert rc == 0
+    proj = tmp_path / "proj"
+    assert (proj / "main.py").exists() and (proj / "params.json").exists()
+
+    # the generated script trains end-to-end
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "main.py", "--type", "train", "--data", str(data)],
+        cwd=str(proj), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "train done" in out.stdout
+
+
+def test_cli_run_command(tmp_path):
+    app = tmp_path / "myapp.py"
+    data_rows = _rows(60)
+    import pickle
+
+    with open(tmp_path / "rows.pkl", "wb") as fh:
+        pickle.dump(data_rows, fh)
+    app.write_text(f'''
+import pickle
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+def make_runner():
+    rows = pickle.load(open({str(tmp_path / "rows.pkl")!r}, "rb"))
+    fs = features_from_schema({SCHEMA!r}, response="label")
+    vec = transmogrify([fs["x1"], fs["cat"]])
+    pred = LogisticRegression()(fs["label"], vec)
+    reader = InMemoryReader(rows)
+    return WorkflowRunner(Workflow().set_result_features(pred),
+                          train_reader=reader, score_reader=reader,
+                          evaluator=Evaluators.binary_classification("label", pred))
+''')
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.cli.main", "run",
+         "--app", "myapp:make_runner", "--type", "train",
+         "--model-location", str(tmp_path / "m")],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout)
+    assert payload["run_type"] == "train"
+    assert os.path.exists(tmp_path / "m" / "model.json")

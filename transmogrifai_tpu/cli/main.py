@@ -1,9 +1,75 @@
-"""`op` command-line entry point (analog of the reference's OpWorkflowRunner CLI +
-`transmogrifai gen` codegen CLI; reference OpWorkflowRunner.scala:390-424,
-cli/.../CommandParser.scala:82-123). Subcommands land with the runner layer."""
+"""`op` command-line entry point.
+
+Analog of the reference's runner CLI (scopt parsing in OpWorkflowRunner.scala:390-424,
+run-type dispatch :296-365) and the `transmogrifai gen` codegen CLI
+(cli/src/main/scala/com/salesforce/op/cli/CommandParser.scala:82-123).
+
+  op run --app mymodule:make_runner --type train --params params.json
+  op gen MyProject --input data.csv --id id --response label
+  op version
+"""
 from __future__ import annotations
 
+import argparse
+import importlib
 import sys
+
+
+def _cmd_run(argv) -> int:
+    ap = argparse.ArgumentParser(prog="op run", description="run a workflow app")
+    ap.add_argument("--app", required=True,
+                    help="module:function returning a WorkflowRunner "
+                         "(function takes no required args)")
+    ap.add_argument("--type", required=True, dest="run_type",
+                    choices=["train", "score", "features", "evaluate", "streaming_score"])
+    ap.add_argument("--params", default=None, help="OpParams JSON file or literal JSON")
+    ap.add_argument("--model-location", default=None)
+    ap.add_argument("--write-location", default=None)
+    ap.add_argument("--metrics-location", default=None)
+    args = ap.parse_args(argv)
+
+    from transmogrifai_tpu.params import OpParams
+
+    params = OpParams.from_json(args.params) if args.params else OpParams()
+    for attr in ("model_location", "write_location", "metrics_location"):
+        v = getattr(args, attr)
+        if v is not None:  # CLI flags override the params file
+            setattr(params, attr, v)
+
+    mod_name, _, fn_name = args.app.partition(":")
+    if not fn_name:
+        print("op run: --app must be module:function", file=sys.stderr)
+        return 2
+    sys.path.insert(0, ".")
+    runner = getattr(importlib.import_module(mod_name), fn_name)()
+    result = runner.run(args.run_type, params)
+    line = {k: v for k, v in vars(result).items() if v is not None and k != "metrics"}
+    if result.metrics is not None:
+        m = result.metrics
+        line["metrics"] = m.to_dict() if hasattr(m, "to_dict") else str(m)
+    import json
+
+    print(json.dumps(line, indent=1, default=str))
+    return 0
+
+
+def _cmd_gen(argv) -> int:
+    ap = argparse.ArgumentParser(prog="op gen", description="scaffold a project from CSV")
+    ap.add_argument("name")
+    ap.add_argument("--input", required=True, help="CSV file with header")
+    ap.add_argument("--id", required=True, dest="id_field")
+    ap.add_argument("--response", required=True)
+    ap.add_argument("--out", default=".")
+    ap.add_argument("--overwrite", action="store_true")
+    args = ap.parse_args(argv)
+    from .codegen import generate_project
+
+    proj = generate_project(
+        args.name, args.input, args.id_field, args.response,
+        out_dir=args.out, overwrite=args.overwrite,
+    )
+    print(f"generated {proj}/ (main.py, params.json, README.md)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -14,14 +80,21 @@ def main(argv=None) -> int:
         print(
             "usage: op <command> [args]\n\n"
             "commands:\n"
-            "  version   print framework version\n"
-            "  (train/score/evaluate/features/init arrive with the runner layer)"
+            "  run       run a workflow app (--app module:fn --type train|score|"
+            "features|evaluate|streaming_score)\n"
+            "  gen       scaffold a project from a CSV (--input --id --response)\n"
+            "  version   print framework version"
         )
         return 0
-    if argv[0] == "version":
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "version":
         print(__version__)
         return 0
-    print(f"op: unknown command {argv[0]!r}", file=sys.stderr)
+    if cmd == "run":
+        return _cmd_run(rest)
+    if cmd == "gen":
+        return _cmd_gen(rest)
+    print(f"op: unknown command {cmd!r}", file=sys.stderr)
     return 2
 
 

@@ -1,0 +1,82 @@
+"""Run-time parameters for workflows and runners.
+
+Analog of the reference's OpParams (features/src/main/scala/com/salesforce/op/OpParams.scala:
+81-233): per-stage parameter overrides keyed by stage class name or uid, reader params
+(data path + custom values), result/model/metrics locations, and freeform custom tags.
+JSON-loadable; injection into stages happens by registry name match — no reflection
+(the reference matches setter methods reflectively, OpWorkflow.scala:166-188).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ReaderParams:
+    """Where and how a reader loads data (reference OpParams reader params)."""
+
+    path: Optional[str] = None
+    partitions: Optional[int] = None
+    custom: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class OpParams:
+    #: {stage-class-name-or-uid: {param: value}} applied before fitting
+    stage_params: dict[str, dict[str, Any]] = field(default_factory=dict)
+    #: {reader-name: ReaderParams}; "default" applies when only one reader exists
+    reader_params: dict[str, ReaderParams] = field(default_factory=dict)
+    model_location: Optional[str] = None
+    write_location: Optional[str] = None     # scored-table output
+    metrics_location: Optional[str] = None   # evaluation metrics JSON
+    log_stage_metrics: bool = False          # per-stage timing into the run report
+    collect_stage_metrics: bool = True
+    custom_tags: dict[str, str] = field(default_factory=dict)
+    custom_params: dict[str, Any] = field(default_factory=dict)
+
+    # --- JSON -------------------------------------------------------------------------
+    @staticmethod
+    def from_json(path_or_str: str) -> "OpParams":
+        """Load from a JSON file path or a literal JSON string."""
+        if path_or_str.lstrip().startswith("{"):
+            raw = json.loads(path_or_str)
+        else:
+            with open(path_or_str) as fh:
+                raw = json.load(fh)
+        return OpParams.from_dict(raw)
+
+    @staticmethod
+    def from_dict(raw: dict) -> "OpParams":
+        rp = {
+            name: ReaderParams(**v) if isinstance(v, dict) else v
+            for name, v in raw.get("reader_params", {}).items()
+        }
+        known = {f for f in OpParams.__dataclass_fields__}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown OpParams keys {sorted(unknown)}; known: {sorted(known)}")
+        kwargs = {k: v for k, v in raw.items() if k != "reader_params"}
+        return OpParams(reader_params=rp, **kwargs)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=1)
+
+    # --- stage-param injection (analog of OpWorkflow.setStageParameters) --------------
+    def apply_to_stages(self, stages) -> list[str]:
+        """Override params on matching stages; match by stage uid first, then by class
+        name. Returns a log of applied overrides; unknown names are ignored the way the
+        reference logs-and-skips them."""
+        applied = []
+        for stage in stages:
+            for key in (stage.uid, type(stage).__name__):
+                overrides = self.stage_params.get(key)
+                if overrides:
+                    stage.params.update(overrides)
+                    applied.append(f"{key} <- {overrides}")
+        return applied
+
+    def reader_path(self, name: str = "default") -> Optional[str]:
+        rp = self.reader_params.get(name)
+        return rp.path if rp is not None else None

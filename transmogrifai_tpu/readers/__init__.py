@@ -11,6 +11,7 @@ from .joined import (
     left_outer_join,
     outer_join,
 )
+from .streaming import BatchStreamingReader, CSVStreamingReader, StreamingReader
 
 
 class Simple:
@@ -84,5 +85,8 @@ __all__ = [
     "left_outer_join",
     "inner_join",
     "outer_join",
+    "StreamingReader",
+    "BatchStreamingReader",
+    "CSVStreamingReader",
     "KEY_COLUMN",
 ]

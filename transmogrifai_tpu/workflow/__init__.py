@@ -1,3 +1,12 @@
+from .runner import AppMetrics, RunResult, StageMetric, WorkflowRunner, write_table_csv
 from .workflow import Workflow, WorkflowModel
 
-__all__ = ["Workflow", "WorkflowModel"]
+__all__ = [
+    "Workflow",
+    "WorkflowModel",
+    "WorkflowRunner",
+    "RunResult",
+    "AppMetrics",
+    "StageMetric",
+    "write_table_csv",
+]

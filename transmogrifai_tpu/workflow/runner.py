@@ -1,0 +1,256 @@
+"""Workflow runner: train / score / features / evaluate / streaming-score dispatch.
+
+Analog of OpWorkflowRunner + OpApp (reference core/src/main/scala/com/salesforce/op/
+OpWorkflowRunner.scala:163-365, OpApp.scala:49-213). The Spark-session bootstrap
+disappears (JAX owns the device); what remains is the run-type dispatch, result
+persistence (model dir, scored table, metrics JSON), and an AppMetrics report emitted to
+registered application-end handlers (OpWorkflowRunner.scala:145-160) — the
+OpSparkListener stage-metrics analog is per-phase wall-clock collected here.
+"""
+from __future__ import annotations
+
+import csv as _csv
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from ..params import OpParams
+from ..readers.base import DataReader
+from ..types import Storage, Table
+from .workflow import Workflow, WorkflowModel
+
+RUN_TYPES = ("train", "score", "features", "evaluate", "streaming_score")
+
+
+@dataclass
+class StageMetric:
+    """Wall-clock of one runner phase (OpSparkListener's StageMetrics analog)."""
+
+    name: str
+    wall_s: float
+
+
+@dataclass
+class AppMetrics:
+    """End-of-run report handed to app-end handlers (OpWorkflowRunner.scala:145-160)."""
+
+    run_type: str
+    start_time: float
+    end_time: float = 0.0
+    stage_metrics: list[StageMetric] = field(default_factory=list)
+    custom_tags: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def app_duration_s(self) -> float:
+        return self.end_time - self.start_time
+
+    def to_dict(self) -> dict:
+        return {
+            "run_type": self.run_type,
+            "app_duration_s": round(self.app_duration_s, 4),
+            "stages": [
+                {"name": m.name, "wall_s": round(m.wall_s, 4)} for m in self.stage_metrics
+            ],
+            "custom_tags": dict(self.custom_tags),
+        }
+
+
+@dataclass
+class RunResult:
+    """Outcome of one runner invocation (analog of the *Result classes,
+    OpWorkflowRunner.scala:445-458)."""
+
+    run_type: str
+    model_location: Optional[str] = None
+    write_location: Optional[str] = None
+    metrics_location: Optional[str] = None
+    metrics: Optional[Any] = None
+    n_rows: Optional[int] = None
+    batches: Optional[int] = None
+
+
+def write_table_csv(table: Table, path: str) -> None:
+    """Scored-table persistence: predictions flatten to prediction/probability_i columns
+    (the reference writes Avro via RichDataset.saveAvro; CSV is this build's default
+    host format)."""
+    rows = table.to_rows()
+    names: list[str] = []
+    for name in table.names():
+        col = table[name]
+        if col.kind.storage is Storage.PREDICTION:
+            import numpy as np
+
+            pred = np.asarray(col.values["prediction"])
+            prob = np.asarray(col.values["probability"])
+            for i, r in enumerate(rows):
+                r.pop(name, None)
+                r[f"{name}.prediction"] = float(pred[i])
+                for c in range(prob.shape[1]):
+                    r[f"{name}.probability_{c}"] = float(prob[i, c])
+            names.extend([f"{name}.prediction"] +
+                         [f"{name}.probability_{c}" for c in range(prob.shape[1])])
+        else:
+            names.append(name)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", newline="") as fh:
+        w = _csv.DictWriter(fh, fieldnames=names, extrasaction="ignore")
+        w.writeheader()
+        for r in rows:
+            w.writerow({k: ("" if v is None else v) for k, v in r.items()})
+
+
+class WorkflowRunner:
+    """Dispatch one run type over a workflow (analog of OpWorkflowRunner.run)."""
+
+    def __init__(
+        self,
+        workflow: Workflow,
+        train_reader: Optional[DataReader] = None,
+        score_reader: Optional[DataReader] = None,
+        streaming_reader: Optional[Any] = None,
+        evaluator: Optional[Any] = None,
+        features_to_compute: Sequence[Any] = (),
+    ):
+        self.workflow = workflow
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+        self.streaming_reader = streaming_reader
+        self.evaluator = evaluator
+        self.features_to_compute = tuple(features_to_compute)
+        self._end_handlers: list[Callable[[AppMetrics], None]] = []
+
+    def add_application_end_handler(self, fn: Callable[[AppMetrics], None]) -> None:
+        self._end_handlers.append(fn)
+
+    # --- dispatch (OpWorkflowRunner.scala:296-365) ------------------------------------
+    def run(self, run_type: str, params: Optional[OpParams] = None) -> RunResult:
+        params = params or OpParams()
+        if run_type not in RUN_TYPES:
+            raise ValueError(f"run type must be one of {RUN_TYPES}, got {run_type!r}")
+        metrics = AppMetrics(run_type, start_time=time.time(),
+                             custom_tags=dict(params.custom_tags))
+        phase_t0 = time.time()
+
+        def mark(name: str) -> None:
+            nonlocal phase_t0
+            now = time.time()
+            metrics.stage_metrics.append(StageMetric(name, now - phase_t0))
+            phase_t0 = now
+
+        try:
+            result = getattr(self, f"_run_{run_type}")(params, mark)
+        finally:
+            metrics.end_time = time.time()
+            for h in self._end_handlers:
+                h(metrics)
+        result.metrics_location = result.metrics_location or params.metrics_location
+        return result
+
+    # --- run types --------------------------------------------------------------------
+    def _run_train(self, params: OpParams, mark) -> RunResult:
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        stages = [f.origin_stage for rf in self.workflow.result_features
+                  for f in rf.all_features() if f.origin_stage is not None]
+        params.apply_to_stages(stages)
+        model = self.workflow.train()
+        mark("train")
+        loc = params.model_location
+        if loc:
+            model.save(loc, overwrite=True)
+            mark("save_model")
+        train_metrics = None
+        if self.evaluator is not None:
+            train_metrics = model.evaluate(self.evaluator)
+            self._write_metrics(train_metrics, params.metrics_location)
+            mark("evaluate")
+        self._model = model
+        return RunResult("train", model_location=loc, metrics=train_metrics,
+                         metrics_location=params.metrics_location)
+
+    def _load_model(self, params: OpParams) -> WorkflowModel:
+        model = getattr(self, "_model", None)
+        if model is None:
+            if not params.model_location:
+                raise ValueError("score/evaluate needs model_location (or a prior train run)")
+            model = WorkflowModel.load(params.model_location)
+        return model
+
+    def _run_score(self, params: OpParams, mark) -> RunResult:
+        model = self._load_model(params)
+        mark("load_model")
+        scores = model.score(reader=self.score_reader, keep_intermediate=True)
+        mark("score")
+        out = model.transform_select(scores)
+        loc = params.write_location
+        if loc:
+            write_table_csv(out, loc)
+            mark("write_scores")
+        eval_metrics = None
+        if self.evaluator is not None:
+            eval_metrics = self.evaluator.evaluate_all(scores)
+            self._write_metrics(eval_metrics, params.metrics_location)
+            mark("evaluate")
+        return RunResult("score", write_location=loc, metrics=eval_metrics,
+                         n_rows=out.nrows)
+
+    def _run_features(self, params: OpParams, mark) -> RunResult:
+        """Compute and persist just the raw features (OpWorkflowRunner.scala:190)."""
+        reader = self.train_reader or self.workflow.reader
+        if reader is None:
+            raise ValueError("features run needs a reader")
+        feats = list(self.features_to_compute) or list(self.workflow.raw_features)
+        table = reader.generate_table(feats)
+        mark("compute_features")
+        loc = params.write_location
+        if loc:
+            write_table_csv(table, loc)
+            mark("write_features")
+        return RunResult("features", write_location=loc, n_rows=table.nrows)
+
+    def _run_evaluate(self, params: OpParams, mark) -> RunResult:
+        if self.evaluator is None:
+            raise ValueError("evaluate run needs an evaluator")
+        model = self._load_model(params)
+        mark("load_model")
+        scores = model.score(reader=self.score_reader, keep_intermediate=True)
+        eval_metrics = self.evaluator.evaluate_all(scores)
+        mark("evaluate")
+        self._write_metrics(eval_metrics, params.metrics_location)
+        return RunResult("evaluate", metrics=eval_metrics,
+                         metrics_location=params.metrics_location)
+
+    def _run_streaming_score(self, params: OpParams, mark) -> RunResult:
+        """Micro-batch scoring loop (the DStream analog, OpWorkflowRunner.scala:232):
+        each batch from the streaming reader is scored with the same jit-cached plan;
+        batch outputs append as CSV parts under write_location."""
+        if self.streaming_reader is None:
+            raise ValueError("streaming_score run needs a streaming reader")
+        model = self._load_model(params)
+        mark("load_model")
+        loc = params.write_location
+        n_rows = 0
+        n_batches = 0
+        for batch in self.streaming_reader.stream():
+            table = batch if isinstance(batch, Table) else Table.from_rows(
+                batch, {f.name: f.kind for f in model.raw_features if not f.is_response}
+            )
+            scored = model.score(table=table)
+            n_rows += scored.nrows
+            if loc:
+                write_table_csv(scored, os.path.join(loc, f"part-{n_batches:05d}.csv"))
+            n_batches += 1
+        mark("streaming_score")
+        return RunResult("streaming_score", write_location=loc, n_rows=n_rows,
+                         batches=n_batches)
+
+    @staticmethod
+    def _write_metrics(metrics: Any, location: Optional[str]) -> None:
+        if not location:
+            return
+        os.makedirs(os.path.dirname(location) or ".", exist_ok=True)
+        payload = metrics.to_dict() if hasattr(metrics, "to_dict") else metrics.__dict__
+        with open(location, "w") as fh:
+            json.dump(payload, fh, indent=1, default=float)
