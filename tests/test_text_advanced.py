@@ -1,0 +1,325 @@
+"""Advanced text stages + parsers + misc transforms (reference OpNGramTest,
+OpStopWordsRemoverTest, OpCountVectorizerTest, NGramSimilarityTest, LangDetectorTest,
+MimeTypeDetectorTest, OpWord2VecTest, OpLDATest, ScalerTransformerTest, FilterMapTest,
+TimePeriodTransformerTest)."""
+import base64
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu  # noqa: F401 (attach dsl)
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.stages.feature import (
+    LDA,
+    Base64ToText,
+    CountVectorizer,
+    DescalerTransformer,
+    EmailToDomain,
+    FilterMap,
+    IsValidEmail,
+    IsValidPhone,
+    IsValidUrl,
+    JaccardSimilarity,
+    LangDetector,
+    MimeTypeDetector,
+    NGram,
+    NGramSimilarity,
+    NameEntityRecognizer,
+    ParsePhone,
+    ScalerTransformer,
+    StopWordsRemover,
+    TimePeriodTransformer,
+    UrlToDomain,
+    Word2Vec,
+)
+from transmogrifai_tpu.types import Column, Table, kind_of
+
+
+def _col(kind, vals):
+    return Column.build(kind_of(kind), vals)
+
+
+def _apply(stage, feats, table):
+    out_feature = stage(*feats)
+    return stage.transform_columns([table[f.name] for f in feats]), out_feature
+
+
+# --- n-grams / stop words / counting ----------------------------------------------------
+def test_ngram():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", [["a", "b", "c"], ["x"], []])}, 3)
+    out, _ = _apply(NGram(n=2), [f], t)
+    assert list(out.values) == [["a b", "b c"], [], []]
+    with pytest.raises(ValueError):
+        NGram(n=0)
+
+
+def test_stop_words_removed():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", [["the", "Quick", "fox", "and", "I"]])}, 1)
+    out, _ = _apply(StopWordsRemover(), [f], t)
+    assert list(out.values) == [["Quick", "fox"]]
+    out2 = StopWordsRemover(stop_words=["fox"]).transform_columns([t["toks"]])
+    assert list(out2.values) == [["the", "Quick", "and", "I"]]
+
+
+def test_count_vectorizer_vocab_and_counts():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    docs = [["a", "b", "a"], ["b", "c"], ["a"]]
+    t = Table({"toks": _col("TextList", docs)}, 3)
+    est = CountVectorizer(vocab_size=2, min_df=2)
+    est(f)
+    model = est.fit_table(t)
+    assert model.params["vocabulary"] == ["a", "b"]  # c has df 1 < 2
+    out = model.transform_columns([t["toks"]])
+    assert np.asarray(out.values).tolist() == [[2, 1], [0, 1], [1, 0]]
+    assert [s.indicator_value for s in out.schema.slots] == ["a", "b"]
+
+
+# --- similarities -----------------------------------------------------------------------
+def test_ngram_similarity():
+    a = FeatureBuilder.Text("a").as_predictor()
+    b = FeatureBuilder.Text("b").as_predictor()
+    t = Table({"a": _col("Text", ["hello", "abc", None]),
+               "b": _col("Text", ["hello", "xyz", "q"])}, 3)
+    out, _ = _apply(NGramSimilarity(n=3), [a, b], t)
+    v = np.asarray(out.values)[:, 0]
+    assert v[0] == pytest.approx(1.0)  # identical
+    assert v[1] < 0.2                  # disjoint
+    assert v[2] == 0.0                 # one missing
+
+
+def test_jaccard_similarity():
+    a = FeatureBuilder.MultiPickList("a").as_predictor()
+    b = FeatureBuilder.MultiPickList("b").as_predictor()
+    t = Table({"a": _col("MultiPickList", [{"x", "y"}, set(), {"p"}]),
+               "b": _col("MultiPickList", [{"y", "z"}, set(), {"q"}])}, 3)
+    out, _ = _apply(JaccardSimilarity(), [a, b], t)
+    v = np.asarray(out.values)[:, 0]
+    assert v[0] == pytest.approx(1 / 3)
+    assert v[1] == 1.0  # both empty = identical (reference semantics)
+    assert v[2] == 0.0
+
+
+# --- detectors --------------------------------------------------------------------------
+def test_lang_detector():
+    f = FeatureBuilder.Text("txt").as_predictor()
+    t = Table({"txt": _col("Text", [
+        "the quick fox and the lazy dog are in the yard",
+        "el perro y el gato en la casa son de su amigo",
+        None,
+    ])}, 3)
+    out, feat = _apply(LangDetector(), [f], t)
+    assert feat.kind.name == "RealMap"
+    assert max(out.values[0], key=out.values[0].get) == "en"
+    assert max(out.values[1], key=out.values[1].get) == "es"
+    assert out.values[2] == {}
+    with pytest.raises(ValueError, match="unsupported"):
+        LangDetector(languages=["xx"])
+
+
+def test_name_entity_recognizer():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList",
+                            [["Alice", "met", "Bob", "in", "Paris", "today"]])}, 1)
+    out, feat = _apply(NameEntityRecognizer(), [f], t)
+    assert feat.kind.name == "MultiPickList"
+    assert out.values[0] == {"Bob", "Paris"}  # Alice is sentence-initial
+
+
+def test_mime_type_detector():
+    f = FeatureBuilder.Base64("b").as_predictor()
+    vals = [
+        base64.b64encode(b"%PDF-1.4 ...").decode(),
+        base64.b64encode(b"\x89PNG\r\n").decode(),
+        base64.b64encode(b"hello world").decode(),
+        None,
+    ]
+    t = Table({"b": _col("Base64", vals)}, 4)
+    out, _ = _apply(MimeTypeDetector(), [f], t)
+    assert list(out.values) == ["application/pdf", "image/png", "text/plain", None]
+
+
+# --- word2vec / LDA ---------------------------------------------------------------------
+def test_word2vec_embeds_related_words_closer():
+    rng = np.random.default_rng(0)
+    docs = []
+    for _ in range(200):  # two disjoint topic vocabularies
+        topic = ["cat", "dog", "pet"] if rng.random() < 0.5 else ["car", "road", "drive"]
+        docs.append([topic[rng.integers(0, 3)] for _ in range(6)])
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", docs)}, len(docs))
+    est = Word2Vec(dim=16, epochs=40, seed=0)
+    est(f)
+    model = est.fit_table(t)
+    vecs = {w: np.asarray(model.params["vectors"])[i]
+            for i, w in enumerate(model.params["vocabulary"])}
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+    assert cos(vecs["cat"], vecs["dog"]) > cos(vecs["cat"], vecs["car"])
+    out = model.transform_columns([t["toks"]])
+    assert np.asarray(out.values).shape == (len(docs), 16)
+
+
+def test_word2vec_empty_vocab():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", [[], []])}, 2)
+    est = Word2Vec(dim=4, min_count=1)
+    est(f)
+    model = est.fit_table(t)
+    out = model.transform_columns([t["toks"]])
+    assert np.asarray(out.values).tolist() == [[0.0] * 4, [0.0] * 4]
+
+
+def test_lda_separates_topics():
+    rng = np.random.default_rng(1)
+    V, N = 20, 100
+    X = np.zeros((N, V), np.float32)
+    for i in range(N):  # docs draw from first or second half of the vocabulary
+        lo = 0 if i % 2 == 0 else V // 2
+        idx = rng.integers(lo, lo + V // 2, size=30)
+        np.add.at(X[i], idx, 1.0)
+    vecf = FeatureBuilder.OPVector("v").as_predictor()
+    t = Table({"v": Column.vector(X)}, N)
+    est = LDA(k=2, iters=100, seed=0)
+    est(vecf)
+    model = est.fit_table(t)
+    theta = np.asarray(model.transform_columns([t["v"]]).values)
+    assert theta.shape == (N, 2)
+    assert np.allclose(theta.sum(axis=1), 1.0, atol=1e-3)
+    even, odd = theta[::2].mean(axis=0), theta[1::2].mean(axis=0)
+    assert abs(even - odd).max() > 0.8  # the two doc groups land on distinct topics
+
+
+# --- parsers ----------------------------------------------------------------------------
+def test_email_stages():
+    f = FeatureBuilder.Email("e").as_predictor()
+    vals = ["a.b@Example.COM", "bad@@x", None, "ok@test.io"]
+    t = Table({"e": _col("Email", vals)}, 4)
+    dom, feat = _apply(EmailToDomain(), [f], t)
+    assert feat.kind.name == "PickList"
+    assert list(dom.values) == ["example.com", None, None, "test.io"]
+    f2 = FeatureBuilder.Email("e2").as_predictor()
+    valid = IsValidEmail()
+    valid(f2)
+    out = valid.transform_columns([t["e"]])
+    assert out.to_list() == [True, False, None, True]
+
+
+def test_phone_stages():
+    f = FeatureBuilder.Phone("p").as_predictor()
+    vals = ["(650) 123-4567", "+1 650 123 4567", "123", None]
+    t = Table({"p": _col("Phone", vals)}, 4)
+    parsed, _ = _apply(ParsePhone(), [f], t)
+    assert list(parsed.values) == ["6501234567", "6501234567", None, None]
+    f2 = FeatureBuilder.Phone("p2").as_predictor()
+    v = IsValidPhone()
+    v(f2)
+    assert v.transform_columns([t["p"]]).to_list() == [True, True, False, None]
+
+
+def test_url_stages():
+    f = FeatureBuilder.URL("u").as_predictor()
+    vals = ["https://Sub.Example.com/x?q=1", "notaurl", "ftp://files.org/a", None]
+    t = Table({"u": _col("URL", vals)}, 4)
+    dom, _ = _apply(UrlToDomain(), [f], t)
+    assert list(dom.values) == ["sub.example.com", None, "files.org", None]
+    f2 = FeatureBuilder.URL("u2").as_predictor()
+    v = IsValidUrl()
+    v(f2)
+    assert v.transform_columns([t["u"]]).to_list() == [True, False, True, None]
+
+
+def test_base64_to_text():
+    f = FeatureBuilder.Base64("b").as_predictor()
+    vals = [base64.b64encode("héllo".encode()).decode(), "!!notb64!!", None]
+    t = Table({"b": _col("Base64", vals)}, 3)
+    out, _ = _apply(Base64ToText(), [f], t)
+    assert list(out.values) == ["héllo", None, None]
+
+
+# --- scaler / descaler / time period / filter map ---------------------------------------
+def test_scaler_descaler_roundtrip():
+    f = FeatureBuilder.Real("x").as_predictor()
+    t = Table({"x": _col("Real", [1.0, 10.0, 100.0])}, 3)
+    sc = ScalerTransformer(scaling_type="log")
+    scaled_f = sc(f)
+    scaled = sc.transform_columns([t["x"]])
+    assert np.asarray(scaled.values) == pytest.approx(np.log([1, 10, 100]), abs=1e-5)
+    pred = FeatureBuilder.Real("pred").as_predictor()
+    de = DescalerTransformer()
+    de(pred, scaled_f)
+    back = de.transform_columns([scaled, scaled])
+    assert np.asarray(back.values) == pytest.approx([1.0, 10.0, 100.0], rel=1e-4)
+
+    lin = ScalerTransformer(scaling_type="linear", slope=2.0, intercept=3.0)
+    linf = lin(FeatureBuilder.Real("y").as_predictor())
+    lout = lin.transform_columns([t["x"]])
+    assert np.asarray(lout.values) == pytest.approx([5.0, 23.0, 203.0])
+    de2 = DescalerTransformer()
+    de2(pred.alias("p2"), linf)
+    assert np.asarray(de2.transform_columns([lout, lout]).values) == pytest.approx(
+        [1.0, 10.0, 100.0])
+
+
+def test_time_period_transformer():
+    f = FeatureBuilder.DateTime("d").as_predictor()
+    # 2020-03-15T13:00:00Z was a Sunday
+    ms = 1584277200000
+    t = Table({"d": _col("DateTime", [ms, None])}, 2)
+    for period, want in [("DayOfWeek", 7), ("DayOfMonth", 15), ("MonthOfYear", 3),
+                         ("HourOfDay", 13), ("DayOfYear", 75)]:
+        st = TimePeriodTransformer(period=period)
+        st(FeatureBuilder.DateTime(f"d_{period}").as_predictor())
+        out = st.transform_columns([t["d"]])
+        assert out.to_list()[0] == want, period
+        assert out.to_list()[1] is None
+    with pytest.raises(ValueError):
+        TimePeriodTransformer(period="Nope")
+
+
+def test_filter_map():
+    f = FeatureBuilder.TextMap("m").as_predictor()
+    t = Table({"m": _col("TextMap", [{"a": "1", "b": "", "c": "3"}, None])}, 2)
+    st = FilterMap(blacklist=["c"])
+    st(f)
+    out = st.transform_columns([t["m"]])
+    assert out.values[0] == {"a": "1"}  # b dropped as empty, c blacklisted
+    assert out.values[1] == {}
+    st2 = FilterMap(whitelist=["a"], filter_empty=False)
+    st2(FeatureBuilder.TextMap("m2").as_predictor())
+    assert st2.transform_columns([t["m"]]).values[0] == {"a": "1"}
+
+
+# --- dsl wiring end-to-end --------------------------------------------------------------
+def test_dsl_text_pipeline_trains():
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(5)
+    animals = ["cat", "dog", "pet", "fur"]
+    cars = ["car", "road", "gas", "wheel"]
+    rows = []
+    for _ in range(120):
+        is_animal = rng.random() < 0.5
+        words = animals if is_animal else cars
+        rows.append({
+            "label": float(is_animal),
+            "bio": " ".join(words[rng.integers(0, 4)] for _ in range(5)),
+        })
+    label = FeatureBuilder.RealNN("label").as_response()
+    bio = FeatureBuilder.Text("bio").as_predictor()
+    toks = bio.tokenize().remove_stop_words()
+    counts = toks.count_vectorize(vocab_size=16, min_df=2)
+    pred = LogisticRegression(max_iter=50)(label, counts)
+    model = Workflow().set_result_features(pred).train(
+        table=InMemoryReader(rows).generate_table([label, bio]))
+    out = model.score(table=InMemoryReader(rows).generate_table([label, bio]),
+                      keep_intermediate=True)
+    probs = np.asarray(out[pred.name].values["probability"])[:, 1]
+    y = np.asarray([r["label"] for r in rows])
+    acc = ((probs > 0.5) == y).mean()
+    assert acc > 0.95  # separable by construction

@@ -149,10 +149,143 @@ def hash_vectorize(self: Feature, *others: Feature, **params) -> Feature:
     return HashingVectorizer(**params)(self, *others)
 
 
+def ngram(self: Feature, n: int = 2, sep: str = " ") -> Feature:
+    from ..stages.feature.text_advanced import NGram
+
+    return NGram(n=n, sep=sep)(self)
+
+
+def remove_stop_words(self: Feature, stop_words: Optional[Sequence[str]] = None,
+                      case_sensitive: bool = False) -> Feature:
+    from ..stages.feature.text_advanced import StopWordsRemover
+
+    return StopWordsRemover(stop_words=stop_words, case_sensitive=case_sensitive)(self)
+
+
+def count_vectorize(self: Feature, *others: Feature, **params) -> Feature:
+    from ..stages.feature.text_advanced import CountVectorizer
+
+    return CountVectorizer(**params)(self, *others)
+
+
+def ngram_similarity(self: Feature, other: Feature, n: int = 3) -> Feature:
+    from ..stages.feature.text_advanced import NGramSimilarity
+
+    return NGramSimilarity(n=n)(self, other)
+
+
+def jaccard_similarity(self: Feature, other: Feature) -> Feature:
+    from ..stages.feature.text_advanced import JaccardSimilarity
+
+    return JaccardSimilarity()(self, other)
+
+
+def detect_languages(self: Feature, languages: Optional[Sequence[str]] = None,
+                     top_k: int = 3) -> Feature:
+    from ..stages.feature.text_advanced import LangDetector
+
+    return LangDetector(languages=languages, top_k=top_k)(self)
+
+
+def recognize_entities(self: Feature) -> Feature:
+    from ..stages.feature.text_advanced import NameEntityRecognizer
+
+    return NameEntityRecognizer()(self)
+
+
+def detect_mime_types(self: Feature, type_hint: Optional[str] = None) -> Feature:
+    from ..stages.feature.text_advanced import MimeTypeDetector
+
+    return MimeTypeDetector(type_hint=type_hint)(self)
+
+
+def word2vec(self: Feature, **params) -> Feature:
+    from ..stages.feature.text_advanced import Word2Vec
+
+    return Word2Vec(**params)(self)
+
+
+def lda_topics(self: Feature, k: int = 10, **params) -> Feature:
+    from ..stages.feature.text_advanced import LDA
+
+    return LDA(k=k, **params)(self)
+
+
+def to_email_domain(self: Feature) -> Feature:
+    from ..stages.feature.parsers import EmailToDomain
+
+    return EmailToDomain()(self)
+
+
+def is_valid_email(self: Feature) -> Feature:
+    from ..stages.feature.parsers import IsValidEmail
+
+    return IsValidEmail()(self)
+
+
+def parse_phone(self: Feature, default_region: str = "US") -> Feature:
+    from ..stages.feature.parsers import ParsePhone
+
+    return ParsePhone(default_region=default_region)(self)
+
+
+def is_valid_phone(self: Feature, default_region: str = "US") -> Feature:
+    from ..stages.feature.parsers import IsValidPhone
+
+    return IsValidPhone(default_region=default_region)(self)
+
+
+def to_url_domain(self: Feature) -> Feature:
+    from ..stages.feature.parsers import UrlToDomain
+
+    return UrlToDomain()(self)
+
+
+def is_valid_url(self: Feature) -> Feature:
+    from ..stages.feature.parsers import IsValidUrl
+
+    return IsValidUrl()(self)
+
+
+def b64_to_text(self: Feature) -> Feature:
+    from ..stages.feature.parsers import Base64ToText
+
+    return Base64ToText()(self)
+
+
+def scale_feature(self: Feature, scaling_type: str = "linear", slope: float = 1.0,
+                  intercept: float = 0.0) -> Feature:
+    from ..stages.feature.misc import ScalerTransformer
+
+    return ScalerTransformer(scaling_type=scaling_type, slope=slope,
+                             intercept=intercept)(self)
+
+
+def descale_feature(self: Feature, scaled: Feature) -> Feature:
+    from ..stages.feature.misc import DescalerTransformer
+
+    return DescalerTransformer()(self, scaled)
+
+
+def filter_map(self: Feature, whitelist: Optional[Sequence[str]] = None,
+               blacklist: Optional[Sequence[str]] = None,
+               filter_empty: bool = True) -> Feature:
+    from ..stages.feature.misc import FilterMap
+
+    return FilterMap(whitelist=whitelist, blacklist=blacklist,
+                     filter_empty=filter_empty)(self)
+
+
 # --- date enrichments (RichDateFeature.scala) ---------------------------------------------
 def to_unit_circle(self: Feature, time_periods: Optional[Sequence[str]] = None) -> Feature:
     kw = {} if time_periods is None else {"time_periods": tuple(time_periods)}
     return DateToUnitCircleVectorizer(**kw)(self)
+
+
+def to_time_period(self: Feature, period: str = "DayOfWeek") -> Feature:
+    from ..stages.feature.misc import TimePeriodTransformer
+
+    return TimePeriodTransformer(period=period)(self)
 
 
 def _attach() -> None:
@@ -187,6 +320,27 @@ def _attach() -> None:
     Feature.text_len = text_len
     Feature.hash_vectorize = hash_vectorize
     Feature.to_unit_circle = to_unit_circle
+    Feature.to_time_period = to_time_period
+    Feature.ngram = ngram
+    Feature.remove_stop_words = remove_stop_words
+    Feature.count_vectorize = count_vectorize
+    Feature.ngram_similarity = ngram_similarity
+    Feature.jaccard_similarity = jaccard_similarity
+    Feature.detect_languages = detect_languages
+    Feature.recognize_entities = recognize_entities
+    Feature.detect_mime_types = detect_mime_types
+    Feature.word2vec = word2vec
+    Feature.lda_topics = lda_topics
+    Feature.to_email_domain = to_email_domain
+    Feature.is_valid_email = is_valid_email
+    Feature.parse_phone = parse_phone
+    Feature.is_valid_phone = is_valid_phone
+    Feature.to_url_domain = to_url_domain
+    Feature.is_valid_url = is_valid_url
+    Feature.b64_to_text = b64_to_text
+    Feature.scale = scale_feature
+    Feature.descale = descale_feature
+    Feature.filter_map = filter_map
 
 
 _attach()

@@ -80,3 +80,164 @@ class ToOccurTransformer(Transformer):
         else:
             occurs = m
         return Column.real(occurs.astype(jnp.float32), kind="RealNN")
+
+
+@register_stage
+class ScalerTransformer(Transformer):
+    """Real -> Real scaled by a recorded, invertible function family
+    (reference ScalerTransformer.scala: Linear(slope, intercept) / Logarithmic).
+    Scaling args live in stage params so DescalerTransformer can invert
+    predictions made in scaled space."""
+
+    operation_name = "scaler"
+    arity = (1, 1)
+    device_op = True
+
+    def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
+                 intercept: float = 0.0):
+        if scaling_type not in ("linear", "log"):
+            raise ValueError(f"scaling_type must be linear|log, got {scaling_type!r}")
+        super().__init__(scaling_type=scaling_type, slope=slope, intercept=intercept)
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        if in_kinds[0].storage is not Storage.REAL:
+            raise TypeError(f"ScalerTransformer takes Real kinds, got {in_kinds[0].name}")
+        return in_kinds[0]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        c = cols[0]
+        p = self.params
+        v = jnp.asarray(c.values, jnp.float32)
+        if p["scaling_type"] == "log":
+            out = jnp.log(jnp.maximum(v, 1e-12))
+        else:
+            out = p["slope"] * v + p["intercept"]
+        return Column(c.kind, out, c.mask)
+
+
+@register_stage
+class DescalerTransformer(Transformer):
+    """Invert a ScalerTransformer: input 1 = value to descale (e.g. a prediction made
+    against the scaled response), input 2 = the scaled feature whose origin scaler
+    supplies the inverse args (reference DescalerTransformer.scala reads the scaler
+    args from vector metadata)."""
+
+    operation_name = "descaler"
+    arity = (2, 2)
+    device_op = True
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        if in_kinds[0].storage is not Storage.REAL:
+            raise TypeError(f"DescalerTransformer takes Real kinds, got {in_kinds[0].name}")
+        return in_kinds[0]
+
+    def _scaler_params(self) -> dict:
+        origin = self.inputs[1].origin_stage
+        if origin is None or origin.operation_name != "scaler":
+            raise ValueError(
+                "DescalerTransformer's second input must be the output of a "
+                f"ScalerTransformer; got origin {origin!r}"
+            )
+        return origin.params
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        c = cols[0]
+        p = self._scaler_params()
+        v = jnp.asarray(c.values, jnp.float32)
+        if p["scaling_type"] == "log":
+            out = jnp.exp(v)
+        else:
+            if p["slope"] == 0:
+                raise ValueError("cannot descale a linear scaling with slope 0")
+            out = (v - p["intercept"]) / p["slope"]
+        return Column(c.kind, out, c.mask)
+
+
+@register_stage
+class TimePeriodTransformer(Transformer):
+    """Date -> Integral calendar unit (reference TimePeriodTransformer.scala:
+    DayOfMonth, DayOfWeek, DayOfYear, HourOfDay, MonthOfYear, WeekOfMonth, WeekOfYear)."""
+
+    operation_name = "timePeriod"
+    arity = (1, 1)
+
+    PERIODS = ("DayOfMonth", "DayOfWeek", "DayOfYear", "HourOfDay", "MonthOfYear",
+               "WeekOfMonth", "WeekOfYear")
+
+    def __init__(self, period: str = "DayOfWeek"):
+        if period not in self.PERIODS:
+            raise ValueError(f"period must be one of {self.PERIODS}, got {period!r}")
+        super().__init__(period=period)
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        if in_kinds[0].storage is not Storage.DATE:
+            raise TypeError(f"TimePeriodTransformer takes Date kinds, got {in_kinds[0].name}")
+        return kind_of("Integral")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        import datetime as _dt
+
+        c = cols[0]
+        period = self.params["period"]
+        mask = np.asarray(c.effective_mask())
+        out = np.zeros(len(c), dtype=np.int64)
+        for i, (ms, ok) in enumerate(zip(np.asarray(c.values), mask)):
+            if not ok:
+                continue
+            t = _dt.datetime.fromtimestamp(int(ms) / 1000.0, tz=_dt.timezone.utc)
+            if period == "DayOfMonth":
+                out[i] = t.day
+            elif period == "DayOfWeek":
+                out[i] = t.isoweekday()
+            elif period == "DayOfYear":
+                out[i] = t.timetuple().tm_yday
+            elif period == "HourOfDay":
+                out[i] = t.hour
+            elif period == "MonthOfYear":
+                out[i] = t.month
+            elif period == "WeekOfMonth":
+                out[i] = (t.day + _dt.date(t.year, t.month, 1).weekday()) // 7 + 1
+            else:  # WeekOfYear
+                out[i] = t.isocalendar()[1]
+        return Column(kind_of("Integral"), out, mask)
+
+
+@register_stage
+class FilterMap(Transformer):
+    """Map kind -> same map kind with keys white/black-listed (reference
+    FilterMap.scala; also filters empty values the way cleanMap does)."""
+
+    operation_name = "filterMap"
+    arity = (1, 1)
+
+    def __init__(self, whitelist: Optional[Sequence[str]] = None,
+                 blacklist: Optional[Sequence[str]] = None,
+                 filter_empty: bool = True):
+        super().__init__(
+            whitelist=sorted(whitelist) if whitelist is not None else None,
+            blacklist=sorted(blacklist) if blacklist is not None else None,
+            filter_empty=filter_empty,
+        )
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        if not in_kinds[0].is_map:
+            raise TypeError(f"FilterMap takes map kinds, got {in_kinds[0].name}")
+        return in_kinds[0]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        wl = set(p["whitelist"]) if p["whitelist"] is not None else None
+        bl = set(p["blacklist"] or ())
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, m in enumerate(cols[0].values):
+            kept = {}
+            for k, v in (m or {}).items():
+                if wl is not None and k not in wl:
+                    continue
+                if k in bl:
+                    continue
+                if p["filter_empty"] and (v is None or v == "" or v == [] or v == {}):
+                    continue
+                kept[k] = v
+            out[i] = kept
+        return Column(cols[0].kind, out, None)

@@ -1,0 +1,189 @@
+"""Parser/validator stages for structured text kinds: email, phone, URL, base64.
+
+TPU-native equivalents of the reference's parse-and-validate transformers surfaced
+through RichTextFeature (core/.../dsl/RichTextFeature.scala:58-747: toEmailDomain,
+parsePhoneDefaultCountry/isValidPhoneDefaultCountry, toUrlDomain/isValidUrl) and the
+Base64 handling in OPCollectionTransformer/Base64Test. All are row-local host stages;
+their categorical/binary outputs feed device vectorizers downstream.
+"""
+from __future__ import annotations
+
+import base64 as _b64
+import re
+from typing import Optional, Sequence
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ...types import Column, kind_of
+from ..base import Transformer, register_stage
+
+_EMAIL_RE = re.compile(r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@([A-Za-z0-9-]+\.)+[A-Za-z]{2,}$")
+#: ITU E.164-ish national number lengths per default region (reference uses libphonenumber)
+_PHONE_LENGTHS = {"US": 10, "CA": 10, "GB": 10, "DE": 10, "FR": 9, "IN": 10, "JP": 10}
+_VALID_SCHEMES = ("http", "https", "ftp")
+
+
+@register_stage
+class EmailToDomain(Transformer):
+    """Email -> PickList of the domain part; invalid emails -> None (reference
+    RichTextFeature.toEmailDomain)."""
+
+    operation_name = "emailDomain"
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "Email":
+            raise TypeError(f"EmailToDomain takes Email, got {in_kinds[0].name}")
+        return kind_of("PickList")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, v in enumerate(cols[0].values):
+            out[i] = v.rsplit("@", 1)[1].lower() if v and _EMAIL_RE.match(v) else None
+        return Column(kind_of("PickList"), out, None)
+
+
+@register_stage
+class IsValidEmail(Transformer):
+    """Email -> Binary validity (reference RichTextFeature.isValidEmail)."""
+
+    operation_name = "isValidEmail"
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "Email":
+            raise TypeError(f"IsValidEmail takes Email, got {in_kinds[0].name}")
+        return kind_of("Binary")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        vals = [
+            None if v is None else bool(_EMAIL_RE.match(v)) for v in cols[0].values
+        ]
+        return Column.build(kind_of("Binary"), vals)
+
+
+def _normalize_phone(v: str, region: str) -> Optional[str]:
+    digits = re.sub(r"\D", "", v)
+    want = _PHONE_LENGTHS.get(region, 10)
+    if len(digits) == want:
+        return digits
+    # leading country code tolerated (e.g. +1 for US/CA)
+    if len(digits) in (want + 1, want + 2) and digits.endswith(digits[-want:]):
+        trimmed = digits[-want:]
+        if not trimmed.startswith("0"):
+            return trimmed
+    return None
+
+
+@register_stage
+class ParsePhone(Transformer):
+    """Phone -> normalized national number as Phone, None when unparseable
+    (reference parsePhoneDefaultCountry via libphonenumber; here digit
+    normalization + per-region length rules)."""
+
+    operation_name = "parsePhone"
+
+    def __init__(self, default_region: str = "US"):
+        super().__init__(default_region=default_region)
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "Phone":
+            raise TypeError(f"ParsePhone takes Phone, got {in_kinds[0].name}")
+        return kind_of("Phone")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        region = self.params["default_region"]
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, v in enumerate(cols[0].values):
+            out[i] = _normalize_phone(v, region) if v else None
+        return Column(kind_of("Phone"), out, None)
+
+
+@register_stage
+class IsValidPhone(Transformer):
+    """Phone -> Binary validity (reference isValidPhoneDefaultCountry)."""
+
+    operation_name = "isValidPhone"
+
+    def __init__(self, default_region: str = "US"):
+        super().__init__(default_region=default_region)
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "Phone":
+            raise TypeError(f"IsValidPhone takes Phone, got {in_kinds[0].name}")
+        return kind_of("Binary")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        region = self.params["default_region"]
+        vals = [
+            None if v is None else _normalize_phone(v, region) is not None
+            for v in cols[0].values
+        ]
+        return Column.build(kind_of("Binary"), vals)
+
+
+@register_stage
+class UrlToDomain(Transformer):
+    """URL -> PickList of the host; invalid URLs -> None (reference toUrlDomain)."""
+
+    operation_name = "urlDomain"
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "URL":
+            raise TypeError(f"UrlToDomain takes URL, got {in_kinds[0].name}")
+        return kind_of("PickList")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, v in enumerate(cols[0].values):
+            out[i] = None
+            if v:
+                parsed = urlparse(v)
+                if parsed.scheme in _VALID_SCHEMES and parsed.hostname and "." in parsed.hostname:
+                    out[i] = parsed.hostname.lower()
+        return Column(kind_of("PickList"), out, None)
+
+
+@register_stage
+class IsValidUrl(Transformer):
+    """URL -> Binary validity (reference isValidUrl: protocol in http/https/ftp)."""
+
+    operation_name = "isValidUrl"
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "URL":
+            raise TypeError(f"IsValidUrl takes URL, got {in_kinds[0].name}")
+        return kind_of("Binary")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        vals = []
+        for v in cols[0].values:
+            if v is None:
+                vals.append(None)
+            else:
+                p = urlparse(v)
+                vals.append(bool(p.scheme in _VALID_SCHEMES and p.hostname and "." in p.hostname))
+        return Column.build(kind_of("Binary"), vals)
+
+
+@register_stage
+class Base64ToText(Transformer):
+    """Base64 -> decoded utf-8 Text (None when not valid base64/utf-8); pairs with
+    MimeTypeDetector for binary payloads."""
+
+    operation_name = "b64Text"
+
+    def out_kind(self, in_kinds):
+        if in_kinds[0].name != "Base64":
+            raise TypeError(f"Base64ToText takes Base64, got {in_kinds[0].name}")
+        return kind_of("Text")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, v in enumerate(cols[0].values):
+            out[i] = None
+            if v:
+                try:
+                    out[i] = _b64.b64decode(v, validate=True).decode("utf-8")
+                except Exception:
+                    pass
+        return Column(kind_of("Text"), out, None)
