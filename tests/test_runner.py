@@ -249,3 +249,34 @@ def make_runner():
     payload = json.loads(out.stdout)
     assert payload["run_type"] == "train"
     assert os.path.exists(tmp_path / "m" / "model.json")
+
+
+def test_profiler_phases_in_app_metrics():
+    """collect_stage_metrics wires per-stage fit/transform timings into AppMetrics
+    (the OpSparkListener analog)."""
+    runner, _ = _runner()
+    seen = []
+    runner.add_application_end_handler(seen.append)
+    runner.run("train", OpParams(collect_stage_metrics=True))
+    prof = seen[0].profile
+    assert prof is not None
+    names = [p["name"] for p in prof["phases"]]
+    assert any(n.startswith("fit:") for n in names)
+    assert any(n.startswith("transform:layer") for n in names)
+    assert all(p["wall_s"] >= 0 for p in prof["phases"])
+
+
+def test_profiler_noop_without_activation():
+    from transmogrifai_tpu import profiling
+
+    assert profiling.current() is None
+    with profiling.phase("anything"):
+        pass  # no active profiler: zero-overhead no-op
+
+    with profiling.profile() as prof:
+        with profiling.phase("a"):
+            pass
+        with profiling.phase("a"):
+            pass
+    assert prof.phases["a"].count == 2
+    assert profiling.current() is None

@@ -41,13 +41,15 @@ class AppMetrics:
     end_time: float = 0.0
     stage_metrics: list[StageMetric] = field(default_factory=list)
     custom_tags: dict[str, str] = field(default_factory=dict)
+    #: fine-grained per-stage profile (fit:X / transform:layerN phases + device cost)
+    profile: Optional[dict] = None
 
     @property
     def app_duration_s(self) -> float:
         return self.end_time - self.start_time
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "run_type": self.run_type,
             "app_duration_s": round(self.app_duration_s, 4),
             "stages": [
@@ -55,6 +57,9 @@ class AppMetrics:
             ],
             "custom_tags": dict(self.custom_tags),
         }
+        if self.profile is not None:
+            out["profile"] = self.profile
+        return out
 
 
 @dataclass
@@ -139,8 +144,22 @@ class WorkflowRunner:
             metrics.stage_metrics.append(StageMetric(name, now - phase_t0))
             phase_t0 = now
 
+        from .. import profiling
+
         try:
-            result = getattr(self, f"_run_{run_type}")(params, mark)
+            if params.collect_stage_metrics or params.log_stage_metrics:
+                trace_dir = params.custom_params.get("trace_dir")
+                with profiling.profile(trace_dir=trace_dir) as prof:
+                    result = getattr(self, f"_run_{run_type}")(params, mark)
+                metrics.profile = prof.report()
+                if params.log_stage_metrics:
+                    import logging
+
+                    logging.getLogger(__name__).info(
+                        "stage metrics for %s: %s", run_type, metrics.profile
+                    )
+            else:
+                result = getattr(self, f"_run_{run_type}")(params, mark)
         finally:
             metrics.end_time = time.time()
             for h in self._end_handlers:

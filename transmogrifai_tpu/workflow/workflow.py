@@ -182,17 +182,21 @@ class Workflow(WorkflowCore):
             data, blacklisted = self._raw_filter.filter_raw(self.raw_features, data)
             if blacklisted:
                 self._apply_blacklist(blacklisted)
+        from .. import profiling
+
         fitted_stages: list[Transformer] = []
-        for layer in self._dag:
+        for li, layer in enumerate(self._dag):
             estimators, device_tf, host_tf = split_layer_by_kind(layer)
             layer_transformers: list[Transformer] = list(device_tf) + list(host_tf)
             for est in estimators:
-                model = est.fit_table(data)
+                with profiling.phase(f"fit:{type(est).__name__}"):
+                    model = est.fit_table(data)
                 layer_transformers.append(model)
             # bulk-apply the whole layer once (fit points materialize new columns for
             # the next layer's estimators)
             plan = _CompiledPlan(_topo_within_layer(layer_transformers))
-            data = plan.apply(data)
+            with profiling.phase(f"transform:layer{li}"):
+                data = plan.apply(data)
             fitted_stages.extend(_topo_within_layer(layer_transformers))
         model = WorkflowModel(
             result_features=self.result_features,
@@ -227,9 +231,12 @@ class WorkflowModel(WorkflowCore):
 
     # --- scoring (analog of OpWorkflowModel.score, scoreFn) ---------------------------
     def transform(self, table: Table, keep_intermediate: bool = False) -> Table:
+        from .. import profiling
+
         if self._plan is None:
             self._plan = _CompiledPlan(self.stages)
-        out = self._plan.apply(table)
+        with profiling.phase("score:transform"):
+            out = self._plan.apply(table)
         if keep_intermediate:
             return out
         keep = [f.name for f in self.result_features if f.name in out.columns]
