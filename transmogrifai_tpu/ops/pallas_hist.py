@@ -1,0 +1,111 @@
+"""Pallas TPU kernel: gradient histograms for tree growth as one-hot MXU matmuls.
+
+The tree grower's inner loop sums per-row gradient/hessian vectors into
+(node, feature, bin) cells (ops/trees.py `_histogram`, the RDD treeAggregate analog of
+the reference's MLlib/xgboost4j trainers — SURVEY §2.11d/2.12). The jnp fallback is a
+`segment_sum`, which XLA lowers to a scatter-add: correct everywhere, but scatters
+serialize on TPU.
+
+This kernel reformulates the scatter as dense matmuls, which is what the MXU is for:
+for one feature d and a block of rows, build the one-hot membership matrix
+M[r, s] = [node(r) * n_bins + bin(r, d) == s] in VMEM and accumulate
+out[d] += M^T @ GH — a [S, Bn] x [Bn, C] matmul per (feature, row-block) grid cell.
+Row blocks stream through VMEM (grid dim 1, "arbitrary" = sequential, accumulating);
+features are independent ("parallel").
+
+VMEM budget per cell: Bn*S one-hot + Bn*C gh + S*C out; with Bn=512, S<=1024 that is
+~2.6 MB — well inside the ~16 MB/core budget (pallas_guide.md: Memory Spaces).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+@functools.cache
+def use_pallas_histogram() -> bool:
+    """Pallas path on by default on TPU backends; force with TT_PALLAS_HIST=0/1."""
+    env = os.environ.get("TT_PALLAS_HIST")
+    if env is not None:
+        return env == "1"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def _hist_kernel(xb_ref, node_ref, gh_ref, out_ref, *, n_bins: int, n_seg: int):
+    """One (feature, row-block) cell: out[d] += onehot(keys)^T @ gh.
+
+    The whole [Bn, D] bin block is resident (TPU blocks can't slice the lane dim
+    below 128); this cell's feature column is picked with an iota mask + row-sum —
+    a VPU select, far cheaper than the matmul it feeds."""
+    d = pl.program_id(0)
+    col = jax.lax.broadcasted_iota(jnp.int32, xb_ref.shape, 1) == d
+    xb_d = jnp.sum(jnp.where(col, xb_ref[:, :], 0), axis=1)           # [Bn]
+    keys = node_ref[:, 0] * n_bins + xb_d                              # [Bn]
+    seg = jax.lax.broadcasted_iota(jnp.int32, (keys.shape[0], n_seg), 1)
+    onehot = (keys[:, None] == seg).astype(jnp.float32)                # [Bn, S]
+    # gh^T @ onehot -> [C, S]: S on the lane axis keeps the MXU wide (C is tiny);
+    # HIGHEST precision = true f32 accumulation, bit-comparable to the scatter path
+    acc = jax.lax.dot_general(
+        gh_ref[:, :], onehot,
+        (((0,), (0,)), ((), ())),                                      # contract rows
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                                  # [C, S]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[0, :, :] = acc
+
+    @pl.when(pl.program_id(1) > 0)
+    def _accum():
+        out_ref[0, :, :] += acc
+
+
+def histogram_pallas(
+    vals: jnp.ndarray,
+    Xb: jnp.ndarray,
+    node: jnp.ndarray,
+    n_nodes: int,
+    n_bins: int,
+    *,
+    block_rows: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Sum vals [N, C] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, C].
+
+    Drop-in replacement for the segment-sum histogram in ops/trees.py; zero-padded
+    rows carry zero gradient mass, so padding never perturbs counts."""
+    N, D = Xb.shape
+    C = vals.shape[1]
+    S = n_nodes * n_bins
+    n_blocks = max((N + block_rows - 1) // block_rows, 1)
+    pad = n_blocks * block_rows - N
+    vals_p = jnp.pad(jnp.asarray(vals, jnp.float32), ((0, pad), (0, 0)))
+    Xb_p = jnp.pad(Xb.astype(jnp.int32), ((0, pad), (0, 0)))
+    node_p = jnp.pad(node.astype(jnp.int32)[:, None], ((0, pad), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, n_bins=n_bins, n_seg=S),
+        grid=(D, n_blocks),
+        in_specs=[
+            pl.BlockSpec((block_rows, D), lambda d, r: (r, 0)),   # all features' bins
+            pl.BlockSpec((block_rows, 1), lambda d, r: (r, 0)),   # row -> node id
+            pl.BlockSpec((block_rows, C), lambda d, r: (r, 0)),   # gradient/hessian
+        ],
+        out_specs=pl.BlockSpec((1, C, S), lambda d, r: (d, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((D, C, S), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(Xb_p, node_p, vals_p)
+    # [D, C, n_nodes * n_bins] -> [n_nodes, D, n_bins, C] (trees.py layout)
+    return out.reshape(D, C, n_nodes, n_bins).transpose(2, 0, 3, 1)

@@ -74,8 +74,14 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
                n_nodes: int, n_bins: int) -> jnp.ndarray:
     """Sum `vals` [N, C] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, C].
 
-    One flat segment-sum — the XLA lowering is a scatter-add that psums across a
+    On TPU this runs as a pallas kernel that phrases the scatter as one-hot MXU
+    matmuls (ops/pallas_hist.py); elsewhere it is one flat segment-sum whose XLA
+    lowering is a scatter-add. Either way partial histograms psum across a
     row-sharded mesh axis (the RDD treeAggregate replacement, SURVEY §2.12)."""
+    from .pallas_hist import histogram_pallas, use_pallas_histogram
+
+    if use_pallas_histogram():
+        return histogram_pallas(vals, Xb, node, n_nodes, n_bins)
     N, D = Xb.shape
     C = vals.shape[1]
     keys = (node[:, None] * D + jnp.arange(D)[None, :]) * n_bins + Xb  # [N, D]
