@@ -1,0 +1,73 @@
+"""Boston housing — regression example.
+
+Port of the reference regression app (reference helloworld/src/main/scala/com/
+salesforce/hw/boston/OpBoston.scala): the UCI housing table (whitespace-separated),
+transmogrified numerics, cross-validated regression selection on RMSE.
+
+Run directly or through the CLI:
+    python examples/boston.py
+    op run --app examples.boston:make_runner --type train
+"""
+from __future__ import annotations
+
+import os
+
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import RegressionModelSelector
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+DATA = os.environ.get(
+    "BOSTON_DATA",
+    "/root/reference/helloworld/src/main/resources/BostonDataset/housing.data",
+)
+FIELDS = ["crim", "zn", "indus", "chas", "nox", "rm", "age", "dis", "rad",
+          "tax", "ptratio", "b", "lstat", "medv"]
+SCHEMA = {**{n: "Real" for n in FIELDS}, "chas": "Binary", "rad": "Integral",
+          "medv": "RealNN"}
+
+
+def _read_rows(path: str) -> list[dict]:
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            vals = line.split()
+            if len(vals) != len(FIELDS):
+                continue
+            row = {}
+            for name, v in zip(FIELDS, vals):
+                if name == "chas":
+                    row[name] = bool(int(float(v)))
+                elif name == "rad":
+                    row[name] = int(float(v))
+                else:
+                    row[name] = float(v)
+            rows.append(row)
+    return rows
+
+
+def make_runner(data_path: str = DATA) -> WorkflowRunner:
+    fs = features_from_schema(SCHEMA, response="medv")
+    predictors = [f for n, f in fs.items() if n != "medv"]
+    vector = transmogrify(predictors)
+    selector = RegressionModelSelector.with_cross_validation(
+        num_folds=3, validation_metric="RootMeanSquaredError"
+    )
+    prediction = selector(fs["medv"], vector)
+    reader = InMemoryReader(_read_rows(data_path))
+    return WorkflowRunner(
+        Workflow().set_result_features(prediction),
+        train_reader=reader,
+        score_reader=reader,
+        evaluator=Evaluators.regression("medv", prediction),
+    )
+
+
+if __name__ == "__main__":
+    from transmogrifai_tpu.params import OpParams
+
+    result = make_runner().run("train", OpParams())
+    print(result.metrics.to_json() if hasattr(result.metrics, "to_json")
+          else result.metrics)

@@ -1,0 +1,40 @@
+"""Example apps (reference helloworld ports: OpTitanicSimple, OpIris, OpBoston)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from transmogrifai_tpu.params import OpParams  # noqa: E402
+
+_RES = "/root/reference/helloworld/src/main/resources"
+
+
+def test_titanic_graph_builds():
+    """Full default search is TPU-scale (depth-12 trees); CI just builds the graph."""
+    import examples.titanic as t
+
+    if not os.path.exists(t.DATA):
+        pytest.skip("titanic data not mounted")
+    runner = t.make_runner()
+    assert runner.workflow.result_features
+    assert runner.evaluator is not None
+
+
+@pytest.mark.skipif(not os.path.exists(f"{_RES}/IrisDataset/bezdekIris.data"),
+                    reason="iris data not mounted")
+def test_iris_trains_multiclass():
+    import examples.iris as ir
+
+    result = ir.make_runner().run("train", OpParams())
+    assert result.metrics.F1 > 0.9  # reference-level multiclass quality
+
+
+@pytest.mark.skipif(not os.path.exists(f"{_RES}/BostonDataset/housing.data"),
+                    reason="boston data not mounted")
+def test_boston_trains_regression():
+    import examples.boston as bo
+
+    result = bo.make_runner().run("train", OpParams())
+    assert result.metrics.RootMeanSquaredError < 6.0  # naive-mean RMSE is ~9.2

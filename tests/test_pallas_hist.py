@@ -44,3 +44,25 @@ def test_segment_sum_path_matches_reference():
     np.testing.assert_allclose(
         np.asarray(got), _ref_histogram(vals, Xb, node, nodes, bins), rtol=1e-5, atol=1e-5
     )
+
+
+def test_pallas_histogram_tiled_segments():
+    """Deep-tree shapes: S = n_nodes * n_bins exceeds one segment tile."""
+    import transmogrifai_tpu.ops.pallas_hist as ph
+
+    rng = np.random.default_rng(2)
+    n, d, c, nodes, bins = 150, 2, 2, 256, 16  # S = 4096 > SEG_TILE when patched
+    old = ph.SEG_TILE
+    ph.SEG_TILE = 512  # force multi-tile without huge interpret cost
+    try:
+        Xb = rng.integers(0, bins, size=(n, d)).astype(np.int32)
+        node = rng.integers(0, nodes, size=n).astype(np.int32)
+        vals = rng.normal(size=(n, c)).astype(np.float32)
+        got = histogram_pallas(jnp.asarray(vals), jnp.asarray(Xb), jnp.asarray(node),
+                               nodes, bins, block_rows=64, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), _ref_histogram(vals, Xb, node, nodes, bins),
+            rtol=1e-5, atol=1e-5,
+        )
+    finally:
+        ph.SEG_TILE = old

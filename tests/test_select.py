@@ -195,3 +195,32 @@ def test_selector_in_workflow_end_to_end():
     scores = model.score(table=table, keep_intermediate=True)
     assert scores[pred.name].prob.shape[0] == len(y)
     assert sel.summary_ is not None
+
+
+def test_selector_with_mlp_candidate_list_param():
+    """Static params containing lists (MLP hidden sizes) must not break the jitted
+    search-program cache (its key canonicalizes lists to tuples)."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.select import ParamGridBuilder
+    from transmogrifai_tpu.select.selector import ModelSelector
+    from transmogrifai_tpu.stages.model.extra import MLPClassifier
+    from transmogrifai_tpu.types import Column, Table
+
+    rng = np.random.default_rng(0)
+    n = 120
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    w = rng.normal(size=6)
+    y = (X @ w > 0).astype(np.float32)
+    label = FeatureBuilder.RealNN("label").as_response()
+    vec = FeatureBuilder.OPVector("v").as_predictor()
+    sel = ModelSelector(
+        "binary",
+        models=[(MLPClassifier(hidden=[8], num_classes=2, max_iter=30),
+                 ParamGridBuilder().add("l2", [0.0, 0.01]).build())],
+    )
+    sel(label, vec)
+    model = sel.fit_columns([Column.build("RealNN", y.tolist()), Column.vector(X)])
+    assert sel.summary_.models_evaluated > 0
+    assert sel.summary_.best_model_name == "MLPClassifier"

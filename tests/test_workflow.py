@@ -119,3 +119,35 @@ class TestTitanicEndToEnd:
         schema = scores[vector.name].schema
         parents = {s.parent_feature for s in schema}
         assert {"sex", "age", "fare", "pClass", "embarked"} <= parents
+
+
+def test_save_load_large_params_npz(tmp_path):
+    """Fitted arrays above the JSON threshold round-trip through the npz sidecar."""
+    import os
+
+    import numpy as np
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import GBTClassifier
+    from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x1": float(rng.normal()),
+             "x2": float(rng.normal())} for _ in range(200)]
+    fs = features_from_schema({"label": "RealNN", "x1": "Real", "x2": "Real"},
+                              response="label")
+    vec = transmogrify([fs["x1"], fs["x2"]])
+    pred = GBTClassifier(n_trees=30, max_depth=6)(fs["label"], vec)
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    model = Workflow().set_result_features(pred).train(table=table)
+    model.save(str(tmp_path / "m"))
+    assert os.path.exists(tmp_path / "m" / "params.npz")  # leaves moved out of JSON
+    loaded = WorkflowModel.load(str(tmp_path / "m"))
+    a = model.score(table=table, keep_intermediate=True)
+    b = loaded.score(table=table, keep_intermediate=True)
+    np.testing.assert_allclose(
+        np.asarray(a[pred.name].values["probability"]),
+        np.asarray(b[pred.name].values["probability"]), rtol=1e-5, atol=1e-6,
+    )

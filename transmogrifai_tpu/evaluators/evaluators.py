@@ -11,6 +11,7 @@ from __future__ import annotations
 from dataclasses import asdict, dataclass, field
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -119,15 +120,21 @@ class BinaryClassificationEvaluator(EvaluatorBase):
         n = tn + fp + fn + tp
         error = (fp + fn) / jnp.maximum(n, 1.0)
         p_th, r_th, f_th = threshold_sweep(scores, y, self.sweep)
+        # ONE device->host transfer for everything: per-element float() would issue
+        # hundreds of scalar fetches, each paying full device round-trip latency
+        (auroc, aupr, precision, recall, f1, error, tp, tn, fp, fn,
+         p_th, r_th, f_th) = jax.device_get(
+            (auroc, aupr, precision, recall, f1, error, tp, tn, fp, fn,
+             p_th, r_th, f_th))
         return BinaryClassificationMetrics(
             AuROC=float(auroc), AuPR=float(aupr),
             Precision=float(precision), Recall=float(recall), F1=float(f1),
             Error=float(error),
             TP=float(tp), TN=float(tn), FP=float(fp), FN=float(fn),
-            thresholds=[float(t) for t in self.sweep],
-            precision_by_threshold=[float(x) for x in p_th],
-            recall_by_threshold=[float(x) for x in r_th],
-            f1_by_threshold=[float(x) for x in f_th],
+            thresholds=np.asarray(self.sweep, np.float64).tolist(),
+            precision_by_threshold=np.asarray(p_th, np.float64).tolist(),
+            recall_by_threshold=np.asarray(r_th, np.float64).tolist(),
+            f1_by_threshold=np.asarray(f_th, np.float64).tolist(),
         )
 
 

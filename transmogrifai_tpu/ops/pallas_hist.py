@@ -7,14 +7,15 @@ the reference's MLlib/xgboost4j trainers — SURVEY §2.11d/2.12). The jnp fallb
 serialize on TPU.
 
 This kernel reformulates the scatter as dense matmuls, which is what the MXU is for:
-for one feature d and a block of rows, build the one-hot membership matrix
-M[r, s] = [node(r) * n_bins + bin(r, d) == s] in VMEM and accumulate
-out[d] += M^T @ GH — a [S, Bn] x [Bn, C] matmul per (feature, row-block) grid cell.
-Row blocks stream through VMEM (grid dim 1, "arbitrary" = sequential, accumulating);
-features are independent ("parallel").
-
-VMEM budget per cell: Bn*S one-hot + Bn*C gh + S*C out; with Bn=512, S<=1024 that is
-~2.6 MB — well inside the ~16 MB/core budget (pallas_guide.md: Memory Spaces).
+for one feature d, one segment tile, and a block of rows, build the one-hot membership
+matrix M[r, s] = [node(r) * n_bins + bin(r, d) == s] in VMEM and accumulate
+out[d, :, s_tile] += GH^T @ M — the segment axis rides the MXU lanes (the channel
+count is tiny, so the transposed orientation is what keeps the MXU wide). Row blocks
+stream sequentially and accumulate ("arbitrary" grid dim); features and segment tiles
+are independent ("parallel"). Deep trees (many nodes) grow the segment axis, so it is
+tiled at SEG_TILE lanes to bound VMEM: per-cell budget is Bn*D bins + Bn*SEG_TILE
+one-hot + C*SEG_TILE out ~= 4.5 MB at Bn=512, D<=1024 — inside the ~16 MB/core budget
+(pallas_guide.md: Memory Spaces).
 """
 from __future__ import annotations
 
@@ -25,6 +26,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+#: max one-hot lanes per grid cell; 2048 f32 lanes x 512 rows = 4 MB VMEM
+SEG_TILE = 2048
 
 
 @functools.cache
@@ -39,32 +43,33 @@ def use_pallas_histogram() -> bool:
         return False
 
 
-def _hist_kernel(xb_ref, node_ref, gh_ref, out_ref, *, n_bins: int, n_seg: int):
-    """One (feature, row-block) cell: out[d] += onehot(keys)^T @ gh.
+def _hist_kernel(xb_ref, node_ref, gh_ref, out_ref, *, n_bins: int, seg_tile: int):
+    """One (feature, segment-tile, row-block) cell: out[d, :, tile] += gh^T @ onehot.
 
     The whole [Bn, D] bin block is resident (TPU blocks can't slice the lane dim
     below 128); this cell's feature column is picked with an iota mask + row-sum —
     a VPU select, far cheaper than the matmul it feeds."""
     d = pl.program_id(0)
+    s = pl.program_id(1)
     col = jax.lax.broadcasted_iota(jnp.int32, xb_ref.shape, 1) == d
-    xb_d = jnp.sum(jnp.where(col, xb_ref[:, :], 0), axis=1)           # [Bn]
-    keys = node_ref[:, 0] * n_bins + xb_d                              # [Bn]
-    seg = jax.lax.broadcasted_iota(jnp.int32, (keys.shape[0], n_seg), 1)
-    onehot = (keys[:, None] == seg).astype(jnp.float32)                # [Bn, S]
-    # gh^T @ onehot -> [C, S]: S on the lane axis keeps the MXU wide (C is tiny);
-    # HIGHEST precision = true f32 accumulation, bit-comparable to the scatter path
+    xb_d = jnp.sum(jnp.where(col, xb_ref[:, :], 0), axis=1)            # [Bn]
+    keys = node_ref[:, 0] * n_bins + xb_d - s * seg_tile               # [Bn], tile-local
+    seg = jax.lax.broadcasted_iota(jnp.int32, (keys.shape[0], seg_tile), 1)
+    onehot = (keys[:, None] == seg).astype(jnp.float32)                # [Bn, S_T]
+    # gh^T @ onehot -> [C, S_T]: lanes = segments keeps the MXU wide (C is tiny);
+    # HIGHEST precision = true f32 accumulation, comparable to the scatter path
     acc = jax.lax.dot_general(
         gh_ref[:, :], onehot,
         (((0,), (0,)), ((), ())),                                      # contract rows
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
-    )                                                                  # [C, S]
+    )                                                                  # [C, S_T]
 
-    @pl.when(pl.program_id(1) == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _init():
         out_ref[0, :, :] = acc
 
-    @pl.when(pl.program_id(1) > 0)
+    @pl.when(pl.program_id(2) > 0)
     def _accum():
         out_ref[0, :, :] += acc
 
@@ -82,30 +87,36 @@ def histogram_pallas(
     """Sum vals [N, C] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, C].
 
     Drop-in replacement for the segment-sum histogram in ops/trees.py; zero-padded
-    rows carry zero gradient mass, so padding never perturbs counts."""
+    rows carry zero gradient mass and out-of-tile keys match no one-hot lane, so
+    padding never perturbs counts."""
     N, D = Xb.shape
     C = vals.shape[1]
     S = n_nodes * n_bins
+    seg_tile = min(S, SEG_TILE)
+    n_seg_tiles = (S + seg_tile - 1) // seg_tile
+    s_pad = n_seg_tiles * seg_tile
     n_blocks = max((N + block_rows - 1) // block_rows, 1)
     pad = n_blocks * block_rows - N
     vals_p = jnp.pad(jnp.asarray(vals, jnp.float32), ((0, pad), (0, 0)))
     Xb_p = jnp.pad(Xb.astype(jnp.int32), ((0, pad), (0, 0)))
-    node_p = jnp.pad(node.astype(jnp.int32)[:, None], ((0, pad), (0, 0)))
+    # padded rows get key -1 (node -1): matches no segment lane in any tile
+    node_p = jnp.pad(node.astype(jnp.int32)[:, None], ((0, pad), (0, 0)),
+                     constant_values=-1)
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_bins=n_bins, n_seg=S),
-        grid=(D, n_blocks),
+        functools.partial(_hist_kernel, n_bins=n_bins, seg_tile=seg_tile),
+        grid=(D, n_seg_tiles, n_blocks),
         in_specs=[
-            pl.BlockSpec((block_rows, D), lambda d, r: (r, 0)),   # all features' bins
-            pl.BlockSpec((block_rows, 1), lambda d, r: (r, 0)),   # row -> node id
-            pl.BlockSpec((block_rows, C), lambda d, r: (r, 0)),   # gradient/hessian
+            pl.BlockSpec((block_rows, D), lambda d, s, r: (r, 0)),  # all features' bins
+            pl.BlockSpec((block_rows, 1), lambda d, s, r: (r, 0)),  # row -> node id
+            pl.BlockSpec((block_rows, C), lambda d, s, r: (r, 0)),  # gradient/hessian
         ],
-        out_specs=pl.BlockSpec((1, C, S), lambda d, r: (d, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((D, C, S), jnp.float32),
+        out_specs=pl.BlockSpec((1, C, seg_tile), lambda d, s, r: (d, 0, s)),
+        out_shape=jax.ShapeDtypeStruct((D, C, s_pad), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(Xb_p, node_p, vals_p)
-    # [D, C, n_nodes * n_bins] -> [n_nodes, D, n_bins, C] (trees.py layout)
-    return out.reshape(D, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
+    # [D, C, S] -> [n_nodes, D, n_bins, C] (trees.py layout)
+    return out[:, :, :S].reshape(D, C, n_nodes, n_bins).transpose(2, 0, 3, 1)

@@ -133,10 +133,13 @@ class ModelSelector(PredictorEstimator):
 
         keep = (weights > 0).astype(np.float32)
         val_masks = self.validator.fold_masks(y_used, keep)
-        results = evaluate_candidates(
-            models, X_tr, y_used, weights, val_masks, keep,
-            self.problem_type, self.metric, num_classes=num_classes,
-        )
+        from .. import profiling
+
+        with profiling.phase("selector:search"):
+            results = evaluate_candidates(
+                models, X_tr, y_used, weights, val_masks, keep,
+                self.problem_type, self.metric, num_classes=num_classes,
+            )
         from .tuning_metrics import make_metric_fn
 
         _, larger = make_metric_fn(self.problem_type, self.metric,
@@ -147,9 +150,13 @@ class ModelSelector(PredictorEstimator):
 
         import jax.numpy as jnp
 
-        params = best_est.fit_fn(jnp.asarray(X_tr), jnp.asarray(y_used),
-                                 sample_weight=jnp.asarray(weights),
-                                 **best_est.fit_kwargs())
+        with profiling.phase("selector:refit"):
+            params = best_est.fit_fn(jnp.asarray(X_tr), jnp.asarray(y_used),
+                                     sample_weight=jnp.asarray(weights),
+                                     **best_est.fit_kwargs())
+            import jax
+
+            jax.block_until_ready(params)
         model = best_est.make_model(params)
 
         summary = ModelSelectorSummary(
@@ -168,18 +175,21 @@ class ModelSelector(PredictorEstimator):
         # train metrics over kept rows only — cutter-dropped rows carry weight 0 and
         # were remapped to class 0, so including them would corrupt the report
         kept_rows = weights > 0
-        summary.train_metrics = self._metrics_on(
-            model, X_tr[kept_rows], y_used[kept_rows])
+        with profiling.phase("selector:train_metrics"):
+            summary.train_metrics = self._metrics_on(
+                model, X_tr[kept_rows], y_used[kept_rows])
         if len(holdout_idx):
-            y_h = y_np[holdout_idx]
-            if label_map is not None:
-                keep_h = np.asarray([float(v) in label_map for v in y_h])
-                y_h = np.asarray([label_map.get(float(v), 0) for v in y_h], np.float32)
-                summary.holdout_metrics = self._metrics_on(
-                    model, X_np[holdout_idx][keep_h], y_h[keep_h])
-            else:
-                summary.holdout_metrics = self._metrics_on(
-                    model, X_np[holdout_idx], y_h)
+            with profiling.phase("selector:holdout_metrics"):
+                y_h = y_np[holdout_idx]
+                if label_map is not None:
+                    keep_h = np.asarray([float(v) in label_map for v in y_h])
+                    y_h = np.asarray(
+                        [label_map.get(float(v), 0) for v in y_h], np.float32)
+                    summary.holdout_metrics = self._metrics_on(
+                        model, X_np[holdout_idx][keep_h], y_h[keep_h])
+                else:
+                    summary.holdout_metrics = self._metrics_on(
+                        model, X_np[holdout_idx], y_h)
         self.summary_ = summary
         model.selector_summary = summary
         return model

@@ -150,6 +150,50 @@ def _group_grid(template, grid: Sequence[dict]):
     return out
 
 
+#: jitted folds x grid search programs, keyed by (family, static params, metric).
+#: Without this cache every selector fit would rebuild the vmap closures and re-trace,
+#: paying tracing + dispatch on each AutoML search; with it, repeat searches on the
+#: same shapes are pure device compute (the bench.py steady state).
+_SEARCH_PROGRAM_CACHE: dict = {}
+
+
+def _hashable(v):
+    """Canonicalize a static param value for the cache key (lists -> tuples, e.g.
+    MLP hidden-layer sizes)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def _search_program(template, static_items: tuple, vmap_names: tuple,
+                    problem_type: str, metric: str, num_classes: int):
+    key = (type(template), tuple((k, _hashable(v)) for k, v in static_items),
+           vmap_names, problem_type, metric, num_classes)
+    fn = _SEARCH_PROGRAM_CACHE.get(key)
+    if fn is not None:
+        return fn
+    static_kwargs = dict(static_items)
+    metric_fn, _ = make_metric_fn(problem_type, metric, num_classes=num_classes)
+
+    def fit_eval(X, y, train_w, val_w, hyper):
+        params = template.fit_fn(X, y, sample_weight=train_w, **static_kwargs, **hyper)
+        pred, raw, prob = template.predict_fn(params, X)
+        return metric_fn(pred, raw, prob, y, val_w)
+
+    if vmap_names:  # vmap over the stacked grid axis, then over folds
+        inner = jax.vmap(fit_eval, in_axes=(None, None, None, None, 0))
+        fn = jax.jit(jax.vmap(inner, in_axes=(None, None, 0, 0, None)))
+    else:
+        fn = jax.jit(jax.vmap(
+            lambda X, y, twk, vwk: fit_eval(X, y, twk, vwk, {}),
+            in_axes=(None, None, 0, 0),
+        ))
+    _SEARCH_PROGRAM_CACHE[key] = fn
+    return fn
+
+
 def evaluate_candidates(
     candidates,
     X,
@@ -175,7 +219,6 @@ def evaluate_candidates(
     keepd = jnp.asarray(keep, jnp.float32)
     fold_train_w = tw[None, :] * (1.0 - vm)  # [K, N]
     fold_val_w = keepd[None, :] * vm  # [K, N]
-    metric_fn, _ = make_metric_fn(problem_type, metric, num_classes=num_classes)
 
     results: list[EvaluatedGridPoint] = []
     for ci, (template, grid) in enumerate(candidates):
@@ -184,23 +227,19 @@ def evaluate_candidates(
             static_kwargs = {**template.fit_kwargs(), **static}
             for k in stacks:
                 static_kwargs.pop(k, None)
-
-            def fit_eval(train_w, val_w, hyper):
-                params = template.fit_fn(
-                    Xd, yd, sample_weight=train_w, **static_kwargs, **hyper
-                )
-                pred, raw, prob = template.predict_fn(params, Xd)
-                return metric_fn(pred, raw, prob, yd, val_w)
-
-            if stacks:  # vmap over the stacked grid axis, then over folds
-                inner = jax.vmap(fit_eval, in_axes=(None, None, 0))
-                outer = jax.vmap(inner, in_axes=(0, 0, None))
+            program = _search_program(
+                template,
+                tuple(sorted(static_kwargs.items())),
+                tuple(sorted(stacks)),
+                problem_type, metric, num_classes,
+            )
+            if stacks:
                 hyper = {k: jnp.asarray(v) for k, v in stacks.items()}
-                scores = np.asarray(outer(fold_train_w, fold_val_w, hyper))  # [K, G]
+                scores = np.asarray(
+                    program(Xd, yd, fold_train_w, fold_val_w, hyper)
+                )  # [K, G]
             else:
-                outer = jax.vmap(lambda twk, vwk: fit_eval(twk, vwk, {}),
-                                 in_axes=(0, 0))
-                scores = np.asarray(outer(fold_train_w, fold_val_w))[:, None]
+                scores = np.asarray(program(Xd, yd, fold_train_w, fold_val_w))[:, None]
 
             for gi, point in enumerate(points):
                 results.append(

@@ -48,14 +48,17 @@ def _params_json(params: TreeEnsembleParams) -> dict:
 
 
 class _TreeModelBase(PredictionModel):
-    """Caches the device-array TreeEnsembleParams so repeated scoring calls do not
-    re-convert the JSON list params every time."""
+    """Converts the JSON list params to device TreeEnsembleParams once, eagerly at
+    construction — construction always happens OUTSIDE jit (fit or from_json), so the
+    cache can never capture a tracer from a traced scoring call (lazy caching inside
+    the fused transform program leaked tracers across jit programs)."""
+
+    def __init__(self, **params):
+        super().__init__(**params)
+        self._ensemble_cache = _ensemble_params(self.params)
 
     def _ensemble(self) -> TreeEnsembleParams:
-        cached = getattr(self, "_ensemble_cache", None)
-        if cached is None:
-            cached = self._ensemble_cache = _ensemble_params(self.params)
-        return cached
+        return self._ensemble_cache
 
 
 @register_stage

@@ -305,11 +305,39 @@ class WorkflowModel(WorkflowCore):
         return self.model_insights(feature).pretty()
 
     # --- persistence (analog of OpWorkflowModelWriter/Reader) -------------------------
+    MANIFEST_ARRAYS = "params.npz"
+    #: fitted arrays above this many elements move to the npz sidecar (the orbax-style
+    #: checkpoint role: tree ensembles / embeddings as binary arrays, not JSON text)
+    _NPZ_THRESHOLD = 1024
+
     def save(self, path: str, overwrite: bool = False) -> None:
+        import numpy as _np
+
         os.makedirs(path, exist_ok=True)
         target = os.path.join(path, self.MANIFEST)
         if os.path.exists(target) and not overwrite:
             raise FileExistsError(f"{target} exists; pass overwrite=True")
+        arrays: dict[str, _np.ndarray] = {}
+        stage_payloads = []
+        for s in self.stages:
+            payload = {**s.to_json(), "output": s.get_output().name,
+                       "output_kind": s.get_output().kind.name}
+            slim = {}
+            for k, v in payload["params"].items():
+                if isinstance(v, list):
+                    try:
+                        arr = _np.asarray(v)
+                    except ValueError:  # ragged (e.g. per-feature category lists)
+                        arr = None
+                    if (arr is not None and arr.size >= self._NPZ_THRESHOLD
+                            and arr.dtype.kind in "fiub"):
+                        key = f"{payload['uid']}/{k}"
+                        arrays[key] = arr
+                        slim[k] = {"__npz__": key}
+                        continue
+                slim[k] = v
+            payload["params"] = slim
+            stage_payloads.append(payload)
         manifest = {
             "version": 1,
             "uid": self.uid,
@@ -319,19 +347,29 @@ class WorkflowModel(WorkflowCore):
             ],
             "result_features": [f.name for f in self.result_features],
             "blacklisted": [f.name for f in self.blacklisted],
-            "stages": [
-                {**s.to_json(), "output": s.get_output().name,
-                 "output_kind": s.get_output().kind.name}
-                for s in self.stages
-            ],
+            "stages": stage_payloads,
         }
         with open(target, "w") as fh:
             json.dump(manifest, fh, indent=1)
+        if arrays:
+            _np.savez_compressed(os.path.join(path, self.MANIFEST_ARRAYS), **arrays)
 
     @staticmethod
     def load(path: str) -> "WorkflowModel":
+        import numpy as _np
+
         with open(os.path.join(path, WorkflowModel.MANIFEST)) as fh:
             manifest = json.load(fh)
+        npz_path = os.path.join(path, WorkflowModel.MANIFEST_ARRAYS)
+        arrays = _np.load(npz_path) if os.path.exists(npz_path) else None
+        for sj in manifest["stages"]:
+            for k, v in sj["params"].items():
+                if isinstance(v, dict) and "__npz__" in v:
+                    if arrays is None:
+                        raise FileNotFoundError(
+                            f"{npz_path} missing but stage {sj['uid']} references it"
+                        )
+                    sj["params"][k] = arrays[v["__npz__"]].tolist()
         from ..graph.builder import FeatureBuilder
 
         features: dict[str, Feature] = {}
