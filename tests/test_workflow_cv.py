@@ -1,0 +1,98 @@
+"""Workflow-level CV (reference OpWorkflowCVTest.scala / FitStagesUtil.cutDAG):
+label-touching estimators upstream of the ModelSelector refit inside each fold."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu  # noqa: F401
+from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.graph.dag import compute_dag, in_fold_estimators, label_tainted_features
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import ParamGridBuilder
+from transmogrifai_tpu.select.selector import ModelSelector
+from transmogrifai_tpu.select.splitters import DataSplitter
+from transmogrifai_tpu.select.validator import CrossValidation
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _noise_rows(n=240, seed=0):
+    """Label is pure coin-flip noise: any validation lift must be leakage."""
+    rng = np.random.default_rng(seed)
+    return [{"label": float(rng.random() > 0.5), "x": float(rng.normal())}
+            for _ in range(n)]
+
+
+def _graph(max_splits=32):
+    fs = features_from_schema({"label": "RealNN", "x": "Real"}, response="label")
+    bucketed = fs["x"].auto_bucketize(fs["label"], max_splits=max_splits,
+                                      min_info_gain=1e-9)
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=40),
+                 ParamGridBuilder().add("l2", [0.0]).build())],
+        validator=CrossValidation(num_folds=3, seed=1),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=1),
+    )
+    pred = sel(fs["label"], transmogrify([bucketed]))
+    return fs, sel, pred
+
+
+def test_cut_detects_label_tainted_estimators():
+    fs, sel, pred = _graph()
+    dag = compute_dag([pred])
+    raw = list(fs.values())
+    tainted = label_tainted_features(dag, raw)
+    refit = in_fold_estimators(dag, raw, sel)
+    assert len(refit) == 1  # exactly the auto-bucketizer
+    from transmogrifai_tpu.stages.feature.calibration import DecisionTreeNumericBucketizer
+
+    kinds = {type(s).__name__ for layer in dag for s in layer if id(s) in refit}
+    assert kinds == {"DecisionTreeNumericBucketizer"}
+    assert tainted  # response + everything downstream of the bucketizer
+
+
+def test_in_fold_refit_happens_per_fold(monkeypatch):
+    from transmogrifai_tpu.stages.feature.calibration import DecisionTreeNumericBucketizer
+
+    fits = []
+    orig = DecisionTreeNumericBucketizer.fit_columns
+
+    def counting(self, cols):
+        fits.append(len(cols[0]))
+        return orig(self, cols)
+
+    monkeypatch.setattr(DecisionTreeNumericBucketizer, "fit_columns", counting)
+    fs, sel, pred = _graph()
+    rows = _noise_rows()
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    Workflow().set_result_features(pred).with_workflow_cv().train(table=table)
+    # 1 full-data fit (pipeline) + 3 in-fold fits on ~2/3 of the train split each
+    assert len(fits) == 4
+    full, folds = fits[0], fits[1:]
+    assert all(f < full for f in folds)
+
+
+def test_workflow_cv_kills_bucketizer_leakage():
+    """Naive CV lets the label-fit bucketizer see validation labels, inflating the
+    validation metric on pure-noise data; workflow-level CV must not."""
+    rows = _noise_rows()
+
+    def run(workflow_cv):
+        fs, sel, pred = _graph()
+        wf = Workflow().set_result_features(pred)
+        if workflow_cv:
+            wf = wf.with_workflow_cv()
+        table = InMemoryReader(rows).generate_table(list(fs.values()))
+        wf.train(table=table)
+        return sel.summary_.validation_results[0].metric_mean
+
+    naive = run(False)
+    honest = run(True)
+    assert naive > honest + 0.04, (naive, honest)  # leakage visibly inflated naive CV
+    assert honest < naive  # and the honest estimate is lower
+    # models_evaluated bookkeeping survives the per-fold path
+    fs, sel, pred = _graph()
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    Workflow().set_result_features(pred).with_workflow_cv().train(table=table)
+    assert sel.summary_.models_evaluated == 3  # 1 grid point x 3 folds

@@ -59,6 +59,37 @@ def validate_dag(dag: list[list[Stage]]) -> None:
             seen_uids.add(s.uid)
 
 
+def label_tainted_features(dag: list[list[Stage]], raw_features: Sequence[Feature]) -> set[int]:
+    """ids of features whose value depends on a response feature (directly or through
+    any ancestor stage). The taint set drives the workflow-level-CV cut: estimators
+    with tainted inputs must refit inside every validation fold (the reference's
+    cutDAG 'during' stages, FitStagesUtil.scala:305-358)."""
+    tainted: set[int] = {id(f) for f in raw_features if f.is_response}
+    for layer in dag:
+        for stage in layer:
+            if any(id(p) in tainted for p in stage.inputs):
+                out = stage.get_output()
+                tainted.add(id(out))
+    return tainted
+
+
+def in_fold_estimators(dag: list[list[Stage]], raw_features: Sequence[Feature],
+                       selector: Stage) -> set[int]:
+    """ids of pre-selector ESTIMATOR stages that consume label-tainted features and
+    therefore leak label signal into validation folds unless refit per fold
+    (reference OpWorkflowCVTest semantics; DecisionTreeNumericBucketizer and
+    SanityChecker are the canonical cases)."""
+    tainted = label_tainted_features(dag, raw_features)
+    out: set[int] = set()
+    for layer in dag:
+        for stage in layer:
+            if stage is selector or not isinstance(stage, Estimator):
+                continue
+            if any(id(p) in tainted for p in stage.inputs):
+                out.add(id(stage))
+    return out
+
+
 def split_layer_by_kind(layer: Sequence[Stage]) -> tuple[list[Estimator], list[Transformer], list[Transformer]]:
     """Partition a layer into (estimators, device transformers, host transformers) —
     the unit structure of fitAndTransformLayer (FitStagesUtil.scala:254-293)."""

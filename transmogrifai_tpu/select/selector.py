@@ -135,11 +135,32 @@ class ModelSelector(PredictorEstimator):
         val_masks = self.validator.fold_masks(y_used, keep)
         from .. import profiling
 
+        fold_matrix_fn = getattr(self, "_in_fold_matrix_fn", None)
         with profiling.phase("selector:search"):
-            results = evaluate_candidates(
-                models, X_tr, y_used, weights, val_masks, keep,
-                self.problem_type, self.metric, num_classes=num_classes,
-            )
+            if fold_matrix_fn is None:
+                results = evaluate_candidates(
+                    models, X_tr, y_used, weights, val_masks, keep,
+                    self.problem_type, self.metric, num_classes=num_classes,
+                )
+            else:
+                # workflow-level CV (cutDAG): label-touching upstream estimators are
+                # refit per fold on that fold's training rows, the matrix recomputed,
+                # and candidates validated against THAT fold only — leakage-safe
+                results = None
+                for k in range(val_masks.shape[0]):
+                    fit_local = (val_masks[k] == 0) & (keep > 0)
+                    global_rows = train_idx[np.nonzero(fit_local)[0]]
+                    col = fold_matrix_fn(np.asarray(global_rows))
+                    X_k = np.asarray(col.values, np.float32)[train_idx]
+                    fold_results = evaluate_candidates(
+                        models, X_k, y_used, weights, val_masks[k:k + 1], keep,
+                        self.problem_type, self.metric, num_classes=num_classes,
+                    )
+                    if results is None:
+                        results = fold_results
+                    else:
+                        for agg, r in zip(results, fold_results):
+                            agg.metric_values.extend(r.metric_values)
         from .tuning_metrics import make_metric_fn
 
         _, larger = make_metric_fn(self.problem_type, self.metric,

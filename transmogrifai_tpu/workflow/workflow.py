@@ -107,6 +107,16 @@ class Workflow(WorkflowCore):
     def __init__(self):
         super().__init__()
         self._raw_filter = None  # RawFeatureFilter, wired via with_raw_feature_filter
+        self._workflow_cv = False
+
+    def with_workflow_cv(self) -> "Workflow":
+        """Workflow-level cross-validation (reference OpWorkflow.withWorkflowCV +
+        FitStagesUtil.cutDAG:305-358): label-touching estimators upstream of a
+        ModelSelector (auto-bucketizers, SanityChecker) are refit INSIDE each
+        validation fold, so their label signal cannot leak into model selection.
+        The final fitted pipeline still trains those stages on the full train set."""
+        self._workflow_cv = True
+        return self
 
     def set_result_features(self, *features: Feature) -> "Workflow":
         """Back-trace lineage into the layered DAG (OpWorkflow.scala:85-105)."""
@@ -184,14 +194,33 @@ class Workflow(WorkflowCore):
                 self._apply_blacklist(blacklisted)
         from .. import profiling
 
+        raw_data = data
+        refit_ids: set[int] = set()
+        if self._workflow_cv:
+            from ..graph.dag import in_fold_estimators
+
+            selectors = [s for layer in self._dag for s in layer
+                         if s.operation_name == "modelSelector"]
+            for sel in selectors:
+                refit_ids |= in_fold_estimators(self._dag, self.raw_features, sel)
+
         fitted_stages: list[Transformer] = []
+        plan_records: list[tuple[Stage, Transformer]] = []  # execution order
         for li, layer in enumerate(self._dag):
             estimators, device_tf, host_tf = split_layer_by_kind(layer)
             layer_transformers: list[Transformer] = list(device_tf) + list(host_tf)
             for est in estimators:
+                if refit_ids and est.operation_name == "modelSelector":
+                    est._in_fold_matrix_fn = _make_fold_matrix_fn(
+                        raw_data, list(plan_records), refit_ids,
+                        est.inputs[1].name,
+                    )
                 with profiling.phase(f"fit:{type(est).__name__}"):
                     model = est.fit_table(data)
                 layer_transformers.append(model)
+                plan_records.append((est, model))
+            for t in list(device_tf) + list(host_tf):
+                plan_records.append((t, t))
             # bulk-apply the whole layer once (fit points materialize new columns for
             # the next layer's estimators)
             plan = _CompiledPlan(_topo_within_layer(layer_transformers))
@@ -206,6 +235,25 @@ class Workflow(WorkflowCore):
         )
         model.reader = self.reader
         return model
+
+
+def _make_fold_matrix_fn(raw_data: Table, records: Sequence[tuple[Stage, Transformer]],
+                         refit_ids: set[int], vector_name: str):
+    """Per-fold matrix recomputation for workflow-level CV: replay the pre-selector
+    plan over ALL rows, but refit the label-tainted estimators on only the fold's
+    training rows (reference cutDAG 'during' refits, OpValidator.scala:228-256)."""
+
+    def fold_matrix(global_fit_rows) -> Column:
+        t = raw_data
+        for orig, fitted in records:
+            if id(orig) in refit_ids:
+                model = orig.fit_table(t.slice(global_fit_rows))
+                t = model.transform_table(t)
+            else:
+                t = fitted.transform_table(t)
+        return t[vector_name]
+
+    return fold_matrix
 
 
 def _topo_within_layer(stages: list[Transformer]) -> list[Transformer]:
