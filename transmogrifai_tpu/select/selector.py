@@ -96,7 +96,8 @@ class ModelSelector(PredictorEstimator):
     def __init__(self, problem_type: str = "binary", metric: Optional[str] = None,
                  models: Optional[Sequence] = None,
                  validator: Optional[ValidatorBase] = None,
-                 splitter: Optional[DataSplitter] = None, seed: int = 42):
+                 splitter: Optional[DataSplitter] = None, seed: int = 42,
+                 mesh=None):
         super().__init__(problem_type=problem_type, seed=seed)
         if problem_type not in ("binary", "multiclass", "regression"):
             raise ValueError(f"unknown problem_type {problem_type!r}")
@@ -108,6 +109,9 @@ class ModelSelector(PredictorEstimator):
                                                       stratify=problem_type != "regression")
         self.splitter = splitter or default_splitter(problem_type, seed)
         self.seed = seed
+        #: optional device mesh: grid points shard over its model axis, rows over
+        #: its data axis (set directly or via ctor; never serialized)
+        self.mesh = mesh
         self.summary_: Optional[ModelSelectorSummary] = None
 
     # the selector's own fit is the whole search; fit_fn/predict_fn are the winner's
@@ -141,6 +145,7 @@ class ModelSelector(PredictorEstimator):
                 results = evaluate_candidates(
                     models, X_tr, y_used, weights, val_masks, keep,
                     self.problem_type, self.metric, num_classes=num_classes,
+                    mesh=self.mesh,
                 )
             else:
                 # workflow-level CV (cutDAG): label-touching upstream estimators are
@@ -155,6 +160,7 @@ class ModelSelector(PredictorEstimator):
                     fold_results = evaluate_candidates(
                         models, X_k, y_used, weights, val_masks[k:k + 1], keep,
                         self.problem_type, self.metric, num_classes=num_classes,
+                        mesh=self.mesh,
                     )
                     if results is None:
                         results = fold_results

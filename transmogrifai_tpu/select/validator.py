@@ -204,6 +204,7 @@ def evaluate_candidates(
     problem_type: str,
     metric: str,
     num_classes: int = 0,
+    mesh=None,
 ) -> list[EvaluatedGridPoint]:
     """Validate every (family, grid-point) over every fold.
 
@@ -211,6 +212,11 @@ def evaluate_candidates(
     train_weights [N]: balancer/cutter weights applied when FITTING.
     val_masks [K, N]: fold validation indicators. keep [N]: cutter keep-mask applied
     when SCORING validation rows.
+    mesh: optional jax.sharding.Mesh (data x model axes). Grid points shard over the
+    model axis — each chip fits its slice of the hyperparameter grid (the Spark
+    thread-pool model-parallelism, SURVEY §2.12, as a sharded device axis); rows
+    shard over the data axis when they divide it evenly (fits' matmuls then psum
+    partial products over ICI).
     """
     Xd = jnp.asarray(X, jnp.float32)
     yd = jnp.asarray(y, jnp.float32)
@@ -219,6 +225,21 @@ def evaluate_candidates(
     keepd = jnp.asarray(keep, jnp.float32)
     fold_train_w = tw[None, :] * (1.0 - vm)  # [K, N]
     fold_val_w = keepd[None, :] * vm  # [K, N]
+
+    n_model = 1
+    if mesh is not None:
+        from ..mesh import DATA_AXIS, MODEL_AXIS, replicate, shard_batch
+
+        n_model = mesh.shape[MODEL_AXIS]
+        n_data = mesh.shape[DATA_AXIS]
+        if Xd.shape[0] % n_data == 0:
+            Xd, yd = shard_batch(mesh, Xd), shard_batch(mesh, yd)
+            fold_train_w = shard_batch(mesh, fold_train_w, batch_dim=1)
+            fold_val_w = shard_batch(mesh, fold_val_w, batch_dim=1)
+        else:  # uneven rows: replicate data, still shard the grid axis
+            Xd, yd = replicate(mesh, Xd), replicate(mesh, yd)
+            fold_train_w = replicate(mesh, fold_train_w)
+            fold_val_w = replicate(mesh, fold_val_w)
 
     results: list[EvaluatedGridPoint] = []
     for ci, (template, grid) in enumerate(candidates):
@@ -234,10 +255,21 @@ def evaluate_candidates(
                 problem_type, metric, num_classes,
             )
             if stacks:
-                hyper = {k: jnp.asarray(v) for k, v in stacks.items()}
+                hyper = {k: np.asarray(v, np.float32) for k, v in stacks.items()}
+                n_points = len(points)
+                if mesh is not None:
+                    from ..mesh import shard_grid
+
+                    pad = (-n_points) % n_model  # even shards: repeat the last point
+                    hyper = {
+                        k: shard_grid(mesh, np.concatenate([v, np.repeat(v[-1:], pad)]))
+                        for k, v in hyper.items()
+                    }
+                else:
+                    hyper = {k: jnp.asarray(v) for k, v in hyper.items()}
                 scores = np.asarray(
                     program(Xd, yd, fold_train_w, fold_val_w, hyper)
-                )  # [K, G]
+                )[:, :n_points]  # [K, G] (padding trimmed)
             else:
                 scores = np.asarray(program(Xd, yd, fold_train_w, fold_val_w))[:, None]
 
