@@ -1,0 +1,94 @@
+"""BinScoreEvaluator, RecordInsightsCorr, PredictionDeIndexer
+(reference OpBinScoreEvaluator.scala, RecordInsightsCorr.scala,
+PredictionDeIndexer.scala)."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import BinScoreEvaluator, Evaluators
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.insights import RecordInsightsCorr
+from transmogrifai_tpu.stages.feature.categorical import (
+    PredictionDeIndexer,
+    StringIndexer,
+)
+from transmogrifai_tpu.types import Column, Table, kind_of
+
+
+def _pred_table(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    scores = rng.random(n).astype(np.float32)
+    y = (rng.random(n) < scores).astype(np.float32)  # perfectly calibrated
+    prob = np.stack([1 - scores, scores], axis=1)
+    raw = np.log(np.clip(prob, 1e-9, None))
+    return Table({
+        "label": Column.build(kind_of("RealNN"), y.tolist()),
+        "pred": Column.prediction((scores > 0.5).astype(np.float32), raw, prob),
+    }, n), scores, y
+
+
+def test_bin_score_evaluator_calibration():
+    table, scores, y = _pred_table()
+    ev = Evaluators.bin_score("label", "pred", num_bins=10)
+    m = ev.evaluate_all(table)
+    assert m.binSize == pytest.approx(0.1)
+    assert len(m.binCenters) == 10
+    assert sum(m.numberOfDataPoints) == 400
+    # calibrated scores: per-bin avg score ~ conversion rate where populated
+    for s, c, k in zip(m.averageScore, m.averageConversionRate, m.numberOfDataPoints):
+        if k > 20:
+            assert abs(s - c) < 0.25
+    assert m.BrierScore == pytest.approx(float(np.mean((scores - y) ** 2)), rel=1e-5)
+    with pytest.raises(ValueError):
+        BinScoreEvaluator("label", "pred", num_bins=0)
+
+
+def test_record_insights_corr():
+    rng = np.random.default_rng(1)
+    n = 300
+    x0 = rng.normal(size=n)  # drives the score
+    x1 = rng.normal(size=n)  # noise
+    score = 1 / (1 + np.exp(-2 * x0))
+    X = np.stack([x0, x1], axis=1).astype(np.float32)
+    prob = np.stack([1 - score, score], axis=1).astype(np.float32)
+    vec = FeatureBuilder.OPVector("v").as_predictor()
+    from transmogrifai_tpu.stages.model.base import PredictionModel  # noqa: F401
+
+    pred_f = FeatureBuilder.Prediction("p").as_predictor()
+    t = Table({
+        "v": Column.vector(X),
+        "p": Column.prediction((score > 0.5).astype(np.float32),
+                               np.log(np.clip(prob, 1e-9, None)), prob),
+    }, n)
+    est = RecordInsightsCorr(top_k=2)
+    est(vec, pred_f)
+    model = est.fit_table(t)
+    corr = np.asarray(model.params["correlations"])
+    assert corr[0] > 0.8 and abs(corr[1]) < 0.3  # x0 correlates, x1 doesn't
+    out = model.transform_columns([t["v"], t["p"]])
+    first = json.loads(out.values[0])
+    assert first[0]["name"] == "f0"  # strongest insight is the driving slot
+
+
+def test_prediction_deindexer():
+    idx = StringIndexer()
+    label = FeatureBuilder.PickList("cls").as_response()
+    indexed = idx(label)
+    t = Table({"cls": Column.build(kind_of("PickList"), ["b", "a", "b", "b"])}, 4)
+    model = idx.fit_table(t)  # labels ordered by freq: b=0, a=1
+    prob = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.1, 0.9]], np.float32)
+    pred_f = FeatureBuilder.Prediction("p").as_predictor()
+    t2 = Table({
+        "cls_idx": model.transform_columns([t["cls"]]),
+        "p": Column.prediction(np.argmax(prob, 1).astype(np.float32),
+                               np.log(prob), prob),
+    }, 4)
+    de = PredictionDeIndexer.for_model(model)
+    de(indexed, pred_f)
+    out = de.transform_columns([t2["cls_idx"], t2["p"]])
+    assert list(out.values) == ["b", "a", "b", "a"]
+    with pytest.raises(ValueError, match="no labels"):
+        d2 = PredictionDeIndexer()
+        d2(indexed.alias("i2"), FeatureBuilder.Prediction("p2").as_predictor())
+        d2.transform_columns([t2["cls_idx"], t2["p"]])

@@ -383,3 +383,41 @@ class TestTransmogrify:
         fs = features_from_schema({"a": "Real", "b": "Real"})
         v = transmogrify(list(fs.values()))
         assert v.origin_stage.operation_name == "vecReal"
+
+
+def test_map_vectorizer_date_and_geo_maps():
+    """DateMap -> per-key epoch-days numeric; GeolocationMap -> per-key (lat, lon, acc)
+    with mean fill (reference DateMapVectorizer / GeolocationMapVectorizer)."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.stages.feature.collections import MapVectorizer
+    from transmogrifai_tpu.types import Column, Table, kind_of
+
+    day = 86_400_000
+    dm = FeatureBuilder.DateMap("dm").as_predictor()
+    gm = FeatureBuilder.GeolocationMap("gm").as_predictor()
+    t = Table({
+        "dm": Column.build(kind_of("DateMap"),
+                           [{"a": 10 * day}, {"a": 20 * day}, {}]),
+        "gm": Column.build(kind_of("GeolocationMap"),
+                           [{"h": (10.0, 20.0, 1.0)}, {}, {"h": (30.0, 40.0, 2.0)}]),
+    }, 3)
+    st = MapVectorizer(track_nulls=True)
+    st(dm, gm)
+    model = st.fit_table(t)
+    out = model.transform_columns([t["dm"], t["gm"]])
+    vals = np.asarray(out.values)
+    # date map: [value_days, null] -> missing row filled with mean (15), null flag set
+    assert vals[:, 0] == pytest.approx([10.0, 20.0, 15.0])
+    assert vals[:, 1].tolist() == [0.0, 0.0, 1.0]
+    # geo map: [lat, lon, acc, null] with mean fill (20, 30, 1.5)
+    assert vals[0, 2:5] == pytest.approx([10.0, 20.0, 1.0])
+    assert vals[1, 2:5] == pytest.approx([20.0, 30.0, 1.5])
+    assert vals[1, 5] == 1.0
+    # transmogrify routes these kinds
+    from transmogrifai_tpu.stages.feature import transmogrify as tmog
+
+    dm2 = FeatureBuilder.DateMap("dm2").as_predictor()
+    vec = tmog([dm2])
+    assert vec.kind.name == "OPVector"

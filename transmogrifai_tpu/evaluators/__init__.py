@@ -1,5 +1,7 @@
 from .evaluators import (
+    BinaryClassificationBinMetrics,
     BinaryClassificationEvaluator,
+    BinScoreEvaluator,
     BinaryClassificationMetrics,
     EvaluatorBase,
     Evaluators,
@@ -15,6 +17,8 @@ __all__ = [
     "EvaluatorBase",
     "BinaryClassificationEvaluator",
     "BinaryClassificationMetrics",
+    "BinScoreEvaluator",
+    "BinaryClassificationBinMetrics",
     "MultiClassificationEvaluator",
     "MultiClassificationMetrics",
     "RegressionEvaluator",

@@ -192,3 +192,60 @@ class Evaluators:
     @staticmethod
     def regression(label, prediction, **kw) -> RegressionEvaluator:
         return RegressionEvaluator(label, prediction, **kw)
+
+    @staticmethod
+    def bin_score(label, prediction, **kw) -> "BinScoreEvaluator":
+        return BinScoreEvaluator(label, prediction, **kw)
+
+
+@dataclass
+class BinaryClassificationBinMetrics:
+    """Score-bin calibration report (reference OpBinScoreEvaluator.scala:66)."""
+
+    BrierScore: float
+    binSize: float
+    binCenters: list = field(default_factory=list)
+    numberOfDataPoints: list = field(default_factory=list)
+    averageScore: list = field(default_factory=list)
+    averageConversionRate: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+class BinScoreEvaluator(EvaluatorBase):
+    """Calibration-by-bin: partition [0, 1] scores into equal bins; per bin report
+    count, mean predicted score, and realized conversion rate; plus the Brier score.
+    All binning is one device segment pass (no host loop over rows)."""
+
+    default_metric = "BrierScore"
+    larger_is_better = False
+
+    def __init__(self, label, prediction, num_bins: int = 100):
+        super().__init__(label, prediction)
+        if num_bins < 1:
+            raise ValueError("num_bins must be >= 1")
+        self.num_bins = num_bins
+
+    def evaluate_all(self, table: Table) -> BinaryClassificationBinMetrics:
+        label, pred = self._cols(table)
+        y = jnp.asarray(np.asarray(label.values), jnp.float32)
+        scores = pred.prob[:, 1] if pred.prob.shape[1] > 1 else pred.prob[:, 0]
+        k = self.num_bins
+        bin_of = jnp.clip((scores * k).astype(jnp.int32), 0, k - 1)
+        ones = jnp.ones_like(scores)
+        counts = jax.ops.segment_sum(ones, bin_of, num_segments=k)
+        score_sum = jax.ops.segment_sum(scores, bin_of, num_segments=k)
+        label_sum = jax.ops.segment_sum(y, bin_of, num_segments=k)
+        brier = jnp.mean((scores - y) ** 2)
+        counts, score_sum, label_sum, brier = jax.device_get(
+            (counts, score_sum, label_sum, brier))
+        denom = np.maximum(counts, 1.0)
+        return BinaryClassificationBinMetrics(
+            BrierScore=float(brier),
+            binSize=1.0 / k,
+            binCenters=[(i + 0.5) / k for i in range(k)],
+            numberOfDataPoints=counts.astype(float).tolist(),
+            averageScore=(score_sum / denom).tolist(),
+            averageConversionRate=(label_sum / denom).tolist(),
+        )

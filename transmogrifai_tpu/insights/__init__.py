@@ -1,3 +1,4 @@
+from .corr import RecordInsightsCorr, slot_score_correlations
 from .loco import RecordInsightsLOCO, loco_deltas
 from .model_insights import FeatureInsight, ModelInsights, model_insights
 
@@ -6,5 +7,7 @@ __all__ = [
     "FeatureInsight",
     "model_insights",
     "RecordInsightsLOCO",
+    "RecordInsightsCorr",
+    "slot_score_correlations",
     "loco_deltas",
 ]

@@ -203,3 +203,47 @@ class IndexToString(SequenceVectorizer):
             iv = int(v)
             out[i] = labels[iv] if 0 <= iv < len(labels) else None
         return Column(kind_of("Text"), out, None)
+
+
+@register_stage
+class PredictionDeIndexer(SequenceVectorizer):
+    """`(indexed response, Prediction) -> Text`: map predicted class indices back to
+    the original label strings (reference impl/preparators/PredictionDeIndexer.scala).
+    Labels come from the fitted StringIndexerModel — pass them explicitly or wire via
+    `for_model(indexer_model)` after fitting."""
+
+    operation_name = "deindexPrediction"
+    arity = (2, 2)
+    accepts = None
+
+    def __init__(self, labels: Sequence[str] = ()):
+        super().__init__(labels=list(labels))
+
+    @classmethod
+    def for_model(cls, indexer_model) -> "PredictionDeIndexer":
+        return cls(labels=indexer_model.params["labels"])
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        if in_kinds[1].name != "Prediction":
+            raise TypeError("PredictionDeIndexer second input must be a Prediction")
+        return kind_of("Text")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import kind_of
+
+        labels = self.params["labels"]
+        if not labels:
+            raise ValueError(
+                "PredictionDeIndexer has no labels; construct with labels= or for_model()"
+            )
+        pred = np.asarray(cols[1].pred)
+        out = np.empty(len(pred), dtype=object)
+        for i, v in enumerate(pred):
+            iv = int(v)
+            out[i] = labels[iv] if 0 <= iv < len(labels) else None
+        return Column(kind_of("Text"), out, None)

@@ -144,6 +144,9 @@ _CATEGORICAL_MAPS = ("TextMap", "TextAreaMap", "PickListMap", "ComboBoxMap", "ID
                      "StateMap", "CityMap", "PostalCodeMap", "StreetMap")
 _BINARY_MAPS = ("BinaryMap",)
 _MULTI_MAPS = ("MultiPickListMap",)
+_DATE_MAPS = ("DateMap", "DateTimeMap")
+_GEO_MAPS = ("GeolocationMap",)
+_MS_PER_DAY = 86_400_000.0
 
 
 @register_stage
@@ -154,7 +157,8 @@ class MapVectorizer(SequenceVectorizerEstimator):
     Keys are whitelisted/blacklisted via allow_keys/block_keys (reference FilterMap)."""
 
     operation_name = "vecMap"
-    accepts = _NUMERIC_MAPS + _CATEGORICAL_MAPS + _BINARY_MAPS + _MULTI_MAPS
+    accepts = (_NUMERIC_MAPS + _CATEGORICAL_MAPS + _BINARY_MAPS + _MULTI_MAPS
+               + _DATE_MAPS + _GEO_MAPS)
 
     def __init__(self, top_k: int = 20, min_support: int = 10, clean_text: bool = True,
                  track_nulls: bool = True, allow_keys: Sequence[str] = (),
@@ -179,7 +183,32 @@ class MapVectorizer(SequenceVectorizerEstimator):
         for c, f in zip(cols, self.inputs):
             keys = self._keys_of(c)
             kind = c.kind.name
-            if kind in _NUMERIC_MAPS:
+            if kind in _DATE_MAPS:
+                # epoch-days numeric per key (reference DateMapVectorizer: time since
+                # reference date), fill = per-key mean day
+                sums = defaultdict(float)
+                cnts = defaultdict(int)
+                for m in c.values:
+                    for k, v in (m or {}).items():
+                        if str(k) in keys and v is not None:
+                            sums[str(k)] += float(v) / _MS_PER_DAY
+                            cnts[str(k)] += 1
+                fills = {k: (sums[k] / cnts[k] if cnts[k] else 0.0) for k in keys}
+                plans.append({"mode": "date", "keys": keys, "fills": fills})
+            elif kind in _GEO_MAPS:
+                sums = defaultdict(lambda: np.zeros(3))
+                cnts = defaultdict(int)
+                for m in c.values:
+                    for k, v in (m or {}).items():
+                        if str(k) in keys and v is not None:
+                            sums[str(k)] = sums[str(k)] + np.asarray(v, np.float64)
+                            cnts[str(k)] += 1
+                fills = {
+                    k: (sums[k] / cnts[k] if cnts[k] else np.zeros(3)).tolist()
+                    for k in keys
+                }
+                plans.append({"mode": "geo", "keys": keys, "fills": fills})
+            elif kind in _NUMERIC_MAPS:
                 sums = defaultdict(float)
                 cnts = defaultdict(int)
                 for m in c.values:
@@ -229,7 +258,8 @@ class MapVectorizerModel(SequenceVectorizer):
             n = len(c)
             mode = plan["mode"]
             keys = plan["keys"]
-            if mode == "numeric":
+            if mode in ("numeric", "date"):
+                scale = _MS_PER_DAY if mode == "date" else 1.0
                 width = len(keys) * (2 if track else 1)
                 mat = np.zeros((n, width), dtype=np.float32)
                 for ki, key in enumerate(keys):
@@ -242,8 +272,26 @@ class MapVectorizerModel(SequenceVectorizer):
                             if track:
                                 mat[i, base + 1] = 1.0
                         else:
-                            mat[i, base] = float(v)
+                            mat[i, base] = float(v) / scale
                     slots.append(value_slot(name, kind, group=key))
+                    if track:
+                        slots.append(null_slot(name, kind, group=key))
+            elif mode == "geo":
+                per = 3 + (1 if track else 0)
+                mat = np.zeros((n, len(keys) * per), dtype=np.float32)
+                for ki, key in enumerate(keys):
+                    base = ki * per
+                    fill = plan["fills"][key]
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if v is None:
+                            mat[i, base:base + 3] = fill
+                            if track:
+                                mat[i, base + 3] = 1.0
+                        else:
+                            mat[i, base:base + 3] = np.asarray(v, np.float32)
+                    for d in ("lat", "lon", "acc"):
+                        slots.append(value_slot(name, kind, group=key, descriptor=d))
                     if track:
                         slots.append(null_slot(name, kind, group=key))
             elif mode == "binary":

@@ -63,7 +63,8 @@ _FAMILIES["OPVector"] = "vector"
 for _k in ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap", "TextMap",
            "TextAreaMap", "PickListMap", "ComboBoxMap", "IDMap", "EmailMap", "URLMap",
            "PhoneMap", "Base64Map", "CountryMap", "StateMap", "CityMap",
-           "PostalCodeMap", "StreetMap", "BinaryMap", "MultiPickListMap"):
+           "PostalCodeMap", "StreetMap", "BinaryMap", "MultiPickListMap",
+           "DateMap", "DateTimeMap", "GeolocationMap"):
     _FAMILIES[_k] = "map"
 
 
