@@ -151,3 +151,50 @@ def test_save_load_large_params_npz(tmp_path):
         np.asarray(a[pred.name].values["probability"]),
         np.asarray(b[pred.name].values["probability"]), rtol=1e-5, atol=1e-6,
     )
+
+
+def test_warm_start_with_model_stages():
+    """with_model_stages grafts fitted stages into a retrain; matching estimators skip
+    refitting (reference OpWorkflow.withModelStages)."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.stages.model.linear import LogisticRegression as LR
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x": float(rng.normal()),
+             "cat": "ab"[int(rng.integers(0, 2))]} for _ in range(120)]
+    fs = features_from_schema({"label": "RealNN", "x": "Real", "cat": "PickList"},
+                              response="label")
+    vec = transmogrify([fs["x"], fs["cat"]])
+    pred = LogisticRegression(max_iter=25)(fs["label"], vec)
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    model1 = Workflow().set_result_features(pred).train(table=table)
+
+    fits = []
+    orig = LR.fit_columns
+
+    def counting(self, cols):
+        fits.append(type(self).__name__)
+        return orig(self, cols)
+
+    import pytest
+
+    mp = pytest.MonkeyPatch()
+    try:
+        mp.setattr(LR, "fit_columns", counting)
+        model2 = Workflow().set_result_features(pred).with_model_stages(model1).train(
+            table=table)
+    finally:
+        mp.undo()
+    assert fits == []  # the LR estimator reused the fitted stage
+    a = model1.score(table=table, keep_intermediate=True)
+    b = model2.score(table=table, keep_intermediate=True)
+    np.testing.assert_allclose(
+        np.asarray(a[pred.name].values["probability"]),
+        np.asarray(b[pred.name].values["probability"]), rtol=1e-6,
+    )

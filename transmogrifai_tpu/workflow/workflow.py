@@ -118,6 +118,16 @@ class Workflow(WorkflowCore):
         self._workflow_cv = True
         return self
 
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Warm start (reference OpWorkflow.withModelStages, OpWorkflow.scala:457-461):
+        estimators whose output feature name AND params match a fitted stage in the
+        given model reuse that fitted transformer instead of refitting. Stages whose
+        configuration changed (different params) still refit."""
+        self._warm_stages = {
+            s.get_output().name: s for s in model.stages
+        }
+        return self
+
     def set_result_features(self, *features: Feature) -> "Workflow":
         """Back-trace lineage into the layered DAG (OpWorkflow.scala:85-105)."""
         if not features:
@@ -209,14 +219,21 @@ class Workflow(WorkflowCore):
         for li, layer in enumerate(self._dag):
             estimators, device_tf, host_tf = split_layer_by_kind(layer)
             layer_transformers: list[Transformer] = list(device_tf) + list(host_tf)
+            warm = getattr(self, "_warm_stages", {})
             for est in estimators:
                 if refit_ids and est.operation_name == "modelSelector":
                     est._in_fold_matrix_fn = _make_fold_matrix_fn(
                         raw_data, list(plan_records), refit_ids,
                         est.inputs[1].name,
                     )
-                with profiling.phase(f"fit:{type(est).__name__}"):
-                    model = est.fit_table(data)
+                reused = warm.get(est.get_output().name)
+                if reused is not None and [f.name for f in reused.inputs] == [
+                    f.name for f in est.inputs
+                ]:
+                    model = reused  # warm start: grafted fitted stage, no refit
+                else:
+                    with profiling.phase(f"fit:{type(est).__name__}"):
+                        model = est.fit_table(data)
                 layer_transformers.append(model)
                 plan_records.append((est, model))
             for t in list(device_tf) + list(host_tf):
