@@ -421,3 +421,43 @@ def test_map_vectorizer_date_and_geo_maps():
     dm2 = FeatureBuilder.DateMap("dm2").as_predictor()
     vec = tmog([dm2])
     assert vec.kind.name == "OPVector"
+
+
+def test_smart_text_map_vectorizer_per_key_decision():
+    """Low-cardinality keys pivot; high-cardinality keys hash (reference
+    SmartTextMapVectorizer fit-time choice, per KEY)."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.stages.feature import SmartTextMapVectorizer
+    from transmogrifai_tpu.types import Column, Table, kind_of
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(60):
+        rows.append({
+            "color": ["red", "blue"][i % 2],                 # cardinality 2 -> pivot
+            "desc": f"unique text value number {i} {rng.integers(1e6)}",  # -> hash
+        })
+    f = FeatureBuilder.TextMap("m").as_predictor()
+    t = Table({"m": Column.build(kind_of("TextMap"), rows)}, len(rows))
+    st = SmartTextMapVectorizer(max_cardinality=10, num_features=32, min_support=1)
+    st(f)
+    model = st.fit_table(t)
+    plans = model.params["plans"][0]["key_plans"]
+    assert plans["color"]["mode"] == "pivot"
+    assert plans["desc"]["mode"] == "hash"
+    out = model.transform_columns([t["m"]])
+    groups = {s.group for s in out.schema.slots}
+    assert groups == {"color", "desc"}
+    # pivot block one-hots exactly one category per present row
+    color_cols = [i for i, s in enumerate(out.schema.slots)
+                  if s.group == "color" and s.indicator_value in ("red", "blue")]
+    vals = np.asarray(out.values)
+    assert np.all(vals[:, color_cols].sum(axis=1) == 1.0)
+    # transmogrify routes TextMap through the smart stage
+    from transmogrifai_tpu.stages.feature import transmogrify as tmog
+
+    f2 = FeatureBuilder.TextMap("m2").as_predictor()
+    vec = tmog([f2])
+    assert vec.origin_stage.operation_name in ("smartTextMap", "combineVectors")

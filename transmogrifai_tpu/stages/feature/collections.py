@@ -349,3 +349,114 @@ class MapVectorizerModel(SequenceVectorizer):
             mats.append(mat)
         return Column.vector(jnp.asarray(np.concatenate(mats, axis=1)),
                              VectorSchema(tuple(slots)))
+
+
+_TEXT_MAPS = ("TextMap", "TextAreaMap")
+
+
+@register_stage
+class SmartTextMapVectorizer(SequenceVectorizerEstimator):
+    """Text maps with a per-KEY cardinality decision: keys whose value vocabulary is
+    small pivot like a PickListMap key; high-cardinality keys hash their tokenized
+    values into a bounded space (reference SmartTextMapVectorizer.scala — the map
+    twin of SmartTextVectorizer's fit-time categorical-vs-hashing choice)."""
+
+    operation_name = "smartTextMap"
+    accepts = _TEXT_MAPS + _CATEGORICAL_MAPS
+
+    def __init__(self, max_cardinality: int = 30, top_k: int = 20, min_support: int = 10,
+                 num_features: int = 512, clean_text: bool = True,
+                 track_nulls: bool = True, seed: int = 0):
+        super().__init__(max_cardinality=max_cardinality, top_k=top_k,
+                         min_support=min_support, num_features=num_features,
+                         clean_text=clean_text, track_nulls=track_nulls, seed=seed)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        plans = []
+        for c in cols:
+            keys: dict[str, None] = {}
+            for m in c.values:
+                for k in (m or {}):
+                    keys[str(k)] = None
+            key_plans = {}
+            for key in sorted(keys):
+                counts: Counter = Counter()
+                for m in c.values:
+                    v = (m or {}).get(key)
+                    if v is not None:
+                        counts[clean_token(str(v), p["clean_text"])] += 1
+                if 0 < len(counts) <= p["max_cardinality"]:
+                    key_plans[key] = {
+                        "mode": "pivot",
+                        "categories": pick_top_k(counts, p["top_k"], p["min_support"]),
+                    }
+                else:
+                    key_plans[key] = {"mode": "hash"}
+            plans.append({"keys": sorted(keys), "key_plans": key_plans})
+        return SmartTextMapVectorizerModel(
+            plans=plans, num_features=p["num_features"], clean_text=p["clean_text"],
+            track_nulls=p["track_nulls"], seed=p["seed"],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs],
+        )
+
+
+@register_stage
+class SmartTextMapVectorizerModel(SequenceVectorizer):
+    operation_name = "smartTextMap"
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from .text import hash_token, tokenize
+
+        p = self.params
+        nf = p["num_features"]
+        track = p["track_nulls"]
+        mats, slots = [], []
+        for c, plan, name, kind in zip(cols, p["plans"], p["names"], p["kinds"]):
+            n = len(c)
+            for key in plan["keys"]:
+                kp = plan["key_plans"][key]
+                if kp["mode"] == "pivot":
+                    cats = kp["categories"]
+                    index = {v: i for i, v in enumerate(cats)}
+                    width = len(cats) + 1 + (1 if track else 0)
+                    mat = np.zeros((n, width), dtype=np.float32)
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if v is None:
+                            if track:
+                                mat[i, len(cats) + 1] = 1.0
+                            continue
+                        j = index.get(clean_token(str(v), p["clean_text"]))
+                        mat[i, j if j is not None else len(cats)] = 1.0
+                    slots.extend(
+                        SlotInfo(name, kind, group=key, indicator_value=v) for v in cats
+                    )
+                    slots.append(other_slot(name, kind, group=key))
+                    if track:
+                        slots.append(null_slot(name, kind, group=key))
+                else:
+                    width = nf + (1 if track else 0)
+                    mat = np.zeros((n, width), dtype=np.float32)
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if v is None:
+                            if track:
+                                mat[i, nf] = 1.0
+                            continue
+                        for tok in tokenize(str(v)):
+                            mat[i, hash_token(tok, nf, p["seed"])] += 1.0
+                    slots.extend(
+                        SlotInfo(name, kind, group=key, descriptor=f"hash_{i}")
+                        for i in range(nf)
+                    )
+                    if track:
+                        slots.append(null_slot(name, kind, group=key))
+                mats.append(mat)
+        if not mats:
+            return Column.vector(jnp.zeros((len(cols[0]), 0), jnp.float32),
+                                 VectorSchema(()))
+        return Column.vector(
+            jnp.asarray(np.concatenate(mats, axis=1)), VectorSchema(tuple(slots))
+        )

@@ -17,7 +17,12 @@ from typing import Optional, Sequence
 
 from ...graph.feature import Feature
 from .categorical import OneHotVectorizer
-from .collections import GeolocationVectorizer, MapVectorizer, MultiPickListVectorizer
+from .collections import (
+    GeolocationVectorizer,
+    MapVectorizer,
+    MultiPickListVectorizer,
+    SmartTextMapVectorizer,
+)
 from .combiner import VectorsCombiner
 from .date import DateListVectorizer, DateToUnitCircleVectorizer, TIME_PERIODS
 from .numeric import BinaryVectorizer, IntegralVectorizer, RealNNVectorizer, RealVectorizer
@@ -60,8 +65,10 @@ for _k in ("DateList", "DateTimeList"):
 _FAMILIES["MultiPickList"] = "multi_pick_list"
 _FAMILIES["Geolocation"] = "geolocation"
 _FAMILIES["OPVector"] = "vector"
-for _k in ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap", "TextMap",
-           "TextAreaMap", "PickListMap", "ComboBoxMap", "IDMap", "EmailMap", "URLMap",
+for _k in ("TextMap", "TextAreaMap"):
+    _FAMILIES[_k] = "smart_text_map"
+for _k in ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap",
+           "PickListMap", "ComboBoxMap", "IDMap", "EmailMap", "URLMap",
            "PhoneMap", "Base64Map", "CountryMap", "StateMap", "CityMap",
            "PostalCodeMap", "StreetMap", "BinaryMap", "MultiPickListMap",
            "DateMap", "DateTimeMap", "GeolocationMap"):
@@ -121,6 +128,11 @@ def transmogrify(
                 clean_text=d.clean_text, track_nulls=d.track_nulls)
         elif fam == "geolocation":
             stage = GeolocationVectorizer(track_nulls=d.track_nulls)
+        elif fam == "smart_text_map":
+            stage = SmartTextMapVectorizer(
+                max_cardinality=d.max_categorical_cardinality, top_k=d.top_k,
+                min_support=d.min_support, num_features=d.num_hash_features,
+                clean_text=d.clean_text, track_nulls=d.track_nulls, seed=d.hash_seed)
         elif fam == "map":
             stage = MapVectorizer(
                 top_k=d.top_k, min_support=d.min_support,
