@@ -98,28 +98,13 @@ def _build():
     return wf, selector, pred, fs
 
 
-def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache: the search's tree-family programs take
-    minutes to compile; caching them on disk makes every later bench/training run
-    start from the steady state (fresh processes included)."""
-    import jax
-
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                       ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:
-        pass  # older jax without the persistent cache: compile in-process only
-
-
 def main() -> None:
     import jax
 
     from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.utils.compile_cache import enable_compile_cache
 
-    _enable_compile_cache()
+    enable_compile_cache()
 
     reader = _reader()
     # warmup end-to-end train: pays one-time XLA compiles for every model family

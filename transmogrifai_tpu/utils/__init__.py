@@ -1,3 +1,4 @@
+from .compile_cache import enable_compile_cache
 from .uid import reset_uid_counter, uid, uid_type
 
-__all__ = ["uid", "uid_type", "reset_uid_counter"]
+__all__ = ["uid", "uid_type", "reset_uid_counter", "enable_compile_cache"]

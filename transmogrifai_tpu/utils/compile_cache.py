@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache helper.
+
+AutoML searches compile one program per (model family, static grid group); tree
+families take minutes. Caching compiled executables on disk lets fresh processes
+(CLI runs, benchmark reruns, retrains on the same shapes) start from the steady
+state. Opt out with TT_COMPILE_CACHE=0; default location is <repo>/.jax_cache or
+$TT_COMPILE_CACHE_DIR.
+"""
+from __future__ import annotations
+
+import os
+
+_ENABLED = False
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> bool:
+    """Idempotently point jax at a persistent on-disk compilation cache.
+    Returns True when active."""
+    global _ENABLED
+    if _ENABLED:
+        return True
+    if os.environ.get("TT_COMPILE_CACHE") == "0":
+        return False
+    import jax
+
+    cache_dir = (cache_dir or os.environ.get("TT_COMPILE_CACHE_DIR")
+                 or os.path.join(os.path.dirname(os.path.dirname(
+                     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _ENABLED = True
+    except Exception:  # older jax without the persistent cache
+        return False
+    return True

@@ -131,6 +131,9 @@ class WorkflowRunner:
 
     # --- dispatch (OpWorkflowRunner.scala:296-365) ------------------------------------
     def run(self, run_type: str, params: Optional[OpParams] = None) -> RunResult:
+        from ..utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         params = params or OpParams()
         if run_type not in RUN_TYPES:
             raise ValueError(f"run type must be one of {RUN_TYPES}, got {run_type!r}")
