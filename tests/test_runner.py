@@ -208,6 +208,42 @@ def test_codegen_project_runs(tmp_path, monkeypatch):
     assert "train done" in out.stdout
 
 
+def test_codegen_string_response_runs(tmp_path):
+    """`op gen` on a dataset whose response labels are strings ('male'/'female') must
+    emit indexing code instead of forcing RealNN (which crashed at float-parse)."""
+    data = tmp_path / "data.csv"
+    rng = np.random.default_rng(2)
+    with open(data, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=["pid", "species", "x1", "x2"])
+        w.writeheader()
+        for i in range(90):
+            k = int(rng.integers(0, 3))
+            w.writerow({"pid": i, "species": ["setosa", "versicolor", "virginica"][k],
+                        "x1": round(float(rng.normal(k, 0.3)), 3),
+                        "x2": round(float(rng.normal(-k, 0.3)), 3)})
+    from transmogrifai_tpu.cli.main import main
+
+    rc = main(["gen", "strproj", "--input", str(data), "--id", "pid",
+               "--response", "species", "--out", str(tmp_path)])
+    assert rc == 0
+    script = (tmp_path / "strproj" / "main.py").read_text()
+    assert 'index_string(handle_invalid="keep")' in script
+    assert '"species": "PickList"' in script
+
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "main.py", "--type", "train", "--data", str(data)],
+        cwd=str(tmp_path / "strproj"), env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "train done" in out.stdout
+
+
 def test_cli_run_command(tmp_path):
     app = tmp_path / "myapp.py"
     data_rows = _rows(60)

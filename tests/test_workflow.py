@@ -198,3 +198,94 @@ def test_warm_start_with_model_stages():
         np.asarray(a[pred.name].values["probability"]),
         np.asarray(b[pred.name].values["probability"]), rtol=1e-6,
     )
+
+
+def test_warm_start_refits_when_params_change():
+    """Changing an estimator's hyperparameters (e.g. a runner-applied OpParams
+    override) must force a refit even when the output/input feature names still
+    match — the reference matches uid+params (OpWorkflow.withModelStages)."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.stages.model.linear import LogisticRegression as LR
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x": float(rng.normal())}
+            for _ in range(120)]
+    fs = features_from_schema({"label": "RealNN", "x": "Real"}, response="label")
+    vec = transmogrify([fs["x"]])
+    lr = LogisticRegression(l2=0.01, max_iter=25)
+    pred = lr(fs["label"], vec)
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    model1 = Workflow().set_result_features(pred).train(table=table)
+
+    lr.params["l2"] = 10.0  # the runner's stage_params override path mutates in place
+
+    fits = []
+    orig = LR.fit_columns
+
+    def counting(self, cols):
+        fits.append(type(self).__name__)
+        return orig(self, cols)
+
+    import pytest
+
+    mp = pytest.MonkeyPatch()
+    try:
+        mp.setattr(LR, "fit_columns", counting)
+        model2 = Workflow().set_result_features(pred).with_model_stages(model1).train(
+            table=table)
+    finally:
+        mp.undo()
+    assert fits == ["LogisticRegression"]  # stale fitted stage NOT grafted
+    a = model1.score(table=table, keep_intermediate=True)
+    b = model2.score(table=table, keep_intermediate=True)
+    # heavy regularization visibly changes the scores
+    assert not np.allclose(np.asarray(a[pred.name].values["probability"]),
+                           np.asarray(b[pred.name].values["probability"]), atol=1e-3)
+
+
+def test_warm_start_after_save_load_roundtrip(tmp_path):
+    """origin params survive model save/load, so warm start still works (and still
+    guards against param drift) on a loaded model."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.stages.model.linear import LogisticRegression as LR
+    from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+    rng = np.random.default_rng(1)
+    rows = [{"label": float(rng.random() > 0.5), "x": float(rng.normal())}
+            for _ in range(80)]
+    fs = features_from_schema({"label": "RealNN", "x": "Real"}, response="label")
+    vec = transmogrify([fs["x"]])
+    pred = LogisticRegression(max_iter=25)(fs["label"], vec)
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    model1 = Workflow().set_result_features(pred).train(table=table)
+    model1.save(str(tmp_path / "m"))
+    loaded = WorkflowModel.load(str(tmp_path / "m"))
+
+    fits = []
+    orig = LR.fit_columns
+
+    def counting(self, cols):
+        fits.append(type(self).__name__)
+        return orig(self, cols)
+
+    import pytest
+
+    mp = pytest.MonkeyPatch()
+    try:
+        mp.setattr(LR, "fit_columns", counting)
+        Workflow().set_result_features(pred).with_model_stages(loaded).train(
+            table=table)
+    finally:
+        mp.undo()
+    assert fits == []  # loaded fitted stage reused, params verified equal

@@ -73,6 +73,38 @@ def test_in_fold_refit_happens_per_fold(monkeypatch):
     assert all(f < full for f in folds)
 
 
+def test_fold_matrix_fn_cleared_on_non_cv_train():
+    """A selector reused across workflows must not replay a stale per-fold closure
+    from a previous with_workflow_cv train (different row counts -> IndexError;
+    same counts -> silently wrong fold matrices)."""
+    fs, sel, pred = _graph()
+    table_cv = InMemoryReader(_noise_rows(n=240)).generate_table(list(fs.values()))
+    Workflow().set_result_features(pred).with_workflow_cv().train(table=table_cv)
+    # the closure (which pins the raw table + plan records) is not retained past fit
+    assert getattr(sel, "_in_fold_matrix_fn", None) is None
+    # second train of the same graph WITHOUT workflow CV, on a different-size table:
+    # a stale closure would IndexError replaying the old 240-row table's folds
+    table2 = InMemoryReader(_noise_rows(n=300, seed=3)).generate_table(list(fs.values()))
+    Workflow().set_result_features(pred).train(table=table2)
+    assert sel.summary_.n_train == 270  # 300 rows minus the 10% holdout
+
+
+def test_refit_set_excludes_downstream_estimators():
+    """Estimators downstream of the selector (e.g. insights over the Prediction) are
+    label-tainted but cannot leak into its folds; including them would force the
+    expensive per-fold path for nothing."""
+    from transmogrifai_tpu.insights.corr import RecordInsightsCorr
+
+    fs, sel, pred = _graph()
+    vector = sel.inputs[1]
+    insights = RecordInsightsCorr()(vector, pred)
+    dag = compute_dag([insights])
+    raw = list(fs.values())
+    refit = in_fold_estimators(dag, raw, sel)
+    kinds = {type(s).__name__ for layer in dag for s in layer if id(s) in refit}
+    assert kinds == {"DecisionTreeNumericBucketizer"}  # insights NOT in the refit set
+
+
 def test_workflow_cv_kills_bucketizer_leakage():
     """Naive CV lets the label-fit bucketizer see validation labels, inflating the
     validation metric on pure-noise data; workflow-level CV must not."""

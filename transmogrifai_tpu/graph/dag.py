@@ -80,10 +80,18 @@ def in_fold_estimators(dag: list[list[Stage]], raw_features: Sequence[Feature],
     (reference OpWorkflowCVTest semantics; DecisionTreeNumericBucketizer and
     SanityChecker are the canonical cases)."""
     tainted = label_tainted_features(dag, raw_features)
+    # only estimators topologically UPSTREAM of the selector's inputs can leak into
+    # its folds; a tainted estimator downstream (e.g. insights consuming the
+    # Prediction) must not trigger the expensive per-fold recomputation path
+    upstream: set[int] = set()
+    for inp in selector.inputs:
+        upstream |= {id(s) for s in inp.parent_stages()}
     out: set[int] = set()
     for layer in dag:
         for stage in layer:
             if stage is selector or not isinstance(stage, Estimator):
+                continue
+            if id(stage) not in upstream:
                 continue
             if any(id(p) in tainted for p in stage.inputs):
                 out.add(id(stage))

@@ -114,6 +114,21 @@ class ModelSelector(PredictorEstimator):
         self.mesh = mesh
         self.summary_: Optional[ModelSelectorSummary] = None
 
+    def config_fingerprint(self):
+        """The selector's search configuration lives in attributes, not ctor params;
+        warm-start reuse must see all of it (models/grids/metric/validator/splitter)."""
+        from ..stages.base import _jsonify
+
+        return {
+            **_jsonify(self.params),
+            "metric": self.metric,
+            "models": [[type(t).__name__, _jsonify(t.params), _jsonify(list(grid))]
+                       for t, grid in self.models],
+            "validator": [type(self.validator).__name__,
+                          _jsonify(vars(self.validator))],
+            "splitter": [type(self.splitter).__name__, _jsonify(vars(self.splitter))],
+        }
+
     # the selector's own fit is the whole search; fit_fn/predict_fn are the winner's
     def fit_columns(self, cols):
         y_full, X_full = self.label_and_matrix(cols)

@@ -156,12 +156,26 @@ class Estimator(Stage):
         adopt_wiring(self, model)
         return model
 
+    def config_fingerprint(self) -> Any:
+        """JSON-able description of everything that affects what fit() learns; the
+        warm-start reuse check compares fingerprints. Defaults to the ctor params;
+        stages holding extra configuration as attributes (e.g. ModelSelector's model
+        grids) must extend it."""
+        return _jsonify(self.params)
+
 
 def adopt_wiring(estimator: Stage, model: Stage) -> None:
     """Point a fitted model at its estimator's graph wiring: same inputs, same output
-    feature (the DAG node keeps its identity across the estimator->model swap)."""
+    feature (the DAG node keeps its identity across the estimator->model swap).
+    Also records the originating estimator's class + params on the model so warm-start
+    reuse (Workflow.with_model_stages) can verify the configuration is unchanged —
+    the reference matches uid+params in withModelStages (OpWorkflow.scala:457-461)."""
     model.inputs = estimator.inputs
     model._output = estimator._output
+    model.origin_class = type(estimator).__name__
+    model.origin_params = (estimator.config_fingerprint()
+                           if isinstance(estimator, Estimator)
+                           else _jsonify(estimator.params))
 
 
 class LambdaTransformer(Transformer):

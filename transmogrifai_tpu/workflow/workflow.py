@@ -17,10 +17,13 @@ naturally and run between device programs.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Callable, Optional, Sequence
 
 import jax
+
+_logger = logging.getLogger(__name__)
 
 from ..graph.dag import compute_dag, split_layer_by_kind, validate_dag
 from ..graph.feature import Feature, validate_distinct_names
@@ -205,14 +208,17 @@ class Workflow(WorkflowCore):
         from .. import profiling
 
         raw_data = data
-        refit_ids: set[int] = set()
+        # per-selector refit sets: a selector with a clean upstream must not pay the
+        # per-fold recomputation just because ANOTHER selector in the graph is tainted
+        refit_by_selector: dict[int, set[int]] = {}
         if self._workflow_cv:
             from ..graph.dag import in_fold_estimators
 
             selectors = [s for layer in self._dag for s in layer
                          if s.operation_name == "modelSelector"]
             for sel in selectors:
-                refit_ids |= in_fold_estimators(self._dag, self.raw_features, sel)
+                refit_by_selector[id(sel)] = in_fold_estimators(
+                    self._dag, self.raw_features, sel)
 
         fitted_stages: list[Transformer] = []
         plan_records: list[tuple[Stage, Transformer]] = []  # execution order
@@ -221,19 +227,42 @@ class Workflow(WorkflowCore):
             layer_transformers: list[Transformer] = list(device_tf) + list(host_tf)
             warm = getattr(self, "_warm_stages", {})
             for est in estimators:
-                if refit_ids and est.operation_name == "modelSelector":
-                    est._in_fold_matrix_fn = _make_fold_matrix_fn(
-                        raw_data, list(plan_records), refit_ids,
-                        est.inputs[1].name,
-                    )
+                is_selector = est.operation_name == "modelSelector"
+                if is_selector:
+                    # clear up-front: a stale closure from a previous with_workflow_cv
+                    # train would otherwise replay the per-fold path against the wrong
+                    # raw table (stage reuse across workflows is supported)
+                    est._in_fold_matrix_fn = None
                 reused = warm.get(est.get_output().name)
-                if reused is not None and [f.name for f in reused.inputs] == [
-                    f.name for f in est.inputs
-                ]:
+                wiring_match = reused is not None and [
+                    f.name for f in reused.inputs] == [f.name for f in est.inputs]
+                if (wiring_match
+                        and getattr(reused, "origin_class", None) == type(est).__name__
+                        and getattr(reused, "origin_params", None)
+                        == est.config_fingerprint()):
                     model = reused  # warm start: grafted fitted stage, no refit
                 else:
-                    with profiling.phase(f"fit:{type(est).__name__}"):
-                        model = est.fit_table(data)
+                    if wiring_match and getattr(reused, "origin_class", None) is None:
+                        _logger.warning(
+                            "with_model_stages: fitted stage for %r predates origin-"
+                            "param tracking (old manifest); refitting because its "
+                            "configuration cannot be verified",
+                            est.get_output().name,
+                        )
+                    sel_refit = refit_by_selector.get(id(est), set())
+                    if is_selector and sel_refit:
+                        est._in_fold_matrix_fn = _make_fold_matrix_fn(
+                            raw_data, list(plan_records), sel_refit,
+                            est.inputs[1].name,
+                        )
+                    try:
+                        with profiling.phase(f"fit:{type(est).__name__}"):
+                            model = est.fit_table(data)
+                    finally:
+                        if is_selector:
+                            # do not retain the closure (it pins the raw table and
+                            # every fitted plan record) beyond the fit itself
+                            est._in_fold_matrix_fn = None
                 layer_transformers.append(model)
                 plan_records.append((est, model))
             for t in list(device_tf) + list(host_tf):
@@ -387,6 +416,9 @@ class WorkflowModel(WorkflowCore):
         for s in self.stages:
             payload = {**s.to_json(), "output": s.get_output().name,
                        "output_kind": s.get_output().kind.name}
+            if getattr(s, "origin_class", None) is not None:
+                payload["origin"] = {"class": s.origin_class,
+                                     "params": s.origin_params}
             slim = {}
             for k, v in payload["params"].items():
                 if isinstance(v, list):
@@ -447,6 +479,9 @@ class WorkflowModel(WorkflowCore):
         stages: list[Transformer] = []
         for sj in manifest["stages"]:
             stage = Stage.from_json(sj)
+            if "origin" in sj:
+                stage.origin_class = sj["origin"]["class"]
+                stage.origin_params = sj["origin"]["params"]
             ins = [features[n] for n in sj["inputs"]]
             out = stage.set_input(*ins)
             out.name = sj["output"]
