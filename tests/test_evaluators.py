@@ -1,0 +1,182 @@
+"""Evaluator metrics tests (reference OpMultiClassificationEvaluatorTest /
+OpBinaryClassificationEvaluatorTest): hand-computable fixtures for the threshold /
+top-N sweeps and explicit masked-label handling."""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.evaluators.metrics_ops import multiclass_threshold_counts
+from transmogrifai_tpu.types import Column, Table
+
+
+def _pred_col(probs):
+    probs = np.asarray(probs, np.float32)
+    pred = probs.argmax(axis=1).astype(np.float32)
+    return Column.prediction(pred, probs, probs)
+
+
+class TestMulticlassThresholdCounts:
+    """Fixture worked out by hand against the reference semantics
+    (OpMultiClassificationEvaluator.calculateThresholdMetrics, .scala:89-269)."""
+
+    PROBS = np.array([
+        [0.2, 0.7, 0.1],    # label 1: rank 0 (in top1)
+        [0.6, 0.3, 0.1],    # label 1: rank 1 (top3 only)
+        [0.1, 0.2, 0.7],    # label 0: rank 2 (top3 only)
+    ], np.float32)
+    LABELS = np.array([1, 1, 0], np.int32)
+    TH = np.array([0.0, 0.5, 0.8], np.float32)
+
+    def test_hand_computed_counts(self):
+        cor, incor, nopred = multiclass_threshold_counts(
+            self.PROBS, self.LABELS, self.TH, (1, 3))
+        np.testing.assert_array_equal(np.asarray(cor), [[1, 1, 0], [3, 1, 0]])
+        np.testing.assert_array_equal(np.asarray(incor), [[2, 2, 0], [0, 2, 0]])
+        np.testing.assert_array_equal(np.asarray(nopred), [[0, 0, 3], [0, 0, 3]])
+
+    def test_counts_partition_rows(self):
+        # correct + incorrect + noPrediction == N at every (topN, threshold) cell
+        rng = np.random.default_rng(0)
+        raw = rng.random((50, 5)).astype(np.float32)
+        probs = raw / raw.sum(axis=1, keepdims=True)
+        labels = rng.integers(0, 5, size=50).astype(np.int32)
+        th = np.linspace(0.0, 1.0, 101).astype(np.float32)
+        cor, incor, nopred = multiclass_threshold_counts(probs, labels, th, (1, 2, 10))
+        total = np.asarray(cor) + np.asarray(incor) + np.asarray(nopred)
+        np.testing.assert_array_equal(total, np.full((3, 101), 50))
+
+    def test_unseen_label_never_correct(self):
+        # label index beyond the score vector scores 0 and is never in top-N
+        cor, incor, nopred = multiclass_threshold_counts(
+            self.PROBS, np.array([7, 7, 7], np.int32), self.TH, (3,))
+        np.testing.assert_array_equal(np.asarray(cor), [[0, 0, 0]])
+        np.testing.assert_array_equal(np.asarray(incor), [[3, 3, 0]])
+
+    def test_topn_larger_than_classes_equals_num_classes(self):
+        a = multiclass_threshold_counts(self.PROBS, self.LABELS, self.TH, (3,))
+        b = multiclass_threshold_counts(self.PROBS, self.LABELS, self.TH, (30,))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_unseen_label_never_correct_even_with_huge_topn(self):
+        # an unseen label's sentinel rank must stay unreachable past topN > C
+        cor, _, _ = multiclass_threshold_counts(
+            self.PROBS, np.array([7, 7, 7], np.int32), self.TH, (30,))
+        np.testing.assert_array_equal(np.asarray(cor), [[0, 0, 0]])
+
+
+class TestMulticlassEvaluator:
+    def test_threshold_metrics_in_report(self):
+        probs = TestMulticlassThresholdCounts.PROBS
+        table = Table({
+            "y": Column.real(np.array([1.0, 1.0, 0.0]), kind="Real"),
+            "p": _pred_col(probs),
+        })
+        ev = Evaluators.multi_classification("y", "p", top_ns=(1, 3),
+                                             thresholds=[0.0, 0.5, 0.8])
+        m = ev.evaluate_all(table)
+        tm = m.threshold_metrics
+        assert tm.topNs == [1, 3]
+        assert tm.correct_counts[1] == [1, 1, 0]
+        assert tm.correct_counts[3] == [3, 1, 0]
+        assert tm.incorrect_counts[1] == [2, 2, 0]
+        assert tm.no_prediction_counts[3] == [0, 0, 3]
+        assert "threshold_metrics" in m.to_json()
+
+    def test_masked_labels_dropped_without_warning(self):
+        # a masked (missing) label row must be excluded, not NaN->int cast
+        vals = jnp.asarray([1.0, 1.0, 0.0, jnp.nan])
+        mask = jnp.asarray([True, True, True, False])
+        probs = np.vstack([TestMulticlassThresholdCounts.PROBS,
+                           [[0.05, 0.05, 0.9]]])
+        table = Table({
+            "y": Column(Column.real([0.0]).kind, vals, mask),
+            "p": _pred_col(probs),
+        })
+        ev = Evaluators.multi_classification("y", "p", thresholds=[0.0, 0.5, 0.8])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any RuntimeWarning fails the test
+            m = ev.evaluate_all(table)
+        # only the 3 valid rows count
+        tm = m.threshold_metrics
+        assert np.asarray(tm.correct_counts[1]).max() <= 3
+        total = (np.asarray(tm.correct_counts[1]) + np.asarray(tm.incorrect_counts[1])
+                 + np.asarray(tm.no_prediction_counts[1]))
+        np.testing.assert_array_equal(total, [3, 3, 3])
+
+    def test_all_labels_masked_returns_zeros(self):
+        vals = jnp.asarray([jnp.nan, jnp.nan])
+        mask = jnp.asarray([False, False])
+        table = Table({
+            "y": Column(Column.real([0.0]).kind, vals, mask),
+            "p": _pred_col([[0.6, 0.4], [0.3, 0.7]]),
+        })
+        m = Evaluators.multi_classification("y", "p").evaluate_all(table)
+        assert m.F1 == 0.0 and m.Error == 0.0
+
+
+    def test_empty_top_ns_skips_sweep(self):
+        table = Table({
+            "y": Column.real(np.array([1.0, 1.0, 0.0]), kind="Real"),
+            "p": _pred_col(TestMulticlassThresholdCounts.PROBS),
+        })
+        m = Evaluators.multi_classification("y", "p", top_ns=()).evaluate_all(table)
+        assert m.threshold_metrics is None and m.F1 > 0
+
+
+def test_all_evaluators_defined_on_zero_valid_rows():
+    """Fully-masked labels: every evaluator returns defined zeros (NaN would corrupt
+    model selection silently; empty arrays crashed the AUC kernel)."""
+    vals = jnp.asarray([jnp.nan, jnp.nan])
+    mask = jnp.asarray([False, False])
+    y = Column(Column.real([0.0]).kind, vals, mask)
+    p = _pred_col([[0.6, 0.4], [0.3, 0.7]])
+    table = Table({"y": y, "p": p})
+    b = Evaluators.binary_classification("y", "p").evaluate_all(table)
+    assert b.AuROC == 0.0 and b.TP == 0.0
+    r = Evaluators.regression("y", "p").evaluate_all(table)
+    assert r.RootMeanSquaredError == 0.0  # defined, not NaN
+    s = Evaluators.bin_score("y", "p").evaluate_all(table)
+    assert s.BrierScore == 0.0
+
+
+def test_avro_nullable_bytes_encoded_per_field(tmp_path):
+    """A nullable bytes field that is null in the first record must still surface as
+    base64 text in later records (per-field schema check, not value sampling)."""
+    from transmogrifai_tpu.readers import AvroReader, write_avro
+
+    schema = {"type": "record", "name": "R", "fields": [
+        {"name": "b", "type": ["null", "bytes"]}]}
+    p = str(tmp_path / "b.avro")
+    write_avro(p, schema, [{"b": None}, {"b": b"\x01\x02"}])
+    recs = AvroReader(p).read_records()
+    assert recs[0]["b"] is None
+    assert isinstance(recs[1]["b"], str)  # base64 text, not raw bytes
+
+
+class TestBinaryMaskedLabels:
+    def test_masked_rows_excluded(self):
+        probs = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.5, 0.5]], np.float32)
+        vals = jnp.asarray([1.0, 0.0, 1.0, jnp.nan])
+        mask = jnp.asarray([True, True, True, False])
+        table = Table({
+            "y": Column(Column.real([0.0]).kind, vals, mask),
+            "p": _pred_col(probs),
+        })
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            m = Evaluators.binary_classification("y", "p").evaluate_all(table)
+        assert m.TP + m.TN + m.FP + m.FN == 3.0  # the masked row never counted
+        assert m.AuROC == 1.0  # perfectly separable on the 3 valid rows
+
+    def test_regression_masked_rows_excluded(self):
+        vals = jnp.asarray([1.0, 2.0, jnp.nan])
+        mask = jnp.asarray([True, True, False])
+        pred = Column.prediction(np.array([1.0, 2.0, 99.0], np.float32))
+        table = Table({"y": Column(Column.real([0.0]).kind, vals, mask), "p": pred})
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            m = Evaluators.regression("y", "p").evaluate_all(table)
+        assert m.RootMeanSquaredError < 1e-6  # the wild masked row is ignored

@@ -22,10 +22,20 @@ from .metrics_ops import (
     confusion_at,
     confusion_matrix,
     multiclass_prf,
+    multiclass_threshold_counts,
     prf,
     regression_metrics_ops,
     threshold_sweep,
 )
+
+
+def _valid_labels(label):
+    """-> (float label values [N], validity mask [N]). Masked / NaN labels are
+    excluded explicitly by every evaluator — never an undefined NaN->int cast
+    (the reference filters null labels upstream via makeDataToUse)."""
+    vals = np.asarray(label.values, np.float64)
+    ok = np.asarray(label.effective_mask(), bool) & ~np.isnan(vals)
+    return vals, ok
 
 
 @dataclass
@@ -52,6 +62,24 @@ class BinaryClassificationMetrics:
 
 
 @dataclass
+class ThresholdMetrics:
+    """Per-threshold / top-N correctness sweeps (reference ThresholdMetrics in
+    OpMultiClassificationEvaluator.scala): for every topN, counts by threshold of
+    rows whose true label is in the top-N scores with score >= threshold (correct),
+    rows where some prediction clears the threshold but not correctly (incorrect),
+    and rows where no score clears it (no prediction). The three sum to N."""
+
+    topNs: list = field(default_factory=list)
+    thresholds: list = field(default_factory=list)
+    correct_counts: dict = field(default_factory=dict)       # topN -> [T] counts
+    incorrect_counts: dict = field(default_factory=dict)
+    no_prediction_counts: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
 class MultiClassificationMetrics:
     Precision: float
     Recall: float
@@ -59,6 +87,7 @@ class MultiClassificationMetrics:
     Error: float
     confusion: list = field(default_factory=list)
     per_class_f1: list = field(default_factory=list)
+    threshold_metrics: Optional[ThresholdMetrics] = None
 
     def to_json(self) -> dict:
         return asdict(self)
@@ -112,8 +141,13 @@ class BinaryClassificationEvaluator(EvaluatorBase):
 
     def evaluate_all(self, table: Table) -> BinaryClassificationMetrics:
         label, pred = self._cols(table)
-        y = jnp.asarray(np.asarray(label.values), jnp.float32)
+        vals, ok = _valid_labels(label)
+        y = jnp.asarray(vals[ok], jnp.float32)
+        if y.size == 0:  # nothing labeled: defined zeros, not an empty-array crash
+            return BinaryClassificationMetrics(0.0, 0.0, 0.0, 0.0, 0.0, 0.0,
+                                               0.0, 0.0, 0.0, 0.0)
         scores = pred.prob[:, 1] if pred.prob.shape[1] > 1 else pred.prob[:, 0]
+        scores = scores[jnp.asarray(ok)]
         auroc, aupr = binary_curve_aucs(scores, y)
         tn, fp, fn, tp = confusion_at(scores, y, self.threshold)
         precision, recall, f1 = prf(tp, fp, fn)
@@ -141,18 +175,50 @@ class BinaryClassificationEvaluator(EvaluatorBase):
 class MultiClassificationEvaluator(EvaluatorBase):
     default_metric = "F1"
 
-    def __init__(self, label, prediction, num_classes: Optional[int] = None):
+    #: reference defaults: topNs (1, 3), thresholds 0.00..1.00 step 0.01
+    DEFAULT_TOP_NS = (1, 3)
+
+    def __init__(self, label, prediction, num_classes: Optional[int] = None,
+                 top_ns: Sequence[int] = DEFAULT_TOP_NS,
+                 thresholds: Optional[Sequence[float]] = None):
         super().__init__(label, prediction)
         self.num_classes = num_classes
+        if any(t <= 0 for t in top_ns):
+            raise ValueError("top_ns must be positive integers")
+        self.top_ns = tuple(int(t) for t in top_ns)  # () skips the threshold sweep
+        self.thresholds = (np.linspace(0.0, 1.0, 101) if thresholds is None
+                           else np.asarray(thresholds, np.float64))
+        if ((self.thresholds < 0) | (self.thresholds > 1)).any():
+            raise ValueError("thresholds must be in [0, 1]")
 
     def evaluate_all(self, table: Table) -> MultiClassificationMetrics:
         label, pred = self._cols(table)
-        y = np.asarray(label.values, np.int32)
-        p = np.asarray(pred.pred, np.int32)
+        vals, ok = _valid_labels(label)
+        y = vals[ok].astype(np.int32)
+        p = np.asarray(pred.pred, np.int32)[ok]
+        if y.size == 0:
+            return MultiClassificationMetrics(0.0, 0.0, 0.0, 0.0)
         nc = self.num_classes or int(max(y.max(), p.max())) + 1
         conf = confusion_matrix(p, y, nc)
         stats = multiclass_prf(conf)
-        correct = float(jnp.diag(conf).sum())
+        tm = None
+        if self.top_ns:
+            probs = np.asarray(pred.prob)[ok]
+            cor, incor, nopred = multiclass_threshold_counts(
+                probs, y, jnp.asarray(self.thresholds, jnp.float32), self.top_ns)
+            cor, incor, nopred = jax.device_get((cor, incor, nopred))
+            tm = ThresholdMetrics(
+                topNs=list(self.top_ns),
+                thresholds=self.thresholds.tolist(),
+                correct_counts={t: cor[i].tolist()
+                                for i, t in enumerate(self.top_ns)},
+                incorrect_counts={t: incor[i].tolist()
+                                  for i, t in enumerate(self.top_ns)},
+                no_prediction_counts={t: nopred[i].tolist()
+                                      for i, t in enumerate(self.top_ns)},
+            )
+        conf, stats = jax.device_get((conf, stats))
+        correct = float(np.diag(conf).sum())
         total = max(float(conf.sum()), 1.0)
         return MultiClassificationMetrics(
             Precision=float(stats["weighted_precision"]),
@@ -161,6 +227,7 @@ class MultiClassificationEvaluator(EvaluatorBase):
             Error=1.0 - correct / total,
             confusion=np.asarray(conf).tolist(),
             per_class_f1=[float(x) for x in stats["per_class_f1"]],
+            threshold_metrics=tm,
         )
 
 
@@ -170,8 +237,12 @@ class RegressionEvaluator(EvaluatorBase):
 
     def evaluate_all(self, table: Table) -> RegressionMetrics:
         label, pred = self._cols(table)
-        y = jnp.asarray(np.asarray(label.values), jnp.float32)
-        mse, rmse, mae, r2 = regression_metrics_ops(pred.pred, y)
+        vals, ok = _valid_labels(label)
+        y = jnp.asarray(vals[ok], jnp.float32)
+        if y.size == 0:
+            return RegressionMetrics(0.0, 0.0, 0.0, 0.0)
+        mse, rmse, mae, r2 = regression_metrics_ops(
+            jnp.asarray(pred.pred)[jnp.asarray(ok)], y)
         return RegressionMetrics(
             RootMeanSquaredError=float(rmse), MeanSquaredError=float(mse),
             MeanAbsoluteError=float(mae), R2=float(r2),
@@ -229,8 +300,12 @@ class BinScoreEvaluator(EvaluatorBase):
 
     def evaluate_all(self, table: Table) -> BinaryClassificationBinMetrics:
         label, pred = self._cols(table)
-        y = jnp.asarray(np.asarray(label.values), jnp.float32)
+        vals, ok = _valid_labels(label)
+        y = jnp.asarray(vals[ok], jnp.float32)
+        if y.size == 0:
+            return BinaryClassificationBinMetrics(0.0, 1.0 / self.num_bins)
         scores = pred.prob[:, 1] if pred.prob.shape[1] > 1 else pred.prob[:, 0]
+        scores = scores[jnp.asarray(ok)]
         k = self.num_bins
         bin_of = jnp.clip((scores * k).astype(jnp.int32), 0, k - 1)
         ones = jnp.ones_like(scores)

@@ -6,6 +6,8 @@ OpMultiClassificationEvaluator.scala:89-269, OpRegressionEvaluator.scala:61-101)
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -126,3 +128,46 @@ def regression_metrics_ops(pred: jnp.ndarray, labels: jnp.ndarray):
     ss_tot = jnp.maximum(jnp.sum((y - y.mean()) ** 2), 1e-12)
     r2 = 1.0 - ss_res / ss_tot
     return mse, rmse, mae, r2
+
+
+@partial(jax.jit, static_argnums=(3,))
+def multiclass_threshold_counts(probs, labels, thresholds, top_ns: tuple):
+    """Per-(topN, threshold) correct / incorrect / no-prediction counts (reference
+    OpMultiClassificationEvaluator.calculateThresholdMetrics semantics, .scala:89-269)
+    as ONE vectorized pass — no per-row host loop, no treeAggregate.
+
+    A row counts at (t, j) as
+      correct:    true label among the top-t scores AND thresholds[j] <= score(true)
+      incorrect:  a prediction was made (thresholds[j] <= max score) but not correct
+      no predict: max score below thresholds[j]
+    A label outside [0, C) (unseen during training) scores 0 and is never in top-t.
+    Returns three [len(top_ns), T] int32 arrays; the three sum to N at every cell.
+    """
+    probs = jnp.asarray(probs, jnp.float32)          # [N, C]
+    labels = jnp.asarray(labels, jnp.int32)          # [N]
+    th = jnp.asarray(thresholds, jnp.float32)        # [T]
+    n, c = probs.shape
+    seen = (labels >= 0) & (labels < c)
+    safe = jnp.clip(labels, 0, c - 1)
+    true_score = jnp.where(seen, probs[jnp.arange(n), safe], 0.0)
+    top_score = probs.max(axis=1)
+    # stable descending rank of the true class: classes with strictly greater score,
+    # plus equal-score classes at a smaller index (stable sort tie order)
+    gt = (probs > true_score[:, None]).sum(axis=1)
+    eq_before = ((probs == true_score[:, None])
+                 & (jnp.arange(c)[None, :] < safe[:, None])).sum(axis=1)
+    # unseen labels get an unreachable rank: c alone would still pass rank < t when
+    # the caller asks for topN > num_classes
+    rank = jnp.where(seen, gt + eq_before, jnp.iinfo(jnp.int32).max)
+    true_le = th[None, :] <= true_score[:, None]     # [N, T]
+    top_ge = th[None, :] <= top_score[:, None]       # [N, T]
+    no_pred = (~top_ge).sum(axis=0).astype(jnp.int32)
+    corrects, incorrects = [], []
+    for t in top_ns:
+        in_top = (rank < t)[:, None]                 # [N, 1]
+        correct = in_top & true_le                   # true_le implies top_ge
+        incorrect = jnp.where(in_top, (~true_le) & top_ge, top_ge)
+        corrects.append(correct.sum(axis=0).astype(jnp.int32))
+        incorrects.append(incorrect.sum(axis=0).astype(jnp.int32))
+    return (jnp.stack(corrects), jnp.stack(incorrects),
+            jnp.broadcast_to(no_pred, (len(top_ns), th.shape[0])))
