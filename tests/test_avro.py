@@ -1,0 +1,212 @@
+"""Avro codec + reader tests (reference AvroReaders.scala / DataReaders factory
+surface; codec implemented from the Avro 1.8 spec in readers/avro.py)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.readers import (
+    Aggregate,
+    AvroReader,
+    Conditional,
+    CSVReader,
+    Simple,
+    read_avro,
+    save_avro,
+    write_avro,
+)
+from transmogrifai_tpu.readers.avro import avro_schema_for_kinds, kinds_from_avro_schema
+from transmogrifai_tpu.types import Table
+
+TITANIC_AVRO = "/root/reference/test-data/PassengerDataAll.avro"
+TITANIC_CSV = "/root/reference/test-data/PassengerDataAll.csv"
+PASSENGER_SNAPPY = "/root/reference/test-data/PassengerData.avro"
+
+needs_fixture = pytest.mark.skipif(
+    not __import__("os").path.exists(TITANIC_AVRO), reason="reference data not mounted")
+
+
+class TestCodec:
+    def test_roundtrip_all_types(self, tmp_path):
+        schema = {
+            "type": "record", "name": "T", "fields": [
+                {"name": "i", "type": ["null", "long"]},
+                {"name": "f", "type": "double"},
+                {"name": "s", "type": ["null", "string"]},
+                {"name": "b", "type": "boolean"},
+                {"name": "e", "type": {"type": "enum", "name": "E",
+                                       "symbols": ["A", "B"]}},
+                {"name": "arr", "type": {"type": "array", "items": "long"}},
+                {"name": "m", "type": {"type": "map", "values": "double"}},
+            ],
+        }
+        records = [
+            {"i": 1, "f": 1.5, "s": "x", "b": True, "e": "A",
+             "arr": [1, 2, 3], "m": {"a": 0.5}},
+            {"i": None, "f": -2.25, "s": None, "b": False, "e": "B",
+             "arr": [], "m": {}},
+            {"i": -(2 ** 40), "f": 0.0, "s": "émoji ✓", "b": True, "e": "A",
+             "arr": [10 ** 12], "m": {"k1": 1.0, "k2": 2.0}},
+        ]
+        for codec in ("null", "deflate"):
+            p = str(tmp_path / f"t_{codec}.avro")
+            write_avro(p, schema, records, codec=codec)
+            s2, r2 = read_avro(p)
+            assert s2 == schema
+            assert r2 == records
+
+    def test_multi_block_files(self, tmp_path):
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "v", "type": "long"}]}
+        records = [{"v": i} for i in range(10_000)]
+        p = str(tmp_path / "big.avro")
+        write_avro(p, schema, records, block_records=256)
+        _, r2 = read_avro(p)
+        assert r2 == records
+
+    def test_corrupt_magic_raises(self, tmp_path):
+        p = tmp_path / "bad.avro"
+        p.write_bytes(b"NOPE" + b"\x00" * 64)
+        with pytest.raises(ValueError, match="container"):
+            read_avro(str(p))
+
+
+@needs_fixture
+class TestReferenceFixtures:
+    def test_reads_titanic_container(self):
+        schema, records = read_avro(TITANIC_AVRO)
+        assert len(records) == 891
+        assert records[0]["Name"] == "Braund, Mr. Owen Harris"
+        kinds = kinds_from_avro_schema(schema)
+        assert kinds["Age"] == "Real" and kinds["PassengerId"] == "Integral"
+
+    def test_reads_snappy_container(self):
+        _, records = read_avro(PASSENGER_SNAPPY)
+        assert len(records) == 8
+        assert records[0]["stringMap"] == {"Female": "string"}
+
+    def test_avro_reader_matches_csv_reader(self):
+        """Same table from the avro and csv forms of the same data."""
+        overrides = {"Survived": "RealNN", "Sex": "PickList", "Pclass": "PickList",
+                     "Embarked": "PickList"}
+        avro_reader = Simple.avro(TITANIC_AVRO, overrides)
+        feats = features_from_schema(
+            {**{k: str(v.name) for k, v in avro_reader.schema.items()}},
+            response="Survived")
+        t = avro_reader.generate_table(list(feats.values()))
+        assert t.nrows == 891
+        assert t["Sex"].to_list()[:3] == ["male", "female", "female"]
+        ages = t["Age"].to_list()
+        assert ages[0] == pytest.approx(22.0) and ages[5] is None  # nulls survive
+        survived = np.asarray(t["Survived"].values)
+        assert float(survived.sum()) == 342.0  # the canonical titanic label count
+
+    def test_workflow_trains_from_avro(self):
+        from transmogrifai_tpu.stages.feature import transmogrify
+        from transmogrifai_tpu.stages.model import LogisticRegression
+        from transmogrifai_tpu.workflow import Workflow
+
+        reader = Simple.avro(
+            TITANIC_AVRO, {"Survived": "RealNN", "Sex": "PickList",
+                           "Pclass": "PickList", "Embarked": "PickList"})
+        schema = {k: str(v.name) for k, v in reader.schema.items()}
+        fs = features_from_schema(schema, response="Survived")
+        predictors = [fs[n] for n in ("Sex", "Age", "Fare", "Pclass", "Embarked")]
+        pred = LogisticRegression(max_iter=25)(fs["Survived"], transmogrify(predictors))
+        model = Workflow().set_reader(reader).set_result_features(pred).train()
+        from transmogrifai_tpu.evaluators import Evaluators
+
+        scores = model.score(reader=reader, keep_intermediate=True)
+        m = Evaluators.binary_classification("Survived", pred).evaluate_all(scores)
+        assert m.AuROC > 0.80
+
+
+class TestAggregateOverAvro:
+    """Aggregate/conditional semantics against an avro events fixture (the VERDICT
+    parity ask: reader factory surface over avro, DataReaders.scala:116-270)."""
+
+    SCHEMA = {
+        "type": "record", "name": "Event", "fields": [
+            {"name": "id", "type": "string"},
+            {"name": "t", "type": "long"},
+            {"name": "amount", "type": ["null", "double"]},
+            {"name": "churned", "type": "boolean"},
+            {"name": "convert", "type": "boolean"},
+        ],
+    }
+    RECORDS = [
+        {"id": "u1", "t": 10, "amount": 1.0, "churned": False, "convert": False},
+        {"id": "u1", "t": 40, "amount": 9.0, "churned": True, "convert": True},
+        {"id": "u2", "t": 15, "amount": 5.0, "churned": False, "convert": True},
+        {"id": "u2", "t": 50, "amount": 7.0, "churned": True, "convert": False},
+        {"id": "u3", "t": 5, "amount": 2.0, "churned": False, "convert": False},
+    ]
+
+    @pytest.fixture
+    def events_avro(self, tmp_path):
+        p = str(tmp_path / "events.avro")
+        write_avro(p, self.SCHEMA, self.RECORDS)
+        return p
+
+    def _features(self):
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        label = (FeatureBuilder.Binary("churned")
+                 .extract(lambda r: r["churned"]).as_response())
+        return amount, label
+
+    def test_aggregate_avro(self, events_avro):
+        from transmogrifai_tpu.aggregators import CutOffTime
+
+        amount, label = self._features()
+        reader = Aggregate.avro(
+            events_avro, key_field="id", timestamp_fn=lambda r: r["t"],
+            cutoff=CutOffTime.unix_epoch(30))
+        t = reader.generate_table([amount, label])
+        assert t["key"].to_list() == ["u1", "u2", "u3"]
+        # predictors: strictly-before-cutoff events; responses: at/after
+        assert t["amount"].to_list() == pytest.approx([1.0, 5.0, 2.0])
+        assert t["churned"].to_list() == [True, True, None]
+
+    def test_conditional_avro(self, events_avro):
+        amount, label = self._features()
+        reader = Conditional.avro(
+            events_avro, key_field="id", timestamp_fn=lambda r: r["t"],
+            target_condition=lambda r: r["convert"],
+            response_window_ms=None, drop_if_target_condition_not_met=True,
+            timestamp_to_keep="min")
+        t = reader.generate_table([amount, label])
+        assert t["key"].to_list() == ["u1", "u2"]  # u3 never met the condition
+        assert t["amount"].to_list()[0] == pytest.approx(1.0)
+        assert t["amount"].to_list()[1] is None
+        assert t["churned"].to_list() == [True, True]
+
+
+class TestSaveAvro:
+    def test_table_roundtrip(self, tmp_path):
+        from transmogrifai_tpu.types import Column
+
+        t = Table({
+            "x": Column.build("Real", [1.5, None, 3.0]),
+            "n": Column.build("Integral", [1, 2, None]),
+            "s": Column.build("Text", ["a", None, "c"]),
+            "b": Column.build("Binary", [True, False, None]),
+        })
+        p = str(tmp_path / "t.avro")
+        save_avro(t, p)
+        schema, records = read_avro(p)
+        assert [f["name"] for f in schema["fields"]] == ["x", "n", "s", "b"]
+        assert records[0] == {"x": 1.5, "n": 1, "s": "a", "b": True}
+        assert records[1]["x"] is None and records[1]["s"] is None
+        # and it reads back through the typed reader
+        reader = AvroReader(p, {"x": "Real", "n": "Integral", "s": "Text",
+                                "b": "Binary"})
+        fs = features_from_schema(
+            {"x": "Real", "n": "Integral", "s": "Text", "b": "Binary"})
+        t2 = reader.generate_table(list(fs.values()))
+        assert t2["x"].to_list() == [1.5, None, 3.0]
+        assert t2["b"].to_list() == [True, False, None]
+
+    def test_avro_schema_for_kinds(self):
+        s = avro_schema_for_kinds("R", {"a": "Real", "b": "PickList", "c": "Date"})
+        types = {f["name"]: f["type"][1] for f in s["fields"]}
+        assert types == {"a": "double", "b": "string", "c": "long"}

@@ -1,6 +1,7 @@
 """Reader factories (analog of the reference DataReaders.Simple/Aggregate/Conditional
 factory surface, readers/.../DataReaders.scala:49-270)."""
 from .aggregates import KEY_COLUMN, AggregateReader, ConditionalReader
+from .avro import AvroReader, read_avro, save_avro, write_avro
 from .base import DataReader, InMemoryReader, TableReader
 from .csv import CSVAutoReader, CSVReader, ParquetReader, infer_schema
 from .joined import (
@@ -19,20 +20,28 @@ class Simple:
 
     csv = CSVReader
     csv_auto = CSVAutoReader
+    avro = AvroReader
     parquet = ParquetReader
     records = InMemoryReader
     table = TableReader
+    # Scala case-class readers parse into products; dict records play that role here
+    csv_case = CSVReader
+    parquet_case = ParquetReader
 
 
 def _csv_base(path, schema, key_fn, key_field):
     """CSV base reader + entity-key fn for the aggregate factories: auto-infer the
     schema when none is given; accept either key_fn or a key_field column name."""
     reader = CSVReader(path, schema) if schema is not None else CSVAutoReader(path)
+    return reader, _key_fn_of(key_fn, key_field)
+
+
+def _key_fn_of(key_fn, key_field):
     if key_fn is None:
         if key_field is None:
-            raise ValueError("aggregate csv readers need key_fn or key_field")
-        key_fn = lambda r: r[key_field]
-    return reader, key_fn
+            raise ValueError("grouped readers need key_fn or key_field")
+        return lambda r: r[key_field]
+    return key_fn
 
 
 class Aggregate:
@@ -47,6 +56,16 @@ class Aggregate:
     def csv(path, schema=None, key_fn=None, key_field=None, **kw) -> AggregateReader:
         base, key_fn = _csv_base(path, schema, key_fn, key_field)
         return AggregateReader(base, key_fn, **kw)
+
+    @staticmethod
+    def avro(path, schema=None, key_fn=None, key_field=None, **kw) -> AggregateReader:
+        return AggregateReader(AvroReader(path, schema),
+                               _key_fn_of(key_fn, key_field), **kw)
+
+    @staticmethod
+    def parquet(path, schema=None, key_fn=None, key_field=None, **kw) -> AggregateReader:
+        return AggregateReader(ParquetReader(path, schema),
+                               _key_fn_of(key_fn, key_field), **kw)
 
     reader = AggregateReader
 
@@ -63,6 +82,17 @@ class Conditional:
         base, key_fn = _csv_base(path, schema, key_fn, key_field)
         return ConditionalReader(base, key_fn, **kw)
 
+    @staticmethod
+    def avro(path, schema=None, key_fn=None, key_field=None, **kw) -> ConditionalReader:
+        return ConditionalReader(AvroReader(path, schema),
+                                 _key_fn_of(key_fn, key_field), **kw)
+
+    @staticmethod
+    def parquet(path, schema=None, key_fn=None, key_field=None,
+                **kw) -> ConditionalReader:
+        return ConditionalReader(ParquetReader(path, schema),
+                                 _key_fn_of(key_fn, key_field), **kw)
+
     reader = ConditionalReader
 
 
@@ -72,7 +102,11 @@ __all__ = [
     "TableReader",
     "CSVReader",
     "CSVAutoReader",
+    "AvroReader",
     "ParquetReader",
+    "read_avro",
+    "write_avro",
+    "save_avro",
     "infer_schema",
     "Simple",
     "Aggregate",

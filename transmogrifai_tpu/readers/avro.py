@@ -1,0 +1,411 @@
+"""Avro object-container ingestion and writing, implemented from the Avro 1.8 spec.
+
+Analog of reference AvroReaders.scala:44-90 (the primary schema'd format of the
+reference's reader factory, DataReaders.scala:49-270) and of RichDataset.saveAvro
+(features/.../RichDataset.scala:174-191). No external avro library exists in this
+environment, so the binary codec lives here: zigzag-varint primitives, record/union/
+array/map/enum/fixed decoding, and null/deflate block codecs. Decoding is a host-side
+ingestion step (string/row-local work stays off the TPU — SURVEY.md §7); the typed
+columns it produces feed the device path like every other reader.
+"""
+from __future__ import annotations
+
+import base64
+import io
+import json
+import struct
+import zlib
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..types import kind_of
+from .base import DataReader
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+
+
+# --- binary primitives (Avro spec: zigzag varint longs, little-endian IEEE floats) ----
+def _read_long(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    while True:
+        if n & ~0x7F:
+            out.write(bytes([(n & 0x7F) | 0x80]))
+            n >>= 7
+        else:
+            out.write(bytes([n]))
+            return
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+# --- schema-driven value decoding -----------------------------------------------------
+def _decode(schema: Any, buf: io.BytesIO) -> Any:
+    """Decode one value of `schema` (parsed JSON avro schema) from buf."""
+    if isinstance(schema, list):  # union: long branch index then value
+        idx = _read_long(buf)
+        return _decode(schema[idx], buf)
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _decode(f["type"], buf) for f in schema["fields"]}
+        if t == "enum":
+            return schema["symbols"][_read_long(buf)]
+        if t == "array":
+            out = []
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    return out
+                if count < 0:  # block with byte size prefix
+                    count = -count
+                    _read_long(buf)
+                for _ in range(count):
+                    out.append(_decode(schema["items"], buf))
+        if t == "map":
+            out = {}
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    return out
+                if count < 0:
+                    count = -count
+                    _read_long(buf)
+                for _ in range(count):
+                    k = _read_bytes(buf).decode("utf-8")
+                    out[k] = _decode(schema["values"], buf)
+        if t == "fixed":
+            return buf.read(schema["size"])
+        return _decode(t, buf)  # {"type": "string"} primitive wrapper
+    # primitive by name
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) != b"\x00"
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema in ("bytes", "string"):
+        raw = _read_bytes(buf)
+        return raw.decode("utf-8") if schema == "string" else raw
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _encode(schema: Any, value: Any, out: io.BytesIO) -> None:
+    if isinstance(schema, list):  # union: pick the null branch for None, else non-null
+        for i, branch in enumerate(schema):
+            if (value is None) == (branch == "null"):
+                _write_long(out, i)
+                _encode(branch, value, out)
+                return
+        raise ValueError(f"no union branch of {schema} fits {value!r}")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _encode(f["type"], value.get(f["name"]), out)
+            return
+        if t == "enum":
+            _write_long(out, schema["symbols"].index(value))
+            return
+        if t == "array":
+            if value:
+                _write_long(out, len(value))
+                for v in value:
+                    _encode(schema["items"], v, out)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if value:
+                _write_long(out, len(value))
+                for k, v in value.items():
+                    raw = str(k).encode("utf-8")
+                    _write_long(out, len(raw))
+                    out.write(raw)
+                    _encode(schema["values"], v, out)
+            _write_long(out, 0)
+            return
+        if t == "fixed":
+            out.write(value)
+            return
+        _encode(t, value, out)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+        return
+    if schema in ("int", "long"):
+        _write_long(out, int(value))
+        return
+    if schema == "float":
+        out.write(struct.pack("<f", float(value)))
+        return
+    if schema == "double":
+        out.write(struct.pack("<d", float(value)))
+        return
+    if schema in ("bytes", "string"):
+        raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        _write_long(out, len(raw))
+        out.write(raw)
+        return
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+# --- container files ------------------------------------------------------------------
+def read_avro(path: str) -> tuple[dict, list[dict]]:
+    """-> (writer schema as parsed JSON, records as dicts)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path} is not an avro object container file")
+    meta: dict[str, bytes] = {}
+    while True:
+        count = _read_long(buf)
+        if count == 0:
+            break
+        if count < 0:
+            count = -count
+            _read_long(buf)
+        for _ in range(count):
+            k = _read_bytes(buf).decode("utf-8")
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    if codec not in ("null", "deflate", "snappy"):
+        raise NotImplementedError(f"avro codec {codec!r} not supported")
+    sync = buf.read(SYNC_SIZE)
+    records: list[dict] = []
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, io.SEEK_CUR)
+        count = _read_long(buf)
+        block = _read_bytes(buf)
+        if codec == "deflate":
+            block = zlib.decompress(block, wbits=-15)
+        elif codec == "snappy":  # snappy payload + 4-byte big-endian CRC32
+            import pyarrow as pa
+
+            # raw snappy leads with the uncompressed size as an LE base-128 varint
+            size, shift, i = 0, 0, 0
+            while True:
+                b = block[i]
+                size |= (b & 0x7F) << shift
+                i += 1
+                if not b & 0x80:
+                    break
+                shift += 7
+            block = pa.Codec("snappy").decompress(
+                block[:-4], decompressed_size=size).to_pybytes()
+        bbuf = io.BytesIO(block)
+        for _ in range(count):
+            records.append(_decode(schema, bbuf))
+        if buf.read(SYNC_SIZE) != sync:
+            raise ValueError("sync marker mismatch (corrupt avro block)")
+    return schema, records
+
+
+def write_avro(path: str, schema: dict, records: Sequence[dict], *,
+               codec: str = "deflate", block_records: int = 4096) -> None:
+    """Write an object container file (saveAvro analog, RichDataset.scala:174-191)."""
+    if codec not in ("null", "deflate"):
+        raise NotImplementedError(f"avro codec {codec!r} not supported")
+    import hashlib
+
+    sync = hashlib.md5(  # deterministic per (path, schema): reproducible outputs
+        (path + json.dumps(schema, sort_keys=True)).encode()).digest()
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode("utf-8"),
+            "avro.codec": codec.encode("utf-8")}
+    _write_long(out, len(meta))
+    for k, v in meta.items():
+        raw = k.encode("utf-8")
+        _write_long(out, len(raw))
+        out.write(raw)
+        _write_long(out, len(v))
+        out.write(v)
+    _write_long(out, 0)
+    out.write(sync)
+    for start in range(0, len(records), block_records):
+        chunk = records[start:start + block_records]
+        body = io.BytesIO()
+        for r in chunk:
+            _encode(schema, r, body)
+        payload = body.getvalue()
+        if codec == "deflate":
+            z = zlib.compressobj(6, zlib.DEFLATED, -15)  # raw deflate, no zlib wrapper
+            payload = z.compress(payload) + z.flush()
+        _write_long(out, len(chunk))
+        _write_long(out, len(payload))
+        out.write(payload)
+        out.write(sync)
+    with open(path, "wb") as fh:
+        fh.write(out.getvalue())
+
+
+# --- schema mapping -------------------------------------------------------------------
+_PRIMITIVE_KINDS = {
+    "int": "Integral", "long": "Integral", "float": "Real", "double": "Real",
+    "boolean": "Binary", "string": "Text", "bytes": "Base64",
+}
+
+
+def kinds_from_avro_schema(schema: dict) -> dict[str, str]:
+    """Writer record schema -> {field: feature-kind-name}. Unions with null map to
+    the nullable kind of the non-null branch; enums become PickList; arrays of
+    strings become TextList. Nested records/maps are not raw-feature material."""
+    if schema.get("type") != "record":
+        raise ValueError("top-level avro schema must be a record")
+    out: dict[str, str] = {}
+    for f in schema["fields"]:
+        out[f["name"]] = _kind_of_avro_type(f["type"], f["name"])
+    return out
+
+
+def _has_bytes_branch(t: Any) -> bool:
+    if isinstance(t, list):
+        return any(_has_bytes_branch(b) for b in t)
+    if isinstance(t, dict):
+        return t["type"] in ("bytes", "fixed")
+    return t in ("bytes", "fixed")
+
+
+def _kind_of_avro_type(t: Any, name: str) -> str:
+    if isinstance(t, list):
+        branches = [b for b in t if b != "null"]
+        if len(branches) != 1:
+            raise ValueError(f"field {name!r}: multi-type unions unsupported")
+        return _kind_of_avro_type(branches[0], name)
+    if isinstance(t, dict):
+        tt = t["type"]
+        if tt == "enum":
+            return "PickList"
+        if tt == "fixed":
+            return "Base64"
+        if tt == "array":
+            if t["items"] == "string":
+                return "TextList"
+            raise ValueError(f"field {name!r}: array of {t['items']} unsupported")
+        if tt in _PRIMITIVE_KINDS:
+            return _PRIMITIVE_KINDS[tt]
+        raise ValueError(f"field {name!r}: nested avro type {tt!r} unsupported")
+    if t in _PRIMITIVE_KINDS:
+        return _PRIMITIVE_KINDS[t]
+    raise ValueError(f"field {name!r}: avro type {t!r} unsupported")
+
+
+def avro_schema_for_kinds(name: str, schema: dict[str, Any]) -> dict:
+    """{field: kind} -> writable avro record schema (kinds collapse to long/double/
+    boolean/string unions with null)."""
+    fields = []
+    for fname, kind in schema.items():
+        k = kind_of(kind) if isinstance(kind, str) else kind
+        st = k.storage.value
+        avro_t = {"integral": "long", "date": "long", "real": "double",
+                  "binary": "boolean"}.get(st, "string")
+        fields.append({"name": fname, "type": ["null", avro_t]})
+    return {"type": "record", "name": name, "fields": fields}
+
+
+class AvroReader(DataReader):
+    """Typed reader over an avro container file (reference AvroReaders.scala:44-90).
+
+    The writer schema embedded in the file determines field kinds; pass `schema`
+    entries to override (e.g. promote a string field to PickList, or an int label
+    to RealNN) — the reference gets this from its compiled avsc record classes.
+    """
+
+    def __init__(self, path: str, schema: Optional[dict[str, str]] = None, *,
+                 key_field: Optional[str] = None):
+        super().__init__(key_fn=(lambda r: r[key_field]) if key_field else None)
+        self.path = path
+        self._overrides = dict(schema or {})
+        self._parsed: Optional[tuple[dict, list[dict]]] = None
+
+    def _load(self) -> tuple[dict, list[dict]]:
+        if self._parsed is None:
+            self._parsed = read_avro(self.path)
+        return self._parsed
+
+    @property
+    def schema(self) -> dict[str, Any]:
+        writer_schema, _ = self._load()
+        kinds = kinds_from_avro_schema(writer_schema)
+        kinds.update(self._overrides)
+        return {k: kind_of(v) if isinstance(v, str) else v for k, v in kinds.items()}
+
+    def read_records(self) -> list[dict]:
+        writer_schema, records = self._load()
+        # bytes/fixed fields surface as base64 text (Base64 kind); decide per FIELD
+        # from the writer schema — a nullable bytes field may be null in any prefix
+        # of the records, so value-sampling would miss it
+        byte_fields = [
+            f["name"] for f in writer_schema.get("fields", ())
+            if _has_bytes_branch(f["type"])
+        ]
+        for name in byte_fields:
+            for r in records:
+                v = r.get(name)
+                if isinstance(v, bytes):
+                    r[name] = base64.b64encode(v).decode("ascii")
+        return records
+
+    def read_columnar(self) -> dict[str, np.ndarray]:
+        records = self.read_records()
+        out: dict[str, np.ndarray] = {}
+        for name in self.schema:
+            arr = np.empty(len(records), dtype=object)
+            for i, r in enumerate(records):
+                arr[i] = r.get(name)
+            out[name] = arr
+        return out
+
+
+def save_avro(table, path: str, *, record_name: str = "Row",
+              codec: str = "deflate") -> None:
+    """Write a Table's rows as an avro container file (RichDataset.saveAvro analog)."""
+    rows = table.to_rows()
+    kinds = {name: table[name].kind for name in table.columns}
+    schema = avro_schema_for_kinds(record_name, kinds)
+    casts = {"long": int, "double": float, "boolean": bool, "string": str}
+    coerced = []
+    for r in rows:
+        out = {}
+        for f in schema["fields"]:
+            v = r.get(f["name"])
+            if v is not None and isinstance(v, float) and np.isnan(v):
+                v = None
+            if v is not None:
+                v = casts[f["type"][1]](v)
+            out[f["name"]] = v
+        coerced.append(out)
+    write_avro(path, schema, coerced, codec=codec)
