@@ -1,15 +1,19 @@
-"""Benchmark: Titanic AutoML model-selection throughput on TPU.
+"""Benchmark: Titanic AutoML model-selection throughput + quality parity on TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Metric: models-evaluated/sec through the full ModelSelector search — folds x grid
-points across the default binary model families (LR / linear SVC / RF / GBT), the
-reference's OpTitanicSimple flow (README.md:62-64: 19 models x 3-fold CV on Spark
-local[*], minutes of wall-clock; BASELINE.md records no published numbers, so
-vs_baseline uses a conservative 19 x 3 / 180 s ~= 0.32 models/sec Spark estimate).
+Headline metric: models-evaluated/sec through the full ModelSelector search — folds
+x grid points across the default binary families (LR / linear SVC / RF / GBT), the
+reference's OpTitanicSimple flow (README.md:62-64: 19 models x 3-fold CV). The
+reference publishes NO throughput numbers (BASELINE.md), so `vs_baseline` is a
+QUALITY ratio against the only measured reference numbers that exist: our selector's
+holdout AuPR over the reference's published holdout AuPR (README.md:85-90, 0.8225).
+>= 1.0 means quality parity on the equivalent search at the reported speed.
 
-The first train pays XLA compilation; the timed run reuses cached programs, which is
-the steady state of an AutoML service re-tuning on fresh data (shapes unchanged).
+Both steady-state models/sec (cached programs — the AutoML-service regime) and
+first-train models/sec (cold compile included) are reported. The wide-sparse 1M x
+10k workload (BASELINE.json config 4) runs via bench_wide.py and lands in detail
+with achieved TFLOP/s and MFU; set BENCH_WIDE=0 to skip it.
 """
 from __future__ import annotations
 
@@ -25,7 +29,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from examples.titanic import FIELDS, SCHEMA  # single schema definition  # noqa: E402
 
 TITANIC_CSV = "/root/reference/test-data/PassengerDataAll.csv"
-SPARK_LOCAL_MODELS_PER_SEC = 19 * 3 / 180.0  # see module docstring
+#: the reference's measured holdout quality (README.md:85-90) — the baseline
+REFERENCE_HOLDOUT = {"AuROC": 0.8822, "AuPR": 0.8225, "Error": 0.1644,
+                     "Precision": 0.85, "Recall": 0.6538, "F1": 0.7391}
 
 
 def _reader():
@@ -101,7 +107,6 @@ def _build():
 def main() -> None:
     import jax
 
-    from transmogrifai_tpu.evaluators import Evaluators
     from transmogrifai_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -111,36 +116,52 @@ def main() -> None:
     t0 = time.perf_counter()
     wf, selector, pred, fs = _build()
     full = reader.generate_table(list(fs.values()))
-    model = wf.train(table=full)
+    wf.train(table=full)
     warm = time.perf_counter() - t0
+    first_models_per_sec = selector.summary_.models_evaluated / warm
 
     # timed steady-state search on the same shapes (fresh graph, cached programs)
     t1 = time.perf_counter()
     wf2, selector2, pred2, _ = _build()
-    model2 = wf2.train(table=full)
+    wf2.train(table=full)
     dt = time.perf_counter() - t1
     summary = selector2.summary_
     models_per_sec = summary.models_evaluated / dt
 
-    scores = model2.score(table=full, keep_intermediate=True)
-    metrics = Evaluators.binary_classification("survived", pred2).evaluate_all(scores)
+    # quality parity: the selector's HOLDOUT metrics (reserved split, never seen by
+    # search or final refit) against the reference's published holdout table
+    holdout = summary.holdout_metrics.to_json() if summary.holdout_metrics else {}
+    vs_baseline = (round(holdout["AuPR"] / REFERENCE_HOLDOUT["AuPR"], 3)
+                   if holdout.get("AuPR") else None)
+
+    detail = {
+        "models_evaluated": summary.models_evaluated,
+        "search_wall_s": round(dt, 3),
+        "first_train_incl_compile_s": round(warm, 3),
+        "first_train_models_per_sec": round(first_models_per_sec, 3),
+        "best_model": summary.best_model_name,
+        "best_params": summary.best_params,
+        "holdout": {k: round(holdout[k], 4) for k in
+                    ("AuROC", "AuPR", "Error", "Precision", "Recall", "F1")
+                    if k in holdout},
+        "n_holdout": summary.n_holdout,
+        "reference_holdout": REFERENCE_HOLDOUT,
+        "vs_baseline_definition": (
+            "holdout AuPR / reference holdout AuPR (README.md:85-90) — the only "
+            "measured reference numbers; no Spark throughput baseline exists"),
+        "device": str(jax.devices()[0]),
+    }
+    if os.environ.get("BENCH_WIDE", "1") != "0":
+        from bench_wide import run_wide
+
+        detail["wide"] = run_wide()
 
     print(json.dumps({
         "metric": "titanic_automl_models_evaluated_per_sec",
         "value": round(models_per_sec, 3),
         "unit": "models/sec",
-        "vs_baseline": round(models_per_sec / SPARK_LOCAL_MODELS_PER_SEC, 2),
-        "detail": {
-            "models_evaluated": summary.models_evaluated,
-            "search_wall_s": round(dt, 3),
-            "first_train_incl_compile_s": round(warm, 3),
-            "best_model": summary.best_model_name,
-            "best_params": summary.best_params,
-            "train_AuROC": round(metrics.AuROC, 4),
-            "train_AuPR": round(metrics.AuPR, 4),
-            "train_Error": round(metrics.Error, 4),
-            "device": str(jax.devices()[0]),
-        },
+        "vs_baseline": vs_baseline,
+        "detail": detail,
     }))
 
 

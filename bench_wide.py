@@ -1,0 +1,139 @@
+"""Wide-sparse benchmark: BASELINE.json config 4 — 1M rows x 10k one-hot columns.
+
+SanityChecker-grade streaming stats (moments + label corr + full 10k x 10k
+correlation via bf16 MXU matmuls) and a streaming logistic regression, on data that
+never exists in memory at once: each row chunk's one-hot matrix is generated on
+device from category indices, consumed, and discarded (HBM holds one chunk). This is
+the regime the reference handles via MLlib sparse vectors + bounded hash spaces
+(OPCollectionHashingVectorizer.scala:59-109); the TPU path makes it dense MXU work
+and reports achieved TFLOP/s and MFU from XLA's own cost model.
+
+Run standalone (prints one JSON line) or via bench.py (merged into its detail).
+"""
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_ROWS = 1_048_576
+N_CAT = 20          # categorical features
+CARD = 500          # levels each -> D = 10,000 one-hot columns
+D = N_CAT * CARD
+CHUNK = 65_536
+N_CHUNKS = N_ROWS // CHUNK
+LR_EPOCHS = 10
+HOLDOUT_CHUNKS = 2
+
+
+@partial(jax.jit, static_argnames=("chunk", "n_cat", "card"))
+def _make_chunk(key, w_true, chunk: int, n_cat: int, card: int):
+    """Generate one [chunk, D] one-hot design chunk + labels from a planted model.
+    The one-hot build is a scatter (what a fused vectorizer emits); labels follow
+    the planted logits so quality is checkable."""
+    k_idx, k_y = jax.random.split(key)
+    idx = jax.random.randint(k_idx, (chunk, n_cat), 0, card)
+    # planted per-(feature, level) weights -> row logit
+    logits = w_true.reshape(n_cat, card)[jnp.arange(n_cat)[None, :], idx].sum(axis=1)
+    y = (jax.nn.sigmoid(logits) > jax.random.uniform(k_y, (chunk,))).astype(jnp.float32)
+    # compare-based one-hot (vectorized broadcast beats scatter on TPU); bf16 halves
+    # the generator's write bandwidth and is exact for 0/1 indicators
+    X = jax.nn.one_hot(idx, card, dtype=jnp.bfloat16).reshape(chunk, n_cat * card)
+    return X, y
+
+
+def run_wide(quick: bool = False) -> dict:
+    from transmogrifai_tpu import profiling
+    from transmogrifai_tpu.evaluators.metrics_ops import binary_curve_aucs
+    from transmogrifai_tpu.ops.linear import fit_logistic_streaming, predict_logistic
+    from transmogrifai_tpu.ops.stats import (
+        streaming_stats_finalize,
+        streaming_stats_init,
+        streaming_stats_update,
+    )
+
+    n_chunks = 2 if quick else N_CHUNKS
+    lr_epochs = 2 if quick else LR_EPOCHS
+    key = jax.random.PRNGKey(7)
+    k_w, key = jax.random.split(key)
+    w_true = (jax.random.normal(k_w, (D,)) * (jax.random.uniform(key, (D,)) < 0.02)
+              * 4.0).astype(jnp.float32)
+    chunk_keys = jax.random.split(jax.random.PRNGKey(11),
+                                  n_chunks + HOLDOUT_CHUNKS)
+
+    def chunk(i):
+        return _make_chunk(chunk_keys[i], w_true, CHUNK, N_CAT, CARD)
+
+    # --- warmup: compile generation + stats + lr step outside the timed windows ----
+    Xw, yw = chunk(0)
+    acc = streaming_stats_update(streaming_stats_init(D), Xw, yw)
+    stats_flops = profiling.compiled_flops(streaming_stats_update, acc, Xw, yw)
+    jax.device_get(acc.n)  # force (block_until_ready may not block over the tunnel)
+
+    # --- streaming SanityChecker stats over all chunks (timed) ---------------------
+    acc = streaming_stats_init(D)
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        X, y = chunk(i)
+        acc = streaming_stats_update(acc, X, y)
+    mean, var, mn, mx, corr_y, corr = streaming_stats_finalize(acc)
+    jax.device_get(corr[0, 0])  # force completion of the whole chain
+    stats_wall = time.perf_counter() - t0
+    total_stats_flops = (stats_flops or 0.0) * n_chunks
+    stats_mfu = profiling.mfu(total_stats_flops, stats_wall)
+
+    # the stats must be RIGHT, not just fast: planted signal columns should carry
+    # the largest label correlations
+    corr_y_h = np.asarray(corr_y)
+    w_h = np.asarray(w_true)
+    top = np.argsort(-np.abs(corr_y_h))[:50]
+    planted_hit = float(np.mean(np.abs(w_h[top]) > 0))
+
+    # --- streaming LR train (timed) ------------------------------------------------
+    # warm the step compile first so the timed window is pure execution
+    fit_logistic_streaming(chunk, 1, D, l2=1e-4, epochs=1)
+    t1 = time.perf_counter()
+    params = fit_logistic_streaming(chunk, n_chunks, D, l2=1e-4, epochs=lr_epochs)
+    jax.device_get(params.b)
+    lr_wall = time.perf_counter() - t1
+    lr_rows_per_sec = n_chunks * CHUNK * lr_epochs / lr_wall
+
+    # --- holdout quality (vs the planted model's Bayes-optimal score) --------------
+    from transmogrifai_tpu.ops.linear import LinearParams
+
+    true_params = LinearParams(w=w_true, b=jnp.float32(0.0))
+    probs, probs_true, labels = [], [], []
+    for i in range(n_chunks, n_chunks + HOLDOUT_CHUNKS):
+        Xh, yh = chunk(i)
+        Xh = jnp.asarray(Xh, jnp.float32)
+        probs.append(np.asarray(predict_logistic(params, Xh)[2][:, 1]))
+        probs_true.append(np.asarray(predict_logistic(true_params, Xh)[2][:, 1]))
+        labels.append(np.asarray(yh))
+    y_all = jnp.asarray(np.concatenate(labels))
+    auroc, _ = binary_curve_aucs(jnp.asarray(np.concatenate(probs)), y_all)
+    bayes_auroc, _ = binary_curve_aucs(jnp.asarray(np.concatenate(probs_true)), y_all)
+    dev = jax.devices()[0]
+    return {
+        "rows": n_chunks * CHUNK,
+        "one_hot_cols": D,
+        "stats_wall_s": round(stats_wall, 3),
+        "stats_tflops_per_sec": (round(total_stats_flops / stats_wall / 1e12, 2)
+                                 if total_stats_flops else None),
+        "stats_mfu": round(stats_mfu, 4) if stats_mfu is not None else None,
+        "corr_top50_planted_hit_rate": planted_hit,
+        "lr_wall_s": round(lr_wall, 3),
+        "lr_rows_per_sec": round(lr_rows_per_sec),
+        "holdout_auroc": round(float(auroc), 4),
+        "bayes_ceiling_auroc": round(float(bayes_auroc), 4),
+        "device": str(dev.device_kind if hasattr(dev, "device_kind") else dev),
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    print(json.dumps({"wide": run_wide(quick="--quick" in sys.argv)}))

@@ -69,6 +69,14 @@ class TestCodec:
         with pytest.raises(ValueError, match="container"):
             read_avro(str(p))
 
+    def test_truncated_boolean_raises(self):
+        import io
+
+        from transmogrifai_tpu.readers.avro import _decode
+
+        with pytest.raises(EOFError, match="boolean"):
+            _decode("boolean", io.BytesIO(b""))
+
 
 @needs_fixture
 class TestReferenceFixtures:
@@ -83,6 +91,18 @@ class TestReferenceFixtures:
         _, records = read_avro(PASSENGER_SNAPPY)
         assert len(records) == 8
         assert records[0]["stringMap"] == {"Female": "string"}
+
+    def test_typed_reader_skips_unmappable_fields(self):
+        """Map-typed fields have no feature kind; they must be skipped, not make
+        the whole file unreadable through the typed reader."""
+        reader = AvroReader(PASSENGER_SNAPPY)
+        kinds = reader.schema
+        assert "stringMap" not in kinds and "age" in kinds
+        fs = features_from_schema({"age": "Integral", "gender": "PickList"})
+        t = reader.generate_table(list(fs.values()))
+        assert t.nrows == 8
+        with pytest.raises(ValueError, match="stringMap"):
+            kinds_from_avro_schema(read_avro(PASSENGER_SNAPPY)[0], strict=True)
 
     def test_avro_reader_matches_csv_reader(self):
         """Same table from the avro and csv forms of the same data."""

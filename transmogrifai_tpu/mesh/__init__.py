@@ -4,6 +4,9 @@ from .mesh import (
     make_mesh,
     shard_batch,
     shard_grid,
+    shard_wide,
+    shard_for_training,
+    pad_to_multiple,
     replicate,
 )
 
@@ -13,5 +16,8 @@ __all__ = [
     "make_mesh",
     "shard_batch",
     "shard_grid",
+    "shard_wide",
+    "shard_for_training",
+    "pad_to_multiple",
     "replicate",
 ]

@@ -60,6 +60,37 @@ def replicate(mesh: Mesh, arr):
     return jax.device_put(arr, NamedSharding(mesh, P()))
 
 
+def shard_wide(mesh: Mesh, arr):
+    """Place an [N, D] design matrix with rows over DATA_AXIS AND columns over
+    MODEL_AXIS — the wide-feature sharding of SURVEY §5.7 (this domain's sequence
+    parallelism). Downstream X@w / X^T r matmuls under jit then psum their partial
+    dot-products over the model axis and their row-partials over the data axis;
+    XLA inserts the collectives from the sharding alone."""
+    return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)))
+
+
+def shard_for_training(mesh: Mesh, X, y, wide_threshold: Optional[int] = None):
+    """Default trainer-layer placement for a fit: rows over the data axis whenever
+    they divide it; the feature axis additionally over the model axis when the
+    matrix is wide (>= wide_threshold columns, defaulting to the SAME threshold
+    that flips LogisticRegression to its D-linear solver — the two decisions must
+    agree or a feature-sharded matrix would still run the DxD-Hessian path).
+    Falls back to replication for non-dividing axes (XLA requires even shards)."""
+    if wide_threshold is None:
+        from ..ops.linear import WIDE_D_THRESHOLD
+
+        wide_threshold = WIDE_D_THRESHOLD
+    n, d = X.shape
+    n_data = mesh.shape[DATA_AXIS]
+    n_model = mesh.shape[MODEL_AXIS]
+    row_ok = n % n_data == 0
+    col_ok = d >= wide_threshold and d % n_model == 0 and n_model > 1
+    spec = P(DATA_AXIS if row_ok else None, MODEL_AXIS if col_ok else None)
+    Xs = jax.device_put(X, NamedSharding(mesh, spec))
+    ys = jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS if row_ok else None)))
+    return Xs, ys
+
+
 def pad_to_multiple(arr, multiple: int, axis: int = 0, fill=0):
     """Pad a batch axis so it divides the mesh (XLA needs even shards); returns
     (padded, original_length)."""

@@ -35,6 +35,23 @@ def _weighted(sample_weight, n):
     return jnp.asarray(sample_weight, jnp.float32)
 
 
+def _adam_update(theta, m, v, g, t, lr_t,
+                 b1=0.9, b2=0.999, eps=1e-8):
+    """One Adam step over matching pytrees (tuples) of params/moments/grads.
+    t is the 1-based step for bias correction."""
+    m = jax.tree.map(lambda mi, gi: b1 * mi + (1 - b1) * gi, m, g)
+    v = jax.tree.map(lambda vi, gi: b2 * vi + (1 - b2) * gi ** 2, v, g)
+    theta = jax.tree.map(
+        lambda p, mi, vi: p - lr_t * (mi / (1 - b1 ** t))
+        / (jnp.sqrt(vi / (1 - b2 ** t)) + eps),
+        theta, m, v)
+    return theta, m, v
+
+
+def _cosine_lr(lr, i, total):
+    return lr * 0.5 * (1 + jnp.cos(jnp.pi * i / total))
+
+
 # --- logistic regression (binary): IRLS/Newton ------------------------------------------
 @partial(jax.jit, static_argnames=("max_iter",))
 def fit_logistic(
@@ -77,6 +94,57 @@ def fit_logistic(
     return LinearParams(w=theta[:-1], b=theta[-1])
 
 
+# --- logistic regression (binary), wide-D solver: full-batch Adam -----------------------
+@partial(jax.jit, static_argnames=("max_iter",))
+def fit_logistic_gd(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    l2: float = 0.0,
+    max_iter: int = 300,
+    lr: float = 0.5,
+) -> LinearParams:
+    """Gradient solver for binary logistic regression, for WIDE feature matrices.
+
+    Newton-IRLS (fit_logistic) builds a DxD Hessian — quadratic memory and an NxD^2
+    matmul per step, prohibitive past a few thousand columns. This solver is linear
+    in D: each step is two [N,D] matmuls (forward + grad), exactly the shapes that
+    shard as P(data, model) over the mesh — rows psum over the data axis, partial
+    dot-products psum over the model axis (SURVEY §5.7 wide-feature sharding). The
+    reference leans on MLlib's OWLQN/L-BFGS over sparse vectors for the same regime
+    (OpLogisticRegression.scala:46); here the MXU eats the dense matmuls instead."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    n, d = X.shape
+    wts = _weighted(sample_weight, n)
+    wsum = wts.sum()
+
+    def loss_fn(theta):
+        w, b = theta
+        z = X @ w + b
+        ll = wts * (jax.nn.log_sigmoid(z) * y + jax.nn.log_sigmoid(-z) * (1.0 - y))
+        return -ll.sum() / wsum + 0.5 * l2 * (w ** 2).sum()
+
+    grad_fn = jax.grad(loss_fn)
+
+    def step(carry, i):
+        theta, m, v = carry
+        g = grad_fn(theta)
+        theta, m, v = _adam_update(theta, m, v, g, i + 1,
+                                   _cosine_lr(lr, i, max_iter))
+        return (theta, m, v), None
+
+    w0, b0 = jnp.zeros(d, jnp.float32), jnp.asarray(0.0, jnp.float32)
+    init = ((w0, b0), (jnp.zeros_like(w0), jnp.zeros_like(b0)),
+            (jnp.zeros_like(w0), jnp.zeros_like(b0)))
+    (theta, _, _), _ = jax.lax.scan(step, init, jnp.arange(max_iter))
+    return LinearParams(w=theta[0], b=theta[1])
+
+
+#: feature widths past this use the gradient solver instead of Newton-IRLS
+WIDE_D_THRESHOLD = 2048
+
+
 def predict_logistic(params: LinearParams, X: jnp.ndarray):
     """-> (pred {0,1} [N], raw [N,2], prob [N,2])."""
     z = jnp.asarray(X, jnp.float32) @ params.w + params.b
@@ -115,24 +183,13 @@ def fit_multinomial(
     grad_fn = jax.grad(loss_fn)
     w0 = jnp.zeros((num_classes, d), jnp.float32)
     b0 = jnp.zeros(num_classes, jnp.float32)
-    # Adam state
+
     def step(carry, i):
-        (w, b), (mw, mb), (vw, vb) = carry
-        gw, gb = grad_fn((w, b))
-        t = i + 1
-        lr_t = lr * 0.5 * (1 + jnp.cos(jnp.pi * i / max_iter))
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        mw = b1 * mw + (1 - b1) * gw
-        mb = b1 * mb + (1 - b1) * gb
-        vw = b2 * vw + (1 - b2) * gw ** 2
-        vb = b2 * vb + (1 - b2) * gb ** 2
-        mw_h = mw / (1 - b1 ** t)
-        mb_h = mb / (1 - b1 ** t)
-        vw_h = vw / (1 - b2 ** t)
-        vb_h = vb / (1 - b2 ** t)
-        w = w - lr_t * mw_h / (jnp.sqrt(vw_h) + eps)
-        b = b - lr_t * mb_h / (jnp.sqrt(vb_h) + eps)
-        return ((w, b), (mw, mb), (vw, vb)), None
+        theta, m, v = carry
+        g = grad_fn(theta)
+        theta, m, v = _adam_update(theta, m, v, g, i + 1,
+                                   _cosine_lr(lr, i, max_iter))
+        return (theta, m, v), None
 
     init = ((w0, b0), (jnp.zeros_like(w0), jnp.zeros_like(b0)),
             (jnp.zeros_like(w0), jnp.zeros_like(b0)))
@@ -202,18 +259,11 @@ def fit_svc(
     grad_fn = jax.grad(loss_fn)
 
     def step(carry, i):
-        (w, b), (mw, mb), (vw, vb) = carry
-        gw, gb = grad_fn((w, b))
-        t = i + 1
-        lr_t = lr * 0.5 * (1 + jnp.cos(jnp.pi * i / max_iter))
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        mw = b1 * mw + (1 - b1) * gw
-        mb = b1 * mb + (1 - b1) * gb
-        vw = b2 * vw + (1 - b2) * gw ** 2
-        vb = b2 * vb + (1 - b2) * gb ** 2
-        w = w - lr_t * (mw / (1 - b1 ** t)) / (jnp.sqrt(vw / (1 - b2 ** t)) + eps)
-        b = b - lr_t * (mb / (1 - b1 ** t)) / (jnp.sqrt(vb / (1 - b2 ** t)) + eps)
-        return ((w, b), (mw, mb), (vw, vb)), None
+        theta, m, v = carry
+        g = grad_fn(theta)
+        theta, m, v = _adam_update(theta, m, v, g, i + 1,
+                                   _cosine_lr(lr, i, max_iter))
+        return (theta, m, v), None
 
     w0, b0 = jnp.zeros(d, jnp.float32), jnp.asarray(0.0, jnp.float32)
     init = ((w0, b0), (jnp.zeros_like(w0), jnp.zeros_like(b0)),
@@ -227,3 +277,49 @@ def predict_svc(params: LinearParams, X: jnp.ndarray):
     raw = jnp.stack([-z, z], axis=1)
     prob = jax.nn.sigmoid(raw)  # not calibrated; mirrors rawPrediction-only SVC
     return (z >= 0.0).astype(jnp.float32), raw, prob
+
+
+# --- streaming (chunked) logistic regression for data larger than HBM -------------------
+@partial(jax.jit, donate_argnums=(0,))
+def logistic_stream_step(state, X, y, lr_t, l2):
+    """One minibatch Adam step on a row chunk. state = ((w, b), (m...), (v...), t).
+    Chunks may be generated on the fly (e.g. one-hot from category indices), so the
+    full [N, D] matrix never exists — HBM holds one chunk (SURVEY §5.7 scale path)."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+
+    def loss_fn(theta):
+        w, b = theta
+        z = X @ w + b
+        ll = jax.nn.log_sigmoid(z) * y + jax.nn.log_sigmoid(-z) * (1.0 - y)
+        return -ll.mean() + 0.5 * l2 * (w ** 2).sum()
+
+    theta, m, v, t = state
+    g = jax.grad(loss_fn)(theta)
+    t = t + 1
+    theta, m, v = _adam_update(theta, m, v, g, t, lr_t)
+    return theta, m, v, t
+
+
+def fit_logistic_streaming(chunk_fn, n_chunks: int, d: int, *, l2: float = 0.0,
+                           epochs: int = 10, lr: float = 0.3) -> LinearParams:
+    """Minibatch-Adam logistic regression over chunks produced by chunk_fn(i) ->
+    (X [R, D], y [R]) device arrays. Cosine lr decay over the full step budget."""
+    w0 = jnp.zeros(d, jnp.float32)
+    state = ((w0, jnp.float32(0.0)),
+             (jnp.zeros_like(w0), jnp.float32(0.0)),
+             (jnp.zeros_like(w0), jnp.float32(0.0)),
+             jnp.float32(0.0))
+    import math
+
+    total = epochs * n_chunks
+    i = 0
+    for _ in range(epochs):
+        for c in range(n_chunks):
+            X, y = chunk_fn(c)
+            lr_t = lr * 0.5 * (1 + math.cos(math.pi * i / total))
+            state = logistic_stream_step(state, X, y, jnp.float32(lr_t),
+                                         jnp.float32(l2))
+            i += 1
+    (w, b), _, _, _ = state
+    return LinearParams(w=w, b=b)

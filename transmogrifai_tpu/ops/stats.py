@@ -151,3 +151,70 @@ def rule_confidence(table: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     conf = jnp.where(row[:, None] > _EPS, t / jnp.clip(row[:, None], _EPS, None), 0.0).max(1)
     support = row / n
     return conf, support
+
+
+# --- streaming (chunked) stats for matrices too wide/tall to materialize --------------
+class StreamingStats(NamedTuple):
+    """Accumulator for one pass of SanityChecker-grade statistics over row chunks of
+    a design matrix that never exists in memory at once (the 1M x 10k regime,
+    SURVEY §5.7). Finalize yields moments, label correlations, and the full DxD
+    correlation matrix — the same quantities the in-memory fused pass computes."""
+
+    n: jnp.ndarray          # scalar rows seen
+    s1: jnp.ndarray         # [D] sum x
+    s2: jnp.ndarray         # [D] sum x^2
+    sy: jnp.ndarray         # [D] sum x*y
+    xtx: jnp.ndarray        # [D, D] sum x_i x_j (fp32, accumulated from bf16 matmul)
+    y1: jnp.ndarray         # scalar sum y
+    y2: jnp.ndarray         # scalar sum y^2
+    mn: jnp.ndarray         # [D] min
+    mx: jnp.ndarray         # [D] max
+
+
+def streaming_stats_init(d: int) -> StreamingStats:
+    z = jnp.zeros(d, jnp.float32)
+    return StreamingStats(
+        n=jnp.float32(0.0), s1=z, s2=z, sy=z,
+        xtx=jnp.zeros((d, d), jnp.float32),
+        y1=jnp.float32(0.0), y2=jnp.float32(0.0),
+        mn=jnp.full(d, jnp.inf, jnp.float32), mx=jnp.full(d, -jnp.inf, jnp.float32),
+    )
+
+
+@jax.jit
+def streaming_stats_update(acc: StreamingStats, X: jnp.ndarray,
+                           y: jnp.ndarray) -> StreamingStats:
+    """Fold one [R, D] chunk in. The X^T X partial runs in bfloat16 on the MXU and
+    accumulates in fp32 — the FLOPs workhorse of the wide sanity pass. Chunks may
+    arrive in bf16 (halving the generator's write bandwidth); the per-consumer f32
+    casts below fuse into their reductions, so no f32 copy of X materializes."""
+    cast = lambda: jnp.asarray(X, jnp.float32)  # noqa: E731 — fused per consumer
+    Xb = jnp.asarray(X, jnp.bfloat16)
+    yf = jnp.asarray(y, jnp.float32)
+    return StreamingStats(
+        n=acc.n + X.shape[0],
+        s1=acc.s1 + cast().sum(axis=0),
+        s2=acc.s2 + jnp.square(cast()).sum(axis=0),
+        sy=acc.sy + jnp.einsum("nd,n->d", cast(), yf),
+        xtx=acc.xtx + jnp.asarray(Xb.T @ Xb, jnp.float32),
+        y1=acc.y1 + yf.sum(),
+        y2=acc.y2 + (yf * yf).sum(),
+        mn=jnp.minimum(acc.mn, cast().min(axis=0)),
+        mx=jnp.maximum(acc.mx, cast().max(axis=0)),
+    )
+
+
+@jax.jit
+def streaming_stats_finalize(acc: StreamingStats):
+    """-> (mean [D], var [D], min, max, corr_with_label [D], corr_matrix [D, D])."""
+    n = jnp.maximum(acc.n, 1.0)
+    mean = acc.s1 / n
+    var = jnp.maximum(acc.s2 / n - mean ** 2, 0.0)
+    y_mean = acc.y1 / n
+    y_var = jnp.maximum(acc.y2 / n - y_mean ** 2, 1e-12)
+    cov_y = acc.sy / n - mean * y_mean
+    corr_y = cov_y / jnp.sqrt(jnp.maximum(var, 1e-12) * y_var)
+    cov = acc.xtx / n - jnp.outer(mean, mean)
+    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    corr = cov / jnp.outer(sd, sd)
+    return mean, var, acc.mn, acc.mx, corr_y, corr

@@ -130,3 +130,50 @@ def record_cost(name: str, jitted_fn, *args, **kwargs) -> None:
     except Exception:
         # cost analysis is best-effort: some backends/fns don't expose it
         pass
+
+
+#: per-chip peak dense bf16 matmul throughput (FLOP/s) by device kind — the MFU
+#: denominator. Public figures for the TPU generations jax reports; anything
+#: unknown (e.g. host CPU in tests) yields None and MFU is omitted.
+_PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOP/s of one device, or None when unknown."""
+    import jax
+
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for name, peak in _PEAK_BF16_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def mfu(total_flops: float, wall_s: float, n_devices: int = 1,
+        device=None) -> Optional[float]:
+    """Model FLOPs Utilization: achieved / peak over the wall-clock interval."""
+    peak = device_peak_flops(device)
+    if peak is None or wall_s <= 0:
+        return None
+    return total_flops / (wall_s * peak * n_devices)
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation per XLA's own cost model (not wall-clock)."""
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        return float(dict(analysis).get("flops", 0.0))
+    except Exception:
+        return None

@@ -103,7 +103,10 @@ def _decode(schema: Any, buf: io.BytesIO) -> Any:
     if schema == "null":
         return None
     if schema == "boolean":
-        return buf.read(1) != b"\x00"
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated boolean")
+        return b != b"\x00"
     if schema in ("int", "long"):
         return _read_long(buf)
     if schema == "float":
@@ -279,15 +282,22 @@ _PRIMITIVE_KINDS = {
 }
 
 
-def kinds_from_avro_schema(schema: dict) -> dict[str, str]:
+def kinds_from_avro_schema(schema: dict, strict: bool = False) -> dict[str, str]:
     """Writer record schema -> {field: feature-kind-name}. Unions with null map to
     the nullable kind of the non-null branch; enums become PickList; arrays of
-    strings become TextList. Nested records/maps are not raw-feature material."""
+    strings become TextList. Fields with no feature-kind mapping (nested records,
+    maps, multi-branch unions) are SKIPPED by default — they are not raw-feature
+    material and must not make the rest of the file unreadable; strict=True raises
+    on them instead."""
     if schema.get("type") != "record":
         raise ValueError("top-level avro schema must be a record")
     out: dict[str, str] = {}
     for f in schema["fields"]:
-        out[f["name"]] = _kind_of_avro_type(f["type"], f["name"])
+        try:
+            out[f["name"]] = _kind_of_avro_type(f["type"], f["name"])
+        except ValueError:
+            if strict:
+                raise
     return out
 
 

@@ -205,6 +205,8 @@ def evaluate_candidates(
     metric: str,
     num_classes: int = 0,
     mesh=None,
+    checkpoint=None,
+    checkpoint_fold: Optional[int] = None,
 ) -> list[EvaluatedGridPoint]:
     """Validate every (family, grid-point) over every fold.
 
@@ -217,6 +219,10 @@ def evaluate_candidates(
     thread-pool model-parallelism, SURVEY §2.12, as a sharded device axis); rows
     shard over the data axis when they divide it evenly (fits' matmuls then psum
     partial products over ICI).
+    checkpoint: optional SearchCheckpoint — each (family, grid-group) appends its
+    results on completion and already-completed groups are skipped on resume
+    (SURVEY §5.4 resumable selector loops); checkpoint_fold scopes group keys when
+    the caller runs one fold at a time (workflow-level CV).
     """
     Xd = jnp.asarray(X, jnp.float32)
     yd = jnp.asarray(y, jnp.float32)
@@ -227,17 +233,34 @@ def evaluate_candidates(
     fold_val_w = keepd[None, :] * vm  # [K, N]
 
     n_model = 1
+    wide = False
     if mesh is not None:
-        from ..mesh import DATA_AXIS, MODEL_AXIS, replicate, shard_batch
+        from ..mesh import DATA_AXIS, MODEL_AXIS, replicate, shard_batch, shard_wide
+        from ..ops.linear import WIDE_D_THRESHOLD
 
         n_model = mesh.shape[MODEL_AXIS]
         n_data = mesh.shape[DATA_AXIS]
-        if Xd.shape[0] % n_data == 0:
-            Xd, yd = shard_batch(mesh, Xd), shard_batch(mesh, yd)
+        rows_ok = Xd.shape[0] % n_data == 0
+        # wide matrices claim the model axis for the FEATURE dimension instead of
+        # the grid: partial dot-products psum over it (SURVEY §5.7); the grid then
+        # rides replicated vmap (compute is matmul-dominated in this regime)
+        wide = (n_model > 1 and Xd.shape[1] >= WIDE_D_THRESHOLD
+                and Xd.shape[1] % n_model == 0)
+        if wide:
+            Xd = shard_wide(mesh, Xd) if rows_ok else jax.device_put(
+                Xd, jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(None, MODEL_AXIS)))
+            n_model = 1  # grid axis no longer sharded
+        elif rows_ok:
+            Xd = shard_batch(mesh, Xd)
+        else:
+            Xd = replicate(mesh, Xd)
+        if rows_ok:
+            yd = shard_batch(mesh, yd)
             fold_train_w = shard_batch(mesh, fold_train_w, batch_dim=1)
             fold_val_w = shard_batch(mesh, fold_val_w, batch_dim=1)
-        else:  # uneven rows: replicate data, still shard the grid axis
-            Xd, yd = replicate(mesh, Xd), replicate(mesh, yd)
+        else:
+            yd = replicate(mesh, yd)
             fold_train_w = replicate(mesh, fold_train_w)
             fold_val_w = replicate(mesh, fold_val_w)
 
@@ -248,6 +271,23 @@ def evaluate_candidates(
             static_kwargs = {**template.fit_kwargs(), **static}
             for k in stacks:
                 static_kwargs.pop(k, None)
+            ck_key = None
+            if checkpoint is not None:
+                from .checkpoint import group_key
+
+                ck_key = group_key(ci, static_kwargs.items(), points,
+                                   fold=checkpoint_fold)
+                done = checkpoint.get(ck_key)
+                if done is not None:
+                    for rec in done:
+                        results.append(EvaluatedGridPoint(
+                            model_name=rec["model_name"],
+                            grid_point=rec["grid_point"],
+                            metric_name=rec["metric_name"],
+                            metric_values=list(rec["metric_values"]),
+                            candidate_index=rec["candidate_index"],
+                        ))
+                    continue
             program = _search_program(
                 template,
                 tuple(sorted(static_kwargs.items())),
@@ -257,7 +297,11 @@ def evaluate_candidates(
             if stacks:
                 hyper = {k: np.asarray(v, np.float32) for k, v in stacks.items()}
                 n_points = len(points)
-                if mesh is not None:
+                if mesh is not None and wide:
+                    from ..mesh import replicate
+
+                    hyper = {k: replicate(mesh, v) for k, v in hyper.items()}
+                elif mesh is not None:
                     from ..mesh import shard_grid
 
                     pad = (-n_points) % n_model  # even shards: repeat the last point
@@ -273,14 +317,20 @@ def evaluate_candidates(
             else:
                 scores = np.asarray(program(Xd, yd, fold_train_w, fold_val_w))[:, None]
 
-            for gi, point in enumerate(points):
-                results.append(
-                    EvaluatedGridPoint(
-                        model_name=name,
-                        grid_point=dict(point),
-                        metric_name=metric,
-                        metric_values=[float(s) for s in scores[:, gi]],
-                        candidate_index=ci,
-                    )
+            group_results = [
+                EvaluatedGridPoint(
+                    model_name=name,
+                    grid_point=dict(point),
+                    metric_name=metric,
+                    metric_values=[float(s) for s in scores[:, gi]],
+                    candidate_index=ci,
                 )
+                for gi, point in enumerate(points)
+            ]
+            if checkpoint is not None:
+                checkpoint.put(ck_key, [
+                    {**r.to_json(), "candidate_index": r.candidate_index}
+                    for r in group_results
+                ])
+            results.extend(group_results)
     return results

@@ -52,8 +52,20 @@ class PredictorEstimator(Estimator):
         """Ctor params passed through to fit_fn (subclasses override to rename/augment)."""
         return dict(self.params)
 
+    def with_mesh(self, mesh) -> "PredictorEstimator":
+        """Attach a device mesh: this trainer's fit then shards its design matrix —
+        rows over the data axis, and the feature axis over the model axis when wide
+        (SURVEY §5.7). Never serialized; scoring stays sharding-agnostic."""
+        self.mesh = mesh
+        return self
+
     def fit_columns(self, cols: Sequence[Column]):
         y, X = self.label_and_matrix(cols)
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            from ...mesh import shard_for_training
+
+            X, y = shard_for_training(mesh, X, y)
         return self.make_model(self.fit_fn(X, y, **self.fit_kwargs()))
 
     def with_params(self, **overrides) -> "PredictorEstimator":

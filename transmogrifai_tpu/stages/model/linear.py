@@ -10,9 +10,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.linear import (
+    WIDE_D_THRESHOLD,
     LinearParams,
     fit_linear,
     fit_logistic,
+    fit_logistic_gd,
     fit_multinomial,
     fit_svc,
     predict_linear,
@@ -31,16 +33,33 @@ def _linear_params(stage_params: dict) -> LinearParams:
 
 @register_stage
 class LogisticRegression(PredictorEstimator):
-    """Binary logistic regression via Newton-IRLS (analog of OpLogisticRegression;
-    regParam/elasticNet grid axis = l2 here)."""
+    """Binary logistic regression (analog of OpLogisticRegression; regParam grid
+    axis = l2 here). solver="auto" picks Newton-IRLS for narrow matrices and the
+    D-linear gradient solver past WIDE_D_THRESHOLD columns — the declared wide-
+    feature strategy of the trainer layer (SURVEY §5.7): the gd solver's [N,D]
+    matmuls shard as P(data, model), psum'ing partial dot-products over the mesh."""
 
     operation_name = "logReg"
     vmap_params = ("l2",)
-    fit_fn = staticmethod(fit_logistic)
     predict_fn = staticmethod(predict_logistic)
 
-    def __init__(self, l2: float = 0.0, max_iter: int = 25):
-        super().__init__(l2=float(l2), max_iter=int(max_iter))
+    def __init__(self, l2: float = 0.0, max_iter: int = 25, solver: str = "auto",
+                 gd_iters: int = 300):
+        if solver not in ("auto", "newton", "gd"):
+            raise ValueError("solver must be auto|newton|gd")
+        super().__init__(l2=float(l2), max_iter=int(max_iter), solver=solver,
+                         gd_iters=int(gd_iters))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, l2=0.0, max_iter=25, solver="auto",
+               gd_iters=300):
+        if solver == "auto":  # X.shape is static at trace time
+            solver = "newton" if X.shape[1] <= WIDE_D_THRESHOLD else "gd"
+        if solver == "newton":
+            return fit_logistic(X, y, sample_weight=sample_weight, l2=l2,
+                                max_iter=max_iter)
+        return fit_logistic_gd(X, y, sample_weight=sample_weight, l2=l2,
+                               max_iter=gd_iters)
 
     def make_model(self, params):
         return LogisticRegressionModel(
