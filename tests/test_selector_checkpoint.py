@@ -1,0 +1,142 @@
+"""Selector checkpoint/resume (SURVEY §5.4 resumable selector loops): kill the
+search midway, resume, and get a bit-identical summary to an uninterrupted run."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.select import ParamGridBuilder
+from transmogrifai_tpu.select.selector import ModelSelector
+from transmogrifai_tpu.select.splitters import DataSplitter
+from transmogrifai_tpu.select.validator import CrossValidation
+from transmogrifai_tpu.stages.model import LinearSVC, LogisticRegression
+from transmogrifai_tpu.types import Column, Table
+
+
+def _data(n=200, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (X @ w + 0.5 * rng.normal(size=n) > 0).astype(np.float32)
+    return Table({"y": Column.real(y, kind="RealNN"), "v": Column.vector(X)})
+
+
+def _selector(path=None):
+    sel = ModelSelector(
+        "binary",
+        models=[
+            (LogisticRegression(max_iter=10),
+             ParamGridBuilder().add("l2", [0.0, 0.01]).build()),
+            (LinearSVC(max_iter=50),
+             ParamGridBuilder().add("reg", [0.01, 0.1]).build()),
+        ],
+        validator=CrossValidation(num_folds=2, seed=3),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=3),
+    )
+    if path:
+        sel.with_checkpoint(path)
+    return sel
+
+
+def _fit(sel, table):
+    return sel.fit_columns([table["y"], table["v"]])
+
+
+def test_kill_resume_bit_identical(tmp_path, monkeypatch):
+    table = _data()
+    ck = str(tmp_path / "search.jsonl")
+
+    # uninterrupted baseline (no checkpoint)
+    base = _selector()
+    _fit(base, table)
+    want = base.summary_.to_json()
+
+    # interrupted run: the second grid group raises (simulated kill mid-search)
+    import transmogrifai_tpu.select.validator as val
+
+    calls = {"n": 0}
+    orig = val._search_program
+
+    def exploding(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise KeyboardInterrupt("killed mid-search")
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(val, "_search_program", exploding)
+    sel1 = _selector(ck)
+    with pytest.raises(KeyboardInterrupt):
+        _fit(sel1, table)
+    monkeypatch.undo()
+    assert (tmp_path / "search.jsonl").exists()  # partial results persisted
+
+    # resume: completed group must be loaded, not recomputed
+    recomputed = []
+    sel2 = _selector(ck)
+
+    def counting_program(template, *args, **kwargs):
+        recomputed.append(type(template).__name__)
+        return orig(template, *args, **kwargs)
+
+    monkeypatch.setattr(val, "_search_program", counting_program)
+    _fit(sel2, table)
+    monkeypatch.undo()
+    got = sel2.summary_.to_json()
+    assert got == want  # bit-identical to the uninterrupted search
+    # the first (completed) group was skipped: only the second family re-ran
+    assert recomputed == ["LinearSVC"]
+    assert not (tmp_path / "search.jsonl").exists()  # cleaned up on completion
+
+
+def test_stale_fingerprint_discards_checkpoint(tmp_path):
+    ck = str(tmp_path / "search.jsonl")
+
+    # write a stale checkpoint by hand (a real fit removes its file on completion)
+    from transmogrifai_tpu.select.checkpoint import SearchCheckpoint
+
+    fp1 = "deadbeef"  # wrong fingerprint: simulates different data/config
+    c = SearchCheckpoint(ck, fp1)
+    c.put("bogus-key", [{"model_name": "X", "grid_point": {}, "metric_name": "AuPR",
+                         "metric_values": [9.9], "candidate_index": 0}])
+    # a fit over different data ignores the stale groups and trains fine
+    sel2 = _selector(ck)
+    _fit(sel2, _data(seed=1))
+    assert sel2.summary_.best_model_name in ("LogisticRegression", "LinearSVC")
+    assert all(v.metric_values != [9.9] for v in sel2.summary_.validation_results)
+
+
+def test_workflow_cv_checkpoint_keys_by_fold(tmp_path, monkeypatch):
+    """Per-fold search units get distinct checkpoint keys (resume works under
+    workflow-level CV too)."""
+    import transmogrifai_tpu  # noqa: F401  (dsl install)
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x": float(rng.normal())}
+            for _ in range(120)]
+    fs = features_from_schema({"label": "RealNN", "x": "Real"}, response="label")
+    bucketed = fs["x"].auto_bucketize(fs["label"], max_splits=8, min_info_gain=1e-9)
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=10),
+                 ParamGridBuilder().add("l2", [0.0]).build())],
+        validator=CrossValidation(num_folds=3, seed=1),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=1),
+    ).with_checkpoint(str(tmp_path / "cv.jsonl"))
+    pred = sel(fs["label"], transmogrify([bucketed]))
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+
+    put_keys = []
+    from transmogrifai_tpu.select.checkpoint import SearchCheckpoint
+
+    orig_put = SearchCheckpoint.put
+
+    def tracking_put(self, key, results):
+        put_keys.append(key)
+        return orig_put(self, key, results)
+
+    monkeypatch.setattr(SearchCheckpoint, "put", tracking_put)
+    Workflow().set_result_features(pred).with_workflow_cv().train(table=table)
+    assert len(put_keys) == 3  # one unit per fold
+    assert len(set(put_keys)) == 3  # distinct keys per fold

@@ -112,7 +112,17 @@ class ModelSelector(PredictorEstimator):
         #: optional device mesh: grid points shard over its model axis, rows over
         #: its data axis (set directly or via ctor; never serialized)
         self.mesh = mesh
+        #: optional search-checkpoint path (SURVEY §5.4): completed grid groups are
+        #: persisted during fit and skipped on resume after a crash/kill
+        self.checkpoint_path: Optional[str] = None
         self.summary_: Optional[ModelSelectorSummary] = None
+
+    def with_checkpoint(self, path: str) -> "ModelSelector":
+        """Enable mid-search checkpoint/resume: fit() appends each completed
+        (family, grid-group) result to `path` and, on a later fit over the same
+        data/config, skips those groups. The file is removed when fit completes."""
+        self.checkpoint_path = path
+        return self
 
     def config_fingerprint(self):
         """The selector's search configuration lives in attributes, not ctor params;
@@ -155,12 +165,19 @@ class ModelSelector(PredictorEstimator):
         from .. import profiling
 
         fold_matrix_fn = getattr(self, "_in_fold_matrix_fn", None)
+        ckpt = None
+        if self.checkpoint_path:
+            from .checkpoint import SearchCheckpoint, search_fingerprint
+
+            fp = search_fingerprint(X_tr, y_used, weights, val_masks, keep,
+                                    self.problem_type, self.metric, models)
+            ckpt = SearchCheckpoint(self.checkpoint_path, fp)
         with profiling.phase("selector:search"):
             if fold_matrix_fn is None:
                 results = evaluate_candidates(
                     models, X_tr, y_used, weights, val_masks, keep,
                     self.problem_type, self.metric, num_classes=num_classes,
-                    mesh=self.mesh,
+                    mesh=self.mesh, checkpoint=ckpt,
                 )
             else:
                 # workflow-level CV (cutDAG): label-touching upstream estimators are
@@ -175,7 +192,7 @@ class ModelSelector(PredictorEstimator):
                     fold_results = evaluate_candidates(
                         models, X_k, y_used, weights, val_masks[k:k + 1], keep,
                         self.problem_type, self.metric, num_classes=num_classes,
-                        mesh=self.mesh,
+                        mesh=self.mesh, checkpoint=ckpt, checkpoint_fold=k,
                     )
                     if results is None:
                         results = fold_results
@@ -232,6 +249,8 @@ class ModelSelector(PredictorEstimator):
                 else:
                     summary.holdout_metrics = self._metrics_on(
                         model, X_np[holdout_idx], y_h)
+        if ckpt is not None:
+            ckpt.complete()  # train finished: next fit starts a fresh search
         self.summary_ = summary
         model.selector_summary = summary
         return model
