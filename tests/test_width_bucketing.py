@@ -1,0 +1,117 @@
+"""Pad-to-bucket vectorizer widths (SURVEY §7 dynamic-shapes mitigation): datasets
+with different vocabularies land on the same compiled programs."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.check.sanity_checker import SanityChecker
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import ParamGridBuilder
+from transmogrifai_tpu.select.selector import ModelSelector
+from transmogrifai_tpu.select.splitters import DataSplitter
+from transmogrifai_tpu.select.validator import _SEARCH_PROGRAM_CACHE, CrossValidation
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.types import PADDING_FEATURE, bucket_width
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _rows(n, n_cats, seed):
+    rng = np.random.default_rng(seed)
+    return [{"label": float(rng.random() > 0.5),
+             "x": float(rng.normal()),
+             "cat": f"v{rng.integers(0, n_cats)}"} for _ in range(n)]
+
+
+def _train(rows, n_folds=2):
+    fs = features_from_schema({"label": "RealNN", "x": "Real", "cat": "PickList"},
+                              response="label")
+    vector = transmogrify([fs["x"], fs["cat"]])
+    checked = SanityChecker(min_variance=1e-9)(fs["label"], vector)
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=10),
+                 ParamGridBuilder().add("l2", [0.0, 0.01]).build())],
+        validator=CrossValidation(num_folds=n_folds, seed=5),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=5),
+    )
+    pred = sel(fs["label"], checked)
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    model = Workflow().set_result_features(pred).train(table=table)
+    out = model.score(table=table, keep_intermediate=True)
+    return sel, out, vector, checked, pred
+
+
+def test_combiner_pads_to_bucket():
+    sel, out, vector, checked, _ = _train(_rows(200, 4, 0))
+    vec = out[vector.name]
+    assert vec.values.shape[1] == bucket_width(vec.values.shape[1])
+    pads = [s for s in vec.schema if s.is_padding]
+    assert pads and pads[0].parent_feature == PADDING_FEATURE
+    # padded columns are inert zeros
+    assert float(np.abs(np.asarray(vec.values)[:, -len(pads):]).sum()) == 0.0
+
+
+def test_sanity_checker_repads_and_hides_padding():
+    sel, out, vector, checked, _ = _train(_rows(200, 4, 0))
+    vec = out[checked.name]
+    assert vec.values.shape[1] == bucket_width(len(
+        [s for s in vec.schema if not s.is_padding]))
+    # padding never appears in the checker's stats or drop report
+    summ = None
+    for s in (st for st in [checked.origin_stage] if st):
+        summ = getattr(s, "summary_", None)
+    stats_names = [st.name for st in summ.slot_stats] if summ else []
+    assert all(PADDING_FEATURE not in n for n in stats_names)
+    assert all(PADDING_FEATURE not in d["name"] for d in (summ.dropped if summ else []))
+
+
+def test_different_vocab_reuses_compiled_search_programs():
+    """Two datasets, same rows, different category cardinality: the bucketed widths
+    coincide, so the second train re-uses every compiled search program (no
+    retrace) — the SURVEY §7 'dynamic shapes' fix."""
+    sel1, *_ = _train(_rows(200, 4, 0))
+    sizes_before = {
+        id(fn): fn._cache_size() for fn in _SEARCH_PROGRAM_CACHE.values()
+    }
+    sel2, *_ = _train(_rows(200, 9, 1))  # 9 categories instead of 4: wider pivot
+    sizes_after = {
+        id(fn): fn._cache_size() for fn in _SEARCH_PROGRAM_CACHE.values()
+    }
+    for k, before in sizes_before.items():
+        assert sizes_after[k] == before, "search program retraced on vocab change"
+
+
+def test_padding_does_not_change_results():
+    """Bucketing is exact: zero columns cannot move any fit or metric."""
+    rows = _rows(240, 4, 2)
+
+    def run(pad):
+        fs = features_from_schema({"label": "RealNN", "x": "Real", "cat": "PickList"},
+                                  response="label")
+        from transmogrifai_tpu.stages.feature.combiner import VectorsCombiner
+
+        import transmogrifai_tpu.stages.feature.transmogrify as tmod
+
+        vector = transmogrify([fs["x"], fs["cat"]])
+        combiner = vector.origin_stage
+        combiner.params["pad_to_bucket"] = pad
+        sel = ModelSelector(
+            "binary",
+            models=[(LogisticRegression(max_iter=10),
+                     ParamGridBuilder().add("l2", [0.0, 0.01]).build())],
+            validator=CrossValidation(num_folds=2, seed=5),
+            splitter=DataSplitter(reserve_test_fraction=0.1, seed=5),
+        )
+        pred = sel(fs["label"], vector)
+        table = InMemoryReader(rows).generate_table(list(fs.values()))
+        Workflow().set_result_features(pred).train(table=table)
+        return sel.summary_
+
+    a, b = run(True), run(False)
+    for ra, rb in zip(a.validation_results, b.validation_results):
+        assert ra.grid_point == rb.grid_point
+        np.testing.assert_allclose(ra.metric_values, rb.metric_values,
+                                   rtol=1e-5, atol=1e-6)
+    assert a.holdout_metrics.to_json() == pytest.approx(
+        b.holdout_metrics.to_json(), rel=1e-4)

@@ -96,7 +96,8 @@ class SanityChecker(Estimator):
                  remove_bad_features: bool = True, corr_type: str = "pearson",
                  max_rule_confidence: float = 1.0,
                  min_required_rule_support: float = 1.0,
-                 categorical_label_cardinality: int = 30):
+                 categorical_label_cardinality: int = 30,
+                 pad_to_bucket: bool = True):
         if corr_type not in ("pearson", "spearman"):
             raise ValueError("corr_type must be 'pearson' or 'spearman'")
         super().__init__(check_sample=float(check_sample), sample_seed=int(sample_seed),
@@ -108,7 +109,8 @@ class SanityChecker(Estimator):
                          corr_type=corr_type,
                          max_rule_confidence=float(max_rule_confidence),
                          min_required_rule_support=float(min_required_rule_support),
-                         categorical_label_cardinality=int(categorical_label_cardinality))
+                         categorical_label_cardinality=int(categorical_label_cardinality),
+                         pad_to_bucket=bool(pad_to_bucket))
 
     def out_kind(self, in_kinds):
         resp, feat = in_kinds
@@ -179,9 +181,14 @@ class SanityChecker(Estimator):
                 )
 
         # --- drop decisions ----------------------------------------------------------
+        # inert pad slots from upstream width bucketing are bookkeeping noise: never
+        # kept (the model re-pads its own output), never reported as drops
+        pad_idx = {i for i, s in enumerate(schema) if s.is_padding}
         names = schema.column_names()
         reasons: dict[int, str] = {}
         for i in range(X.shape[1]):
+            if i in pad_idx:
+                continue
             if var[i] < p["min_variance"]:
                 reasons[i] = f"variance {var[i]:.2e} < min_variance {p['min_variance']:.2e}"
             elif abs(corr[i]) > p["max_correlation"]:
@@ -203,14 +210,14 @@ class SanityChecker(Estimator):
                         i, f"group Cramér's V {cv:.3f} > max_cramers_v {p['max_cramers_v']}"
                     )
 
-        keep = [i for i in range(X.shape[1]) if i not in reasons]
+        keep = [i for i in range(X.shape[1]) if i not in reasons and i not in pad_idx]
         if p["remove_bad_features"] and not keep:
             raise ValueError(
                 "SanityChecker would drop every feature slot — check the label or relax "
                 "thresholds (reference throws the same way)"
             )
         if not p["remove_bad_features"]:
-            keep = list(range(X.shape[1]))
+            keep = [i for i in range(X.shape[1]) if i not in pad_idx]
 
         summary = SanityCheckerSummary(
             n_rows=n,
@@ -223,15 +230,18 @@ class SanityChecker(Estimator):
                     max_rule_confidence=(None if np.isnan(slot_conf[i]) else float(slot_conf[i])),
                     support=(None if np.isnan(slot_support[i]) else float(slot_support[i])),
                 )
-                for i in range(X.shape[1])
+                for i in range(X.shape[1]) if i not in pad_idx
             ],
             dropped=[{"name": names[i], "reason": reasons[i]} for i in sorted(reasons)]
             if p["remove_bad_features"] else [],
             categorical_groups=categorical_groups,
         )
+        from ..types import bucket_width
+
         model = SanityCheckerModel(
             keep_indices=keep,
             dropped=[d["name"] for d in summary.dropped],
+            pad_to=bucket_width(len(keep)) if p.get("pad_to_bucket", True) else 0,
         )
         model.summary_ = summary
         return model
@@ -245,9 +255,10 @@ class SanityCheckerModel(Transformer):
     arity = (2, 2)
     device_op = True
 
-    def __init__(self, keep_indices: Sequence[int] = (), dropped: Sequence[str] = ()):
+    def __init__(self, keep_indices: Sequence[int] = (), dropped: Sequence[str] = (),
+                 pad_to: int = 0):
         super().__init__(keep_indices=[int(i) for i in keep_indices],
-                         dropped=list(dropped))
+                         dropped=list(dropped), pad_to=int(pad_to))
         self.summary_: Optional[SanityCheckerSummary] = None
 
     def out_kind(self, in_kinds):
@@ -261,4 +272,9 @@ class SanityCheckerModel(Transformer):
         keep = jnp.asarray(self.params["keep_indices"], jnp.int32)
         out = jnp.take(jnp.asarray(vec.values, jnp.float32), keep, axis=1)
         schema = vec.schema.select(self.params["keep_indices"]) if vec.schema else None
+        pad_to = self.params.get("pad_to", 0)
+        if pad_to > out.shape[1]:  # keep the downstream width compile-stable
+            from ..types.vector_schema import pad_vector_values
+
+            out, schema = pad_vector_values(out, schema, pad_to)
         return Column.vector(out, schema=schema)

@@ -13,15 +13,30 @@ from .common import SequenceVectorizer
 
 @register_stage
 class VectorsCombiner(SequenceVectorizer):
+    """pad_to_bucket (default on) rounds the combined width up to a compile-stable
+    bucket with inert zero slots (SURVEY §7 "dynamic shapes" mitigation): datasets
+    whose vocabularies land in the same bucket reuse every downstream compiled
+    program. Padding slots are marked in the VectorSchema and skipped by the
+    SanityChecker/insights."""
+
     operation_name = "combine"
     device_op = True
     accepts = ("OPVector",)
 
+    def __init__(self, pad_to_bucket: bool = True):
+        super().__init__(pad_to_bucket=bool(pad_to_bucket))
+
     def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import bucket_width
+        from ...types.vector_schema import pad_vector_values
+
         vec = jnp.concatenate([jnp.asarray(c.values, jnp.float32) for c in cols], axis=1)
         schemas = [c.schema if c.schema is not None else _anonymous_schema(c, f)
                    for c, f in zip(cols, self.inputs)]
-        return Column.vector(vec, schemas[0].concat(*schemas[1:]))
+        schema = schemas[0].concat(*schemas[1:])
+        if self.params["pad_to_bucket"]:
+            vec, schema = pad_vector_values(vec, schema, bucket_width(vec.shape[1]))
+        return Column.vector(vec, schema)
 
 
 def _anonymous_schema(col: Column, feature) -> VectorSchema:

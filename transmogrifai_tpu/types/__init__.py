@@ -14,8 +14,11 @@ from .table import Table
 from .vector_schema import (
     NULL_INDICATOR,
     OTHER_INDICATOR,
+    PADDING_FEATURE,
     SlotInfo,
     VectorSchema,
+    bucket_width,
+    padding_slots,
     slots_for,
 )
 
@@ -31,6 +34,9 @@ __all__ = [
     "VectorSchema",
     "SlotInfo",
     "slots_for",
+    "PADDING_FEATURE",
+    "bucket_width",
+    "padding_slots",
     "NULL_INDICATOR",
     "OTHER_INDICATOR",
     "PREDICTION_KEY",

@@ -30,6 +30,10 @@ class SlotInfo:
     descriptor: Optional[str] = None
 
     @property
+    def is_padding(self) -> bool:
+        return self.parent_feature == PADDING_FEATURE
+
+    @property
     def is_null_indicator(self) -> bool:
         return self.indicator_value == NULL_INDICATOR
 
@@ -57,6 +61,42 @@ class SlotInfo:
 #: reserved indicator values (reference OpVectorColumnMetadata.NullString / OtherString)
 NULL_INDICATOR = "NullIndicatorValue"
 OTHER_INDICATOR = "OTHER"
+
+#: reserved parent name of inert pad slots appended by width bucketing
+PADDING_FEATURE = "__padding__"
+
+
+def bucket_width(n: int) -> int:
+    """Round a vector width up to a compile-stable bucket: multiples of 64 up to 512,
+    powers of two beyond. Datasets whose vocabularies land in the same bucket reuse
+    every downstream compiled program (fit/search/score) — the SURVEY §7 mitigation
+    for data-dependent vocab widths. Buckets are also MXU-lane friendly."""
+    if n <= 64:
+        return 64
+    if n <= 512:
+        return (n + 63) // 64 * 64
+    return 1 << (n - 1).bit_length()
+
+
+def padding_slots(n: int) -> tuple[SlotInfo, ...]:
+    """n inert all-zero slots (weights stay exactly zero in every trainer; quantile
+    binning never splits on them; stats pass sees zero variance)."""
+    return tuple(SlotInfo(PADDING_FEATURE, "OPVector", descriptor=f"pad{i}")
+                 for i in range(n))
+
+
+def pad_vector_values(values, schema: Optional["VectorSchema"], target: int):
+    """-> (values zero-padded to `target` columns, schema extended with padding
+    slots). The single implementation of the width-bucketing invariant (zeros,
+    appended at the END, marked in the schema) shared by every padding stage."""
+    import jax.numpy as jnp
+
+    if target <= values.shape[1]:
+        return values, schema
+    values = jnp.concatenate(
+        [values, jnp.zeros((values.shape[0], target - values.shape[1]),
+                           values.dtype)], axis=1)
+    return values, (schema.pad_to(target) if schema is not None else None)
 
 
 @dataclass(frozen=True)
@@ -92,6 +132,12 @@ class VectorSchema:
     def select(self, indices: Sequence[int]) -> "VectorSchema":
         """Schema after keeping only `indices` slots (SanityChecker / DropIndices)."""
         return VectorSchema(tuple(self.slots[i] for i in indices))
+
+    def pad_to(self, width: int) -> "VectorSchema":
+        """Schema extended with inert padding slots up to `width`."""
+        if width < len(self.slots):
+            raise ValueError(f"cannot pad {len(self.slots)} slots down to {width}")
+        return VectorSchema(self.slots + padding_slots(width - len(self.slots)))
 
     def index_of_parent(self, parent_feature: str) -> list[int]:
         return [i for i, s in enumerate(self.slots) if s.parent_feature == parent_feature]
