@@ -105,6 +105,41 @@ def test_refit_set_excludes_downstream_estimators():
     assert kinds == {"DecisionTreeNumericBucketizer"}  # insights NOT in the refit set
 
 
+def test_fold_replay_reuses_unaffected_columns(monkeypatch):
+    """Stages outside the label-tainted cone must not be re-applied per fold — their
+    full-train outputs are reused from the main pass (the CV-cost fix)."""
+    from transmogrifai_tpu.stages.feature.numeric import StandardScalerModel
+
+    calls = []
+    orig = StandardScalerModel.transform_columns
+
+    def counting(self, cols):
+        calls.append(1)
+        return orig(self, cols)
+
+    monkeypatch.setattr(StandardScalerModel, "transform_columns", counting)
+
+    fs = features_from_schema({"label": "RealNN", "x": "Real", "z": "Real"},
+                              response="label")
+    bucketed = fs["x"].auto_bucketize(fs["label"], max_splits=8, min_info_gain=1e-9)
+    z_scaled = fs["z"].z_normalize()  # label-free: outside the refit cone
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=20),
+                 ParamGridBuilder().add("l2", [0.0]).build())],
+        validator=CrossValidation(num_folds=3, seed=1),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=1),
+    )
+    pred = sel(fs["label"], transmogrify([bucketed, z_scaled]))
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x": float(rng.normal()),
+             "z": float(rng.normal())} for _ in range(240)]
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    Workflow().set_result_features(pred).with_workflow_cv().train(table=table)
+    # the scaler transforms once in the main pass; fold replays reuse its column
+    assert len(calls) == 1, f"scaler re-applied {len(calls)} times"
+
+
 def test_workflow_cv_kills_bucketizer_leakage():
     """Naive CV lets the label-fit bucketizer see validation labels, inflating the
     validation metric on pure-noise data; workflow-level CV must not."""

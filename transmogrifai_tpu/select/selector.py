@@ -182,23 +182,39 @@ class ModelSelector(PredictorEstimator):
             else:
                 # workflow-level CV (cutDAG): label-touching upstream estimators are
                 # refit per fold on that fold's training rows, the matrix recomputed,
-                # and candidates validated against THAT fold only — leakage-safe
-                results = None
+                # and candidates validated against THAT fold only — leakage-safe.
+                # The K per-fold matrices stack into one [K, N, D] batch so the
+                # whole search stays ONE vmapped program over (folds x grid) rather
+                # than K serial dispatches; refits themselves replay only the
+                # label-tainted cone (unaffected columns are reused from the main
+                # pass), so the CV path costs the refit cone, not K full plans.
+                fold_mats = []
                 for k in range(val_masks.shape[0]):
                     fit_local = (val_masks[k] == 0) & (keep > 0)
                     global_rows = train_idx[np.nonzero(fit_local)[0]]
                     col = fold_matrix_fn(np.asarray(global_rows))
-                    X_k = np.asarray(col.values, np.float32)[train_idx]
-                    fold_results = evaluate_candidates(
-                        models, X_k, y_used, weights, val_masks[k:k + 1], keep,
-                        self.problem_type, self.metric, num_classes=num_classes,
-                        mesh=self.mesh, checkpoint=ckpt, checkpoint_fold=k,
+                    fold_mats.append(np.asarray(col.values, np.float32)[train_idx])
+                widths = {m.shape[1] for m in fold_mats}
+                if len(widths) == 1:  # width-stable (pad-to-bucket): batched path
+                    results = evaluate_candidates(
+                        models, np.stack(fold_mats), y_used, weights, val_masks,
+                        keep, self.problem_type, self.metric,
+                        num_classes=num_classes, mesh=self.mesh, checkpoint=ckpt,
                     )
-                    if results is None:
-                        results = fold_results
-                    else:
-                        for agg, r in zip(results, fold_results):
-                            agg.metric_values.extend(r.metric_values)
+                else:  # per-fold widths diverged (bucketing off): serial fallback
+                    results = None
+                    for k, X_k in enumerate(fold_mats):
+                        fold_results = evaluate_candidates(
+                            models, X_k, y_used, weights, val_masks[k:k + 1], keep,
+                            self.problem_type, self.metric,
+                            num_classes=num_classes, mesh=self.mesh,
+                            checkpoint=ckpt, checkpoint_fold=k,
+                        )
+                        if results is None:
+                            results = fold_results
+                        else:
+                            for agg, r in zip(results, fold_results):
+                                agg.metric_values.extend(r.metric_values)
         from .tuning_metrics import make_metric_fn
 
         _, larger = make_metric_fn(self.problem_type, self.metric,

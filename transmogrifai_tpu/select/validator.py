@@ -168,9 +168,10 @@ def _hashable(v):
 
 
 def _search_program(template, static_items: tuple, vmap_names: tuple,
-                    problem_type: str, metric: str, num_classes: int):
+                    problem_type: str, metric: str, num_classes: int,
+                    per_fold_X: bool = False):
     key = (type(template), tuple((k, _hashable(v)) for k, v in static_items),
-           vmap_names, problem_type, metric, num_classes)
+           vmap_names, problem_type, metric, num_classes, per_fold_X)
     fn = _SEARCH_PROGRAM_CACHE.get(key)
     if fn is not None:
         return fn
@@ -182,13 +183,17 @@ def _search_program(template, static_items: tuple, vmap_names: tuple,
         pred, raw, prob = template.predict_fn(params, X)
         return metric_fn(pred, raw, prob, y, val_w)
 
+    # per_fold_X: workflow-level CV recomputes the matrix per fold, so X carries a
+    # leading fold axis and rides the SAME fold vmap as the weights — all folds'
+    # fits stay one batched program instead of K serial dispatches
+    x_axis = 0 if per_fold_X else None
     if vmap_names:  # vmap over the stacked grid axis, then over folds
         inner = jax.vmap(fit_eval, in_axes=(None, None, None, None, 0))
-        fn = jax.jit(jax.vmap(inner, in_axes=(None, None, 0, 0, None)))
+        fn = jax.jit(jax.vmap(inner, in_axes=(x_axis, None, 0, 0, None)))
     else:
         fn = jax.jit(jax.vmap(
             lambda X, y, twk, vwk: fit_eval(X, y, twk, vwk, {}),
-            in_axes=(None, None, 0, 0),
+            in_axes=(x_axis, None, 0, 0),
         ))
     _SEARCH_PROGRAM_CACHE[key] = fn
     return fn
@@ -224,6 +229,7 @@ def evaluate_candidates(
     (SURVEY §5.4 resumable selector loops); checkpoint_fold scopes group keys when
     the caller runs one fold at a time (workflow-level CV).
     """
+    per_fold_X = np.ndim(X) == 3  # [K, N, D]: per-fold matrices (workflow-level CV)
     Xd = jnp.asarray(X, jnp.float32)
     yd = jnp.asarray(y, jnp.float32)
     tw = jnp.asarray(train_weights, jnp.float32)
@@ -240,11 +246,13 @@ def evaluate_candidates(
 
         n_model = mesh.shape[MODEL_AXIS]
         n_data = mesh.shape[DATA_AXIS]
-        rows_ok = Xd.shape[0] % n_data == 0
+        row_dim = 1 if per_fold_X else 0
+        rows_ok = Xd.shape[row_dim] % n_data == 0
         # wide matrices claim the model axis for the FEATURE dimension instead of
         # the grid: partial dot-products psum over it (SURVEY §5.7); the grid then
         # rides replicated vmap (compute is matmul-dominated in this regime)
-        wide = (n_model > 1 and Xd.shape[1] >= WIDE_D_THRESHOLD
+        wide = (not per_fold_X and n_model > 1
+                and Xd.shape[1] >= WIDE_D_THRESHOLD
                 and Xd.shape[1] % n_model == 0)
         if wide:
             Xd = shard_wide(mesh, Xd) if rows_ok else jax.device_put(
@@ -252,7 +260,7 @@ def evaluate_candidates(
                     mesh, jax.sharding.PartitionSpec(None, MODEL_AXIS)))
             n_model = 1  # grid axis no longer sharded
         elif rows_ok:
-            Xd = shard_batch(mesh, Xd)
+            Xd = shard_batch(mesh, Xd, batch_dim=row_dim)
         else:
             Xd = replicate(mesh, Xd)
         if rows_ok:
@@ -293,6 +301,7 @@ def evaluate_candidates(
                 tuple(sorted(static_kwargs.items())),
                 tuple(sorted(stacks)),
                 problem_type, metric, num_classes,
+                per_fold_X=per_fold_X,
             )
             if stacks:
                 hyper = {k: np.asarray(v, np.float32) for k, v in stacks.items()}

@@ -201,6 +201,10 @@ class Workflow(WorkflowCore):
             self.set_input_table(table)
         data = self._generate_raw()
         blacklisted: tuple[Feature, ...] = ()
+        # distributions describe THIS train's RawFeatureFilter pass; clear any
+        # stale tuples from a previous train of a reused feature graph first
+        for f in self.raw_features:
+            f.distributions = ()
         if self._raw_filter is not None:
             data, blacklisted = self._raw_filter.filter_raw(self.raw_features, data)
             if blacklisted:
@@ -253,7 +257,7 @@ class Workflow(WorkflowCore):
                     if is_selector and sel_refit:
                         est._in_fold_matrix_fn = _make_fold_matrix_fn(
                             raw_data, list(plan_records), sel_refit,
-                            est.inputs[1].name,
+                            est.inputs[1].name, cached=data,
                         )
                     try:
                         with profiling.phase(f"fit:{type(est).__name__}"):
@@ -284,14 +288,30 @@ class Workflow(WorkflowCore):
 
 
 def _make_fold_matrix_fn(raw_data: Table, records: Sequence[tuple[Stage, Transformer]],
-                         refit_ids: set[int], vector_name: str):
-    """Per-fold matrix recomputation for workflow-level CV: replay the pre-selector
-    plan over ALL rows, but refit the label-tainted estimators on only the fold's
-    training rows (reference cutDAG 'during' refits, OpValidator.scala:228-256)."""
+                         refit_ids: set[int], vector_name: str,
+                         cached: Optional[Table] = None):
+    """Per-fold matrix recomputation for workflow-level CV: refit the label-tainted
+    estimators on only the fold's training rows and recompute their downstream cone
+    (reference cutDAG 'during' refits, OpValidator.scala:228-256). Stages OUTSIDE
+    the cone produce identical columns in every fold, so their full-train outputs
+    (already computed in the main pass) are reused instead of replayed — the
+    per-fold cost is the refit cone, not the whole pre-selector plan."""
+    affected_stages: set[int] = set(refit_ids)
+    affected_feats: set[int] = set()
+    for orig, _ in records:
+        if id(orig) in affected_stages or any(
+                id(p) in affected_feats for p in orig.inputs):
+            affected_stages.add(id(orig))
+            affected_feats.add(id(orig.get_output()))
 
     def fold_matrix(global_fit_rows) -> Column:
         t = raw_data
         for orig, fitted in records:
+            if id(orig) not in affected_stages:
+                name = orig.get_output().name
+                if cached is not None and name in cached:
+                    t = t.with_column(name, cached[name])
+                    continue
             if id(orig) in refit_ids:
                 model = orig.fit_table(t.slice(global_fit_rows))
                 t = model.transform_table(t)
