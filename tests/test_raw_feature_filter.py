@@ -131,6 +131,28 @@ def test_workflow_blacklist_surgery_and_training():
     assert len(out[pred.name].to_list()) == 50
 
 
+def test_distributions_attached_to_features_and_insights():
+    """RFF distributions land on the Feature objects (FeatureLike.distributions
+    analog) and flow into the ModelInsights report."""
+    fs = _features()
+    predictors = [fs["age"], fs["fare"], fs["sex"]]
+    vector = transmogrify(predictors)
+    pred = LogisticRegression()(fs["y"], vector)
+    rows = _rows(300, fill_age=0.8, seed=11)
+    wf = (Workflow().set_reader(InMemoryReader(rows))
+          .set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.1)))
+    model = wf.train()
+    age = next(f for f in model.raw_features if f.name == "age")
+    splits = dict(age.distributions)
+    assert "train" in splits
+    assert splits["train"].fill_rate == pytest.approx(0.8, abs=0.1)
+    rep = model.model_insights(pred)
+    by_name = {fi.feature_name: fi for fi in rep.features}
+    assert "train" in by_name["age"].distributions
+    assert by_name["age"].to_json()["distributions"]["train"]["count"] == 300
+
+
 def test_workflow_unreachable_result_errors():
     fs = _features()
     vector = transmogrify([fs["age"]])  # result depends ONLY on the bad feature

@@ -237,6 +237,17 @@ class RawFeatureFilter:
                     reasons[f.name] = (f"train/scoring JS divergence {js:.3f} > "
                                        f"max_js_divergence {self.max_js_divergence}")
 
+        # attach the computed distributions to the Feature objects themselves so
+        # downstream insights can read them off the lineage (the reference's
+        # FeatureLike.distributions, FeatureLike.scala:48-103)
+        for f in raw_features:
+            dists = []
+            if f.name in train_dists:
+                dists.append(("train", train_dists[f.name]))
+            if f.name in scoring_dists:
+                dists.append(("scoring", scoring_dists[f.name]))
+            f.distributions = tuple(dists)
+
         self.results_ = RawFeatureFilterResults(
             train_distributions=train_dists,
             scoring_distributions=scoring_dists,

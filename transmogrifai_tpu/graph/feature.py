@@ -23,7 +23,8 @@ class FeatureCycleError(Exception):
 
 
 class Feature:
-    __slots__ = ("name", "kind", "is_response", "origin_stage", "parents", "uid")
+    __slots__ = ("name", "kind", "is_response", "origin_stage", "parents", "uid",
+                 "distributions")
 
     def __init__(
         self,
@@ -40,6 +41,10 @@ class Feature:
         self.origin_stage = origin_stage
         self.parents = tuple(parents)
         self.uid = make_uid("Feature")
+        #: FeatureDistributions attached by the RawFeatureFilter during train
+        #: (analog of FeatureLike.distributions, FeatureLike.scala:48-103):
+        #: tuple of (split-name, FeatureDistribution) for "train"/"scoring"
+        self.distributions: tuple = ()
 
     # --- identity is object identity; uid for serialization ---------------------------
     def __repr__(self) -> str:

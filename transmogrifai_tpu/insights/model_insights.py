@@ -46,6 +46,9 @@ class FeatureInsight:
     feature_name: str
     kind: str
     derived: list[SlotInsight] = field(default_factory=list)
+    #: RawFeatureFilter distributions read off the feature lineage
+    #: (FeatureLike.distributions analog): {"train": {...}, "scoring": {...}}
+    distributions: dict = field(default_factory=dict)
 
     @property
     def max_contribution(self) -> Optional[float]:
@@ -57,6 +60,7 @@ class FeatureInsight:
             "feature_name": self.feature_name,
             "kind": self.kind,
             "derived": [s.to_json() for s in self.derived],
+            "distributions": self.distributions,
         }
 
 
@@ -138,14 +142,25 @@ def _contributions(stage, n_slots: int) -> Optional[np.ndarray]:
     imp = getattr(stage, "feature_importances_", None)
     if imp is not None:
         arr = np.asarray(imp, np.float64).ravel()
-        return arr if arr.size == n_slots else None
+        return _crop_padding(arr, n_slots)
     w = stage.params.get("w") if hasattr(stage, "params") else None
     if w is None:
         return None
     arr = np.abs(np.asarray(w, np.float64))
     if arr.ndim == 2:  # [C, D] multiclass (LinearParams layout) -> per-slot max
         arr = arr.max(axis=0)
-    return arr if arr.size == n_slots else None
+    return _crop_padding(arr, n_slots)
+
+
+def _crop_padding(arr: np.ndarray, n_slots: int) -> Optional[np.ndarray]:
+    """Width bucketing appends inert pad columns at the END whose contribution is
+    exactly zero — crop ONLY that case. Any other size mismatch (unknown weight
+    layout, upstream slot bug) must yield None, not misattributed contributions."""
+    if arr.size == n_slots:
+        return arr
+    if arr.size > n_slots and not np.any(arr[n_slots:]):
+        return arr[:n_slots]
+    return None
 
 
 def model_insights(model: "WorkflowModel", feature: "Feature") -> ModelInsights:
@@ -215,5 +230,12 @@ def model_insights(model: "WorkflowModel", feature: "Feature") -> ModelInsights:
         fi = by_feature.setdefault(
             parent, FeatureInsight(parent, kind_by_name.get(parent, "?")))
         fi.derived.append(insight)
+    # fold in RawFeatureFilter distributions attached to the raw features
+    for f in model.raw_features:
+        dists = getattr(f, "distributions", ())
+        if not dists:
+            continue
+        fi = by_feature.setdefault(f.name, FeatureInsight(f.name, f.kind.name))
+        fi.distributions = {split: d.to_json() for split, d in dists}
     report.features = list(by_feature.values())
     return report
