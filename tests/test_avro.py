@@ -230,3 +230,117 @@ class TestSaveAvro:
         s = avro_schema_for_kinds("R", {"a": "Real", "b": "PickList", "c": "Date"})
         types = {f["name"]: f["type"][1] for f in s["fields"]}
         assert types == {"a": "double", "b": "string", "c": "long"}
+
+
+class TestNativeDecoder:
+    """C block decoder (native/avrodec.c) vs the pure-Python decoder: identical
+    records on every supported shape; graceful fallback when disabled."""
+
+    SCHEMA = {"type": "record", "name": "R", "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "x", "type": ["null", "double"]},
+        {"name": "f", "type": "float"},
+        {"name": "s", "type": ["null", "string"]},
+        {"name": "b", "type": "boolean"},
+        {"name": "nb", "type": ["null", "boolean"]},
+        {"name": "e", "type": {"type": "enum", "name": "E", "symbols": ["A", "B"]}},
+        {"name": "raw", "type": ["null", "bytes"]},
+        {"name": "rev", "type": ["null", "long"]},
+    ]}
+
+    def _records(self, n=500):
+        rng = np.random.default_rng(3)
+        return [{
+            "id": int(rng.integers(-2**50, 2**50)),
+            "x": None if i % 7 == 0 else float(rng.normal()),
+            "f": float(np.float32(rng.normal())),
+            "s": None if i % 5 == 0 else f"v{i} émoji✓",
+            "b": bool(i % 2),
+            "nb": None if i % 3 == 0 else bool(i % 2),
+            "e": "AB"[i % 2],
+            "raw": None if i % 4 == 0 else bytes([i % 256, (i * 7) % 256]),
+            "rev": None if i % 11 == 0 else i,
+        } for i in range(n)]
+
+    @pytest.fixture
+    def avro_file(self, tmp_path):
+        # rev uses ["long","null"] branch order (null at index 1)
+        schema = dict(self.SCHEMA)
+        schema["fields"] = [dict(f) for f in self.SCHEMA["fields"]]
+        schema["fields"][-1]["type"] = ["long", "null"]
+        p = str(tmp_path / "n.avro")
+        write_avro(p, schema, self._records(), block_records=128)
+        return p
+
+    def test_native_matches_python(self, avro_file, monkeypatch):
+        from transmogrifai_tpu import native
+
+        assert native.load_avrodec() is not None, "native build failed"
+        fast = AvroReader(avro_file).read_records()
+
+        # force the pure-Python path on a fresh reader
+        monkeypatch.setattr(native, "_LIB", None)
+        monkeypatch.setattr(native, "_TRIED", True)
+        slow_reader = AvroReader(avro_file)
+        slow = slow_reader.read_records()
+        assert slow_reader._native is None  # really took the fallback
+        assert len(fast) == len(slow) == 500
+        for a, b in zip(fast, slow):
+            for k, vb in b.items():
+                va = a[k]
+                if isinstance(vb, float) and vb == vb:
+                    assert va == pytest.approx(vb, rel=1e-6), k
+                else:
+                    assert va == vb, (k, va, vb)
+
+    def test_nested_schema_falls_back(self):
+        # maps are not flat: ops must be None and the reader must still work
+        from transmogrifai_tpu import native
+
+        schema, _ = read_avro(PASSENGER_SNAPPY) if __import__("os").path.exists(
+            PASSENGER_SNAPPY) else (None, None)
+        if schema is None:
+            pytest.skip("reference data not mounted")
+        assert native.field_ops_for_schema(schema) is None
+        r = AvroReader(PASSENGER_SNAPPY)
+        assert len(r.read_records()) == 8
+        assert r._native is None
+
+    def test_int64_exactness_through_native_path(self, avro_file):
+        recs = AvroReader(avro_file).read_records()
+        assert all(isinstance(r["id"], int) for r in recs[:5])  # no float round-trip
+
+    def test_present_nan_double_distinct_from_null(self, tmp_path):
+        # a present NaN value must NOT become None on the native path
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "x", "type": ["null", "double"]}]}
+        p = str(tmp_path / "nan.avro")
+        write_avro(p, schema, [{"x": float("nan")}, {"x": None}, {"x": 2.0}])
+        recs = AvroReader(p).read_records()
+        assert recs[0]["x"] != recs[0]["x"]  # NaN, not None
+        assert recs[1]["x"] is None
+        assert recs[2]["x"] == 2.0
+
+    def test_override_only_field_yields_none_column(self, avro_file):
+        r = AvroReader(avro_file, {"extra": "Real"})
+        cols = r.read_columnar()
+        assert all(v is None for v in cols["extra"])
+
+    def test_corrupt_huge_string_length_rejected(self, avro_file):
+        # a near-INT64_MAX string length must fail cleanly, not read out of bounds
+        import io as _io
+
+        from transmogrifai_tpu import native
+        from transmogrifai_tpu.readers.avro import (
+            _read_container_blocks,
+            _native_columns,
+            _write_long,
+        )
+
+        schema = {"type": "record", "name": "S", "fields": [
+            {"name": "s", "type": "string"}]}
+        body = _io.BytesIO()
+        _write_long(body, 2 ** 62)  # absurd length, no bytes follow
+        cols = _native_columns(schema, [(1, body.getvalue())])
+        if native.load_avrodec() is not None:
+            assert cols is None  # decoder refused; caller falls back to Python

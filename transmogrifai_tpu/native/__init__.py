@@ -1,0 +1,100 @@
+"""Native (C) runtime components, bound through ctypes.
+
+The reference's runtime is JVM code end to end; where this framework has genuinely
+hot host-side loops (the data plane: Avro binary decode), they are implemented in C
+and compiled on first use with the system toolchain into a cached shared object.
+Everything has a pure-Python fallback, so the native layer is an accelerator, never
+a requirement (e.g. if no C compiler exists at runtime)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+#: field-op encoding shared with avrodec.c
+T_BOOL, T_LONG, T_FLOAT, T_DOUBLE, T_STRING, T_BYTES, T_ENUM = 1, 2, 3, 4, 5, 6, 7
+F_UNION, F_NULL_IS_1 = 0x100, 0x200
+
+
+def _build_dir() -> str:
+    d = os.environ.get("TT_NATIVE_CACHE_DIR") or os.path.join(_HERE, ".build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_avrodec() -> Optional[ctypes.CDLL]:
+    """Compile (once, content-hashed) and load the decoder; None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("TT_NATIVE", "1") == "0":
+        return None
+    src = os.path.join(_HERE, "avrodec.c")
+    try:
+        with open(src, "rb") as fh:
+            digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+        so = os.path.join(_build_dir(), f"avrodec_{digest}.so")
+        if not os.path.exists(so):
+            tmp = f"{so}.tmp{os.getpid()}"  # per-process tmp, then atomic rename
+            subprocess.run(
+                ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        pp_d = ctypes.POINTER(ctypes.POINTER(ctypes.c_double))
+        pp_i = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))
+        pp_b = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        lib.avro_decode_block.restype = ctypes.c_int64
+        lib.avro_decode_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            pp_d, pp_i, pp_b, pp_i, pp_i, pp_b,
+        ]
+        _LIB = lib
+    except Exception:
+        _LIB = None
+    return _LIB
+
+
+def field_ops_for_schema(schema: dict) -> Optional[list[tuple[str, int, list]]]:
+    """Record schema -> [(field_name, op, enum_symbols)] when every field is flat
+    (primitive / 2-branch union with null / enum / string / bytes); None when the
+    schema needs the general Python decoder."""
+    if schema.get("type") != "record":
+        return None
+    base_of = {"boolean": T_BOOL, "int": T_LONG, "long": T_LONG, "float": T_FLOAT,
+               "double": T_DOUBLE, "string": T_STRING, "bytes": T_BYTES}
+    out = []
+    for f in schema["fields"]:
+        t = f["type"]
+        op = 0
+        symbols: list = []
+        if isinstance(t, list):
+            if len(t) != 2 or "null" not in t:
+                return None
+            op |= F_UNION
+            if t[1] == "null":
+                op |= F_NULL_IS_1
+                t = t[0]
+            else:
+                t = t[1]
+        if isinstance(t, dict):
+            if t.get("type") == "enum":
+                op |= T_ENUM
+                symbols = list(t["symbols"])
+            else:
+                return None
+        elif t in base_of:
+            op |= base_of[t]
+        else:
+            return None
+        out.append((f["name"], op, symbols))
+    return out

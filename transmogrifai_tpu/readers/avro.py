@@ -181,8 +181,8 @@ def _encode(schema: Any, value: Any, out: io.BytesIO) -> None:
 
 
 # --- container files ------------------------------------------------------------------
-def read_avro(path: str) -> tuple[dict, list[dict]]:
-    """-> (writer schema as parsed JSON, records as dicts)."""
+def _read_container_blocks(path: str):
+    """-> (schema, [(count, decompressed_block_bytes), ...])."""
     with open(path, "rb") as fh:
         data = fh.read()
     buf = io.BytesIO(data)
@@ -204,7 +204,7 @@ def read_avro(path: str) -> tuple[dict, list[dict]]:
     if codec not in ("null", "deflate", "snappy"):
         raise NotImplementedError(f"avro codec {codec!r} not supported")
     sync = buf.read(SYNC_SIZE)
-    records: list[dict] = []
+    blocks: list[tuple[int, bytes]] = []
     while True:
         head = buf.read(1)
         if not head:
@@ -228,12 +228,136 @@ def read_avro(path: str) -> tuple[dict, list[dict]]:
                 shift += 7
             block = pa.Codec("snappy").decompress(
                 block[:-4], decompressed_size=size).to_pybytes()
+        blocks.append((count, block))
+        if buf.read(SYNC_SIZE) != sync:
+            raise ValueError("sync marker mismatch (corrupt avro block)")
+    return schema, blocks
+
+
+def _decode_blocks(schema: dict, blocks) -> list[dict]:
+    records: list[dict] = []
+    for count, block in blocks:
         bbuf = io.BytesIO(block)
         for _ in range(count):
             records.append(_decode(schema, bbuf))
-        if buf.read(SYNC_SIZE) != sync:
-            raise ValueError("sync marker mismatch (corrupt avro block)")
-    return schema, records
+    return records
+
+
+def read_avro(path: str) -> tuple[dict, list[dict]]:
+    """-> (writer schema as parsed JSON, records as dicts)."""
+    schema, blocks = _read_container_blocks(path)
+    return schema, _decode_blocks(schema, blocks)
+
+
+def _native_columns(schema: dict, blocks) -> Optional[dict[str, np.ndarray]]:
+    """Decode flat record schemas through the C decoder (native/avrodec.c) straight
+    into columns — no per-value Python parsing. None when the schema is not flat or
+    the native library is unavailable (caller uses the pure-Python decoder)."""
+    import ctypes
+
+    from .. import native
+
+    ops = native.field_ops_for_schema(schema)
+    lib = native.load_avrodec() if ops is not None else None
+    if ops is None or lib is None:
+        return None
+    n_fields = len(ops)
+    total = sum(c for c, _ in blocks)
+
+    # allocate only each field's own typed buffer (the decoder never touches the
+    # others — they stay NULL); masks always exist
+    def buf_for(f: int, kinds: tuple) -> Optional[np.ndarray]:
+        base = ops[f][1] & 0xFF
+        if base in kinds:
+            return np.zeros(total, {native.T_FLOAT: np.float64,
+                                    native.T_DOUBLE: np.float64,
+                                    native.T_LONG: np.int64,
+                                    native.T_ENUM: np.int64,
+                                    native.T_BOOL: np.uint8,
+                                    native.T_STRING: np.int64,
+                                    native.T_BYTES: np.int64}[base])
+        return None
+
+    num = [buf_for(f, (native.T_FLOAT, native.T_DOUBLE)) for f in range(n_fields)]
+    ints = [buf_for(f, (native.T_LONG, native.T_ENUM)) for f in range(n_fields)]
+    bools = [buf_for(f, (native.T_BOOL,)) for f in range(n_fields)]
+    soff = [buf_for(f, (native.T_STRING, native.T_BYTES)) for f in range(n_fields)]
+    slen = [buf_for(f, (native.T_STRING, native.T_BYTES)) for f in range(n_fields)]
+    mask = [np.zeros(total, np.uint8) for _ in range(n_fields)]
+    op_arr = (ctypes.c_int32 * n_fields)(*[op for _, op, _ in ops])
+
+    def ptrs(arrs, ctype, row0):
+        return (ctypes.POINTER(ctype) * n_fields)(*[
+            ctypes.cast(a[row0:].ctypes.data_as(ctypes.POINTER(ctype)),
+                        ctypes.POINTER(ctype)) if a is not None
+            else ctypes.cast(None, ctypes.POINTER(ctype)) for a in arrs])
+
+    row = 0
+    kept_blocks = []  # string slices index into their source block
+    for count, block in blocks:
+        consumed = lib.avro_decode_block(
+            block, len(block), count, op_arr, n_fields,
+            ptrs(num, ctypes.c_double, row), ptrs(ints, ctypes.c_int64, row),
+            ptrs(bools, ctypes.c_uint8, row), ptrs(soff, ctypes.c_int64, row),
+            ptrs(slen, ctypes.c_int64, row), ptrs(mask, ctypes.c_uint8, row),
+        )
+        if consumed < 0:
+            return None  # malformed for the fast path: let Python raise precisely
+        kept_blocks.append((row, count, block))
+        row += count
+
+    cols: dict[str, np.ndarray] = {}
+    for f, (name, op, symbols) in enumerate(ops):
+        base = op & 0xFF
+        m = mask[f].astype(bool)
+        if base in (native.T_FLOAT, native.T_DOUBLE):
+            vals = num[f]
+            if bool((m & np.isnan(vals)).any()):
+                # a PRESENT NaN must stay a NaN value, distinct from null — the
+                # pure-Python decoder preserves it, so the fast path must too
+                out = np.empty(total, object)
+                for i in range(total):
+                    out[i] = float(vals[i]) if m[i] else None
+                cols[name] = out
+            else:
+                vals = vals.copy()
+                vals[~m] = np.nan
+                cols[name] = vals
+        elif base == native.T_LONG:
+            if m.all():
+                cols[name] = ints[f].copy()  # exact int64, no float round-trip
+            else:
+                out = np.empty(total, object)
+                for i in range(total):
+                    out[i] = int(ints[f][i]) if m[i] else None
+                cols[name] = out
+        elif base == native.T_BOOL:
+            if m.all():
+                cols[name] = bools[f].astype(bool)
+            else:
+                out = np.empty(total, object)
+                for i in range(total):
+                    out[i] = bool(bools[f][i]) if m[i] else None
+                cols[name] = out
+        elif base == native.T_ENUM:
+            out = np.empty(total, object)
+            for i in range(total):
+                out[i] = symbols[ints[f][i]] if m[i] else None
+            cols[name] = out
+        else:  # string / bytes: one slice per present row out of the source block
+            out = np.empty(total, object)
+            is_bytes = base == native.T_BYTES
+            for row0, count, block in kept_blocks:
+                o, ln, mm = soff[f], slen[f], m
+                for i in range(row0, row0 + count):
+                    if not mm[i]:
+                        out[i] = None
+                        continue
+                    raw = block[o[i]:o[i] + ln[i]]
+                    out[i] = (base64.b64encode(raw).decode("ascii") if is_bytes
+                              else raw.decode("utf-8"))
+            cols[name] = out
+    return cols
 
 
 def write_avro(path: str, schema: dict, records: Sequence[dict], *,
@@ -359,22 +483,51 @@ class AvroReader(DataReader):
         super().__init__(key_fn=(lambda r: r[key_field]) if key_field else None)
         self.path = path
         self._overrides = dict(schema or {})
-        self._parsed: Optional[tuple[dict, list[dict]]] = None
+        self._container: Optional[tuple[dict, list]] = None
+        self._native: Optional[dict[str, np.ndarray]] = None
+        self._native_tried = False
+        self._records: Optional[list[dict]] = None
 
-    def _load(self) -> tuple[dict, list[dict]]:
-        if self._parsed is None:
-            self._parsed = read_avro(self.path)
-        return self._parsed
+    def _load_container(self):
+        if self._container is None:
+            self._container = _read_container_blocks(self.path)
+        return self._container
+
+    def _native_columns(self) -> Optional[dict[str, np.ndarray]]:
+        if not self._native_tried:
+            self._native_tried = True
+            writer_schema, blocks = self._load_container()
+            self._native = _native_columns(writer_schema, blocks)
+        return self._native
 
     @property
     def schema(self) -> dict[str, Any]:
-        writer_schema, _ = self._load()
+        writer_schema, _ = self._load_container()
         kinds = kinds_from_avro_schema(writer_schema)
         kinds.update(self._overrides)
         return {k: kind_of(v) if isinstance(v, str) else v for k, v in kinds.items()}
 
     def read_records(self) -> list[dict]:
-        writer_schema, records = self._load()
+        if self._records is not None:
+            return self._records
+        cols = self._native_columns()
+        if cols is not None:
+            from .base import _np_to_values
+
+            def to_values(arr):
+                if arr.dtype == object:
+                    # already python-native incl. present NaN floats, which must
+                    # NOT collapse to None (only the null mask means missing)
+                    return list(arr)
+                return _np_to_values(arr)
+
+            names = list(cols)
+            values = [to_values(cols[n]) for n in names]
+            self._records = [dict(zip(names, row)) for row in zip(*values)] \
+                if names else []
+            return self._records
+        writer_schema, blocks = self._load_container()
+        records = _decode_blocks(writer_schema, blocks)
         # bytes/fixed fields surface as base64 text (Base64 kind); decide per FIELD
         # from the writer schema — a nullable bytes field may be null in any prefix
         # of the records, so value-sampling would miss it
@@ -387,9 +540,20 @@ class AvroReader(DataReader):
                 v = r.get(name)
                 if isinstance(v, bytes):
                     r[name] = base64.b64encode(v).decode("ascii")
+        self._records = records
         return records
 
     def read_columnar(self) -> dict[str, np.ndarray]:
+        cols = self._native_columns()
+        if cols is not None:
+            out = {}
+            n = len(next(iter(cols.values()))) if cols else 0
+            for k in self.schema:
+                if k in cols:
+                    out[k] = cols[k]
+                else:  # override-only field absent from the file: all-missing,
+                    out[k] = np.full(n, None, dtype=object)  # same as pure path
+            return out
         records = self.read_records()
         out: dict[str, np.ndarray] = {}
         for name in self.schema:
