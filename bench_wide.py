@@ -30,16 +30,26 @@ LR_EPOCHS = 10
 HOLDOUT_CHUNKS = 2
 
 
+LR_SPARSE_ITERS = 30
+
+
 @partial(jax.jit, static_argnames=("chunk", "n_cat", "card"))
-def _make_chunk(key, w_true, chunk: int, n_cat: int, card: int):
-    """Generate one [chunk, D] one-hot design chunk + labels from a planted model.
-    The one-hot build is a scatter (what a fused vectorizer emits); labels follow
-    the planted logits so quality is checkable."""
+def _make_indices(key, w_true, chunk: int, n_cat: int, card: int):
+    """Category indices [chunk, n_cat] + labels from the planted model — the single
+    source both the dense and sparse paths derive their data from (so their holdout
+    comparisons are guaranteed to pair the same rows)."""
     k_idx, k_y = jax.random.split(key)
     idx = jax.random.randint(k_idx, (chunk, n_cat), 0, card)
     # planted per-(feature, level) weights -> row logit
     logits = w_true.reshape(n_cat, card)[jnp.arange(n_cat)[None, :], idx].sum(axis=1)
     y = (jax.nn.sigmoid(logits) > jax.random.uniform(k_y, (chunk,))).astype(jnp.float32)
+    return idx, y
+
+
+@partial(jax.jit, static_argnames=("chunk", "n_cat", "card"))
+def _make_chunk(key, w_true, chunk: int, n_cat: int, card: int):
+    """One [chunk, D] one-hot design chunk + labels (dense view of _make_indices)."""
+    idx, y = _make_indices(key, w_true, chunk, n_cat, card)
     # compare-based one-hot (vectorized broadcast beats scatter on TPU); bf16 halves
     # the generator's write bandwidth and is exact for 0/1 indicators
     X = jax.nn.one_hot(idx, card, dtype=jnp.bfloat16).reshape(chunk, n_cat * card)
@@ -102,20 +112,50 @@ def run_wide(quick: bool = False) -> dict:
     lr_wall = time.perf_counter() - t1
     lr_rows_per_sec = n_chunks * CHUNK * lr_epochs / lr_wall
 
+    # --- sparse (gather) LR: same model, indices instead of one-hot ----------------
+    from transmogrifai_tpu.ops.linear import (
+        fit_logistic_onehot,
+        predict_logistic_onehot,
+    )
+
+    offsets = (jnp.arange(N_CAT) * CARD).astype(jnp.int32)
+
+    def idx_chunk(i):
+        return _make_indices(chunk_keys[i], w_true, CHUNK, N_CAT, CARD)
+
+    pairs = [idx_chunk(i) for i in range(n_chunks)]
+    idx_all = jnp.concatenate([p[0] for p in pairs])
+    y_all_tr = jnp.concatenate([p[1] for p in pairs])
+    # warmup at the REAL shape; the iteration count is traced, so the same
+    # compiled program serves the timed run
+    sp = fit_logistic_onehot(idx_all, offsets, y_all_tr, D, l2=1e-4, max_iter=1)
+    jax.device_get(sp.b)
+    t2 = time.perf_counter()
+    sparse_params = fit_logistic_onehot(idx_all, offsets, y_all_tr, D, l2=1e-4,
+                                        max_iter=LR_SPARSE_ITERS)
+    jax.device_get(sparse_params.b)
+    sparse_wall = time.perf_counter() - t2
+    sparse_rows_per_sec = n_chunks * CHUNK * LR_SPARSE_ITERS / sparse_wall
+
     # --- holdout quality (vs the planted model's Bayes-optimal score) --------------
     from transmogrifai_tpu.ops.linear import LinearParams
 
     true_params = LinearParams(w=w_true, b=jnp.float32(0.0))
-    probs, probs_true, labels = [], [], []
+    probs, probs_true, probs_sparse, labels = [], [], [], []
     for i in range(n_chunks, n_chunks + HOLDOUT_CHUNKS):
         Xh, yh = chunk(i)
         Xh = jnp.asarray(Xh, jnp.float32)
         probs.append(np.asarray(predict_logistic(params, Xh)[2][:, 1]))
         probs_true.append(np.asarray(predict_logistic(true_params, Xh)[2][:, 1]))
+        idx_h, _ = idx_chunk(i)
+        probs_sparse.append(np.asarray(
+            predict_logistic_onehot(sparse_params, idx_h, offsets)[2][:, 1]))
         labels.append(np.asarray(yh))
     y_all = jnp.asarray(np.concatenate(labels))
     auroc, _ = binary_curve_aucs(jnp.asarray(np.concatenate(probs)), y_all)
     bayes_auroc, _ = binary_curve_aucs(jnp.asarray(np.concatenate(probs_true)), y_all)
+    sparse_auroc, _ = binary_curve_aucs(
+        jnp.asarray(np.concatenate(probs_sparse)), y_all)
     dev = jax.devices()[0]
     return {
         "rows": n_chunks * CHUNK,
@@ -128,6 +168,9 @@ def run_wide(quick: bool = False) -> dict:
         "lr_wall_s": round(lr_wall, 3),
         "lr_rows_per_sec": round(lr_rows_per_sec),
         "holdout_auroc": round(float(auroc), 4),
+        "sparse_lr_wall_s": round(sparse_wall, 3),
+        "sparse_lr_rows_per_sec": round(sparse_rows_per_sec),
+        "sparse_holdout_auroc": round(float(sparse_auroc), 4),
         "bayes_ceiling_auroc": round(float(bayes_auroc), 4),
         "device": str(dev.device_kind if hasattr(dev, "device_kind") else dev),
     }

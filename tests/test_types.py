@@ -184,3 +184,19 @@ def test_uid():
     u1, u2 = uid("Stage"), uid("Stage")
     assert u1 != u2
     assert uid_type(u1) == "Stage"
+
+
+def test_pretty_table():
+    from transmogrifai_tpu.utils.table import pretty_table
+
+    out = pretty_table([["LR", 0.78123, None], ["RF", 0.81, 3]],
+                       headers=["model", "AuPR", "n"], title="Results:")
+    lines = out.splitlines()
+    assert lines[0] == "Results:"
+    assert lines[1].startswith("+") and lines[1].endswith("+")
+    assert "| model | AuPR   | n |" == lines[2]
+    assert "| LR    | 0.7812 | - |" in out
+    assert "| RF    | 0.8100 | 3 |" in out
+    # long cells truncate to max_col_width
+    wide = pretty_table([["x" * 100]], headers=["h"], max_col_width=10)
+    assert all(len(ln) <= 16 for ln in wide.splitlines())

@@ -60,6 +60,69 @@ def test_shard_for_training_placement():
     assert Xo.sharding.spec == jax.sharding.PartitionSpec(DATA_AXIS, None)
 
 
+def test_linreg_gd_solver_matches_closed_form():
+    """The wide gradient solver converges to the same ridge solution."""
+    from transmogrifai_tpu.ops.linear import fit_linear, fit_linear_gd
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 24)).astype(np.float32)
+    w = rng.normal(size=24).astype(np.float32)
+    y = (X @ w + 0.05 * rng.normal(size=400) + 3.0).astype(np.float32)
+    a = fit_linear(X, y, l2=0.01)
+    b = fit_linear_gd(X, y, l2=0.01, max_iter=800)
+    np.testing.assert_allclose(np.asarray(b.w), np.asarray(a.w), atol=0.02)
+    assert float(b.b) == pytest.approx(float(a.b), abs=0.05)
+
+
+def test_linreg_column_sharded_matches_replicated():
+    from transmogrifai_tpu.ops.linear import fit_linear_gd
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(128, 32)).astype(np.float32)
+    y = (X[:, 0] * 2 + 1).astype(np.float32)
+    mesh = make_mesh(n_data=2, n_model=4)
+    ref = fit_linear_gd(X, y, max_iter=60)
+    Xs, ys = shard_for_training(mesh, jnp.asarray(X), jnp.asarray(y),
+                                wide_threshold=16)
+    got = fit_linear_gd(Xs, ys, max_iter=60)
+    # float32 psum reduction order differs across shards; 60 Adam steps amplify
+    # the ulp-level noise slightly — equivalence, not bit-identity
+    np.testing.assert_allclose(np.asarray(got.w), np.asarray(ref.w), atol=5e-3)
+
+
+def test_sparse_onehot_lr_matches_dense_gd():
+    """Gather-based LR over category indices == gradient LR over the materialized
+    one-hot matrix (the sparse path never builds the D-wide matrix)."""
+    from transmogrifai_tpu.ops.linear import (
+        fit_logistic_gd,
+        fit_logistic_onehot,
+        predict_logistic,
+        predict_logistic_onehot,
+    )
+
+    rng = np.random.default_rng(0)
+    n, f, v = 600, 5, 8
+    idx = rng.integers(0, v, size=(n, f)).astype(np.int32)
+    offsets = (np.arange(f) * v).astype(np.int32)
+    d = f * v
+    X = np.zeros((n, d), np.float32)
+    X[np.arange(n)[:, None], idx + offsets[None, :]] = 1.0
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (1 / (1 + np.exp(-(X @ w_true))) > rng.random(n)).astype(np.float32)
+
+    dense = fit_logistic_gd(X, y, l2=1e-3, max_iter=150)
+    sparse = fit_logistic_onehot(idx, offsets, y, d, l2=1e-3, max_iter=150)
+    pd = np.asarray(predict_logistic(dense, X)[2][:, 1])
+    ps = np.asarray(predict_logistic_onehot(sparse, idx, offsets)[2][:, 1])
+    np.testing.assert_allclose(ps, pd, atol=1e-5)
+    # sample weights thread through identically
+    w = rng.random(n).astype(np.float32)
+    dw = fit_logistic_gd(X, y, sample_weight=w, l2=1e-3, max_iter=100)
+    sw = fit_logistic_onehot(idx, offsets, y, d, sample_weight=w, l2=1e-3,
+                             max_iter=100)
+    np.testing.assert_allclose(np.asarray(sw.w), np.asarray(dw.w), atol=1e-4)
+
+
 def test_stage_level_wide_fit_matches_unsharded():
     """LogisticRegression(solver='gd').with_mesh(...) == plain fit."""
     from transmogrifai_tpu.graph import features_from_schema

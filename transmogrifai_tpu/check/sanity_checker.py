@@ -68,9 +68,14 @@ class SanityCheckerSummary:
         }
 
     def pretty(self) -> str:
-        lines = [f"SanityChecker: {len(self.dropped)} of {len(self.slot_stats)} slots dropped"]
-        for d in self.dropped:
-            lines.append(f"  - {d['name']}: {d['reason']}")
+        from ..utils.table import pretty_table
+
+        lines = [f"SanityChecker: {len(self.dropped)} of {len(self.slot_stats)} "
+                 "slots dropped"]
+        if self.dropped:
+            lines.append(pretty_table(
+                [[d["name"], d["reason"]] for d in self.dropped],
+                headers=["slot", "reason"], max_col_width=64))
         return "\n".join(lines)
 
 

@@ -118,9 +118,12 @@ class ModelInsights:
             key=lambda f: -(f.max_contribution or 0.0),
         )
         if ranked:
-            lines.append("Top feature contributions:")
-            for f in ranked[:20]:
-                lines.append(f"  {f.feature_name}: {f.max_contribution:.4f}")
+            from ..utils.table import pretty_table
+
+            lines.append(pretty_table(
+                [[f.feature_name, f.kind, f.max_contribution] for f in ranked[:20]],
+                headers=["feature", "kind", "max contribution"],
+                title="Top feature contributions:"))
         return "\n".join(lines)
 
 

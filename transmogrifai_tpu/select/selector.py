@@ -72,17 +72,28 @@ class ModelSelectorSummary:
         }
 
     def pretty(self) -> str:
-        lines = [
-            f"Selected model: {self.best_model_name} {self.best_params}",
-            f"Validation ({self.validation_type}, metric={self.metric_name}):",
-        ]
+        from ..utils.table import pretty_table
+
+        lines = [f"Selected model: {self.best_model_name} {self.best_params}"]
         ranked = sorted(self.validation_results, key=lambda r: r.metric_mean,
                         reverse=self.larger_is_better)
-        for r in ranked[:10]:
-            lines.append(f"  {r.model_name} {r.grid_point}: "
-                         f"{r.metric_mean:.4f} (folds {['%.4f' % v for v in r.metric_values]})")
+        lines.append(pretty_table(
+            [[r.model_name, str(r.grid_point), r.metric_mean,
+              " ".join(f"{v:.4f}" for v in r.metric_values)]
+             for r in ranked[:10]],
+            headers=["model", "grid point", f"mean {self.metric_name}", "folds"],
+            title=f"Validation ({self.validation_type}, metric={self.metric_name}):",
+        ))
         if self.holdout_metrics is not None:
-            lines.append(f"Holdout metrics: {self.holdout_metrics.to_json()}")
+            hj = self.holdout_metrics.to_json()
+            scalar = [(k, v) for k, v in hj.items() if isinstance(v, (int, float))]
+            other = [k for k, v in hj.items()
+                     if not isinstance(v, (int, float)) and v]
+            lines.append(pretty_table(
+                [[k, v] for k, v in scalar], headers=["holdout metric", "value"]))
+            if other:
+                lines.append(f"(non-scalar holdout metrics in to_json(): "
+                             f"{', '.join(other)})")
         return "\n".join(lines)
 
 

@@ -13,6 +13,7 @@ from ...ops.linear import (
     WIDE_D_THRESHOLD,
     LinearParams,
     fit_linear,
+    fit_linear_gd,
     fit_logistic,
     fit_logistic_gd,
     fit_multinomial,
@@ -113,15 +114,28 @@ class MultinomialLogisticRegressionModel(PredictionModel):
 
 @register_stage
 class LinearRegression(PredictorEstimator):
-    """Weighted ridge regression, closed form (analog of OpLinearRegression)."""
+    """Weighted ridge regression (analog of OpLinearRegression): closed form for
+    narrow matrices, D-linear gradient solver past WIDE_D_THRESHOLD columns (the
+    normal-equation DxD system is prohibitive there; same wide-sharding story as
+    LogisticRegression)."""
 
     operation_name = "linReg"
     vmap_params = ("l2",)
-    fit_fn = staticmethod(fit_linear)
     predict_fn = staticmethod(predict_linear)
 
-    def __init__(self, l2: float = 0.0):
-        super().__init__(l2=float(l2))
+    def __init__(self, l2: float = 0.0, solver: str = "auto", gd_iters: int = 300):
+        if solver not in ("auto", "normal", "gd"):
+            raise ValueError("solver must be auto|normal|gd")
+        super().__init__(l2=float(l2), solver=solver, gd_iters=int(gd_iters))
+
+    @staticmethod
+    def fit_fn(X, y, sample_weight=None, l2=0.0, solver="auto", gd_iters=300):
+        if solver == "auto":  # X.shape is static at trace time
+            solver = "normal" if X.shape[1] <= WIDE_D_THRESHOLD else "gd"
+        if solver == "normal":
+            return fit_linear(X, y, sample_weight=sample_weight, l2=l2)
+        return fit_linear_gd(X, y, sample_weight=sample_weight, l2=l2,
+                             max_iter=gd_iters)
 
     def make_model(self, params):
         return LinearRegressionModel(w=np.asarray(params.w).tolist(), b=float(params.b))

@@ -1,0 +1,43 @@
+"""ASCII table pretty-printer (analog of reference utils/.../table/Table.scala),
+used by the selector / sanity-checker / insights `pretty()` reports."""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+
+def format_cell(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)
+
+
+def pretty_table(rows: Sequence[Sequence[Any]], headers: Sequence[str],
+                 title: Optional[str] = None, max_col_width: int = 40) -> str:
+    """Render rows as a boxed ASCII table:
+
+    +-------+------+
+    | model | AuPR |
+    +-------+------+
+    | LR    | 0.78 |
+    +-------+------+
+    """
+    cells = [[format_cell(v)[:max_col_width] for v in r] for r in rows]
+    headers = [str(h)[:max_col_width] for h in headers]
+    widths = [
+        max(len(headers[c]), *(len(r[c]) for r in cells)) if cells else len(headers[c])
+        for c in range(len(headers))
+    ]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+
+    def line(vals):
+        return "| " + " | ".join(v.ljust(w) for v, w in zip(vals, widths)) + " |"
+
+    out = []
+    if title:
+        out.append(title)
+    out.extend([sep, line(headers), sep])
+    out.extend(line(r) for r in cells)
+    out.append(sep)
+    return "\n".join(out)
