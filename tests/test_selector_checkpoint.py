@@ -49,7 +49,10 @@ def test_kill_resume_bit_identical(tmp_path, monkeypatch):
     _fit(base, table)
     want = base.summary_.to_json()
 
-    # interrupted run: the second grid group raises (simulated kill mid-search)
+    # interrupted run: the second grid group raises (simulated kill mid-search).
+    # Force the serial path so "first group completed, second killed" is
+    # deterministic (the parallel path races the two groups by design).
+    monkeypatch.setenv("TT_PARALLEL_COMPILE", "0")
     import transmogrifai_tpu.select.validator as val
 
     calls = {"n": 0}
