@@ -144,6 +144,93 @@ def test_streaming_score(tmp_path):
     assert parts == ["part-00000.csv", "part-00001.csv", "part-00002.csv"]
 
 
+def test_streaming_ragged_batches_pad_to_buckets(tmp_path, monkeypatch):
+    """Ragged arrivals score through power-of-two-padded tables (one compiled plan
+    per bucket, not per arrival size) and outputs are sliced back to true counts."""
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    runner, _ = _runner()
+    runner.run("train", OpParams())
+    batches = [_rows(n, seed=n) for n in (16, 7, 5, 3)]
+    for b in batches:
+        for r in b:
+            del r["label"]
+    runner.streaming_reader = BatchStreamingReader(batches)
+    seen_sizes = []
+    orig = WorkflowModel.score
+
+    def spy(self, table=None, **kw):
+        seen_sizes.append(table.nrows)
+        return orig(self, table=table, **kw)
+
+    monkeypatch.setattr(WorkflowModel, "score", spy)
+    res = runner.run("streaming_score", OpParams(write_location=str(tmp_path / "s")))
+    assert res.n_rows == 16 + 7 + 5 + 3
+    assert seen_sizes == [16, 8, 8, 4]  # buckets, and 7/5 share one program shape
+    with open(tmp_path / "s" / "part-00001.csv") as fh:
+        assert len(list(csv.DictReader(fh))) == 7  # padding rows sliced off
+
+
+def test_streaming_rebatch_fixed_size():
+    runner, _ = _runner()
+    runner.run("train", OpParams())
+    batches = [_rows(n, seed=n) for n in (10, 3, 9, 2)]
+    for b in batches:
+        for r in b:
+            del r["label"]
+    runner.streaming_reader = BatchStreamingReader(batches)
+    runner.stream_batch_size = 8
+    res = runner.run("streaming_score", OpParams())
+    assert res.batches == 3  # 24 rows -> 8, 8, 8
+    assert res.n_rows == 24
+
+
+def test_queue_streaming_reader_threaded():
+    import threading
+
+    from transmogrifai_tpu.readers import QueueStreamingReader
+
+    q = QueueStreamingReader()
+
+    def producer():
+        for i in range(3):
+            q.put([{"x1": float(i), "cat": "a"}])
+        q.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = list(q.stream())
+    t.join()
+    assert [b[0]["x1"] for b in got] == [0.0, 1.0, 2.0]
+
+
+def test_queue_streaming_reader_timeout():
+    from transmogrifai_tpu.readers import QueueStreamingReader
+
+    q = QueueStreamingReader(timeout=0.05)
+    q.put([{"x1": 1.0}])
+    assert len(list(q.stream())) == 1  # drains, then idle timeout ends the stream
+
+
+def test_rebatch_carries_remainders():
+    from transmogrifai_tpu.readers import rebatch
+
+    out = list(rebatch(iter([[1, 2, 3], [4], [5, 6, 7, 8, 9]]), 4))
+    assert out == [[1, 2, 3, 4], [5, 6, 7, 8], [9]]
+
+
+def test_table_pad_to():
+    from transmogrifai_tpu.types import Column, Table
+
+    t = Table({"x": Column.build("Real", [1.0, 2.0, None])})
+    p = t.pad_to(8)
+    assert p.nrows == 8
+    assert p["x"].to_list()[:3] == [1.0, 2.0, None]
+    assert p["x"].to_list()[3] == 1.0  # repeats row 0
+    with pytest.raises(ValueError):
+        t.pad_to(2)
+
+
 def test_csv_streaming_reader(tmp_path):
     for i in range(2):
         with open(tmp_path / f"b{i}.csv", "w", newline="") as fh:

@@ -12,7 +12,13 @@ from .joined import (
     left_outer_join,
     outer_join,
 )
-from .streaming import BatchStreamingReader, CSVStreamingReader, StreamingReader
+from .streaming import (
+    BatchStreamingReader,
+    CSVStreamingReader,
+    QueueStreamingReader,
+    StreamingReader,
+    rebatch,
+)
 
 
 class Simple:
@@ -122,5 +128,7 @@ __all__ = [
     "StreamingReader",
     "BatchStreamingReader",
     "CSVStreamingReader",
+    "QueueStreamingReader",
+    "rebatch",
     "KEY_COLUMN",
 ]

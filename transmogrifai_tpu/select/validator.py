@@ -374,12 +374,29 @@ def evaluate_candidates(
             by_future = {ex.submit(run_unit, u): u for u in live}
             # completion order: each group checkpoints the moment it finishes,
             # regardless of how long earlier-submitted groups still compile;
-            # drain EVERYTHING so completed groups survive any failure
-            for fut in as_completed(by_future):
-                try:
-                    finish(by_future[fut], fut.result())
-                except BaseException as e:  # noqa: BLE001
-                    errors.append(e)
+            # drain EVERYTHING so completed groups survive any failure — including
+            # an interrupt raised while WAITING in as_completed (not just inside
+            # fut.result()): checkpoint whatever already finished before re-raising
+            try:
+                for fut in as_completed(by_future):
+                    try:
+                        finish(by_future[fut], fut.result())
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+            except BaseException as e:  # noqa: BLE001
+                for fut in by_future:  # queued-not-started units exit immediately
+                    fut.cancel()
+                errors.append(e)
+        if errors:
+            # shutdown already waited for in-flight units; checkpoint any that
+            # completed during the wait (their compute is paid — a resume must
+            # not re-run them)
+            for fut, u in by_future.items():
+                if fut.done() and not fut.cancelled() and "group_results" not in u:
+                    try:
+                        finish(u, fut.result())
+                    except BaseException:  # noqa: BLE001
+                        pass
         if errors:
             # interrupts outrank model errors: never swallow a Ctrl-C behind one
             for e in errors:

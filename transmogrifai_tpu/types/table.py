@@ -69,6 +69,21 @@ class Table:
             idx = np.flatnonzero(idx)
         return Table({n: c.slice(idx) for n, c in self.columns.items()}, int(idx.shape[0]))
 
+    def pad_to(self, target: int) -> "Table":
+        """Pad to `target` rows by repeating the first row — shape discipline for
+        streaming/serving: a ragged final micro-batch rounds up to a bucket size so
+        the jit-compiled scoring plan is reused instead of retraced (the XLA analog
+        of the reference's fixed DStream batch interval). Callers slice the first
+        `nrows` rows of any derived output."""
+        if target < self.nrows:
+            raise ValueError(f"pad_to({target}) smaller than nrows={self.nrows}")
+        if target == self.nrows:
+            return self
+        if self.nrows == 0:
+            raise ValueError("cannot pad an empty table (no row to repeat)")
+        idx = np.concatenate([np.arange(self.nrows), np.zeros(target - self.nrows, np.int64)])
+        return self.slice(idx)
+
     # --- device/host split --------------------------------------------------------------
     def device_part(self) -> dict[str, Column]:
         return {n: c for n, c in self.columns.items() if c.is_device}

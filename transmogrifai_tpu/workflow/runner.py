@@ -13,6 +13,8 @@ import csv as _csv
 import json
 import os
 import time
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
@@ -117,11 +119,18 @@ class WorkflowRunner:
         streaming_reader: Optional[Any] = None,
         evaluator: Optional[Any] = None,
         features_to_compute: Sequence[Any] = (),
+        stream_batch_size: Optional[int] = None,
+        stream_pad: bool = True,
     ):
         self.workflow = workflow
         self.train_reader = train_reader
         self.score_reader = score_reader
         self.streaming_reader = streaming_reader
+        #: re-chunk arrivals to this fixed size (None = score batches as they come)
+        self.stream_batch_size = stream_batch_size
+        #: pad ragged batches up to power-of-two buckets so the jit-compiled scoring
+        #: plan is reused — at most log2(max batch) programs ever compile
+        self.stream_pad = stream_pad
         self.evaluator = evaluator
         self.features_to_compute = tuple(features_to_compute)
         self._end_handlers: list[Callable[[AppMetrics], None]] = []
@@ -255,11 +264,24 @@ class WorkflowRunner:
         loc = params.write_location
         n_rows = 0
         n_batches = 0
-        for batch in self.streaming_reader.stream():
+        batches = self.streaming_reader.stream()
+        if self.stream_batch_size:
+            from ..readers.streaming import rebatch
+
+            batches = rebatch(
+                (b.to_rows() if isinstance(b, Table) else b for b in batches),
+                self.stream_batch_size,
+            )
+        for batch in batches:
             table = batch if isinstance(batch, Table) else Table.from_rows(
                 batch, {f.name: f.kind for f in model.raw_features if not f.is_response}
             )
+            n = table.nrows
+            if self.stream_pad and n > 0:
+                table = table.pad_to(1 << (n - 1).bit_length())
             scored = model.score(table=table)
+            if scored.nrows > n:
+                scored = scored.slice(np.arange(n))
             n_rows += scored.nrows
             if loc:
                 write_table_csv(scored, os.path.join(loc, f"part-{n_batches:05d}.csv"))
