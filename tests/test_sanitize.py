@@ -1,0 +1,146 @@
+"""Stage sanitizer tests (SURVEY §5.2): jit purity, traceability, serializability,
+donation guards — the TPU analog of the reference's checkSerializable validation
+(OpWorkflow.scala:265-272)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.stages.base import Transformer, register_stage
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.utils.sanitize import (
+    StageSanitizerError,
+    check_pure,
+    check_serializable,
+    check_stages,
+    check_traceable,
+    donating_jit,
+)
+
+
+def _real_col(vals):
+    return Column.build("Real", vals)
+
+
+@register_stage
+class _GoodStage(Transformer):
+    operation_name = "good"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return in_kinds[0]
+
+    def transform_columns(self, cols):
+        c = cols[0]
+        return Column.real(c.values * 2.0, c.mask)
+
+
+@register_stage
+class _BranchyStage(Transformer):
+    """Data-dependent Python branch: fine eagerly, breaks under jit."""
+
+    operation_name = "branchy"
+    device_op = True
+
+    def out_kind(self, in_kinds):
+        return in_kinds[0]
+
+    def transform_columns(self, cols):
+        c = cols[0]
+        if float(jnp.nansum(c.values)) > 0:  # host sync on a tracer
+            return Column.real(c.values + 1.0, c.mask)
+        return Column.real(c.values - 1.0, c.mask)
+
+
+@register_stage
+class _ImpureStage(Transformer):
+    operation_name = "impure"
+    device_op = True
+    _counter = 0
+
+    def out_kind(self, in_kinds):
+        return in_kinds[0]
+
+    def transform_columns(self, cols):
+        type(self)._counter += 1  # class-level state baked into each call
+        return Column.real(cols[0].values + float(type(self)._counter), cols[0].mask)
+
+
+class _UnregisteredStage(Transformer):
+    operation_name = "unregistered"
+
+    def out_kind(self, in_kinds):
+        return in_kinds[0]
+
+    def transform_columns(self, cols):
+        return cols[0]
+
+
+def test_traceable_passes_pure_jnp_stage():
+    col = _real_col([1.0, 2.0, None])
+    check_traceable(_GoodStage(), [col])
+    check_pure(_GoodStage(), [col])
+
+
+def test_traceable_catches_host_branch():
+    with pytest.raises(StageSanitizerError, match="not jit-traceable"):
+        check_traceable(_BranchyStage(), [_real_col([1.0, 2.0])])
+
+
+def test_purity_catches_global_state():
+    with pytest.raises(StageSanitizerError, match="impure"):
+        check_pure(_ImpureStage(), [_real_col([1.0, 2.0])])
+
+
+def test_serializable_round_trip_and_rejection():
+    check_serializable(_GoodStage())
+    with pytest.raises(StageSanitizerError, match="STAGE_REGISTRY"):
+        check_serializable(_UnregisteredStage())
+
+
+def test_check_stages_runs_device_checks_on_sample():
+    from transmogrifai_tpu.graph import features_from_schema
+
+    fs = features_from_schema({"x": "Real"})
+    stage = _GoodStage()
+    stage(fs["x"])
+    table = Table({"x": _real_col([1.0, None, 3.0])})
+    assert check_stages([stage], table) == [stage.uid]
+
+
+def test_workflow_train_sanitize_flag():
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x1": float(rng.normal())}
+            for _ in range(32)]
+    fs = features_from_schema({"label": "RealNN", "x1": "Real"}, response="label")
+    pred = LogisticRegression(l2=0.1)(fs["label"], transmogrify([fs["x1"]]))
+    wf = Workflow().set_result_features(pred)
+    table = Table.from_rows(rows, {"label": "RealNN", "x1": "Real"})
+    model = wf.train(table=table, sanitize=True)  # all shipped stages pass
+    assert model.score(table=table).nrows == 32
+
+
+def test_donating_jit_guards_reuse_on_cpu():
+    def step(acc, x):
+        return acc + x
+
+    guarded = donating_jit(step, donate_argnums=0)
+    acc = jnp.zeros(4)
+    out = guarded(acc, jnp.ones(4))
+    assert np.allclose(np.asarray(out), 1.0)
+    # the donated input is now deleted even on CPU, mirroring TPU aliasing
+    with pytest.raises(RuntimeError):
+        np.asarray(acc)
+
+
+def test_donating_jit_output_usable_across_steps():
+    guarded = donating_jit(lambda acc, x: acc + x, donate_argnums=0)
+    acc = jnp.zeros(2)
+    for _ in range(3):
+        acc = guarded(acc, jnp.ones(2))
+    assert np.allclose(np.asarray(acc), 3.0)

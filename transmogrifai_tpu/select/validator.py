@@ -395,8 +395,10 @@ def evaluate_candidates(
                 if fut.done() and not fut.cancelled() and "group_results" not in u:
                     try:
                         finish(u, fut.result())
+                    except (KeyboardInterrupt, SystemExit) as ie:
+                        errors.append(ie)  # an interrupt during drain still outranks
                     except BaseException:  # noqa: BLE001
-                        pass
+                        pass  # this unit already failed; its error is in `errors`
         if errors:
             # interrupts outrank model errors: never swallow a Ctrl-C behind one
             for e in errors:

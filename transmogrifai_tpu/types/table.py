@@ -15,6 +15,14 @@ import numpy as np
 from .column import Column
 
 
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n: the shared batch-size bucket policy for streaming
+    and serving (at most log2(max batch) compiled programs per scoring plan)."""
+    if n <= 0:
+        raise ValueError(f"bucket size needs n >= 1, got {n}")
+    return 1 << (n - 1).bit_length()
+
+
 class Table:
     def __init__(self, columns: Mapping[str, Column], nrows: Optional[int] = None):
         self.columns: dict[str, Column] = dict(columns)

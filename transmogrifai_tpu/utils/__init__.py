@@ -1,4 +1,23 @@
 from .compile_cache import enable_compile_cache
+from .sanitize import (
+    StageSanitizerError,
+    check_pure,
+    check_serializable,
+    check_stages,
+    check_traceable,
+    donating_jit,
+)
 from .uid import reset_uid_counter, uid, uid_type
 
-__all__ = ["uid", "uid_type", "reset_uid_counter", "enable_compile_cache"]
+__all__ = [
+    "uid",
+    "uid_type",
+    "reset_uid_counter",
+    "enable_compile_cache",
+    "StageSanitizerError",
+    "check_stages",
+    "check_pure",
+    "check_serializable",
+    "check_traceable",
+    "donating_jit",
+]

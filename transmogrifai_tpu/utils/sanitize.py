@@ -1,0 +1,159 @@
+"""Stage sanitizers: jit-purity, traceability, serializability, donation guards.
+
+TPU-native analog of the reference's pre-train validation (SURVEY §5.2): where Spark
+needs closure-serializability checks (OpWorkflow.checkSerializable, OpWorkflow.scala:
+265-272, ClosureUtils) because stages ship to executors, the single-controller JAX
+runtime's failure modes are different — an impure kernel (global state, host RNG)
+silently bakes stale values into the traced program, a data-dependent Python branch
+fails deep inside jit with a trace error that names no stage, and a donated buffer
+reused after donation only explodes on real TPU hardware (CPU tests silently copy).
+These checks surface each of those at workflow-build time with the offending stage
+named.
+
+Opt-in: `check_stages(stages, sample_table)` from tests/CI, or
+`Workflow.train(..., sanitize=True)` before fitting.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+
+class StageSanitizerError(Exception):
+    """A stage failed a sanitizer check; message names the stage and the fix."""
+
+
+def _device_arrays(col) -> list:
+    """The jnp leaves of a Column pytree."""
+    import jax
+
+    return [x for x in jax.tree_util.tree_leaves(col) if hasattr(x, "dtype")]
+
+
+def check_traceable(stage, cols: Sequence[Any]) -> None:
+    """Abstractly trace a device stage's kernel (jax.make_jaxpr): catches
+    data-dependent Python control flow, host sync (np.asarray on a tracer), and
+    dynamic output shapes — at build time, with the stage named, instead of as an
+    anonymous trace error mid-train."""
+    import jax
+
+    if not getattr(stage, "device_op", False):
+        return
+    try:
+        jax.make_jaxpr(lambda cs: stage.transform_columns(cs))(list(cols))
+    except Exception as e:  # noqa: BLE001
+        raise StageSanitizerError(
+            f"{stage} (device_op) is not jit-traceable: {type(e).__name__}: {e}. "
+            "Device stages must be pure jnp — move data-dependent Python control "
+            "flow to lax.cond/lax.select, or mark the stage host-side "
+            "(device_op=False)."
+        ) from e
+
+
+def check_pure(stage, cols: Sequence[Any]) -> None:
+    """Run a transformer's kernel twice on identical inputs and demand bit-identical
+    outputs — catches global mutable state, unseeded RNG, and call-counting caches
+    that would bake one trace's values into every future batch."""
+    out1 = stage.transform_columns(list(cols))
+    out2 = stage.transform_columns(list(cols))
+    a1, a2 = _device_arrays(out1), _device_arrays(out2)
+    if len(a1) != len(a2):
+        raise StageSanitizerError(
+            f"{stage} returned different output structure across identical calls"
+        )
+    for x, y in zip(a1, a2):
+        if x.shape != y.shape or not np.array_equal(
+            np.asarray(x), np.asarray(y), equal_nan=True
+        ):
+            raise StageSanitizerError(
+                f"{stage} is impure: two calls on identical inputs produced "
+                "different outputs. Under jit the FIRST call's behavior is traced "
+                "and replayed forever — seed RNG via an explicit key param and "
+                "avoid module/global state in the kernel."
+            )
+
+
+def check_serializable(stage) -> None:
+    """to_json -> from_json round-trip (the checkSerializable analog): every stage in
+    a trained workflow must reconstruct from its manifest entry, or model save/load
+    breaks at load time — far from the stage that caused it."""
+    from ..stages.base import STAGE_REGISTRY
+
+    data = stage.to_json()
+    cls_name = data.get("class")
+    if cls_name not in STAGE_REGISTRY:
+        raise StageSanitizerError(
+            f"{stage} ({cls_name}) is not in STAGE_REGISTRY — annotate the class "
+            "with @register_stage, or it cannot be restored by model load."
+        )
+    try:
+        clone = STAGE_REGISTRY[cls_name](**data["params"])
+    except Exception as e:  # noqa: BLE001
+        raise StageSanitizerError(
+            f"{stage} params do not round-trip through JSON "
+            f"({type(e).__name__}: {e}); ctor must accept exactly what to_json "
+            "emits. Lambda-style stages need a registered fn_name."
+        ) from e
+    if type(clone) is not type(stage):
+        raise StageSanitizerError(
+            f"{cls_name} registry entry reconstructs {type(clone).__name__}"
+        )
+
+
+def check_stages(stages: Sequence[Any], sample_table=None) -> list[str]:
+    """Run all applicable sanitizers over `stages`; returns the checked stage uids.
+    With a `sample_table` (a few rows suffice — shapes don't matter, dtypes do),
+    device transformers are additionally trace- and purity-checked on their real
+    input columns."""
+    from ..stages.base import Transformer
+
+    checked: list[str] = []
+    for stage in stages:
+        check_serializable(stage)
+        if (
+            sample_table is not None
+            and isinstance(stage, Transformer)
+            and getattr(stage, "device_op", False)
+            and all(f.name in sample_table for f in stage.inputs)
+        ):
+            cols = [sample_table[f.name] for f in stage.inputs]
+            check_traceable(stage, cols)
+            check_pure(stage, cols)
+        checked.append(stage.uid)
+    return checked
+
+
+def donating_jit(fn: Callable, donate_argnums: int | Sequence[int], **jit_kw):
+    """jit with donated inputs that fails fast on misuse EVERYWHERE: on TPU, XLA
+    reuses a donated buffer's memory and any later read raises; on CPU (where all
+    tests run) donation is silently ignored, so a buffer-reuse bug ships to hardware
+    undetected. This wrapper deletes the donated input buffers after each call,
+    making CPU reads raise the same way TPU's would.
+    """
+    import jax
+
+    if isinstance(donate_argnums, int):
+        donate_argnums = (donate_argnums,)
+    donate_argnums = tuple(donate_argnums)
+    jitted = jax.jit(fn, donate_argnums=donate_argnums, **jit_kw)
+
+    def wrapper(*args, **kwargs):
+        missing = [i for i in donate_argnums if i >= len(args)]
+        if missing:
+            # jax.jit silently skips donation for keyword args — require positional
+            # so the guarantee ("reuse raises") actually holds, and fail BEFORE the
+            # computation rather than after it succeeded
+            raise TypeError(
+                f"donated args {missing} must be passed positionally"
+            )
+        out = jitted(*args, **kwargs)
+        for i in donate_argnums:
+            for leaf in jax.tree_util.tree_leaves(args[i]):
+                if hasattr(leaf, "delete") and hasattr(leaf, "is_deleted"):
+                    if not leaf.is_deleted():
+                        leaf.delete()
+        return out
+
+    wrapper._jitted = jitted  # escape hatch: profiling / cost analysis
+    return wrapper

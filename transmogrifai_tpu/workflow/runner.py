@@ -278,7 +278,9 @@ class WorkflowRunner:
             )
             n = table.nrows
             if self.stream_pad and n > 0:
-                table = table.pad_to(1 << (n - 1).bit_length())
+                from ..types.table import pow2_bucket
+
+                table = table.pad_to(pow2_bucket(n))
             scored = model.score(table=table)
             if scored.nrows > n:
                 scored = scored.slice(np.arange(n))

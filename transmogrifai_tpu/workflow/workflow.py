@@ -22,6 +22,7 @@ import os
 from typing import Callable, Optional, Sequence
 
 import jax
+import numpy as np
 
 _logger = logging.getLogger(__name__)
 
@@ -192,14 +193,25 @@ class Workflow(WorkflowCore):
         self._dag = compute_dag(self.result_features)
         validate_dag(self._dag)
 
-    def train(self, table: Optional[Table] = None) -> "WorkflowModel":
+    def train(self, table: Optional[Table] = None,
+              sanitize: bool = False) -> "WorkflowModel":
         """Fit all estimator stages layer by layer; bulk-apply transformers between fit
-        points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG)."""
+        points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG).
+
+        `sanitize=True` runs the stage sanitizers (utils/sanitize.py: serializability
+        round-trip for every stage; jit-traceability + purity for device transformers
+        on an 8-row sample) before fitting — the pre-train validation analog of the
+        reference's checkSerializable (OpWorkflow.scala:265-272)."""
         if not self.result_features:
             raise ValueError("set_result_features first")
         if table is not None:
             self.set_input_table(table)
         data = self._generate_raw()
+        if sanitize:
+            from ..utils.sanitize import check_stages
+
+            sample = data.slice(np.arange(min(8, data.nrows)))
+            check_stages([s for layer in self._dag for s in layer], sample)
         blacklisted: tuple[Feature, ...] = ()
         # distributions describe THIS train's RawFeatureFilter pass; clear any
         # stale tuples from a previous train of a reused feature graph first
