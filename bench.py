@@ -155,6 +155,14 @@ def main() -> None:
         from bench_wide import run_wide
 
         detail["wide"] = run_wide()
+    if os.environ.get("BENCH_EXTRA", "1") != "0":
+        # BASELINE.json configs 2/3/5 + the pallas histogram kernel evidence
+        from bench_extra import run_boston, run_hist, run_iris, run_mlp
+
+        detail["iris"] = run_iris()
+        detail["boston"] = run_boston()
+        detail["hist_kernel"] = run_hist()
+        detail["mlp_deep_tabular"] = run_mlp()
 
     print(json.dumps({
         "metric": "titanic_automl_models_evaluated_per_sec",

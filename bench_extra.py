@@ -1,0 +1,217 @@
+"""Extra benchmark workloads beyond the Titanic headline (BASELINE.json configs 2-5).
+
+Each function returns a JSON-able dict; bench.py merges them into its `detail`:
+  - run_iris():   multiclass AutoML search (config 2, OpIris analog) — holdout quality
+  - run_boston(): regression AutoML search (config 3, OpBoston analog) — holdout quality
+  - run_hist():   pallas MXU histogram kernel vs the portable segment-sum lowering at
+                  a tree-growth-shaped size (the perf evidence for ops/pallas_hist.py)
+  - run_mlp():    deep-tabular minibatch-SGD MLP throughput + MFU (config 5 regime)
+
+Run standalone: python bench_extra.py [iris|boston|hist|mlp ...]
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+IRIS_CSV = "/root/reference/helloworld/src/main/resources/IrisDataset/bezdekIris.data"
+BOSTON_DATA = "/root/reference/helloworld/src/main/resources/BostonDataset/housing.data"
+
+
+def _summary_dict(selector, wall: float) -> dict:
+    s = selector.summary_
+    hold = s.holdout_metrics.to_json() if s.holdout_metrics else {}
+    return {
+        "models_evaluated": s.models_evaluated,
+        "search_wall_s": round(wall, 3),
+        "models_per_sec": round(s.models_evaluated / wall, 3),
+        "best_model": s.best_model_name,
+        "holdout": {k: round(v, 4) for k, v in hold.items()
+                    if isinstance(v, (int, float))},
+        "n_holdout": s.n_holdout,
+    }
+
+
+def run_iris() -> dict:
+    """Config 2: the OpIris multiclass flow (reference helloworld OpIris.scala) —
+    indexed labels, transmogrified measurements, DataCutter-reserved holdout."""
+    from examples.iris import FIELDS, SCHEMA
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import CSVReader
+    from transmogrifai_tpu.select import DataCutter, MultiClassificationModelSelector
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    if not os.path.exists(IRIS_CSV):
+        return {"skipped": "iris dataset not mounted"}
+    fs = features_from_schema(SCHEMA, response="irisClass")
+    labels = fs["irisClass"].index_string()
+    vector = transmogrify([fs[n] for n in FIELDS[:4]])
+    selector = MultiClassificationModelSelector.with_cross_validation(
+        splitter=DataCutter(reserve_test_fraction=0.2, seed=42), seed=42
+    )
+    pred = selector(labels, vector)
+    reader = CSVReader(IRIS_CSV, SCHEMA, has_header=False, field_names=FIELDS)
+    table = reader.generate_table(list(fs.values()))
+    t0 = time.perf_counter()
+    Workflow().set_result_features(pred, labels).train(table=table)
+    return _summary_dict(selector, time.perf_counter() - t0)
+
+
+def run_boston() -> dict:
+    """Config 3: the OpBoston regression flow (reference helloworld OpBoston.scala)."""
+    from examples.boston import SCHEMA, _read_rows
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.select import RegressionModelSelector
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    if not os.path.exists(BOSTON_DATA):
+        return {"skipped": "boston dataset not mounted"}
+    fs = features_from_schema(SCHEMA, response="medv")
+    vector = transmogrify([f for n, f in fs.items() if n != "medv"])
+    selector = RegressionModelSelector.with_cross_validation(
+        num_folds=3, validation_metric="RootMeanSquaredError"
+    )
+    pred = selector(fs["medv"], vector)
+    table = InMemoryReader(_read_rows(BOSTON_DATA)).generate_table(list(fs.values()))
+    t0 = time.perf_counter()
+    Workflow().set_result_features(pred).train(table=table)
+    return _summary_dict(selector, time.perf_counter() - t0)
+
+
+def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
+             n_nodes: int = 8, iters: int = 20) -> dict:
+    """Pallas MXU histogram vs the portable segment-sum scatter at a tree-growth
+    shape (one level of an 8-leaf tree over 128k rows x 64 features x 64 bins) —
+    the measured evidence that the kernel beats the fallback on TPU. (At 512k rows
+    the segment-sum lowering OOMs — 16.5G HBM program — so the pallas kernel is
+    what makes bigger shapes trainable at all.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops.pallas_hist import histogram_pallas, use_pallas_histogram
+    from transmogrifai_tpu.ops.trees import histogram_segment_sum
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    Xb = jax.random.randint(k1, (n_rows, n_feat), 0, n_bins, jnp.int32)
+    node = jax.random.randint(k2, (n_rows,), 0, n_nodes, jnp.int32)
+    gh = jax.random.normal(k3, (n_rows, 2), jnp.float32)
+
+    def timed(fn) -> tuple[float, np.ndarray]:
+        out = fn(gh, Xb, node, n_nodes, n_bins)  # compile + warm
+        jax.device_get(out)  # force: block_until_ready may not block over the tunnel
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(gh, Xb, node, n_nodes, n_bins)
+        host = jax.device_get(out)
+        return (time.perf_counter() - t0) / iters, np.asarray(host)
+
+    seg_fn = jax.jit(histogram_segment_sum, static_argnums=(3, 4))
+    seg_t, seg_out = timed(seg_fn)
+    result = {
+        "rows": n_rows, "features": n_feat, "bins": n_bins, "nodes": n_nodes,
+        "segment_sum_ms": round(seg_t * 1e3, 3),
+        "pallas_available": bool(use_pallas_histogram()),
+    }
+    if use_pallas_histogram():
+        pal_fn = jax.jit(histogram_pallas, static_argnums=(3, 4))
+        pal_t, pal_out = timed(pal_fn)
+        result["pallas_ms"] = round(pal_t * 1e3, 3)
+        result["pallas_speedup"] = round(seg_t / pal_t, 2)
+        result["max_abs_diff"] = float(np.max(np.abs(seg_out - pal_out)))
+    return result
+
+
+def run_mlp(n_rows: int = 1 << 20, d: int = 1024, chunk: int = 1 << 16,
+            epochs: int = 2, hidden=(1024, 512, 256)) -> dict:
+    """Config 5 regime: deep-tabular MLP (1024 -> 1024 -> 512 -> 256 -> 2, the
+    Criteo-MLP width class) trained with minibatch Adam over streamed chunks
+    (bf16 matmuls, donated state, one compiled step); reports rows/sec and MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu import profiling
+    from transmogrifai_tpu.ops.mlp import (
+        fit_mlp_minibatch,
+        fit_mlp_scan,
+        predict_mlp,
+    )
+
+    n_chunks = n_rows // chunk
+    key = jax.random.PRNGKey(3)
+    kw, key = jax.random.split(key)
+    # planted two-layer teacher so holdout accuracy is checkable
+    W1 = jax.random.normal(kw, (d, 32)) / np.sqrt(d)
+    w2 = jax.random.normal(key, (32,))
+    chunk_keys = jax.random.split(jax.random.PRNGKey(5), n_chunks + 1)
+
+    @jax.jit
+    def make(k):
+        kx, kn = jax.random.split(k)
+        X = jax.random.normal(kx, (chunk, d), jnp.float32)
+        logits = jnp.tanh(X @ W1) @ w2 * 2.0
+        y = (jax.nn.sigmoid(logits) >
+             jax.random.uniform(kn, (chunk,))).astype(jnp.int32)
+        return X, y
+
+    def chunk_fn(i):
+        return make(chunk_keys[i])
+
+    sizes = (d, *hidden, 2)
+    flops_per_row = sum(6 * i * o for i, o in zip(sizes[:-1], sizes[1:]))
+    total_flops = flops_per_row * n_rows * epochs
+    batch = 1 << 15
+
+    # --- in-HBM path: whole epochs as lax.scan in ONE program (zero per-step host
+    # round-trips; X staged bf16, 2 GB at 1M x 1024) -------------------------------
+    X_all = jnp.concatenate(
+        [make(chunk_keys[i])[0].astype(jnp.bfloat16) for i in range(n_chunks)])
+    y_all = jnp.concatenate([make(chunk_keys[i])[1] for i in range(n_chunks)])
+    # warm at the SAME static args (epochs is static — a different value is a
+    # different program and would put the compile inside the timed window)
+    fit_mlp_scan(X_all, y_all, batch_size=batch, hidden=hidden, epochs=epochs)
+    t0 = time.perf_counter()
+    params = fit_mlp_scan(X_all, y_all, batch_size=batch, hidden=hidden,
+                          epochs=epochs)
+    jax.device_get(params[-1][1])  # force: block_until_ready may not block over tunnel
+    scan_wall = time.perf_counter() - t0
+
+    # --- streamed path: one jitted Adam step per host-fed chunk (donated state) ----
+    fit_mlp_minibatch(chunk_fn, 1, d, hidden=hidden, epochs=1)  # warm compile
+    t1 = time.perf_counter()
+    params_stream = fit_mlp_minibatch(chunk_fn, n_chunks, d, hidden=hidden,
+                                      epochs=epochs)
+    jax.device_get(params_stream[-1][1])
+    stream_wall = time.perf_counter() - t1
+
+    Xh, yh = make(chunk_keys[n_chunks])
+    acc = float((predict_mlp(params, jnp.asarray(Xh, jnp.float32))[0] == yh).mean())
+    mfu_scan = profiling.mfu(total_flops, scan_wall)
+    return {
+        "rows": n_rows, "width": d, "hidden": list(hidden), "epochs": epochs,
+        "batch_size": batch,
+        "wall_s": round(scan_wall, 3),
+        "rows_per_sec": round(n_rows * epochs / scan_wall),
+        "tflops_per_sec": round(total_flops / scan_wall / 1e12, 2),
+        "mfu": round(mfu_scan, 4) if mfu_scan is not None else None,
+        "streamed_wall_s": round(stream_wall, 3),
+        "streamed_rows_per_sec": round(n_rows * epochs / stream_wall),
+        "holdout_accuracy": round(acc, 4),
+    }
+
+
+ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp}
+
+if __name__ == "__main__":
+    import sys
+
+    which = [a for a in sys.argv[1:] if a in ALL] or list(ALL)
+    print(json.dumps({name: ALL[name]() for name in which}))

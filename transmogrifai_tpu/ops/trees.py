@@ -82,6 +82,13 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
 
     if use_pallas_histogram():
         return histogram_pallas(vals, Xb, node, n_nodes, n_bins)
+    return histogram_segment_sum(vals, Xb, node, n_nodes, n_bins)
+
+
+def histogram_segment_sum(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
+                          n_nodes: int, n_bins: int) -> jnp.ndarray:
+    """Portable scatter-add histogram (the non-pallas path; also the baseline the
+    pallas kernel is benchmarked against in bench_extra.py)."""
     N, D = Xb.shape
     C = vals.shape[1]
     keys = (node[:, None] * D + jnp.arange(D)[None, :]) * n_bins + Xb  # [N, D]
