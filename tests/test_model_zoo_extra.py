@@ -77,21 +77,35 @@ def test_mlp_minibatch_streamed_chunks(rng):
     assert (pred == yh).mean() > 0.9
 
 
-def test_mlp_scan_matches_minibatch_regime(rng):
-    """fit_mlp_scan (whole run in one program) reaches the same quality as the
-    streamed trainer on identical data/order/hyperparams."""
+def test_mlp_scan_matches_minibatch_trainer(rng):
+    """fit_mlp_scan (whole run in one program) produces the same parameters as
+    fit_mlp_minibatch on identical data/order/hyperparams — the shared Adam core
+    must never diverge between the two trainers."""
     import jax.numpy as jnp
 
-    from transmogrifai_tpu.ops.mlp import fit_mlp_scan, predict_mlp
+    from transmogrifai_tpu.ops.mlp import (
+        fit_mlp_minibatch,
+        fit_mlp_scan,
+        predict_mlp,
+    )
 
     w_true = rng.normal(size=8).astype(np.float32)
     X = rng.normal(size=(256, 8)).astype(np.float32)
     y = (X @ w_true > 0).astype(np.int32)
-    params = fit_mlp_scan(jnp.asarray(X), jnp.asarray(y), batch_size=64,
-                          hidden=(16,), epochs=60, lr=0.02)
+    kw = dict(hidden=(16,), epochs=30, lr=0.02)
+    p_scan = fit_mlp_scan(jnp.asarray(X), jnp.asarray(y), batch_size=64, **kw)
+    chunks = [(jnp.asarray(X[i:i + 64]), jnp.asarray(y[i:i + 64]))
+              for i in range(0, 256, 64)]
+    p_stream = fit_mlp_minibatch(lambda i: chunks[i], 4, 8, **kw)
+    for (Ws, bs), (Wm, bm) in zip(p_scan, p_stream):
+        np.testing.assert_allclose(np.asarray(Ws), np.asarray(Wm),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(bs), np.asarray(bm),
+                                   rtol=1e-4, atol=1e-4)
+
     Xh = rng.normal(size=(200, 8)).astype(np.float32)
     yh = (Xh @ w_true > 0).astype(np.int32)
-    pred = np.asarray(predict_mlp(params, jnp.asarray(Xh))[0])
+    pred = np.asarray(predict_mlp(p_scan, jnp.asarray(Xh))[0])
     assert (pred == yh).mean() > 0.9
 
 

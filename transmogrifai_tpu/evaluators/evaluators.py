@@ -23,7 +23,6 @@ from .metrics_ops import (
     confusion_matrix,
     multiclass_prf,
     multiclass_threshold_counts,
-    prf,
     regression_metrics_ops,
     threshold_sweep,
 )
@@ -142,24 +141,29 @@ class BinaryClassificationEvaluator(EvaluatorBase):
     def evaluate_all(self, table: Table) -> BinaryClassificationMetrics:
         label, pred = self._cols(table)
         vals, ok = _valid_labels(label)
-        y = jnp.asarray(vals[ok], jnp.float32)
-        if y.size == 0:  # nothing labeled: defined zeros, not an empty-array crash
+        y_np = vals[ok].astype(np.float32)
+        if y_np.size == 0:  # nothing labeled: defined zeros, not an empty-array crash
             return BinaryClassificationMetrics(0.0, 0.0, 0.0, 0.0, 0.0, 0.0,
                                                0.0, 0.0, 0.0, 0.0)
-        scores = pred.prob[:, 1] if pred.prob.shape[1] > 1 else pred.prob[:, 0]
-        scores = scores[jnp.asarray(ok)]
+        # slice/mask on HOST: eager device slicing would dispatch a fresh tiny
+        # program per new shape (expensive on a tunneled device); the kernels
+        # below are the only device work
+        prob_np = np.asarray(pred.prob)  # one device->host transfer
+        scores_np = prob_np[:, 1] if prob_np.shape[1] > 1 else prob_np[:, 0]
+        scores = jnp.asarray(scores_np[ok])
+        y = jnp.asarray(y_np)
         auroc, aupr = binary_curve_aucs(scores, y)
         tn, fp, fn, tp = confusion_at(scores, y, self.threshold)
-        precision, recall, f1 = prf(tp, fp, fn)
-        n = tn + fp + fn + tp
-        error = (fp + fn) / jnp.maximum(n, 1.0)
         p_th, r_th, f_th = threshold_sweep(scores, y, self.sweep)
         # ONE device->host transfer for everything: per-element float() would issue
         # hundreds of scalar fetches, each paying full device round-trip latency
-        (auroc, aupr, precision, recall, f1, error, tp, tn, fp, fn,
-         p_th, r_th, f_th) = jax.device_get(
-            (auroc, aupr, precision, recall, f1, error, tp, tn, fp, fn,
-             p_th, r_th, f_th))
+        (auroc, aupr, tp, tn, fp, fn, p_th, r_th, f_th) = jax.device_get(
+            (auroc, aupr, tp, tn, fp, fn, p_th, r_th, f_th))
+        # derived scalars in host float math (mirrors metrics_ops.prf exactly)
+        precision = tp / max(tp + fp, 1.0)
+        recall = tp / max(tp + fn, 1.0)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        error = (fp + fn) / max(tn + fp + fn + tp, 1.0)
         return BinaryClassificationMetrics(
             AuROC=float(auroc), AuPR=float(aupr),
             Precision=float(precision), Recall=float(recall), F1=float(f1),
@@ -238,11 +242,12 @@ class RegressionEvaluator(EvaluatorBase):
     def evaluate_all(self, table: Table) -> RegressionMetrics:
         label, pred = self._cols(table)
         vals, ok = _valid_labels(label)
-        y = jnp.asarray(vals[ok], jnp.float32)
-        if y.size == 0:
+        y_np = vals[ok].astype(np.float32)
+        if y_np.size == 0:
             return RegressionMetrics(0.0, 0.0, 0.0, 0.0)
+        # mask on host (numpy) — eager device gathers dispatch a program per shape
         mse, rmse, mae, r2 = regression_metrics_ops(
-            jnp.asarray(pred.pred)[jnp.asarray(ok)], y)
+            jnp.asarray(np.asarray(pred.pred)[ok]), jnp.asarray(y_np))
         return RegressionMetrics(
             RootMeanSquaredError=float(rmse), MeanSquaredError=float(mse),
             MeanAbsoluteError=float(mae), R2=float(r2),

@@ -91,6 +91,7 @@ def threshold_sweep(scores: jnp.ndarray, labels: jnp.ndarray, thresholds: jnp.nd
     return prf(tp, fp, fn)
 
 
+@partial(jax.jit, static_argnums=(2,))
 def confusion_matrix(pred, labels, num_classes: int):
     """[C, C] confusion (rows=label, cols=pred) via one-hot matmul — MXU-friendly."""
     p = jax.nn.one_hot(jnp.asarray(pred, jnp.int32), num_classes)
@@ -98,6 +99,7 @@ def confusion_matrix(pred, labels, num_classes: int):
     return l.T @ p
 
 
+@jax.jit
 def multiclass_prf(conf):
     tp = jnp.diag(conf)
     fp = conf.sum(axis=0) - tp

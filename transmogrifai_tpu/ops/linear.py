@@ -145,8 +145,10 @@ def fit_logistic_gd(
 WIDE_D_THRESHOLD = 2048
 
 
+@jax.jit
 def predict_logistic(params: LinearParams, X: jnp.ndarray):
-    """-> (pred {0,1} [N], raw [N,2], prob [N,2])."""
+    """-> (pred {0,1} [N], raw [N,2], prob [N,2]). Jitted as one program: eager
+    matmul+sigmoid+stack glue would dispatch several tiny compiles per shape."""
     z = jnp.asarray(X, jnp.float32) @ params.w + params.b
     p1 = jax.nn.sigmoid(z)
     prob = jnp.stack([1.0 - p1, p1], axis=1)
@@ -197,6 +199,7 @@ def fit_multinomial(
     return LinearParams(w=theta[0], b=theta[1])
 
 
+@jax.jit
 def predict_multinomial(params: LinearParams, X: jnp.ndarray):
     logits = jnp.asarray(X, jnp.float32) @ params.w.T + params.b
     prob = jax.nn.softmax(logits, axis=1)
@@ -227,6 +230,7 @@ def fit_linear(
     return LinearParams(w=theta[:-1], b=theta[-1])
 
 
+@jax.jit
 def predict_linear(params: LinearParams, X: jnp.ndarray):
     z = jnp.asarray(X, jnp.float32) @ params.w + params.b
     return z, z[:, None], z[:, None]
@@ -316,6 +320,7 @@ def fit_svc(
     return LinearParams(w=theta[0], b=theta[1])
 
 
+@jax.jit
 def predict_svc(params: LinearParams, X: jnp.ndarray):
     z = jnp.asarray(X, jnp.float32) @ params.w + params.b
     raw = jnp.stack([-z, z], axis=1)
@@ -418,6 +423,7 @@ def fit_logistic_onehot(
     return LinearParams(w=w, b=b)
 
 
+@jax.jit
 def predict_logistic_onehot(params: LinearParams, idx, offsets):
     cols = jnp.asarray(idx, jnp.int32) + jnp.asarray(offsets, jnp.int32)[None, :]
     z = params.w[cols].sum(axis=1) + params.b

@@ -196,7 +196,11 @@ def fit_mlp_scan(
     host round-trips between steps (the dispatch-bound regime of per-step stepping
     disappears; on a tunneled device this is the difference between dispatch
     latency x steps and pure device time). Same update rule as fit_mlp_minibatch;
-    use that one when data streams from host and this one when it fits in HBM."""
+    use that one when data streams from host and this one when it fits in HBM.
+
+    Static-shape discipline: the tail `n % batch_size` rows are dropped each
+    epoch (shuffle or pad upstream if every row must be seen); batch_size > n is
+    an error rather than a silent no-op."""
     X = jnp.asarray(X)
     n, d = X.shape
     steps = n // batch_size
@@ -218,10 +222,15 @@ def fit_mlp_scan(
         g = jax.grad(_mlp_loss)(carry[0], Xc, Yc, l2, compute_dtype)
         return _adam_update(carry, g, lr), None
 
+    def epoch(carry, _):
+        carry, _ = jax.lax.scan(step, carry, (Xb, Yb))
+        return carry, None
+
     zeros = jax.tree.map(jnp.zeros_like, params)
     carry = (params, zeros, jax.tree.map(jnp.zeros_like, params), jnp.float32(0.0))
-    for _ in range(epochs):  # unrolled over epochs, scanned over steps
-        carry, _ = jax.lax.scan(step, carry, (Xb, Yb))
+    # nested scan: program size is O(1) in epochs (a Python loop would trace
+    # `epochs` copies of the step and recompile per distinct epoch count)
+    carry, _ = jax.lax.scan(epoch, carry, None, length=epochs)
     return carry[0]
 
 

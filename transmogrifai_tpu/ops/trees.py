@@ -354,6 +354,7 @@ def fit_forest(
 
 
 # --- prediction heads ----------------------------------------------------------------
+@jax.jit
 def predict_gbt_binary(params: TreeEnsembleParams, X):
     z = predict_ensemble(params, X)[:, 0]
     p1 = jax.nn.sigmoid(z)
@@ -362,24 +363,31 @@ def predict_gbt_binary(params: TreeEnsembleParams, X):
     return (p1 >= 0.5).astype(jnp.float32), raw, prob
 
 
+@jax.jit
 def predict_gbt_multiclass(params: TreeEnsembleParams, X):
     logits = predict_ensemble(params, X)
     prob = jax.nn.softmax(logits, axis=1)
     return jnp.argmax(logits, axis=1).astype(jnp.float32), logits, prob
 
 
+@jax.jit
 def predict_gbt_regression(params: TreeEnsembleParams, X):
     z = predict_ensemble(params, X)[:, 0]
     return z, z[:, None], z[:, None]
 
 
+@jax.jit
 def predict_forest_classification(params: TreeEnsembleParams, X):
+    # one program end-to-end: eager clip/divide/log glue would otherwise dispatch
+    # 4+ separate tiny compiles per new shape (each a remote round trip on a
+    # tunneled device)
     dist = jnp.clip(predict_ensemble(params, X, average=True), 0.0, None)
     prob = dist / jnp.clip(dist.sum(axis=1, keepdims=True), _EPS, None)
     raw = jnp.log(jnp.clip(prob, 1e-12, None))
     return jnp.argmax(prob, axis=1).astype(jnp.float32), raw, prob
 
 
+@jax.jit
 def predict_forest_regression(params: TreeEnsembleParams, X):
     z = predict_ensemble(params, X, average=True)[:, 0]
     return z, z[:, None], z[:, None]

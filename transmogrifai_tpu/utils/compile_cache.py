@@ -28,7 +28,10 @@ def enable_compile_cache(cache_dir: str | None = None) -> bool:
                      os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # cache EVERY program, even sub-second ones: over a tunneled/remote compile
+        # path each tiny eager op costs a ~0.5s round trip, and a cold train
+        # dispatches dozens of them — they are exactly the entries worth caching
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _ENABLED = True
     except Exception:  # older jax without the persistent cache
