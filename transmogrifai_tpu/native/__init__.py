@@ -28,6 +28,22 @@ def _build_dir() -> str:
     return d
 
 
+def _compile_and_load(name: str) -> ctypes.CDLL:
+    """Compile <name>.c (once, content-hashed) into the build cache and dlopen it."""
+    src = os.path.join(_HERE, f"{name}.c")
+    with open(src, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    so = os.path.join(_build_dir(), f"{name}_{digest}.so")
+    if not os.path.exists(so):
+        tmp = f"{so}.tmp{os.getpid()}"  # per-process tmp, then atomic rename
+        subprocess.run(
+            ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+            check=True, capture_output=True,
+        )
+        os.replace(tmp, so)
+    return ctypes.CDLL(so)
+
+
 def load_avrodec() -> Optional[ctypes.CDLL]:
     """Compile (once, content-hashed) and load the decoder; None if unavailable."""
     global _LIB, _TRIED
@@ -36,19 +52,8 @@ def load_avrodec() -> Optional[ctypes.CDLL]:
     _TRIED = True
     if os.environ.get("TT_NATIVE", "1") == "0":
         return None
-    src = os.path.join(_HERE, "avrodec.c")
     try:
-        with open(src, "rb") as fh:
-            digest = hashlib.sha256(fh.read()).hexdigest()[:16]
-        so = os.path.join(_build_dir(), f"avrodec_{digest}.so")
-        if not os.path.exists(so):
-            tmp = f"{so}.tmp{os.getpid()}"  # per-process tmp, then atomic rename
-            subprocess.run(
-                ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
-                check=True, capture_output=True,
-            )
-            os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
+        lib = _compile_and_load("avrodec")
         pp_d = ctypes.POINTER(ctypes.POINTER(ctypes.c_double))
         pp_i = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))
         pp_b = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
@@ -62,6 +67,113 @@ def load_avrodec() -> Optional[ctypes.CDLL]:
     except Exception:
         _LIB = None
     return _LIB
+
+
+#: csvtok.c column type codes
+CT_SKIP, CT_REAL, CT_INT, CT_BOOL, CT_TEXT = 0, 1, 2, 3, 4
+
+_CSV_LIB: Optional[ctypes.CDLL] = None
+_CSV_TRIED = False
+
+
+def load_csvtok() -> Optional[ctypes.CDLL]:
+    """Compile/load the CSV tokenizer; None if unavailable (pure-python fallback)."""
+    global _CSV_LIB, _CSV_TRIED
+    if _CSV_LIB is not None or _CSV_TRIED:
+        return _CSV_LIB
+    _CSV_TRIED = True
+    if os.environ.get("TT_NATIVE", "1") == "0":
+        return None
+    try:
+        lib = _compile_and_load("csvtok")
+        c = ctypes
+        lib.csv_count_records.restype = c.c_int64
+        lib.csv_count_records.argtypes = [c.c_char_p, c.c_int64]
+        lib.csv_parse_typed.restype = c.c_int64
+        lib.csv_parse_typed.argtypes = [
+            c.c_char_p, c.c_int64, c.c_int32,
+            c.c_int32, c.POINTER(c.c_int32),
+            c.POINTER(c.POINTER(c.c_double)),
+            c.POINTER(c.POINTER(c.c_int64)),
+            c.POINTER(c.POINTER(c.c_uint8)),
+            c.POINTER(c.POINTER(c.c_uint8)),
+            c.POINTER(c.POINTER(c.c_int64)),
+            c.POINTER(c.POINTER(c.c_int32)),
+            c.c_int64,
+        ]
+        _CSV_LIB = lib
+    except Exception:
+        _CSV_LIB = None
+    return _CSV_LIB
+
+
+def parse_csv_typed(data: bytes, coltypes: list, skip_header: bool):
+    """Tokenize+parse a CSV byte buffer into typed columns.
+
+    Returns a list (one entry per column, same order as `coltypes`) of
+      ("real", float64[n], present_mask) | ("int", int64[n], mask) |
+      ("bool", uint8[n], mask) | ("text", offsets int64[n], lens int32[n]) | None
+    or None when the native library is unavailable / the buffer has a malformed
+    numeric cell (callers fall back to the Python path, which raises the precise
+    error)."""
+    import numpy as np
+
+    lib = load_csvtok()
+    if lib is None:
+        return None
+    c = ctypes
+    n = lib.csv_count_records(data, len(data)) - (1 if skip_header else 0)
+    if n < 0:
+        return None
+    n = max(n, 1)  # zero-row allocation guard; rows returned governs the slice
+    ncols = len(coltypes)
+    ct_arr = (c.c_int32 * ncols)(*coltypes)
+    d_ptrs = (c.POINTER(c.c_double) * ncols)()
+    i_ptrs = (c.POINTER(c.c_int64) * ncols)()
+    b_ptrs = (c.POINTER(c.c_uint8) * ncols)()
+    m_ptrs = (c.POINTER(c.c_uint8) * ncols)()
+    o_ptrs = (c.POINTER(c.c_int64) * ncols)()
+    l_ptrs = (c.POINTER(c.c_int32) * ncols)()
+    keep = []  # (kind, arrays...) per column, aligned with coltypes
+    for j, t in enumerate(coltypes):
+        if t == CT_REAL:
+            v = np.empty(n, np.float64)
+            m = np.zeros(n, np.uint8)
+            d_ptrs[j] = v.ctypes.data_as(c.POINTER(c.c_double))
+            m_ptrs[j] = m.ctypes.data_as(c.POINTER(c.c_uint8))
+            keep.append(("real", v, m))
+        elif t == CT_INT:
+            v = np.zeros(n, np.int64)
+            m = np.zeros(n, np.uint8)
+            i_ptrs[j] = v.ctypes.data_as(c.POINTER(c.c_int64))
+            m_ptrs[j] = m.ctypes.data_as(c.POINTER(c.c_uint8))
+            keep.append(("int", v, m))
+        elif t == CT_BOOL:
+            v = np.zeros(n, np.uint8)
+            m = np.zeros(n, np.uint8)
+            b_ptrs[j] = v.ctypes.data_as(c.POINTER(c.c_uint8))
+            m_ptrs[j] = m.ctypes.data_as(c.POINTER(c.c_uint8))
+            keep.append(("bool", v, m))
+        elif t == CT_TEXT:
+            o = np.zeros(n, np.int64)
+            ln = np.full(n, -1, np.int32)
+            o_ptrs[j] = o.ctypes.data_as(c.POINTER(c.c_int64))
+            l_ptrs[j] = ln.ctypes.data_as(c.POINTER(c.c_int32))
+            keep.append(("text", o, ln))
+        else:
+            keep.append(None)
+    rows = lib.csv_parse_typed(data, len(data), int(skip_header), ncols, ct_arr,
+                               d_ptrs, i_ptrs, b_ptrs, m_ptrs, o_ptrs, l_ptrs, n)
+    if rows < 0:
+        return None
+    out = []
+    for entry in keep:
+        if entry is None:
+            out.append(None)
+        else:
+            kind, a, b2 = entry
+            out.append((kind, a[:rows], b2[:rows]))
+    return out
 
 
 def field_ops_for_schema(schema: dict) -> Optional[list[tuple[str, int, list]]]:

@@ -1,0 +1,255 @@
+/* csvtok.c — RFC4180 CSV tokenizer + typed column parser.
+ *
+ * Native fast path for the ingestion hot loop (readers/csv.py). The Python csv
+ * module materializes every cell as a PyObject and the per-cell kind parse costs
+ * a try/except; here the whole file buffer is tokenized once in C and numeric
+ * columns land directly in double/int64 arrays with presence masks — Python
+ * objects are created only for text columns (and only at decode time).
+ *
+ * Mirrors readers/csv.py _parse semantics exactly:
+ *   real:     strtod over the full trimmed field, empty -> null
+ *   integral: strtoll, falling back to an integral-valued double; a non-integral
+ *             or unparseable field is a hard error (caller re-raises via the
+ *             Python slow path for the precise message)
+ *   binary:   present iff non-empty; true iff trimmed-lowercased value is in
+ *             {true,t,yes,y,1}
+ *   text:     (offset, len) into the buffer; len<0 flags a cell containing the
+ *             "" escape so the caller unescapes on decode
+ *
+ * Quoting: fields may be wrapped in '"'; inside quotes, '""' is a literal quote
+ * and ',' '\n' are data. CRLF line ends are handled. Records shorter than ncols
+ * leave the missing trailing cells null.
+ */
+#include <errno.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+enum { CT_SKIP = 0, CT_REAL = 1, CT_INT = 2, CT_BOOL = 3, CT_TEXT = 4 };
+
+/* Count non-blank records honoring quotes (blank lines are skipped, matching
+ * Python's csv module; a trailing unterminated line counts). */
+int64_t csv_count_records(const char *buf, int64_t len) {
+    int64_t n = 0;
+    int inq = 0;
+    int sawdata = 0;
+    for (int64_t i = 0; i < len; i++) {
+        char c = buf[i];
+        if (inq) {
+            if (c == '"') {
+                if (i + 1 < len && buf[i + 1] == '"') i++;
+                else inq = 0;
+            }
+        } else if (c == '"') {
+            inq = 1;
+            sawdata = 1;
+        } else if (c == '\n') {
+            if (sawdata) n++;
+            sawdata = 0;
+        } else if (c != '\r') {
+            sawdata = 1;
+        }
+    }
+    if (sawdata) n++;
+    return n;
+}
+
+static void trim(const char **s, const char **e) {
+    while (*s < *e && (**s == ' ' || **s == '\t')) (*s)++;
+    while (*e > *s && ((*e)[-1] == ' ' || (*e)[-1] == '\t' || (*e)[-1] == '\r')) (*e)--;
+}
+
+/* empty-cell test mirroring python (`value == ""`): only \r-stripping, no trim —
+ * a whitespace-only numeric cell is a python-path ERROR (float(" ") raises),
+ * never a null */
+static int cell_empty(const char *s, const char *e) {
+    while (e > s && e[-1] == '\r') e--;
+    return s == e;
+}
+
+/* 1 = parsed, 0 = empty/null, -1 = malformed */
+static int parse_real(const char *s, const char *e, double *out) {
+    if (cell_empty(s, e)) return 0;
+    trim(&s, &e);
+    if (s == e) return -1; /* whitespace-only: python raises */
+    char tmp[512];
+    size_t n = (size_t)(e - s);
+    if (n >= sizeof tmp) return -1;
+    memcpy(tmp, s, n);
+    tmp[n] = 0;
+    char *end;
+    double v = strtod(tmp, &end);
+    if (end != tmp + n) return -1;
+    *out = v;
+    return 1;
+}
+
+static int parse_int(const char *s, const char *e, int64_t *out) {
+    if (cell_empty(s, e)) return 0;
+    trim(&s, &e);
+    if (s == e) return -1; /* whitespace-only: python raises */
+    char tmp[512];
+    size_t n = (size_t)(e - s);
+    if (n >= sizeof tmp) return -1;
+    memcpy(tmp, s, n);
+    tmp[n] = 0;
+    char *end;
+    errno = 0;
+    long long v = strtoll(tmp, &end, 10);
+    if (end == tmp + n) {
+        if (errno == ERANGE) return -1; /* overflow: python path errors loudly */
+        *out = (int64_t)v;
+        return 1;
+    }
+    double d = strtod(tmp, &end); /* "3.0" -> 3 (the float fallback) */
+    if (end != tmp + n) return -1;
+    int64_t iv = (int64_t)d;
+    if ((double)iv != d) return -1; /* non-integral: hard error */
+    *out = iv;
+    return 1;
+}
+
+static int parse_bool(const char *s, const char *e, uint8_t *out) {
+    if (cell_empty(s, e)) return 0;
+    trim(&s, &e);
+    if (s == e) { *out = 0; return 1; } /* whitespace-only: python -> False */
+    char tmp[16];
+    size_t n = (size_t)(e - s);
+    if (n >= sizeof tmp) { *out = 0; return 1; } /* long junk -> false, like python */
+    for (size_t i = 0; i < n; i++) {
+        char c = s[i];
+        tmp[i] = (char)(c >= 'A' && c <= 'Z' ? c + 32 : c);
+    }
+    tmp[n] = 0;
+    *out = (strcmp(tmp, "true") == 0 || strcmp(tmp, "t") == 0 ||
+            strcmp(tmp, "yes") == 0 || strcmp(tmp, "y") == 0 ||
+            strcmp(tmp, "1") == 0);
+    return 1;
+}
+
+/* Parse the buffer into pre-allocated per-column arrays (each sized for
+ * csv_count_records rows). Returns rows parsed, or -(1-based row) on a
+ * malformed numeric cell (caller falls back to the Python path for the
+ * precise error).  All output pointer arrays are length ncols; entries for
+ * columns whose type doesn't use them may be NULL. */
+int64_t csv_parse_typed(const char *buf, int64_t len, int32_t skip_header,
+                        int32_t ncols, const int32_t *coltypes,
+                        double **dcols, int64_t **icols, uint8_t **bcols,
+                        uint8_t **masks,
+                        int64_t **toffs, int32_t **tlens,
+                        int64_t max_rows) {
+    int64_t i = 0, row = 0;
+    int32_t col = 0;
+    if (skip_header) { /* skip one (quote-aware) record */
+        int inq = 0;
+        for (; i < len; i++) {
+            char c = buf[i];
+            if (inq) {
+                if (c == '"') {
+                    if (i + 1 < len && buf[i + 1] == '"') i++;
+                    else inq = 0;
+                }
+            } else if (c == '"') inq = 1;
+            else if (c == '\n') { i++; break; }
+        }
+    }
+    while (i <= len && row < max_rows) {
+        if (i == len) {
+            if (col == 0) break; /* clean EOF at record boundary */
+        }
+        /* parse one field starting at i */
+        int64_t fs, fe;   /* content span */
+        int esc = 0;      /* saw "" escape (text needs unescaping) */
+        int quoted = 0;
+        if (i < len && buf[i] == '"') {
+            quoted = 1;
+            i++;
+            fs = i;
+            for (; i < len; i++) {
+                if (buf[i] == '"') {
+                    if (i + 1 < len && buf[i + 1] == '"') { esc = 1; i++; }
+                    else break;
+                }
+            }
+            fe = i;
+            if (i < len) i++; /* closing quote */
+            /* python csv APPENDS text after a closing quote to the cell
+             * ('"ab"cd' -> 'abcd'); that can't be expressed as a buffer span,
+             * so any such junk (beyond a bare \r) falls back to the slow path */
+            while (i < len && buf[i] != ',' && buf[i] != '\n') {
+                if (buf[i] != '\r') return -(row + 1);
+                i++;
+            }
+        } else {
+            fs = i;
+            while (i < len && buf[i] != ',' && buf[i] != '\n') i++;
+            fe = i;
+        }
+        int at_end = (i >= len) || (buf[i] == '\n');
+        /* blank line (only possible as a lone empty unquoted first field):
+         * python csv skips it entirely — emit no row */
+        if (at_end && col == 0 && !quoted) {
+            int64_t be = fe;
+            while (be > fs && buf[be - 1] == '\r') be--;
+            if (be == fs) { /* truly empty (modulo \r) — not whitespace */
+                if (i >= len) break;
+                i++; /* consume '\n' */
+                continue;
+            }
+        }
+        if (col < ncols) {
+            int32_t t = coltypes[col];
+            const char *s = buf + fs, *e = buf + fe;
+            int r = 0;
+            switch (t) {
+            case CT_REAL:
+                r = parse_real(s, e, &dcols[col][row]);
+                break;
+            case CT_INT:
+                r = parse_int(s, e, &icols[col][row]);
+                break;
+            case CT_BOOL:
+                r = parse_bool(s, e, &bcols[col][row]);
+                break;
+            case CT_TEXT: {
+                const char *ts = s, *te = e;
+                if (!esc) { /* python csv keeps inner spaces; only strip \r */
+                    while (te > ts && te[-1] == '\r') te--;
+                }
+                toffs[col][row] = ts - buf;
+                int32_t l = (int32_t)(te - ts);
+                /* encoding: len > 0 plain; len == -1 null (empty); len <= -2
+                 * escaped ("" inside), true length = -len - 2 */
+                tlens[col][row] = (l == 0) ? -1 : (esc ? -l - 2 : l);
+                r = 1;
+                break;
+            }
+            default:
+                r = 1;
+                break;
+            }
+            if (r < 0) return -(row + 1);
+            if (t == CT_REAL || t == CT_INT || t == CT_BOOL)
+                masks[col][row] = (uint8_t)(r == 1);
+        }
+        col++;
+        if (at_end) {
+            /* null-fill missing trailing columns */
+            for (; col < ncols; col++) {
+                int32_t t = coltypes[col];
+                if (t == CT_REAL || t == CT_INT || t == CT_BOOL)
+                    masks[col][row] = 0;
+                else if (t == CT_TEXT)
+                    tlens[col][row] = -1;
+            }
+            row++;
+            col = 0;
+            if (i >= len) break;
+            i++; /* consume '\n' */
+            if (i >= len) break;
+        } else {
+            i++; /* consume ',' */
+        }
+    }
+    return row;
+}

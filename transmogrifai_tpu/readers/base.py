@@ -42,9 +42,11 @@ class DataReader:
             self._records_cache = cache
         return cache
 
-    def read_columnar(self) -> Optional[dict[str, np.ndarray]]:
-        """Columnar fast path: name -> numpy array (object arrays allowed). Return None
-        if only record-wise reading is available."""
+    def read_columnar(self) -> Optional[dict[str, Any]]:
+        """Columnar fast path: name -> numpy array (object arrays allowed) or an
+        already-built Column (native readers construct typed Columns directly, with
+        no Python-object round trip). Return None if only record-wise reading is
+        available."""
         return None
 
     # --- main entry (analog of DataReader.generateDataFrame) --------------------------
@@ -70,7 +72,15 @@ class DataReader:
                     )
                 data = columnar[name]
                 n = len(data) if n is None else n
-                cols[name] = Column.build(f.kind, _np_to_values(data))
+                if isinstance(data, Column):
+                    if data.kind is not f.kind:
+                        raise TypeError(
+                            f"reader built {name!r} as {data.kind.name}, feature "
+                            f"declares {f.kind.name}"
+                        )
+                    cols[name] = data
+                else:
+                    cols[name] = Column.build(f.kind, _np_to_values(data))
             return Table(cols, n)
         records = self.cached_records()
         cols = {}

@@ -131,7 +131,10 @@ class CSVReader(DataReader):
                 names = self.field_names
                 if names is None:
                     raise ValueError("headerless CSV requires field_names")
-                rows = [dict(zip(names, rec)) for rec in islice(_csv.reader(fh), limit)]
+                # `if rec` skips blank lines, matching DictReader (and the native
+                # tokenizer) — a blank line is no record, not an all-null row
+                rows = [dict(zip(names, rec))
+                        for rec in islice(_csv.reader(fh), limit) if rec]
         return rows
 
     def read_records(self) -> list[dict]:
@@ -142,15 +145,92 @@ class CSVReader(DataReader):
             ]
         return self._cache
 
-    def read_columnar(self) -> dict[str, np.ndarray]:
-        records = self.read_records()
-        out = {}
-        for name in self.schema:
-            arr = np.empty(len(records), dtype=object)
-            for i, r in enumerate(records):
-                arr[i] = r[name]
-            out[name] = arr
+    #: storage -> csvtok.c column type code (anything else falls back to Python)
+    _NATIVE_STORAGE = {"real": 1, "integral": 2, "date": 2, "binary": 3, "text": 4}
+
+    def read_columnar(self) -> Optional[dict[str, np.ndarray]]:
+        """Native (C) fast path: tokenize + type-parse the whole file in one pass
+        (native/csvtok.c); numeric columns never become Python objects until the
+        final Column build. Falls back to the record path (None) whenever the
+        schema, file, or a malformed cell needs the Python parser's semantics."""
+        from ..native import CT_SKIP, parse_csv_typed
+
+        try:
+            with open(self.path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return None
+        if self.has_header:
+            head_end = data.find(b"\n")
+            if head_end < 0:
+                return None
+            try:
+                names = next(_csv.reader([data[:head_end].decode("utf-8").rstrip("\r")]))
+            except (StopIteration, UnicodeDecodeError, _csv.Error):
+                return None
+        else:
+            names = self.field_names
+            if names is None:
+                return None
+        if not set(self.schema) <= set(names):
+            return None  # missing columns: record path gives them all-null
+        coltypes = []
+        for nm in names:
+            kind = self.schema.get(nm)
+            if kind is None:
+                coltypes.append(CT_SKIP)
+                continue
+            ct = self._NATIVE_STORAGE.get(kind.storage.value)
+            if ct is None:
+                return None  # non-flat kind: python parser semantics required
+            coltypes.append(ct)
+        parsed = parse_csv_typed(data, coltypes, self.has_header)
+        if parsed is None:
+            return None
+        from ..types import Column
+
+        out: dict[str, Column] = {}
+        for nm, entry in zip(names, parsed):
+            if entry is None:
+                continue
+            kind = self.schema[nm]
+            what, a, b = entry
+            if what in ("real", "int", "bool"):
+                mask = b.astype(bool)
+                if not kind.nullable and not mask.all():
+                    missing = int((~mask).sum())  # same error Column.build raises
+                    raise ValueError(
+                        f"{kind.name} is non-nullable but {missing} of {len(mask)} "
+                        "values are missing"
+                    )
+                if what == "real":
+                    import jax.numpy as jnp
+
+                    v = a.astype(np.float32)
+                    v[~mask] = np.nan
+                    out[nm] = Column(kind, jnp.asarray(v), jnp.asarray(mask))
+                elif what == "int":
+                    out[nm] = Column(kind, a, mask)  # host-exact int64
+                else:
+                    import jax.numpy as jnp
+
+                    out[nm] = Column(kind, jnp.asarray(a.astype(bool)),
+                                     jnp.asarray(mask))
+            else:  # text: decode only the cells that exist
+                vals = np.empty(len(a), object)
+                offs = a.tolist()
+                lens = b.tolist()
+                for i, (o, ln) in enumerate(zip(offs, lens)):
+                    if ln == -1:
+                        vals[i] = None
+                    elif ln >= 0:
+                        vals[i] = data[o:o + ln].decode("utf-8", "replace")
+                    else:  # "" escapes inside: true length is -ln - 2
+                        vals[i] = (data[o:o - ln - 2].decode("utf-8", "replace")
+                                   .replace('""', '"'))
+                out[nm] = Column(kind, vals, None)
         return out
+
 
 
 class CSVAutoReader(CSVReader):
