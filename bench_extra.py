@@ -97,7 +97,7 @@ def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
     import jax.numpy as jnp
 
     from transmogrifai_tpu.ops.pallas_hist import histogram_pallas, use_pallas_histogram
-    from transmogrifai_tpu.ops.trees import histogram_segment_sum
+    from transmogrifai_tpu.ops.trees import histogram_binmm, histogram_segment_sum
 
     key = jax.random.PRNGKey(0)
     k1, k2, k3 = jax.random.split(key, 3)
@@ -116,9 +116,14 @@ def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
 
     seg_fn = jax.jit(histogram_segment_sum, static_argnums=(3, 4))
     seg_t, seg_out = timed(seg_fn)
+    bin_fn = jax.jit(histogram_binmm, static_argnums=(3, 4))
+    bin_t, bin_out = timed(bin_fn)
     result = {
         "rows": n_rows, "features": n_feat, "bins": n_bins, "nodes": n_nodes,
         "segment_sum_ms": round(seg_t * 1e3, 3),
+        "binmm_ms": round(bin_t * 1e3, 3),  # the default _histogram path
+        "binmm_speedup_vs_segsum": round(seg_t / bin_t, 2),
+        "binmm_max_abs_diff": float(np.max(np.abs(seg_out - bin_out))),
         "pallas_available": bool(use_pallas_histogram()),
     }
     if use_pallas_histogram():

@@ -1,0 +1,15 @@
+"""Shared backend probe for kernel-path selection."""
+from __future__ import annotations
+
+import functools
+
+
+@functools.cache
+def backend_is_tpu() -> bool:
+    """True when the default jax backend is a TPU (cached; False on init failure)."""
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False

@@ -7,15 +7,20 @@ the reference's MLlib/xgboost4j trainers — SURVEY §2.11d/2.12). The jnp fallb
 serialize on TPU.
 
 This kernel reformulates the scatter as dense matmuls, which is what the MXU is for:
-for one feature d, one segment tile, and a block of rows, build the one-hot membership
-matrix M[r, s] = [node(r) * n_bins + bin(r, d) == s] in VMEM and accumulate
-out[d, :, s_tile] += GH^T @ M — the segment axis rides the MXU lanes (the channel
-count is tiny, so the transposed orientation is what keeps the MXU wide). Row blocks
-stream sequentially and accumulate ("arbitrary" grid dim); features and segment tiles
-are independent ("parallel"). Deep trees (many nodes) grow the segment axis, so it is
-tiled at SEG_TILE lanes to bound VMEM: per-cell budget is Bn*D bins + Bn*SEG_TILE
-one-hot + C*SEG_TILE out ~= 4.5 MB at Bn=512, D<=1024 — inside the ~16 MB/core budget
-(pallas_guide.md: Memory Spaces).
+for each feature d in a cell's feature tile, one segment tile, and a block of rows,
+build the one-hot membership matrix M[r, s] = [node(r) * n_bins + bin(r, d) == s] in
+VMEM and accumulate out[d, :, s_tile] += GH^T @ M — the segment axis rides the MXU
+lanes (the channel count is tiny, so the transposed orientation is what keeps the
+MXU wide). Row blocks stream sequentially and accumulate ("arbitrary" grid dim);
+feature tiles and segment tiles are independent ("parallel"). Deep trees (many
+nodes) grow the segment axis, so it is tiled at SEG_TILE lanes to bound VMEM.
+
+NOTE: this kernel is retained as a comparison baseline and optional path
+(TT_HIST=pallas); the production default on TPU is ops/trees.histogram_binmm,
+whose bin-wise dense-matmul decomposition avoids materializing the [Bn, S]
+one-hot entirely and measures 3-13x faster (bench_extra.run_hist) — the rare
+case where plain XLA beats the hand-written kernel because the better algorithm
+is expressible as matmuls XLA already schedules well.
 """
 from __future__ import annotations
 
@@ -33,45 +38,46 @@ SEG_TILE = 2048
 
 @functools.cache
 def use_pallas_histogram() -> bool:
-    """Pallas path on by default on TPU backends; force with TT_PALLAS_HIST=0/1."""
+    """Whether the pallas kernel is RUNNABLE here (TPU backend; TT_PALLAS_HIST=0/1
+    overrides). Note this gates availability only — the live training histogram
+    is selected by TT_HIST in ops/trees._histogram (default: binmm on TPU, which
+    measures faster than this kernel; pallas stays as a comparison baseline)."""
     env = os.environ.get("TT_PALLAS_HIST")
     if env is not None:
         return env == "1"
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover - backend init failure
-        return False
+    from .backend import backend_is_tpu
+
+    return backend_is_tpu()
 
 
-def _hist_kernel(xb_ref, node_ref, gh_ref, out_ref, *, n_bins: int, seg_tile: int):
-    """One (feature, segment-tile, row-block) cell: out[d, :, tile] += gh^T @ onehot.
-
-    The whole [Bn, D] bin block is resident (TPU blocks can't slice the lane dim
-    below 128); this cell's feature column is picked with an iota mask + row-sum —
-    a VPU select, far cheaper than the matmul it feeds."""
-    d = pl.program_id(0)
+def _hist_kernel_ftile(xb_ref, node_ref, gh_ref, out_ref, *, n_bins: int,
+                       seg_tile: int, f_tile: int):
+    """One (feature-tile, segment-tile, row-block) cell: for each of the f_tile
+    features resident in this cell's [Bn, f_tile] bin block, accumulate
+    out[j, :, tile] += gh^T @ onehot. Unlike the one-feature-per-cell layout,
+    each cell loads only its feature slice (HBM traffic O(N*D) total instead of
+    O(N*D*D/f_tile)) and the per-feature lane-select scans f_tile lanes, not D."""
     s = pl.program_id(1)
-    col = jax.lax.broadcasted_iota(jnp.int32, xb_ref.shape, 1) == d
-    xb_d = jnp.sum(jnp.where(col, xb_ref[:, :], 0), axis=1)            # [Bn]
-    keys = node_ref[:, 0] * n_bins + xb_d - s * seg_tile               # [Bn], tile-local
-    seg = jax.lax.broadcasted_iota(jnp.int32, (keys.shape[0], seg_tile), 1)
-    onehot = (keys[:, None] == seg).astype(jnp.float32)                # [Bn, S_T]
-    # gh^T @ onehot -> [C, S_T]: lanes = segments keeps the MXU wide (C is tiny);
-    # HIGHEST precision = true f32 accumulation, comparable to the scatter path
-    acc = jax.lax.dot_general(
-        gh_ref[:, :], onehot,
-        (((0,), (0,)), ((), ())),                                      # contract rows
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                                  # [C, S_T]
+    first_rows = pl.program_id(2) == 0
+    base = node_ref[:, 0] * n_bins - s * seg_tile  # [Bn], tile-local
+    seg = jax.lax.broadcasted_iota(jnp.int32, (base.shape[0], seg_tile), 1)
+    gh = gh_ref[:, :]
+    xb = xb_ref[:, :]
 
-    @pl.when(pl.program_id(2) == 0)
-    def _init():
-        out_ref[0, :, :] = acc
+    def body(j, _):
+        col = jax.lax.broadcasted_iota(jnp.int32, xb.shape, 1) == j
+        xb_j = jnp.sum(jnp.where(col, xb, 0), axis=1)  # [Bn]
+        onehot = ((base + xb_j)[:, None] == seg).astype(jnp.float32)
+        acc = jax.lax.dot_general(
+            gh, onehot, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )[None]  # [1, C, S_T]
+        prev = out_ref[pl.ds(j, 1), :, :]
+        out_ref[pl.ds(j, 1), :, :] = jnp.where(first_rows, acc, prev + acc)
+        return 0
 
-    @pl.when(pl.program_id(2) > 0)
-    def _accum():
-        out_ref[0, :, :] += acc
+    jax.lax.fori_loop(0, f_tile, body, 0)
 
 
 def histogram_pallas(
@@ -97,26 +103,30 @@ def histogram_pallas(
     s_pad = n_seg_tiles * seg_tile
     n_blocks = max((N + block_rows - 1) // block_rows, 1)
     pad = n_blocks * block_rows - N
+    f_tile = min(D, 128)  # lane-granule feature tile
+    f_pad = (-D) % f_tile
     vals_p = jnp.pad(jnp.asarray(vals, jnp.float32), ((0, pad), (0, 0)))
-    Xb_p = jnp.pad(Xb.astype(jnp.int32), ((0, pad), (0, 0)))
+    Xb_p = jnp.pad(Xb.astype(jnp.int32), ((0, pad), (0, f_pad)))
     # padded rows get key -1 (node -1): matches no segment lane in any tile
     node_p = jnp.pad(node.astype(jnp.int32)[:, None], ((0, pad), (0, 0)),
                      constant_values=-1)
+    Dp = D + f_pad
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_bins=n_bins, seg_tile=seg_tile),
-        grid=(D, n_seg_tiles, n_blocks),
+        functools.partial(_hist_kernel_ftile, n_bins=n_bins, seg_tile=seg_tile,
+                          f_tile=f_tile),
+        grid=(Dp // f_tile, n_seg_tiles, n_blocks),
         in_specs=[
-            pl.BlockSpec((block_rows, D), lambda d, s, r: (r, 0)),  # all features' bins
-            pl.BlockSpec((block_rows, 1), lambda d, s, r: (r, 0)),  # row -> node id
-            pl.BlockSpec((block_rows, C), lambda d, s, r: (r, 0)),  # gradient/hessian
+            pl.BlockSpec((block_rows, f_tile), lambda f, s, r: (r, f)),  # bin slice
+            pl.BlockSpec((block_rows, 1), lambda f, s, r: (r, 0)),  # row -> node id
+            pl.BlockSpec((block_rows, C), lambda f, s, r: (r, 0)),  # gradient/hessian
         ],
-        out_specs=pl.BlockSpec((1, C, seg_tile), lambda d, s, r: (d, 0, s)),
-        out_shape=jax.ShapeDtypeStruct((D, C, s_pad), jnp.float32),
+        out_specs=pl.BlockSpec((f_tile, C, seg_tile), lambda f, s, r: (f, 0, s)),
+        out_shape=jax.ShapeDtypeStruct((Dp, C, s_pad), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(Xb_p, node_p, vals_p)
-    # [D, C, S] -> [n_nodes, D, n_bins, C] (trees.py layout)
-    return out[:, :, :S].reshape(D, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
+    # [Dp, C, S] -> [n_nodes, D, n_bins, C] (trees.py layout)
+    return out[:D, :, :S].reshape(D, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
