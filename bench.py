@@ -157,12 +157,13 @@ def main() -> None:
         detail["wide"] = run_wide()
     if os.environ.get("BENCH_EXTRA", "1") != "0":
         # BASELINE.json configs 2/3/5 + the pallas histogram kernel evidence
-        from bench_extra import run_boston, run_hist, run_iris, run_mlp
+        from bench_extra import run_boston, run_hist, run_iris, run_mlp, run_trees
 
         detail["iris"] = run_iris()
         detail["boston"] = run_boston()
         detail["hist_kernel"] = run_hist()
         detail["mlp_deep_tabular"] = run_mlp()
+        detail["gbt_scale"] = run_trees()
 
     print(json.dumps({
         "metric": "titanic_automl_models_evaluated_per_sec",

@@ -86,7 +86,11 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     beats CPU dense matmuls; binmm parity has its own test). TT_HIST=
     binmm|pallas|segsum forces a specific path. All paths are pure
     collectives-safe jnp: partial histograms psum across a row-sharded mesh axis
-    (the RDD treeAggregate replacement, SURVEY §2.12)."""
+    (the RDD treeAggregate replacement, SURVEY §2.12).
+
+    NOTE: the mode is read at TRACE time — jit caches bake the chosen path per
+    shape, so set TT_HIST before the first fit of a process (changing it later
+    only affects not-yet-compiled shapes)."""
     mode = os.environ.get("TT_HIST")
     if mode is None:
         mode = "binmm" if backend_is_tpu() else "segsum"
