@@ -206,8 +206,9 @@ class CSVReader(DataReader):
                 if what == "real":
                     import jax.numpy as jnp
 
-                    v = a.astype(np.float32)
-                    v[~mask] = np.nan
+                    # mask BEFORE the f32 cast: unparsed cells hold uninitialized
+                    # doubles (np.empty) that would warn/overflow in the cast
+                    v = np.where(mask, a, np.nan).astype(np.float32)
                     out[nm] = Column(kind, jnp.asarray(v), jnp.asarray(mask))
                 elif what == "int":
                     out[nm] = Column(kind, a, mask)  # host-exact int64

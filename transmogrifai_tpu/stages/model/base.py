@@ -111,6 +111,10 @@ class PredictionModel(Transformer):
 
     arity = (2, 2)
     device_op = True
+    #: predict() dispatches to a module-level jitted kernel with params as
+    #: arguments — the workflow plan calls it directly instead of fusing it into
+    #: an outer jit (which would bake params as constants and retrace per train)
+    kernel_jitted = True
 
     def out_kind(self, in_kinds):
         return kind_of("Prediction")
@@ -121,6 +125,15 @@ class PredictionModel(Transformer):
     def predict(self, X):
         """-> (pred [N], raw [N,C], prob [N,C]) in pure jnp."""
         raise NotImplementedError
+
+    def device_params(self, convert):
+        """`convert(self.params)` memoized per model instance: predict() runs
+        OUTSIDE the fused jit (kernel_jitted), so without caching every scoring
+        call would re-pay list->device-array conversion of the fitted weights."""
+        cached = self.__dict__.get("_device_params_cache")
+        if cached is None:
+            cached = self.__dict__["_device_params_cache"] = convert(self.params)
+        return cached
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         X = jnp.asarray(cols[1].values, jnp.float32)

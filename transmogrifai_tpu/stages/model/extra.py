@@ -59,14 +59,13 @@ class NaiveBayesModel(PredictionModel):
     operation_name = "naiveBayes"
 
     def predict(self, X):
-        p = self.params
-        params = NaiveBayesParams(
+        params = self.device_params(lambda p: NaiveBayesParams(
             jnp.asarray(p["log_prior"], jnp.float32),
             jnp.asarray(p["log_theta"], jnp.float32),
             jnp.asarray(p["mean"], jnp.float32),
             jnp.asarray(p["var"], jnp.float32),
-        )
-        return predict_naive_bayes(params, X, model_type=p["model_type"])
+        ))
+        return predict_naive_bayes(params, X, model_type=self.params["model_type"])
 
 
 @register_stage
@@ -102,10 +101,10 @@ class MLPClassifierModel(PredictionModel):
     operation_name = "mlpClassifier"
 
     def predict(self, X):
-        params = [
+        params = self.device_params(lambda p: [
             (jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
-            for W, b in self.params["layers"]
-        ]
+            for W, b in p["layers"]
+        ])
         return predict_mlp(params, X)
 
 
@@ -139,8 +138,8 @@ class GeneralizedLinearRegressionModel(PredictionModel):
     operation_name = "glm"
 
     def predict(self, X):
-        params = LinearParams(jnp.asarray(self.params["w"], jnp.float32),
-                              jnp.asarray(self.params["b"], jnp.float32))
+        params = self.device_params(lambda p: LinearParams(
+            jnp.asarray(p["w"], jnp.float32), jnp.asarray(p["b"], jnp.float32)))
         return predict_glm(params, X, family=self.params["family"])
 
 

@@ -72,7 +72,7 @@ class LogisticRegressionModel(PredictionModel):
     operation_name = "logReg"
 
     def predict(self, X):
-        return predict_logistic(_linear_params(self.params), X)
+        return predict_logistic(self.device_params(_linear_params), X)
 
 
 @register_stage
@@ -109,7 +109,7 @@ class MultinomialLogisticRegressionModel(PredictionModel):
     operation_name = "mnLogReg"
 
     def predict(self, X):
-        return predict_multinomial(_linear_params(self.params), X)
+        return predict_multinomial(self.device_params(_linear_params), X)
 
 
 @register_stage
@@ -146,7 +146,7 @@ class LinearRegressionModel(PredictionModel):
     operation_name = "linReg"
 
     def predict(self, X):
-        return predict_linear(_linear_params(self.params), X)
+        return predict_linear(self.device_params(_linear_params), X)
 
 
 @register_stage
@@ -170,4 +170,4 @@ class LinearSVCModel(PredictionModel):
     operation_name = "svc"
 
     def predict(self, X):
-        return predict_svc(_linear_params(self.params), X)
+        return predict_svc(self.device_params(_linear_params), X)

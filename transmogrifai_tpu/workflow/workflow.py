@@ -56,7 +56,15 @@ class _CompiledPlan:
     def __init__(self, stages_in_order: Sequence[Transformer]):
         self.groups: list[tuple[str, list[Transformer]]] = []
         for s in stages_in_order:
-            kind = "device" if s.device_op else "host"
+            # kernel_jitted stages (fitted models) dispatch to module-level
+            # jitted kernels taking params as ARGUMENTS — calling them directly
+            # hits one shared jit cache across every train/model of the same
+            # shapes. Wrapping them in the fused outer jit would bake this
+            # model's params in as constants and retrace per train (measured
+            # ~1.7s of pure retrace per Titanic train). Fusion still applies to
+            # runs of small elementwise vectorizer stages, where it pays.
+            kind = ("device" if s.device_op
+                    and not getattr(s, "kernel_jitted", False) else "host")
             if self.groups and self.groups[-1][0] == kind == "device":
                 self.groups[-1][1].append(s)
             else:
