@@ -2,6 +2,7 @@ from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     make_mesh,
+    make_multislice_mesh,
     shard_batch,
     shard_grid,
     shard_wide,
@@ -14,6 +15,7 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",
+    "make_multislice_mesh",
     "shard_batch",
     "shard_grid",
     "shard_wide",

@@ -42,6 +42,58 @@ def make_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def make_multislice_mesh(
+    n_model: int = 1,
+    devices: Optional[Sequence] = None,
+    slice_assignments: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """(data x model) mesh spanning multiple slices/hosts (the pod-scale form of
+    SURVEY §5.8): each slice's devices are laid CONTIGUOUSLY along the data axis,
+    and the model/tuning axis pairs devices within one slice. Reductions over
+    DATA_AXIS (gradient psums, moment/histogram combines) are associative, so
+    XLA's hierarchical collectives do the heavy segment over ICI inside each
+    slice and only the tiny per-slice partials cross DCN — the layout, not
+    hand-written comms, is the whole multi-host story. Cross-slice traffic on
+    MODEL_AXIS never occurs with this layout.
+
+    Slice membership comes from each device's `slice_index` (TPU multi-slice) or
+    `process_index` (multi-host CPU/GPU); `slice_assignments` overrides it (one
+    slice id per device — how tests fake a 2-slice topology on 8 CPU devices).
+    Falls back to `make_mesh` when only one slice is visible."""
+    devices = list(devices if devices is not None else jax.devices())
+    if slice_assignments is None:
+        def _slice_of(d):
+            si = getattr(d, "slice_index", None)  # slice 0 is falsy but VALID
+            return si if si is not None else d.process_index
+
+        slice_assignments = [_slice_of(d) for d in devices]
+    if len(slice_assignments) != len(devices):
+        raise ValueError(
+            f"{len(slice_assignments)} slice assignments for {len(devices)} devices"
+        )
+    groups: dict = {}
+    for d, sl in zip(devices, slice_assignments):
+        groups.setdefault(sl, []).append(d)
+    if len(groups) <= 1:
+        return make_mesh(n_model=n_model, devices=devices)
+    sizes = {sl: len(g) for sl, g in groups.items()}
+    if len(set(sizes.values())) != 1:
+        # a mesh must be rectangular; silently trimming the bigger slice would
+        # train on less hardware than provisioned
+        raise ValueError(f"slices are uneven ({sizes}); pass explicit devices")
+    per = next(iter(sizes.values()))
+    if per % n_model != 0:
+        raise ValueError(
+            f"n_model={n_model} must divide the {per} devices of each slice, or "
+            "the tuning axis would pair devices across DCN"
+        )
+    ordered = [
+        d for sl in sorted(groups) for d in sorted(groups[sl], key=lambda x: x.id)
+    ]
+    arr = np.array(ordered).reshape(-1, n_model)  # slice-contiguous data axis
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
 def shard_batch(mesh: Mesh, arr, batch_dim: int = 0):
     """Place an array with its batch dim sharded over DATA_AXIS (rows across chips)."""
     spec = [None] * np.ndim(arr)
