@@ -75,6 +75,11 @@ def make_multislice_mesh(
     for d, sl in zip(devices, slice_assignments):
         groups.setdefault(sl, []).append(d)
     if len(groups) <= 1:
+        if len(devices) % n_model != 0:
+            # same contract as the multi-slice path: never silently shrink
+            raise ValueError(
+                f"n_model={n_model} must divide the {len(devices)} devices"
+            )
         return make_mesh(n_model=n_model, devices=devices)
     sizes = {sl: len(g) for sl, g in groups.items()}
     if len(set(sizes.values())) != 1:

@@ -41,14 +41,18 @@ class RealVectorizer(SequenceVectorizerEstimator):
         super().__init__(fill_value=fill_value, track_nulls=track_nulls)
 
     def fit_columns(self, cols: Sequence[Column]):
-        fills = []
-        for c in cols:
-            if self.params["fill_value"] == "mean":
-                m = c.effective_mask()
-                denom = jnp.maximum(jnp.asarray(m).sum(), 1)
-                fills.append(float((c.filled(0.0) * m).sum() / denom))
-            else:
-                fills.append(float(self.params["fill_value"]))
+        if self.params["fill_value"] == "mean":
+            # ONE stacked device reduction + ONE host fetch for every column:
+            # a per-column float() would pay a full device round trip each (the
+            # dominant cost of this fit on a tunneled device)
+            masks = [jnp.asarray(c.effective_mask()) for c in cols]
+            means = jnp.stack([
+                (c.filled(0.0) * m).sum() / jnp.maximum(m.sum(), 1)
+                for c, m in zip(cols, masks)
+            ])
+            fills = [float(v) for v in np.asarray(means)]
+        else:
+            fills = [float(self.params["fill_value"])] * len(cols)
         return RealVectorizerModel(
             fills=fills,
             track_nulls=self.params["track_nulls"],
@@ -177,8 +181,11 @@ class FillMissingWithMean(Estimator):
     def fit_columns(self, cols: Sequence[Column]):
         c = cols[0]
         m = jnp.asarray(c.effective_mask())
-        n = jnp.asarray(m).sum()
-        mean = float((c.filled(0.0) * m).sum() / jnp.maximum(n, 1)) if int(n) else self.params["default"]
+        # one fetch for (count, mean) together, not two device round trips
+        n_host, mean_host = np.asarray(
+            jnp.stack([m.sum(), (c.filled(0.0) * m).sum() / jnp.maximum(m.sum(), 1)])
+        )
+        mean = float(mean_host) if n_host else self.params["default"]
         return FillMissingWithMeanModel(mean=mean)
 
 
