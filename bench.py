@@ -87,7 +87,9 @@ def _models():
 
 
 def _build():
-    """Fresh graph per train (stages are single-wire): the OpTitanicSimple pipeline."""
+    """Fresh graph per train (stages are single-wire): the OpTitanicSimple pipeline —
+    transmogrify -> sanityCheck(removeBadFeatures) -> selector, matching the
+    reference walkthrough flow."""
     from transmogrifai_tpu.graph import features_from_schema
     from transmogrifai_tpu.select import BinaryClassificationModelSelector
     from transmogrifai_tpu.stages.feature import transmogrify
@@ -96,10 +98,11 @@ def _build():
     fs = features_from_schema({"id": "ID", **SCHEMA}, response="survived")
     predictors = [f for n, f in fs.items() if n not in ("id", "survived")]
     vector = transmogrify(predictors)
+    checked = vector.sanity_check(fs["survived"], remove_bad_features=True)
     selector = BinaryClassificationModelSelector.with_cross_validation(
         num_folds=3, validation_metric="AuPR", models=_models()
     )
-    pred = selector(fs["survived"], vector)
+    pred = selector(fs["survived"], checked)
     wf = Workflow().set_result_features(pred)
     return wf, selector, pred, fs
 

@@ -40,10 +40,14 @@ def make_runner(data_path: str = DATA) -> WorkflowRunner:
     family_size = fs["sibSp"] + fs["parCh"] + 1.0
     predictors = [f for n, f in fs.items() if n not in ("id", "survived")]
     vector = transmogrify(predictors + [family_size])
+    # the reference walkthrough sanity-checks the vector against the label and
+    # drops offenders before selection (OpTitanicSimple.scala: sanityCheck with
+    # removeBadFeatures = true)
+    checked = vector.sanity_check(fs["survived"], remove_bad_features=True)
     selector = BinaryClassificationModelSelector.with_cross_validation(
         num_folds=3, validation_metric="AuPR"
     )
-    prediction = selector(fs["survived"], vector)
+    prediction = selector(fs["survived"], checked)
     reader = CSVReader(data_path, SCHEMA, has_header=False, field_names=FIELDS)
     return WorkflowRunner(
         Workflow().set_result_features(prediction),

@@ -23,14 +23,39 @@ import numpy as np
 from ..ops.stats import (
     column_stats,
     contingency_table,
-    cramers_v,
     pearson_with_label,
-    rule_confidence,
     spearman_with_label,
 )
 from ..stages.base import Estimator, Transformer, register_stage
 from ..types import Column, kind_of
 from ..types.vector_schema import SlotInfo, VectorSchema
+
+_EPS = 1e-12
+
+
+def _cramers_v_np(t: np.ndarray) -> float:
+    """numpy mirror of ops.stats.cramers_v (host math on a small [K, C] table —
+    per-group device dispatches here were the SanityChecker's dominant cost)."""
+    t = np.asarray(t, np.float64)
+    n = t.sum() + _EPS
+    rows = t.sum(1, keepdims=True)
+    cols = t.sum(0, keepdims=True)
+    expected = rows @ cols / n
+    chi2 = np.where(expected > _EPS,
+                    (t - expected) ** 2 / np.clip(expected, _EPS, None), 0.0).sum()
+    k = min((rows[:, 0] > 0).sum(), (cols[0] > 0).sum())
+    dof = max(k - 1.0, 1e-6)
+    return float(np.sqrt(chi2 / (n * dof)))
+
+
+def _rule_confidence_np(t: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """numpy mirror of ops.stats.rule_confidence."""
+    t = np.asarray(t, np.float64)
+    n = t.sum() + _EPS
+    row = t.sum(1)
+    conf = np.where(row[:, None] > _EPS,
+                    t / np.clip(row[:, None], _EPS, None), 0.0).max(1)
+    return conf, row / n
 
 
 @dataclass
@@ -165,17 +190,28 @@ class SanityChecker(Estimator):
         groups = schema.groups()
         if label_is_categorical:
             lab_oh = (ys[:, None] == uniq[None, :]).astype(np.float32)
-            for key, idxs in groups.items():
-                # contingency stats are defined over 0/1 indicator slots only — a
-                # group can also carry continuous slots (e.g. a numeric value next
-                # to its null indicator), which must not enter the table
-                idxs = [i for i in idxs if schema[i].indicator_value is not None]
-                if not idxs:
-                    continue
-                ind = jnp.asarray(Xs[:, idxs])
-                table = contingency_table(ind, jnp.asarray(lab_oh))
-                cv = float(cramers_v(table))
-                conf, support = rule_confidence(table)
+            # contingency stats are defined over 0/1 indicator slots only — a
+            # group can also carry continuous slots (e.g. a numeric value next
+            # to its null indicator), which must not enter the table. ALL groups'
+            # tables come from ONE device matmul (their rows are disjoint slot
+            # sets); per-group Cramér's V / rule stats are then O(K*C) numpy —
+            # the previous per-group device loop paid 2-3 dispatches + scalar
+            # fetches per group, a multi-second sync storm on a tunneled device.
+            ind_groups = [
+                (key, [i for i in idxs if schema[i].indicator_value is not None])
+                for key, idxs in groups.items()
+            ]
+            ind_groups = [(key, idxs) for key, idxs in ind_groups if idxs]
+            flat_idx = [i for _, idxs in ind_groups for i in idxs]
+            if flat_idx:
+                all_tables = np.asarray(contingency_table(
+                    jnp.asarray(Xs[:, flat_idx]), jnp.asarray(lab_oh)))
+            pos = 0
+            for key, idxs in ind_groups:
+                table = all_tables[pos:pos + len(idxs)]
+                pos += len(idxs)
+                cv = _cramers_v_np(table)
+                conf, support = _rule_confidence_np(table)
                 group_cv[key] = cv
                 for j, i in enumerate(idxs):
                     slot_conf[i] = float(conf[j])

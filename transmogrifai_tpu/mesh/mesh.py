@@ -33,11 +33,24 @@ def make_mesh(
     n_model: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a (data x model) mesh over the available devices."""
+    """Build a (data x model) mesh over the available devices. An explicit
+    `n_data` requests exactly n_data*n_model devices (extras intentionally
+    unused); with n_data inferred, n_model must divide the device count —
+    silently training on fewer devices than visible is never the default."""
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
+        if len(devices) % n_model != 0:
+            raise ValueError(
+                f"n_model={n_model} must divide the {len(devices)} devices "
+                "(or pass n_data explicitly to use a subset)"
+            )
         n_data = max(1, len(devices) // n_model)
     use = devices[: n_data * n_model]
+    if len(use) < n_data * n_model:
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, "
+            f"have {len(devices)}"
+        )
     arr = np.array(use).reshape(n_data, n_model)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
@@ -75,12 +88,7 @@ def make_multislice_mesh(
     for d, sl in zip(devices, slice_assignments):
         groups.setdefault(sl, []).append(d)
     if len(groups) <= 1:
-        if len(devices) % n_model != 0:
-            # same contract as the multi-slice path: never silently shrink
-            raise ValueError(
-                f"n_model={n_model} must divide the {len(devices)} devices"
-            )
-        return make_mesh(n_model=n_model, devices=devices)
+        return make_mesh(n_model=n_model, devices=devices)  # raises if non-dividing
     sizes = {sl: len(g) for sl, g in groups.items()}
     if len(set(sizes.values())) != 1:
         # a mesh must be rectangular; silently trimming the bigger slice would
