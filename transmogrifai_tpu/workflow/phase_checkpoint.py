@@ -1,0 +1,149 @@
+"""Phase-level checkpoint/resume for Workflow.train (SURVEY §5.4).
+
+The ModelSelector already checkpoints its search units (select/checkpoint.py);
+this extends the same posture to every OTHER fit point in the DAG: each fitted
+estimator's model JSON is appended to a dir-local JSONL the moment its fit
+completes, guarded by a fingerprint of the raw data and the graph configuration.
+A killed train re-run with the same data and graph restores fitted stages
+instead of refitting them — deterministic restart from phase checkpoints, the
+fault-tolerance contract the README states. Stale checkpoints (different data
+or configuration) are discarded wholesale.
+
+Restoration goes through the same registry path as model load
+(`Stage.from_json`), so anything the contract sweep (tests/test_stage_contracts)
+round-trips is resumable by construction.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def data_fingerprint(table) -> str:
+    """Digest of a Table's contents (column names + values + masks)."""
+    h = hashlib.sha256()
+    for name in sorted(table.names()):
+        col = table[name]
+        h.update(name.encode())
+        vals = col.values
+        if isinstance(vals, dict):  # prediction columns never feed fits, but be total
+            for k in sorted(vals):
+                h.update(np.ascontiguousarray(np.asarray(vals[k])).tobytes())
+        elif getattr(vals, "dtype", None) is not None and vals.dtype != object:
+            h.update(np.ascontiguousarray(np.asarray(vals)).tobytes())
+        else:  # host object storage: strings/lists/sets/maps
+            for v in vals:
+                # sets iterate in hash-randomized order across PROCESSES — a
+                # resume is exactly a fresh process, so canonicalize first
+                if isinstance(v, (set, frozenset)):
+                    h.update(repr(sorted(map(str, v))).encode())
+                elif isinstance(v, dict):
+                    h.update(repr(sorted((str(k), str(x))
+                                         for k, x in v.items())).encode())
+                else:
+                    h.update(repr(v).encode())
+                h.update(b"\x1f")
+        if col.mask is not None:
+            h.update(np.ascontiguousarray(np.asarray(col.mask)).tobytes())
+    return h.hexdigest()
+
+
+def graph_fingerprint(dag) -> str:
+    """Digest of the stage DAG configuration: classes, config, and wiring.
+    Uses config_fingerprint() where available — fit-relevant configuration held
+    in ATTRIBUTES (the ModelSelector's models/grids/validator/splitter) must
+    invalidate the checkpoint, not just ctor params."""
+    h = hashlib.sha256()
+    for layer in dag:
+        for s in layer:
+            h.update(type(s).__name__.encode())
+            cf = getattr(s, "config_fingerprint", None)
+            config = cf() if callable(cf) else getattr(s, "params", {})
+            h.update(json.dumps(config, sort_keys=True, default=str).encode())
+            h.update(",".join(f.name for f in s.inputs).encode())
+            h.update(s.get_output().name.encode())
+    return h.hexdigest()
+
+
+def stage_key(est, layer_index: int) -> str:
+    """Stable identity of one fit point within a fingerprinted train."""
+    payload = {
+        "class": type(est).__name__,
+        "config": est.config_fingerprint(),
+        "inputs": [f.name for f in est.inputs],
+        "output": est.get_output().name,
+        "layer": layer_index,
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()).hexdigest()
+
+
+class PhaseCheckpoint:
+    """Append-only JSONL of fitted-stage payloads, fingerprint-guarded."""
+
+    FILE = "phases.jsonl"
+
+    def __init__(self, directory: str, fingerprint: str):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.path = os.path.join(directory, self.FILE)
+        self.fingerprint = fingerprint
+        self._stages: dict[str, dict] = {}
+        self._load_or_init()
+
+    def _load_or_init(self) -> None:
+        records = []
+        good_bytes = 0  # offset of the last fully-parsed line
+        torn = False
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as fh:
+                    for ln in fh:
+                        if not ln.strip():
+                            good_bytes += len(ln)
+                            continue
+                        try:
+                            records.append(json.loads(ln))
+                            good_bytes += len(ln)
+                        except json.JSONDecodeError:
+                            torn = True  # torn final line from a crash
+                            break
+            except OSError:
+                records = []
+        if records and records[0].get("kind") == "header" \
+                and records[0].get("fingerprint") == self.fingerprint:
+            if torn:
+                # drop the torn bytes NOW, or the next append would fuse onto
+                # them and poison every later resume's parse
+                with open(self.path, "r+") as fh:
+                    fh.truncate(good_bytes)
+            for rec in records[1:]:
+                if rec.get("kind") == "stage":
+                    self._stages[rec["key"]] = rec["payload"]
+            return
+        # fresh or stale: restart the file with our header
+        with open(self.path, "w") as fh:
+            fh.write(json.dumps({"kind": "header",
+                                 "fingerprint": self.fingerprint}) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._stages = {}
+
+    def get(self, key: str) -> Optional[dict]:
+        return self._stages.get(key)
+
+    def put(self, key: str, payload: dict) -> None:
+        self._stages[key] = payload
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps({"kind": "stage", "key": key,
+                                 "payload": payload}, default=str) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def selector_search_path(self) -> str:
+        """The ModelSelector's own search checkpoint lives alongside the phases."""
+        return os.path.join(self.directory, "selector_search.jsonl")

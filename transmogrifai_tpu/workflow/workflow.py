@@ -29,7 +29,13 @@ _logger = logging.getLogger(__name__)
 from ..graph.dag import compute_dag, split_layer_by_kind, validate_dag
 from ..graph.feature import Feature, validate_distinct_names
 from ..readers.base import DataReader, TableReader
-from ..stages.base import Estimator, FeatureGeneratorStage, Stage, Transformer
+from ..stages.base import (
+    Estimator,
+    FeatureGeneratorStage,
+    Stage,
+    Transformer,
+    adopt_wiring,
+)
 from ..types import Column, Table
 from ..utils import uid as make_uid
 
@@ -202,14 +208,21 @@ class Workflow(WorkflowCore):
         validate_dag(self._dag)
 
     def train(self, table: Optional[Table] = None,
-              sanitize: bool = False) -> "WorkflowModel":
+              sanitize: bool = False,
+              checkpoint_dir: Optional[str] = None) -> "WorkflowModel":
         """Fit all estimator stages layer by layer; bulk-apply transformers between fit
         points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG).
 
         `sanitize=True` runs the stage sanitizers (utils/sanitize.py: serializability
         round-trip for every stage; jit-traceability + purity for device transformers
         on an 8-row sample) before fitting — the pre-train validation analog of the
-        reference's checkSerializable (OpWorkflow.scala:265-272)."""
+        reference's checkSerializable (OpWorkflow.scala:265-272).
+
+        `checkpoint_dir` enables phase-level checkpoint/resume (SURVEY §5.4): each
+        fitted estimator persists the moment its fit completes, and a re-run with
+        the same data + graph restores instead of refitting; a ModelSelector in
+        the graph additionally checkpoints its search units into the same
+        directory unless it already has its own checkpoint path."""
         if not self.result_features:
             raise ValueError("set_result_features first")
         if table is not None:
@@ -231,6 +244,19 @@ class Workflow(WorkflowCore):
                 self._apply_blacklist(blacklisted)
         from .. import profiling
 
+        ckpt = None
+        if checkpoint_dir:
+            from .phase_checkpoint import (
+                PhaseCheckpoint,
+                data_fingerprint,
+                graph_fingerprint,
+                stage_key,
+            )
+
+            ckpt = PhaseCheckpoint(
+                checkpoint_dir,
+                data_fingerprint(data) + graph_fingerprint(self._dag),
+            )
         raw_data = data
         # per-selector refit sets: a selector with a clean upstream must not pay the
         # per-fold recomputation just because ANOTHER selector in the graph is tainted
@@ -279,14 +305,36 @@ class Workflow(WorkflowCore):
                             raw_data, list(plan_records), sel_refit,
                             est.inputs[1].name, cached=data,
                         )
+                    # the selector checkpoints its own SEARCH units (the expensive
+                    # part) into the same dir; its final model is not phase-cached
+                    # because the restored stage would lose selector_summary
+                    assigned_sel_ckpt = False
+                    if is_selector and ckpt is not None \
+                            and not getattr(est, "checkpoint_path", None):
+                        est.checkpoint_path = ckpt.selector_search_path()
+                        assigned_sel_ckpt = True
+                    use_ckpt = ckpt is not None and not is_selector
+                    key = stage_key(est, li) if use_ckpt else None
+                    stored = ckpt.get(key) if use_ckpt else None
                     try:
-                        with profiling.phase(f"fit:{type(est).__name__}"):
-                            model = est.fit_table(data)
+                        if stored is not None:
+                            model = Stage.from_json(stored)
+                            adopt_wiring(est, model)
+                        else:
+                            with profiling.phase(f"fit:{type(est).__name__}"):
+                                model = est.fit_table(data)
+                            if use_ckpt:
+                                ckpt.put(key, model.to_json())
                     finally:
                         if is_selector:
                             # do not retain the closure (it pins the raw table and
                             # every fitted plan record) beyond the fit itself
                             est._in_fold_matrix_fn = None
+                            if assigned_sel_ckpt:
+                                # workflow-assigned, not user-owned: a reused
+                                # selector must not keep writing into this dir
+                                # in later trains with other (or no) checkpoints
+                                est.checkpoint_path = None
                 layer_transformers.append(model)
                 plan_records.append((est, model))
             for t in list(device_tf) + list(host_tf):
