@@ -51,9 +51,9 @@ def test_resume_restores_fitted_stages(tmp_path, monkeypatch):
     m1 = wf.train(table=t, checkpoint_dir=str(tmp_path))
     scores1 = m1.score(table=t)
     assert (tmp_path / "phases.jsonl").exists()
-    # the selector's own search checkpoint is REMOVED on successful completion
-    # (SearchCheckpoint.complete) — it only survives a mid-search kill
-    assert not (tmp_path / "selector_search.jsonl").exists()
+    # the selector's search checkpoint is REMOVED once the whole train
+    # completes — it only survives a kill mid-search or mid-later-phase
+    assert not list(tmp_path.glob("selector_search_*.jsonl"))
 
     # second train: every non-selector estimator restores; a fit would raise
     def boom(self, cols):
@@ -153,3 +153,48 @@ def test_selector_checkpoint_path_not_retained(tmp_path):
     wf, sel = _build()
     wf.train(table=t, checkpoint_dir=str(tmp_path))
     assert sel.checkpoint_path is None  # workflow-assigned path is not sticky
+
+
+def test_search_file_survives_kill_in_later_phase(tmp_path, monkeypatch):
+    """A kill AFTER the selector fit but before train end must leave the search
+    checkpoint on disk (its removal is deferred to train completion), so the
+    resume replays completed search groups instead of redoing the search."""
+    from transmogrifai_tpu.insights.corr import RecordInsightsCorr
+
+    def build_with_downstream():
+        import transmogrifai_tpu  # noqa: F401
+        from transmogrifai_tpu.utils import reset_uid_counter
+
+        reset_uid_counter()
+        fs = features_from_schema(SCHEMA, response="label")
+        scaled = StandardScaler()(fs["x1"])
+        vec = transmogrify([scaled, fs["x2"], fs["cat"]])
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, validation_metric="AuPR",
+            models=[(LogisticRegression(max_iter=10),
+                     ParamGridBuilder().add("l2", [0.01, 0.1]).build())],
+        )
+        pred = selector(fs["label"], vec)
+        insights = RecordInsightsCorr()(vec, pred)  # a LATER fit point
+        return Workflow().set_result_features(pred, insights), selector
+
+    t = _table()
+    orig = RecordInsightsCorr.fit_columns
+
+    def die(self, cols):
+        raise KeyboardInterrupt("kill after selector fit")
+
+    monkeypatch.setattr(RecordInsightsCorr, "fit_columns", die)
+    wf, sel = build_with_downstream()
+    with pytest.raises(KeyboardInterrupt):
+        wf.train(table=t, checkpoint_dir=str(tmp_path))
+    assert list(tmp_path.glob("selector_search_*.jsonl")), (
+        "search checkpoint must survive a kill in a later phase"
+    )
+
+    monkeypatch.setattr(RecordInsightsCorr, "fit_columns", orig)
+    wf2, sel2 = build_with_downstream()
+    m = wf2.train(table=t, checkpoint_dir=str(tmp_path))
+    assert sel2.summary_ is not None
+    assert not list(tmp_path.glob("selector_search_*.jsonl"))  # removed at end
+    assert sel2.summary_.models_evaluated == 4  # 2 points x 2 folds, replayed

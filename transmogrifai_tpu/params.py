@@ -31,6 +31,9 @@ class OpParams:
     model_location: Optional[str] = None
     write_location: Optional[str] = None     # scored-table output
     metrics_location: Optional[str] = None   # evaluation metrics JSON
+    #: phase-level checkpoint dir for train (Workflow.train(checkpoint_dir=...));
+    #: a killed train run resumes by restoring completed fits (SURVEY §5.4)
+    checkpoint_location: Optional[str] = None
     log_stage_metrics: bool = False          # per-stage timing into the run report
     collect_stage_metrics: bool = True
     custom_tags: dict[str, str] = field(default_factory=dict)

@@ -17,6 +17,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.jsonl_checkpoint import JsonlCheckpoint
+
 
 def search_fingerprint(X, y, weights, val_masks, keep, problem_type: str,
                        metric: str, candidates) -> str:
@@ -49,54 +51,13 @@ def group_key(candidate_index: int, static_items, points, fold: Optional[int] = 
         json.dumps(payload, sort_keys=True, default=str).encode()).hexdigest()
 
 
-class SearchCheckpoint:
-    """Append-only JSONL: one header record + one record per completed group."""
+class SearchCheckpoint(JsonlCheckpoint):
+    """Append-only JSONL: one header record + one record per completed group.
+    File protocol (fingerprint header, fsync'd appends, torn-tail truncation)
+    is the shared utils.jsonl_checkpoint.JsonlCheckpoint."""
 
-    def __init__(self, path: str, fingerprint: str):
-        self.path = path
-        self.fingerprint = fingerprint
-        self._groups: dict[str, list[dict]] = {}
-        self._load_or_init()
-
-    def _load_or_init(self) -> None:
-        if os.path.exists(self.path):
-            lines = []
-            try:
-                with open(self.path) as fh:
-                    for ln in fh:
-                        if not ln.strip():
-                            continue
-                        try:
-                            lines.append(json.loads(ln))
-                        except json.JSONDecodeError:
-                            break  # torn final line from a crash: keep what parsed
-            except OSError:
-                lines = []
-            if lines and lines[0].get("kind") == "header" \
-                    and lines[0].get("fingerprint") == self.fingerprint:
-                for rec in lines[1:]:
-                    if rec.get("kind") == "group":
-                        self._groups[rec["key"]] = rec["results"]
-                return
-        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
-        with open(self.path, "w") as fh:
-            fh.write(json.dumps({"kind": "header",
-                                 "fingerprint": self.fingerprint}) + "\n")
+    RECORD_KIND = "group"
+    PAYLOAD_FIELD = "results"
 
     def get(self, key: str) -> Optional[list[dict]]:
-        return self._groups.get(key)
-
-    def put(self, key: str, results: list[dict]) -> None:
-        self._groups[key] = results
-        with open(self.path, "a") as fh:
-            fh.write(json.dumps({"kind": "group", "key": key,
-                                 "results": results}) + "\n")
-            fh.flush()
-            os.fsync(fh.fileno())
-
-    def complete(self) -> None:
-        """The search finished: remove the file so the next train starts fresh."""
-        try:
-            os.remove(self.path)
-        except FileNotFoundError:
-            pass
+        return self._records.get(key)

@@ -276,8 +276,11 @@ class ModelSelector(PredictorEstimator):
                 else:
                     summary.holdout_metrics = self._metrics_on(
                         model, X_np[holdout_idx], y_h)
-        if ckpt is not None:
-            ckpt.complete()  # train finished: next fit starts a fresh search
+        if ckpt is not None and not getattr(self, "_defer_checkpoint_complete", False):
+            # fit finished: next fit starts a fresh search. A checkpointed
+            # Workflow.train defers this removal to TRAIN end — a kill during a
+            # LATER phase must still be able to resume without redoing the search
+            ckpt.complete()
         self.summary_ = summary
         model.selector_summary = summary
         return model

@@ -1,0 +1,88 @@
+"""Shared append-only fingerprint-guarded JSONL checkpoint file.
+
+One protocol serves both checkpoint layers (select/checkpoint.py search units,
+workflow/phase_checkpoint.py fitted stages): a header record carrying a
+fingerprint of everything that determines the stored results, then one record
+per completed unit, fsync'd as written. Crash semantics are uniform: a torn
+final line is truncated away on load (so later appends never fuse onto torn
+bytes), and a header whose fingerprint doesn't match restarts the file.
+Payloads serialize with plain json.dumps — no default=str — so a non-JSON-able
+payload fails loudly at write time instead of resuming a silently stringified
+model later.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class JsonlCheckpoint:
+    #: record kind tag for non-header records
+    RECORD_KIND = "record"
+    #: field name the payload is stored under
+    PAYLOAD_FIELD = "payload"
+
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+        self._records: dict[str, object] = {}
+        self._load_or_init()
+
+    def _load_or_init(self) -> None:
+        records = []
+        good_bytes = 0  # offset of the last fully-parsed line
+        torn = False
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as fh:
+                    for ln in fh:
+                        if not ln.strip():
+                            good_bytes += len(ln)
+                            continue
+                        try:
+                            records.append(json.loads(ln))
+                            good_bytes += len(ln)
+                        except json.JSONDecodeError:
+                            torn = True  # torn final line from a crash
+                            break
+            except OSError:
+                records = []
+        if records and records[0].get("kind") == "header" \
+                and records[0].get("fingerprint") == self.fingerprint:
+            if torn:
+                # drop the torn bytes NOW, or the next append would fuse onto
+                # them and poison every later resume's parse
+                with open(self.path, "r+") as fh:
+                    fh.truncate(good_bytes)
+            for rec in records[1:]:
+                if rec.get("kind") == self.RECORD_KIND:
+                    self._records[rec["key"]] = rec[self.PAYLOAD_FIELD]
+            return
+        # fresh or stale: restart the file with our header
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(self.path, "w") as fh:
+            fh.write(json.dumps({"kind": "header",
+                                 "fingerprint": self.fingerprint}) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._records = {}
+
+    def get(self, key: str):
+        return self._records.get(key)
+
+    def put(self, key: str, payload) -> None:
+        line = json.dumps({"kind": self.RECORD_KIND, "key": key,
+                           self.PAYLOAD_FIELD: payload}) + "\n"
+        self._records[key] = payload
+        with open(self.path, "a") as fh:
+            fh.write(line)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def complete(self) -> None:
+        """Work finished: remove the file so the next run starts fresh."""
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass

@@ -22,19 +22,30 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.jsonl_checkpoint import JsonlCheckpoint
+
+
+def _hash_array(h, a) -> None:
+    a = np.ascontiguousarray(np.asarray(a))
+    h.update(str(a.shape).encode())  # bytes alone collide across shapes/dtypes
+    h.update(str(a.dtype).encode())
+    h.update(a.tobytes())
+
 
 def data_fingerprint(table) -> str:
-    """Digest of a Table's contents (column names + values + masks)."""
+    """Digest of a Table's contents (column names + kinds + shapes + values +
+    masks)."""
     h = hashlib.sha256()
     for name in sorted(table.names()):
         col = table[name]
         h.update(name.encode())
+        h.update(col.kind.name.encode())
         vals = col.values
         if isinstance(vals, dict):  # prediction columns never feed fits, but be total
             for k in sorted(vals):
-                h.update(np.ascontiguousarray(np.asarray(vals[k])).tobytes())
+                _hash_array(h, vals[k])
         elif getattr(vals, "dtype", None) is not None and vals.dtype != object:
-            h.update(np.ascontiguousarray(np.asarray(vals)).tobytes())
+            _hash_array(h, vals)
         else:  # host object storage: strings/lists/sets/maps
             for v in vals:
                 # sets iterate in hash-randomized order across PROCESSES — a
@@ -48,7 +59,7 @@ def data_fingerprint(table) -> str:
                     h.update(repr(v).encode())
                 h.update(b"\x1f")
         if col.mask is not None:
-            h.update(np.ascontiguousarray(np.asarray(col.mask)).tobytes())
+            _hash_array(h, col.mask)
     return h.hexdigest()
 
 
@@ -82,68 +93,25 @@ def stage_key(est, layer_index: int) -> str:
         json.dumps(payload, sort_keys=True, default=str).encode()).hexdigest()
 
 
-class PhaseCheckpoint:
-    """Append-only JSONL of fitted-stage payloads, fingerprint-guarded."""
+class PhaseCheckpoint(JsonlCheckpoint):
+    """Append-only JSONL of fitted-stage payloads, fingerprint-guarded. File
+    protocol (header, fsync'd appends, torn-tail truncation, fail-fast JSON —
+    no default=str, so a non-serializable fitted param raises at WRITE time
+    instead of resuming a stringified model) is the shared JsonlCheckpoint."""
 
+    RECORD_KIND = "stage"
     FILE = "phases.jsonl"
 
     def __init__(self, directory: str, fingerprint: str):
-        os.makedirs(directory, exist_ok=True)
         self.directory = directory
-        self.path = os.path.join(directory, self.FILE)
-        self.fingerprint = fingerprint
-        self._stages: dict[str, dict] = {}
-        self._load_or_init()
-
-    def _load_or_init(self) -> None:
-        records = []
-        good_bytes = 0  # offset of the last fully-parsed line
-        torn = False
-        if os.path.exists(self.path):
-            try:
-                with open(self.path, "rb") as fh:
-                    for ln in fh:
-                        if not ln.strip():
-                            good_bytes += len(ln)
-                            continue
-                        try:
-                            records.append(json.loads(ln))
-                            good_bytes += len(ln)
-                        except json.JSONDecodeError:
-                            torn = True  # torn final line from a crash
-                            break
-            except OSError:
-                records = []
-        if records and records[0].get("kind") == "header" \
-                and records[0].get("fingerprint") == self.fingerprint:
-            if torn:
-                # drop the torn bytes NOW, or the next append would fuse onto
-                # them and poison every later resume's parse
-                with open(self.path, "r+") as fh:
-                    fh.truncate(good_bytes)
-            for rec in records[1:]:
-                if rec.get("kind") == "stage":
-                    self._stages[rec["key"]] = rec["payload"]
-            return
-        # fresh or stale: restart the file with our header
-        with open(self.path, "w") as fh:
-            fh.write(json.dumps({"kind": "header",
-                                 "fingerprint": self.fingerprint}) + "\n")
-            fh.flush()
-            os.fsync(fh.fileno())
-        self._stages = {}
+        super().__init__(os.path.join(directory, self.FILE), fingerprint)
 
     def get(self, key: str) -> Optional[dict]:
-        return self._stages.get(key)
+        return self._records.get(key)
 
-    def put(self, key: str, payload: dict) -> None:
-        self._stages[key] = payload
-        with open(self.path, "a") as fh:
-            fh.write(json.dumps({"kind": "stage", "key": key,
-                                 "payload": payload}, default=str) + "\n")
-            fh.flush()
-            os.fsync(fh.fileno())
-
-    def selector_search_path(self) -> str:
-        """The ModelSelector's own search checkpoint lives alongside the phases."""
-        return os.path.join(self.directory, "selector_search.jsonl")
+    def selector_search_path(self, output_name: str) -> str:
+        """A ModelSelector's own search checkpoint lives alongside the phases,
+        keyed per selector: with several selectors in one graph, a shared file
+        would let the first one's fingerprint reset clobber the others'."""
+        safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in output_name)
+        return os.path.join(self.directory, f"selector_search_{safe}.jsonl")

@@ -186,7 +186,7 @@ class WorkflowRunner:
         stages = [f.origin_stage for rf in self.workflow.result_features
                   for f in rf.all_features() if f.origin_stage is not None]
         params.apply_to_stages(stages)
-        model = self.workflow.train()
+        model = self.workflow.train(checkpoint_dir=params.checkpoint_location)
         mark("train")
         loc = params.model_location
         if loc:

@@ -257,6 +257,7 @@ class Workflow(WorkflowCore):
                 checkpoint_dir,
                 data_fingerprint(data) + graph_fingerprint(self._dag),
             )
+        deferred_search_files: list[str] = []
         raw_data = data
         # per-selector refit sets: a selector with a clean upstream must not pay the
         # per-fold recomputation just because ANOTHER selector in the graph is tainted
@@ -307,11 +308,16 @@ class Workflow(WorkflowCore):
                         )
                     # the selector checkpoints its own SEARCH units (the expensive
                     # part) into the same dir; its final model is not phase-cached
-                    # because the restored stage would lose selector_summary
+                    # because the restored stage would lose selector_summary.
+                    # Deletion of its search file is deferred to TRAIN end so a
+                    # kill during a LATER phase still resumes without redoing it.
                     assigned_sel_ckpt = False
                     if is_selector and ckpt is not None \
                             and not getattr(est, "checkpoint_path", None):
-                        est.checkpoint_path = ckpt.selector_search_path()
+                        est.checkpoint_path = ckpt.selector_search_path(
+                            est.get_output().name)
+                        est._defer_checkpoint_complete = True
+                        deferred_search_files.append(est.checkpoint_path)
                         assigned_sel_ckpt = True
                     use_ckpt = ckpt is not None and not is_selector
                     key = stage_key(est, li) if use_ckpt else None
@@ -335,6 +341,7 @@ class Workflow(WorkflowCore):
                                 # selector must not keep writing into this dir
                                 # in later trains with other (or no) checkpoints
                                 est.checkpoint_path = None
+                                est._defer_checkpoint_complete = False
                 layer_transformers.append(model)
                 plan_records.append((est, model))
             for t in list(device_tf) + list(host_tf):
@@ -345,6 +352,12 @@ class Workflow(WorkflowCore):
             with profiling.phase(f"transform:layer{li}"):
                 data = plan.apply(data)
             fitted_stages.extend(_topo_within_layer(layer_transformers))
+        for p in deferred_search_files:
+            # the WHOLE train completed: the next train starts a fresh search
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
         model = WorkflowModel(
             result_features=self.result_features,
             raw_features=self.raw_features,
