@@ -276,3 +276,61 @@ def test_selector_picks_tree_on_nonlinear_data():
     out = model.transform_table(table)
     pred = np.asarray(out[model.get_output().name].pred)
     assert float((pred == y).mean()) > 0.9
+
+
+def test_reg_alpha_l1_shrinks_leaves():
+    """xgboost-style L1: large reg_alpha soft-thresholds every leaf to zero."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops.trees import fit_gbt, predict_gbt_binary
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    plain = fit_gbt(jnp.asarray(X), jnp.asarray(y), n_trees=5, max_depth=3)
+    heavy = fit_gbt(jnp.asarray(X), jnp.asarray(y), n_trees=5, max_depth=3,
+                    reg_alpha=1e6)
+    assert float(np.abs(np.asarray(heavy.leaf_values)).max()) == 0.0
+    assert float(np.abs(np.asarray(plain.leaf_values)).max()) > 0.0
+    # moderate alpha shrinks but does not kill the model
+    mid = fit_gbt(jnp.asarray(X), jnp.asarray(y), n_trees=5, max_depth=3,
+                  reg_alpha=1.0)
+    assert 0.0 < float(np.abs(np.asarray(mid.leaf_values)).max()) \
+        <= float(np.abs(np.asarray(plain.leaf_values)).max()) + 1e-6
+    pred = np.asarray(predict_gbt_binary(mid, jnp.asarray(X))[0])
+    assert (pred == y).mean() > 0.9
+
+
+def test_scale_pos_weight_shifts_toward_positives():
+    from transmogrifai_tpu.stages.model.trees import XGBoostClassifier
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=300) > 1.0).astype(np.float32)  # ~16% pos
+    plain = XGBoostClassifier.fit_fn(X, y, n_trees=10, max_depth=3)
+    boosted = XGBoostClassifier.fit_fn(X, y, n_trees=10, max_depth=3,
+                                       scale_pos_weight=10.0)
+    from transmogrifai_tpu.ops.trees import predict_gbt_binary
+
+    p_plain = np.asarray(predict_gbt_binary(plain, X)[2][:, 1]).mean()
+    p_boost = np.asarray(predict_gbt_binary(boosted, X)[2][:, 1]).mean()
+    assert p_boost > p_plain  # upweighted positives raise predicted positive mass
+
+
+def test_reg_alpha_vmaps_in_selector_grid():
+    from transmogrifai_tpu.select.grids import ParamGridBuilder
+    from transmogrifai_tpu.select.validator import CrossValidation, evaluate_candidates
+    from transmogrifai_tpu.stages.model.trees import XGBoostClassifier
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(120, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ones = np.ones(120, np.float32)
+    masks = CrossValidation(num_folds=2, seed=0).fold_masks(y, ones)
+    results = evaluate_candidates(
+        [(XGBoostClassifier(n_trees=5, max_depth=3),
+          ParamGridBuilder().add("reg_alpha", [0.0, 0.5, 5.0]).build())],
+        X, y, ones, masks, ones, "binary", "AuPR",
+    )
+    assert len(results) == 3
+    assert all(np.isfinite(v) for r in results for v in r.metric_values)

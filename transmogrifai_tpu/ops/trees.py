@@ -143,6 +143,16 @@ def histogram_segment_sum(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     return flat.reshape(n_nodes, D, n_bins, C)
 
 
+def _l1_threshold(G, reg_alpha):
+    """xgboost L1 soft-threshold T_alpha(G) = sign(G) * max(|G| - alpha, 0).
+    When reg_alpha is the Python scalar 0 (every non-XGBoost tree family), skip
+    the thresholding at TRACE time — a traced alpha cannot be folded away by XLA
+    and would tax the [nodes, D, bins, C] gain tensors of every fit."""
+    if isinstance(reg_alpha, (int, float)) and reg_alpha == 0:
+        return G
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - reg_alpha, 0.0)
+
+
 def grow_tree(
     Xb: jnp.ndarray,
     edges: jnp.ndarray,
@@ -153,13 +163,16 @@ def grow_tree(
     min_child_weight,
     min_gain,
     feature_mask: Optional[jnp.ndarray] = None,
+    reg_alpha=0.0,
 ):
     """Grow one perfect tree level-by-level on binned features.
 
     Xb [N, D] int32 bins; edges [D, B-1]; g, h [N, C] per-row gradient/hessian
     (channels = output dimension). Returns (split_feature [2^depth-1] int32,
     split_threshold [2^depth-1] f32, leaf_values [2^depth, C], leaf_of_row [N] int32)
-    where leaf_values = -G/(H + lambda) per leaf.
+    where leaf_values = -T_alpha(G)/(H + lambda) per leaf, with
+    T_alpha(G) = sign(G) * max(|G| - alpha, 0) the xgboost L1 soft-threshold
+    (reg_alpha=0 recovers the plain second-order leaf).
     """
     N, D = Xb.shape
     n_bins = edges.shape[1] + 1
@@ -178,7 +191,8 @@ def grow_tree(
         GR, HR = Gt - GL, Ht - HL
 
         def score(G, H):
-            return (G ** 2 / (H + reg_lambda + _EPS)).sum(-1)
+            Gt_ = _l1_threshold(G, reg_alpha)
+            return (Gt_ ** 2 / (H + reg_lambda + _EPS)).sum(-1)
 
         gain = score(GL, HL) + score(GR, HR) - score(Gt, Ht)  # [n_nodes, D, n_bins]
         hl, hr = HL.sum(-1), HR.sum(-1)
@@ -210,7 +224,7 @@ def grow_tree(
     n_leaves = 2 ** max_depth
     Gleaf = jax.ops.segment_sum(g, node, num_segments=n_leaves)
     Hleaf = jax.ops.segment_sum(h, node, num_segments=n_leaves)
-    leaf_values = -Gleaf / (Hleaf + reg_lambda + _EPS)
+    leaf_values = -_l1_threshold(Gleaf, reg_alpha) / (Hleaf + reg_lambda + _EPS)
     return (
         jnp.concatenate(feats),
         jnp.concatenate(threshs),
@@ -277,6 +291,7 @@ def fit_gbt(
     reg_lambda=1.0,
     min_child_weight=1.0,
     min_gain=0.0,
+    reg_alpha=0.0,
     subsample: float = 1.0,
     colsample: float = 1.0,
     n_bins: int = 32,
@@ -325,7 +340,8 @@ def fit_gbt(
             jax.random.bernoulli(kcol, colsample, (D,)) if colsample < 1.0 else None
         )
         sf, st, lv, leaf = grow_tree(
-            Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain, fmask
+            Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
+            fmask, reg_alpha=reg_alpha,
         )
         lv = lv * learning_rate
         return F + lv[leaf], (sf, st, lv)

@@ -276,17 +276,21 @@ class XGBoostClassifier(ClassifierEstimator):
     tree loops). Analog of OpXGBoostClassifier.scala:48."""
 
     operation_name = "xgboostClassifier"
-    vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
+    vmap_params = ("learning_rate", "reg_lambda", "reg_alpha", "min_child_weight",
+                   "min_gain")
 
     def __init__(self, num_classes: int = 0, n_trees: int = 50, max_depth: int = 6,
                  learning_rate: float = 0.3, min_child_weight: float = 1.0,
                  min_gain: float = 0.0, reg_lambda: float = 1.0,
+                 reg_alpha: float = 0.0, scale_pos_weight: float = 1.0,
                  subsample: float = 1.0, colsample: float = 1.0, n_bins: int = 64,
                  seed: int = 7):
         super().__init__(num_classes=int(num_classes), n_trees=int(n_trees),
                          max_depth=int(max_depth), learning_rate=float(learning_rate),
                          min_child_weight=float(min_child_weight),
                          min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         reg_alpha=float(reg_alpha),
+                         scale_pos_weight=float(scale_pos_weight),
                          subsample=float(subsample), colsample=float(colsample),
                          n_bins=int(n_bins), seed=int(seed))
 
@@ -294,6 +298,20 @@ class XGBoostClassifier(ClassifierEstimator):
     def fit_fn(X, y, sample_weight=None, num_classes=0, **kw):
         num_classes = max(int(num_classes), 2)
         objective = "binary" if num_classes <= 2 else "multiclass"
+        spw = kw.pop("scale_pos_weight", 1.0)
+        if spw != 1.0:
+            if objective != "binary":
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "scale_pos_weight=%s ignored for multiclass (binary-only "
+                    "imbalance knob, as in xgboost)", spw)
+            else:
+                # xgboost semantics: positive-class rows weigh scale_pos_weight x
+                yv = jnp.asarray(y, jnp.float32)
+                base_w = (jnp.ones_like(yv) if sample_weight is None
+                          else jnp.asarray(sample_weight, jnp.float32))
+                sample_weight = base_w * jnp.where(yv > 0, spw, 1.0)
         return fit_gbt(X, y, sample_weight, objective=objective,
                        num_classes=num_classes, **kw)
 
@@ -318,17 +336,20 @@ class XGBoostClassifierModel(_TreeModelBase):
 @register_stage
 class XGBoostRegressor(PredictorEstimator):
     operation_name = "xgboostRegressor"
-    vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
+    vmap_params = ("learning_rate", "reg_lambda", "reg_alpha", "min_child_weight",
+                   "min_gain")
 
     def __init__(self, n_trees: int = 50, max_depth: int = 6,
                  learning_rate: float = 0.3, min_child_weight: float = 1.0,
                  min_gain: float = 0.0, reg_lambda: float = 1.0,
+                 reg_alpha: float = 0.0,
                  subsample: float = 1.0, colsample: float = 1.0, n_bins: int = 64,
                  seed: int = 7):
         super().__init__(n_trees=int(n_trees), max_depth=int(max_depth),
                          learning_rate=float(learning_rate),
                          min_child_weight=float(min_child_weight),
                          min_gain=float(min_gain), reg_lambda=float(reg_lambda),
+                         reg_alpha=float(reg_alpha),
                          subsample=float(subsample), colsample=float(colsample),
                          n_bins=int(n_bins), seed=int(seed))
 
