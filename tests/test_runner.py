@@ -403,3 +403,39 @@ def test_profiler_noop_without_activation():
             pass
     assert prof.phases["a"].count == 2
     assert profiling.current() is None
+
+
+def test_codegen_from_avro(tmp_path):
+    """`op gen` accepts an Avro container: kinds come from the writer schema and
+    the generated project reads through AvroReader (reference --schema avsc path)."""
+    from transmogrifai_tpu.readers import save_avro
+    from transmogrifai_tpu.types import Table
+
+    rng = np.random.default_rng(3)
+    rows = [{"pid": int(i), "survived": float(rng.random() > 0.5),
+             "age": float(rng.normal(40, 10)), "sex": "mf"[int(rng.integers(0, 2))]}
+            for i in range(60)]
+    t = Table.from_rows(rows, {"pid": "Integral", "survived": "RealNN",
+                               "age": "Real", "sex": "Text"})
+    data = tmp_path / "data.avro"
+    save_avro(t, str(data))
+
+    from transmogrifai_tpu.cli.main import main
+    rc = main(["gen", "avroproj", "--input", str(data), "--id", "pid",
+               "--response", "survived", "--out", str(tmp_path)])
+    assert rc == 0
+    script = (tmp_path / "avroproj" / "main.py").read_text()
+    assert "AvroReader" in script and "CSVReader" not in script
+
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "main.py", "--type", "train", "--data", str(data)],
+        cwd=str(tmp_path / "avroproj"), env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]

@@ -66,25 +66,48 @@ def generate_project(
     sample_rows: int = 1000,
     overwrite: bool = False,
 ) -> str:
-    """Write the project directory; returns its path."""
+    """Write the project directory; returns its path. `input_csv` may also be an
+    Avro container file (*.avro) — kinds then come from the embedded writer
+    schema (the reference CLI's --schema avsc path, CommandParser.scala:82-123)
+    instead of CSV sampling."""
     input_csv = os.path.abspath(input_csv)  # generated script must run from anywhere
-    with open(input_csv, newline="") as fh:
-        rows = [dict(r) for r in _csv.DictReader(fh)]
-    if not rows:
-        raise ValueError(f"{input_csv} has no data rows")
-    sample = rows[:sample_rows]
-    for missing in ({id_field, response_field} - set(sample[0])):
-        raise ValueError(f"field {missing!r} not in CSV header {sorted(sample[0])}")
-    schema = infer_schema(
-        [{k: (None if v == "" else v) for k, v in r.items()} for r in sample],
-        id_fields=[id_field],
-    )
-    response_values = [r[response_field] for r in sample]
+    with open(input_csv, "rb") as fh:
+        is_avro = fh.read(4) == b"Obj\x01"  # container magic, not the extension
+    if is_avro:
+        from ..readers import AvroReader
+
+        rdr = AvroReader(input_csv)
+        schema = {k: kind.name for k, kind in rdr.schema.items()}
+        for missing in ({id_field, response_field} - set(schema)):
+            raise ValueError(
+                f"field {missing!r} not in avro schema {sorted(schema)}")
+        # columnar read (native fast path): only the response column's sample is
+        # needed — per-row dicts over a big file would be O(N*D) Python objects
+        resp_col = rdr.read_columnar()[response_field][:sample_rows]
+        if len(resp_col) == 0:
+            raise ValueError(f"{input_csv} has no data rows")
+        response_values = ["" if v is None else str(v) for v in resp_col]
+        numeric_response = schema[response_field] in (
+            "Real", "RealNN", "Integral", "Binary", "Currency", "Percent")
+    else:
+        with open(input_csv, newline="") as fh:
+            rows = [dict(r) for r in _csv.DictReader(fh)]
+        if not rows:
+            raise ValueError(f"{input_csv} has no data rows")
+        sample = rows[:sample_rows]
+        for missing in ({id_field, response_field} - set(sample[0])):
+            raise ValueError(
+                f"field {missing!r} not in CSV header {sorted(sample[0])}")
+        schema = infer_schema(
+            [{k: (None if v == "" else v) for k, v in r.items()} for r in sample],
+            id_fields=[id_field],
+        )
+        response_values = [r[response_field] for r in sample]
+        numeric_response = _is_numeric(response_values)
     problem = infer_problem_kind(response_values)
     # selectors expect a numeric response: numeric labels read directly as RealNN;
     # string labels keep a categorical kind and the generated code indexes them
     # inline with .index_string() (same as examples/iris.py)
-    numeric_response = _is_numeric(response_values)
     schema[response_field] = "RealNN" if numeric_response else "PickList"
 
     proj = os.path.join(out_dir, name)
@@ -107,6 +130,7 @@ def generate_project(
         else 'features[RESPONSE].index_string(handle_invalid="keep")'
     )
 
+    reader_cls = "AvroReader" if is_avro else "CSVReader"
     predictors = [n for n in schema if n not in (id_field, response_field)]
     feature_lines = "\n".join(
         f'    {_ident(n)} = features["{n}"]' for n in predictors
@@ -123,7 +147,7 @@ import argparse
 from transmogrifai_tpu.evaluators import Evaluators
 from transmogrifai_tpu.graph import features_from_schema
 from transmogrifai_tpu.params import OpParams
-from transmogrifai_tpu.readers import CSVReader
+from transmogrifai_tpu.readers import {reader_cls}
 from transmogrifai_tpu.select import {selector_cls}
 from transmogrifai_tpu.stages.feature import transmogrify
 from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
@@ -144,7 +168,7 @@ def make_runner(data_path: str) -> WorkflowRunner:
     )
     prediction = selector(response, vector)
     workflow = Workflow().set_result_features(prediction, response)
-    reader = CSVReader(data_path, SCHEMA)
+    reader = {reader_cls}(data_path, SCHEMA)
     return WorkflowRunner(
         workflow,
         train_reader=reader,

@@ -54,9 +54,12 @@ def _cmd_run(argv) -> int:
 
 
 def _cmd_gen(argv) -> int:
-    ap = argparse.ArgumentParser(prog="op gen", description="scaffold a project from CSV")
+    ap = argparse.ArgumentParser(
+        prog="op gen", description="scaffold a project from CSV or Avro")
     ap.add_argument("name")
-    ap.add_argument("--input", required=True, help="CSV file with header")
+    ap.add_argument("--input", required=True,
+                    help="CSV file with header, or an .avro container "
+                         "(kinds from its writer schema)")
     ap.add_argument("--id", required=True, dest="id_field")
     ap.add_argument("--response", required=True)
     ap.add_argument("--out", default=".")

@@ -145,9 +145,11 @@ def histogram_segment_sum(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
 
 def _l1_threshold(G, reg_alpha):
     """xgboost L1 soft-threshold T_alpha(G) = sign(G) * max(|G| - alpha, 0).
-    When reg_alpha is the Python scalar 0 (every non-XGBoost tree family), skip
-    the thresholding at TRACE time — a traced alpha cannot be folded away by XLA
-    and would tax the [nodes, D, bins, C] gain tensors of every fit."""
+    When reg_alpha is the Python scalar 0, skip the thresholding at TRACE time —
+    a traced alpha cannot be folded away by XLA and would tax the
+    [nodes, D, bins, C] gain tensors. Callers inside jit must therefore pass a
+    LITERAL 0 when L1 is off (fit_gbt's use_l1 static flag does this; a traced
+    0.0 would defeat the guard)."""
     if isinstance(reg_alpha, (int, float)) and reg_alpha == 0:
         return G
     return jnp.sign(G) * jnp.maximum(jnp.abs(G) - reg_alpha, 0.0)
@@ -271,14 +273,23 @@ def _weights(sample_weight, n):
 
 
 # --- gradient boosting (GBT / XGBoost-style, second order) ---------------------------
+def fit_gbt(X, y, sample_weight=None, *, reg_alpha=0.0, **kw):
+    """Public entry: decides the static use_l1 flag OUTSIDE the jit boundary.
+    Inside _fit_gbt a default reg_alpha=0.0 would arrive as a TRACER, defeating
+    _l1_threshold's literal-zero skip and taxing every fit with thresholding
+    ops it doesn't need."""
+    use_l1 = not (isinstance(reg_alpha, (int, float)) and reg_alpha == 0)
+    return _fit_gbt(X, y, sample_weight, reg_alpha=reg_alpha, use_l1=use_l1, **kw)
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "objective", "num_classes", "n_trees", "max_depth", "n_bins",
-        "subsample", "colsample", "seed",
+        "subsample", "colsample", "seed", "use_l1",
     ),
 )
-def fit_gbt(
+def _fit_gbt(
     X: jnp.ndarray,
     y: jnp.ndarray,
     sample_weight: Optional[jnp.ndarray] = None,
@@ -292,6 +303,7 @@ def fit_gbt(
     min_child_weight=1.0,
     min_gain=0.0,
     reg_alpha=0.0,
+    use_l1: bool = False,
     subsample: float = 1.0,
     colsample: float = 1.0,
     n_bins: int = 32,
@@ -341,7 +353,7 @@ def fit_gbt(
         )
         sf, st, lv, leaf = grow_tree(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
-            fmask, reg_alpha=reg_alpha,
+            fmask, reg_alpha=reg_alpha if use_l1 else 0.0,  # literal 0 -> skip
         )
         lv = lv * learning_rate
         return F + lv[leaf], (sf, st, lv)
