@@ -214,6 +214,31 @@ class TestNativeCSV:
         assert fast["x"].to_list() == slow["x"].to_list() == [1, 2]
         assert fast["y"].to_list() == slow["y"].to_list() == ["a", "b"]
 
+    def test_cr_before_closing_quote_is_data(self, tmp_path, monkeypatch):
+        # python csv keeps a \r that sits before the closing quote; only
+        # UNQUOTED fields have their line-terminator \r stripped
+        p = tmp_path / "qcr.csv"
+        p.write_bytes(b'a,b\r\n1,"abc\r"\r\n2,xyz\r\n')
+        schema = {"a": "Integral", "b": "Text"}
+        fast, slow = self._tables_for(str(p), schema, monkeypatch)
+        assert fast["b"].to_list() == slow["b"].to_list() == ["abc\r", "xyz"]
+
+    def test_hex_float_errors_like_python(self, tmp_path, monkeypatch):
+        # strtod accepts '0x1A'; python float() raises — the native path must
+        # fall back so both paths raise identically
+        from transmogrifai_tpu import native
+
+        p = tmp_path / "hex.csv"
+        p.write_text("a,b\n0x1A,-0X2\n")
+        schema = {"a": "Real", "b": "Integral"}
+        fs = features_from_schema(schema)
+        with pytest.raises(ValueError):
+            CSVReader(str(p), schema).generate_table(list(fs.values()))
+        monkeypatch.setattr(native, "_CSV_LIB", None)
+        monkeypatch.setattr(native, "_CSV_TRIED", True)
+        with pytest.raises(ValueError):
+            CSVReader(str(p), schema).generate_table(list(fs.values()))
+
     def _tables_for(self, path, schema, monkeypatch):
         from transmogrifai_tpu import native
 

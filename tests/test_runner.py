@@ -185,6 +185,22 @@ def test_streaming_rebatch_fixed_size():
     assert res.n_rows == 24
 
 
+def test_streaming_rebatch_keeps_response_columns(tmp_path):
+    """Rebatched streams that carry the response keep it in the scored output
+    (same contract as the unbatched Table pass-through path)."""
+    runner, _ = _runner()
+    runner.run("train", OpParams())
+    batches = [_rows(n, seed=n) for n in (10, 6)]  # labels kept
+    runner.streaming_reader = BatchStreamingReader(batches)
+    runner.stream_batch_size = 8
+    res = runner.run("streaming_score", OpParams(write_location=str(tmp_path / "s")))
+    assert res.n_rows == 16
+    with open(tmp_path / "s" / "part-00000.csv") as fh:
+        rows = list(csv.DictReader(fh))
+    assert "label" in rows[0]
+    assert any(k.endswith(".prediction") for k in rows[0])
+
+
 def test_queue_streaming_reader_threaded():
     import threading
 

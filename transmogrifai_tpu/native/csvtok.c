@@ -67,11 +67,19 @@ static int cell_empty(const char *s, const char *e) {
     return s == e;
 }
 
+/* strtod/strtoll accept C99 hex floats ("0x1A") that python float()/int()
+ * reject — force those cells down the python fallback path */
+static int is_hex_literal(const char *s, const char *e) {
+    if (s < e && (*s == '+' || *s == '-')) s++;
+    return (e - s) >= 2 && s[0] == '0' && (s[1] == 'x' || s[1] == 'X');
+}
+
 /* 1 = parsed, 0 = empty/null, -1 = malformed */
 static int parse_real(const char *s, const char *e, double *out) {
     if (cell_empty(s, e)) return 0;
     trim(&s, &e);
     if (s == e) return -1; /* whitespace-only: python raises */
+    if (is_hex_literal(s, e)) return -1;
     char tmp[512];
     size_t n = (size_t)(e - s);
     if (n >= sizeof tmp) return -1;
@@ -88,6 +96,7 @@ static int parse_int(const char *s, const char *e, int64_t *out) {
     if (cell_empty(s, e)) return 0;
     trim(&s, &e);
     if (s == e) return -1; /* whitespace-only: python raises */
+    if (is_hex_literal(s, e)) return -1;
     char tmp[512];
     size_t n = (size_t)(e - s);
     if (n >= sizeof tmp) return -1;
@@ -213,7 +222,9 @@ int64_t csv_parse_typed(const char *buf, int64_t len, int32_t skip_header,
                 break;
             case CT_TEXT: {
                 const char *ts = s, *te = e;
-                if (!esc) { /* python csv keeps inner spaces; only strip \r */
+                /* python csv keeps inner spaces; strip only the line-ending \r
+                 * of UNQUOTED fields — a \r before a closing quote is data */
+                if (!quoted) {
                     while (te > ts && te[-1] == '\r') te--;
                 }
                 toffs[col][row] = ts - buf;

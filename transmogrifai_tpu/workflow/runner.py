@@ -126,7 +126,9 @@ class WorkflowRunner:
         self.train_reader = train_reader
         self.score_reader = score_reader
         self.streaming_reader = streaming_reader
-        #: re-chunk arrivals to this fixed size (None = score batches as they come)
+        #: re-chunk arrivals to this fixed size (None = score batches as they come).
+        #: Rebatching rebuilds batches from the model's raw features (responses
+        #: kept when present); columns that are not raw features are dropped.
         self.stream_batch_size = stream_batch_size
         #: pad ragged batches up to power-of-two buckets so the jit-compiled scoring
         #: plan is reused — at most log2(max batch) programs ever compile
@@ -273,9 +275,22 @@ class WorkflowRunner:
                 self.stream_batch_size,
             )
         for batch in batches:
-            table = batch if isinstance(batch, Table) else Table.from_rows(
-                batch, {f.name: f.kind for f in model.raw_features if not f.is_response}
-            )
+            if isinstance(batch, Table):
+                table = batch
+            else:
+                # rebuild every raw-feature column the stream actually carries —
+                # responses included, so scored output keeps labels for downstream
+                # evaluation just like the unbatched path. Columns that are not
+                # raw features have no declared kind and are dropped (documented
+                # on stream_batch_size).
+                # a response column is kept only when EVERY row in the (possibly
+                # mixed, post-rebatch) batch carries it — response kinds are
+                # often non-nullable (RealNN), so a partial column can't build
+                present = (set.intersection(*(set(r.keys()) for r in batch))
+                           if batch else set())
+                kinds = {f.name: f.kind for f in model.raw_features
+                         if not f.is_response or f.name in present}
+                table = Table.from_rows(batch, kinds)
             n = table.nrows
             if self.stream_pad and n > 0:
                 from ..types.table import pow2_bucket

@@ -222,7 +222,15 @@ class Workflow(WorkflowCore):
         fitted estimator persists the moment its fit completes, and a re-run with
         the same data + graph restores instead of refitting; a ModelSelector in
         the graph additionally checkpoints its search units into the same
-        directory unless it already has its own checkpoint path."""
+        directory unless it already has its own checkpoint path.
+
+        Retention contract (deliberately asymmetric with the selector's search
+        files): phases.jsonl SURVIVES a successful train, so an identical
+        retrain restores every non-selector fit — a fingerprint-guarded warm
+        restart (different data or graph invalidates it). Mid-search selector
+        state, by contrast, is deleted at train end: replaying a finished
+        search from partial units is not a restore, so the next train searches
+        fresh."""
         if not self.result_features:
             raise ValueError("set_result_features first")
         if table is not None:
