@@ -146,12 +146,22 @@ def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
     result = {
         "rows": n_rows, "features": n_feat, "bins": n_bins, "nodes": n_nodes,
         "segment_sum_ms": round(seg_t * 1e3, 3),
-        "binmm_ms": round(bin_t * 1e3, 3),  # the default _histogram path
+        "binmm_ms": round(bin_t * 1e3, 3),
         "binmm_speedup_vs_segsum": round(seg_t / bin_t, 2),
         "binmm_max_abs_diff": float(np.max(np.abs(seg_out - bin_out))),
         "pallas_available": bool(use_pallas_histogram()),
     }
     if use_pallas_histogram():
+        # the at-scale default (_histogram mode "mxu"): bf16 operands, f32 accum
+        from transmogrifai_tpu.ops.pallas_trees import histogram_mxu
+
+        mxu_fn = jax.jit(histogram_mxu, static_argnums=(3, 4))
+        mxu_t, mxu_out = timed(mxu_fn)
+        result["mxu_ms"] = round(mxu_t * 1e3, 3)
+        result["mxu_speedup_vs_segsum"] = round(seg_t / mxu_t, 2)
+        result["mxu_max_rel_diff"] = float(
+            np.max(np.abs(mxu_out - seg_out)) /
+            (np.max(np.abs(seg_out)) + 1e-9))
         pal_fn = jax.jit(histogram_pallas, static_argnums=(3, 4))
         pal_t, pal_out = timed(pal_fn)
         result["pallas_ms"] = round(pal_t * 1e3, 3)

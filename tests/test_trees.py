@@ -334,3 +334,38 @@ def test_reg_alpha_vmaps_in_selector_grid():
     )
     assert len(results) == 3
     assert all(np.isfinite(v) for r in results for v in r.metric_values)
+
+
+# --- at-scale pallas kernels (interpret mode on CPU; live path is TPU-only) ------------
+def test_histogram_mxu_matches_segment_sum():
+    from transmogrifai_tpu.ops.pallas_trees import histogram_mxu
+    from transmogrifai_tpu.ops.trees import histogram_segment_sum
+
+    rng = np.random.default_rng(5)
+    N, D, B, nodes = 300, 7, 8, 4  # deliberately unaligned: exercises padding
+    Xb = jnp.asarray(rng.integers(0, B, (N, D)), jnp.int32)
+    node = jnp.asarray(rng.integers(0, nodes, N), jnp.int32)
+    gh = jnp.asarray(rng.normal(size=(N, 2)), jnp.float32)
+    ref = np.asarray(histogram_segment_sum(gh, Xb, node, nodes, B))
+    out = np.asarray(histogram_mxu(gh, Xb, node, nodes, B, interpret=True))
+    assert out.shape == ref.shape == (nodes, D, B, 2)
+    # bf16 operands, f32 accumulation: ~2^-9 relative
+    np.testing.assert_allclose(out, ref, rtol=0, atol=6e-3 * np.abs(ref).max())
+
+
+def test_digitize_mxu_matches_compare_scan():
+    from transmogrifai_tpu.ops.pallas_trees import digitize_mxu
+
+    rng = np.random.default_rng(6)
+    X = jnp.asarray(rng.normal(size=(137, 5)), jnp.float32)
+    edges = quantile_bins(X, n_bins=16)
+    ref = np.asarray(bin_features(X, edges))  # the portable compare-scan path
+    out = np.asarray(digitize_mxu(X, edges, interpret=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_bin_features_ties_go_right():
+    # bin = #{edges <= x}: a value exactly ON an edge lands in the bin ABOVE it
+    edges = jnp.asarray([[1.0, 2.0, 3.0]], jnp.float32).T.reshape(1, 3)
+    X = jnp.asarray([[0.5], [1.0], [2.0], [3.0], [9.0]], jnp.float32)
+    assert np.asarray(bin_features(X, edges)).ravel().tolist() == [0, 1, 2, 3, 3]

@@ -56,44 +56,104 @@ class TreeEnsembleParams(NamedTuple):
     base: jnp.ndarray
 
 
+#: above this many rows, quantile edges come from a strided row sketch — the
+#: xgboost "approx sketch" analog; 128k rows bound the per-feature quantile
+#: error at ~O(1e-3) while cutting the O(N log N) per-feature sorts ~8x at 1M
+_QUANTILE_SKETCH_ROWS = 1 << 17
+
+#: N*D threshold for the pallas at-scale kernels (selector fits stay below it,
+#: so the nested folds x grid vmap never sees a pallas_call)
+_PALLAS_MIN_ELEMS = 1 << 24
+
+
+def _is_batched(*xs) -> bool:
+    """True when any arg is a vmap tracer — the pallas paths opt out under
+    vmap (the selector's folds x grid batching) and the jnp paths serve."""
+    try:
+        from jax.interpreters.batching import BatchTracer
+    except ImportError:  # moved in newer jax
+        from jax._src.interpreters.batching import BatchTracer
+
+    return any(isinstance(x, BatchTracer) for x in xs)
+
+
 def quantile_bins(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
-    """Per-feature quantile bin edges -> [D, n_bins - 1]."""
+    """Per-feature quantile bin edges -> [D, n_bins - 1].
+
+    Above _QUANTILE_SKETCH_ROWS rows a strided subsample estimates the
+    quantiles (deterministic, no RNG): at 1M x 256 the exact per-feature sorts
+    cost ~0.7 s on v5e for edges whose placement is statistically identical."""
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    if n > _QUANTILE_SKETCH_ROWS and not _is_batched(X):
+        stride = -(-n // _QUANTILE_SKETCH_ROWS)
+        X = X[::stride]
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    return jnp.quantile(jnp.asarray(X, jnp.float32), qs, axis=0).T
+    return jnp.quantile(X, qs, axis=0).T
 
 
 def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     """Digitize X [N, D] against per-feature edges [D, B-1] -> int32 bins in [0, B-1].
 
     bin b means edges[b-1] <= x < edges[b], so the split "bin <= b goes left" is
-    exactly "x < edges[b]" on raw values — inference never re-bins."""
+    exactly "x < edges[b]" on raw values — inference never re-bins.
+
+    Implementation: bin = #{edges <= x}, summed threshold compares under a
+    lax.scan (one compare pass per edge). NOT searchsorted: XLA lowers vmapped
+    binary search to a per-element while_loop with gathers — measured 15.8 s
+    for 1M x 256 on v5e vs ~0.2 s for the compare scan and ~10 ms for the
+    pallas single-pass kernel (digitize_mxu), which takes over on TPU at
+    large static shapes."""
     X = jnp.asarray(X, jnp.float32)
-    return jax.vmap(
-        lambda e, col: jnp.searchsorted(e, col, side="right"), in_axes=(0, 1), out_axes=1
-    )(edges, X).astype(jnp.int32)
+    if (backend_is_tpu() and X.size >= _PALLAS_MIN_ELEMS
+            and not _is_batched(X, edges)):
+        from .pallas_trees import digitize_mxu
+
+        return digitize_mxu(X, edges)
+
+    def step(acc, eb):  # eb [D]: one edge per feature
+        return acc + (X >= eb[None, :]).astype(jnp.int32), None
+
+    acc, _ = jax.lax.scan(step, jnp.zeros(X.shape, jnp.int32),
+                          jnp.asarray(edges, jnp.float32).T, unroll=8)
+    return acc
 
 
 def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
                n_nodes: int, n_bins: int) -> jnp.ndarray:
     """Sum `vals` [N, C] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, C].
 
-    Default path on TPU is the bin-wise MXU matmul decomposition
-    (histogram_binmm) — measured 3-13x the hand-written pallas one-hot kernel
-    and >10x the segment-sum scatter lowering (bench_extra.run_hist), because it
-    never materializes the [N, S] one-hot: per bin b, one [nodes*C, N] @ [N, D]
-    matmul whose mask operand is an elementwise compare XLA fuses into the
-    matmul read. Non-TPU backends default to the segment-sum (CPU scatter-add
-    beats CPU dense matmuls; binmm parity has its own test). TT_HIST=
-    binmm|pallas|segsum forces a specific path. All paths are pure
-    collectives-safe jnp: partial histograms psum across a row-sharded mesh axis
-    (the RDD treeAggregate replacement, SURVEY §2.12).
+    Default paths on TPU: the pallas bin-loop MXU kernel (pallas_trees.
+    histogram_mxu — reads each row tile into VMEM once, ~3.5x binmm, flat in
+    tree depth) for LARGE unbatched shapes, else the bin-wise MXU matmul
+    decomposition (histogram_binmm), which never materializes the [N, S]
+    one-hot: per bin b, one [nodes*C, N] @ [N, D] matmul whose mask operand is
+    an elementwise compare XLA fuses into the matmul read. Non-TPU backends
+    default to the segment-sum (CPU scatter-add beats CPU dense matmuls; binmm
+    parity has its own test). TT_HIST=binmm|mxu|pallas|segsum forces a
+    specific path. All paths are collectives-safe: partial histograms psum
+    across a row-sharded mesh axis (the RDD treeAggregate replacement, SURVEY
+    §2.12).
 
     NOTE: the mode is read at TRACE time — jit caches bake the chosen path per
     shape, so set TT_HIST before the first fit of a process (changing it later
     only affects not-yet-compiled shapes)."""
     mode = os.environ.get("TT_HIST")
     if mode is None:
-        mode = "binmm" if backend_is_tpu() else "segsum"
+        if backend_is_tpu():
+            from .pallas_trees import histogram_mxu_supported
+
+            big = (Xb.size >= _PALLAS_MIN_ELEMS
+                   and not _is_batched(vals, Xb, node)
+                   and histogram_mxu_supported(Xb.shape[0], Xb.shape[1],
+                                               n_nodes, vals.shape[1], n_bins))
+            mode = "mxu" if big else "binmm"
+        else:
+            mode = "segsum"
+    if mode == "mxu":
+        from .pallas_trees import histogram_mxu
+
+        return histogram_mxu(vals, Xb, node, n_nodes, n_bins)
     if mode == "pallas":
         from .pallas_hist import histogram_pallas
 
@@ -101,7 +161,8 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     if mode == "segsum":
         return histogram_segment_sum(vals, Xb, node, n_nodes, n_bins)
     if mode != "binmm":
-        raise ValueError(f"TT_HIST={mode!r}: expected binmm | pallas | segsum")
+        raise ValueError(
+            f"TT_HIST={mode!r}: expected binmm | mxu | pallas | segsum")
     return histogram_binmm(vals, Xb, node, n_nodes, n_bins)
 
 
@@ -114,7 +175,10 @@ def histogram_binmm(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     Folding (node, channel) into one small lane axis A = node1h (x) gh [N, n*C]
     turns each bin into ONE dense matmul A^T @ (Xb==b) — the MXU does the
     reduction, no scatter, no [N, n*bins] one-hot ever materializes. The scan
-    over bins is unrolled 8-wide so XLA overlaps mask builds with matmuls."""
+    over bins is unrolled 8-wide so XLA overlaps mask builds with matmuls.
+    This is the TPU default for SMALL/batched shapes (it vmaps under the
+    selector's folds x grid); large fits route to pallas_trees.histogram_mxu,
+    which avoids this path's per-bin HBM re-read of Xb (~3.5x at 1M x 256)."""
     N, D = Xb.shape
     C = vals.shape[1]
     node1h = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)  # [-1 pad rows -> 0]
@@ -178,6 +242,11 @@ def grow_tree(
     """
     N, D = Xb.shape
     n_bins = edges.shape[1] + 1
+    # at-scale TPU fits swap the row-gather routing and scatter leaf sums for
+    # one-hot compare/matmul forms (XLA's gather/scatter lowerings serialize);
+    # small (selector-vmapped) fits keep the jnp forms
+    big = (backend_is_tpu() and Xb.size >= _PALLAS_MIN_ELEMS
+           and not _is_batched(Xb, g, h))
     fmask = jnp.ones(D, bool) if feature_mask is None else feature_mask
     node = jnp.zeros(N, jnp.int32)  # level-local node id
     feats, threshs = [], []
@@ -220,12 +289,27 @@ def grow_tree(
         feats.append(best_d)
         threshs.append(thresh.astype(jnp.float32))
 
-        go_right = Xb[jnp.arange(N), best_d[node]] > best_b[node]
+        if big:
+            # gather-free routing: the per-row split feature is selected with a
+            # one-hot compare + integer sum (exact — bins < 2^31), because the
+            # row-varying column gather lowers poorly at scale on TPU
+            sel = best_d[node]  # [N] (small-table gather: fine)
+            oh = sel[:, None] == jnp.arange(D)[None, :]
+            xv = jnp.where(oh, Xb, 0).sum(axis=1)
+            go_right = xv > best_b[node]
+        else:
+            go_right = Xb[jnp.arange(N), best_d[node]] > best_b[node]
         node = node * 2 + go_right.astype(jnp.int32)
 
     n_leaves = 2 ** max_depth
-    Gleaf = jax.ops.segment_sum(g, node, num_segments=n_leaves)
-    Hleaf = jax.ops.segment_sum(h, node, num_segments=n_leaves)
+    if big:
+        # scatter-free leaf sums: one [leaves, N] @ [N, C] matmul each (f32 —
+        # leaf VALUES never see the histogram's bf16 rounding)
+        oh = (node[None, :] == jnp.arange(n_leaves)[:, None]).astype(jnp.float32)
+        Gleaf, Hleaf = oh @ g, oh @ h
+    else:
+        Gleaf = jax.ops.segment_sum(g, node, num_segments=n_leaves)
+        Hleaf = jax.ops.segment_sum(h, node, num_segments=n_leaves)
     leaf_values = -_l1_threshold(Gleaf, reg_alpha) / (Hleaf + reg_lambda + _EPS)
     return (
         jnp.concatenate(feats),
