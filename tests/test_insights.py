@@ -65,3 +65,61 @@ class TestModelInsights:
         model, pred = _train(with_selector=True)
         text = model.summary_pretty(pred)
         assert "Selected model" in text and "label" in text
+
+
+def test_slot_history_chain_threads_through_pipeline():
+    """Multi-hop provenance (OpVectorColumnHistory analog): each slot's history
+    records every stage op from the raw feature through the SanityChecker."""
+    model, pred = _train(with_selector=False)
+    table = model.score(keep_intermediate=True)
+    # find the sanity-checked vector column feeding the predictor
+    checked_name = pred.origin_stage.inputs[1].name
+    schema = table[checked_name].schema
+    assert schema is not None
+    non_pad = [s for s in schema if not s.is_padding]
+    assert non_pad, "expected real slots"
+    for s in non_pad:
+        assert s.history, f"slot {s.column_name()} has no history"
+        assert s.history[-1] == "sanityChecker"
+        assert "vecCombine" in s.history or len(s.history) >= 2
+    # JSON round trip preserves the chain
+    from transmogrifai_tpu.types.vector_schema import VectorSchema
+
+    rt = VectorSchema.from_json(schema.to_json())
+    assert [s.history for s in rt] == [s.history for s in schema]
+
+
+def test_record_insights_parser_round_trip():
+    """RecordInsightsParser analog: LOCO payloads parse into typed records with
+    slot provenance resolved against the vector schema."""
+    from transmogrifai_tpu.insights import (
+        RecordInsightsLOCO,
+        dump_record_insights,
+        parse_insights_column,
+        parse_record_insights,
+    )
+
+    model, pred = _train(with_selector=False)
+    table = model.score(keep_intermediate=True)
+    checked_feat = pred.origin_stage.inputs[1]
+    fitted = next(s for s in model.stages
+                  if s.get_output().name == pred.name)
+    loco = RecordInsightsLOCO.for_model(fitted, top_k=3)
+    loco(checked_feat, pred)
+    out = loco.transform_columns([table[checked_feat.name], table[pred.name]])
+    schema = table[checked_feat.name].schema
+    parsed = parse_insights_column(out, schema)
+    assert len(parsed) == table.nrows
+    row = parsed[0]
+    assert 0 < len(row) <= 3
+    assert all(isinstance(r.delta, float) for r in row)
+    # deltas ordered by magnitude, slots resolved with history
+    mags = [abs(r.delta) for r in row]
+    assert mags == sorted(mags, reverse=True)
+    resolved = [r for r in row if r.slot is not None]
+    assert resolved and all(r.slot.history for r in resolved)
+    # round trip
+    payload = dump_record_insights(row)
+    again = parse_record_insights(payload, schema)
+    assert [(r.slot_name, r.delta) for r in again] == \
+        [(r.slot_name, r.delta) for r in row]

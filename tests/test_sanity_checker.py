@@ -184,3 +184,47 @@ def test_regression_label_skips_categorical_tests(rng):
     X = rng.normal(size=(300, 2)).astype(np.float32)
     _, model, _ = _fit_checker(X, y)
     assert model.summary_.categorical_groups == []
+
+
+def test_pmi_recorded_per_group_and_slot(rng):
+    """PMI (bits) and mutual information land in the summary per contingency
+    group and per slot (reference OpStatistics pointwiseMutualInfo consumed at
+    SanityChecker.scala:420+)."""
+    y = rng.integers(0, 2, 400).astype(np.float32)
+    onehot = np.stack([y, 1 - y], axis=1).astype(np.float32)  # perfect assoc.
+    noise = rng.normal(size=(400, 1)).astype(np.float32)
+    X = np.concatenate([onehot, noise], axis=1)
+    schema = VectorSchema((
+        SlotInfo("cat", "PickList", group="cat", indicator_value="A"),
+        SlotInfo("cat", "PickList", group="cat", indicator_value="B"),
+        SlotInfo("num", "Real", descriptor="value"),
+    ))
+    _, model, _ = _fit_checker(X, y, schema=schema, max_correlation=2.0,
+                               max_cramers_v=2.0)
+    summ = model.summary_
+    [grp] = summ.categorical_groups
+    assert grp["mutual_info"] > 0.9  # perfect association ~ H(label) ~ 1 bit
+    assert set(grp["pointwise_mutual_info"]) == {"0.0", "1.0"}
+    # slot A indicates label 1: positive PMI with label 1; the (A, label 0)
+    # cell is an exact zero count -> PMI 0 (the reference's v==0 guard)
+    pmi_a = grp["pointwise_mutual_info"]
+    assert pmi_a["1.0"][0] > 0 and pmi_a["0.0"][0] == 0.0
+    by_name = {s.name: s for s in summ.slot_stats}
+    assert by_name["cat_cat_A"].pmi_with_label is not None
+    assert by_name["cat_cat_A"].pmi_with_label[1] > 0
+    assert by_name["num_value"].pmi_with_label is None  # continuous slot
+
+
+def test_pmi_matches_reference_formula():
+    """jnp PMI/MI ops agree with the log2 closed form of a known table."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops.stats import mutual_information
+
+    table = jnp.asarray([[30.0, 10.0], [10.0, 30.0]])
+    pmi = np.asarray(pointwise_mutual_info(table))
+    # p(x0,y0)=3/8, p(x0)=p(y0)=1/2 -> log2(1.5)
+    np.testing.assert_allclose(pmi[0, 0], np.log2(1.5), atol=1e-5)
+    mi = float(mutual_information(table))
+    expected = (2 * (3 / 8) * np.log2(1.5) + 2 * (1 / 8) * np.log2(0.5))
+    np.testing.assert_allclose(mi, expected, atol=1e-5)

@@ -58,6 +58,23 @@ def _rule_confidence_np(t: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return conf, row / n
 
 
+def _pmi_np(t: np.ndarray) -> tuple[np.ndarray, float]:
+    """numpy mirror of ops.stats.pointwise_mutual_info/mutual_information:
+    (PMI matrix [K, C] in bits, total mutual information in bits) — the
+    reference's OpStatistics.mutualInfo (OpStatistics.scala:234-271)."""
+    t = np.asarray(t, np.float64)
+    n = t.sum() + _EPS
+    pxy = t / n
+    px = pxy.sum(1, keepdims=True)
+    py = pxy.sum(0, keepdims=True)
+    safe = (pxy > _EPS) & (px > _EPS) & (py > _EPS)
+    pmi = np.where(
+        safe,
+        np.log2(np.clip(pxy, _EPS, None) / np.clip(px * py, _EPS, None)), 0.0)
+    mi = float((pmi * pxy).sum())
+    return pmi, mi
+
+
 @dataclass
 class SlotStats:
     """Per-slot diagnostics (SanityCheckerMetadata column entries)."""
@@ -71,6 +88,9 @@ class SlotStats:
     cramers_v: Optional[float] = None
     max_rule_confidence: Optional[float] = None
     support: Optional[float] = None
+    #: this indicator's PMI with each label value (bits), label order = the
+    #: group's "labels" list (OpStatistics pointwiseMutualInfo row)
+    pmi_with_label: Optional[list] = None
 
 
 @dataclass
@@ -186,6 +206,7 @@ class SanityChecker(Estimator):
         group_cv: dict[tuple, float] = {}
         slot_conf = np.full(X.shape[1], np.nan)
         slot_support = np.full(X.shape[1], np.nan)
+        slot_pmi: dict[int, list] = {}
         categorical_groups = []
         groups = schema.groups()
         if label_is_categorical:
@@ -212,13 +233,23 @@ class SanityChecker(Estimator):
                 pos += len(idxs)
                 cv = _cramers_v_np(table)
                 conf, support = _rule_confidence_np(table)
+                pmi, mi = _pmi_np(table)
                 group_cv[key] = cv
                 for j, i in enumerate(idxs):
                     slot_conf[i] = float(conf[j])
                     slot_support[i] = float(support[j])
+                    slot_pmi[i] = [round(float(v), 6) for v in pmi[j]]
                 categorical_groups.append(
                     {"group": "_".join(str(k) for k in key if k is not None),
-                     "cramers_v": cv, "slots": [schema[i].column_name() for i in idxs]}
+                     "cramers_v": cv,
+                     "mutual_info": mi,
+                     "labels": [float(u) for u in uniq],
+                     "pointwise_mutual_info": {
+                         str(float(uniq[c])): [round(float(v), 6)
+                                               for v in pmi[:, c]]
+                         for c in range(pmi.shape[1])
+                     },
+                     "slots": [schema[i].column_name() for i in idxs]}
                 )
 
         # --- drop decisions ----------------------------------------------------------
@@ -270,6 +301,7 @@ class SanityChecker(Estimator):
                     cramers_v=group_cv.get(schema[i].grouping_key()),
                     max_rule_confidence=(None if np.isnan(slot_conf[i]) else float(slot_conf[i])),
                     support=(None if np.isnan(slot_support[i]) else float(slot_support[i])),
+                    pmi_with_label=slot_pmi.get(i),
                 )
                 for i in range(X.shape[1]) if i not in pad_idx
             ],

@@ -128,6 +128,30 @@ class Feature:
             stack.extend(f.parents)
         return out
 
+    def lineage_ops(self) -> tuple[str, ...]:
+        """Operation names of the stages between the raw ancestors and this
+        feature, ancestor-first (the OpVectorColumnHistory stage-chain analog,
+        OpVectorColumnMetadata.scala:67-204). Raw generator stages are elided;
+        consecutive duplicates collapse (diamond lineage)."""
+        ops: list[str] = []
+        seen: set[int] = set()
+        stack: list[tuple["Feature", bool]] = [(self, False)]
+        while stack:
+            f, done = stack.pop()
+            if done:
+                if (f.origin_stage is not None and not f.is_raw
+                        and getattr(f.origin_stage, "operation_name", None)):
+                    op = f.origin_stage.operation_name
+                    if not ops or ops[-1] != op:
+                        ops.append(op)
+                continue
+            if id(f) in seen:
+                continue
+            seen.add(id(f))
+            stack.append((f, True))
+            stack.extend((p, False) for p in f.parents)
+        return tuple(ops)
+
     def pretty_lineage(self, indent: int = 0) -> str:
         """Human-readable lineage tree (analog of prettyParentStages)."""
         pad = "  " * indent

@@ -1,6 +1,12 @@
 from .corr import RecordInsightsCorr, slot_score_correlations
 from .loco import RecordInsightsLOCO, loco_deltas
 from .model_insights import FeatureInsight, ModelInsights, model_insights
+from .parser import (
+    RecordInsight,
+    dump_record_insights,
+    parse_insights_column,
+    parse_record_insights,
+)
 
 __all__ = [
     "ModelInsights",
@@ -8,6 +14,10 @@ __all__ = [
     "model_insights",
     "RecordInsightsLOCO",
     "RecordInsightsCorr",
+    "RecordInsight",
     "slot_score_correlations",
     "loco_deltas",
+    "parse_record_insights",
+    "parse_insights_column",
+    "dump_record_insights",
 ]

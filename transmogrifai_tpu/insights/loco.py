@@ -95,7 +95,13 @@ class RecordInsightsLOCO(Transformer):
         X = jnp.asarray(vec.values, jnp.float32)
         deltas = loco_deltas(self.model.predict, X, self.params["slot_batch"])
         k = min(self.params["top_k"], X.shape[1])
-        top_vals, top_idx = jax.lax.top_k(jnp.abs(deltas), k)
+        # inert width-bucketing pad slots carry zero signal by construction —
+        # they must never be NAMED in a per-row explanation (ranked below every
+        # real slot and filtered from the emitted entries)
+        pad = (np.array([s.is_padding for s in vec.schema], bool)
+               if vec.schema is not None else np.zeros(X.shape[1], bool))
+        ranked = jnp.where(jnp.asarray(pad)[None, :], -1.0, jnp.abs(deltas))
+        top_vals, top_idx = jax.lax.top_k(ranked, k)
         top_idx = np.asarray(top_idx)
         deltas_np = np.asarray(deltas)
         names = (
@@ -108,7 +114,7 @@ class RecordInsightsLOCO(Transformer):
             out[i] = json.dumps(
                 [
                     {"name": names[j], "delta": round(float(deltas_np[i, j]), 6)}
-                    for j in top_idx[i]
+                    for j in top_idx[i] if not pad[j]
                 ]
             )
         return Column(kind_of("Text"), out, None)

@@ -30,6 +30,9 @@ class SlotInsight:
     variance: Optional[float] = None
     mean: Optional[float] = None
     cramers_v: Optional[float] = None
+    #: PMI (bits) of this indicator with each label value (OpStatistics
+    #: pointwiseMutualInfo row; label order = the checker group's labels list)
+    pmi_with_label: Optional[list] = None
     contribution: Optional[float] = None
     dropped_reason: Optional[str] = None
 
@@ -216,6 +219,7 @@ def model_insights(model: "WorkflowModel", feature: "Feature") -> ModelInsights:
                 variance=st.variance,
                 mean=st.mean,
                 cramers_v=st.cramers_v,
+                pmi_with_label=getattr(st, "pmi_with_label", None),
                 dropped_reason=dropped.get(st.name),
             )
             if st.name not in dropped:

@@ -1,0 +1,70 @@
+"""RecordInsightsParser: typed access to per-row LOCO insight payloads.
+
+Analog of the reference RecordInsightsParser (core/src/main/scala/com/salesforce/
+op/stages/impl/insights/RecordInsightsParser.scala), which parses the LOCO
+output map back into `OpVectorColumnHistory -> strength` pairs for consumers.
+Here the LOCO stage (insights/loco.py) emits one JSON string per row — a list
+of {"name", "delta"} ordered by |delta| — and this module parses it back into
+typed records, optionally resolving each slot name against a VectorSchema so
+consumers get the full SlotInfo provenance (parent feature, indicator,
+multi-hop history) instead of a display string.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from ..types.vector_schema import SlotInfo, VectorSchema
+
+
+@dataclass(frozen=True)
+class RecordInsight:
+    """One slot's contribution to one scored row (parsed LOCO entry)."""
+
+    slot_name: str
+    delta: float
+    #: resolved provenance when a schema was supplied to the parser
+    slot: Optional[SlotInfo] = None
+
+    def to_json(self) -> dict:
+        return {"name": self.slot_name, "delta": self.delta}
+
+
+def parse_record_insights(
+    payload: str, schema: Optional[VectorSchema] = None
+) -> list[RecordInsight]:
+    """Parse one row's LOCO JSON payload -> typed records, ordered as emitted
+    (descending |delta|). With a schema, slot names resolve to SlotInfo —
+    unknown names (schema drift) resolve to None rather than erroring."""
+    by_name: dict[str, SlotInfo] = {}
+    if schema is not None:
+        for s in schema:
+            by_name[s.column_name()] = s
+    entries = json.loads(payload)
+    if not isinstance(entries, list):
+        raise ValueError(f"record insight payload must be a JSON list, "
+                         f"got {type(entries).__name__}")
+    out = []
+    for e in entries:
+        out.append(RecordInsight(
+            slot_name=str(e["name"]),
+            delta=float(e["delta"]),
+            slot=by_name.get(str(e["name"])),
+        ))
+    return out
+
+
+def parse_insights_column(
+    column, schema: Optional[VectorSchema] = None
+) -> list[list[RecordInsight]]:
+    """Parse a whole LOCO Text column (Column or iterable of JSON strings)."""
+    values: Iterable = (column.to_list() if hasattr(column, "to_list")
+                        else column)
+    return [parse_record_insights(v, schema) if v is not None else []
+            for v in values]
+
+
+def dump_record_insights(insights: Iterable[RecordInsight]) -> str:
+    """Inverse of parse_record_insights (round-trip serialization)."""
+    return json.dumps([r.to_json() for r in insights])

@@ -129,15 +129,28 @@ def cramers_v(table: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def pointwise_mutual_info(table: jnp.ndarray) -> jnp.ndarray:
-    """PMI matrix [K, C] in nats: log(p(x,y) / (p(x) p(y)))
-    (OpStatistics contingency PMI); empty cells yield 0."""
+    """PMI matrix [K, C] in BITS: log2(p(x,y) / (p(x) p(y))) — base 2 to match
+    the reference (OpStatistics.mutualInfo divides by log(2),
+    OpStatistics.scala:258); empty cells/rows/cols yield 0."""
     t = jnp.asarray(table, jnp.float32)
     n = t.sum() + _EPS
     pxy = t / n
     px = pxy.sum(1, keepdims=True)
     py = pxy.sum(0, keepdims=True)
     safe = (pxy > _EPS) & (px > _EPS) & (py > _EPS)
-    return jnp.where(safe, jnp.log(jnp.clip(pxy, _EPS, None) / jnp.clip(px * py, _EPS, None)), 0.0)
+    return jnp.where(
+        safe,
+        jnp.log2(jnp.clip(pxy, _EPS, None) / jnp.clip(px * py, _EPS, None)),
+        0.0)
+
+
+@jax.jit
+def mutual_information(table: jnp.ndarray) -> jnp.ndarray:
+    """Total mutual information (bits) of a contingency table [K, C]:
+    sum of PMI * p(x,y) (OpStatistics.mutualInfo, OpStatistics.scala:269)."""
+    t = jnp.asarray(table, jnp.float32)
+    n = t.sum() + _EPS
+    return (pointwise_mutual_info(t) * t / n).sum()
 
 
 @jax.jit

@@ -36,6 +36,19 @@ if TYPE_CHECKING:  # graph imports stages at module level; keep the reverse edge
 STAGE_REGISTRY: dict[str, type] = {}
 
 
+def attach_slot_history(col: Column, stage: "Stage") -> Column:
+    """Thread multi-hop slot provenance (OpVectorColumnHistory analog) through a
+    stage's output: every schema slot gains this stage's operation name, seeded
+    from the parent feature's lineage when the slot is fresh. Pure static-aux
+    work — safe inside a jit trace (schemas never live on device)."""
+    schema = getattr(col, "schema", None)
+    if schema is None or not getattr(stage, "operation_name", None):
+        return col
+    lineage_of = {f.name: f.lineage_ops() for f in stage.inputs}
+    new_schema = schema.with_history_hop(stage.operation_name, lineage_of)
+    return Column(col.kind, col.values, col.mask, schema=new_schema)
+
+
 def register_stage(cls):
     """Class decorator: add to the serialization registry."""
     STAGE_REGISTRY[cls.__name__] = cls
@@ -137,7 +150,8 @@ class Transformer(Stage):
         raise NotImplementedError
 
     def transform_table(self, table: Table) -> Table:
-        out = self.transform_columns([table[f.name] for f in self.inputs])
+        out = attach_slot_history(
+            self.transform_columns([table[f.name] for f in self.inputs]), self)
         return table.with_column(self.get_output().name, out)
 
 

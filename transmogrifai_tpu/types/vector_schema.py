@@ -28,6 +28,10 @@ class SlotInfo:
     indicator_value: Optional[str] = None
     #: free-form descriptor for non-indicator slots (e.g. "x"/"y" of a date unit circle)
     descriptor: Optional[str] = None
+    #: multi-hop stage provenance: operation names from the raw ancestor through
+    #: every stage this slot passed (OpVectorColumnHistory analog,
+    #: OpVectorColumnMetadata.scala:67-204); appended by the transform plan
+    history: tuple = ()
 
     @property
     def is_padding(self) -> bool:
@@ -163,13 +167,33 @@ class VectorSchema:
                 "group": s.group,
                 "indicator_value": s.indicator_value,
                 "descriptor": s.descriptor,
+                "history": list(s.history),
             }
             for s in self.slots
         ]
 
     @staticmethod
     def from_json(data: Iterable[dict]) -> "VectorSchema":
-        return VectorSchema(tuple(SlotInfo(**d) for d in data))
+        return VectorSchema(tuple(
+            SlotInfo(**{**d, "history": tuple(d.get("history", ()))})
+            for d in data
+        ))
+
+    def with_history_hop(self, stage_op: str,
+                         lineage_of: dict) -> "VectorSchema":
+        """Append one stage hop to every slot's history; slots with no history
+        yet are seeded from their parent feature's lineage (`lineage_of` maps
+        feature name -> tuple of ancestor ops). Padding slots stay bare."""
+        from dataclasses import replace
+
+        out = []
+        for s in self.slots:
+            if s.is_padding:
+                out.append(s)
+                continue
+            base = s.history or lineage_of.get(s.parent_feature, ())
+            out.append(replace(s, history=tuple(base) + (stage_op,)))
+        return VectorSchema(tuple(out))
 
 
 def slots_for(
