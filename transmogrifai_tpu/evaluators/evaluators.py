@@ -18,13 +18,9 @@ import numpy as np
 from ..graph.feature import Feature
 from ..types import Table
 from .metrics_ops import (
-    binary_curve_aucs,
-    confusion_at,
-    confusion_matrix,
-    multiclass_prf,
-    multiclass_threshold_counts,
+    binary_metrics_fused,
+    multiclass_metrics_fused,
     regression_metrics_ops,
-    threshold_sweep,
 )
 
 
@@ -138,28 +134,20 @@ class BinaryClassificationEvaluator(EvaluatorBase):
         self.sweep = (np.linspace(0.0, 1.0, 101) if sweep_thresholds is None
                       else np.asarray(sweep_thresholds))
 
-    def evaluate_all(self, table: Table) -> BinaryClassificationMetrics:
-        label, pred = self._cols(table)
-        vals, ok = _valid_labels(label)
-        y_np = vals[ok].astype(np.float32)
-        if y_np.size == 0:  # nothing labeled: defined zeros, not an empty-array crash
-            return BinaryClassificationMetrics(0.0, 0.0, 0.0, 0.0, 0.0, 0.0,
-                                               0.0, 0.0, 0.0, 0.0)
-        # slice/mask on HOST: eager device slicing would dispatch a fresh tiny
-        # program per new shape (expensive on a tunneled device); the kernels
-        # below are the only device work
-        prob_np = np.asarray(pred.prob)  # one device->host transfer
-        scores_np = prob_np[:, 1] if prob_np.shape[1] > 1 else prob_np[:, 0]
-        scores = jnp.asarray(scores_np[ok])
-        y = jnp.asarray(y_np)
-        auroc, aupr = binary_curve_aucs(scores, y)
-        tn, fp, fn, tp = confusion_at(scores, y, self.threshold)
-        p_th, r_th, f_th = threshold_sweep(scores, y, self.sweep)
-        # ONE device->host transfer for everything: per-element float() would issue
-        # hundreds of scalar fetches, each paying full device round-trip latency
-        (auroc, aupr, tp, tn, fp, fn, p_th, r_th, f_th) = jax.device_get(
-            (auroc, aupr, tp, tn, fp, fn, p_th, r_th, f_th))
+    def device_metrics(self, pred, raw, prob, y):
+        """Pure-jnp metric tensors — traceable inside a larger jit (the
+        ModelSelector fuses predict + metrics into ONE program, one fetch)."""
+        scores = prob[:, 1] if prob.shape[1] > 1 else prob[:, 0]
+        return binary_metrics_fused(scores, jnp.asarray(y, jnp.float32),
+                                    self.threshold,
+                                    jnp.asarray(self.sweep, jnp.float32))
+
+    def assemble(self, fetched) -> BinaryClassificationMetrics:
+        """Host-side metrics object from the fetched device_metrics tensors."""
+        auroc, aupr, tp, tn, fp, fn, p_th, r_th, f_th = (
+            np.asarray(v) for v in fetched)
         # derived scalars in host float math (mirrors metrics_ops.prf exactly)
+        tp, tn, fp, fn = float(tp), float(tn), float(fp), float(fn)
         precision = tp / max(tp + fp, 1.0)
         recall = tp / max(tp + fn, 1.0)
         f1 = 2 * precision * recall / max(precision + recall, 1e-12)
@@ -168,12 +156,29 @@ class BinaryClassificationEvaluator(EvaluatorBase):
             AuROC=float(auroc), AuPR=float(aupr),
             Precision=float(precision), Recall=float(recall), F1=float(f1),
             Error=float(error),
-            TP=float(tp), TN=float(tn), FP=float(fp), FN=float(fn),
+            TP=tp, TN=tn, FP=fp, FN=fn,
             thresholds=np.asarray(self.sweep, np.float64).tolist(),
             precision_by_threshold=np.asarray(p_th, np.float64).tolist(),
             recall_by_threshold=np.asarray(r_th, np.float64).tolist(),
             f1_by_threshold=np.asarray(f_th, np.float64).tolist(),
         )
+
+    def evaluate_all(self, table: Table) -> BinaryClassificationMetrics:
+        label, pred = self._cols(table)
+        vals, ok = _valid_labels(label)
+        y_np = vals[ok].astype(np.float32)
+        if y_np.size == 0:  # nothing labeled: defined zeros, not an empty-array crash
+            return BinaryClassificationMetrics(0.0, 0.0, 0.0, 0.0, 0.0, 0.0,
+                                               0.0, 0.0, 0.0, 0.0)
+        # slice/mask on HOST: eager device slicing would dispatch a fresh tiny
+        # program per new shape (expensive on a tunneled device); ONE device
+        # program + ONE fetch is the only device work
+        prob_np = np.asarray(pred.prob)  # one device->host transfer
+        scores_np = prob_np[:, 1] if prob_np.shape[1] > 1 else prob_np[:, 0]
+        fetched = jax.device_get(binary_metrics_fused(
+            jnp.asarray(scores_np[ok]), jnp.asarray(y_np),
+            self.threshold, jnp.asarray(self.sweep, jnp.float32)))
+        return self.assemble(fetched)
 
 
 class MultiClassificationEvaluator(EvaluatorBase):
@@ -195,6 +200,43 @@ class MultiClassificationEvaluator(EvaluatorBase):
         if ((self.thresholds < 0) | (self.thresholds > 1)).any():
             raise ValueError("thresholds must be in [0, 1]")
 
+    def device_metrics(self, pred, raw, prob, y, num_classes: Optional[int] = None):
+        """Pure-jnp metric tensors (one fused program) — traceable inside a
+        larger jit. num_classes must be static (self.num_classes or the arg)."""
+        nc = num_classes or self.num_classes
+        if not nc:
+            raise ValueError("device_metrics needs a static num_classes")
+        return multiclass_metrics_fused(
+            jnp.asarray(pred, jnp.int32), jnp.asarray(y, jnp.int32), prob,
+            jnp.asarray(self.thresholds, jnp.float32), nc, self.top_ns)
+
+    def assemble(self, fetched) -> MultiClassificationMetrics:
+        conf, stats, cor, incor, nopred = fetched
+        tm = None
+        if self.top_ns:
+            tm = ThresholdMetrics(
+                topNs=list(self.top_ns),
+                thresholds=self.thresholds.tolist(),
+                correct_counts={t: np.asarray(cor[i]).tolist()
+                                for i, t in enumerate(self.top_ns)},
+                incorrect_counts={t: np.asarray(incor[i]).tolist()
+                                  for i, t in enumerate(self.top_ns)},
+                no_prediction_counts={t: np.asarray(nopred[i]).tolist()
+                                      for i, t in enumerate(self.top_ns)},
+            )
+        conf = np.asarray(conf)
+        correct = float(np.diag(conf).sum())
+        total = max(float(conf.sum()), 1.0)
+        return MultiClassificationMetrics(
+            Precision=float(stats["weighted_precision"]),
+            Recall=float(stats["weighted_recall"]),
+            F1=float(stats["weighted_f1"]),
+            Error=1.0 - correct / total,
+            confusion=conf.tolist(),
+            per_class_f1=[float(x) for x in np.asarray(stats["per_class_f1"])],
+            threshold_metrics=tm,
+        )
+
     def evaluate_all(self, table: Table) -> MultiClassificationMetrics:
         label, pred = self._cols(table)
         vals, ok = _valid_labels(label)
@@ -203,41 +245,30 @@ class MultiClassificationEvaluator(EvaluatorBase):
         if y.size == 0:
             return MultiClassificationMetrics(0.0, 0.0, 0.0, 0.0)
         nc = self.num_classes or int(max(y.max(), p.max())) + 1
-        conf = confusion_matrix(p, y, nc)
-        stats = multiclass_prf(conf)
-        tm = None
-        if self.top_ns:
-            probs = np.asarray(pred.prob)[ok]
-            cor, incor, nopred = multiclass_threshold_counts(
-                probs, y, jnp.asarray(self.thresholds, jnp.float32), self.top_ns)
-            cor, incor, nopred = jax.device_get((cor, incor, nopred))
-            tm = ThresholdMetrics(
-                topNs=list(self.top_ns),
-                thresholds=self.thresholds.tolist(),
-                correct_counts={t: cor[i].tolist()
-                                for i, t in enumerate(self.top_ns)},
-                incorrect_counts={t: incor[i].tolist()
-                                  for i, t in enumerate(self.top_ns)},
-                no_prediction_counts={t: nopred[i].tolist()
-                                      for i, t in enumerate(self.top_ns)},
-            )
-        conf, stats = jax.device_get((conf, stats))
-        correct = float(np.diag(conf).sum())
-        total = max(float(conf.sum()), 1.0)
-        return MultiClassificationMetrics(
-            Precision=float(stats["weighted_precision"]),
-            Recall=float(stats["weighted_recall"]),
-            F1=float(stats["weighted_f1"]),
-            Error=1.0 - correct / total,
-            confusion=np.asarray(conf).tolist(),
-            per_class_f1=[float(x) for x in stats["per_class_f1"]],
-            threshold_metrics=tm,
-        )
+        # ONE device program + ONE fetch for confusion + PRF + threshold sweep:
+        # separate calls each pay a full round trip on a tunneled device, and
+        # this runs twice per selector fit (train + holdout metrics)
+        probs = (np.asarray(pred.prob)[ok] if self.top_ns
+                 else np.zeros((y.size, nc), np.float32))
+        fetched = jax.device_get(self.device_metrics(p, None, probs, y, nc))
+        return self.assemble(fetched)
 
 
 class RegressionEvaluator(EvaluatorBase):
     default_metric = "RootMeanSquaredError"
     larger_is_better = False
+
+    def device_metrics(self, pred, raw, prob, y):
+        """Pure-jnp (mse, rmse, mae, r2) — traceable inside a larger jit."""
+        return regression_metrics_ops(jnp.asarray(pred, jnp.float32),
+                                      jnp.asarray(y, jnp.float32))
+
+    def assemble(self, fetched) -> RegressionMetrics:
+        mse, rmse, mae, r2 = fetched
+        return RegressionMetrics(
+            RootMeanSquaredError=float(rmse), MeanSquaredError=float(mse),
+            MeanAbsoluteError=float(mae), R2=float(r2),
+        )
 
     def evaluate_all(self, table: Table) -> RegressionMetrics:
         label, pred = self._cols(table)
@@ -246,12 +277,8 @@ class RegressionEvaluator(EvaluatorBase):
         if y_np.size == 0:
             return RegressionMetrics(0.0, 0.0, 0.0, 0.0)
         # mask on host (numpy) — eager device gathers dispatch a program per shape
-        mse, rmse, mae, r2 = regression_metrics_ops(
-            jnp.asarray(np.asarray(pred.pred)[ok]), jnp.asarray(y_np))
-        return RegressionMetrics(
-            RootMeanSquaredError=float(rmse), MeanSquaredError=float(mse),
-            MeanAbsoluteError=float(mae), R2=float(r2),
-        )
+        return self.assemble(jax.device_get(self.device_metrics(
+            jnp.asarray(np.asarray(pred.pred)[ok]), None, None, y_np)))
 
 
 class Evaluators:

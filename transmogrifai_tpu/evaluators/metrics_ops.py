@@ -91,16 +91,30 @@ def threshold_sweep(scores: jnp.ndarray, labels: jnp.ndarray, thresholds: jnp.nd
     return prf(tp, fp, fn)
 
 
-@partial(jax.jit, static_argnums=(2,))
-def confusion_matrix(pred, labels, num_classes: int):
-    """[C, C] confusion (rows=label, cols=pred) via one-hot matmul — MXU-friendly."""
+@jax.jit
+def binary_metrics_fused(scores, y, threshold, sweep):
+    """AUCs + confusion-at-threshold + threshold sweep as ONE program / ONE
+    fetch (each separate call pays a full round trip on a tunneled device).
+    Also traceable inside a larger jit: the selector fuses predict+metrics."""
+    auroc, aupr = binary_curve_aucs(scores, y)
+    tn, fp, fn, tp = confusion_at(scores, y, threshold)
+    p_th, r_th, f_th = threshold_sweep(scores, y, sweep)
+    return auroc, aupr, tp, tn, fp, fn, p_th, r_th, f_th
+
+
+def _confusion_matrix_impl(pred, labels, num_classes: int):
     p = jax.nn.one_hot(jnp.asarray(pred, jnp.int32), num_classes)
     l = jax.nn.one_hot(jnp.asarray(labels, jnp.int32), num_classes)
     return l.T @ p
 
 
-@jax.jit
-def multiclass_prf(conf):
+@partial(jax.jit, static_argnums=(2,))
+def confusion_matrix(pred, labels, num_classes: int):
+    """[C, C] confusion (rows=label, cols=pred) via one-hot matmul — MXU-friendly."""
+    return _confusion_matrix_impl(pred, labels, num_classes)
+
+
+def _multiclass_prf_impl(conf):
     tp = jnp.diag(conf)
     fp = conf.sum(axis=0) - tp
     fn = conf.sum(axis=1) - tp
@@ -118,6 +132,9 @@ def multiclass_prf(conf):
     }
 
 
+multiclass_prf = jax.jit(_multiclass_prf_impl)
+
+
 @jax.jit
 def regression_metrics_ops(pred: jnp.ndarray, labels: jnp.ndarray):
     pred = jnp.asarray(pred, jnp.float32)
@@ -132,8 +149,24 @@ def regression_metrics_ops(pred: jnp.ndarray, labels: jnp.ndarray):
     return mse, rmse, mae, r2
 
 
-@partial(jax.jit, static_argnums=(3,))
-def multiclass_threshold_counts(probs, labels, thresholds, top_ns: tuple):
+@partial(jax.jit, static_argnums=(4, 5))
+def multiclass_metrics_fused(pred, labels, probs, thresholds,
+                             num_classes: int, top_ns: tuple):
+    """Confusion + weighted PRF + threshold counts as ONE program so the caller
+    pays ONE dispatch and ONE device->host fetch — on a tunneled device each
+    separate fetch costs a ~90ms round trip, and the multiclass evaluator runs
+    twice per selector fit (train + holdout)."""
+    conf = _confusion_matrix_impl(pred, labels, num_classes)
+    stats = _multiclass_prf_impl(conf)
+    if top_ns:
+        cor, incor, nopred = _multiclass_threshold_counts_impl(
+            probs, labels, thresholds, top_ns)
+    else:
+        cor = incor = nopred = jnp.zeros((0, 0), jnp.int32)
+    return conf, stats, cor, incor, nopred
+
+
+def _multiclass_threshold_counts_impl(probs, labels, thresholds, top_ns: tuple):
     """Per-(topN, threshold) correct / incorrect / no-prediction counts (reference
     OpMultiClassificationEvaluator.calculateThresholdMetrics semantics, .scala:89-269)
     as ONE vectorized pass — no per-row host loop, no treeAggregate.
@@ -173,3 +206,7 @@ def multiclass_threshold_counts(probs, labels, thresholds, top_ns: tuple):
         incorrects.append(incorrect.sum(axis=0).astype(jnp.int32))
     return (jnp.stack(corrects), jnp.stack(incorrects),
             jnp.broadcast_to(no_pred, (len(top_ns), th.shape[0])))
+
+
+multiclass_threshold_counts = partial(jax.jit, static_argnums=(3,))(
+    _multiclass_threshold_counts_impl)

@@ -10,6 +10,7 @@ evaluators. The search itself is device-batched (see validator.py).
 """
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -18,7 +19,6 @@ import numpy as np
 from ..evaluators.evaluators import Evaluators
 from ..stages.base import register_stage
 from ..stages.model.base import PredictorEstimator
-from ..types import Column, Table
 from .grids import ParamGridBuilder
 from .splitters import DataBalancer, DataCutter, DataSplitter, SplitterSummary
 from .validator import (
@@ -152,12 +152,18 @@ class ModelSelector(PredictorEstimator):
 
     # the selector's own fit is the whole search; fit_fn/predict_fn are the winner's
     def fit_columns(self, cols):
+        import jax
+        import jax.numpy as jnp
+
         y_full, X_full = self.label_and_matrix(cols)
-        y_np = np.asarray(y_full, np.float32)
-        X_np = np.asarray(X_full, np.float32)
+        y_np = np.asarray(y_full, np.float32)  # split/fold logic is host numpy
 
         train_idx, holdout_idx = self.splitter.split_indices(y_np)
-        y_tr, X_tr = y_np[train_idx], X_np[train_idx]
+        # the matrix stays DEVICE-resident end to end (search -> refit ->
+        # metrics): row slices are device gathers, and the host copy is fetched
+        # only where actually needed (checkpoint fingerprint, per-fold CV path)
+        X_tr = jnp.take(X_full, jnp.asarray(train_idx), axis=0)
+        y_tr = y_np[train_idx]
         weights, label_map, split_summary = self.splitter.prepare(y_tr)
 
         num_classes = 0
@@ -180,7 +186,8 @@ class ModelSelector(PredictorEstimator):
         if self.checkpoint_path:
             from .checkpoint import SearchCheckpoint, search_fingerprint
 
-            fp = search_fingerprint(X_tr, y_used, weights, val_masks, keep,
+            fp = search_fingerprint(np.asarray(X_tr, np.float32), y_used,
+                                    weights, val_masks, keep,
                                     self.problem_type, self.metric, models)
             ckpt = SearchCheckpoint(self.checkpoint_path, fp)
         with profiling.phase("selector:search"):
@@ -234,16 +241,13 @@ class ModelSelector(PredictorEstimator):
         template = models[best.candidate_index][0]
         best_est = template.with_params(**best.grid_point)
 
-        import jax.numpy as jnp
-
         with profiling.phase("selector:refit"):
-            params = best_est.fit_fn(jnp.asarray(X_tr), jnp.asarray(y_used),
+            # no block_until_ready: the refit output flows straight into the
+            # fused predict+metrics programs — forcing it here would add one
+            # ~90ms tunnel round trip purely for phase attribution
+            params = best_est.fit_fn(X_tr, jnp.asarray(y_used),
                                      sample_weight=jnp.asarray(weights),
                                      **best_est.fit_kwargs())
-            import jax
-
-            jax.block_until_ready(params)
-        model = best_est.make_model(params)
 
         summary = ModelSelectorSummary(
             validation_type=self.validator.validation_type,
@@ -258,24 +262,38 @@ class ModelSelector(PredictorEstimator):
             n_holdout=len(holdout_idx),
             models_evaluated=len(results) * val_masks.shape[0],
         )
+        # metrics run as ONE fused predict+metrics program per pass (one
+        # dispatch + one fetch each — each extra device call costs a ~90ms
+        # round trip on a tunneled device); the metrics objects are then
+        # assembled on host by the exact evaluators
+        ev = _metrics_evaluator(self.problem_type, num_classes)
+        prog = _metrics_program(best_est, ev, self.problem_type, num_classes)
         # train metrics over kept rows only — cutter-dropped rows carry weight 0 and
         # were remapped to class 0, so including them would corrupt the report
         kept_rows = weights > 0
         with profiling.phase("selector:train_metrics"):
-            summary.train_metrics = self._metrics_on(
-                model, X_tr[kept_rows], y_used[kept_rows])
+            if kept_rows.all():
+                Xk, yk = X_tr, y_used
+            else:
+                ki = jnp.asarray(np.nonzero(kept_rows)[0])
+                Xk, yk = jnp.take(X_tr, ki, axis=0), y_used[kept_rows]
+            summary.train_metrics = ev.assemble(jax.device_get(
+                prog(params, Xk, jnp.asarray(yk, jnp.float32))))
         if len(holdout_idx):
             with profiling.phase("selector:holdout_metrics"):
                 y_h = y_np[holdout_idx]
+                h_idx = np.asarray(holdout_idx)
                 if label_map is not None:
                     keep_h = np.asarray([float(v) in label_map for v in y_h])
-                    y_h = np.asarray(
-                        [label_map.get(float(v), 0) for v in y_h], np.float32)
-                    summary.holdout_metrics = self._metrics_on(
-                        model, X_np[holdout_idx][keep_h], y_h[keep_h])
-                else:
-                    summary.holdout_metrics = self._metrics_on(
-                        model, X_np[holdout_idx], y_h)
+                    h_idx = h_idx[keep_h]
+                    y_h = np.asarray([label_map.get(float(v), 0)
+                                      for v in y_h[keep_h]], np.float32)
+                X_h = jnp.take(X_full, jnp.asarray(h_idx), axis=0)
+                summary.holdout_metrics = ev.assemble(jax.device_get(
+                    prog(params, X_h, jnp.asarray(y_h, jnp.float32))))
+        # the returned fitted stage is built AFTER the metric programs: its
+        # host-list param conversion forces a device fetch of the weights
+        model = best_est.make_model(params)
         if ckpt is not None and not getattr(self, "_defer_checkpoint_complete", False):
             # fit finished: next fit starts a fresh search. A checkpointed
             # Workflow.train defers this removal to TRAIN end — a kill during a
@@ -285,21 +303,57 @@ class ModelSelector(PredictorEstimator):
         model.selector_summary = summary
         return model
 
-    def _metrics_on(self, model, X, y):
-        """Exact metrics via the host evaluators on an ad-hoc scored table."""
-        import jax.numpy as jnp
 
-        pred, raw, prob = model.predict(jnp.asarray(X, jnp.float32))
-        table = Table({
-            "label": Column.real(y, kind="Real"),
-            "pred": Column.prediction(pred, raw, prob),
-        })
-        ev = {
-            "binary": Evaluators.binary_classification,
-            "multiclass": Evaluators.multi_classification,
-            "regression": Evaluators.regression,
-        }[self.problem_type]("label", "pred")
-        return ev.evaluate_all(table)
+#: fused predict+metrics jit programs, keyed by (model family, problem type,
+#: num_classes) — see _metrics_program. Default-config evaluators only (the
+#: selector builds its own); custom-threshold evaluators go through
+#: evaluate_all on a scored table instead.
+_METRICS_PROGRAM_CACHE: dict = {}
+_EVALUATOR_CACHE: dict = {}
+
+
+def _metrics_evaluator(problem_type: str, num_classes: int):
+    key = (problem_type, num_classes)
+    ev = _EVALUATOR_CACHE.get(key)
+    if ev is None:
+        ev = _EVALUATOR_CACHE[key] = {
+            "binary": lambda: Evaluators.binary_classification("label", "pred"),
+            "multiclass": lambda: Evaluators.multi_classification(
+                "label", "pred", num_classes=num_classes),
+            "regression": lambda: Evaluators.regression("label", "pred"),
+        }[problem_type]()
+    return ev
+
+
+def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
+    """ONE jitted program: winner's predict_fn -> evaluator.device_metrics.
+    Params ride as ARGUMENTS (not baked constants), so the program caches
+    across trains of the same family/shapes; the caller pays one dispatch and
+    one fetch per metrics pass. The key includes the template's ctor params:
+    predict_fn can be instance-BOUND and branch on them (NaiveBayes
+    model_type, GLM family), so two configs of one class must not share a
+    traced program."""
+    from ..stages.base import _jsonify
+
+    try:
+        cfg = json.dumps(_jsonify(template.params), sort_keys=True)
+    except TypeError:
+        cfg = repr(sorted(template.params.items(), key=lambda kv: kv[0]))
+    key = (template.__class__, cfg, problem_type, num_classes)
+    fn = _METRICS_PROGRAM_CACHE.get(key)
+    if fn is None:
+        import jax
+
+        if problem_type == "multiclass":
+            def prog(params, X, y):
+                pred, raw, prob = template.predict_fn(params, X)
+                return evaluator.device_metrics(pred, raw, prob, y, num_classes)
+        else:
+            def prog(params, X, y):
+                pred, raw, prob = template.predict_fn(params, X)
+                return evaluator.device_metrics(pred, raw, prob, y)
+        fn = _METRICS_PROGRAM_CACHE[key] = jax.jit(prog)
+    return fn
 
 
 def default_splitter(problem_type: str, seed: int = 42) -> DataSplitter:

@@ -161,6 +161,20 @@ _SEARCH_PROGRAM_CACHE: dict = {}
 _SEARCH_PROGRAM_LOCK = threading.Lock()
 
 
+@jax.jit
+def _fold_weights(tw, vm, keepd):
+    """(train_weights [N], val_masks [K,N], keep [N]) -> per-fold train/val
+    weight grids, as one program."""
+    return tw[None, :] * (1.0 - vm), keepd[None, :] * vm
+
+
+@jax.jit
+def _concat_flat(arrays):
+    """Flatten+concatenate unit results in ONE program (the fused-fetch path);
+    eager ravel/concat would dispatch per array."""
+    return jnp.concatenate([jnp.ravel(a) for a in arrays])
+
+
 def _hashable(v):
     """Canonicalize a static param value for the cache key (lists -> tuples, e.g.
     MLP hidden-layer sizes)."""
@@ -250,8 +264,9 @@ def evaluate_candidates(
     tw = jnp.asarray(train_weights, jnp.float32)
     vm = jnp.asarray(val_masks, jnp.float32)
     keepd = jnp.asarray(keep, jnp.float32)
-    fold_train_w = tw[None, :] * (1.0 - vm)  # [K, N]
-    fold_val_w = keepd[None, :] * vm  # [K, N]
+    # ONE dispatch for both [K, N] weight grids (eager broadcasts would be
+    # 3-4 separate tiny programs — each a round trip on a tunneled device)
+    fold_train_w, fold_val_w = _fold_weights(tw, vm, keepd)
 
     n_model = 1
     wide = False
@@ -330,16 +345,21 @@ def evaluate_candidates(
                           "vmap_names": tuple(sorted(stacks)),
                           "hyper": hyper, "ck_key": ck_key, "n_points": n_points})
 
-    def run_unit(u) -> np.ndarray:
+    def run_unit(u):
+        """Dispatch one group's program; returns the DEVICE [K, G_padded] array.
+        No host fetch here: over a tunneled device each fetch is a ~90ms round
+        trip, so all units' results are fetched in ONE transfer afterwards."""
         program = _search_program(
             u["template"], u["static_items"], u["vmap_names"],
             problem_type, metric, num_classes, per_fold_X=per_fold_X,
         )
         if u["hyper"] is not None:
-            return np.asarray(
-                program(Xd, yd, fold_train_w, fold_val_w, u["hyper"])
-            )[:, :u["n_points"]]  # [K, G] (padding trimmed)
-        return np.asarray(program(Xd, yd, fold_train_w, fold_val_w))[:, None]
+            return program(Xd, yd, fold_train_w, fold_val_w, u["hyper"])
+        return program(Xd, yd, fold_train_w, fold_val_w)[:, None]
+
+    def trim(u, scores_padded: np.ndarray) -> np.ndarray:
+        return scores_padded[:, :u["n_points"]] if u["hyper"] is not None \
+            else scores_padded
 
     def finish(u, scores) -> None:
         """Record one completed group (and checkpoint it IMMEDIATELY — a kill while
@@ -366,7 +386,28 @@ def evaluate_candidates(
     # threads overlaps the XLA compilations (compile releases the GIL; device
     # execution serializes on the runtime regardless). Measured ~1.7x on two cold
     # tree programs. TT_PARALLEL_COMPILE=0 forces the serial path.
-    if len(live) > 1 and os.environ.get("TT_PARALLEL_COMPILE", "1") != "0":
+    use_threads = (len(live) > 1
+                   and os.environ.get("TT_PARALLEL_COMPILE", "1") != "0")
+    if checkpoint is None:
+        # latency path: dispatch every unit's program (async), then ONE fused
+        # host fetch for all results — each per-unit np.asarray would pay a
+        # ~90ms tunnel round trip, and searches have 3-8 units
+        if use_threads:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(min(4, len(live))) as ex:
+                devs = list(ex.map(run_unit, live))
+        else:
+            devs = [run_unit(u) for u in live]
+        if devs:
+            shapes = [d.shape for d in devs]
+            flat = np.asarray(_concat_flat(devs))
+            off = 0
+            for u, shp in zip(live, shapes):
+                size = int(np.prod(shp))
+                finish(u, trim(u, flat[off:off + size].reshape(shp)))
+                off += size
+    elif use_threads:
         from concurrent.futures import ThreadPoolExecutor, as_completed
 
         errors: list[BaseException] = []
@@ -380,7 +421,8 @@ def evaluate_candidates(
             try:
                 for fut in as_completed(by_future):
                     try:
-                        finish(by_future[fut], fut.result())
+                        u = by_future[fut]
+                        finish(u, trim(u, np.asarray(fut.result())))
                     except BaseException as e:  # noqa: BLE001
                         errors.append(e)
             except BaseException as e:  # noqa: BLE001
@@ -394,7 +436,7 @@ def evaluate_candidates(
             for fut, u in by_future.items():
                 if fut.done() and not fut.cancelled() and "group_results" not in u:
                     try:
-                        finish(u, fut.result())
+                        finish(u, trim(u, np.asarray(fut.result())))
                     except (KeyboardInterrupt, SystemExit) as ie:
                         errors.append(ie)  # an interrupt during drain still outranks
                     except BaseException:  # noqa: BLE001
@@ -407,7 +449,7 @@ def evaluate_candidates(
             raise errors[0]
     else:
         for u in live:
-            finish(u, run_unit(u))
+            finish(u, trim(u, np.asarray(run_unit(u))))
 
     results: list[EvaluatedGridPoint] = []
     for u in units:  # original order: results are deterministic either way

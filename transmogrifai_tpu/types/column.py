@@ -43,7 +43,7 @@ class Column:
     mask: bool array [N]; True = value present. None for vector/prediction/host storage.
     """
 
-    __slots__ = ("kind", "values", "mask", "schema")
+    __slots__ = ("kind", "values", "mask", "schema", "_device_col")
 
     def __init__(
         self,

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -34,26 +35,95 @@ from ..stages.base import (
     FeatureGeneratorStage,
     Stage,
     Transformer,
+    _jsonify,
     adopt_wiring,
 )
 from ..types import Column, Table
 from ..utils import uid as make_uid
 
 
-def _fuse_device_run(stages: Sequence[Transformer]) -> Callable[[dict], dict]:
-    """One jit program applying a run of device transformers; input/output = dicts of
-    Columns (pytrees)."""
-    out_names = [s.get_output().name for s in stages]
+def _device_resident(c):
+    """Memoized device copy of a numeric column: the upload happens once per
+    COLUMN, not once per fused-run call — a raw Table reused across trains
+    (the AutoML steady state) would otherwise re-upload every input column on
+    every train, each a round trip on a tunneled device. The original column
+    keeps its host values (and full f64 precision) for writers; compute sees
+    the same f32 demotion jnp.asarray applies inside the jit anyway."""
+    import jax.numpy as jnp
 
-    def fn(cols: dict) -> dict:
-        cols = dict(cols)
-        for s in stages:
-            cols[s.get_output().name] = s.transform_columns(
-                [cols[f.name] for f in s.inputs]
-            )
-        return {n: cols[n] for n in out_names}
+    v = c.values
+    if isinstance(v, jax.Array) or not isinstance(v, np.ndarray) \
+            or v.dtype == object or v.dtype.kind in "US":
+        return c
+    cached = getattr(c, "_device_col", None)
+    if cached is None:
+        mask = c.mask
+        if isinstance(mask, np.ndarray):
+            mask = jnp.asarray(mask)
+        cached = Column(c.kind, jnp.asarray(v), mask, schema=c.schema)
+        c._device_col = cached
+    return cached
 
-    return jax.jit(fn)
+
+#: traced fused-run programs shared across _CompiledPlan instances, keyed by
+#: (input names, wiring positions, fitted-param fingerprint). A fresh graph
+#: whose fits land on identical params (the AutoML steady state: same data,
+#: same config, new uids) reuses the traced program instead of re-tracing a
+#: new jit wrapper every train (~0.6s/train measured on iris). LRU-bounded:
+#: each entry pins its fitted stage objects + compiled executables, so a
+#: long-lived service training on ever-changing data must evict.
+_FUSED_RUN_CACHE: OrderedDict = OrderedDict()
+_FUSED_RUN_CACHE_MAX = 64
+_FUSED_FINGERPRINT_MAX = 1 << 16
+
+
+def _fuse_device_run(stages: Sequence[Transformer],
+                     in_names: Sequence[str]) -> Callable[[tuple], tuple]:
+    """One jit program applying a run of device transformers over a TUPLE of
+    input columns. Inputs are positional so the per-train uid-bearing feature
+    NAMES never enter the trace — the python-level jit cache would otherwise
+    miss on every new graph. Returns one output column per stage, in order."""
+    pos = {n: i for i, n in enumerate(in_names)}
+    out_index = {s.get_output().name: si for si, s in enumerate(stages)}
+    wiring = tuple(
+        tuple(("m", out_index[f.name]) if f.name in out_index
+              else ("i", pos[f.name]) for f in s.inputs)
+        for s in stages)
+    key = None
+    try:
+        fps = tuple(
+            json.dumps({"c": type(s).__name__, "p": _jsonify(s.params)},
+                       sort_keys=True)
+            for s in stages)
+        if sum(map(len, fps)) <= _FUSED_FINGERPRINT_MAX:
+            # in_names is part of the key: stages with identical params over
+            # DIFFERENT inputs must not share a program (output VectorSchemas
+            # name parents). Raw-feature names are uid-free, so the layer-0
+            # run — the expensive one — still hits across fresh graphs.
+            key = (tuple(in_names), wiring, fps)
+    except TypeError:
+        pass  # unfingerprintable params: fall back to a per-plan program
+    if key is not None:
+        cached = _FUSED_RUN_CACHE.get(key)
+        if cached is not None:
+            _FUSED_RUN_CACHE.move_to_end(key)
+            return cached
+
+    def fn(cols: tuple) -> tuple:
+        from ..stages.base import attach_slot_history
+
+        mid: dict[int, Column] = {}
+        for si, s in enumerate(stages):
+            ins = [mid[j] if tag == "m" else cols[j] for tag, j in wiring[si]]
+            mid[si] = attach_slot_history(s.transform_columns(ins), s)
+        return tuple(mid[si] for si in range(len(stages)))
+
+    jfn = jax.jit(fn)
+    if key is not None:
+        _FUSED_RUN_CACHE[key] = jfn
+        while len(_FUSED_RUN_CACHE) > _FUSED_RUN_CACHE_MAX:
+            _FUSED_RUN_CACHE.popitem(last=False)
+    return jfn
 
 
 class _CompiledPlan:
@@ -80,13 +150,17 @@ class _CompiledPlan:
     def apply(self, table: Table, jit_fuse: bool = True) -> Table:
         for gi, (kind, stages) in enumerate(self.groups):
             if kind == "device" and jit_fuse:
-                fn = self._jitted.get(gi)
-                if fn is None:
-                    fn = self._jitted[gi] = _fuse_device_run(stages)
-                produced = {s.get_output().name for s in stages}
-                needed = {f.name for s in stages for f in s.inputs} - produced
-                outs = fn({n: table[n] for n in needed})
-                table = table.with_columns(outs)
+                entry = self._jitted.get(gi)
+                if entry is None:
+                    produced = {s.get_output().name for s in stages}
+                    needed = sorted({f.name for s in stages
+                                     for f in s.inputs} - produced)
+                    entry = self._jitted[gi] = (
+                        _fuse_device_run(stages, needed), needed)
+                fn, needed = entry
+                outs = fn(tuple(_device_resident(table[n]) for n in needed))
+                table = table.with_columns(
+                    {s.get_output().name: c for s, c in zip(stages, outs)})
             else:
                 for s in stages:
                     table = s.transform_table(table)
