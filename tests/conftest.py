@@ -17,6 +17,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Subprocess tests must not depend on the TPU relay: with the pool var set,
+# the sitecustomize startup hook makes `import jax` dial the relay even under
+# JAX_PLATFORMS=cpu — if the relay is down, every spawned python hangs. The
+# pop shields subprocesses (they inherit this env); the PARENT process's
+# registration is already baked at interpreter startup, so when the relay is
+# down pytest itself must be launched with PALLAS_AXON_POOL_IPS= (blank).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 

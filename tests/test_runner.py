@@ -304,7 +304,8 @@ def test_codegen_project_runs(tmp_path, monkeypatch):
     env = dict(os.environ)
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
-        [sys.executable, "main.py", "--type", "train", "--data", str(data)],
+        [sys.executable, "main.py", "--type", "train", "--smoke",
+         "--data", str(data)],
         cwd=str(proj), env=env, capture_output=True, text=True, timeout=600,
     )
     assert out.returncode == 0, out.stderr[-2000:]
@@ -339,7 +340,8 @@ def test_codegen_string_response_runs(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
-        [sys.executable, "main.py", "--type", "train", "--data", str(data)],
+        [sys.executable, "main.py", "--type", "train", "--smoke",
+         "--data", str(data)],
         cwd=str(tmp_path / "strproj"), env=env, capture_output=True, text=True,
         timeout=600,
     )
@@ -450,7 +452,8 @@ def test_codegen_from_avro(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
-        [sys.executable, "main.py", "--type", "train", "--data", str(data)],
+        [sys.executable, "main.py", "--type", "train", "--smoke",
+         "--data", str(data)],
         cwd=str(tmp_path / "avroproj"), env=env, capture_output=True, text=True,
         timeout=600,
     )

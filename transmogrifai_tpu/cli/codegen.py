@@ -23,6 +23,15 @@ _KIND_TO_SELECTOR = {
     "regression": ("RegressionModelSelector", "RootMeanSquaredError"),
 }
 
+#: single-family search used by the generated project's --smoke flag: a fast
+#: end-to-end validation run (the full default grids take minutes of CPU time
+#: on small hosts, which is the wrong bill for "does my generated project run")
+_KIND_TO_SMOKE_MODEL = {
+    "binary": "LogisticRegression",
+    "multiclass": "MultinomialLogisticRegression",
+    "regression": "LinearRegression",
+}
+
 
 def _is_numeric(values: Sequence[str]) -> bool:
     present = [v for v in values if v not in (None, "")]
@@ -131,6 +140,7 @@ def generate_project(
     )
 
     reader_cls = "AvroReader" if is_avro else "CSVReader"
+    smoke_model_cls = _KIND_TO_SMOKE_MODEL[problem]
     predictors = [n for n in schema if n not in (id_field, response_field)]
     feature_lines = "\n".join(
         f'    {_ident(n)} = features["{n}"]' for n in predictors
@@ -150,6 +160,7 @@ from transmogrifai_tpu.params import OpParams
 from transmogrifai_tpu.readers import {reader_cls}
 from transmogrifai_tpu.select import {selector_cls}
 from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import {smoke_model_cls}
 from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
 
 SCHEMA = {json.dumps(schema, indent=4)}
@@ -157,14 +168,17 @@ ID_FIELD = {id_field!r}
 RESPONSE = {response_field!r}
 
 
-def make_runner(data_path: str) -> WorkflowRunner:
+def make_runner(data_path: str, smoke: bool = False) -> WorkflowRunner:
     features = features_from_schema(SCHEMA, response=RESPONSE)
 {feature_lines}
     predictors = [f for n, f in features.items() if n not in (ID_FIELD, RESPONSE)]
     response = {response_expr}
     vector = transmogrify(predictors)
+    # --smoke: one fast family / one grid point / 2 folds — validates the whole
+    # pipeline end-to-end in seconds; the default is the full reference grids
+    models = [({smoke_model_cls}(), [{{"l2": 0.1}}])] if smoke else None
     selector = {selector_cls}.with_cross_validation(
-        num_folds=3, validation_metric={metric!r}
+        num_folds=2 if smoke else 3, validation_metric={metric!r}, models=models
     )
     prediction = selector(response, vector)
     workflow = Workflow().set_result_features(prediction, response)
@@ -182,9 +196,11 @@ def main() -> None:
     ap.add_argument("--type", default="train", choices=["train", "score", "features", "evaluate"])
     ap.add_argument("--data", default={input_csv!r})
     ap.add_argument("--params", default=None, help="OpParams JSON file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast single-family search (pipeline validation)")
     args = ap.parse_args()
     params = OpParams.from_json(args.params) if args.params else OpParams()
-    result = make_runner(args.data).run(args.type, params)
+    result = make_runner(args.data, smoke=args.smoke).run(args.type, params)
     print(f"{{result.run_type}} done:", result.metrics or result.write_location or "")
 
 

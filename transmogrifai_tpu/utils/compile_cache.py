@@ -8,9 +8,42 @@ $TT_COMPILE_CACHE_DIR.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 
 _ENABLED = False
+
+
+def _host_namespace() -> str:
+    """Cache subdirectory per (backend platform, host CPU fingerprint).
+
+    XLA's cache key does NOT include host CPU features: a CPU AOT blob
+    compiled on one machine loads on another (cpu_aot_loader warns) and runs
+    with that machine's lowering choices — up to and including SIGILL when
+    ISA sets genuinely differ. The workdir persists across driver rounds that
+    may land on different hosts, so namespace CPU entries by cpuinfo flags.
+    (Note: the loader also warns when XLA's compile-time feature set merely
+    disagrees with its runtime detection on the SAME machine — the warning
+    alone does not prove cross-machine contamination.)"""
+    import jax
+
+    platform = jax.default_backend()
+    if platform != "cpu":
+        # accelerator AOT is device-targeted, not host-CPU-targeted: keep the
+        # base dir itself so warm entries survive across hosts and upgrades
+        return ""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        import platform as _plat
+
+        flags = _plat.processor() or _plat.machine()
+    return f"cpu-{hashlib.sha256(flags.encode()).hexdigest()[:12]}"
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> bool:
@@ -26,6 +59,9 @@ def enable_compile_cache(cache_dir: str | None = None) -> bool:
     cache_dir = (cache_dir or os.environ.get("TT_COMPILE_CACHE_DIR")
                  or os.path.join(os.path.dirname(os.path.dirname(
                      os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    ns = _host_namespace()
+    if ns:
+        cache_dir = os.path.join(cache_dir, ns)
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache EVERY program, even sub-second ones: over a tunneled/remote compile
