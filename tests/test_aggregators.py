@@ -474,3 +474,99 @@ def test_conditional_keys_align_with_dropped_rows():
     )
     t = r.generate_table([amount])
     assert t["key"].to_list() == r.keys() == ["u1", "u3"]
+
+
+# ---------------------------------------------------------------------------------------
+# Post-join secondary aggregation (reference JoinedAggregateDataReader,
+# JoinedDataReader.scala:356-447; test cases mirror
+# JoinedDataReaderDataGenerationTest's "secondary aggregation" suite)
+# ---------------------------------------------------------------------------------------
+def _post_join_setup(window_ms=None, drop_time_columns=False):
+    from transmogrifai_tpu.readers import left_outer_join
+
+    name = FeatureBuilder.Text("name").extract(lambda r: r["name"]).as_predictor()
+    cutoff = FeatureBuilder.Date("cutoff").extract(lambda r: r["cutoff"]).as_predictor()
+    amount = FeatureBuilder.Real("amount").extract(lambda r: r["amount"]).as_predictor()
+    etime = FeatureBuilder.Date("etime").extract(lambda r: r["etime"]).as_predictor()
+    churned = (FeatureBuilder.Binary("churned")
+               .extract(lambda r: r["churned"]).as_response())
+    left = InMemoryReader(
+        [{"k": "a", "name": "ann", "cutoff": 50},
+         {"k": "b", "name": "bob", "cutoff": 50},
+         {"k": "c", "name": "cat", "cutoff": 50}],
+        key_fn=lambda r: r["k"],
+    )
+    right = InMemoryReader(
+        [{"k": "a", "etime": 10, "amount": 2.0, "churned": False},
+         {"k": "a", "etime": 20, "amount": 3.0, "churned": False},
+         {"k": "a", "etime": 60, "amount": 100.0, "churned": True},
+         {"k": "b", "etime": 45, "amount": 7.0, "churned": False},
+         {"k": "b", "etime": 49, "amount": None, "churned": False}],
+        key_fn=lambda r: r["k"],
+    )
+    reader = left_outer_join(
+        left, right, ["amount", "etime", "churned"]
+    ).with_aggregation(
+        TimeBasedFilter(time_column="etime", cutoff_column="cutoff"),
+        window_ms=window_ms, drop_time_columns=drop_time_columns,
+    )
+    return reader, [name, cutoff, amount, etime, churned]
+
+
+def test_post_join_secondary_aggregation_rolls_up_right():
+    reader, feats = _post_join_setup()
+    t = reader.generate_table(feats)
+    assert t["key"].to_list() == ["a", "b", "c"]
+    # left (parent) features keep one copy per key
+    assert t["name"].to_list() == ["ann", "bob", "cat"]
+    # predictor monoid (Real default: sum) over rows with etime < cutoff only:
+    # a: 2+3 (the t=60 event is past the cutoff); b: 7 (None event skipped);
+    # c: no events -> empty
+    assert t["amount"].to_list()[0] == pytest.approx(5.0)
+    assert t["amount"].to_list()[1] == pytest.approx(7.0)
+    assert t["amount"].to_list()[2] is None
+    # response monoid gates the other way: etime >= cutoff
+    assert t["churned"].to_list() == [True, None, None]
+
+
+def test_post_join_aggregation_duplicate_right_keys_need_with_aggregation():
+    from transmogrifai_tpu.readers import left_outer_join
+
+    reader, feats = _post_join_setup()
+    plain = left_outer_join(reader.left, reader.right,
+                            ["amount", "etime", "churned"])
+    with pytest.raises(ValueError, match="duplicate key"):
+        plain.generate_table(feats)
+
+
+def test_post_join_aggregation_window_and_drop_columns():
+    reader, feats = _post_join_setup(window_ms=15, drop_time_columns=True)
+    t = reader.generate_table(feats)
+    # predictor window [cutoff-15, cutoff): only b's t=45 event survives
+    assert t["amount"].to_list()[0] is None
+    assert t["amount"].to_list()[1] == pytest.approx(7.0)
+    assert "etime" not in t.names()
+    assert "cutoff" not in t.names()
+    assert "name" in t.names() and "amount" in t.names()
+
+
+def test_post_join_aggregation_outer_right_only_groups():
+    from transmogrifai_tpu.readers import outer_join
+
+    reader, feats = _post_join_setup()
+    r2 = outer_join(reader.left, reader.right, ["amount", "etime", "churned"])
+    right_plus = InMemoryReader(
+        list(reader.right._records) + [{"k": "z", "etime": 10, "amount": 4.0,
+                                 "churned": False}],
+        key_fn=lambda r: r["k"],
+    )
+    agg = outer_join(reader.left, right_plus, ["amount", "etime", "churned"]
+                     ).with_aggregation(
+        TimeBasedFilter(time_column="etime", cutoff_column="cutoff"))
+    t = agg.generate_table(feats)
+    assert t["key"].to_list() == ["a", "b", "c", "z"]
+    # right-only group: no left row -> cutoff None (read as 0) -> t >= 0 is a
+    # RESPONSE window; the predictor amount can never be before a 0 cutoff
+    assert t["name"].to_list()[3] is None
+    assert t["amount"].to_list()[3] is None
+    del r2

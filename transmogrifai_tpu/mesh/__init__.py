@@ -1,8 +1,10 @@
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    global_batch_from_process_shards,
     make_mesh,
     make_multislice_mesh,
+    process_local_batch,
     shard_batch,
     shard_grid,
     shard_wide,
@@ -14,8 +16,10 @@ from .mesh import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "global_batch_from_process_shards",
     "make_mesh",
     "make_multislice_mesh",
+    "process_local_batch",
     "shard_batch",
     "shard_grid",
     "shard_wide",

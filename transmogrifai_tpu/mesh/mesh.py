@@ -156,6 +156,46 @@ def shard_for_training(mesh: Mesh, X, y, wide_threshold: Optional[int] = None):
     return Xs, ys
 
 
+def process_local_batch(mesh: Mesh, local_rows, batch_dim: int = 0):
+    """Multi-host ingestion (SURVEY §2.7's TPU column): each PROCESS passes only
+    the rows its own reader loaded, and jax assembles the global DATA_AXIS-
+    sharded array without any host ever holding the full matrix
+    (jax.make_array_from_process_local_data). Single-process meshes degenerate
+    to a plain sharded device_put — same call site either way."""
+    spec = [None] * np.ndim(local_rows)
+    spec[batch_dim] = DATA_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    local = np.asarray(local_rows)
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def global_batch_from_process_shards(mesh: Mesh, local_parts: Sequence,
+                                     batch_dim: int = 0):
+    """Assemble a DATA_AXIS-sharded global array from PER-PROCESS local row
+    blocks on a single controller — the dryrun/test twin of
+    `process_local_batch` (which takes only this process's block): each block
+    lands on its contiguous share of the data axis via
+    jax.make_array_from_single_device_arrays, so the construction exercises the
+    same per-shard placement a real pod performs, without N hosts."""
+    parts = [np.asarray(p) for p in local_parts]
+    n_total = sum(p.shape[batch_dim] for p in parts)
+    n_data = mesh.shape[DATA_AXIS]
+    if n_total % n_data != 0:
+        raise ValueError(f"{n_total} rows do not divide the data axis ({n_data})")
+    flat = np.concatenate(parts, axis=batch_dim)  # single-controller only
+    shape = flat.shape
+    spec = [None] * flat.ndim
+    spec[batch_dim] = DATA_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    arrays = [
+        jax.device_put(flat[idx], device)
+        for device, idx in sharding.addressable_devices_indices_map(shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrays)
+
+
 def pad_to_multiple(arr, multiple: int, axis: int = 0, fill=0):
     """Pad a batch axis so it divides the mesh (XLA needs even shards); returns
     (padded, original_length)."""

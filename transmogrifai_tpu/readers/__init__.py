@@ -6,12 +6,14 @@ from .base import DataReader, InMemoryReader, TableReader
 from .csv import CSVAutoReader, CSVReader, ParquetReader, infer_schema
 from .joined import (
     JoinKeys,
+    JoinedAggregateReader,
     JoinedReader,
     TimeBasedFilter,
     inner_join,
     left_outer_join,
     outer_join,
 )
+from .process_shard import ProcessShardedReader
 from .streaming import (
     BatchStreamingReader,
     CSVStreamingReader,
@@ -119,6 +121,8 @@ __all__ = [
     "Conditional",
     "AggregateReader",
     "ConditionalReader",
+    "JoinedAggregateReader",
+    "ProcessShardedReader",
     "JoinedReader",
     "JoinKeys",
     "TimeBasedFilter",

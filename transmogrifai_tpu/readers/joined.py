@@ -7,8 +7,13 @@ joined right row's cutoff. Spark's shuffle join becomes a host-side hash join ov
 two generated Tables (ingestion-scale data lives on host anyway); the joined Table then
 shards onto the device mesh downstream like any other.
 
-The right side must produce one row per key — aggregate it first (AggregateReader), the
-same constraint the reference enforces by requiring AggregatedReader on the right.
+The right side must produce one row per key — aggregate it first (AggregateReader) —
+UNLESS post-join aggregation is requested: `JoinedAggregateReader` (or
+`JoinedReader.with_aggregation(...)`) joins every matching right row and then rolls the
+joined rows up per result key with each feature's monoid, gated by the TimeBasedFilter
+window semantics (analog of JoinedAggregateDataReader + JoinedConditionalAggregator,
+JoinedDataReader.scala:356-447) — the "time-filtered events joined then aggregated"
+pattern of the reference's event readers.
 """
 from __future__ import annotations
 
@@ -106,14 +111,16 @@ class JoinedReader(DataReader):
                     f"TimeBasedFilter columns {sorted(missing)} not in joined schema "
                     f"{sorted(available)}; the leakage guard would silently no-op"
                 )
-        rindex: dict[str, int] = {}
+        rindex: dict[str, list[int]] = {}
         for i, k in enumerate(rkeys):
-            if k in rindex:
+            rindex.setdefault(k, []).append(i)
+        if not self._multi_right_ok:
+            dup = next((k for k, v in rindex.items() if len(v) > 1), None)
+            if dup is not None:
                 raise ValueError(
-                    f"right side has duplicate key {k!r}; aggregate it first "
-                    "(wrap in AggregateReader)"
+                    f"right side has duplicate key {dup!r}; aggregate it first "
+                    "(wrap in AggregateReader) or use with_aggregation()"
                 )
-            rindex[k] = i
 
         lrows = lt.to_rows()
         rrows = rt.to_rows()
@@ -122,26 +129,29 @@ class JoinedReader(DataReader):
         out_keys: list[str] = []
         matched_right: set[str] = set()
         for lk, lrow in zip(lkeys, lrows):
-            ri = rindex.get(lk)
-            if ri is None and self.join_type == "inner":
+            matches = rindex.get(lk)
+            if matches is None and self.join_type == "inner":
                 continue
-            row = dict(lrow)
-            rrow = rrows[ri] if ri is not None else {f.name: None for f in right_feats}
-            row.update(rrow)
-            if self.time_filter is not None:
-                t = row.get(self.time_filter.time_column)
-                c = row.get(self.time_filter.cutoff_column)
-                if c is None or ri is None:
-                    if not self.time_filter.keep_if_right_missing:
+            for ri in matches if matches is not None else [None]:
+                row = dict(lrow)
+                rrow = (rrows[ri] if ri is not None
+                        else {f.name: None for f in right_feats})
+                row.update(rrow)
+                if self.time_filter is not None:
+                    t = row.get(self.time_filter.time_column)
+                    c = row.get(self.time_filter.cutoff_column)
+                    if c is None or ri is None:
+                        if not self.time_filter.keep_if_right_missing:
+                            continue
+                    elif t is not None and int(t) >= int(c):
                         continue
-                elif t is not None and int(t) >= int(c):
-                    continue
-            # mark only on emit: a right row whose every left match was time-filtered
-            # away must still survive an outer join as a right-only row
-            if ri is not None:
-                matched_right.add(lk)
-            out_rows.append(row)
-            out_keys.append(lk)
+                # mark only on emit: a right row whose every left match was
+                # time-filtered away must still survive an outer join as a
+                # right-only row
+                if ri is not None:
+                    matched_right.add(lk)
+                out_rows.append(row)
+                out_keys.append(lk)
         if self.join_type == "outer":
             for rk, rrow in zip(rkeys, rrows):
                 if rk in matched_right:
@@ -151,12 +161,140 @@ class JoinedReader(DataReader):
                 out_rows.append(row)
                 out_keys.append(rk)
 
+        return self._build_output(out_rows, out_keys, raw_features,
+                                  left_feats, right_feats)
+
+    #: subclasses that aggregate post-join accept many right rows per key
+    _multi_right_ok = False
+
+    def _build_output(self, out_rows, out_keys, raw_features, left_feats,
+                      right_feats) -> Table:
         cols: dict[str, Column] = {
             self.join_keys.result_key: Column.build("ID", out_keys)
         }
         for f in raw_features:
             cols[f.name] = Column.build(f.kind, [r.get(f.name) for r in out_rows])
         return Table(cols, len(out_rows))
+
+    def with_aggregation(
+        self,
+        time_filter: TimeBasedFilter,
+        window_ms: Optional[int] = None,
+        drop_time_columns: bool = False,
+    ) -> "JoinedAggregateReader":
+        """Post-join secondary aggregation (JoinedDataReader.scala:356-418):
+        join EVERY matching right row, then roll the joined rows up per result
+        key — left features keep one copy, right features fold through their
+        monoids inside the time window around each row's cutoff."""
+        return JoinedAggregateReader(
+            self.left, self.right, self.right_feature_names,
+            join_type=self.join_type, join_keys=self.join_keys,
+            time_filter=time_filter, window_ms=window_ms,
+            drop_time_columns=drop_time_columns,
+            left_key_fn=self.left_key_fn, right_key_fn=self.right_key_fn,
+        )
+
+
+class JoinedAggregateReader(JoinedReader):
+    """Join then aggregate (reference JoinedAggregateDataReader,
+    JoinedDataReader.scala:253-306,356-418).
+
+    Differences from the plain JoinedReader: the right side may produce MANY
+    rows per key (each joins its own row), and instead of row-level time
+    filtering the TimeBasedFilter gates which joined rows enter each feature's
+    monoid fold (JoinedConditionalAggregator, JoinedDataReader.scala:420-447):
+
+      predictor rows aggregate iff  cutoff - window <= time <  cutoff
+      response  rows aggregate iff  cutoff          <= time <  cutoff + window
+
+    with a missing time/cutoff read as 0 (the reference's `getOrElse(0L)`).
+    LEFT (parent) features keep one copy per key — the last joined row's value
+    (DummyJoinedAggregator keeps its second operand). Each right feature uses
+    its FeatureBuilder aggregator (or its kind's monoid default) and honors a
+    per-feature `.window(...)` override of `window_ms`."""
+
+    _multi_right_ok = True
+
+    def __init__(
+        self,
+        left: DataReader,
+        right: DataReader,
+        right_feature_names: Sequence[str],
+        join_type: str = "left-outer",
+        join_keys: JoinKeys = JoinKeys(),
+        time_filter: Optional[TimeBasedFilter] = None,
+        window_ms: Optional[int] = None,
+        drop_time_columns: bool = False,
+        left_key_fn: Optional[Callable[[Any], Any]] = None,
+        right_key_fn: Optional[Callable[[Any], Any]] = None,
+    ):
+        # the time filter gates AGGREGATION, not join rows: the base class gets
+        # none, so generate_table emits every (left, right-match) pair
+        super().__init__(left, right, right_feature_names, join_type,
+                         join_keys, time_filter=None,
+                         left_key_fn=left_key_fn, right_key_fn=right_key_fn)
+        if time_filter is None:
+            raise ValueError("JoinedAggregateReader needs a TimeBasedFilter")
+        self.agg_time_filter = time_filter
+        self.window_ms = window_ms
+        self.drop_time_columns = drop_time_columns
+
+    def _feature_monoid(self, f: Feature):
+        from ..aggregators import default_aggregator
+
+        gen = f.origin_stage
+        agg = getattr(gen, "aggregator", None)
+        return agg if agg is not None else default_aggregator(f.kind)
+
+    def _feature_window(self, f: Feature) -> Optional[int]:
+        gen = f.origin_stage
+        w = getattr(gen, "params", {}).get("window_ms")
+        return w if w is not None else self.window_ms
+
+    def _build_output(self, out_rows, out_keys, raw_features, left_feats,
+                      right_feats) -> Table:
+        tf = self.agg_time_filter
+        groups: dict[str, list[dict]] = {}
+        order: list[str] = []
+        for k, row in zip(out_keys, out_rows):
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(row)
+
+        agg_rows: list[dict] = []
+        for k in order:
+            rows = groups[k]
+            out: dict = {}
+            for f in left_feats:  # one copy per key: last joined row's value
+                out[f.name] = rows[-1].get(f.name)
+            for f in right_feats:
+                agg = self._feature_monoid(f)
+                w = self._feature_window(f)
+                acc = agg.zero()
+                for row in rows:
+                    t = int(row.get(tf.time_column) or 0)
+                    c = int(row.get(tf.cutoff_column) or 0)
+                    if f.is_response:
+                        ok = t >= c and (w is None or t < c + w)
+                    else:
+                        ok = t < c and (w is None or t >= c - w)
+                    v = row.get(f.name)
+                    if ok and v is not None:
+                        acc = agg.combine(acc, agg.prepare(v))
+                out[f.name] = agg.present(acc)
+            agg_rows.append(out)
+
+        dropped = ({tf.time_column, tf.cutoff_column}
+                   if self.drop_time_columns else set())
+        cols: dict[str, Column] = {
+            self.join_keys.result_key: Column.build("ID", order)
+        }
+        for f in raw_features:
+            if f.name in dropped:
+                continue
+            cols[f.name] = Column.build(f.kind, [r.get(f.name) for r in agg_rows])
+        return Table(cols, len(order))
 
 
 def left_outer_join(left, right, right_feature_names, **kw) -> JoinedReader:

@@ -1,0 +1,67 @@
+"""Process-sharded ingestion: each host reads only its share of the rows.
+
+The reference delegates multi-host reads to HDFS-parallel Spark executors
+(CSVReaders.scala et al.); the TPU-native analog (SURVEY §2.7) is: every
+process wraps its reader in a `ProcessShardedReader`, loads ONLY its row
+shard, and the per-process local tables assemble into one global
+DATA_AXIS-sharded array via `mesh.process_local_batch` (real pods) or
+`mesh.global_batch_from_process_shards` (single-controller dryruns/tests).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..types import Column
+from .base import DataReader
+
+
+class ProcessShardedReader(DataReader):
+    """Wrap ANY reader so it yields only rows `r` with r % n_processes ==
+    process_index (stride sharding: no row count needed up front, balanced to
+    within one row, format-agnostic).
+
+    `process_index`/`n_processes` default to jax.process_index()/count() — on a
+    real pod each host constructs the same pipeline code and automatically
+    reads its own shard."""
+
+    def __init__(self, base: DataReader,
+                 process_index: Optional[int] = None,
+                 n_processes: Optional[int] = None):
+        super().__init__(key_fn=base.key_fn)
+        if (process_index is None) != (n_processes is None):
+            raise ValueError("pass both process_index and n_processes, or neither")
+        if process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+            n_processes = jax.process_count()
+        if not 0 <= process_index < n_processes:
+            raise ValueError(
+                f"process_index {process_index} not in [0, {n_processes})")
+        self.base = base
+        self.process_index = int(process_index)
+        self.n_processes = int(n_processes)
+
+    def read_records(self) -> list[Any]:
+        return self.base.cached_records()[self.process_index::self.n_processes]
+
+    def read_columnar(self):
+        """Strided VIEW of the base's columnar data: only this shard's rows are
+        ever built into Columns/Tables (the parse itself still scans the whole
+        source — skipping bytes at IO level needs format support; the memory
+        bound this wrapper guarantees is on the materialized Table)."""
+        cols = self.base.read_columnar()
+        if cols is None:
+            return None
+        out = {}
+        for name, data in cols.items():
+            if isinstance(data, Column):
+                out[name] = data.slice(
+                    np.arange(self.process_index, len(data), self.n_processes))
+            else:
+                out[name] = data[self.process_index::self.n_processes]
+        return out
+    # generate_table: the DataReader base builds from read_columnar()/
+    # cached_records(), both strided above — no full-table materialization
