@@ -28,6 +28,7 @@ from transmogrifai_tpu.stages.feature import (
     ParsePhone,
     ScalerTransformer,
     StopWordsRemover,
+    TextTokenizer,
     TimePeriodTransformer,
     UrlToDomain,
     Word2Vec,
@@ -124,7 +125,9 @@ def test_name_entity_recognizer():
                             [["Alice", "met", "Bob", "in", "Paris", "today"]])}, 1)
     out, feat = _apply(NameEntityRecognizer(), [f], t)
     assert feat.kind.name == "MultiPickList"
-    assert out.values[0] == {"Bob", "Paris"}  # Alice is sentence-initial
+    # Alice is sentence-initial but a gazetteer name (the round-2 heuristic
+    # missed it); Bob is a gazetteer hit; Paris is a shape hit
+    assert out.values[0] == {"Alice", "Bob", "Paris"}
 
 
 def test_mime_type_detector():
@@ -323,3 +326,68 @@ def test_dsl_text_pipeline_trains():
     y = np.asarray([r["label"] for r in rows])
     acc = ((probs > 0.5) == y).mean()
     assert acc > 0.95  # separable by construction
+
+
+def test_ner_honorific_and_chained_surnames():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", [
+        ["Dr", "Watson", "visited", "Mr", "Holmes", "yesterday"],
+        ["maria", "Garcia", "and", "JAMES", "arrived"],
+    ])}, 2)
+    out, _ = _apply(NameEntityRecognizer(), [f], t)
+    # honorifics introduce names even sentence-initially; all-caps tokens are
+    # not names (shape rule); lowercase gazetteer words are not names either
+    assert out.values[0] == {"Watson", "Holmes"}
+    assert out.values[1] == {"Garcia"}
+
+
+def test_ner_extra_names_extends_gazetteer():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", [["Zorblax", "went", "home"]])}, 1)
+    out, _ = _apply(NameEntityRecognizer(), [f], t)
+    assert out.values[0] == frozenset()
+    out2, _ = _apply(NameEntityRecognizer(extra_names=["zorblax"]), [f], t)
+    assert out2.values[0] == {"Zorblax"}
+
+
+def test_lang_detector_reference_fixture_ranking():
+    """The reference LangDetectorTest fixtures rank correctly (en/ja/fr)."""
+    f = FeatureBuilder.Text("t").as_predictor()
+    rows = [
+        ("I've got a lovely bunch of coconuts", "en"),
+        ("Big ones, small ones, some as big as your head", "en"),
+        ("地磁気発生の謎に迫る地球内部の環境、再現実験", "ja"),
+        ("Il publie sa théorie de la relativité restreinte en 1905", "fr"),
+        ("Les deux commissions, créées respectivement en juin 2016", "fr"),
+        (None, None),
+    ]
+    t = Table({"t": _col("Text", [r[0] for r in rows])}, len(rows))
+    out, feat = _apply(LangDetector(), [f], t)
+    assert feat.kind.name == "RealMap"
+    for (txt, expect), scores in zip(rows, out.values):
+        if expect is None:
+            assert scores == {}
+        else:
+            assert next(iter(scores)) == expect, (txt, scores)
+            assert abs(sum(scores.values())) <= 1.0 + 1e-6
+
+
+def test_lang_detector_trainable():
+    from transmogrifai_tpu.utils import text_lang
+
+    text_lang.train("xx", "zzq zzq wubba wubba lubba zzq dub dub " * 20)
+    try:
+        scores = text_lang.detect_languages("wubba lubba dub dub zzq",
+                                            languages=["en", "xx"])
+        assert next(iter(scores)) == "xx"
+    finally:
+        text_lang._PROFILES.pop("xx", None)
+
+
+def test_tokenizer_language_dispatch():
+    f = FeatureBuilder.Text("t").as_predictor()
+    t = Table({"t": _col("Text", ["世界文化遺産への登録", "Hello World"])}, 2)
+    out, _ = _apply(TextTokenizer(auto_detect_language=True), [f], t)
+    # CJK rows tokenize as character bigrams; latin rows as words
+    assert "世界" in out.values[0] and "界文" in out.values[0]
+    assert out.values[1] == ["hello", "world"]

@@ -10,7 +10,6 @@ place of the reference's MurMur3 — same bounded-feature-space role.
 """
 from __future__ import annotations
 
-import re
 import zlib
 from collections import Counter
 from typing import Optional, Sequence
@@ -28,14 +27,23 @@ from .common import (
     value_slot,
 )
 
-_TOKEN_RE = re.compile(r"[^\w]+", re.UNICODE)
+from ...utils.text_lang import TOKEN_SPLIT_RE as _TOKEN_RE  # one splitter everywhere
 _TEXT_KINDS = ("Text", "TextArea", "Email", "URL", "Phone", "ID", "Base64",
                "Country", "State", "City", "PostalCode", "Street", "PickList", "ComboBox")
 
 
-def tokenize(text: Optional[str], *, to_lower: bool = True, min_token_len: int = 1) -> list[str]:
+def tokenize(text: Optional[str], *, to_lower: bool = True, min_token_len: int = 1,
+             language: Optional[str] = None) -> list[str]:
+    """Unicode word tokenization; `language` selects per-language rules (CJK
+    languages emit character bigrams — the Lucene analyzer-dispatch analog,
+    see utils/text_lang.tokenize_for_language)."""
     if text is None:
         return []
+    if language is not None:
+        from ...utils.text_lang import tokenize_for_language
+
+        return tokenize_for_language(text, language, to_lower=to_lower,
+                                     min_token_len=min_token_len)
     s = text.lower() if to_lower else text
     return [t for t in _TOKEN_RE.split(s) if len(t) >= min_token_len]
 
@@ -48,14 +56,21 @@ def hash_token(token: str, num_features: int, seed: int = 0) -> int:
 
 @register_stage
 class TextTokenizer(Transformer):
-    """Text -> TextList (reference TextTokenizer; Lucene analyzers replaced by a
-    unicode word splitter; language detection stays a separate stage)."""
+    """Text -> TextList (reference TextTokenizer.scala:50-120: language-aware
+    Lucene analyzer dispatch). `auto_detect_language=True` identifies each
+    value's language (char-n-gram textcat, utils/text_lang) and applies that
+    language's tokenization rules — CJK text tokenizes as character bigrams
+    (the CJKAnalyzer behavior); `language` pins the rules instead."""
 
     operation_name = "tokenize"
     device_op = False
 
-    def __init__(self, to_lower: bool = True, min_token_len: int = 1):
-        super().__init__(to_lower=to_lower, min_token_len=min_token_len)
+    def __init__(self, to_lower: bool = True, min_token_len: int = 1,
+                 language: Optional[str] = None,
+                 auto_detect_language: bool = False):
+        super().__init__(to_lower=to_lower, min_token_len=min_token_len,
+                         language=language,
+                         auto_detect_language=auto_detect_language)
 
     def out_kind(self, in_kinds):
         if in_kinds[0].storage.value != "text":
@@ -64,9 +79,16 @@ class TextTokenizer(Transformer):
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         p = self.params
+        auto = p.get("auto_detect_language", False)
+        lang = p.get("language")
+        if auto:
+            from ...utils.text_lang import detect_language
         out = np.empty(len(cols[0]), dtype=object)
         for i, v in enumerate(cols[0].values):
-            out[i] = tokenize(v, to_lower=p["to_lower"], min_token_len=p["min_token_len"])
+            row_lang = detect_language(v) if auto else lang
+            out[i] = tokenize(v, to_lower=p["to_lower"],
+                              min_token_len=p["min_token_len"],
+                              language=row_lang)
         return Column(kind_of("TextList"), out, None)
 
 
@@ -158,10 +180,11 @@ class SmartTextVectorizer(SequenceVectorizerEstimator):
 
     def __init__(self, max_cardinality: int = 30, top_k: int = 20, min_support: int = 10,
                  num_features: int = 512, clean_text: bool = True, track_nulls: bool = True,
-                 seed: int = 0):
+                 auto_detect_language: bool = False, seed: int = 0):
         super().__init__(max_cardinality=max_cardinality, top_k=top_k,
                          min_support=min_support, num_features=num_features,
-                         clean_text=clean_text, track_nulls=track_nulls, seed=seed)
+                         clean_text=clean_text, track_nulls=track_nulls,
+                         auto_detect_language=auto_detect_language, seed=seed)
 
     def fit_columns(self, cols: Sequence[Column]):
         p = self.params
@@ -180,6 +203,7 @@ class SmartTextVectorizer(SequenceVectorizerEstimator):
             num_features=p["num_features"],
             clean_text=p["clean_text"],
             track_nulls=p["track_nulls"],
+            auto_detect_language=p.get("auto_detect_language", False),
             seed=p["seed"],
             names=[f.name for f in self.inputs],
             kinds=[f.kind.name for f in self.inputs],
@@ -217,6 +241,12 @@ class SmartTextVectorizerModel(SequenceVectorizer):
                 if p["track_nulls"]:
                     slots.append(null_slot(name, kind))
             else:
+                # language-aware hashing path (SmartTextVectorizer.scala:60-118
+                # tokenizes with the detected language's analyzer): CJK values
+                # hash character bigrams instead of whitespace "words"
+                auto = p.get("auto_detect_language", False)
+                if auto:
+                    from ...utils.text_lang import detect_language
                 width = nf + (1 if p["track_nulls"] else 0)
                 mat = np.zeros((n, width), dtype=np.float32)
                 for i, v in enumerate(c.values):
@@ -224,7 +254,8 @@ class SmartTextVectorizerModel(SequenceVectorizer):
                         if p["track_nulls"]:
                             mat[i, nf] = 1.0
                         continue
-                    for tok in tokenize(v):
+                    lang = detect_language(v) if auto else None
+                    for tok in tokenize(v, language=lang):
                         mat[i, hash_token(tok, nf, p["seed"])] += 1.0
                 slots.extend(
                     SlotInfo(name, kind, descriptor=f"hash_{i}") for i in range(nf)

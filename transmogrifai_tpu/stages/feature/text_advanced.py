@@ -223,30 +223,26 @@ class JaccardSimilarity(SequenceVectorizer):
 
 # --- detectors --------------------------------------------------------------------------
 
-#: high-frequency function words per language; hit-rate scoring replaces the
-#: reference's language-detector library (LangDetector.scala) — same RealMap output
-_LANG_MARKERS: dict[str, frozenset] = {
-    "en": frozenset("the and of to in is you that it he was for on are as with his they at be this have from or had by".split()),
-    "es": frozenset("el la de que y a en un ser se no haber por con su para como estar tener le lo todo pero".split()),
-    "fr": frozenset("le la de et les des en un une du que est pour qui dans ce il au sur se ne pas plus par".split()),
-    "de": frozenset("der die und in den von zu das mit sich des auf ist im dem nicht ein eine als auch es an".split()),
-    "it": frozenset("il di che e la in un a per è non sono con si da come le dei nel alla più".split()),
-    "pt": frozenset("o de a e que do da em um para é com não uma os no se na por mais as dos como".split()),
-}
-
 
 @register_stage
 class LangDetector(Transformer):
-    """Text -> RealMap of {language: confidence} (reference LangDetector.scala)."""
+    """Text -> RealMap of {language: confidence} (reference LangDetector.scala
+    wraps com.optimaize.langdetect). Implementation: char-n-gram textcat
+    profiles + unicode-script restriction (utils/text_lang) — trainable via
+    text_lang.train(lang, corpus), no binary model files. Agrees with the
+    reference LangDetectorTest fixtures on language ranking (en/ja/fr)."""
 
     operation_name = "langDetect"
 
     def __init__(self, languages: Optional[Sequence[str]] = None, top_k: int = 3):
-        langs = sorted(languages) if languages is not None else sorted(_LANG_MARKERS)
-        unknown = set(langs) - set(_LANG_MARKERS)
+        from ...utils.text_lang import supported_languages
+
+        langs = sorted(languages) if languages is not None else supported_languages()
+        unknown = set(langs) - set(supported_languages())
         if unknown:
             raise ValueError(f"unsupported languages {sorted(unknown)}; "
-                             f"supported: {sorted(_LANG_MARKERS)}")
+                             f"supported: {supported_languages()} "
+                             "(utils.text_lang.train() adds more)")
         super().__init__(languages=langs, top_k=top_k)
 
     def out_kind(self, in_kinds):
@@ -255,37 +251,55 @@ class LangDetector(Transformer):
         return kind_of("RealMap")
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
-        langs = self.params["languages"]
+        from ...utils.text_lang import detect_languages
+
+        p = self.params
         out = np.empty(len(cols[0]), dtype=object)
         for i, v in enumerate(cols[0].values):
-            toks = tokenize(v)
-            if not toks:
-                out[i] = {}
-                continue
-            hits = {
-                lg: sum(t in _LANG_MARKERS[lg] for t in toks) / len(toks)
-                for lg in langs
-            }
-            total = sum(hits.values())
-            if total == 0:
-                out[i] = {}
-                continue
-            scored = sorted(
-                ((lg, h / total) for lg, h in hits.items() if h > 0),
-                key=lambda kv: -kv[1],
-            )[: self.params["top_k"]]
-            out[i] = dict(scored)
+            out[i] = detect_languages(v, p["languages"], top_k=p["top_k"])
         return Column(kind_of("RealMap"), out, None)
+
+
+#: honorifics introducing person names (context features, the OpenNLP-model
+#: replacement's strongest rule)
+_NER_HONORIFICS = frozenset(
+    "mr mrs ms miss dr prof sir madam lord lady captain president senator".split())
+
+#: compact gazetteer of common given names across locales — the trainable seed
+#: (extend via NameEntityRecognizer(extra_names=[...]))
+_NER_GIVEN_NAMES = frozenset("""
+james john robert michael william david richard joseph thomas charles mary
+patricia jennifer linda elizabeth barbara susan jessica sarah karen maria
+anna ana luis carlos jose juan pedro miguel sofia lucia marta paulo joao
+pierre jean marie claire louis michel francois anne laurent sophie hans
+karl heinz peter klaus anna greta fritz giovanni marco luca giulia paolo
+francesca wei li ming hiroshi takashi yuki kenji sakura haruto ji-woo
+min-jun seo-yeon ivan dmitri sergei natasha olga tatiana ahmed mohammed
+fatima omar layla aisha raj priya arjun ananya vikram deepa emma olivia
+noah liam mason lucas ethan amelia harper mia isabella evelyn henry jack
+george oscar arthur alice grace ruby ella leo max felix hugo theo
+""".split())
 
 
 @register_stage
 class NameEntityRecognizer(Transformer):
-    """TextList -> MultiPickList of likely name entities (reference
-    NameEntityRecognizer.scala uses OpenNLP binary models; this build uses a
-    capitalization heuristic over the token stream — capitalized tokens that are not
-    sentence-initial and not stop words)."""
+    """TextList -> MultiPickList of likely person-name entities (reference
+    NameEntityRecognizer.scala runs OpenNLP binary NER models). This build
+    combines three signals — no binaries needed:
+
+      1. gazetteer: tokens matching a built-in multi-locale given-name list
+         (case-insensitive; extendable via `extra_names`), even sentence-initial;
+      2. context: any capitalized token following an honorific (Mr/Dr/...)
+         or following a recognized name (multi-token names chain: the surname
+         after a gazetteer hit is taken as part of the entity);
+      3. shape: capitalized, non-sentence-initial, non-stop-word tokens
+         (the round-2 heuristic, now the weakest of the three signals).
+    """
 
     operation_name = "ner"
+
+    def __init__(self, extra_names: Sequence[str] = ()):
+        super().__init__(extra_names=sorted(str(n).lower() for n in extra_names))
 
     def out_kind(self, in_kinds):
         if in_kinds[0].name != "TextList":
@@ -293,13 +307,29 @@ class NameEntityRecognizer(Transformer):
         return kind_of("MultiPickList")
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
+        gazetteer = _NER_GIVEN_NAMES | frozenset(self.params["extra_names"])
         out = np.empty(len(cols[0]), dtype=object)
         for i, toks in enumerate(cols[0].values):
             ents = set()
+            prev_was_name = False
+            prev_was_honorific = False
             for j, t in enumerate(toks):
-                if (j > 0 and t[:1].isupper() and t[1:].islower()
-                        and t.lower() not in ENGLISH_STOP_WORDS):
+                low = t.lower()
+                capitalized = t[:1].isupper() and (len(t) == 1 or not t.isupper())
+                is_name = False
+                if low.rstrip(".") in _NER_HONORIFICS:
+                    pass  # honorifics introduce names; they are never entities
+                elif capitalized:
+                    if low in gazetteer:
+                        is_name = True
+                    elif prev_was_honorific or prev_was_name:
+                        is_name = low not in ENGLISH_STOP_WORDS
+                    elif j > 0 and low not in ENGLISH_STOP_WORDS:
+                        is_name = t[1:].islower()  # shape signal
+                if is_name:
                     ents.add(t)
+                prev_was_name = is_name
+                prev_was_honorific = low.rstrip(".") in _NER_HONORIFICS
             out[i] = frozenset(ents)
         return Column(kind_of("MultiPickList"), out, None)
 
