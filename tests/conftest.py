@@ -52,3 +52,16 @@ def _reset_uids():
 
     reset_uid_counter()
     yield
+
+
+def import_all_package_modules():
+    """Import every transmogrifai_tpu module so every @register_stage lands in
+    the registry — shared by the registry-wide sweeps (contracts + outputs)."""
+    import importlib
+    import pkgutil
+
+    import transmogrifai_tpu
+
+    for mod in pkgutil.walk_packages(transmogrifai_tpu.__path__,
+                                     prefix="transmogrifai_tpu."):
+        importlib.import_module(mod.name)

@@ -8,14 +8,9 @@ import pytest
 
 # import EVERY package module so @register_stage in any file, exported or not,
 # lands in the registry — the sweep's "automatic coverage" depends on it
-import importlib
-import pkgutil
+from conftest import import_all_package_modules
 
-import transmogrifai_tpu
-
-for _mod in pkgutil.walk_packages(transmogrifai_tpu.__path__,
-                                  prefix="transmogrifai_tpu."):
-    importlib.import_module(_mod.name)
+import_all_package_modules()
 
 from transmogrifai_tpu.stages.base import STAGE_REGISTRY  # noqa: E402
 from transmogrifai_tpu.utils.sanitize import check_serializable  # noqa: E402
