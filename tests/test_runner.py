@@ -458,3 +458,33 @@ def test_codegen_from_avro(tmp_path):
         timeout=600,
     )
     assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
+    """`op warmup` runs a synthetic selector fit at the requested shape and
+    reports per-cell walls; a real same-shape train afterwards reuses the
+    in-process jit caches (the persistent cache serves fresh processes)."""
+    from transmogrifai_tpu.utils import compile_cache
+
+    # force a fresh activation so the tmp cache dir is actually honored (the
+    # helper is idempotent per process and may have run in an earlier test)
+    monkeypatch.setattr(compile_cache, "_ENABLED", False)
+    monkeypatch.setenv("TT_COMPILE_CACHE_DIR", str(tmp_path / "cache"))
+    from transmogrifai_tpu.cli.main import main as op_main
+    from transmogrifai_tpu.workflow.warmup import warmup
+
+    rep = warmup(problem="binary", rows=60, width=8, models=None)
+    # widths round through bucket_width: real trains pad to buckets, so the
+    # warmed shape must be the padded one
+    assert rep["rows"] == 60 and rep["width"] == 64 and rep["wall_s"] > 0
+    assert rep["requested_width"] == 8
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = op_main(["warmup", "--problem", "regression", "--rows", "48",
+                      "--widths", "8"])
+    assert rc == 0
+    assert '"regression"' in buf.getvalue()

@@ -75,6 +75,35 @@ def _cmd_gen(argv) -> int:
     return 0
 
 
+def _cmd_warmup(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op warmup",
+        description="pre-seed the persistent compile cache for planned train "
+                    "shapes (run ahead of interactive sessions: CI, deploy)")
+    ap.add_argument("--problem", default="binary",
+                    choices=["binary", "multiclass", "regression", "all"])
+    ap.add_argument("--rows", type=int, default=891,
+                    help="planned dataset row count (fold shapes derive "
+                         "from it; default 891)")
+    ap.add_argument("--widths", default="128",
+                    help="comma-separated training-matrix width buckets "
+                         "(default: 128)")
+    ap.add_argument("--num-classes", type=int, default=3)
+    args = ap.parse_args(argv)
+    from transmogrifai_tpu.workflow.warmup import _PROBLEMS, warmup_matrix
+
+    problems = _PROBLEMS if args.problem == "all" else (args.problem,)
+    widths = [int(w) for w in args.widths.split(",") if w]
+    # progress to stderr: stdout carries ONLY the JSON report (CI pipes to jq)
+    reports = warmup_matrix(problems=problems, rows=args.rows, widths=widths,
+                            num_classes=args.num_classes,
+                            log=lambda m: print(m, file=sys.stderr))
+    import json
+
+    print(json.dumps(reports))
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     from transmogrifai_tpu import __version__
@@ -86,6 +115,7 @@ def main(argv=None) -> int:
             "  run       run a workflow app (--app module:fn --type train|score|"
             "features|evaluate|streaming_score)\n"
             "  gen       scaffold a project from a CSV (--input --id --response)\n"
+            "  warmup    pre-seed the compile cache for planned train shapes\n"
             "  version   print framework version"
         )
         return 0
@@ -97,6 +127,8 @@ def main(argv=None) -> int:
         return _cmd_run(rest)
     if cmd == "gen":
         return _cmd_gen(rest)
+    if cmd == "warmup":
+        return _cmd_warmup(rest)
     print(f"op: unknown command {cmd!r}", file=sys.stderr)
     return 2
 

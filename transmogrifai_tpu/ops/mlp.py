@@ -94,15 +94,24 @@ def _mlp_init(d: int, hidden: Sequence[int], num_classes: int, seed: int) -> lis
     ]
 
 
+def _matmul_mp(h, W, compute_dtype):
+    """Mixed-precision matmul: operands in compute_dtype (bf16 = MXU native),
+    accumulation and OUTPUT in f32 via preferred_element_type — one op, no
+    separate output-cast pass over the [B, width] activation (the bf16->f32
+    astype after each layer materialized an extra activation-sized write)."""
+    return jax.lax.dot_general(
+        h.astype(compute_dtype), W.astype(compute_dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+
 def _mlp_forward(params: list, X, compute_dtype):
-    """Mixed-precision forward: matmuls in compute_dtype on the MXU,
-    bias+activation in f32."""
-    h = X.astype(compute_dtype)
+    """Mixed-precision forward: matmuls in compute_dtype on the MXU with f32
+    accumulation, bias+activation in f32."""
+    h = X
     for W, b in params[:-1]:
-        h = jnp.tanh((h @ W.astype(compute_dtype)).astype(jnp.float32) + b)
-        h = h.astype(compute_dtype)
+        h = jnp.tanh(_matmul_mp(h, W, compute_dtype) + b)
     W, b = params[-1]
-    return (h @ W.astype(compute_dtype)).astype(jnp.float32) + b
+    return _matmul_mp(h, W, compute_dtype) + b
 
 
 def _mlp_loss(params: list, X, Y, l2, compute_dtype):

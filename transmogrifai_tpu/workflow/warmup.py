@@ -1,0 +1,98 @@
+"""`op warmup`: pre-seed the persistent compile cache for planned train shapes.
+
+Cold-start cost is compile-dominated: the first train of a given
+(rows, vector-width, problem type) compiles every selector search program
+(one per model family x static grid group), the winner's refit, and the fused
+predict+metrics programs. All of those key on SHAPES, not data — so running
+one synthetic search with the same shapes ahead of time (CI, deploy, nightly)
+leaves the persistent cache warm and the user's first real train pays only
+tracing + cache reads.
+
+Width is the TRAINING-MATRIX width after vectorization; widths are bucketed
+(types/vector_schema.bucket_width: multiples of 64 to 512, of 128 to 2048),
+so warming the handful of buckets around your schema's expected width covers
+vocabulary drift. Rows matter too (fold shapes derive from them): pass the
+planned dataset size.
+"""
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import numpy as np
+
+_PROBLEMS = ("binary", "multiclass", "regression")
+
+
+def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
+           num_classes: int = 3, seed: int = 0, models=None) -> dict:
+    """Run one full synthetic ModelSelector fit at (rows, bucket_width(width))
+    — compiling (and persisting) every program the same-shaped real train
+    will need. The width rounds through the SAME bucket function real trains
+    pad to (types/vector_schema.bucket_width), so any requested width lands
+    on a shape that will actually be used. Returns {problem, rows, width,
+    requested_width, wall_s}."""
+    import jax.numpy as jnp
+
+    from ..graph import FeatureBuilder
+    from ..select import (
+        BinaryClassificationModelSelector,
+        MultiClassificationModelSelector,
+        RegressionModelSelector,
+    )
+    from ..types import Column, Table
+    from ..types.vector_schema import SlotInfo, VectorSchema, bucket_width
+    from ..utils.compile_cache import enable_compile_cache
+
+    if problem not in _PROBLEMS:
+        raise ValueError(f"problem must be one of {_PROBLEMS}, got {problem!r}")
+    enable_compile_cache()
+    requested = int(width)
+    width = bucket_width(requested)
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, width)).astype(np.float32)
+    if problem == "binary":
+        y = (X[:, 0] + 0.25 * rng.normal(size=rows) > 0).astype(np.float32)
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            models=models, seed=seed)
+    elif problem == "multiclass":
+        y = np.clip((X[:, 0] * 1.5 + num_classes / 2).astype(int),
+                    0, num_classes - 1).astype(np.float32)
+        selector = MultiClassificationModelSelector.with_cross_validation(
+            models=models, seed=seed)
+    else:
+        y = (X[:, 0] * 2.0 + rng.normal(size=rows)).astype(np.float32)
+        selector = RegressionModelSelector.with_cross_validation(
+            models=models, seed=seed)
+
+    label = FeatureBuilder("label", "RealNN").as_response()
+    vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    selector(label, vec)
+    schema = VectorSchema(tuple(
+        SlotInfo("warm", "Real", descriptor=f"w{i}") for i in range(width)))
+    table = Table({
+        "label": Column.build("RealNN", [float(v) for v in y]),
+        "vec": Column.vector(jnp.asarray(X), schema=schema),
+    })
+    t0 = time.perf_counter()
+    selector.fit_table(table)
+    return {"problem": problem, "rows": int(rows), "width": int(width),
+            "requested_width": requested,
+            "wall_s": round(time.perf_counter() - t0, 2)}
+
+
+def warmup_matrix(problems: Sequence[str] = ("binary",),
+                  rows: int = 891,
+                  widths: Sequence[int] = (128,),
+                  num_classes: int = 3,
+                  models=None,
+                  log=print) -> list[dict]:
+    """Warm every (problem, width) combination; returns the per-cell reports."""
+    out = []
+    for p in problems:
+        for w in widths:
+            rep = warmup(problem=p, rows=rows, width=int(w),
+                         num_classes=num_classes, models=models)
+            log(f"warmed {p} rows={rows} width={w}: {rep['wall_s']}s")
+            out.append(rep)
+    return out
