@@ -172,3 +172,49 @@ def test_occurs_blank_text_is_not_occurrence():
     f = FeatureBuilder.Text("t").as_predictor()
     out = run(f.occurs(), [{"t": "a"}, {"t": "  "}, {"t": None}], {"t": "Text"})
     assert out.to_list() == [1.0, 0.0, 0.0]
+
+
+def test_map_set_list_geo_dsl_methods():
+    """RichMapFeature/RichSetFeature/RichListFeature vectorize shortcuts."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.types import Column, Table
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.readers import TableReader
+
+    n = 24
+    rng = np.random.default_rng(0)
+    rmap = FeatureBuilder("rm", "RealMap").as_predictor()
+    tmap = FeatureBuilder("tm", "TextMap").as_predictor()
+    mset = FeatureBuilder("ms", "MultiPickList").as_predictor()
+    dlist = FeatureBuilder("dl", "DateList").as_predictor()
+    geo = FeatureBuilder("geo", "Geolocation").as_predictor()
+
+    v1 = rmap.vectorize_map(top_k=3, min_support=1)
+    v2 = tmap.vectorize_map(max_cardinality=2, num_features=8)
+    v3 = mset.pivot_set(top_k=2, min_support=1)
+    v4 = dlist.vectorize_dates()
+    v5 = geo.vectorize_geolocation()
+    for v in (v1, v2, v3, v4, v5):
+        assert v.kind.name == "OPVector"
+
+    table = Table({
+        "rm": Column.build("RealMap", [{"a": float(rng.normal()), "b": 1.0}
+                                       for _ in range(n)]),
+        "tm": Column.build("TextMap", [{"k": "xy"[i % 2]} for i in range(n)]),
+        "ms": Column.build("MultiPickList",
+                           [frozenset(["p", "q"][: 1 + i % 2]) for i in range(n)]),
+        "dl": Column.build("DateList", [[1000 + i, 2000 + i] for i in range(n)]),
+        "geo": Column.build("Geolocation",
+                            [(10.0, 20.0, 1.0) for _ in range(n)]),
+    }, n)
+    from transmogrifai_tpu.stages.feature import transmogrify
+
+    combined = transmogrify([v1, v2, v3, v4, v5])
+    wf = Workflow().set_reader(TableReader(table)).set_result_features(combined)
+    model = wf.train()
+    out = model.score(keep_intermediate=True)[combined.name]
+    assert out.width == len(out.schema)
+    parents = {s.parent_feature for s in out.schema if not s.is_padding}
+    assert {"rm", "tm", "ms", "dl", "geo"} <= {p.split("_")[0] for p in parents} | parents

@@ -288,6 +288,69 @@ def to_time_period(self: Feature, period: str = "DayOfWeek") -> Feature:
     return TimePeriodTransformer(period=period)(self)
 
 
+# --- map enrichments (RichMapFeature.scala per-type vectorize overloads) ------------------
+def vectorize_map(self: Feature, *others: Feature,
+                  top_k: int = DEFAULTS.top_k,
+                  min_support: int = DEFAULTS.min_support,
+                  clean_text: bool = True, track_nulls: bool = True,
+                  allow_keys: Sequence[str] = (),
+                  block_keys: Sequence[str] = (),
+                  max_cardinality: int = 30,
+                  num_features: int = DEFAULTS.num_hash_features) -> Feature:
+    """Kind-aware map vectorization (the RichMapFeature.vectorize overload
+    family): text-valued maps take the smart categorical-vs-hashing path with
+    its cardinality/width knobs; every other map kind pivots per (key, value)
+    with top_k/min_support and optional key allow/block lists."""
+    kind = self.kind.name
+    if kind in ("TextMap", "TextAreaMap"):
+        from ..stages.feature.collections import SmartTextMapVectorizer
+
+        return SmartTextMapVectorizer(
+            max_cardinality=max_cardinality, top_k=top_k,
+            min_support=min_support, num_features=num_features,
+            clean_text=clean_text, track_nulls=track_nulls)(self, *others)
+    from ..stages.feature.collections import MapVectorizer
+
+    return MapVectorizer(
+        top_k=top_k, min_support=min_support, clean_text=clean_text,
+        track_nulls=track_nulls, allow_keys=allow_keys,
+        block_keys=block_keys)(self, *others)
+
+
+# --- set enrichments (RichSetFeature.scala) -----------------------------------------------
+def pivot_set(self: Feature, *others: Feature,
+              top_k: int = DEFAULTS.top_k,
+              min_support: int = DEFAULTS.min_support,
+              clean_text: bool = True, track_nulls: bool = True) -> Feature:
+    """MultiPickList -> multi-hot pivot over the fitted top-k values
+    (RichSetFeature.pivot/vectorize)."""
+    from ..stages.feature.collections import MultiPickListVectorizer
+
+    return MultiPickListVectorizer(
+        top_k=top_k, min_support=min_support, clean_text=clean_text,
+        track_nulls=track_nulls)(self, *others)
+
+
+# --- list enrichments (RichListFeature.scala) ---------------------------------------------
+def vectorize_dates(self: Feature, *others: Feature,
+                    reference_date_ms: Optional[int] = None,
+                    track_nulls: bool = True) -> Feature:
+    """DateList/DateTimeList -> time-since-last + count vector
+    (RichListFeature.vectorize for date lists)."""
+    from ..stages.feature.date import DateListVectorizer
+
+    return DateListVectorizer(reference_date_ms=reference_date_ms,
+                              track_nulls=track_nulls)(self, *others)
+
+
+def vectorize_geolocation(self: Feature, *others: Feature,
+                          track_nulls: bool = True) -> Feature:
+    """Geolocation -> (lat, lon, accuracy) slots (RichLocationFeature)."""
+    from ..stages.feature.collections import GeolocationVectorizer
+
+    return GeolocationVectorizer(track_nulls=track_nulls)(self, *others)
+
+
 def _attach() -> None:
     Feature.__add__ = _binary_op("+")
     Feature.__sub__ = _binary_op("-")
@@ -338,6 +401,10 @@ def _attach() -> None:
     Feature.to_url_domain = to_url_domain
     Feature.is_valid_url = is_valid_url
     Feature.b64_to_text = b64_to_text
+    Feature.vectorize_map = vectorize_map
+    Feature.pivot_set = pivot_set
+    Feature.vectorize_dates = vectorize_dates
+    Feature.vectorize_geolocation = vectorize_geolocation
     Feature.scale = scale_feature
     Feature.descale = descale_feature
     Feature.filter_map = filter_map
