@@ -193,6 +193,9 @@ def test_map_set_list_geo_dsl_methods():
 
     v1 = rmap.vectorize_map(top_k=3, min_support=1)
     v2 = tmap.vectorize_map(max_cardinality=2, num_features=8)
+    v2b = tmap.vectorize_map(max_cardinality=2, num_features=8,
+                             block_keys=["secret"])  # filters, then smart-vec
+    assert v2b.kind.name == "OPVector"
     v3 = mset.pivot_set(top_k=2, min_support=1)
     v4 = dlist.vectorize_dates()
     v5 = geo.vectorize_geolocation()

@@ -301,10 +301,23 @@ def vectorize_map(self: Feature, *others: Feature,
     family): text-valued maps take the smart categorical-vs-hashing path with
     its cardinality/width knobs; every other map kind pivots per (key, value)
     with top_k/min_support and optional key allow/block lists."""
+    from ..stages.feature.collections import _TEXT_MAPS
+
     kind = self.kind.name
-    if kind in ("TextMap", "TextAreaMap"):
+    if kind in _TEXT_MAPS:
         from ..stages.feature.collections import SmartTextMapVectorizer
 
+        if allow_keys or block_keys:
+            # the smart text-map path has no key filters — silently hashing a
+            # blocked key would defeat the caller's exclusion; filter first
+            from ..stages.feature.misc import FilterMap
+
+            filtered = FilterMap(whitelist=list(allow_keys) or None,
+                                 blacklist=list(block_keys) or None)(self)
+            return vectorize_map(
+                filtered, *others, top_k=top_k, min_support=min_support,
+                clean_text=clean_text, track_nulls=track_nulls,
+                max_cardinality=max_cardinality, num_features=num_features)
         return SmartTextMapVectorizer(
             max_cardinality=max_cardinality, top_k=top_k,
             min_support=min_support, num_features=num_features,
