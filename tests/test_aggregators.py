@@ -570,3 +570,25 @@ def test_post_join_aggregation_outer_right_only_groups():
     assert t["name"].to_list()[3] is None
     assert t["amount"].to_list()[3] is None
     del r2
+
+
+def test_post_join_aggregation_requires_gate_columns():
+    """Missing time/cutoff features fail LOUDLY (a silently-zero gate would
+    aggregate nothing); passing them via time_features fixes it and keeps
+    them out of the output."""
+    reader, feats = _post_join_setup()
+    by_name = {f.name: f for f in feats}
+    model_feats = [by_name["name"], by_name["amount"], by_name["churned"]]
+    with pytest.raises(ValueError, match="time_features"):
+        reader.generate_table(model_feats)
+
+    from transmogrifai_tpu.readers import left_outer_join
+
+    r2 = left_outer_join(reader.left, reader.right,
+                         ["amount", "etime", "churned"]).with_aggregation(
+        TimeBasedFilter(time_column="etime", cutoff_column="cutoff"),
+        time_features=[by_name["etime"], by_name["cutoff"]],
+    )
+    t = r2.generate_table(model_feats)
+    assert "etime" not in t.names() and "cutoff" not in t.names()
+    assert t["amount"].to_list()[0] == pytest.approx(5.0)  # gate works

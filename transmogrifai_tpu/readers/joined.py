@@ -181,16 +181,23 @@ class JoinedReader(DataReader):
         time_filter: TimeBasedFilter,
         window_ms: Optional[int] = None,
         drop_time_columns: bool = False,
+        time_features: Sequence[Feature] = (),
     ) -> "JoinedAggregateReader":
         """Post-join secondary aggregation (JoinedDataReader.scala:356-418):
         join EVERY matching right row, then roll the joined rows up per result
         key — left features keep one copy, right features fold through their
-        monoids inside the time window around each row's cutoff."""
+        monoids inside the time window around each row's cutoff.
+
+        `time_features`: the Feature objects behind the filter's time/cutoff
+        columns, for pipelines whose MODEL does not otherwise consume them —
+        they are generated for the gating and dropped from the output (the
+        reference's TimeColumn(feature) wiring)."""
         return JoinedAggregateReader(
             self.left, self.right, self.right_feature_names,
             join_type=self.join_type, join_keys=self.join_keys,
             time_filter=time_filter, window_ms=window_ms,
             drop_time_columns=drop_time_columns,
+            time_features=time_features,
             left_key_fn=self.left_key_fn, right_key_fn=self.right_key_fn,
         )
 
@@ -211,7 +218,12 @@ class JoinedAggregateReader(JoinedReader):
     LEFT (parent) features keep one copy per key — the last joined row's value
     (DummyJoinedAggregator keeps its second operand). Each right feature uses
     its FeatureBuilder aggregator (or its kind's monoid default) and honors a
-    per-feature `.window(...)` override of `window_ms`."""
+    per-feature `.window(...)` override of `window_ms`.
+
+    Right-side features that are sparse over events (e.g. an outcome recorded
+    on one event row per key) must use NULLABLE kinds (Real, Binary, ...): the
+    intermediate joined rows carry missing values, and only the aggregation
+    densifies them — a non-nullable kind fails at the right table build."""
 
     _multi_right_ok = True
 
@@ -225,6 +237,7 @@ class JoinedAggregateReader(JoinedReader):
         time_filter: Optional[TimeBasedFilter] = None,
         window_ms: Optional[int] = None,
         drop_time_columns: bool = False,
+        time_features: Sequence[Feature] = (),
         left_key_fn: Optional[Callable[[Any], Any]] = None,
         right_key_fn: Optional[Callable[[Any], Any]] = None,
     ):
@@ -238,6 +251,29 @@ class JoinedAggregateReader(JoinedReader):
         self.agg_time_filter = time_filter
         self.window_ms = window_ms
         self.drop_time_columns = drop_time_columns
+        self.time_features = tuple(time_features)
+
+    def generate_table(self, raw_features: Sequence[Feature]) -> Table:
+        """Extend generation with the filter's time/cutoff features when the
+        model itself does not consume them, and fail LOUDLY when the gate
+        columns are generated by neither — a missing time column would read as
+        0 in every window comparison and silently aggregate nothing."""
+        tf = self.agg_time_filter
+        names = {f.name for f in raw_features}
+        self._requested_names = set(names)
+        extended = list(raw_features) + [
+            f for f in self.time_features if f.name not in names
+        ]
+        have = {f.name for f in extended}
+        missing = {tf.time_column, tf.cutoff_column} - have
+        if missing:
+            raise ValueError(
+                f"TimeBasedFilter columns {sorted(missing)} are not generated "
+                "by this workflow's raw features — pass their Feature objects "
+                "via with_aggregation(..., time_features=[...]) so the gate "
+                "has real timestamps (they are dropped from the output)"
+            )
+        return super().generate_table(extended)
 
     def _feature_monoid(self, f: Feature):
         from ..aggregators import default_aggregator
@@ -287,6 +323,10 @@ class JoinedAggregateReader(JoinedReader):
 
         dropped = ({tf.time_column, tf.cutoff_column}
                    if self.drop_time_columns else set())
+        # features added only for gating (time_features) never reach the output
+        requested = getattr(self, "_requested_names", None)
+        if requested is not None:
+            dropped |= {f.name for f in raw_features if f.name not in requested}
         cols: dict[str, Column] = {
             self.join_keys.result_key: Column.build("ID", order)
         }
