@@ -404,7 +404,13 @@ def test_every_registered_stage_is_covered():
     # fitted models are exercised through their estimator's fit
     for est in RECIPES:
         covered.add(est + "Model")
-    missing = sorted(set(STAGE_REGISTRY) - covered)
+    # test modules register fixture stages (test_graph/test_sanitize): only
+    # stages defined inside the package are the sweep's contract
+    package_stages = {
+        name for name, cls in STAGE_REGISTRY.items()
+        if cls.__module__.startswith("transmogrifai_tpu")
+    }
+    missing = sorted(package_stages - covered)
     assert not missing, (
         f"stages with no output recipe (add to RECIPES or EXCLUDED with a "
         f"reason): {missing}")
