@@ -65,3 +65,35 @@ class TestScoreFunction:
     def test_empty_batch(self, fitted):
         model, _, _ = fitted
         assert model.score_fn().batch([]) == []
+
+
+def test_serve_language_aware_tokenization_parity():
+    """A pipeline with auto-detected per-language tokenization scores the same
+    through the dict->dict serving path as through bulk scoring."""
+    import numpy as np
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.feature.text import TextTokenizer
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.types import Table
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(4)
+    texts = ["the quick brown fox", "世界文化遺産への登録",
+             "le chien court dans le parc", "good morning friends"]
+    rows = [{"label": float(i % 2), "msg": texts[i % 4],
+             "x": float(rng.normal())} for i in range(64)]
+    fs = features_from_schema({"label": "RealNN", "msg": "Text", "x": "Real"},
+                              response="label")
+    toks = TextTokenizer(auto_detect_language=True)(fs["msg"])
+    vec = transmogrify([toks.hash_vectorize(num_features=16), fs["x"]])
+    pred = LogisticRegression(max_iter=10)(fs["label"], vec)
+    t = Table.from_rows(rows, {"label": "RealNN", "msg": "Text", "x": "Real"})
+    model = Workflow().set_result_features(pred).train(table=t)
+
+    bulk = np.asarray(model.score(table=t)[pred.name].prob)
+    serve = model.score_fn()
+    one = serve({"msg": rows[1]["msg"], "x": rows[1]["x"]})
+    payload = one[pred.name]
+    np.testing.assert_allclose(payload["probability"][1], bulk[1, 1], rtol=1e-5)
