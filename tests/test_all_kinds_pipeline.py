@@ -1,0 +1,69 @@
+"""One pipeline over EVERY generatable feature kind: transmogrify ->
+SanityChecker -> LogisticRegression -> score + serve. The stage-output sweep
+checks stages in isolation; this catches inter-kind integration issues (slot
+schema merging, mask threading across families, serving parity) in one go."""
+import numpy as np
+import pytest
+
+from test_stage_outputs import _col, _stream_for, N
+
+from transmogrifai_tpu.check import SanityChecker
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.types.kinds import KINDS
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _generatable_kinds() -> list[str]:
+    out = []
+    for name in sorted(KINDS):
+        if name in ("Prediction", "OPVector", "RealNN"):
+            continue  # RealNN is the label below
+        try:
+            _stream_for(name)
+        except KeyError:
+            continue
+        out.append(name)
+    return out
+
+
+def test_every_generatable_kind_trains_end_to_end():
+    kinds = _generatable_kinds()
+    assert len(kinds) >= 30, kinds  # the testkit covers the broad kind space
+
+    rng = np.random.default_rng(11)
+    label_col = Column.build("RealNN", [float(v) for v in rng.integers(0, 2, N)])
+    feats = {"label": FeatureBuilder("label", "RealNN").as_response()}
+    cols = {"label": label_col}
+    for i, kind in enumerate(kinds):
+        name = f"f_{kind}"
+        feats[name] = FeatureBuilder(name, kind).as_predictor()
+        cols[name] = _col(kind, seed=300 + i)
+    table = Table(cols, N)
+
+    vec = transmogrify([f for n, f in feats.items() if n != "label"])
+    checked = SanityChecker(min_variance=1e-9)(feats["label"], vec)
+    pred = LogisticRegression(max_iter=8)(feats["label"], checked)
+    model = Workflow().set_result_features(pred).train(table=table)
+
+    out = model.score(table=table, keep_intermediate=True)
+    prob = np.asarray(out[pred.name].prob)
+    assert prob.shape == (N, 2) and np.isfinite(prob).all()
+
+    # the combined (pre-check) schema names every kind's parent feature; the
+    # SanityChecker may legitimately drop ALL of a degenerate kind's slots
+    # (48 unique postal codes -> only zero-variance OTHER/null indicators)
+    schema = out[vec.name].schema
+    parents = {s.parent_feature for s in schema if not s.is_padding}
+    missing = {f"f_{k}" for k in kinds} - parents
+    assert not missing, f"kinds absent from the combined vector: {missing}"
+
+    # dict->dict serving consumes one raw row of every kind
+    serve = model.score_fn()
+    row = table.to_rows()[0]
+    row.pop("label")
+    single = serve(row)
+    np.testing.assert_allclose(single[pred.name]["probability"][1],
+                               prob[0, 1], rtol=1e-4)

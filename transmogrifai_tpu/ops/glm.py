@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .linear import LinearParams
+from .linear import LinearParams, ridge_solve
 
 _FAMILIES = ("gaussian", "poisson", "gamma", "binomial")
 
@@ -63,9 +63,9 @@ def fit_glm(
         z = eta + (y - mu) / jnp.clip(dmu, 1e-6, None)
         ww = w * dmu ** 2 / jnp.clip(var, 1e-6, None)
         A = (Xa.T * ww) @ Xa / jnp.clip(ww.sum(), 1e-6, None) + lam * reg_eye
-        A = A + 1e-6 * jnp.eye(d + 1)
         g = (Xa.T * ww) @ z / jnp.clip(ww.sum(), 1e-6, None)
-        theta_new = jax.scipy.linalg.solve(A, g, assume_a="pos")
+        # a non-finite solve keeps the previous iterate (IRLS progress survives)
+        theta_new = ridge_solve(A, g, fallback=theta)
         return theta_new, None
 
     theta0 = jnp.zeros(d + 1, jnp.float32)

@@ -52,6 +52,31 @@ def _cosine_lr(lr, i, total):
     return lr * 0.5 * (1 + jnp.cos(jnp.pi * i / total))
 
 
+def ridge_solve(H: jnp.ndarray, g: jnp.ndarray,
+                fallback: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Solve H x = g with a RELATIVE ridge.
+
+    Rank-deficient normal matrices are ROUTINE here (n << D after width
+    bucketing pads zero columns); an absolute 1e-6 ridge vanishes next to
+    large diagonal entries and the f32 Cholesky then returns NaN — which the
+    callers' step-norm caps pass straight through (NaN > cap is False).
+    Scaling the ridge by the mean diagonal keeps the system positive-definite
+    at any data magnitude.
+
+    `fallback` substitutes for a still-non-finite solution: iterative callers
+    pass their no-op value (a zero STEP, or the previous iterate) so one bad
+    solve cannot poison every later iteration. Without a fallback the raw
+    solution returns — single closed-form solves should surface NaN honestly
+    rather than silently produce an all-zero model."""
+    d = H.shape[0]
+    scale = jnp.trace(H) / d + 1e-12
+    x = jax.scipy.linalg.solve(H + (1e-5 * scale) * jnp.eye(d), g,
+                               assume_a="pos")
+    if fallback is None:
+        return x
+    return jnp.where(jnp.all(jnp.isfinite(x)), x, fallback)
+
+
 # --- logistic regression (binary): IRLS/Newton ------------------------------------------
 @partial(jax.jit, static_argnames=("max_iter",))
 def fit_logistic(
@@ -82,8 +107,7 @@ def fit_logistic(
         reg = lam * theta.at[-1].set(0.0)  # don't penalize intercept
         grad = Xa.T @ r / wsum + reg
         H = (Xa.T * s) @ Xa / wsum + lam * jnp.eye(d + 1).at[-1, -1].set(0.0)
-        H = H + 1e-6 * jnp.eye(d + 1)
-        delta = jax.scipy.linalg.solve(H, grad, assume_a="pos")
+        delta = ridge_solve(H, grad, fallback=jnp.zeros_like(grad))
         # guard divergence: cap the Newton step norm
         norm = jnp.linalg.norm(delta)
         delta = jnp.where(norm > 1e3, delta * (1e3 / norm), delta)
@@ -224,9 +248,9 @@ def fit_linear(
     Xa = jnp.concatenate([X, jnp.ones((n, 1), jnp.float32)], axis=1)
     A = (Xa.T * wts) @ Xa / wts.sum()
     lam = jnp.asarray(l2, jnp.float32)
-    A = A + lam * jnp.eye(d + 1).at[-1, -1].set(0.0) + 1e-6 * jnp.eye(d + 1)
+    A = A + lam * jnp.eye(d + 1).at[-1, -1].set(0.0)
     g = (Xa.T * wts) @ y / wts.sum()
-    theta = jax.scipy.linalg.solve(A, g, assume_a="pos")
+    theta = ridge_solve(A, g)
     return LinearParams(w=theta[:-1], b=theta[-1])
 
 
