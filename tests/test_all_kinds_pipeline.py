@@ -67,3 +67,56 @@ def test_every_generatable_kind_trains_end_to_end():
     single = serve(row)
     np.testing.assert_allclose(single[pred.name]["probability"][1],
                                prob[0, 1], rtol=1e-4)
+
+
+def test_all_kinds_model_save_load_parity(tmp_path):
+    """The all-kinds fitted model round-trips through save/load and rescores
+    identically (stage serialization across every vectorizer family)."""
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    kinds = _generatable_kinds()
+    rng = np.random.default_rng(12)
+    label_col = Column.build("RealNN", [float(v) for v in rng.integers(0, 2, N)])
+    feats = {"label": FeatureBuilder("label", "RealNN").as_response()}
+    cols = {"label": label_col}
+    for i, kind in enumerate(kinds):
+        name = f"f_{kind}"
+        feats[name] = FeatureBuilder(name, kind).as_predictor()
+        cols[name] = _col(kind, seed=400 + i)
+    table = Table(cols, N)
+    vec = transmogrify([f for n, f in feats.items() if n != "label"])
+    pred = LogisticRegression(max_iter=6)(feats["label"], vec)
+    model = Workflow().set_result_features(pred).train(table=table)
+    a = np.asarray(model.score(table=table)[pred.name].prob)
+
+    model.save(str(tmp_path))
+    loaded = WorkflowModel.load(str(tmp_path))
+    b = np.asarray(loaded.score(table=table)[pred.name].prob)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_all_kinds_raw_feature_filter():
+    """RawFeatureFilter computes distributions and fill rates for every kind
+    without error (the pre-modeling QA pass over the whole kind space)."""
+    from transmogrifai_tpu.filter import RawFeatureFilter
+
+    kinds = _generatable_kinds()
+    rng = np.random.default_rng(13)
+    label_col = Column.build("RealNN", [float(v) for v in rng.integers(0, 2, N)])
+    feats = {"label": FeatureBuilder("label", "RealNN").as_response()}
+    cols = {"label": label_col}
+    for i, kind in enumerate(kinds):
+        name = f"f_{kind}"
+        feats[name] = FeatureBuilder(name, kind).as_predictor()
+        cols[name] = _col(kind, seed=500 + i)
+    table = Table(cols, N)
+
+    rff = RawFeatureFilter(min_fill_rate=0.0)
+    raw = tuple(feats.values())
+    out, blacklisted = rff.filter_raw(raw, table)
+    assert out.nrows == N
+    # distributions recorded on every predictor feature
+    for f in raw:
+        if f.is_response:
+            continue
+        assert f.distributions, f"no distribution recorded for {f.name}"
