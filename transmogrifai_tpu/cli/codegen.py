@@ -217,7 +217,8 @@ if __name__ == "__main__":
         f"# {name}\n\nGenerated by `op gen` from `{input_csv}`.\n\n"
         f"- problem kind: **{problem}**\n- id field: `{id_field}`\n"
         f"- response: `{response_field}`\n\n"
-        "```bash\npython main.py --type train --params params.json\n```\n\n"
+        "```bash\npython main.py --type train --params params.json\n"
+        "python main.py --type train --smoke   # fast pipeline validation\n```\n\n"
         "Framework concepts (Feature/Stage/Workflow/Reader, serving, scaling): see\n"
         "`docs/abstractions.md`, `docs/examples.md`, and `docs/faq.md` in the\n"
         "transmogrifai_tpu repository.\n"
