@@ -18,6 +18,7 @@ import numpy as np
 from ..graph.feature import Feature
 from ..types import Table
 from .metrics_ops import (
+    bin_score_metrics,
     binary_metrics_fused,
     multiclass_metrics_fused,
     regression_metrics_ops,
@@ -333,20 +334,17 @@ class BinScoreEvaluator(EvaluatorBase):
     def evaluate_all(self, table: Table) -> BinaryClassificationBinMetrics:
         label, pred = self._cols(table)
         vals, ok = _valid_labels(label)
-        y = jnp.asarray(vals[ok], jnp.float32)
-        if y.size == 0:
+        y_np = vals[ok].astype(np.float32)
+        if y_np.size == 0:
             return BinaryClassificationBinMetrics(0.0, 1.0 / self.num_bins)
-        scores = pred.prob[:, 1] if pred.prob.shape[1] > 1 else pred.prob[:, 0]
-        scores = scores[jnp.asarray(ok)]
+        # host mask, ONE device program + ONE fetch (same discipline as the
+        # other evaluators: each separate eager op/fetch is a round trip)
+        prob_np = np.asarray(pred.prob)
+        scores_np = (prob_np[:, 1] if prob_np.shape[1] > 1
+                     else prob_np[:, 0])[ok]
         k = self.num_bins
-        bin_of = jnp.clip((scores * k).astype(jnp.int32), 0, k - 1)
-        ones = jnp.ones_like(scores)
-        counts = jax.ops.segment_sum(ones, bin_of, num_segments=k)
-        score_sum = jax.ops.segment_sum(scores, bin_of, num_segments=k)
-        label_sum = jax.ops.segment_sum(y, bin_of, num_segments=k)
-        brier = jnp.mean((scores - y) ** 2)
         counts, score_sum, label_sum, brier = jax.device_get(
-            (counts, score_sum, label_sum, brier))
+            bin_score_metrics(scores_np, y_np, k))
         denom = np.maximum(counts, 1.0)
         return BinaryClassificationBinMetrics(
             BrierScore=float(brier),

@@ -210,3 +210,18 @@ def _multiclass_threshold_counts_impl(probs, labels, thresholds, top_ns: tuple):
 
 multiclass_threshold_counts = partial(jax.jit, static_argnums=(3,))(
     _multiclass_threshold_counts_impl)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def bin_score_metrics(scores, y, num_bins: int):
+    """Score-bin calibration sums (OpBinScoreEvaluator) as ONE program / ONE
+    fetch: per-bin counts, score sums, label sums + Brier score."""
+    k = num_bins
+    scores = jnp.asarray(scores, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    bin_of = jnp.clip((scores * k).astype(jnp.int32), 0, k - 1)
+    counts = jax.ops.segment_sum(jnp.ones_like(scores), bin_of, num_segments=k)
+    score_sum = jax.ops.segment_sum(scores, bin_of, num_segments=k)
+    label_sum = jax.ops.segment_sum(y, bin_of, num_segments=k)
+    brier = jnp.mean((scores - y) ** 2)
+    return counts, score_sum, label_sum, brier
