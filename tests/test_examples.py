@@ -38,3 +38,15 @@ def test_boston_trains_regression():
 
     result = bo.make_runner().run("train", OpParams())
     assert result.metrics.RootMeanSquaredError < 6.0  # naive-mean RMSE is ~9.2
+
+
+def test_events_example_trains():
+    """examples/events.py (join-then-aggregate) learns the planted pre-cutoff
+    spend signal."""
+    import examples.events as ev
+
+    runner = ev.make_runner()
+    from transmogrifai_tpu.params import OpParams
+
+    res = runner.run("train", OpParams())
+    assert res.metrics.AuROC > 0.65  # planted signal, not noise
