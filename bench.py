@@ -22,11 +22,41 @@ from __future__ import annotations
 
 import json
 import os
+import socket
+import sys
+import threading
 import time
 
 import numpy as np
 
-import sys
+
+def _pre_guard() -> bool | None:
+    """Relay-proofing, stage 1 (BEFORE any jax/package import): if a TPU relay
+    is configured but its port is closed, force the CPU backend now — in the
+    fast-refuse death mode every later backend touch would raise, and in the
+    hang mode it would block forever. Stage 2 (init_backend on a worker
+    thread) runs in main(). Returns None (no relay), True (alive), False
+    (dead → CPU forced)."""
+    ips = os.environ.get("PALLAS_AXON_POOL_IPS", "").strip()
+    if not ips:
+        return None
+    port = int(os.environ.get("TT_RELAY_PORT", "8103"))
+    for ip in ips.replace(",", " ").split():
+        try:
+            socket.create_connection((ip, port), timeout=3).close()
+        except OSError:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            if "jax" in sys.modules:  # registered at interpreter startup
+                try:
+                    sys.modules["jax"].config.update("jax_platforms", "cpu")
+                except Exception:
+                    pass
+            return False
+    return True
+
+
+_RELAY_OK = _pre_guard()
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from examples.titanic import FIELDS, SCHEMA  # single schema definition  # noqa: E402
@@ -57,10 +87,20 @@ def _reader():
     return InMemoryReader(rows)
 
 
+#: what the search grid is vs the reference walkthrough — recorded in detail so
+#: the substitution is explicit, not implied parity (reference README.md:62-64)
+GRID_NOTE = ("default: 3 LR + 8 RF + 8 GBT = 19 models x 3 folds; reference "
+             "README.md:62-64 runs 3 LR + 16 RF = 19 models x 3 folds — half "
+             "the RF budget is substituted with GBT to cover both tree "
+             "families. BENCH_REF_GRID=1 runs the reference-exact 3 LR + 16 RF.")
+
+
 def _models():
     """19 candidate models mirroring the reference's Titanic README search
-    (README.md:62-64: 3 LR + 16 RF/GBT-ish, AuPR selection): 3 LR + 8 RF + 8 GBT.
-    RF depths {3, 6} are the only static-compile axes; everything else vmaps."""
+    (README.md:62-64: 3 LR + 16 RF, AuPR selection). Default: 3 LR + 8 RF +
+    8 GBT (see GRID_NOTE); BENCH_REF_GRID=1 selects the reference-exact
+    3 LR + 16 RF split. RF depths {3, 6} are the only static-compile axes;
+    everything else vmaps."""
     from transmogrifai_tpu.select import ParamGridBuilder
     from transmogrifai_tpu.stages.model import (
         GBTClassifier,
@@ -69,6 +109,18 @@ def _models():
     )
 
     lr_grid = ParamGridBuilder().add("l2", [0.001, 0.01, 0.1]).build()
+    if os.environ.get("BENCH_REF_GRID") == "1":
+        rf16 = (
+            ParamGridBuilder()
+            .add("max_depth", [3, 6])
+            .add("min_child_weight", [1.0, 10.0, 100.0, 1000.0])
+            .add("reg_lambda", [1e-3, 1e-1])
+            .build()
+        )
+        return [
+            (LogisticRegression(max_iter=25), lr_grid),
+            (RandomForestClassifier(n_trees=50), rf16),
+        ]
     rf_grid = (
         ParamGridBuilder()
         .add("max_depth", [3, 6])
@@ -110,7 +162,98 @@ def _build():
     return wf, selector, pred, fs
 
 
+_METRIC = "titanic_automl_models_evaluated_per_sec"
+
+
+def _emit_final(payload: dict) -> None:
+    """The driver records only the last ~2000 bytes of output; this line must
+    be last, standalone, and parseable."""
+    sys.stdout.flush()
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def _error_payload(stage: str, err: str, partial: dict | None = None) -> dict:
+    p = {"metric": _METRIC, "value": None, "unit": "models/sec",
+         "vs_baseline": None, "error": f"{stage}: {err}"}
+    if partial:
+        # scalars only, and keep the WHOLE line comfortably under the driver's
+        # 2000-byte tail so it parses
+        flat = {k: v for k, v in partial.items()
+                if isinstance(v, (int, float, str, bool, type(None)))}
+        while flat and len(json.dumps({**p, "partial": flat})) > 1500:
+            flat.pop(next(iter(flat)))
+        p["partial"] = flat
+    return p
+
+
 def main() -> None:
+    """Relay-proof wrapper: a watchdog guarantees a final JSON line even if the
+    TPU relay hangs mid-run, and any exception degrades to an error payload
+    instead of a bare traceback (VERDICT r03 #1)."""
+    partial: dict = {}
+    deadline = float(os.environ.get("TT_BENCH_DEADLINE_S", "2700"))
+
+    def watchdog():
+        time.sleep(deadline)
+        msg = f"bench exceeded {deadline:.0f}s — relay likely hung mid-run"
+        try:
+            # snapshot: _run mutates `partial` concurrently, and an iteration
+            # error here would kill the very thread that guarantees the final
+            # JSON line
+            _emit_final(_error_payload("deadline", msg, dict(partial)))
+        except Exception:
+            _emit_final({"metric": _METRIC, "value": None, "unit": "models/sec",
+                         "vs_baseline": None, "error": f"deadline: {msg}"})
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        _run(partial)
+    except Exception as e:
+        import traceback
+
+        last = traceback.format_exc().strip().splitlines()[-1]
+        _emit_final(_error_payload(
+            "run", f"{type(e).__name__}: {e} ({last})"[:600], partial))
+
+
+def _run(partial: dict) -> None:
+    # stage-2 backend guard: first backend touch on a worker thread so a
+    # protocol-level relay hang is detected, not inherited
+    from transmogrifai_tpu.utils.backend_guard import (
+        force_cpu,
+        init_backend,
+        reexec_cpu,
+    )
+
+    platform, _ndev, err = init_backend(
+        timeout_s=float(os.environ.get("TT_BACKEND_INIT_TIMEOUT_S", "120")))
+    note = None
+    if err is not None and "timed out" in err:
+        # a thread is stuck holding jax's backend lock: in-process recovery is
+        # impossible — re-exec on a cleaned CPU-only env (never returns)
+        reexec_cpu()
+    if err is not None:
+        force_cpu()
+        try:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        except Exception:
+            pass
+        platform, _ndev, err2 = init_backend(timeout_s=60)
+        if err2 is not None:
+            raise RuntimeError(
+                f"no usable backend — tpu: {err}; cpu fallback: {err2}")
+        note = f"TPU backend unavailable ({err}); ran on CPU fallback"
+    elif _RELAY_OK is False:
+        note = "TPU relay port closed at launch; ran on CPU fallback"
+    elif os.environ.get("TT_BACKEND_REEXEC"):
+        note = "re-exec'd onto CPU after a relay hang during backend init"
+    if note:
+        partial["device_note"] = note
+
     import jax
 
     from transmogrifai_tpu.utils.compile_cache import enable_compile_cache
@@ -125,6 +268,7 @@ def main() -> None:
     wf.train(table=full)
     warm = time.perf_counter() - t0
     first_models_per_sec = selector.summary_.models_evaluated / warm
+    partial["first_train_s"] = round(warm, 3)
 
     # timed steady-state search on the same shapes (fresh graph, cached programs)
     t1 = time.perf_counter()
@@ -133,6 +277,7 @@ def main() -> None:
     dt = time.perf_counter() - t1
     summary = selector2.summary_
     models_per_sec = summary.models_evaluated / dt
+    partial["titanic_models_per_sec_steady"] = round(models_per_sec, 3)
 
     # quality parity: the selector's HOLDOUT metrics (reserved split, never seen by
     # search or final refit) against the reference's published holdout table
@@ -140,7 +285,12 @@ def main() -> None:
     vs_baseline = (round(holdout["AuPR"] / REFERENCE_HOLDOUT["AuPR"], 3)
                    if holdout.get("AuPR") else None)
 
+    if holdout.get("AuPR"):
+        partial["titanic_holdout_AuPR"] = round(holdout["AuPR"], 4)
+
     detail = {
+        "grid": GRID_NOTE,
+        "device_note": partial.get("device_note"),
         "models_evaluated": summary.models_evaluated,
         "search_wall_s": round(dt, 3),
         "first_train_incl_compile_s": round(warm, 3),
@@ -161,19 +311,24 @@ def main() -> None:
         from bench_wide import run_wide
 
         detail["wide"] = run_wide()
+        partial["wide_stats_mfu"] = detail["wide"].get("stats_mfu")
     if os.environ.get("BENCH_EXTRA", "1") != "0":
         # BASELINE.json configs 2/3/5 + the pallas histogram kernel evidence
         from bench_extra import run_boston, run_hist, run_iris, run_mlp, run_trees
 
         detail["iris"] = run_iris()
+        partial["iris_models_per_sec"] = detail["iris"].get("models_per_sec")
         detail["boston"] = run_boston()
+        partial["boston_models_per_sec"] = detail["boston"].get("models_per_sec")
         detail["hist_kernel"] = run_hist()
         detail["mlp_deep_tabular"] = run_mlp()
+        partial["mlp_mfu"] = detail["mlp_deep_tabular"].get("mfu")
         detail["gbt_scale"] = run_trees()
+        partial["gbt_hist_mfu"] = detail["gbt_scale"].get("hist_mfu")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({
-        "metric": "titanic_automl_models_evaluated_per_sec",
+        "metric": _METRIC,
         "value": round(models_per_sec, 3),
         "unit": "models/sec",
         "vs_baseline": vs_baseline,
@@ -183,7 +338,7 @@ def main() -> None:
     # the last ~2000 bytes of output, so this line must be compact (<1.5 KB)
     # and carry every number the judge needs on its own.
     compact = {
-        "metric": "titanic_automl_models_evaluated_per_sec",
+        "metric": _METRIC,
         "value": round(models_per_sec, 3),
         "unit": "models/sec",
         "vs_baseline": vs_baseline,
@@ -197,6 +352,8 @@ def main() -> None:
         },
     }
     s = compact["summary"]
+    if partial.get("device_note"):
+        s["device_note"] = partial["device_note"]
     if "wide" in detail:
         s["wide_stats_mfu"] = detail["wide"].get("stats_mfu")
         s["wide_stats_tflops_per_sec"] = detail["wide"].get("stats_tflops_per_sec")
@@ -209,8 +366,7 @@ def main() -> None:
     if "gbt_scale" in detail:
         s["gbt_hist_mfu"] = detail["gbt_scale"].get("hist_mfu")
         s["gbt_hist_tflops_per_sec"] = detail["gbt_scale"].get("hist_tflops_per_sec")
-    sys.stdout.flush()
-    print(json.dumps(compact))
+    _emit_final(compact)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,251 @@
+"""Semantic tests for the map/list/indexer micro-stages added for reference
+parity (VERDICT r03 #6/#7): label-aware map bucketization, date-map circular
+encoding, text-map len/null, text-list null, time-period list/map, substring,
+and the no-filter indexer pair."""
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder
+from transmogrifai_tpu.types import Column, Table, kind_of
+
+
+def _map_col(kind, rows):
+    return Column.build(kind_of(kind), rows)
+
+
+# --- DecisionTreeNumericMapBucketizer ----------------------------------------------
+
+
+def test_map_bucketizer_splits_informative_key_only():
+    """k1 separates the label perfectly -> bucketed; k2 is constant noise ->
+    collapses to its null indicator (the reference's per-key shortcut)."""
+    from transmogrifai_tpu.stages.feature.calibration import (
+        DecisionTreeNumericMapBucketizer,
+    )
+
+    n = 40
+    y = [float(i % 2) for i in range(n)]
+    rows = [{"k1": (5.0 if i % 2 else -5.0), "k2": 1.0} for i in range(n)]
+    rows[3] = {"k2": 1.0}  # a missing k1 exercises the per-key null path
+    label = FeatureBuilder.RealNN("y").as_response()
+    m = FeatureBuilder.RealMap("m").as_predictor()
+    stage = DecisionTreeNumericMapBucketizer()
+    stage(label, m)
+    model = stage.fit_columns(
+        [Column.build(kind_of("RealNN"), y), _map_col("RealMap", rows)])
+
+    splits = model.params["splits_per_key"]
+    assert splits["k1"], "informative key must get at least one split"
+    assert splits["k2"] == [], "constant key must get none"
+
+    out = model.transform_columns(
+        [Column.build(kind_of("RealNN"), y), _map_col("RealMap", rows)])
+    schema = out.schema
+    groups = {s.group for s in schema.slots}
+    assert groups == {"k1", "k2"}
+    vec = np.asarray(out.values)
+    assert vec.shape[1] == len(schema.slots)
+    # row 3: k1 missing -> its buckets all zero, its null slot 1
+    k1_slots = [i for i, s in enumerate(schema.slots) if s.group == "k1"]
+    k1_null = [i for i in k1_slots if schema.slots[i].indicator_value == "NullIndicatorValue"]
+    assert vec[3, k1_null].sum() == 1.0
+    bucket_slots = [i for i in k1_slots if i not in k1_null]
+    assert vec[3, bucket_slots].sum() == 0.0
+    # even rows bucket below the split, odd above — one-hot exactly once
+    assert (vec[0, bucket_slots].sum(), vec[1, bucket_slots].sum()) == (1.0, 1.0)
+    assert np.argmax(vec[0, bucket_slots]) != np.argmax(vec[1, bucket_slots])
+
+
+# --- DateMapToUnitCircleVectorizer -------------------------------------------------
+
+
+def test_date_map_unit_circle_matches_plain_date_encoding():
+    from transmogrifai_tpu.stages.feature.date import (
+        DateMapToUnitCircleVectorizer,
+        DateToUnitCircleVectorizer,
+    )
+
+    ms = 1584277200000  # 2020-03-15T13:00:00Z
+    rows = [{"k": ms}, None, {"k": ms + 3_600_000}]
+    f = FeatureBuilder.DateMap("dm").as_predictor()
+    est = DateMapToUnitCircleVectorizer(time_periods=["HourOfDay"])
+    est(f)
+    model = est.fit_columns([_map_col("DateMap", rows)])
+    out = model.transform_columns([_map_col("DateMap", rows)])
+    vec = np.asarray(out.values)
+    assert vec.shape == (3, 2)
+
+    plain = DateToUnitCircleVectorizer(time_periods=["HourOfDay"],
+                                       track_nulls=False)
+    pf = FeatureBuilder.Date("d").as_predictor()
+    plain(pf)
+    pvec = np.asarray(plain.transform_columns(
+        [Column.build(kind_of("Date"), [ms, ms + 3_600_000])]).values)
+    np.testing.assert_allclose(vec[0], pvec[0], atol=1e-6)
+    np.testing.assert_allclose(vec[2], pvec[1], atol=1e-6)
+    # missing map -> (0, 0): off the unit circle, unambiguous
+    np.testing.assert_allclose(vec[1], [0.0, 0.0])
+
+
+def test_transmogrify_routes_date_maps_through_unit_circle():
+    from transmogrifai_tpu.stages.feature import transmogrify
+
+    f = FeatureBuilder.DateMap("dm").as_predictor()
+    vec = transmogrify([f])
+    # combined schema must carry BOTH circular descriptors and day values
+    stage = vec.origin_stage
+    names = set()
+
+    def walk(feat):
+        if feat.origin_stage is not None:
+            names.add(type(feat.origin_stage).__name__)
+            for p in feat.parents:
+                walk(p)
+
+    walk(vec)
+    assert "DateMapToUnitCircleVectorizer" in names, names
+    assert "MapVectorizer" in names, names
+    assert stage is not None
+
+
+# --- text map len / null, text list null -------------------------------------------
+
+
+def test_text_map_len_and_null():
+    from transmogrifai_tpu.stages.feature.collections import (
+        TextMapLenEstimator,
+        TextMapNullEstimator,
+    )
+
+    rows = [{"k1": "hello world", "k2": "a"}, {"k1": ""}, None]
+    f = FeatureBuilder.TextMap("tm").as_predictor()
+
+    est = TextMapLenEstimator()
+    est(f)
+    model = est.fit_columns([_map_col("TextMap", rows)])
+    out = np.asarray(model.transform_columns([_map_col("TextMap", rows)]).values)
+    # k1: "hello world" -> 5+5=10 token chars; "" -> 0; missing -> 0
+    k1 = [i for i, s in enumerate(model.params["all_keys"][0]) if s == "k1"][0]
+    np.testing.assert_allclose(out[:, k1], [10.0, 0.0, 0.0])
+
+    nst = TextMapNullEstimator()
+    nst(FeatureBuilder.TextMap("tm2").as_predictor())
+    nmodel = nst.fit_columns([_map_col("TextMap", rows)])
+    nout = np.asarray(nmodel.transform_columns([_map_col("TextMap", rows)]).values)
+    # null iff missing OR tokenizes empty
+    np.testing.assert_allclose(nout[:, k1], [0.0, 1.0, 1.0])
+
+
+def test_text_list_null_transformer():
+    from transmogrifai_tpu.stages.feature.collections import TextListNullTransformer
+
+    f = FeatureBuilder.TextList("tl").as_predictor()
+    t = TextListNullTransformer()
+    t(f)
+    col = Column.build(kind_of("TextList"), [["a"], [], None])
+    out = np.asarray(t.transform_columns([col]).values)
+    np.testing.assert_allclose(out[:, 0], [0.0, 1.0, 1.0])
+
+
+# --- time period list / map --------------------------------------------------------
+
+
+def test_time_period_map_transformer():
+    from transmogrifai_tpu.stages.feature.misc import TimePeriodMapTransformer
+
+    ms = 1584277200000  # Sunday 2020-03-15, 13:00 UTC
+    f = FeatureBuilder.DateMap("dm").as_predictor()
+    st = TimePeriodMapTransformer(period="DayOfWeek")
+    st(f)
+    out = st.transform_columns([_map_col("DateMap", [{"k": ms}, None])])
+    assert out.kind.name == "IntegralMap"
+    assert out.values[0] == {"k": 7}  # ISO Sunday
+    assert not out.values[1]
+
+
+def test_time_period_list_transformer_pads_and_counts():
+    from transmogrifai_tpu.stages.feature.misc import TimePeriodListTransformer
+
+    ms = 1584277200000
+    f = FeatureBuilder.DateList("dl").as_predictor()
+    st = TimePeriodListTransformer(period="HourOfDay", max_elements=3)
+    st(f)
+    col = Column.build(kind_of("DateList"), [[ms, ms + 3_600_000], [], None])
+    out = st.transform_columns([col])
+    vec = np.asarray(out.values)
+    assert vec.shape == (3, 4)  # 3 period slots + count
+    np.testing.assert_allclose(vec[0], [13.0, 14.0, 0.0, 2.0])
+    np.testing.assert_allclose(vec[1], 0.0)
+
+
+# --- substring ---------------------------------------------------------------------
+
+
+def test_substring_transformer():
+    from transmogrifai_tpu.stages.feature.text import SubstringTransformer
+
+    a = FeatureBuilder.Text("a").as_predictor()
+    b = FeatureBuilder.TextArea("b").as_predictor()
+    st = SubstringTransformer()
+    st(a, b)
+    out = st.transform_columns([
+        Column.build(kind_of("Text"), ["World", "xyz", None]),
+        Column.build(kind_of("TextArea"), ["Hello world", "Hello world", "hi"]),
+    ])
+    assert out.kind.name == "Binary"
+    vals = np.asarray(out.values)
+    mask = np.asarray(out.effective_mask())
+    assert vals[0] == 1.0  # case-folded containment
+    assert vals[1] == 0.0
+    assert not mask[2]  # null sub -> null out
+
+    st2 = SubstringTransformer(to_lowercase=False)
+    st2(FeatureBuilder.Text("a2").as_predictor(),
+        FeatureBuilder.TextArea("b2").as_predictor())
+    out2 = st2.transform_columns([
+        Column.build(kind_of("Text"), ["World"]),
+        Column.build(kind_of("TextArea"), ["Hello world"]),
+    ])
+    assert np.asarray(out2.values)[0] == 0.0  # case-sensitive now
+
+
+# --- no-filter indexers ------------------------------------------------------------
+
+
+def test_string_indexer_no_filter_tracks_unseen_and_null():
+    from transmogrifai_tpu.stages.feature.categorical import (
+        IndexToStringNoFilter,
+        StringIndexerNoFilter,
+    )
+
+    f = FeatureBuilder.PickList("p").as_predictor()
+    est = StringIndexerNoFilter()
+    est(f)
+    fit_col = Column.build(kind_of("PickList"), ["b", "b", "a", None])
+    model = est.fit_columns([fit_col])
+    # frequency order: b (2) first; None and "a" tie at 1 -> null first
+    assert model.params["labels"] == ["b", None, "a"]
+    assert model.label_names == ["b", "null", "a", "UnseenLabel"]
+
+    score = Column.build(kind_of("PickList"), ["a", "zzz", None])
+    out = np.asarray(model.transform_columns([score]).values)
+    np.testing.assert_allclose(out, [2.0, 3.0, 1.0])  # unseen -> otherPos=3
+
+    inv = IndexToStringNoFilter(labels=["b", "null", "a"])
+    inv(f.alias("idx"))
+    back = inv.transform_columns([Column.build(kind_of("RealNN"), [0.0, 3.0])])
+    assert list(back.values) == ["b", "UnseenIndex"]
+
+
+def test_indexer_no_filter_roundtrips_in_workflow():
+    """End-to-end: NoFilter index -> model JSON round trip keeps labels."""
+    from transmogrifai_tpu.stages.feature.categorical import StringIndexerNoFilterModel
+
+    m = StringIndexerNoFilterModel(labels=["x", None, "y"])
+    clone = StringIndexerNoFilterModel.from_json(m.to_json())
+    assert clone.params["labels"] == ["x", None, "y"]
+    out = np.asarray(clone.transform_columns(
+        [Column.build(kind_of("PickList"), [None, "y", "nope"])]).values)
+    np.testing.assert_allclose(out, [1.0, 2.0, 3.0])

@@ -181,6 +181,16 @@ def _recipes():
         "TextLenTransformer": ({}, [("x", "Text", False)]),
         "TextTokenizer": ({}, [("x", "Text", False)]),
         "TimePeriodTransformer": ({}, [("x", "Date", False)]),
+        "TimePeriodMapTransformer": ({}, [("x", RandomMap.of(
+            RandomIntegral.dates(), keys=("k1", "k2"),
+            kind="DateMap").with_seed(31).column(N), False)]),
+        "TimePeriodListTransformer": (dict(max_elements=4),
+                                      [("x", "DateList", False)]),
+        "SubstringTransformer": ({}, [("a", "Text", False),
+                                      ("b", "TextArea", False)]),
+        "TextListNullTransformer": ({}, [("x", "TextList", False)]),
+        "IndexToStringNoFilter": (dict(labels=["a", "b", "c"]),
+                                  [("x", idx_col, False)]),
         "ToOccurTransformer": ({}, [("x", "Text", False)]),
         "ScalerTransformer": (dict(slope=2.0, intercept=1.0),
                               [("x", "Real", False)]),
@@ -207,6 +217,14 @@ def _recipes():
                                    [("x", "TextMap", False)]),
         "StandardScaler": ({}, [("x", "Real", False)]),
         "StringIndexer": ({}, [("x", "PickList", False)]),
+        "StringIndexerNoFilter": ({}, [("x", "PickList", False)]),
+        "TextMapLenEstimator": ({}, [("x", "TextMap", False)]),
+        "TextMapNullEstimator": ({}, [("x", "TextMap", False)]),
+        "DateMapToUnitCircleVectorizer": ({}, [("x", RandomMap.of(
+            RandomIntegral.dates(), keys=("k1", "k2"),
+            kind="DateMap").with_seed(32).column(N), False)]),
+        "DecisionTreeNumericMapBucketizer": ({}, [("y", _labels_binary(), True),
+                                                  ("x", "RealMap", False)]),
         "PercentileCalibrator": (dict(buckets=10), [("x", _labels_real(21), False)]),
         "Word2Vec": (dict(dim=8, window=2, epochs=2), [("x", "TextList", False)]),
         "LDA": (dict(k=3, iters=5), [("x", _vec_col(13, nonneg=True), False)]),
@@ -425,6 +443,9 @@ def test_every_registered_stage_is_covered():
     # fitted models are exercised through their estimator's fit
     for est in RECIPES:
         covered.add(est + "Model")
+        if est.endswith("Estimator"):
+            # reference naming: TextMapLenEstimator fits TextMapLenModel
+            covered.add(est[: -len("Estimator")] + "Model")
     # test modules register fixture stages (test_graph/test_sanitize): only
     # stages defined inside the package are the sweep's contract
     package_stages = {

@@ -289,3 +289,61 @@ def test_warm_start_after_save_load_roundtrip(tmp_path):
     finally:
         mp.undo()
     assert fits == []  # loaded fitted stage reused, params verified equal
+
+
+def test_fused_cache_descaler_cross_stage_fingerprint():
+    """Two graphs identical in stage classes + own params but with a DIFFERENT
+    upstream scaler slope must not share a fused traced program: the Descaler
+    bakes the scaler's inverse args in as python constants (ADVICE r03 medium)."""
+    from transmogrifai_tpu.stages.feature.misc import (
+        DescalerTransformer,
+        ScalerTransformer,
+    )
+    from transmogrifai_tpu.types import Column
+    from transmogrifai_tpu.workflow.workflow import _fuse_device_run
+
+    def build(slope):
+        raw = FeatureBuilder("x", "Real").as_predictor()
+        scaler = ScalerTransformer(slope=slope, intercept=0.0)
+        scaled = scaler(raw)
+        de = DescalerTransformer()
+        de(scaled.alias("scaled_in"), scaled)
+        return de, scaled
+
+    from transmogrifai_tpu.utils import reset_uid_counter
+
+    vals = np.asarray([2.0, 4.0], np.float32)
+    outs = []
+    for slope in (2.0, 4.0):
+        # repeat uids so feature NAMES (and hence the cache key's in_names)
+        # collide across the two graphs — the scenario the fingerprint must
+        # disambiguate
+        reset_uid_counter()
+        de, scaled = build(slope)
+        # identical in_names + wiring + class names + OWN params across the two
+        # iterations; only the upstream scaler's slope differs
+        fn = _fuse_device_run([de], ["scaled_in", scaled.name])
+        col = Column.real(vals)
+        outs.append(np.asarray(fn((col, col))[0].values))
+    np.testing.assert_allclose(outs[0], vals / 2.0)
+    np.testing.assert_allclose(outs[1], vals / 4.0)  # stale program would give /2
+
+
+def test_fused_cache_lambda_not_shared():
+    """Anonymous lambdas have no JSON identity: two different lambdas must not
+    collide on one cached traced program (ADVICE r03)."""
+    from transmogrifai_tpu.stages.base import LambdaTransformer
+    from transmogrifai_tpu.types import Column
+    from transmogrifai_tpu.workflow.workflow import _fuse_device_run
+
+    import jax.numpy as jnp
+
+    outs = []
+    for fn in (lambda c: Column.real(jnp.asarray(c.values) * 2),
+               lambda c: Column.real(jnp.asarray(c.values) * 3)):
+        raw = FeatureBuilder("x", "Real").as_predictor()
+        stage = LambdaTransformer(fn, "Real", device_op=True)
+        stage(raw)
+        run = _fuse_device_run([stage], ["x"])
+        outs.append(float(np.asarray(run((Column.real(np.asarray([1.0], np.float32)),))[0].values)[0]))
+    assert outs == [2.0, 3.0]

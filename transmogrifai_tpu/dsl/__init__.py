@@ -309,13 +309,17 @@ def vectorize_map(self: Feature, *others: Feature,
 
         if allow_keys or block_keys:
             # the smart text-map path has no key filters — silently hashing a
-            # blocked key would defeat the caller's exclusion; filter first
+            # blocked key would defeat the caller's exclusion; filter EVERY
+            # map input (self and others alike) before recursing
             from ..stages.feature.misc import FilterMap
 
-            filtered = FilterMap(whitelist=list(allow_keys) or None,
-                                 blacklist=list(block_keys) or None)(self)
+            def _filt(f: Feature) -> Feature:
+                return FilterMap(whitelist=list(allow_keys) or None,
+                                 blacklist=list(block_keys) or None)(f)
+
             return vectorize_map(
-                filtered, *others, top_k=top_k, min_support=min_support,
+                _filt(self), *(_filt(o) for o in others),
+                top_k=top_k, min_support=min_support,
                 clean_text=clean_text, track_nulls=track_nulls,
                 max_cardinality=max_cardinality, num_features=num_features)
         return SmartTextMapVectorizer(
@@ -323,6 +327,29 @@ def vectorize_map(self: Feature, *others: Feature,
             min_support=min_support, num_features=num_features,
             clean_text=clean_text, track_nulls=track_nulls)(self, *others)
     from ..stages.feature.collections import MapVectorizer
+
+    if kind in ("DateMap", "DateTimeMap"):
+        # circular encoding per period + days-since, combined — the reference's
+        # RichDateMapFeature.vectorize shape (RichMapFeature.scala:757-782)
+        from ..stages.feature.combiner import VectorsCombiner
+        from ..stages.feature.date import TIME_PERIODS, DateMapToUnitCircleVectorizer
+
+        circ_ins = (self,) + tuple(others)
+        if allow_keys or block_keys:
+            # the circular vectorizer has no key filters of its own
+            from ..stages.feature.misc import FilterMap
+
+            circ_ins = tuple(
+                FilterMap(whitelist=list(allow_keys) or None,
+                          blacklist=list(block_keys) or None)(f)
+                for f in circ_ins)
+        circ = DateMapToUnitCircleVectorizer(
+            time_periods=list(TIME_PERIODS))(*circ_ins)
+        days = MapVectorizer(
+            top_k=top_k, min_support=min_support, clean_text=clean_text,
+            track_nulls=track_nulls, allow_keys=allow_keys,
+            block_keys=block_keys)(self, *others)
+        return VectorsCombiner()(circ, days)
 
     return MapVectorizer(
         top_k=top_k, min_support=min_support, clean_text=clean_text,

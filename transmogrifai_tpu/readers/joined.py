@@ -314,7 +314,10 @@ class JoinedAggregateReader(JoinedReader):
                     if f.is_response:
                         ok = t >= c and (w is None or t < c + w)
                     else:
-                        ok = t < c and (w is None or t >= c - w)
+                        # strict lower bound: the reference excludes events at
+                        # exactly cutoff - window (JoinedDataReader.scala:433,
+                        # timeStamp > cutOff - timeWindow)
+                        ok = t < c and (w is None or t > c - w)
                     v = row.get(f.name)
                     if ok and v is not None:
                         acc = agg.combine(acc, agg.prepare(v))

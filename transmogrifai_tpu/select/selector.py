@@ -11,6 +11,7 @@ evaluators. The search itself is device-batched (see validator.py).
 from __future__ import annotations
 
 import json
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -304,11 +305,14 @@ class ModelSelector(PredictorEstimator):
         return model
 
 
-#: fused predict+metrics jit programs, keyed by (model family, problem type,
-#: num_classes) — see _metrics_program. Default-config evaluators only (the
-#: selector builds its own); custom-threshold evaluators go through
-#: evaluate_all on a scored table instead.
-_METRICS_PROGRAM_CACHE: dict = {}
+#: fused predict+metrics jit programs, keyed by (model family, ctor params,
+#: problem type, num_classes) — see _metrics_program. Default-config evaluators
+#: only (the selector builds its own); custom-threshold evaluators go through
+#: evaluate_all on a scored table instead. LRU-bounded like _FUSED_RUN_CACHE:
+#: each entry pins a compiled executable, and a long-lived service whose
+#: searches win ever-different grid points must evict (ADVICE r03).
+_METRICS_PROGRAM_CACHE: OrderedDict = OrderedDict()
+_METRICS_PROGRAM_CACHE_MAX = 64
 _EVALUATOR_CACHE: dict = {}
 
 
@@ -341,6 +345,8 @@ def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
         cfg = repr(sorted(template.params.items(), key=lambda kv: kv[0]))
     key = (template.__class__, cfg, problem_type, num_classes)
     fn = _METRICS_PROGRAM_CACHE.get(key)
+    if fn is not None:
+        _METRICS_PROGRAM_CACHE.move_to_end(key)
     if fn is None:
         import jax
 
@@ -353,6 +359,8 @@ def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
                 pred, raw, prob = template.predict_fn(params, X)
                 return evaluator.device_metrics(pred, raw, prob, y)
         fn = _METRICS_PROGRAM_CACHE[key] = jax.jit(prog)
+        while len(_METRICS_PROGRAM_CACHE) > _METRICS_PROGRAM_CACHE_MAX:
+            _METRICS_PROGRAM_CACHE.popitem(last=False)
     return fn
 
 

@@ -154,6 +154,17 @@ class Transformer(Stage):
             self.transform_columns([table[f.name] for f in self.inputs]), self)
         return table.with_column(self.get_output().name, out)
 
+    def trace_fingerprint(self) -> Any:
+        """JSON-able identity of EVERYTHING transform_columns bakes into a traced
+        program as a python constant. The fused-run program cache keys on this:
+        two stages with equal fingerprints may share one traced program, so a
+        stage whose transform reads state outside self.params (cross-stage
+        reads, e.g. DescalerTransformer's upstream scaler args) MUST override
+        this to include that state. Raise TypeError when the state has no
+        faithful JSON identity (lambdas, closures) — the caller then skips
+        caching rather than risking a stale-program hit."""
+        return _fingerprint_jsonify(self.params)
+
 
 class Estimator(Stage):
     """A stage that learns parameters from data before transforming
@@ -213,6 +224,15 @@ class LambdaTransformer(Transformer):
     def transform_columns(self, cols):
         return self.fn(*cols)
 
+    def trace_fingerprint(self):
+        # self.fn lives OUTSIDE params: without it two different lambdas would
+        # share {"fn_name": None} and hit one cached traced program. A given
+        # fn_name is a user-asserted stable identity; otherwise the callable
+        # itself must fingerprint (TypeError for anonymous lambdas → uncached).
+        if self.params.get("fn_name"):
+            return _fingerprint_jsonify(self.params)
+        return _fingerprint_jsonify({"fn": self.fn, **self.params})
+
 
 class FeatureGeneratorStage(Stage):
     """Stage 0 of every raw feature: holds the record->value extract function and the
@@ -256,4 +276,31 @@ def _jsonify(obj):
         return obj.tolist()
     if callable(obj) and not isinstance(obj, type):
         return getattr(obj, "__name__", "<fn>")
+    return obj
+
+
+def _fingerprint_jsonify(obj):
+    """Like _jsonify but STRICT about identity — for cache keys, not display.
+
+    Raises TypeError for values whose JSON form would not uniquely identify the
+    computation a traced program bakes in: lambdas and local closures both
+    jsonify to '<lambda>'/their bare name, so two different functions would
+    collide on one cached program. Module-level callables fingerprint as
+    module.qualname (stable across graphs)."""
+    if isinstance(obj, dict):
+        return {k: _fingerprint_jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_fingerprint_jsonify(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if callable(obj) and not isinstance(obj, type):
+        qn = getattr(obj, "__qualname__", "") or ""
+        mod = getattr(obj, "__module__", "") or ""
+        if not mod or "<lambda>" in qn or "<locals>" in qn:
+            raise TypeError(f"unfingerprintable callable: {obj!r}")
+        return f"{mod}.{qn}"
     return obj

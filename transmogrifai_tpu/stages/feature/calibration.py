@@ -200,3 +200,104 @@ class PercentileCalibratorModel(Transformer):
         # scale to [0, buckets-1] like the reference's min-max scaling of bucket ids
         scale = (p["buckets"] - 1) / max(len(p["splits"]), 1)
         return Column.real(idx * scale, kind="RealNN")
+
+
+@register_stage
+class DecisionTreeNumericMapBucketizer(Estimator):
+    """(label, numeric map) -> per-key one-hot buckets at per-key tree-discovered
+    splits (reference DecisionTreeNumericMapBucketizer.scala: the map twin of
+    DecisionTreeNumericBucketizer, label-aware split search independently per
+    key). Keys with no informative split collapse to their null indicator only —
+    the reference's per-key 'shortcut'. Missing keys are nulls for that key."""
+
+    operation_name = "autoBucketizeMap"
+    arity = (2, 2)
+
+    NUMERIC_MAPS = ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap")
+
+    def __init__(self, track_nulls: bool = True, max_splits: int = 16,
+                 min_info_gain: float = 0.01):
+        super().__init__(track_nulls=bool(track_nulls), max_splits=int(max_splits),
+                         min_info_gain=float(min_info_gain))
+
+    def out_kind(self, in_kinds):
+        if in_kinds[1].name not in self.NUMERIC_MAPS:
+            raise TypeError(
+                f"autoBucketizeMap needs a numeric map, got {in_kinds[1].name}")
+        return kind_of("OPVector")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def fit_columns(self, cols: Sequence[Column]):
+        p = self.params
+        y = np.asarray(cols[0].filled(0.0), np.float32)
+        c = cols[1]
+        keys: dict[str, None] = {}
+        for m in c.values:
+            for k in (m or {}):
+                keys[str(k)] = None
+        splits_per_key = {}
+        for key in sorted(keys):
+            xs, ys = [], []
+            for i, m in enumerate(c.values):
+                v = (m or {}).get(key)
+                if v is not None:
+                    xs.append(float(v))
+                    ys.append(y[i])
+            splits_per_key[key] = find_splits(
+                np.asarray(xs, np.float32), np.asarray(ys, np.float32),
+                max_splits=p["max_splits"], min_info_gain=p["min_info_gain"])
+        return DecisionTreeNumericMapBucketizerModel(
+            splits_per_key=splits_per_key, track_nulls=p["track_nulls"],
+            name=self.inputs[1].name, kind=self.inputs[1].kind.name)
+
+
+@register_stage
+class DecisionTreeNumericMapBucketizerModel(Transformer):
+    operation_name = "autoBucketizeMap"
+    arity = (2, 2)
+    device_op = False  # host map pivot
+
+    def __init__(self, splits_per_key: dict | None = None, track_nulls: bool = True,
+                 name: str = "", kind: str = ""):
+        super().__init__(splits_per_key=dict(splits_per_key or {}),
+                         track_nulls=track_nulls, name=name, kind=kind)
+
+    def out_kind(self, in_kinds):
+        return kind_of("OPVector")
+
+    def is_response_out(self) -> bool:
+        return False
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        c = cols[1]
+        name, kind = p["name"], p["kind"]
+        n = len(c)
+        parts, slots = [], []
+        for key in sorted(p["splits_per_key"]):
+            splits = list(p["splits_per_key"][key])
+            vals = np.zeros(n, np.float32)
+            present = np.zeros(n, bool)
+            for i, m in enumerate(c.values):
+                v = (m or {}).get(key)
+                if v is not None:
+                    vals[i] = float(v)
+                    present[i] = True
+            if splits:
+                idx = np.searchsorted(np.asarray(splits, np.float32), vals,
+                                      side="right")
+                onehot = np.zeros((n, len(splits) + 1), np.float32)
+                onehot[np.arange(n), idx] = present.astype(np.float32)
+                parts.append(jnp.asarray(onehot))
+                bounds = ["-Inf"] + [str(s) for s in splits] + ["Inf"]
+                slots.extend(
+                    SlotInfo(name, kind, group=key, indicator_value=f"{a}-{b}")
+                    for a, b in zip(bounds, bounds[1:]))
+            if p["track_nulls"] or not splits:
+                parts.append(jnp.asarray((~present).astype(np.float32)))
+                slots.append(null_slot(name, kind, group=key))
+        if not parts:
+            return Column.vector(jnp.zeros((n, 0), jnp.float32), VectorSchema(()))
+        return stack_vector(parts, slots)

@@ -247,3 +247,111 @@ class PredictionDeIndexer(SequenceVectorizer):
             iv = int(v)
             out[i] = labels[iv] if 0 <= iv < len(labels) else None
         return Column(kind_of("Text"), out, None)
+
+
+@register_stage
+class StringIndexerNoFilter(SequenceVectorizerEstimator):
+    """Text -> label index keeping EVERY value, null included, plus a tracked
+    extra class for values unseen at fit time (reference
+    OpStringIndexerNoFilter.scala: labels are Seq[Option[String]] ordered by
+    frequency; transform maps unseen values to otherPos = len(labels), named
+    `unseen_name`). Unlike StringIndexer's handle_invalid="keep", the unseen
+    bucket here is a first-class label the PredictionDeIndexer flows can name."""
+
+    operation_name = "str2idx"
+    accepts = _CATEGORICAL_TEXT
+    arity = (1, 1)
+
+    UNSEEN_NAME_DEFAULT = "UnseenLabel"
+
+    def __init__(self, unseen_name: str = UNSEEN_NAME_DEFAULT):
+        super().__init__(unseen_name=unseen_name)
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        super().out_kind(in_kinds)
+        return kind_of("RealNN")
+
+    def fit_columns(self, cols: Sequence[Column]):
+        # null is a legitimate label (the reference counts Option values, None
+        # included); order by frequency desc, then null-first, then value —
+        # Scala's Option ordering puts None before Some on ties
+        counts: Counter = Counter()
+        for v in cols[0].values:
+            counts[None if v is None else str(v)] += 1
+        labels = sorted(counts, key=lambda v: (-counts[v], v is not None, v or ""))
+        return StringIndexerNoFilterModel(
+            labels=labels, unseen_name=self.params["unseen_name"])
+
+
+@register_stage
+class StringIndexerNoFilterModel(SequenceVectorizer):
+    operation_name = "str2idx"
+    device_op = False
+    arity = (1, 1)
+
+    def __init__(self, labels: Sequence[Optional[str]] = (),
+                 unseen_name: str = StringIndexerNoFilter.UNSEEN_NAME_DEFAULT):
+        super().__init__(labels=list(labels), unseen_name=unseen_name)
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        return kind_of("RealNN")
+
+    @property
+    def labels(self) -> list:
+        return self.params["labels"]
+
+    @property
+    def label_names(self) -> list[str]:
+        """Display labels: null -> "null", plus the unseen bucket's name at the
+        end (the reference's cleanedLabels metadata)."""
+        return (["null" if v is None else v for v in self.params["labels"]]
+                + [self.params["unseen_name"]])
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import kind_of
+
+        p = self.params
+        index = {v: float(i) for i, v in enumerate(p["labels"])}
+        other = float(len(p["labels"]))
+        out = np.empty(len(cols[0]), dtype=np.float32)
+        for i, v in enumerate(cols[0].values):
+            out[i] = index.get(None if v is None else str(v), other)
+        return Column(kind_of("RealNN"), jnp.asarray(out), None)
+
+
+@register_stage
+class IndexToStringNoFilter(SequenceVectorizer):
+    """Inverse of StringIndexerNoFilter: out-of-range indices become the named
+    unseen string instead of null (reference OpIndexToStringNoFilter.scala)."""
+
+    operation_name = "idx2str"
+    device_op = False
+    arity = (1, 1)
+    accepts = None
+
+    UNSEEN_DEFAULT = "UnseenIndex"
+
+    def __init__(self, labels: Sequence[Optional[str]] = (),
+                 unseen_name: str = UNSEEN_DEFAULT):
+        super().__init__(labels=list(labels), unseen_name=unseen_name)
+
+    def out_kind(self, in_kinds):
+        from ...types import kind_of
+
+        return kind_of("Text")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import kind_of
+
+        p = self.params
+        labels, unseen = p["labels"], p["unseen_name"]
+        vals = np.asarray(cols[0].values)
+        out = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            iv = int(v)
+            out[i] = labels[iv] if 0 <= iv < len(labels) else unseen
+        return Column(kind_of("Text"), out, None)

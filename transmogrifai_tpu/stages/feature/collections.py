@@ -460,3 +460,120 @@ class SmartTextMapVectorizerModel(SequenceVectorizer):
         return Column.vector(
             jnp.asarray(np.concatenate(mats, axis=1)), VectorSchema(tuple(slots))
         )
+
+
+def _map_keys_of(col: Column) -> list[str]:
+    keys: dict[str, None] = {}
+    for m in col.values:
+        for k in (m or {}):
+            keys[str(k)] = None
+    return sorted(keys)
+
+
+@register_stage
+class TextListNullTransformer(SequenceVectorizer):
+    """TextList inputs -> one null-indicator slot per input: 1.0 when the list
+    is empty/missing (reference TextListNullTransformer.scala)."""
+
+    operation_name = "textListNull"
+    device_op = False
+    accepts = ("TextList",)
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        parts, slots = [], []
+        for c, f in zip(cols, self.inputs):
+            empty = np.array([0.0 if v else 1.0 for v in c.values], np.float32)
+            parts.append(jnp.asarray(empty))
+            slots.append(null_slot(f.name, f.kind.name))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class TextMapLenEstimator(SequenceVectorizerEstimator):
+    """Text maps -> per-key total token length (reference TextMapLenEstimator
+    .scala: fit learns each input's key set; transform tokenizes each value and
+    sums token lengths, 0 for missing keys)."""
+
+    operation_name = "textLenMap"
+    accepts = _TEXT_MAPS + _CATEGORICAL_MAPS
+
+    def fit_columns(self, cols: Sequence[Column]):
+        return TextMapLenModel(
+            all_keys=[_map_keys_of(c) for c in cols],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs])
+
+
+@register_stage
+class TextMapLenModel(SequenceVectorizer):
+    operation_name = "textLenMap"
+    device_op = False
+
+    def __init__(self, all_keys: Sequence[Sequence[str]] = (),
+                 names: Sequence[str] = (), kinds: Sequence[str] = ()):
+        super().__init__(all_keys=[list(k) for k in all_keys],
+                         names=list(names), kinds=list(kinds))
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from .text import tokenize
+
+        p = self.params
+        parts, slots = [], []
+        for c, keys, name, kind in zip(cols, p["all_keys"], p["names"], p["kinds"]):
+            for key in keys:
+                lens = np.zeros(len(c), np.float32)
+                for i, m in enumerate(c.values):
+                    v = (m or {}).get(key)
+                    if v is not None:
+                        lens[i] = float(sum(len(t) for t in tokenize(str(v))))
+                parts.append(jnp.asarray(lens))
+                slots.append(value_slot(name, kind, group=key, descriptor="textLen"))
+        if not parts:
+            return Column.vector(jnp.zeros((len(cols[0]), 0), jnp.float32),
+                                 VectorSchema(()))
+        return stack_vector(parts, slots)
+
+
+@register_stage
+class TextMapNullEstimator(SequenceVectorizerEstimator):
+    """Text maps -> per-key null indicator: 1.0 when the key is missing or its
+    value tokenizes to nothing (reference TextMapNullEstimator.scala)."""
+
+    operation_name = "textMapNull"
+    accepts = _TEXT_MAPS + _CATEGORICAL_MAPS
+
+    def fit_columns(self, cols: Sequence[Column]):
+        return TextMapNullModel(
+            all_keys=[_map_keys_of(c) for c in cols],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs])
+
+
+@register_stage
+class TextMapNullModel(SequenceVectorizer):
+    operation_name = "textMapNull"
+    device_op = False
+
+    def __init__(self, all_keys: Sequence[Sequence[str]] = (),
+                 names: Sequence[str] = (), kinds: Sequence[str] = ()):
+        super().__init__(all_keys=[list(k) for k in all_keys],
+                         names=list(names), kinds=list(kinds))
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from .text import tokenize
+
+        p = self.params
+        parts, slots = [], []
+        for c, keys, name, kind in zip(cols, p["all_keys"], p["names"], p["kinds"]):
+            for key in keys:
+                nulls = np.ones(len(c), np.float32)
+                for i, m in enumerate(c.values):
+                    v = (m or {}).get(key)
+                    if v is not None and tokenize(str(v)):
+                        nulls[i] = 0.0
+                parts.append(jnp.asarray(nulls))
+                slots.append(null_slot(name, kind, group=key))
+        if not parts:
+            return Column.vector(jnp.zeros((len(cols[0]), 0), jnp.float32),
+                                 VectorSchema(()))
+        return stack_vector(parts, slots)

@@ -140,3 +140,78 @@ class DateListVectorizerModel(SequenceVectorizer):
                 parts.append(jnp.asarray(empty))
                 slots.append(null_slot(f.name, f.kind.name))
         return stack_vector(parts, slots)
+
+
+@register_stage
+class DateMapToUnitCircleVectorizer(SequenceVectorizerEstimator):
+    """DateMap/DateTimeMap -> [sin, cos] per (key, period): the circular encoding
+    plain dates get, applied per map key (reference DateMapToUnitCircleVectorizer
+    .scala — fit learns each input's key set, transform pivots). Missing keys emit
+    (0, 0), distinguishable from any real angle since sin^2+cos^2=1 there."""
+
+    operation_name = "dateMapCircle"
+    accepts = ("DateMap", "DateTimeMap")
+
+    def __init__(self, time_periods: Sequence[str] = TIME_PERIODS,
+                 track_nulls: bool = False):
+        for pd in time_periods:
+            if pd not in TIME_PERIODS:
+                raise ValueError(f"unknown time period {pd!r}")
+        super().__init__(time_periods=list(time_periods), track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column]):
+        all_keys = []
+        for c in cols:
+            keys: dict[str, None] = {}
+            for m in c.values:
+                for k in (m or {}):
+                    keys[str(k)] = None
+            all_keys.append(sorted(keys))
+        return DateMapToUnitCircleVectorizerModel(
+            all_keys=all_keys, time_periods=self.params["time_periods"],
+            track_nulls=self.params["track_nulls"],
+            names=[f.name for f in self.inputs],
+            kinds=[f.kind.name for f in self.inputs])
+
+
+@register_stage
+class DateMapToUnitCircleVectorizerModel(SequenceVectorizer):
+    operation_name = "dateMapCircle"
+    device_op = False  # host int64 calendar math, like DateToUnitCircleVectorizer
+
+    def __init__(self, all_keys: Sequence[Sequence[str]] = (),
+                 time_periods: Sequence[str] = TIME_PERIODS,
+                 track_nulls: bool = False, names: Sequence[str] = (),
+                 kinds: Sequence[str] = ()):
+        super().__init__(all_keys=[list(k) for k in all_keys],
+                         time_periods=list(time_periods), track_nulls=track_nulls,
+                         names=list(names), kinds=list(kinds))
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        p = self.params
+        parts, slots = [], []
+        for c, keys, name, kind in zip(cols, p["all_keys"], p["names"], p["kinds"]):
+            n = len(c)
+            for key in keys:
+                ms = np.zeros(n, np.int64)
+                present = np.zeros(n, bool)
+                for i, m in enumerate(c.values):
+                    v = (m or {}).get(key)
+                    if v is not None:
+                        ms[i] = int(v)
+                        present[i] = True
+                for period in p["time_periods"]:
+                    rad = 2.0 * math.pi * _period_fraction(ms, period)
+                    parts.append(np.where(present, np.sin(rad), 0.0).astype(np.float32))
+                    parts.append(np.where(present, np.cos(rad), 0.0).astype(np.float32))
+                    slots.append(value_slot(name, kind, group=key,
+                                            descriptor=f"{period}_x"))
+                    slots.append(value_slot(name, kind, group=key,
+                                            descriptor=f"{period}_y"))
+                if p["track_nulls"]:
+                    parts.append((~present).astype(np.float32))
+                    slots.append(null_slot(name, kind, group=key))
+        if not parts:  # no keys observed at fit: empty (but well-formed) vector
+            return Column.vector(jnp.zeros((len(cols[0]), 0), jnp.float32),
+                                 VectorSchema(()))
+        return stack_vector(parts, slots)

@@ -152,6 +152,38 @@ class DescalerTransformer(Transformer):
             out = (v - p["intercept"]) / p["slope"]
         return Column(c.kind, out, c.mask)
 
+    def trace_fingerprint(self):
+        # transform_columns bakes the UPSTREAM scaler's slope/intercept into the
+        # traced program as python constants — a cross-stage read the default
+        # own-params fingerprint cannot see. Two graphs identical in class names
+        # + own params but with a different scaler slope must not share a cached
+        # program (ADVICE r03 medium).
+        from ..base import _fingerprint_jsonify
+
+        return {"p": _fingerprint_jsonify(self.params),
+                "scaler": _fingerprint_jsonify(self._scaler_params())}
+
+
+def _period_of_ms(ms: int, period: str) -> int:
+    """Calendar period of one epoch-millis instant (UTC), reference
+    TimePeriod.extractIntFromMillis semantics."""
+    import datetime as _dt
+
+    t = _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
+    if period == "DayOfMonth":
+        return t.day
+    if period == "DayOfWeek":
+        return t.isoweekday()
+    if period == "DayOfYear":
+        return t.timetuple().tm_yday
+    if period == "HourOfDay":
+        return t.hour
+    if period == "MonthOfYear":
+        return t.month
+    if period == "WeekOfMonth":
+        return (t.day + _dt.date(t.year, t.month, 1).weekday()) // 7 + 1
+    return t.isocalendar()[1]  # WeekOfYear
+
 
 @register_stage
 class TimePeriodTransformer(Transformer):
@@ -175,30 +207,13 @@ class TimePeriodTransformer(Transformer):
         return kind_of("Integral")
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
-        import datetime as _dt
-
         c = cols[0]
         period = self.params["period"]
         mask = np.asarray(c.effective_mask())
         out = np.zeros(len(c), dtype=np.int64)
         for i, (ms, ok) in enumerate(zip(np.asarray(c.values), mask)):
-            if not ok:
-                continue
-            t = _dt.datetime.fromtimestamp(int(ms) / 1000.0, tz=_dt.timezone.utc)
-            if period == "DayOfMonth":
-                out[i] = t.day
-            elif period == "DayOfWeek":
-                out[i] = t.isoweekday()
-            elif period == "DayOfYear":
-                out[i] = t.timetuple().tm_yday
-            elif period == "HourOfDay":
-                out[i] = t.hour
-            elif period == "MonthOfYear":
-                out[i] = t.month
-            elif period == "WeekOfMonth":
-                out[i] = (t.day + _dt.date(t.year, t.month, 1).weekday()) // 7 + 1
-            else:  # WeekOfYear
-                out[i] = t.isocalendar()[1]
+            if ok:
+                out[i] = _period_of_ms(int(ms), period)
         return Column(kind_of("Integral"), out, mask)
 
 
@@ -241,3 +256,81 @@ class FilterMap(Transformer):
                 kept[k] = v
             out[i] = kept
         return Column(cols[0].kind, out, None)
+
+
+@register_stage
+class TimePeriodMapTransformer(Transformer):
+    """DateMap/DateTimeMap -> IntegralMap of each value's calendar period
+    (reference TimePeriodMapTransformer.scala). Reuses TimePeriodTransformer's
+    exact per-period extraction."""
+
+    operation_name = "dateMapToTimePeriod"
+    arity = (1, 1)
+
+    def __init__(self, period: str = "DayOfWeek"):
+        if period not in TimePeriodTransformer.PERIODS:
+            raise ValueError(
+                f"period must be one of {TimePeriodTransformer.PERIODS}, got {period!r}")
+        super().__init__(period=period)
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        if in_kinds[0].name not in ("DateMap", "DateTimeMap"):
+            raise TypeError(
+                f"TimePeriodMapTransformer takes date maps, got {in_kinds[0].name}")
+        return kind_of("IntegralMap")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        period = self.params["period"]
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, m in enumerate(cols[0].values):
+            out[i] = ({k: _period_of_ms(int(v), period) for k, v in m.items()
+                       if v is not None}
+                      if m else None)
+        return Column(kind_of("IntegralMap"), out, None)
+
+
+@register_stage
+class TimePeriodListTransformer(Transformer):
+    """DateList/DateTimeList -> OPVector of each date's calendar period
+    (reference TimePeriodListTransformer.scala). The reference emits a RAGGED
+    vector (row width = list length) — impossible under XLA's static shapes, so
+    rows are left-aligned into `max_elements` slots, zero-padded, with a count
+    slot carrying the true length. max_elements=None infers the batch maximum
+    (the reference's per-batch raggedness); set it explicitly for a stable
+    serving schema."""
+
+    operation_name = "dateListToTimePeriod"
+    arity = (1, 1)
+
+    def __init__(self, period: str = "DayOfWeek", max_elements: Optional[int] = None):
+        if period not in TimePeriodTransformer.PERIODS:
+            raise ValueError(
+                f"period must be one of {TimePeriodTransformer.PERIODS}, got {period!r}")
+        super().__init__(period=period, max_elements=max_elements)
+
+    def out_kind(self, in_kinds: Sequence[FeatureKind]) -> FeatureKind:
+        if in_kinds[0].name not in ("DateList", "DateTimeList"):
+            raise TypeError(
+                f"TimePeriodListTransformer takes date lists, got {in_kinds[0].name}")
+        return kind_of("OPVector")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...types import SlotInfo, VectorSchema
+
+        p = self.params
+        c = cols[0]
+        name, kind = self.inputs[0].name, self.inputs[0].kind.name
+        width = p["max_elements"]
+        if width is None:
+            width = max((len(v) for v in c.values if v), default=0)
+        mat = np.zeros((len(c), width + 1), dtype=np.float32)
+        for i, v in enumerate(c.values):
+            if not v:
+                continue
+            for j, ms in enumerate(v[:width]):
+                mat[i, j] = _period_of_ms(int(ms), p["period"])
+            mat[i, width] = float(len(v))
+        slots = [SlotInfo(name, kind, descriptor=f"{p['period']}_{j}")
+                 for j in range(width)]
+        slots.append(SlotInfo(name, kind, descriptor="count"))
+        return Column.vector(jnp.asarray(mat), VectorSchema(tuple(slots)))

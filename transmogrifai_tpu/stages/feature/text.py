@@ -266,3 +266,37 @@ class SmartTextVectorizerModel(SequenceVectorizer):
         return Column.vector(
             jnp.asarray(np.concatenate(mats, axis=1)), VectorSchema(tuple(slots))
         )
+
+
+@register_stage
+class SubstringTransformer(Transformer):
+    """(sub: Text, full: Text) -> Binary: does `full` contain `sub`?
+    (reference SubstringTransformer.scala; `to_lowercase` mirrors
+    TextMatchingParams' default-on case folding). Either side empty -> null."""
+
+    operation_name = "substring"
+    device_op = False
+    arity = (2, 2)
+
+    def __init__(self, to_lowercase: bool = True):
+        super().__init__(to_lowercase=to_lowercase)
+
+    def out_kind(self, in_kinds):
+        for k in in_kinds:
+            if k.storage.value != "text":
+                raise TypeError(f"SubstringTransformer takes text kinds, got {k.name}")
+        return kind_of("Binary")
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        lower = self.params["to_lowercase"]
+        out = np.zeros(len(cols[0]), dtype=np.float32)
+        mask = np.zeros(len(cols[0]), dtype=bool)
+        for i, (sub, full) in enumerate(zip(cols[0].values, cols[1].values)):
+            if sub is None or full is None:
+                continue
+            mask[i] = True
+            s, f = (str(sub), str(full))
+            if lower:
+                s, f = s.lower(), f.lower()
+            out[i] = float(s in f)
+        return Column(kind_of("Binary"), out, mask)

@@ -71,8 +71,10 @@ for _k in ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap",
            "PickListMap", "ComboBoxMap", "IDMap", "EmailMap", "URLMap",
            "PhoneMap", "Base64Map", "CountryMap", "StateMap", "CityMap",
            "PostalCodeMap", "StreetMap", "BinaryMap", "MultiPickListMap",
-           "DateMap", "DateTimeMap", "GeolocationMap"):
+           "GeolocationMap"):
     _FAMILIES[_k] = "map"
+for _k in ("DateMap", "DateTimeMap"):
+    _FAMILIES[_k] = "date_map"
 
 
 def transmogrify(
@@ -133,6 +135,18 @@ def transmogrify(
                 max_cardinality=d.max_categorical_cardinality, top_k=d.top_k,
                 min_support=d.min_support, num_features=d.num_hash_features,
                 clean_text=d.clean_text, track_nulls=d.track_nulls, seed=d.hash_seed)
+        elif fam == "date_map":
+            # the reference's RichDateMapFeature.vectorize: circular encoding
+            # per period PLUS days-since values, combined
+            # (RichMapFeature.scala:757-782)
+            from .date import DateMapToUnitCircleVectorizer
+
+            vectors.append(DateMapToUnitCircleVectorizer(
+                time_periods=list(d.time_periods))(*feats))
+            vectors.append(MapVectorizer(
+                top_k=d.top_k, min_support=d.min_support,
+                clean_text=d.clean_text, track_nulls=d.track_nulls)(*feats))
+            continue
         elif fam == "map":
             stage = MapVectorizer(
                 top_k=d.top_k, min_support=d.min_support,

@@ -1,0 +1,112 @@
+"""Relay-proof jax backend bring-up.
+
+The TPU path on this box runs through a loopback relay (a PJRT plugin registered
+by a sitecustomize hook whenever ``PALLAS_AXON_POOL_IPS`` is set). The relay has
+two death modes with different symptoms:
+
+- **fast-refuse**: the port is closed; plugin registration fails fast and any
+  backend touch (``jax.devices()`` / ``jax.default_backend()``) *raises*.
+- **hang**: the port accepts but the protocol stalls; the first backend touch
+  *blocks forever* (no exception to catch).
+
+Driver-graded entry points (``bench.py``, ``__graft_entry__``) must survive
+both: probe the relay with a socket timeout BEFORE the first backend touch,
+force the CPU backend when it is dead, and do the first touch on a worker
+thread so a protocol-level hang is detected instead of inherited.
+
+This is the environment discipline the reference enforces via its
+TestSparkContext harness (reference: utils/src/main/scala/com/salesforce/op/
+test/TestSparkContext.scala:31-77) — tests and tools bring up their own known
+-good execution context rather than assuming the ambient one works.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+
+#: the loopback relay's fixed port on this image (see docs/faq.md)
+RELAY_PORT = int(os.environ.get("TT_RELAY_PORT", "8103"))
+
+
+def relay_probe(timeout_s: float = 3.0) -> bool | None:
+    """Is the TPU relay reachable? None = no relay configured (nothing to
+    probe), True = TCP connect succeeded, False = dead/unreachable."""
+    ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if not ips.strip():
+        return None
+    for ip in ips.replace(",", " ").split():
+        try:
+            with socket.create_connection((ip, RELAY_PORT), timeout=timeout_s):
+                pass
+        except OSError:
+            return False
+    return True
+
+
+def force_cpu(n_devices: int | None = None):
+    """Force the CPU backend as hard as in-process state allows.
+
+    Must run before the first backend init to take effect; the relay plugin may
+    have forced ``jax_platforms`` via jax.config at interpreter startup, so the
+    env var alone is NOT enough (same discipline as tests/conftest.py).
+    Returns the jax module."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # shield subprocesses too
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices and ("xla_force_host_platform_device_count"
+                      not in os.environ.get("XLA_FLAGS", "")):
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            pass  # backend already initialized; caller may clear_backends
+    return jax
+
+
+def init_backend(timeout_s: float = 120.0):
+    """First backend touch, hang-proofed: run ``jax.devices()`` on a daemon
+    thread and wait at most timeout_s.
+
+    Returns (platform, n_devices, error). error is None on success; on failure
+    platform/n_devices are None and error describes it. A return of
+    ``error="backend init timed out..."`` means a thread is STUCK inside
+    backend init holding jax's backend lock — in-process recovery is
+    impossible; the caller must re-exec with a cleaned env (see reexec_cpu)."""
+    box: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            devs = jax.devices()
+            box["platform"] = devs[0].platform
+            box["n"] = len(devs)
+        except Exception as e:  # fast-refuse mode
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=probe, daemon=True, name="jax-backend-probe")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, None, f"backend init timed out after {timeout_s:.0f}s (relay hang)"
+    if "error" in box:
+        return None, None, box["error"]
+    return box["platform"], box["n"], None
+
+
+def reexec_cpu(argv: list[str] | None = None) -> None:
+    """Replace this process with a fresh interpreter on a clean CPU-only env —
+    the only recovery from a thread stuck in backend init. Guarded by
+    TT_BACKEND_REEXEC so a broken CPU path cannot loop."""
+    if os.environ.get("TT_BACKEND_REEXEC"):
+        raise RuntimeError("backend init failed even after CPU re-exec")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TT_BACKEND_REEXEC"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable] + (argv or sys.argv), env)

@@ -14,7 +14,7 @@ import os
 _ENABLED = False
 
 
-def _host_namespace() -> str:
+def _host_namespace() -> str | None:
     """Cache subdirectory per (backend platform, host CPU fingerprint).
 
     XLA's cache key does NOT include host CPU features: a CPU AOT blob
@@ -24,10 +24,17 @@ def _host_namespace() -> str:
     may land on different hosts, so namespace CPU entries by cpuinfo flags.
     (Note: the loader also warns when XLA's compile-time feature set merely
     disagrees with its runtime detection on the SAME machine — the warning
-    alone does not prove cross-machine contamination.)"""
-    import jax
+    alone does not prove cross-machine contamination.)
 
-    platform = jax.default_backend()
+    Returns None when no backend can be brought up (e.g. a TPU relay plugin is
+    registered but its relay is dead — ``jax.default_backend()`` raises); the
+    caller must then disable the cache rather than crash."""
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception:
+        return None
     if platform != "cpu":
         # accelerator AOT is device-targeted, not host-CPU-targeted: keep the
         # base dir itself so warm entries survive across hosts and upgrades
@@ -54,15 +61,19 @@ def enable_compile_cache(cache_dir: str | None = None) -> bool:
         return True
     if os.environ.get("TT_COMPILE_CACHE") == "0":
         return False
-    import jax
-
     cache_dir = (cache_dir or os.environ.get("TT_COMPILE_CACHE_DIR")
                  or os.path.join(os.path.dirname(os.path.dirname(
                      os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
     ns = _host_namespace()
+    if ns is None:
+        # no live backend (dead TPU relay, broken plugin): a cache is useless
+        # and probing further would crash the caller — degrade to disabled
+        return False
     if ns:
         cache_dir = os.path.join(cache_dir, ns)
     try:
+        import jax
+
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache EVERY program, even sub-second ones: over a tunneled/remote compile
         # path each tiny eager op costs a ~0.5s round trip, and a cold train

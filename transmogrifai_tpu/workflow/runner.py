@@ -284,10 +284,13 @@ class WorkflowRunner:
                 # raw features have no declared kind and are dropped (documented
                 # on stream_batch_size).
                 # a response column is kept only when EVERY row in the (possibly
-                # mixed, post-rebatch) batch carries it — response kinds are
-                # often non-nullable (RealNN), so a partial column can't build
-                present = (set.intersection(*(set(r.keys()) for r in batch))
-                           if batch else set())
+                # mixed, post-rebatch) batch carries a NON-None value for it —
+                # response kinds are often non-nullable (RealNN), so a key
+                # present with value None (e.g. sparse event outcomes) can't
+                # build a column any more than a missing key can
+                present = (set.intersection(
+                    *({k for k, v in r.items() if v is not None} for r in batch))
+                    if batch else set())
                 kinds = {f.name: f.kind for f in model.raw_features
                          if not f.is_response or f.name in present}
                 table = Table.from_rows(batch, kinds)

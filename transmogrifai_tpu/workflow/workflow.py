@@ -91,8 +91,12 @@ def _fuse_device_run(stages: Sequence[Transformer],
         for s in stages)
     key = None
     try:
+        # trace_fingerprint (NOT _jsonify(s.params)): it covers cross-stage
+        # reads baked in at trace time (e.g. Descaler's upstream scaler args)
+        # and raises TypeError for identity-less callables (lambdas), both of
+        # which must disable sharing instead of silently colliding (ADVICE r03)
         fps = tuple(
-            json.dumps({"c": type(s).__name__, "p": _jsonify(s.params)},
+            json.dumps({"c": type(s).__name__, "p": s.trace_fingerprint()},
                        sort_keys=True)
             for s in stages)
         if sum(map(len, fps)) <= _FUSED_FINGERPRINT_MAX:
