@@ -171,10 +171,14 @@ def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
 
 
 def run_mlp(n_rows: int = 1 << 20, d: int = 1024, chunk: int = 1 << 16,
-            epochs: int = 2, hidden=(1024, 512, 256)) -> dict:
+            epochs: int = 8, hidden=(1024, 512, 256)) -> dict:
     """Config 5 regime: deep-tabular MLP (1024 -> 1024 -> 512 -> 256 -> 2, the
-    Criteo-MLP width class) trained with minibatch Adam over streamed chunks
-    (bf16 matmuls, donated state, one compiled step); reports rows/sec and MFU."""
+    Criteo-MLP width class) trained with minibatch Adam (bf16 matmuls AND bf16
+    activation residency, f32 accumulation/master state); reports rows/sec and
+    MFU. epochs=8 (256 steps) so the one-time ~0.1 s tunnel dispatch round-trip
+    is <20% of wall — the Criteo-1TB regime this stands in for streams billions
+    of rows, so steady-state throughput is the number that transfers; the
+    single-dispatch overhead is reported separately via the streamed path."""
     import jax
     import jax.numpy as jnp
 
@@ -219,17 +223,22 @@ def run_mlp(n_rows: int = 1 << 20, d: int = 1024, chunk: int = 1 << 16,
     # warm at the SAME static args (epochs is static — a different value is a
     # different program and would put the compile inside the timed window)
     fit_mlp_scan(X_all, y_all, batch_size=batch, hidden=hidden, epochs=epochs)
-    t0 = time.perf_counter()
-    params = fit_mlp_scan(X_all, y_all, batch_size=batch, hidden=hidden,
-                          epochs=epochs)
-    jax.device_get(params[-1][1])  # force: block_until_ready may not block over tunnel
-    scan_wall = time.perf_counter() - t0
+    scan_wall = float("inf")
+    for _ in range(3):  # min-of-3: tunnel dispatch latency jitters by tens of ms
+        t0 = time.perf_counter()
+        params = fit_mlp_scan(X_all, y_all, batch_size=batch, hidden=hidden,
+                              epochs=epochs)
+        jax.device_get(params[-1][1])  # force: block_until_ready may not block over tunnel
+        scan_wall = min(scan_wall, time.perf_counter() - t0)
 
-    # --- streamed path: one jitted Adam step per host-fed chunk (donated state) ----
+    # --- streamed path: one jitted Adam step per host-fed chunk (donated state);
+    # fixed 2 epochs — it measures per-chunk dispatch overhead, not device FLOPs,
+    # and scales linearly in chunk count ------------------------------------------
+    stream_epochs = 2
     fit_mlp_minibatch(chunk_fn, 1, d, hidden=hidden, epochs=1)  # warm compile
     t1 = time.perf_counter()
     params_stream = fit_mlp_minibatch(chunk_fn, n_chunks, d, hidden=hidden,
-                                      epochs=epochs)
+                                      epochs=stream_epochs)
     jax.device_get(params_stream[-1][1])
     stream_wall = time.perf_counter() - t1
 
@@ -243,8 +252,9 @@ def run_mlp(n_rows: int = 1 << 20, d: int = 1024, chunk: int = 1 << 16,
         "rows_per_sec": round(n_rows * epochs / scan_wall),
         "tflops_per_sec": round(total_flops / scan_wall / 1e12, 2),
         "mfu": round(mfu_scan, 4) if mfu_scan is not None else None,
+        "streamed_epochs": stream_epochs,
         "streamed_wall_s": round(stream_wall, 3),
-        "streamed_rows_per_sec": round(n_rows * epochs / stream_wall),
+        "streamed_rows_per_sec": round(n_rows * stream_epochs / stream_wall),
         "holdout_accuracy": round(acc, 4),
     }
 

@@ -1,0 +1,214 @@
+"""Unfitted feature-graph JSON round trip (reference FeatureJsonHelper,
+features/src/main/scala/com/salesforce/op/features/FeatureJsonHelper.scala:48-110):
+save the pipeline DEFINITION before training, reload it, and train — including a
+codegen'd project's graph and a ModelSelector with a fully customized search."""
+import csv
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import (
+    features_from_schema,
+    graph_from_json,
+    graph_to_json,
+    load_graph,
+    save_graph,
+)
+
+
+def _rows(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"id": str(i), "label": float(rng.random() > 0.5),
+         "x1": float(rng.normal()), "x2": float(rng.normal()),
+         "color": ["red", "green", "blue"][int(rng.integers(0, 3))]}
+        for i in range(n)
+    ]
+
+
+SCHEMA = {"id": "ID", "label": "RealNN", "x1": "Real", "x2": "Real",
+          "color": "PickList"}
+
+
+def _build_graph(models=None):
+    from transmogrifai_tpu.select import BinaryClassificationModelSelector
+    from transmogrifai_tpu.stages.feature import transmogrify
+
+    fs = features_from_schema(SCHEMA, response="label")
+    vector = transmogrify([fs["x1"], fs["x2"], fs["color"]])
+    checked = vector.sanity_check(fs["label"], remove_bad_features=True)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, validation_metric="AuPR", models=models)
+    return selector(fs["label"], checked), fs
+
+
+def _tiny_models():
+    from transmogrifai_tpu.select import ParamGridBuilder
+    from transmogrifai_tpu.stages.model import LogisticRegression
+
+    grid = ParamGridBuilder().add("l2", [0.01, 0.1]).build()
+    return [(LogisticRegression(max_iter=10), grid)]
+
+
+def test_unfitted_graph_roundtrip_trains_identically(tmp_path):
+    """Save the definition pre-train, reload, train BOTH graphs on the same table:
+    structure, stage params, and resulting scores must match."""
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.workflow import Workflow
+
+    pred, fs = _build_graph(models=_tiny_models())
+    path = str(tmp_path / "graph.json")
+    save_graph(path, [pred])
+
+    loaded = load_graph(path)
+    assert len(loaded) == 1 and loaded[0].name == pred.name
+
+    reader = InMemoryReader(_rows())
+    table = reader.generate_table(list(fs.values()))
+    m1 = Workflow().set_result_features(pred).train(table=table)
+    # the loaded graph carries its own raw features; regenerate its table from them
+    raws = {f.name: f for f in loaded[0].raw_features()}
+    table2 = InMemoryReader(_rows()).generate_table(list(raws.values()))
+    m2 = Workflow().set_result_features(loaded[0]).train(table=table2)
+
+    s1 = np.asarray(m1.score(table=table)[pred.name].prob)
+    s2 = np.asarray(m2.score(table=table2)[loaded[0].name].prob)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5, atol=1e-6)
+
+
+def test_graph_json_is_unfitted_and_ordered(tmp_path):
+    """The payload records raw features, result names, and topologically ordered
+    stages; reload rejects a reordered (corrupt) stage list loudly."""
+    pred, _ = _build_graph(models=_tiny_models())
+    spec = graph_to_json([pred])
+    assert spec["fitted"] is False
+    assert spec["result_features"] == [pred.name]
+    raw_names = {r["name"] for r in spec["raw_features"]}
+    assert {"label", "x1", "x2", "color"} <= raw_names
+    produced = set(raw_names)
+    for sj in spec["stages"]:  # every stage's inputs precede it
+        assert set(sj["inputs"]) <= produced, sj["class"]
+        produced.add(sj["output"])
+
+    corrupt = dict(spec, stages=list(reversed(spec["stages"])))
+    with pytest.raises(ValueError, match="not produced"):
+        graph_from_json(corrupt)
+
+
+def test_selector_search_config_survives_roundtrip():
+    """Customized metric/models/validator/splitter must survive — the selector's
+    search lives outside ctor params (selector.py to_json/from_json)."""
+    from transmogrifai_tpu.select import ParamGridBuilder
+    from transmogrifai_tpu.select.selector import ModelSelector
+    from transmogrifai_tpu.select.splitters import DataBalancer
+    from transmogrifai_tpu.select.validator import TrainValidationSplit
+    from transmogrifai_tpu.stages.model import GBTClassifier, LogisticRegression
+
+    sel = ModelSelector(
+        problem_type="binary", metric="AuROC",
+        models=[
+            (LogisticRegression(max_iter=7),
+             ParamGridBuilder().add("l2", [0.5]).build()),
+            (GBTClassifier(n_trees=3, max_depth=2),
+             ParamGridBuilder().add("learning_rate", [0.2, 0.3]).build()),
+        ],
+        validator=TrainValidationSplit(train_ratio=0.8, seed=9),
+        splitter=DataBalancer(sample_fraction=0.2, seed=9),
+        seed=9,
+    )
+    clone = ModelSelector.from_json(sel.to_json())
+    assert clone.uid == sel.uid
+    assert clone.config_fingerprint() == sel.config_fingerprint()
+    assert clone.metric == "AuROC"
+    assert isinstance(clone.validator, TrainValidationSplit)
+    assert clone.validator.train_ratio == 0.8
+    assert isinstance(clone.splitter, DataBalancer)
+    assert clone.splitter.sample_fraction == 0.2
+    assert [type(t).__name__ for t, _ in clone.models] == [
+        "LogisticRegression", "GBTClassifier"]
+    assert clone.models[1][1] == [{"learning_rate": 0.2}, {"learning_rate": 0.3}]
+
+
+def test_lambda_stage_refused_loudly():
+    """Graphs over live callables have no JSON identity: refuse at SAVE time with a
+    pointed error, not at load time far from the cause."""
+    fs = features_from_schema({"label": "RealNN", "x1": "Real"}, response="label")
+    doubled = fs["x1"].map_via(lambda c: c, "Real")
+    with pytest.raises(TypeError, match="registry|callables|JSON"):
+        graph_to_json([doubled])
+
+
+def test_custom_extract_and_aggregator_refused_loudly():
+    """Raw features with live callables (custom extract / aggregator objects) must
+    refuse at save time — replaying a bare FeatureBuilder would silently train a
+    different model on record.get() fallbacks."""
+    from transmogrifai_tpu.graph import FeatureBuilder
+
+    from transmogrifai_tpu.stages.feature import transmogrify
+
+    x = FeatureBuilder("age", "Real").extract(lambda r: r["years_old"]).as_predictor()
+    with pytest.raises(TypeError, match="extract"):
+        graph_to_json([transmogrify([x])])
+
+    from transmogrifai_tpu.aggregators import CustomMonoidAggregator
+
+    agg = FeatureBuilder("fare", "Real").aggregate(
+        CustomMonoidAggregator(0.0, max, name="maxFare")).as_predictor()
+    with pytest.raises(TypeError, match="aggregator"):
+        graph_to_json([transmogrify([agg])])
+
+
+def test_window_ms_survives_roundtrip():
+    from transmogrifai_tpu.graph import FeatureBuilder
+
+    from transmogrifai_tpu.stages.feature import transmogrify
+
+    x = FeatureBuilder("x", "Real").window(86_400_000).as_predictor()
+    spec = graph_to_json([transmogrify([x])])
+    (loaded,) = graph_from_json(spec)
+    raws = {r.name: r for r in loaded.raw_features()}
+    assert raws["x"].origin_stage.params["window_ms"] == 86_400_000
+
+
+def test_codegen_project_graph_roundtrips(tmp_path):
+    """A codegen'd project's graph (transmogrify -> selector over an inferred
+    schema) survives the unfitted round trip and still trains."""
+    data = tmp_path / "data.csv"
+    rng = np.random.default_rng(5)
+    with open(data, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=["pid", "survived", "age", "fare"])
+        w.writeheader()
+        for i in range(80):
+            w.writerow({"pid": i, "survived": int(rng.random() > 0.6),
+                        "age": round(float(rng.uniform(1, 80)), 1),
+                        "fare": round(float(rng.uniform(5, 100)), 2)})
+    from transmogrifai_tpu.cli.codegen import generate_project
+
+    proj = generate_project("jsonproj", str(data), "pid", "survived",
+                            out_dir=str(tmp_path))
+    spec_path = os.path.join(proj, "main.py")
+    mod_spec = importlib.util.spec_from_file_location("jsonproj_main", spec_path)
+    mod = importlib.util.module_from_spec(mod_spec)
+    mod_spec.loader.exec_module(mod)
+    runner = mod.make_runner(str(data), smoke=True)
+    result_features = runner.workflow.result_features
+
+    spec = graph_to_json(result_features)
+    loaded = graph_from_json(spec)
+    assert [f.name for f in loaded] == [f.name for f in result_features]
+    assert [s["class"] for s in graph_to_json(loaded)["stages"]] == [
+        s["class"] for s in spec["stages"]]
+
+    # the reloaded definition trains end-to-end
+    from transmogrifai_tpu.readers import CSVReader
+    from transmogrifai_tpu.workflow import Workflow
+
+    raws = {}
+    for f in loaded:
+        for r in f.raw_features():
+            raws[r.name] = r
+    table = CSVReader(str(data), mod.SCHEMA).generate_table(list(raws.values()))
+    model = Workflow().set_result_features(*loaded).train(table=table)
+    assert model.score(table=table) is not None

@@ -1,8 +1,13 @@
 from .builder import FeatureBuilder, features_from_schema, features_from_table
 from .dag import compute_dag, dag_stages, split_layer_by_kind, validate_dag
 from .feature import Feature, FeatureCycleError, validate_distinct_names
+from .json_helper import graph_from_json, graph_to_json, load_graph, save_graph
 
 __all__ = [
+    "graph_from_json",
+    "graph_to_json",
+    "load_graph",
+    "save_graph",
     "Feature",
     "FeatureCycleError",
     "FeatureBuilder",

@@ -1,0 +1,184 @@
+"""Unfitted feature-graph <-> JSON round trip (analog of FeatureJsonHelper,
+reference features/src/main/scala/com/salesforce/op/features/FeatureJsonHelper.scala:48-110).
+
+The fitted path (`WorkflowModel.save/load`) persists trained transformers; this module
+persists the *pipeline definition* — raw features plus the topologically ordered, still
+UNFITTED stage graph — so a graph can be authored once (by hand or by `op codegen`),
+saved as JSON, and trained later or elsewhere:
+
+    spec = graph_to_json([pred])          # before any train()
+    ...
+    pred2 = graph_from_json(spec)[-1]
+    Workflow().set_result_features(pred2).train(table=...)
+
+Stage identity rides the same registry serialization model save/load uses
+(`Stage.to_json`/`from_json`), so every `@register_stage` class round-trips here for
+free; stages carrying live callables (LambdaTransformer over a local closure) have no
+faithful JSON identity and are refused loudly at save time, exactly like the
+serializability sanitizer does for model save (`utils/sanitize.check_serializable`).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+from ..stages.base import STAGE_REGISTRY
+from .builder import FeatureBuilder
+from .dag import compute_dag, dag_stages, validate_dag
+from .feature import Feature
+
+GRAPH_JSON_VERSION = 1
+
+
+def stage_payload(s) -> dict:
+    """One stage's manifest entry: registry JSON + its output wiring. Shared by
+    the fitted model manifest (WorkflowModel.save) and the unfitted graph here."""
+    return {**s.to_json(), "output": s.get_output().name,
+            "output_kind": s.get_output().kind.name}
+
+
+def replay_manifest(manifest: dict):
+    """Rebuild (features_by_name, raw_features, stages) from a manifest's
+    raw_features + stages sections — THE wiring replay loop, shared by
+    WorkflowModel.load (fitted) and graph_from_json (unfitted) so corrupt-input
+    handling and name semantics cannot diverge."""
+    from ..stages.base import Stage
+
+    features: dict[str, Feature] = {}
+    raw = []
+    for rf in manifest["raw_features"]:
+        fb = FeatureBuilder(rf["name"], rf["kind"])
+        if rf.get("window_ms") is not None:
+            fb = fb.window(rf["window_ms"])
+        f = fb.as_response() if rf["is_response"] else fb.as_predictor()
+        features[f.name] = f
+        raw.append(f)
+    stages = []
+    for sj in manifest["stages"]:
+        stage = Stage.from_json(sj)
+        if "origin" in sj:
+            stage.origin_class = sj["origin"]["class"]
+            stage.origin_params = sj["origin"]["params"]
+        missing = [n for n in sj["inputs"] if n not in features]
+        if missing:
+            raise ValueError(
+                f"stage {sj['uid']} inputs {missing} are not produced by any "
+                "earlier stage or raw feature — corrupt or reordered graph json"
+            )
+        out = stage.set_input(*[features[n] for n in sj["inputs"]])
+        out.name = sj["output"]
+        features[out.name] = out
+        stages.append(stage)
+    return features, raw, stages
+
+
+def _check_json_faithful(stage, payload: dict) -> None:
+    """Refuse stages whose JSON form cannot reconstruct them (callables and other
+    objects `_jsonify` collapses to a bare name). Rebuilds through the same
+    `Stage.from_json` dispatch load uses, then compares the clone's re-serialized
+    form — covering subclass sections (ModelSelector's `search`) too."""
+    from ..stages.base import Stage
+
+    if payload["class"] not in STAGE_REGISTRY:
+        raise TypeError(f"{stage} is not @register_stage'd; unfitted graphs can "
+                        "only carry registry stages")
+    try:
+        clone = Stage.from_json(payload)
+    except Exception as e:  # noqa: BLE001
+        raise TypeError(
+            f"{stage} cannot be serialized unfitted: it does not reconstruct from "
+            f"its own to_json ({type(e).__name__}: {e}). Stages built over live "
+            "callables (local lambdas/closures) have no JSON identity — use a "
+            "registered stage class instead."
+        ) from e
+    wiring = ("inputs", "output", "output_kind")
+    reserialized = {k: v for k, v in clone.to_json().items() if k not in wiring}
+    original = {k: v for k, v in payload.items() if k not in wiring}
+    if reserialized != original:
+        raise TypeError(
+            f"{stage} does not survive the JSON round trip — it bakes state "
+            "(callables, live objects) that JSON cannot carry."
+        )
+
+
+def _check_raw_serializable(r: Feature) -> None:
+    """Raw features carrying live callables (custom `.extract(fn)` or a monoid
+    `.aggregate(...)` object) cannot round-trip: replaying a bare FeatureBuilder
+    would silently fall back to `record.get(name)` / no aggregation and train a
+    DIFFERENT model. Refuse at save time, same contract as lambda stages."""
+    gen = r.origin_stage
+    if gen is None:
+        return
+    if getattr(gen, "extract_fn", None) is not None:
+        raise TypeError(
+            f"raw feature {r.name!r} has a custom extract function — live "
+            "callables have no JSON identity; restructure the extraction as a "
+            "stage, or re-attach .extract(fn) after graph_from_json"
+        )
+    if getattr(gen, "aggregator", None) is not None:
+        raise TypeError(
+            f"raw feature {r.name!r} has a custom aggregator — aggregator objects "
+            "are not serialized; re-attach .aggregate(...) after graph_from_json"
+        )
+
+
+def graph_to_json(result_features: Sequence[Feature]) -> dict:
+    """Serialize the UNFITTED graph reachable from `result_features`.
+
+    Raises TypeError for stages that cannot round-trip (live callables)."""
+    if isinstance(result_features, Feature):
+        result_features = [result_features]
+    dag = compute_dag(result_features)
+    validate_dag(dag)
+    raw = []
+    seen_raw: set[str] = set()
+    for f in result_features:
+        for r in f.raw_features():
+            if r.name not in seen_raw:
+                seen_raw.add(r.name)
+                _check_raw_serializable(r)
+                raw.append(r)
+    stage_payloads = []
+    for s in dag_stages(dag):
+        payload = stage_payload(s)
+        _check_json_faithful(s, payload)
+        stage_payloads.append(payload)
+    return {
+        "version": GRAPH_JSON_VERSION,
+        "fitted": False,
+        "raw_features": [
+            {"name": f.name, "kind": f.kind.name, "is_response": f.is_response,
+             **({"window_ms": f.origin_stage.params["window_ms"]}
+                if f.origin_stage is not None
+                and f.origin_stage.params.get("window_ms") is not None else {})}
+            for f in raw
+        ],
+        "result_features": [f.name for f in result_features],
+        "stages": stage_payloads,
+    }
+
+
+def graph_from_json(data: dict) -> list[Feature]:
+    """Rebuild the unfitted graph; returns the result features (same order as saved).
+    The rebuilt features wire fresh stage instances restored from the registry, so the
+    graph is immediately trainable: `Workflow().set_result_features(*loaded)`."""
+    if data.get("version") != GRAPH_JSON_VERSION:
+        raise ValueError(f"unsupported graph json version {data.get('version')!r}")
+    features, _, _ = replay_manifest(data)
+    return [features[n] for n in data["result_features"]]
+
+
+def save_graph(path: str, result_features: Sequence[Feature],
+               overwrite: bool = False) -> None:
+    """Write the unfitted graph to a JSON file."""
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists; pass overwrite=True")
+    spec = graph_to_json(result_features)
+    with open(path, "w") as fh:
+        json.dump(spec, fh, indent=1)
+
+
+def load_graph(path: str) -> list[Feature]:
+    with open(path) as fh:
+        return graph_from_json(json.load(fh))

@@ -33,6 +33,29 @@ from .validator import (
 #: reference default regularization grid (DefaultSelectorParams.scala: Regularization)
 REGULARIZATION_GRID = [0.001, 0.01, 0.1, 0.2]
 
+_VALIDATOR_CLASSES = {c.__name__: c for c in (CrossValidation, TrainValidationSplit)}
+_SPLITTER_CLASSES = {c.__name__: c for c in (DataSplitter, DataBalancer, DataCutter)}
+
+
+def _ctor_args(obj) -> dict:
+    """JSON args reconstructing `obj` via type(obj)(**args): the instance attributes
+    restricted to the ctor's keyword names (validators/splitters store every ctor arg
+    under its own name; derived state like summaries is excluded by construction)."""
+    import inspect
+
+    from ..stages.base import _jsonify
+
+    sig = inspect.signature(type(obj).__init__)
+    return {name: _jsonify(getattr(obj, name)) for name in sig.parameters
+            if name != "self" and hasattr(obj, name)}
+
+
+def _restore_by_ctor(classes: dict, spec: dict):
+    if spec["class"] not in classes:
+        raise ValueError(f"unknown class {spec['class']!r}; expected one of "
+                         f"{sorted(classes)}")
+    return classes[spec["class"]](**spec["args"])
+
 
 @dataclass
 class ModelSelectorSummary:
@@ -150,6 +173,54 @@ class ModelSelector(PredictorEstimator):
                           _jsonify(vars(self.validator))],
             "splitter": [type(self.splitter).__name__, _jsonify(vars(self.splitter))],
         }
+
+    # --- unfitted serialization (FeatureJsonHelper-grade graph round trip) ----------
+    def to_json(self) -> dict:
+        """Ctor params + the search configuration (metric/models/validator/splitter),
+        so an UNFITTED selector survives graph_to_json -> graph_from_json with its
+        full search intact (graph/json_helper.py). The mesh and checkpoint_path are
+        runtime wiring and are deliberately not serialized."""
+        from ..stages.base import _jsonify
+
+        data = super().to_json()
+        data["search"] = {
+            "metric": self.metric,
+            "models": [
+                {"class": type(t).__name__, "params": _jsonify(t.params),
+                 "grid": _jsonify(list(grid))}
+                for t, grid in self.models
+            ],
+            "validator": {"class": type(self.validator).__name__,
+                          "args": _ctor_args(self.validator)},
+            "splitter": {"class": type(self.splitter).__name__,
+                         "args": _ctor_args(self.splitter)},
+        }
+        return data
+
+    @classmethod
+    def from_json(cls, data: dict) -> "ModelSelector":
+        from ..stages.base import STAGE_REGISTRY
+
+        kwargs = dict(data["params"])
+        search = data.get("search")
+        if search:
+            kwargs["metric"] = search["metric"]
+            for m in search["models"]:
+                if m["class"] not in STAGE_REGISTRY:
+                    raise ValueError(
+                        f"unknown model class {m['class']!r}; not in the stage "
+                        "registry of this build")
+            kwargs["models"] = [
+                (STAGE_REGISTRY[m["class"]](**m["params"]), list(m["grid"]))
+                for m in search["models"]
+            ]
+            kwargs["validator"] = _restore_by_ctor(
+                _VALIDATOR_CLASSES, search["validator"])
+            kwargs["splitter"] = _restore_by_ctor(
+                _SPLITTER_CLASSES, search["splitter"])
+        stage = cls(**kwargs)
+        stage.uid = data["uid"]
+        return stage
 
     # the selector's own fit is the whole search; fit_fn/predict_fn are the winner's
     def fit_columns(self, cols):

@@ -133,6 +133,10 @@ class Stage:
     @classmethod
     def from_json(cls, data: dict) -> "Stage":
         klass = STAGE_REGISTRY[data["class"]]
+        if "from_json" in klass.__dict__ and klass is not cls:
+            # stages whose configuration lives outside ctor params (ModelSelector's
+            # models/validator/splitter) restore it via their own from_json
+            return klass.from_json(data)
         stage = klass(**data["params"])
         stage.uid = data["uid"]
         return stage

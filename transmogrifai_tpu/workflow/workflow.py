@@ -598,11 +598,12 @@ class WorkflowModel(WorkflowCore):
         target = os.path.join(path, self.MANIFEST)
         if os.path.exists(target) and not overwrite:
             raise FileExistsError(f"{target} exists; pass overwrite=True")
+        from ..graph.json_helper import stage_payload
+
         arrays: dict[str, _np.ndarray] = {}
         stage_payloads = []
         for s in self.stages:
-            payload = {**s.to_json(), "output": s.get_output().name,
-                       "output_kind": s.get_output().kind.name}
+            payload = stage_payload(s)
             if getattr(s, "origin_class", None) is not None:
                 payload["origin"] = {"class": s.origin_class,
                                      "params": s.origin_params}
@@ -654,26 +655,9 @@ class WorkflowModel(WorkflowCore):
                             f"{npz_path} missing but stage {sj['uid']} references it"
                         )
                     sj["params"][k] = arrays[v["__npz__"]].tolist()
-        from ..graph.builder import FeatureBuilder
+        from ..graph.json_helper import replay_manifest
 
-        features: dict[str, Feature] = {}
-        raw = []
-        for rf in manifest["raw_features"]:
-            fb = FeatureBuilder(rf["name"], rf["kind"])
-            f = fb.as_response() if rf["is_response"] else fb.as_predictor()
-            features[f.name] = f
-            raw.append(f)
-        stages: list[Transformer] = []
-        for sj in manifest["stages"]:
-            stage = Stage.from_json(sj)
-            if "origin" in sj:
-                stage.origin_class = sj["origin"]["class"]
-                stage.origin_params = sj["origin"]["params"]
-            ins = [features[n] for n in sj["inputs"]]
-            out = stage.set_input(*ins)
-            out.name = sj["output"]
-            features[out.name] = out
-            stages.append(stage)
+        features, raw, stages = replay_manifest(manifest)
         model = WorkflowModel(
             result_features=[features[n] for n in manifest["result_features"]],
             raw_features=raw,
