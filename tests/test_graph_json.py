@@ -160,6 +160,18 @@ def test_custom_extract_and_aggregator_refused_loudly():
         graph_to_json([transmogrify([agg])])
 
 
+def test_duplicate_feature_names_refused():
+    """Two distinct features sharing a name would silently collapse into one on
+    reload (name-keyed wiring) — refuse at save time."""
+    from transmogrifai_tpu.graph import FeatureBuilder
+    from transmogrifai_tpu.stages.feature import transmogrify
+
+    a = FeatureBuilder("x", "Real").as_predictor()
+    b = FeatureBuilder("x", "Integral").as_predictor()
+    with pytest.raises(ValueError, match="[Dd]uplicate|distinct"):
+        graph_to_json([transmogrify([a, b])])
+
+
 def test_window_ms_survives_roundtrip():
     from transmogrifai_tpu.graph import FeatureBuilder
 

@@ -39,15 +39,25 @@ _SPLITTER_CLASSES = {c.__name__: c for c in (DataSplitter, DataBalancer, DataCut
 
 def _ctor_args(obj) -> dict:
     """JSON args reconstructing `obj` via type(obj)(**args): the instance attributes
-    restricted to the ctor's keyword names (validators/splitters store every ctor arg
-    under its own name; derived state like summaries is excluded by construction)."""
+    named by the ctor's keyword parameters (validators/splitters store every ctor
+    arg under its own name). A ctor parameter with NO same-named attribute raises —
+    silently substituting the ctor default would reload a different search."""
     import inspect
 
     from ..stages.base import _jsonify
 
     sig = inspect.signature(type(obj).__init__)
-    return {name: _jsonify(getattr(obj, name)) for name in sig.parameters
-            if name != "self" and hasattr(obj, name)}
+    out = {}
+    for name in sig.parameters:
+        if name == "self":
+            continue
+        if not hasattr(obj, name):
+            raise TypeError(
+                f"{type(obj).__name__} stores ctor arg {name!r} under a different "
+                "attribute name — it cannot be serialized faithfully; store it "
+                f"as self.{name}")
+        out[name] = _jsonify(getattr(obj, name))
+    return out
 
 
 def _restore_by_ctor(classes: dict, spec: dict):

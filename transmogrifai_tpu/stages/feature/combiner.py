@@ -1,14 +1,34 @@
 """VectorsCombiner: concatenate OPVectors + their schemas
-(reference VectorsCombiner.scala:51). Pure jnp -> fuses with neighbors under jit."""
+(reference VectorsCombiner.scala:51).
+
+kernel_jitted: the device work (concat + width-bucket pad) dispatches to ONE
+module-level jitted kernel keyed on shapes only, while the schema concat (pure
+host metadata naming uid-suffixed parents) runs eagerly. Fusing this stage into
+the per-plan jit instead would bake the parent NAMES into the fused-run cache
+key, forcing a fresh ~0.6 s XLA compile on every train of a fresh graph — the
+exact steady-state regression profiled on the boston search."""
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 
 from ...types import Column, VectorSchema
 from ..base import register_stage
 from .common import SequenceVectorizer
+
+
+@partial(jax.jit, static_argnames=("target",))
+def _concat_pad_kernel(vals: tuple, target: int) -> jnp.ndarray:
+    """Concat [N, w_i] blocks -> [N, target], padding via pad_vector_values (the
+    single width-bucketing implementation). Shape-keyed jit cache: every train
+    whose vector widths land in the same bucket shares this program."""
+    from ...types.vector_schema import pad_vector_values
+
+    vec = jnp.concatenate([jnp.asarray(v, jnp.float32) for v in vals], axis=1)
+    return pad_vector_values(vec, None, target)[0]
 
 
 @register_stage
@@ -21,6 +41,9 @@ class VectorsCombiner(SequenceVectorizer):
 
     operation_name = "combine"
     device_op = True
+    #: device work rides the shape-keyed module kernel; keep it OUT of the
+    #: per-plan fused jit (whose cache key includes uid-bearing input names)
+    kernel_jitted = True
     accepts = ("OPVector",)
 
     def __init__(self, pad_to_bucket: bool = True):
@@ -28,14 +51,15 @@ class VectorsCombiner(SequenceVectorizer):
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         from ...types import bucket_width
-        from ...types.vector_schema import pad_vector_values
 
-        vec = jnp.concatenate([jnp.asarray(c.values, jnp.float32) for c in cols], axis=1)
+        width = sum(int(c.values.shape[1]) for c in cols)
+        target = bucket_width(width) if self.params["pad_to_bucket"] else width
+        vec = _concat_pad_kernel(tuple(c.values for c in cols), target)
         schemas = [c.schema if c.schema is not None else _anonymous_schema(c, f)
                    for c, f in zip(cols, self.inputs)]
         schema = schemas[0].concat(*schemas[1:])
-        if self.params["pad_to_bucket"]:
-            vec, schema = pad_vector_values(vec, schema, bucket_width(vec.shape[1]))
+        if target > width and schema is not None:
+            schema = schema.pad_to(target)
         return Column.vector(vec, schema)
 
 

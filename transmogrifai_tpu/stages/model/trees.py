@@ -39,11 +39,18 @@ def _ensemble_params(stage_params: dict) -> TreeEnsembleParams:
 
 
 def _params_json(params: TreeEnsembleParams) -> dict:
+    import jax
+
+    # ONE device_get over the tuple: async copies for every leaf are issued
+    # before any blocks — per-field np.asarray paid 4 serial tunnel round trips
+    # (~0.4 s of the boston steady train)
+    host = jax.device_get((params.split_feature, params.split_threshold,
+                           params.leaf_values, params.base))
     return {
-        "split_feature": np.asarray(params.split_feature).tolist(),
-        "split_threshold": np.asarray(params.split_threshold).tolist(),
-        "leaf_values": np.asarray(params.leaf_values).tolist(),
-        "base": np.asarray(params.base).tolist(),
+        "split_feature": host[0].tolist(),
+        "split_threshold": host[1].tolist(),
+        "leaf_values": host[2].tolist(),
+        "base": host[3].tolist(),
     }
 
 
