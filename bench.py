@@ -261,7 +261,19 @@ def _run(partial: dict) -> None:
     enable_compile_cache()
 
     reader = _reader()
-    # warmup end-to-end train: pays one-time XLA compiles for every model family
+    # `op warmup` first — the deploy-time step a real service runs (CLI: `op
+    # warmup --problem binary --rows 891 --widths 512`): one synthetic search at
+    # the SAME shapes/grids compiles + persists every selector/refit/metrics
+    # program, so the user's first real train pays tracing only. warmup_s is the
+    # true cold cost (compiles included on a cold .jax_cache; cache reads on a
+    # warm one); first_train below is the first REAL train after warmup.
+    from transmogrifai_tpu.workflow.warmup import warmup as op_warmup
+
+    t_w = time.perf_counter()
+    op_warmup(problem="binary", rows=891, width=512, models=_models())
+    warmup_wall = time.perf_counter() - t_w
+    partial["warmup_s"] = round(warmup_wall, 3)
+
     t0 = time.perf_counter()
     wf, selector, pred, fs = _build()
     full = reader.generate_table(list(fs.values()))
@@ -293,7 +305,8 @@ def _run(partial: dict) -> None:
         "device_note": partial.get("device_note"),
         "models_evaluated": summary.models_evaluated,
         "search_wall_s": round(dt, 3),
-        "first_train_incl_compile_s": round(warm, 3),
+        "op_warmup_s": round(warmup_wall, 3),
+        "first_train_after_warmup_s": round(warm, 3),
         "first_train_models_per_sec": round(first_models_per_sec, 3),
         "best_model": summary.best_model_name,
         "best_params": summary.best_params,
@@ -344,7 +357,8 @@ def _run(partial: dict) -> None:
         "vs_baseline": vs_baseline,
         "summary": {
             "titanic_models_per_sec_steady": round(models_per_sec, 3),
-            "titanic_first_train_s": round(warm, 3),
+            "titanic_op_warmup_s": round(warmup_wall, 3),
+            "titanic_first_train_after_warmup_s": round(warm, 3),
             "titanic_holdout_AuPR": detail["holdout"].get("AuPR"),
             "titanic_holdout_AuROC": detail["holdout"].get("AuROC"),
             "reference_holdout_AuPR": REFERENCE_HOLDOUT["AuPR"],
@@ -360,7 +374,9 @@ def _run(partial: dict) -> None:
     for name in ("iris", "boston"):
         if name in detail:
             s[f"{name}_models_per_sec_steady"] = detail[name].get("models_per_sec")
+            # first train AFTER the op-warmup deploy step (op_warmup_s alongside)
             s[f"{name}_first_train_s"] = detail[name].get("first_train_s")
+            s[f"{name}_op_warmup_s"] = detail[name].get("op_warmup_s")
     if "mlp_deep_tabular" in detail:
         s["mlp_mfu"] = detail["mlp_deep_tabular"].get("mfu")
     if "gbt_scale" in detail:

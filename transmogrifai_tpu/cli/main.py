@@ -89,14 +89,37 @@ def _cmd_warmup(argv) -> int:
                     help="comma-separated training-matrix width buckets "
                          "(default: 128)")
     ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--num-folds", type=int, default=3,
+                    help="planned CV fold count (fold shapes derive from it)")
+    ap.add_argument("--splitter", default="default",
+                    choices=["default", "plain", "balancer", "cutter"],
+                    help="planned splitter kind — holdout row counts enter "
+                         "program shapes, so a custom splitter must be warmed "
+                         "with the same one (default: the problem's default)")
+    ap.add_argument("--reserve-test-fraction", type=float, default=None,
+                    help="planned holdout fraction (with --splitter)")
     args = ap.parse_args(argv)
     from transmogrifai_tpu.workflow.warmup import _PROBLEMS, warmup_matrix
 
+    splitter = None
+    if args.splitter != "default" or args.reserve_test_fraction is not None:
+        from transmogrifai_tpu.select.splitters import (
+            DataBalancer,
+            DataCutter,
+            DataSplitter,
+        )
+
+        cls = {"plain": DataSplitter, "balancer": DataBalancer,
+               "cutter": DataCutter, "default": DataSplitter}[args.splitter]
+        kw = ({} if args.reserve_test_fraction is None
+              else {"reserve_test_fraction": args.reserve_test_fraction})
+        splitter = cls(**kw)
     problems = _PROBLEMS if args.problem == "all" else (args.problem,)
     widths = [int(w) for w in args.widths.split(",") if w]
     # progress to stderr: stdout carries ONLY the JSON report (CI pipes to jq)
     reports = warmup_matrix(problems=problems, rows=args.rows, widths=widths,
                             num_classes=args.num_classes,
+                            splitter=splitter, num_folds=args.num_folds,
                             log=lambda m: print(m, file=sys.stderr))
     import json
 

@@ -136,11 +136,7 @@ def graph_to_json(result_features: Sequence[Feature]) -> dict:
     # name-keyed serialization: two distinct features sharing a name would be
     # silently collapsed into one on reload — refuse loudly instead (the same
     # check train() runs, applied at authoring time)
-    seen_features: dict[int, Feature] = {}
-    for f in result_features:
-        for a in f.all_features():
-            seen_features.setdefault(id(a), a)
-    validate_distinct_names(seen_features.values())
+    validate_distinct_names([a for f in result_features for a in f.all_features()])
     raw = []
     seen_raw: set[str] = set()
     for f in result_features:

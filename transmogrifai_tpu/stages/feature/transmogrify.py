@@ -156,6 +156,8 @@ def transmogrify(
             continue
         vectors.append(stage(*feats))
 
-    if len(vectors) == 1:
-        return vectors[0]
+    # ALWAYS combine, even a single family: VectorsCombiner owns the
+    # width-bucket padding policy, and a selector fed an unbucketed vector
+    # (e.g. 4 reals -> width 8) would compile per-exact-width programs that
+    # `op warmup`'s bucketed shapes can never pre-seed
     return VectorsCombiner()(*vectors)

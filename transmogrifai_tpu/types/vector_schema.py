@@ -71,16 +71,17 @@ PADDING_FEATURE = "__padding__"
 
 
 def bucket_width(n: int) -> int:
-    """Round a vector width up to a compile-stable bucket: multiples of 64 up to 512,
-    multiples of 128 up to 2048, powers of two beyond. Datasets whose vocabularies
-    land in the same bucket reuse every downstream compiled program (fit/search/
-    score) — the SURVEY §7 mitigation for data-dependent vocab widths. Buckets are
-    also MXU-lane friendly. The mid-range uses 128-steps rather than powers of two
+    """Round a vector width up to a compile-stable bucket: multiples of 8 up to 64,
+    of 64 up to 512, of 128 up to 2048, powers of two beyond. Datasets whose
+    vocabularies land in the same bucket reuse every downstream compiled program
+    (fit/search/score) — the SURVEY §7 mitigation for data-dependent vocab widths.
+    Buckets are also MXU-lane friendly. Steps stay proportional to the width
     because tree histogram work scales linearly with padded width: rounding a 539-
-    wide Titanic matrix to 1024 doubled the whole search's device time for zeros
-    (640 keeps waste under 20% and still bounds the program count)."""
+    wide Titanic matrix to 1024 doubled the whole search's device time for zeros,
+    and a 64 floor made a width-8 iris matrix pay 8x tree compute (halving its
+    search throughput). <=20% waste at every scale, program count still bounded."""
     if n <= 64:
-        return 64
+        return max(8, (n + 7) // 8 * 8)
     if n <= 512:
         return (n + 63) // 64 * 64
     if n <= 2048:

@@ -9,10 +9,11 @@ leaves the persistent cache warm and the user's first real train pays only
 tracing + cache reads.
 
 Width is the TRAINING-MATRIX width after vectorization; widths are bucketed
-(types/vector_schema.bucket_width: multiples of 64 to 512, of 128 to 2048),
-so warming the handful of buckets around your schema's expected width covers
-vocabulary drift. Rows matter too (fold shapes derive from them): pass the
-planned dataset size.
+(types/vector_schema.bucket_width: multiples of 8 to 64, of 64 to 512, of 128
+to 2048), so warming the handful of buckets around your schema's expected
+width covers vocabulary drift. Rows matter too (fold shapes derive from
+them): pass the planned dataset size — and the planned splitter/num_folds
+when they are custom (holdout/fold row counts enter program shapes).
 """
 from __future__ import annotations
 
@@ -25,7 +26,8 @@ _PROBLEMS = ("binary", "multiclass", "regression")
 
 
 def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
-           num_classes: int = 3, seed: int = 0, models=None) -> dict:
+           num_classes: int = 3, seed: int = 0, models=None,
+           splitter=None, num_folds: int = 3) -> dict:
     """Run one full synthetic ModelSelector fit at (rows, bucket_width(width))
     — compiling (and persisting) every program the same-shaped real train
     will need. The width rounds through the SAME bucket function real trains
@@ -51,19 +53,22 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     width = bucket_width(requested)
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(rows, width)).astype(np.float32)
+    # splitter/num_folds matter for shape fidelity: fold/holdout row counts enter
+    # program shapes, so a planned train with a custom splitter (e.g. iris's
+    # DataCutter(reserve_test_fraction=0.2)) must warm with the same one
     if problem == "binary":
         y = (X[:, 0] + 0.25 * rng.normal(size=rows) > 0).astype(np.float32)
         selector = BinaryClassificationModelSelector.with_cross_validation(
-            models=models, seed=seed)
+            num_folds=num_folds, models=models, splitter=splitter, seed=seed)
     elif problem == "multiclass":
         y = np.clip((X[:, 0] * 1.5 + num_classes / 2).astype(int),
                     0, num_classes - 1).astype(np.float32)
         selector = MultiClassificationModelSelector.with_cross_validation(
-            models=models, seed=seed)
+            num_folds=num_folds, models=models, splitter=splitter, seed=seed)
     else:
         y = (X[:, 0] * 2.0 + rng.normal(size=rows)).astype(np.float32)
         selector = RegressionModelSelector.with_cross_validation(
-            models=models, seed=seed)
+            num_folds=num_folds, models=models, splitter=splitter, seed=seed)
 
     label = FeatureBuilder("label", "RealNN").as_response()
     vec = FeatureBuilder("vec", "OPVector").as_predictor()
@@ -86,13 +91,16 @@ def warmup_matrix(problems: Sequence[str] = ("binary",),
                   widths: Sequence[int] = (128,),
                   num_classes: int = 3,
                   models=None,
+                  splitter=None,
+                  num_folds: int = 3,
                   log=print) -> list[dict]:
     """Warm every (problem, width) combination; returns the per-cell reports."""
     out = []
     for p in problems:
         for w in widths:
             rep = warmup(problem=p, rows=rows, width=int(w),
-                         num_classes=num_classes, models=models)
+                         num_classes=num_classes, models=models,
+                         splitter=splitter, num_folds=num_folds)
             log(f"warmed {p} rows={rows} width={w}: {rep['wall_s']}s")
             out.append(rep)
     return out
