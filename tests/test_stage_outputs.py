@@ -177,6 +177,7 @@ def _recipes():
         "NGram": (dict(n=2), [("x", "TextList", False)]),
         "NGramSimilarity": ({}, [("a", "Text", False), ("b", "Text", False)]),
         "NameEntityRecognizer": ({}, [("x", "TextList", False)]),
+        "NameEntityTagger": ({}, [("x", "Text", False)]),
         "StopWordsRemover": ({}, [("x", "TextList", False)]),
         "TextLenTransformer": ({}, [("x", "Text", False)]),
         "TextTokenizer": ({}, [("x", "Text", False)]),

@@ -130,6 +130,43 @@ def test_name_entity_recognizer():
     assert out.values[0] == {"Alice", "Bob", "Paris"}
 
 
+def test_name_entity_recognizer_multi_type():
+    """The full NameEntityType coverage (reference NameEntityTagger.scala:76-87):
+    location/organization/date/time/money/percentage engines, selectable."""
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    toks = ["Alice", "paid", "$4,200", "to", "Acme", "Corp", "in", "France",
+            "on", "January", "3", "2021", "at", "4:30pm", "up", "12%"]
+    t = Table({"toks": _col("TextList", [toks])}, 1)
+    out, _ = _apply(NameEntityRecognizer(
+        entity_types=("person", "location", "organization", "date", "time",
+                      "money", "percentage")), [f], t)
+    ents = out.values[0]
+    for expected in ("Alice", "$4,200", "Acme", "Corp", "France", "January",
+                     "3", "2021", "4:30pm", "12%"):
+        assert expected in ents, (expected, ents)
+    with pytest.raises(ValueError, match="unknown entity types"):
+        NameEntityRecognizer(entity_types=("persons",))
+
+
+def test_name_entity_tagger_token_tags_map():
+    """Text -> MultiPickListMap {token: tags}, the reference stage's exact
+    output shape (NameEntityRecognizer.scala:73-89)."""
+    from transmogrifai_tpu.stages.feature.text_advanced import NameEntityTagger
+
+    f = FeatureBuilder.Text("txt").as_predictor()
+    t = Table({"txt": _col(
+        "Text", ["Dr Alice Smith flew to Japan on Monday for $3,000", None])}, 2)
+    out, feat = _apply(NameEntityTagger(), [f], t)
+    assert feat.kind.name == "MultiPickListMap"
+    tags = out.values[0]
+    assert "person" in tags["Alice"]
+    assert "person" in tags["Smith"]      # chained surname after a gazetteer hit
+    assert "location" in tags["Japan"]
+    assert "date" in tags["Monday"]
+    assert tags["$3,000"] == frozenset({"money"})
+    assert out.values[1] is None
+
+
 def test_mime_type_detector():
     f = FeatureBuilder.Base64("b").as_predictor()
     vals = [
@@ -141,6 +178,72 @@ def test_mime_type_detector():
     t = Table({"b": _col("Base64", vals)}, 4)
     out, _ = _apply(MimeTypeDetector(), [f], t)
     assert list(out.values) == ["application/pdf", "image/png", "text/plain", None]
+
+
+def test_mime_boundary_multibyte_is_text():
+    """A multi-byte char straddling the 4096-byte sniff cut is still text."""
+    data = b"a" * 4095 + "é".encode() * 8 + b" tail"
+    f = FeatureBuilder.Base64("b").as_predictor()
+    t = Table({"b": _col("Base64", [base64.b64encode(data).decode()])}, 1)
+    out, _ = _apply(MimeTypeDetector(), [f], t)
+    assert out.values[0] == "text/plain"
+
+
+def test_location_only_excludes_person_names():
+    """Suppression of person names in the prepositional-location rule must not
+    depend on 'person' being among the requested types."""
+    from transmogrifai_tpu.utils.ner import tag_tokens
+
+    toks = ["Flying", "to", "Maria", "from", "France"]
+    loc_only = tag_tokens(toks, entity_types=("location",))
+    assert "Maria" not in loc_only
+    assert "location" in loc_only["France"]
+
+
+def test_mime_type_detector_container_introspection():
+    """Tika's second layer: zip entries identify OOXML/ODF/jar; RIFF fourcc
+    identifies the media subtype; text classifies by leading syntax."""
+    import io
+    import zipfile
+
+    def zip_with(*names_data):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            for n, d in names_data:
+                zf.writestr(n, d)
+        return buf.getvalue()
+
+    docx = zip_with(("[Content_Types].xml", "<x/>"), ("word/document.xml", "<d/>"))
+    xlsx = zip_with(("[Content_Types].xml", "<x/>"), ("xl/workbook.xml", "<w/>"))
+    odt = zip_with(("mimetype", "application/vnd.oasis.opendocument.text"))
+    jar = zip_with(("META-INF/MANIFEST.MF", "Manifest-Version: 1.0"))
+    plain_zip = zip_with(("a.txt", "hi"))
+    wav = b"RIFF\x00\x00\x00\x00WAVEfmt "
+    webp = b"RIFF\x00\x00\x00\x00WEBPVP8 "
+    svg = b'<?xml version="1.0"?><svg xmlns="http://www.w3.org/2000/svg"/>'
+    html = b"<!DOCTYPE html><html></html>"
+    j = b'{"a": [1, 2]}'
+    tar = b"x" * 257 + b"ustar\x00" + b"y" * 100
+
+    f = FeatureBuilder.Base64("b").as_predictor()
+    vals = [base64.b64encode(v).decode()
+            for v in (docx, xlsx, odt, jar, plain_zip, wav, webp, svg, html,
+                      j, tar)]
+    t = Table({"b": _col("Base64", vals)}, len(vals))
+    out, _ = _apply(MimeTypeDetector(), [f], t)
+    assert list(out.values) == [
+        "application/vnd.openxmlformats-officedocument.wordprocessingml.document",
+        "application/vnd.openxmlformats-officedocument.spreadsheetml.sheet",
+        "application/vnd.oasis.opendocument.text",
+        "application/java-archive",
+        "application/zip",
+        "audio/wav",
+        "image/webp",
+        "image/svg+xml",
+        "text/html",
+        "application/json",
+        "application/x-tar",
+    ]
 
 
 # --- word2vec / LDA ---------------------------------------------------------------------

@@ -260,46 +260,31 @@ class LangDetector(Transformer):
         return Column(kind_of("RealMap"), out, None)
 
 
-#: honorifics introducing person names (context features, the OpenNLP-model
-#: replacement's strongest rule)
-_NER_HONORIFICS = frozenset(
-    "mr mrs ms miss dr prof sir madam lord lady captain president senator".split())
-
-#: compact gazetteer of common given names across locales — the trainable seed
-#: (extend via NameEntityRecognizer(extra_names=[...]))
-_NER_GIVEN_NAMES = frozenset("""
-james john robert michael william david richard joseph thomas charles mary
-patricia jennifer linda elizabeth barbara susan jessica sarah karen maria
-anna ana luis carlos jose juan pedro miguel sofia lucia marta paulo joao
-pierre jean marie claire louis michel francois anne laurent sophie hans
-karl heinz peter klaus anna greta fritz giovanni marco luca giulia paolo
-francesca wei li ming hiroshi takashi yuki kenji sakura haruto ji-woo
-min-jun seo-yeon ivan dmitri sergei natasha olga tatiana ahmed mohammed
-fatima omar layla aisha raj priya arjun ananya vikram deepa emma olivia
-noah liam mason lucas ethan amelia harper mia isabella evelyn henry jack
-george oscar arthur alice grace ruby ella leo max felix hugo theo
-""".split())
-
-
 @register_stage
 class NameEntityRecognizer(Transformer):
-    """TextList -> MultiPickList of likely person-name entities (reference
-    NameEntityRecognizer.scala runs OpenNLP binary NER models). This build
-    combines three signals — no binaries needed:
-
-      1. gazetteer: tokens matching a built-in multi-locale given-name list
-         (case-insensitive; extendable via `extra_names`), even sentence-initial;
-      2. context: any capitalized token following an honorific (Mr/Dr/...)
-         or following a recognized name (multi-token names chain: the surname
-         after a gazetteer hit is taken as part of the entity);
-      3. shape: capitalized, non-sentence-initial, non-stop-word tokens
-         (the round-2 heuristic, now the weakest of the three signals).
-    """
+    """TextList -> MultiPickList of entities of the requested types (reference
+    NameEntityRecognizer.scala runs OpenNLP binary NER models over the full
+    NameEntityType enum). The engine (`utils/ner.tag_tokens`) ships no
+    binaries: person combines a multi-locale given-name gazetteer (extendable
+    via `extra_names`) with honorific/chain context and capitalization shape;
+    location/organization ride gazetteers + suffix/context rules; date, time,
+    money and percentage are pattern grammars. `entity_types` defaults to
+    person-only (this stage's historical behavior); pass any subset of
+    utils.ner.ENTITY_TYPES."""
 
     operation_name = "ner"
 
-    def __init__(self, extra_names: Sequence[str] = ()):
-        super().__init__(extra_names=sorted(str(n).lower() for n in extra_names))
+    def __init__(self, extra_names: Sequence[str] = (),
+                 entity_types: Sequence[str] = ("person",)):
+        from ...utils.ner import ENTITY_TYPES
+
+        types = tuple(entity_types)
+        unknown = set(types) - set(ENTITY_TYPES)
+        if unknown:
+            raise ValueError(f"unknown entity types {sorted(unknown)}; "
+                             f"supported: {list(ENTITY_TYPES)}")
+        super().__init__(extra_names=sorted(str(n).lower() for n in extra_names),
+                         entity_types=list(types))
 
     def out_kind(self, in_kinds):
         if in_kinds[0].name != "TextList":
@@ -307,52 +292,189 @@ class NameEntityRecognizer(Transformer):
         return kind_of("MultiPickList")
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
-        gazetteer = _NER_GIVEN_NAMES | frozenset(self.params["extra_names"])
+        from ...utils.ner import Tagger
+
+        p = self.params
+        tagger = Tagger(entity_types=p["entity_types"],
+                        extra_names=p["extra_names"],
+                        stop_words=ENGLISH_STOP_WORDS)
         out = np.empty(len(cols[0]), dtype=object)
         for i, toks in enumerate(cols[0].values):
-            ents = set()
-            prev_was_name = False
-            prev_was_honorific = False
-            for j, t in enumerate(toks):
-                low = t.lower()
-                capitalized = t[:1].isupper() and (len(t) == 1 or not t.isupper())
-                is_name = False
-                if low.rstrip(".") in _NER_HONORIFICS:
-                    pass  # honorifics introduce names; they are never entities
-                elif capitalized:
-                    if low in gazetteer:
-                        is_name = True
-                    elif prev_was_honorific or prev_was_name:
-                        is_name = low not in ENGLISH_STOP_WORDS
-                    elif j > 0 and low not in ENGLISH_STOP_WORDS:
-                        is_name = t[1:].islower()  # shape signal
-                if is_name:
-                    ents.add(t)
-                prev_was_name = is_name
-                prev_was_honorific = low.rstrip(".") in _NER_HONORIFICS
-            out[i] = frozenset(ents)
+            out[i] = frozenset(tagger.tag(list(toks)))
         return Column(kind_of("MultiPickList"), out, None)
+
+
+@register_stage
+class NameEntityTagger(Transformer):
+    """Text -> MultiPickListMap of {token: entity tags} across every entity
+    type — the exact output shape of the reference stage (NameEntityRecognizer.
+    scala:73-89 folds per-sentence OpenNLP tokenTags into one MultiPickListMap).
+    Tokenization is language-aware (LangDetector's detector + the per-language
+    tokenizer), case preserved, mirroring the reference's toLowercase=false
+    analyzer chain."""
+
+    operation_name = "nameEntityRec"
+
+    def __init__(self, extra_names: Sequence[str] = (),
+                 default_language: str = "en"):
+        super().__init__(extra_names=sorted(str(n).lower() for n in extra_names),
+                         default_language=default_language)
+
+    def out_kind(self, in_kinds):
+        if not in_kinds[0].is_text:
+            raise TypeError(
+                f"NameEntityTagger takes a text kind, got {in_kinds[0].name}")
+        return kind_of("MultiPickListMap")
+
+    @staticmethod
+    def _ner_tokens(text: str) -> list:
+        """Whitespace tokens with sentence punctuation stripped at the EDGES
+        only — inner $ , . % : / stay, so '$3,000', '4:30pm', '12%' survive
+        (the word tokenizer's punctuation split would shred them; OpenNLP's
+        tokenizer likewise keeps such tokens whole)."""
+        return [t for t in (w.strip(".,;:!?\"'()[]") for w in text.split()) if t]
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        from ...utils.ner import Tagger
+        from ...utils.text_lang import detect_language, tokenize_for_language
+
+        p = self.params
+        tagger = Tagger(extra_names=p["extra_names"],
+                        stop_words=ENGLISH_STOP_WORDS)
+        out = np.empty(len(cols[0]), dtype=object)
+        for i, v in enumerate(cols[0].values):
+            if v is None:
+                out[i] = None
+                continue
+            lang = detect_language(v) or p["default_language"]
+            toks = (tokenize_for_language(v, lang, to_lower=False)
+                    if lang in ("ja", "zh", "ko") else self._ner_tokens(v))
+            out[i] = {tok: frozenset(ts)
+                      for tok, ts in tagger.tag(toks).items()}
+        return Column(kind_of("MultiPickListMap"), out, None)
 
 
 _MAGIC = (
     (b"%PDF", "application/pdf"),
-    (b"PK\x03\x04", "application/zip"),
     (b"\x89PNG", "image/png"),
     (b"\xff\xd8\xff", "image/jpeg"),
     (b"GIF8", "image/gif"),
     (b"BM", "image/bmp"),
     (b"\x1f\x8b", "application/gzip"),
-    (b"<?xml", "application/xml"),
-    (b"{", "application/json"),
+    (b"BZh", "application/x-bzip2"),
+    (b"\xfd7zXZ\x00", "application/x-xz"),
+    (b"7z\xbc\xaf\x27\x1c", "application/x-7z-compressed"),
+    (b"Rar!\x1a\x07", "application/x-rar-compressed"),
+    (b"\x28\xb5\x2f\xfd", "application/zstd"),
     (b"OggS", "audio/ogg"),
     (b"ID3", "audio/mpeg"),
+    (b"\xff\xfb", "audio/mpeg"),
+    (b"fLaC", "audio/flac"),
+    (b"MThd", "audio/midi"),
+    (b"\x1aE\xdf\xa3", "video/x-matroska"),
+    (b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1", "application/x-ole-storage"),
+    (b"\x7fELF", "application/x-executable"),
+    (b"MZ", "application/x-msdownload"),
+    (b"\xca\xfe\xba\xbe", "application/java-vm"),
+    (b"wOFF", "font/woff"),
+    (b"wOF2", "font/woff2"),
+    (b"\x00\x00\x01\x00", "image/vnd.microsoft.icon"),
+    (b"II*\x00", "image/tiff"),
+    (b"MM\x00*", "image/tiff"),
+    (b"SQLite format 3\x00", "application/vnd.sqlite3"),
+    (b"PAR1", "application/vnd.apache.parquet"),
+    (b"Obj\x01", "application/avro"),
+    (b"%!PS", "application/postscript"),
+    (b"{\\rtf", "application/rtf"),
 )
+
+#: zip entry names -> the container's real type (Tika's zip introspection:
+#: OOXML and ODF documents are zips whose first entries identify the format)
+_ZIP_ENTRY_TYPES = (
+    ("word/", "application/vnd.openxmlformats-officedocument"
+              ".wordprocessingml.document"),
+    ("xl/", "application/vnd.openxmlformats-officedocument"
+            ".spreadsheetml.sheet"),
+    ("ppt/", "application/vnd.openxmlformats-officedocument"
+             ".presentationml.presentation"),
+    ("META-INF/MANIFEST.MF", "application/java-archive"),
+)
+
+
+def _sniff_mime(data: bytes) -> Optional[str]:
+    """Magic-number + container-introspection sniffing (the Tika detector's
+    two layers): zip-based documents are identified by their entries, RIFF/
+    ISO-BMFF media by their subtype fourcc, text by decode + leading syntax."""
+    head = data[:64]
+    if head.startswith(b"PK\x03\x04"):
+        import io
+        import zipfile
+
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                names = zf.namelist()
+                # ODF stores its type verbatim in a `mimetype` entry
+                if "mimetype" in names:
+                    return zf.read("mimetype").decode("ascii", "ignore").strip()
+                for marker, mime in _ZIP_ENTRY_TYPES:
+                    if any(n.startswith(marker) for n in names):
+                        return mime
+        except Exception:
+            pass  # truncated/odd zip: still a zip
+        return "application/zip"
+    if head.startswith(b"RIFF") and len(head) >= 12:
+        sub = head[8:12]
+        return {b"WAVE": "audio/wav", b"AVI ": "video/x-msvideo",
+                b"WEBP": "image/webp"}.get(sub, "application/octet-stream")
+    if len(head) >= 12 and head[4:8] == b"ftyp":  # ISO base media (mp4 family)
+        brand = head[8:12]
+        if brand.startswith(b"M4A"):
+            return "audio/mp4"
+        if brand in (b"qt  ",):
+            return "video/quicktime"
+        return "video/mp4"
+    if len(data) > 257 + 8 and data[257:262] == b"ustar":
+        return "application/x-tar"
+    for sig, m in _MAGIC:
+        if head.startswith(sig):
+            return m
+    # text layer: must decode; subtype from leading syntax. A multi-byte
+    # character straddling the 4096 cut is NOT binary — back off up to 3
+    # trailing bytes (max UTF-8 continuation run) before giving up.
+    chunk = data[:4096]
+    text = None
+    for trim in range(4):
+        if len(data) > 4096 or trim == 0:
+            try:
+                text = chunk[:len(chunk) - trim].decode("utf-8")
+                break
+            except UnicodeDecodeError:
+                continue
+    if text is None:
+        return None
+    s = text.lstrip().lower()
+    if s.startswith("<?xml"):
+        return "image/svg+xml" if "<svg" in s else "application/xml"
+    if s.startswith("<!doctype html") or s.startswith("<html"):
+        return "text/html"
+    if s.startswith("{") or s.startswith("["):
+        import json as _json
+
+        try:
+            _json.loads(text if len(data) <= 4096 else data.decode("utf-8"))
+            return "application/json"
+        except Exception:
+            pass
+    return "text/plain"
 
 
 @register_stage
 class MimeTypeDetector(Transformer):
-    """Base64 -> PickList MIME type via magic bytes (reference MimeTypeDetector.scala
-    uses Apache Tika; magic-number sniffing covers the same test fixtures)."""
+    """Base64 -> PickList MIME type (reference MimeTypeDetector.scala uses
+    Apache Tika). Two Tika-grade layers, no dependency: ~35 magic signatures
+    plus container introspection — zip entries identify OOXML/ODF/jar, RIFF
+    and ISO-BMFF fourcc codes identify the media subtype, text decodes then
+    classifies by leading syntax (xml/svg/html/json/plain)."""
 
     operation_name = "mimeType"
 
@@ -371,19 +493,13 @@ class MimeTypeDetector(Transformer):
                 out[i] = None
                 continue
             try:
-                head = _b64.b64decode(v, validate=False)[:16]
+                data = _b64.b64decode(v, validate=False)
             except Exception:
                 out[i] = None
                 continue
             mime = self.params["type_hint"]
             if mime is None:
-                mime = next((m for sig, m in _MAGIC if head.startswith(sig)), None)
-            if mime is None:
-                try:
-                    head.decode("utf-8")
-                    mime = "text/plain"
-                except UnicodeDecodeError:
-                    mime = "application/octet-stream"
+                mime = _sniff_mime(data) or "application/octet-stream"
             out[i] = mime
         return Column(kind_of("PickList"), out, None)
 

@@ -1,0 +1,280 @@
+"""Rule + gazetteer named-entity tagging engine across the reference's entity set.
+
+The reference tags tokens with OpenNLP binary maxent models over the full
+NameEntityType enum (utils/src/main/scala/com/salesforce/op/utils/text/
+NameEntityTagger.scala:76-87: Date/Location/Money/Organization/Percentage/
+Person/Time/Misc/Other). This build ships no binary models; each type gets a
+deterministic engine of the corresponding classic design — gazetteers with
+context rules for person/location/organization, pattern grammars for
+date/time/money/percentage. Engines run over the SAME tokens the pipeline's
+language-aware tokenizer produced, so tagging composes with LangDetector and
+TextTokenizer exactly as the reference's analyzer chain does.
+
+`tag_tokens` is the single entry point; stage wrappers live in
+stages/feature/text_advanced.py (NameEntityRecognizer, NameEntityTagger).
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+#: reference NameEntityType values implemented here (Misc/Other are model
+#: leftovers with no rule analog; OpenNLP English ships the same seven)
+ENTITY_TYPES = ("person", "location", "organization",
+                "date", "time", "money", "percentage")
+
+# --- person ----------------------------------------------------------------------------
+
+#: honorifics introducing person names (context features, the OpenNLP-model
+#: replacement's strongest rule)
+HONORIFICS = frozenset(
+    "mr mrs ms miss dr prof sir madam lord lady captain president senator".split())
+
+#: compact gazetteer of common given names across locales — the trainable seed
+#: (extend via NameEntityRecognizer(extra_names=[...]))
+GIVEN_NAMES = frozenset("""
+james john robert michael william david richard joseph thomas charles mary
+patricia jennifer linda elizabeth barbara susan jessica sarah karen maria
+anna ana luis carlos jose juan pedro miguel sofia lucia marta paulo joao
+pierre jean marie claire louis michel francois anne laurent sophie hans
+karl heinz peter klaus anna greta fritz giovanni marco luca giulia paolo
+francesca wei li ming hiroshi takashi yuki kenji sakura haruto ji-woo
+min-jun seo-yeon ivan dmitri sergei natasha olga tatiana ahmed mohammed
+fatima omar layla aisha raj priya arjun ananya vikram deepa emma olivia
+noah liam mason lucas ethan amelia harper mia isabella evelyn henry jack
+george oscar arthur alice grace ruby ella leo max felix hugo theo
+""".split())
+
+# --- location --------------------------------------------------------------------------
+
+COUNTRIES = frozenset("""
+afghanistan albania algeria andorra angola argentina armenia australia austria
+azerbaijan bahamas bahrain bangladesh barbados belarus belgium belize benin
+bhutan bolivia botswana brazil brunei bulgaria burundi cambodia cameroon canada
+chad chile china colombia congo croatia cuba cyprus czechia denmark djibouti
+dominica ecuador egypt eritrea estonia eswatini ethiopia fiji finland france
+gabon gambia georgia germany ghana greece greenland grenada guatemala guinea
+guyana haiti honduras hungary iceland india indonesia iran iraq ireland israel
+italy jamaica japan jordan kazakhstan kenya kiribati kosovo kuwait kyrgyzstan
+laos latvia lebanon lesotho liberia libya liechtenstein lithuania luxembourg
+madagascar malawi malaysia maldives mali malta mauritania mauritius mexico
+moldova monaco mongolia montenegro morocco mozambique myanmar namibia nauru
+nepal netherlands nicaragua niger nigeria norway oman pakistan palau panama
+paraguay peru philippines poland portugal qatar romania russia rwanda samoa
+senegal serbia seychelles singapore slovakia slovenia somalia spain sudan
+suriname sweden switzerland syria taiwan tajikistan tanzania thailand togo
+tonga tunisia turkey turkmenistan tuvalu uganda ukraine uruguay uzbekistan
+vanuatu venezuela vietnam yemen zambia zimbabwe
+""".split())
+
+CITIES = frozenset("""
+london paris tokyo berlin madrid rome amsterdam vienna prague dublin lisbon
+athens moscow istanbul beijing shanghai delhi mumbai bangalore karachi dhaka
+jakarta manila bangkok singapore seoul osaka kyoto sydney melbourne auckland
+toronto vancouver montreal chicago boston seattle denver dallas houston
+austin atlanta miami detroit philadelphia phoenix baltimore pittsburgh
+portland cleveland minneapolis cairo lagos nairobi johannesburg capetown
+casablanca dubai riyadh tehran baghdad damascus jerusalem budapest warsaw
+zurich geneva munich hamburg frankfurt cologne barcelona valencia seville
+milan naples turin florence venice marseille lyon bordeaux brussels antwerp
+rotterdam copenhagen stockholm oslo helsinki reykjavik edinburgh glasgow
+manchester liverpool birmingham leeds bristol oxford cambridge southampton
+""".split())
+
+#: geographic feature heads: "<Cap> Island", "Lake <Cap>", ...
+_GEO_HEADS = frozenset(
+    "island islands river lake bay mountain mountains valley beach coast "
+    "peninsula desert falls strait gulf".split())
+#: prepositions whose capitalized object is likely a place
+_LOC_PREPS = frozenset("in at from near to".split())
+
+# --- organization ----------------------------------------------------------------------
+
+#: corporate/institutional suffix tokens (Tika/OpenNLP-era rule NER staple)
+ORG_SUFFIXES = frozenset(
+    "inc inc. corp corp. corporation ltd ltd. llc llp plc gmbh ag sa nv co "
+    "co. company group holdings bank university college institute institution "
+    "agency association society foundation ministry council committee "
+    "laboratories labs partners ventures".split())
+_ORG_MID = frozenset("of the for & and".split())
+
+# --- date / time / money / percentage ---------------------------------------------------
+
+MONTHS = frozenset(
+    "january february march april may june july august september october "
+    "november december jan feb mar apr jun jul aug sep sept oct nov dec".split())
+WEEKDAYS = frozenset(
+    "monday tuesday wednesday thursday friday saturday sunday mon tue wed "
+    "thu fri sat sun".split())
+_DATE_WORDS = frozenset("today tomorrow yesterday".split())
+
+_YEAR_RE = re.compile(r"^(1[89]\d\d|20\d\d)$")
+_ISO_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
+_SLASH_DATE_RE = re.compile(r"^\d{1,2}[/.]\d{1,2}[/.]\d{2,4}$")
+_DAY_ORDINAL_RE = re.compile(r"^\d{1,2}(st|nd|rd|th)$", re.IGNORECASE)
+_DAY_NUM_RE = re.compile(r"^\d{1,2}$")
+
+_CLOCK_RE = re.compile(r"^\d{1,2}:\d{2}(:\d{2})?(am|pm)?$", re.IGNORECASE)
+_AMPM_RE = re.compile(r"^\d{1,2}(am|pm)$", re.IGNORECASE)
+_AMPM_WORD = frozenset(("am", "pm", "a.m.", "p.m.", "a.m", "p.m"))
+_TIME_WORDS = frozenset(("noon", "midnight"))
+
+_CURRENCY_SYMBOLS = "$€£¥₹"
+_AMOUNT_RE = re.compile(r"^\d{1,3}(,\d{3})*(\.\d+)?$|^\d+(\.\d+)?$")
+_SYM_AMOUNT_RE = re.compile(
+    rf"^[{re.escape(_CURRENCY_SYMBOLS)}]\d[\d,]*(\.\d+)?[kmb]?$", re.IGNORECASE)
+_CURRENCY_CODES = frozenset("usd eur gbp jpy cny inr aud cad chf".split())
+_CURRENCY_WORDS = frozenset(
+    "dollar dollars euro euros pound pounds yen yuan rupee rupees cent cents "
+    "franc francs".split())
+
+_PCT_RE = re.compile(r"^\d+(\.\d+)?%$")
+_PCT_WORDS = frozenset(("percent", "percentage", "pct"))
+
+
+def _is_capitalized(t: str) -> bool:
+    return t[:1].isupper() and (len(t) == 1 or not t.isupper())
+
+
+def _is_acronym(t: str) -> bool:
+    return len(t) >= 2 and t.isupper() and t.isalpha()
+
+
+class Tagger:
+    """Prepared tagging engine: validation, gazetteer union and stop-word set
+    are built ONCE here; `tag()` runs per row. Stages construct one Tagger per
+    transform_columns call (the per-row rebuild was pure allocation overhead
+    on large text columns)."""
+
+    def __init__(self, entity_types: Iterable[str] = ENTITY_TYPES,
+                 extra_names: Iterable[str] = (),
+                 stop_words: frozenset = None):
+        self.want = set(entity_types)
+        unknown = self.want - set(ENTITY_TYPES)
+        if unknown:
+            raise ValueError(f"unknown entity types {sorted(unknown)}; "
+                             f"supported: {list(ENTITY_TYPES)}")
+        self.stoppers = stop_words if stop_words is not None else _DEFAULT_STOPPERS
+        self.gazetteer = GIVEN_NAMES | frozenset(
+            str(n).lower() for n in extra_names)
+
+    def tag(self, tokens: list[str]) -> dict[str, set[str]]:
+        """-> {token: {entity tags}} over `tokens` of ONE sentence, case
+        preserved (the OpenNLPNameEntityTagger.tokenTags shape,
+        NameEntityTagger.scala:30-60). Tokens never tagged are absent."""
+        want, stoppers, gazetteer = self.want, self.stoppers, self.gazetteer
+        tags: dict[str, set[str]] = {}
+
+        def tag(tok: str, t: str) -> None:
+            if t in want:
+                tags.setdefault(tok, set()).add(t)
+
+        lows = [t.lower() for t in tokens]
+        n = len(tokens)
+
+        # person pass ALWAYS runs (other rules consult person_hits for
+        # suppression even when 'person' itself is not requested)
+        person_hits: set[str] = set()
+        prev_was_name = False
+        for j, (t, low) in enumerate(zip(tokens, lows)):
+            is_name = False
+            if low.rstrip(".") in HONORIFICS:
+                pass  # honorifics introduce names; they are never entities
+            elif _is_capitalized(t):
+                if low in gazetteer:
+                    is_name = True
+                elif (j > 0 and (lows[j - 1].rstrip(".") in HONORIFICS
+                                 or prev_was_name)):
+                    is_name = low not in stoppers
+                elif j > 0 and low not in stoppers:
+                    is_name = t[1:].islower()  # shape signal, not sentence-initial
+            if is_name:
+                person_hits.add(t)
+                tag(t, "person")
+            prev_was_name = is_name
+
+        for j, (t, low) in enumerate(zip(tokens, lows)):
+            # location: gazetteers, geo heads, prepositional objects
+            if _is_capitalized(t) or _is_acronym(t):
+                if low in COUNTRIES or low in CITIES:
+                    tag(t, "location")
+                elif (j + 1 < n and lows[j + 1] in _GEO_HEADS
+                      and _is_capitalized(t)):
+                    tag(t, "location")
+                elif (j > 0 and lows[j - 1] in _LOC_PREPS and _is_capitalized(t)
+                      and low not in stoppers and t not in person_hits
+                      and t[1:].islower()):
+                    tag(t, "location")
+
+            # organization: suffix rule tags the whole capitalized run; acronyms
+            if low in ORG_SUFFIXES and j > 0:
+                k = j - 1
+                while k >= 0 and (_is_capitalized(tokens[k])
+                                  or _is_acronym(tokens[k])
+                                  or lows[k] in _ORG_MID):
+                    if lows[k] not in _ORG_MID:
+                        tag(tokens[k], "organization")
+                    k -= 1
+                tag(t, "organization")
+            elif _is_acronym(t) and low not in _CURRENCY_CODES.union(_AMPM_WORD):
+                tag(t, "organization")
+
+            # date
+            if (low in MONTHS or low in WEEKDAYS or low in _DATE_WORDS
+                    or _ISO_DATE_RE.match(t) or _SLASH_DATE_RE.match(t)):
+                tag(t, "date")
+            elif _YEAR_RE.match(t) and not (j > 0 and lows[j - 1] in _PCT_WORDS):
+                tag(t, "date")
+            elif _DAY_ORDINAL_RE.match(t) or _DAY_NUM_RE.match(t):
+                near_month = (j > 0 and lows[j - 1] in MONTHS) or \
+                             (j + 1 < n and lows[j + 1] in MONTHS) or \
+                             (j + 2 < n and lows[j + 1] == "of"
+                              and lows[j + 2] in MONTHS)
+                if near_month:
+                    tag(t, "date")
+
+            # time
+            if (_CLOCK_RE.match(t) or _AMPM_RE.match(t) or low in _TIME_WORDS
+                    or (low in _AMPM_WORD and j > 0
+                        and (_DAY_NUM_RE.match(tokens[j - 1])
+                             or _CLOCK_RE.match(tokens[j - 1])))):
+                tag(t, "time")
+                if low in _AMPM_WORD and j > 0:
+                    tag(tokens[j - 1], "time")
+
+            # money
+            if _SYM_AMOUNT_RE.match(t) or (len(t) > 1
+                                           and t[0] in _CURRENCY_SYMBOLS
+                                           and _AMOUNT_RE.match(t[1:])):
+                tag(t, "money")
+            elif t in _CURRENCY_SYMBOLS or low in _CURRENCY_CODES:
+                if j + 1 < n and _AMOUNT_RE.match(tokens[j + 1]):
+                    tag(t, "money")
+                    tag(tokens[j + 1], "money")
+            elif (low in _CURRENCY_WORDS and j > 0
+                  and _AMOUNT_RE.match(tokens[j - 1])):
+                tag(tokens[j - 1], "money")
+                tag(t, "money")
+
+            # percentage
+            if _PCT_RE.match(t):
+                tag(t, "percentage")
+            elif low in _PCT_WORDS and j > 0 and _AMOUNT_RE.match(tokens[j - 1]):
+                tag(tokens[j - 1], "percentage")
+                tag(t, "percentage")
+
+        return tags
+
+
+def tag_tokens(tokens: list[str],
+               entity_types: Iterable[str] = ENTITY_TYPES,
+               extra_names: Iterable[str] = (),
+               stop_words: frozenset = None) -> dict[str, set[str]]:
+    """One-shot form of Tagger (per-row callers should build a Tagger once)."""
+    return Tagger(entity_types, extra_names, stop_words).tag(tokens)
+
+
+#: words that end a person-name chain (articles/preps commonly capitalized in
+#: titles); kept tiny — the full stop-word list over-fires on surnames
+_DEFAULT_STOPPERS = frozenset(
+    "the a an and or but of in on at for with to from by is was are were".split())
