@@ -285,11 +285,39 @@ def _run(partial: dict) -> None:
     # timed steady-state search on the same shapes (fresh graph, cached programs)
     t1 = time.perf_counter()
     wf2, selector2, pred2, _ = _build()
-    wf2.train(table=full)
+    model2 = wf2.train(table=full)
     dt = time.perf_counter() - t1
     summary = selector2.summary_
     models_per_sec = summary.models_evaluated / dt
     partial["titanic_models_per_sec_steady"] = round(models_per_sec, 3)
+
+    # serving (L5): the Spark/MLeap-free local scoring path — single-record
+    # latency and batch throughput through score_fn (same jit kernels as
+    # training; reference OpWorkflowModelLocal has no published numbers).
+    # Best-effort: a serving failure must not discard the primary
+    # quality/parity results computed below.
+    try:
+        raw_names = [f.name for f in model2.raw_features]
+        cols_list = {n: full[n].to_list() for n in raw_names}
+        records = [{n: v[i] for n, v in cols_list.items()}
+                   for i in range(len(full[raw_names[0]]))]
+        serve_fn = model2.score_fn(pad_to=[1, 8, 64, 1024])
+        serve_fn(records[0])  # warm single-row program
+        t_s = time.perf_counter()
+        for r in records[:20]:
+            serve_fn(r)
+        single_ms = (time.perf_counter() - t_s) / 20 * 1000
+        serve_fn.batch(records)  # warm batch program
+        batch_wall = float("inf")
+        for _ in range(3):
+            t_b = time.perf_counter()
+            serve_fn.batch(records)
+            batch_wall = min(batch_wall, time.perf_counter() - t_b)
+        serving = {"single_row_ms": round(single_ms, 2),
+                   "batch_rows_per_sec": round(len(records) / batch_wall)}
+        partial["serving_rows_per_sec"] = serving["batch_rows_per_sec"]
+    except Exception as e:  # noqa: BLE001
+        serving = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # quality parity: the selector's HOLDOUT metrics (reserved split, never seen by
     # search or final refit) against the reference's published holdout table
@@ -314,6 +342,7 @@ def _run(partial: dict) -> None:
                     ("AuROC", "AuPR", "Error", "Precision", "Recall", "F1")
                     if k in holdout},
         "n_holdout": summary.n_holdout,
+        "serving": serving,
         "reference_holdout": REFERENCE_HOLDOUT,
         "vs_baseline_definition": (
             "holdout AuPR / reference holdout AuPR (README.md:85-90) — the only "
@@ -366,6 +395,9 @@ def _run(partial: dict) -> None:
         },
     }
     s = compact["summary"]
+    if "batch_rows_per_sec" in serving:
+        s["serving_rows_per_sec"] = serving["batch_rows_per_sec"]
+        s["serving_single_row_ms"] = serving["single_row_ms"]
     if partial.get("device_note"):
         s["device_note"] = partial["device_note"]
     if "wide" in detail:
