@@ -17,6 +17,16 @@ from ...types import Column, kind_of
 from ..base import Estimator, Transformer
 
 
+def host_params(params):
+    """Fetch a fitted-params pytree to host in ONE device_get: per-leaf
+    np.asarray pays one ~100ms tunnel round trip per field, and make_model
+    runs once per train (the winner's refit). Returns the same structure
+    with numpy leaves."""
+    import jax
+
+    return jax.device_get(params)
+
+
 class PredictorEstimator(Estimator):
     """Base for trainers: inputs (response, features).
 

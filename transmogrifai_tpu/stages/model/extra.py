@@ -18,7 +18,7 @@ from ...ops.linear import LinearParams
 from ...ops.mlp import fit_mlp, predict_mlp
 from ...types import Column, kind_of
 from ..base import Estimator, Transformer, register_stage
-from .base import ClassifierEstimator, PredictionModel, PredictorEstimator
+from .base import ClassifierEstimator, PredictionModel, PredictorEstimator, host_params
 
 
 @register_stage
@@ -45,11 +45,10 @@ class NaiveBayes(ClassifierEstimator):
         return predict_naive_bayes(params, X, model_type=self.params["model_type"])
 
     def make_model(self, params: NaiveBayesParams):
+        p = host_params(params)
         return NaiveBayesModel(
-            log_prior=np.asarray(params.log_prior).tolist(),
-            log_theta=np.asarray(params.log_theta).tolist(),
-            mean=np.asarray(params.mean).tolist(),
-            var=np.asarray(params.var).tolist(),
+            log_prior=p.log_prior.tolist(), log_theta=p.log_theta.tolist(),
+            mean=p.mean.tolist(), var=p.var.tolist(),
             model_type=self.params["model_type"],
         )
 
@@ -91,9 +90,9 @@ class MLPClassifier(ClassifierEstimator):
     predict_fn = staticmethod(predict_mlp)
 
     def make_model(self, params):
+        layers = host_params([(W, b) for W, b in params])
         return MLPClassifierModel(
-            layers=[[np.asarray(W).tolist(), np.asarray(b).tolist()]
-                    for W, b in params])
+            layers=[[W.tolist(), b.tolist()] for W, b in layers])
 
 
 @register_stage
@@ -128,9 +127,9 @@ class GeneralizedLinearRegression(PredictorEstimator):
         return predict_glm(params, X, family=self.params["family"])
 
     def make_model(self, params: LinearParams):
+        p = host_params(params)
         return GeneralizedLinearRegressionModel(
-            w=np.asarray(params.w).tolist(), b=float(params.b),
-            family=self.params["family"])
+            w=p.w.tolist(), b=float(p.b), family=self.params["family"])
 
 
 @register_stage

@@ -24,7 +24,7 @@ from ...ops.linear import (
     predict_svc,
 )
 from ..base import register_stage
-from .base import PredictionModel, PredictorEstimator
+from .base import PredictionModel, PredictorEstimator, host_params
 
 
 def _linear_params(stage_params: dict) -> LinearParams:
@@ -63,8 +63,8 @@ class LogisticRegression(PredictorEstimator):
                                max_iter=gd_iters)
 
     def make_model(self, params):
-        return LogisticRegressionModel(
-            w=np.asarray(params.w).tolist(), b=float(params.b))
+        p = host_params(params)
+        return LogisticRegressionModel(w=p.w.tolist(), b=float(p.b))
 
 
 @register_stage
@@ -100,8 +100,8 @@ class MultinomialLogisticRegression(PredictorEstimator):
         return self.make_model(self.fit_fn(X, y, **kw))
 
     def make_model(self, params):
-        return MultinomialLogisticRegressionModel(
-            w=np.asarray(params.w).tolist(), b=np.asarray(params.b).tolist())
+        p = host_params(params)
+        return MultinomialLogisticRegressionModel(w=p.w.tolist(), b=p.b.tolist())
 
 
 @register_stage
@@ -138,7 +138,8 @@ class LinearRegression(PredictorEstimator):
                              max_iter=gd_iters)
 
     def make_model(self, params):
-        return LinearRegressionModel(w=np.asarray(params.w).tolist(), b=float(params.b))
+        p = host_params(params)
+        return LinearRegressionModel(w=p.w.tolist(), b=float(p.b))
 
 
 @register_stage
@@ -162,7 +163,8 @@ class LinearSVC(PredictorEstimator):
         super().__init__(reg=float(reg), max_iter=int(max_iter))
 
     def make_model(self, params):
-        return LinearSVCModel(w=np.asarray(params.w).tolist(), b=float(params.b))
+        p = host_params(params)
+        return LinearSVCModel(w=p.w.tolist(), b=float(p.b))
 
 
 @register_stage

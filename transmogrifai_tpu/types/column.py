@@ -260,12 +260,18 @@ class Column:
         if st is Storage.VECTOR:
             return [list(map(float, row)) for row in np.asarray(self.values)]
         if st in (Storage.INTEGRAL, Storage.DATE):
+            # host-resident by construction (kinds.py: np.int64 values + mask)
             mask = self.mask if self.mask is not None else np.ones(len(self.values), bool)
             return [int(v) if m else None for v, m in zip(self.values, mask)]
         if not self.kind.on_device:
             return list(self.values)
-        vals = np.asarray(self.values)
-        mask = np.asarray(self.mask) if self.mask is not None else np.ones(len(vals), bool)
+        if self.mask is not None:
+            # one fused fetch (device_get passes host arrays through unchanged)
+            vals, mask = jax.device_get((self.values, self.mask))
+            vals, mask = np.asarray(vals), np.asarray(mask)
+        else:
+            vals = np.asarray(self.values)
+            mask = np.ones(len(vals), bool)
         out: list = []
         for v, m in zip(vals, mask):
             if not m:
