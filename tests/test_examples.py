@@ -50,3 +50,13 @@ def test_events_example_trains():
 
     res = runner.run("train", OpParams())
     assert res.metrics.AuROC > 0.65  # planted signal, not noise
+
+
+def test_serving_example_lifecycle(capsys):
+    """examples/serving.py: author -> unfitted JSON -> train -> fitted save/load
+    -> dict->dict serving, end to end."""
+    import examples.serving as sv
+
+    sv.main()
+    out = capsys.readouterr().out
+    assert "single-record score" in out and "batch of 32 served" in out
