@@ -63,8 +63,7 @@ def rows(n: int = 400, seed: int = 7) -> list[dict]:
 def main() -> None:
     spec = author()                                   # 1. definition as JSON
     (pred,) = graph_from_json(spec)                   # 2. reload + train
-    raws = {r.name: r for r in pred.raw_features()}
-    table = InMemoryReader(rows()).generate_table(list(raws.values()))
+    table = InMemoryReader(rows()).generate_table(pred.raw_features())
     model = Workflow().set_result_features(pred).train(table=table)
 
     with tempfile.TemporaryDirectory() as td:         # 3. fitted round trip

@@ -29,19 +29,24 @@ def _generatable_kinds() -> list[str]:
     return out
 
 
-def test_every_generatable_kind_trains_end_to_end():
+def _all_kinds_table(seed: int, seed_base: int):
+    """(feats, cols table) over every generatable kind — shared by the four
+    sweeps below so the setup cannot drift between them."""
     kinds = _generatable_kinds()
-    assert len(kinds) >= 30, kinds  # the testkit covers the broad kind space
-
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(seed)
     label_col = Column.build("RealNN", [float(v) for v in rng.integers(0, 2, N)])
     feats = {"label": FeatureBuilder("label", "RealNN").as_response()}
     cols = {"label": label_col}
     for i, kind in enumerate(kinds):
         name = f"f_{kind}"
         feats[name] = FeatureBuilder(name, kind).as_predictor()
-        cols[name] = _col(kind, seed=300 + i)
-    table = Table(cols, N)
+        cols[name] = _col(kind, seed=seed_base + i)
+    return kinds, feats, Table(cols, N)
+
+
+def test_every_generatable_kind_trains_end_to_end():
+    kinds, feats, table = _all_kinds_table(seed=11, seed_base=300)
+    assert len(kinds) >= 30, kinds  # the testkit covers the broad kind space
 
     vec = transmogrify([f for n, f in feats.items() if n != "label"])
     checked = SanityChecker(min_variance=1e-9)(feats["label"], vec)
@@ -74,16 +79,7 @@ def test_all_kinds_model_save_load_parity(tmp_path):
     identically (stage serialization across every vectorizer family)."""
     from transmogrifai_tpu.workflow import WorkflowModel
 
-    kinds = _generatable_kinds()
-    rng = np.random.default_rng(12)
-    label_col = Column.build("RealNN", [float(v) for v in rng.integers(0, 2, N)])
-    feats = {"label": FeatureBuilder("label", "RealNN").as_response()}
-    cols = {"label": label_col}
-    for i, kind in enumerate(kinds):
-        name = f"f_{kind}"
-        feats[name] = FeatureBuilder(name, kind).as_predictor()
-        cols[name] = _col(kind, seed=400 + i)
-    table = Table(cols, N)
+    kinds, feats, table = _all_kinds_table(seed=12, seed_base=400)
     vec = transmogrify([f for n, f in feats.items() if n != "label"])
     pred = LogisticRegression(max_iter=6)(feats["label"], vec)
     model = Workflow().set_result_features(pred).train(table=table)
@@ -100,16 +96,7 @@ def test_all_kinds_raw_feature_filter():
     without error (the pre-modeling QA pass over the whole kind space)."""
     from transmogrifai_tpu.filter import RawFeatureFilter
 
-    kinds = _generatable_kinds()
-    rng = np.random.default_rng(13)
-    label_col = Column.build("RealNN", [float(v) for v in rng.integers(0, 2, N)])
-    feats = {"label": FeatureBuilder("label", "RealNN").as_response()}
-    cols = {"label": label_col}
-    for i, kind in enumerate(kinds):
-        name = f"f_{kind}"
-        feats[name] = FeatureBuilder(name, kind).as_predictor()
-        cols[name] = _col(kind, seed=500 + i)
-    table = Table(cols, N)
+    kinds, feats, table = _all_kinds_table(seed=13, seed_base=500)
 
     rff = RawFeatureFilter(min_fill_rate=0.0)
     raw = tuple(feats.values())
@@ -120,3 +107,25 @@ def test_all_kinds_raw_feature_filter():
         if f.is_response:
             continue
         assert f.distributions, f"no distribution recorded for {f.name}"
+
+
+def test_every_generatable_kind_graph_roundtrips_unfitted():
+    """The UNFITTED graph over every kind family survives graph_to_json ->
+    graph_from_json and still trains — one sweep catching unserializable ctor
+    params anywhere in the transmogrify surface."""
+    from transmogrifai_tpu.graph import graph_from_json, graph_to_json
+
+    kinds, feats, table = _all_kinds_table(seed=13, seed_base=500)
+
+    vec = transmogrify([f for n, f in feats.items() if n != "label"])
+    checked = SanityChecker(min_variance=1e-9)(feats["label"], vec)
+    pred = LogisticRegression(max_iter=8)(feats["label"], checked)
+
+    spec = graph_to_json([pred])
+    (loaded,) = graph_from_json(spec)
+    assert {s["class"] for s in spec["stages"]} == {
+        s["class"] for s in graph_to_json([loaded])["stages"]}
+
+    model = Workflow().set_result_features(loaded).train(table=table)
+    prob = np.asarray(model.score(table=table)[loaded.name].prob)
+    assert prob.shape == (N, 2) and np.isfinite(prob).all()
