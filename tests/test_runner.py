@@ -473,18 +473,77 @@ def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
     from transmogrifai_tpu.cli.main import main as op_main
     from transmogrifai_tpu.workflow.warmup import warmup
 
-    rep = warmup(problem="binary", rows=60, width=8, models=None)
+    # a small custom grid keeps CPU CI fast while still exercising the
+    # per-(family, static-group) solo refits: 2 LR groups (max_iter is static)
+    from transmogrifai_tpu.stages.model import LogisticRegression
+
+    models = [(LogisticRegression(max_iter=5),
+               [{"l2": 0.1, "max_iter": 5}, {"l2": 0.1, "max_iter": 6}])]
+    rep = warmup(problem="binary", rows=60, width=8, models=models)
     # widths round through bucket_width: real trains pad to buckets, so the
     # warmed shape must be the padded one
     assert rep["rows"] == 60 and rep["width"] == 8 and rep["wall_s"] > 0
     assert rep["requested_width"] == 8
 
+    # CLI plumbing: flags reach warmup() (the solo-refit loop over default
+    # grids is covered by test_warmup_solo_fits_cover_every_static_group;
+    # re-running every family's real refits on CPU CI would take minutes)
     import contextlib
     import io
 
+    from transmogrifai_tpu.workflow import warmup as warmup_mod
+
+    seen = {}
+
+    def fake_warmup(problem, rows, width, num_classes=3, models=None,
+                    splitter=None, num_folds=3, seed=0):
+        seen.update(problem=problem, rows=rows, width=width,
+                    splitter=type(splitter).__name__ if splitter else None,
+                    num_folds=num_folds)
+        return {"problem": problem, "rows": rows, "width": width,
+                "requested_width": width, "wall_s": 0.01}
+
+    monkeypatch.setattr(warmup_mod, "warmup", fake_warmup)
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         rc = op_main(["warmup", "--problem", "regression", "--rows", "48",
-                      "--widths", "8"])
+                      "--widths", "8", "--num-folds", "2",
+                      "--splitter", "cutter", "--reserve-test-fraction", "0.2"])
     assert rc == 0
     assert '"regression"' in buf.getvalue()
+    assert seen == {"problem": "regression", "rows": 48, "width": 8,
+                    "splitter": "DataCutter", "num_folds": 2}
+
+
+def test_warmup_solo_fits_cover_every_static_group(monkeypatch):
+    """The warmup's solo-refit loop must run one one-point fit per
+    (family, static-grid-group) of the DEFAULT grids — deleting the loop or
+    mis-partitioning the grids must fail here."""
+    from transmogrifai_tpu.select.selector import ModelSelector, default_models
+    from transmogrifai_tpu.select.validator import _group_grid
+    from transmogrifai_tpu.workflow.warmup import warmup
+
+    fitted: list = []
+    orig = ModelSelector.fit_table
+
+    def spy(self, table):
+        fitted.append([(type(t).__name__, list(g)) for t, g in self.models])
+        # the warm effect itself is exercised on TPU by the bench; CI only
+        # checks the loop's enumeration, so skip the real (slow) fits
+        self.summary_ = None
+        return None
+
+    monkeypatch.setattr(ModelSelector, "fit_table", spy)
+    warmup(problem="regression", rows=48, width=8, models=None)
+
+    # first call = the full search; then one solo fit per static group
+    assert len(fitted[0]) == len(default_models("regression"))
+    solo = fitted[1:]
+    expected = []
+    for template, grid in default_models("regression"):
+        for _static, _stacks, points in _group_grid(template, grid):
+            expected.append((type(template).__name__, dict(points[0])))
+    got = [(cfg[0][0], dict(cfg[0][1][0])) for cfg in solo]
+    assert sorted(got, key=str) == sorted(expected, key=str)
+    assert all(len(cfg) == 1 and len(cfg[0][1]) == 1 for cfg in solo), (
+        "solo fits must be single-family, one-point grids")

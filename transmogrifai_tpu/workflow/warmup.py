@@ -81,6 +81,31 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     })
     t0 = time.perf_counter()
     selector.fit_table(table)
+    # the fit above compiles every family's SEARCH programs but only the
+    # synthetic winner's REFIT + metrics programs for ONE static grid group —
+    # and the real data's winner can be any (template, static-group) pair: a
+    # cold RF refit alone traced+compiled for ~2s on the first real Titanic
+    # train. Run a one-point solo fit per (candidate, static group): refit
+    # hyperparams outside vmap_params are compile-time statics, so each group
+    # is a distinct refit/metrics program (validator._group_grid is the same
+    # partition the search itself uses). Each solo fit also compiles a G=1
+    # search program no real train reuses — accepted deliberately: going
+    # through the REAL fit path guarantees the warmed refit/metrics programs
+    # are byte-identical to what a real train builds (hand-calling fit_fn +
+    # _metrics_program here would have to mirror the selector's weight/label
+    # plumbing and silently drift).
+    from ..select.selector import ModelSelector
+    from ..select.validator import _group_grid
+
+    for template, grid in selector.models:
+        for _static, _stacks, points in _group_grid(template, grid):
+            solo = ModelSelector(problem_type=problem, metric=selector.metric,
+                                 models=[(template, [dict(points[0])])],
+                                 validator=selector.validator,
+                                 splitter=selector.splitter, seed=seed)
+            solo(FeatureBuilder("label", "RealNN").as_response(),
+                 FeatureBuilder("vec", "OPVector").as_predictor())
+            solo.fit_table(table)
     return {"problem": problem, "rows": int(rows), "width": int(width),
             "requested_width": requested,
             "wall_s": round(time.perf_counter() - t0, 2)}
