@@ -524,7 +524,6 @@ def test_warmup_solo_fits_cover_every_static_group(monkeypatch):
     from transmogrifai_tpu.workflow.warmup import warmup
 
     fitted: list = []
-    orig = ModelSelector.fit_table
 
     def spy(self, table):
         fitted.append([(type(t).__name__, list(g)) for t, g in self.models])

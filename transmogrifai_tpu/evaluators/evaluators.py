@@ -29,8 +29,12 @@ def _valid_labels(label):
     """-> (float label values [N], validity mask [N]). Masked / NaN labels are
     excluded explicitly by every evaluator — never an undefined NaN->int cast
     (the reference filters null labels upstream via makeDataToUse)."""
-    vals = np.asarray(label.values, np.float64)
-    ok = np.asarray(label.effective_mask(), bool) & ~np.isnan(vals)
+    import jax
+
+    # one fused fetch: two serial np.asarray calls = two tunnel round trips
+    vals, mask = jax.device_get((label.values, label.effective_mask()))
+    vals = np.asarray(vals, np.float64)
+    ok = np.asarray(mask, bool) & ~np.isnan(vals)
     return vals, ok
 
 

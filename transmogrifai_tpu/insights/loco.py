@@ -102,8 +102,8 @@ class RecordInsightsLOCO(Transformer):
                if vec.schema is not None else np.zeros(X.shape[1], bool))
         ranked = jnp.where(jnp.asarray(pad)[None, :], -1.0, jnp.abs(deltas))
         top_vals, top_idx = jax.lax.top_k(ranked, k)
-        top_idx = np.asarray(top_idx)
-        deltas_np = np.asarray(deltas)
+        # one fused fetch (two serial np.asarray calls = two tunnel round trips)
+        top_idx, deltas_np = jax.device_get((top_idx, deltas))
         names = (
             vec.schema.column_names()
             if vec.schema is not None
