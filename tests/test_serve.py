@@ -66,6 +66,43 @@ class TestScoreFunction:
         model, _, _ = fitted
         assert model.score_fn().batch([]) == []
 
+    def test_cpu_backend_parity(self, fitted):
+        """backend="cpu" pins the LocalPlan to host CPU-JAX in-process (the
+        reference's local-JVM deployment mode) and must match device scoring."""
+        model, pred, rows = fitted
+        fn = model.score_fn(pad_to=[1, 8], backend="cpu")
+        serving = [{k: v for k, v in r.items() if k != "label"} for r in rows[:8]]
+        singles = [fn(r) for r in serving]
+        t = Table.from_rows(rows[:8], KINDS)
+        expected = model.score(table=t)[pred.name].to_list()
+        for got, exp in zip(singles, expected):
+            assert got[pred.name]["prediction"] == exp["prediction"]
+            np.testing.assert_allclose(got[pred.name]["probability"],
+                                       exp["probability"], rtol=1e-5)
+
+    def test_columnar_table_parity_and_fetch(self, fitted):
+        """.table() scores columnar without labels; .fetch() returns the same
+        numbers as to_list in one device_get."""
+        model, pred, rows = fitted
+        fn = model.score_fn()
+        nolabel = {k: v for k, v in KINDS.items() if k != "label"}
+        t = Table.from_rows(
+            [{k: v for k, v in r.items() if k != "label"} for r in rows[:16]],
+            nolabel)
+        out = fn.table(t)
+        got = out[pred.name].to_list()
+        expected = model.score(
+            table=Table.from_rows(rows[:16], KINDS))[pred.name].to_list()
+        for a, b in zip(got, expected):
+            assert a["prediction"] == b["prediction"]
+            np.testing.assert_allclose(a["probability"], b["probability"],
+                                       rtol=1e-5)
+        arrs = out[pred.name].fetch()
+        np.testing.assert_allclose(
+            arrs["prediction"], [g["prediction"] for g in got], rtol=1e-6)
+        np.testing.assert_allclose(
+            arrs["probability"], [g["probability"] for g in got], rtol=1e-6)
+
 
 def test_serve_language_aware_tokenization_parity():
     """A pipeline with auto-detected per-language tokenization scores the same

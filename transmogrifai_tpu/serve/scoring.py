@@ -4,12 +4,16 @@ TPU-native analog of OpWorkflowModelLocal.scoreFunction (reference local/src/mai
 com/salesforce/op/local/OpWorkflowModelLocal.scala:54-154, runner
 OpWorkflowRunnerLocal.scala:42). The reference needs a whole MLeap conversion layer
 because its training stages are Spark-bound; here the SAME stage kernels serve — the
-fitted workflow's transform plan is applied to a 1-row (or N-row) Table built from the
-input dict, with the device portions jit-compiled and cached across calls.
+fitted workflow's transform plan is re-grouped into a latency-optimized LocalPlan
+(serve/local.py) with the device portions jit-compiled and cached across calls.
 
-Batching semantics: `score_fn(row_dict)` scores one record (µs-scale after warmup on
-CPU-JAX; the reference quotes ~µs/row for its local scoring), `score_fn.batch(rows)`
-scores a list of records in one fused device pass — the TPU-friendly path.
+Three serving shapes:
+- `score_fn(row_dict)` — one record. With `backend="cpu"` the plan is pinned to
+  host CPU-JAX in-process (no device round trip): sub-ms after warmup, the
+  analog of the reference's local JVM scoring.
+- `score_fn.batch(rows)` — a list of records in one fused pass.
+- `score_fn.table(table)` — columnar in, columnar out: the high-throughput
+  device path (no per-row dict churn; one fused result fetch via `to_list`).
 """
 from __future__ import annotations
 
@@ -22,10 +26,15 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 class ScoreFunction:
-    """Callable serving handle for a fitted WorkflowModel."""
+    """Callable serving handle for a fitted WorkflowModel.
+
+    backend: None = the process default (TPU when present); "cpu" = pin every
+    jit + intermediate to host CPU-JAX in this process (`jax.default_device`),
+    the low-latency single-record deployment mode.
+    """
 
     def __init__(self, model: "WorkflowModel", result_names: Optional[Sequence[str]] = None,
-                 pad_to: Optional[Sequence[int]] = None):
+                 pad_to: Optional[Sequence[int]] = None, backend: Optional[str] = None):
         self._model = model
         self._result_names = list(result_names) if result_names else [
             f.name for f in model.result_features
@@ -35,6 +44,21 @@ class ScoreFunction:
         #: pad batches up to these sizes to bound XLA recompilation (one compiled
         #: program per bucket, analog of serving-side shape bucketing)
         self._pad_to = sorted(pad_to) if pad_to else None
+        self._backend = backend
+        self._plan = None
+
+    def _local_plan(self):
+        if self._plan is None:
+            from .local import LocalPlan
+
+            device = None
+            if self._backend is not None:
+                import jax
+
+                device = jax.devices(self._backend)[0]
+            self._plan = LocalPlan(self._model.stages, self._result_names,
+                                   device=device)
+        return self._plan
 
     # --- single record ------------------------------------------------------------------
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
@@ -46,14 +70,28 @@ class ScoreFunction:
         if n == 0:
             return []
         padded = self._pad(records)
-        table = self._build_table(padded)
-        out = self._model.transform(table, keep_intermediate=True)
+        out = self._local_plan().run(self._build_table(padded))
         results: list[dict[str, Any]] = [{} for _ in range(n)]
         for name in self._result_names:
-            col = out[name]
-            for i, v in enumerate(col.to_list()[:n]):
+            for i, v in enumerate(out[name].to_list()[:n]):
                 results[i][name] = v
         return results
+
+    # --- columnar -----------------------------------------------------------------------
+    def table(self, table: Table) -> Table:
+        """Columnar scoring: a Table holding the raw predictor columns (responses
+        optional — serving is unlabeled) -> a Table of the result columns. The
+        throughput path: no per-row dict building, results fetched lazily (call
+        `.to_list()` on a result column for one fused device_get)."""
+        cols = {f.name: table[f.name] for f in self._predictors}
+        n = table.nrows
+        for f in self._responses:
+            if f.name in table.columns:
+                cols[f.name] = table[f.name]
+            else:
+                cols[f.name] = Column.build(f.kind, [_placeholder(f.kind)] * n, device=False)
+        out = self._local_plan().run(cols)
+        return Table({n_: out[n_] for n_ in self._result_names})
 
     def _pad(self, records: Sequence[Mapping[str, Any]]):
         if not self._pad_to or len(records) >= self._pad_to[-1]:
@@ -71,12 +109,12 @@ class ScoreFunction:
                 raise KeyError(
                     f"serving record missing predictor {f.name!r}"
                 ) from e
-            cols[f.name] = Column.build(f.kind, vals)
+            cols[f.name] = Column.build(f.kind, vals, device=False)
         for f in self._responses:  # placeholder labels (serving is unlabeled)
             default = _placeholder(f.kind)
             vals = [r.get(f.name, default) for r in records]
             vals = [default if v is None else v for v in vals]
-            cols[f.name] = Column.build(f.kind, vals)
+            cols[f.name] = Column.build(f.kind, vals, device=False)
         return Table(cols)
 
 
@@ -99,6 +137,8 @@ def _placeholder(kind) -> Any:
 
 
 def score_function(model: "WorkflowModel", result_names: Optional[Sequence[str]] = None,
-                  pad_to: Optional[Sequence[int]] = None) -> ScoreFunction:
+                  pad_to: Optional[Sequence[int]] = None,
+                  backend: Optional[str] = None) -> ScoreFunction:
     """Build the serving callable (analog of `model.scoreFunction`)."""
-    return ScoreFunction(model, result_names=result_names, pad_to=pad_to)
+    return ScoreFunction(model, result_names=result_names, pad_to=pad_to,
+                         backend=backend)

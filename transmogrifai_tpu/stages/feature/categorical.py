@@ -15,7 +15,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ...types import Column, SlotInfo, VectorSchema
+from ...types import Column, SlotInfo, VectorSchema, kind_of
 from ..base import register_stage
 from .common import (
     SequenceVectorizer,
@@ -23,6 +23,7 @@ from .common import (
     clean_token,
     null_slot,
     other_slot,
+    pivot_fill,
 )
 
 _CATEGORICAL_TEXT = (
@@ -84,36 +85,46 @@ class OneHotVectorizerModel(SequenceVectorizer):
     operation_name = "pivot"
     device_op = False  # consumes host strings
 
-    def transform_columns(self, cols: Sequence[Column]) -> Column:
+    def make_serving_kernel(self):
+        """Pure-numpy per-call kernel with index dicts + output schema built
+        ONCE (serve/local.py uses this for sub-ms single-record scoring; the
+        training transform reuses it so the schema churn is also paid once
+        per fitted stage, not once per table)."""
         p = self.params
-        mats, slots = [], []
-        for c, cats, name, kind in zip(cols, p["categories"], p["names"], p["kinds"]):
+        track, clean = p["track_nulls"], p["clean_text"]
+        metas, slots = [], []
+        for cats, name, kind in zip(p["categories"], p["names"], p["kinds"]):
             index = {v: i for i, v in enumerate(cats)}
             k = len(cats)
-            width = k + 1 + (1 if p["track_nulls"] else 0)  # values + OTHER (+ null)
-            mat = np.zeros((len(c), width), dtype=np.float32)
-            if c.kind.name == "Binary":
-                vals = np.asarray(c.values)
-                mask = np.asarray(c.effective_mask())
-                mat[:, 0] = vals & mask
-                mat[:, 1] = (~vals) & mask
-                if p["track_nulls"]:
-                    mat[:, k + 1] = ~mask
-            else:
-                for i, v in enumerate(c.values):
-                    if v is None:
-                        if p["track_nulls"]:
-                            mat[i, k + 1] = 1.0
-                        continue
-                    j = index.get(clean_token(str(v), p["clean_text"]))
-                    mat[i, j if j is not None else k] = 1.0
-            mats.append(mat)
+            metas.append((index, k, k + 1 + (1 if track else 0)))
             slots.extend(SlotInfo(name, kind, indicator_value=v) for v in cats)
             slots.append(other_slot(name, kind))
-            if p["track_nulls"]:
+            if track:
                 slots.append(null_slot(name, kind))
-        vec = jnp.asarray(np.concatenate(mats, axis=1))
-        return Column.vector(vec, VectorSchema(tuple(slots)))
+        schema = VectorSchema(tuple(slots))
+
+        memos = [{} for _ in metas]
+
+        def kernel(cols: Sequence[Column]) -> Column:
+            mats = []
+            for c, (index, k, width), memo in zip(cols, metas, memos):
+                # uint8 indicators: 4x less host->device transfer than f32 (the
+                # serving plan uploads these raw; the device program casts)
+                mat = np.zeros((len(c), width), dtype=np.uint8)
+                if c.kind.name == "Binary":
+                    vals = np.asarray(c.values)
+                    mask = np.asarray(c.effective_mask())
+                    mat[:, 0] = vals & mask
+                    mat[:, 1] = (~vals) & mask
+                    if track:
+                        mat[:, k + 1] = ~mask
+                else:
+                    pivot_fill(mat, c.values, index, k, clean, track, memo)
+                mats.append(mat)
+            vec = mats[0] if len(mats) == 1 else np.concatenate(mats, axis=1)
+            return Column(kind_of("OPVector"), vec, None, schema=schema)
+
+        return kernel
 
 
 @register_stage

@@ -46,6 +46,38 @@ class SequenceVectorizer(Transformer):
                 f"{type(self).__name__} accepts {self.accepts}, got {bad}"
             )
 
+    # --- serving-kernel protocol ------------------------------------------------------
+    def make_serving_kernel(self):
+        """Optional fast path: return a pure-numpy `fn(cols) -> Column` with all
+        per-model constants (index dicts, output schema) precomputed — the
+        serving plan (serve/local.py) calls it per record with no eager jnp
+        dispatches. None = the family has no host fast path."""
+        return None
+
+    def serving_kernel(self):
+        """Instance-memoized make_serving_kernel (shared by training transform
+        and the serving plan, so index dicts/schemas are built once per fitted
+        stage)."""
+        kernel = self.__dict__.get("_serving_kernel")
+        if kernel is None and "_serving_kernel" not in self.__dict__:
+            kernel = self.__dict__["_serving_kernel"] = self.make_serving_kernel()
+        return kernel
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        """Default for kernel-backed host vectorizers: run the serving kernel,
+        then promote values to the device (training tables are scored in bulk).
+        Families without a kernel override transform_columns directly."""
+        kernel = self.serving_kernel()
+        if kernel is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} defines neither transform_columns nor "
+                "make_serving_kernel")
+        out = kernel(cols)
+        # kernels may emit compact integer dtypes (uint8 one-hot / uint16 hash
+        # counts) to shrink host->device transfer; vectors are f32 on device
+        return Column(out.kind, jnp.asarray(out.values, jnp.float32), None,
+                      schema=out.schema)
+
 
 class SequenceVectorizerEstimator(Estimator):
     """N inputs -> fitted model producing one OPVector."""
@@ -87,3 +119,28 @@ def clean_token(s: str, clean: bool = True) -> str:
     if not clean:
         return s
     return "".join(ch for ch in s.strip() if ch.isalnum() or ch == " ")
+
+
+#: bound on the per-kernel raw-value -> slot memo (guards adversarial streams
+#: of unique values from growing the dict without limit)
+PIVOT_MEMO_MAX = 4096
+
+
+def pivot_fill(mat: np.ndarray, values, index: dict, k: int, clean: bool,
+               track_nulls: bool, memo: dict) -> None:
+    """Fill a one-hot matrix row-by-row for a pivot (top-K categories + OTHER
+    [+ null]) plan. Shared by OneHotVectorizerModel and SmartTextVectorizer's
+    pivot mode. `memo` caches raw value -> column so the steady state is one
+    dict hit per row instead of clean_token string churn."""
+    for i, v in enumerate(values):
+        if v is None:
+            if track_nulls:
+                mat[i, k + 1] = 1.0
+            continue
+        j = memo.get(v)
+        if j is None:
+            j = index.get(clean_token(str(v), clean))
+            j = j if j is not None else k
+            if len(memo) < PIVOT_MEMO_MAX:
+                memo[v] = j
+        mat[i, j] = 1.0

@@ -125,18 +125,32 @@ class IntegralVectorizerModel(SequenceVectorizer):
     # integral columns are host int64; conversion to float32 happens here, then device
     device_op = False
 
-    def transform_columns(self, cols: Sequence[Column]) -> Column:
+    def make_serving_kernel(self):
+        """Pure-numpy kernel + schema built once (serving fast path; the int64
+        -> f64 -> f32 demotion stays on HOST deliberately — int64 leaves would
+        truncate to int32 at a jit boundary under disabled x64)."""
         p = self.params
-        parts, slots = [], []
-        for c, fill, name, kind in zip(cols, p["fills"], p["names"], p["kinds"]):
-            mask = np.asarray(c.effective_mask())
-            vals = np.where(mask, np.asarray(c.values, np.float64), float(fill))
-            parts.append(jnp.asarray(vals, jnp.float32))
+        track = p["track_nulls"]
+        fills = [float(f) for f in p["fills"]]
+        slots = []
+        for name, kind in zip(p["names"], p["kinds"]):
             slots.append(value_slot(name, kind))
-            if p["track_nulls"]:
-                parts.append(jnp.asarray(~mask, jnp.float32))
+            if track:
                 slots.append(null_slot(name, kind))
-        return stack_vector(parts, slots)
+        schema = VectorSchema(tuple(slots))
+
+        def kernel(cols: Sequence[Column]) -> Column:
+            parts = []
+            for c, fill in zip(cols, fills):
+                mask = np.asarray(c.effective_mask())
+                vals = np.where(mask, np.asarray(c.values, np.float64), fill)
+                parts.append(vals.astype(np.float32))
+                if track:
+                    parts.append((~mask).astype(np.float32))
+            return Column(kind_of("OPVector"), np.stack(parts, axis=1), None,
+                          schema=schema)
+
+        return kernel
 
 
 @register_stage

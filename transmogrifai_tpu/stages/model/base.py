@@ -139,10 +139,26 @@ class PredictionModel(Transformer):
     def device_params(self, convert):
         """`convert(self.params)` memoized per model instance: predict() runs
         OUTSIDE the fused jit (kernel_jitted), so without caching every scoring
-        call would re-pay list->device-array conversion of the fitted weights."""
-        cached = self.__dict__.get("_device_params_cache")
+        call would re-pay list->device-array conversion of the fitted weights.
+        Keyed by the active default device (serve/local.py pins scoring to host
+        CPU-JAX via jax.default_device): one model instance may serve on CPU
+        while the training path keeps its accelerator-resident copy."""
+        import jax
+
+        dd = jax.config.jax_default_device
+        key = getattr(dd, "platform", None) or "default"
+        cache = self.__dict__.setdefault("_device_params_cache", {})
+        cached = cache.get(key)
         if cached is None:
-            cached = self.__dict__["_device_params_cache"] = convert(self.params)
+            cached = convert(self.params)
+            # only memoize concrete arrays: when the first conversion happens
+            # INSIDE a jit trace (serve/local.py fuses fitted models into the
+            # serving program), the result leaves are trace-local constants —
+            # caching them would leak dead tracers into the next trace/eager
+            # call (UnexpectedTracerError on any second batch shape)
+            if not any(isinstance(x, jax.core.Tracer)
+                       for x in jax.tree_util.tree_leaves(cached)):
+                cache[key] = cached
         return cached
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:

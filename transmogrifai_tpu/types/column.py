@@ -93,9 +93,13 @@ class Column:
 
     # --- construction ----------------------------------------------------------------
     @staticmethod
-    def build(kind: FeatureKind | str, data: Sequence[Any]) -> "Column":
+    def build(kind: FeatureKind | str, data: Sequence[Any],
+              device: bool = True) -> "Column":
         """Build a Column from a python sequence with None = missing
-        (the FeatureTypeFactory analog, reference FeatureTypeFactory.scala)."""
+        (the FeatureTypeFactory analog, reference FeatureTypeFactory.scala).
+        device=False keeps numeric storage in host numpy — the serving path
+        defers the transfer to its jit boundary so a single-record score pays
+        zero eager device_puts."""
         if isinstance(kind, str):
             kind = kind_of(kind)
         st = kind.storage
@@ -117,6 +121,8 @@ class Column:
                 )
             if st in (Storage.INTEGRAL, Storage.DATE):
                 return Column(kind, vals, mask)  # host-exact int64
+            if not device:
+                return Column(kind, vals, mask)
             return Column(kind, jnp.asarray(vals), jnp.asarray(mask))
         if st is Storage.GEOLOCATION:
             mask = np.array([d is not None for d in data], dtype=bool)
@@ -124,6 +130,8 @@ class Column:
             for i, d in enumerate(data):
                 if d is not None:
                     vals[i, :] = np.asarray(d, dtype=np.float32)
+            if not device:
+                return Column(kind, vals, mask)
             return Column(kind, jnp.asarray(vals), jnp.asarray(mask))
         if st is Storage.VECTOR:
             return Column.vector(np.asarray(data, dtype=np.float32))
@@ -239,6 +247,18 @@ class Column:
         if vals.ndim == 2:
             mask = mask[:, None]
         return jnp.where(mask, vals, jnp.float32(default))
+
+    def fetch(self):
+        """Columnar host fetch in ONE device_get: numpy values (+mask), or for
+        Prediction columns a dict of numpy arrays {prediction, rawPrediction,
+        probability}. The throughput-serving counterpart of `to_list` — no
+        per-row python object building."""
+        if self.kind.storage is Storage.PREDICTION:
+            return dict(zip((PREDICTION_KEY, RAW_PREDICTION_KEY, PROBABILITY_KEY),
+                            jax.device_get((self.pred, self.raw_pred, self.prob))))
+        if self.mask is not None:
+            return jax.device_get((self.values, self.mask))
+        return jax.device_get(self.values)
 
     def to_list(self) -> list:
         """Back to python values with None = missing (test/serving round-trip)."""

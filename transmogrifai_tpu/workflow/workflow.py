@@ -568,12 +568,16 @@ class WorkflowModel(WorkflowCore):
 
     # --- serving (analog of OpWorkflowModelLocal.scoreFunction) -----------------------
     def score_fn(self, result_names: Optional[Sequence[str]] = None,
-                 pad_to: Optional[Sequence[int]] = None):
+                 pad_to: Optional[Sequence[int]] = None,
+                 backend: Optional[str] = None):
         """Spark-free serving callable: dict -> dict for one record, .batch(rows) for
-        many; same stage kernels as training, jit-cached (no MLeap-style conversion)."""
+        many, .table(table) columnar; same stage kernels as training, jit-cached
+        (no MLeap-style conversion). backend="cpu" pins the plan to host CPU-JAX
+        in-process — the reference's local-JVM deployment mode (sub-ms/record)."""
         from ..serve.scoring import score_function
 
-        return score_function(self, result_names=result_names, pad_to=pad_to)
+        return score_function(self, result_names=result_names, pad_to=pad_to,
+                              backend=backend)
 
     # --- insights (analog of OpWorkflowModel.modelInsights / summaryPretty) -----------
     def model_insights(self, feature: Optional[Feature] = None):
