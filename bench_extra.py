@@ -4,7 +4,7 @@ Each function returns a JSON-able dict; bench.py merges them into its `detail`:
   - run_iris():   multiclass AutoML search (config 2, OpIris analog) — holdout quality
   - run_boston(): regression AutoML search (config 3, OpBoston analog) — holdout quality
   - run_hist():   pallas MXU histogram kernel vs the portable segment-sum lowering at
-                  a tree-growth-shaped size (the perf evidence for ops/pallas_hist.py)
+                  a tree-growth-shaped size (the perf evidence for ops/pallas_trees.py)
   - run_mlp():    deep-tabular minibatch-SGD MLP throughput + MFU (config 5 regime)
 
 Run standalone: python bench_extra.py [iris|boston|hist|mlp ...]
@@ -134,16 +134,20 @@ def run_boston() -> dict:
 def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
              n_nodes: int = 8, iters: int = 20) -> dict:
     """Tree-growth histogram shoot-out at one level of an 8-leaf tree over 128k
-    rows x 64 features x 64 bins: the production bin-wise-matmul path
-    (histogram_binmm, the TPU default) vs the segment-sum scatter lowering (which
-    OOMs outright at 512k rows — 16.5G HBM program) vs the hand-written pallas
-    one-hot kernel (retained as a comparison baseline; binmm measures 3-13x
-    faster than it)."""
+    rows x 64 features x 64 bins: the small-shape bin-wise-matmul path
+    (histogram_binmm) vs the at-scale pallas bin-loop MXU kernel
+    (pallas_trees.histogram_mxu, the TPU default for large unbatched fits) vs
+    the segment-sum scatter lowering (which OOMs outright at 512k rows — 16.5G
+    HBM program). The r2 showcase one-hot pallas kernel was DELETED in r5
+    after measuring 4x slower than binmm (BENCH_r04 hist_kernel)."""
     import jax
     import jax.numpy as jnp
 
-    from transmogrifai_tpu.ops.pallas_hist import histogram_pallas, use_pallas_histogram
-    from transmogrifai_tpu.ops.trees import histogram_binmm, histogram_segment_sum
+    from transmogrifai_tpu.ops.trees import (
+        backend_is_tpu,
+        histogram_binmm,
+        histogram_segment_sum,
+    )
 
     key = jax.random.PRNGKey(0)
     k1, k2, k3 = jax.random.split(key, 3)
@@ -170,24 +174,19 @@ def run_hist(n_rows: int = 1 << 17, n_feat: int = 64, n_bins: int = 64,
         "binmm_ms": round(bin_t * 1e3, 3),
         "binmm_speedup_vs_segsum": round(seg_t / bin_t, 2),
         "binmm_max_abs_diff": float(np.max(np.abs(seg_out - bin_out))),
-        "pallas_available": bool(use_pallas_histogram()),
     }
-    if use_pallas_histogram():
+    if backend_is_tpu():
         # the at-scale default (_histogram mode "mxu"): bf16 operands, f32 accum
         from transmogrifai_tpu.ops.pallas_trees import histogram_mxu
 
         mxu_fn = jax.jit(histogram_mxu, static_argnums=(3, 4))
         mxu_t, mxu_out = timed(mxu_fn)
-        result["mxu_ms"] = round(mxu_t * 1e3, 3)
-        result["mxu_speedup_vs_segsum"] = round(seg_t / mxu_t, 2)
-        result["mxu_max_rel_diff"] = float(
+        result["pallas_mxu_ms"] = round(mxu_t * 1e3, 3)
+        result["pallas_mxu_speedup_vs_segsum"] = round(seg_t / mxu_t, 2)
+        result["pallas_mxu_vs_binmm"] = round(bin_t / mxu_t, 2)
+        result["pallas_mxu_max_rel_diff"] = float(
             np.max(np.abs(mxu_out - seg_out)) /
             (np.max(np.abs(seg_out)) + 1e-9))
-        pal_fn = jax.jit(histogram_pallas, static_argnums=(3, 4))
-        pal_t, pal_out = timed(pal_fn)
-        result["pallas_ms"] = round(pal_t * 1e3, 3)
-        result["pallas_speedup"] = round(seg_t / pal_t, 2)
-        result["max_abs_diff"] = float(np.max(np.abs(seg_out - pal_out)))
     return result
 
 

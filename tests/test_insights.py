@@ -66,6 +66,38 @@ class TestModelInsights:
         text = model.summary_pretty(pred)
         assert "Selected model" in text and "label" in text
 
+    def test_tree_winner_reports_contributions(self):
+        """A tree-family winner must yield a non-empty Top-feature-contributions
+        table (split-gain importances — reference ModelInsights.scala:72-391
+        reports featureImportances for every Spark tree model)."""
+        from transmogrifai_tpu.stages.model import RandomForestClassifier
+
+        fs = features_from_schema(
+            {"label": "RealNN", "a": "Real", "b": "Real", "cat": "PickList"},
+            response="label")
+        vec = transmogrify([fs["a"], fs["b"], fs["cat"]])
+        checked = SanityChecker(min_variance=1e-9)(fs["label"], vec)
+        grid = ParamGridBuilder().add("min_child_weight", [1.0, 5.0]).build()
+        est = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(RandomForestClassifier(n_trees=10, max_depth=3), grid)])
+        pred = est(fs["label"], checked)
+        rng = np.random.default_rng(3)
+        rows = [{"label": float(i % 2), "a": float(i % 2) * 2 + rng.normal(),
+                 "b": float(rng.normal()), "cat": "uv"[i % 2]} for i in range(80)]
+        wf = Workflow().set_reader(InMemoryReader(rows)).set_result_features(pred)
+        model = wf.train()
+        rep = model.model_insights(pred)
+        assert rep.selected_model["best_model_name"] == "RandomForestClassifier"
+        contribs = [f.max_contribution for f in rep.features
+                    if f.max_contribution is not None]
+        assert contribs, "tree winner produced no feature contributions"
+        assert max(contribs) > 0
+        # the informative feature 'a' should dominate the noise feature 'b'
+        a = next(f for f in rep.features if f.feature_name == "a")
+        b = next(f for f in rep.features if f.feature_name == "b")
+        assert a.max_contribution > b.max_contribution
+        assert "Top feature contributions" in model.summary_pretty(pred)
+
 
 def test_slot_history_chain_threads_through_pipeline():
     """Multi-hop provenance (OpVectorColumnHistory analog): each slot's history

@@ -70,7 +70,7 @@ def test_grow_tree_single_split_recovers_threshold():
     Xb = bin_features(X, edges)
     g = -jnp.asarray(y)[:, None]
     h = jnp.ones((500, 1), jnp.float32)
-    sf, st, leaves, leaf_of_row = grow_tree(
+    sf, st, leaves, leaf_of_row, _fg = grow_tree(
         Xb, edges, g, h, max_depth=1, reg_lambda=0.0, min_child_weight=1.0, min_gain=0.0
     )
     assert sf.shape == (1,) and st.shape == (1,) and leaves.shape == (2, 1)
@@ -87,7 +87,7 @@ def test_grow_tree_respects_min_child_weight():
     y = (X[:, 0] > 0.5).astype(np.float32)
     g = -jnp.asarray(y)[:, None]
     h = jnp.ones((20, 1), jnp.float32)
-    _, st, _, _ = grow_tree(Xb, edges, g, h, 1, 0.0, 50.0, 0.0)
+    _, st, _, _, _ = grow_tree(Xb, edges, g, h, 1, 0.0, 50.0, 0.0)
     assert np.isinf(np.asarray(st)[0])
 
 

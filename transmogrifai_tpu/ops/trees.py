@@ -54,6 +54,10 @@ class TreeEnsembleParams(NamedTuple):
     split_threshold: jnp.ndarray
     leaf_values: jnp.ndarray
     base: jnp.ndarray
+    #: [D] total split gain per feature summed over all trees (the XGBoost
+    #: "total_gain" importance; reference ModelInsights.scala:72-391 reports
+    #: featureImportances for every Spark tree model). None on pre-r5 params.
+    feature_gain: Optional[jnp.ndarray] = None
 
 
 #: above this many rows, quantile edges come from a strided row sketch — the
@@ -130,7 +134,7 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     one-hot: per bin b, one [nodes*C, N] @ [N, D] matmul whose mask operand is
     an elementwise compare XLA fuses into the matmul read. Non-TPU backends
     default to the segment-sum (CPU scatter-add beats CPU dense matmuls; binmm
-    parity has its own test). TT_HIST=binmm|mxu|pallas|segsum forces a
+    parity has its own test). TT_HIST=binmm|mxu|segsum forces a
     specific path. All paths are collectives-safe: partial histograms psum
     across a row-sharded mesh axis (the RDD treeAggregate replacement, SURVEY
     §2.12).
@@ -154,15 +158,14 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
         from .pallas_trees import histogram_mxu
 
         return histogram_mxu(vals, Xb, node, n_nodes, n_bins)
-    if mode == "pallas":
-        from .pallas_hist import histogram_pallas
-
-        return histogram_pallas(vals, Xb, node, n_nodes, n_bins)
     if mode == "segsum":
         return histogram_segment_sum(vals, Xb, node, n_nodes, n_bins)
     if mode != "binmm":
+        # the r2 showcase "pallas" one-hot kernel was deleted in r5: it
+        # measured 4x SLOWER than binmm (BENCH_r04 hist_kernel); the winning
+        # pallas path is "mxu" (pallas_trees.histogram_mxu)
         raise ValueError(
-            f"TT_HIST={mode!r}: expected binmm | mxu | pallas | segsum")
+            f"TT_HIST={mode!r}: expected binmm | mxu | segsum")
     return histogram_binmm(vals, Xb, node, n_nodes, n_bins)
 
 
@@ -250,6 +253,7 @@ def grow_tree(
     fmask = jnp.ones(D, bool) if feature_mask is None else feature_mask
     node = jnp.zeros(N, jnp.int32)  # level-local node id
     feats, threshs = [], []
+    feat_gain = jnp.zeros(D, jnp.float32)  # split-gain importance accumulator
 
     C = g.shape[1]
     gh = jnp.concatenate([g, h], axis=1)  # one fused histogram pass for both
@@ -288,6 +292,9 @@ def grow_tree(
         )
         feats.append(best_d)
         threshs.append(thresh.astype(jnp.float32))
+        # importance: realized gain of every executed split, scattered onto its
+        # feature (n_nodes-sized scatter — tiny next to the histogram work)
+        feat_gain = feat_gain.at[best_d].add(jnp.where(do_split, best_gain, 0.0))
 
         if big:
             # gather-free routing: the per-row split feature is selected with a
@@ -316,6 +323,7 @@ def grow_tree(
         jnp.concatenate(threshs),
         leaf_values,
         node,
+        feat_gain,
     )
 
 
@@ -402,6 +410,12 @@ def _fit_gbt(
     edges = quantile_bins(X, n_bins)
     Xb = bin_features(X, edges)
 
+    if n_bins <= 127:
+        # int8 bins end-to-end: the binned matrix is the fit's dominant tensor
+        # (1 GB at 1M x 256 in int32); every level's histogram AND routing pass
+        # re-reads it, so narrowing it 4x is a direct HBM-bandwidth win
+        Xb = Xb.astype(jnp.int8)
+
     if objective == "binary":
         Y = jnp.asarray(y, jnp.float32)[:, None]
         p0 = jnp.clip((w * Y[:, 0]).sum() / wsum, 1e-6, 1 - 1e-6)
@@ -435,17 +449,17 @@ def _fit_gbt(
         fmask = (
             jax.random.bernoulli(kcol, colsample, (D,)) if colsample < 1.0 else None
         )
-        sf, st, lv, leaf = grow_tree(
+        sf, st, lv, leaf, fg = grow_tree(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
             fmask, reg_alpha=reg_alpha if use_l1 else 0.0,  # literal 0 -> skip
         )
         lv = lv * learning_rate
-        return F + lv[leaf], (sf, st, lv)
+        return F + lv[leaf], (sf, st, lv, fg)
 
     F0 = jnp.broadcast_to(base[None, :], (N, C))
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
-    _, (sfs, sts, lvs) = jax.lax.scan(tree_round, F0, keys)
-    return TreeEnsembleParams(sfs, sts, lvs, base)
+    _, (sfs, sts, lvs, fgs) = jax.lax.scan(tree_round, F0, keys)
+    return TreeEnsembleParams(sfs, sts, lvs, base, fgs.sum(axis=0))
 
 
 # --- bagged forests (RF / single decision tree) --------------------------------------
@@ -482,6 +496,8 @@ def fit_forest(
     w = _weights(sample_weight, N)
     edges = quantile_bins(X, n_bins)
     Xb = bin_features(X, edges)
+    if n_bins <= 127:
+        Xb = Xb.astype(jnp.int8)  # see _fit_gbt: 4x less per-level HBM traffic
 
     if objective == "classification":
         Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
@@ -501,10 +517,10 @@ def fit_forest(
         fmask = (
             jax.random.bernoulli(kcol, colsample, (D,)) if colsample < 1.0 else None
         )
-        sf, st, lv, _ = grow_tree(
+        sf, st, lv, _, fg = grow_tree(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain, fmask
         )
-        return sf, st, lv
+        return sf, st, lv, fg
 
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     # bagged trees are independent, but growing them under vmap multiplies the
@@ -512,10 +528,11 @@ def fit_forest(
     # vmap — measured 18G of HBM for an 80-row dataset. lax.scan keeps one tree's
     # temps live; with the bin-wise-matmul histogram the per-step device cost is
     # small enough that scan is within ~12% of full vmap anyway.
-    _, (sfs, sts, lvs) = jax.lax.scan(
+    _, (sfs, sts, lvs, fgs) = jax.lax.scan(
         lambda _, k: (None, one_tree(k)), None, keys
     )
-    return TreeEnsembleParams(sfs, sts, lvs, jnp.zeros(C, jnp.float32))
+    return TreeEnsembleParams(sfs, sts, lvs, jnp.zeros(C, jnp.float32),
+                              fgs.sum(axis=0))
 
 
 # --- prediction heads ----------------------------------------------------------------

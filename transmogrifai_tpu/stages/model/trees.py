@@ -45,13 +45,17 @@ def _params_json(params: TreeEnsembleParams) -> dict:
     # before any blocks — per-field np.asarray paid 4 serial tunnel round trips
     # (~0.4 s of the boston steady train)
     host = jax.device_get((params.split_feature, params.split_threshold,
-                           params.leaf_values, params.base))
-    return {
+                           params.leaf_values, params.base,
+                           params.feature_gain))
+    out = {
         "split_feature": host[0].tolist(),
         "split_threshold": host[1].tolist(),
         "leaf_values": host[2].tolist(),
         "base": host[3].tolist(),
     }
+    if host[4] is not None:
+        out["feature_gain"] = host[4].tolist()
+    return out
 
 
 class _TreeModelBase(PredictionModel):
@@ -66,6 +70,18 @@ class _TreeModelBase(PredictionModel):
 
     def _ensemble(self) -> TreeEnsembleParams:
         return self._ensemble_cache
+
+    @property
+    def feature_importances_(self):
+        """Normalized total split gain per input-vector slot (the Spark/XGBoost
+        featureImportances analog consumed by ModelInsights — reference
+        ModelInsights.scala:72-391 reports these for every tree model)."""
+        fg = self.params.get("feature_gain")
+        if not fg:
+            return None
+        arr = np.asarray(fg, np.float64)
+        total = arr.sum()
+        return arr / total if total > 0 else arr
 
 
 @register_stage
