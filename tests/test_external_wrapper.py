@@ -1,0 +1,149 @@
+"""External-estimator hosting tests (mirror of the reference's generic wrapper
+suites: OpPredictorWrapperTest / SparkWrapperParamsTest — any fit/predict object
+participates as a stage with serialization, selector grids, and insights)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import BinaryClassificationModelSelector, ParamGridBuilder
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import (
+    ExternalPredictorWrapper,
+    LogisticRegression,
+)
+from transmogrifai_tpu.types import Table
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+
+class HandRolledCentroid:
+    """A hand-rolled sklearn-protocol binary classifier: nearest class centroid
+    with a temperature'd distance softmax. No sklearn dependency."""
+
+    def __init__(self, temperature: float = 1.0):
+        self.temperature = float(temperature)
+        self.centroids_ = None
+
+    def fit(self, X, y, sample_weight=None):
+        w = np.ones(len(y)) if sample_weight is None else np.asarray(sample_weight)
+        cents = []
+        for c in (0.0, 1.0):
+            m = (np.asarray(y) == c) & (w > 0)
+            cents.append(np.average(X[m], axis=0, weights=w[m]) if m.any()
+                         else np.zeros(X.shape[1]))
+        self.centroids_ = np.stack(cents)
+        return self
+
+    def _scores(self, X):
+        d = ((X[:, None, :] - self.centroids_[None, :, :]) ** 2).sum(-1)
+        z = -d / max(self.temperature, 1e-6)
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    def predict(self, X):
+        return self._scores(X).argmax(axis=1).astype(np.float32)
+
+    def predict_proba(self, X):
+        return self._scores(X).astype(np.float32)
+
+
+KINDS = {"label": "RealNN", "a": "Real", "b": "Real"}
+
+
+def _rows(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"label": float(i % 2), "a": float(i % 2) * 2 + rng.normal(0, 0.4),
+             "b": float(rng.normal())} for i in range(n)]
+
+
+def _features():
+    fs = features_from_schema(KINDS, response="label")
+    return fs, transmogrify([fs["a"], fs["b"]])
+
+
+class TestExternalWrapper:
+    def test_end_to_end_train_score(self):
+        fs, vec = _features()
+        est = ExternalPredictorWrapper(factory=HandRolledCentroid,
+                                       problem="binary", temperature=0.5)
+        pred = est(fs["label"], vec)
+        rows = _rows()
+        model = Workflow().set_reader(InMemoryReader(rows)) \
+                          .set_result_features(pred).train()
+        out = model.score(table=Table.from_rows(rows, KINDS))
+        preds = out[pred.name].to_list()
+        acc = np.mean([p["prediction"] == r["label"]
+                       for p, r in zip(preds, rows)])
+        assert acc > 0.9  # separable-ish data: the centroid model must learn it
+        assert len(preds[0]["probability"]) == 2
+
+    def test_save_load_round_trip(self, tmp_path):
+        fs, vec = _features()
+        est = ExternalPredictorWrapper(factory=HandRolledCentroid,
+                                       problem="binary")
+        pred = est(fs["label"], vec)
+        rows = _rows()
+        model = Workflow().set_reader(InMemoryReader(rows)) \
+                          .set_result_features(pred).train()
+        t = Table.from_rows(rows[:10], KINDS)
+        before = model.score(table=t)[pred.name].to_list()
+        path = str(tmp_path / "ext_model")
+        model.save(path)
+        loaded = WorkflowModel.load(path)
+        after = loaded.score(table=t)[pred.name].to_list()
+        for x, y in zip(before, after):
+            assert x["prediction"] == y["prediction"]
+            np.testing.assert_allclose(x["probability"], y["probability"],
+                                       rtol=1e-6)
+
+    def test_selector_grid_participation(self):
+        """The wrapped estimator competes in a ModelSelector search (host lane)
+        against a native device family, with a tunable grid."""
+        fs, vec = _features()
+        grid = ParamGridBuilder().add("temperature", [0.1, 1.0, 10.0]).build()
+        lr_grid = ParamGridBuilder().add("l2", [0.01]).build()
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, validation_metric="AuPR",
+            models=[
+                (ExternalPredictorWrapper(factory=HandRolledCentroid,
+                                          problem="binary"), grid),
+                (LogisticRegression(max_iter=10), lr_grid),
+            ])
+        pred = sel(fs["label"], vec)
+        rows = _rows()
+        model = Workflow().set_reader(InMemoryReader(rows)) \
+                          .set_result_features(pred).train()
+        summary = sel.summary_
+        names = {r.model_name for r in summary.validation_results}
+        assert "ExternalPredictorWrapper" in names
+        ext = [r for r in summary.validation_results
+               if r.model_name == "ExternalPredictorWrapper"]
+        assert len(ext) == 3  # one result per grid point
+        assert all(len(r.metric_values) == 2 for r in ext)  # one per fold
+        assert summary.holdout_metrics is not None
+        # scoring works whoever won
+        out = model.score(table=Table.from_rows(rows[:5], KINDS))
+        assert len(out[pred.name].to_list()) == 5
+
+    def test_unimportable_factory_refuses_serialization(self):
+        fs, vec = _features()
+
+        class Local(HandRolledCentroid):
+            pass
+
+        est = ExternalPredictorWrapper(factory=Local, problem="binary")
+        est(fs["label"], vec)
+        with pytest.raises(TypeError, match="not importable"):
+            est.to_json()
+
+    def test_serving_path(self):
+        fs, vec = _features()
+        est = ExternalPredictorWrapper(factory=HandRolledCentroid,
+                                       problem="binary")
+        pred = est(fs["label"], vec)
+        rows = _rows()
+        model = Workflow().set_reader(InMemoryReader(rows)) \
+                          .set_result_features(pred).train()
+        fn = model.score_fn()
+        one = fn({"a": 2.0, "b": 0.0})
+        assert one[pred.name]["prediction"] == 1.0

@@ -40,6 +40,11 @@ NEEDS_ARGS = {
     "RandomForestRegressorModel": _TREE_PARAMS,
     "XGBoostClassifierModel": _TREE_PARAMS_2C,
     "XGBoostRegressorModel": _TREE_PARAMS,
+    "ExternalPredictorWrapper": dict(
+        factory="transmogrifai_tpu.testkit.external:CentroidClassifier",
+        problem="binary"),
+    "ExternalPredictorModel": dict(pickle=[0], problem="binary",
+                                   num_classes=2),
 }
 
 

@@ -275,6 +275,10 @@ EXCLUDED = {
                           "output-tested in test_insights.py",
     "ModelSelector": "full search stage; output-tested in test_select.py / "
                      "test_examples.py end to end",
+    "ExternalPredictorWrapper": "hosts an external fit/predict object; "
+                                "output-tested in test_external_wrapper.py",
+    "ExternalPredictorModel": "fitted external object (pickle payload); "
+                              "output-tested in test_external_wrapper.py",
 }
 
 

@@ -323,13 +323,21 @@ class ModelSelector(PredictorEstimator):
         template = models[best.candidate_index][0]
         best_est = template.with_params(**best.grid_point)
 
+        host_lane = getattr(best_est, "host_fit", False)
         with profiling.phase("selector:refit"):
-            # no block_until_ready: the refit output flows straight into the
-            # fused predict+metrics programs — forcing it here would add one
-            # ~90ms tunnel round trip purely for phase attribution
-            params = best_est.fit_fn(X_tr, jnp.asarray(y_used),
-                                     sample_weight=jnp.asarray(weights),
-                                     **best_est.fit_kwargs())
+            if host_lane:
+                # wrapped external estimator (stages/model/wrapper.py): fit on
+                # host; `params` is the fitted external object
+                params = best_est.host_fit_full(
+                    np.asarray(X_tr, np.float32), np.asarray(y_used, np.float32),
+                    np.asarray(weights))
+            else:
+                # no block_until_ready: the refit output flows straight into the
+                # fused predict+metrics programs — forcing it here would add one
+                # ~90ms tunnel round trip purely for phase attribution
+                params = best_est.fit_fn(X_tr, jnp.asarray(y_used),
+                                         sample_weight=jnp.asarray(weights),
+                                         **best_est.fit_kwargs())
 
         summary = ModelSelectorSummary(
             validation_type=self.validator.validation_type,
@@ -349,7 +357,16 @@ class ModelSelector(PredictorEstimator):
         # round trip on a tunneled device); the metrics objects are then
         # assembled on host by the exact evaluators
         ev = _metrics_evaluator(self.problem_type, num_classes)
-        prog = _metrics_program(best_est, ev, self.problem_type, num_classes)
+        if host_lane:
+            def prog(p, Xs, ys, _ev=ev):
+                pred, raw, prob = best_est.host_predict(p, np.asarray(Xs))
+                args = [jnp.asarray(pred), jnp.asarray(raw), jnp.asarray(prob),
+                        jnp.asarray(ys, jnp.float32)]
+                if self.problem_type == "multiclass":
+                    args.append(num_classes)
+                return _ev.device_metrics(*args)
+        else:
+            prog = _metrics_program(best_est, ev, self.problem_type, num_classes)
         # train metrics over kept rows only — cutter-dropped rows carry weight 0 and
         # were remapped to class 0, so including them would corrupt the report
         kept_rows = weights > 0

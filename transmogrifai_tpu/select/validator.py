@@ -228,6 +228,31 @@ def _build_search_program(key, template, static_items, problem_type, metric,
     return fn
 
 
+def _host_unit_scores(u, X, y, train_weights, val_masks, keep,
+                      problem_type, metric, num_classes, per_fold_X):
+    """Fold x grid-point scores [K, G] for a host-lane template (host_fit=True):
+    each point fits an external estimator on the fold's weighted train rows and
+    scores validation rows with the SAME metric function as the device lane."""
+    metric_fn, _ = make_metric_fn(problem_type, metric,
+                                  num_classes=max(num_classes, 2))
+    template = u["template"]
+    Xh, yh = np.asarray(X, np.float32), np.asarray(y, np.float32)
+    tw, vm = np.asarray(train_weights), np.asarray(val_masks)
+    ftw = tw[None, :] * (1.0 - vm)              # [K, N] fold train weights
+    fvw = np.asarray(keep)[None, :] * vm        # [K, N] fold val weights
+    K = vm.shape[0]
+    scores = np.zeros((K, u["n_points"]), np.float32)
+    yd = jnp.asarray(yh)
+    for gi, point in enumerate(u["points"]):
+        for k in range(K):
+            Xk = Xh[k] if per_fold_X else Xh
+            pred, raw, prob = template.host_score(Xk, yh, ftw[k], **point)
+            scores[k, gi] = float(metric_fn(
+                jnp.asarray(pred), jnp.asarray(raw), jnp.asarray(prob),
+                yd, jnp.asarray(fvw[k])))
+    return scores
+
+
 def evaluate_candidates(
     candidates,
     X,
@@ -349,6 +374,13 @@ def evaluate_candidates(
         """Dispatch one group's program; returns the DEVICE [K, G_padded] array.
         No host fetch here: over a tunneled device each fetch is a ~90ms round
         trip, so all units' results are fetched in ONE transfer afterwards."""
+        if getattr(u["template"], "host_fit", False):
+            # host lane: wrapped external estimators (stages/model/wrapper.py)
+            # fit on the host, fold by fold — the reference runs its wrapped
+            # Spark estimators on the JVM next to its own stages the same way
+            return jnp.asarray(_host_unit_scores(
+                u, X, y, train_weights, val_masks, keep,
+                problem_type, metric, num_classes, per_fold_X))
         program = _search_program(
             u["template"], u["static_items"], u["vmap_names"],
             problem_type, metric, num_classes, per_fold_X=per_fold_X,

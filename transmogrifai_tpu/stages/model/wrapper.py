@@ -1,0 +1,238 @@
+"""Generic external-estimator hosting: wrap ANY fit/predict object as an OP stage.
+
+Analog of the reference's generic Spark-wrapper layer — OpPredictorWrapper
+(core/.../sparkwrappers/specific/OpPredictorWrapper.scala:67-109), the ten
+generic `Sw*` wrappers under sparkwrappers/generic/, and SparkModelConverter
+(SparkModelConverter.scala:47-81). The reference's wrapper turns any Spark
+`Predictor` into a (label, features) -> Prediction stage with serialization and
+selector participation intact; this module does the same for any HOST python
+estimator with the sklearn protocol:
+
+    est = factory(**hyper)
+    est.fit(X, y[, sample_weight])          # numpy in
+    est.predict(X)                          # -> [N]
+    est.predict_proba(X)                    # optional -> [N, C]
+
+Design (TPU framing): an arbitrary python object cannot ride the selector's
+vmapped folds x grid device search, so wrapped estimators take the HOST LANE —
+`select/validator.py` runs their fold x point fits on the host and merges the
+scores into the same results stream, exactly as the reference runs Spark
+estimators on the JVM next to its own stages. Fitted state is serialized as
+pickle bytes inside the workflow's npz sidecar (the MLeap-conversion role,
+without the conversion).
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import pickle
+from typing import Any, Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from ...types import Column
+from ..base import register_stage
+from .base import PredictionModel, PredictorEstimator
+
+
+def _resolve_factory(f: Union[str, Callable]) -> Callable:
+    if callable(f):
+        return f
+    if not isinstance(f, str) or ":" not in f:
+        raise ValueError(
+            "factory must be a callable or an 'importable.module:qualname' "
+            f"string, got {f!r}")
+    mod, _, name = f.partition(":")
+    obj: Any = importlib.import_module(mod)
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _factory_ref(f: Union[str, Callable]) -> str:
+    if isinstance(f, str):
+        return f
+    if f.__qualname__ != f.__name__ or f.__module__ == "__main__":
+        # closures/locals/__main__ can't be re-imported in a fresh process
+        raise TypeError(
+            f"external factory {f!r} is not importable (module "
+            f"{f.__module__!r}, qualname {f.__qualname__!r}); pass a "
+            "module-level class/function or an 'module:qualname' string")
+    return f"{f.__module__}:{f.__qualname__}"
+
+
+def _fit_external(est, X: np.ndarray, y: np.ndarray,
+                  sample_weight: Optional[np.ndarray]):
+    """Fit on the rows selected by the weights (0-weight rows are excluded —
+    fold masks arrive as weight vectors), forwarding the weights when the
+    estimator's fit accepts them."""
+    if sample_weight is not None:
+        rows = np.asarray(sample_weight) > 0
+        X, y, w = X[rows], y[rows], np.asarray(sample_weight)[rows]
+        try:  # guards ONLY the introspection: builtins without signatures
+            takes_weight = "sample_weight" in inspect.signature(est.fit).parameters
+        except (TypeError, ValueError):
+            takes_weight = False
+        if takes_weight:
+            # a real error from the weighted fit must propagate — silently
+            # refitting unweighted would drop the balancer's class weights
+            est.fit(X, y, sample_weight=w)
+            return est
+    est.fit(X, y)
+    return est
+
+
+def _host_predictions(est, X: np.ndarray, problem: str, num_classes: int):
+    """-> (pred [N], raw [N,C], prob [N,C]) numpy, the Prediction contract."""
+    pred = np.asarray(est.predict(X), np.float32).reshape(-1)
+    if problem == "regression":
+        col = pred[:, None]
+        return pred, col, col
+    if hasattr(est, "predict_proba"):
+        prob = np.asarray(est.predict_proba(X), np.float32)
+        if prob.ndim == 1:
+            prob = np.stack([1.0 - prob, prob], axis=1)
+        raw = np.log(np.clip(prob, 1e-9, None)).astype(np.float32)
+        return pred, raw, prob
+    # hard-label classifier: degenerate one-hot probabilities
+    c = max(int(num_classes), 2)
+    prob = np.eye(c, dtype=np.float32)[np.clip(pred.astype(np.int64), 0, c - 1)]
+    return pred, prob, prob
+
+
+@register_stage
+class ExternalPredictorWrapper(PredictorEstimator):
+    """Host any sklearn-protocol estimator as an OP predictor stage.
+
+        wrapped = ExternalPredictorWrapper(factory="my_pkg.models:MyModel",
+                                           problem="binary", alpha=0.5)
+        pred = wrapped(label, features)
+
+    Extra ctor kwargs become the wrapped estimator's constructor args and are
+    tunable through ParamGridBuilder grids in a ModelSelector (host lane).
+    """
+
+    operation_name = "externalPredictor"
+    #: selector host lane (select/validator.py): fold x point fits run on host
+    host_fit = True
+    vmap_params = ()
+
+    def __init__(self, factory: Union[str, Callable, None] = None,
+                 problem: str = "binary", num_classes: int = 0, **hyper):
+        if factory is None:
+            raise ValueError("ExternalPredictorWrapper requires factory=")
+        if problem not in ("binary", "multiclass", "regression"):
+            raise ValueError(f"unknown problem {problem!r}")
+        super().__init__(factory=factory, problem=problem,
+                         num_classes=int(num_classes), **hyper)
+
+    # ctor params are open-ended (**hyper) — the base with_params would drop
+    # grid keys that aren't named parameters of __init__
+    def with_params(self, **overrides) -> "ExternalPredictorWrapper":
+        return type(self)(**{**self.params, **overrides})
+
+    def _hyper(self, point: Optional[dict] = None) -> dict:
+        h = {k: v for k, v in self.params.items()
+             if k not in ("factory", "problem", "num_classes")}
+        if point:
+            h.update(point)
+        return h
+
+    def _instantiate(self, point: Optional[dict] = None):
+        return _resolve_factory(self.params["factory"])(**self._hyper(point))
+
+    # --- selector host-lane protocol --------------------------------------------------
+    def host_score(self, X: np.ndarray, y: np.ndarray,
+                   train_weight: np.ndarray, **point):
+        """One fold x grid-point unit: fit on weighted rows, predict ALL rows."""
+        est = _fit_external(self._instantiate(point), np.asarray(X, np.float32),
+                            np.asarray(y, np.float32), train_weight)
+        return _host_predictions(est, np.asarray(X, np.float32),
+                                 self.params["problem"],
+                                 self.params["num_classes"])
+
+    def host_fit_full(self, X: np.ndarray, y: np.ndarray,
+                      sample_weight: Optional[np.ndarray] = None):
+        return _fit_external(self._instantiate(), np.asarray(X, np.float32),
+                             np.asarray(y, np.float32), sample_weight)
+
+    def host_predict(self, fitted, X: np.ndarray):
+        return _host_predictions(fitted, np.asarray(X, np.float32),
+                                 self.params["problem"],
+                                 self.params["num_classes"])
+
+    # --- Estimator interface ----------------------------------------------------------
+    def fit_columns(self, cols: Sequence[Column]):
+        y = np.asarray(cols[0].values, np.float32)
+        X = np.asarray(cols[1].values, np.float32)
+        return self.make_model(self.host_fit_full(X, y))
+
+    def make_model(self, fitted) -> "ExternalPredictorModel":
+        # kept as a np.uint8 array in params (not a python int list — ~8x the
+        # memory for a big pickled model); _jsonify converts at save time and
+        # the npz sidecar stores it as binary
+        blob = np.frombuffer(pickle.dumps(fitted), np.uint8)
+        return ExternalPredictorModel(
+            pickle=blob,
+            problem=self.params["problem"],
+            num_classes=self.params["num_classes"],
+        )
+
+    def config_fingerprint(self):
+        """JSON-able fingerprint: the callable factory is identified by import
+        path (or repr when not importable — still a faithful identity for the
+        warm-start equality check, and keeps model.save() serializable)."""
+        from ..base import _jsonify
+
+        params = dict(self.params)
+        try:
+            params["factory"] = _factory_ref(params["factory"])
+        except TypeError:
+            params["factory"] = repr(params["factory"])
+        return _jsonify(params)
+
+    def to_json(self) -> dict:
+        # base Stage.to_json would _jsonify a callable factory; swap in the
+        # import path first
+        from ..base import _jsonify
+
+        params = dict(self.params)
+        params["factory"] = _factory_ref(params["factory"])
+        return {
+            "class": type(self).__name__,
+            "uid": self.uid,
+            "operation": self.operation_name,
+            "params": _jsonify(params),
+            "inputs": [f.name for f in self.inputs],
+        }
+
+
+@register_stage
+class ExternalPredictorModel(PredictionModel):
+    """Fitted external estimator as a HOST transformer: the pickled object
+    scores on the host; output is a regular Prediction column so downstream
+    evaluators/insights/serving see no difference."""
+
+    operation_name = "externalPredictor"
+    device_op = False  # host object — never traced or fused
+    kernel_jitted = False
+
+    def __init__(self, **params):
+        super().__init__(**params)
+        self._fitted = None
+
+    def _model(self):
+        if self._fitted is None:
+            blob = np.asarray(self.params["pickle"], np.uint8).tobytes()
+            self._fitted = pickle.loads(blob)
+        return self._fitted
+
+    def predict(self, X):
+        return _host_predictions(self._model(), np.asarray(X, np.float32),
+                                 self.params["problem"],
+                                 self.params["num_classes"])
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        X = np.asarray(cols[1].values, np.float32)
+        pred, raw, prob = self.predict(X)
+        return Column.prediction(pred, raw, prob)

@@ -1,0 +1,43 @@
+"""A tiny self-contained sklearn-protocol estimator for wrapper tests/examples.
+
+`ExternalPredictorWrapper(factory="transmogrifai_tpu.testkit.external:CentroidClassifier")`
+hosts it as a stage — the documented minimal example of the external-estimator
+protocol (fit/predict/predict_proba, numpy in/out; see stages/model/wrapper.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class CentroidClassifier:
+    """Nearest-class-centroid binary classifier with a temperature'd distance
+    softmax. No dependencies; weights live in `centroids_`."""
+
+    def __init__(self, temperature: float = 1.0):
+        self.temperature = float(temperature)
+        self.centroids_ = None
+
+    def fit(self, X, y, sample_weight=None):
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y)
+        w = np.ones(len(y)) if sample_weight is None else np.asarray(sample_weight)
+        cents = []
+        for c in (0.0, 1.0):
+            m = (y == c) & (w > 0)
+            cents.append(np.average(X[m], axis=0, weights=w[m]) if m.any()
+                         else np.zeros(X.shape[1]))
+        self.centroids_ = np.stack(cents)
+        return self
+
+    def _scores(self, X):
+        X = np.asarray(X, np.float64)
+        d = ((X[:, None, :] - self.centroids_[None, :, :]) ** 2).sum(-1)
+        z = -d / max(self.temperature, 1e-6)
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    def predict(self, X):
+        return self._scores(X).argmax(axis=1).astype(np.float32)
+
+    def predict_proba(self, X):
+        return self._scores(X).astype(np.float32)
