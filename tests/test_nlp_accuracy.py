@@ -1,0 +1,86 @@
+"""Measured NLP accuracy against the labeled fixture (VERDICT r04 #6: the
+reference ships trained OpenNLP models + Lucene analyzers; our hand-rolled
+detectors must be MEASURED, not asserted. Fixture: tests/fixtures/nlp_eval.json,
+built by build_nlp_eval.py — 176 out-of-sample lang-id sentences across the 11
+supported languages and 40 entity-annotated English sentences / 187 entities).
+
+The lang-id floor (95%) is the VERDICT criterion. NER is reported per type with
+precision/recall/F1 and held to a conservative floor; known gaps (bare
+acronyms without context, seasonal words, uncommon surnames) are annotated in
+the fixture and documented in docs/performance.md.
+"""
+import json
+import os
+
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "nlp_eval.json")
+
+ENTITY_TYPES = ("person", "location", "organization", "date", "time",
+                "money", "percentage")
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    with open(FIXTURE) as fh:
+        return json.load(fh)
+
+
+def test_lang_id_accuracy_floor(fixture):
+    from transmogrifai_tpu.utils.text_lang import detect_language
+
+    total, hits = 0, 0
+    misses = []
+    for case in fixture["lang_id"]:
+        got = detect_language(case["text"])
+        total += 1
+        if got == case["lang"]:
+            hits += 1
+        else:
+            misses.append((case["lang"], got, case["text"][:40]))
+    acc = hits / total
+    print(f"\nlang-id accuracy: {acc:.3f} ({hits}/{total}); misses: {misses}")
+    assert acc >= 0.95, f"lang-id accuracy {acc:.3f} < 0.95; misses: {misses}"
+
+
+def test_ner_f1_report(fixture):
+    from transmogrifai_tpu.utils.ner import tag_tokens
+
+    tp = {t: 0 for t in ENTITY_TYPES}
+    fp = {t: 0 for t in ENTITY_TYPES}
+    fn = {t: 0 for t in ENTITY_TYPES}
+    for case in fixture["ner"]:
+        tokens = case["text"].split()
+        gold = {(t, tok) for t, tok in map(tuple, case["entities"])}
+        tagged = tag_tokens(tokens, entity_types=ENTITY_TYPES)
+        # tag_tokens maps token -> set of types
+        predicted = {(t, tok) for tok, types in tagged.items() for t in types}
+        for t in ENTITY_TYPES:
+            g = {x for x in gold if x[0] == t}
+            p = {x for x in predicted if x[0] == t}
+            tp[t] += len(g & p)
+            fp[t] += len(p - g)
+            fn[t] += len(g - p)
+
+    report = {}
+    for t in ENTITY_TYPES:
+        prec = tp[t] / max(tp[t] + fp[t], 1)
+        rec = tp[t] / max(tp[t] + fn[t], 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-9)
+        report[t] = {"precision": round(prec, 3), "recall": round(rec, 3),
+                     "f1": round(f1, 3), "support": tp[t] + fn[t]}
+    TP, FP, FN = sum(tp.values()), sum(fp.values()), sum(fn.values())
+    micro_p = TP / max(TP + FP, 1)
+    micro_r = TP / max(TP + FN, 1)
+    micro_f1 = 2 * micro_p * micro_r / max(micro_p + micro_r, 1e-9)
+    print(f"\nNER micro P={micro_p:.3f} R={micro_r:.3f} F1={micro_f1:.3f}")
+    for t, m in report.items():
+        print(f"  {t:14s} P={m['precision']:.3f} R={m['recall']:.3f} "
+              f"F1={m['f1']:.3f} (n={m['support']})")
+    # conservative floor: heuristics, not trained models — regressions in the
+    # rules must fail the suite; docs/performance.md records the measured value
+    # (0.901 micro-F1 at the r5 fixture after the person-precision fix)
+    assert micro_f1 >= 0.80, f"NER micro-F1 {micro_f1:.3f} < 0.80: {report}"
+    # date/money/percentage are pattern-driven and must stay strong
+    for t in ("date", "money", "percentage"):
+        assert report[t]["f1"] >= 0.75, (t, report[t])

@@ -126,8 +126,11 @@ def test_name_entity_recognizer():
     out, feat = _apply(NameEntityRecognizer(), [f], t)
     assert feat.kind.name == "MultiPickList"
     # Alice is sentence-initial but a gazetteer name (the round-2 heuristic
-    # missed it); Bob is a gazetteer hit; Paris is a shape hit
-    assert out.values[0] == {"Alice", "Bob", "Paris"}
+    # missed it); Bob is a gazetteer hit. Paris is a CITY-gazetteer token — as
+    # of r5 the person-shape rule excludes tokens positively known to other
+    # passes (person precision 0.28 -> 0.85 on the labeled fixture), so it is
+    # correctly a location, not a person
+    assert out.values[0] == {"Alice", "Bob"}
 
 
 def test_name_entity_recognizer_multi_type():
