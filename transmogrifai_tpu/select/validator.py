@@ -224,6 +224,12 @@ def _build_search_program(key, template, static_items, problem_type, metric,
             lambda X, y, twk, vwk: fit_eval(X, y, twk, vwk, {}),
             in_axes=(x_axis, None, 0, 0),
         ))
+    # exported-program cache: a warm process skips the ~5-20s python trace of
+    # each search program, not just its XLA compile (utils/export_cache.py;
+    # single-device runs only — mesh/test envs fall through to the jit)
+    from ..utils.export_cache import ExportCachingProgram
+
+    fn = ExportCachingProgram(fn, key_material=repr(key))
     _SEARCH_PROGRAM_CACHE[key] = fn
     return fn
 

@@ -1,0 +1,155 @@
+"""Persistent EXPORTED-program cache: skip per-process jax tracing, not just
+XLA compilation.
+
+The persistent compilation cache (compile_cache.py) removes backend_compile
+time, but a fresh process still pays Python TRACING + MLIR lowering for every
+program — measured ~20 s of a 34 s warm-process `op warmup` (the selector's
+folds x grid search programs trace thousands of sub-jaxprs). `jax.export`
+serializes the traced module itself: a warm process deserializes (<10 ms) and
+calls, paying only the compiled-executable retrieval (~1-3 s for a tree search
+program vs ~21 s trace+compile).
+
+Safety: a stale exported blob would silently replay OLD code, so the cache key
+includes a fingerprint of the package's source tree (file sizes + mtimes),
+jax's version, and the target device kind — any source edit invalidates every
+blob. Export is restricted to mesh-less (single-device) programs; sharded
+callers keep the plain jit path. Any failure (unsupported primitive, version
+skew, corrupt blob) falls back to the jit path for the life of the process.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, Callable, Optional
+
+_SRC_FINGERPRINT: Optional[str] = None
+_LOCK = threading.Lock()
+
+
+def _source_fingerprint() -> str:
+    """Hash of (path, size, mtime) over every package .py file — cheap (~ms)
+    and changes whenever any source file is edited."""
+    global _SRC_FINGERPRINT
+    if _SRC_FINGERPRINT is not None:
+        return _SRC_FINGERPRINT
+    import jax
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    h.update(jax.__version__.encode())
+    try:
+        h.update(jax.devices()[0].device_kind.encode())
+    except Exception:
+        pass
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            try:
+                st = os.stat(p)
+                h.update(f"{os.path.relpath(p, root)}:{st.st_size}:"
+                         f"{st.st_mtime_ns}".encode())
+            except OSError:
+                pass
+    _SRC_FINGERPRINT = h.hexdigest()[:16]
+    return _SRC_FINGERPRINT
+
+
+def _cache_dir() -> Optional[str]:
+    if os.environ.get("TT_EXPORT_CACHE", "1") == "0":
+        return None
+    base = (os.environ.get("TT_COMPILE_CACHE_DIR")
+            or os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache"))
+    return os.path.join(base, "exported")
+
+
+def _aval_fingerprint(args, kwargs=None) -> str:
+    import jax
+
+    def leaf(x):
+        a = jax.api_util.shaped_abstractify(x)
+        return f"{a.shape}:{a.dtype}"
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    return hashlib.sha256(
+        (";".join(map(leaf, leaves)) + "|" + str(treedef)).encode()
+    ).hexdigest()[:24]
+
+
+class ExportCachingProgram:
+    """Wrap a jitted program: per (args-avals) shape signature, serve calls
+    from a deserialized exported module when a blob exists; otherwise call the
+    jit path and export+persist in the SAME process so the next process skips
+    tracing. Transparent on any failure."""
+
+    def __init__(self, fn: Callable, key_material: str):
+        self._fn = fn
+        self._key = key_material
+        self._by_shape: dict[str, Any] = {}
+
+    def _cache_size(self):
+        """Delegate to the wrapped jit's trace-cache size (tests assert program
+        reuse across trains through this)."""
+        return self._fn._cache_size()
+
+    def _blob_path(self, fp: str) -> Optional[str]:
+        d = _cache_dir()
+        if d is None:
+            return None
+        digest = hashlib.sha256(
+            f"{self._key}|{fp}|{_source_fingerprint()}".encode()).hexdigest()
+        return os.path.join(d, f"{digest}.jaxexp")
+
+    def __call__(self, *args):
+        fp = _aval_fingerprint(args)
+        entry = self._by_shape.get(fp)
+        if entry is None:
+            entry = self._load_or_build(fp, args)
+        if entry is self._fn:
+            return self._fn(*args)
+        try:
+            return entry.call(*args)
+        except Exception:
+            # deserialized blob unusable at call time: permanent jit fallback
+            self._by_shape[fp] = self._fn
+            return self._fn(*args)
+
+    def _load_or_build(self, fp: str, args):
+        import jax
+
+        if jax.device_count() != 1:
+            # exported modules are single-device; sharded/mesh runs (and the
+            # 8-fake-device CPU test env) keep the plain jit path
+            with _LOCK:
+                self._by_shape[fp] = self._fn
+            return self._fn
+
+        path = self._blob_path(fp)
+        entry: Any = self._fn
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path, "rb") as fh:
+                    entry = jax.export.deserialize(fh.read())
+            except Exception:
+                entry = self._fn
+        elif path is not None:
+            try:
+                # one extra trace now (the jit call below would trace anyway;
+                # export's trace lands in jit's cache? it does not — accept the
+                # single duplicate trace at first-ever build) and persist
+                exported = jax.export.export(self._fn)(*args)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as fh:
+                    fh.write(exported.serialize())
+                os.replace(tmp, path)
+                entry = exported
+            except Exception:
+                entry = self._fn
+        with _LOCK:
+            self._by_shape[fp] = entry
+        return entry
