@@ -102,7 +102,15 @@ def _cmd_warmup(argv) -> int:
     from transmogrifai_tpu.workflow.warmup import _PROBLEMS, warmup_matrix
 
     splitter = None
-    if args.splitter != "default" or args.reserve_test_fraction is not None:
+    splitter_fraction = None
+    if args.splitter == "default":
+        # the real train's default splitter is per-problem (balancer for
+        # binary, cutter for multiclass — its label remap changes class-axis
+        # shapes), so a plain DataSplitter here would warm the WRONG shapes;
+        # warmup_matrix builds default_splitter(problem) per problem and only
+        # overrides the holdout fraction
+        splitter_fraction = args.reserve_test_fraction
+    else:
         from transmogrifai_tpu.select.splitters import (
             DataBalancer,
             DataCutter,
@@ -110,7 +118,7 @@ def _cmd_warmup(argv) -> int:
         )
 
         cls = {"plain": DataSplitter, "balancer": DataBalancer,
-               "cutter": DataCutter, "default": DataSplitter}[args.splitter]
+               "cutter": DataCutter}[args.splitter]
         kw = ({} if args.reserve_test_fraction is None
               else {"reserve_test_fraction": args.reserve_test_fraction})
         splitter = cls(**kw)
@@ -120,6 +128,7 @@ def _cmd_warmup(argv) -> int:
     reports = warmup_matrix(problems=problems, rows=args.rows, widths=widths,
                             num_classes=args.num_classes,
                             splitter=splitter, num_folds=args.num_folds,
+                            splitter_fraction=splitter_fraction,
                             log=lambda m: print(m, file=sys.stderr))
     import json
 

@@ -46,14 +46,36 @@ class VectorsCombiner(SequenceVectorizer):
     kernel_jitted = True
     accepts = ("OPVector",)
 
-    def __init__(self, pad_to_bucket: bool = True):
-        super().__init__(pad_to_bucket=bool(pad_to_bucket))
+    def __init__(self, pad_to_bucket: bool = True, fitted_width: int = 0,
+                 target_width: int = 0):
+        # (fitted_width, target_width): the padded width the LAST transform of
+        # the training run derived, persisted with the model. A reloaded model
+        # whose inputs have the trained width keeps the trained padding even if
+        # the bucket_width table changes across versions (ADVICE r04: a bucket
+        # change otherwise shape-mismatches reloaded models against their
+        # downstream weights with an opaque matmul error). Inputs of a
+        # DIFFERENT width (per-fold workflow-CV cone refits vectorize
+        # fold-specific vocabularies) re-derive their own bucket as before.
+        super().__init__(pad_to_bucket=bool(pad_to_bucket),
+                         fitted_width=int(fitted_width),
+                         target_width=int(target_width))
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         from ...types import bucket_width
 
         width = sum(int(c.values.shape[1]) for c in cols)
-        target = bucket_width(width) if self.params["pad_to_bucket"] else width
+        if width == self.params["fitted_width"] and self.params["target_width"]:
+            target = int(self.params["target_width"])
+        else:
+            target = bucket_width(width) if self.params["pad_to_bucket"] else width
+            if not self.params["target_width"]:
+                # FIRST transform of a fresh instance records the training
+                # width; persisted values (a reloaded model, or this session's
+                # main fit) are never overwritten — a foreign-width transform
+                # (fold cone, variant vectorization) must not silently rewrite
+                # the width the saved downstream weights were trained at
+                self.params["fitted_width"] = width
+                self.params["target_width"] = target
         vec = _concat_pad_kernel(tuple(c.values for c in cols), target)
         schemas = [c.schema if c.schema is not None else _anonymous_schema(c, f)
                    for c, f in zip(cols, self.inputs)]

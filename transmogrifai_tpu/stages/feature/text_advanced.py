@@ -460,9 +460,20 @@ def _sniff_mime(data: bytes) -> Optional[str]:
     if s.startswith("{") or s.startswith("["):
         import json as _json
 
+        # validate ONLY the bounded prefix (ADVICE r04: parsing the full
+        # payload made sniffing O(size) per row on multi-MB blobs). Small
+        # payloads (fully inside the prefix) parse strictly; longer ones are
+        # JSON-like when the parse fails only in a truncation-consistent way —
+        # an unterminated string (whose reported pos is the string START, which
+        # can be far back) or any error at the ragged end of the cut.
         try:
-            _json.loads(text if len(data) <= 4096 else data.decode("utf-8"))
+            _json.loads(text)
             return "application/json"
+        except _json.JSONDecodeError as e:
+            truncated = len(data) > 4096
+            if truncated and ("Unterminated string" in e.msg
+                              or e.pos >= int(len(text) * 0.9)):
+                return "application/json"
         except Exception:
             pass
     return "text/plain"

@@ -118,14 +118,26 @@ def warmup_matrix(problems: Sequence[str] = ("binary",),
                   models=None,
                   splitter=None,
                   num_folds: int = 3,
+                  splitter_fraction=None,
                   log=print) -> list[dict]:
-    """Warm every (problem, width) combination; returns the per-cell reports."""
+    """Warm every (problem, width) combination; returns the per-cell reports.
+
+    splitter=None warms with each problem's DEFAULT splitter (balancer for
+    binary, cutter for multiclass — shape fidelity: the real train uses these,
+    and the cutter's label remap changes class-axis shapes); splitter_fraction
+    overrides only its holdout fraction."""
     out = []
     for p in problems:
+        sp = splitter
+        if sp is None and splitter_fraction is not None:
+            from ..select.selector import default_splitter
+
+            sp = default_splitter(p)
+            sp.reserve_test_fraction = float(splitter_fraction)
         for w in widths:
             rep = warmup(problem=p, rows=rows, width=int(w),
                          num_classes=num_classes, models=models,
-                         splitter=splitter, num_folds=num_folds)
+                         splitter=sp, num_folds=num_folds)
             log(f"warmed {p} rows={rows} width={w}: {rep['wall_s']}s")
             out.append(rep)
     return out
