@@ -272,6 +272,33 @@ def test_word2vec_embeds_related_words_closer():
     assert np.asarray(out.values).shape == (len(docs), 16)
 
 
+def test_word2vec_minibatched_full_pair_set():
+    """max_pairs is the per-STEP batch size (r5), not a silent subsample cap:
+    a corpus whose pair count far exceeds max_pairs still embeds topic
+    structure — every pair trains across minibatches."""
+    rng = np.random.default_rng(3)
+    docs = []
+    for _ in range(300):
+        topic = (["sun", "moon", "star", "sky"] if rng.random() < 0.5
+                 else ["fork", "spoon", "plate", "bowl"])
+        docs.append([topic[rng.integers(0, 4)] for _ in range(8)])
+    # pairs ~= 300 * 8 * 4 window pairs >> max_pairs=256 -> many steps/epoch
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    t = Table({"toks": _col("TextList", docs)}, len(docs))
+    est = Word2Vec(dim=16, epochs=12, max_pairs=256, seed=0)
+    est(f)
+    model = est.fit_table(t)
+    vecs = {w: np.asarray(model.params["vectors"])[i]
+            for i, w in enumerate(model.params["vocabulary"])}
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+    within = cos(vecs["sun"], vecs["moon"])
+    across = cos(vecs["sun"], vecs["fork"])
+    assert within > across + 0.2, (within, across)
+
+
 def test_word2vec_empty_vocab():
     f = FeatureBuilder.TextList("toks").as_predictor()
     t = Table({"toks": _col("TextList", [[], []])}, 2)

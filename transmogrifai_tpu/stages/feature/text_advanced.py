@@ -518,27 +518,48 @@ class MimeTypeDetector(Transformer):
 # --- word2vec (device skip-gram) --------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("epochs",))
-def _sgns_train(w_in, w_out, centers, contexts, negatives, lr, epochs):
-    """Skip-gram with negative sampling: per-epoch full-batch SGD. Embedding gathers
-    and dot-products are batched matvecs (MXU); the pairs tensor is fixed-shape so the
-    whole training loop is ONE XLA program."""
+@partial(jax.jit, static_argnames=("epochs", "batch", "n_neg", "seed"))
+def _sgns_train(w_in, w_out, centers, contexts, weights, neg_logits,
+                lr, epochs, batch, n_neg, seed):
+    """Skip-gram with negative sampling as minibatched SGD over the FULL pair
+    set: an outer scan over epochs (device-side permutation each epoch), an
+    inner scan over fixed-size minibatches. Negatives are drawn FRESH per step
+    from the unigram^0.75 table (jax.random.categorical over `neg_logits`) —
+    no [P, K] negatives tensor is ever materialized, so the pair count is
+    unbounded (the old full-batch form silently subsampled to max_pairs).
+    `weights` zero out the pad pairs. One XLA program end to end."""
+    P = centers.shape[0]
+    n_steps = P // batch
 
-    def loss_fn(params):
-        wi, wo = params
-        c = wi[centers]                     # [P, D]
-        pos = wo[contexts]                  # [P, D]
-        neg = wo[negatives]                 # [P, K, D]
-        pos_score = jax.nn.log_sigmoid(jnp.sum(c * pos, axis=-1))
-        neg_score = jax.nn.log_sigmoid(-jnp.einsum("pd,pkd->pk", c, neg))
-        return -(pos_score.sum() + neg_score.sum()) / centers.shape[0]
+    def minibatch(params, inp):
+        c_ids, x_ids, w, key = inp
+        neg = jax.random.categorical(key, neg_logits, shape=(batch, n_neg))
 
-    def step(params, _):
+        def loss_fn(ps):
+            wi, wo = ps
+            c = wi[c_ids]                       # [B, D]
+            pos = wo[x_ids]                     # [B, D]
+            nv = wo[neg]                        # [B, K, D]
+            pos_score = jax.nn.log_sigmoid(jnp.sum(c * pos, axis=-1))
+            neg_score = jax.nn.log_sigmoid(
+                -jnp.einsum("bd,bkd->bk", c, nv)).sum(-1)
+            return -(w * (pos_score + neg_score)).sum() / (w.sum() + 1e-6)
+
         loss, grads = jax.value_and_grad(loss_fn)(params)
         params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
         return params, loss
 
-    (w_in, w_out), losses = jax.lax.scan(step, (w_in, w_out), None, length=epochs)
+    def epoch(params, ekey):
+        perm = jax.random.permutation(jax.random.fold_in(ekey, 0), P)
+        cs = centers[perm].reshape(n_steps, batch)
+        xs = contexts[perm].reshape(n_steps, batch)
+        ws = weights[perm].reshape(n_steps, batch)
+        keys = jax.random.split(jax.random.fold_in(ekey, 1), n_steps)
+        params, losses = jax.lax.scan(minibatch, params, (cs, xs, ws, keys))
+        return params, losses.mean()
+
+    ekeys = jax.random.split(jax.random.PRNGKey(seed), epochs)
+    (w_in, w_out), losses = jax.lax.scan(epoch, (w_in, w_out), ekeys)
     return w_in, losses
 
 
@@ -555,6 +576,8 @@ class Word2Vec(SequenceVectorizerEstimator):
     def __init__(self, dim: int = 32, window: int = 2, min_count: int = 2,
                  negatives: int = 5, epochs: int = 30, lr: float = 0.1,
                  max_pairs: int = 100_000, seed: int = 42):
+        # max_pairs is the per-STEP minibatch cap (r5) — the full pair set
+        # always trains; it was a silent subsample limit before
         super().__init__(dim=dim, window=window, min_count=min_count,
                          negatives=negatives, epochs=epochs, lr=lr,
                          max_pairs=max_pairs, seed=seed)
@@ -582,18 +605,34 @@ class Word2Vec(SequenceVectorizerEstimator):
             vecs = rng.normal(scale=0.1, size=(len(vocab), p["dim"]))
             return Word2VecModel(vocabulary=vocab, vectors=vecs.tolist(),
                                  dim=p["dim"], name=self.inputs[0].name)
-        pairs = rng.permutation(len(centers))[: p["max_pairs"]]
-        centers = np.asarray(centers, np.int32)[pairs]
-        contexts = np.asarray(contexts, np.int32)[pairs]
-        # unigram^0.75 negative table (word2vec's standard proposal distribution)
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+        # minibatch layout: the FULL pair set, padded up to a whole number of
+        # fixed-size steps (pad pairs carry weight 0). Batch targets >= 8 SGD
+        # steps per epoch (small corpora need update COUNT — one full-batch
+        # step per epoch barely moves the embeddings) and is capped by
+        # max_pairs so huge corpora keep a bounded per-step shape.
+        batch = max(1, min(int(p["max_pairs"]),
+                           max(256, -(-len(centers) // 8)),
+                           len(centers)))
+        pad = (-len(centers)) % batch
+        weights = np.ones(len(centers), np.float32)
+        if pad:
+            centers = np.concatenate([centers, np.zeros(pad, np.int32)])
+            contexts = np.concatenate([contexts, np.zeros(pad, np.int32)])
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        # unigram^0.75 negative table (word2vec's standard proposal
+        # distribution) as logits: negatives are sampled on device per step
         freq = np.array([counts[w] for w in vocab], np.float64) ** 0.75
-        neg = rng.choice(len(vocab), size=(len(centers), p["negatives"]),
-                         p=freq / freq.sum()).astype(np.int32)
+        neg_logits = jnp.asarray(np.log(freq / freq.sum()), jnp.float32)
         v, d = len(vocab), p["dim"]
         w_in = jnp.asarray(rng.normal(scale=1 / np.sqrt(d), size=(v, d)), jnp.float32)
         w_out = jnp.zeros((v, d), jnp.float32)
-        w_in, _ = _sgns_train(w_in, w_out, jnp.asarray(centers), jnp.asarray(contexts),
-                              jnp.asarray(neg), p["lr"], p["epochs"])
+        w_in, _ = _sgns_train(w_in, w_out, jnp.asarray(centers),
+                              jnp.asarray(contexts), jnp.asarray(weights),
+                              neg_logits, p["lr"], epochs=int(p["epochs"]),
+                              batch=batch, n_neg=int(p["negatives"]),
+                              seed=int(p["seed"]))
         return Word2VecModel(vocabulary=vocab, vectors=np.asarray(w_in).tolist(),
                              dim=p["dim"], name=self.inputs[0].name)
 
