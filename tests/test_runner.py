@@ -247,6 +247,117 @@ def test_table_pad_to():
         t.pad_to(2)
 
 
+def test_socket_streaming_reader_threaded_producer(tmp_path):
+    """Line-delimited JSON over a real TCP socket feeds streaming_score with a
+    producer thread; bounded buffering (max_buffered_batches) gives true
+    backpressure (reference StreamingReader.scala:54 socket source)."""
+    import json
+    import socket
+    import threading
+
+    from transmogrifai_tpu.readers import SocketStreamingReader
+
+    runner, _ = _runner()
+    runner.run("train", OpParams())
+    reader = SocketStreamingReader(batch_size=8, max_buffered_batches=2)
+    reader.start()
+    host, port = reader.address
+    rows = []
+    for b in (_rows(16, seed=1), _rows(16, seed=2)):
+        for r in b:
+            del r["label"]
+        rows.extend(b)
+
+    def produce():
+        with socket.create_connection((host, port)) as s:
+            for r in rows:
+                s.sendall((json.dumps(r) + "\n").encode())
+
+    t = threading.Thread(target=produce)
+    t.start()
+    runner.streaming_reader = reader
+    params = OpParams(write_location=str(tmp_path / "sock_stream"))
+    res = runner.run("streaming_score", params)
+    t.join()
+    assert res.n_rows == 32
+    assert res.batches == 4  # 32 rows / batch_size 8
+    assert sorted(os.listdir(tmp_path / "sock_stream"))[0] == "part-00000.csv"
+
+
+def test_file_tail_streaming_reader(tmp_path):
+    """tail -f a growing line-delimited file: batches appear as lines land,
+    idle timeout ends the stream (the file-based live source)."""
+    import json
+    import threading
+    import time
+
+    from transmogrifai_tpu.readers import FileTailStreamingReader
+
+    path = tmp_path / "events.jsonl"
+    path.write_text("")
+    rows = _rows(12, seed=3)
+    for r in rows:
+        del r["label"]
+
+    def append():
+        with open(path, "a") as fh:
+            for i, r in enumerate(rows):
+                fh.write(json.dumps(r) + "\n")
+                fh.flush()
+                if i % 4 == 3:
+                    time.sleep(0.05)
+
+    t = threading.Thread(target=append)
+    t.start()
+    reader = FileTailStreamingReader(str(path), batch_size=4,
+                                     poll_s=0.02, idle_timeout_s=0.5)
+    got = [b for b in reader.stream()]
+    t.join()
+    assert sum(len(b) for b in got) == 12
+    assert all(len(b) <= 4 for b in got)
+    assert got[0][0]["x1"] == rows[0]["x1"]
+
+
+def test_socket_streaming_parse_error_surfaces():
+    """A malformed line must RAISE in the consumer, not silently end the
+    stream (dropping the tail would be silent data loss)."""
+    import json
+    import socket
+    import threading
+
+    import pytest
+
+    from transmogrifai_tpu.readers import SocketStreamingReader
+
+    reader = SocketStreamingReader(batch_size=2).start()
+    host, port = reader.address
+
+    def produce():
+        with socket.create_connection((host, port)) as s:
+            s.sendall((json.dumps({"a": 1}) + "\n").encode())
+            s.sendall(b"{not json}\n")
+            s.sendall((json.dumps({"a": 2}) + "\n").encode())
+
+    t = threading.Thread(target=produce)
+    t.start()
+    with pytest.raises(json.JSONDecodeError):
+        list(reader.stream())
+    t.join()
+
+
+def test_file_tail_flushes_unterminated_final_line(tmp_path):
+    import json
+
+    from transmogrifai_tpu.readers import FileTailStreamingReader
+
+    path = tmp_path / "tail.jsonl"
+    path.write_text(json.dumps({"a": 1}) + "\n" + json.dumps({"a": 2}))  # no \n
+    reader = FileTailStreamingReader(str(path), batch_size=4,
+                                     poll_s=0.01, idle_timeout_s=0.05)
+    got = [r for b in reader.stream() for r in b]
+    assert got == [{"a": 1}, {"a": 2}]
+
+
 def test_csv_streaming_reader(tmp_path):
     for i in range(2):
         with open(tmp_path / f"b{i}.csv", "w", newline="") as fh:

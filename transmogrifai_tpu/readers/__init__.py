@@ -17,7 +17,9 @@ from .process_shard import ProcessShardedReader
 from .streaming import (
     BatchStreamingReader,
     CSVStreamingReader,
+    FileTailStreamingReader,
     QueueStreamingReader,
+    SocketStreamingReader,
     StreamingReader,
     rebatch,
 )
@@ -133,6 +135,8 @@ __all__ = [
     "BatchStreamingReader",
     "CSVStreamingReader",
     "QueueStreamingReader",
+    "SocketStreamingReader",
+    "FileTailStreamingReader",
     "rebatch",
     "KEY_COLUMN",
 ]

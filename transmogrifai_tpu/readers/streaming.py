@@ -90,6 +90,159 @@ def rebatch(batches: Iterable[list], batch_size: int) -> Iterator[list]:
         yield carry
 
 
+class SocketStreamingReader(StreamingReader):
+    """Line-delimited records over a TCP socket with BOUNDED buffering — the
+    analog of the reference's socket DStream source (StreamingReader.scala:54 /
+    Spark socketTextStream), completing the streaming-score run type's live
+    sources.
+
+    A daemon thread reads the connection, parses each line (default:
+    `json.loads`; pass `parse=str` for raw text) and accumulates fixed-size
+    batches onto a bounded queue. Backpressure is real end-to-end: when the
+    consumer falls behind, `put` blocks the reader thread, the kernel TCP
+    buffer fills, and the producer's `send` stalls — no unbounded memory.
+    `listen=True` (default) binds host:port and accepts ONE connection
+    (`port=0` picks an ephemeral port, exposed as `.address` after `start()`);
+    `listen=False` connects out to an existing server, the Spark shape.
+    `idle_timeout_s` ends the stream when no batch arrives for that long
+    (None = wait forever). A record the `parse` callable rejects ends the
+    stream and RE-RAISES in the consumer — silently dropping the rest of the
+    stream would be data loss."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 parse: Callable[[str], Any] = None, batch_size: int = 64,
+                 max_buffered_batches: int = 8, listen: bool = True,
+                 idle_timeout_s: Optional[float] = None):
+        import json as _json
+
+        self.host, self.port = host, int(port)
+        self.parse = parse if parse is not None else _json.loads
+        self.batch_size = int(batch_size)
+        self.listen = bool(listen)
+        # the bounded-queue + sentinel machinery is QueueStreamingReader's —
+        # one implementation of the close/drain contract in this module
+        self._q = QueueStreamingReader(maxsize=int(max_buffered_batches),
+                                       timeout=idle_timeout_s)
+        self._error: Optional[BaseException] = None
+        self._sock = None
+        self.address: Optional[tuple] = None
+
+    def start(self) -> "SocketStreamingReader":
+        """Bind/connect and launch the reader thread (idempotent; stream()
+        calls it lazily)."""
+        import socket
+        import threading
+
+        if self._sock is not None:
+            return self
+        if self.listen:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((self.host, self.port))
+            srv.listen(1)
+            self.address = srv.getsockname()
+            self._sock = srv
+        else:
+            cli = socket.create_connection((self.host, self.port))
+            self.address = cli.getpeername()
+            self._sock = cli
+        threading.Thread(target=self._pump, daemon=True).start()
+        return self
+
+    def _pump(self) -> None:
+        import socket
+
+        conn = self._sock
+        try:
+            if self.listen:
+                conn, _ = self._sock.accept()
+            batch: list = []
+            with conn, conn.makefile("r", encoding="utf-8") as lines:
+                for line in lines:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    batch.append(self.parse(line))
+                    if len(batch) >= self.batch_size:
+                        self._q.put(batch)  # blocks when full: backpressure
+                        batch = []
+            if batch:
+                self._q.put(batch)
+        except (OSError, socket.error):
+            pass  # connection dropped: end the stream with what arrived
+        except Exception as e:  # parse error: surface it, don't drop the tail
+            self._error = e
+        finally:
+            if self.listen:
+                self._sock.close()
+            self._q.close()
+
+    def stream(self) -> Iterator[list]:
+        self.start()
+        yield from self._q.stream()
+        if self._error is not None:
+            raise self._error
+
+
+class FileTailStreamingReader(StreamingReader):
+    """`tail -f` a line-delimited file as a micro-batch stream (the file-based
+    live source; pairs with SocketStreamingReader for the reference's
+    StreamingReaders surface). Synchronous by design: lines are only read when
+    the consumer pulls the next batch, so buffering is bounded by one batch —
+    backpressure needs no queue at all. `idle_timeout_s` turns a quiet file
+    into end-of-stream (None = tail forever); `from_start=False` starts at the
+    current end like tail -f."""
+
+    def __init__(self, path: str, parse: Callable[[str], Any] = None,
+                 batch_size: int = 64, poll_s: float = 0.05,
+                 idle_timeout_s: Optional[float] = 5.0, from_start: bool = True):
+        import json as _json
+
+        self.path = path
+        self.parse = parse if parse is not None else _json.loads
+        self.batch_size = int(batch_size)
+        self.poll_s = float(poll_s)
+        self.idle_timeout_s = idle_timeout_s
+        self.from_start = bool(from_start)
+
+    def stream(self) -> Iterator[list]:
+        import time as _time
+
+        with open(self.path, "r", encoding="utf-8") as fh:
+            if not self.from_start:
+                fh.seek(0, os.SEEK_END)
+            batch: list = []
+            idle = 0.0
+            carry = ""
+            while True:
+                chunk = fh.readline()
+                if chunk:
+                    idle = 0.0
+                    if not chunk.endswith("\n"):
+                        carry += chunk  # partial line: writer mid-append
+                        continue
+                    line = (carry + chunk).strip()
+                    carry = ""
+                    if line:
+                        batch.append(self.parse(line))
+                        if len(batch) >= self.batch_size:
+                            yield batch
+                            batch = []
+                    continue
+                if batch:
+                    yield batch  # flush on quiet file: bounded latency
+                    batch = []
+                if (self.idle_timeout_s is not None
+                        and idle >= self.idle_timeout_s):
+                    if carry.strip():
+                        # unterminated final line (no trailing newline): the
+                        # writer is done — parse and flush it, don't drop it
+                        yield [self.parse(carry.strip())]
+                    return
+                _time.sleep(self.poll_s)
+                idle += self.poll_s
+
+
 class CSVStreamingReader(StreamingReader):
     """Micro-batch a directory of CSV files, one batch per file, in name order
     (the file-based DStream analog — StreamingReaders.csvStream)."""
