@@ -527,7 +527,8 @@ def fit_forest(
     # per-level histogram memory by n_trees ON TOP of the selector's folds x grid
     # vmap — measured 18G of HBM for an 80-row dataset. lax.scan keeps one tree's
     # temps live; with the bin-wise-matmul histogram the per-step device cost is
-    # small enough that scan is within ~12% of full vmap anyway.
+    # small enough that scan is within ~12% of full vmap anyway (re-measured in
+    # r5: a tree-axis vmap for small fits was WITHIN NOISE on the iris search).
     _, (sfs, sts, lvs, fgs) = jax.lax.scan(
         lambda _, k: (None, one_tree(k)), None, keys
     )

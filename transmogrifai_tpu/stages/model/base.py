@@ -100,7 +100,16 @@ class PredictorEstimator(Estimator):
 
     @staticmethod
     def label_and_matrix(cols: Sequence[Column]):
-        y = jnp.asarray(np.asarray(cols[0].values), jnp.float32)
+        v = cols[0].values
+        if not isinstance(v, np.ndarray):
+            # host python values need numpy staging; a DEVICE-resident label
+            # column must NOT round-trip through np.asarray (a ~90ms blocking
+            # download on a tunneled device, measured on the iris steady train)
+            import jax as _jax
+
+            if not isinstance(v, _jax.Array):
+                v = np.asarray(v)
+        y = jnp.asarray(v, jnp.float32)
         X = jnp.asarray(cols[1].values, jnp.float32)
         return y, X
 
