@@ -33,6 +33,29 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+#: suites that refit full searches / run subprocesses — excluded from the
+#: `-m smoke` tier (SURVEY §4 quick loop: `pytest tests -m smoke` < 2 min)
+_SLOW_MODULES = frozenset({
+    "test_select", "test_selector_checkpoint", "test_workflow_cv",
+    "test_model_zoo_extra", "test_examples", "test_phase_checkpoint",
+    "test_stage_contracts", "test_stage_outputs", "test_insights",
+    "test_trees", "test_workflow", "test_wide_sharding",
+    "test_width_bucketing", "test_external_wrapper", "test_serve",
+})
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast tier (everything but the search-refit and "
+        "subprocess suites); run with -m smoke for a <2-min loop")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ not in _SLOW_MODULES:
+            item.add_marker(pytest.mark.smoke)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu_mesh():
     devs = jax.devices()

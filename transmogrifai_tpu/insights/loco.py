@@ -21,14 +21,26 @@ from ..types import Column, kind_of
 from ..types.vector_schema import VectorSchema
 
 
+#: memory cap for the auto-derived slot chunk: the sweep materializes
+#: [slot_batch, N, D] masked copies of X in f32
+_LOCO_SWEEP_BYTES = 1 << 28  # 256 MB
+
+
 def loco_deltas(predict_fn, X: jnp.ndarray, slot_batch: int = 0) -> jnp.ndarray:
     """Score deltas [N, D] for zeroing each slot: base_score - masked_score, taken on
     probability of the predicted class (binary: class 1; regression: the value).
 
-    predict_fn: X -> (pred, raw, prob). slot_batch > 0 chunks the vmap over slots to
-    bound memory at [slot_batch, N, D]."""
+    predict_fn: X -> (pred, raw, prob). slot_batch > 0 chunks the vmap over
+    slots to bound memory at [slot_batch, N, D]; slot_batch == 0 (default)
+    AUTO-derives the chunk from the vector width so a wide vector cannot OOM
+    (the full [D, N, D] sweep is 256 GB at N=64k, D=1k — ADVICE r04): the
+    largest slot chunk whose masked-copy tensor stays under ~256 MB."""
     X = jnp.asarray(X, jnp.float32)
     n, d = X.shape
+    if not slot_batch:
+        slot_batch = max(1, min(d, _LOCO_SWEEP_BYTES // max(n * d * 4, 1)))
+        if slot_batch == d:
+            slot_batch = 0  # whole sweep fits: single vmap, no chunk loop
     base_pred, _, base_prob = predict_fn(X)
     c = base_prob.shape[1]
     if c == 1:
@@ -47,11 +59,17 @@ def loco_deltas(predict_fn, X: jnp.ndarray, slot_batch: int = 0) -> jnp.ndarray:
 
     slots = jnp.arange(d)
     if slot_batch and slot_batch < d:
+        # pad the slot axis to a multiple of slot_batch so every chunk shares
+        # ONE compiled shape (a ragged tail would re-trace/re-compile the whole
+        # vmapped predict graph); pad slots mask a real column, their rows are
+        # sliced off below
+        pad = (-d) % slot_batch
+        slots_p = jnp.concatenate([slots, jnp.zeros(pad, slots.dtype)])
         chunks = [
-            jax.vmap(masked_score)(slots[i: i + slot_batch])
-            for i in range(0, d, slot_batch)
+            jax.vmap(masked_score)(slots_p[i: i + slot_batch])
+            for i in range(0, d + pad, slot_batch)
         ]
-        masked = jnp.concatenate(chunks, axis=0)  # [D, N]
+        masked = jnp.concatenate(chunks, axis=0)[:d]  # [D, N]
     else:
         masked = jax.vmap(masked_score)(slots)
     return base_prob[rows, score_col][:, None] - masked.T  # [N, D]
