@@ -17,6 +17,7 @@ active profiler those are zero-overhead no-ops.
 """
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -37,14 +38,19 @@ class Profiler:
     device_cost: dict[str, dict[str, float]] = field(default_factory=dict)
     trace_dir: Optional[str] = None
     _order: list[str] = field(default_factory=list)
+    _lock: "threading.Lock" = field(default_factory=lambda: threading.Lock())
 
     def add_phase(self, name: str, wall_s: float) -> None:
-        t = self.phases.get(name)
-        if t is None:
-            t = self.phases[name] = PhaseTiming(name)
-            self._order.append(name)
-        t.wall_s += wall_s
-        t.count += 1
+        # lock: phases report from worker threads too (warmup's parallel solo
+        # fits, the selector's overlapped unit compiles) — the check-then-create
+        # and the += pair would lose updates unprotected
+        with self._lock:
+            t = self.phases.get(name)
+            if t is None:
+                t = self.phases[name] = PhaseTiming(name)
+                self._order.append(name)
+            t.wall_s += wall_s
+            t.count += 1
 
     def add_cost(self, name: str, cost: dict[str, float]) -> None:
         self.device_cost[name] = dict(cost)

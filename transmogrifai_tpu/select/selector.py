@@ -11,6 +11,7 @@ evaluators. The search itself is device-batched (see validator.py).
 from __future__ import annotations
 
 import json
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -420,6 +421,7 @@ class ModelSelector(PredictorEstimator):
 #: searches win ever-different grid points must evict (ADVICE r03).
 _METRICS_PROGRAM_CACHE: OrderedDict = OrderedDict()
 _METRICS_PROGRAM_CACHE_MAX = 64
+_METRICS_PROGRAM_LOCK = threading.Lock()
 _EVALUATOR_CACHE: dict = {}
 
 
@@ -451,9 +453,12 @@ def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
     except TypeError:
         cfg = repr(sorted(template.params.items(), key=lambda kv: kv[0]))
     key = (template.__class__, cfg, problem_type, num_classes)
-    fn = _METRICS_PROGRAM_CACHE.get(key)
-    if fn is not None:
-        _METRICS_PROGRAM_CACHE.move_to_end(key)
+    # lock: warmup runs solo fits on threads (workflow/warmup.py), and the
+    # LRU's move_to_end/popitem pair is not safe under concurrent mutation
+    with _METRICS_PROGRAM_LOCK:
+        fn = _METRICS_PROGRAM_CACHE.get(key)
+        if fn is not None:
+            _METRICS_PROGRAM_CACHE.move_to_end(key)
     if fn is None:
         import jax
 
@@ -465,9 +470,11 @@ def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
             def prog(params, X, y):
                 pred, raw, prob = template.predict_fn(params, X)
                 return evaluator.device_metrics(pred, raw, prob, y)
-        fn = _METRICS_PROGRAM_CACHE[key] = jax.jit(prog)
-        while len(_METRICS_PROGRAM_CACHE) > _METRICS_PROGRAM_CACHE_MAX:
-            _METRICS_PROGRAM_CACHE.popitem(last=False)
+        fn = jax.jit(prog)
+        with _METRICS_PROGRAM_LOCK:
+            fn = _METRICS_PROGRAM_CACHE.setdefault(key, fn)
+            while len(_METRICS_PROGRAM_CACHE) > _METRICS_PROGRAM_CACHE_MAX:
+                _METRICS_PROGRAM_CACHE.popitem(last=False)
     return fn
 
 

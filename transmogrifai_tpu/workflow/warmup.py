@@ -94,18 +94,37 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     # are byte-identical to what a real train builds (hand-calling fit_fn +
     # _metrics_program here would have to mirror the selector's weight/label
     # plumbing and silently drift).
+    from concurrent.futures import ThreadPoolExecutor
+
     from ..select.selector import ModelSelector
     from ..select.validator import _group_grid
 
-    for template, grid in selector.models:
-        for _static, _stacks, points in _group_grid(template, grid):
-            solo = ModelSelector(problem_type=problem, metric=selector.metric,
-                                 models=[(template, [dict(points[0])])],
-                                 validator=selector.validator,
-                                 splitter=selector.splitter, seed=seed)
-            solo(FeatureBuilder("label", "RealNN").as_response(),
-                 FeatureBuilder("vec", "OPVector").as_predictor())
-            solo.fit_table(table)
+    def solo_fit(template, point):
+        solo = ModelSelector(problem_type=problem, metric=selector.metric,
+                             models=[(template, [dict(point)])],
+                             validator=selector.validator,
+                             splitter=selector.splitter, seed=seed)
+        solo(FeatureBuilder("label", "RealNN").as_response(),
+             FeatureBuilder("vec", "OPVector").as_predictor())
+        solo.fit_table(table)
+
+    units = [(template, points[0])
+             for template, grid in selector.models
+             for _static, _stacks, points in _group_grid(template, grid)]
+    # solo fits are independent warm-the-cache work: threads overlap their
+    # tracing (GIL-bound) with each other's XLA compiles / cache retrievals /
+    # device runs (GIL-released) — program caches are lock-protected.
+    # TT_PARALLEL_COMPILE=0 serializes here too (same deterministic-compile
+    # gate as the validator's overlapped unit compiles)
+    import os as _os
+
+    if (len(units) > 1
+            and _os.environ.get("TT_PARALLEL_COMPILE", "1") != "0"):
+        with ThreadPoolExecutor(min(4, len(units))) as ex:
+            list(ex.map(lambda u: solo_fit(*u), units))
+    else:
+        for template, point in units:
+            solo_fit(template, point)
     return {"problem": problem, "rows": int(rows), "width": int(width),
             "requested_width": requested,
             "wall_s": round(time.perf_counter() - t0, 2)}
