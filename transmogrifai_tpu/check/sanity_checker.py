@@ -31,6 +31,14 @@ from ..stages.base import Estimator, Transformer, register_stage
 from ..types import Column, kind_of
 from ..types.vector_schema import SlotInfo, VectorSchema
 
+
+@jax.jit
+def _onehot_contingency(Xd, flat_idx, yd, uniq):
+    """Indicator-slot gather + label one-hot + contingency tables as one
+    program (the SanityChecker's warm-label path; see fit_columns)."""
+    lab_oh = (yd[:, None] == uniq[None, :]).astype(jnp.float32)
+    return contingency_table(jnp.take(Xd, flat_idx, axis=1), lab_oh)
+
 _EPS = 1e-12
 
 
@@ -196,40 +204,69 @@ class SanityChecker(Estimator):
             Xd, yd = X_dev, y_dev
 
         # --- fused stats pass --------------------------------------------------------
-        # all programs dispatch async; ONE fetch returns stats + corr + label
+        # all programs dispatch async; ONE fetch returns stats + corr + label.
+        # The contingency tables need the label's UNIQUE values (host), which
+        # would force a SECOND fetch+dispatch+fetch (~0.13s of every steady
+        # train on a tunneled device) — so uniq is memoized on the label
+        # COLUMN object (the AutoML steady state re-trains fresh graphs on the
+        # same table): warm trains build the label one-hot ON DEVICE and the
+        # whole fit is ONE device_get.
         stats = column_stats(Xd)
         if p["corr_type"] == "spearman":
             corr = spearman_with_label(Xd, yd)
         else:
             corr = pearson_with_label(Xd, yd)
-        mean, var, mn, mx, corr, ys = jax.device_get(
-            (stats.mean, stats.variance, stats.min, stats.max, corr, yd))
+
+        groups = schema.groups()
+        ind_groups = [
+            (key, [i for i in idxs if schema[i].indicator_value is not None])
+            for key, idxs in groups.items()
+        ]
+        ind_groups = [(key, idxs) for key, idxs in ind_groups if idxs]
+        flat_idx = [i for _, idxs in ind_groups for i in idxs]
+
+        uniq_key = (p["check_sample"], p["sample_seed"],
+                    p["categorical_label_cardinality"])
+        cached = getattr(cols[0], "_sanity_label_uniq", None)
+        uniq = cached[1] if cached is not None and cached[0] == uniq_key else None
+
+        tables_dev = None
+        if uniq is not None and flat_idx \
+                and len(uniq) <= p["categorical_label_cardinality"]:
+            # warm path: slot gather + label one-hot + contingency as ONE
+            # jitted dispatch alongside the stats (eager jnp here would pay
+            # 4-6 serial ~17ms dispatches on a tunneled device — measured
+            # slower than the second fetch it replaces)
+            tables_dev = _onehot_contingency(
+                Xd, jnp.asarray(flat_idx), yd,
+                jnp.asarray(uniq, jnp.float32))
+        # yd is only consumed by the cold path's np.unique/one-hot — warm
+        # trains skip its transfer entirely
+        mean, var, mn, mx, corr, ys, all_tables = jax.device_get(
+            (stats.mean, stats.variance, stats.min, stats.max, corr,
+             yd if uniq is None else None, tables_dev))
 
         # --- categorical tests: per indicator group ----------------------------------
-        uniq = np.unique(ys)
+        if uniq is None:
+            uniq = np.unique(ys)
+            cols[0]._sanity_label_uniq = (uniq_key, uniq)
         label_is_categorical = len(uniq) <= p["categorical_label_cardinality"]
         group_cv: dict[tuple, float] = {}
         slot_conf = np.full(d, np.nan)
         slot_support = np.full(d, np.nan)
         slot_pmi: dict[int, list] = {}
         categorical_groups = []
-        groups = schema.groups()
         if label_is_categorical:
-            lab_oh = (ys[:, None] == uniq[None, :]).astype(np.float32)
-            # contingency stats are defined over 0/1 indicator slots only — a
-            # group can also carry continuous slots (e.g. a numeric value next
-            # to its null indicator), which must not enter the table. ALL groups'
-            # tables come from ONE device matmul (their rows are disjoint slot
-            # sets); per-group Cramér's V / rule stats are then O(K*C) numpy —
-            # the previous per-group device loop paid 2-3 dispatches + scalar
-            # fetches per group, a multi-second sync storm on a tunneled device.
-            ind_groups = [
-                (key, [i for i in idxs if schema[i].indicator_value is not None])
-                for key, idxs in groups.items()
-            ]
-            ind_groups = [(key, idxs) for key, idxs in ind_groups if idxs]
-            flat_idx = [i for _, idxs in ind_groups for i in idxs]
-            if flat_idx:
+            if all_tables is None and flat_idx:
+                # cold path (first train on this label column): the one-hot
+                # needs host uniq, so the tables are a second dispatch+fetch.
+                # contingency stats are defined over 0/1 indicator slots only —
+                # a group can also carry continuous slots (e.g. a numeric value
+                # next to its null indicator), which must not enter the table.
+                # ALL groups' tables come from ONE device matmul (their rows
+                # are disjoint slot sets); per-group Cramér's V / rule stats
+                # are then O(K*C) numpy.
+                lab_oh = (ys[:, None] == uniq[None, :]).astype(np.float32)
                 all_tables = np.asarray(contingency_table(
                     jnp.take(Xd, jnp.asarray(flat_idx), axis=1),
                     jnp.asarray(lab_oh)))

@@ -43,7 +43,11 @@ class Column:
     mask: bool array [N]; True = value present. None for vector/prediction/host storage.
     """
 
-    __slots__ = ("kind", "values", "mask", "schema", "_device_col")
+    # _device_col / _sanity_label_uniq: per-object memos (device residency;
+    # the SanityChecker's label-unique cache) — steady-state AutoML reuses one
+    # raw Table across trains, so column-attached caches amortize round trips
+    __slots__ = ("kind", "values", "mask", "schema", "_device_col",
+                 "_sanity_label_uniq")
 
     def __init__(
         self,
