@@ -230,9 +230,11 @@ class SanityChecker(Estimator):
         cached = getattr(cols[0], "_sanity_label_uniq", None)
         uniq = cached[1] if cached is not None and cached[0] == uniq_key else None
 
+        def is_categorical(u):
+            return len(u) <= p["categorical_label_cardinality"]
+
         tables_dev = None
-        if uniq is not None and flat_idx \
-                and len(uniq) <= p["categorical_label_cardinality"]:
+        if uniq is not None and flat_idx and is_categorical(uniq):
             # warm path: slot gather + label one-hot + contingency as ONE
             # jitted dispatch alongside the stats (eager jnp here would pay
             # 4-6 serial ~17ms dispatches on a tunneled device — measured
@@ -240,8 +242,8 @@ class SanityChecker(Estimator):
             tables_dev = _onehot_contingency(
                 Xd, jnp.asarray(flat_idx), yd,
                 jnp.asarray(uniq, jnp.float32))
-        # yd is only consumed by the cold path's np.unique/one-hot — warm
-        # trains skip its transfer entirely
+        # yd is only consumed by the cold path's np.unique — warm trains skip
+        # its transfer entirely
         mean, var, mn, mx, corr, ys, all_tables = jax.device_get(
             (stats.mean, stats.variance, stats.min, stats.max, corr,
              yd if uniq is None else None, tables_dev))
@@ -250,7 +252,7 @@ class SanityChecker(Estimator):
         if uniq is None:
             uniq = np.unique(ys)
             cols[0]._sanity_label_uniq = (uniq_key, uniq)
-        label_is_categorical = len(uniq) <= p["categorical_label_cardinality"]
+        label_is_categorical = is_categorical(uniq)
         group_cv: dict[tuple, float] = {}
         slot_conf = np.full(d, np.nan)
         slot_support = np.full(d, np.nan)
@@ -258,18 +260,19 @@ class SanityChecker(Estimator):
         categorical_groups = []
         if label_is_categorical:
             if all_tables is None and flat_idx:
-                # cold path (first train on this label column): the one-hot
-                # needs host uniq, so the tables are a second dispatch+fetch.
-                # contingency stats are defined over 0/1 indicator slots only —
+                # cold path (first train on this label column): host uniq was
+                # not known at dispatch time, so the tables are a second
+                # dispatch+fetch — through the SAME jitted program the warm
+                # path uses, which also pre-compiles it at these shapes.
+                # Contingency stats are defined over 0/1 indicator slots only —
                 # a group can also carry continuous slots (e.g. a numeric value
                 # next to its null indicator), which must not enter the table.
                 # ALL groups' tables come from ONE device matmul (their rows
                 # are disjoint slot sets); per-group Cramér's V / rule stats
                 # are then O(K*C) numpy.
-                lab_oh = (ys[:, None] == uniq[None, :]).astype(np.float32)
-                all_tables = np.asarray(contingency_table(
-                    jnp.take(Xd, jnp.asarray(flat_idx), axis=1),
-                    jnp.asarray(lab_oh)))
+                all_tables = np.asarray(_onehot_contingency(
+                    Xd, jnp.asarray(flat_idx), yd,
+                    jnp.asarray(uniq, jnp.float32)))
             pos = 0
             for key, idxs in ind_groups:
                 table = all_tables[pos:pos + len(idxs)]

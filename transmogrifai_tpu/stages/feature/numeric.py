@@ -42,15 +42,23 @@ class RealVectorizer(SequenceVectorizerEstimator):
 
     def fit_columns(self, cols: Sequence[Column]):
         if self.params["fill_value"] == "mean":
-            # ONE stacked device reduction + ONE host fetch for every column:
-            # a per-column float() would pay a full device round trip each (the
-            # dominant cost of this fit on a tunneled device)
-            masks = [jnp.asarray(c.effective_mask()) for c in cols]
-            means = jnp.stack([
-                (c.filled(0.0) * m).sum() / jnp.maximum(m.sum(), 1)
-                for c, m in zip(cols, masks)
-            ])
-            fills = [float(v) for v in np.asarray(means)]
+            # ONE stacked device reduction + ONE host fetch for every column
+            # that doesn't already carry its mean — and the mean is memoized
+            # on the COLUMN object, so steady-state AutoML (fresh graphs over
+            # the same raw table) pays ZERO round trips here after the first
+            # train (per-column float() would be a ~100ms round trip each,
+            # and even the fused fetch is ~100ms per train on a tunnel)
+            missing = [c for c in cols
+                       if getattr(c, "_mean_fill", None) is None]
+            if missing:
+                masks = [jnp.asarray(c.effective_mask()) for c in missing]
+                means = jnp.stack([
+                    (c.filled(0.0) * m).sum() / jnp.maximum(m.sum(), 1)
+                    for c, m in zip(missing, masks)
+                ])
+                for c, v in zip(missing, np.asarray(means)):
+                    c._mean_fill = float(v)
+            fills = [c._mean_fill for c in cols]
         else:
             fills = [float(self.params["fill_value"])] * len(cols)
         return RealVectorizerModel(

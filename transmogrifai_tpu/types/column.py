@@ -47,7 +47,7 @@ class Column:
     # the SanityChecker's label-unique cache) — steady-state AutoML reuses one
     # raw Table across trains, so column-attached caches amortize round trips
     __slots__ = ("kind", "values", "mask", "schema", "_device_col",
-                 "_sanity_label_uniq")
+                 "_sanity_label_uniq", "_mean_fill")
 
     def __init__(
         self,
