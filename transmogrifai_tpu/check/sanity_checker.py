@@ -15,6 +15,7 @@ SanityCheckerSummary (the SanityCheckerMetadata analog) carried by the fitted mo
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Optional, Sequence
 
 import jax
@@ -366,6 +367,17 @@ class SanityChecker(Estimator):
         return model
 
 
+@partial(jax.jit, static_argnames=("pad_to",))
+def _select_pad_kernel(vec, keep, pad_to):
+    """Column subset + pad as one module-level shape-keyed program."""
+    from ..types.vector_schema import pad_vector_values
+
+    out = jnp.take(jnp.asarray(vec, jnp.float32), keep, axis=1)
+    if pad_to > out.shape[1]:
+        out = pad_vector_values(out, None, pad_to)[0]
+    return out
+
+
 @register_stage
 class SanityCheckerModel(Transformer):
     """Fitted column-subset transform: keep the surviving slots, re-derive the schema."""
@@ -373,6 +385,13 @@ class SanityCheckerModel(Transformer):
     operation_name = "sanityChecker"
     arity = (2, 2)
     device_op = True
+    #: the device work dispatches to the module-level shape-keyed kernel above
+    #: with keep-indices as an ARGUMENT. Fusing this stage into the per-plan
+    #: jit instead keyed the program on its input's uid-suffixed name (the
+    #: combiner's output) — a fresh ~60-90ms retrace+compile on EVERY steady
+    #: train (caught by the round-5 compile-log soak; same class of offender
+    #: as the r4 VectorsCombiner fix).
+    kernel_jitted = True
 
     def __init__(self, keep_indices: Sequence[int] = (), dropped: Sequence[str] = (),
                  pad_to: int = 0):
@@ -389,11 +408,9 @@ class SanityCheckerModel(Transformer):
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         vec = cols[1]
         keep = jnp.asarray(self.params["keep_indices"], jnp.int32)
-        out = jnp.take(jnp.asarray(vec.values, jnp.float32), keep, axis=1)
-        schema = vec.schema.select(self.params["keep_indices"]) if vec.schema else None
         pad_to = self.params.get("pad_to", 0)
-        if pad_to > out.shape[1]:  # keep the downstream width compile-stable
-            from ..types.vector_schema import pad_vector_values
-
-            out, schema = pad_vector_values(out, schema, pad_to)
+        out = _select_pad_kernel(vec.values, keep, pad_to)
+        schema = vec.schema.select(self.params["keep_indices"]) if vec.schema else None
+        if schema is not None and pad_to > schema.size:
+            schema = schema.pad_to(pad_to)
         return Column.vector(out, schema=schema)
