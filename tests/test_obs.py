@@ -1,0 +1,275 @@
+"""obs tracer + compile watchdog: span nesting (including across threads),
+compile-event attribution, Chrome-trace export schema, retrace budgets,
+cached-lowering cost capture, and the profiling back-compat facade."""
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obs, profiling
+
+
+# --- no-op without a tracer -------------------------------------------------------------
+def test_span_is_noop_without_tracer():
+    assert obs.current() is None
+    assert obs.current_span() is None
+    with obs.span("anything") as sp:
+        assert sp is None
+    obs.record_cost("x", jax.jit(lambda a: a), jnp.ones(3))  # must not raise
+    assert obs.current() is None
+
+
+# --- span tree --------------------------------------------------------------------------
+def test_span_nesting_and_report_superset():
+    with obs.trace() as t:
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+            with obs.span("inner"):
+                pass
+    assert t.phases["inner"].count == 2
+    rep = t.report()
+    # legacy Profiler.report() shape survives...
+    names = [p["name"] for p in rep["phases"]]
+    assert "inner" in names and "outer" in names
+    assert all(p["wall_s"] >= 0 for p in rep["phases"])
+    # ...plus the new sections
+    tree = rep["spans"]
+    assert tree["name"] == "run"
+    outer = tree["children"][0]
+    assert outer["name"] == "outer"
+    assert [c["name"] for c in outer["children"]] == ["inner", "inner"]
+    assert set(rep["compiles"]["counts"]) == set(obs.tracer.COMPILE_KINDS)
+
+
+def test_span_nesting_across_threads():
+    """Warmup's parallel solo fits: a worker-thread span with an explicit
+    parent nests under it; an unparented worker span attaches to the root."""
+    with obs.trace() as t:
+        with obs.span("parent") as parent:
+            def worker():
+                with obs.span("child", parent=parent):
+                    pass
+                with obs.span("orphan"):
+                    pass
+
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+    tree = t.report()["spans"]
+    parent_node = next(c for c in tree["children"] if c["name"] == "parent")
+    assert [c["name"] for c in parent_node.get("children", ())] == ["child"]
+    assert any(c["name"] == "orphan" for c in tree["children"])
+
+
+# --- compile attribution ----------------------------------------------------------------
+def test_compile_events_attributed_to_named_span():
+    def freshly_named_program(x):
+        return x @ x.T - x.sum()
+
+    with obs.trace() as t:
+        with obs.span("hot"):
+            jax.jit(freshly_named_program)(jnp.ones((8, 8))).block_until_ready()
+    rep = t.compile_report()
+    # lower always fires for a fresh program; the executable either compiles
+    # or (when an earlier run left it in the persistent cache) retrieves
+    assert rep["counts"]["lower"] >= 1
+    assert rep["counts"]["compile"] + rep["counts"]["cache_hit"] >= 1
+    mine = [e for e in rep["events"] if e["program"] == "freshly_named_program"]
+    assert mine, rep["events"]
+    assert all(e["span"].endswith("run/hot") for e in mine)
+    assert any(e["kind"] in ("compile", "cache_hit") and e["duration_s"] > 0
+               for e in mine)
+    # by_span rollup points at the same place
+    assert "run/hot" in rep["by_span"]
+
+
+def test_warm_calls_produce_no_events():
+    f = jax.jit(lambda x: x * 2 + 1)
+    f(jnp.ones(7))  # compile outside
+    with obs.trace() as t:
+        with obs.span("steady"):
+            f(jnp.ones(7)).block_until_ready()
+    counts = t.compile_report()["counts"]
+    assert counts["lower"] == 0 and counts["compile"] == 0
+
+
+# --- Chrome trace export ----------------------------------------------------------------
+def test_chrome_export_schema(tmp_path):
+    def chrome_probe_fn(x):
+        return jnp.sin(x) + 2
+
+    with obs.trace() as t:
+        with obs.span("alpha"):
+            with obs.span("beta"):
+                jax.jit(chrome_probe_fn)(jnp.ones(5))
+    path = t.export_chrome(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M", "i")
+        assert "name" in ev and "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+    span_names = {e["name"] for e in doc["traceEvents"] if e.get("cat") == "span"}
+    assert {"run", "alpha", "beta"} <= span_names
+    compile_evs = [e for e in doc["traceEvents"] if e.get("cat") == "compile"]
+    assert any("chrome_probe_fn" in e["name"] for e in compile_evs)
+    # spans nest in time: child interval inside parent interval
+    by_name = {e["name"]: e for e in doc["traceEvents"] if e.get("cat") == "span"}
+    a, b = by_name["alpha"], by_name["beta"]
+    assert a["ts"] <= b["ts"] and b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e3
+
+
+def test_text_tree_one_screen():
+    with obs.trace() as t:
+        with obs.span("phase_one"):
+            pass
+        for i in range(100):
+            with obs.span(f"s{i}"):
+                pass
+    tree = t.text_tree(max_lines=30)
+    lines = tree.splitlines()
+    assert len(lines) <= 31
+    assert "phase_one" in tree and "more spans" in lines[-1]
+
+
+# --- retrace budget ---------------------------------------------------------------------
+def test_retrace_budget_raises_on_fresh_compile():
+    with pytest.raises(obs.RetraceBudgetExceeded) as exc:
+        with obs.retrace_budget(0):
+            jax.jit(lambda x: x * 31 + 5)(jnp.ones(9))
+    assert exc.value.events
+
+
+def test_retrace_budget_allows_warm_path():
+    f = jax.jit(lambda x: x * 13)
+    f(jnp.ones(6))
+    with obs.retrace_budget(0):
+        f(jnp.ones(6)).block_until_ready()
+
+
+def test_retrace_budget_warn_action(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="transmogrifai_tpu.obs"):
+        with obs.retrace_budget(0, action="warn") as budget:
+            jax.jit(lambda x: x - 17)(jnp.ones(11))
+    assert budget.count > 0
+    assert any("retrace budget" in r.message for r in caplog.records)
+
+
+def test_retrace_budget_nonzero_and_kind_filter():
+    with obs.retrace_budget(8) as b:  # generous budget: records but passes
+        jax.jit(lambda x: x + 23)(jnp.ones(13))
+    assert 0 < b.count <= 8
+    # counting only backend compiles ignores cache-absorbed retraces
+    f = jax.jit(lambda x: x * 29)
+    f(jnp.ones(15))
+    with obs.retrace_budget(0, kinds=("compile",)):
+        f(jnp.ones(15))
+
+
+def test_does_not_disturb_jax_logging_config():
+    import logging
+
+    lg = logging.getLogger("jax._src.dispatch")
+    level, prop = lg.level, lg.propagate
+    with obs.trace():
+        jax.jit(lambda x: x + 41)(jnp.ones(2))
+    assert lg.level == level and lg.propagate == prop
+
+
+# --- cached lowering / cost capture -----------------------------------------------------
+def test_cached_compiled_no_second_backend_compile():
+    f = jax.jit(lambda x: (x * x).sum())
+    x = jnp.ones((32, 32))
+    f(x)
+    first = obs.cached_compiled(f, x)
+    # the memoized Compiled makes every later cost lookup free: no lowering,
+    # no backend compile — the old double-lowering bug paid one per lookup
+    with obs.retrace_budget(0, kinds=("lower", "compile")):
+        again = obs.cached_compiled(f, x)
+        fl = obs.compiled_flops(f, x)
+    assert again is first
+    assert fl is not None and fl > 0
+    # distinct signature -> distinct entry
+    y = jnp.ones((16, 16))
+    assert obs.cached_compiled(f, y) is not first
+
+
+def test_record_cost_lands_on_tracer_and_span():
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((8, 8))
+    f(x)
+    with obs.trace() as t:
+        with obs.span("costed"):
+            obs.record_cost("prog", f, x)
+    assert "prog" in t.device_cost
+    rep = t.report()
+    assert rep["device_cost"]["programs"]["prog"].get("flops", 0) > 0
+    costed = next(c for c in rep["spans"]["children"] if c["name"] == "costed")
+    assert costed.get("cost", {}).get("flops", 0) > 0
+
+
+# --- profiling facade back-compat -------------------------------------------------------
+def test_profiling_facade_compat():
+    assert profiling.current() is None
+    with profiling.phase("anything"):
+        pass
+    with profiling.profile() as prof:
+        with profiling.phase("a"):
+            pass
+        with profiling.phase("a"):
+            pass
+    assert isinstance(prof, profiling.Profiler)
+    assert prof.phases["a"].count == 2
+    legacy = prof.report()
+    assert [p["name"] for p in legacy["phases"]] == ["a"]
+    assert profiling.current() is None
+
+
+def test_runner_emits_trace_section():
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    rng = np.random.default_rng(0)
+    rows = [{"label": float(rng.random() > 0.5), "x1": float(rng.normal()),
+             "cat": "abc"[int(rng.integers(0, 3))]} for _ in range(120)]
+    fs = features_from_schema({"label": "RealNN", "x1": "Real",
+                               "cat": "PickList"}, response="label")
+    vec = transmogrify([fs["x1"], fs["cat"]])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    reader = InMemoryReader(rows)
+    runner = WorkflowRunner(Workflow().set_result_features(pred),
+                            train_reader=reader, score_reader=reader,
+                            evaluator=Evaluators.binary_classification("label", pred))
+    seen = []
+    runner.add_application_end_handler(seen.append)
+    runner.run("train", OpParams(collect_stage_metrics=True))
+    m = seen[0]
+    # legacy profile keys unchanged; span tree + compile attribution in trace
+    assert set(m.profile) <= {"phases", "device_cost", "trace_dir"}
+    assert any(p["name"].startswith("fit:") for p in m.profile["phases"])
+    assert m.trace is not None
+    assert m.trace["spans"]["name"] == "train"
+    span_names = set()
+
+    def walk(n):
+        span_names.add(n["name"])
+        for c in n.get("children", ()):
+            walk(c)
+
+    walk(m.trace["spans"])
+    assert "workflow:train" in span_names
+    assert any(n.startswith("fit:") for n in span_names)
+    assert "counts" in m.trace["compiles"]
+    assert "trace" in m.to_dict()

@@ -1,0 +1,120 @@
+"""Enforced compile invariants (the rounds-4/5 soak methodology as pytest):
+
+1. Steady-state trains must not compile: after the first train of a
+   titanic-like pipeline (transmogrify -> SanityChecker -> ModelSelector) in a
+   process, later identical-shape trains run entirely on cached programs.
+   Locks in the round-4 VectorsCombiner and round-5 SanityCheckerModel
+   kernel-dispatch fixes: reintroducing a per-train retrace (e.g. a per-call
+   jax.jit closure in SanityCheckerModel.transform_columns) fails this test.
+
+2. op_warmup must cover the regression lane's shapes: a real selector fit at
+   the exact (rows, width, folds, splitter, family) warmup ran compiles
+   NOTHING — the BENCH_r04->r05 boston first-train 3.8x slip was warmup
+   losing coverage of a shape/family group, and nothing guarded it. Asserts
+   compile events, not wall-clock, so it is CI-stable.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.check.sanity_checker import SanityChecker
+from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import (
+    ParamGridBuilder,
+    RegressionModelSelector,
+)
+from transmogrifai_tpu.select.selector import ModelSelector
+from transmogrifai_tpu.select.splitters import DataSplitter
+from transmogrifai_tpu.select.validator import CrossValidation
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LinearRegression, LogisticRegression
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.types.vector_schema import SlotInfo, VectorSchema
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _rows(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"label": float(rng.random() > 0.5), "x": float(rng.normal()),
+             "cat": f"v{rng.integers(0, 5)}"} for _ in range(n)]
+
+
+def _train(table):
+    """Fresh graph every call — the AutoML steady state retrains new graphs on
+    the same table, which is exactly where per-train retraces used to hide."""
+    fs = features_from_schema({"label": "RealNN", "x": "Real",
+                               "cat": "PickList"}, response="label")
+    vector = transmogrify([fs["x"], fs["cat"]])
+    checked = SanityChecker(min_variance=1e-9)(fs["label"], vector)
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=10),
+                 ParamGridBuilder().add("l2", [0.0, 0.01]).build())],
+        validator=CrossValidation(num_folds=2, seed=5),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=5),
+    )
+    pred = sel(fs["label"], checked)
+    return Workflow().set_result_features(pred).train(table=table)
+
+
+def test_steady_state_trains_do_not_compile():
+    fs = features_from_schema({"label": "RealNN", "x": "Real",
+                               "cat": "PickList"}, response="label")
+    table = InMemoryReader(_rows()).generate_table(list(fs.values()))
+    _train(table)  # cold: compiles everything
+    _train(table)  # settle any second-train-only work (uniq memoization etc.)
+    for _ in range(3):
+        with obs.retrace_budget(0):  # lower+compile: cache hits can't hide it
+            _train(table)
+
+
+# --- warmup coverage guard (regression lane) --------------------------------------------
+_ROWS, _WIDTH, _FOLDS, _SEED = 256, 16, 2, 0
+
+
+def _reg_models():
+    return [(LinearRegression(),
+             ParamGridBuilder().add("l2", [0.0, 0.01]).build())]
+
+
+def _reg_fit(rows, seed=7):
+    """A real regression selector fit at warmup's shapes (same constructors
+    warmup itself builds: default splitter, CV folds, synthetic vector)."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, _WIDTH)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + rng.normal(size=rows)).astype(np.float32)
+    sel = RegressionModelSelector.with_cross_validation(
+        num_folds=_FOLDS, models=_reg_models(), seed=_SEED)
+    sel(FeatureBuilder("label", "RealNN").as_response(),
+        FeatureBuilder("vec", "OPVector").as_predictor())
+    schema = VectorSchema(tuple(
+        SlotInfo("warm", "Real", descriptor=f"w{i}") for i in range(_WIDTH)))
+    table = Table({
+        "label": Column.build("RealNN", [float(v) for v in y]),
+        "vec": Column.vector(jnp.asarray(X), schema=schema),
+    })
+    sel.fit_table(table)
+    return sel
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_warmup_covers_regression_first_train():
+    from transmogrifai_tpu.workflow.warmup import warmup
+
+    warmup(problem="regression", rows=_ROWS, width=_WIDTH,
+           models=_reg_models(), num_folds=_FOLDS, seed=_SEED)
+    # first REAL train at the warmed shapes: nothing may compile — not even
+    # when the winning grid point differs from the one warmup solo-fitted
+    # (the metrics-program key excludes vmap params for exactly this reason)
+    with obs.retrace_budget(0):
+        sel = _reg_fit(_ROWS)
+    assert sel.summary_.best_model_name == "LinearRegression"
+
+    # negative control: a shape warmup did NOT cover must be VISIBLE to the
+    # watchdog (counted as lowerings regardless of persistent-cache state) —
+    # proves the guard above cannot pass vacuously
+    with obs.trace() as t:
+        _reg_fit(_ROWS + 128)
+    assert t.compile_report()["counts"]["lower"] > 0

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Compare two benchmark records and fail on regression.
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json [--threshold 0.25]
+
+Accepts the driver's BENCH_*.json wrapper ({"parsed": {"summary": {...}}}),
+a bare {"summary": {...}} record, or a flat {metric: value} JSON. Every scalar
+metric present in BOTH files is compared; direction is inferred from the name
+(seconds/latency metrics regress upward, throughput/quality metrics regress
+downward). Exits non-zero when any shared metric regressed by more than the
+threshold (default 25%) — the guard the r04->r05 boston first-train 3.8x slip
+(2.349 s -> 8.828 s) shipped straight past.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+#: unit SUFFIXES marking "lower is better" (wall clock, latency) — suffix-only,
+#: so a mid-name "_s" (best_score, n_samples_used) cannot flip the direction
+_LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
+#: name fragments marking "lower is better" anywhere in the name
+_LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99")
+#: overrides: fragments that look like seconds but are throughput/quality
+_HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
+                  "accuracy", "auroc", "aupr", "r2", "f1", "speedup",
+                  "tflops", "flops")
+
+
+def lower_is_better(name: str) -> bool:
+    n = name.lower()
+    if any(frag in n for frag in _HIGHER_BETTER):
+        return False
+    return (any(n.endswith(suf) for suf in _LOWER_SUFFIXES)
+            or any(frag in n for frag in _LOWER_SUBSTR))
+
+
+def load_summary(path: str) -> dict[str, float]:
+    """Extract the flat {metric: scalar} dict from any supported shape."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if isinstance(doc, dict) and isinstance(doc.get("summary"), dict):
+        doc = doc["summary"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: no metric dict found")
+    return {k: float(v) for k, v in doc.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def compare(old: dict[str, float], new: dict[str, float],
+            threshold: float = 0.25) -> list[dict]:
+    """Rows for every shared metric; row["regressed"] marks >threshold slips."""
+    rows = []
+    for name in sorted(set(old) & set(new)):
+        a, b = old[name], new[name]
+        lower = lower_is_better(name)
+        ratio: Optional[float] = (b / a) if a else None
+        if a == 0:
+            regressed = lower and b > 0
+        elif lower:
+            regressed = b > a * (1.0 + threshold)
+        else:
+            regressed = b < a * (1.0 - threshold)
+        rows.append({"metric": name, "old": a, "new": b, "ratio": ratio,
+                     "direction": "lower" if lower else "higher",
+                     "regressed": regressed})
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_*.json records; exit 1 on >threshold "
+                    "regression of any shared scalar metric")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="fractional regression tolerance (default 0.25)")
+    args = ap.parse_args(argv)
+
+    rows = compare(load_summary(args.old), load_summary(args.new),
+                   threshold=args.threshold)
+    if not rows:
+        print("bench_diff: no shared scalar metrics", file=sys.stderr)
+        return 2
+    width = max(len(r["metric"]) for r in rows)
+    for r in rows:
+        flag = "REGRESSED" if r["regressed"] else ""
+        ratio = f"{r['ratio']:.3f}x" if r["ratio"] is not None else "   -  "
+        print(f"{r['metric']:<{width}}  {r['old']:>12.4g}  ->  "
+              f"{r['new']:>12.4g}  {ratio:>8}  ({r['direction']} is better)"
+              f"  {flag}")
+    bad = [r for r in rows if r["regressed"]]
+    if bad:
+        print(f"\nbench_diff: {len(bad)} metric(s) regressed more than "
+              f"{args.threshold:.0%}: "
+              + ", ".join(r["metric"] for r in bad), file=sys.stderr)
+        return 1
+    print(f"\nbench_diff: ok ({len(rows)} shared metrics within "
+          f"{args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

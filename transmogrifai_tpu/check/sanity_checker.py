@@ -245,9 +245,12 @@ class SanityChecker(Estimator):
                 jnp.asarray(uniq, jnp.float32))
         # yd is only consumed by the cold path's np.unique — warm trains skip
         # its transfer entirely
-        mean, var, mn, mx, corr, ys, all_tables = jax.device_get(
-            (stats.mean, stats.variance, stats.min, stats.max, corr,
-             yd if uniq is None else None, tables_dev))
+        from .. import obs
+
+        with obs.span("sanity_checker:stats_fetch"):
+            mean, var, mn, mx, corr, ys, all_tables = jax.device_get(
+                (stats.mean, stats.variance, stats.min, stats.max, corr,
+                 yd if uniq is None else None, tables_dev))
 
         # --- categorical tests: per indicator group ----------------------------------
         if uniq is None:

@@ -26,6 +26,15 @@ def _cmd_run(argv) -> int:
     ap.add_argument("--model-location", default=None)
     ap.add_argument("--write-location", default=None)
     ap.add_argument("--metrics-location", default=None)
+    ap.add_argument("--trace", action="store_true",
+                    help="print a one-screen span tree (wall time + XLA "
+                         "compile attribution) to stderr after the run")
+    ap.add_argument("--trace-chrome", default=None, metavar="PATH",
+                    help="write a Chrome-trace/Perfetto JSON of the run "
+                         "(load at ui.perfetto.dev)")
+    ap.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="capture an on-disk jax.profiler trace for "
+                         "TensorBoard/XProf")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.params import OpParams
@@ -42,7 +51,22 @@ def _cmd_run(argv) -> int:
         return 2
     sys.path.insert(0, ".")
     runner = getattr(importlib.import_module(mod_name), fn_name)()
-    result = runner.run(args.run_type, params)
+    if args.trace or args.trace_chrome or args.trace_dir:
+        from transmogrifai_tpu import obs
+
+        # CLI-level tracer wraps the runner's own (inner spans nest under the
+        # innermost active tracer; this outer one sees everything, including
+        # model load and result persistence)
+        with obs.trace(trace_dir=args.trace_dir, name=args.run_type) as tracer:
+            result = runner.run(args.run_type, params)
+        if args.trace:
+            print(tracer.text_tree(), file=sys.stderr)
+        if args.trace_chrome:
+            tracer.export_chrome(args.trace_chrome)
+            print(f"chrome trace written to {args.trace_chrome}",
+                  file=sys.stderr)
+    else:
+        result = runner.run(args.run_type, params)
     line = {k: v for k, v in vars(result).items() if v is not None and k != "metrics"}
     if result.metrics is not None:
         m = result.metrics

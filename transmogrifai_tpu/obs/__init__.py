@@ -1,0 +1,108 @@
+"""obs — unified span tracer + XLA compile/retrace watchdog.
+
+The runtime-telemetry substrate for every layer of the stack (the structured
+successor to the flat `profiling` phase timer; see docs/observability.md):
+
+    from transmogrifai_tpu import obs
+
+    with obs.trace() as t:
+        runner.run("train", params)
+    print(t.text_tree())            # one-screen span tree with compile counts
+    t.export_chrome("trace.json")   # load at ui.perfetto.dev
+    t.compile_report()              # what compiled, attributed to spans
+
+    with obs.retrace_budget(0):     # steady state must not compile
+        model = workflow.train(table=table)
+
+`obs.span("name")` is a zero-overhead no-op without an active tracer, so
+library code annotates unconditionally. All of `workflow`, `select`, `serve`,
+`check`, and the warmup path carry spans.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+from .cost import cached_compiled, compiled_flops, cost_analysis, record_cost
+from .tracer import CompileEvent, PhaseTiming, Span, Tracer
+from .watchdog import RetraceBudget, RetraceBudgetExceeded
+from .watchdog import activate as _activate
+from .watchdog import deactivate as _deactivate
+
+__all__ = [
+    "CompileEvent", "PhaseTiming", "RetraceBudget", "RetraceBudgetExceeded",
+    "Span", "Tracer", "cached_compiled", "compiled_flops", "cost_analysis",
+    "current", "current_span", "record_cost", "retrace_budget", "span",
+    "trace",
+]
+
+#: innermost-first stack of active tracers (module-global, shared across
+#: threads on purpose: a tracer opened on the main thread must see spans and
+#: compiles from warmup's worker threads)
+_ACTIVE: list[Tracer] = []
+
+
+def current() -> Optional[Tracer]:
+    """The innermost active tracer, or None."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def current_span() -> Optional[Span]:
+    """The calling thread's innermost open span of the active tracer (the
+    tracer root when no span is open), or None without a tracer. Capture this
+    before handing work to a thread pool and pass it as `span(..., parent=)`
+    to nest worker-side spans under the caller."""
+    t = current()
+    return t.current_span() if t is not None else None
+
+
+@contextmanager
+def trace(trace_dir: Optional[str] = None, name: str = "run"):
+    """Activate a Tracer for the dynamic extent; optionally also capture an
+    on-disk jax.profiler trace viewable in TensorBoard/XProf (trace_dir)."""
+    tracer = Tracer(trace_dir=trace_dir, name=name)
+    _ACTIVE.append(tracer)
+    _activate(tracer, "tracer")
+    started_trace = False
+    try:
+        # inside the try: a start_trace failure (unwritable dir, a profiler
+        # trace already running) must still unwind the tracer stack and the
+        # watchdog's logger takeover
+        if trace_dir is not None:
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            started_trace = True
+        yield tracer
+    finally:
+        if started_trace:
+            import jax
+
+            jax.profiler.stop_trace()
+        _deactivate(tracer, "tracer")
+        _ACTIVE.remove(tracer)
+        tracer.finish()
+
+
+@contextmanager
+def span(name: str, parent: Optional[Span] = None):
+    """Open a named span on the active tracer; no-op without one."""
+    t = current()
+    if t is None:
+        yield None
+        return
+    with t.span(name, parent=parent) as sp:
+        yield sp
+
+
+def retrace_budget(budget: int = 0, kinds=("lower", "compile"),
+                   action: str = "raise") -> RetraceBudget:
+    """Enforce "at most `budget` compilation events in this block".
+
+    Counts XLA pipeline events of the given kinds ("trace", "lower",
+    "compile", "cache_hit"); the default catches any program (re)build even
+    when the persistent compile cache absorbs the backend compile. With
+    action="raise" the violation raises RetraceBudgetExceeded at context exit;
+    "warn" logs each excess event instead.
+    """
+    return RetraceBudget(budget=budget, kinds=kinds, action=action)

@@ -1,0 +1,100 @@
+"""XLA cost-model capture with a cached lowering.
+
+The old `profiling.record_cost`/`compiled_flops` called
+`jitted_fn.lower(*args).compile()` every time — the AOT path does not share
+executables with the function's own call cache, so each cost lookup paid a
+full second backend compile of an already-compiled program. Here the Compiled
+object is memoized per (jitted function, abstract input signature): the first
+lookup pays one AOT compile (or a persistent-cache retrieval), every later
+lookup on the warm path is a dict hit.
+
+The cache holds weak references to the jitted functions, so per-fit jit
+wrappers (the selector builds them per search) do not leak; entries evict when
+the function is collected.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Optional
+
+#: id(fn) -> (weakref to fn, {signature: Compiled})
+_CACHE: dict[int, tuple[Any, dict]] = {}
+_LOCK = threading.Lock()
+
+_COST_KEYS = ("flops", "bytes accessed", "utilization operand 0 {}")
+
+
+def _leaf_sig(leaf) -> tuple:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(shape), str(dtype))
+    return ("o", type(leaf).__name__, leaf if isinstance(
+        leaf, (int, float, bool, str, bytes, type(None))) else id(leaf))
+
+
+def _signature(args, kwargs) -> tuple:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (treedef, tuple(_leaf_sig(x) for x in leaves))
+
+
+def cached_compiled(jitted_fn, *args, **kwargs):
+    """`jitted_fn.lower(*args).compile()`, memoized on (fn, input signature)."""
+    key = id(jitted_fn)
+    sig = _signature(args, kwargs)
+    with _LOCK:
+        entry = _CACHE.get(key)
+        if entry is not None and entry[0]() is not None:
+            hit = entry[1].get(sig)
+            if hit is not None:
+                return hit
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    with _LOCK:
+        entry = _CACHE.get(key)
+        if entry is None or entry[0]() is None:
+            try:
+                ref = weakref.ref(jitted_fn,
+                                  lambda _r, _k=key: _CACHE.pop(_k, None))
+            except TypeError:  # not weakrefable: still cache, pinning the fn
+                ref = (lambda fn: (lambda: fn))(jitted_fn)
+            entry = _CACHE[key] = (ref, {})
+        entry[1][sig] = compiled
+    return compiled
+
+
+def cost_analysis(compiled) -> dict[str, float]:
+    """Normalize Compiled.cost_analysis() across jax versions (list vs dict)."""
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return {k: float(v) for k, v in dict(analysis).items()
+            if isinstance(v, (int, float))}
+
+
+def record_cost(name: str, jitted_fn, *args, **kwargs) -> None:
+    """Attach the XLA cost-model estimate of a jitted program to the active
+    tracer (flops / bytes accessed — the compiler's own numbers, not wall-clock
+    measurement). Free on the warm path; no-op without an active tracer."""
+    from . import current
+
+    tracer = current()
+    if tracer is None:
+        return
+    try:
+        full = cost_analysis(cached_compiled(jitted_fn, *args, **kwargs))
+        tracer.add_cost(name, {k: v for k, v in full.items() if k in _COST_KEYS})
+    except Exception:
+        # cost analysis is best-effort: some backends/fns don't expose it
+        pass
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation per XLA's own cost model (not wall-clock)."""
+    try:
+        full = cost_analysis(cached_compiled(jitted_fn, *args, **kwargs))
+        return float(full.get("flops", 0.0))
+    except Exception:
+        return None

@@ -1,0 +1,359 @@
+"""Hierarchical span tracer with XLA compile attribution.
+
+The structured successor to the flat phase timer (`profiling.py`): spans carry
+parent/child structure, wall time, the XLA cost model's FLOP/byte estimates,
+device memory deltas (where the backend exposes `memory_stats()`), and — the
+headline — every XLA compilation event observed while the span was the calling
+thread's innermost open span. That last part is what turns "the soak was slow"
+into "steady train #7 recompiled `_select_pad_kernel`, opened under
+fit:SanityCheckerModel": the two recurring silent-failure classes of rounds 4-5
+(steady-state retraces, unwarmed first trains) become attributable facts in a
+report instead of hand-run compile-log archaeology.
+
+Thread model: each Tracer keeps a *per-thread* stack of open spans. A span
+opened in a worker thread with no explicit parent nests under that thread's
+innermost span, falling back to the tracer root — so warmup's parallel solo
+fits attribute their compiles somewhere sensible even unannotated. For real
+nesting across threads, capture `obs.current_span()` in the parent thread and
+pass it as `span(..., parent=captured)` from the worker.
+
+Export formats:
+  * `report()` — JSON, a backward-compatible superset of the old
+    `Profiler.report()` ({"phases": [...]} plus "spans" and "compiles").
+  * `export_chrome(path)` — Chrome-trace/Perfetto JSON (load at ui.perfetto.dev
+    or chrome://tracing).
+  * `text_tree()` — a one-screen tree for terminals (`op run --trace`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+#: compile-event kinds, in pipeline order: python tracing -> StableHLO lowering
+#: -> XLA backend compile; "cache_hit" marks a persistent-cache executable
+#: retrieval (deserialization — cheap relative to a compile, not free).
+COMPILE_KINDS = ("trace", "lower", "compile", "cache_hit")
+
+
+@dataclass
+class PhaseTiming:
+    """Aggregated wall clock of all spans sharing one name (legacy shape)."""
+
+    name: str
+    wall_s: float = 0.0
+    count: int = 0
+
+
+@dataclass
+class CompileEvent:
+    """One observed XLA compilation-pipeline event, attributed to a span."""
+
+    kind: str          # one of COMPILE_KINDS
+    program: str       # jit program name when known, "" otherwise
+    duration_s: float
+    t_s: float         # offset of the event's END from tracer start
+    span: str          # slash path of the attributed span
+    thread: int        # ident of the thread the event fired in
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "program": self.program,
+                "duration_s": round(self.duration_s, 6),
+                "t_s": round(self.t_s, 6), "span": self.span}
+
+
+class Span:
+    """One node of the trace tree. Created via Tracer.span(); not by hand."""
+
+    __slots__ = ("name", "parent", "children", "t0", "t1", "thread",
+                 "compiles", "cost", "mem_delta_bytes")
+
+    def __init__(self, name: str, parent: Optional["Span"] = None):
+        self.name = name
+        self.parent = parent
+        self.children: list[Span] = []
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.thread = threading.get_ident()
+        self.compiles: list[CompileEvent] = []
+        self.cost: Optional[dict[str, float]] = None
+        self.mem_delta_bytes: Optional[int] = None
+
+    @property
+    def wall_s(self) -> float:
+        return (self.t1 or time.perf_counter()) - self.t0
+
+    @property
+    def path(self) -> str:
+        parts = []
+        node: Optional[Span] = self
+        while node is not None:
+            parts.append(node.name)
+            node = node.parent
+        return "/".join(reversed(parts))
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"name": self.name,
+                               "wall_s": round(self.wall_s, 6)}
+        if self.compiles:
+            out["compiles"] = [e.to_dict() for e in self.compiles]
+        if self.cost:
+            out["cost"] = dict(self.cost)
+        if self.mem_delta_bytes is not None:
+            out["mem_delta_bytes"] = self.mem_delta_bytes
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class Tracer:
+    """Collects a span tree plus every compile event fired inside it.
+
+    Also exposes the legacy Profiler surface (`phases`, `add_phase`,
+    `add_cost`, `device_cost`, `report()["phases"]`) so existing callers and
+    reports keep working unchanged.
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None, name: str = "run"):
+        self.trace_dir = trace_dir
+        self.root = Span(name)
+        self.root.t0 = time.perf_counter()
+        self.phases: dict[str, PhaseTiming] = {}
+        self.device_cost: dict[str, dict[str, float]] = {}
+        self.compile_events: list[CompileEvent] = []
+        self._order: list[str] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._mem_fn = _memory_stats_fn()
+
+    # --- span stack (per thread) ------------------------------------------------------
+    def _stack(self) -> list[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_span(self) -> Span:
+        st = self._stack()
+        return st[-1] if st else self.root
+
+    @contextmanager
+    def span(self, name: str, parent: Optional[Span] = None):
+        """Open a child span of `parent` (default: the calling thread's
+        innermost open span, falling back to the tracer root)."""
+        sp = Span(name, parent=parent or self.current_span())
+        with self._lock:
+            sp.parent.children.append(sp)
+        mem0 = self._mem_fn() if self._mem_fn else None
+        st = self._stack()
+        st.append(sp)
+        sp.t0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            sp.t1 = time.perf_counter()
+            st.pop()
+            if mem0 is not None:
+                mem1 = self._mem_fn()
+                if mem1 is not None:
+                    sp.mem_delta_bytes = mem1 - mem0
+            self.add_phase(name, sp.t1 - sp.t0)
+
+    # --- legacy Profiler surface ------------------------------------------------------
+    def add_phase(self, name: str, wall_s: float) -> None:
+        # lock: phases report from worker threads too (warmup's parallel solo
+        # fits) — the check-then-create and the += pair would lose updates
+        # unprotected
+        with self._lock:
+            t = self.phases.get(name)
+            if t is None:
+                t = self.phases[name] = PhaseTiming(name)
+                self._order.append(name)
+            t.wall_s += wall_s
+            t.count += 1
+
+    def add_cost(self, name: str, cost: dict[str, float]) -> None:
+        with self._lock:
+            self.device_cost[name] = dict(cost)
+        sp = self.current_span()
+        if sp is not self.root:
+            sp.cost = dict(cost)
+
+    # --- compile attribution (called by watchdog listeners) ---------------------------
+    def on_compile_event(self, kind: str, program: str, duration_s: float) -> None:
+        sp = self.current_span()
+        now = time.perf_counter()
+        ev = CompileEvent(kind=kind, program=program, duration_s=duration_s,
+                          t_s=now - self.root.t0, span=sp.path,
+                          thread=threading.get_ident())
+        with self._lock:
+            sp.compiles.append(ev)
+            self.compile_events.append(ev)
+
+    # --- reports ----------------------------------------------------------------------
+    def finish(self) -> None:
+        # idempotent but monotone: a mid-run report() (e.g. the runner
+        # reporting inside a CLI-owned tracer) must not freeze the root early
+        self.root.t1 = time.perf_counter()
+
+    def compile_report(self, max_events: int = 200) -> dict:
+        """Answer "what compiled, when, and which span caused it"."""
+        with self._lock:
+            events = list(self.compile_events)
+        counts = {k: 0 for k in COMPILE_KINDS}
+        secs = {k: 0.0 for k in COMPILE_KINDS}
+        by_span: dict[str, dict[str, Any]] = {}
+        for e in events:
+            counts[e.kind] = counts.get(e.kind, 0) + 1
+            secs[e.kind] = secs.get(e.kind, 0.0) + e.duration_s
+            row = by_span.setdefault(e.span, {k: 0 for k in COMPILE_KINDS})
+            row[e.kind] = row.get(e.kind, 0) + 1
+        out = {
+            "counts": counts,
+            "seconds": {k: round(v, 6) for k, v in secs.items()},
+            "by_span": by_span,
+            "events": [e.to_dict() for e in events[:max_events]],
+        }
+        if len(events) > max_events:
+            out["events_dropped"] = len(events) - max_events
+        return out
+
+    def report(self) -> dict:
+        """Backward-compatible superset of the old Profiler.report()."""
+        self.finish()
+        out: dict[str, Any] = {
+            "phases": [
+                {"name": n, "wall_s": round(self.phases[n].wall_s, 6),
+                 "count": self.phases[n].count}
+                for n in self._order
+            ],
+        }
+        if self.device_cost:
+            total_flops = sum(c.get("flops", 0.0) for c in self.device_cost.values())
+            out["device_cost"] = {
+                "programs": self.device_cost,
+                "total_estimated_flops": total_flops,
+            }
+        if self.trace_dir:
+            out["trace_dir"] = self.trace_dir
+        out["spans"] = self.root.to_dict()
+        out["compiles"] = self.compile_report()
+        return out
+
+    # --- Chrome trace / Perfetto ------------------------------------------------------
+    def export_chrome(self, path: str) -> str:
+        """Write a Chrome-trace JSON (the `traceEvents` array format Perfetto
+        and chrome://tracing load). Spans become complete ("X") events on their
+        thread's track; compile events become "X" events in a "compile"
+        category; cache hits are instants."""
+        self.finish()
+        t_base = self.root.t0
+        events: list[dict] = []
+        threads: dict[int, int] = {}
+
+        def tid_of(ident: int) -> int:
+            if ident not in threads:
+                threads[ident] = len(threads)
+                events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                               "tid": threads[ident],
+                               "args": {"name": f"thread-{len(threads) - 1}"
+                                        if len(threads) > 1 else "main"}})
+            return threads[ident]
+
+        def walk(sp: Span) -> None:
+            events.append({
+                "ph": "X", "name": sp.name, "cat": "span", "pid": 1,
+                "tid": tid_of(sp.thread),
+                "ts": round((sp.t0 - t_base) * 1e6, 3),
+                "dur": round(max(sp.wall_s, 0.0) * 1e6, 3),
+                "args": {"path": sp.path},
+            })
+            for c in sp.children:
+                walk(c)
+
+        walk(self.root)
+        with self._lock:
+            compile_events = list(self.compile_events)
+        for e in compile_events:
+            base = {"cat": "compile", "pid": 1, "tid": tid_of(e.thread),
+                    "name": f"{e.kind}:{e.program or '?'}",
+                    "args": {"span": e.span, "program": e.program}}
+            if e.duration_s > 0:
+                base.update({"ph": "X", "dur": round(e.duration_s * 1e6, 3),
+                             "ts": round((e.t_s - e.duration_s) * 1e6, 3)})
+            else:
+                base.update({"ph": "i", "s": "t",
+                             "ts": round(e.t_s * 1e6, 3)})
+            events.append(base)
+        payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        return path
+
+    # --- text tree --------------------------------------------------------------------
+    def text_tree(self, max_lines: int = 40) -> str:
+        """One-screen indented tree: wall time, compile counts/seconds per span."""
+        self.finish()
+        lines: list[str] = []
+
+        def annot(sp: Span) -> str:
+            parts = [f"{sp.wall_s * 1e3:9.1f} ms"]
+            if sp.compiles:
+                n = sum(1 for e in sp.compiles if e.kind == "compile")
+                lo = sum(1 for e in sp.compiles if e.kind == "lower")
+                ch = sum(1 for e in sp.compiles if e.kind == "cache_hit")
+                cs = sum(e.duration_s for e in sp.compiles)
+                tag = []
+                if n:
+                    tag.append(f"{n} compile")
+                if lo:
+                    tag.append(f"{lo} lower")
+                if ch:
+                    tag.append(f"{ch} cache-hit")
+                if tag:
+                    parts.append(f"[{', '.join(tag)}; {cs:.2f}s]")
+            if sp.cost and sp.cost.get("flops"):
+                parts.append(f"{sp.cost['flops'] / 1e9:.2f} GFLOP")
+            if sp.mem_delta_bytes:
+                parts.append(f"mem {sp.mem_delta_bytes / 1e6:+.1f} MB")
+            return "  ".join(parts)
+
+        def walk(sp: Span, depth: int) -> None:
+            lines.append(f"{'  ' * depth}{sp.name:<{max(40 - 2 * depth, 8)}}"
+                         f" {annot(sp)}")
+            for c in sp.children:
+                walk(c, depth + 1)
+
+        walk(self.root, 0)
+        if len(lines) > max_lines:
+            dropped = len(lines) - max_lines
+            lines = lines[:max_lines] + [f"... (+{dropped} more spans)"]
+        return "\n".join(lines)
+
+
+def _memory_stats_fn():
+    """Return a zero-arg callable yielding bytes-in-use of device 0, or None
+    when the backend does not expose memory_stats (host CPU returns None)."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if not stats or "bytes_in_use" not in stats:
+            return None
+
+        def fn() -> Optional[int]:
+            try:
+                s = dev.memory_stats()
+                return int(s["bytes_in_use"]) if s else None
+            except Exception:
+                return None
+
+        return fn
+    except Exception:
+        return None

@@ -1,0 +1,256 @@
+"""XLA compile/retrace watchdog: jax.monitoring listeners + a log-capture shim.
+
+Two complementary sources, stitched per-thread:
+
+* `jax.monitoring` duration events carry WHAT happened and for how long —
+  `/jax/core/compile/jaxpr_trace_duration` (python tracing),
+  `/jax/core/compile/jaxpr_to_mlir_module_duration` (StableHLO lowering; one
+  per program build, fires even when the persistent compile cache absorbs the
+  XLA compile — this is the retrace signal), and
+  `/jax/core/compile/backend_compile_duration` (a real XLA compile). Plain
+  events under `/jax/compilation_cache/` mark persistent-cache retrievals.
+  None of them carry the program NAME.
+* jax's dispatch logger emits "Finished tracing + transforming <name> …" /
+  "Finished jaxpr to MLIR module conversion jit(<name>) …" / "Finished XLA
+  compilation of jit(<name>) …" immediately BEFORE recording the matching
+  duration event, in the same thread — at DEBUG level when
+  `jax.config.jax_log_compiles` is off, WARNING when on. A logging.Handler
+  captures the name into a thread-local mailbox; the next duration event of
+  that kind (same thread) consumes it. This is the `jax_log_compiles` shim:
+  capture without flipping the user-visible config.
+
+Listeners register once per process (jax.monitoring has no deregistration) and
+fast-path out when no Tracer or RetraceBudget is active. The log handler is
+attached/detached with an activation refcount so idle processes pay nothing.
+
+`RetraceBudget` turns the rounds-4/5 soak methodology into an enforced
+invariant: `with obs.retrace_budget(0): train()` raises (at context exit, so a
+partially-compiled run still finishes cleanly) or warns when steady-state code
+compiles. Default counted kinds are ("lower", "compile"): a retrace always
+lowers, even when the persistent cache then hands back a cached executable.
+"""
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Optional
+
+_logger = logging.getLogger("transmogrifai_tpu.obs")
+
+_EVENT_KINDS = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+}
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+#: dispatch-log message -> (kind, program-name) extraction
+_LOG_PATTERNS = (
+    ("trace", re.compile(r"Finished tracing \+ transforming (.+?) for pjit")),
+    ("lower", re.compile(r"Finished jaxpr to MLIR module conversion jit\((.+?)\) in")),
+    ("compile", re.compile(r"Finished XLA compilation of jit\((.+?)\) in")),
+)
+#: loggers that emit the messages above (dispatch owns all three in current
+#: jax; pxla's "Compiling <name> with global shapes" is a fallback lower-name)
+_JAX_LOGGER_NAMES = ("jax._src.dispatch", "jax._src.interpreters.pxla")
+_PXLA_COMPILING = re.compile(r"Compiling ([^\s]+) with global shapes")
+
+# consumers: active tracers and budgets (appended/removed by their contexts)
+_tracers: list = []
+_budgets: list = []
+_state_lock = threading.Lock()
+_tls = threading.local()  # per-thread {kind: pending program name}
+
+_listeners_installed = False
+_handler: Optional["_NameCaptureHandler"] = None
+_saved_levels: dict[str, int] = {}
+_saved_effective: dict[str, int] = {}
+_saved_propagate: dict[str, bool] = {}
+_activations = 0
+
+
+def _pending() -> dict:
+    d = getattr(_tls, "pending", None)
+    if d is None:
+        d = _tls.pending = {}
+    return d
+
+
+class _NameCaptureHandler(logging.Handler):
+    """Captures jit program names from jax's compile-pipeline log lines.
+
+    While attached, the captured loggers are opened to DEBUG (the name-bearing
+    lines log at DEBUG when jax_log_compiles is off) with propagation stopped;
+    records that met the logger's ORIGINAL effective level are re-forwarded to
+    its parent so user-visible logging behavior is unchanged."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            msg = ""
+        matched = False
+        for kind, pat in _LOG_PATTERNS:
+            m = pat.search(msg)
+            if m:
+                _pending()[kind] = m.group(1)
+                matched = True
+                break
+        if not matched:
+            m = _PXLA_COMPILING.search(msg)
+            if m:
+                _pending().setdefault("lower", m.group(1))
+        orig = _saved_effective.get(record.name)
+        if orig is not None and record.levelno >= orig:
+            parent = logging.getLogger(record.name).parent
+            if parent is not None:
+                parent.handle(record)
+
+
+def _on_duration_event(event: str, duration: float, **_kw) -> None:
+    kind = _EVENT_KINDS.get(event)
+    if kind is None or not (_tracers or _budgets):
+        return
+    pending = _pending()
+    program = pending.pop(kind, "")
+    if kind == "lower":
+        pending.pop("hit_pending", None)  # a new program build starts clean
+    elif kind == "compile" and pending.pop("hit_pending", False):
+        # jax's backend_compile_duration event wraps compile_OR_GET_CACHED:
+        # when the persistent cache reported a hit since the last lowering
+        # (same thread, synchronous sequence lower -> cache_hits -> this),
+        # this duration is executable retrieval/deserialization, not an XLA
+        # compile — reclassify so "compile" means a REAL compile and
+        # cache_hit carries the retrieval cost
+        kind = "cache_hit"
+    for t in list(_tracers):
+        t.on_compile_event(kind, program, duration)
+    for b in list(_budgets):
+        b.on_event(kind, program)
+
+
+def _on_event(event: str, **_kw) -> None:
+    if event != _CACHE_HIT_EVENT or not (_tracers or _budgets):
+        return
+    # mark only: the enclosing backend_compile duration event (fires next in
+    # this thread) is reclassified to cache_hit and carries the duration
+    _pending()["hit_pending"] = True
+
+
+def _install_listeners() -> None:
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    import jax.monitoring as monitoring
+
+    monitoring.register_event_duration_secs_listener(_on_duration_event)
+    monitoring.register_event_listener(_on_event)
+    _listeners_installed = True
+
+
+def activate(consumer, kind: str) -> None:
+    """Register a Tracer ("tracer") or RetraceBudget ("budget") as live."""
+    global _handler, _activations
+    with _state_lock:
+        _install_listeners()
+        (_tracers if kind == "tracer" else _budgets).append(consumer)
+        _activations += 1
+        if _activations == 1:
+            _handler = _NameCaptureHandler()
+            for name in _JAX_LOGGER_NAMES:
+                lg = logging.getLogger(name)
+                _saved_levels[name] = lg.level
+                _saved_effective[name] = lg.getEffectiveLevel()
+                _saved_propagate[name] = lg.propagate
+                lg.setLevel(logging.DEBUG)
+                lg.propagate = False  # the handler re-forwards what would show
+                lg.addHandler(_handler)
+
+
+def deactivate(consumer, kind: str) -> None:
+    global _handler, _activations
+    with _state_lock:
+        lst = _tracers if kind == "tracer" else _budgets
+        if consumer in lst:
+            lst.remove(consumer)
+        _activations = max(_activations - 1, 0)
+        if _activations == 0 and _handler is not None:
+            for name in _JAX_LOGGER_NAMES:
+                lg = logging.getLogger(name)
+                lg.removeHandler(_handler)
+                lg.setLevel(_saved_levels.get(name, 0))
+                lg.propagate = _saved_propagate.get(name, True)
+            _saved_levels.clear()
+            _saved_effective.clear()
+            _saved_propagate.clear()
+            _handler = None
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """Steady-state code compiled more than its budget allows."""
+
+    def __init__(self, msg: str, events: list):
+        super().__init__(msg)
+        self.events = events
+
+
+class RetraceBudget:
+    """Context manager enforcing "at most N compilation events happen here".
+
+    kinds: which event kinds count against the budget. The default
+    ("lower", "compile") catches retraces whether or not the persistent
+    compile cache absorbs the XLA compile; use ("compile",) to assert only
+    "nothing actually XLA-compiled" (e.g. warmed first trains, where cache
+    retrievals are expected and correct).
+
+    action="raise" raises RetraceBudgetExceeded at context EXIT (never mid-
+    compile, and never masking an in-flight exception); action="warn" logs a
+    warning per excess event and never raises.
+    """
+
+    def __init__(self, budget: int = 0, kinds=("lower", "compile"),
+                 action: str = "raise"):
+        if action not in ("raise", "warn"):
+            raise ValueError(f"action must be 'raise' or 'warn', got {action!r}")
+        self.budget = int(budget)
+        self.kinds = tuple(kinds)
+        self.action = action
+        self.events: list[tuple[str, str]] = []  # (kind, program) that counted
+        self._lock = threading.Lock()
+
+    @property
+    def count(self) -> int:
+        return len(self.events)
+
+    @property
+    def excess(self) -> list[tuple[str, str]]:
+        return self.events[self.budget:]
+
+    def on_event(self, kind: str, program: str) -> None:
+        if kind not in self.kinds:
+            return
+        with self._lock:
+            self.events.append((kind, program))
+            over = len(self.events) > self.budget
+        if over and self.action == "warn":
+            _logger.warning(
+                "retrace budget (%d) exceeded: %s of %r (event %d)",
+                self.budget, kind, program or "?", len(self.events))
+
+    def __enter__(self) -> "RetraceBudget":
+        activate(self, "budget")
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        deactivate(self, "budget")
+        if exc_type is None and self.action == "raise" \
+                and len(self.events) > self.budget:
+            detail = ", ".join(
+                f"{k}:{p or '?'}" for k, p in self.events[:10])
+            if len(self.events) > 10:
+                detail += f", … ({len(self.events) - 10} more)"
+            raise RetraceBudgetExceeded(
+                f"{len(self.events)} compilation event(s) exceeded the "
+                f"retrace budget of {self.budget} (kinds={self.kinds}): "
+                f"{detail}", list(self.events))

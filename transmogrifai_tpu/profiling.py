@@ -1,141 +1,32 @@
-"""Profiling/tracing: per-phase wall clock, device cost estimates, XLA trace capture.
+"""Profiling facade — thin back-compat layer over `transmogrifai_tpu.obs`.
 
-Analog of the reference's OpSparkListener metrics bus (utils/src/main/scala/com/
-salesforce/op/utils/spark/OpSparkListener.scala:56-146, wired via logStageMetrics/
-collectStageMetrics in OpParams.scala:94-95): Spark's per-stage task metrics become
-(a) per-phase wall clock collected by a context-manager profiler, (b) XLA cost-model
-FLOP/byte estimates of the jitted programs (the GC-time/shuffle-bytes analog), and
-(c) optional on-disk device traces via jax.profiler for TensorBoard.
+The flat phase timer that used to live here grew into the hierarchical span
+tracer + compile watchdog in `obs/` (spans, XLA compile attribution,
+Chrome-trace export, retrace budgets — see docs/observability.md). This module
+keeps the original surface working unchanged:
 
-Usage:
     with profile(trace_dir=None) as prof:
         ... train/score ...
-    prof.report()  # {"phases": [...], "device_cost": {...}}
+    prof.report()  # superset of the old {"phases": [...], "device_cost": ...}
 
-Workflow.train/transform and WorkflowRunner call `phase(...)` internally; with no
-active profiler those are zero-overhead no-ops.
+`profile()` now yields an `obs.Tracer` (exposing the old Profiler attributes:
+`phases`, `add_phase`, `add_cost`, `device_cost`, `report()`), `phase(...)`
+opens an `obs.span(...)`, and `record_cost`/`compiled_flops` route through the
+tracer's cached lowering so cost capture no longer pays a second backend
+compile per program. MFU helpers (device peak FLOPs tables) stay here.
 """
 from __future__ import annotations
 
-import threading
-import time
-from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Optional
 
+from . import obs
+from .obs import PhaseTiming, Tracer  # noqa: F401  (back-compat re-exports)
+from .obs import compiled_flops, record_cost  # noqa: F401
+from .obs.tracer import Tracer as Profiler  # noqa: F401  (legacy name)
 
-@dataclass
-class PhaseTiming:
-    name: str
-    wall_s: float = 0.0
-    count: int = 0
-
-
-@dataclass
-class Profiler:
-    phases: dict[str, PhaseTiming] = field(default_factory=dict)
-    #: program-name -> XLA cost analysis ({"flops": ..., "bytes accessed": ...})
-    device_cost: dict[str, dict[str, float]] = field(default_factory=dict)
-    trace_dir: Optional[str] = None
-    _order: list[str] = field(default_factory=list)
-    _lock: "threading.Lock" = field(default_factory=lambda: threading.Lock())
-
-    def add_phase(self, name: str, wall_s: float) -> None:
-        # lock: phases report from worker threads too (warmup's parallel solo
-        # fits, the selector's overlapped unit compiles) — the check-then-create
-        # and the += pair would lose updates unprotected
-        with self._lock:
-            t = self.phases.get(name)
-            if t is None:
-                t = self.phases[name] = PhaseTiming(name)
-                self._order.append(name)
-            t.wall_s += wall_s
-            t.count += 1
-
-    def add_cost(self, name: str, cost: dict[str, float]) -> None:
-        self.device_cost[name] = dict(cost)
-
-    def report(self) -> dict:
-        out: dict[str, Any] = {
-            "phases": [
-                {"name": n, "wall_s": round(self.phases[n].wall_s, 6),
-                 "count": self.phases[n].count}
-                for n in self._order
-            ],
-        }
-        if self.device_cost:
-            total_flops = sum(c.get("flops", 0.0) for c in self.device_cost.values())
-            out["device_cost"] = {
-                "programs": self.device_cost,
-                "total_estimated_flops": total_flops,
-            }
-        if self.trace_dir:
-            out["trace_dir"] = self.trace_dir
-        return out
-
-
-_ACTIVE: list[Profiler] = []
-
-
-def current() -> Optional[Profiler]:
-    return _ACTIVE[-1] if _ACTIVE else None
-
-
-@contextmanager
-def profile(trace_dir: Optional[str] = None):
-    """Activate a profiler for the dynamic extent; optionally capture an on-disk
-    jax.profiler trace viewable in TensorBoard/XProf."""
-    prof = Profiler(trace_dir=trace_dir)
-    _ACTIVE.append(prof)
-    started_trace = False
-    if trace_dir is not None:
-        import jax
-
-        jax.profiler.start_trace(trace_dir)
-        started_trace = True
-    try:
-        yield prof
-    finally:
-        if started_trace:
-            import jax
-
-            jax.profiler.stop_trace()
-        _ACTIVE.pop()
-
-
-@contextmanager
-def phase(name: str):
-    """Time a named phase into the active profiler; no-op without one."""
-    prof = current()
-    if prof is None:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        prof.add_phase(name, time.perf_counter() - t0)
-
-
-def record_cost(name: str, jitted_fn, *args, **kwargs) -> None:
-    """Attach the XLA cost-model estimate of a jitted program to the active profiler
-    (flops / bytes accessed — the compiler's own numbers, not wall-clock measurement)."""
-    prof = current()
-    if prof is None:
-        return
-    try:
-        compiled = jitted_fn.lower(*args, **kwargs).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
-        prof.add_cost(name, {
-            k: float(v) for k, v in dict(analysis).items()
-            if isinstance(v, (int, float)) and k in
-            ("flops", "bytes accessed", "utilization operand 0 {}")
-        })
-    except Exception:
-        # cost analysis is best-effort: some backends/fns don't expose it
-        pass
+current = obs.current
+profile = obs.trace
+phase = obs.span
 
 
 #: per-chip peak dense bf16 matmul throughput (FLOP/s) by device kind — the MFU
@@ -171,15 +62,3 @@ def mfu(total_flops: float, wall_s: float, n_devices: int = 1,
     if peak is None or wall_s <= 0:
         return None
     return total_flops / (wall_s * peak * n_devices)
-
-
-def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
-    """FLOPs of one invocation per XLA's own cost model (not wall-clock)."""
-    try:
-        compiled = jitted_fn.lower(*args, **kwargs).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
-        return float(dict(analysis).get("flops", 0.0))
-    except Exception:
-        return None

@@ -262,7 +262,7 @@ class ModelSelector(PredictorEstimator):
 
         keep = (weights > 0).astype(np.float32)
         val_masks = self.validator.fold_masks(y_used, keep)
-        from .. import profiling
+        from .. import obs
 
         fold_matrix_fn = getattr(self, "_in_fold_matrix_fn", None)
         ckpt = None
@@ -273,7 +273,7 @@ class ModelSelector(PredictorEstimator):
                                     weights, val_masks, keep,
                                     self.problem_type, self.metric, models)
             ckpt = SearchCheckpoint(self.checkpoint_path, fp)
-        with profiling.phase("selector:search"):
+        with obs.span("selector:search"):
             if fold_matrix_fn is None:
                 results = evaluate_candidates(
                     models, X_tr, y_used, weights, val_masks, keep,
@@ -325,7 +325,7 @@ class ModelSelector(PredictorEstimator):
         best_est = template.with_params(**best.grid_point)
 
         host_lane = getattr(best_est, "host_fit", False)
-        with profiling.phase("selector:refit"):
+        with obs.span("selector:refit"):
             if host_lane:
                 # wrapped external estimator (stages/model/wrapper.py): fit on
                 # host; `params` is the fitted external object
@@ -374,7 +374,7 @@ class ModelSelector(PredictorEstimator):
         # the fitted params in ONE device_get: the former three serial fetches
         # (train, holdout, make_model's host_params) each paid a ~90ms round
         # trip on a tunneled device — ~0.3s of every small-problem train.
-        with profiling.phase("selector:train_metrics"):
+        with obs.span("selector:train_metrics"):
             kept_rows = weights > 0
             if kept_rows.all():
                 Xk, yk = X_tr, y_used
@@ -384,7 +384,7 @@ class ModelSelector(PredictorEstimator):
             train_dev = prog(params, Xk, jnp.asarray(yk, jnp.float32))
         hold_dev = None
         if len(holdout_idx):
-            with profiling.phase("selector:holdout_metrics"):
+            with obs.span("selector:holdout_metrics"):
                 y_h = y_np[holdout_idx]
                 h_idx = np.asarray(holdout_idx)
                 if label_map is not None:
@@ -394,7 +394,7 @@ class ModelSelector(PredictorEstimator):
                                       for v in y_h[keep_h]], np.float32)
                 X_h = jnp.take(X_full, jnp.asarray(h_idx), axis=0)
                 hold_dev = prog(params, X_h, jnp.asarray(y_h, jnp.float32))
-        with profiling.phase("selector:metrics_fetch"):
+        with obs.span("selector:metrics_fetch"):
             train_host, hold_host, params_host = jax.device_get(
                 (train_dev, hold_dev, params))
         summary.train_metrics = ev.assemble(train_host)
@@ -442,16 +442,24 @@ def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
     """ONE jitted program: winner's predict_fn -> evaluator.device_metrics.
     Params ride as ARGUMENTS (not baked constants), so the program caches
     across trains of the same family/shapes; the caller pays one dispatch and
-    one fetch per metrics pass. The key includes the template's ctor params:
-    predict_fn can be instance-BOUND and branch on them (NaiveBayes
+    one fetch per metrics pass. The key includes the template's STATIC ctor
+    params: predict_fn can be instance-BOUND and branch on them (NaiveBayes
     model_type, GLM family), so two configs of one class must not share a
-    traced program."""
+    traced program. vmap_params are excluded: the search already runs every
+    grid point of a static group through ONE vmapped program, so they cannot
+    change program structure by contract — and keying on them made the winner
+    miss this cache whenever it was not the grid point op_warmup solo-fitted
+    (points[0] per group), re-paying the fused-metrics compiles on the first
+    real train (the BENCH_r05 boston 3.8x first-train slip)."""
     from ..stages.base import _jsonify
 
+    dynamic = set(getattr(template, "vmap_params", ()))
+    static_params = {k: v for k, v in template.params.items()
+                     if k not in dynamic}
     try:
-        cfg = json.dumps(_jsonify(template.params), sort_keys=True)
+        cfg = json.dumps(_jsonify(static_params), sort_keys=True)
     except TypeError:
-        cfg = repr(sorted(template.params.items(), key=lambda kv: kv[0]))
+        cfg = repr(sorted(static_params.items(), key=lambda kv: kv[0]))
     key = (template.__class__, cfg, problem_type, num_classes)
     # lock: warmup runs solo fits on threads (workflow/warmup.py), and the
     # LRU's move_to_end/popitem pair is not safe under concurrent mutation

@@ -31,6 +31,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 
+from .. import obs
 from ..types import Column
 
 
@@ -122,7 +123,10 @@ class LocalPlan:
             tag, ref = src
             return raw_cols[ref] if tag == "r" else mid[ref]
 
-        with self._ctx():
+        # obs.span is a no-op without an active tracer (~1µs), so the serving
+        # hot path stays unburdened; under a tracer, any steady-state compile
+        # here (a serving retrace — the round-4 failure class) is attributed
+        with obs.span("serve:run"), self._ctx():
             for step in self._steps:
                 if step[0] == "h":
                     _, fn, srcs, si = step

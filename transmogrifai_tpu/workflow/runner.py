@@ -45,6 +45,8 @@ class AppMetrics:
     custom_tags: dict[str, str] = field(default_factory=dict)
     #: fine-grained per-stage profile (fit:X / transform:layerN phases + device cost)
     profile: Optional[dict] = None
+    #: span tree + compile attribution from the obs tracer ({"spans", "compiles"})
+    trace: Optional[dict] = None
 
     @property
     def app_duration_s(self) -> float:
@@ -61,6 +63,8 @@ class AppMetrics:
         }
         if self.profile is not None:
             out["profile"] = self.profile
+        if self.trace is not None:
+            out["trace"] = self.trace
         return out
 
 
@@ -158,19 +162,45 @@ class WorkflowRunner:
             metrics.stage_metrics.append(StageMetric(name, now - phase_t0))
             phase_t0 = now
 
-        from .. import profiling
+        import contextlib
+
+        from .. import obs
 
         try:
             if params.collect_stage_metrics or params.log_stage_metrics:
                 trace_dir = params.custom_params.get("trace_dir")
-                with profiling.profile(trace_dir=trace_dir) as prof:
+                # an already-active tracer (e.g. `op run --trace`, or a user's
+                # enclosing obs.trace()) is reused rather than stacked: spans
+                # land on the innermost tracer, so opening a second one here
+                # would rob the outer one of the whole run. A requested
+                # jax.profiler capture still honors trace_dir in that case.
+                outer = obs.current()
+                ctx = (contextlib.nullcontext(outer) if outer is not None
+                       else obs.trace(trace_dir=trace_dir, name=run_type))
+                prof_ctx = contextlib.nullcontext()
+                if outer is not None and trace_dir:
+                    import jax
+
+                    prof_ctx = jax.profiler.trace(trace_dir)
+                with ctx as tracer, prof_ctx:
                     result = getattr(self, f"_run_{run_type}")(params, mark)
-                metrics.profile = prof.report()
+                full = tracer.report()
+                # profile keeps the legacy shape; the span tree + compile
+                # attribution ride in the new AppMetrics trace section
+                metrics.profile = {k: v for k, v in full.items()
+                                   if k in ("phases", "device_cost", "trace_dir")}
+                metrics.trace = {k: full[k] for k in ("spans", "compiles")}
+                chrome_path = params.custom_params.get("trace_chrome")
+                if chrome_path:
+                    tracer.export_chrome(chrome_path)
                 if params.log_stage_metrics:
                     import logging
 
                     logging.getLogger(__name__).info(
                         "stage metrics for %s: %s", run_type, metrics.profile
+                    )
+                    logging.getLogger(__name__).info(
+                        "trace for %s:\n%s", run_type, tracer.text_tree()
                     )
             else:
                 result = getattr(self, f"_run_{run_type}")(params, mark)
@@ -191,12 +221,16 @@ class WorkflowRunner:
         model = self.workflow.train(checkpoint_dir=params.checkpoint_location)
         mark("train")
         loc = params.model_location
+        from .. import obs
+
         if loc:
-            model.save(loc, overwrite=True)
+            with obs.span("runner:save_model"):
+                model.save(loc, overwrite=True)
             mark("save_model")
         train_metrics = None
         if self.evaluator is not None:
-            train_metrics = model.evaluate(self.evaluator)
+            with obs.span("runner:evaluate"):
+                train_metrics = model.evaluate(self.evaluator)
             self._write_metrics(train_metrics, params.metrics_location)
             mark("evaluate")
         self._model = model
@@ -218,12 +252,16 @@ class WorkflowRunner:
         mark("score")
         out = model.transform_select(scores)
         loc = params.write_location
+        from .. import obs
+
         if loc:
-            write_table_csv(out, loc)
+            with obs.span("runner:write_scores"):
+                write_table_csv(out, loc)
             mark("write_scores")
         eval_metrics = None
         if self.evaluator is not None:
-            eval_metrics = self.evaluator.evaluate_all(scores)
+            with obs.span("runner:evaluate"):
+                eval_metrics = self.evaluator.evaluate_all(scores)
             self._write_metrics(eval_metrics, params.metrics_location)
             mark("evaluate")
         return RunResult("score", write_location=loc, metrics=eval_metrics,

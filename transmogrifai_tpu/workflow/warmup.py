@@ -70,6 +70,8 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
         selector = RegressionModelSelector.with_cross_validation(
             num_folds=num_folds, models=models, splitter=splitter, seed=seed)
 
+    from .. import obs
+
     label = FeatureBuilder("label", "RealNN").as_response()
     vec = FeatureBuilder("vec", "OPVector").as_predictor()
     selector(label, vec)
@@ -80,7 +82,8 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
         "vec": Column.vector(jnp.asarray(X), schema=schema),
     })
     t0 = time.perf_counter()
-    selector.fit_table(table)
+    with obs.span(f"warmup:{problem}:search"):
+        selector.fit_table(table)
     # the fit above compiles every family's SEARCH programs but only the
     # synthetic winner's REFIT + metrics programs for ONE static grid group —
     # and the real data's winner can be any (template, static-group) pair: a
@@ -99,14 +102,21 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     from ..select.selector import ModelSelector
     from ..select.validator import _group_grid
 
+    # assigned just before the pool runs: the caller-side span the worker
+    # threads' spans nest under (a thread-local stack cannot see across
+    # threads, so the parent is handed over explicitly)
+    parent_span = None
+
     def solo_fit(template, point):
-        solo = ModelSelector(problem_type=problem, metric=selector.metric,
-                             models=[(template, [dict(point)])],
-                             validator=selector.validator,
-                             splitter=selector.splitter, seed=seed)
-        solo(FeatureBuilder("label", "RealNN").as_response(),
-             FeatureBuilder("vec", "OPVector").as_predictor())
-        solo.fit_table(table)
+        with obs.span(f"warmup:solo:{type(template).__name__}",
+                      parent=parent_span):
+            solo = ModelSelector(problem_type=problem, metric=selector.metric,
+                                 models=[(template, [dict(point)])],
+                                 validator=selector.validator,
+                                 splitter=selector.splitter, seed=seed)
+            solo(FeatureBuilder("label", "RealNN").as_response(),
+                 FeatureBuilder("vec", "OPVector").as_predictor())
+            solo.fit_table(table)
 
     units = [(template, points[0])
              for template, grid in selector.models
@@ -118,13 +128,15 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     # gate as the validator's overlapped unit compiles)
     import os as _os
 
-    if (len(units) > 1
-            and _os.environ.get("TT_PARALLEL_COMPILE", "1") != "0"):
-        with ThreadPoolExecutor(min(4, len(units))) as ex:
-            list(ex.map(lambda u: solo_fit(*u), units))
-    else:
-        for template, point in units:
-            solo_fit(template, point)
+    with obs.span(f"warmup:{problem}:solo_fits") as _sp:
+        parent_span = _sp
+        if (len(units) > 1
+                and _os.environ.get("TT_PARALLEL_COMPILE", "1") != "0"):
+            with ThreadPoolExecutor(min(4, len(units))) as ex:
+                list(ex.map(lambda u: solo_fit(*u), units))
+        else:
+            for template, point in units:
+                solo_fit(template, point)
     return {"problem": problem, "rows": int(rows), "width": int(width),
             "requested_width": requested,
             "wall_s": round(time.perf_counter() - t0, 2)}

@@ -309,6 +309,13 @@ class Workflow(WorkflowCore):
         state, by contrast, is deleted at train end: replaying a finished
         search from partial units is not a restore, so the next train searches
         fresh."""
+        from .. import obs
+
+        with obs.span("workflow:train"):
+            return self._train_impl(table, sanitize, checkpoint_dir)
+
+    def _train_impl(self, table: Optional[Table], sanitize: bool,
+                    checkpoint_dir: Optional[str]) -> "WorkflowModel":
         if not self.result_features:
             raise ValueError("set_result_features first")
         if table is not None:
@@ -328,7 +335,7 @@ class Workflow(WorkflowCore):
             data, blacklisted = self._raw_filter.filter_raw(self.raw_features, data)
             if blacklisted:
                 self._apply_blacklist(blacklisted)
-        from .. import profiling
+        from .. import obs
 
         ckpt = None
         if checkpoint_dir:
@@ -413,7 +420,7 @@ class Workflow(WorkflowCore):
                             model = Stage.from_json(stored)
                             adopt_wiring(est, model)
                         else:
-                            with profiling.phase(f"fit:{type(est).__name__}"):
+                            with obs.span(f"fit:{type(est).__name__}"):
                                 model = est.fit_table(data)
                             if use_ckpt:
                                 ckpt.put(key, model.to_json())
@@ -435,7 +442,7 @@ class Workflow(WorkflowCore):
             # bulk-apply the whole layer once (fit points materialize new columns for
             # the next layer's estimators)
             plan = _CompiledPlan(_topo_within_layer(layer_transformers))
-            with profiling.phase(f"transform:layer{li}"):
+            with obs.span(f"transform:layer{li}"):
                 data = plan.apply(data)
             fitted_stages.extend(_topo_within_layer(layer_transformers))
         for p in deferred_search_files:
@@ -512,11 +519,12 @@ class WorkflowModel(WorkflowCore):
 
     # --- scoring (analog of OpWorkflowModel.score, scoreFn) ---------------------------
     def transform(self, table: Table, keep_intermediate: bool = False) -> Table:
-        from .. import profiling
+        from .. import obs
 
         if self._plan is None:
-            self._plan = _CompiledPlan(self.stages)
-        with profiling.phase("score:transform"):
+            with obs.span("score:plan_build"):
+                self._plan = _CompiledPlan(self.stages)
+        with obs.span("score:transform"):
             out = self._plan.apply(table)
         if keep_intermediate:
             return out
