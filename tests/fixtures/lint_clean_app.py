@@ -1,0 +1,12 @@
+"""Clean example app for the `op lint` CLI tests: no findings expected."""
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.stages.feature.transmogrify import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+
+def make_runner():
+    fs = features_from_schema({"y": "RealNN", "a": "Real", "b": "Real"},
+                              response="y")
+    pred = LogisticRegression(max_iter=8)(fs["y"], transmogrify([fs["a"], fs["b"]]))
+    return Workflow().set_result_features(pred)

@@ -1,0 +1,14 @@
+"""Seeded-leakage app for the `op lint` CLI tests: a feature derived pointwise
+from the response lands in the design matrix -> OP302 error, nonzero exit."""
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.stages.feature.numeric import RealVectorizer
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+
+def make_runner():
+    fs = features_from_schema({"y": "RealNN", "a": "Real"}, response="y")
+    leaked = fs["y"] + 0.0
+    vec = RealVectorizer()(fs["a"], leaked)
+    pred = LogisticRegression(max_iter=8)(fs["y"], vec)
+    return Workflow().set_result_features(pred)

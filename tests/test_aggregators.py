@@ -16,7 +16,6 @@ from transmogrifai_tpu.readers import (
     Aggregate,
     Conditional,
     InMemoryReader,
-    JoinKeys,
     TimeBasedFilter,
     left_outer_join,
     inner_join,

@@ -3,7 +3,6 @@ SanityChecker -> LogisticRegression -> score + serve. The stage-output sweep
 checks stages in isolation; this catches inter-kind integration issues (slot
 schema merging, mask threading across families, serving parity) in one go."""
 import numpy as np
-import pytest
 
 from test_stage_outputs import _col, _stream_for, N
 

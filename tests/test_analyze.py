@@ -1,0 +1,519 @@
+"""Static plan analyzer (`oplint`, transmogrifai_tpu/analyze/) tests: every
+rule code with at least one positive (diagnostic fired) and one negative
+(clean plan) case, plus the Workflow.train plan-time gate — ill-kinded or
+leaking plans must fail BEFORE any reader access or XLA trace."""
+import json
+
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.analyze import (
+    RULES,
+    PlanAnalysisError,
+    analyze_model,
+    analyze_plan,
+)
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.graph.feature import Feature
+from transmogrifai_tpu.stages import LambdaTransformer
+from transmogrifai_tpu.stages.feature.combiner import VectorsCombiner
+from transmogrifai_tpu.stages.feature.numeric import (
+    FillMissingWithMean,
+    FillMissingWithMeanModel,
+    RealNNVectorizer,
+    RealVectorizer,
+    StandardScalerModel,
+)
+from transmogrifai_tpu.stages.feature.transmogrify import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.types import Column, Table, kind_of
+
+
+def _host_id(col):
+    return col
+
+
+def _simple_graph():
+    """Clean plan: two real predictors -> vector -> logistic regression."""
+    fs = features_from_schema({"y": "RealNN", "a": "Real", "b": "Real"},
+                              response="y")
+    vec = transmogrify([fs["a"], fs["b"]])
+    pred = LogisticRegression(max_iter=8)(fs["y"], vec)
+    return fs, pred
+
+
+def _codes(report):
+    return report.codes()
+
+
+class TestCatalog:
+    def test_every_rule_documented(self):
+        # the catalog drives docs/static_analysis.md and `op lint --rules`
+        assert {"OP001", "OP101", "OP102", "OP103", "OP104", "OP201", "OP202",
+                "OP203", "OP301", "OP302", "OP401", "OP402", "OP403"} \
+            == set(RULES)
+        for r in RULES.values():
+            assert r.title and r.rationale and r.severity in ("error", "warn", "info")
+
+
+class TestCleanPlan:
+    def test_no_findings(self):
+        _, pred = _simple_graph()
+        report = analyze_plan([pred])
+        assert not report.diagnostics, report.pretty()
+        assert not report.has_errors
+        assert "clean plan" in report.pretty()
+
+    def test_report_json_shape(self):
+        _, pred = _simple_graph()
+        doc = analyze_plan([pred]).to_json()
+        assert doc["version"] == 1
+        assert doc["counts"] == {"error": 0, "warn": 0, "info": 0}
+        assert doc["n_stages"] >= 2 and doc["n_features"] >= 4
+        json.dumps(doc)  # must be serializable as-is
+
+
+class TestOP001Uniqueness:
+    def test_duplicate_uid_fires(self):
+        fs = features_from_schema({"a": "Real", "b": "Real"})
+        s1, s2 = FillMissingWithMean(), FillMissingWithMean()
+        f1, f2 = s1(fs["a"]), s2(fs["b"])
+        s2.uid = s1.uid
+        report = analyze_plan([f1, f2])
+        assert "OP001" in _codes(report) and report.has_errors
+
+    def test_shared_instance_fires(self):
+        fs = features_from_schema({"a": "Real"})
+        s = FillMissingWithMean()
+        f = s(fs["a"])
+        report = analyze_plan([f], dag=[[s], [s]])
+        assert any("appears twice" in d.message
+                   for d in report.by_code("OP001"))
+
+    def test_clean(self):
+        _, pred = _simple_graph()
+        assert "OP001" not in _codes(analyze_plan([pred]))
+
+
+class TestOP101KindMismatch:
+    def test_mutated_input_kind_fires(self):
+        fs = features_from_schema({"a": "Real"})
+        stage = RealVectorizer()
+        out = stage(fs["a"])
+        rogue = Feature("t", "Text")
+        stage.inputs = (rogue,)
+        out.parents = (rogue,)
+        report = analyze_plan([out])
+        diags = report.by_code("OP101")
+        assert diags and diags[0].severity == "error"
+        assert "Text" in diags[0].message
+
+    def test_clean(self):
+        _, pred = _simple_graph()
+        assert "OP101" not in _codes(analyze_plan([pred]))
+
+
+class TestOP102Arity:
+    def test_input_count_violation_fires(self):
+        fs = features_from_schema({"a": "Real"})
+        stage = FillMissingWithMean()
+        out = stage(fs["a"])
+        stage.inputs = ()  # simulate a bad mutation / deserialization bug
+        report = analyze_plan([out], raw_features=[fs["a"]])
+        assert report.by_code("OP102") and report.has_errors
+
+    def test_clean(self):
+        _, pred = _simple_graph()
+        assert "OP102" not in _codes(analyze_plan([pred]))
+
+    def test_arity_violation_short_circuits_out_kind(self):
+        # an arity-(2,2) stage whose out_kind indexes in_kinds[1]: after the
+        # arity diagnostic the analyzer must NOT call out_kind (it would
+        # crash on the very plans OP102 exists for)
+        fs = features_from_schema({"label": "RealNN", "x": "Real"},
+                                  response="label")
+        out = fs["x"].auto_bucketize(fs["label"], max_splits=4)
+        stage = out.origin_stage
+        stage.inputs = (fs["label"],)  # drop the numeric input
+        report = analyze_plan([out], raw_features=list(fs.values()))
+        assert report.by_code("OP102")  # reported, not raised
+
+
+class TestOP103NullableIntoNonNullable:
+    def test_nullable_real_into_realnn_vectorizer_fires(self):
+        fs = features_from_schema({"x": "RealNN", "a": "Real"})
+        stage = RealNNVectorizer()
+        out = stage(fs["x"])
+        stage.inputs = (fs["a"],)  # Real (nullable) into a RealNN-only stage
+        out.parents = (fs["a"],)
+        report = analyze_plan([out])
+        diags = report.by_code("OP103")
+        assert diags and "fill" in (diags[0].hint or "")
+        assert "OP101" not in _codes(report)  # classified, not generic
+
+    def test_nonnullable_input_clean(self):
+        fs = features_from_schema({"x": "RealNN"})
+        out = RealNNVectorizer()(fs["x"])
+        assert "OP103" not in _codes(analyze_plan([out]))
+
+
+class TestOP104KindDrift:
+    def test_mutated_output_kind_fires(self):
+        fs = features_from_schema({"a": "Real"})
+        stage = FillMissingWithMean()
+        out = stage(fs["a"])
+        out.kind = kind_of("Text")  # recorded kind no longer matches out_kind
+        report = analyze_plan([out])
+        diags = report.by_code("OP104")
+        assert diags and "RealNN" in diags[0].message
+
+    def test_clean(self):
+        _, pred = _simple_graph()
+        assert "OP104" not in _codes(analyze_plan([pred]))
+
+
+class TestOP201Unfingerprintable:
+    def test_anonymous_device_lambda_fires(self):
+        fs = features_from_schema({"a": "Real"})
+        out = LambdaTransformer(lambda c: c, "Real", device_op=True)(fs["a"])
+        report = analyze_plan([out])
+        diags = report.by_code("OP201")
+        assert diags and diags[0].severity == "warn"
+
+    def test_named_fn_clean(self):
+        fs = features_from_schema({"a": "Real"})
+        out = LambdaTransformer(_host_id, "Real", device_op=True,
+                                fn_name="host_id")(fs["a"])
+        assert "OP201" not in _codes(analyze_plan([out]))
+
+
+class TestOP202BulkTracedConstants:
+    def _scaled(self, width):
+        v = Feature("v", "OPVector")
+        return StandardScalerModel(mean=[0.0] * width, std=[1.0] * width)(v)
+
+    def test_bulk_fitted_params_fire(self):
+        report = analyze_plan([self._scaled(2000)])
+        diags = report.by_code("OP202")
+        assert diags and "kernel" in (diags[0].hint or "")
+
+    def test_small_params_clean(self):
+        assert "OP202" not in _codes(analyze_plan([self._scaled(8)]))
+
+
+class TestOP203FingerprintOverBudget:
+    def test_oversized_run_fingerprint_fires(self):
+        v = Feature("v", "OPVector")
+        w = 9000  # ~2 * 9000 float reprs ≫ the 64 KiB fused-cache key limit
+        out = StandardScalerModel(mean=[0.5] * w, std=[1.5] * w)(v)
+        report = analyze_plan([out])
+        assert report.by_code("OP203")
+
+    def test_small_run_clean(self):
+        v = Feature("v", "OPVector")
+        out = StandardScalerModel(mean=[0.5] * 4, std=[1.5] * 4)(v)
+        assert "OP203" not in _codes(analyze_plan([out]))
+
+
+def _selector_graph(max_splits=8):
+    """auto-bucketizer (label-tainted estimator) upstream of a ModelSelector."""
+    from transmogrifai_tpu.select import ParamGridBuilder
+    from transmogrifai_tpu.select.selector import ModelSelector
+    from transmogrifai_tpu.select.splitters import DataSplitter
+    from transmogrifai_tpu.select.validator import CrossValidation
+
+    fs = features_from_schema({"label": "RealNN", "x": "Real"}, response="label")
+    bucketed = fs["x"].auto_bucketize(fs["label"], max_splits=max_splits)
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=8),
+                 ParamGridBuilder().add("l2", [0.0]).build())],
+        validator=CrossValidation(num_folds=3, seed=1),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=1),
+    )
+    pred = sel(fs["label"], transmogrify([bucketed]))
+    return fs, pred
+
+
+class TestOP301FoldLeakage:
+    def test_tainted_estimator_without_workflow_cv_fires(self):
+        _, pred = _selector_graph()
+        report = analyze_plan([pred], workflow_cv=False)
+        diags = report.by_code("OP301")
+        assert diags and diags[0].severity == "warn"
+        assert "with_workflow_cv" in (diags[0].hint or "")
+
+    def test_workflow_cv_on_clean(self):
+        _, pred = _selector_graph()
+        assert "OP301" not in _codes(analyze_plan([pred], workflow_cv=True))
+
+    def test_label_slot_only_estimator_clean(self):
+        # index_string-style: an estimator that merely ENCODES the response
+        # reaches the selector only through its fit-only label slot — nothing
+        # leaks into the matrix, so OP301 must stay silent (refitting it per
+        # fold would re-index labels per fold: harmful advice)
+        from transmogrifai_tpu.select import ParamGridBuilder
+        from transmogrifai_tpu.select.selector import ModelSelector
+        from transmogrifai_tpu.select.splitters import DataSplitter
+        from transmogrifai_tpu.select.validator import CrossValidation
+        from transmogrifai_tpu.stages.feature.numeric import StandardScaler
+
+        fs = features_from_schema({"label": "RealNN", "x": "Real"},
+                                  response="label")
+        encoded = StandardScaler()(fs["label"])  # estimator on the label path
+        sel = ModelSelector(
+            "binary",
+            models=[(LogisticRegression(max_iter=8),
+                     ParamGridBuilder().add("l2", [0.0]).build())],
+            validator=CrossValidation(num_folds=3, seed=1),
+            splitter=DataSplitter(reserve_test_fraction=0.1, seed=1),
+        )
+        pred = sel(encoded, transmogrify([fs["x"]]))
+        assert "OP301" not in _codes(analyze_plan([pred], workflow_cv=False))
+
+
+def _leaky_graph():
+    """The response is vectorized straight into the design matrix (transmogrify
+    itself refuses raw responses, so the leak arrives the realistic way: a
+    feature DERIVED from the label's values slips into the predictor set)."""
+    fs = features_from_schema({"y": "RealNN", "a": "Real"}, response="y")
+    leaked = fs["y"] + 0.0  # pointwise function of the response
+    vec = RealVectorizer()(fs["a"], leaked)
+    pred = LogisticRegression(max_iter=8)(fs["y"], vec)
+    return fs, pred
+
+
+class TestOP302ResponseInMatrix:
+    def test_vectorized_response_fires(self):
+        _, pred = _leaky_graph()
+        report = analyze_plan([pred])
+        diags = report.by_code("OP302")
+        assert diags and diags[0].severity == "error"
+        assert "y" in diags[0].message
+
+    def test_fit_only_label_path_clean(self):
+        # the auto-bucketizer reads the label during FIT only: its output
+        # rows carry no response values, so OP302 must NOT fire (that path
+        # is OP301's per-fold refit territory instead)
+        _, pred = _selector_graph()
+        assert "OP302" not in _codes(analyze_plan([pred], workflow_cv=True))
+
+
+class TestOP401DeadStage:
+    def test_orphan_consumer_fires(self):
+        fs, pred = _simple_graph()
+        dead = FillMissingWithMean()
+        dead(fs["a"])  # wired onto the plan's features, output unused
+        report = analyze_plan([pred])
+        diags = report.by_code("OP401")
+        assert diags and diags[0].stage_uid == dead.uid
+        assert diags[0].severity == "info"
+
+    def test_clean(self):
+        _, pred = _simple_graph()
+        assert "OP401" not in _codes(analyze_plan([pred]))
+
+    def test_sibling_plan_downstream_stages_not_reported(self):
+        # two plans over the SAME raw features: plan B's stages that consume
+        # plan-B-internal features must not appear in plan A's report at all;
+        # plan B's first layer (wired purely onto shared raws) is statically
+        # indistinguishable from a dead stage, so it reports with the honest
+        # "another plan" wording
+        fs, pred_a = _simple_graph()
+        vec_b = RealVectorizer()(fs["a"])
+        pred_b = LogisticRegression(max_iter=8)(fs["y"], vec_b)
+        report = analyze_plan([pred_a])
+        uids = {d.stage_uid for d in report.by_code("OP401")}
+        assert pred_b.origin_stage.uid not in uids  # consumes vec_b: skipped
+        first_layer = [d for d in report.by_code("OP401")
+                       if d.stage_uid == vec_b.origin_stage.uid]
+        assert first_layer and "another plan" in first_layer[0].message
+
+    def test_abandoned_consumers_are_not_retained(self):
+        # the consumer edges are WEAK: dropping a plan releases its stages
+        # even while the shared raw features live on, and later analyses
+        # stop reporting them
+        import gc
+        import weakref
+
+        fs, pred = _simple_graph()
+        dead = FillMissingWithMean()
+        dead(fs["a"])
+        ref = weakref.ref(dead)
+        assert "OP401" in _codes(analyze_plan([pred]))
+        del dead
+        gc.collect()
+        assert ref() is None  # the consumers edge did not pin the stage
+        assert "OP401" not in _codes(analyze_plan([pred]))
+
+
+class TestOP402DuplicateVectorizer:
+    def test_identical_twins_fire(self):
+        fs = features_from_schema({"a": "Real"})
+        v1 = RealVectorizer()(fs["a"])
+        v2 = RealVectorizer()(fs["a"])
+        out = VectorsCombiner()(v1, v2)
+        report = analyze_plan([out])
+        assert report.by_code("OP402")
+
+    def test_different_params_clean(self):
+        fs = features_from_schema({"a": "Real"})
+        v1 = RealVectorizer()(fs["a"])
+        v2 = RealVectorizer(track_nulls=False)(fs["a"])
+        out = VectorsCombiner()(v1, v2)
+        assert "OP402" not in _codes(analyze_plan([out]))
+
+    def test_distinct_anonymous_lambdas_not_duplicates(self):
+        # LambdaTransformer holds its fn OUTSIDE params; two different
+        # lambdas share {'fn_name': None} but have no provable identity and
+        # must not be called duplicates (identity = trace_fingerprint, which
+        # raises for anonymous callables)
+        fs = features_from_schema({"a": "Real"})
+        v1 = LambdaTransformer(lambda c: c, "Real")(fs["a"])
+        v2 = LambdaTransformer(lambda c: c * 2, "Real")(fs["a"])
+        report = analyze_plan([v1, v2])
+        assert "OP402" not in _codes(report)
+
+
+class TestOP403FusionBreaker:
+    def _chain(self, host: bool):
+        fs = features_from_schema({"a": "Real"})
+        d1 = FillMissingWithMeanModel(mean=0.0)(fs["a"])
+        mid = LambdaTransformer(_host_id, "RealNN", device_op=not host,
+                                fn_name="host_id")(d1)
+        d2 = FillMissingWithMeanModel(mean=0.0)(mid)
+        return d2
+
+    def test_host_stage_between_device_stages_fires(self):
+        report = analyze_plan([self._chain(host=True)])
+        diags = report.by_code("OP403")
+        assert diags and "transfers" in diags[0].message
+
+    def test_all_device_clean(self):
+        assert "OP403" not in _codes(analyze_plan([self._chain(host=False)]))
+
+
+# --- Workflow.train gate: fail at plan time, zero data, zero traces -------------------
+
+class _BoomReader:
+    """DataReader stand-in that fails the test if the train path reads data."""
+
+    def generate_table(self, features):
+        raise AssertionError("reader accessed before plan analysis passed")
+
+
+def _rows(n=24):
+    return Table({
+        "y": Column.build(kind_of("RealNN"), [float(i % 2) for i in range(n)]),
+        "a": Column.build(kind_of("Real"), [float(i) for i in range(n)]),
+        "b": Column.build(kind_of("Real"), [float(n - i) for i in range(n)]),
+    })
+
+
+class TestTrainGate:
+    def test_ill_kinded_plan_fails_at_plan_time(self):
+        from transmogrifai_tpu.workflow import Workflow
+
+        fs = features_from_schema({"a": "Real"})
+        stage = RealVectorizer()
+        out = stage(fs["a"])
+        wf = Workflow().set_result_features(out)
+        rogue = Feature("t", "Text")
+        stage.inputs = (rogue,)
+        out.parents = (rogue,)
+        wf.reader = _BoomReader()
+        with obs.retrace_budget(0):  # zero XLA activity before the raise
+            with pytest.raises(PlanAnalysisError, match="OP101"):
+                wf.train()
+
+    def test_leaky_plan_fails_at_plan_time(self):
+        from transmogrifai_tpu.workflow import Workflow
+
+        _, pred = _leaky_graph()
+        wf = Workflow().set_result_features(pred)
+        wf.reader = _BoomReader()
+        with obs.retrace_budget(0):
+            with pytest.raises(PlanAnalysisError, match="OP302"):
+                wf.train()
+
+    def test_strict_false_downgrades_and_trains(self):
+        from transmogrifai_tpu.workflow import Workflow
+
+        _, pred = _leaky_graph()
+        wf = Workflow().set_result_features(pred)
+        with obs.trace() as t:
+            model = wf.train(table=_rows(), strict=False)
+        assert model.analysis_report is not None
+        assert model.analysis_report.has_errors  # downgraded, not erased
+        # the downgrade leaves an audit trail on the train span
+        events = []
+
+        def walk(sp):
+            events.extend(sp.events)
+            for c in sp.children:
+                walk(c)
+
+        walk(t.root)
+        assert any(e["name"] == "oplint" and e["code"] == "OP302"
+                   for e in events)
+
+    def test_clean_plan_trains_and_stamps_report(self, tmp_path):
+        from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+        _, pred = _simple_graph()
+        wf = Workflow().set_result_features(pred)
+        model = wf.train(table=_rows())
+        assert model.analysis_report is not None
+        assert not model.analysis_report.has_errors
+        path = str(tmp_path / "model")
+        model.save(path)
+        with open(tmp_path / "model" / "model.json") as fh:
+            manifest = json.load(fh)
+        assert manifest["analysis"]["counts"]["error"] == 0
+        # a LOADED model has no plan report: save() re-analyzes the fitted plan
+        loaded = WorkflowModel.load(path)
+        assert loaded.analysis_report is None
+        loaded.save(str(tmp_path / "model2"))
+        with open(tmp_path / "model2" / "model.json") as fh:
+            manifest2 = json.load(fh)
+        assert manifest2["analysis"]["counts"]["error"] == 0
+
+
+class TestRunnerLenientLint:
+    def _runner(self):
+        from transmogrifai_tpu.readers import InMemoryReader
+        from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+        _, pred = _leaky_graph()
+        rows = [{"y": float(i % 2), "a": float(i)} for i in range(24)]
+        return WorkflowRunner(Workflow().set_result_features(pred),
+                              train_reader=InMemoryReader(rows))
+
+    def test_run_train_strict_by_default(self):
+        from transmogrifai_tpu.params import OpParams
+
+        with pytest.raises(PlanAnalysisError, match="OP302"):
+            self._runner().run("train", OpParams())
+
+    def test_lenient_lint_param_downgrades(self):
+        from transmogrifai_tpu.params import OpParams
+
+        result = self._runner().run("train", OpParams(lenient_lint=True))
+        assert result is not None
+
+    def test_lenient_lint_json_roundtrip(self):
+        from transmogrifai_tpu.params import OpParams
+
+        p = OpParams.from_json('{"lenient_lint": true}')
+        assert p.lenient_lint is True
+
+
+class TestAnalyzeModel:
+    def test_fitted_plan_report(self):
+        from transmogrifai_tpu.workflow import Workflow
+
+        _, pred = _simple_graph()
+        model = Workflow().set_result_features(pred).train(table=_rows())
+        report = analyze_model(model)
+        assert not report.has_errors
+        assert report.n_stages == len(model.stages)

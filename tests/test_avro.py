@@ -8,7 +8,6 @@ from transmogrifai_tpu.readers import (
     Aggregate,
     AvroReader,
     Conditional,
-    CSVReader,
     Simple,
     read_avro,
     save_avro,
@@ -331,11 +330,7 @@ class TestNativeDecoder:
         import io as _io
 
         from transmogrifai_tpu import native
-        from transmogrifai_tpu.readers.avro import (
-            _read_container_blocks,
-            _native_columns,
-            _write_long,
-        )
+        from transmogrifai_tpu.readers.avro import _native_columns, _write_long
 
         schema = {"type": "record", "name": "S", "fields": [
             {"name": "s", "type": "string"}]}

@@ -1,0 +1,67 @@
+"""CLI-level `op lint` tests: clean app exits 0, seeded leakage app exits
+nonzero, the rule catalog prints, and the command is registered in help."""
+import json
+import os
+import sys
+
+import pytest
+
+from transmogrifai_tpu.cli.main import main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(autouse=True)
+def _fixtures_on_path(monkeypatch):
+    monkeypatch.syspath_prepend(FIXTURES)
+    yield
+    # the lint command inserts "." (parity with `op run`); drop it again
+    while "." in sys.path:
+        sys.path.remove(".")
+
+
+def test_lint_clean_app_exits_zero(capsys):
+    rc = main(["lint", "--app", "lint_clean_app:make_runner"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "clean plan" in out
+
+
+def test_lint_leaky_app_exits_nonzero(capsys):
+    rc = main(["lint", "--app", "lint_leaky_app:make_runner"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "OP302" in out
+
+
+def test_lint_json_report(capsys):
+    rc = main(["lint", "--app", "lint_leaky_app:make_runner", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["counts"]["error"] >= 1
+    assert any(d["code"] == "OP302" for d in doc["diagnostics"])
+
+
+def test_lint_rules_catalog(capsys):
+    rc = main(["lint", "--rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for code in ("OP001", "OP101", "OP203", "OP302", "OP403"):
+        assert code in out
+
+
+def test_lint_requires_app(capsys):
+    assert main(["lint"]) == 2
+
+
+def test_lint_bad_app_spec(capsys):
+    assert main(["lint", "--app", "no_colon_here"]) == 2
+
+
+def test_help_lists_lint(capsys):
+    assert main([]) == 0
+    assert "lint" in capsys.readouterr().out
+
+
+def test_unknown_command_still_errors(capsys):
+    assert main(["lintt"]) == 2

@@ -16,7 +16,6 @@ from transmogrifai_tpu.stages import (
     LambdaTransformer,
     Stage,
     Transformer,
-    adopt_wiring,
     register_stage,
 )
 from transmogrifai_tpu.types import Column, Table, kind_of
@@ -236,3 +235,28 @@ class TestLambdaTransformer:
         out = doubler(age)
         t = Table.from_rows([{"age": 3.0}], {"age": "Real"})
         assert doubler.transform_table(t)[out.name].to_list() == [6.0]
+
+
+class TestValidateDag:
+    """Direct tests of the two validate_dag failure paths (now analyzer rule
+    OP001; validate_dag keeps the raising contract for graph construction)."""
+
+    def test_duplicate_uid_raises(self):
+        s1 = PlusOne()
+        s2 = PlusOne()
+        s1(FeatureBuilder.Real("a").as_predictor())
+        s2(FeatureBuilder.Real("b").as_predictor())
+        s2.uid = s1.uid
+        with pytest.raises(ValueError, match="OP001.*duplicate stage uid"):
+            validate_dag([[s1], [s2]])
+
+    def test_shared_stage_instance_raises(self):
+        s = PlusOne()
+        s(FeatureBuilder.Real("a").as_predictor())
+        with pytest.raises(ValueError, match="OP001.*appears twice"):
+            validate_dag([[s], [s]])
+
+    def test_clean_dag_passes(self):
+        age = FeatureBuilder.Real("age").as_predictor()
+        out = PlusOne()(age)
+        validate_dag(compute_dag([out]))  # no raise

@@ -2,13 +2,11 @@
 parity (VERDICT r03 #6/#7): label-aware map bucketization, date-map circular
 encoding, text-map len/null, text-list null, time-period list/map, substring,
 and the no-filter indexer pair."""
-import math
 
 import numpy as np
-import pytest
 
 from transmogrifai_tpu.graph import FeatureBuilder
-from transmogrifai_tpu.types import Column, Table, kind_of
+from transmogrifai_tpu.types import Column, kind_of
 
 
 def _map_col(kind, rows):

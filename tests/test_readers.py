@@ -1,6 +1,5 @@
 """Reader tests (mirror of reference readers/src/test suites for simple readers +
 CSVAutoReaders schema inference)."""
-import numpy as np
 import pytest
 
 from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema

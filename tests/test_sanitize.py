@@ -1,7 +1,6 @@
 """Stage sanitizer tests (SURVEY §5.2): jit purity, traceability, serializability,
 donation guards — the TPU analog of the reference's checkSerializable validation
 (OpWorkflow.scala:265-272)."""
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

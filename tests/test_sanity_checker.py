@@ -8,7 +8,6 @@ from transmogrifai_tpu.check import SanityChecker
 from transmogrifai_tpu.graph import FeatureBuilder
 from transmogrifai_tpu.ops.stats import (
     column_stats,
-    contingency_table,
     correlation_matrix,
     cramers_v,
     pearson_with_label,

@@ -3,7 +3,7 @@ import numpy as np
 import pytest
 
 import transmogrifai_tpu.types as T
-from transmogrifai_tpu.types import Column, Table, VectorSchema, SlotInfo, kind_of
+from transmogrifai_tpu.types import Column, Table, VectorSchema, kind_of
 
 
 class TestKindRegistry:

@@ -91,9 +91,7 @@ def test_padding_does_not_change_results():
     def run(pad):
         fs = features_from_schema({"label": "RealNN", "x": "Real", "cat": "PickList"},
                                   response="label")
-        from transmogrifai_tpu.stages.feature.combiner import VectorsCombiner
 
-        import transmogrifai_tpu.stages.feature.transmogrify as tmod
 
         vector = transmogrify([fs["x"], fs["cat"]])
         combiner = vector.origin_stage

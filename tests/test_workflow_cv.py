@@ -1,10 +1,9 @@
 """Workflow-level CV (reference OpWorkflowCVTest.scala / FitStagesUtil.cutDAG):
 label-touching estimators upstream of the ModelSelector refit inside each fold."""
 import numpy as np
-import pytest
 
 import transmogrifai_tpu  # noqa: F401
-from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.graph import features_from_schema
 from transmogrifai_tpu.graph.dag import compute_dag, in_fold_estimators, label_tainted_features
 from transmogrifai_tpu.readers import InMemoryReader
 from transmogrifai_tpu.select import ParamGridBuilder
@@ -163,3 +162,99 @@ def test_workflow_cv_kills_bucketizer_leakage():
     table = InMemoryReader(rows).generate_table(list(fs.values()))
     Workflow().set_result_features(pred).with_workflow_cv().train(table=table)
     assert sel.summary_.models_evaluated == 3  # 1 grid point x 3 folds
+
+
+class TestTaintMultiPath:
+    """label_tainted_features / in_fold_estimators on multi-path lineage:
+    taint arriving through ONE of several parents, and diamond DAGs where the
+    tainted path is the longer one (max-distance layering must not lose it)."""
+
+    def _fs(self):
+        return features_from_schema({"label": "RealNN", "x": "Real"},
+                                    response="label")
+
+    def test_taint_through_one_of_two_parents(self):
+        fs = self._fs()
+        derived = fs["label"] + 1.0          # tainted branch
+        combined = fs["x"] + derived         # one clean + one tainted parent
+        dag = compute_dag([combined])
+        tainted = label_tainted_features(dag, list(fs.values()))
+        assert id(combined) in tainted
+        assert id(derived) in tainted
+        assert id(fs["x"]) not in tainted
+
+    def test_diamond_with_longer_tainted_path(self):
+        fs = self._fs()
+        short = fs["x"] + 1.0                        # x -> short clean path
+        long1 = fs["x"] + (fs["label"] + 1.0)        # x joins the label branch
+        long2 = long1 + 1.0                          # ... and runs deeper
+        joined = short + long2                       # diamond join on x
+        dag = compute_dag([joined])
+        tainted = label_tainted_features(dag, list(fs.values()))
+        assert id(joined) in tainted
+        assert id(long1) in tainted and id(long2) in tainted
+        assert id(short) not in tainted
+
+    def _selector(self):
+        return ModelSelector(
+            "binary",
+            models=[(LogisticRegression(max_iter=8),
+                     ParamGridBuilder().add("l2", [0.0]).build())],
+            validator=CrossValidation(num_folds=3, seed=1),
+            splitter=DataSplitter(reserve_test_fraction=0.1, seed=1),
+        )
+
+    def test_in_fold_estimator_tainted_via_second_parent(self):
+        from transmogrifai_tpu.stages.feature.numeric import StandardScaler
+
+        fs = self._fs()
+        combined = fs["x"] + (fs["label"] + 1.0)
+        scaled = StandardScaler()(combined)  # estimator; taint via 2nd parent
+        sel = self._selector()
+        # transmogrify refuses response-derived features; vectorize directly
+        from transmogrifai_tpu.stages.feature.numeric import RealVectorizer
+
+        pred = sel(fs["label"], RealVectorizer()(scaled))
+        dag = compute_dag([pred])
+        refit = in_fold_estimators(dag, list(fs.values()), sel)
+        assert id(scaled.origin_stage) in refit
+
+    def test_in_fold_estimator_on_diamond_longer_tainted_path(self):
+        from transmogrifai_tpu.stages.feature.numeric import StandardScaler
+
+        fs = self._fs()
+        short = fs["x"] + 1.0
+        long2 = (fs["x"] + (fs["label"] + 1.0)) + 1.0
+        joined = short + long2
+        scaled = StandardScaler()(joined)
+        sel = self._selector()
+        from transmogrifai_tpu.stages.feature.numeric import RealVectorizer
+
+        pred = sel(fs["label"], RealVectorizer()(scaled))
+        dag = compute_dag([pred])
+        refit = in_fold_estimators(dag, list(fs.values()), sel)
+        assert id(scaled.origin_stage) in refit
+        # a clean-input estimator in the same graph must NOT be refit per fold
+        fs2 = self._fs()
+        clean_scaled = StandardScaler()(fs2["x"] + 1.0)
+        sel2 = self._selector()
+        pred2 = sel2(fs2["label"], transmogrify([clean_scaled]))
+        refit2 = in_fold_estimators(compute_dag([pred2]), list(fs2.values()), sel2)
+        assert id(clean_scaled.origin_stage) not in refit2
+
+    def test_value_taint_stops_at_fit_only_label_slots(self):
+        from transmogrifai_tpu.graph.dag import value_tainted_features
+
+        fs = self._fs()
+        bucketed = fs["x"].auto_bucketize(fs["label"], max_splits=8)
+        dag = compute_dag([bucketed])
+        raw = list(fs.values())
+        # fit-taint: the bucketizer's splits depend on the label
+        assert id(bucketed) in label_tainted_features(dag, raw)
+        # value-taint: its OUTPUT ROWS carry no label values (label slot is
+        # declared fit-only), so pointwise taint must stop there
+        assert id(bucketed) not in value_tainted_features(dag, raw)
+        # ... while a plain transformer path carries label values through
+        derived = fs["label"] + 1.0
+        dag2 = compute_dag([derived])
+        assert id(derived) in value_tainted_features(dag2, raw)

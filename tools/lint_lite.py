@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Dependency-free fallback linter for containers without a `ruff` binary.
+
+Covers the highest-signal subset of the repo's `[tool.ruff]` config
+(pyproject.toml): syntax errors (E9) and unused imports (F401), honoring
+`# noqa` line suppressions and the per-file-ignores for `__init__.py`
+re-export surfaces. `tools/ci_check.sh` prefers real ruff when present and
+falls back to this script.
+
+    python tools/lint_lite.py [paths...]     # default: the package + tests + tools
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ("transmogrifai_tpu", "tests", "tools", "examples")
+
+
+def iter_py(paths) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c -> record the ROOT name ("a"), the piece imports bind
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # names re-exported via __all__ strings count as used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            used.add(el.value)
+    return used
+
+
+def check_file(path: Path) -> list[str]:
+    src = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+    if path.name == "__init__.py":
+        return []  # re-export surface (per-file-ignores: F401)
+    noqa_lines = {i + 1 for i, line in enumerate(src.splitlines())
+                  if "# noqa" in line}
+    used = _used_names(tree)
+    # imports under `if TYPE_CHECKING:` feed quoted annotations — treat the
+    # whole guarded block as used (ruff resolves the annotations; we can't)
+    type_checking_lines: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.If) and isinstance(node.test, ast.Name) \
+                and node.test.id == "TYPE_CHECKING":
+            for sub in ast.walk(node):
+                if hasattr(sub, "lineno"):
+                    type_checking_lines.add(sub.lineno)
+    problems: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if node.lineno in type_checking_lines:
+            continue
+        if node.lineno in noqa_lines:
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in used:
+                problems.append(
+                    f"{path}:{node.lineno}: F401 unused import {bound!r}")
+    return problems
+
+
+def main(argv=None) -> int:
+    paths = (argv or sys.argv[1:]) or DEFAULT_PATHS
+    problems: list[str] = []
+    files = iter_py(paths)
+    for f in files:
+        problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint_lite: {len(files)} files, {len(problems)} problem(s)",
+          file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,10 +15,10 @@ from __future__ import annotations
 
 import math
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-from ..types import FeatureKind, Storage, kind_of
+from ..types import FeatureKind
 
 _MS_PER_DAY = 24 * 3600 * 1000
 

@@ -1,0 +1,32 @@
+"""analyze — `oplint`, the pre-trace static analyzer for feature-DAG plans.
+
+The static half of the observability story (obs/ is the runtime half): with
+zero data and zero XLA traces it walks `(result_features, dag)` and emits
+structured Diagnostics — kind/arity abstract interpretation (OP10x), retrace
+hazards that defeat the compile caches (OP20x), label-leakage paths (OP30x),
+and plan hygiene (OP001, OP40x). See docs/static_analysis.md for the catalog.
+
+    from transmogrifai_tpu.analyze import analyze_plan
+    report = analyze_plan([prediction])
+    report.raise_if_errors()
+    print(report.pretty())
+
+Wired into `Workflow.train` (errors raise at plan time; `strict=False`
+downgrades), the `op lint` CLI subcommand, and `WorkflowModel.save` (report
+stamped into the model bundle).
+"""
+from .analyzer import analyze_model, analyze_plan
+from .diagnostics import (
+    AnalysisReport,
+    Diagnostic,
+    PlanAnalysisError,
+    RuleInfo,
+    SEVERITIES,
+)
+from .rules import PASSES, RULES, PlanContext, check_dag_uniqueness
+
+__all__ = [
+    "AnalysisReport", "Diagnostic", "PASSES", "PlanAnalysisError",
+    "PlanContext", "RULES", "RuleInfo", "SEVERITIES", "analyze_model",
+    "analyze_plan", "check_dag_uniqueness",
+]

@@ -1,0 +1,72 @@
+"""Entry points of the static plan analyzer (`oplint`).
+
+`analyze_plan` inspects an un-trained workflow plan; `analyze_model` replays
+the same passes over a fitted WorkflowModel's stage list (used by
+WorkflowModel.save to stamp the report into the bundle). Both run with zero
+data and zero XLA traces — pure graph walks — so Workflow.train can gate on
+the result before any reader or device work happens.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..graph.dag import compute_dag
+from ..graph.feature import Feature
+from .diagnostics import AnalysisReport, Diagnostic
+from .rules import PASSES, PlanContext
+
+
+def _derive_raw(result_features: Sequence[Feature]) -> tuple[Feature, ...]:
+    raw: list[Feature] = []
+    seen: set[int] = set()
+    for f in result_features:
+        for r in f.raw_features():
+            if id(r) not in seen:
+                seen.add(id(r))
+                raw.append(r)
+    return tuple(raw)
+
+
+def analyze_plan(result_features: Sequence[Feature],
+                 dag: Optional[list] = None, *,
+                 raw_features: Optional[Sequence[Feature]] = None,
+                 workflow_cv: bool = False,
+                 fitted: bool = False,
+                 rules: Optional[Sequence[str]] = None) -> AnalysisReport:
+    """Run every analysis pass over `(result_features, dag)`.
+
+    `dag` defaults to `compute_dag(result_features)`; `raw_features` to the
+    back-traced leaves. `rules` restricts the report to the given codes
+    (after running all passes — passes are cheap, filtering is for callers
+    that only care about one family).
+    """
+    result_features = tuple(result_features)
+    if dag is None:
+        dag = compute_dag(result_features)
+    ctx = PlanContext(
+        result_features=result_features,
+        dag=dag,
+        raw_features=tuple(raw_features) if raw_features is not None
+        else _derive_raw(result_features),
+        workflow_cv=workflow_cv,
+        fitted=fitted,
+    )
+    diagnostics: list[Diagnostic] = []
+    for p in PASSES:
+        diagnostics.extend(p(ctx))
+    if rules is not None:
+        keep = set(rules)
+        diagnostics = [d for d in diagnostics if d.code in keep]
+    n_stages = sum(len(layer) for layer in dag)
+    return AnalysisReport(diagnostics, n_stages=n_stages,
+                          n_features=len(ctx.cone_features()))
+
+
+def analyze_model(model) -> AnalysisReport:
+    """Analyze a fitted WorkflowModel's transform plan (one stage per layer,
+    execution order). Estimator-only rules (fold-refit leakage) are skipped;
+    kind, retrace, and hygiene rules apply to the fitted stages as they will
+    run at scoring time."""
+    dag = [[s] for s in model.stages]
+    return analyze_plan(model.result_features, dag,
+                        raw_features=model.raw_features, fitted=True)

@@ -1,0 +1,136 @@
+"""Diagnostic objects emitted by the static plan analyzer (`oplint`).
+
+The analyzer is the static complement of the runtime compile watchdog
+(obs/watchdog.py): it inspects `(result_features, dag)` with zero data and
+zero XLA traces and reports structured findings. Each finding carries a rule
+code (see docs/static_analysis.md for the catalog), a severity, the offending
+stage/feature uids, and a fix hint — the shape CI tooling (`op lint --json`)
+and the model bundle stamp consume.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+#: severity levels, most severe first
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    """Catalog entry for one rule code (rendered by docs and `op lint --rules`)."""
+
+    code: str
+    title: str
+    severity: str          # default severity of diagnostics the rule emits
+    rationale: str         # one-line why-this-matters
+
+    def to_json(self) -> dict:
+        return {"code": self.code, "title": self.title,
+                "severity": self.severity, "rationale": self.rationale}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: rule code + severity + location + fix hint."""
+
+    code: str
+    severity: str
+    message: str
+    stage_uid: Optional[str] = None
+    feature_uids: tuple = field(default_factory=tuple)
+    hint: Optional[str] = None
+
+    def to_json(self) -> dict:
+        out = {"code": self.code, "severity": self.severity, "message": self.message}
+        if self.stage_uid:
+            out["stage_uid"] = self.stage_uid
+        if self.feature_uids:
+            out["feature_uids"] = list(self.feature_uids)
+        if self.hint:
+            out["hint"] = self.hint
+        return out
+
+    def pretty(self) -> str:
+        loc = f" [{self.stage_uid}]" if self.stage_uid else ""
+        hint = f" (fix: {self.hint})" if self.hint else ""
+        return f"{self.severity.upper():5s} {self.code}{loc} {self.message}{hint}"
+
+
+class AnalysisReport:
+    """All diagnostics of one analyzer run, plus plan-size context."""
+
+    def __init__(self, diagnostics: Iterable[Diagnostic], n_stages: int = 0,
+                 n_features: int = 0):
+        self.diagnostics = sorted(
+            diagnostics, key=lambda d: (SEVERITIES.index(d.severity), d.code))
+        self.n_stages = n_stages
+        self.n_features = n_features
+
+    def _of(self, severity: str) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return self._of("error")
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        return self._of("warn")
+
+    @property
+    def infos(self) -> list[Diagnostic]:
+        return self._of("info")
+
+    @property
+    def has_errors(self) -> bool:
+        return any(d.severity == "error" for d in self.diagnostics)
+
+    def codes(self) -> set[str]:
+        return {d.code for d in self.diagnostics}
+
+    def by_code(self, code: str) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def raise_if_errors(self) -> "AnalysisReport":
+        if self.has_errors:
+            raise PlanAnalysisError(self)
+        return self
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "n_stages": self.n_stages,
+            "n_features": self.n_features,
+            "counts": {s: len(self._of(s)) for s in SEVERITIES},
+            "diagnostics": [d.to_json() for d in self.diagnostics],
+        }
+
+    def pretty(self) -> str:
+        head = (f"oplint: {self.n_stages} stage(s), {self.n_features} feature(s) — "
+                f"{len(self.errors)} error(s), {len(self.warnings)} warning(s), "
+                f"{len(self.infos)} info")
+        if not self.diagnostics:
+            return head + "\nclean plan: no findings"
+        return "\n".join([head] + [d.pretty() for d in self.diagnostics])
+
+    def __repr__(self) -> str:
+        return (f"AnalysisReport(errors={len(self.errors)}, "
+                f"warnings={len(self.warnings)}, infos={len(self.infos)})")
+
+
+class PlanAnalysisError(ValueError):
+    """Raised by Workflow.train (strict mode) when the plan analyzer finds
+    errors — BEFORE any reader/table access or XLA trace, the static analog
+    of the Scala compiler rejecting an ill-typed pipeline."""
+
+    def __init__(self, report: AnalysisReport):
+        self.report = report
+        errs = report.errors
+        head = "; ".join(d.pretty() for d in errs[:5])
+        more = f" (+{len(errs) - 5} more)" if len(errs) > 5 else ""
+        super().__init__(
+            f"static plan analysis found {len(errs)} error(s): {head}{more} — "
+            "run `op lint --app module:fn` for the full report, or train with "
+            "strict=False to downgrade to warnings"
+        )

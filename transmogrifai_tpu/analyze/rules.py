@@ -1,0 +1,506 @@
+"""Rule passes of the static plan analyzer.
+
+Every pass is a generator `(PlanContext) -> Iterator[Diagnostic]` registered in
+PASSES; the catalog of rule codes lives in RULES (rendered by
+docs/static_analysis.md and `op lint --rules`). All passes run on the plan
+alone — no data, no XLA traces; the kind pass is an abstract interpretation of
+`out_kind` over the DAG, the retrace pass is the static form of the runtime
+compile watchdog (obs/watchdog.py), and the leakage pass builds on the two
+taint analyses in graph/dag.py.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..graph.dag import in_fold_estimators, value_tainted_features
+from ..graph.feature import Feature
+from ..stages.base import Estimator, FeatureGeneratorStage, Stage, Transformer
+from ..types import kind_of
+from .diagnostics import Diagnostic, RuleInfo
+
+#: numeric scalars a device transformer may bake into its traced program as
+#: constants before we call it a retrace hazard (aligned with the npz sidecar
+#: threshold in WorkflowModel.save: beyond this the params are bulk fitted
+#: state, not configuration)
+CONST_PARAM_LIMIT = 1024
+
+RULES: dict[str, RuleInfo] = {}
+
+
+def _rule(code: str, title: str, severity: str, rationale: str) -> RuleInfo:
+    info = RuleInfo(code, title, severity, rationale)
+    RULES[code] = info
+    return info
+
+
+OP001 = _rule("OP001", "duplicate stage in DAG", "error",
+              "one stage instance (or uid) appearing twice corrupts layer "
+              "scheduling and serialization round-trips")
+OP101 = _rule("OP101", "kind mismatch", "error",
+              "a stage's out_kind rejects the kinds its inputs now carry — the "
+              "kernel would throw mid-train after data was read")
+OP102 = _rule("OP102", "arity violation", "error",
+              "input count outside the stage's declared (min, max) arity")
+OP103 = _rule("OP103", "nullable into NonNullable", "error",
+              "a nullable feature flows into a stage that requires the "
+              "non-nullable kind of the same storage (nulls would reach a "
+              "kernel with no fill semantics)")
+OP104 = _rule("OP104", "recorded kind drift", "error",
+              "the plan's recorded output kind no longer matches what the "
+              "stage would produce from its current inputs (graph mutated "
+              "after wiring)")
+OP201 = _rule("OP201", "unfingerprintable trace params", "warn",
+              "trace_fingerprint raises for this stage, so the fused-run "
+              "program cache is disabled for its whole device run — every "
+              "fresh graph retraces")
+OP202 = _rule("OP202", "bulk params baked as traced constants", "warn",
+              "a device stage bakes a large fitted array into its traced "
+              "program as a constant, so every new fit compiles a new program")
+OP203 = _rule("OP203", "fused-run fingerprint over budget", "warn",
+              "the summed trace fingerprints of one device run exceed the "
+              "cache key limit, silently disabling program reuse across "
+              "trains")
+OP301 = _rule("OP301", "label-tainted estimator outside fold refits", "warn",
+              "an upstream estimator consumes label-tainted features but is "
+              "not refit per validation fold, so label signal leaks into "
+              "model selection metrics")
+OP302 = _rule("OP302", "response values reach the design matrix", "error",
+              "the response flows pointwise (through transform-time reads, "
+              "not fitted params) into a predictor's feature input — the "
+              "model would train on its own answer")
+OP401 = _rule("OP401", "dead stage", "info",
+              "a wired stage consumes features of this plan but its output "
+              "reaches no result feature — dead weight in the graph")
+OP402 = _rule("OP402", "duplicate vectorizer", "warn",
+              "two stages with identical class, params, and inputs compute "
+              "the same columns twice")
+OP403 = _rule("OP403", "host stage between device layers", "info",
+              "a host stage sandwiched between device stages breaks XLA "
+              "fusion and forces device<->host transfers")
+
+
+def make_diag(code: str, message: str, **kw) -> Diagnostic:
+    """Diagnostic with severity taken from the RULES catalog — the single
+    source of truth, so retuning a rule's severity retunes emission, the
+    `op lint --rules` catalog, and train gating together."""
+    return Diagnostic(code, RULES[code].severity, message, **kw)
+
+
+@dataclass
+class PlanContext:
+    """Everything a pass may inspect; built by analyzer.analyze_plan."""
+
+    result_features: tuple
+    dag: list
+    raw_features: tuple
+    workflow_cv: bool = False
+    #: analyzing a fitted plan (WorkflowModel.save): estimator-only rules skip
+    fitted: bool = False
+    #: lazily-built feature-id -> consuming cone stages
+    _consumers: Optional[dict] = field(default=None, repr=False)
+
+    def stages(self) -> Iterator[Stage]:
+        for layer in self.dag:
+            for s in layer:
+                yield s
+
+    def cone_features(self) -> dict[int, Feature]:
+        out: dict[int, Feature] = {}
+        for f in self.result_features:
+            for a in f.all_features():
+                out[id(a)] = a
+        return out
+
+    def consumers_in_cone(self) -> dict[int, list[Stage]]:
+        if self._consumers is None:
+            cons: dict[int, list[Stage]] = {}
+            for s in self.stages():
+                for p in s.inputs:
+                    cons.setdefault(id(p), []).append(s)
+            self._consumers = cons
+        return self._consumers
+
+
+# --- OP001: uniqueness (folded-in validate_dag) ---------------------------------------
+
+def check_dag_uniqueness(dag: Sequence[Sequence[Stage]]) -> list[Diagnostic]:
+    """Shared by the analyzer pass and graph.dag.validate_dag (which raises on
+    the first finding, keeping its historical contract)."""
+    out: list[Diagnostic] = []
+    seen_uids: dict[str, Stage] = {}
+    seen_ids: set[int] = set()
+    for layer in dag:
+        for s in layer:
+            if id(s) in seen_ids:
+                out.append(make_diag(
+                    "OP001", f"stage {s} appears twice in DAG",
+                    stage_uid=s.uid,
+                    hint="wire a fresh stage instance per DAG node"))
+                continue
+            seen_ids.add(id(s))
+            if s.uid in seen_uids:
+                out.append(make_diag(
+                    "OP001",
+                    f"duplicate stage uid {s.uid} "
+                    f"({type(seen_uids[s.uid]).__name__} vs {type(s).__name__})",
+                    stage_uid=s.uid,
+                    hint="uids must be unique; do not copy uids across instances"))
+            else:
+                seen_uids[s.uid] = s
+    return out
+
+
+def pass_uniqueness(ctx: PlanContext) -> Iterator[Diagnostic]:
+    yield from check_dag_uniqueness(ctx.dag)
+
+
+# --- OP101..OP104: kind/schema abstract interpretation --------------------------------
+
+def _classify_kind_error(stage: Stage, in_kinds) -> str:
+    """OP103 when the mismatch is purely nullability against a same-storage
+    non-nullable accepted kind; OP101 otherwise."""
+    accepts = getattr(stage, "accepts", None)
+    if not accepts:
+        return "OP101"
+    acc = [kind_of(a) for a in accepts]
+    bad = [k for k in in_kinds if k.name not in accepts]
+    if bad and all(
+        k.nullable and any(a.storage is k.storage and not a.nullable for a in acc)
+        for k in bad
+    ):
+        return "OP103"
+    return "OP101"
+
+
+def pass_kinds(ctx: PlanContext) -> Iterator[Diagnostic]:
+    """Propagate FeatureKind through every stage via out_kind + arity — the
+    transformSchema walk the Scala compiler performs via types, replayed over
+    the current (possibly mutated) plan."""
+    env: dict[int, object] = {id(f): f.kind for f in ctx.raw_features}
+    for s in ctx.stages():
+        if isinstance(s, FeatureGeneratorStage):
+            continue
+        out_feat = s._output
+        lo, hi = s.arity
+        n = len(s.inputs)
+        if n < lo or (hi is not None and n > hi):
+            yield make_diag(
+                "OP102",
+                f"{type(s).__name__} takes {lo}..{hi if hi is not None else 'N'} "
+                f"inputs, got {n}",
+                stage_uid=s.uid,
+                feature_uids=tuple(f.uid for f in s.inputs),
+                hint="rewire the stage with the declared input count")
+            # out_kind contracts assume the declared arity (in_kinds[1] etc.)
+            # — calling it anyway would crash the analyzer on the very plans
+            # OP102 exists for; downstream sees the recorded kind instead
+            if out_feat is not None:
+                env[id(out_feat)] = out_feat.kind
+            continue
+        in_kinds = [env.get(id(p), p.kind) for p in s.inputs]
+        recomputed = None
+        try:
+            recomputed = s.out_kind(in_kinds)
+        except (TypeError, ValueError, KeyError) as e:
+            # the out_kind contract: raise one of these for invalid inputs.
+            # Anything else is a stage BUG and must propagate, not masquerade
+            # as a user wiring error.
+            code = _classify_kind_error(s, in_kinds)
+            names = [k.name for k in in_kinds]
+            if code == "OP103":
+                hint = ("fill the nulls upstream (e.g. fillMissingWithMean / a "
+                        "vectorizer with fill) so the non-nullable kind is "
+                        "produced before this stage")
+            else:
+                hint = "rewire with accepted input kinds or pick the matching stage"
+            yield make_diag(
+                code,
+                f"{type(s).__name__} rejects input kinds {names}: {e}",
+                stage_uid=s.uid,
+                feature_uids=tuple(f.uid for f in s.inputs),
+                hint=hint)
+        if recomputed is not None and out_feat is not None \
+                and recomputed.name != out_feat.kind.name:
+            yield make_diag(
+                "OP104",
+                f"{type(s).__name__} would produce {recomputed.name} from its "
+                f"current inputs but the plan records {out_feat.kind.name} for "
+                f"{out_feat.name!r}",
+                stage_uid=s.uid, feature_uids=(out_feat.uid,),
+                hint="rebuild the graph instead of mutating wired features")
+        if out_feat is not None:
+            env[id(out_feat)] = recomputed if recomputed is not None else out_feat.kind
+
+
+# --- OP201..OP203: retrace-hazard lint ------------------------------------------------
+
+def _count_bulk_scalars(v) -> int:
+    """Numeric scalars in a nested params value (list/tuple/ndarray trees)."""
+    if isinstance(v, np.ndarray):
+        return int(v.size) if v.dtype.kind in "fiub" else 0
+    if isinstance(v, (list, tuple)):
+        return sum(_count_bulk_scalars(x) for x in v)
+    if isinstance(v, dict):
+        return sum(_count_bulk_scalars(x) for x in v.values())
+    return 1 if isinstance(v, (int, float, np.integer, np.floating)) else 0
+
+
+def _fused_runs(ctx: PlanContext) -> Iterator[tuple[int, list[Stage]]]:
+    """(layer index, contiguous fused device run) pairs, grouped exactly as
+    `_CompiledPlan` will group them: fitted plans (analyze_model) fuse across
+    the whole stage sequence, train plans fuse per layer with device stages
+    ordered first (`_topo_within_layer`); kernel_jitted stages break runs in
+    both. Estimators are skipped — their fitted models' runs are only
+    analyzable post-fit."""
+    from ..workflow.workflow import _topo_within_layer, fuses_into_run
+
+    if ctx.fitted:
+        orders = [(0, [s for layer in ctx.dag for s in layer])]
+    else:
+        # the runtime's own per-layer ordering, so the run grouping here can
+        # never drift from what _CompiledPlan actually fuses
+        orders = [
+            (li, _topo_within_layer(
+                [s for s in layer
+                 if isinstance(s, Transformer) and not isinstance(s, Estimator)]))
+            for li, layer in enumerate(ctx.dag)
+        ]
+    for li, seq in orders:
+        run: list[Stage] = []
+        for s in seq:
+            if isinstance(s, Transformer) and not isinstance(s, Estimator) \
+                    and fuses_into_run(s):
+                run.append(s)
+            elif run:
+                yield li, run
+                run = []
+        if run:
+            yield li, run
+
+
+def pass_retrace(ctx: PlanContext) -> Iterator[Diagnostic]:
+    """Static form of the compile watchdog: find plan properties that defeat
+    the `_CompiledPlan` fused-run cache and the warmup compile caches (the
+    static analog of the r05 `_metrics_program` vmap-keying regression)."""
+    # lazy, no cycle: workflow itself imports analyze only inside train
+    from ..workflow.workflow import _FUSED_FINGERPRINT_MAX, stage_fingerprint_entry
+
+    for li, run in _fused_runs(ctx):
+        run_fp_bytes = 0
+        run_cacheable = True
+        for s in run:
+            # this stage enters a fused jit run: its params become traced
+            # constants and its fingerprint becomes part of the cache key
+            for key, v in s.params.items():
+                n = _count_bulk_scalars(v)
+                if n > CONST_PARAM_LIMIT:
+                    yield make_diag(
+                        "OP202",
+                        f"{type(s).__name__} bakes param {key!r} "
+                        f"({n} scalars) into its traced program as a constant; "
+                        "every new fit compiles a new program",
+                        stage_uid=s.uid,
+                        hint="dispatch through a module-level jitted kernel "
+                             "taking the params as arguments (kernel_jitted) "
+                             "so fits of the same shape share one program")
+            try:
+                run_fp_bytes += len(stage_fingerprint_entry(s))
+            except TypeError as e:
+                run_cacheable = False
+                yield make_diag(
+                    "OP201",
+                    f"{type(s).__name__} has no stable trace fingerprint ({e}); "
+                    f"the fused-run program cache is disabled for its whole "
+                    f"device run in layer {li} — every fresh graph retraces it",
+                    stage_uid=s.uid,
+                    hint="give the callable a registered identity (fn_name= / "
+                         "module-level function) or keep state in params")
+        if run_cacheable and run_fp_bytes > _FUSED_FINGERPRINT_MAX:
+            yield make_diag(
+                "OP203",
+                f"layer {li}: one fused run's fingerprints total {run_fp_bytes} "
+                f"bytes (> {_FUSED_FINGERPRINT_MAX}); the program cache silently "
+                "skips this run, so every train re-traces it",
+                stage_uid=run[0].uid,
+                hint="move bulk fitted arrays out of ctor params (kernel_jitted "
+                     "kernels take them as arguments) to shrink the cache key")
+
+
+# --- OP301/OP302: leakage lint --------------------------------------------------------
+
+def pass_leakage(ctx: PlanContext) -> Iterator[Diagnostic]:
+    if ctx.fitted:
+        return  # fitted plans have no estimators left to refit
+    dag, raw = ctx.dag, ctx.raw_features
+    stage_by_id = {id(s): s for s in ctx.stages()}
+
+    selectors = [s for s in ctx.stages()
+                 if isinstance(s, Estimator) and s.operation_name == "modelSelector"]
+    for sel in selectors:
+        refit = in_fold_estimators(dag, raw, sel)
+        if not refit or ctx.workflow_cv:
+            continue
+        # only estimators on the selector's DESIGN-MATRIX path can inflate
+        # fold metrics; one reaching it solely through a fit-only label slot
+        # (a StringIndexer encoding the response) leaks nothing into the
+        # matrix, and "refit it per fold" would be harmful advice (per-fold
+        # label re-indexing)
+        fit_only = set(getattr(sel, "fit_only_inputs", ()) or ())
+        matrix_upstream: set[int] = set()
+        for i, inp in enumerate(sel.inputs):
+            if i not in fit_only:
+                matrix_upstream |= {id(s) for s in inp.parent_stages()}
+        offenders = refit & matrix_upstream
+        if offenders:
+            names = sorted(repr(stage_by_id[i]) for i in offenders)
+            yield make_diag(
+                "OP301",
+                f"estimator(s) {', '.join(names)} consume label-tainted "
+                f"features upstream of {sel!r} but workflow-level CV is off: "
+                "their label signal leaks into every validation fold",
+                stage_uid=sel.uid,
+                hint="enable Workflow().with_workflow_cv() so they refit per "
+                     "fold, or remove the label dependence")
+
+    # pointwise response flow: taint crosses every input EXCEPT declared
+    # fit-only label slots (those influence fitted params, handled above)
+    value_tainted = value_tainted_features(dag, raw)
+    resp_names = [f.name for f in raw if f.is_response]
+    for s in ctx.stages():
+        fit_only = set(getattr(s, "fit_only_inputs", ()) or ())
+        if not fit_only or not isinstance(s, Estimator):
+            continue
+        for i, f in enumerate(s.inputs):
+            if i in fit_only:
+                continue
+            if id(f) in value_tainted:
+                yield make_diag(
+                    "OP302",
+                    f"response value(s) {resp_names} reach the design-matrix "
+                    f"input {f.name!r} of {type(s).__name__} through "
+                    "transform-time reads: the model would train on its own "
+                    "answer",
+                    stage_uid=s.uid, feature_uids=(f.uid,),
+                    hint="exclude the response (and features derived from its "
+                         "values) from the predictor set")
+
+
+# --- OP401..OP403: plan hygiene -------------------------------------------------------
+
+def pass_hygiene(ctx: PlanContext) -> Iterator[Diagnostic]:
+    cone_feats = ctx.cone_features()
+    cone_stage_ids = {id(s) for s in ctx.stages()}
+    for f in cone_feats.values():
+        if f.origin_stage is not None:
+            cone_stage_ids.add(id(f.origin_stage))
+
+    # OP401: stages wired onto this plan's features whose output goes nowhere.
+    # Consumers with any input OUTSIDE the cone clearly belong to a sibling
+    # plan built over shared features and are skipped; a consumer wired purely
+    # onto cone features is either dead weight or a sibling plan's first
+    # layer — statically indistinguishable, so the message says so (info).
+    reported: set[int] = set()
+    for f in cone_feats.values():
+        for ref in getattr(f, "consumers", ()):
+            c = ref() if callable(ref) else ref
+            if c is None:  # stage of an abandoned plan, already collected
+                continue
+            if id(c) in cone_stage_ids or id(c) in reported:
+                continue
+            reported.add(id(c))
+            if any(id(p) not in cone_feats for p in c.inputs):
+                continue  # consumes features of another plan: not ours to judge
+            out_name = c._output.name if c._output is not None else "?"
+            yield make_diag(
+                "OP401",
+                f"{type(c).__name__} consumes {f.name!r} but its output "
+                f"{out_name!r} reaches no result feature of this plan "
+                "(dead stage — or part of another plan sharing these features)",
+                stage_uid=c.uid,
+                hint="if unintended, include its output in the result features "
+                     "or drop the stage")
+
+    # OP402: duplicate vectorizers/transformers over identical parents. The
+    # identity is the stage's OWN fingerprint contract — trace_fingerprint for
+    # transformers, config_fingerprint for estimators — which covers state
+    # held outside params (LambdaTransformer.fn) and raises TypeError when a
+    # stage has no provable identity (two anonymous lambdas must NOT be
+    # called duplicates).
+    seen: dict[tuple, Stage] = {}
+    for s in ctx.stages():
+        if isinstance(s, FeatureGeneratorStage):
+            continue
+        try:
+            if isinstance(s, Estimator):
+                ident = s.config_fingerprint()
+            elif isinstance(s, Transformer):
+                ident = s.trace_fingerprint()
+            else:
+                continue
+            fp = json.dumps(_plain_params(ident), sort_keys=True)
+        except (TypeError, ValueError):
+            continue
+        key = (type(s).__name__, tuple(id(p) for p in s.inputs), fp)
+        first = seen.get(key)
+        if first is None:
+            seen[key] = s
+        else:
+            yield make_diag(
+                "OP402",
+                f"{type(s).__name__} ({s.uid}) duplicates {first.uid}: same "
+                "class, params, and input features — the same columns are "
+                "computed twice",
+                stage_uid=s.uid,
+                hint=f"reuse the output feature of {first.uid}")
+
+    # OP403: host stages sandwiched between device stages (fusion breakers)
+    consumers = ctx.consumers_in_cone()
+    for li, layer in enumerate(ctx.dag):
+        breakers: list[tuple[Stage, int]] = []
+        for s in layer:
+            if not isinstance(s, Transformer) or isinstance(s, Estimator) \
+                    or s.device_op:
+                continue
+            dev_parents = sum(
+                1 for p in s.inputs
+                if p.origin_stage is not None
+                and getattr(p.origin_stage, "device_op", False))
+            out = s._output
+            dev_consumers = 0 if out is None else sum(
+                1 for c in consumers.get(id(out), ())
+                if getattr(c, "device_op", False))
+            if dev_parents and dev_consumers:
+                breakers.append((s, dev_parents + dev_consumers))
+        total = sum(n for _, n in breakers)
+        for s, n in breakers:
+            yield make_diag(
+                "OP403",
+                f"host stage {type(s).__name__} sits between device stages "
+                f"(layer {li}: {len(breakers)} fusion breaker(s), ~{total} "
+                "device<->host transfers per pass)",
+                stage_uid=s.uid,
+                hint="make the kernel pure-jnp (device_op=True) or move host "
+                     "work before the first device layer")
+
+
+def _plain_params(obj):
+    """Params -> comparable plain values (callables by qualified name)."""
+    if isinstance(obj, dict):
+        return {k: _plain_params(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_plain_params(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer, np.floating)):
+        return obj.item()
+    if callable(obj) and not isinstance(obj, type):
+        return f"{getattr(obj, '__module__', '')}.{getattr(obj, '__qualname__', repr(obj))}"
+    return obj
+
+
+#: pass registry, run in order by the analyzer
+PASSES = (pass_uniqueness, pass_kinds, pass_retrace, pass_leakage, pass_hygiene)

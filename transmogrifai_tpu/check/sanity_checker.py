@@ -149,6 +149,7 @@ class SanityChecker(Estimator):
 
     operation_name = "sanityChecker"
     arity = (2, 2)
+    fit_only_inputs = (0,)  # the label drives drop decisions, never the output rows
 
     def __init__(self, check_sample: float = 1.0, sample_seed: int = 42,
                  max_correlation: float = 0.95, min_correlation: float = 0.0,
@@ -388,6 +389,7 @@ class SanityCheckerModel(Transformer):
     operation_name = "sanityChecker"
     arity = (2, 2)
     device_op = True
+    fit_only_inputs = (0,)  # transform reads only the vector input
     #: the device work dispatches to the module-level shape-keyed kernel above
     #: with keep-indices as an ARGUMENT. Fusing this stage into the per-plan
     #: jit instead keyed the program on its input's uid-suffixed name (the

@@ -13,7 +13,7 @@ import json
 import keyword
 import os
 import re
-from typing import Optional, Sequence
+from typing import Sequence
 
 from ..readers.csv import infer_schema
 

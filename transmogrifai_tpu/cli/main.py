@@ -35,11 +35,16 @@ def _cmd_run(argv) -> int:
     ap.add_argument("--trace-dir", default=None, metavar="DIR",
                     help="capture an on-disk jax.profiler trace for "
                          "TensorBoard/XProf")
+    ap.add_argument("--lenient-lint", action="store_true",
+                    help="downgrade error-severity oplint findings to "
+                         "warnings instead of failing train at plan time")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.params import OpParams
 
     params = OpParams.from_json(args.params) if args.params else OpParams()
+    if args.lenient_lint:
+        params.lenient_lint = True
     for attr in ("model_location", "write_location", "metrics_location"):
         v = getattr(args, attr)
         if v is not None:  # CLI flags override the params file
@@ -97,6 +102,61 @@ def _cmd_gen(argv) -> int:
     )
     print(f"generated {proj}/ (main.py, params.json, README.md)")
     return 0
+
+
+def _cmd_lint(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op lint",
+        description="statically analyze a workflow app's plan (oplint): kind/"
+                    "arity checks, retrace hazards, leakage paths, plan "
+                    "hygiene — zero data, zero XLA traces; exits nonzero on "
+                    "any error-severity finding")
+    ap.add_argument("--app", default=None,
+                    help="module:function returning a WorkflowRunner or a "
+                         "Workflow (function takes no required args)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the structured report as JSON on stdout (for CI)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from transmogrifai_tpu.analyze import RULES, analyze_plan
+
+    if args.rules:
+        import json
+
+        if args.as_json:
+            print(json.dumps([r.to_json() for r in RULES.values()], indent=1))
+        else:
+            for r in RULES.values():
+                print(f"{r.code}  {r.severity:5s} {r.title} — {r.rationale}")
+        return 0
+    if not args.app:
+        print("op lint: --app module:fn is required (or --rules)", file=sys.stderr)
+        return 2
+    mod_name, _, fn_name = args.app.partition(":")
+    if not fn_name:
+        print("op lint: --app must be module:function", file=sys.stderr)
+        return 2
+    sys.path.insert(0, ".")
+    app = getattr(importlib.import_module(mod_name), fn_name)()
+    workflow = getattr(app, "workflow", app)  # WorkflowRunner or bare Workflow
+    if not getattr(workflow, "result_features", ()):
+        print("op lint: the app's workflow has no result features", file=sys.stderr)
+        return 2
+    report = analyze_plan(
+        workflow.result_features,
+        getattr(workflow, "_dag", None),
+        raw_features=getattr(workflow, "raw_features", None) or None,
+        workflow_cv=getattr(workflow, "_workflow_cv", False),
+    )
+    if args.as_json:
+        import json
+
+        print(json.dumps(report.to_json(), indent=1))
+    else:
+        print(report.pretty())
+    return 1 if report.has_errors else 0
 
 
 def _cmd_warmup(argv) -> int:
@@ -171,6 +231,8 @@ def main(argv=None) -> int:
             "  run       run a workflow app (--app module:fn --type train|score|"
             "features|evaluate|streaming_score)\n"
             "  gen       scaffold a project from a CSV (--input --id --response)\n"
+            "  lint      statically analyze an app's plan "
+            "(--app module:fn [--json] [--rules])\n"
             "  warmup    pre-seed the compile cache for planned train shapes\n"
             "  version   print framework version"
         )
@@ -183,6 +245,8 @@ def main(argv=None) -> int:
         return _cmd_run(rest)
     if cmd == "gen":
         return _cmd_gen(rest)
+    if cmd == "lint":
+        return _cmd_lint(rest)
     if cmd == "warmup":
         return _cmd_warmup(rest)
     print(f"op: unknown command {cmd!r}", file=sys.stderr)

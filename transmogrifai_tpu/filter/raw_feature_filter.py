@@ -25,7 +25,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from ..types import Column, Storage

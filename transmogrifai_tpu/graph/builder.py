@@ -8,7 +8,7 @@ codegen extract path becomes plain Python callables; `fromDataFrame` becomes
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Mapping, Optional, Sequence
+from typing import Any, Callable, Mapping, Optional
 
 from ..types import KINDS, FeatureKind, Table, kind_of
 from .feature import Feature

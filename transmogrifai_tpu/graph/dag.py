@@ -46,17 +46,15 @@ def dag_stages(dag: list[list[Stage]]) -> list[Stage]:
 
 
 def validate_dag(dag: list[list[Stage]]) -> None:
-    """Uniqueness checks (analog of OpWorkflow.validateStages, OpWorkflow.scala:265-323)."""
-    seen_uids: set[str] = set()
-    seen_ids: set[int] = set()
-    for layer in dag:
-        for s in layer:
-            if id(s) in seen_ids:
-                raise ValueError(f"stage {s} appears twice in DAG")
-            if s.uid in seen_uids:
-                raise ValueError(f"duplicate stage uid {s.uid}")
-            seen_ids.add(id(s))
-            seen_uids.add(s.uid)
+    """Uniqueness checks (analog of OpWorkflow.validateStages, OpWorkflow.scala:265-323).
+
+    The check itself lives in the static analyzer as rule OP001
+    (analyze/rules.py) — this raising wrapper keeps the historical
+    fail-fast contract for graph construction and manifest replay."""
+    from ..analyze.rules import check_dag_uniqueness  # lazy: analyze imports graph
+
+    for d in check_dag_uniqueness(dag):
+        raise ValueError(f"[{d.code}] {d.message}")
 
 
 def label_tainted_features(dag: list[list[Stage]], raw_features: Sequence[Feature]) -> set[int]:
@@ -70,6 +68,26 @@ def label_tainted_features(dag: list[list[Stage]], raw_features: Sequence[Featur
             if any(id(p) in tainted for p in stage.inputs):
                 out = stage.get_output()
                 tainted.add(id(out))
+    return tainted
+
+
+def value_tainted_features(dag: list[list[Stage]],
+                           raw_features: Sequence[Feature]) -> set[int]:
+    """ids of features whose transform-time VALUES depend pointwise on a
+    response. Unlike label_tainted_features (any dependence, including through
+    fitted params — the fold-refit cut), taint here does NOT flow through a
+    stage's declared `fit_only_inputs` (label slots read only during fit, e.g.
+    DecisionTreeNumericBucketizer's inputs[0]): those influence what is
+    learned, not the rows the fitted transform emits. The analyzer's OP302
+    rule uses this to reject plans where the raw response literally lands in
+    a predictor's design matrix."""
+    tainted: set[int] = {id(f) for f in raw_features if f.is_response}
+    for layer in dag:
+        for stage in layer:
+            fit_only = set(getattr(stage, "fit_only_inputs", ()) or ())
+            if any(id(p) in tainted for i, p in enumerate(stage.inputs)
+                   if i not in fit_only):
+                tainted.add(id(stage.get_output()))
     return tainted
 
 

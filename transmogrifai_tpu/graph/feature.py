@@ -7,7 +7,6 @@ rooted at result features is the compile target that lowers to XLA computations.
 """
 from __future__ import annotations
 
-from collections import deque
 from typing import TYPE_CHECKING, Iterable, Optional
 
 from ..types import FeatureKind, kind_of
@@ -24,7 +23,7 @@ class FeatureCycleError(Exception):
 
 class Feature:
     __slots__ = ("name", "kind", "is_response", "origin_stage", "parents", "uid",
-                 "distributions")
+                 "distributions", "consumers")
 
     def __init__(
         self,
@@ -45,6 +44,14 @@ class Feature:
         #: (analog of FeatureLike.distributions, FeatureLike.scala:48-103):
         #: tuple of (split-name, FeatureDistribution) for "train"/"scoring"
         self.distributions: tuple = ()
+        #: WEAK references to stages wired onto this feature via set_input
+        #: (the forward edges the lineage graph lacks); the analyzer's
+        #: dead-stage rule (OP401) walks them. Weakrefs + opportunistic
+        #: pruning keep long-lived processes that build many plans over
+        #: shared raw features from retaining every abandoned plan's stages.
+        #: Fitted models adopt wiring without registering, so only user-wired
+        #: stages appear.
+        self.consumers: list = []
 
     # --- identity is object identity; uid for serialization ---------------------------
     def __repr__(self) -> str:

@@ -10,7 +10,7 @@ top-K selection is jax.lax.top_k over the slot axis.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -18,7 +18,6 @@ import numpy as np
 
 from ..stages.base import Transformer, register_stage
 from ..types import Column, kind_of
-from ..types.vector_schema import VectorSchema
 
 
 #: memory cap for the auto-derived slot chunk: the sweep materializes

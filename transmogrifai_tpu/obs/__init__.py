@@ -31,9 +31,9 @@ from .watchdog import deactivate as _deactivate
 
 __all__ = [
     "CompileEvent", "PhaseTiming", "RetraceBudget", "RetraceBudgetExceeded",
-    "Span", "Tracer", "cached_compiled", "compiled_flops", "cost_analysis",
-    "current", "current_span", "record_cost", "retrace_budget", "span",
-    "trace",
+    "Span", "Tracer", "add_event", "cached_compiled", "compiled_flops",
+    "cost_analysis", "current", "current_span", "record_cost",
+    "retrace_budget", "span", "trace",
 ]
 
 #: innermost-first stack of active tracers (module-global, shared across
@@ -82,6 +82,15 @@ def trace(trace_dir: Optional[str] = None, name: str = "run"):
         _deactivate(tracer, "tracer")
         _ACTIVE.remove(tracer)
         tracer.finish()
+
+
+def add_event(name: str, **attrs) -> None:
+    """Attach a point-in-time annotation to the active tracer's current span
+    (e.g. oplint diagnostics downgraded by `train(strict=False)`); no-op
+    without a tracer."""
+    t = current()
+    if t is not None:
+        t.add_event(name, **attrs)
 
 
 @contextmanager

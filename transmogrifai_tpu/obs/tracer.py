@@ -31,7 +31,7 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional
 
 #: compile-event kinds, in pipeline order: python tracing -> StableHLO lowering
@@ -70,7 +70,7 @@ class Span:
     """One node of the trace tree. Created via Tracer.span(); not by hand."""
 
     __slots__ = ("name", "parent", "children", "t0", "t1", "thread",
-                 "compiles", "cost", "mem_delta_bytes")
+                 "compiles", "cost", "mem_delta_bytes", "events")
 
     def __init__(self, name: str, parent: Optional["Span"] = None):
         self.name = name
@@ -82,6 +82,10 @@ class Span:
         self.compiles: list[CompileEvent] = []
         self.cost: Optional[dict[str, float]] = None
         self.mem_delta_bytes: Optional[int] = None
+        #: point-in-time annotations attached via Tracer.add_event (e.g. the
+        #: plan analyzer's downgraded diagnostics in strict=False trains):
+        #: list of {"name": ..., **attrs} dicts
+        self.events: list[dict] = []
 
     @property
     def wall_s(self) -> float:
@@ -105,6 +109,8 @@ class Span:
             out["cost"] = dict(self.cost)
         if self.mem_delta_bytes is not None:
             out["mem_delta_bytes"] = self.mem_delta_bytes
+        if self.events:
+            out["events"] = [dict(e) for e in self.events]
         if self.children:
             out["children"] = [c.to_dict() for c in self.children]
         return out
@@ -182,6 +188,13 @@ class Tracer:
         sp = self.current_span()
         if sp is not self.root:
             sp.cost = dict(cost)
+
+    def add_event(self, name: str, **attrs) -> None:
+        """Attach a point-in-time annotation to the calling thread's innermost
+        open span (the root outside any span)."""
+        sp = self.current_span()
+        with self._lock:
+            sp.events.append({"name": name, **attrs})
 
     # --- compile attribution (called by watchdog listeners) ---------------------------
     def on_compile_event(self, kind: str, program: str, duration_s: float) -> None:

@@ -36,6 +36,9 @@ class OpParams:
     checkpoint_location: Optional[str] = None
     log_stage_metrics: bool = False          # per-stage timing into the run report
     collect_stage_metrics: bool = True
+    #: downgrade error-severity oplint findings to warnings instead of failing
+    #: train at plan time (Workflow.train(strict=False); `op run --lenient-lint`)
+    lenient_lint: bool = False
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

@@ -10,9 +10,8 @@ from __future__ import annotations
 
 import csv as _csv
 import os
-from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+from typing import Any, Callable, Iterable, Iterator, Optional
 
-from ..types import Table
 
 
 class StreamingReader:

@@ -25,7 +25,6 @@ from .grids import ParamGridBuilder
 from .splitters import DataBalancer, DataCutter, DataSplitter, SplitterSummary
 from .validator import (
     CrossValidation,
-    EvaluatedGridPoint,
     TrainValidationSplit,
     ValidatorBase,
     evaluate_candidates,

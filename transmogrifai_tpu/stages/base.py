@@ -21,6 +21,7 @@ params (no reflection — explicit `params` dict), fitted state is a jnp pytree 
 """
 from __future__ import annotations
 
+import weakref
 from typing import TYPE_CHECKING, Any, Callable, Optional, Sequence
 
 import numpy as np
@@ -64,6 +65,14 @@ class Stage:
     device_op: bool = False
     #: (min, max) accepted input count; max None = unbounded (Sequence stages)
     arity: tuple[int, Optional[int]] = (1, 1)
+    #: input positions read ONLY during fit (label slots of label-aware
+    #: estimators: PredictorEstimator/SanityChecker/DecisionTree bucketizers
+    #: declare (0,)). The fitted transform never reads these columns, so
+    #: response taint does not flow through them pointwise — the distinction
+    #: between "leaks into fold metrics" (refit per fold, OP301) and "response
+    #: values land in the design matrix" (always wrong, OP302); see
+    #: graph.dag.value_tainted_features.
+    fit_only_inputs: tuple[int, ...] = ()
 
     def __init__(self, **params):
         self.uid = make_uid(type(self).__name__)
@@ -93,6 +102,20 @@ class Stage:
             )
         self.inputs = tuple(features)
         out_kind = self.out_kind([f.kind for f in features])
+        for f in features:
+            # forward edge for the static analyzer (lineage only stores
+            # parents), registered only once wiring validated. WEAK refs:
+            # a shared raw feature must not pin every stage of every plan
+            # ever wired onto it; dead entries are pruned as the list grows
+            cons = getattr(f, "consumers", None)
+            if cons is not None:
+                n = len(cons)
+                # prune dead refs at power-of-two sizes: O(n) total rescans
+                # across n wirings, so a feature with many LIVE consumers is
+                # not rescanned on every append
+                if n >= 8 and (n & (n - 1)) == 0:
+                    cons[:] = [r for r in cons if r() is not None]
+                cons.append(weakref.ref(self))
         self._output = Feature(
             self.make_output_name(),
             out_kind,

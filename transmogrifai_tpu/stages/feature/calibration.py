@@ -92,6 +92,7 @@ class DecisionTreeNumericBucketizer(Estimator):
 
     operation_name = "autoBucketize"
     arity = (2, 2)
+    fit_only_inputs = (0,)  # label read only at fit time
 
     def __init__(self, track_nulls: bool = True, max_splits: int = 16,
                  min_info_gain: float = 0.01):
@@ -125,6 +126,7 @@ class DecisionTreeNumericBucketizer(Estimator):
 class DecisionTreeNumericBucketizerModel(Transformer):
     operation_name = "autoBucketize"
     arity = (2, 2)
+    fit_only_inputs = (0,)  # label read only at fit time
     device_op = False  # integral inputs arrive as host int64
 
     def out_kind(self, in_kinds):
@@ -212,6 +214,7 @@ class DecisionTreeNumericMapBucketizer(Estimator):
 
     operation_name = "autoBucketizeMap"
     arity = (2, 2)
+    fit_only_inputs = (0,)  # label read only at fit time
 
     NUMERIC_MAPS = ("RealMap", "CurrencyMap", "PercentMap", "IntegralMap")
 
@@ -257,6 +260,7 @@ class DecisionTreeNumericMapBucketizer(Estimator):
 class DecisionTreeNumericMapBucketizerModel(Transformer):
     operation_name = "autoBucketizeMap"
     arity = (2, 2)
+    fit_only_inputs = (0,)  # label read only at fit time
     device_op = False  # host map pivot
 
     def __init__(self, splits_per_key: dict | None = None, track_nulls: bool = True,

@@ -10,12 +10,12 @@ the SURVEY §7 recompilation mitigation).
 from __future__ import annotations
 
 from collections import Counter, defaultdict
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np
 
-from ...types import Column, SlotInfo, VectorSchema, kind_of
+from ...types import Column, SlotInfo, VectorSchema
 from ..base import register_stage
 from .categorical import pick_top_k
 from .common import (

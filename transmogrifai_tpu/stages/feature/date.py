@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ...types import Column, SlotInfo, VectorSchema
+from ...types import Column, VectorSchema
 from ..base import register_stage
 from .common import (
     SequenceVectorizer,

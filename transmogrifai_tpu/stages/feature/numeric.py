@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...types import Column, SlotInfo, VectorSchema, kind_of
-from ..base import Estimator, Transformer, adopt_wiring, register_stage
+from ..base import Estimator, Transformer, register_stage
 from .common import (
     SequenceVectorizer,
     SequenceVectorizerEstimator,

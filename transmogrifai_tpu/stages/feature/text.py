@@ -11,7 +11,6 @@ place of the reference's MurMur3 — same bounded-feature-space role.
 from __future__ import annotations
 
 import zlib
-from collections import Counter
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
@@ -19,7 +18,7 @@ import numpy as np
 
 from ...types import Column, SlotInfo, VectorSchema, kind_of
 from ..base import Transformer, register_stage
-from .categorical import OneHotVectorizerModel, count_categories, pick_top_k
+from .categorical import count_categories, pick_top_k
 from .common import (
     SequenceVectorizer,
     SequenceVectorizerEstimator,

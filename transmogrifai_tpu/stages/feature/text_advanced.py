@@ -26,7 +26,7 @@ import numpy as np
 from ...types import Column, SlotInfo, VectorSchema, kind_of
 from ..base import Transformer, register_stage
 from .common import SequenceVectorizer, SequenceVectorizerEstimator, value_slot
-from .text import _TEXT_KINDS, tokenize
+from .text import _TEXT_KINDS
 
 # --- n-grams & stop words ---------------------------------------------------------------
 

@@ -13,7 +13,7 @@ everything with VectorsCombiner into the final feature vector.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Sequence
 
 from ...graph.feature import Feature
 from .categorical import OneHotVectorizer

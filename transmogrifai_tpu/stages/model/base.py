@@ -44,6 +44,9 @@ class PredictorEstimator(Estimator):
     """
 
     arity = (2, 2)
+    #: the response input is read only during fit — predictions never read it
+    #: (the value-taint cut the static analyzer's leakage rules rely on)
+    fit_only_inputs = (0,)
     #: hyperparams that can be vmapped (must be accepted as traced floats by fit_fn)
     vmap_params: tuple = ()
 
@@ -130,6 +133,7 @@ class PredictionModel(Transformer):
 
     arity = (2, 2)
     device_op = True
+    fit_only_inputs = (0,)  # scoring reads only the feature vector
     #: predict() dispatches to a module-level jitted kernel with params as
     #: arguments — the workflow plan calls it directly instead of fusing it into
     #: an outer jit (which would bake params as constants and retrace per train)

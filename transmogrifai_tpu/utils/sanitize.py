@@ -15,7 +15,7 @@ Opt-in: `check_stages(stages, sample_table)` from tests/CI, or
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Sequence
 
 import numpy as np
 

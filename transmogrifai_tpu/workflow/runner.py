@@ -218,7 +218,8 @@ class WorkflowRunner:
         stages = [f.origin_stage for rf in self.workflow.result_features
                   for f in rf.all_features() if f.origin_stage is not None]
         params.apply_to_stages(stages)
-        model = self.workflow.train(checkpoint_dir=params.checkpoint_location)
+        model = self.workflow.train(checkpoint_dir=params.checkpoint_location,
+                                    strict=not params.lenient_lint)
         mark("train")
         loc = params.model_location
         from .. import obs

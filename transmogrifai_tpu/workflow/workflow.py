@@ -30,14 +30,7 @@ _logger = logging.getLogger(__name__)
 from ..graph.dag import compute_dag, split_layer_by_kind, validate_dag
 from ..graph.feature import Feature, validate_distinct_names
 from ..readers.base import DataReader, TableReader
-from ..stages.base import (
-    Estimator,
-    FeatureGeneratorStage,
-    Stage,
-    Transformer,
-    _jsonify,
-    adopt_wiring,
-)
+from ..stages.base import Stage, Transformer, adopt_wiring
 from ..types import Column, Table
 from ..utils import uid as make_uid
 
@@ -77,6 +70,23 @@ _FUSED_RUN_CACHE_MAX = 64
 _FUSED_FINGERPRINT_MAX = 1 << 16
 
 
+def stage_fingerprint_entry(s: "Transformer") -> str:
+    """One stage's contribution to the fused-run cache key. The static
+    analyzer's retrace rules (OP201/OP203) call this too, so lint verdicts and
+    the runtime cache can never drift apart; raises TypeError exactly when the
+    stage's trace_fingerprint does (identity-less callables -> run uncached)."""
+    return json.dumps({"c": type(s).__name__, "p": s.trace_fingerprint()},
+                      sort_keys=True)
+
+
+def fuses_into_run(s) -> bool:
+    """Whether _CompiledPlan would place this stage inside a fused device run
+    (kernel_jitted stages dispatch to their own shared-jit kernels and BREAK
+    runs — mirrored by the analyzer's run grouping)."""
+    return bool(getattr(s, "device_op", False)) \
+        and not getattr(s, "kernel_jitted", False)
+
+
 def _fuse_device_run(stages: Sequence[Transformer],
                      in_names: Sequence[str]) -> Callable[[tuple], tuple]:
     """One jit program applying a run of device transformers over a TUPLE of
@@ -95,10 +105,7 @@ def _fuse_device_run(stages: Sequence[Transformer],
         # reads baked in at trace time (e.g. Descaler's upstream scaler args)
         # and raises TypeError for identity-less callables (lambdas), both of
         # which must disable sharing instead of silently colliding (ADVICE r03)
-        fps = tuple(
-            json.dumps({"c": type(s).__name__, "p": s.trace_fingerprint()},
-                       sort_keys=True)
-            for s in stages)
+        fps = tuple(stage_fingerprint_entry(s) for s in stages)
         if sum(map(len, fps)) <= _FUSED_FINGERPRINT_MAX:
             # in_names is part of the key: stages with identical params over
             # DIFFERENT inputs must not share a program (output VectorSchemas
@@ -143,8 +150,7 @@ class _CompiledPlan:
             # model's params in as constants and retrace per train (measured
             # ~1.7s of pure retrace per Titanic train). Fusion still applies to
             # runs of small elementwise vectorizer stages, where it pays.
-            kind = ("device" if s.device_op
-                    and not getattr(s, "kernel_jitted", False) else "host")
+            kind = "device" if fuses_into_run(s) else "host"
             if self.groups and self.groups[-1][0] == kind == "device":
                 self.groups[-1][1].append(s)
             else:
@@ -287,7 +293,8 @@ class Workflow(WorkflowCore):
 
     def train(self, table: Optional[Table] = None,
               sanitize: bool = False,
-              checkpoint_dir: Optional[str] = None) -> "WorkflowModel":
+              checkpoint_dir: Optional[str] = None,
+              strict: bool = True) -> "WorkflowModel":
         """Fit all estimator stages layer by layer; bulk-apply transformers between fit
         points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG).
 
@@ -312,14 +319,38 @@ class Workflow(WorkflowCore):
         from .. import obs
 
         with obs.span("workflow:train"):
-            return self._train_impl(table, sanitize, checkpoint_dir)
+            return self._train_impl(table, sanitize, checkpoint_dir, strict)
+
+    def _analyze(self, strict: bool):
+        """Static plan analysis (analyze/ — `oplint`) before ANY data or device
+        work: ill-kinded, leaking, or duplicate-stage plans fail here at plan
+        time with rule codes, the way the reference's Scala compiler rejects
+        ill-typed pipelines before a row is read. strict=False downgrades
+        errors to log warnings + tracer span events."""
+        from .. import obs
+        from ..analyze import analyze_plan
+
+        report = analyze_plan(self.result_features, self._dag,
+                              raw_features=self.raw_features,
+                              workflow_cv=self._workflow_cv)
+        if report.has_errors and strict:
+            from ..analyze import PlanAnalysisError
+
+            raise PlanAnalysisError(report)
+        for d in report.errors + report.warnings:
+            _logger.warning("oplint %s", d.pretty())
+            obs.add_event("oplint", code=d.code, severity=d.severity,
+                          message=d.message, stage_uid=d.stage_uid)
+        return report
 
     def _train_impl(self, table: Optional[Table], sanitize: bool,
-                    checkpoint_dir: Optional[str]) -> "WorkflowModel":
+                    checkpoint_dir: Optional[str],
+                    strict: bool = True) -> "WorkflowModel":
         if not self.result_features:
             raise ValueError("set_result_features first")
         if table is not None:
             self.set_input_table(table)
+        analysis = self._analyze(strict)
         data = self._generate_raw()
         if sanitize:
             from ..utils.sanitize import check_stages
@@ -458,6 +489,8 @@ class Workflow(WorkflowCore):
             blacklisted=blacklisted,
         )
         model.reader = self.reader
+        # plan-time report rides along so save() stamps it without re-analysis
+        model.analysis_report = analysis
         return model
 
 
@@ -516,6 +549,9 @@ class WorkflowModel(WorkflowCore):
         self.blacklisted = tuple(blacklisted)
         self.uid = make_uid("WorkflowModel")
         self._plan: Optional[_CompiledPlan] = None
+        #: AnalysisReport from the producing train (None for loaded models;
+        #: save() re-analyzes the fitted plan in that case)
+        self.analysis_report = None
 
     # --- scoring (analog of OpWorkflowModel.score, scoreFn) ---------------------------
     def transform(self, table: Table, keep_intermediate: bool = False) -> Table:
@@ -635,9 +671,18 @@ class WorkflowModel(WorkflowCore):
                 slim[k] = v
             payload["params"] = slim
             stage_payloads.append(payload)
+        # stamp the oplint report into the bundle: consumers of a served model
+        # can audit what the plan analyzer saw at train time (or, for loaded
+        # models, what the fitted transform plan looks like now)
+        report = self.analysis_report
+        if report is None:
+            from ..analyze import analyze_model
+
+            report = analyze_model(self)
         manifest = {
             "version": 1,
             "uid": self.uid,
+            "analysis": report.to_json(),
             "raw_features": [
                 {"name": f.name, "kind": f.kind.name, "is_response": f.is_response}
                 for f in self.raw_features
