@@ -453,7 +453,14 @@ def _run(partial: dict) -> None:
         partial["wide_stats_mfu"] = detail["wide"].get("stats_mfu")
     if os.environ.get("BENCH_EXTRA", "1") != "0":
         # BASELINE.json configs 2/3/5 + the pallas histogram kernel evidence
-        from bench_extra import run_boston, run_hist, run_iris, run_mlp, run_trees
+        from bench_extra import (
+            run_boston,
+            run_hist,
+            run_iris,
+            run_mlp,
+            run_streaming_score,
+            run_trees,
+        )
 
         detail["iris"] = run_iris()
         partial["iris_models_per_sec"] = detail["iris"].get("models_per_sec")
@@ -464,6 +471,14 @@ def _run(partial: dict) -> None:
         partial["mlp_mfu"] = detail["mlp_deep_tabular"].get("mfu")
         detail["gbt_scale"] = run_trees()
         partial["gbt_hist_mfu"] = detail["gbt_scale"].get("hist_mfu")
+        # streaming-score input pipeline: pipelined vs sync vs resident
+        # (best-effort: a streaming failure must not discard the headline)
+        try:
+            detail["streaming_score"] = run_streaming_score()
+        except Exception as e:  # noqa: BLE001
+            detail["streaming_score"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        partial["streaming_score_rows_per_sec"] = \
+            detail["streaming_score"].get("rows_per_sec")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({
@@ -518,6 +533,14 @@ def _run(partial: dict) -> None:
             s[f"{name}_op_warmup_s"] = detail[name].get("op_warmup_s")
     if "mlp_deep_tabular" in detail:
         s["mlp_mfu"] = detail["mlp_deep_tabular"].get("mfu")
+        s["mlp_streamed_vs_resident_ratio"] = \
+            detail["mlp_deep_tabular"].get("streamed_vs_resident_ratio")
+    if detail.get("streaming_score", {}).get("rows_per_sec") is not None:
+        ss = detail["streaming_score"]
+        s["streaming_score_rows_per_sec"] = ss["rows_per_sec"]
+        s["streaming_score_sync_rows_per_sec"] = ss["sync_rows_per_sec"]
+        s["streaming_pipeline_speedup"] = ss["pipeline_speedup"]
+        s["streaming_vs_resident_ratio"] = ss["vs_resident_ratio"]
     if "gbt_scale" in detail:
         s["gbt_hist_mfu"] = detail["gbt_scale"].get("hist_mfu")
         s["gbt_hist_tflops_per_sec"] = detail["gbt_scale"].get("hist_tflops_per_sec")

@@ -48,6 +48,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: fast tier (everything but the search-refit and "
         "subprocess suites); run with -m smoke for a <2-min loop")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow'): "
+        "sleep-based overlap assertions and other wall-clock-heavy checks")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,492 @@
+"""Input-pipeline executor tests (readers/pipeline.py) + the satellite
+contracts that ride with it: output equivalence vs the synchronous path,
+bounded-queue backpressure, producer-error propagation, the drain-safe
+QueueStreamingReader close, pow2 bucket floors, and the numpy columnar CSV
+fast path. The sleepy-reader overlap assertion is marked `slow`."""
+import csv
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.readers.pipeline import AsyncSink, Prefetcher, run_pipeline
+
+
+# --- Prefetcher -------------------------------------------------------------------------
+def test_prefetcher_preserves_order_and_applies_fn():
+    with Prefetcher(range(50), lambda x: x * 2, depth=4) as pf:
+        assert list(pf) == [x * 2 for x in range(50)]
+
+
+def test_prefetcher_propagates_producer_error_in_order():
+    def source():
+        yield 1
+        yield 2
+        raise RuntimeError("ingest failed")
+
+    got = []
+    with Prefetcher(source(), lambda x: x, depth=2) as pf:
+        with pytest.raises(RuntimeError, match="ingest failed"):
+            for x in pf:
+                got.append(x)
+    assert got == [1, 2]  # items before the failure are delivered, none after
+
+
+def test_prefetcher_error_in_fn_propagates():
+    def boom(x):
+        if x == 3:
+            raise ValueError("bad item")
+        return x
+
+    with Prefetcher(range(10), boom, depth=2) as pf:
+        with pytest.raises(ValueError, match="bad item"):
+            list(pf)
+
+
+def test_prefetcher_backpressure_bounds_lookahead():
+    """The producer never runs more than depth+1 items ahead of the consumer
+    (depth in the queue + one in flight): a slow consumer cannot be buried."""
+    produced = []
+
+    def source():
+        for i in range(30):
+            produced.append(i)
+            yield i
+
+    depth = 3
+    max_ahead = 0
+    with Prefetcher(source(), None, depth=depth) as pf:
+        for consumed, _ in enumerate(pf):
+            time.sleep(0.002)  # slow consumer
+            max_ahead = max(max_ahead, len(produced) - (consumed + 1))
+    assert max_ahead <= depth + 1
+
+
+def test_prefetcher_early_close_stops_producer():
+    produced = []
+
+    def source():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    pf = Prefetcher(source(), None, depth=2)
+    it = iter(pf)
+    assert next(it) == 0
+    pf.close()
+    n = len(produced)
+    time.sleep(0.05)
+    assert len(produced) <= n + 2  # producer actually stopped, not detached
+
+
+# --- AsyncSink --------------------------------------------------------------------------
+def test_async_sink_runs_in_order_and_close_joins():
+    got = []
+    with AsyncSink(got.append, depth=2) as sink:
+        for i in range(20):
+            sink.put(i)
+    assert got == list(range(20))
+
+
+def test_async_sink_error_reraises():
+    def bad(item):
+        if item == 2:
+            raise IOError("disk full")
+
+    sink = AsyncSink(bad, depth=1)
+    with pytest.raises(IOError, match="disk full"):
+        for i in range(50):
+            sink.put(i)
+            time.sleep(0.001)
+        sink.close()
+
+
+# --- run_pipeline -----------------------------------------------------------------------
+def test_run_pipeline_matches_sync_path():
+    def prepare(x):
+        return x + 1
+
+    def compute(x):
+        return x * 10
+
+    for prefetch in (0, 1, 3):
+        out = []
+        stats = run_pipeline(range(25), prepare, compute, out.append,
+                             prefetch=prefetch)
+        assert out == [(x + 1) * 10 for x in range(25)]
+        assert stats.batches == 25
+
+
+def test_run_pipeline_sink_error_propagates():
+    def sink(x):
+        if x == 5:
+            raise IOError("sink failed")
+
+    with pytest.raises(IOError, match="sink failed"):
+        run_pipeline(range(100), None, lambda x: x, sink, prefetch=2)
+
+
+def test_run_pipeline_stats_shape():
+    stats = run_pipeline(range(8), lambda x: x, lambda x: x, prefetch=2)
+    d = stats.to_dict()
+    assert d["batches"] == 8
+    for key in ("prepare_s", "compute_s", "host_stall_s", "backpressure_s",
+                "sink_stall_s", "queue_depth"):
+        assert key in d
+    assert sum(d["queue_depth"].values()) > 0  # gauge sampled per dequeue
+
+
+@pytest.mark.slow
+def test_pipeline_overlap_sleepy_reader():
+    """A deterministic sleepy reader proves real overlap: prepare of item k+1
+    runs DURING compute of item k, witnessed by obs span timestamps (the
+    prepare span's window intersects a compute span's window)."""
+    from transmogrifai_tpu import obs
+
+    naptime = 0.03
+    items = 6
+
+    with obs.trace() as tracer:
+        run_pipeline(
+            range(items),
+            lambda x: time.sleep(naptime) or x,
+            lambda x: time.sleep(naptime) or x,
+            prefetch=2,
+        )
+
+    def spans_named(sp, name, acc):
+        if sp.name == name:
+            acc.append(sp)
+        for c in sp.children:
+            spans_named(c, name, acc)
+        return acc
+
+    prepares = spans_named(tracer.root, "pipeline:prepare", [])
+    computes = spans_named(tracer.root, "pipeline:compute", [])
+    assert len(prepares) == items and len(computes) == items
+    overlaps = [
+        (p, c) for p in prepares for c in computes
+        if p.t0 < c.t1 and c.t0 < p.t1
+    ]
+    assert overlaps, "no prepare span overlapped any compute span"
+    # and the wall clock actually collapsed: serial would be >= 2*items*nap
+    wall = tracer.root.wall_s
+    assert wall < 2 * items * naptime * 0.9
+
+
+# --- streaming_score equivalence --------------------------------------------------------
+SCHEMA = {"label": "RealNN", "x1": "Real", "cat": "PickList"}
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"label": float(rng.random() > 0.5), "x1": float(rng.normal()),
+         "cat": "abc"[int(rng.integers(0, 3))]}
+        for _ in range(n)
+    ]
+
+
+def _trained_runner():
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    fs = features_from_schema(SCHEMA, response="label")
+    vec = transmogrify([fs["x1"], fs["cat"]])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    wf = Workflow().set_result_features(pred)
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(_rows(160)))
+    runner.run("train", OpParams())
+    return runner
+
+
+def _stream_parts(runner, batches, out_dir, prefetch):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import BatchStreamingReader
+
+    runner.streaming_reader = BatchStreamingReader(batches)
+    runner.stream_prefetch = prefetch
+    res = runner.run("streaming_score", OpParams(write_location=str(out_dir)))
+    parts = {}
+    for fname in sorted(os.listdir(out_dir)):
+        with open(os.path.join(out_dir, fname), "rb") as fh:
+            parts[fname] = fh.read()
+    return res, parts
+
+
+def test_streaming_score_pipelined_bit_identical_to_sync(tmp_path):
+    """The acceptance bar: pipelined output bytes == synchronous output bytes
+    (same batches, same part files, same scores to the last digit)."""
+    runner = _trained_runner()
+    batches = [_rows(n, seed=n) for n in (16, 7, 33, 5)]
+    for b in batches[:2]:  # mixed: some batches unlabeled
+        for r in b:
+            del r["label"]
+    res_sync, parts_sync = _stream_parts(
+        runner, [list(b) for b in batches], tmp_path / "sync", prefetch=0)
+    res_pipe, parts_pipe = _stream_parts(
+        runner, [list(b) for b in batches], tmp_path / "pipe", prefetch=3)
+    assert res_sync.n_rows == res_pipe.n_rows == 16 + 7 + 33 + 5
+    assert res_sync.batches == res_pipe.batches == 4
+    assert list(parts_sync) == list(parts_pipe)
+    assert parts_sync == parts_pipe  # bit-identical CSV bytes
+    assert res_pipe.pipeline["batches"] == 4
+
+
+def test_streaming_score_producer_error_propagates(tmp_path):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import StreamingReader
+
+    class FailingReader(StreamingReader):
+        def stream(self):
+            yield _rows(8, seed=1)
+            raise ConnectionError("upstream died")
+
+    runner = _trained_runner()
+    runner.streaming_reader = FailingReader()
+    with pytest.raises(ConnectionError, match="upstream died"):
+        runner.run("streaming_score", OpParams(write_location=str(tmp_path)))
+    # the batch before the failure was scored and persisted
+    assert sorted(os.listdir(tmp_path)) == ["part-00000.csv"]
+
+
+def test_streaming_score_backpressure_bounds_ingest(tmp_path):
+    """With a slow device (spy-delayed score), the producer stays within the
+    prefetch bound instead of materializing every batch's columns up front."""
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import StreamingReader
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    pulled = []
+
+    class CountingReader(StreamingReader):
+        def stream(self):
+            for i in range(12):
+                pulled.append(i)
+                yield _rows(4, seed=i)
+
+    runner = _trained_runner()
+    runner.streaming_reader = CountingReader()
+    runner.stream_prefetch = 2
+    max_ahead = 0
+    scored = [0]
+    orig = WorkflowModel.score
+
+    def slow_score(self, **kw):
+        nonlocal max_ahead
+        time.sleep(0.01)
+        out = orig(self, **kw)
+        scored[0] += 1
+        max_ahead = max(max_ahead, len(pulled) - scored[0])
+        return out
+
+    mp = pytest.MonkeyPatch()
+    mp.setattr(WorkflowModel, "score", slow_score)
+    try:
+        res = runner.run("streaming_score", OpParams())
+    finally:
+        mp.undo()
+    assert res.batches == 12
+    assert max_ahead <= runner.stream_prefetch + 2  # queue + in-flight + dispatch
+
+
+# --- QueueStreamingReader close contract ------------------------------------------------
+def test_queue_put_after_close_raises():
+    from transmogrifai_tpu.readers import QueueStreamingReader, StreamClosed
+
+    q = QueueStreamingReader()
+    q.put([{"x": 1}])
+    q.close()
+    assert q.closed
+    with pytest.raises(StreamClosed):
+        q.put([{"x": 2}])
+    q.close()  # idempotent
+    assert len(list(q.stream())) == 1
+
+
+def test_queue_racing_put_consumed_or_raises():
+    """Hammer put() against close() from another thread: every put that
+    RETURNED is consumed by stream(); every other attempt raised StreamClosed;
+    no batch vanishes behind the sentinel."""
+    from transmogrifai_tpu.readers import QueueStreamingReader, StreamClosed
+
+    for trial in range(20):
+        q = QueueStreamingReader()
+        accepted, rejected = [], []
+
+        def producer():
+            for i in range(100):
+                try:
+                    q.put(i)
+                    accepted.append(i)
+                except StreamClosed:
+                    rejected.append(i)
+                    return
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.0005 * (trial % 4))
+        q.close()
+        t.join()
+        consumed = list(q.stream())
+        assert consumed == accepted  # exactly the accepted batches, in order
+        assert len(accepted) + len(rejected) <= 100
+
+
+# --- pow2 bucket floor ------------------------------------------------------------------
+def test_pow2_bucket_floor():
+    from transmogrifai_tpu.types.table import pow2_bucket
+
+    assert pow2_bucket(5) == 8
+    assert pow2_bucket(5, floor=64) == 64
+    assert pow2_bucket(64, floor=64) == 64
+    assert pow2_bucket(65, floor=64) == 128
+    assert pow2_bucket(3, floor=48) == 64  # non-pow2 floor rounds up
+    with pytest.raises(ValueError):
+        pow2_bucket(0)
+    with pytest.raises(ValueError):
+        pow2_bucket(4, floor=0)
+
+
+# --- numpy columnar CSV fast path -------------------------------------------------------
+CSV_SCHEMA = {"age": "Real", "n": "Integral", "flag": "Binary",
+              "name": "Text", "cat": "PickList"}
+
+
+def _write_csv(path, rows, names):
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(names)
+        for r in rows:
+            w.writerow([("" if r[n] is None else r[n]) for n in names])
+
+
+def _csv_rows():
+    return [
+        {"age": 1.5, "n": 7, "flag": "true", "name": "ann", "cat": "a"},
+        {"age": None, "n": 0, "flag": "0", "name": None, "cat": "b"},
+        {"age": -2.25, "n": -13, "flag": "YES", "name": "b,c", "cat": "a"},
+        {"age": 1e30, "n": 99999999999, "flag": None, "name": 'q"x', "cat": None},
+    ]
+
+
+def test_csv_numpy_columnar_matches_record_path(tmp_path, monkeypatch):
+    from transmogrifai_tpu.readers import CSVReader
+
+    names = list(CSV_SCHEMA)
+    path = tmp_path / "t.csv"
+    _write_csv(path, _csv_rows(), names)
+    reader = CSVReader(str(path), CSV_SCHEMA)
+    monkeypatch.setattr(CSVReader, "_read_columnar_native", lambda self: None)
+    cols = reader.read_columnar()
+    assert cols is not None  # the numpy path engaged
+    from transmogrifai_tpu.types import Column
+
+    records = reader.read_records()
+    for nm, kind in reader.schema.items():
+        got = cols[nm].to_list()
+        # the record path's values also round-trip through Column storage
+        # (float32 for Real), so the comparison is exact
+        want = Column.build(kind, [r[nm] for r in records]).to_list()
+        assert got == want, nm
+
+
+def test_csv_numpy_columnar_demotes_float_ints(tmp_path, monkeypatch):
+    """"3.0" in an Integral column defeats the vectorized int cast; the column
+    demotes to the scalar parser and still parses exactly like the record
+    path (int via the float round trip)."""
+    from transmogrifai_tpu.readers import CSVReader
+
+    path = tmp_path / "t.csv"
+    _write_csv(path, [{"n": "3.0"}, {"n": "5"}, {"n": None}], ["n"])
+    reader = CSVReader(str(path), {"n": "Integral"})
+    monkeypatch.setattr(CSVReader, "_read_columnar_native", lambda self: None)
+    cols = reader.read_columnar()
+    assert cols["n"].to_list() == [3, 5, None]
+
+
+def test_csv_numpy_columnar_generate_table(tmp_path, monkeypatch):
+    """End to end: generate_table over the numpy columnar path == the table
+    built from per-row records."""
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import CSVReader
+
+    names = list(CSV_SCHEMA)
+    path = tmp_path / "t.csv"
+    _write_csv(path, _csv_rows(), names)
+    fs = features_from_schema(CSV_SCHEMA)
+    monkeypatch.setattr(CSVReader, "_read_columnar_native", lambda self: None)
+    t_np = CSVReader(str(path), CSV_SCHEMA).generate_table(list(fs.values()))
+    monkeypatch.setattr(CSVReader, "read_columnar", lambda self: None)
+    t_rec = CSVReader(str(path), CSV_SCHEMA).generate_table(list(fs.values()))
+    assert t_np.nrows == t_rec.nrows == 4
+    for nm in names:
+        assert t_np[nm].to_list() == t_rec[nm].to_list(), nm
+
+
+def test_csv_numpy_columnar_duplicate_header_last_wins(tmp_path, monkeypatch):
+    """Duplicate header names resolve to the LAST occurrence — DictReader's
+    (record path) behavior, so the fast path can't silently read a different
+    physical column than the slow path."""
+    from transmogrifai_tpu.readers import CSVReader
+
+    path = tmp_path / "t.csv"
+    with open(path, "w", newline="") as fh:
+        fh.write("a,b,a\n1.0,x,9.0\n2.0,y,8.0\n")
+    reader = CSVReader(str(path), {"a": "Real"})
+    monkeypatch.setattr(CSVReader, "_read_columnar_native", lambda self: None)
+    assert reader.read_columnar()["a"].to_list() == [9.0, 8.0]
+    assert [r["a"] for r in reader.read_records()] == [9.0, 8.0]
+
+
+def test_csv_numpy_columnar_nonnullable_missing_raises(tmp_path, monkeypatch):
+    from transmogrifai_tpu.readers import CSVReader
+
+    path = tmp_path / "t.csv"
+    _write_csv(path, [{"v": 1.0}, {"v": None}], ["v"])
+    reader = CSVReader(str(path), {"v": "RealNN"})
+    monkeypatch.setattr(CSVReader, "_read_columnar_native", lambda self: None)
+    with pytest.raises(ValueError, match="non-nullable"):
+        reader.read_columnar()
+
+
+def test_csv_numpy_columnar_through_process_shard(tmp_path, monkeypatch):
+    """The sharded wrapper strides the numpy-built Columns without touching
+    Python records — the multi-host feed into the same input executor."""
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import CSVReader, ProcessShardedReader
+
+    names = list(CSV_SCHEMA)
+    path = tmp_path / "t.csv"
+    _write_csv(path, _csv_rows(), names)
+    monkeypatch.setattr(CSVReader, "_read_columnar_native", lambda self: None)
+    fs = features_from_schema(CSV_SCHEMA)
+    base = CSVReader(str(path), CSV_SCHEMA)
+    t0 = ProcessShardedReader(base, process_index=0,
+                              n_processes=2).generate_table(list(fs.values()))
+    t1 = ProcessShardedReader(base, process_index=1,
+                              n_processes=2).generate_table(list(fs.values()))
+    assert t0.nrows == 2 and t1.nrows == 2
+    assert t0["n"].to_list() == [7, -13]
+    assert t1["n"].to_list() == [0, 99999999999]
+
+
+# --- serving stream ---------------------------------------------------------------------
+def test_score_fn_stream_matches_batch(tmp_path):
+    runner = _trained_runner()
+    model = runner._model
+    batches = [_rows(n, seed=10 + n) for n in (4, 9, 2)]
+    for b in batches:
+        for r in b:
+            del r["label"]
+    fn = model.score_fn(pad_to=[16])
+    want = [fn.batch(b) for b in batches]
+    got = list(fn.stream(iter(batches), prefetch=2))
+    assert got == want
+    assert list(fn.stream(iter(batches), prefetch=0)) == want

@@ -156,6 +156,7 @@ def test_streaming_ragged_batches_pad_to_buckets(tmp_path, monkeypatch):
         for r in b:
             del r["label"]
     runner.streaming_reader = BatchStreamingReader(batches)
+    runner.stream_bucket_floor = 1  # exercise raw pow2 buckets (default floor is 64)
     seen_sizes = []
     orig = WorkflowModel.score
 
@@ -169,6 +170,44 @@ def test_streaming_ragged_batches_pad_to_buckets(tmp_path, monkeypatch):
     assert seen_sizes == [16, 8, 8, 4]  # buckets, and 7/5 share one program shape
     with open(tmp_path / "s" / "part-00001.csv") as fh:
         assert len(list(csv.DictReader(fh))) == 7  # padding rows sliced off
+
+
+def test_streaming_bucket_floor_default(tmp_path):
+    """Trickle arrivals (1-16 rows) all pad to the default 64-row floor bucket:
+    ONE program shape instead of one per tiny power of two; the bucket
+    histogram lands in the trace section of AppMetrics."""
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    runner, _ = _runner()
+    runner.run("train", OpParams())
+    batches = [_rows(n, seed=n) for n in (1, 3, 16, 100)]
+    for b in batches:
+        for r in b:
+            del r["label"]
+    runner.streaming_reader = BatchStreamingReader(batches)
+    seen_sizes = []
+    orig = WorkflowModel.score
+
+    def spy(self, table=None, **kw):
+        seen_sizes.append(table.nrows)
+        return orig(self, table=table, **kw)
+
+    import pytest as _pytest
+
+    _pytest.MonkeyPatch().setattr(WorkflowModel, "score", spy)
+    try:
+        reports = []
+        runner.add_application_end_handler(lambda m: reports.append(m))
+        res = runner.run("streaming_score", OpParams(write_location=str(tmp_path / "s")))
+    finally:
+        WorkflowModel.score = orig
+    assert seen_sizes == [64, 64, 64, 128]  # floor, then the true pow2 above it
+    assert res.n_rows == 1 + 3 + 16 + 100
+    assert res.pipeline["pad_buckets"] == {"64": 3, "128": 1}
+    trace = reports[0].to_dict()["trace"]
+    assert trace["pipeline"]["pad_buckets"] == {"64": 3, "128": 1}
+    assert trace["pipeline"]["batches"] == 4
+    assert "queue_depth" in trace["pipeline"]
 
 
 def test_streaming_rebatch_fixed_size():

@@ -24,6 +24,9 @@ for app in examples.iris:make_runner examples.titanic:make_runner; do
 done
 
 echo "== bench regression gate =="
+# Every scalar in the bench summary is gated, including the streaming_score
+# input-pipeline lane (streaming_score_rows_per_sec, streaming_pipeline_speedup,
+# streaming_vs_resident_ratio) once a post-pipeline BENCH record lands.
 # The newest checked-in pair (r04 -> r05) RECORDS the boston first-train slip
 # that PR 1 fixed in code, so the comparison is report-only until a post-fix
 # record lands; set CI_BENCH_STRICT=1 to make regressions fail the gate.

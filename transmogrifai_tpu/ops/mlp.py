@@ -200,10 +200,11 @@ def fit_mlp_minibatch(
     (BASELINE.json config 5): data that never sits in HBM at once. `chunk_fn(i)`
     yields (X [B, d], y [B]) for chunk i. Two overlap mechanisms (r5):
 
-    - `prefetch`: a background thread runs chunk_fn and starts the async
-      host->device transfer (`jax.device_put`) for upcoming chunks while the
-      device trains on the current ones — the tf.data-style double buffering;
-      device-resident chunks pass through untouched.
+    - `prefetch`: the shared input executor (readers/pipeline.py Prefetcher —
+      this trainer's private loop was its prototype) runs chunk_fn and starts
+      the async host->device transfer (`jax.device_put`) for upcoming chunks
+      while the device trains on the current ones — the tf.data-style double
+      buffering; device-resident chunks pass through untouched.
     - `dispatch_window`: W prefetched chunks stack into ONE jitted
       scan-of-Adam-steps program (identical update math, 1 RPC dispatch
       instead of W). The ragged tail falls back to the per-chunk step so no
@@ -217,8 +218,7 @@ def fit_mlp_minibatch(
     state stay f32). Multi-chip: shard the batch axis of each chunk over the
     mesh data axis and the grads psum (the minibatch-SGD-over-ICI path; the
     single-chip program is unchanged)."""
-    from collections import deque
-    from concurrent.futures import ThreadPoolExecutor
+    from ..readers.pipeline import Prefetcher
 
     params = _mlp_init(d, hidden, num_classes, seed)
     step = _minibatch_step(num_classes, float(lr), float(l2), compute_dtype)
@@ -236,16 +236,11 @@ def fit_mlp_minibatch(
             y = jax.device_put(y)
         return X, y
 
-    with ThreadPoolExecutor(max_workers=1) as ex:
-        ahead = max(W, int(prefetch))
-        futs: deque = deque(ex.submit(load, i) for i in seq[:ahead])
-        k = len(futs)
-        pending: list = []
-        for _ in range(len(seq)):
-            pending.append(futs.popleft().result())
-            if k < len(seq):
-                futs.append(ex.submit(load, seq[k]))
-                k += 1
+    pending: list = []
+    with Prefetcher(seq, load, depth=max(W, int(prefetch)),
+                    name="mlp_chunk") as pf:
+        for xy in pf:
+            pending.append(xy)
             if len(pending) == W:
                 if W == 1:
                     state = step(state, *pending[0])
@@ -254,8 +249,8 @@ def fit_mlp_minibatch(
                     ys = jnp.stack([y for _, y in pending])
                     state = win(state, Xs, ys)
                 pending = []
-        for X, y in pending:  # ragged tail: per-chunk steps, no new shapes
-            state = step(state, X, y)
+    for X, y in pending:  # ragged tail: per-chunk steps, no new shapes
+        state = step(state, X, y)
     return state[0]
 
 

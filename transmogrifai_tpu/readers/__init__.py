@@ -13,6 +13,7 @@ from .joined import (
     left_outer_join,
     outer_join,
 )
+from .pipeline import AsyncSink, PipelineStats, Prefetcher, run_pipeline
 from .process_shard import ProcessShardedReader
 from .streaming import (
     BatchStreamingReader,
@@ -20,6 +21,7 @@ from .streaming import (
     FileTailStreamingReader,
     QueueStreamingReader,
     SocketStreamingReader,
+    StreamClosed,
     StreamingReader,
     rebatch,
 )
@@ -137,6 +139,11 @@ __all__ = [
     "QueueStreamingReader",
     "SocketStreamingReader",
     "FileTailStreamingReader",
+    "StreamClosed",
     "rebatch",
+    "AsyncSink",
+    "PipelineStats",
+    "Prefetcher",
+    "run_pipeline",
     "KEY_COLUMN",
 ]

@@ -148,11 +148,21 @@ class CSVReader(DataReader):
     #: storage -> csvtok.c column type code (anything else falls back to Python)
     _NATIVE_STORAGE = {"real": 1, "integral": 2, "date": 2, "binary": 3, "text": 4}
 
-    def read_columnar(self) -> Optional[dict[str, np.ndarray]]:
+    def read_columnar(self) -> Optional[dict[str, "Column"]]:
+        """Columnar fast paths, tried in order: the native (C) tokenizer, then
+        the numpy-vectorized converter, then None (record path). Both fast
+        paths build typed Columns directly — numeric data never becomes Python
+        objects — and both match the record path's parse semantics exactly."""
+        out = self._read_columnar_native()
+        if out is not None:
+            return out
+        return self._read_columnar_numpy()
+
+    def _read_columnar_native(self) -> Optional[dict[str, "Column"]]:
         """Native (C) fast path: tokenize + type-parse the whole file in one pass
         (native/csvtok.c); numeric columns never become Python objects until the
-        final Column build. Falls back to the record path (None) whenever the
-        schema, file, or a malformed cell needs the Python parser's semantics."""
+        final Column build. Falls back (None) whenever the schema, file, or a
+        malformed cell needs the Python parser's semantics."""
         from ..native import CT_SKIP, parse_csv_typed
 
         try:
@@ -197,12 +207,7 @@ class CSVReader(DataReader):
             what, a, b = entry
             if what in ("real", "int", "bool"):
                 mask = b.astype(bool)
-                if not kind.nullable and not mask.all():
-                    missing = int((~mask).sum())  # same error Column.build raises
-                    raise ValueError(
-                        f"{kind.name} is non-nullable but {missing} of {len(mask)} "
-                        "values are missing"
-                    )
+                _require_non_nullable(kind, mask)
                 if what == "real":
                     import jax.numpy as jnp
 
@@ -231,6 +236,125 @@ class CSVReader(DataReader):
                                    .replace('""', '"'))
                 out[nm] = Column(kind, vals, None)
         return out
+
+    #: rows per conversion chunk for the numpy columnar path: bounds the peak
+    #: of the intermediate unicode arrays while keeping each astype vectorized
+    _NUMPY_CHUNK_ROWS = 1 << 16
+
+    def _read_columnar_numpy(self) -> Optional[dict[str, "Column"]]:
+        """numpy-vectorized columnar fallback: parse the file with the stdlib
+        tokenizer but convert COLUMNS in chunked `np.asarray` passes instead of
+        running `_parse` per cell of per-row dicts — the fast host-ingest feed
+        for the input pipeline when the native tokenizer bows out (quoting
+        variants, platforms without the extension). Only flat storages
+        (real/integral/date/binary/text) qualify; a cell the vectorized cast
+        rejects (e.g. "3.0" in an Integral column) demotes just that column to
+        the scalar `_parse` loop, so semantics stay bit-identical."""
+        from ..types import Column, Storage
+
+        flat = {Storage.REAL, Storage.INTEGRAL, Storage.DATE, Storage.BINARY,
+                Storage.TEXT}
+        if any(k.storage not in flat for k in self.schema.values()):
+            return None  # non-flat kinds keep the record path's semantics
+        try:
+            fh = open(self.path, newline="")
+        except OSError:
+            return None
+        with fh:
+            reader = _csv.reader(fh)
+            if self.has_header:
+                try:
+                    names = next(reader)
+                except StopIteration:
+                    return None
+            else:
+                names = self.field_names
+                if names is None:
+                    return None
+            if not set(self.schema) <= set(names):
+                return None  # missing columns: record path gives them all-null
+            # duplicate header names resolve to the LAST occurrence, matching
+            # DictReader (record path) and the native tokenizer's zip order
+            pos = {nm: j for j, nm in enumerate(names)}
+            idx = [pos[nm] for nm in self.schema]
+            width = len(names)
+            chunks: dict[str, list] = {nm: [] for nm in self.schema}
+            masks: dict[str, list] = {nm: [] for nm in self.schema}
+            buf: list = []
+
+            def flush() -> None:
+                grid = np.asarray(buf, dtype=object)
+                for nm, j in zip(self.schema, idx):
+                    col = grid[:, j].astype(str)
+                    present = col != ""
+                    chunks[nm].append(col)
+                    masks[nm].append(present)
+                buf.clear()
+
+            for rec in reader:
+                if not rec:
+                    continue  # blank line is no record (DictReader semantics)
+                if len(rec) < width:  # short row: missing trailing cells
+                    rec = rec + [""] * (width - len(rec))
+                buf.append(rec[:width])
+                if len(buf) >= self._NUMPY_CHUNK_ROWS:
+                    flush()
+            if buf:
+                flush()
+        n = sum(len(c) for c in next(iter(chunks.values()), []))
+        out: dict[str, Column] = {}
+        for nm, kind in self.schema.items():
+            strs = (np.concatenate(chunks[nm]) if chunks[nm]
+                    else np.empty(0, dtype=str))
+            mask = (np.concatenate(masks[nm]) if masks[nm]
+                    else np.empty(0, dtype=bool))
+            out[nm] = _column_from_strings(kind, strs, mask, n)
+        return out
+
+
+def _require_non_nullable(kind: FeatureKind, mask: np.ndarray) -> None:
+    """The non-nullable presence check both columnar fast paths share — same
+    error Column.build raises on the record path."""
+    if not kind.nullable and not mask.all():
+        missing = int((~mask).sum())
+        raise ValueError(
+            f"{kind.name} is non-nullable but {missing} of {len(mask)} "
+            "values are missing"
+        )
+
+
+def _column_from_strings(kind: FeatureKind, strs: np.ndarray,
+                         mask: np.ndarray, n: int) -> "Column":
+    """One column's chunked string cells -> a typed Column via vectorized numpy
+    casts, demoting to the scalar `_parse` loop when a cell defeats the cast."""
+    import jax.numpy as jnp
+
+    from ..types import Column, Storage
+
+    st = kind.storage
+    if st is Storage.TEXT:
+        vals = np.empty(n, dtype=object)
+        vals[mask] = strs[mask]
+        return Column(kind, vals, None)
+    _require_non_nullable(kind, mask)
+    try:
+        if st is Storage.REAL:
+            v = np.where(mask, strs, "nan").astype(np.float64)
+            return Column(kind, jnp.asarray(v.astype(np.float32)),
+                          jnp.asarray(mask))
+        if st in (Storage.INTEGRAL, Storage.DATE):
+            v = np.where(mask, strs, "0").astype(np.int64)
+            return Column(kind, v, mask)  # host-exact int64
+        # binary: word-booleans/0-1; anything else parses False (_parse)
+        low = np.char.lower(np.char.strip(strs))
+        v = np.isin(low, sorted(_TRUE)) & mask
+        return Column(kind, jnp.asarray(v), jnp.asarray(mask))
+    except ValueError:
+        # a cell the vectorized cast rejects ("3.0" as Integral, "1e3" with
+        # locale quirks): this column drops to the exact scalar parser
+        vals = [_parse(s if m else None, kind)
+                for s, m in zip(strs.tolist(), mask.tolist())]
+        return Column.build(kind, vals)
 
 
 

@@ -1,0 +1,303 @@
+"""Pipelined input executor: overlap host ingest, H2D transfer, and device compute.
+
+The tf.data-style (arXiv:2101.12127) overlapped input pipeline as a first-class
+subsystem — the generalization of the private prefetch loop that used to live
+inside `ops/mlp.py`. Three stages run concurrently over a stream of items:
+
+    source ──prepare (producer thread)──▶ bounded queue
+           ──compute (caller thread, async XLA dispatch)──▶ bounded queue
+           ──sink (writer thread: D2H fetch / persist)
+
+* `prepare` parses/builds the NEXT batch's host columns and starts its async
+  host→device transfer (`jax.device_put` / eager `jnp.asarray`) while the
+  device is busy scoring the CURRENT batch.
+* `compute` runs on the caller's thread in arrival order. JAX dispatch is
+  asynchronous: the call returns as soon as the program is enqueued, so the
+  caller immediately loops back to pick up the next prepared batch.
+* `sink` forces the device→host result fetch (and any write) on a separate
+  thread, so the blocking D2H of batch k overlaps the device compute of
+  batch k+1.
+
+Both queues are BOUNDED: a slow consumer blocks the producer (backpressure —
+memory never grows past `prefetch + sink_depth + 3` in-flight batches: the
+two queues plus one batch each in the producer's, caller's, and writer's
+hands), and a
+producer/sink error tears the pipeline down cleanly and re-raises in the
+caller. Items flow strictly in order end to end, so pipelined output is
+bit-identical to the synchronous loop it replaces.
+
+Observability: each stage opens `pipeline:prepare` / `pipeline:compute` /
+`pipeline:sink` obs spans (parented under the caller's span even from worker
+threads), and `PipelineStats` aggregates host-stall vs device-stall time plus
+a queue-depth gauge — the runner merges it into AppMetrics' `trace` section.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from .. import obs
+
+_SENTINEL = object()
+
+
+@dataclass
+class PipelineStats:
+    """Aggregated timing of one pipeline run.
+
+    host_stall_s is time the COMPUTE thread spent waiting on the prepare queue
+    (device idle because host ingest was too slow); backpressure_s is time the
+    PRODUCER spent blocked on the full queue (host ahead — the healthy state);
+    sink_stall_s is time compute spent blocked handing results to a full sink
+    queue (writes/fetches are the bottleneck). queue_depth is a {depth: count}
+    gauge sampled at every compute-side dequeue: depths pinned at 0 mean the
+    pipeline is ingest-bound, pinned at `prefetch` means compute-bound.
+    """
+
+    batches: int = 0
+    prepare_s: float = 0.0
+    compute_s: float = 0.0
+    sink_s: float = 0.0
+    host_stall_s: float = 0.0
+    backpressure_s: float = 0.0
+    sink_stall_s: float = 0.0
+    queue_depth: dict[int, int] = field(default_factory=dict)
+    bucket_hist: dict[int, int] = field(default_factory=dict)
+
+    def observe_depth(self, depth: int) -> None:
+        self.queue_depth[depth] = self.queue_depth.get(depth, 0) + 1
+
+    def observe_bucket(self, size: int) -> None:
+        self.bucket_hist[size] = self.bucket_hist.get(size, 0) + 1
+
+    def to_dict(self) -> dict:
+        out = {
+            "batches": self.batches,
+            "prepare_s": round(self.prepare_s, 6),
+            "compute_s": round(self.compute_s, 6),
+            "sink_s": round(self.sink_s, 6),
+            "host_stall_s": round(self.host_stall_s, 6),
+            "backpressure_s": round(self.backpressure_s, 6),
+            "sink_stall_s": round(self.sink_stall_s, 6),
+            "queue_depth": {str(k): v for k, v in sorted(self.queue_depth.items())},
+        }
+        if self.bucket_hist:
+            out["pad_buckets"] = {str(k): v
+                                  for k, v in sorted(self.bucket_hist.items())}
+        return out
+
+
+class Prefetcher:
+    """Bounded background map over an iterable, preserving order.
+
+    Iterating a Prefetcher yields `fn(item)` for each item of `source`, with a
+    producer thread running up to `depth + 1` items ahead of the consumer
+    (`depth` queued plus one in preparation). The
+    queue is bounded at `depth`, so the producer blocks (backpressure) instead
+    of buffering the whole stream. A producer exception is re-raised at the
+    consumer's NEXT dequeue — never swallowed, never after extra items.
+
+    Use as a context manager (or call `close()`): early exits drain the queue
+    and stop the producer so no thread outlives the consumer.
+    """
+
+    def __init__(self, source: Iterable, fn: Optional[Callable[[Any], Any]] = None,
+                 *, depth: int = 2, name: str = "prepare",
+                 stats: Optional[PipelineStats] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = source
+        self._fn = fn
+        self._depth = depth
+        self._name = name
+        self.stats = stats if stats is not None else PipelineStats()
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        #: caller-side span captured at construction so worker-side spans nest
+        #: under it instead of the worker thread's (empty) stack
+        self._parent = obs.current_span()
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name=f"pipeline-{name}")
+        self._thread.start()
+
+    # --- producer thread --------------------------------------------------------------
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                if self._fn is not None:
+                    t0 = time.perf_counter()
+                    with obs.span(f"pipeline:{self._name}", parent=self._parent):
+                        item = self._fn(item)
+                    self.stats.prepare_s += time.perf_counter() - t0
+                self._put(("item", item))
+        except BaseException as e:  # noqa: BLE001 — surfaced at the consumer
+            self._put(("error", e))
+            return
+        self._put(("end", None))
+
+    def _put(self, msg: tuple) -> None:
+        t0 = time.perf_counter()
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.1)
+                break
+            except queue.Full:
+                continue
+        self.stats.backpressure_s += time.perf_counter() - t0
+
+    # --- consumer side ----------------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            self.stats.observe_depth(self._q.qsize())
+            t0 = time.perf_counter()
+            tag, payload = self._q.get()
+            self.stats.host_stall_s += time.perf_counter() - t0
+            if tag == "end":
+                return
+            if tag == "error":
+                raise payload
+            yield payload
+
+    def close(self) -> None:
+        """Stop the producer and drain the queue (idempotent)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class AsyncSink:
+    """Bounded background consumer: `put(item)` hands work to a writer thread
+    running `fn(item)` in order; `close()` waits for the drain and re-raises
+    the first sink error. The D2H/persist stage of the pipeline."""
+
+    def __init__(self, fn: Callable[[Any], None], *, depth: int = 2,
+                 name: str = "sink", stats: Optional[PipelineStats] = None):
+        if depth < 1:
+            raise ValueError(f"sink depth must be >= 1, got {depth}")
+        self._fn = fn
+        self.stats = stats if stats is not None else PipelineStats()
+        self._name = name
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._error: Optional[BaseException] = None
+        self._parent = obs.current_span()
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name=f"pipeline-{name}")
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                return
+            if self._error is not None:
+                continue  # swallow the backlog after a failure; close() raises
+            try:
+                t0 = time.perf_counter()
+                with obs.span(f"pipeline:{self._name}", parent=self._parent):
+                    self._fn(item)
+                self.stats.sink_s += time.perf_counter() - t0
+            except BaseException as e:  # noqa: BLE001 — re-raised from close()
+                self._error = e
+
+    def put(self, item: Any) -> None:
+        if self._error is not None:
+            raise self._error
+        t0 = time.perf_counter()
+        self._q.put(item)
+        self.stats.sink_stall_s += time.perf_counter() - t0
+
+    def close(self) -> None:
+        self._q.put(_SENTINEL)
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+
+    def __enter__(self) -> "AsyncSink":
+        return self
+
+    def abandon(self) -> None:
+        """Tear down after an UPSTREAM error: batches already computed are
+        valid, so the writer flushes its backlog before stopping — a producer
+        failure must not discard completed work. Does not re-raise (the
+        caller already has the original exception in flight); a sink-side
+        error still short-circuits the backlog via `_error`."""
+        self._q.put(_SENTINEL)
+        self._thread.join(timeout=5.0)
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is not None:
+            self.abandon()
+            return
+        self.close()
+
+
+def run_pipeline(
+    source: Iterable,
+    prepare: Optional[Callable[[Any], Any]],
+    compute: Callable[[Any], Any],
+    sink: Optional[Callable[[Any], None]] = None,
+    *,
+    prefetch: int = 2,
+    sink_depth: int = 2,
+    name: str = "pipeline",
+    stats: Optional[PipelineStats] = None,
+) -> PipelineStats:
+    """Run `source -> prepare -> compute -> sink` with the three stages
+    overlapped; returns the aggregated PipelineStats.
+
+    `prefetch=0` disables all threading and runs the stages synchronously in
+    order — the reference path pipelined output must stay bit-identical to
+    (and the honest baseline for measuring the overlap win).
+    """
+    stats = stats if stats is not None else PipelineStats()
+    if prefetch <= 0:
+        for item in source:
+            if prepare is not None:
+                t0 = time.perf_counter()
+                item = prepare(item)
+                stats.prepare_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            with obs.span("pipeline:compute"):
+                out = compute(item)
+            stats.compute_s += time.perf_counter() - t0
+            if sink is not None:
+                t0 = time.perf_counter()
+                sink(out)
+                stats.sink_s += time.perf_counter() - t0
+            stats.batches += 1
+        return stats
+
+    with Prefetcher(source, prepare, depth=prefetch, stats=stats) as pf:
+        sink_cm = (AsyncSink(sink, depth=sink_depth, stats=stats)
+                   if sink is not None else None)
+        try:
+            for item in pf:
+                t0 = time.perf_counter()
+                with obs.span("pipeline:compute"):
+                    out = compute(item)
+                stats.compute_s += time.perf_counter() - t0
+                if sink_cm is not None:
+                    sink_cm.put(out)
+                stats.batches += 1
+        except BaseException:
+            if sink_cm is not None:
+                sink_cm.abandon()
+            raise
+        if sink_cm is not None:
+            sink_cm.close()
+    return stats

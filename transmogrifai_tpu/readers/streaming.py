@@ -31,6 +31,12 @@ class BatchStreamingReader(StreamingReader):
         yield from self._batches
 
 
+class StreamClosed(RuntimeError):
+    """Raised by `QueueStreamingReader.put` after `close()`: the batch was NOT
+    enqueued and will never be consumed — the producer must handle (retry
+    elsewhere, drop knowingly) instead of silently losing data."""
+
+
 class QueueStreamingReader(StreamingReader):
     """Long-running micro-batch source backed by a `queue.Queue` — the analog of the
     reference's socket/receiver DStreams (StreamingReader.scala:54) for a service
@@ -38,23 +44,41 @@ class QueueStreamingReader(StreamingReader):
     `close()` ends the stream cleanly. A `timeout` turns an idle queue into
     end-of-stream instead of blocking forever.
 
-    Contract: call `close()` only after every producer's `put()` has returned
-    (join the producers first) — the sentinel is an ordinary FIFO item, so a batch
-    enqueued after it would never be consumed."""
+    Close contract (drain-safe): `put()` and `close()` serialize on a lock, so
+    a `put()` racing `close()` either lands BEFORE the end-of-stream sentinel
+    (and is consumed) or observes the closed flag and raises `StreamClosed` —
+    a batch can no longer be silently dropped behind the sentinel. `close()`
+    is idempotent. Producers need no external join; with a bounded `maxsize`
+    a blocked `put` simply delays `close()` until the consumer drains."""
 
     _SENTINEL = object()
 
     def __init__(self, maxsize: int = 0, timeout: Optional[float] = None):
         import queue
+        import threading
 
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._lock = threading.Lock()
+        self._closed = False
         self.timeout = timeout
 
     def put(self, batch: Any) -> None:
-        self._q.put(batch)
+        with self._lock:
+            if self._closed:
+                raise StreamClosed(
+                    "put() after close(): batch rejected, not silently dropped")
+            self._q.put(batch)
 
     def close(self) -> None:
-        self._q.put(self._SENTINEL)
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(self._SENTINEL)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def stream(self) -> Iterator[Any]:
         import queue

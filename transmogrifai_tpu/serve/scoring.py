@@ -14,6 +14,9 @@ Three serving shapes:
 - `score_fn.batch(rows)` — a list of records in one fused pass.
 - `score_fn.table(table)` — columnar in, columnar out: the high-throughput
   device path (no per-row dict churn; one fused result fetch via `to_list`).
+- `score_fn.stream(batches)` — pipelined micro-batch scoring: host table
+  build of the next batch overlaps the fused device pass of the current one
+  (the shared input executor, readers/pipeline.py).
 """
 from __future__ import annotations
 
@@ -71,11 +74,41 @@ class ScoreFunction:
             return []
         padded = self._pad(records)
         out = self._local_plan().run(self._build_table(padded))
+        return self._rows_out(out, n)
+
+    def _rows_out(self, out: Mapping[str, Column], n: int) -> list[dict[str, Any]]:
         results: list[dict[str, Any]] = [{} for _ in range(n)]
         for name in self._result_names:
             for i, v in enumerate(out[name].to_list()[:n]):
                 results[i][name] = v
         return results
+
+    # --- streaming ----------------------------------------------------------------------
+    def stream(self, batches, *, prefetch: int = 2):
+        """Pipelined batch scoring over an iterable of record batches: the
+        host-side table build (+ padding) of batch k+1 runs on a producer
+        thread while the fused LocalPlan program scores batch k — the serving
+        face of the shared input executor (readers/pipeline.py). Yields one
+        `batch()`-shaped result list per input batch, in order; results are
+        bit-identical to mapping `batch()` over the same stream. `prefetch=0`
+        degrades to the synchronous loop."""
+        if prefetch <= 0:
+            for records in batches:
+                yield self.batch(records)
+            return
+        from ..readers.pipeline import Prefetcher
+
+        plan = self._local_plan()  # build once, outside the timed overlap
+
+        def prep(records):
+            n = len(records)
+            if n == 0:
+                return 0, None
+            return n, self._build_table(self._pad(records))
+
+        with Prefetcher(batches, prep, depth=prefetch, name="serve_build") as pf:
+            for n, table in pf:
+                yield [] if n == 0 else self._rows_out(plan.run(table), n)
 
     # --- columnar -----------------------------------------------------------------------
     def table(self, table: Table) -> Table:

@@ -15,12 +15,19 @@ import numpy as np
 from .column import Column
 
 
-def pow2_bucket(n: int) -> int:
+def pow2_bucket(n: int, floor: int = 1) -> int:
     """Smallest power of two >= n: the shared batch-size bucket policy for streaming
-    and serving (at most log2(max batch) compiled programs per scoring plan)."""
+    and serving (at most log2(max batch) compiled programs per scoring plan).
+
+    `floor` clamps the result to a minimum bucket (rounded up to a power of
+    two itself): trickle traffic — 1-row, 3-row, 5-row arrivals — otherwise
+    compiles one program per tiny power of two before reaching steady state.
+    With floor=64 every arrival under 64 rows shares ONE program shape."""
     if n <= 0:
         raise ValueError(f"bucket size needs n >= 1, got {n}")
-    return 1 << (n - 1).bit_length()
+    if floor < 1:
+        raise ValueError(f"bucket floor needs floor >= 1, got {floor}")
+    return 1 << (max(n, floor) - 1).bit_length()
 
 
 class Table:

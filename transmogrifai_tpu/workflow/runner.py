@@ -80,6 +80,10 @@ class RunResult:
     metrics: Optional[Any] = None
     n_rows: Optional[int] = None
     batches: Optional[int] = None
+    #: input-pipeline stats for streaming runs (PipelineStats.to_dict():
+    #: per-stage seconds, host-stall vs backpressure, queue-depth gauge,
+    #: pad-bucket histogram) — also merged into AppMetrics.trace
+    pipeline: Optional[dict] = None
 
 
 def write_table_csv(table: Table, path: str) -> None:
@@ -112,6 +116,33 @@ def write_table_csv(table: Table, path: str) -> None:
             w.writerow({k: ("" if v is None else v) for k, v in r.items()})
 
 
+class _StreamColumnsPlan:
+    """Cached per-raw-feature extraction plan for streamed record batches.
+
+    The schema walk — predictor/response split and kind dispatch — is derived
+    ONCE per streaming run; per batch only response presence is re-checked.
+    Semantics match the old inline path: every raw-feature column the stream
+    carries is rebuilt (responses included, so scored output keeps labels for
+    downstream evaluation); non-raw columns are dropped; a response column is
+    kept only when EVERY row in the (possibly mixed, post-rebatch) batch has a
+    NON-None value for it — response kinds are often non-nullable (RealNN), so
+    a key present with value None (e.g. sparse event outcomes) can't build a
+    column any more than a missing key can."""
+
+    def __init__(self, raw_features: Sequence[Any]):
+        #: (name, kind, is_response) in raw-feature order — column (and hence
+        #: scored-CSV field) order matches the unbatched path
+        self._plan = [(f.name, f.kind, f.is_response) for f in raw_features]
+
+    def build(self, rows: Sequence[dict]) -> Table:
+        kinds = {
+            name: kind for name, kind, is_response in self._plan
+            if not is_response
+            or (rows and all(r.get(name) is not None for r in rows))
+        }
+        return Table.from_rows(rows, kinds)
+
+
 class WorkflowRunner:
     """Dispatch one run type over a workflow (analog of OpWorkflowRunner.run)."""
 
@@ -125,6 +156,9 @@ class WorkflowRunner:
         features_to_compute: Sequence[Any] = (),
         stream_batch_size: Optional[int] = None,
         stream_pad: bool = True,
+        stream_prefetch: int = 2,
+        stream_sink_depth: int = 2,
+        stream_bucket_floor: int = 64,
     ):
         self.workflow = workflow
         self.train_reader = train_reader
@@ -137,6 +171,15 @@ class WorkflowRunner:
         #: pad ragged batches up to power-of-two buckets so the jit-compiled scoring
         #: plan is reused — at most log2(max batch) programs ever compile
         self.stream_pad = stream_pad
+        #: input-pipeline depth for streaming_score: column build + H2D of batch
+        #: k+1 overlaps device compute of batch k, result fetch/write of batch
+        #: k-1 rides a writer thread (readers/pipeline.py). 0 = fully
+        #: synchronous (the pre-pipeline reference path; outputs bit-identical)
+        self.stream_prefetch = stream_prefetch
+        self.stream_sink_depth = stream_sink_depth
+        #: minimum pad bucket (rounded up to a power of two): trickle arrivals
+        #: share one program shape instead of compiling per tiny power of two
+        self.stream_bucket_floor = stream_bucket_floor
         self.evaluator = evaluator
         self.features_to_compute = tuple(features_to_compute)
         self._end_handlers: list[Callable[[AppMetrics], None]] = []
@@ -204,6 +247,13 @@ class WorkflowRunner:
                     )
             else:
                 result = getattr(self, f"_run_{run_type}")(params, mark)
+            # input-pipeline stats (host-stall vs backpressure, queue-depth
+            # gauge, pad-bucket histogram) ride the trace section alongside
+            # spans/compiles so app-end handlers see the whole picture
+            if result.pipeline:
+                if metrics.trace is None:
+                    metrics.trace = {}
+                metrics.trace["pipeline"] = result.pipeline
         finally:
             metrics.end_time = time.time()
             for h in self._end_handlers:
@@ -297,14 +347,26 @@ class WorkflowRunner:
     def _run_streaming_score(self, params: OpParams, mark) -> RunResult:
         """Micro-batch scoring loop (the DStream analog, OpWorkflowRunner.scala:232):
         each batch from the streaming reader is scored with the same jit-cached plan;
-        batch outputs append as CSV parts under write_location."""
+        batch outputs append as CSV parts under write_location.
+
+        Pipelined (stream_prefetch > 0, the default): column build + pad + H2D
+        of batch k+1 runs on a producer thread while the device scores batch k,
+        and the blocking result fetch + CSV write of batch k-1 rides a writer
+        thread — the tf.data-style overlapped input pipeline
+        (readers/pipeline.py). Batch order, program shapes, and output bytes
+        are identical to the synchronous loop (stream_prefetch=0)."""
         if self.streaming_reader is None:
             raise ValueError("streaming_score run needs a streaming reader")
+        from ..readers.pipeline import PipelineStats, run_pipeline
+        from ..types.table import pow2_bucket
+
         model = self._load_model(params)
         mark("load_model")
         loc = params.write_location
-        n_rows = 0
-        n_batches = 0
+        # per-raw-feature extraction plan derived ONCE per run: the
+        # predictor/response split and kind lookups used to be rebuilt for
+        # every batch (pure host-side work on the pipeline's critical path)
+        plan = _StreamColumnsPlan(model.raw_features)
         batches = self.streaming_reader.stream()
         if self.stream_batch_size:
             from ..readers.streaming import rebatch
@@ -313,41 +375,44 @@ class WorkflowRunner:
                 (b.to_rows() if isinstance(b, Table) else b for b in batches),
                 self.stream_batch_size,
             )
-        for batch in batches:
-            if isinstance(batch, Table):
-                table = batch
-            else:
-                # rebuild every raw-feature column the stream actually carries —
-                # responses included, so scored output keeps labels for downstream
-                # evaluation just like the unbatched path. Columns that are not
-                # raw features have no declared kind and are dropped (documented
-                # on stream_batch_size).
-                # a response column is kept only when EVERY row in the (possibly
-                # mixed, post-rebatch) batch carries a NON-None value for it —
-                # response kinds are often non-nullable (RealNN), so a key
-                # present with value None (e.g. sparse event outcomes) can't
-                # build a column any more than a missing key can
-                present = (set.intersection(
-                    *({k for k, v in r.items() if v is not None} for r in batch))
-                    if batch else set())
-                kinds = {f.name: f.kind for f in model.raw_features
-                         if not f.is_response or f.name in present}
-                table = Table.from_rows(batch, kinds)
+        stats = PipelineStats()
+        counts = {"rows": 0, "batches": 0}
+
+        def prepare(batch):
+            # building device columns (jnp.asarray) on the producer thread IS
+            # the async H2D start: the transfer proceeds while the consumer
+            # dispatches the previous batch's scoring program
+            table = batch if isinstance(batch, Table) else plan.build(batch)
             n = table.nrows
             if self.stream_pad and n > 0:
-                from ..types.table import pow2_bucket
+                table = table.pad_to(
+                    pow2_bucket(n, floor=self.stream_bucket_floor))
+                stats.observe_bucket(table.nrows)
+            return n, table
 
-                table = table.pad_to(pow2_bucket(n))
+        def compute(item):
+            n, table = item
             scored = model.score(table=table)
             if scored.nrows > n:
                 scored = scored.slice(np.arange(n))
-            n_rows += scored.nrows
-            if loc:
-                write_table_csv(scored, os.path.join(loc, f"part-{n_batches:05d}.csv"))
-            n_batches += 1
+            counts["rows"] += scored.nrows
+            return scored
+
+        def sink(scored):
+            # write_table_csv -> to_rows forces the D2H fetch here, off the
+            # dispatch thread: the fetch of batch k overlaps compute of k+1
+            write_table_csv(
+                scored, os.path.join(loc, f"part-{counts['written']:05d}.csv"))
+            counts["written"] += 1
+
+        counts["written"] = 0
+        run_pipeline(batches, prepare, compute, sink if loc else None,
+                     prefetch=self.stream_prefetch,
+                     sink_depth=self.stream_sink_depth, stats=stats)
         mark("streaming_score")
-        return RunResult("streaming_score", write_location=loc, n_rows=n_rows,
-                         batches=n_batches)
+        return RunResult("streaming_score", write_location=loc,
+                         n_rows=counts["rows"], batches=stats.batches,
+                         pipeline=stats.to_dict())
 
     @staticmethod
     def _write_metrics(metrics: Any, location: Optional[str]) -> None:
