@@ -1,0 +1,301 @@
+"""Multichip scaling bench: stats / scoring / AutoML-search lanes over mesh shapes.
+
+Measures the three row-parallel paths this codebase shards over the
+(data x model) mesh — design-matrix statistics (ops/stats.py), fused batch
+scoring (serve/local.py), and the ModelSelector's folds x grid search
+(select/validator.py) — at mesh shapes 1x1, 8x1, 1x8, and 4x2, and reports a
+`scaling_efficiency` per lane.
+
+Efficiency definition (honest on both substrates):
+
+  scaling_efficiency = mesh_throughput / (single_device_throughput * ideal)
+
+* On REAL multi-chip hardware (TPU pod slice), ideal = n_devices: the classic
+  strong-scaling efficiency.
+* On FORCED HOST-PLATFORM devices (CPU with
+  --xla_force_host_platform_device_count=8 — the CI substrate), the 8 virtual
+  devices SHARE the machine's cores, so ideal aggregate throughput equals the
+  single-device throughput and ideal = 1: the metric then measures SHARDING
+  OVERHEAD RETENTION — how much of the machine's throughput the partitioned
+  program (collectives, per-shard dispatch, layout) preserves. 1.0 = free
+  sharding; the CI gate is >= 0.6 on the data-parallel (8x1) stats/scoring
+  lanes. The 1x8 row replicates the batch to every device and is reported as
+  the measured cost of NOT sharding rows (the waste oplint OP404 flags).
+
+Prints a full JSON payload line, then a compact final summary line (the
+driver records only the tail of stdout; tools/bench_diff.py parses either).
+
+Usage: python bench_multichip.py [--quick] [--tpu]
+  default: forces JAX_PLATFORMS=cpu with 8 virtual host devices (safe
+  anywhere; never touches a TPU relay). --tpu uses the real visible devices.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+#: device forcing must precede the first jax import
+_ap = argparse.ArgumentParser()
+_ap.add_argument("--quick", action="store_true",
+                 help="small shapes / few reps (the CI smoke)")
+_ap.add_argument("--tpu", action="store_true",
+                 help="use the real visible devices instead of forcing 8 "
+                      "virtual CPU devices")
+ARGS = _ap.parse_args()
+
+if not ARGS.tpu:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial a TPU relay
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_METRIC = "multichip_scaling_efficiency"
+#: mesh shapes exercised, as (n_data, n_model)
+SHAPES = ((1, 1), (8, 1), (1, 8), (4, 2))
+
+
+def _bench(fn, *args, reps: int = 5) -> float:
+    """Amortized wall seconds per call (one block_until_ready per rep set)."""
+    import jax
+
+    jax.block_until_ready(fn(*args))  # warm/compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def _shapes_for(n_devices: int):
+    return [(d, m) for d, m in SHAPES if d * m <= n_devices]
+
+
+def _efficiency(thr_mesh: float, thr_single: float, n_devices: int,
+                forced_host: bool) -> float:
+    ideal = 1.0 if forced_host else float(n_devices)
+    return thr_mesh / (thr_single * ideal) if thr_single > 0 else 0.0
+
+
+def run_stats_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
+    """Design-matrix statistics (the SanityChecker/RawFeatureFilter substrate):
+    fused column moments + label correlations, rows sharded over DATA_AXIS."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.mesh import shard_batch
+    from transmogrifai_tpu.ops.stats import column_stats, pearson_with_label
+
+    n, d = (1 << 15, 128) if quick else (1 << 17, 256)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def pass_(a, b):
+        s = column_stats(a)
+        c = pearson_with_label(a, b)
+        return s.mean, c
+
+    out = {"rows": n, "cols": d, "per_shape": {}}
+    base = None
+    for (nd, nm), mesh in meshes.items():
+        if mesh is None:
+            Xd, yd = jnp.asarray(X), jnp.asarray(y)
+        else:
+            Xd, yd = shard_batch(mesh, X), shard_batch(mesh, y)
+        wall = _bench(pass_, Xd, yd, reps=3 if quick else 5)
+        rows_s = n / wall
+        out["per_shape"][f"{nd}x{nm}"] = round(rows_s)
+        if (nd, nm) == (1, 1):
+            base = rows_s
+    data_par = out["per_shape"].get("8x1")
+    if base and data_par:
+        out["scaling_efficiency"] = round(_efficiency(
+            data_par, base, 8, forced_host), 4)
+    return out
+
+
+def run_scoring_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
+    """Fused batch scoring (serve/local.py LocalPlan): a trained numeric
+    pipeline scored columnar, batch rows sharded over DATA_AXIS."""
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.types import Column, Table
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.workflow.runner import shard_table_rows
+
+    n_feat = 8
+    n_rows = (1 << 14) if quick else (1 << 16)
+    schema = {"label": "RealNN", **{f"x{i}": "Real" for i in range(n_feat)}}
+    rng = np.random.default_rng(1)
+    train = [{"label": float(rng.random() > 0.5),
+              **{f"x{i}": float(v)
+                 for i, v in enumerate(rng.normal(size=n_feat))}}
+             for _ in range(512)]
+    fs = features_from_schema(schema, response="label")
+    vec = transmogrify([f for k, f in fs.items() if k != "label"])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    model = (Workflow().set_result_features(pred)
+             .train(table=InMemoryReader(train).generate_table(list(fs.values())),
+                    mesh=None))
+    pname = model.result_features[0].name
+
+    cols = {f"x{i}": rng.normal(size=n_rows).astype(np.float32)
+            for i in range(n_feat)}
+    big = Table({k: Column.build("Real", v, device=False)
+                 for k, v in cols.items()})
+    # explicit device backend: this lane measures the fused device pass, not
+    # the auto-router (bench the router separately if it ever regresses)
+    fn = model.score_fn(backend=None)
+
+    def score(t):
+        return fn.table(t)[pname].pred
+
+    out = {"rows": n_rows, "per_shape": {}}
+    base = None
+    for (nd, nm), mesh in meshes.items():
+        t = big if mesh is None else shard_table_rows(mesh, big)
+        wall = _bench(score, t, reps=3 if quick else 5)
+        rows_s = n_rows / wall
+        out["per_shape"][f"{nd}x{nm}"] = round(rows_s)
+        if (nd, nm) == (1, 1):
+            base = rows_s
+    data_par = out["per_shape"].get("8x1")
+    if base and data_par:
+        out["scaling_efficiency"] = round(_efficiency(
+            data_par, base, 8, forced_host), 4)
+    return out
+
+
+def run_selector_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
+    """The AutoML search itself (select/validator.py): folds x grid over the
+    mesh — rows shard the data axis, grid points shard the model axis."""
+    from transmogrifai_tpu.select import ParamGridBuilder
+    from transmogrifai_tpu.select.validator import (
+        CrossValidation,
+        evaluate_candidates,
+    )
+    from transmogrifai_tpu.stages.model import LogisticRegression
+
+    n, d = (1024, 32) if quick else (4096, 64)
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.float32)
+    grid = ParamGridBuilder().add(
+        "l2", [0.0, 1e-3, 1e-2, 1e-1, 0.2, 0.5, 1.0, 2.0]).build()
+    candidates = [(LogisticRegression(max_iter=15), grid)]
+    ones = np.ones(n, np.float32)
+    masks = CrossValidation(num_folds=3, seed=0).fold_masks(y, ones)
+    n_models = len(grid) * masks.shape[0]
+
+    out = {"rows": n, "cols": d, "models": n_models, "per_shape": {}}
+    base = None
+    for (nd, nm), mesh in meshes.items():
+        def search(mesh=mesh):
+            return evaluate_candidates(candidates, X, y, ones, masks, ones,
+                                       "binary", "AuROC", mesh=mesh)
+        search()  # warm (compiles this mesh's partitioned programs)
+        reps = 2 if quick else 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            results = search()
+        wall = (time.perf_counter() - t0) / reps
+        out["per_shape"][f"{nd}x{nm}"] = round(n_models / wall, 2)
+        if (nd, nm) == (1, 1):
+            base = n_models / wall
+            out["base_scores"] = [round(r.metric_mean, 6) for r in results]
+        else:
+            # sharded search must agree with the single-device one
+            got = [round(r.metric_mean, 6) for r in results]
+            for a, b in zip(out["base_scores"], got):
+                if abs(a - b) > 1e-3:
+                    out["parity_error"] = f"{nd}x{nm}: {a} vs {b}"
+    data_par = out["per_shape"].get("8x1")
+    if base and data_par:
+        out["scaling_efficiency"] = round(_efficiency(
+            data_par, base, 8, forced_host), 4)
+    return out
+
+
+def main() -> None:
+    import jax
+
+    from transmogrifai_tpu.mesh import make_mesh
+
+    devices = jax.devices()
+    n_devices = len(devices)
+    forced_host = devices[0].platform == "cpu"
+    meshes = {
+        (nd, nm): None if (nd, nm) == (1, 1)
+        else make_mesh(n_data=nd, n_model=nm, devices=devices[:nd * nm])
+        for nd, nm in _shapes_for(n_devices)
+    }
+
+    detail = {
+        "n_devices": n_devices,
+        "device": str(devices[0]),
+        "forced_host_devices": forced_host,
+        "efficiency_definition": (
+            "mesh_throughput / (single_device_throughput * ideal); ideal = "
+            "n_devices on real chips, 1 on forced host-platform devices "
+            "(they share the machine's cores, so the metric is sharding-"
+            "overhead retention)"),
+        "quick": ARGS.quick,
+    }
+    detail["stats"] = run_stats_lane(meshes, ARGS.quick, forced_host)
+    detail["scoring"] = run_scoring_lane(meshes, ARGS.quick, forced_host)
+    detail["selector"] = run_selector_lane(meshes, ARGS.quick, forced_host)
+
+    stats_eff = detail["stats"].get("scaling_efficiency")
+    scoring_eff = detail["scoring"].get("scaling_efficiency")
+    gated = [e for e in (stats_eff, scoring_eff) if e is not None]
+    headline = round(min(gated), 4) if gated else None
+
+    print(json.dumps({"metric": _METRIC, "value": headline, "unit": "ratio",
+                      "detail": detail}))
+    summary = {
+        "multichip_stats_scaling_efficiency": stats_eff,
+        "multichip_scoring_scaling_efficiency": scoring_eff,
+        "multichip_selector_scaling_efficiency":
+            detail["selector"].get("scaling_efficiency"),
+        "multichip_stats_rows_per_sec_8x1":
+            detail["stats"]["per_shape"].get("8x1"),
+        "multichip_scoring_rows_per_sec_8x1":
+            detail["scoring"]["per_shape"].get("8x1"),
+        "multichip_models_per_sec_8x1":
+            detail["selector"]["per_shape"].get("8x1"),
+        "multichip_models_per_sec_1x8":
+            detail["selector"]["per_shape"].get("1x8"),
+        "multichip_models_per_sec_4x2":
+            detail["selector"]["per_shape"].get("4x2"),
+        "n_devices": n_devices,
+    }
+    parity_error = detail["selector"].get("parity_error")
+    if parity_error:
+        summary["selector_parity_error"] = parity_error
+    sys.stdout.flush()
+    print(json.dumps({"metric": _METRIC, "value": headline, "unit": "ratio",
+                      "summary": {k: v for k, v in summary.items()
+                                  if v is not None}}))
+    sys.stdout.flush()
+    if parity_error:
+        # a sharded search disagreeing with the single-device one is the
+        # miscompile class this lane exists to catch: fail LOUDLY, never
+        # record garbage throughput as a green run
+        print(f"bench_multichip: SHARDED SEARCH PARITY VIOLATION: "
+              f"{parity_error}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # registration is already baked at interpreter startup, so when the relay is
 # down pytest itself must be launched with PALLAS_AXON_POOL_IPS= (blank).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# The 8 fake devices exist to exercise sharding code DELIBERATELY. Without
+# this, Workflow.train's auto-mesh default would turn every train in the
+# suite into an 8-way multichip run — single-device behavior would go
+# untested (and the suite would crawl on small hosts). Mesh execution is
+# pinned by the suites that attach meshes explicitly (test_multichip,
+# test_wide_sharding, test_mesh_multislice) and by bench_multichip.py.
+os.environ.setdefault("TT_AUTO_MESH", "0")
 
 import jax  # noqa: E402
 

@@ -50,7 +50,8 @@ class TestCatalog:
     def test_every_rule_documented(self):
         # the catalog drives docs/static_analysis.md and `op lint --rules`
         assert {"OP001", "OP101", "OP102", "OP103", "OP104", "OP201", "OP202",
-                "OP203", "OP301", "OP302", "OP401", "OP402", "OP403"} \
+                "OP203", "OP301", "OP302", "OP401", "OP402", "OP403",
+                "OP404"} \
             == set(RULES)
         for r in RULES.values():
             assert r.title and r.rationale and r.severity in ("error", "warn", "info")
@@ -391,6 +392,35 @@ class TestOP403FusionBreaker:
 
     def test_all_device_clean(self):
         assert "OP403" not in _codes(analyze_plan([self._chain(host=False)]))
+
+
+class TestOP404MeshReplication:
+    """Host column consumed by device stages: replicated to every mesh device."""
+
+    def _plan(self, host: bool, device_consumer: bool = True):
+        fs = features_from_schema({"a": "Real"})
+        mid = LambdaTransformer(_host_id, "RealNN", device_op=not host,
+                                fn_name="host_id")(fs["a"])
+        if device_consumer:
+            out = FillMissingWithMeanModel(mean=0.0)(mid)
+        else:
+            out = LambdaTransformer(_host_id, "RealNN", device_op=False,
+                                    fn_name="host_id2")(mid)
+        return out
+
+    def test_host_into_device_fires(self):
+        report = analyze_plan([self._plan(host=True)])
+        diags = report.by_code("OP404")
+        assert diags and "replicated" in diags[0].message
+        assert diags[0].severity == "info"
+
+    def test_device_into_device_clean(self):
+        assert "OP404" not in _codes(analyze_plan([self._plan(host=False)]))
+
+    def test_host_into_host_clean(self):
+        # a host column consumed only by host stages never rides the mesh
+        assert "OP404" not in _codes(
+            analyze_plan([self._plan(host=True, device_consumer=False)]))
 
 
 # --- Workflow.train gate: fail at plan time, zero data, zero traces -------------------

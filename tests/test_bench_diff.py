@@ -70,6 +70,32 @@ def test_r04_to_r05_flags_boston_slip(capsys):
     assert bench_diff.main([r05, r05]) == 0
 
 
+def test_multichip_tail_record(tmp_path, capsys):
+    """The MULTICHIP record format: {"tail": "...stdout tail..."} whose last
+    JSON line carries the bench_multichip summary — and scaling_efficiency
+    regressions are flagged (higher is better)."""
+    assert not bench_diff.lower_is_better("multichip_stats_scaling_efficiency")
+    line = json.dumps({"metric": "multichip_scaling_efficiency", "value": 0.9,
+                       "summary": {"multichip_stats_scaling_efficiency": 0.9,
+                                   "multichip_scoring_rows_per_sec_8x1": 1000}})
+    a = tmp_path / "MULTICHIP_a.json"
+    b = tmp_path / "MULTICHIP_b.json"
+    a.write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True,
+         "tail": f"noise line\n{line}\n"}))
+    got = bench_diff.load_summary(str(a))
+    assert got["multichip_stats_scaling_efficiency"] == 0.9
+    # a 50% efficiency collapse regresses
+    worse = json.dumps({"summary": {"multichip_stats_scaling_efficiency": 0.4,
+                                    "multichip_scoring_rows_per_sec_8x1": 990}})
+    b.write_text(json.dumps({"n_devices": 8, "rc": 0, "tail": worse}))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    # pre-lane stub (empty tail): --allow-empty skips instead of erroring
+    b.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True, "tail": ""}))
+    assert bench_diff.main([str(a), str(b), "--allow-empty"]) == 0
+    assert bench_diff.main([str(a), str(b)]) == 2  # without the flag
+
+
 def test_cli_on_flat_json(tmp_path, capsys):
     a = tmp_path / "a.json"
     b = tmp_path / "b.json"

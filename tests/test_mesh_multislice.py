@@ -11,6 +11,7 @@ from transmogrifai_tpu.mesh import (
     make_mesh,
     make_multislice_mesh,
     shard_batch,
+    use_mesh,
 )
 
 FAKE_SLICES = [0, 0, 0, 0, 1, 1, 1, 1]  # 8 CPU devices as 2 fake slices of 4
@@ -48,7 +49,7 @@ def test_sharded_fit_matches_replicated():
 
     plain = fit_logistic(jnp.asarray(X), jnp.asarray(y), l2=0.1, max_iter=10)
     mesh = make_multislice_mesh(n_model=2, slice_assignments=FAKE_SLICES)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         Xs = shard_batch(mesh, jnp.asarray(X))
         ys = shard_batch(mesh, jnp.asarray(y))
         sharded = jax.jit(lambda a, b: fit_logistic(a, b, l2=0.1, max_iter=10))(Xs, ys)
@@ -142,7 +143,7 @@ def test_gbt_fit_row_sharded_matches_single_device():
     mesh = make_mesh(n_data=8, n_model=1, devices=jax.devices()[:8])
     Xs = shard_batch(mesh, jnp.asarray(X))
     ys = shard_batch(mesh, jnp.asarray(y))
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = fit_gbt(Xs, ys, **kw)
         pred_sharded = np.asarray(predict_gbt_binary(sharded, Xs)[2])
 

@@ -1,0 +1,357 @@
+"""End-to-end multi-chip execution tests on the fake 8-device CPU mesh.
+
+The tentpole invariants of the auto-mesh path:
+
+* mesh-vs-single-device parity: the SAME titanic-shaped synthetic train on an
+  explicit mesh picks the same winner with the same metrics (fp tolerance) as
+  the unmeshed train — sharding is a layout, never a semantics change;
+* steady state stays compiled: repeat meshed trains run under
+  `obs.retrace_budget(0)`;
+* the validator's grid padding (repeat-last-point to a multiple of n_model)
+  never leaks a padded clone into results or winner selection;
+* the dual-axis regression: grid sharding combined with row sharding
+  miscompiled under the XLA SPMD partitioner (4x2 mesh, 2 folds, sort-based
+  metrics -> garbage), so the validator replicates rows whenever the grid
+  claims the model axis — pinned here against the unsharded scores.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    auto_mesh,
+    make_mesh,
+    parse_mesh_shape,
+    shard_rows_padded,
+)
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import (
+    BinaryClassificationModelSelector,
+    ParamGridBuilder,
+)
+from transmogrifai_tpu.select.validator import (
+    CrossValidation,
+    evaluate_candidates,
+)
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+from transmogrifai_tpu.params import OpParams
+
+
+def _rows(n=256, seed=0):
+    """Titanic-shaped synthetic: numeric + categorical predictors, binary label."""
+    rng = np.random.default_rng(seed)
+    return [{"label": float(rng.random() > 0.55),
+             "age": float(rng.integers(1, 80)),
+             "fare": float(rng.random() * 100),
+             "cls": f"c{rng.integers(1, 4)}"} for _ in range(n)]
+
+
+def _schema():
+    return {"label": "RealNN", "age": "Real", "fare": "Real",
+            "cls": "PickList"}
+
+
+def _build(mesh):
+    fs = features_from_schema(_schema(), response="label")
+    vec = transmogrify([fs["age"], fs["fare"], fs["cls"]])
+    checked = vec.sanity_check(fs["label"], min_variance=1e-9)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, models=[(LogisticRegression(max_iter=10),
+                              ParamGridBuilder().add(
+                                  "l2", [0.0, 0.01, 0.1]).build())])
+    pred = sel(fs["label"], checked)
+    wf = Workflow().set_result_features(pred)
+    if mesh is not None:
+        wf.with_mesh(mesh)
+    return wf, sel, fs
+
+
+@pytest.fixture(scope="module")
+def table():
+    fs = features_from_schema(_schema(), response="label")
+    return InMemoryReader(_rows()).generate_table(list(fs.values()))
+
+
+class TestMeshParity:
+    def test_mesh_vs_single_device_parity(self, table):
+        """Same winner + same metrics, unmeshed vs 2x2 vs full 8x1."""
+        summaries = {}
+        for name, mesh in (("plain", None),
+                           ("2x2", make_mesh(n_data=2, n_model=2)),
+                           ("8x1", make_mesh(n_data=8, n_model=1))):
+            wf, sel, _ = _build(mesh)
+            wf.train(table=table)
+            summaries[name] = sel.summary_
+        base = summaries["plain"]
+        for name in ("2x2", "8x1"):
+            s = summaries[name]
+            assert s.best_model_name == base.best_model_name, name
+            assert s.best_params == base.best_params, name
+            np.testing.assert_allclose(
+                [r.metric_mean for r in s.validation_results],
+                [r.metric_mean for r in base.validation_results],
+                rtol=1e-4, atol=1e-5, err_msg=name)
+            np.testing.assert_allclose(
+                s.holdout_metrics.to_json()["AuPR"],
+                base.holdout_metrics.to_json()["AuPR"],
+                rtol=1e-4, atol=1e-5, err_msg=name)
+
+    def test_meshed_steady_state_no_retrace(self, table):
+        """Fresh meshed graphs on the same table: zero steady-state compiles."""
+        mesh = make_mesh(n_data=8, n_model=1)
+        for _ in range(2):  # cold + settle (uniq memoization etc.)
+            wf, _, _ = _build(mesh)
+            wf.train(table=table)
+        with obs.retrace_budget(0):
+            wf, _, _ = _build(mesh)
+            wf.train(table=table)
+
+    def test_sanity_checker_mesh_parity_nondividing_rows(self):
+        """The padded sharded stats pass reports the same stats and drops as
+        the unmeshed one — 250 rows do NOT divide 8 (weight-0 pad rows)."""
+        from transmogrifai_tpu.check.sanity_checker import SanityChecker
+
+        rng = np.random.default_rng(3)
+        n = 250
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        X[:, 3] = 0.0  # zero-variance slot: must drop identically
+        y = (X[:, 0] > 0).astype(np.float32)
+        cols = lambda: [Column.build("RealNN", [float(v) for v in y]),  # noqa: E731
+                        Column.vector(X.copy())]
+        plain = SanityChecker(min_variance=1e-9).fit_columns(cols())
+        meshed_stage = SanityChecker(min_variance=1e-9)
+        meshed_stage.mesh = make_mesh(n_data=8, n_model=1)
+        meshed = meshed_stage.fit_columns(cols())
+        assert meshed.params["keep_indices"] == plain.params["keep_indices"]
+        ps, ms = plain.summary_, meshed.summary_
+        assert ms.n_sampled == ps.n_sampled == n
+        for a, b in zip(ps.slot_stats, ms.slot_stats):
+            np.testing.assert_allclose(
+                [a.mean, a.variance, a.min, a.max, a.corr_with_label],
+                [b.mean, b.variance, b.min, b.max, b.corr_with_label],
+                rtol=1e-4, atol=1e-5)
+
+
+class TestValidatorMesh:
+    def _data(self, n=256, folds=2):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(n, 16)).astype(np.float32)
+        y = (X @ rng.normal(size=16) > 0).astype(np.float32)
+        ones = np.ones(n, np.float32)
+        masks = CrossValidation(num_folds=folds, seed=0).fold_masks(y, ones)
+        return X, y, ones, masks
+
+    def test_dual_axis_search_parity(self):
+        """4x2 mesh + 2 folds + sort-based metric: the XLA SPMD miscompile
+        combo — the validator must replicate rows when the grid shards."""
+        X, y, ones, masks = self._data()
+        cand = [(LogisticRegression(max_iter=5),
+                 ParamGridBuilder().add("l2", [0.0, 0.01, 0.1]).build())]
+        ref = evaluate_candidates(cand, X, y, ones, masks, ones,
+                                  "binary", "AuPR")
+        got = evaluate_candidates(cand, X, y, ones, masks, ones,
+                                  "binary", "AuPR",
+                                  mesh=make_mesh(n_data=4, n_model=2))
+        for a, b in zip(ref, got):
+            assert a.grid_point == b.grid_point
+            np.testing.assert_allclose(b.metric_values, a.metric_values,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grid_padding_clones_masked(self):
+        """3 grid points over a model axis of 2 pad to 4 by repeating the last
+        point: the padded clone must appear in neither the results nor the
+        winner — even when the LAST (duplicated) point is the best one."""
+        X, y, ones, masks = self._data()
+        # descending l2 so the duplicated last point (l2=0.0) scores best
+        grid = ParamGridBuilder().add("l2", [0.1, 0.01, 0.0]).build()
+        cand = [(LogisticRegression(max_iter=5), grid)]
+        results = evaluate_candidates(cand, X, y, ones, masks, ones,
+                                      "binary", "AuROC",
+                                      mesh=make_mesh(n_data=1, n_model=2))
+        assert len(results) == 3  # padded 4th column trimmed
+        assert [r.grid_point for r in results] == grid
+        best = max(results, key=lambda r: r.metric_mean)
+        assert best.grid_point == {"l2": 0.0}
+        # and each point appears exactly once
+        seen = [tuple(sorted(r.grid_point.items())) for r in results]
+        assert len(set(seen)) == 3
+
+
+class TestAutoMesh:
+    def test_parse_mesh_shape(self):
+        assert parse_mesh_shape(None) is None
+        assert parse_mesh_shape("auto") is None
+        assert parse_mesh_shape("4,2") == (4, 2)
+        assert parse_mesh_shape([8, 1]) == (8, 1)
+        with pytest.raises(ValueError):
+            parse_mesh_shape("4")
+        with pytest.raises(ValueError):
+            parse_mesh_shape("0,2")
+
+    def test_auto_mesh_default_lays_data_axis(self):
+        mesh = auto_mesh()
+        assert mesh is not None
+        assert mesh.shape[DATA_AXIS] == 8 and mesh.shape[MODEL_AXIS] == 1
+        mesh = auto_mesh("4,2")
+        assert mesh.shape[DATA_AXIS] == 4 and mesh.shape[MODEL_AXIS] == 2
+
+    def test_auto_mesh_single_device_degenerates(self):
+        assert auto_mesh(devices=jax.devices()[:1]) is None
+
+    def test_train_threads_mesh_into_estimators(self, table):
+        """Workflow.train(mesh=...) lands on the selector AND sanity checker;
+        a later unmeshed train clears the workflow-threaded mesh."""
+        wf, sel, _ = _build(None)
+        mesh = make_mesh(n_data=2, n_model=1)
+        wf.train(table=table, mesh=mesh)
+        assert sel.mesh is mesh
+        checker = [s for layer in wf._dag for s in layer
+                   if s.operation_name == "sanityChecker"][0]
+        assert checker.mesh is mesh
+        # stage instances are single-wire; re-train the same workflow unmeshed
+        wf.train(table=table, mesh=None)
+        assert sel.mesh is None or os.environ.get("TT_AUTO_MESH") != "0"
+
+    def test_runner_mesh_section(self, table):
+        """A meshed runner train reports the mesh section in AppMetrics."""
+        wf, _, fs = _build(None)
+        runner = WorkflowRunner(
+            wf, train_reader=InMemoryReader(_rows()),
+            mesh=make_mesh(n_data=2, n_model=1))
+        seen = []
+        runner.add_application_end_handler(seen.append)
+        runner.run("train", OpParams())
+        assert seen and seen[0].mesh is not None
+        sec = seen[0].mesh
+        assert sec["shape"] == {DATA_AXIS: 2, MODEL_AXIS: 1}
+        assert sec["n_devices"] == 2
+        assert sec["transfers"] > 0
+        assert sec["sharded_dispatches"] > 0
+        assert sec == seen[0].to_dict()["mesh"]
+
+
+class TestShardHelpers:
+    def test_shard_rows_padded_weighted_stats_exact(self):
+        """Weight-0 padding: moments/correlations over 250 rows on 8 shards
+        equal the unsharded values exactly (to fp reduction order)."""
+        from transmogrifai_tpu.ops.stats import column_stats, pearson_with_label
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(250, 12)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        mesh = make_mesh(n_data=8, n_model=1)
+        Xs, ys, ws, n = shard_rows_padded(mesh, X, y)
+        assert n == 250 and Xs.shape[0] == 256
+        ref = column_stats(X)
+        got = column_stats(Xs, ws)
+        for a, b in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pearson_with_label(Xs, ys, ws)),
+            np.asarray(pearson_with_label(X, y)), rtol=1e-4, atol=1e-5)
+
+    def test_shard_table_rows(self):
+        from transmogrifai_tpu.workflow.runner import shard_table_rows
+
+        mesh = make_mesh(n_data=8, n_model=1)
+        t = Table({"x": Column.build("Real", [float(i) for i in range(64)],
+                                     device=False),
+                   "s": Column.build("Text", [f"v{i}" for i in range(64)],
+                                     device=False)})
+        out = shard_table_rows(mesh, t)
+        assert isinstance(out["x"].values, jax.Array)
+        spec = out["x"].values.sharding.spec
+        assert spec == jax.sharding.PartitionSpec(DATA_AXIS)
+        assert not isinstance(out["s"].values, jax.Array)  # host column stays
+        # non-dividing and too-small batches pass through untouched
+        t65 = Table({"x": Column.build("Real", [0.0] * 65, device=False)})
+        assert shard_table_rows(mesh, t65) is t65
+        assert shard_table_rows(mesh, t, min_rows=128) is t
+
+
+class TestServingRouting:
+    @pytest.fixture(scope="class")
+    def model(self):
+        fs = features_from_schema(_schema(), response="label")
+        vec = transmogrify([fs["age"], fs["fare"], fs["cls"]])
+        pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+        table = InMemoryReader(_rows(96)).generate_table(list(fs.values()))
+        return Workflow().set_result_features(pred).train(table=table)
+
+    def test_auto_routing_small_batch_to_cpu(self, model, monkeypatch):
+        """With a non-CPU default device, small batches route to the CPU
+        columnar plan; large ones to the device plan; every decision lands on
+        the trace span."""
+        real_devices = jax.devices
+
+        class _FakeTpu:
+            platform = "tpu"
+
+        def fake_devices(backend=None):
+            if backend is None:
+                return [_FakeTpu()]
+            return real_devices(backend)
+
+        rows = _rows(300, seed=9)
+        for r in rows:
+            r.pop("label")
+        # pad_to bucketing must not defeat the router: decisions key on the
+        # REAL row count, so a 4-row batch padded to 512 still routes to cpu
+        fn = model.score_fn(pad_to=[512])  # backend="auto" default
+        monkeypatch.setattr(jax, "devices", fake_devices)
+        with obs.trace() as tracer:
+            fn(rows[0])               # 1 row (padded 512) -> cpu
+            fn.batch(rows[:4])        # 4 rows (padded 512) -> cpu
+            fn.batch(rows)            # 300 rows -> device
+        events = [e for e in tracer.root.events if e["name"] == "serve:routing"]
+        assert [e["backend"] for e in events] == ["cpu", "cpu", "device"]
+        assert [e["rows"] for e in events] == [1, 4, 300]
+        assert all(e["decided"] == "auto" for e in events)
+        assert set(fn._plans) == {"cpu", "default"}
+
+    def test_explicit_backend_respected(self, model):
+        fn = model.score_fn(backend="cpu")
+        rows = _rows(4, seed=10)
+        for r in rows:
+            r.pop("label")
+        with obs.trace() as tracer:
+            out = fn.batch(rows)
+        assert len(out) == 4
+        events = [e for e in tracer.root.events if e["name"] == "serve:routing"]
+        assert events and events[0]["decided"] == "explicit"
+        assert events[0]["backend"] == "cpu"
+        assert set(fn._plans) == {"cpu"}
+
+    def test_auto_on_cpu_process_single_plan_parity(self, model):
+        """On a CPU-default process auto routing is inert: same results as
+        the explicit plans, one device-lane plan."""
+        rows = _rows(8, seed=11)
+        for r in rows:
+            r.pop("label")
+        auto = model.score_fn()
+        explicit = model.score_fn(backend="cpu")
+        pname = model.result_features[0].name
+        a = auto.batch(rows)
+        b = explicit.batch(rows)
+        for ra, rb in zip(a, b):
+            assert abs(ra[pname]["prediction"] - rb[pname]["prediction"]) < 1e-5
+
+    def test_streamed_routing_matches_batch(self, model):
+        rows = _rows(12, seed=12)
+        for r in rows:
+            r.pop("label")
+        fn = model.score_fn()
+        batches = [rows[:5], rows[5:]]
+        streamed = list(fn.stream(iter(batches)))
+        direct = [fn.batch(b) for b in batches]
+        assert streamed == direct

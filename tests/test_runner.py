@@ -646,10 +646,10 @@ def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
     seen = {}
 
     def fake_warmup(problem, rows, width, num_classes=3, models=None,
-                    splitter=None, num_folds=3, seed=0):
+                    splitter=None, num_folds=3, seed=0, mesh="auto"):
         seen.update(problem=problem, rows=rows, width=width,
                     splitter=type(splitter).__name__ if splitter else None,
-                    num_folds=num_folds)
+                    num_folds=num_folds, mesh=mesh)
         return {"problem": problem, "rows": rows, "width": width,
                 "requested_width": width, "wall_s": 0.01}
 
@@ -662,7 +662,7 @@ def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
     assert rc == 0
     assert '"regression"' in buf.getvalue()
     assert seen == {"problem": "regression", "rows": 48, "width": 8,
-                    "splitter": "DataCutter", "num_folds": 2}
+                    "splitter": "DataCutter", "num_folds": 2, "mesh": "auto"}
 
 
 def test_warmup_solo_fits_cover_every_static_group(monkeypatch):

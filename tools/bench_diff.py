@@ -4,12 +4,16 @@
     python tools/bench_diff.py BENCH_r04.json BENCH_r05.json [--threshold 0.25]
 
 Accepts the driver's BENCH_*.json wrapper ({"parsed": {"summary": {...}}}),
-a bare {"summary": {...}} record, or a flat {metric: value} JSON. Every scalar
-metric present in BOTH files is compared; direction is inferred from the name
-(seconds/latency metrics regress upward, throughput/quality metrics regress
-downward). Exits non-zero when any shared metric regressed by more than the
-threshold (default 25%) — the guard the r04->r05 boston first-train 3.8x slip
-(2.349 s -> 8.828 s) shipped straight past.
+a bare {"summary": {...}} record, a flat {metric: value} JSON, or a MULTICHIP
+record ({"tail": "...stdout tail..."} — the last JSON line of the tail
+carrying a "summary", as bench_multichip.py emits). Every scalar metric
+present in BOTH files is compared; direction is inferred from the name
+(seconds/latency metrics regress upward, throughput/quality metrics — incl.
+scaling_efficiency — regress downward). Exits non-zero when any shared metric
+regressed by more than the threshold (default 25%) — the guard the r04->r05
+boston first-train 3.8x slip (2.349 s -> 8.828 s) shipped straight past.
+--allow-empty exits 0 when either record carries no scalar metrics (the
+pre-lane MULTICHIP stubs).
 """
 from __future__ import annotations
 
@@ -26,7 +30,7 @@ _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99")
 #: overrides: fragments that look like seconds but are throughput/quality
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",
-                  "tflops", "flops")
+                  "tflops", "flops", "efficiency")
 
 
 def lower_is_better(name: str) -> bool:
@@ -37,12 +41,34 @@ def lower_is_better(name: str) -> bool:
             or any(frag in n for frag in _LOWER_SUBSTR))
 
 
+def _from_tail(tail: str) -> Optional[dict]:
+    """Last parseable JSON object line of a captured-stdout tail (the driver
+    records only the final ~2000 bytes; bench lanes emit their compact
+    summary as the final line). Prefers lines carrying a 'summary'."""
+    best = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and (isinstance(doc.get("summary"), dict)
+                                      or best is None):
+            best = doc
+    return best
+
+
 def load_summary(path: str) -> dict[str, float]:
     """Extract the flat {metric: scalar} dict from any supported shape."""
     with open(path) as fh:
         doc = json.load(fh)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         doc = doc["parsed"]
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str) \
+            and "summary" not in doc:
+        doc = _from_tail(doc["tail"]) or {}
     if isinstance(doc, dict) and isinstance(doc.get("summary"), dict):
         doc = doc["summary"]
     if not isinstance(doc, dict):
@@ -79,10 +105,17 @@ def main(argv=None) -> int:
     ap.add_argument("new")
     ap.add_argument("--threshold", type=float, default=0.25,
                     help="fractional regression tolerance (default 0.25)")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="exit 0 when either record has no scalar metrics "
+                         "(pre-lane MULTICHIP stubs)")
     args = ap.parse_args(argv)
 
-    rows = compare(load_summary(args.old), load_summary(args.new),
-                   threshold=args.threshold)
+    old, new = load_summary(args.old), load_summary(args.new)
+    if args.allow_empty and (not old or not new):
+        print("bench_diff: a record has no scalar metrics; skipping "
+              "(--allow-empty)")
+        return 0
+    rows = compare(old, new, threshold=args.threshold)
     if not rows:
         print("bench_diff: no shared scalar metrics", file=sys.stderr)
         return 2

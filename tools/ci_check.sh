@@ -23,6 +23,42 @@ for app in examples.iris:make_runner examples.titanic:make_runner; do
     python -m transmogrifai_tpu.cli.main lint --app "$app"
 done
 
+echo "== multichip mesh smoke =="
+# forced-8-device mesh lane: end-to-end mesh-vs-single-device parity (same
+# winner, same metrics, steady-state retrace_budget(0)) + the multichip
+# scaling bench in quick mode. Everything runs on CPU virtual devices.
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    python -m pytest tests/test_multichip.py -q -p no:cacheprovider
+python bench_multichip.py --quick > /tmp/_multichip_ci.json.out
+tail -1 /tmp/_multichip_ci.json.out
+# absolute floor (the acceptance criterion): the gated stats/scoring lanes
+# must hold scaling_efficiency >= 0.6 on the 8 forced host devices
+tail -1 /tmp/_multichip_ci.json.out | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+s = doc.get("summary", {})
+bad = {k: s[k] for k in ("multichip_stats_scaling_efficiency",
+                         "multichip_scoring_scaling_efficiency")
+       if s.get(k) is not None and s[k] < 0.6}
+if bad:
+    sys.exit("multichip scaling_efficiency below the 0.6 floor: %s" % bad)
+print("multichip efficiency floor ok: value=%s" % doc.get("value"))
+'
+# relative gate against the newest MULTICHIP record (report-only unless
+# CI_BENCH_STRICT=1, mirroring the BENCH gate below; pre-lane stub records
+# carry no metrics and are skipped via --allow-empty)
+# shellcheck disable=SC2012,SC2207
+MC=( $(ls MULTICHIP_r*.json 2>/dev/null | sort | tail -1) )
+if [ "${#MC[@]}" -eq 1 ]; then
+    tail -1 /tmp/_multichip_ci.json.out > /tmp/_multichip_new.json
+    if [ "${CI_BENCH_STRICT:-0}" = "1" ]; then
+        python tools/bench_diff.py --allow-empty "${MC[0]}" /tmp/_multichip_new.json
+    else
+        python tools/bench_diff.py --allow-empty "${MC[0]}" /tmp/_multichip_new.json \
+            || echo "(multichip regression vs ${MC[0]}; rerun with CI_BENCH_STRICT=1 to enforce)"
+    fi
+fi
+
 echo "== bench regression gate =="
 # Every scalar in the bench summary is gated, including the streaming_score
 # input-pipeline lane (streaming_score_rows_per_sec, streaming_pipeline_speedup,

@@ -81,6 +81,12 @@ OP402 = _rule("OP402", "duplicate vectorizer", "warn",
 OP403 = _rule("OP403", "host stage between device layers", "info",
               "a host stage sandwiched between device stages breaks XLA "
               "fusion and forces device<->host transfers")
+OP404 = _rule("OP404", "host column replicated to every mesh device", "info",
+              "a host-computed full-table column re-enters the device program "
+              "unsharded: under a multi-device mesh it is replicated to every "
+              "chip (n_devices x the memory and transfer), while "
+              "device-produced columns stay row-sharded — the multi-device "
+              "form of OP403")
 
 
 def make_diag(code: str, message: str, **kw) -> Diagnostic:
@@ -457,8 +463,35 @@ def pass_hygiene(ctx: PlanContext) -> Iterator[Diagnostic]:
                 stage_uid=s.uid,
                 hint=f"reuse the output feature of {first.uid}")
 
-    # OP403: host stages sandwiched between device stages (fusion breakers)
+    # OP404: host-produced columns consumed by device stages. A device stage's
+    # input that came off a HOST stage is a plain (unsharded) array: under a
+    # (data x model) mesh the runtime device_puts it REPLICATED onto every
+    # device, while device-produced columns stay row-sharded — a full-table
+    # array times n_devices in memory and interconnect (the multi-device form
+    # of OP403's fusion break). Flag the producing host stage once.
     consumers = ctx.consumers_in_cone()
+    for s in ctx.stages():
+        if not isinstance(s, Transformer) or isinstance(s, Estimator) \
+                or isinstance(s, FeatureGeneratorStage) or s.device_op:
+            continue
+        out = s._output
+        dev_consumers = [] if out is None else [
+            c for c in consumers.get(id(out), ())
+            if getattr(c, "device_op", False)]
+        if dev_consumers:
+            names = sorted({type(c).__name__ for c in dev_consumers})
+            yield make_diag(
+                "OP404",
+                f"host stage {type(s).__name__} feeds device stage(s) "
+                f"{', '.join(names)}: under a multi-device mesh its "
+                f"full-table output column {out.name!r} is replicated to "
+                "every device (device-produced columns stay row-sharded)",
+                stage_uid=s.uid, feature_uids=(out.uid,),
+                hint="make the kernel pure-jnp (device_op=True) so its rows "
+                     "ride the mesh sharding, or accept the replication cost "
+                     "knowingly for small tables")
+
+    # OP403: host stages sandwiched between device stages (fusion breakers)
     for li, layer in enumerate(ctx.dag):
         breakers: list[tuple[Stage, int]] = []
         for s in layer:

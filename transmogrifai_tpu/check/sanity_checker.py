@@ -34,11 +34,12 @@ from ..types.vector_schema import SlotInfo, VectorSchema
 
 
 @jax.jit
-def _onehot_contingency(Xd, flat_idx, yd, uniq):
+def _onehot_contingency(Xd, flat_idx, yd, uniq, w=None):
     """Indicator-slot gather + label one-hot + contingency tables as one
-    program (the SanityChecker's warm-label path; see fit_columns)."""
+    program (the SanityChecker's warm-label path; see fit_columns). `w` masks
+    mesh-padding rows (weight 0) out of the counts."""
     lab_oh = (yd[:, None] == uniq[None, :]).astype(jnp.float32)
-    return contingency_table(jnp.take(Xd, flat_idx, axis=1), lab_oh)
+    return contingency_table(jnp.take(Xd, flat_idx, axis=1), lab_oh, w)
 
 _EPS = 1e-12
 
@@ -150,6 +151,10 @@ class SanityChecker(Estimator):
     operation_name = "sanityChecker"
     arity = (2, 2)
     fit_only_inputs = (0,)  # the label drives drop decisions, never the output rows
+    #: device mesh slot (None = unmeshed): the design-matrix stats pass then
+    #: shards rows over DATA_AXIS (reductions psum over ICI); threaded in by
+    #: Workflow.train's auto-mesh or set directly. Never serialized.
+    mesh = None
 
     def __init__(self, check_sample: float = 1.0, sample_seed: int = 42,
                  max_correlation: float = 0.95, min_correlation: float = 0.0,
@@ -205,6 +210,38 @@ class SanityChecker(Estimator):
         else:
             Xd, yd = X_dev, y_dev
 
+        # --- mesh placement ----------------------------------------------------------
+        # rows over DATA_AXIS: the moment/correlation/contingency reductions
+        # below auto-partition and psum over ICI. Non-dividing row counts pad
+        # by repeating row 0 at WEIGHT 0 (exact for every weighted reduction;
+        # min/max see only existing values) — except spearman, whose ranks are
+        # not pad-safe, so it shards only on even division. Device-side twin
+        # of mesh.shard_rows_padded: the matrix is already device-resident,
+        # so padding runs as jnp ops instead of a host round trip.
+        n_stat = int(Xd.shape[0])
+        ws = None
+        mesh = self.mesh
+        if mesh is not None:
+            from ..mesh import DATA_AXIS, record_sharded_dispatch, shard_batch
+
+            n_data = int(mesh.shape[DATA_AXIS])
+            pad = (-n_stat) % n_data
+            if n_data <= 1 or (pad and p["corr_type"] == "spearman"):
+                mesh = None
+            else:
+                if pad:
+                    Xd = jnp.concatenate(
+                        [Xd, jnp.broadcast_to(Xd[:1], (pad, d))])
+                    yd = jnp.concatenate(
+                        [yd, jnp.broadcast_to(yd[:1], (pad,))])
+                    ws = jnp.concatenate([jnp.ones(n_stat, jnp.float32),
+                                          jnp.zeros(pad, jnp.float32)])
+                Xd = shard_batch(mesh, Xd)
+                yd = shard_batch(mesh, yd)
+                if ws is not None:
+                    ws = shard_batch(mesh, ws)
+                record_sharded_dispatch()
+
         # --- fused stats pass --------------------------------------------------------
         # all programs dispatch async; ONE fetch returns stats + corr + label.
         # The contingency tables need the label's UNIQUE values (host), which
@@ -213,11 +250,11 @@ class SanityChecker(Estimator):
         # COLUMN object (the AutoML steady state re-trains fresh graphs on the
         # same table): warm trains build the label one-hot ON DEVICE and the
         # whole fit is ONE device_get.
-        stats = column_stats(Xd)
+        stats = column_stats(Xd, ws)
         if p["corr_type"] == "spearman":
             corr = spearman_with_label(Xd, yd)
         else:
-            corr = pearson_with_label(Xd, yd)
+            corr = pearson_with_label(Xd, yd, ws)
 
         groups = schema.groups()
         ind_groups = [
@@ -243,7 +280,7 @@ class SanityChecker(Estimator):
             # slower than the second fetch it replaces)
             tables_dev = _onehot_contingency(
                 Xd, jnp.asarray(flat_idx), yd,
-                jnp.asarray(uniq, jnp.float32))
+                jnp.asarray(uniq, jnp.float32), ws)
         # yd is only consumed by the cold path's np.unique — warm trains skip
         # its transfer entirely
         from .. import obs
@@ -277,7 +314,7 @@ class SanityChecker(Estimator):
                 # are then O(K*C) numpy.
                 all_tables = np.asarray(_onehot_contingency(
                     Xd, jnp.asarray(flat_idx), yd,
-                    jnp.asarray(uniq, jnp.float32)))
+                    jnp.asarray(uniq, jnp.float32), ws))
             pos = 0
             for key, idxs in ind_groups:
                 table = all_tables[pos:pos + len(idxs)]
@@ -344,7 +381,7 @@ class SanityChecker(Estimator):
 
         summary = SanityCheckerSummary(
             n_rows=n,
-            n_sampled=int(Xd.shape[0]),
+            n_sampled=n_stat,
             slot_stats=[
                 SlotStats(
                     name=names[i], mean=float(mean[i]), variance=float(var[i]),

@@ -38,6 +38,11 @@ def _cmd_run(argv) -> int:
     ap.add_argument("--lenient-lint", action="store_true",
                     help="downgrade error-severity oplint findings to "
                          "warnings instead of failing train at plan time")
+    ap.add_argument("--mesh", default=None, metavar="DATA,MODEL",
+                    help="device-mesh layout for multi-chip execution: "
+                         "'auto' (all visible devices on the data axis — the "
+                         "default) or explicit 'n_data,n_model' (e.g. 4,2); "
+                         "single-device processes run unmeshed either way")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.params import OpParams
@@ -45,6 +50,11 @@ def _cmd_run(argv) -> int:
     params = OpParams.from_json(args.params) if args.params else OpParams()
     if args.lenient_lint:
         params.lenient_lint = True
+    if args.mesh is not None:
+        from transmogrifai_tpu.mesh import parse_mesh_shape
+
+        parse_mesh_shape(args.mesh)  # fail fast on a malformed layout
+        params.mesh_shape = args.mesh
     for attr in ("model_location", "write_location", "metrics_location"):
         v = getattr(args, attr)
         if v is not None:  # CLI flags override the params file
@@ -182,6 +192,13 @@ def _cmd_warmup(argv) -> int:
                          "with the same one (default: the problem's default)")
     ap.add_argument("--reserve-test-fraction", type=float, default=None,
                     help="planned holdout fraction (with --splitter)")
+    ap.add_argument("--mesh", default=None, metavar="DATA,MODEL",
+                    help="warm the SHARDED program shapes for this mesh "
+                         "layout ('auto' or 'n_data,n_model') — a meshed "
+                         "train compiles different (partitioned) programs "
+                         "than a single-device one, so warm with the layout "
+                         "the real train will use (default: the same "
+                         "auto-mesh resolution Workflow.train applies)")
     args = ap.parse_args(argv)
     from transmogrifai_tpu.workflow.warmup import _PROBLEMS, warmup_matrix
 
@@ -213,6 +230,7 @@ def _cmd_warmup(argv) -> int:
                             num_classes=args.num_classes,
                             splitter=splitter, num_folds=args.num_folds,
                             splitter_fraction=splitter_fraction,
+                            mesh_shape=args.mesh,
                             log=lambda m: print(m, file=sys.stderr))
     import json
 

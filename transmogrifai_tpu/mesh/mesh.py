@@ -18,7 +18,8 @@ feature axis of X over MODEL_AXIS when D is large: partial dot-products psum acr
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import threading
+from typing import Optional, Sequence, Union
 
 import jax
 import numpy as np
@@ -26,6 +27,125 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+# --- mesh observability ---------------------------------------------------------------
+#: process-wide counters of mesh-placement work: every sharded/replicated
+#: device_put issued through the helpers below (count + bytes) and every
+#: dispatch of a program whose reductions psum over the mesh (recorded by the
+#: sharded callers: validator search units, sanity/stats passes, sharded
+#: scoring batches). The runner snapshots deltas around a run and reports them
+#: in AppMetrics' `mesh` section next to the tracer's span tree.
+_MESH_STATS_LOCK = threading.Lock()
+_MESH_STATS = {"transfers": 0, "transfer_bytes": 0, "sharded_dispatches": 0}
+
+
+def record_transfer(arr) -> None:
+    nbytes = int(getattr(arr, "nbytes", 0) or 0)
+    with _MESH_STATS_LOCK:
+        _MESH_STATS["transfers"] += 1
+        _MESH_STATS["transfer_bytes"] += nbytes
+
+
+def record_sharded_dispatch(n: int = 1) -> None:
+    """Count a dispatch of a program running over sharded operands (its
+    cross-device reductions lower to psum over ICI)."""
+    with _MESH_STATS_LOCK:
+        _MESH_STATS["sharded_dispatches"] += int(n)
+
+
+def mesh_stats() -> dict:
+    with _MESH_STATS_LOCK:
+        return dict(_MESH_STATS)
+
+
+def reset_mesh_stats() -> None:
+    with _MESH_STATS_LOCK:
+        for k in _MESH_STATS:
+            _MESH_STATS[k] = 0
+
+
+def mesh_section(mesh: Optional[Mesh],
+                 base: Optional[dict] = None) -> Optional[dict]:
+    """The AppMetrics `mesh` report: axis sizes + placement counters. With
+    `base` (an earlier mesh_stats() snapshot) the counters are per-run
+    deltas — how the runner scopes the process-wide totals to one run."""
+    if mesh is None:
+        return None
+    stats = mesh_stats()
+    if base is not None:
+        stats = {k: v - base.get(k, 0) for k, v in stats.items()}
+    return {
+        "shape": {DATA_AXIS: int(mesh.shape[DATA_AXIS]),
+                  MODEL_AXIS: int(mesh.shape[MODEL_AXIS])},
+        "n_devices": int(mesh.size),
+        **stats,
+    }
+
+
+# --- auto-mesh ------------------------------------------------------------------------
+def parse_mesh_shape(spec: Union[None, str, Sequence[int]]):
+    """'4,2' / 'data,model' counts / (4, 2) -> (n_data, n_model);
+    None or 'auto' -> None (let auto_mesh lay all devices on the data axis)."""
+    if spec is None or spec == "auto":
+        return None
+    if isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+    else:
+        parts = list(spec)
+    if len(parts) != 2:
+        raise ValueError(
+            f"mesh shape must be 'n_data,n_model' (e.g. '4,2') or 'auto', "
+            f"got {spec!r}")
+    n_data, n_model = int(parts[0]), int(parts[1])
+    if n_data < 1 or n_model < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {n_data}x{n_model}")
+    return n_data, n_model
+
+
+def auto_mesh(mesh_shape: Union[None, str, Sequence[int]] = None,
+              devices: Optional[Sequence] = None) -> Optional[Mesh]:
+    """The default multi-device wiring (Workflow.train / WorkflowRunner /
+    `op run`): build a (data x model) mesh over every visible device. With no
+    explicit shape, all devices lay on the DATA axis — per the cross-replica
+    data-parallel touchstone (PAPERS.md), the layout carries the scaling and
+    row-parallel reductions psum over ICI, while the tuning grid stays
+    unsharded (grid sharding needs padding; opt in via an explicit shape).
+
+    Returns None when exactly ONE device is visible and no shape was
+    requested: single-chip execution degenerates to the unmeshed path exactly
+    (same programs, same caches, zero behavior change)."""
+    shape = parse_mesh_shape(mesh_shape)
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        if len(devices) <= 1:
+            return None
+        return make_mesh(n_data=len(devices), n_model=1, devices=devices)
+    n_data, n_model = shape
+    return make_mesh(n_data=n_data, n_model=n_model, devices=devices)
+
+
+def use_mesh(mesh: Mesh):
+    """Version-portable ambient-mesh context: `jax.set_mesh` where it exists
+    (jax >= 0.6), falling back to the classic `Mesh` context manager. Only
+    needed by code relying on ambient-mesh name resolution — NamedSharding-
+    placed inputs partition under plain jit without it."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh  # Mesh is itself a context manager
+
+
+def default_mesh(mesh_shape: Union[None, str, Sequence[int]] = None) -> Optional[Mesh]:
+    """The shared auto-mesh resolution of Workflow.train / WorkflowRunner /
+    `op warmup`: auto_mesh over the visible devices, honoring the
+    TT_AUTO_MESH=0 kill switch (which disables only the IMPLICIT mesh — an
+    explicit mesh_shape still builds one)."""
+    import os
+
+    if mesh_shape is None and os.environ.get("TT_AUTO_MESH", "1") == "0":
+        return None
+    return auto_mesh(mesh_shape)
 
 
 def make_mesh(
@@ -111,6 +231,7 @@ def shard_batch(mesh: Mesh, arr, batch_dim: int = 0):
     """Place an array with its batch dim sharded over DATA_AXIS (rows across chips)."""
     spec = [None] * np.ndim(arr)
     spec[batch_dim] = DATA_AXIS
+    record_transfer(arr)
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
@@ -118,11 +239,43 @@ def shard_grid(mesh: Mesh, arr, grid_dim: int = 0):
     """Place a hyperparameter-grid axis over MODEL_AXIS."""
     spec = [None] * np.ndim(arr)
     spec[grid_dim] = MODEL_AXIS
+    record_transfer(arr)
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
 def replicate(mesh: Mesh, arr):
+    record_transfer(arr)
     return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def shard_rows_padded(mesh: Mesh, X, y=None, w=None):
+    """Rows over DATA_AXIS for arbitrary row counts: pad to a multiple of the
+    data axis by REPEATING ROW 0 with WEIGHT 0, so every weighted reduction
+    (moments, correlations, contingency matmuls) is exact and min/max see only
+    values already present. Returns (Xs, ys, ws, n_rows) — consumers MUST
+    thread `ws` through their reductions; unweighted statistics (ranks,
+    unweighted quantiles) are NOT pad-safe and must use even shards instead.
+
+    This is the HOST-side form (numpy pad, one H2D per array) for ingest-time
+    call sites and benches. SanityChecker.fit_columns applies the same
+    repeat-row-0/weight-0 policy DEVICE-side (jnp.concatenate + reshard) so an
+    already-device-resident design matrix never round-trips to the host —
+    keep the two in sync."""
+    X = np.asarray(X)
+    n = X.shape[0]
+    n_data = mesh.shape[DATA_AXIS]
+    pad = (-n) % n_data
+    w_full = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+    if pad:
+        X = np.concatenate([X, np.repeat(X[:1], pad, axis=0)])
+        w_full = np.concatenate([w_full, np.zeros(pad, np.float32)])
+        if y is not None:
+            y = np.asarray(y)
+            y = np.concatenate([y, np.repeat(y[:1], pad, axis=0)])
+    Xs = shard_batch(mesh, X)
+    ys = None if y is None else shard_batch(mesh, y)
+    ws = shard_batch(mesh, w_full)
+    return Xs, ys, ws, n
 
 
 def shard_wide(mesh: Mesh, arr):
@@ -131,6 +284,7 @@ def shard_wide(mesh: Mesh, arr):
     parallelism). Downstream X@w / X^T r matmuls under jit then psum their partial
     dot-products over the model axis and their row-partials over the data axis;
     XLA inserts the collectives from the sharding alone."""
+    record_transfer(arr)
     return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)))
 
 
@@ -151,6 +305,8 @@ def shard_for_training(mesh: Mesh, X, y, wide_threshold: Optional[int] = None):
     row_ok = n % n_data == 0
     col_ok = d >= wide_threshold and d % n_model == 0 and n_model > 1
     spec = P(DATA_AXIS if row_ok else None, MODEL_AXIS if col_ok else None)
+    record_transfer(X)
+    record_transfer(y)
     Xs = jax.device_put(X, NamedSharding(mesh, spec))
     ys = jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS if row_ok else None)))
     return Xs, ys

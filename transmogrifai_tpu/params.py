@@ -39,6 +39,11 @@ class OpParams:
     #: downgrade error-severity oplint findings to warnings instead of failing
     #: train at plan time (Workflow.train(strict=False); `op run --lenient-lint`)
     lenient_lint: bool = False
+    #: device-mesh layout for multi-chip runs: "auto" / "n_data,n_model"
+    #: (e.g. "4,2") / [n_data, n_model]. None = auto-mesh over every visible
+    #: device (all on the data axis; single-device processes run unmeshed).
+    #: CLI: `op run --mesh 4,2`.
+    mesh_shape: Optional[Any] = None
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

@@ -106,11 +106,18 @@ class Prefetcher:
 
     def __init__(self, source: Iterable, fn: Optional[Callable[[Any], Any]] = None,
                  *, depth: int = 2, name: str = "prepare",
-                 stats: Optional[PipelineStats] = None):
+                 stats: Optional[PipelineStats] = None,
+                 place: Optional[Callable[[Any], Any]] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._source = source
         self._fn = fn
+        #: optional device-placement hook run on the PRODUCER thread after
+        #: `fn`: under a mesh this is the per-shard `jax.device_put` that
+        #: lands a streamed batch pre-sharded over the data axis while the
+        #: device is still computing the previous batch (the tf.data-service
+        #: analog of per-replica input splits)
+        self._place = place
         self._depth = depth
         self._name = name
         self.stats = stats if stats is not None else PipelineStats()
@@ -133,6 +140,12 @@ class Prefetcher:
                     t0 = time.perf_counter()
                     with obs.span(f"pipeline:{self._name}", parent=self._parent):
                         item = self._fn(item)
+                    self.stats.prepare_s += time.perf_counter() - t0
+                if self._place is not None:
+                    t0 = time.perf_counter()
+                    with obs.span(f"pipeline:{self._name}:place",
+                                  parent=self._parent):
+                        item = self._place(item)
                     self.stats.prepare_s += time.perf_counter() - t0
                 self._put(("item", item))
         except BaseException as e:  # noqa: BLE001 — surfaced at the consumer
@@ -256,6 +269,7 @@ def run_pipeline(
     sink_depth: int = 2,
     name: str = "pipeline",
     stats: Optional[PipelineStats] = None,
+    place: Optional[Callable[[Any], Any]] = None,
 ) -> PipelineStats:
     """Run `source -> prepare -> compute -> sink` with the three stages
     overlapped; returns the aggregated PipelineStats.
@@ -271,6 +285,10 @@ def run_pipeline(
                 t0 = time.perf_counter()
                 item = prepare(item)
                 stats.prepare_s += time.perf_counter() - t0
+            if place is not None:
+                t0 = time.perf_counter()
+                item = place(item)
+                stats.prepare_s += time.perf_counter() - t0
             t0 = time.perf_counter()
             with obs.span("pipeline:compute"):
                 out = compute(item)
@@ -282,7 +300,8 @@ def run_pipeline(
             stats.batches += 1
         return stats
 
-    with Prefetcher(source, prepare, depth=prefetch, stats=stats) as pf:
+    with Prefetcher(source, prepare, depth=prefetch, stats=stats,
+                    place=place) as pf:
         sink_cm = (AsyncSink(sink, depth=sink_depth, stats=stats)
                    if sink is not None else None)
         try:

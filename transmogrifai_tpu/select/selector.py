@@ -332,11 +332,31 @@ class ModelSelector(PredictorEstimator):
                     np.asarray(X_tr, np.float32), np.asarray(y_used, np.float32),
                     np.asarray(weights))
             else:
+                X_fit, y_fit = X_tr, jnp.asarray(y_used)
+                w_fit = jnp.asarray(weights)
+                if self.mesh is not None:
+                    # winner refit over the mesh: rows over the data axis when
+                    # they divide it (the fit's matmuls psum partial products
+                    # over ICI), features over the model axis when wide —
+                    # same placement policy as the search itself
+                    from ..mesh import (
+                        DATA_AXIS,
+                        record_sharded_dispatch,
+                        replicate,
+                        shard_batch,
+                        shard_for_training,
+                    )
+
+                    X_fit, y_fit = shard_for_training(self.mesh, X_fit, y_fit)
+                    if X_fit.shape[0] % self.mesh.shape[DATA_AXIS] == 0:
+                        w_fit = shard_batch(self.mesh, w_fit)
+                    else:
+                        w_fit = replicate(self.mesh, w_fit)
+                    record_sharded_dispatch()
                 # no block_until_ready: the refit output flows straight into the
                 # fused predict+metrics programs — forcing it here would add one
                 # ~90ms tunnel round trip purely for phase attribution
-                params = best_est.fit_fn(X_tr, jnp.asarray(y_used),
-                                         sample_weight=jnp.asarray(weights),
+                params = best_est.fit_fn(X_fit, y_fit, sample_weight=w_fit,
                                          **best_est.fit_kwargs())
 
         summary = ModelSelectorSummary(

@@ -315,16 +315,26 @@ def evaluate_candidates(
         wide = (not per_fold_X and n_model > 1
                 and Xd.shape[1] >= WIDE_D_THRESHOLD
                 and Xd.shape[1] % n_model == 0)
+        # rows shard over the data axis ONLY when the grid axis is not also
+        # sharded: combining MODEL_AXIS grid sharding with DATA_AXIS row
+        # sharding in the folds x grid program miscompiles under the XLA SPMD
+        # partitioner at some shape coincidences (observed: 4x2 mesh, 2 folds,
+        # sort-based AuROC/AuPR return large negative garbage while 2x4 and
+        # 4 folds are exact — jax 0.4.37 CPU). Data-parallel meshes (the
+        # auto-mesh default, n_model == 1) keep full row sharding; dual-axis
+        # meshes buy grid parallelism and replicate rows. Regression test:
+        # tests/test_multichip.py::test_dual_axis_search_parity.
+        row_shard = rows_ok and (wide or n_model == 1)
         if wide:
             Xd = shard_wide(mesh, Xd) if rows_ok else jax.device_put(
                 Xd, jax.sharding.NamedSharding(
                     mesh, jax.sharding.PartitionSpec(None, MODEL_AXIS)))
             n_model = 1  # grid axis no longer sharded
-        elif rows_ok:
+        elif row_shard:
             Xd = shard_batch(mesh, Xd, batch_dim=row_dim)
         else:
             Xd = replicate(mesh, Xd)
-        if rows_ok:
+        if row_shard:
             yd = shard_batch(mesh, yd)
             fold_train_w = shard_batch(mesh, fold_train_w, batch_dim=1)
             fold_val_w = shard_batch(mesh, fold_val_w, batch_dim=1)
@@ -391,6 +401,10 @@ def evaluate_candidates(
             u["template"], u["static_items"], u["vmap_names"],
             problem_type, metric, num_classes, per_fold_X=per_fold_X,
         )
+        if mesh is not None:
+            from ..mesh import record_sharded_dispatch
+
+            record_sharded_dispatch()
         if u["hyper"] is not None:
             return program(Xd, yd, fold_train_w, fold_val_w, u["hyper"])
         return program(Xd, yd, fold_train_w, fold_val_w)[:, None]

@@ -28,16 +28,34 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..workflow.workflow import WorkflowModel
 
 
+#: batches strictly below this row count route to the CPU columnar plan under
+#: backend="auto": BENCH_r05 measured 101.55 ms single-row on the (tunneled)
+#: device vs 0.307 ms on host CPU-JAX — a device round trip only pays for
+#: itself when the batch amortizes it
+AUTO_CPU_THRESHOLD = 256
+
+
 class ScoreFunction:
     """Callable serving handle for a fitted WorkflowModel.
 
-    backend: None = the process default (TPU when present); "cpu" = pin every
-    jit + intermediate to host CPU-JAX in this process (`jax.default_device`),
-    the low-latency single-record deployment mode.
+    backend: "auto" (default) = route by batch size — batches below
+    `auto_cpu_threshold` rows run on the in-process host CPU-JAX plan (the
+    sub-ms single-record path), larger ones on the process-default device;
+    each decision is recorded as a `serve:routing` event on the active trace
+    span. None = always the process default (TPU when present); "cpu" = pin
+    every jit + intermediate to host CPU-JAX (`jax.default_device`). Explicit
+    values are always respected — no routing happens unless backend="auto".
+
+    mesh: optional device mesh — batches whose rows divide its data axis (and
+    that routed to the device plan) are placed row-sharded before the fused
+    pass, so the scoring program partitions across chips.
     """
 
     def __init__(self, model: "WorkflowModel", result_names: Optional[Sequence[str]] = None,
-                 pad_to: Optional[Sequence[int]] = None, backend: Optional[str] = None):
+                 pad_to: Optional[Sequence[int]] = None,
+                 backend: Optional[str] = "auto",
+                 auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
+                 mesh=None):
         self._model = model
         self._result_names = list(result_names) if result_names else [
             f.name for f in model.result_features
@@ -48,20 +66,64 @@ class ScoreFunction:
         #: program per bucket, analog of serving-side shape bucketing)
         self._pad_to = sorted(pad_to) if pad_to else None
         self._backend = backend
-        self._plan = None
+        self._auto_cpu_threshold = int(auto_cpu_threshold)
+        self._mesh = mesh
+        self._plans: dict = {}  # backend key -> LocalPlan
 
-    def _local_plan(self):
-        if self._plan is None:
+    def _plan_for(self, backend: Optional[str]):
+        key = backend or "default"
+        plan = self._plans.get(key)
+        if plan is None:
             from .local import LocalPlan
 
             device = None
-            if self._backend is not None:
+            if backend is not None:
                 import jax
 
-                device = jax.devices(self._backend)[0]
-            self._plan = LocalPlan(self._model.stages, self._result_names,
-                                   device=device)
-        return self._plan
+                device = jax.devices(backend)[0]
+            plan = self._plans[key] = LocalPlan(
+                self._model.stages, self._result_names, device=device)
+        return plan
+
+    def _route(self, n_rows: int):
+        """-> (LocalPlan, backend label). Under "auto", small batches take the
+        CPU columnar path; the decision lands on the score trace span."""
+        from .. import obs
+
+        if self._backend != "auto":
+            backend = self._backend
+            decided = "explicit"
+        else:
+            import jax
+
+            default_is_cpu = jax.devices()[0].platform == "cpu"
+            backend = ("cpu" if not default_is_cpu
+                       and n_rows < self._auto_cpu_threshold else None)
+            decided = "auto"
+        obs.add_event("serve:routing", backend=backend or "device",
+                      rows=int(n_rows), decided=decided)
+        return self._plan_for(backend), backend
+
+    def _local_plan(self):
+        # back-compat surface (tests/tools introspect it): the device-lane plan
+        return self._plan_for(None if self._backend == "auto" else self._backend)
+
+    def _maybe_shard(self, table_or_cols, n_rows: int, backend: Optional[str]):
+        """Row-shard numeric columns over the mesh data axis for large
+        device-lane batches (pre-sharded inputs partition the fused pass)."""
+        if self._mesh is None or backend is not None:
+            return table_or_cols
+        from ..mesh import DATA_AXIS
+
+        n_data = int(self._mesh.shape[DATA_AXIS])
+        if n_data <= 1 or n_rows < n_data or n_rows % n_data != 0:
+            return table_or_cols
+        from ..workflow.runner import shard_table_rows
+
+        if isinstance(table_or_cols, Table):
+            return shard_table_rows(self._mesh, table_or_cols)
+        sharded = shard_table_rows(self._mesh, Table(dict(table_or_cols)))
+        return {n: sharded[n] for n in sharded.names()}
 
     # --- single record ------------------------------------------------------------------
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
@@ -73,7 +135,11 @@ class ScoreFunction:
         if n == 0:
             return []
         padded = self._pad(records)
-        out = self._local_plan().run(self._build_table(padded))
+        # route on the REAL row count: pad_to bucketing must not flip a
+        # 4-row request onto the device lane just because its bucket is big
+        plan, backend = self._route(n)
+        table = self._maybe_shard(self._build_table(padded), len(padded), backend)
+        out = plan.run(table)
         return self._rows_out(out, n)
 
     def _rows_out(self, out: Mapping[str, Column], n: int) -> list[dict[str, Any]]:
@@ -98,17 +164,38 @@ class ScoreFunction:
             return
         from ..readers.pipeline import Prefetcher
 
-        plan = self._local_plan()  # build once, outside the timed overlap
-
         def prep(records):
             n = len(records)
             if n == 0:
-                return 0, None
-            return n, self._build_table(self._pad(records))
+                return 0, None, None
+            padded = self._pad(records)
+            plan, backend = self._route(n)  # real rows, not the pad bucket
+            return n, self._build_table(padded), (plan, backend, len(padded))
 
-        with Prefetcher(batches, prep, depth=prefetch, name="serve_build") as pf:
-            for n, table in pf:
-                yield [] if n == 0 else self._rows_out(plan.run(table), n)
+        def place(item):
+            # producer-thread device placement: under a mesh, device-lane
+            # batches land PRE-SHARDED over the data axis while the fused
+            # pass still scores the previous batch
+            n, table, route = item
+            if route is None:
+                return item
+            plan, backend, n_padded = route
+            return n, self._maybe_shard(table, n_padded, backend), route
+
+        # plans build once, outside the timed overlap
+        if self._backend == "auto":
+            self._plan_for(None)
+            import jax
+
+            if jax.devices()[0].platform != "cpu":
+                self._plan_for("cpu")
+        else:
+            self._local_plan()
+
+        with Prefetcher(batches, prep, depth=prefetch, name="serve_build",
+                        place=place) as pf:
+            for n, table, route in pf:
+                yield [] if n == 0 else self._rows_out(route[0].run(table), n)
 
     # --- columnar -----------------------------------------------------------------------
     def table(self, table: Table) -> Table:
@@ -123,7 +210,9 @@ class ScoreFunction:
                 cols[f.name] = table[f.name]
             else:
                 cols[f.name] = Column.build(f.kind, [_placeholder(f.kind)] * n, device=False)
-        out = self._local_plan().run(cols)
+        plan, backend = self._route(n)
+        cols = self._maybe_shard(cols, n, backend)
+        out = plan.run(cols)
         return Table({n_: out[n_] for n_ in self._result_names})
 
     def _pad(self, records: Sequence[Mapping[str, Any]]):
@@ -171,7 +260,10 @@ def _placeholder(kind) -> Any:
 
 def score_function(model: "WorkflowModel", result_names: Optional[Sequence[str]] = None,
                   pad_to: Optional[Sequence[int]] = None,
-                  backend: Optional[str] = None) -> ScoreFunction:
+                  backend: Optional[str] = "auto",
+                  auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
+                  mesh=None) -> ScoreFunction:
     """Build the serving callable (analog of `model.scoreFunction`)."""
     return ScoreFunction(model, result_names=result_names, pad_to=pad_to,
-                         backend=backend)
+                         backend=backend, auto_cpu_threshold=auto_cpu_threshold,
+                         mesh=mesh)

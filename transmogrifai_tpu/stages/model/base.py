@@ -49,6 +49,9 @@ class PredictorEstimator(Estimator):
     fit_only_inputs = (0,)
     #: hyperparams that can be vmapped (must be accepted as traced floats by fit_fn)
     vmap_params: tuple = ()
+    #: device mesh slot (None = unmeshed): set explicitly via with_mesh, or
+    #: threaded in by Workflow.train's auto-mesh; never serialized
+    mesh = None
 
     @staticmethod
     def fit_fn(X, y, sample_weight=None, **hyper):
@@ -76,9 +79,10 @@ class PredictorEstimator(Estimator):
         y, X = self.label_and_matrix(cols)
         mesh = getattr(self, "mesh", None)
         if mesh is not None:
-            from ...mesh import shard_for_training
+            from ...mesh import record_sharded_dispatch, shard_for_training
 
             X, y = shard_for_training(mesh, X, y)
+            record_sharded_dispatch()
         return self.make_model(self.fit_fn(X, y, **self.fit_kwargs()))
 
     def with_params(self, **overrides) -> "PredictorEstimator":
@@ -125,6 +129,12 @@ class ClassifierEstimator(PredictorEstimator):
         y, X = self.label_and_matrix(cols)
         kw = self.fit_kwargs()
         kw["num_classes"] = kw["num_classes"] or max(int(np.asarray(y).max()) + 1, 2)
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            from ...mesh import record_sharded_dispatch, shard_for_training
+
+            X, y = shard_for_training(mesh, X, y)
+            record_sharded_dispatch()
         return self.make_model(self.fit_fn(X, y, **kw))
 
 

@@ -47,6 +47,10 @@ class AppMetrics:
     profile: Optional[dict] = None
     #: span tree + compile attribution from the obs tracer ({"spans", "compiles"})
     trace: Optional[dict] = None
+    #: multi-chip section: mesh axis sizes plus the run's sharded-placement
+    #: counters (device_put transfers + bytes, psum-carrying dispatches) from
+    #: mesh/mesh.py — None for unmeshed (single-device) runs
+    mesh: Optional[dict] = None
 
     @property
     def app_duration_s(self) -> float:
@@ -65,6 +69,8 @@ class AppMetrics:
             out["profile"] = self.profile
         if self.trace is not None:
             out["trace"] = self.trace
+        if self.mesh is not None:
+            out["mesh"] = self.mesh
         return out
 
 
@@ -116,6 +122,51 @@ def write_table_csv(table: Table, path: str) -> None:
             w.writerow({k: ("" if v is None else v) for k, v in r.items()})
 
 
+def shard_table_rows(mesh, table: Table, min_rows: int = 0) -> Table:
+    """Pre-shard a scoring batch's numeric columns over the mesh DATA_AXIS:
+    the fused scoring program then auto-partitions with its reductions
+    psum'ing over ICI. Host/object columns (text, lists) stay put — host
+    stages consume them before the device layers. Batches smaller than
+    `min_rows`, or whose row count does not divide the data axis, are
+    returned unchanged (sharding a tiny batch costs more in placement than
+    the partitioned program saves)."""
+    from ..mesh import DATA_AXIS, record_sharded_dispatch, shard_batch
+
+    import jax
+
+    n = table.nrows
+    n_data = int(mesh.shape[DATA_AXIS])
+    if n_data <= 1 or n < max(min_rows, n_data) or n % n_data != 0:
+        return table
+    from ..types import Column
+
+    def numeric_array(v):
+        # host numpy OR an already-device-resident array (Column.build's
+        # default): both reshard with one device_put; host object/string
+        # columns stay put for the host stages
+        return (isinstance(v, (np.ndarray, jax.Array))
+                and v.dtype.kind in "fiub")
+
+    out = {}
+    changed = False
+    for name in table.names():
+        c = table[name]
+        v = c.values
+        if numeric_array(v):
+            mask = c.mask
+            if mask is not None and numeric_array(mask):
+                mask = shard_batch(mesh, mask)
+            out[name] = Column(c.kind, shard_batch(mesh, v), mask,
+                               schema=c.schema)
+            changed = True
+        else:
+            out[name] = c
+    if not changed:
+        return table
+    record_sharded_dispatch()
+    return Table(out)
+
+
 class _StreamColumnsPlan:
     """Cached per-raw-feature extraction plan for streamed record batches.
 
@@ -159,6 +210,8 @@ class WorkflowRunner:
         stream_prefetch: int = 2,
         stream_sink_depth: int = 2,
         stream_bucket_floor: int = 64,
+        mesh=None,
+        stream_shard_min_rows: int = 256,
     ):
         self.workflow = workflow
         self.train_reader = train_reader
@@ -180,9 +233,25 @@ class WorkflowRunner:
         #: minimum pad bucket (rounded up to a power of two): trickle arrivals
         #: share one program shape instead of compiling per tiny power of two
         self.stream_bucket_floor = stream_bucket_floor
+        #: explicit device mesh; None resolves per run from OpParams.mesh_shape
+        #: via mesh.default_mesh (auto-mesh over the visible devices — a
+        #: single-device process resolves to no mesh)
+        self.mesh = mesh
+        #: streamed batches at least this many rows (and evenly dividing the
+        #: mesh data axis) land pre-sharded over DATA_AXIS from the producer
+        #: thread; smaller batches stay on one device (sharding a tiny batch
+        #: costs more in placement than the partitioned program saves)
+        self.stream_shard_min_rows = stream_shard_min_rows
         self.evaluator = evaluator
         self.features_to_compute = tuple(features_to_compute)
         self._end_handlers: list[Callable[[AppMetrics], None]] = []
+
+    def _resolve_mesh(self, params: OpParams):
+        if self.mesh is not None:
+            return self.mesh
+        from ..mesh import default_mesh
+
+        return default_mesh(params.mesh_shape)
 
     def add_application_end_handler(self, fn: Callable[[AppMetrics], None]) -> None:
         self._end_handlers.append(fn)
@@ -208,7 +277,13 @@ class WorkflowRunner:
         import contextlib
 
         from .. import obs
+        from ..mesh import mesh_section, mesh_stats
 
+        #: per-run placement counters come from deltas of the process-wide
+        #: mesh counters (concurrent runners in one process would blur them —
+        #: acceptable for a diagnostics section)
+        mesh_stats_before = mesh_stats()
+        self._run_mesh = None
         try:
             if params.collect_stage_metrics or params.log_stage_metrics:
                 trace_dir = params.custom_params.get("trace_dir")
@@ -256,6 +331,8 @@ class WorkflowRunner:
                 metrics.trace["pipeline"] = result.pipeline
         finally:
             metrics.end_time = time.time()
+            metrics.mesh = mesh_section(getattr(self, "_run_mesh", None),
+                                        base=mesh_stats_before)
             for h in self._end_handlers:
                 h(metrics)
         result.metrics_location = result.metrics_location or params.metrics_location
@@ -268,8 +345,11 @@ class WorkflowRunner:
         stages = [f.origin_stage for rf in self.workflow.result_features
                   for f in rf.all_features() if f.origin_stage is not None]
         params.apply_to_stages(stages)
+        mesh = self._resolve_mesh(params)
+        self._run_mesh = mesh
         model = self.workflow.train(checkpoint_dir=params.checkpoint_location,
-                                    strict=not params.lenient_lint)
+                                    strict=not params.lenient_lint,
+                                    mesh=mesh)
         mark("train")
         loc = params.model_location
         from .. import obs
@@ -363,6 +443,8 @@ class WorkflowRunner:
         model = self._load_model(params)
         mark("load_model")
         loc = params.write_location
+        mesh = self._resolve_mesh(params)
+        self._run_mesh = mesh
         # per-raw-feature extraction plan derived ONCE per run: the
         # predictor/response split and kind lookups used to be rebuilt for
         # every batch (pure host-side work on the pipeline's critical path)
@@ -405,10 +487,20 @@ class WorkflowRunner:
                 scored, os.path.join(loc, f"part-{counts['written']:05d}.csv"))
             counts["written"] += 1
 
+        place = None
+        if mesh is not None:
+            def place(item):
+                # producer-thread placement: the batch lands PRE-SHARDED over
+                # the data axis while the device still scores its predecessor
+                n, table = item
+                return n, shard_table_rows(mesh, table,
+                                           self.stream_shard_min_rows)
+
         counts["written"] = 0
         run_pipeline(batches, prepare, compute, sink if loc else None,
                      prefetch=self.stream_prefetch,
-                     sink_depth=self.stream_sink_depth, stats=stats)
+                     sink_depth=self.stream_sink_depth, stats=stats,
+                     place=place)
         mark("streaming_score")
         return RunResult("streaming_score", write_location=loc,
                          n_rows=counts["rows"], batches=stats.batches,

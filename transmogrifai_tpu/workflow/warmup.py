@@ -27,13 +27,20 @@ _PROBLEMS = ("binary", "multiclass", "regression")
 
 def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
            num_classes: int = 3, seed: int = 0, models=None,
-           splitter=None, num_folds: int = 3) -> dict:
+           splitter=None, num_folds: int = 3, mesh="auto") -> dict:
     """Run one full synthetic ModelSelector fit at (rows, bucket_width(width))
     — compiling (and persisting) every program the same-shaped real train
     will need. The width rounds through the SAME bucket function real trains
     pad to (types/vector_schema.bucket_width), so any requested width lands
     on a shape that will actually be used. Returns {problem, rows, width,
-    requested_width, wall_s}."""
+    requested_width, wall_s}.
+
+    `mesh`: a jax Mesh, a 'n_data,n_model' shape string, None (unmeshed), or
+    "auto" (default) — resolve exactly the way Workflow.train does, so the
+    warmed search/refit/metrics programs carry the SAME shardings the real
+    meshed train will compile (a partitioned program is a different
+    executable; warming only the single-device shapes would leave a mesh
+    train cold)."""
     import jax.numpy as jnp
 
     from ..graph import FeatureBuilder
@@ -49,6 +56,10 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     if problem not in _PROBLEMS:
         raise ValueError(f"problem must be one of {_PROBLEMS}, got {problem!r}")
     enable_compile_cache()
+    if isinstance(mesh, (str, list, tuple)):  # shape spec, not a Mesh object
+        from ..mesh import default_mesh
+
+        mesh = default_mesh(None if mesh == "auto" else mesh)
     requested = int(width)
     width = bucket_width(requested)
     rng = np.random.default_rng(seed)
@@ -74,6 +85,7 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
 
     label = FeatureBuilder("label", "RealNN").as_response()
     vec = FeatureBuilder("vec", "OPVector").as_predictor()
+    selector.mesh = mesh
     selector(label, vec)
     schema = VectorSchema(tuple(
         SlotInfo("warm", "Real", descriptor=f"w{i}") for i in range(width)))
@@ -113,7 +125,8 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
             solo = ModelSelector(problem_type=problem, metric=selector.metric,
                                  models=[(template, [dict(point)])],
                                  validator=selector.validator,
-                                 splitter=selector.splitter, seed=seed)
+                                 splitter=selector.splitter, seed=seed,
+                                 mesh=mesh)
             solo(FeatureBuilder("label", "RealNN").as_response(),
                  FeatureBuilder("vec", "OPVector").as_predictor())
             solo.fit_table(table)
@@ -150,13 +163,16 @@ def warmup_matrix(problems: Sequence[str] = ("binary",),
                   splitter=None,
                   num_folds: int = 3,
                   splitter_fraction=None,
+                  mesh_shape=None,
                   log=print) -> list[dict]:
     """Warm every (problem, width) combination; returns the per-cell reports.
 
     splitter=None warms with each problem's DEFAULT splitter (balancer for
     binary, cutter for multiclass — shape fidelity: the real train uses these,
     and the cutter's label remap changes class-axis shapes); splitter_fraction
-    overrides only its holdout fraction."""
+    overrides only its holdout fraction. mesh_shape warms the sharded program
+    shapes for that layout (None = the same auto-mesh Workflow.train uses)."""
+    mesh = "auto" if mesh_shape is None else mesh_shape
     out = []
     for p in problems:
         sp = splitter
@@ -168,7 +184,7 @@ def warmup_matrix(problems: Sequence[str] = ("binary",),
         for w in widths:
             rep = warmup(problem=p, rows=rows, width=int(w),
                          num_classes=num_classes, models=models,
-                         splitter=sp, num_folds=num_folds)
+                         splitter=sp, num_folds=num_folds, mesh=mesh)
             log(f"warmed {p} rows={rows} width={w}: {rep['wall_s']}s")
             out.append(rep)
     return out

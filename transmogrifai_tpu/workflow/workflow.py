@@ -210,6 +210,17 @@ class Workflow(WorkflowCore):
         super().__init__()
         self._raw_filter = None  # RawFeatureFilter, wired via with_raw_feature_filter
         self._workflow_cv = False
+        self._mesh = None  # device mesh, wired via with_mesh (None = auto)
+
+    def with_mesh(self, mesh) -> "Workflow":
+        """Pin the device mesh multi-chip execution uses (mesh/mesh.py). By
+        default train() builds one automatically from the visible devices
+        (auto_mesh: all devices on the data axis; single-device processes get
+        none and run exactly the unmeshed path) — this override picks the
+        (data x model) layout explicitly, e.g. make_mesh(n_data=4, n_model=2).
+        TT_AUTO_MESH=0 disables only the implicit mesh, never this one."""
+        self._mesh = mesh
+        return self
 
     def with_workflow_cv(self) -> "Workflow":
         """Workflow-level cross-validation (reference OpWorkflow.withWorkflowCV +
@@ -294,7 +305,8 @@ class Workflow(WorkflowCore):
     def train(self, table: Optional[Table] = None,
               sanitize: bool = False,
               checkpoint_dir: Optional[str] = None,
-              strict: bool = True) -> "WorkflowModel":
+              strict: bool = True,
+              mesh=None) -> "WorkflowModel":
         """Fit all estimator stages layer by layer; bulk-apply transformers between fit
         points (analog of OpWorkflow.train -> FitStagesUtil.fitAndTransformDAG).
 
@@ -315,11 +327,19 @@ class Workflow(WorkflowCore):
         restart (different data or graph invalidates it). Mid-search selector
         state, by contrast, is deleted at train end: replaying a finished
         search from partial units is not a restore, so the next train searches
-        fresh."""
+        fresh.
+
+        `mesh` pins the device mesh for this train; None resolves to the
+        workflow's with_mesh() mesh, falling back to the auto-mesh over every
+        visible device (mesh/mesh.py default_mesh — a single-device process
+        resolves to no mesh and runs exactly the historical path). The mesh is
+        threaded into every mesh-capable estimator (ModelSelector search +
+        winner refit, SanityChecker stats, predictor fits)."""
         from .. import obs
 
         with obs.span("workflow:train"):
-            return self._train_impl(table, sanitize, checkpoint_dir, strict)
+            return self._train_impl(table, sanitize, checkpoint_dir, strict,
+                                    mesh=mesh)
 
     def _analyze(self, strict: bool):
         """Static plan analysis (analyze/ — `oplint`) before ANY data or device
@@ -345,12 +365,18 @@ class Workflow(WorkflowCore):
 
     def _train_impl(self, table: Optional[Table], sanitize: bool,
                     checkpoint_dir: Optional[str],
-                    strict: bool = True) -> "WorkflowModel":
+                    strict: bool = True, mesh=None) -> "WorkflowModel":
         if not self.result_features:
             raise ValueError("set_result_features first")
         if table is not None:
             self.set_input_table(table)
         analysis = self._analyze(strict)
+        if mesh is None:
+            mesh = self._mesh
+        if mesh is None:
+            from ..mesh import default_mesh
+
+            mesh = default_mesh()
         data = self._generate_raw()
         if sanitize:
             from ..utils.sanitize import check_stages
@@ -403,6 +429,15 @@ class Workflow(WorkflowCore):
             warm = getattr(self, "_warm_stages", {})
             for est in estimators:
                 is_selector = est.operation_name == "modelSelector"
+                # mesh threading: any mesh-capable estimator (one exposing a
+                # `mesh` slot — ModelSelector, SanityChecker, bare predictor
+                # stages) trains over this train's mesh. A user-attached mesh
+                # (with_mesh on the stage) wins; workflow-threaded ones are
+                # marked so a later train re-threads (or clears) them.
+                if hasattr(est, "mesh") and (
+                        est.mesh is None or getattr(est, "_mesh_auto", False)):
+                    est.mesh = mesh
+                    est._mesh_auto = True
                 if is_selector:
                     # clear up-front: a stale closure from a previous with_workflow_cv
                     # train would otherwise replay the per-fold path against the wrong
@@ -613,15 +648,18 @@ class WorkflowModel(WorkflowCore):
     # --- serving (analog of OpWorkflowModelLocal.scoreFunction) -----------------------
     def score_fn(self, result_names: Optional[Sequence[str]] = None,
                  pad_to: Optional[Sequence[int]] = None,
-                 backend: Optional[str] = None):
+                 backend: Optional[str] = "auto", mesh=None):
         """Spark-free serving callable: dict -> dict for one record, .batch(rows) for
         many, .table(table) columnar; same stage kernels as training, jit-cached
-        (no MLeap-style conversion). backend="cpu" pins the plan to host CPU-JAX
-        in-process — the reference's local-JVM deployment mode (sub-ms/record)."""
+        (no MLeap-style conversion). backend="auto" (default) routes small
+        batches to the in-process host CPU-JAX plan (sub-ms/record — the
+        reference's local-JVM deployment mode) and large ones to the device;
+        backend="cpu"/None pin explicitly. `mesh` row-shards large device-lane
+        batches across chips (serve/scoring.py)."""
         from ..serve.scoring import score_function
 
         return score_function(self, result_names=result_names, pad_to=pad_to,
-                              backend=backend)
+                              backend=backend, mesh=mesh)
 
     # --- insights (analog of OpWorkflowModel.modelInsights / summaryPretty) -----------
     def model_insights(self, feature: Optional[Feature] = None):
