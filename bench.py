@@ -458,6 +458,7 @@ def _run(partial: dict) -> None:
             run_hist,
             run_iris,
             run_mlp,
+            run_monitor_overhead,
             run_streaming_score,
             run_trees,
         )
@@ -479,6 +480,14 @@ def _run(partial: dict) -> None:
             detail["streaming_score"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         partial["streaming_score_rows_per_sec"] = \
             detail["streaming_score"].get("rows_per_sec")
+        # serving drift monitor: streamed scoring with sketch folding on vs
+        # off — the <=5% overhead contract (best-effort like streaming above)
+        try:
+            detail["monitor_overhead"] = run_monitor_overhead()
+        except Exception as e:  # noqa: BLE001
+            detail["monitor_overhead"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        partial["monitor_throughput_retention"] = \
+            detail["monitor_overhead"].get("monitor_throughput_retention")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({
@@ -544,6 +553,11 @@ def _run(partial: dict) -> None:
     if "gbt_scale" in detail:
         s["gbt_hist_mfu"] = detail["gbt_scale"].get("hist_mfu")
         s["gbt_hist_tflops_per_sec"] = detail["gbt_scale"].get("hist_tflops_per_sec")
+    if detail.get("monitor_overhead", {}).get(
+            "monitor_throughput_retention") is not None:
+        mo = detail["monitor_overhead"]
+        s["monitor_throughput_retention"] = mo["monitor_throughput_retention"]
+        s["monitored_rows_per_sec"] = mo["monitored_rows_per_sec"]
     _emit_final(compact)
 
 

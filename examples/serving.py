@@ -88,6 +88,22 @@ def main() -> None:
     arrs = serve.table(big)[pred.name].fetch()
     print(f"columnar: scored {len(arrs['prediction'])} rows in one pass")
 
+    # 6. drift monitoring: train() stamped per-feature baselines into
+    # model.json, so the LOADED model can watch its own scoring traffic.
+    # In-distribution traffic stays silent; a mean-shifted feed alerts.
+    from transmogrifai_tpu.obs.monitor import DriftThresholds, ServingMonitor
+
+    monitor = ServingMonitor.for_model(
+        served, thresholds=DriftThresholds(min_rows=128))
+    monitored = served.score_fn(backend="cpu", monitor=monitor)
+    monitored.batch([{k: v for k, v in r.items() if k != "label"}
+                     for r in rows(256, seed=13)])          # in-distribution
+    drifted = [{"age": float(a), "income": None, "plan": "enterprise"}
+               for a in np.random.default_rng(3).uniform(95, 120, size=256)]
+    monitored.batch(drifted)                                # shifted feed
+    monitor.check()
+    print(monitor.pretty())
+
 
 if __name__ == "__main__":
     main()

@@ -58,6 +58,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 gate (-m 'not slow'): "
         "sleep-based overlap assertions and other wall-clock-heavy checks")
+    config.addinivalue_line(
+        "markers", "monitor: serving drift-monitor end-to-end tests "
+        "(train -> stamp baseline -> score -> alert); filterable in the "
+        "fake-8-device lane with -m 'not monitor' mirroring `slow`")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,242 @@
+"""Metrics registry (obs/metrics.py): instrument semantics, exact
+percentiles, Prometheus exposition validity, thread safety (including under
+the input pipeline's producer thread), and the migrated producers — mesh
+placement counters and PipelineStats publication."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.obs import metrics as M
+
+
+def test_counter_monotone_and_labeled_series():
+    reg = M.MetricsRegistry()
+    c = reg.counter("requests_total", help="requests", labels={"lane": "cpu"})
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # same (name, labels) -> same instrument; different labels -> sibling series
+    assert reg.counter("requests_total", labels={"lane": "cpu"}) is c
+    c2 = reg.counter("requests_total", labels={"lane": "device"})
+    assert c2 is not c and c2.value == 0
+    snap = reg.snapshot()["requests_total"]
+    assert snap["kind"] == "counter"
+    assert {tuple(s["labels"].items()) for s in snap["series"]} == {
+        (("lane", "cpu"),), (("lane", "device"),)}
+
+
+def test_kind_collision_rejected():
+    reg = M.MetricsRegistry()
+    reg.counter("x_total")
+    with pytest.raises(TypeError):
+        reg.gauge("x_total")
+    with pytest.raises(TypeError):
+        reg.histogram("x_total")
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", labels={"bad-label": "v"})
+
+
+def test_gauge_set_inc_dec():
+    g = M.MetricsRegistry().gauge("depth")
+    g.set(5)
+    g.inc(2)
+    g.dec(3)
+    assert g.value == 4
+
+
+def test_histogram_exact_percentiles_within_reservoir():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    vals = list(np.linspace(0.01, 2.0, 100))
+    for v in vals:
+        h.observe(v)
+    # exact while count <= reservoir: percentile = ceil-rank order statistic
+    srt = sorted(vals)
+    assert h.percentile(50) == srt[49]
+    assert h.percentile(95) == srt[94]
+    assert h.percentile(99) == srt[98]
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["min"] == srt[0] and snap["max"] == srt[-1]
+    assert snap["p50"] == srt[49] and snap["p99"] == srt[98]
+    # cumulative buckets end at +Inf == count
+    assert snap["buckets"]["+Inf"] == 100
+    assert snap["buckets"]["0.1"] == sum(1 for v in vals if v <= 0.1)
+    assert snap["buckets"]["1"] == sum(1 for v in vals if v <= 1.0)
+
+
+def test_histogram_reservoir_degrades_not_breaks():
+    h = M.MetricsRegistry().histogram("h_seconds", buckets=(1.0,), reservoir=64)
+    for v in np.linspace(0, 1, 1000):
+        h.observe(v)
+    assert h.count == 1000
+    p50 = h.percentile(50)
+    assert 0.2 <= p50 <= 0.8  # uniform sample estimate stays sane
+    h.observe(float("nan"))  # ignored, never poisons the sum
+    assert h.count == 1000 and np.isfinite(h.sum)
+
+
+def test_percentile_none_before_observations():
+    h = M.MetricsRegistry().histogram("empty_seconds")
+    assert h.percentile(50) is None
+    snap = h.snapshot()
+    assert snap["count"] == 0 and snap["p50"] is None and snap["min"] is None
+
+
+def test_prometheus_exposition_valid_and_parsed():
+    reg = M.MetricsRegistry()
+    reg.counter("a_total", help="a counter", labels={"k": "v,with\"quote"}).inc(3)
+    reg.gauge("b_level", help="a gauge").set(1.5)
+    h = reg.histogram("c_seconds", help="a histogram", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    text = reg.to_prometheus()
+    fams = M.parse_prometheus(text)  # raises on malformed output
+    assert fams["a_total"]["type"] == "counter"
+    assert fams["b_level"]["type"] == "gauge"
+    assert fams["c_seconds"]["type"] == "histogram"
+    bucket_lines = [s for s in fams["c_seconds"]["samples"]
+                    if s[0] == "c_seconds_bucket"]
+    assert any('le="+Inf"' in s[1] for s in bucket_lines)
+    count_line = next(s for s in fams["c_seconds"]["samples"]
+                      if s[0] == "c_seconds_count")
+    assert count_line[2] == "2"
+    # snapshot is plain JSON all the way down
+    json.dumps(reg.snapshot())
+
+
+def test_parse_prometheus_rejects_malformed():
+    with pytest.raises(ValueError):
+        M.parse_prometheus("metric_without_value\n")
+    with pytest.raises(ValueError):
+        M.parse_prometheus('m{unterminated="x} 1\n')
+    with pytest.raises(ValueError):
+        M.parse_prometheus("m 1\nm 2\n# TYPE m counter\n# TYPE m counter\n")
+    with pytest.raises(ValueError):
+        M.parse_prometheus("# TYPE h histogram\nh_bucket{le=\"+Inf\"} 1\n")
+    # valid: histogram with all three sample families
+    M.parse_prometheus(
+        "# TYPE h histogram\n"
+        'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 2\nh_sum 3\nh_count 2\n')
+
+
+def test_registry_thread_safety_hammer():
+    reg = M.MetricsRegistry()
+    c = reg.counter("hammer_total")
+    h = reg.histogram("hammer_seconds", buckets=(0.5,))
+    n_threads, per = 8, 500
+
+    def work(tid):
+        g = reg.gauge("hammer_gauge", labels={"t": str(tid)})
+        for i in range(per):
+            c.inc()
+            h.observe(i / per)
+            g.set(i)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per
+    assert h.count == n_threads * per
+    assert h.snapshot()["buckets"]["+Inf"] == n_threads * per
+    M.parse_prometheus(reg.to_prometheus())
+
+
+def test_registry_updates_from_prefetcher_producer_thread():
+    """The input pipeline's producer thread publishes into the registry while
+    the consumer reads snapshots — no torn counts, no exposition errors."""
+    from transmogrifai_tpu.readers.pipeline import Prefetcher
+
+    reg = M.default_registry()
+    c = reg.counter("producer_probe_total")
+    before = c.value
+
+    def prep(i):
+        c.inc()
+        reg.histogram("producer_probe_seconds").observe(i * 1e-4)
+        return i * 2
+
+    with Prefetcher(range(64), prep, depth=3) as pf:
+        out = list(pf)
+    assert out == [i * 2 for i in range(64)]
+    assert c.value == before + 64
+    M.parse_prometheus(reg.to_prometheus())
+
+
+def test_mesh_counters_live_in_registry():
+    """mesh/mesh.py's ad-hoc stats dict is gone: record_transfer lands on
+    mesh_transfers_total/mesh_transfer_bytes_total, and the historical
+    mesh_stats()/reset_mesh_stats() delta surface still works on top."""
+    from transmogrifai_tpu import mesh as mesh_mod
+
+    mesh_mod.reset_mesh_stats()
+    base = M.default_registry().counter("mesh_transfers_total").value
+    mesh_mod.mesh.record_transfer(np.zeros(16, np.float32))
+    mesh_mod.mesh.record_sharded_dispatch(2)
+    stats = mesh_mod.mesh.mesh_stats()
+    assert stats["transfers"] == 1
+    assert stats["transfer_bytes"] == 64
+    assert stats["sharded_dispatches"] == 2
+    assert M.default_registry().counter("mesh_transfers_total").value == base + 1
+    mesh_mod.reset_mesh_stats()
+    assert mesh_mod.mesh.mesh_stats()["transfers"] == 0
+
+
+def test_pipeline_stats_publish_into_registry():
+    from transmogrifai_tpu.readers.pipeline import PipelineStats, run_pipeline
+
+    reg = M.default_registry()
+    before = reg.counter("pipeline_batches_total").value
+    stats = PipelineStats()
+    run_pipeline(range(5), lambda x: x + 1, lambda x: x * 2,
+                 prefetch=2, stats=stats)
+    assert stats.batches == 5
+    assert reg.counter("pipeline_batches_total").value == before + 5
+    # idempotent: publish again is a no-op
+    stats.publish()
+    assert reg.counter("pipeline_batches_total").value == before + 5
+    # sync path publishes too
+    stats2 = run_pipeline(range(3), None, lambda x: x, prefetch=0)
+    assert reg.counter("pipeline_batches_total").value == before + 8
+    assert stats2.batches == 3
+
+
+def test_serve_routing_counter_and_latency_histogram():
+    """ScoreFunction routing decisions + per-backend latency land in the
+    registry (serve_routing_total / serve_latency_seconds)."""
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(3)
+    rows = [{"label": float(rng.random() > 0.5),
+             "x0": float(rng.normal()), "x1": float(rng.normal())}
+            for _ in range(64)]
+    fs = features_from_schema(
+        {"label": "RealNN", "x0": "Real", "x1": "Real"}, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["x0"], fs["x1"]]))
+    model = Workflow().set_result_features(pred).train(
+        table=InMemoryReader(rows).generate_table(list(fs.values())))
+
+    reg = M.default_registry()
+    routing = reg.counter("serve_routing_total",
+                          labels={"backend": "cpu", "decided": "explicit"})
+    before = routing.value
+    fn = model.score_fn(backend="cpu")
+    fn.batch([{"x0": 0.1, "x1": -0.2}] * 4)
+    assert routing.value == before + 1
+    lat = reg.histogram("serve_latency_seconds", labels={"backend": "cpu"})
+    assert lat.count >= 1 and lat.percentile(50) > 0
+    M.parse_prometheus(reg.to_prometheus())

@@ -1,0 +1,382 @@
+"""Serving drift monitor (obs/monitor.py): baseline stamping through
+train/save/load, sketch round-trips, drift alerting on shifted traffic with a
+silent in-distribution control, ScoreFunction/streaming-runner wiring, thread
+safety under the input pipeline's producer thread, and the `op monitor` CLI.
+End-to-end train->serve tests carry the `monitor` marker (filterable in the
+fake-8-device lane like `slow`)."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.obs import metrics as M
+from transmogrifai_tpu.obs.monitor import (
+    DriftThresholds,
+    ServingMonitor,
+    baseline_from_json,
+    baseline_to_json,
+    demo_monitor,
+)
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+SCHEMA = {"label": "RealNN", "age": "Real", "fare": "Real", "sex": "PickList"}
+
+
+def _rows(n, seed=0, shift=0.0, missing=0.0, labeled=True):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        r = {
+            "age": (None if rng.random() < missing
+                    else float(rng.normal(30 + shift, 5))),
+            "fare": float(rng.normal(50, 10)),
+            "sex": "m" if rng.random() > 0.4 else "f",
+        }
+        if labeled:
+            r["label"] = float(rng.random() > 0.5)
+        out.append(r)
+    return out
+
+
+def _train(rows=None):
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"],
+        transmogrify([fs["age"], fs["fare"], fs["sex"]]))
+    wf = Workflow().set_result_features(pred)
+    table = InMemoryReader(rows or _rows(600)).generate_table(list(fs.values()))
+    return wf.train(table=table)
+
+
+# --- baseline stamping ------------------------------------------------------------------
+def test_train_stamps_serving_baseline():
+    model = _train()
+    assert sorted(model.serving_baseline) == ["age", "fare", "sex"]
+    age = model.serving_baseline["age"]
+    assert age.count == 600 and age.fill_rate == 1.0
+    assert age.bin_edges is not None  # numeric features keep edges for serving
+    assert model.serving_baseline["sex"].bin_edges is None  # hashed buckets
+
+
+def test_with_serving_baseline_disable_and_tune():
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["age"], fs["fare"], fs["sex"]]))
+    table = InMemoryReader(_rows(200)).generate_table(list(fs.values()))
+    off = (Workflow().set_result_features(pred)
+           .with_serving_baseline(enabled=False).train(table=table))
+    assert off.serving_baseline == {}
+    tuned = (Workflow().set_result_features(pred)
+             .with_serving_baseline(bins=16, sample_rows=100).train(table=table))
+    assert len(tuned.serving_baseline["age"].histogram) == 16
+    assert tuned.serving_baseline["age"].count == 100  # sampled pass
+
+
+def test_baseline_json_round_trip():
+    model = _train()
+    doc = baseline_to_json(model.serving_baseline)
+    json.dumps(doc)  # plain JSON
+    back = baseline_from_json(doc)
+    for name, d in model.serving_baseline.items():
+        b = back[name]
+        assert (b.count, b.null_count, b.kind) == (d.count, d.null_count, d.kind)
+        np.testing.assert_allclose(b.histogram, d.histogram)
+        if d.bin_edges is None:
+            assert b.bin_edges is None
+        else:
+            np.testing.assert_allclose(b.bin_edges, d.bin_edges)
+
+
+@pytest.mark.monitor
+def test_model_save_load_reserve_identical_sketches(tmp_path):
+    """save -> load -> re-serve: the loaded model's monitor folds the same
+    scoring stream into bit-identical sketches (same edges, same counts)."""
+    model = _train()
+    model.save(str(tmp_path / "m"), overwrite=True)
+    loaded = WorkflowModel.load(str(tmp_path / "m"))
+    scoring = _rows(300, seed=9, labeled=False)
+
+    def serve(m):
+        mon = ServingMonitor.for_model(
+            m, registry=M.MetricsRegistry(),
+            thresholds=DriftThresholds(min_rows=100))
+        fn = m.score_fn(backend="cpu", monitor=mon)
+        fn.batch(scoring)
+        return mon
+
+    a, b = serve(model), serve(loaded)
+    assert sorted(a.sketches) == sorted(b.sketches)
+    for name in a.sketches:
+        sa, sb = a.sketches[name], b.sketches[name]
+        assert (sa.count, sa.null_count) == (sb.count, sb.null_count)
+        np.testing.assert_allclose(sa.histogram, sb.histogram)
+    ra, rb = a.report(), b.report()
+    assert ra["features"] == rb["features"]
+
+
+# --- drift detection --------------------------------------------------------------------
+@pytest.mark.monitor
+def test_drift_fires_on_shift_and_stays_silent_in_distribution():
+    model = _train()
+    th = DriftThresholds(min_rows=128, max_js_divergence=0.25,
+                         max_fill_delta=0.15)
+
+    # control: same distribution as training -> ZERO alerts
+    control = ServingMonitor.for_model(model, registry=M.MetricsRegistry(),
+                                       thresholds=th)
+    fn = model.score_fn(backend="cpu", monitor=control)
+    for seed in (21, 22, 23):
+        fn.batch(_rows(200, seed=seed, labeled=False))
+    control.check()
+    assert control.alerts == []
+    assert control.report()["active_alerts"] == []
+
+    # mean-shifted age + degraded fill -> structured alerts on age only
+    reg = M.MetricsRegistry()
+    drifted = ServingMonitor.for_model(model, registry=reg, thresholds=th)
+    fn2 = model.score_fn(backend="cpu", monitor=drifted)
+    for seed in (31, 32, 33):
+        fn2.batch(_rows(200, seed=seed, shift=40.0, missing=0.5,
+                        labeled=False))
+    new = drifted.check()
+    kinds = {(a.feature, a.kind) for a in drifted.alerts}
+    assert ("age", "js_divergence") in kinds
+    assert ("age", "fill_rate") in kinds
+    assert all(a.feature == "age" for a in drifted.alerts)
+    for a in drifted.alerts:
+        assert a.value > a.threshold and a.rows_seen >= th.min_rows
+        assert "age" in a.message
+    # alerts are edge-triggered: a second check with no recovery adds nothing
+    assert drifted.check() == []
+    assert len(new) <= len(drifted.alerts)
+    # counters + gauges landed in the registry
+    assert reg.counter("serving_drift_alerts_total",
+                       labels={"feature": "age",
+                               "kind": "js_divergence"}).value == 1
+    assert reg.gauge("serving_js_divergence",
+                     labels={"feature": "age"}).value > th.max_js_divergence
+
+
+def test_min_rows_gate_suppresses_early_alerts():
+    model = _train()
+    mon = ServingMonitor.for_model(
+        model, registry=M.MetricsRegistry(),
+        thresholds=DriftThresholds(min_rows=10_000))
+    fn = model.score_fn(backend="cpu", monitor=mon)
+    fn.batch(_rows(100, seed=5, shift=40.0, labeled=False))
+    mon.check()
+    assert mon.alerts == []  # wildly drifted but under the min_rows gate
+
+
+def test_monitor_never_raises_on_garbage():
+    mon = demo_monitor(registry=M.MetricsRegistry())
+    errors = mon._errors_c.value
+    mon.observe_table(object())        # not a table
+    mon.observe_rows([{"x": object()}])  # unbuildable values
+    assert mon._errors_c.value >= errors  # swallowed, counted, never raised
+
+
+def test_row_sampling_caps_fold_cost():
+    model = _train()
+    mon = ServingMonitor.for_model(model, registry=M.MetricsRegistry(),
+                                   max_rows_per_batch=64)
+    mon.observe_rows(_rows(512, seed=7, labeled=False))
+    assert mon.sketches["age"].count == 64  # stride-sampled, not 512
+    uncapped = ServingMonitor.for_model(model, registry=M.MetricsRegistry(),
+                                        max_rows_per_batch=None)
+    uncapped.observe_rows(_rows(512, seed=7, labeled=False))
+    assert uncapped.sketches["age"].count == 512
+
+
+def test_for_model_requires_baseline():
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["age"], fs["fare"], fs["sex"]]))
+    table = InMemoryReader(_rows(200)).generate_table(list(fs.values()))
+    bare = (Workflow().set_result_features(pred)
+            .with_serving_baseline(enabled=False).train(table=table))
+    with pytest.raises(ValueError, match="serving_baseline"):
+        ServingMonitor.for_model(bare)
+
+
+# --- serving integration ----------------------------------------------------------------
+@pytest.mark.monitor
+def test_score_fn_stream_folds_on_producer_thread():
+    """ScoreFunction.stream observes on the Prefetcher's producer thread —
+    sketches and registry must stay consistent under that concurrency."""
+    model = _train()
+    reg = M.MetricsRegistry()
+    mon = ServingMonitor.for_model(model, registry=reg,
+                                   thresholds=DriftThresholds(min_rows=64),
+                                   max_rows_per_batch=None)
+    fn = model.score_fn(backend="cpu", monitor=mon)
+    batches = [_rows(64, seed=40 + i, labeled=False) for i in range(8)]
+    pipeline_batches = M.default_registry().counter("pipeline_batches_total")
+    published_before = pipeline_batches.value
+    out = list(fn.stream(iter(batches), prefetch=3))
+    assert [len(b) for b in out] == [64] * 8
+    assert mon.batches == 8 and mon.rows == 8 * 64
+    # the stream's Prefetcher publishes its PipelineStats at drain
+    assert pipeline_batches.value == published_before + 8
+    assert mon.sketches["age"].count == 8 * 64
+    M.parse_prometheus(reg.to_prometheus())
+    # parity: the streamed fold equals one synchronous fold of the same rows
+    flat = [r for b in batches for r in b]
+    sync = ServingMonitor.for_model(model, registry=M.MetricsRegistry(),
+                                    max_rows_per_batch=None)
+    sync.observe_rows(flat)
+    np.testing.assert_allclose(sync.sketches["age"].histogram,
+                               mon.sketches["age"].histogram)
+
+
+@pytest.mark.monitor
+def test_streaming_runner_monitor_end_to_end(tmp_path):
+    """`op run --type streaming_score --monitor` shape: drift report rides
+    RunResult.monitor, alerts fire on a shifted stream, AppMetrics carries
+    the unified metrics section."""
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import BatchStreamingReader
+    from transmogrifai_tpu.workflow import WorkflowRunner
+
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["age"], fs["fare"], fs["sex"]]))
+    wf = Workflow().set_result_features(pred)
+    runner = WorkflowRunner(
+        wf, train_reader=InMemoryReader(_rows(600)),
+        streaming_reader=BatchStreamingReader(
+            [_rows(256, seed=60 + i, shift=40.0, missing=0.5, labeled=False)
+             for i in range(4)]))
+    captured = []
+    runner.add_application_end_handler(captured.append)
+    runner.run("train", OpParams())
+    res = runner.run("streaming_score",
+                     OpParams(write_location=str(tmp_path / "parts"),
+                              monitor=True))
+    assert res.n_rows == 4 * 256
+    assert res.monitor is not None
+    assert res.monitor["rows"] > 0
+    assert any(a["feature"] == "age" for a in res.monitor["alerts"])
+    app = captured[-1]
+    assert app.metrics is not None  # unified metrics section
+    assert "serving_monitor_rows_total" in app.metrics
+    assert "serving_js_divergence" in app.metrics
+    d = app.to_dict()
+    assert "metrics" in d and json.dumps(d["metrics"])
+
+
+@pytest.mark.monitor
+def test_score_runner_monitor(tmp_path):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.workflow import WorkflowRunner
+
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["age"], fs["fare"], fs["sex"]]))
+    wf = Workflow().set_result_features(pred)
+    # labeled scoring rows: InMemoryReader builds every declared column, and
+    # the RealNN response cannot be all-missing (matching `score` run usage)
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(_rows(600)),
+                            score_reader=InMemoryReader(_rows(400, seed=70)))
+    runner.run("train", OpParams())
+    res = runner.run("score", OpParams(monitor=True))
+    assert res.monitor is not None and res.monitor["rows"] > 0
+    assert {f["feature"] for f in res.monitor["features"]} == \
+        {"age", "fare", "sex"}
+    # in-distribution scoring table: silent
+    assert res.monitor["alerts"] == []
+
+
+def test_monitor_requires_baseline_when_requested():
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.workflow import WorkflowRunner
+
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["age"], fs["fare"], fs["sex"]]))
+    wf = (Workflow().set_result_features(pred)
+          .with_serving_baseline(enabled=False))
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(_rows(200)),
+                            score_reader=InMemoryReader(
+                                _rows(50, labeled=False)))
+    runner.run("train", OpParams())
+    with pytest.raises(ValueError, match="serving_baseline"):
+        runner.run("score", OpParams(monitor=True))
+
+
+# --- demo + CLI -------------------------------------------------------------------------
+def test_demo_monitor_fires_and_exports():
+    reg = M.MetricsRegistry()
+    mon = demo_monitor(registry=reg)
+    rep = mon.report()
+    assert rep["alerts"], "demo must fire at least one alert"
+    assert {f["feature"] for f in rep["features"]} == {"x", "y", "cat"}
+    M.parse_prometheus(reg.to_prometheus())
+
+
+def test_cli_monitor_model_and_json(tmp_path, capsys):
+    from transmogrifai_tpu.cli.main import main as cli_main
+
+    model = _train()
+    model.save(str(tmp_path / "m"), overwrite=True)
+    rc = cli_main(["monitor", "--model", str(tmp_path / "m"), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["thresholds"]["max_js_divergence"] == 0.25
+    assert doc["batches"] == 0  # baseline inspection only, nothing observed
+
+
+@pytest.mark.monitor
+def test_cli_monitor_scoring_csv_flags_shift(tmp_path, capsys):
+    """`op monitor --model DIR --scoring CSV`: offline fold of a scoring file
+    (every row, device fetch allowed) flags a mean-shifted column and
+    --fail-on-drift gates on it."""
+    import csv as _csv
+
+    from transmogrifai_tpu.cli.main import main as cli_main
+
+    model = _train()
+    model.save(str(tmp_path / "m"), overwrite=True)
+    path = tmp_path / "scoring.csv"
+    rng = np.random.default_rng(8)
+    with open(path, "w", newline="") as fh:
+        w = _csv.DictWriter(fh, fieldnames=["age", "fare", "sex"])
+        w.writeheader()
+        for _ in range(300):
+            w.writerow({"age": float(rng.normal(90, 5)),  # shifted
+                        "fare": float(rng.normal(50, 10)),
+                        "sex": "m" if rng.random() > 0.4 else "f"})
+    rc = cli_main(["monitor", "--model", str(tmp_path / "m"),
+                   "--scoring", str(path), "--json", "--fail-on-drift"])
+    assert rc == 3
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rows"] == 300  # offline path folds every row
+    age = next(f for f in doc["features"] if f["feature"] == "age")
+    assert age["js_divergence"] > 0.25
+    assert any(a["feature"] == "age" for a in doc["alerts"])
+    fare = next(f for f in doc["features"] if f["feature"] == "fare")
+    assert fare["js_divergence"] < 0.25  # in-distribution column stays quiet
+
+
+def test_cli_monitor_demo_prom_parses(capsys):
+    from transmogrifai_tpu.cli.main import main as cli_main
+
+    rc = cli_main(["monitor", "--demo", "--prom"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    fams = M.parse_prometheus(text)
+    assert "serving_js_divergence" in fams
+    assert "serving_drift_alerts_total" in fams
+
+
+def test_cli_monitor_fail_on_drift(capsys):
+    from transmogrifai_tpu.cli.main import main as cli_main
+
+    rc = cli_main(["monitor", "--demo", "--fail-on-drift"])
+    assert rc == 3  # the demo drifts by construction
+    capsys.readouterr()

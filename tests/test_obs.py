@@ -124,6 +124,32 @@ def test_chrome_export_schema(tmp_path):
     assert a["ts"] <= b["ts"] and b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e3
 
 
+def test_chrome_export_carries_span_events(tmp_path):
+    """Span events (add_event: oplint findings, serve:routing decisions,
+    drift alerts) must land in the Chrome trace as instant events — they used
+    to be silently dropped, making run decisions invisible in the timeline."""
+    with obs.trace() as t:
+        with obs.span("serving"):
+            obs.add_event("serve:routing", backend="cpu", rows=4,
+                          decided="auto")
+            obs.add_event("drift", feature="age", kind="js_divergence")
+    path = t.export_chrome(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    inst = [e for e in doc["traceEvents"] if e.get("cat") == "event"]
+    assert {e["name"] for e in inst} == {"serve:routing", "drift"}
+    routing = next(e for e in inst if e["name"] == "serve:routing")
+    assert routing["ph"] == "i"
+    assert routing["args"]["backend"] == "cpu" and routing["args"]["rows"] == 4
+    assert routing["args"]["span"].endswith("serving")
+    # placed on the timeline via the event's own t_s stamp
+    serving = next(e for e in doc["traceEvents"]
+                   if e.get("cat") == "span" and e["name"] == "serving")
+    assert serving["ts"] <= routing["ts"] <= serving["ts"] + serving["dur"] + 1e3
+    # ...and the report shape carries the stamp too
+    ev = t.report()["spans"]["children"][0]["events"][0]
+    assert ev["name"] == "serve:routing" and ev["t_s"] >= 0
+
+
 def test_text_tree_one_screen():
     with obs.trace() as t:
         with obs.span("phase_one"):

@@ -185,3 +185,34 @@ def test_failed_blacklist_leaves_graph_intact_for_retry():
            .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.0)))
     model = wf2.train()
     assert model.blacklisted == ()
+
+
+def test_js_divergence_guards_degenerate_count_vectors():
+    """A feature 100% missing in one table yields an all-zero histogram; the
+    divergence must pin to 0.0 (no distribution-shape evidence — missingness
+    is the fill-rate checks' job), never NaN or a spurious 0.5. Same for
+    empty, mismatched, and non-finite inputs."""
+    from transmogrifai_tpu.filter.raw_feature_filter import _js_divergence
+
+    full = np.array([3.0, 2.0, 5.0, 1.0])
+    assert _js_divergence(np.zeros(4), full) == 0.0
+    assert _js_divergence(full, np.zeros(4)) == 0.0
+    assert _js_divergence(np.zeros(4), np.zeros(4)) == 0.0
+    assert _js_divergence(np.array([]), np.array([])) == 0.0
+    assert _js_divergence(full, np.array([1.0, 2.0])) == 0.0  # length mismatch
+    nan_counts = np.array([np.nan, 1.0, 2.0, 1.0])
+    assert _js_divergence(nan_counts, full) == 0.0
+    assert np.isfinite(_js_divergence(full, full))
+    assert _js_divergence(full, full) == pytest.approx(0.0, abs=1e-12)
+    # genuinely disjoint distributions still read as maximal divergence
+    assert _js_divergence(np.array([1.0, 0.0]), np.array([0.0, 1.0])) == \
+        pytest.approx(1.0, abs=1e-9)
+
+
+def test_feature_distribution_js_uses_guard():
+    a = FeatureDistribution(name="x", kind="Real", count=10, null_count=10,
+                            histogram=np.zeros(8))
+    b = FeatureDistribution(name="x", kind="Real", count=10, null_count=0,
+                            histogram=np.ones(8))
+    assert a.js_divergence(b) == 0.0
+    assert b.js_divergence(a) == 0.0

@@ -30,7 +30,7 @@ _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99")
 #: overrides: fragments that look like seconds but are throughput/quality
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",
-                  "tflops", "flops", "efficiency")
+                  "tflops", "flops", "efficiency", "retention")
 
 
 def lower_is_better(name: str) -> bool:

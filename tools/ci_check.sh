@@ -23,6 +23,25 @@ for app in examples.iris:make_runner examples.titanic:make_runner; do
     python -m transmogrifai_tpu.cli.main lint --app "$app"
 done
 
+echo "== op monitor smoke (metrics exposition lint) =="
+# the built-in drift demo exercises every serving_* instrument with no data
+# dependency; the exposition must parse as valid Prometheus text format
+# (parse_prometheus is the same strict checker the unit tests use)
+python -m transmogrifai_tpu.cli.main monitor --demo --prom > /tmp/_monitor_prom.txt
+python - <<'PY'
+from transmogrifai_tpu.obs.metrics import parse_prometheus
+
+text = open("/tmp/_monitor_prom.txt").read()
+fams = parse_prometheus(text)
+need = {"serving_fill_rate", "serving_js_divergence",
+        "serving_monitor_rows_total", "serving_drift_alerts_total"}
+missing = need - set(fams)
+if missing:
+    raise SystemExit(f"monitor exposition missing families: {sorted(missing)}")
+print(f"monitor exposition ok: {len(fams)} families, "
+      f"{sum(len(f['samples']) for f in fams.values())} samples")
+PY
+
 echo "== multichip mesh smoke =="
 # forced-8-device mesh lane: end-to-end mesh-vs-single-device parity (same
 # winner, same metrics, steady-state retrace_budget(0)) + the multichip

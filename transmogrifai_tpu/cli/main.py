@@ -43,6 +43,11 @@ def _cmd_run(argv) -> int:
                          "'auto' (all visible devices on the data axis — the "
                          "default) or explicit 'n_data,n_model' (e.g. 4,2); "
                          "single-device processes run unmeshed either way")
+    ap.add_argument("--monitor", action="store_true",
+                    help="score/streaming_score: fold scoring batches into "
+                         "feature-drift sketches against the model's stamped "
+                         "serving_baseline and report per-feature fill-rate/"
+                         "JS-divergence + structured drift alerts")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.params import OpParams
@@ -50,6 +55,8 @@ def _cmd_run(argv) -> int:
     params = OpParams.from_json(args.params) if args.params else OpParams()
     if args.lenient_lint:
         params.lenient_lint = True
+    if args.monitor:
+        params.monitor = True
     if args.mesh is not None:
         from transmogrifai_tpu.mesh import parse_mesh_shape
 
@@ -169,6 +176,98 @@ def _cmd_lint(argv) -> int:
     return 1 if report.has_errors else 0
 
 
+def _cmd_monitor(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op monitor",
+        description="serving telemetry: inspect a model's stamped training "
+                    "baseline, fold a scoring table into feature-drift "
+                    "sketches, and export the unified metrics registry "
+                    "(pretty table / --json / Prometheus --prom)")
+    ap.add_argument("--model", default=None, metavar="DIR",
+                    help="saved model directory (model.json carrying "
+                         "'serving_baseline')")
+    ap.add_argument("--scoring", default=None, metavar="CSV",
+                    help="scoring CSV (header row; schema taken from the "
+                         "model's raw features) to fold into the drift "
+                         "sketches")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the built-in synthetic drift demo instead of a "
+                         "model (CI smoke: exercises every serving_* metric "
+                         "with no data dependency)")
+    ap.add_argument("--prom", action="store_true",
+                    help="print the Prometheus text exposition of the "
+                         "metrics registry to stdout")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the structured monitor report as JSON")
+    ap.add_argument("--max-js", type=float, default=None,
+                    help="JS-divergence alert threshold (default 0.25)")
+    ap.add_argument("--max-fill-delta", type=float, default=None,
+                    help="|train-serving| fill-rate alert threshold "
+                         "(default 0.15)")
+    ap.add_argument("--min-rows", type=int, default=None,
+                    help="rows observed before alerts arm (default 256)")
+    ap.add_argument("--fail-on-drift", action="store_true",
+                    help="exit 3 when any drift alert fired (CI gating)")
+    args = ap.parse_args(argv)
+
+    from transmogrifai_tpu.obs.metrics import default_registry
+    from transmogrifai_tpu.obs.monitor import (
+        DriftThresholds,
+        ServingMonitor,
+        demo_monitor,
+    )
+
+    if not args.demo and not args.model:
+        print("op monitor: --model DIR or --demo is required", file=sys.stderr)
+        return 2
+    defaults = DriftThresholds()
+    thresholds = DriftThresholds(
+        max_js_divergence=(args.max_js if args.max_js is not None
+                           else defaults.max_js_divergence),
+        max_fill_delta=(args.max_fill_delta if args.max_fill_delta is not None
+                        else defaults.max_fill_delta),
+        min_rows=(args.min_rows if args.min_rows is not None
+                  else defaults.min_rows))
+    if args.demo:
+        monitor = demo_monitor(thresholds=thresholds)
+    else:
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+        model = WorkflowModel.load(args.model)
+        try:
+            # offline inspection: fold EVERY row (no hot-path sampling cap)
+            # and fetch reader-built device columns freely
+            monitor = ServingMonitor.for_model(model, thresholds=thresholds,
+                                               max_rows_per_batch=None)
+        except ValueError as e:
+            print(f"op monitor: {e}", file=sys.stderr)
+            return 2
+        if args.scoring:
+            from transmogrifai_tpu.readers import CSVReader
+
+            predictors = [f for f in model.raw_features if not f.is_response]
+            reader = CSVReader(args.scoring,
+                               {f.name: f.kind.name for f in predictors})
+            monitor.observe_table(reader.generate_table(predictors),
+                                  allow_device_fetch=True)
+            monitor.check()
+
+    report = monitor.report()
+    if args.prom:
+        print(default_registry().to_prometheus(), end="")
+    elif args.as_json:
+        import json
+
+        print(json.dumps(report, indent=1, default=float))
+    else:
+        print(monitor.pretty())
+    if args.fail_on_drift and report["alerts"]:
+        print(f"op monitor: {len(report['alerts'])} drift alert(s)",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
 def _cmd_warmup(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="op warmup",
@@ -251,6 +350,9 @@ def main(argv=None) -> int:
             "  gen       scaffold a project from a CSV (--input --id --response)\n"
             "  lint      statically analyze an app's plan "
             "(--app module:fn [--json] [--rules])\n"
+            "  monitor   serving telemetry: drift report vs the model's "
+            "training baseline + metrics export (--model DIR [--scoring CSV] "
+            "| --demo) [--prom|--json]\n"
             "  warmup    pre-seed the compile cache for planned train shapes\n"
             "  version   print framework version"
         )
@@ -265,6 +367,8 @@ def main(argv=None) -> int:
         return _cmd_gen(rest)
     if cmd == "lint":
         return _cmd_lint(rest)
+    if cmd == "monitor":
+        return _cmd_monitor(rest)
     if cmd == "warmup":
         return _cmd_warmup(rest)
     print(f"op: unknown command {cmd!r}", file=sys.stderr)

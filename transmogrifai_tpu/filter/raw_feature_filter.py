@@ -43,9 +43,22 @@ def _stable_hash(s: str) -> int:
 
 
 def _js_divergence(p: np.ndarray, q: np.ndarray) -> float:
-    """Jensen-Shannon divergence (log base 2 -> [0, 1]) between two count vectors."""
-    p = p / max(p.sum(), _EPS)
-    q = q / max(q.sum(), _EPS)
+    """Jensen-Shannon divergence (log base 2 -> [0, 1]) between two count vectors.
+
+    Degenerate inputs are guarded to 0.0: empty vectors, mismatched lengths,
+    and all-zero or non-finite-sum counts (a feature 100% missing in one of
+    the two tables yields an all-zero histogram; NaN counts would otherwise
+    propagate a NaN total). Missingness itself is the fill-rate checks' job —
+    "no mass observed" carries no distribution-shape evidence."""
+    p = np.asarray(p, np.float64)
+    q = np.asarray(q, np.float64)
+    if p.size == 0 or p.shape != q.shape:
+        return 0.0
+    ps, qs = float(p.sum()), float(q.sum())
+    if not (np.isfinite(ps) and np.isfinite(qs)) or ps <= 0 or qs <= 0:
+        return 0.0
+    p = p / ps
+    q = q / qs
     m = 0.5 * (p + q)
 
     def kl(a, b):

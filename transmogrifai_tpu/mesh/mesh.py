@@ -30,39 +30,65 @@ MODEL_AXIS = "model"
 
 
 # --- mesh observability ---------------------------------------------------------------
-#: process-wide counters of mesh-placement work: every sharded/replicated
-#: device_put issued through the helpers below (count + bytes) and every
-#: dispatch of a program whose reductions psum over the mesh (recorded by the
-#: sharded callers: validator search units, sanity/stats passes, sharded
-#: scoring batches). The runner snapshots deltas around a run and reports them
-#: in AppMetrics' `mesh` section next to the tracer's span tree.
+# Process-wide counters of mesh-placement work: every sharded/replicated
+# device_put issued through the helpers below (count + bytes) and every
+# dispatch of a program whose reductions psum over the mesh (recorded by the
+# sharded callers: validator search units, sanity/stats passes, sharded
+# scoring batches). The counters LIVE in the unified metrics registry
+# (obs/metrics.py — `mesh_*` series in `op monitor --prom` and AppMetrics'
+# `metrics` section); mesh_stats()/reset_mesh_stats() keep the historical
+# per-run-delta surface the runner's `mesh` section is built from.
+from ..obs import metrics as _obs_metrics
+
+_MESH_COUNTERS = {
+    "transfers": ("mesh_transfers_total",
+                  "sharded/replicated device_put placements issued by mesh "
+                  "helpers"),
+    "transfer_bytes": ("mesh_transfer_bytes_total",
+                       "bytes moved by mesh placement device_puts"),
+    "sharded_dispatches": ("mesh_sharded_dispatches_total",
+                           "dispatches of programs over sharded operands "
+                           "(psum over ICI)"),
+}
+
+
+def _counter(key: str) -> "_obs_metrics.Counter":
+    # fetched per call (one lock + dict hit, trivial next to a device_put):
+    # module-cached instruments would detach from the registry when tests
+    # reset it
+    name, help_text = _MESH_COUNTERS[key]
+    return _obs_metrics.default_registry().counter(name, help=help_text)
+
+
 _MESH_STATS_LOCK = threading.Lock()
-_MESH_STATS = {"transfers": 0, "transfer_bytes": 0, "sharded_dispatches": 0}
+#: reset_mesh_stats() baseline: registry counters are monotone by contract,
+#: so "reset" subtracts a remembered floor instead of rewinding them
+_MESH_STATS_BASE = {"transfers": 0.0, "transfer_bytes": 0.0,
+                    "sharded_dispatches": 0.0}
 
 
 def record_transfer(arr) -> None:
-    nbytes = int(getattr(arr, "nbytes", 0) or 0)
-    with _MESH_STATS_LOCK:
-        _MESH_STATS["transfers"] += 1
-        _MESH_STATS["transfer_bytes"] += nbytes
+    _counter("transfers").inc()
+    _counter("transfer_bytes").inc(int(getattr(arr, "nbytes", 0) or 0))
 
 
 def record_sharded_dispatch(n: int = 1) -> None:
     """Count a dispatch of a program running over sharded operands (its
     cross-device reductions lower to psum over ICI)."""
-    with _MESH_STATS_LOCK:
-        _MESH_STATS["sharded_dispatches"] += int(n)
+    _counter("sharded_dispatches").inc(int(n))
 
 
 def mesh_stats() -> dict:
+    totals = {k: _counter(k).value for k in _MESH_COUNTERS}
     with _MESH_STATS_LOCK:
-        return dict(_MESH_STATS)
+        return {k: int(v - min(_MESH_STATS_BASE[k], v))
+                for k, v in totals.items()}
 
 
 def reset_mesh_stats() -> None:
     with _MESH_STATS_LOCK:
-        for k in _MESH_STATS:
-            _MESH_STATS[k] = 0
+        for k in _MESH_COUNTERS:
+            _MESH_STATS_BASE[k] = _counter(k).value
 
 
 def mesh_section(mesh: Optional[Mesh],

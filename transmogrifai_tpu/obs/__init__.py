@@ -24,16 +24,25 @@ from contextlib import contextmanager
 from typing import Optional
 
 from .cost import cached_compiled, compiled_flops, cost_analysis, record_cost
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    parse_prometheus,
+)
 from .tracer import CompileEvent, PhaseTiming, Span, Tracer
 from .watchdog import RetraceBudget, RetraceBudgetExceeded
 from .watchdog import activate as _activate
 from .watchdog import deactivate as _deactivate
 
 __all__ = [
-    "CompileEvent", "PhaseTiming", "RetraceBudget", "RetraceBudgetExceeded",
+    "CompileEvent", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "PhaseTiming", "RetraceBudget", "RetraceBudgetExceeded",
     "Span", "Tracer", "add_event", "cached_compiled", "compiled_flops",
-    "cost_analysis", "current", "current_span", "record_cost",
-    "retrace_budget", "span", "trace",
+    "cost_analysis", "current", "current_span", "default_registry",
+    "parse_prometheus", "record_cost", "retrace_budget", "span", "trace",
 ]
 
 #: innermost-first stack of active tracers (module-global, shared across

@@ -1,0 +1,496 @@
+"""Numeric telemetry: a thread-safe metrics registry with Prometheus export.
+
+The companion of the span tracer (tracer.py): spans answer "where did the time
+go in THIS run", the registry answers "what are the running totals/levels/
+distributions of the process" — mesh transfer counters, input-pipeline stall
+seconds and queue depths, serving routing decisions and latency percentiles,
+feature-drift gauges. tf.data (arXiv:2101.12127) makes the case that an input
+runtime is only tunable when these numbers exist as first-class metrics; the
+TensorFlow system paper (arXiv:1605.08695) treats the unified metrics layer as
+a subsystem in its own right. Before this module each producer kept an ad-hoc
+dict (`mesh._MESH_STATS`, `PipelineStats`, `serve:routing` span events) with
+no percentiles and no export format.
+
+Three instrument kinds, Prometheus-shaped:
+
+  - Counter   — monotone float total (`.inc(n)`); name by convention `*_total`
+                or `*_seconds_total`.
+  - Gauge     — last-written level (`.set(v)`, `.inc`/`.dec`).
+  - Histogram — log-bucketed counts for exposition PLUS a bounded sample
+                reservoir for exact p50/p95/p99 (exact while the observation
+                count stays within the reservoir; uniform reservoir sampling —
+                deterministic seed — beyond it).
+
+Every instrument takes an optional frozen label set at creation
+(`registry.counter("serve_routing_total", labels={"backend": "cpu"})`); the
+(name, labels) pair is the identity, so repeated get-or-create calls from any
+thread return the same instrument. Export:
+
+  - `registry.snapshot()`   — plain-JSON dict (rides AppMetrics' `metrics`
+                              section and `op monitor --json`)
+  - `registry.to_prometheus()` — text exposition format 0.0.4 (`op monitor
+                              --prom`; scrapeable)
+  - `parse_prometheus(text)` — strict validity check of an exposition (the CI
+                              lint and the tests share it)
+
+All updates are lock-protected: producers include the input pipeline's
+producer thread and warmup's solo-fit pool, so unsynchronized `+=` would lose
+increments exactly like the tracer's phase table would (tracer.py add_phase).
+"""
+from __future__ import annotations
+
+import math
+import random
+import re
+import threading
+from typing import Optional, Sequence
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
+    "parse_prometheus", "reset_default_registry",
+]
+
+#: default log-spaced histogram bounds: 10 µs doubling up to ~84 s — covers
+#: sub-ms CPU serving through multi-second cold device dispatches in 24 buckets
+DEFAULT_BUCKETS = tuple(1e-5 * (2.0 ** i) for i in range(24))
+
+#: exact-percentile window: reservoir size per histogram (beyond this the
+#: percentiles degrade gracefully to uniform-sample estimates)
+DEFAULT_RESERVOIR = 4096
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
+
+def _freeze_labels(labels: Optional[dict]) -> tuple:
+    if not labels:
+        return ()
+    for k in labels:
+        if not _LABEL_RE.match(k):
+            raise ValueError(f"invalid label name {k!r}")
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_str(labels: tuple) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + body + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Metric:
+    """Shared identity + lock of all instrument kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: tuple = ()):
+        self.name = _check_name(name)
+        self.help = help
+        self.labels = labels
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotone total. `inc(n)` with n >= 0; negative increments raise."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", labels: tuple = ()):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} increment must be >= 0, got {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"value": self.value}
+
+
+class Gauge(_Metric):
+    """Last-written level; `set`/`inc`/`dec`."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", labels: tuple = ()):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"value": self.value}
+
+
+class Histogram(_Metric):
+    """Log-bucketed distribution with exact small-count percentiles.
+
+    Two structures per instrument, updated under one lock:
+      - cumulative bucket counts over `bounds` (+Inf implicit) + sum/count —
+        the Prometheus exposition shape, mergeable across scrapes;
+      - a bounded reservoir of raw observations — p50/p95/p99 are computed
+        from it at snapshot time, EXACT while count <= reservoir size, then a
+        uniform (seeded, deterministic) sample estimate.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", labels: tuple = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 reservoir: int = DEFAULT_RESERVOIR):
+        super().__init__(name, help, labels)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._reservoir_max = int(reservoir)
+        self._samples: list[float] = []
+        # deterministic reservoir: tests and repeated benches see stable
+        # percentile estimates past the exact window. crc32, not hash():
+        # python hash() is salted per process, which would re-randomize the
+        # eviction sequence across runs (the same reason raw_feature_filter
+        # uses a stable hash for its text buckets)
+        import zlib
+
+        self._rng = random.Random(0x5EED ^ zlib.crc32(name.encode("utf-8")))
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if not math.isfinite(v):
+            return  # a NaN latency must never poison sum/percentiles
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+            lo, hi = 0, len(self.bounds)
+            while lo < hi:  # first bound >= v (bisect; bounds are sorted)
+                mid = (lo + hi) // 2
+                if self.bounds[mid] >= v:
+                    hi = mid
+                else:
+                    lo = mid + 1
+            self._counts[lo] += 1
+            if len(self._samples) < self._reservoir_max:
+                self._samples.append(v)
+            else:  # Algorithm R: uniform over the whole stream
+                j = self._rng.randrange(self._count)
+                if j < self._reservoir_max:
+                    self._samples[j] = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 100]; None before any observation."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return None
+        idx = min(len(samples) - 1, max(0, math.ceil(q / 100.0 * len(samples)) - 1))
+        return samples[idx]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+            mn, mx = self._min, self._max
+            samples = sorted(self._samples)
+
+        def pct(q: float) -> Optional[float]:
+            if not samples:
+                return None
+            idx = min(len(samples) - 1,
+                      max(0, math.ceil(q / 100.0 * len(samples)) - 1))
+            return samples[idx]
+
+        cum = 0
+        buckets = {}
+        for b, c in zip(self.bounds, counts[:-1]):
+            cum += c
+            buckets[f"{b:g}"] = cum
+        buckets["+Inf"] = total
+        return {
+            "count": total, "sum": round(s, 9),
+            "min": None if total == 0 else mn,
+            "max": None if total == 0 else mx,
+            "p50": pct(50), "p95": pct(95), "p99": pct(99),
+            "buckets": buckets,
+        }
+
+
+class MetricsRegistry:
+    """Get-or-create registry of instruments keyed by (name, labels).
+
+    One registry per process is the normal shape (`default_registry()`); tests
+    construct private ones. A name is bound to ONE instrument kind — asking
+    for a gauge under an existing counter name raises, the mistake Prometheus
+    servers reject at scrape time.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, _Metric] = {}  # (name, labels) -> instrument
+        self._kinds: dict[str, str] = {}
+        self._help: dict[str, str] = {}
+
+    # --- get-or-create ----------------------------------------------------------------
+    def _get(self, cls, name: str, help: str, labels: Optional[dict], **kw):
+        frozen = _freeze_labels(labels)
+        key = (name, frozen)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is not None:
+                if m.kind != cls.kind:
+                    raise TypeError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"requested {cls.kind}")
+                return m
+            existing = self._kinds.get(name)
+            if existing is not None and existing != cls.kind:
+                raise TypeError(
+                    f"metric name {name!r} already bound to kind {existing}")
+            m = cls(name, help=help, labels=frozen, **kw)
+            self._metrics[key] = m
+            self._kinds[name] = cls.kind
+            if help:
+                self._help.setdefault(name, help)
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[dict] = None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[dict] = None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[dict] = None,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  reservoir: int = DEFAULT_RESERVOIR) -> Histogram:
+        return self._get(Histogram, name, help, labels,
+                         buckets=buckets, reservoir=reservoir)
+
+    # --- introspection / reset --------------------------------------------------------
+    def collect(self) -> list[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(),
+                          key=lambda m: (m.name, m.labels))
+
+    def reset(self) -> None:
+        """Drop every instrument (tests / bench isolation — a live service
+        never resets; Prometheus counters are cumulative by contract)."""
+        with self._lock:
+            self._metrics.clear()
+            self._kinds.clear()
+            self._help.clear()
+
+    # --- export -----------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Plain-JSON view: {name: {kind, help, series: [{labels, ...}]}}."""
+        out: dict[str, dict] = {}
+        for m in self.collect():
+            entry = out.setdefault(m.name, {
+                "kind": m.kind, "help": self._help.get(m.name, ""),
+                "series": [],
+            })
+            entry["series"].append({"labels": dict(m.labels), **m.snapshot()})
+        return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition format 0.0.4 (the format every Prometheus scraper
+        and `promtool check metrics` accepts)."""
+        lines: list[str] = []
+        seen: set[str] = set()
+        for m in self.collect():
+            if m.name not in seen:
+                seen.add(m.name)
+                help_text = self._help.get(m.name, "") or m.name
+                lines.append(f"# HELP {m.name} {_escape(help_text)}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+            ls = _label_str(m.labels)
+            if isinstance(m, Histogram):
+                snap = m.snapshot()
+                for le, cum in snap["buckets"].items():
+                    lab = list(m.labels) + [("le", le)]
+                    lines.append(
+                        f"{m.name}_bucket{_label_str(tuple(lab))} {cum}")
+                lines.append(f"{m.name}_sum{ls} {_fmt(snap['sum'])}")
+                lines.append(f"{m.name}_count{ls} {snap['count']}")
+            else:
+                lines.append(f"{m.name}{ls} {_fmt(m.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+# --- exposition validity check ----------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?"
+    r"\s+(?P<value>[^\s]+)(\s+\d+)?$")
+_LABEL_PAIR_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def parse_prometheus(text: str) -> dict[str, dict]:
+    """Strictly parse a text exposition; raises ValueError on any malformed
+    line. Returns {metric_name: {"type": ..., "samples": [(name, labels,
+    value)]}} — `tools/ci_check.sh` and the tests share this as the format
+    lint (HELP/TYPE ordering, label syntax, numeric values, histogram _sum/
+    _count/_bucket consistency)."""
+    families: dict[str, dict] = {}
+    typed: dict[str, str] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or not _NAME_RE.match(parts[2]):
+                raise ValueError(f"line {i}: malformed HELP: {line!r}")
+            families.setdefault(parts[2], {"type": None, "samples": []})
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or not _NAME_RE.match(parts[2]) \
+                    or parts[3] not in ("counter", "gauge", "histogram",
+                                        "summary", "untyped"):
+                raise ValueError(f"line {i}: malformed TYPE: {line!r}")
+            if parts[2] in typed:
+                raise ValueError(f"line {i}: duplicate TYPE for {parts[2]}")
+            typed[parts[2]] = parts[3]
+            families.setdefault(parts[2], {"type": None, "samples": []})
+            families[parts[2]]["type"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # free comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: malformed sample: {line!r}")
+        labels_body = (m.group("labels") or "{}")[1:-1]
+        if labels_body:
+            for pair in _split_label_pairs(labels_body, i, line):
+                if not _LABEL_PAIR_RE.match(pair):
+                    raise ValueError(f"line {i}: malformed label {pair!r}")
+        raw_v = m.group("value")
+        if raw_v not in ("+Inf", "-Inf", "NaN"):
+            try:
+                float(raw_v)
+            except ValueError:
+                raise ValueError(
+                    f"line {i}: non-numeric value {raw_v!r}") from None
+        sample_name = m.group("name")
+        family = sample_name
+        for suf in ("_bucket", "_sum", "_count"):
+            base = sample_name[: -len(suf)] if sample_name.endswith(suf) else None
+            if base and typed.get(base) in ("histogram", "summary"):
+                family = base
+                break
+        families.setdefault(family, {"type": typed.get(family), "samples": []})
+        families[family]["samples"].append(
+            (sample_name, m.group("labels") or "", raw_v))
+    # histogram consistency: every histogram family needs _bucket/_sum/_count
+    for name, fam in families.items():
+        if fam.get("type") == "histogram" and fam["samples"]:
+            kinds = {s[0] for s in fam["samples"]}
+            for suf in ("_bucket", "_sum", "_count"):
+                if name + suf not in kinds:
+                    raise ValueError(
+                        f"histogram {name} missing {name}{suf} samples")
+            if not any('le="+Inf"' in s[1] for s in fam["samples"]
+                       if s[0] == name + "_bucket"):
+                raise ValueError(f"histogram {name} missing +Inf bucket")
+    return families
+
+
+def _split_label_pairs(body: str, lineno: int, line: str) -> list[str]:
+    """Split `a="x",b="y,z"` on commas OUTSIDE quotes."""
+    pairs, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            cur.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            cur.append(ch)
+            continue
+        if ch == "," and not in_q:
+            pairs.append("".join(cur))
+            cur = []
+            continue
+        cur.append(ch)
+    if in_q:
+        raise ValueError(f"line {lineno}: unterminated label quote: {line!r}")
+    if cur:
+        pairs.append("".join(cur))
+    return pairs
+
+
+# --- process default --------------------------------------------------------------------
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem publishes into (mesh
+    placement counters, pipeline stalls, serving routing/latency, drift
+    gauges). AppMetrics' `metrics` section and `op monitor --prom/--json`
+    export exactly this."""
+    return _DEFAULT
+
+
+def reset_default_registry() -> None:
+    """Test/bench isolation only — see MetricsRegistry.reset()."""
+    _DEFAULT.reset()

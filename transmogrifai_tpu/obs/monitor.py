@@ -1,0 +1,521 @@
+"""Serving-time feature-drift monitor against training baselines.
+
+The serving half of the paper's feature-validation story (RawFeatureFilter,
+SURVEY L4): training compares feature distributions between the TRAIN and
+SCORING tables once, offline — this module runs the same comparison
+continuously at serving time, against a baseline stamped into the model
+artifact at train time.
+
+  train:  Workflow.train computes one FeatureDistribution per raw feature
+          (fill rate + histogram over training-range bins; text features hash
+          into fixed buckets) — `compute_serving_baseline`. WorkflowModel.save
+          writes them to model.json under "serving_baseline"; load restores.
+  serve:  a ServingMonitor folds every scoring batch into per-feature
+          STREAMING sketches (the same mergeable FeatureDistribution monoid:
+          counts and histograms add) using cheap numpy on already-host
+          columns, then emits per-feature fill-rate and Jensen-Shannon-
+          divergence gauges into the metrics registry and raises structured
+          DriftAlerts past configurable thresholds.
+
+The monitor NEVER raises on the scoring hot path: any internal failure lands
+on the `serving_monitor_errors_total` counter and scoring proceeds. Alerts are
+span events + registry counters, one per (feature, kind) episode — an alert
+re-arms only after the signal drops back under threshold.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..filter.raw_feature_filter import FeatureDistribution, RawFeatureFilter
+
+#: default histogram resolution of the stamped baseline — coarser than the
+#: RawFeatureFilter's offline default (100): serving sketches merge per batch,
+#: and 32 bins keep the JS signal while shrinking the artifact
+BASELINE_BINS = 32
+#: row cap for the train-time baseline pass (evenly-spaced subsample):
+#: stamping must stay O(sample) however large the training table is
+BASELINE_SAMPLE_ROWS = 8192
+
+
+@dataclass(frozen=True)
+class DriftThresholds:
+    """When a drifting feature becomes an alert.
+
+    max_js_divergence: JS (log2, [0, 1]) between the training histogram and
+    the serving sketch. max_fill_delta: |train fill rate - serving fill rate|.
+    min_rows: observations required before EITHER check arms (tiny sketches
+    alert on noise). Serving thresholds default tighter than the offline
+    RawFeatureFilter exclusion thresholds (0.90) — monitoring warns well
+    before training would have excluded the feature."""
+
+    max_js_divergence: float = 0.25
+    max_fill_delta: float = 0.15
+    min_rows: int = 256
+
+    def to_json(self) -> dict:
+        return {"max_js_divergence": self.max_js_divergence,
+                "max_fill_delta": self.max_fill_delta,
+                "min_rows": self.min_rows}
+
+
+@dataclass
+class DriftAlert:
+    """One threshold crossing, structured for handlers/logs."""
+
+    feature: str
+    kind: str          # "js_divergence" | "fill_rate"
+    value: float
+    threshold: float
+    rows_seen: int
+    message: str
+
+    def to_json(self) -> dict:
+        return {"feature": self.feature, "kind": self.kind,
+                "value": round(self.value, 6),
+                "threshold": self.threshold,
+                "rows_seen": self.rows_seen, "message": self.message}
+
+
+# --- baseline computation / (de)serialization -------------------------------------------
+def compute_serving_baseline(features: Sequence[Any], table,
+                             bins: int = BASELINE_BINS,
+                             sample_rows: int = BASELINE_SAMPLE_ROWS,
+                             ) -> dict[str, FeatureDistribution]:
+    """Per-raw-feature training distributions for the model artifact.
+
+    Reuses the RawFeatureFilter's distribution pass (numeric histograms over
+    the training range, hashed-value buckets for text) on an evenly-spaced
+    row subsample capped at `sample_rows` — deterministic, O(sample) whatever
+    the table size. Responses are skipped (serving is unlabeled)."""
+    n = table.nrows
+    if n > sample_rows:
+        idx = np.linspace(0, n - 1, sample_rows).astype(np.int64)
+        table = table.slice(idx)
+    rff = RawFeatureFilter(bins=bins)
+    return rff.compute_distributions(
+        [f for f in features if not f.is_response], table)
+
+
+def baseline_to_json(dists: Mapping[str, FeatureDistribution]) -> dict:
+    """model.json "serving_baseline" payload. Unlike FeatureDistribution.
+    to_json (a report), this keeps bin_edges — the serving sketch must bin
+    scoring values over the SAME edges or JS is meaningless."""
+    feats = {}
+    for name, d in dists.items():
+        feats[name] = {
+            "kind": d.kind, "count": int(d.count),
+            "null_count": int(d.null_count),
+            "histogram": np.asarray(d.histogram, np.float64).tolist(),
+            "bin_edges": (None if d.bin_edges is None
+                          else np.asarray(d.bin_edges, np.float64).tolist()),
+        }
+    return {"version": 1, "bins": _bins_of(dists), "features": feats}
+
+
+def baseline_from_json(doc: Mapping) -> dict[str, FeatureDistribution]:
+    out = {}
+    for name, f in doc.get("features", {}).items():
+        out[name] = FeatureDistribution(
+            name=name, kind=f["kind"], count=int(f["count"]),
+            null_count=int(f["null_count"]),
+            histogram=np.asarray(f["histogram"], np.float64),
+            bin_edges=(None if f.get("bin_edges") is None
+                       else np.asarray(f["bin_edges"], np.float64)),
+        )
+    return out
+
+
+def _bins_of(dists: Mapping[str, FeatureDistribution]) -> int:
+    for d in dists.values():
+        if len(d.histogram):
+            return int(len(d.histogram))
+    return BASELINE_BINS
+
+
+class _NamedFeature:
+    """Adapter: RawFeatureFilter._distribution reads only `.name` off the
+    feature object (compute_distributions additionally `.is_response`), and
+    serving batches carry bare column names."""
+
+    __slots__ = ("name", "is_response")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.is_response = False
+
+
+class ServingMonitor:
+    """Streaming drift detector for one served model.
+
+    Thread-safe: `observe_table` is called from the input pipeline's producer
+    thread (ScoreFunction.stream, the runner's streaming loop) while `check`/
+    `report` run on the caller thread. Construct from a model —
+    `ServingMonitor.for_model(model)` — or directly from a baseline dict.
+    """
+
+    #: per-batch stride-sample cap: drift is a statistical signal, so folding
+    #: every row of every batch buys nothing but hot-path python time — 128
+    #: rows/batch keeps the monitor at a few percent of streamed-scoring cost
+    #: while a mean shift still crosses threshold within a couple of batches
+    MAX_ROWS_PER_BATCH = 128
+    #: threshold evaluation every N observed batches (check() also runs on
+    #: demand and inside report(), so the final state never lags)
+    CHECK_EVERY = 8
+
+    def __init__(self, baseline: Mapping[str, FeatureDistribution],
+                 thresholds: Optional[DriftThresholds] = None,
+                 registry=None, source: str = "serve",
+                 kinds: Optional[Mapping[str, Any]] = None,
+                 max_rows_per_batch: Optional[int] = MAX_ROWS_PER_BATCH,
+                 check_every: int = CHECK_EVERY):
+        from .metrics import default_registry
+
+        if not baseline:
+            raise ValueError(
+                "empty serving baseline — train with a current build (or "
+                "re-save the model) so model.json carries 'serving_baseline'")
+        self.baseline = dict(baseline)
+        #: {feature name: FeatureKind} — required only by observe_rows (raw
+        #: record batches carry no kind metadata); for_model fills it in
+        self.kinds = dict(kinds) if kinds else {}
+        self.thresholds = thresholds or DriftThresholds()
+        self.registry = registry if registry is not None else default_registry()
+        self.source = source
+        self.max_rows_per_batch = max_rows_per_batch
+        self.check_every = max(1, int(check_every))
+        bins = _bins_of(self.baseline)
+        self._rff = RawFeatureFilter(bins=bins)
+        #: gauges cached per feature: get-or-create freezes/sorts labels under
+        #: the registry lock — measurable at per-batch frequency
+        self._gauges: dict[tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+        self.sketches: dict[str, FeatureDistribution] = {}
+        self.batches = 0
+        self.rows = 0
+        #: (feature, kind) pairs currently past threshold — an alert fires on
+        #: the False->True edge and re-arms when the signal recovers
+        self._active: set[tuple[str, str]] = set()
+        self.alerts: list[DriftAlert] = []
+        self._max_alerts = 256
+        # instruments are created once; observe() only updates them
+        self._rows_c = self.registry.counter(
+            "serving_monitor_rows_total",
+            help="rows folded into the serving drift sketches")
+        self._batches_c = self.registry.counter(
+            "serving_monitor_batches_total",
+            help="scoring batches observed by the drift monitor")
+        self._errors_c = self.registry.counter(
+            "serving_monitor_errors_total",
+            help="internal monitor failures swallowed off the scoring hot path")
+        self._skipped_c = self.registry.counter(
+            "serving_monitor_skipped_columns_total",
+            help="column observations skipped (device-resident or absent)")
+
+    @classmethod
+    def for_model(cls, model, thresholds: Optional[DriftThresholds] = None,
+                  registry=None, **kwargs) -> "ServingMonitor":
+        """Build from a WorkflowModel's stamped baseline (train stamps it;
+        load restores it). Raises ValueError when the model predates the
+        baseline contract. Extra kwargs (max_rows_per_batch, check_every,
+        source) pass through to the constructor."""
+        baseline = getattr(model, "serving_baseline", None)
+        if not baseline:
+            raise ValueError(
+                "model carries no serving_baseline (trained before drift "
+                "monitoring existed?) — retrain or re-save to stamp one")
+        kinds = {f.name: f.kind for f in model.raw_features
+                 if not f.is_response}
+        return cls(baseline, thresholds=thresholds, registry=registry,
+                   kinds=kinds, **kwargs)
+
+    # --- observation (hot path; never raises) -----------------------------------------
+    def observe_table(self, table, n: Optional[int] = None,
+                      allow_device_fetch: bool = False) -> None:
+        """Fold one scoring batch. `n` limits to the first n rows (serving
+        pads batches to bucket sizes; filler rows must not skew fill rates).
+        Only already-host columns are folded — a device-resident column would
+        cost a D2H fetch on the scoring path, so it is counted as skipped
+        instead. `allow_device_fetch=True` opts into that fetch for OFFLINE
+        batch-scoring runs (the runner's `score` run type), where the arrays
+        come back to the host for persistence anyway."""
+        try:
+            cols = {name: table[name] for name in table.names()}
+            self._observe_cols(cols, n, allow_device_fetch=allow_device_fetch)
+        except Exception:
+            self._errors_c.inc()
+
+    def observe_columns(self, cols: Mapping[str, Any],
+                        n: Optional[int] = None) -> None:
+        try:
+            self._observe_cols(dict(cols), n)
+        except Exception:
+            self._errors_c.inc()
+
+    def observe_rows(self, rows: Sequence[Mapping[str, Any]]) -> None:
+        """Fold a batch of raw record dicts (the streaming runner's arrival
+        shape — its table build is device-eager, so the monitor builds its
+        own HOST columns from the rows instead of fetching device arrays
+        back). Requires `kinds` (for_model provides them)."""
+        try:
+            if not rows or not self.kinds:
+                if rows:
+                    self._skipped_c.inc(len(self.baseline))
+                return
+            idx = self._sample_idx(len(rows))
+            if idx is not None:
+                # sample BEFORE column building: the per-row dict.get loops
+                # are the dominant cost of folding a record batch
+                rows = [rows[i] for i in idx]
+            from ..types import Column
+
+            cols = {}
+            for name in self.baseline:
+                kind = self.kinds.get(name)
+                if kind is None:
+                    continue
+                try:
+                    cols[name] = Column.build(
+                        kind, [r.get(name) for r in rows], device=False)
+                except (TypeError, ValueError):
+                    self._skipped_c.inc()  # malformed values: skip, don't raise
+            self._observe_cols(cols, None)
+        except Exception:
+            self._errors_c.inc()
+
+    def _sample_idx(self, n_rows: int) -> Optional[np.ndarray]:
+        """Evenly-spaced sample of EXACTLY max_rows_per_batch indices (None =
+        fold every row). Drift is statistical — the cap bounds the python
+        cost of huge batches without blinding the sketch. Exactness matters:
+        an under-filled sample (the naive ceil-stride) delays the min_rows
+        alert gate by whole batches."""
+        cap = self.max_rows_per_batch
+        if not cap or n_rows <= cap:
+            return None
+        # i * n/cap with n/cap > 1: floors are strictly increasing, so the
+        # sample is cap DISTINCT evenly-spaced rows
+        return np.linspace(0, n_rows, cap, endpoint=False).astype(np.int64)
+
+    def _gauge(self, kind: str, name: str):
+        g = self._gauges.get((kind, name))
+        if g is None:
+            help_text = {
+                "fill": "serving-side fill rate per raw feature",
+                "js": "JS divergence (log2) of the serving sketch vs the "
+                      "training baseline, per raw feature",
+            }[kind]
+            metric = ("serving_fill_rate" if kind == "fill"
+                      else "serving_js_divergence")
+            g = self._gauges[(kind, name)] = self.registry.gauge(
+                metric, help=help_text, labels={"feature": name})
+        return g
+
+    def _observe_cols(self, cols: dict, n: Optional[int],
+                      allow_device_fetch: bool = False) -> None:
+        folded_rows = 0
+        idx_cache: dict[int, Optional[np.ndarray]] = {}
+        for name, base in self.baseline.items():
+            col = cols.get(name)
+            if col is not None and not _host_resident(col) \
+                    and allow_device_fetch:
+                col = _fetched_host_copy(col)
+            if col is None or not _host_resident(col):
+                self._skipped_c.inc()
+                continue
+            if n is not None and n < len(col):
+                col = col.slice(np.arange(n))
+            n_col = len(col)
+            if n_col not in idx_cache:
+                idx_cache[n_col] = self._sample_idx(n_col)
+            idx = idx_cache[n_col]
+            if idx is not None:
+                col = col.slice(idx)
+            dist = self._rff._distribution(_NamedFeature(name), col,
+                                           train_dist=base)
+            with self._lock:
+                sk = self.sketches.get(name)
+                if sk is None:
+                    self.sketches[name] = dist
+                else:
+                    _merge_into(sk, dist)
+                sk = self.sketches[name]
+                fill, js = sk.fill_rate, base.js_divergence(sk)
+            folded_rows = max(folded_rows, len(col))
+            self._gauge("fill", name).set(fill)
+            self._gauge("js", name).set(js)
+        with self._lock:
+            self.batches += 1
+            self.rows += folded_rows
+            due = self.batches % self.check_every == 0
+        self._batches_c.inc()
+        self._rows_c.inc(folded_rows)
+        if due:
+            self._check_safe()
+
+    # --- drift decision ---------------------------------------------------------------
+    def _feature_state(self, name: str) -> Optional[dict]:
+        base = self.baseline[name]
+        sk = self.sketches.get(name)
+        if sk is None:
+            return None
+        return {
+            "feature": name, "kind": base.kind,
+            "rows": sk.count,
+            "train_fill_rate": round(base.fill_rate, 6),
+            "serving_fill_rate": round(sk.fill_rate, 6),
+            "fill_delta": round(abs(base.fill_rate - sk.fill_rate), 6),
+            "js_divergence": round(base.js_divergence(sk), 6),
+        }
+
+    def _check_safe(self) -> None:
+        try:
+            self.check()
+        except Exception:
+            self._errors_c.inc()
+
+    def check(self) -> list[DriftAlert]:
+        """Evaluate thresholds; returns alerts NEWLY fired by this call (the
+        full history stays on `self.alerts`). Each new alert lands as an
+        `obs` span event and on serving_drift_alerts_total."""
+        from .. import obs
+
+        th = self.thresholds
+        new: list[DriftAlert] = []
+        with self._lock:
+            for name in self.baseline:
+                st = self._feature_state(name)
+                if st is None or st["rows"] < th.min_rows:
+                    continue
+                for kind, value, limit in (
+                        ("js_divergence", st["js_divergence"],
+                         th.max_js_divergence),
+                        ("fill_rate", st["fill_delta"], th.max_fill_delta)):
+                    key = (name, kind)
+                    if value > limit:
+                        if key in self._active:
+                            continue
+                        self._active.add(key)
+                        alert = DriftAlert(
+                            feature=name, kind=kind, value=float(value),
+                            threshold=limit, rows_seen=int(st["rows"]),
+                            message=(f"{name}: serving {kind} {value:.4f} > "
+                                     f"{limit} after {st['rows']} rows"))
+                        new.append(alert)
+                        if len(self.alerts) < self._max_alerts:
+                            self.alerts.append(alert)
+                    else:
+                        self._active.discard(key)
+        for alert in new:
+            obs.add_event("drift", **alert.to_json())
+            self.registry.counter(
+                "serving_drift_alerts_total",
+                help="structured drift alerts raised past thresholds",
+                labels={"feature": alert.feature, "kind": alert.kind}).inc()
+        return new
+
+    # --- reporting --------------------------------------------------------------------
+    def report(self) -> dict:
+        self._check_safe()  # the throttle must never stale a report
+        with self._lock:
+            feats = [st for name in sorted(self.baseline)
+                     if (st := self._feature_state(name)) is not None]
+            return {
+                "source": self.source,
+                "batches": self.batches, "rows": self.rows,
+                "thresholds": self.thresholds.to_json(),
+                "features": feats,
+                "alerts": [a.to_json() for a in self.alerts],
+                "active_alerts": sorted(
+                    f"{f}:{k}" for f, k in self._active),
+            }
+
+    def pretty(self) -> str:
+        rep = self.report()
+        lines = [f"ServingMonitor: {rep['rows']} rows / {rep['batches']} "
+                 f"batches observed, {len(rep['alerts'])} alert(s)"]
+        if rep["features"]:
+            hdr = (f"  {'feature':<24} {'kind':<12} {'fill(train)':>11} "
+                   f"{'fill(serve)':>11} {'JS':>8}  status")
+            lines.append(hdr)
+            active = {a.split(":")[0] for a in rep["active_alerts"]}
+            for st in rep["features"]:
+                flag = "DRIFT" if st["feature"] in active else "ok"
+                lines.append(
+                    f"  {st['feature']:<24} {st['kind']:<12} "
+                    f"{st['train_fill_rate']:>11.4f} "
+                    f"{st['serving_fill_rate']:>11.4f} "
+                    f"{st['js_divergence']:>8.4f}  {flag}")
+        for a in rep["alerts"][-5:]:
+            lines.append(f"  ! {a['message']}")
+        return "\n".join(lines)
+
+
+def demo_monitor(registry=None, rows: int = 512,
+                 thresholds: Optional[DriftThresholds] = None) -> ServingMonitor:
+    """Self-contained demo/smoke: a synthetic 3-feature baseline observed
+    against one in-distribution batch and one drifted batch (mean-shifted
+    numeric + degraded fill). Populates the registry's serving_* series with
+    real values and fires at least one DriftAlert — `op monitor --demo` and
+    the CI exposition lint run on this, needing no dataset or model."""
+    from ..types import Column, Table
+
+    rng = np.random.default_rng(7)
+
+    def table(shift: float = 0.0, missing: float = 0.0, n: int = rows) -> Table:
+        x = rng.normal(loc=shift, size=n)
+        x_vals = [None if rng.random() < missing else float(v) for v in x]
+        cats = [str(c) for c in rng.choice(list("abcd"), size=n)]
+        return Table({
+            "x": Column.build("Real", x_vals, device=False),
+            "y": Column.build("Real", list(rng.normal(size=n)), device=False),
+            "cat": Column.build("PickList", cats, device=False),
+        })
+
+    feats = [_NamedFeature(n) for n in ("x", "y", "cat")]
+    baseline = compute_serving_baseline(feats, table())
+    if thresholds is None:
+        thresholds = DriftThresholds(min_rows=min(rows, 256))
+    mon = ServingMonitor(baseline, registry=registry, source="demo",
+                         thresholds=thresholds)
+    mon.observe_table(table())                          # in-distribution
+    mon.observe_table(table(shift=6.0, missing=0.5))    # drifted
+    return mon
+
+
+def _host_resident(col) -> bool:
+    """True when observing the column is pure numpy (no D2H). Prediction-dict
+    and device-array columns are skipped on the hot path."""
+    v = getattr(col, "values", None)
+    if isinstance(v, np.ndarray):
+        return True
+    return isinstance(v, (list, tuple))
+
+
+def _fetched_host_copy(col):
+    """Host Column copy of a device-array column (one device_get per array),
+    or None for shapes the monitor cannot fold (prediction dicts)."""
+    from ..types import Column
+
+    v = getattr(col, "values", None)
+    if v is None or isinstance(v, dict):
+        return None
+    try:
+        vals = np.asarray(v)
+        mask = None if col.mask is None else np.asarray(col.mask)
+        return Column(col.kind, vals, mask, schema=col.schema)
+    except Exception:
+        return None
+
+
+def _merge_into(acc: FeatureDistribution, d: FeatureDistribution) -> None:
+    """Monoid merge (the reference reduces FeatureDistribution over RDD
+    partitions the same way): counts add, histograms add bin-wise. Histogram
+    shapes always agree — both sides binned over the baseline's edges."""
+    acc.count += d.count
+    acc.null_count += d.null_count
+    if len(acc.histogram) == len(d.histogram):
+        acc.histogram = np.asarray(acc.histogram, np.float64) + \
+            np.asarray(d.histogram, np.float64)

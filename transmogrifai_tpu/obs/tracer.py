@@ -191,10 +191,14 @@ class Tracer:
 
     def add_event(self, name: str, **attrs) -> None:
         """Attach a point-in-time annotation to the calling thread's innermost
-        open span (the root outside any span)."""
+        open span (the root outside any span). The tracer-relative timestamp
+        rides along as "t_s" so exporters can place the instant on the
+        timeline (export_chrome emits these as instant events)."""
         sp = self.current_span()
+        ev = {"name": name, **attrs}
+        ev.setdefault("t_s", round(time.perf_counter() - self.root.t0, 6))
         with self._lock:
-            sp.events.append({"name": name, **attrs})
+            sp.events.append(ev)
 
     # --- compile attribution (called by watchdog listeners) ---------------------------
     def on_compile_event(self, kind: str, program: str, duration_s: float) -> None:
@@ -262,7 +266,9 @@ class Tracer:
         """Write a Chrome-trace JSON (the `traceEvents` array format Perfetto
         and chrome://tracing load). Spans become complete ("X") events on their
         thread's track; compile events become "X" events in a "compile"
-        category; cache hits are instants."""
+        category; cache hits are instants; span events (`add_event`: oplint
+        diagnostics, serve:routing decisions, drift alerts) become instant
+        ("i") events in an "event" category on the span's thread."""
         self.finish()
         t_base = self.root.t0
         events: list[dict] = []
@@ -285,6 +291,21 @@ class Tracer:
                 "dur": round(max(sp.wall_s, 0.0) * 1e6, 3),
                 "args": {"path": sp.path},
             })
+            for ev in sp.events:
+                # instant events on the span's own thread track: oplint
+                # findings, serve:routing decisions, drift alerts — without
+                # these the timeline shows WHERE time went but not WHAT the
+                # run decided. Events predating the t_s stamp fall back to
+                # the span start.
+                attrs = {k: v for k, v in ev.items() if k not in ("name", "t_s")}
+                ts_s = ev.get("t_s", sp.t0 - t_base)
+                events.append({
+                    "ph": "i", "s": "t", "cat": "event",
+                    "name": str(ev.get("name", "event")), "pid": 1,
+                    "tid": tid_of(sp.thread),
+                    "ts": round(float(ts_s) * 1e6, 3),
+                    "args": {"span": sp.path, **attrs},
+                })
             for c in sp.children:
                 walk(c)
 

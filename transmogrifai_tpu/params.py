@@ -44,6 +44,11 @@ class OpParams:
     #: device (all on the data axis; single-device processes run unmeshed).
     #: CLI: `op run --mesh 4,2`.
     mesh_shape: Optional[Any] = None
+    #: serving-time feature-drift monitoring for score/streaming_score runs
+    #: (obs/monitor.py): fold scoring batches into drift sketches against the
+    #: model's stamped serving_baseline, emit fill-rate/JS gauges, and attach
+    #: the monitor report to the run result. CLI: `op run --monitor`.
+    monitor: bool = False
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

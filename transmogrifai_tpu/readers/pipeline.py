@@ -66,12 +66,41 @@ class PipelineStats:
     sink_stall_s: float = 0.0
     queue_depth: dict[int, int] = field(default_factory=dict)
     bucket_hist: dict[int, int] = field(default_factory=dict)
+    #: guard against double publication into the metrics registry: the same
+    #: stats object flows through a Prefetcher AND run_pipeline
+    _published: bool = field(default=False, repr=False)
 
     def observe_depth(self, depth: int) -> None:
         self.queue_depth[depth] = self.queue_depth.get(depth, 0) + 1
 
     def observe_bucket(self, size: int) -> None:
         self.bucket_hist[size] = self.bucket_hist.get(size, 0) + 1
+
+    def publish(self, registry=None) -> None:
+        """Fold this run's totals into the unified metrics registry
+        (obs/metrics.py): per-stage/stall seconds and batch counts as
+        `pipeline_*_total` counters, the final queue depth distribution as a
+        gauge of its modal depth. Idempotent per stats object — run_pipeline
+        and ScoreFunction.stream call it once at drain."""
+        if self._published or self.batches == 0:
+            return
+        self._published = True
+        from ..obs.metrics import default_registry
+
+        reg = registry if registry is not None else default_registry()
+        reg.counter("pipeline_batches_total",
+                    help="batches through the input pipeline").inc(self.batches)
+        for key in ("prepare_s", "compute_s", "sink_s", "host_stall_s",
+                    "backpressure_s", "sink_stall_s"):
+            reg.counter(f"pipeline_{key[:-2]}_seconds_total",
+                        help="input-pipeline stage/stall seconds "
+                             "(PipelineStats aggregate)").inc(getattr(self, key))
+        if self.queue_depth:
+            modal = max(self.queue_depth, key=self.queue_depth.get)
+            reg.gauge("pipeline_queue_depth_modal",
+                      help="most frequent prepare-queue depth of the latest "
+                           "pipeline run (0 = ingest-bound, max = "
+                           "compute-bound)").set(modal)
 
     def to_dict(self) -> dict:
         out = {
@@ -185,6 +214,7 @@ class Prefetcher:
             except queue.Empty:
                 break
         self._thread.join(timeout=5.0)
+        self.stats.publish()
 
     def __enter__(self) -> "Prefetcher":
         return self
@@ -298,6 +328,7 @@ def run_pipeline(
                 sink(out)
                 stats.sink_s += time.perf_counter() - t0
             stats.batches += 1
+        stats.publish()
         return stats
 
     with Prefetcher(source, prepare, depth=prefetch, stats=stats,
@@ -319,4 +350,5 @@ def run_pipeline(
             raise
         if sink_cm is not None:
             sink_cm.close()
+    stats.publish()
     return stats

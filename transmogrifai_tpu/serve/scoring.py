@@ -55,7 +55,7 @@ class ScoreFunction:
                  pad_to: Optional[Sequence[int]] = None,
                  backend: Optional[str] = "auto",
                  auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
-                 mesh=None):
+                 mesh=None, monitor=None):
         self._model = model
         self._result_names = list(result_names) if result_names else [
             f.name for f in model.result_features
@@ -68,7 +68,21 @@ class ScoreFunction:
         self._backend = backend
         self._auto_cpu_threshold = int(auto_cpu_threshold)
         self._mesh = mesh
+        #: drift monitor (obs/monitor.py). monitor=True builds one from the
+        #: model's stamped serving_baseline; a ServingMonitor instance is used
+        #: as-is; None/False disables. Batches fold into its streaming
+        #: sketches BEFORE padding (filler rows must not skew fill rates).
+        if monitor is True:
+            from ..obs.monitor import ServingMonitor
+
+            monitor = ServingMonitor.for_model(model)
+        self.monitor = monitor or None
         self._plans: dict = {}  # backend key -> LocalPlan
+        #: registry instruments cached per backend lane: get-or-create
+        #: freezes/sorts labels under the registry lock — measurable at
+        #: per-record serving frequency (same policy as ServingMonitor._gauge)
+        self._route_counters: dict = {}
+        self._lat_hists: dict = {}
 
     def _plan_for(self, backend: Optional[str]):
         key = backend or "default"
@@ -87,7 +101,8 @@ class ScoreFunction:
 
     def _route(self, n_rows: int):
         """-> (LocalPlan, backend label). Under "auto", small batches take the
-        CPU columnar path; the decision lands on the score trace span."""
+        CPU columnar path; the decision lands on the score trace span AND the
+        metrics registry (`serve_routing_total{backend,decided}`)."""
         from .. import obs
 
         if self._backend != "auto":
@@ -102,7 +117,46 @@ class ScoreFunction:
             decided = "auto"
         obs.add_event("serve:routing", backend=backend or "device",
                       rows=int(n_rows), decided=decided)
+        key = (backend or "device", decided)
+        c = self._route_counters.get(key)
+        if c is None:
+            c = self._route_counters[key] = obs.default_registry().counter(
+                "serve_routing_total",
+                help="serving batches routed per backend lane",
+                labels={"backend": key[0], "decided": decided})
+        c.inc()
         return self._plan_for(backend), backend
+
+    def _timed_run(self, plan, table, backend: Optional[str]):
+        """plan.run with the per-backend latency histogram
+        (`serve_latency_seconds{backend}`: log buckets + exact p50/p95/p99).
+        The observe is a few µs under one lock — noise against even the
+        sub-ms CPU single-record path."""
+        import time
+
+        from .. import obs
+
+        t0 = time.perf_counter()
+        out = plan.run(table)
+        key = backend or "device"
+        h = self._lat_hists.get(key)
+        if h is None:
+            h = self._lat_hists[key] = obs.default_registry().histogram(
+                "serve_latency_seconds",
+                help="LocalPlan scoring latency per backend lane",
+                labels={"backend": key})
+        h.observe(time.perf_counter() - t0)
+        return out
+
+    def _observe(self, table_or_cols, n: int) -> None:
+        """Fold a scoring batch into the drift monitor (no-op without one;
+        never raises — the monitor owns its error counter)."""
+        if self.monitor is None:
+            return
+        if isinstance(table_or_cols, Table):
+            self.monitor.observe_table(table_or_cols, n=n)
+        else:
+            self.monitor.observe_columns(table_or_cols, n=n)
 
     def _local_plan(self):
         # back-compat surface (tests/tools introspect it): the device-lane plan
@@ -138,8 +192,10 @@ class ScoreFunction:
         # route on the REAL row count: pad_to bucketing must not flip a
         # 4-row request onto the device lane just because its bucket is big
         plan, backend = self._route(n)
-        table = self._maybe_shard(self._build_table(padded), len(padded), backend)
-        out = plan.run(table)
+        table = self._build_table(padded)
+        self._observe(table, n)
+        table = self._maybe_shard(table, len(padded), backend)
+        out = self._timed_run(plan, table, backend)
         return self._rows_out(out, n)
 
     def _rows_out(self, out: Mapping[str, Column], n: int) -> list[dict[str, Any]]:
@@ -170,7 +226,12 @@ class ScoreFunction:
                 return 0, None, None
             padded = self._pad(records)
             plan, backend = self._route(n)  # real rows, not the pad bucket
-            return n, self._build_table(padded), (plan, backend, len(padded))
+            table = self._build_table(padded)
+            # drift sketches fold on the PRODUCER thread: the numpy histogram
+            # pass overlaps the device scoring of the previous batch instead
+            # of extending the critical path
+            self._observe(table, n)
+            return n, table, (plan, backend, len(padded))
 
         def place(item):
             # producer-thread device placement: under a mesh, device-lane
@@ -195,7 +256,12 @@ class ScoreFunction:
         with Prefetcher(batches, prep, depth=prefetch, name="serve_build",
                         place=place) as pf:
             for n, table, route in pf:
-                yield [] if n == 0 else self._rows_out(route[0].run(table), n)
+                # bare-Prefetcher use: the consumer owns the batch count
+                # (run_pipeline's loop does this for the runner), so
+                # close()-time stats.publish() has real totals to fold
+                pf.stats.batches += 1
+                yield ([] if n == 0 else self._rows_out(
+                    self._timed_run(route[0], table, route[1]), n))
 
     # --- columnar -----------------------------------------------------------------------
     def table(self, table: Table) -> Table:
@@ -211,8 +277,9 @@ class ScoreFunction:
             else:
                 cols[f.name] = Column.build(f.kind, [_placeholder(f.kind)] * n, device=False)
         plan, backend = self._route(n)
+        self._observe(cols, n)
         cols = self._maybe_shard(cols, n, backend)
-        out = plan.run(cols)
+        out = self._timed_run(plan, cols, backend)
         return Table({n_: out[n_] for n_ in self._result_names})
 
     def _pad(self, records: Sequence[Mapping[str, Any]]):
@@ -262,8 +329,8 @@ def score_function(model: "WorkflowModel", result_names: Optional[Sequence[str]]
                   pad_to: Optional[Sequence[int]] = None,
                   backend: Optional[str] = "auto",
                   auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
-                  mesh=None) -> ScoreFunction:
+                  mesh=None, monitor=None) -> ScoreFunction:
     """Build the serving callable (analog of `model.scoreFunction`)."""
     return ScoreFunction(model, result_names=result_names, pad_to=pad_to,
                          backend=backend, auto_cpu_threshold=auto_cpu_threshold,
-                         mesh=mesh)
+                         mesh=mesh, monitor=monitor)

@@ -50,6 +50,24 @@ def attach_slot_history(col: Column, stage: "Stage") -> Column:
     return Column(col.kind, col.values, col.mask, schema=new_schema)
 
 
+def _import_stage_modules() -> None:
+    """Import every package module so each @register_stage side effect lands
+    in STAGE_REGISTRY (the same walk the test harness's registry sweeps use).
+    Called lazily on a from_json registry miss only — normal app flows have
+    already imported the stages they built their graphs from."""
+    import importlib
+    import pkgutil
+
+    import transmogrifai_tpu
+
+    for mod in pkgutil.walk_packages(transmogrifai_tpu.__path__,
+                                     prefix="transmogrifai_tpu."):
+        try:
+            importlib.import_module(mod.name)
+        except Exception:  # noqa: BLE001 — optional deps must not break load
+            continue
+
+
 def register_stage(cls):
     """Class decorator: add to the serialization registry."""
     STAGE_REGISTRY[cls.__name__] = cls
@@ -155,7 +173,14 @@ class Stage:
 
     @classmethod
     def from_json(cls, data: dict) -> "Stage":
-        klass = STAGE_REGISTRY[data["class"]]
+        klass = STAGE_REGISTRY.get(data["class"])
+        if klass is None:
+            # registration is an import side effect, so a standalone loader
+            # (`op monitor --model`, a bare WorkflowModel.load in a fresh
+            # process) may not have imported the defining module yet — walk
+            # the package once and retry before declaring the class unknown
+            _import_stage_modules()
+            klass = STAGE_REGISTRY[data["class"]]
         if "from_json" in klass.__dict__ and klass is not cls:
             # stages whose configuration lives outside ctor params (ModelSelector's
             # models/validator/splitter) restore it via their own from_json

@@ -51,6 +51,11 @@ class AppMetrics:
     #: counters (device_put transfers + bytes, psum-carrying dispatches) from
     #: mesh/mesh.py — None for unmeshed (single-device) runs
     mesh: Optional[dict] = None
+    #: unified metrics-registry snapshot (obs/metrics.py default_registry):
+    #: mesh placement counters, pipeline stall/stage seconds, serving routing
+    #: and latency histograms, drift gauges/alert counters. Cumulative
+    #: process-wide totals (the Prometheus contract), not per-run deltas.
+    metrics: Optional[dict] = None
 
     @property
     def app_duration_s(self) -> float:
@@ -71,6 +76,8 @@ class AppMetrics:
             out["trace"] = self.trace
         if self.mesh is not None:
             out["mesh"] = self.mesh
+        if self.metrics is not None:
+            out["metrics"] = self.metrics
         return out
 
 
@@ -90,6 +97,9 @@ class RunResult:
     #: per-stage seconds, host-stall vs backpressure, queue-depth gauge,
     #: pad-bucket histogram) — also merged into AppMetrics.trace
     pipeline: Optional[dict] = None
+    #: drift-monitor report for monitored score/streaming_score runs
+    #: (ServingMonitor.report(): per-feature fill/JS state + alerts)
+    monitor: Optional[dict] = None
 
 
 def write_table_csv(table: Table, path: str) -> None:
@@ -333,6 +343,10 @@ class WorkflowRunner:
             metrics.end_time = time.time()
             metrics.mesh = mesh_section(getattr(self, "_run_mesh", None),
                                         base=mesh_stats_before)
+            # the unified numeric-telemetry section: whatever the run pushed
+            # into the registry (mesh placements, pipeline stalls, serving
+            # routing/latency, drift gauges) in one Prometheus-shaped snapshot
+            metrics.metrics = obs.default_registry().snapshot() or None
             for h in self._end_handlers:
                 h(metrics)
         result.metrics_location = result.metrics_location or params.metrics_location
@@ -368,6 +382,16 @@ class WorkflowRunner:
         return RunResult("train", model_location=loc, metrics=train_metrics,
                          metrics_location=params.metrics_location)
 
+    def _build_monitor(self, model: WorkflowModel, params: OpParams):
+        """ServingMonitor for monitored runs (params.monitor / `op run
+        --monitor`), or None. A missing baseline is a loud setup error —
+        the user explicitly asked for drift monitoring."""
+        if not params.monitor:
+            return None
+        from ..obs.monitor import ServingMonitor
+
+        return ServingMonitor.for_model(model)
+
     def _load_model(self, params: OpParams) -> WorkflowModel:
         model = getattr(self, "_model", None)
         if model is None:
@@ -379,7 +403,21 @@ class WorkflowRunner:
     def _run_score(self, params: OpParams, mark) -> RunResult:
         model = self._load_model(params)
         mark("load_model")
-        scores = model.score(reader=self.score_reader, keep_intermediate=True)
+        monitor = self._build_monitor(model, params)
+        if monitor is None:
+            scores = model.score(reader=self.score_reader, keep_intermediate=True)
+        else:
+            # raw table generated once so the drift sketches fold the exact
+            # columns the plan scores (model.score would hide them)
+            reader = self.score_reader or model.reader
+            if reader is None:
+                raise ValueError("score run needs a score reader")
+            raw = model._generate_raw_for_scoring(reader)
+            # offline batch scoring: fetching reader-built device columns
+            # back is fine here (nothing latency-critical, and the scored
+            # output returns to the host for persistence anyway)
+            monitor.observe_table(raw, allow_device_fetch=True)
+            scores = model.transform(raw, keep_intermediate=True)
         mark("score")
         out = model.transform_select(scores)
         loc = params.write_location
@@ -396,7 +434,8 @@ class WorkflowRunner:
             self._write_metrics(eval_metrics, params.metrics_location)
             mark("evaluate")
         return RunResult("score", write_location=loc, metrics=eval_metrics,
-                         n_rows=out.nrows)
+                         n_rows=out.nrows,
+                         monitor=monitor.report() if monitor else None)
 
     def _run_features(self, params: OpParams, mark) -> RunResult:
         """Compute and persist just the raw features (OpWorkflowRunner.scala:190)."""
@@ -445,6 +484,7 @@ class WorkflowRunner:
         loc = params.write_location
         mesh = self._resolve_mesh(params)
         self._run_mesh = mesh
+        monitor = self._build_monitor(model, params)
         # per-raw-feature extraction plan derived ONCE per run: the
         # predictor/response split and kind lookups used to be rebuilt for
         # every batch (pure host-side work on the pipeline's critical path)
@@ -461,6 +501,16 @@ class WorkflowRunner:
         counts = {"rows": 0, "batches": 0}
 
         def prepare(batch):
+            if monitor is not None:
+                # drift sketches fold on the producer thread, pre-pad and
+                # pre-table-build: the numpy histogram pass overlaps the
+                # previous batch's device compute, and the monitor's own
+                # HOST columns never force a device fetch (the table built
+                # below is deliberately device-eager)
+                if isinstance(batch, Table):
+                    monitor.observe_table(batch, n=batch.nrows)
+                elif batch:
+                    monitor.observe_rows(batch)
             # building device columns (jnp.asarray) on the producer thread IS
             # the async H2D start: the transfer proceeds while the consumer
             # dispatches the previous batch's scoring program
@@ -504,7 +554,8 @@ class WorkflowRunner:
         mark("streaming_score")
         return RunResult("streaming_score", write_location=loc,
                          n_rows=counts["rows"], batches=stats.batches,
-                         pipeline=stats.to_dict())
+                         pipeline=stats.to_dict(),
+                         monitor=monitor.report() if monitor else None)
 
     @staticmethod
     def _write_metrics(metrics: Any, location: Optional[str]) -> None:

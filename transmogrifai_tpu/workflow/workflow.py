@@ -211,6 +211,25 @@ class Workflow(WorkflowCore):
         self._raw_filter = None  # RawFeatureFilter, wired via with_raw_feature_filter
         self._workflow_cv = False
         self._mesh = None  # device mesh, wired via with_mesh (None = auto)
+        # serving-baseline stamping (obs/monitor.py): every train computes
+        # per-raw-feature distributions on a bounded subsample and the model
+        # artifact carries them for serving-time drift monitoring
+        self._baseline_enabled = True
+        self._baseline_bins: Optional[int] = None
+        self._baseline_sample_rows: Optional[int] = None
+
+    def with_serving_baseline(self, enabled: bool = True,
+                              bins: Optional[int] = None,
+                              sample_rows: Optional[int] = None) -> "Workflow":
+        """Tune (or disable) the serving-baseline pass train() runs by
+        default: per-raw-feature fill rates + histograms, stamped into
+        model.json under "serving_baseline" for the ServingMonitor
+        (obs/monitor.py). `bins` sets the histogram resolution, `sample_rows`
+        caps the evenly-spaced row subsample the pass reads."""
+        self._baseline_enabled = enabled
+        self._baseline_bins = bins
+        self._baseline_sample_rows = sample_rows
+        return self
 
     def with_mesh(self, mesh) -> "Workflow":
         """Pin the device mesh multi-chip execution uses (mesh/mesh.py). By
@@ -394,6 +413,24 @@ class Workflow(WorkflowCore):
                 self._apply_blacklist(blacklisted)
         from .. import obs
 
+        serving_baseline: dict = {}
+        if self._baseline_enabled:
+            # after the raw filter: the baseline describes the features the
+            # model actually serves, binned over the (possibly filtered)
+            # training table. Sampled pass — never the train bottleneck.
+            from ..obs.monitor import (
+                BASELINE_BINS,
+                BASELINE_SAMPLE_ROWS,
+                compute_serving_baseline,
+            )
+
+            with obs.span("train:serving_baseline"):
+                serving_baseline = compute_serving_baseline(
+                    self.raw_features, data,
+                    bins=self._baseline_bins or BASELINE_BINS,
+                    sample_rows=(self._baseline_sample_rows
+                                 or BASELINE_SAMPLE_ROWS))
+
         ckpt = None
         if checkpoint_dir:
             from .phase_checkpoint import (
@@ -526,6 +563,7 @@ class Workflow(WorkflowCore):
         model.reader = self.reader
         # plan-time report rides along so save() stamps it without re-analysis
         model.analysis_report = analysis
+        model.serving_baseline = serving_baseline
         return model
 
 
@@ -587,6 +625,10 @@ class WorkflowModel(WorkflowCore):
         #: AnalysisReport from the producing train (None for loaded models;
         #: save() re-analyzes the fitted plan in that case)
         self.analysis_report = None
+        #: {raw feature name: FeatureDistribution} training baselines for the
+        #: serving drift monitor (obs/monitor.py) — stamped by train(), saved
+        #: under model.json "serving_baseline", restored by load()
+        self.serving_baseline: dict = {}
 
     # --- scoring (analog of OpWorkflowModel.score, scoreFn) ---------------------------
     def transform(self, table: Table, keep_intermediate: bool = False) -> Table:
@@ -648,18 +690,21 @@ class WorkflowModel(WorkflowCore):
     # --- serving (analog of OpWorkflowModelLocal.scoreFunction) -----------------------
     def score_fn(self, result_names: Optional[Sequence[str]] = None,
                  pad_to: Optional[Sequence[int]] = None,
-                 backend: Optional[str] = "auto", mesh=None):
+                 backend: Optional[str] = "auto", mesh=None, monitor=None):
         """Spark-free serving callable: dict -> dict for one record, .batch(rows) for
         many, .table(table) columnar; same stage kernels as training, jit-cached
         (no MLeap-style conversion). backend="auto" (default) routes small
         batches to the in-process host CPU-JAX plan (sub-ms/record — the
         reference's local-JVM deployment mode) and large ones to the device;
         backend="cpu"/None pin explicitly. `mesh` row-shards large device-lane
-        batches across chips (serve/scoring.py)."""
+        batches across chips (serve/scoring.py). `monitor=True` attaches a
+        ServingMonitor built from the model's stamped serving_baseline
+        (obs/monitor.py): scoring batches fold into drift sketches and
+        threshold crossings raise structured DriftAlerts."""
         from ..serve.scoring import score_function
 
         return score_function(self, result_names=result_names, pad_to=pad_to,
-                              backend=backend, mesh=mesh)
+                              backend=backend, mesh=mesh, monitor=monitor)
 
     # --- insights (analog of OpWorkflowModel.modelInsights / summaryPretty) -----------
     def model_insights(self, feature: Optional[Feature] = None):
@@ -729,6 +774,13 @@ class WorkflowModel(WorkflowCore):
             "blacklisted": [f.name for f in self.blacklisted],
             "stages": stage_payloads,
         }
+        if self.serving_baseline:
+            # training feature distributions (fill rate + histogram + bin
+            # edges) ride the artifact so a loaded model can drift-monitor
+            # its scoring traffic against exactly what it was trained on
+            from ..obs.monitor import baseline_to_json
+
+            manifest["serving_baseline"] = baseline_to_json(self.serving_baseline)
         with open(target, "w") as fh:
             json.dump(manifest, fh, indent=1)
         if arrays:
@@ -759,4 +811,9 @@ class WorkflowModel(WorkflowCore):
             stages=stages,
         )
         model.uid = manifest["uid"]
+        if manifest.get("serving_baseline"):
+            from ..obs.monitor import baseline_from_json
+
+            model.serving_baseline = baseline_from_json(
+                manifest["serving_baseline"])
         return model
