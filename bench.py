@@ -459,6 +459,7 @@ def _run(partial: dict) -> None:
             run_iris,
             run_mlp,
             run_monitor_overhead,
+            run_resilience_overhead,
             run_streaming_score,
             run_trees,
         )
@@ -488,6 +489,15 @@ def _run(partial: dict) -> None:
             detail["monitor_overhead"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         partial["monitor_throughput_retention"] = \
             detail["monitor_overhead"].get("monitor_throughput_retention")
+        # runtime fault-tolerance layer armed-vs-off on the same streamed
+        # scoring: the fault-free path must retain >= 0.97 throughput
+        try:
+            detail["resilience_overhead"] = run_resilience_overhead()
+        except Exception as e:  # noqa: BLE001
+            detail["resilience_overhead"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        partial["resilience_throughput_retention"] = \
+            detail["resilience_overhead"].get("resilience_throughput_retention")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({
@@ -558,6 +568,12 @@ def _run(partial: dict) -> None:
         mo = detail["monitor_overhead"]
         s["monitor_throughput_retention"] = mo["monitor_throughput_retention"]
         s["monitored_rows_per_sec"] = mo["monitored_rows_per_sec"]
+    if detail.get("resilience_overhead", {}).get(
+            "resilience_throughput_retention") is not None:
+        ro = detail["resilience_overhead"]
+        s["resilience_throughput_retention"] = \
+            ro["resilience_throughput_retention"]
+        s["resilience_armed_rows_per_sec"] = ro["armed_rows_per_sec"]
     _emit_final(compact)
 
 

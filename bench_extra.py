@@ -446,6 +446,81 @@ def run_monitor_overhead(n_batches: int = 32, batch: int = 512) -> dict:
     }
 
 
+def run_resilience_overhead(n_batches: int = 32, batch: int = 512) -> dict:
+    """Resilience-overhead lane: the same streamed-scoring run with the
+    runtime fault-tolerance layer OFF vs ON (`OpParams(retry_max=2,
+    quarantine_dir=...)` — ambient retry scope, quarantine-armed prepare/
+    compute, non-finite result scan) with ZERO injected faults. Reports
+    rows/s for both and `resilience_throughput_retention` = armed/off (1.0 =
+    free; the acceptance floor is 0.97 — the fault-free path must cost ~
+    nothing beyond counter increments). Also sanity-reports that nothing was
+    quarantined: in-distribution traffic must pass untouched."""
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import BatchStreamingReader, InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    schema = {"label": "RealNN", **{f"x{i}": "Real" for i in range(6)},
+              "cat": "PickList"}
+    rng = np.random.default_rng(13)
+
+    def rows(n, labeled=True):
+        out = []
+        for _ in range(n):
+            r = {f"x{i}": float(v)
+                 for i, v in enumerate(rng.normal(size=6))}
+            r["cat"] = "abcd"[int(rng.integers(0, 4))]
+            if labeled:
+                r["label"] = float(rng.random() > 0.5)
+            out.append(r)
+        return out
+
+    fs = features_from_schema(schema, response="label")
+    vec = transmogrify([f for n_, f in fs.items() if n_ != "label"])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    wf = Workflow().set_result_features(pred)
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(rows(1024)))
+    runner.run("train", OpParams())
+
+    batches = [rows(batch, labeled=False) for _ in range(n_batches)]
+    n_rows = n_batches * batch
+
+    def streamed(armed: bool) -> tuple[float, "dict | None"]:
+        out_dir = tempfile.mkdtemp(prefix="bench_resilience_")
+        qdir = tempfile.mkdtemp(prefix="bench_resilience_q_")
+        try:
+            runner.streaming_reader = BatchStreamingReader(
+                [list(b) for b in batches])
+            params = (OpParams(write_location=out_dir, retry_max=2,
+                               quarantine_dir=qdir) if armed
+                      else OpParams(write_location=out_dir))
+            t0 = time.perf_counter()
+            res = runner.run("streaming_score", params)
+            wall = time.perf_counter() - t0
+            assert res.n_rows == n_rows
+            return wall, res.quarantine
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+            shutil.rmtree(qdir, ignore_errors=True)
+
+    streamed(False)  # warm: compile the bucket-shape programs once
+    off_wall, _ = streamed(False)
+    on_wall, quarantine = streamed(True)
+    off_rps, on_rps = n_rows / off_wall, n_rows / on_wall
+    return {
+        "rows": n_rows, "batches": n_batches, "batch_size": batch,
+        "unarmed_rows_per_sec": round(off_rps),
+        "armed_rows_per_sec": round(on_rps),
+        "resilience_throughput_retention": round(on_rps / off_rps, 4),
+        "quarantined_fault_free": (quarantine or {}).get("rows", 0),
+    }
+
+
 def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
               max_depth: int = 6, n_bins: int = 64) -> dict:
     """Gradient-boosted trees at data scale: 1M rows x 256 features, n_trees
@@ -499,7 +574,8 @@ def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
 
 ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp,
        "trees": run_trees, "streaming": run_streaming_score,
-       "monitor": run_monitor_overhead}
+       "monitor": run_monitor_overhead,
+       "resilience": run_resilience_overhead}
 
 if __name__ == "__main__":
     import sys

@@ -103,6 +103,113 @@ def test_async_sink_error_reraises():
         sink.close()
 
 
+def test_racing_producer_error_vs_sink_close():
+    """A producer error arriving while the sink still holds a backlog: every
+    batch computed BEFORE the failure must be flushed (abandon drains the
+    writer), the error must re-raise in the caller, and no thread may be left
+    behind — the race the resilience layer's retry path sits on top of."""
+    flushed = []
+
+    def source():
+        for i in range(6):
+            yield i
+        raise ConnectionError("upstream died mid-stream")
+
+    def slow_sink(x):
+        time.sleep(0.02)  # writer lags: backlog exists when the error lands
+        flushed.append(x)
+
+    before = set(threading.enumerate())
+    with pytest.raises(ConnectionError, match="upstream died"):
+        run_pipeline(source(), lambda x: x, lambda x: x * 10, slow_sink,
+                     prefetch=2, sink_depth=2)
+    # the sink flushed its whole backlog before stopping: completed work is
+    # never discarded by an upstream failure
+    assert flushed == [x * 10 for x in range(6)]
+    # and no thread THIS pipeline started outlives it
+    assert not [t for t in threading.enumerate()
+                if t not in before and t.name.startswith("pipeline-")
+                and t.is_alive()]
+
+
+def test_prefetcher_policy_retries_transient_prepare_errors():
+    """Producer-stage retry (resilience.FaultPolicy): transient errors from
+    `fn` no longer kill the run via the error sentinel — they retry with
+    seeded backoff on the producer thread and the stream completes."""
+    from transmogrifai_tpu.resilience import FaultPolicy
+
+    attempts = {}
+
+    def flaky(x):
+        attempts[x] = attempts.get(x, 0) + 1
+        if x == 3 and attempts[x] <= 2:
+            raise OSError("transient ingest hiccup")
+        return x * 2
+
+    policy = FaultPolicy(retry_max=3, backoff_base_s=0.0)
+    with Prefetcher(range(8), flaky, depth=2, policy=policy) as pf:
+        assert list(pf) == [x * 2 for x in range(8)]
+    assert attempts[3] == 3  # two retries, then success
+
+
+def test_prefetcher_policy_budget_exhaustion_still_propagates():
+    from transmogrifai_tpu.resilience import FaultPolicy
+
+    def always_fail(x):
+        if x == 2:
+            raise OSError("persistently down")
+        return x
+
+    policy = FaultPolicy(retry_max=2, backoff_base_s=0.0)
+    got = []
+    with Prefetcher(range(8), always_fail, depth=2, policy=policy) as pf:
+        with pytest.raises(OSError, match="persistently down"):
+            for x in pf:
+                got.append(x)
+    assert got == [0, 1]  # in-order delivery up to the exhausted item
+
+
+def test_prefetcher_retry_never_retries_stream_closed():
+    """StreamClosed raised during a retried producer stage is terminal: the
+    retry loop must not spin on a queue that will never reopen."""
+    from transmogrifai_tpu.readers.streaming import QueueStreamingReader, StreamClosed
+    from transmogrifai_tpu.resilience import FaultPolicy
+
+    q = QueueStreamingReader()
+    q.close()
+    calls = {"n": 0}
+
+    def forward(x):
+        calls["n"] += 1
+        q.put([x])  # raises StreamClosed: the downstream queue is gone
+        return x
+
+    policy = FaultPolicy(retry_max=5, backoff_base_s=0.0)
+    with Prefetcher(range(4), forward, depth=2, policy=policy) as pf:
+        with pytest.raises(StreamClosed):
+            list(pf)
+    assert calls["n"] == 1  # exactly one attempt: no retry of a closed stream
+
+
+def test_run_pipeline_sync_path_honors_policy():
+    from transmogrifai_tpu.resilience import FaultPolicy
+
+    attempts = {"n": 0}
+
+    def flaky(x):
+        if x == 1:
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise TimeoutError("slow source")
+        return x
+
+    out = []
+    run_pipeline(range(4), flaky, lambda x: x, out.append, prefetch=0,
+                 policy=FaultPolicy(retry_max=1, backoff_base_s=0.0))
+    assert out == [0, 1, 2, 3]
+    assert attempts["n"] == 2
+
+
 # --- run_pipeline -----------------------------------------------------------------------
 def test_run_pipeline_matches_sync_path():
     def prepare(x):

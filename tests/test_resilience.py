@@ -1,0 +1,871 @@
+"""Runtime fault-tolerance layer (transmogrifai_tpu/resilience/): retry/
+backoff policy, circuit breaker, poison-batch quarantine, the deterministic
+chaos harness, and the acceptance bars — chaos determinism (same seed, same
+event sequence, byte-identical quarantine sidecar), fault-free bit-identity
+(resilience armed but no faults == today's output), and end-to-end breaker
+failover (persistent device failures: serving stays available on the CPU
+plan, breaker_state flips, half-open probing restores the device path)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    FaultInjector,
+    FaultPolicy,
+    InjectedDispatchError,
+    QuarantineWriter,
+    call_with_deadline,
+    isolate_failing,
+    retry_call,
+    scoped,
+)
+from transmogrifai_tpu.resilience.policy import io_guard
+
+
+# --- FaultPolicy / retry_call -----------------------------------------------------------
+def test_retry_recovers_after_transient_errors():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return "ok"
+
+    policy = FaultPolicy(retry_max=3, backoff_base_s=0.0)
+    assert retry_call(flaky, policy=policy, site="t") == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_budget_exhaustion_raises_last_error():
+    policy = FaultPolicy(retry_max=2, backoff_base_s=0.0)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError, match="down"):
+        retry_call(always, policy=policy, site="t")
+    assert calls["n"] == 3  # first attempt + 2 retries
+
+
+def test_retry_never_touches_data_errors():
+    calls = {"n": 0}
+
+    def poison():
+        calls["n"] += 1
+        raise ValueError("bad cell")
+
+    with pytest.raises(ValueError):
+        retry_call(poison, policy=FaultPolicy(retry_max=5), site="t")
+    assert calls["n"] == 1  # data errors are quarantine's job, not retry's
+
+
+def test_stream_closed_is_terminal_not_retried():
+    """StreamClosed during a retry loop must propagate immediately — a batch
+    rejected by a closed queue can never be accepted by retrying."""
+    from transmogrifai_tpu.readers.streaming import StreamClosed
+
+    calls = {"n": 0}
+
+    def closed():
+        calls["n"] += 1
+        raise StreamClosed("put() after close()")
+
+    with pytest.raises(StreamClosed):
+        retry_call(closed, policy=FaultPolicy(retry_max=5), site="t")
+    assert calls["n"] == 1
+
+
+def test_backoff_is_deterministic_and_bounded():
+    p = FaultPolicy(retry_max=5, backoff_base_s=0.1, backoff_cap_s=0.5,
+                    jitter=0.5, seed=7)
+    seq1 = [p.backoff_s("site", k) for k in range(5)]
+    seq2 = [p.backoff_s("site", k) for k in range(5)]
+    assert seq1 == seq2  # stateless: replays exactly
+    other = [p.backoff_s("other", k) for k in range(5)]
+    assert seq1 != other  # site decorrelates
+    for k, s in enumerate(seq1):
+        base = min(0.5, 0.1 * 2 ** k)
+        assert base * 0.5 <= s <= base
+
+
+def test_retry_metrics_and_sleep_schedule():
+    from transmogrifai_tpu import obs
+
+    reg = obs.default_registry()
+    before = reg.counter("resilience_retries_total",
+                         labels={"site": "metrics_t"}).value
+    slept = []
+    policy = FaultPolicy(retry_max=3, backoff_base_s=0.25, jitter=0.0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("x")
+        return 1
+
+    retry_call(flaky, policy=policy, site="metrics_t", sleep=slept.append)
+    assert slept == [0.25, 0.5]  # jitter 0: pure exponential
+    assert reg.counter("resilience_retries_total",
+                       labels={"site": "metrics_t"}).value == before + 2
+
+
+def test_io_guard_inert_without_policy_or_injector():
+    assert io_guard("ingest:open", lambda: 42) == 42
+
+
+def test_io_guard_uses_ambient_policy():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("blip")
+        return "data"
+
+    with scoped(FaultPolicy(retry_max=2, backoff_base_s=0.0)):
+        assert io_guard("ingest:open", flaky) == "data"
+    assert calls["n"] == 2
+
+
+# --- deadlines --------------------------------------------------------------------------
+def test_deadline_passes_fast_work_and_raises_on_breach():
+    import time
+
+    assert call_with_deadline(lambda: "v", deadline_s=5.0, site="t") == "v"
+    with pytest.raises(DeadlineExceeded):
+        call_with_deadline(lambda: time.sleep(0.5), deadline_s=0.05, site="t")
+    from transmogrifai_tpu import obs
+
+    assert obs.default_registry().counter(
+        "resilience_deadline_breaches_total", labels={"site": "t"}).value >= 1
+
+
+def test_deadline_propagates_worker_errors():
+    def boom():
+        raise RuntimeError("inside")
+
+    with pytest.raises(RuntimeError, match="inside"):
+        call_with_deadline(boom, deadline_s=1.0, site="t")
+
+
+# --- circuit breaker --------------------------------------------------------------------
+def test_breaker_trips_half_opens_and_recovers():
+    clock = {"t": 0.0}
+    b = CircuitBreaker(threshold=3, cooldown_s=10.0, name="unit_t",
+                       clock=lambda: clock["t"])
+    assert b.state == "closed" and b.allow()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()  # cooldown not elapsed
+    clock["t"] = 10.0
+    assert b.allow()  # half-open probe admitted
+    assert b.state == "half_open"
+    assert not b.allow()  # only ONE in-flight probe
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    clock = {"t": 0.0}
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, name="unit_t2",
+                       clock=lambda: clock["t"])
+    b.record_failure()
+    assert b.state == "open"
+    clock["t"] = 5.0
+    assert b.allow()
+    b.record_failure()  # probe fails
+    assert b.state == "open"
+    clock["t"] = 9.0
+    assert not b.allow()  # fresh cooldown from the failed probe
+    clock["t"] = 10.0
+    assert b.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(threshold=3, name="unit_t3")
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"  # never 3 CONSECUTIVE
+
+
+def test_breaker_gauge_tracks_state():
+    from transmogrifai_tpu import obs
+
+    b = CircuitBreaker(threshold=1, cooldown_s=1e9, name="unit_gauge")
+    g = obs.default_registry().gauge("breaker_state",
+                                     labels={"breaker": "unit_gauge"})
+    assert g.value == 0
+    b.record_failure()
+    assert g.value == 1
+
+
+# --- quarantine -------------------------------------------------------------------------
+def test_isolate_failing_lets_interrupts_abort():
+    """KeyboardInterrupt inside a probe must ABORT the bisect, never be
+    laundered into quarantined 'poison' rows the operator cannot stop."""
+    def probe(idx):
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        isolate_failing(8, probe)
+
+
+def test_isolate_failing_bisects_minimal_set():
+    bad_set = {3, 17, 18}
+    probes = []
+
+    def probe(idx):
+        probes.append(list(idx))
+        if any(i in bad_set for i in idx):
+            raise ValueError(f"poison in {idx}")
+
+    good, bad = isolate_failing(32, probe)
+    assert [i for i, _ in bad] == sorted(bad_set)
+    assert good == [i for i in range(32) if i not in bad_set]
+    assert len(probes) < 32  # bisection, not row-by-row
+
+
+def test_quarantine_writer_records_and_summary(tmp_path):
+    qw = QuarantineWriter(str(tmp_path))
+    n = qw.quarantine_rows([{"a": 1.5, "b": None}, {"a": float("nan")}],
+                           batch_index=4, stage="parse",
+                           errors=[ValueError("x"), None],
+                           row_indices=[7, 9])
+    assert n == 2
+    qw.quarantine_rows([{"c": 1}], batch_index=5, stage="nonfinite")
+    s = qw.summary()
+    assert s["rows"] == 3 and s["batches"] == 2
+    assert s["by_stage"] == {"parse": 2, "nonfinite": 1}
+    qw.close()
+    recs = [json.loads(ln) for ln in open(qw.path)]
+    assert [r["row"] for r in recs] == [7, 9, 0]
+    assert recs[0]["error"]["type"] == "ValueError"
+    assert recs[1]["record"]["a"] == "nan"  # NaN serialized as its repr
+    assert QuarantineWriter(str(tmp_path / "empty")).summary() is None
+
+
+# --- chaos harness ----------------------------------------------------------------------
+def test_injector_budgets_and_event_log():
+    inj = FaultInjector(seed=3, io_failures=2, device_failures=1)
+    from transmogrifai_tpu.resilience import InjectedIOError
+
+    with inj.installed():
+        for _ in range(2):
+            with pytest.raises(InjectedIOError):
+                inj.io("ingest:open")
+        inj.io("ingest:open")  # budget spent: succeeds
+        with pytest.raises(InjectedDispatchError):
+            inj.device("serve:dispatch")
+        inj.device("serve:dispatch")
+    assert inj.events == [("io_error", "ingest:open", 0),
+                          ("io_error", "ingest:open", 1),
+                          ("device_error", "serve:dispatch", 0)]
+
+
+def test_injector_single_install():
+    a, b = FaultInjector(0), FaultInjector(1)
+    with a.installed():
+        with pytest.raises(RuntimeError, match="already installed"):
+            b.installed().__enter__()
+
+
+def test_injector_corrupt_rows_is_pure_and_seeded():
+    rows = [{"x": 1.0, "y": "a"}, {"x": 2.0, "y": "b"}]
+    inj1 = FaultInjector(seed=5, poison_batches=(0,))
+    inj2 = FaultInjector(seed=5, poison_batches=(0,))
+    out1, out2 = inj1.corrupt(list(rows), 0), inj2.corrupt(list(rows), 0)
+    assert out1 == out2  # seeded: same row poisoned
+    assert rows[0]["x"] == 1.0 and rows[1]["x"] == 2.0  # originals untouched
+    assert any(r["x"] == "§poison§" for r in out1)
+    assert inj1.corrupt(rows, 3) is rows  # untargeted batch: passthrough
+
+
+# --- streamed scoring under faults ------------------------------------------------------
+SCHEMA = {"label": "RealNN", "x1": "Real", "cat": "PickList"}
+
+
+def _rows(n, seed=0, labeled=True, poison_at=(), nan_at=()):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        r = {"x1": float(rng.normal()), "cat": "abc"[int(rng.integers(0, 3))]}
+        if labeled:
+            r["label"] = float(rng.random() > 0.5)
+        if i in poison_at:
+            r["x1"] = "not-a-number"
+        if i in nan_at:
+            r["x1"] = float("nan")
+        out.append(r)
+    return out
+
+
+@pytest.fixture(scope="module")
+def trained_runner():
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    fs = features_from_schema(SCHEMA, response="label")
+    vec = transmogrify([fs["x1"], fs["cat"]])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    wf = Workflow().set_result_features(pred)
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(_rows(160)))
+    runner.run("train", OpParams())
+    return runner
+
+
+def _stream(runner, batches, out_dir, **param_kw):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import BatchStreamingReader
+
+    runner.streaming_reader = BatchStreamingReader([list(b) for b in batches])
+    res = runner.run("streaming_score",
+                     OpParams(write_location=str(out_dir), **param_kw))
+    parts = {}
+    for fname in sorted(os.listdir(out_dir)):
+        with open(os.path.join(out_dir, fname), "rb") as fh:
+            parts[fname] = fh.read()
+    return res, parts
+
+
+def test_fault_free_run_is_bit_identical_with_resilience_armed(tmp_path, trained_runner):
+    """The zero-overhead acceptance bar: armed resilience + no faults ==
+    byte-identical part files to the unarmed baseline, and nothing lands in
+    quarantine."""
+    batches = [_rows(n, seed=n) for n in (16, 7, 33)]
+    res0, parts0 = _stream(trained_runner, batches, tmp_path / "base")
+    res1, parts1 = _stream(trained_runner, batches, tmp_path / "armed",
+                           retry_max=3, quarantine_dir=str(tmp_path / "q"))
+    assert parts0 == parts1
+    assert res0.n_rows == res1.n_rows
+    assert res1.quarantine is None
+    assert not os.path.exists(tmp_path / "q" / "quarantine.jsonl")
+
+
+def test_poison_batch_quarantined_run_completes(tmp_path, trained_runner):
+    batches = [_rows(16, seed=1), _rows(16, seed=2, poison_at=(3, 11)),
+               _rows(16, seed=3)]
+    res, parts = _stream(trained_runner, batches, tmp_path / "out",
+                         quarantine_dir=str(tmp_path / "q"))
+    assert res.n_rows == 46  # 48 - 2 poisoned
+    assert res.quarantine["rows"] == 2
+    assert res.quarantine["by_stage"] == {"parse": 2}
+    assert len(parts) == 3  # every batch still produced a part
+    recs = [json.loads(ln)
+            for ln in open(tmp_path / "q" / "quarantine.jsonl")]
+    assert [(r["batch"], r["row"]) for r in recs] == [(1, 3), (1, 11)]
+    assert all(r["record"]["x1"] == "not-a-number" for r in recs)
+
+
+def test_nonfinite_scores_quarantined(tmp_path, trained_runner):
+    """A row that parses (NaN is a float) but scores non-finite is shed at
+    the result-scan stage."""
+    batches = [_rows(16, seed=4, nan_at=(5,))]
+    res, parts = _stream(trained_runner, batches, tmp_path / "out",
+                         quarantine_dir=str(tmp_path / "q"))
+    assert res.n_rows == 15
+    assert res.quarantine["by_stage"] == {"nonfinite": 1}
+    recs = [json.loads(ln)
+            for ln in open(tmp_path / "q" / "quarantine.jsonl")]
+    assert [(r["batch"], r["row"]) for r in recs] == [(0, 5)]
+
+
+def test_fully_poisoned_batch_quarantines_whole_batch(tmp_path, trained_runner):
+    """EVERY row of a batch failing parse: the run must still complete (the
+    n=0 table flows through compute/shed without the empty-reshape crash),
+    shedding the whole batch and keeping the healthy ones."""
+    batches = [_rows(4, seed=1),
+               _rows(3, seed=2, poison_at=(0, 1, 2)),
+               _rows(4, seed=3)]
+    res, parts = _stream(trained_runner, batches, tmp_path / "out",
+                         quarantine_dir=str(tmp_path / "q"))
+    assert res.n_rows == 8
+    assert res.quarantine["rows"] == 3
+    assert res.quarantine["by_stage"] == {"parse": 3}
+
+
+def test_default_knobs_fail_fast_on_transient_dispatch(tmp_path, trained_runner):
+    """With EVERY resilience knob at its default, a transient dispatch error
+    must propagate immediately — no silent whole-batch second chance."""
+    import time as _time  # noqa: F401
+
+    model = trained_runner._model
+    real_score = model.score
+    state = {"calls": 0}
+
+    def flaky_score(*a, **kw):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            raise TimeoutError("transient blip")
+        return real_score(*a, **kw)
+
+    model.score = flaky_score
+    try:
+        with pytest.raises(TimeoutError):
+            _stream(trained_runner, [_rows(4, seed=1)], tmp_path / "out")
+    finally:
+        del model.score
+    assert state["calls"] == 1  # fail fast: exactly one attempt
+
+
+def test_without_quarantine_poison_still_fails_fast(tmp_path, trained_runner):
+    batches = [_rows(8, seed=1), _rows(8, seed=2, poison_at=(0,))]
+    with pytest.raises(Exception):
+        _stream(trained_runner, batches, tmp_path / "out")
+
+
+def test_chaos_streaming_determinism(tmp_path, trained_runner):
+    """Same injector seed/config -> identical event sequence AND a byte-
+    identical quarantine sidecar, run after run."""
+    batches = [_rows(16, seed=s) for s in (1, 2, 3, 4)]
+
+    def chaos_run(tag):
+        inj = FaultInjector(seed=0, io_failures=1, poison_batches=(1,),
+                            torn_batches=(3,))
+        with inj.installed():
+            res, parts = _stream(trained_runner, batches, tmp_path / tag,
+                                 retry_max=3,
+                                 quarantine_dir=str(tmp_path / f"q_{tag}"))
+        sidecar = open(tmp_path / f"q_{tag}" / "quarantine.jsonl",
+                       "rb").read()
+        return inj.events, res, parts, sidecar
+
+    ev1, res1, parts1, side1 = chaos_run("a")
+    ev2, res2, parts2, side2 = chaos_run("b")
+    assert ev1 == ev2
+    assert side1 == side2
+    assert parts1 == parts2
+    assert res1.quarantine == {**res2.quarantine,
+                               "path": res1.quarantine["path"]}
+    kinds = [e[0] for e in ev1]
+    assert kinds.count("poison") == 1 and kinds.count("torn") == 1
+    assert res1.quarantine["rows"] == 2  # one poisoned + one torn row
+    assert res1.n_rows == 62
+
+
+def test_chaos_transient_io_recovered_by_retries(tmp_path, trained_runner):
+    """Injected transient IO errors at the reader-open site are absorbed by
+    the ambient retry policy: the run completes with full output."""
+    import csv as _csv
+
+    from transmogrifai_tpu.readers.streaming import CSVStreamingReader
+
+    stream_dir = tmp_path / "stream"
+    os.makedirs(stream_dir)
+    batches = [_rows(8, seed=s, labeled=False) for s in (1, 2)]
+    for b, rows in enumerate(batches):
+        with open(stream_dir / f"b{b}.csv", "w", newline="") as fh:
+            w = _csv.DictWriter(fh, fieldnames=["x1", "cat"])
+            w.writeheader()
+            w.writerows(rows)
+    from transmogrifai_tpu.params import OpParams
+
+    trained_runner.streaming_reader = CSVStreamingReader(str(stream_dir))
+    inj = FaultInjector(seed=0, io_failures=2)
+    with inj.installed():
+        res = trained_runner.run("streaming_score", OpParams(
+            write_location=str(tmp_path / "out"), retry_max=3))
+    assert res.n_rows == 16  # nothing lost
+    assert [e[0] for e in inj.events] == ["io_error", "io_error"]
+    # without retries the same schedule kills the run
+    trained_runner.streaming_reader = CSVStreamingReader(str(stream_dir))
+    inj2 = FaultInjector(seed=0, io_failures=2)
+    from transmogrifai_tpu.resilience import InjectedIOError
+
+    with inj2.installed(), pytest.raises(InjectedIOError):
+        trained_runner.run("streaming_score", OpParams(
+            write_location=str(tmp_path / "out2")))
+
+
+def test_transient_dispatch_blip_survives_without_quarantine(tmp_path, trained_runner):
+    """--deadline-s (or any transient dispatch fault) WITHOUT quarantine:
+    one whole-batch retry absorbs a blip; a persistent fault fails the run
+    fast (no hang, no silent row loss) rather than being masked."""
+    batches = [_rows(8, seed=1), _rows(8, seed=2)]
+    # blip: one injected TimeoutError-class fault -> retry clears it.
+    # InjectedDispatchError is RuntimeError (not transient), so use the
+    # deadline path's own class via a slow wedge: simpler — monkey-level
+    # wedge on model.score for exactly one call under a deadline.
+    import time as _time
+
+    model = trained_runner._model
+    real_score = model.score
+    state = {"calls": 0}
+
+    def blip_score(*a, **kw):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            _time.sleep(0.3)
+        return real_score(*a, **kw)
+
+    model.score = blip_score
+    try:
+        res, parts = _stream(trained_runner, batches, tmp_path / "out",
+                             deadline_s=0.05)
+    finally:
+        del model.score
+    assert res.n_rows == 16 and res.quarantine is None  # blip absorbed
+
+    # persistent wedge, still no quarantine: the run FAILS (fast) instead
+    # of hanging or silently dropping the batch
+    from transmogrifai_tpu.resilience import DeadlineExceeded
+
+    model.score = lambda *a, **kw: (_time.sleep(0.3), real_score(*a, **kw))[1]
+    try:
+        with pytest.raises(DeadlineExceeded):
+            _stream(trained_runner, batches, tmp_path / "out2",
+                    deadline_s=0.05)
+    finally:
+        del model.score
+
+
+def test_stream_dispatch_faults_recovered_without_data_loss(tmp_path, trained_runner):
+    """Two injected dispatch failures on the same batch: the whole-batch
+    retry fails too, the row-bisect probes (which bypass the chaos device
+    hook — they test DATA, not the device) find every row clean, and the
+    batch is re-scored in full. Nothing quarantined, nothing lost."""
+    batches = [_rows(8, seed=1), _rows(8, seed=2)]
+    inj = FaultInjector(seed=0, device_failures=2)
+    with inj.installed():
+        res, parts = _stream(trained_runner, batches, tmp_path / "out",
+                             quarantine_dir=str(tmp_path / "q"))
+    assert res.n_rows == 16
+    assert res.quarantine is None
+    assert [e[0] for e in inj.events] == ["device_error", "device_error"]
+
+
+def test_double_deadline_breach_quarantines_whole_batch(tmp_path, trained_runner):
+    """A dispatch that blows its deadline twice is a wedged DEVICE, not data
+    poison: the whole batch quarantines as stage="deadline" (bisect probes
+    run deadline-free and could hang on a truly wedged backend) and the run
+    completes with the healthy batches' output."""
+    import time as _time
+
+    model = trained_runner._model
+    real_score = model.score
+    state = {"calls": 0}
+
+    def wedged_score(*a, **kw):
+        state["calls"] += 1
+        if state["calls"] <= 2:  # first batch: dispatch + its retry wedge
+            _time.sleep(0.3)
+        return real_score(*a, **kw)
+
+    model.score = wedged_score
+    try:
+        batches = [_rows(8, seed=1), _rows(8, seed=2)]
+        res, parts = _stream(trained_runner, batches, tmp_path / "out",
+                             deadline_s=0.05,
+                             quarantine_dir=str(tmp_path / "q"))
+    finally:
+        del model.score
+    assert res.n_rows == 8  # second batch survived
+    assert res.quarantine["by_stage"] == {"deadline": 8}
+    recs = [json.loads(ln)
+            for ln in open(tmp_path / "q" / "quarantine.jsonl")]
+    assert all(r["error"]["type"] == "DeadlineExceeded" for r in recs)
+    from transmogrifai_tpu import obs
+
+    assert obs.default_registry().counter(
+        "resilience_deadline_breaches_total",
+        labels={"site": "stream:dispatch"}).value >= 2
+
+
+# --- serving breaker end-to-end ---------------------------------------------------------
+def test_breaker_failover_end_to_end(trained_runner):
+    """Persistent device failures: every request still succeeds (CPU plan),
+    breaker_state flips OPEN in the metrics snapshot, and once injection
+    stops a half-open probe restores the device path."""
+    from transmogrifai_tpu import obs
+
+    model = trained_runner._model
+    fn = model.score_fn()  # backend="auto" -> breaker attached
+    clock = {"t": 0.0}
+    fn._breaker = CircuitBreaker(threshold=2, cooldown_s=30.0,
+                                 name="e2e_t", clock=lambda: clock["t"])
+    records = [dict(r) for r in _rows(4, seed=9, labeled=False)]
+    want = fn.batch(records)  # healthy baseline
+
+    inj = FaultInjector(seed=0, device_failures=100)  # persistent outage
+    with inj.installed():
+        outs = [fn.batch(records) for _ in range(6)]
+    assert all(o == want for o in outs)  # availability: zero request errors
+    assert fn._breaker.state == "open"
+    gauge = obs.default_registry().gauge("breaker_state",
+                                         labels={"breaker": "e2e_t"})
+    assert gauge.value == 1.0  # flipped in the snapshot
+    # open breaker routes WITHOUT consuming injector budget: only the first
+    # two dispatches (threshold) ever touched the failing device lane
+    assert len(inj.events) == 2
+
+    # cooldown elapses while the fault is still present: probe fails, reopens
+    clock["t"] = 31.0
+    with inj.installed():
+        assert fn.batch(records) == want
+    assert fn._breaker.state == "open"
+
+    # injection stops (outage over): next probe heals the breaker
+    clock["t"] = 62.0
+    assert fn.batch(records) == want
+    assert fn._breaker.state == "closed"
+    assert gauge.value == 0.0
+
+
+def test_breaker_trip_during_stream(trained_runner):
+    """Breaker trips mid-stream: remaining batches ride the CPU plan, the
+    stream yields correct results throughout."""
+    model = trained_runner._model
+    fn = model.score_fn()
+    fn._breaker = CircuitBreaker(threshold=2, cooldown_s=1e9, name="stream_t")
+    batches = [_rows(6, seed=s, labeled=False) for s in (1, 2, 3, 4, 5)]
+    want = [fn.batch(list(b)) for b in batches]
+    inj = FaultInjector(seed=0, device_failures=100)
+    with inj.installed():
+        got = list(fn.stream(iter([list(b) for b in batches]), prefetch=2))
+    assert got == want
+    assert fn._breaker.state == "open"
+
+
+def test_stream_quarantine_yields_none_placeholders(tmp_path, trained_runner):
+    model = trained_runner._model
+    fn = model.score_fn(
+        policy=FaultPolicy(quarantine_dir=str(tmp_path / "q")))
+    batches = [_rows(6, seed=1, labeled=False),
+               _rows(6, seed=2, labeled=False, poison_at=(2,), nan_at=(4,))]
+    got = list(fn.stream(iter([list(b) for b in batches]), prefetch=2))
+    assert len(got[0]) == 6 and all(r is not None for r in got[0])
+    assert len(got[1]) == 6
+    assert got[1][2] is None and got[1][4] is None  # explicit absence
+    assert all(got[1][i] is not None for i in (0, 1, 3, 5))
+    s = fn.quarantine_summary()
+    assert s["rows"] == 2 and s["by_stage"] == {"parse": 1, "nonfinite": 1}
+
+
+def test_half_open_probe_hitting_poison_does_not_wedge_breaker(trained_runner):
+    """A probe batch that fails with a DATA error is inconclusive for the
+    lane: the probe slot must be released (abort_probe), not consumed — else
+    the breaker pins in HALF_OPEN forever and the device path never heals."""
+    model = trained_runner._model
+    fn = model.score_fn()
+    clock = {"t": 0.0}
+    fn._breaker = CircuitBreaker(threshold=1, cooldown_s=10.0, name="wedge_t",
+                                 clock=lambda: clock["t"])
+    healthy = [dict(r) for r in _rows(2, seed=9, labeled=False)]
+    want = fn.batch(healthy)
+    with FaultInjector(seed=0, device_failures=1).installed():
+        assert fn.batch(healthy) == want  # trips (threshold 1) + fails over
+    assert fn._breaker.state == "open"
+    clock["t"] = 11.0  # cooldown elapsed: next device-lane batch is the probe
+    with pytest.raises(ValueError):
+        fn.batch([{"x1": "not-a-number", "cat": "a", "label": 1.0}])
+    assert fn._breaker.state == "half_open"
+    # the probe slot was released: a healthy batch can probe and heal
+    assert fn.batch(healthy) == want
+    assert fn._breaker.state == "closed"
+
+
+def test_quarantine_counts_distinct_batches(tmp_path):
+    qw = QuarantineWriter(str(tmp_path))
+    qw.quarantine_rows([{"a": 1}], batch_index=7, stage="parse")
+    qw.quarantine_rows([{"a": 2}], batch_index=7, stage="nonfinite")
+    s = qw.summary()
+    assert s["rows"] == 2 and s["batches"] == 1  # one AFFECTED batch
+
+
+def test_data_errors_never_trip_the_breaker(trained_runner):
+    """Poison requests (ValueError from the plan) must re-raise untouched:
+    bad client data failing N requests in a row must not evict a healthy
+    device lane behind a 30s-cooldown breaker."""
+    model = trained_runner._model
+    fn = model.score_fn()
+    fn._breaker = CircuitBreaker(threshold=2, cooldown_s=1e9, name="data_t")
+    poison = [{"x1": "not-a-number", "cat": "a", "label": 1.0}]
+    for _ in range(4):
+        with pytest.raises(ValueError):
+            fn.batch(poison)
+    assert fn._breaker.state == "closed"
+    # and the device lane still serves healthy traffic directly
+    assert fn.batch([dict(r) for r in _rows(2, seed=9, labeled=False)])
+
+
+def test_score_run_honors_retry_policy(tmp_path, trained_runner):
+    """`op run --type score --retry-max N` must retry reader opens too — the
+    ambient policy scope covers every run type, not just streaming_score."""
+    import csv as _csv
+
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import CSVReader
+    from transmogrifai_tpu.resilience import InjectedIOError
+
+    path = tmp_path / "score.csv"
+    with open(path, "w", newline="") as fh:
+        w = _csv.DictWriter(fh, fieldnames=["label", "x1", "cat"])
+        w.writeheader()
+        for r in _rows(8, seed=3):
+            w.writerow(r)
+    trained_runner.score_reader = CSVReader(str(path), SCHEMA)
+    try:
+        inj = FaultInjector(seed=0, io_failures=2)
+        with inj.installed():
+            res = trained_runner.run("score", OpParams(
+                write_location=str(tmp_path / "out.csv"), retry_max=3))
+        assert res.n_rows == 8
+        assert [e[0] for e in inj.events] == ["io_error", "io_error"]
+        # fail-fast without the knob: enough failures to exhaust the
+        # native -> numpy -> record fallback chain (each layer eats one
+        # OSError by design) kill the run
+        trained_runner.score_reader = CSVReader(str(path), SCHEMA)
+        with FaultInjector(seed=0, io_failures=3).installed(), \
+                pytest.raises(InjectedIOError):
+            trained_runner.run("score", OpParams(
+                write_location=str(tmp_path / "out2.csv")))
+    finally:
+        trained_runner.score_reader = None
+
+
+def test_abandoned_stream_releases_probe_slot(trained_runner):
+    """A stream torn down between prep()'s routing (which may hold the
+    half-open probe slot) and its dispatch must release the slot on
+    generator close — else the breaker wedges in HALF_OPEN forever."""
+    model = trained_runner._model
+    fn = model.score_fn()
+    clock = {"t": 0.0}
+    fn._breaker = CircuitBreaker(threshold=1, cooldown_s=10.0, name="aband_t",
+                                 clock=lambda: clock["t"])
+    healthy = [dict(r) for r in _rows(2, seed=9, labeled=False)]
+    want = fn.batch(healthy)
+    with FaultInjector(seed=0, device_failures=1).installed():
+        fn.batch(healthy)
+    assert fn._breaker.state == "open"
+    clock["t"] = 11.0
+    gen = fn.stream(iter([list(healthy)] * 4), prefetch=2)
+    next(gen)       # prep consumed the probe slot for some batch
+    gen.close()     # abandoned mid-stream
+    # the slot was released: a fresh healthy request can probe and heal
+    assert fn.batch(healthy) == want
+    assert fn._breaker.state == "closed"
+
+
+def test_quarantine_indices_map_to_original_rows_after_parse_shed(
+        tmp_path, trained_runner):
+    """A batch shedding at parse AND nonfinite stages must record ORIGINAL
+    batch positions for both — the nonfinite index must not be renumbered
+    into the parse-surviving subset."""
+    batches = [_rows(10, seed=6, poison_at=(2,), nan_at=(7,))]
+    res, _ = _stream(trained_runner, batches, tmp_path / "out",
+                     quarantine_dir=str(tmp_path / "q"))
+    assert res.n_rows == 8
+    recs = [json.loads(ln) for ln in open(tmp_path / "q" / "quarantine.jsonl")]
+    assert [(r["stage"], r["row"]) for r in recs] == [("parse", 2),
+                                                      ("nonfinite", 7)]
+    assert res.quarantine["batches"] == 1  # one AFFECTED batch, two stages
+
+
+def test_stream_batch_indices_unique_across_calls(tmp_path, trained_runner):
+    """Two stream() calls on one handle share the sidecar: their batch
+    ordinals must not collide, so distinct-batch accounting stays honest."""
+    model = trained_runner._model
+    fn = model.score_fn(
+        policy=FaultPolicy(quarantine_dir=str(tmp_path / "q")))
+    bad = _rows(4, seed=2, labeled=False, poison_at=(1,))
+    list(fn.stream(iter([list(bad)]), prefetch=0))
+    list(fn.stream(iter([list(bad)]), prefetch=0))
+    s = fn.quarantine_summary()
+    assert s["rows"] == 2 and s["batches"] == 2
+    recs = [json.loads(ln) for ln in open(tmp_path / "q" / "quarantine.jsonl")]
+    assert recs[0]["batch"] != recs[1]["batch"]
+
+
+# --- atomic model save ------------------------------------------------------------------
+def test_kill_mid_save_leaves_previous_model_loadable(tmp_path, trained_runner, monkeypatch):
+    """A crash mid-save must never leave a torn, half-loadable model dir:
+    the manifest is written to a temp file and published with os.replace."""
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    model = trained_runner._model
+    path = str(tmp_path / "model")
+    model.save(path)
+    before = open(os.path.join(path, "model.json"), "rb").read()
+
+    real_dump = json.dump
+    state = {"writes": 0}
+
+    def dying_dump(obj, fh, **kw):
+        # emit a torn prefix, then die — the classic kill-mid-write
+        fh.write('{"version": 1, "uid": "TORN')
+        fh.flush()
+        raise KeyboardInterrupt("killed mid-save")
+
+    monkeypatch.setattr(json, "dump", dying_dump)
+    with pytest.raises(KeyboardInterrupt):
+        model.save(path, overwrite=True)
+    monkeypatch.setattr(json, "dump", real_dump)
+
+    assert open(os.path.join(path, "model.json"), "rb").read() == before
+    assert not [f for f in os.listdir(path) if ".tmp." in f]  # no debris
+    loaded = WorkflowModel.load(path)
+    assert loaded.uid == model.uid
+    # and a healthy save still round-trips
+    model.save(path, overwrite=True)
+    assert WorkflowModel.load(path).uid == model.uid
+
+
+def test_kill_between_npz_and_manifest_keeps_old_model(tmp_path, trained_runner, monkeypatch):
+    """RESAVE atomicity: a crash after the new npz lands but before the new
+    manifest must keep the OLD model fully loadable with its OWN arrays —
+    a new-arrays/old-manifest mix can never be served (generation-named
+    sidecars; the manifest's os.replace is the single publish point)."""
+    import numpy as np
+
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    model = trained_runner._model
+    path = str(tmp_path / "model")
+    # force the fitted params into the npz sidecar
+    monkeypatch.setattr(WorkflowModel, "_NPZ_THRESHOLD", 1)
+    model.save(path)
+    manifest_before = open(os.path.join(path, "model.json"), "rb").read()
+    npz_before = [f for f in os.listdir(path) if f.endswith(".npz")]
+    assert len(npz_before) == 1 and npz_before[0].startswith("params-")
+    want = WorkflowModel.load(path)
+
+    real_replace = os.replace
+
+    def dying_replace(src, dst):
+        if dst.endswith("model.json"):
+            raise KeyboardInterrupt("killed between npz and manifest")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", dying_replace)
+    with pytest.raises(KeyboardInterrupt):
+        model.save(path, overwrite=True)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    # old manifest intact, its own npz still on disk -> old model loads
+    assert open(os.path.join(path, "model.json"), "rb").read() == manifest_before
+    assert npz_before[0] in os.listdir(path)
+    loaded = WorkflowModel.load(path)
+    assert loaded.uid == want.uid
+    # orphan new-generation npz (if any) is inert debris, swept on the next
+    # healthy save, which round-trips to identical scores
+    model.save(path, overwrite=True)
+    reloaded = WorkflowModel.load(path)
+    assert len([f for f in os.listdir(path) if f.endswith(".npz")]) == 1
+    recs = [dict(r) for r in _rows(3, seed=5, labeled=False)]
+    a, b = want.score_fn(backend="cpu"), reloaded.score_fn(backend="cpu")
+    assert a.batch(recs) == b.batch(recs)

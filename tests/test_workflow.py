@@ -143,7 +143,14 @@ def test_save_load_large_params_npz(tmp_path):
     table = InMemoryReader(rows).generate_table(list(fs.values()))
     model = Workflow().set_result_features(pred).train(table=table)
     model.save(str(tmp_path / "m"))
-    assert os.path.exists(tmp_path / "m" / "params.npz")  # leaves moved out of JSON
+    # leaves moved out of JSON into the generation-named sidecar the
+    # manifest references (atomic resave: see WorkflowModel.save)
+    npz = [f for f in os.listdir(tmp_path / "m") if f.endswith(".npz")]
+    assert len(npz) == 1 and npz[0].startswith("params-")
+    import json as _json
+
+    with open(tmp_path / "m" / "model.json") as fh:
+        assert _json.load(fh)["arrays_file"] == npz[0]
     loaded = WorkflowModel.load(str(tmp_path / "m"))
     a = model.score(table=table, keep_intermediate=True)
     b = loaded.score(table=table, keep_intermediate=True)

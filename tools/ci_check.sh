@@ -48,7 +48,11 @@ echo "== multichip mesh smoke =="
 # scaling bench in quick mode. Everything runs on CPU virtual devices.
 XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
     python -m pytest tests/test_multichip.py -q -p no:cacheprovider
-python bench_multichip.py --quick > /tmp/_multichip_ci.json.out
+# --out persists the scaling tables unconditionally (bench_multichip writes
+# the same payload even when stdout capture is lossy), so the diff below
+# always has a populated record to gate on
+python bench_multichip.py --quick --out /tmp/_multichip_new.json \
+    > /tmp/_multichip_ci.json.out
 tail -1 /tmp/_multichip_ci.json.out
 # absolute floor (the acceptance criterion): the gated stats/scoring lanes
 # must hold scaling_efficiency >= 0.6 on the 8 forced host devices
@@ -68,8 +72,7 @@ print("multichip efficiency floor ok: value=%s" % doc.get("value"))
 # carry no metrics and are skipped via --allow-empty)
 # shellcheck disable=SC2012,SC2207
 MC=( $(ls MULTICHIP_r*.json 2>/dev/null | sort | tail -1) )
-if [ "${#MC[@]}" -eq 1 ]; then
-    tail -1 /tmp/_multichip_ci.json.out > /tmp/_multichip_new.json
+if [ "${#MC[@]}" -eq 1 ] && [ -s /tmp/_multichip_new.json ]; then
     if [ "${CI_BENCH_STRICT:-0}" = "1" ]; then
         python tools/bench_diff.py --allow-empty "${MC[0]}" /tmp/_multichip_new.json
     else
@@ -77,6 +80,83 @@ if [ "${#MC[@]}" -eq 1 ]; then
             || echo "(multichip regression vs ${MC[0]}; rerun with CI_BENCH_STRICT=1 to enforce)"
     fi
 fi
+
+echo "== chaos smoke (resilience) =="
+# streamed scoring of titanic-schema traffic under FaultInjector(seed=0):
+# injected transient IO errors must be absorbed by retries, the injected
+# poison batch must shed EXACTLY its poisoned row to quarantine.jsonl, and
+# the run must complete with a partial-success summary — zero crash. (The
+# model is a fast single-LR workflow over examples.titanic's schema: the
+# full CV selector is minutes of compile on cold CI, and the fault layer
+# under test is identical either way.)
+python - <<'PY'
+import csv, os, random, tempfile
+
+from examples.titanic import FIELDS, SCHEMA
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.readers import CSVReader
+from transmogrifai_tpu.readers.streaming import CSVStreamingReader
+from transmogrifai_tpu.resilience import FaultInjector
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+rng = random.Random(0)
+work = tempfile.mkdtemp(prefix="chaos_smoke_")
+
+
+def passenger(i):
+    return [i, int(rng.random() > 0.55), rng.choice("123"), f"Name {i}",
+            rng.choice(["male", "female"]), round(rng.uniform(1, 70), 1),
+            rng.randint(0, 3), rng.randint(0, 2), f"T{i % 40}",
+            round(rng.uniform(5, 100), 2), "", rng.choice(["S", "C", "Q"])]
+
+
+train_csv = os.path.join(work, "train.csv")
+with open(train_csv, "w", newline="") as fh:
+    w = csv.writer(fh)
+    for i in range(160):
+        w.writerow(passenger(i))
+
+stream_dir = os.path.join(work, "stream")
+os.makedirs(stream_dir)
+for b in range(4):
+    with open(os.path.join(stream_dir, f"batch-{b}.csv"), "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(FIELDS)
+        for i in range(16):
+            w.writerow(passenger(1000 + b * 16 + i))
+
+fs = features_from_schema(SCHEMA, response="survived")
+predictors = [f for n, f in fs.items() if n not in ("id", "survived")]
+pred = LogisticRegression(l2=0.1)(fs["survived"], transmogrify(predictors))
+runner = WorkflowRunner(
+    Workflow().set_result_features(pred),
+    train_reader=CSVReader(train_csv, SCHEMA, has_header=False,
+                           field_names=FIELDS),
+    streaming_reader=CSVStreamingReader(stream_dir),
+)
+runner.run("train", OpParams())
+
+qdir, out = os.path.join(work, "q"), os.path.join(work, "out")
+inj = FaultInjector(seed=0, io_failures=2, poison_batches=(1,))
+with inj.installed():
+    res = runner.run("streaming_score", OpParams(
+        write_location=out, retry_max=3, quarantine_dir=qdir))
+
+kinds = [e[0] for e in inj.events]
+assert kinds.count("io_error") == 2, inj.events
+assert "poison" in kinds, inj.events
+assert res.n_rows == 63, res.n_rows          # 64 streamed - 1 poisoned
+assert res.quarantine and res.quarantine["rows"] == 1, res.quarantine
+assert res.quarantine["by_stage"] == {"parse": 1}, res.quarantine
+assert os.path.exists(os.path.join(qdir, "quarantine.jsonl"))
+assert len(os.listdir(out)) == 4             # every batch produced a part
+print(f"chaos smoke ok: {len(inj.events)} faults injected, "
+      f"{res.quarantine['rows']} row quarantined, run completed "
+      f"({res.n_rows} rows scored)")
+PY
 
 echo "== bench regression gate =="
 # Every scalar in the bench summary is gated, including the streaming_score

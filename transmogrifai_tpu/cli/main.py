@@ -48,6 +48,28 @@ def _cmd_run(argv) -> int:
                          "feature-drift sketches against the model's stamped "
                          "serving_baseline and report per-feature fill-rate/"
                          "JS-divergence + structured drift alerts")
+    ap.add_argument("--retry-max", type=int, default=None, metavar="N",
+                    help="retries (seeded-jitter exponential backoff) for "
+                         "transient host-side ingest errors; default 0 = "
+                         "fail fast (docs/robustness.md)")
+    ap.add_argument("--deadline-s", type=float, default=None, metavar="SEC",
+                    help="per-dispatch deadline on the device-compute stage "
+                         "of streamed scoring: a breach fails the dispatch "
+                         "(retried once) instead of wedging the run forever; "
+                         "pair with --quarantine-dir to shed the batch and "
+                         "keep the run alive, else a persistent breach fails "
+                         "the run fast")
+    ap.add_argument("--quarantine-dir", default=None, metavar="DIR",
+                    help="enable poison-batch quarantine: rows that fail "
+                         "parse/scoring or produce non-finite scores are "
+                         "row-bisect isolated into DIR/quarantine.jsonl and "
+                         "the run completes with a partial-success summary")
+    ap.add_argument("--chaos-seed", type=int, default=None, metavar="SEED",
+                    help="chaos drill: run under FaultInjector.default_"
+                         "schedule(SEED) — two transient IO errors, one "
+                         "poison batch, one slow batch on a reproducible "
+                         "schedule (pair with --quarantine-dir and "
+                         "--retry-max so the run survives what it injects)")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.params import OpParams
@@ -57,6 +79,12 @@ def _cmd_run(argv) -> int:
         params.lenient_lint = True
     if args.monitor:
         params.monitor = True
+    if args.retry_max is not None:
+        params.retry_max = args.retry_max
+    if args.deadline_s is not None:
+        params.deadline_s = args.deadline_s
+    if args.quarantine_dir is not None:
+        params.quarantine_dir = args.quarantine_dir
     if args.mesh is not None:
         from transmogrifai_tpu.mesh import parse_mesh_shape
 
@@ -73,22 +101,37 @@ def _cmd_run(argv) -> int:
         return 2
     sys.path.insert(0, ".")
     runner = getattr(importlib.import_module(mod_name), fn_name)()
-    if args.trace or args.trace_chrome or args.trace_dir:
-        from transmogrifai_tpu import obs
+    import contextlib
 
-        # CLI-level tracer wraps the runner's own (inner spans nest under the
-        # innermost active tracer; this outer one sees everything, including
-        # model load and result persistence)
-        with obs.trace(trace_dir=args.trace_dir, name=args.run_type) as tracer:
+    chaos_ctx = contextlib.nullcontext()
+    injector = None
+    if args.chaos_seed is not None:
+        from transmogrifai_tpu.resilience import FaultInjector
+
+        injector = FaultInjector.default_schedule(args.chaos_seed)
+        chaos_ctx = injector.installed()
+    with chaos_ctx:
+        if args.trace or args.trace_chrome or args.trace_dir:
+            from transmogrifai_tpu import obs
+
+            # CLI-level tracer wraps the runner's own (inner spans nest under
+            # the innermost active tracer; this outer one sees everything,
+            # including model load and result persistence)
+            with obs.trace(trace_dir=args.trace_dir,
+                           name=args.run_type) as tracer:
+                result = runner.run(args.run_type, params)
+            if args.trace:
+                print(tracer.text_tree(), file=sys.stderr)
+            if args.trace_chrome:
+                tracer.export_chrome(args.trace_chrome)
+                print(f"chrome trace written to {args.trace_chrome}",
+                      file=sys.stderr)
+        else:
             result = runner.run(args.run_type, params)
-        if args.trace:
-            print(tracer.text_tree(), file=sys.stderr)
-        if args.trace_chrome:
-            tracer.export_chrome(args.trace_chrome)
-            print(f"chrome trace written to {args.trace_chrome}",
-                  file=sys.stderr)
-    else:
-        result = runner.run(args.run_type, params)
+    if injector is not None:
+        print(f"chaos[{args.chaos_seed}]: injected "
+              f"{len(injector.events)} fault(s): {injector.events}",
+              file=sys.stderr)
     line = {k: v for k, v in vars(result).items() if v is not None and k != "metrics"}
     if result.metrics is not None:
         m = result.metrics

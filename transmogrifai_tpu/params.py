@@ -49,6 +49,27 @@ class OpParams:
     #: model's stamped serving_baseline, emit fill-rate/JS gauges, and attach
     #: the monitor report to the run result. CLI: `op run --monitor`.
     monitor: bool = False
+    #: --- runtime fault tolerance (resilience/; docs/robustness.md) ---
+    #: transient-IO retries for host-side ingest work (reader opens, the
+    #: input pipeline's producer stage), seeded-jitter exponential backoff.
+    #: 0 = fail fast (today's behavior). CLI: `op run --retry-max`.
+    retry_max: int = 0
+    #: per-dispatch deadline (seconds) on the device-compute stage of
+    #: streamed scoring; a breach fails the dispatch (retried once) instead
+    #: of wedging the run — then quarantines the batch when quarantine_dir
+    #: is set, else fails the run fast. None = no deadline.
+    deadline_s: Optional[float] = None
+    #: consecutive device-lane failures that trip the serving circuit
+    #: breaker. Rides the FaultPolicy these params resolve to; it takes
+    #: effect on SERVING handles built from that policy
+    #: (`model.score_fn(policy=...)`) — the runner's own run types have no
+    #: serving breaker to configure.
+    breaker_threshold: int = 5
+    #: directory for the poison-batch sidecar (quarantine.jsonl): batches
+    #: that fail parse/cast, crash scoring, or produce non-finite scores shed
+    #: their offending rows there and the run completes with a partial-
+    #: success summary instead of dying. None = poison fails the run.
+    quarantine_dir: Optional[str] = None
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

@@ -123,19 +123,25 @@ class CSVReader(DataReader):
     def _raw_rows(self, limit: Optional[int] = None) -> list[dict]:
         from itertools import islice
 
-        with open(self.path, newline="") as fh:
-            if self.has_header:
-                reader = _csv.DictReader(fh)
-                rows = [dict(r) for r in islice(reader, limit)]
-            else:
+        from ..resilience.policy import io_guard
+
+        def read() -> list[dict]:
+            with open(self.path, newline="") as fh:
+                if self.has_header:
+                    reader = _csv.DictReader(fh)
+                    return [dict(r) for r in islice(reader, limit)]
                 names = self.field_names
                 if names is None:
                     raise ValueError("headerless CSV requires field_names")
                 # `if rec` skips blank lines, matching DictReader (and the native
                 # tokenizer) — a blank line is no record, not an all-null row
-                rows = [dict(zip(names, rec))
+                return [dict(zip(names, rec))
                         for rec in islice(_csv.reader(fh), limit) if rec]
-        return rows
+
+        # open+tokenize under the ambient fault policy: a transient IO error
+        # (flaky NFS, chaos injection) retries with seeded backoff instead of
+        # killing the run; without a policy this is a bare call
+        return io_guard("ingest:open", read)
 
     def read_records(self) -> list[dict]:
         if self._cache is None:
@@ -164,10 +170,16 @@ class CSVReader(DataReader):
         final Column build. Falls back (None) whenever the schema, file, or a
         malformed cell needs the Python parser's semantics."""
         from ..native import CT_SKIP, parse_csv_typed
+        from ..resilience.policy import io_guard
+
+        def read_bytes() -> bytes:
+            with open(self.path, "rb") as fh:
+                return fh.read()
 
         try:
-            with open(self.path, "rb") as fh:
-                data = fh.read()
+            # ambient-policy retry keeps a transient IO error from silently
+            # demoting this fast path; a persistent one still falls back
+            data = io_guard("ingest:open", read_bytes)
         except OSError:
             return None
         if self.has_header:
@@ -256,8 +268,10 @@ class CSVReader(DataReader):
                 Storage.TEXT}
         if any(k.storage not in flat for k in self.schema.values()):
             return None  # non-flat kinds keep the record path's semantics
+        from ..resilience.policy import io_guard
+
         try:
-            fh = open(self.path, newline="")
+            fh = io_guard("ingest:open", lambda: open(self.path, newline=""))
         except OSError:
             return None
         with fh:

@@ -136,11 +136,17 @@ class Prefetcher:
     def __init__(self, source: Iterable, fn: Optional[Callable[[Any], Any]] = None,
                  *, depth: int = 2, name: str = "prepare",
                  stats: Optional[PipelineStats] = None,
-                 place: Optional[Callable[[Any], Any]] = None):
+                 place: Optional[Callable[[Any], Any]] = None,
+                 policy=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._source = source
         self._fn = fn
+        #: optional resilience.FaultPolicy: transient errors from `fn` retry
+        #: with seeded-jitter backoff on the producer thread instead of
+        #: killing the whole run (data errors still propagate immediately —
+        #: quarantine, not retry, owns those)
+        self._policy = policy
         #: optional device-placement hook run on the PRODUCER thread after
         #: `fn`: under a mesh this is the per-shard `jax.device_put` that
         #: lands a streamed batch pre-sharded over the data axis while the
@@ -160,15 +166,23 @@ class Prefetcher:
         self._thread.start()
 
     # --- producer thread --------------------------------------------------------------
+    def _apply_fn(self, item, index: int):
+        """`fn(item)` under the shared producer-stage wrapper (chaos slow
+        hook + policy retry — resilience/policy.resilient_prepare)."""
+        from ..resilience.policy import resilient_prepare
+
+        return resilient_prepare(self._fn, item, index, self._policy,
+                                 f"pipeline:{self._name}")
+
     def _produce(self) -> None:
         try:
-            for item in self._source:
+            for index, item in enumerate(self._source):
                 if self._stop.is_set():
                     return
                 if self._fn is not None:
                     t0 = time.perf_counter()
                     with obs.span(f"pipeline:{self._name}", parent=self._parent):
-                        item = self._fn(item)
+                        item = self._apply_fn(item, index)
                     self.stats.prepare_s += time.perf_counter() - t0
                 if self._place is not None:
                     t0 = time.perf_counter()
@@ -300,6 +314,7 @@ def run_pipeline(
     name: str = "pipeline",
     stats: Optional[PipelineStats] = None,
     place: Optional[Callable[[Any], Any]] = None,
+    policy=None,
 ) -> PipelineStats:
     """Run `source -> prepare -> compute -> sink` with the three stages
     overlapped; returns the aggregated PipelineStats.
@@ -310,10 +325,16 @@ def run_pipeline(
     """
     stats = stats if stats is not None else PipelineStats()
     if prefetch <= 0:
-        for item in source:
+        from ..resilience.policy import resilient_prepare
+
+        # the retry/chaos site matches the threaded path's producer stage
+        # ("pipeline:prepare"), so the two paths share metrics series and the
+        # chaos schedule regardless of stream_prefetch
+        for index, item in enumerate(source):
             if prepare is not None:
                 t0 = time.perf_counter()
-                item = prepare(item)
+                item = resilient_prepare(prepare, item, index, policy,
+                                         "pipeline:prepare")
                 stats.prepare_s += time.perf_counter() - t0
             if place is not None:
                 t0 = time.perf_counter()
@@ -332,7 +353,7 @@ def run_pipeline(
         return stats
 
     with Prefetcher(source, prepare, depth=prefetch, stats=stats,
-                    place=place) as pf:
+                    place=place, policy=policy) as pf:
         sink_cm = (AsyncSink(sink, depth=sink_depth, stats=stats)
                    if sink is not None else None)
         try:

@@ -277,11 +277,20 @@ class CSVStreamingReader(StreamingReader):
         self.transform = transform
 
     def stream(self) -> Iterator[list[dict]]:
+        from ..resilience.policy import io_guard
+
         for fname in sorted(os.listdir(self.directory)):
             if not fname.endswith(".csv"):
                 continue
-            with open(os.path.join(self.directory, fname), newline="") as fh:
-                rows = [dict(r) for r in _csv.DictReader(fh)]
+            path = os.path.join(self.directory, fname)
+
+            def read(path=path) -> list[dict]:
+                with open(path, newline="") as fh:
+                    return [dict(r) for r in _csv.DictReader(fh)]
+
+            # per-file open/parse under the ambient fault policy: one flaky
+            # file read retries with backoff instead of ending the stream
+            rows = io_guard("ingest:open", read)
             if self.transform is not None:
                 rows = [self.transform(r) for r in rows]
             if self.batch_size is None:

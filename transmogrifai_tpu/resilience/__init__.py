@@ -1,0 +1,62 @@
+"""resilience — runtime fault tolerance for ingest, scoring, and serving.
+
+The Spark substrate the reference leaned on (task retries, lineage recovery —
+SURVEY §2.11) disappeared with the pjit rewrite; this package restores the
+runtime half of it as an explicit layer (crash-safe *checkpointing* already
+exists in select/checkpoint.py and workflow/phase_checkpoint.py):
+
+* `FaultPolicy` / `retry_call` / `io_guard` — seeded-jitter exponential
+  backoff for host-side ingest work (reader opens, the input pipeline's
+  producer stage), with transient-vs-data error classification (policy.py).
+* `CircuitBreaker` — the serving device lane's failover state machine:
+  consecutive failures or deadline breaches trip it and all traffic routes to
+  the in-process CPU columnar plan until a half-open probe heals (breaker.py).
+* `QuarantineWriter` / `isolate_failing` — poison-batch quarantine: row-
+  bisect isolation, a structured `quarantine.jsonl` sidecar, and partial-
+  success run summaries (quarantine.py).
+* `FaultInjector` — the deterministic chaos harness that injects IO errors,
+  torn/poison rows, slow batches, and device-dispatch failures on a
+  reproducible schedule (chaos.py).
+
+Everything lands on the PR-5 metrics registry (`resilience_retries_total`,
+`breaker_state`, `quarantined_rows_total`, `resilience_dispatch_seconds`,
+`chaos_injected_total`) and the PR-1 span tracer, so every degradation is
+observable. With the knobs at their defaults the layer is inert: fault-free
+runs are bit-identical to the pre-resilience build (pinned by test).
+
+See docs/robustness.md for the failure model and usage.
+"""
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from .chaos import (
+    FaultInjector,
+    InjectedDispatchError,
+    InjectedIOError,
+    active,
+    corrupt_batch,
+    maybe_device,
+    maybe_io,
+    maybe_slow,
+)
+from .policy import (
+    TRANSIENT_ERRORS,
+    DeadlineExceeded,
+    FaultPolicy,
+    TransientError,
+    ambient,
+    call_with_deadline,
+    io_guard,
+    resilient_prepare,
+    retry_call,
+    scoped,
+)
+from .quarantine import QuarantineWriter, isolate_failing
+
+__all__ = [
+    "CLOSED", "HALF_OPEN", "OPEN", "TRANSIENT_ERRORS",
+    "CircuitBreaker", "DeadlineExceeded", "FaultInjector",
+    "FaultPolicy", "InjectedDispatchError", "InjectedIOError",
+    "QuarantineWriter", "TransientError",
+    "active", "ambient", "call_with_deadline", "corrupt_batch",
+    "io_guard", "isolate_failing", "maybe_device", "maybe_io", "maybe_slow",
+    "resilient_prepare", "retry_call", "scoped",
+]

@@ -1,0 +1,142 @@
+"""Device circuit breaker: consecutive-failure trip, half-open probing.
+
+The serving-side failover state machine (the classic breaker of fault-
+tolerant RPC stacks, applied to the XLA dispatch lane): N consecutive
+device-lane failures or deadline breaches flip the breaker OPEN and route
+every batch to the in-process CPU columnar plan (the PR-4 small-batch
+auto-router's lane, promoted to a failover target); after `cooldown_s` one
+probe batch is admitted (HALF_OPEN) — success restores the device path,
+failure re-opens with a fresh cooldown.
+
+State lands on the metrics registry so degradation is visible, never silent:
+`breaker_state{breaker}` gauge (0 closed / 1 open / 2 half-open),
+`breaker_failures_total{breaker}` and `breaker_transitions_total{breaker,to}`
+counters, plus a `breaker:transition` span event per flip.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import obs
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: gauge encoding of the state (0 is healthy so dashboards alert on > 0)
+_STATE_GAUGE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Thread-safe consecutive-failure circuit breaker.
+
+    Protocol per unit of work on the protected lane:
+
+        if breaker.allow():   # False -> take the fallback lane
+            try: work(); breaker.record_success()
+            except ...: breaker.record_failure(); fallback
+
+    `clock` is injectable (monotonic seconds) so tests drive the cooldown
+    without sleeping.
+    """
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 30.0,
+                 name: str = "serve_device",
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        reg = registry if registry is not None else obs.default_registry()
+        # NO set(0) here: a second breaker constructed over the same labeled
+        # series (the registry get-or-creates by (name, labels)) must not
+        # mask an existing breaker's OPEN state back to "closed" — a fresh
+        # gauge already reads 0
+        self._gauge = reg.gauge(
+            "breaker_state",
+            help="circuit-breaker state (0 closed, 1 open, 2 half-open)",
+            labels={"breaker": name})
+        self._failures = reg.counter(
+            "breaker_failures_total",
+            help="failures recorded on the protected lane",
+            labels={"breaker": name})
+        self._transitions = {
+            to: reg.counter("breaker_transitions_total",
+                            help="breaker state transitions by target state",
+                            labels={"breaker": name, "to": to})
+            for to in (CLOSED, OPEN, HALF_OPEN)
+        }
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        # lock held by the caller
+        if self._state == to:
+            return
+        self._state = to
+        self._gauge.set(_STATE_GAUGE[to])
+        self._transitions[to].inc()
+        obs.add_event("breaker:transition", breaker=self.name, to=to,
+                      consecutive_failures=self._consecutive_failures)
+
+    def allow(self) -> bool:
+        """May the next unit of work take the protected lane? OPEN admits a
+        single HALF_OPEN probe once the cooldown has elapsed; concurrent
+        callers during a probe are told False (they stay on the fallback)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if (self._opened_at is not None
+                        and self._clock() - self._opened_at >= self.cooldown_s):
+                    self._transition(HALF_OPEN)
+                    self._probing = True
+                    return True
+                return False
+            # HALF_OPEN: exactly one in-flight probe
+            if not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def abort_probe(self) -> None:
+        """The admitted probe ended INCONCLUSIVELY for the lane (e.g. a data
+        error that would fail anywhere): clear the in-flight-probe flag
+        without judging the device, so the next unit of work can probe again
+        instead of the breaker wedging in HALF_OPEN forever."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures.inc()
+            self._consecutive_failures += 1
+            self._probing = False
+            if self._state == HALF_OPEN:
+                # failed probe: back to OPEN with a fresh cooldown
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+            elif (self._state == CLOSED
+                  and self._consecutive_failures >= self.threshold):
+                self._opened_at = self._clock()
+                self._transition(OPEN)

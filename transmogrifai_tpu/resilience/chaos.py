@@ -1,0 +1,230 @@
+"""FaultInjector: a deterministic, seeded chaos harness.
+
+The thing the resilience test suite and the CI chaos lane drive: inject IO
+errors at reader opens, torn/poison rows into streamed batches, slow batches
+into the pipeline's prepare stage, and device-dispatch failures into the
+serving lane — all on a reproducible schedule derived from a seed and
+explicit budgets, never wall clock. Two runs with the same injector
+configuration produce the identical `events` log, the identical retry
+sequence, and byte-identical quarantine sidecars (pinned by
+tests/test_resilience.py).
+
+Install for a dynamic extent:
+
+    inj = FaultInjector(seed=0, io_failures=2, poison_batches=(1,))
+    with inj.installed():
+        runner.run("streaming_score", params)
+    assert inj.events == [...]
+
+Instrumented sites consult the active injector through the module-level
+hooks (`maybe_io` / `maybe_slow` / `maybe_device` / `corrupt_batch`); with no
+injector installed each hook is one global None-check — nothing on the
+production path.
+
+Budget semantics: `io_failures` / `device_failures` are TRANSIENT budgets —
+the first N hook calls at the site fail, later calls succeed. A large
+`device_failures` models a persistently failing device (trips the serving
+circuit breaker); exhausting it models recovery (the half-open probe then
+succeeds). Rate-based injection (`io_rate`) draws from the seeded RNG in
+call order, so it is deterministic for serial call sites.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional, Sequence
+
+from .. import obs
+
+
+class InjectedIOError(OSError):
+    """Chaos-injected transient IO failure (OSError -> retryable)."""
+
+
+class InjectedDispatchError(RuntimeError):
+    """Chaos-injected device-dispatch failure (non-transient: the breaker and
+    failover path own it, not the retry loop)."""
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0, *,
+                 io_failures: int = 0, io_rate: float = 0.0,
+                 poison_batches: Sequence[int] = (),
+                 torn_batches: Sequence[int] = (),
+                 slow_batches: Sequence[int] = (), slow_s: float = 0.05,
+                 device_failures: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self.io_rate = float(io_rate)
+        self.slow_s = float(slow_s)
+        self._io_budget = int(io_failures)
+        self._device_budget = int(device_failures)
+        self.poison_batches = frozenset(int(b) for b in poison_batches)
+        self.torn_batches = frozenset(int(b) for b in torn_batches)
+        self.slow_batches = frozenset(int(b) for b in slow_batches)
+        #: deterministic event log: (kind, site, call_or_batch_index[, row])
+        self.events: list[tuple] = []
+        self._calls: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def default_schedule(cls, seed: int = 0) -> "FaultInjector":
+        """The canonical chaos drill (`op run --chaos-seed N`): two transient
+        IO errors (recovered by retries), one poison batch (sheds rows to
+        quarantine — pair with `quarantine_dir`), one slow batch."""
+        return cls(seed, io_failures=2, poison_batches=(1,),
+                   slow_batches=(2,), slow_s=0.02)
+
+    # --- bookkeeping ------------------------------------------------------------------
+    def _record(self, kind: str, site: str, index: int, **extra) -> None:
+        ev = (kind, site, index) + tuple(sorted(extra.items()))
+        with self._lock:
+            self.events.append(ev)
+        obs.default_registry().counter(
+            "chaos_injected_total",
+            help="faults injected by the chaos harness",
+            labels={"site": site, "kind": kind}).inc()
+        obs.add_event("chaos:inject", kind=kind, site=site, index=index)
+
+    def _next_call(self, site: str) -> int:
+        with self._lock:
+            n = self._calls.get(site, 0)
+            self._calls[site] = n + 1
+            return n
+
+    # --- hook implementations ----------------------------------------------------------
+    def io(self, site: str) -> None:
+        """Reader-open/parse site: consume the transient budget, else roll
+        the seeded rate."""
+        idx = self._next_call(site)
+        with self._lock:
+            fire = self._io_budget > 0
+            if fire:
+                self._io_budget -= 1
+        if not fire and self.io_rate > 0:
+            with self._lock:
+                fire = self._rng.random() < self.io_rate
+        if fire:
+            self._record("io_error", site, idx)
+            raise InjectedIOError(f"chaos[{self.seed}]: injected IO error "
+                                  f"at {site} call {idx}")
+
+    def device(self, site: str) -> None:
+        """Device-dispatch site (serving / streamed-score compute)."""
+        idx = self._next_call(site)
+        with self._lock:
+            fire = self._device_budget > 0
+            if fire:
+                self._device_budget -= 1
+        if fire:
+            self._record("device_error", site, idx)
+            raise InjectedDispatchError(
+                f"chaos[{self.seed}]: injected dispatch failure at {site} "
+                f"call {idx}")
+
+    def slow(self, site: str, index: int) -> None:
+        if index in self.slow_batches:
+            self._record("slow", site, index, s=self.slow_s)
+            time.sleep(self.slow_s)
+
+    def corrupt(self, rows, index: int):
+        """Poison/tear rows of batch `index` (record streams only — a Table
+        batch passes through untouched). Returns a NEW list when corrupted so
+        the caller's original batch is never mutated."""
+        if index not in self.poison_batches and index not in self.torn_batches:
+            return rows
+        if not isinstance(rows, list) or not rows or not isinstance(rows[0], dict):
+            self._record("corrupt_skipped", "stream:batch", index)
+            return rows
+        out = [dict(r) for r in rows]
+        row_rng = random.Random(f"{self.seed}:batch:{index}")
+        if index in self.poison_batches:
+            k = row_rng.randrange(len(out))
+            field = self._numeric_field(out[k])
+            if field is not None:
+                out[k][field] = "§poison§"
+                self._record("poison", "stream:batch", index, row=k)
+        if index in self.torn_batches:
+            k = row_rng.randrange(len(out))
+            keys = sorted(out[k])
+            keep = keys[: max(1, len(keys) // 2)]
+            torn = {kk: out[k][kk] for kk in keep}
+            # a half-written CSV line: the record truncates mid-value, so one
+            # NUMERIC cell carries the unseparated tail of the dropped fields
+            # (guaranteeing a cast failure, not a silently-null row)
+            field = (self._numeric_field(torn)
+                     or self._numeric_field(out[k]))
+            if field is not None:
+                torn[field] = ",".join(
+                    str(out[k][kk]) for kk in keys[len(keep):]) or "§torn§"
+            out[k] = torn
+            self._record("torn", "stream:batch", index, row=k)
+        return out
+
+    @staticmethod
+    def _numeric_field(row: dict) -> Optional[str]:
+        """First (sorted) field holding a number — or a numeric-looking
+        string, the shape CSV-sourced record streams carry."""
+        for k in sorted(row):
+            v = row[k]
+            if isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                return k
+            if isinstance(v, str) and v:
+                try:
+                    float(v)
+                except ValueError:
+                    continue
+                return k
+        return None
+
+    # --- installation -----------------------------------------------------------------
+    @contextmanager
+    def installed(self):
+        global _ACTIVE
+        with _INSTALL_LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError("a FaultInjector is already installed")
+            _ACTIVE = self
+        try:
+            yield self
+        finally:
+            with _INSTALL_LOCK:
+                _ACTIVE = None
+
+
+_ACTIVE: Optional[FaultInjector] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+# --- call-site hooks (one global None-check when no injector is installed) --------------
+def maybe_io(site: str) -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.io(site)
+
+
+def maybe_device(site: str) -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.device(site)
+
+
+def maybe_slow(site: str, index: int) -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.slow(site, index)
+
+
+def corrupt_batch(rows, index: int):
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.corrupt(rows, index)
+    return rows

@@ -1,0 +1,248 @@
+"""Fault policy: seeded-jitter retry/backoff + per-dispatch deadlines.
+
+The reference inherited task retries and lineage recovery from Spark
+(SURVEY §2.11); this build's pjit + hand-rolled input pipeline has no such
+substrate, so transient host-side failures (a reader hiccup, a flaky NFS
+open, a parse of a half-written file) need explicit, *bounded* retry — and
+device dispatches need deadlines so a wedged backend surfaces as a failure
+event instead of hanging a serving replica forever (the tf.data-service /
+TensorFlow fault-model position: arXiv 2210.14826 §4, arXiv 1605.08695 §4.2).
+
+Design rules:
+
+* **Deterministic.** Backoff jitter is derived from `(policy.seed, site,
+  attempt)` — never wall clock or a shared RNG — so the same fault schedule
+  (see chaos.py) produces the identical retry sequence run after run. The
+  chaos-determinism test pins this.
+* **Classified.** Only TRANSIENT errors retry (OSError/ConnectionError/
+  TimeoutError + the explicit `TransientError` marker). Data errors
+  (ValueError/KeyError — a poison batch) are NOT transient: they go to
+  quarantine (quarantine.py), not into a retry loop that can never succeed.
+  `StreamClosed` is terminal by construction and never retried.
+* **Observable.** Every retry lands on the metrics registry
+  (`resilience_retries_total{site}`, `resilience_backoff_seconds_total{site}`)
+  and as a `resilience:retry` span event; deadline-armed dispatches feed the
+  `resilience_dispatch_seconds{site}` histogram and breaches the
+  `resilience_deadline_breaches_total{site}` counter.
+* **Zero ambient cost.** With no policy in scope, `io_guard` is a module
+  global None-check plus the original call — the fault-free path stays
+  bit-identical to the pre-resilience code.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .. import obs
+
+
+class TransientError(RuntimeError):
+    """Explicitly retryable marker for errors that are not OS-level IO."""
+
+
+#: error classes the retry loop treats as transient. ConnectionError and the
+#: chaos harness's InjectedIOError are OSError subclasses; everything else
+#: (ValueError, KeyError, StreamClosed, ...) propagates immediately — retrying
+#: a parse error re-parses the same poison bytes forever.
+TRANSIENT_ERRORS = (OSError, TimeoutError, TransientError)
+
+
+class DeadlineExceeded(TimeoutError):
+    """A device dispatch exceeded its per-dispatch deadline. TimeoutError, so
+    it classifies as transient for the retry loop and as a failure for the
+    circuit breaker."""
+
+
+@dataclass(frozen=True)
+class FaultPolicy:
+    """Knobs for the runtime fault-tolerance layer (threads through OpParams:
+    `retry_max`, `deadline_s`, `breaker_threshold`, `quarantine_dir`)."""
+
+    #: retries AFTER the first attempt (0 = today's fail-fast behavior)
+    retry_max: int = 3
+    #: exponential backoff: sleep ~ base * 2**attempt, capped
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    #: fraction of each backoff randomized by the seeded jitter (0..1);
+    #: jitter decorrelates replicas hammering a shared source after an outage
+    jitter: float = 0.5
+    #: seed for the deterministic jitter (and the chaos harness convention)
+    seed: int = 0
+    #: per-dispatch deadline on the device-compute stage (None = no deadline;
+    #: a breach raises DeadlineExceeded and counts as a breaker failure)
+    deadline_s: Optional[float] = None
+    #: consecutive device-lane failures that trip the serving circuit breaker
+    breaker_threshold: int = 5
+    #: seconds an open breaker waits before admitting a half-open probe
+    breaker_cooldown_s: float = 30.0
+    #: directory for the poison-batch sidecar (quarantine.jsonl); None
+    #: disables quarantine — a poison batch then fails the run, as today
+    quarantine_dir: Optional[str] = None
+
+    def backoff_s(self, site: str, attempt: int) -> float:
+        """Deterministic seeded-jitter exponential backoff for retry number
+        `attempt` (0-based) at `site`. Stateless: the value depends only on
+        (seed, site, attempt), so retry schedules replay exactly."""
+        base = min(self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt))
+        if self.jitter <= 0:
+            return base
+        u = random.Random(f"{self.seed}:{site}:{attempt}").random()
+        return base * (1.0 - self.jitter + self.jitter * u)
+
+
+def retry_call(fn: Callable, *, policy: FaultPolicy, site: str,
+               retryable: tuple = TRANSIENT_ERRORS,
+               sleep: Callable[[float], None] = time.sleep):
+    """Run `fn()` with up to `policy.retry_max` retries on transient errors.
+
+    Non-retryable exceptions (and the final transient failure once the budget
+    is spent) propagate unchanged. Each retry increments
+    `resilience_retries_total{site}` and emits a `resilience:retry` event.
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            if attempt >= policy.retry_max:
+                raise
+            delay = policy.backoff_s(site, attempt)
+            obs.add_event("resilience:retry", site=site, attempt=attempt + 1,
+                          error=f"{type(e).__name__}: {e}"[:200],
+                          backoff_s=round(delay, 4))
+            reg = obs.default_registry()
+            reg.counter("resilience_retries_total",
+                        help="transient-error retries per site",
+                        labels={"site": site}).inc()
+            reg.counter("resilience_backoff_seconds_total",
+                        help="seconds slept in retry backoff per site",
+                        labels={"site": site}).inc(delay)
+            if delay > 0:
+                sleep(delay)
+            attempt += 1
+
+
+# --- ambient policy scope ---------------------------------------------------------------
+#: innermost-first stack of in-scope policies. The runner pushes its resolved
+#: policy for the extent of a run so deep call sites (reader opens) pick up
+#: retry behavior without threading a parameter through every layer.
+_SCOPE: list[FaultPolicy] = []
+_SCOPE_LOCK = threading.Lock()
+
+
+@contextmanager
+def scoped(policy: Optional[FaultPolicy]):
+    """Install `policy` as the ambient fault policy for the dynamic extent
+    (None = no-op). Shared across threads on purpose: the input pipeline's
+    producer thread must see the policy the runner installed. The flip side:
+    CONCURRENT runs in one process share the stack (innermost policy wins for
+    everyone) — same single-runner-per-process posture as the mesh counters'
+    per-run deltas (runner.py); run one workload per process if their fault
+    policies must not mix."""
+    if policy is None:
+        yield None
+        return
+    with _SCOPE_LOCK:
+        _SCOPE.append(policy)
+    try:
+        yield policy
+    finally:
+        with _SCOPE_LOCK:
+            _SCOPE.remove(policy)
+
+
+def ambient() -> Optional[FaultPolicy]:
+    """The innermost in-scope policy, or None."""
+    return _SCOPE[-1] if _SCOPE else None
+
+
+def io_guard(site: str, fn: Callable):
+    """Run a host-side IO thunk under the ambient policy's retry loop (and the
+    active chaos injector's fault schedule). With no ambient policy and no
+    injector this is `fn()` — zero overhead on the fault-free default path."""
+    from .chaos import active
+
+    inj = active()
+    if inj is None and not _SCOPE:
+        return fn()
+
+    def attempt():
+        # the chaos hook lives INSIDE the retried thunk so each retry
+        # re-consults the injector: a transient injected IO error is consumed
+        # from the schedule and the retry then succeeds — the recovery the
+        # chaos test proves
+        cur = active()
+        if cur is not None:
+            cur.io(site)
+        return fn()
+
+    policy = ambient()
+    if policy is None or policy.retry_max <= 0:
+        return attempt()
+    return retry_call(attempt, policy=policy, site=site)
+
+
+def resilient_prepare(fn: Callable, item, index: int,
+                      policy: Optional[FaultPolicy], site: str):
+    """The producer-stage wrapper every prepare path shares — the threaded
+    Prefetcher, run_pipeline's sync arm, and ScoreFunction.stream's
+    prefetch=0 arm must not diverge in retry or chaos semantics, so all
+    three call this: the chaos slow-batch hook fires first (injected latency
+    lands where real ingest latency would), then `fn(item)` runs under the
+    policy's transient-error retry loop (bare call when no policy)."""
+    from .chaos import maybe_slow
+
+    maybe_slow(site, index)
+    if policy is not None and policy.retry_max > 0:
+        return retry_call(lambda: fn(item), policy=policy, site=site)
+    return fn(item)
+
+
+# --- per-dispatch deadlines -------------------------------------------------------------
+def call_with_deadline(fn: Callable, *, deadline_s: float, site: str):
+    """Run `fn()` on a worker thread and wait at most `deadline_s` for it.
+
+    JAX exposes no timeout on blocking fetches, so a wedged dispatch can only
+    be *detected*, not cancelled: on a breach the worker thread is abandoned
+    (daemon — it dies with the process or finishes harmlessly late) and
+    DeadlineExceeded raises in the caller, which fails over / quarantines.
+    The observed wall time always lands on the
+    `resilience_dispatch_seconds{site}` histogram, so deadline tuning has
+    data; breaches increment `resilience_deadline_breaches_total{site}`.
+    """
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t0 = time.perf_counter()
+    worker = threading.Thread(target=run, daemon=True,
+                              name=f"deadline-{site}")
+    worker.start()
+    finished = done.wait(timeout=deadline_s)
+    elapsed = time.perf_counter() - t0
+    reg = obs.default_registry()
+    reg.histogram("resilience_dispatch_seconds",
+                  help="deadline-armed dispatch wall seconds per site",
+                  labels={"site": site}).observe(elapsed)
+    if not finished:
+        reg.counter("resilience_deadline_breaches_total",
+                    help="dispatches that exceeded their deadline",
+                    labels={"site": site}).inc()
+        obs.add_event("resilience:deadline", site=site,
+                      deadline_s=deadline_s, elapsed_s=round(elapsed, 4))
+        raise DeadlineExceeded(
+            f"{site}: dispatch exceeded deadline {deadline_s}s")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]

@@ -55,7 +55,7 @@ class ScoreFunction:
                  pad_to: Optional[Sequence[int]] = None,
                  backend: Optional[str] = "auto",
                  auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
-                 mesh=None, monitor=None):
+                 mesh=None, monitor=None, policy=None):
         self._model = model
         self._result_names = list(result_names) if result_names else [
             f.name for f in model.result_features
@@ -83,6 +83,35 @@ class ScoreFunction:
         #: per-record serving frequency (same policy as ServingMonitor._gauge)
         self._route_counters: dict = {}
         self._lat_hists: dict = {}
+        #: resilience.FaultPolicy: deadline_s arms per-dispatch deadlines on
+        #: the device lane, breaker_threshold/cooldown configure the circuit
+        #: breaker, quarantine_dir enables poison-row quarantine in stream().
+        #: None = defaults (breaker on under "auto", everything else off).
+        self._policy = policy
+        #: device circuit breaker — only under backend="auto", the one mode
+        #: with an in-process CPU plan to fail over to. Consecutive device-
+        #: lane failures (or deadline breaches) trip it OPEN: every batch
+        #: routes to the CPU columnar plan (decided="breaker") until a
+        #: half-open probe succeeds. Explicitly pinned backends are never
+        #: silently rerouted. Tests may swap in a breaker with a fake clock.
+        self._breaker = None
+        if backend == "auto":
+            from ..resilience import CircuitBreaker, FaultPolicy
+
+            pol = policy if policy is not None else FaultPolicy()
+            # per-MODEL metric series: handles for different models must not
+            # merge their failures/transitions into one "serve_device" line
+            # (bounded cardinality — one series per served model)
+            self._breaker = CircuitBreaker(
+                threshold=pol.breaker_threshold,
+                cooldown_s=pol.breaker_cooldown_s,
+                name=f"serve_device:{getattr(model, 'uid', 'model')}")
+        self._qwriter = None
+        import itertools
+
+        #: stream() batch ordinal, monotone across calls on this handle (the
+        #: quarantine sidecar's "batch" field must be unambiguous)
+        self._stream_counter = itertools.count()
 
     def _plan_for(self, backend: Optional[str]):
         key = backend or "default"
@@ -115,6 +144,13 @@ class ScoreFunction:
             backend = ("cpu" if not default_is_cpu
                        and n_rows < self._auto_cpu_threshold else None)
             decided = "auto"
+            if (backend is None and self._breaker is not None
+                    and not self._breaker.allow()):
+                # breaker open: the device lane is failing — the whole stream
+                # of traffic takes the in-process CPU plan until a half-open
+                # probe restores the device path
+                backend = "cpu"
+                decided = "breaker"
         obs.add_event("serve:routing", backend=backend or "device",
                       rows=int(n_rows), decided=decided)
         key = (backend or "device", decided)
@@ -131,13 +167,15 @@ class ScoreFunction:
         """plan.run with the per-backend latency histogram
         (`serve_latency_seconds{backend}`: log buckets + exact p50/p95/p99).
         The observe is a few µs under one lock — noise against even the
-        sub-ms CPU single-record path."""
+        sub-ms CPU single-record path. On the device lane this is also where
+        the chaos harness's dispatch faults land and where a configured
+        per-dispatch deadline is enforced."""
         import time
 
         from .. import obs
 
         t0 = time.perf_counter()
-        out = plan.run(table)
+        out = self._dispatch(plan, table, backend)
         key = backend or "device"
         h = self._lat_hists.get(key)
         if h is None:
@@ -146,6 +184,84 @@ class ScoreFunction:
                 help="LocalPlan scoring latency per backend lane",
                 labels={"backend": key})
         h.observe(time.perf_counter() - t0)
+        return out
+
+    def _dispatch(self, plan, table, backend: Optional[str]):
+        """One plan.run on its lane. The device lane (backend != "cpu")
+        consults the chaos injector and, when `policy.deadline_s` is set,
+        runs under a per-dispatch deadline: the result fetch is forced on a
+        worker thread and a breach raises DeadlineExceeded (counted as a
+        breaker failure by the caller) instead of wedging the replica."""
+        if backend != "cpu":
+            from ..resilience import chaos
+
+            chaos.maybe_device("serve:dispatch")
+        pol = self._policy
+        if pol is not None and pol.deadline_s and backend != "cpu":
+            import jax
+
+            from ..resilience.policy import call_with_deadline
+
+            def run_and_block():
+                out = plan.run(table)
+                # the deadline covers EXECUTION, not just the async enqueue:
+                # block on the result buffers inside the guarded thread
+                jax.block_until_ready([c.values for c in out.values()])
+                return out
+
+            return call_with_deadline(run_and_block,
+                                      deadline_s=pol.deadline_s,
+                                      site="serve:dispatch")
+        return plan.run(table)
+
+    def _release_probe(self, backend: Optional[str]) -> None:
+        """Idempotent: clear a half-open probe slot this request may hold on
+        the device lane (no-op for cpu-routed requests or without a
+        breaker)."""
+        if backend != "cpu" and self._breaker is not None:
+            self._breaker.abort_probe()
+
+    def _run_with_failover(self, plan, table, backend: Optional[str],
+                           fallback_table=None):
+        """_timed_run plus breaker bookkeeping and CPU failover.
+
+        Only the auto-routed device lane is protected: a failure there
+        (dispatch error, deadline breach) records on the breaker and the
+        batch transparently re-runs on the in-process CPU plan — the request
+        succeeds, availability is preserved, and once `breaker_threshold`
+        consecutive failures accumulate the breaker opens and routing stops
+        even trying the device. Explicit backends keep fail-fast semantics.
+        DATA errors (ValueError/KeyError/... — a poison row) are re-raised
+        untouched: they would fail identically on the CPU plan, and counting
+        them on the breaker would let bad client data evict a healthy device
+        (the transient-vs-data rule of resilience/policy.py).
+        """
+        protected = (backend != "cpu" and self._breaker is not None
+                     and self._backend == "auto")
+        try:
+            out = self._timed_run(plan, table, backend)
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not protected:
+                raise
+            if isinstance(e, (ValueError, KeyError, TypeError, IndexError)):
+                # inconclusive for the LANE: if this batch was the half-open
+                # probe, release the probe slot so the breaker cannot wedge
+                # in HALF_OPEN on poison data
+                self._breaker.abort_probe()
+                raise
+            self._breaker.record_failure()
+            from .. import obs
+
+            obs.add_event("serve:failover", error=f"{type(e).__name__}: {e}"[:200])
+            obs.default_registry().counter(
+                "serve_failover_total",
+                help="device-lane batches re-run on the CPU plan after a "
+                     "dispatch failure or deadline breach").inc()
+            cpu_plan = self._plan_for("cpu")
+            t = fallback_table if fallback_table is not None else table
+            return self._timed_run(cpu_plan, t, "cpu")
+        if protected:
+            self._breaker.record_success()
         return out
 
     def _observe(self, table_or_cols, n: int) -> None:
@@ -192,10 +308,20 @@ class ScoreFunction:
         # route on the REAL row count: pad_to bucketing must not flip a
         # 4-row request onto the device lane just because its bucket is big
         plan, backend = self._route(n)
-        table = self._build_table(padded)
-        self._observe(table, n)
-        table = self._maybe_shard(table, len(padded), backend)
-        out = self._timed_run(plan, table, backend)
+        try:
+            table = self._build_table(padded)
+            self._observe(table, n)
+            sharded = self._maybe_shard(table, len(padded), backend)
+            # failover re-runs on the CPU plan with the UNSHARDED table: a
+            # batch spread over a failing mesh must not be handed back to it
+            out = self._run_with_failover(plan, sharded, backend,
+                                          fallback_table=table)
+        except BaseException:
+            # anything that dies after routing — a parse error in the table
+            # build included — must release a half-open probe slot this
+            # request may hold, or the breaker wedges in HALF_OPEN
+            self._release_probe(backend)
+            raise
         return self._rows_out(out, n)
 
     def _rows_out(self, out: Mapping[str, Column], n: int) -> list[dict[str, Any]]:
@@ -206,6 +332,31 @@ class ScoreFunction:
         return results
 
     # --- streaming ----------------------------------------------------------------------
+    def _quarantine_writer(self):
+        """Lazy QuarantineWriter when the policy carries a quarantine_dir
+        (shared across stream() calls on this handle), else None."""
+        pol = self._policy
+        if pol is None or not pol.quarantine_dir:
+            return None
+        if self._qwriter is None:
+            from ..resilience import QuarantineWriter
+
+            self._qwriter = QuarantineWriter(pol.quarantine_dir)
+        return self._qwriter
+
+    def quarantine_summary(self) -> Optional[dict]:
+        """Partial-success summary of rows shed by stream() (None when
+        quarantine is off or nothing was quarantined)."""
+        return self._qwriter.summary() if self._qwriter is not None else None
+
+    def close(self) -> None:
+        """Release the handle's quarantine sidecar file handle (idempotent;
+        records already written are flushed per write, so close is about
+        descriptor hygiene in long-lived serving processes, not durability).
+        """
+        if self._qwriter is not None:
+            self._qwriter.close()
+
     def stream(self, batches, *, prefetch: int = 2):
         """Pipelined batch scoring over an iterable of record batches: the
         host-side table build (+ padding) of batch k+1 runs on a producer
@@ -213,37 +364,103 @@ class ScoreFunction:
         face of the shared input executor (readers/pipeline.py). Yields one
         `batch()`-shaped result list per input batch, in order; results are
         bit-identical to mapping `batch()` over the same stream. `prefetch=0`
-        degrades to the synchronous loop."""
-        if prefetch <= 0:
-            for records in batches:
-                yield self.batch(records)
-            return
-        from ..readers.pipeline import Prefetcher
+        degrades to the synchronous loop.
 
+        With a `FaultPolicy(quarantine_dir=...)` on the handle, a poison
+        batch no longer kills the stream: rows that fail parse/cast or
+        scoring are isolated by row-bisect and written to the sidecar, rows
+        whose scores come back non-finite are shed likewise, and the yielded
+        list keeps ONE entry per input row — quarantined positions hold None
+        (explicitly absent, never silently dropped). `quarantine_summary()`
+        reports the partial-success totals."""
+        from ..resilience.chaos import corrupt_batch
+
+        qw = self._quarantine_writer()
+        # the batch ordinal is PER HANDLE, not per stream() call: the
+        # quarantine sidecar (and its distinct-batch accounting) is shared
+        # across calls, so "batch" fields must stay unique across them
+        counter = self._stream_counter
+
+        # items flow prep -> place -> score as a FIXED 5-tuple
+        # (n, table, route, ctx, fallback): place replaces `table` with its
+        # sharded form and fills `fallback` with the unsharded original, so
+        # no stage sniffs tuple arity to learn what ran before it
         def prep(records):
+            bidx = next(counter)
+            records = corrupt_batch(list(records), bidx)
             n = len(records)
             if n == 0:
-                return 0, None, None
-            padded = self._pad(records)
+                return 0, None, None, None, None
+            keep = None
+            try:
+                padded = self._pad(records)
+                table = self._build_table(padded)
+            except Exception:  # noqa: BLE001 — quarantine or re-raise
+                if qw is None:
+                    raise
+                from ..resilience import isolate_failing
+
+                good, bad = isolate_failing(
+                    n, lambda idx: self._build_table([records[i] for i in idx]))
+                qw.quarantine_rows([records[i] for i, _ in bad],
+                                   batch_index=bidx, stage="parse",
+                                   errors=[err for _, err in bad],
+                                   row_indices=[i for i, _ in bad])
+                keep = good
+                records = [records[i] for i in good]
+                if not records:
+                    return 0, None, None, {"orig_n": n, "keep": [],
+                                           "batch": bidx, "records": []}, None
+                orig_n, n = n, len(records)
+                padded = self._pad(records)
+                table = self._build_table(padded)
             plan, backend = self._route(n)  # real rows, not the pad bucket
-            table = self._build_table(padded)
             # drift sketches fold on the PRODUCER thread: the numpy histogram
             # pass overlaps the device scoring of the previous batch instead
             # of extending the critical path
             self._observe(table, n)
-            return n, table, (plan, backend, len(padded))
+            ctx = None
+            if qw is not None:
+                ctx = {"orig_n": n if keep is None else orig_n, "keep": keep,
+                       "batch": bidx, "records": records}
+            return n, table, (plan, backend, len(padded)), ctx, None
 
         def place(item):
             # producer-thread device placement: under a mesh, device-lane
             # batches land PRE-SHARDED over the data axis while the fused
-            # pass still scores the previous batch
-            n, table, route = item
+            # pass still scores the previous batch. The UNSHARDED table rides
+            # along as the failover fallback: a batch spread over a failing
+            # mesh must not be handed back to it on the CPU lane.
+            n, table, route, ctx, _ = item
             if route is None:
                 return item
             plan, backend, n_padded = route
-            return n, self._maybe_shard(table, n_padded, backend), route
+            return (n, self._maybe_shard(table, n_padded, backend), route,
+                    ctx, table)
 
-        # plans build once, outside the timed overlap
+        def score(item):
+            n, table, route, ctx, fallback = item
+            if n == 0:
+                return ([None] * ctx["orig_n"]) if ctx is not None else []
+            plan, backend, n_padded = route
+            try:
+                rows = self._rows_out(
+                    self._run_with_failover(plan, table, backend,
+                                            fallback_table=fallback), n)
+                positions = (ctx["keep"] if ctx is not None
+                             and ctx["keep"] is not None else list(range(n)))
+            except Exception:  # noqa: BLE001 — quarantine or re-raise
+                if ctx is None:
+                    raise
+                rows, positions = self._bisect_score(ctx, qw)
+            if ctx is None:
+                return rows
+            return self._shed_nonfinite(rows, positions, ctx, qw)
+
+        # plans build once, outside the timed overlap (the CPU failover lane
+        # only pre-builds when it differs from the default lane — on a
+        # CPU-only host it would be a duplicate plan; the breaker path still
+        # builds it lazily on demand via _plan_for's cache)
         if self._backend == "auto":
             self._plan_for(None)
             import jax
@@ -253,15 +470,95 @@ class ScoreFunction:
         else:
             self._local_plan()
 
-        with Prefetcher(batches, prep, depth=prefetch, name="serve_build",
-                        place=place) as pf:
-            for n, table, route in pf:
-                # bare-Prefetcher use: the consumer owns the batch count
-                # (run_pipeline's loop does this for the runner), so
-                # close()-time stats.publish() has real totals to fold
-                pf.stats.batches += 1
-                yield ([] if n == 0 else self._rows_out(
-                    self._timed_run(route[0], table, route[1]), n))
+        try:
+            if prefetch <= 0:
+                # the sync path runs the SAME three stages — place() for mesh
+                # sharding, plus the shared producer-stage wrapper (chaos
+                # slow hook + policy retry) — so prefetch=0 and the pipelined
+                # path diverge in nothing but threading
+                from ..resilience.policy import resilient_prepare
+
+                for index, records in enumerate(batches):
+                    yield score(place(resilient_prepare(
+                        prep, records, index, self._policy,
+                        "pipeline:serve_build")))
+                return
+            from ..readers.pipeline import Prefetcher
+
+            with Prefetcher(batches, prep, depth=prefetch, name="serve_build",
+                            place=place, policy=self._policy) as pf:
+                for item in pf:
+                    # bare-Prefetcher use: the consumer owns the batch count
+                    # (run_pipeline's loop does this for the runner), so
+                    # close()-time stats.publish() has real totals to fold
+                    pf.stats.batches += 1
+                    yield score(item)
+        finally:
+            # a stream torn down between prep()'s routing (which may have
+            # been admitted as the half-open probe) and its score() must not
+            # strand the probe slot — idempotent release on ANY exit. If a
+            # concurrent batch() on this handle holds the probe, this may
+            # re-admit a second prober — a rare, self-correcting flap,
+            # strictly better than the permanent HALF_OPEN wedge.
+            self._release_probe(None)
+
+    def _bisect_score(self, ctx: dict, qw):
+        """Score-time poison isolation: probe row subsets, quarantine the
+        minimal failing rows, score the survivors once as a clean batch.
+        Under backend="auto" probes run on the CPU plan (failover already
+        failed to get here, which means data poison, and poison reproduces
+        anywhere); an explicitly pinned backend keeps its own lane — pinned
+        handles are never silently rerouted, so survivors' numerics come
+        from the lane the caller chose."""
+        from ..resilience import isolate_failing
+
+        recs = ctx["records"]
+        plan = self._plan_for("cpu" if self._backend == "auto"
+                              else self._backend)
+
+        def probe(idx):
+            # probes pad like real traffic: O(bad*log n) novel row counts
+            # must not each compile a fresh program on a pad_to-bucketed
+            # handle (the runner-side bisect pads for the same reason)
+            plan.run(self._build_table(self._pad([recs[i] for i in idx])))
+
+        good, bad = isolate_failing(len(recs), probe)
+        base = (ctx["keep"] if ctx["keep"] is not None
+                else list(range(len(recs))))
+        qw.quarantine_rows([recs[i] for i, _ in bad], batch_index=ctx["batch"],
+                           stage="score", errors=[err for _, err in bad],
+                           row_indices=[base[i] for i, _ in bad])
+        if not good:
+            return [], []
+        out = plan.run(self._build_table(self._pad(
+            [recs[i] for i in good])))
+        return self._rows_out(out, len(good)), [base[i] for i in good]
+
+    def _shed_nonfinite(self, rows: list, positions: list, ctx: dict, qw
+                        ) -> list:
+        """Assemble the per-input-row result list: scored rows land at their
+        original positions, quarantined positions hold None. Rows whose
+        scores are non-finite (NaN/Inf anywhere in the result payload — a
+        poison row that parsed fine but produced garbage) are shed here."""
+        finite_rows, finite_pos, bad = [], [], []
+        for row, pos in zip(rows, positions):
+            if _row_nonfinite(row):
+                bad.append(pos)
+            else:
+                finite_rows.append(row)
+                finite_pos.append(pos)
+        if bad:
+            recs = ctx["records"]
+            base = (ctx["keep"] if ctx["keep"] is not None
+                    else list(range(len(recs))))
+            back = {p: i for i, p in enumerate(base)}
+            qw.quarantine_rows([recs[back[p]] for p in bad],
+                               batch_index=ctx["batch"], stage="nonfinite",
+                               row_indices=list(bad))
+        out: list = [None] * ctx["orig_n"]
+        for row, pos in zip(finite_rows, finite_pos):
+            out[pos] = row
+        return out
 
     # --- columnar -----------------------------------------------------------------------
     def table(self, table: Table) -> Table:
@@ -277,9 +574,14 @@ class ScoreFunction:
             else:
                 cols[f.name] = Column.build(f.kind, [_placeholder(f.kind)] * n, device=False)
         plan, backend = self._route(n)
-        self._observe(cols, n)
-        cols = self._maybe_shard(cols, n, backend)
-        out = self._timed_run(plan, cols, backend)
+        try:
+            self._observe(cols, n)
+            sharded = self._maybe_shard(cols, n, backend)
+            out = self._run_with_failover(plan, sharded, backend,
+                                          fallback_table=cols)
+        except BaseException:
+            self._release_probe(backend)
+            raise
         return Table({n_: out[n_] for n_ in self._result_names})
 
     def _pad(self, records: Sequence[Mapping[str, Any]]):
@@ -307,6 +609,24 @@ class ScoreFunction:
         return Table(cols)
 
 
+def _row_nonfinite(row: Mapping[str, Any]) -> bool:
+    """True when any float in a result row (including nested prediction
+    payloads: prediction scalar, rawPrediction/probability lists) is NaN or
+    ±Inf — the signature of a poison row that parsed but produced garbage."""
+    import math
+
+    def bad(v) -> bool:
+        if isinstance(v, float):
+            return not math.isfinite(v)
+        if isinstance(v, dict):
+            return any(bad(x) for x in v.values())
+        if isinstance(v, (list, tuple)):
+            return any(bad(x) for x in v)
+        return False
+
+    return any(bad(v) for v in row.values())
+
+
 def _placeholder(kind) -> Any:
     """Kind-appropriate missing-label placeholder: numerics get 0, host object kinds
     (text/lists/maps) get their natural empty value — fabricating int 0 into a Text
@@ -329,8 +649,8 @@ def score_function(model: "WorkflowModel", result_names: Optional[Sequence[str]]
                   pad_to: Optional[Sequence[int]] = None,
                   backend: Optional[str] = "auto",
                   auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
-                  mesh=None, monitor=None) -> ScoreFunction:
+                  mesh=None, monitor=None, policy=None) -> ScoreFunction:
     """Build the serving callable (analog of `model.scoreFunction`)."""
     return ScoreFunction(model, result_names=result_names, pad_to=pad_to,
                          backend=backend, auto_cpu_threshold=auto_cpu_threshold,
-                         mesh=mesh, monitor=monitor)
+                         mesh=mesh, monitor=monitor, policy=policy)

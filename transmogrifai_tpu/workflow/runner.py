@@ -100,6 +100,10 @@ class RunResult:
     #: drift-monitor report for monitored score/streaming_score runs
     #: (ServingMonitor.report(): per-feature fill/JS state + alerts)
     monitor: Optional[dict] = None
+    #: partial-success summary when rows were quarantined (resilience/
+    #: quarantine.py: sidecar path, row/batch totals, by-stage breakdown) —
+    #: None when quarantine is off or nothing was shed
+    quarantine: Optional[dict] = None
 
 
 def write_table_csv(table: Table, path: str) -> None:
@@ -175,6 +179,37 @@ def shard_table_rows(mesh, table: Table, min_rows: int = 0) -> Table:
         return table
     record_sharded_dispatch()
     return Table(out)
+
+
+def _nonfinite_rows(scored: Table, result_features) -> np.ndarray:
+    """Per-row poison mask over a scored table: True where any RESULT column
+    (prediction scalar/probabilities, numeric outputs) holds NaN/Inf for that
+    row. Only runs in quarantine mode — it forces a D2H fetch of the result
+    columns, which the fault-free hot path must never pay."""
+    n = scored.nrows
+    bad = np.zeros(n, dtype=bool)
+    if n == 0:
+        # a fully-quarantined (or legitimately empty) batch: nothing to
+        # scan — and reshape(0, -1) on empty prediction arrays would raise
+        return bad
+    for f in result_features:
+        if f.name not in scored.columns:
+            continue
+        col = scored[f.name]
+        st = col.kind.storage
+        if st is Storage.PREDICTION:
+            pred = np.asarray(col.pred, np.float64)
+            prob = np.asarray(col.prob, np.float64).reshape(n, -1)
+            raw = np.asarray(col.raw_pred, np.float64).reshape(n, -1)
+            bad |= ~np.isfinite(pred)
+            bad |= ~np.isfinite(prob).all(axis=1)
+            bad |= ~np.isfinite(raw).all(axis=1)
+        elif st.value in ("real", "vector"):
+            v = np.asarray(col.values, np.float64).reshape(n, -1)
+            present = (np.ones(n, dtype=bool) if col.mask is None
+                       else np.asarray(col.mask, bool))
+            bad |= present & ~np.isfinite(v).all(axis=1)
+    return bad
 
 
 class _StreamColumnsPlan:
@@ -263,6 +298,26 @@ class WorkflowRunner:
 
         return default_mesh(params.mesh_shape)
 
+    @staticmethod
+    def _resolve_policy(params: OpParams):
+        """FaultPolicy from the OpParams knobs, or None when every knob sits
+        at its fail-fast default — the fault-free path then runs the exact
+        pre-resilience code."""
+        from ..resilience import FaultPolicy
+
+        # breaker_threshold alone does NOT arm a policy: it is a serving-
+        # handle tuning value and must not flip the runner's dispatch
+        # semantics away from fail-fast (it rides along once something
+        # that concerns the runner — retries/deadline/quarantine — arms one)
+        if (params.retry_max <= 0 and params.deadline_s is None
+                and params.quarantine_dir is None):
+            return None
+
+        return FaultPolicy(retry_max=params.retry_max,
+                           deadline_s=params.deadline_s,
+                           breaker_threshold=params.breaker_threshold,
+                           quarantine_dir=params.quarantine_dir)
+
     def add_application_end_handler(self, fn: Callable[[AppMetrics], None]) -> None:
         self._end_handlers.append(fn)
 
@@ -294,6 +349,18 @@ class WorkflowRunner:
         #: acceptable for a diagnostics section)
         mesh_stats_before = mesh_stats()
         self._run_mesh = None
+        # ambient fault policy for the WHOLE run (resilience.scoped): reader
+        # opens in every run type — train/score/features/evaluate, not just
+        # streaming — retry transient IO per params.retry_max. scoped(None)
+        # is a no-op, so default knobs change nothing.
+        from ..resilience import scoped as _policy_scope
+
+        policy = self._resolve_policy(params)
+
+        def dispatch():
+            with _policy_scope(policy):
+                return getattr(self, f"_run_{run_type}")(params, mark)
+
         try:
             if params.collect_stage_metrics or params.log_stage_metrics:
                 trace_dir = params.custom_params.get("trace_dir")
@@ -311,7 +378,7 @@ class WorkflowRunner:
 
                     prof_ctx = jax.profiler.trace(trace_dir)
                 with ctx as tracer, prof_ctx:
-                    result = getattr(self, f"_run_{run_type}")(params, mark)
+                    result = dispatch()
                 full = tracer.report()
                 # profile keeps the legacy shape; the span tree + compile
                 # attribution ride in the new AppMetrics trace section
@@ -331,7 +398,7 @@ class WorkflowRunner:
                         "trace for %s:\n%s", run_type, tracer.text_tree()
                     )
             else:
-                result = getattr(self, f"_run_{run_type}")(params, mark)
+                result = dispatch()
             # input-pipeline stats (host-stall vs backpressure, queue-depth
             # gauge, pad-bucket histogram) ride the trace section alongside
             # spans/compiles so app-end handlers see the whole picture
@@ -473,10 +540,23 @@ class WorkflowRunner:
         and the blocking result fetch + CSV write of batch k-1 rides a writer
         thread — the tf.data-style overlapped input pipeline
         (readers/pipeline.py). Batch order, program shapes, and output bytes
-        are identical to the synchronous loop (stream_prefetch=0)."""
+        are identical to the synchronous loop (stream_prefetch=0).
+
+        Resilient (any of OpParams retry_max / deadline_s / quarantine_dir
+        set; docs/robustness.md): transient ingest errors retry with seeded
+        backoff, device dispatches honor a per-dispatch deadline, and a
+        poison batch — parse/cast failure, dispatch crash, or non-finite
+        scores — sheds its offending rows to `quarantine_dir/quarantine.jsonl`
+        via row-bisect isolation. The run then COMPLETES, reporting the
+        partial-success summary on RunResult.quarantine. With the knobs at
+        their defaults this path is bit-identical to the pre-resilience
+        code (pinned by test)."""
         if self.streaming_reader is None:
             raise ValueError("streaming_score run needs a streaming reader")
+        import itertools
+
         from ..readers.pipeline import PipelineStats, run_pipeline
+        from ..resilience import chaos
         from ..types.table import pow2_bucket
 
         model = self._load_model(params)
@@ -485,6 +565,15 @@ class WorkflowRunner:
         mesh = self._resolve_mesh(params)
         self._run_mesh = mesh
         monitor = self._build_monitor(model, params)
+        # same _resolve_policy(params) run() used for the ambient scope —
+        # one resolver, so the dispatch/quarantine policy here can never
+        # drift from the policy the reader opens retry under
+        policy = self._resolve_policy(params)
+        qw = None
+        if policy is not None and policy.quarantine_dir:
+            from ..resilience import QuarantineWriter
+
+            qw = QuarantineWriter(policy.quarantine_dir)
         # per-raw-feature extraction plan derived ONCE per run: the
         # predictor/response split and kind lookups used to be rebuilt for
         # every batch (pure host-side work on the pipeline's critical path)
@@ -499,8 +588,18 @@ class WorkflowRunner:
             )
         stats = PipelineStats()
         counts = {"rows": 0, "batches": 0}
+        batch_counter = itertools.count()
+
+        def pad(table: Table) -> Table:
+            if self.stream_pad and table.nrows > 0:
+                table = table.pad_to(
+                    pow2_bucket(table.nrows, floor=self.stream_bucket_floor))
+            return table
 
         def prepare(batch):
+            bidx = next(batch_counter)
+            if not isinstance(batch, Table):
+                batch = chaos.corrupt_batch(batch, bidx)
             if monitor is not None:
                 # drift sketches fold on the producer thread, pre-pad and
                 # pre-table-build: the numpy histogram pass overlaps the
@@ -514,23 +613,184 @@ class WorkflowRunner:
             # building device columns (jnp.asarray) on the producer thread IS
             # the async H2D start: the transfer proceeds while the consumer
             # dispatches the previous batch's scoring program
-            table = batch if isinstance(batch, Table) else plan.build(batch)
+            base = None  # raw-table row -> ORIGINAL batch row (None = identity)
+            try:
+                table = batch if isinstance(batch, Table) else plan.build(batch)
+            except Exception:  # noqa: BLE001 — quarantine or re-raise
+                if qw is None or isinstance(batch, Table):
+                    raise
+                from ..resilience import isolate_failing
+
+                good, bad = isolate_failing(
+                    len(batch), lambda idx: plan.build([batch[i] for i in idx]))
+                qw.quarantine_rows([batch[i] for i, _ in bad],
+                                   batch_index=bidx, stage="parse",
+                                   errors=[e for _, e in bad],
+                                   row_indices=[i for i, _ in bad])
+                table = plan.build([batch[i] for i in good])
+                base = good
             n = table.nrows
+            #: the UNPADDED table rides along only in quarantine mode: the
+            #: score-time bisect probes row slices of it
+            raw = table if qw is not None else None
+            table = pad(table)
             if self.stream_pad and n > 0:
-                table = table.pad_to(
-                    pow2_bucket(n, floor=self.stream_bucket_floor))
                 stats.observe_bucket(table.nrows)
-            return n, table
+            return n, table, (bidx, raw, base)
+
+        def dispatch(table: Table) -> Table:
+            chaos.maybe_device("stream:dispatch")
+            if policy is not None and policy.deadline_s:
+                import jax
+
+                from ..resilience.policy import call_with_deadline
+
+                def run_and_block():
+                    scored = model.score(table=table)
+                    # the deadline covers execution, not just the enqueue
+                    jax.block_until_ready(
+                        {name: c.values for name, c in scored.items()})
+                    return scored
+
+                return call_with_deadline(run_and_block,
+                                          deadline_s=policy.deadline_s,
+                                          site="stream:dispatch")
+            return model.score(table=table)
+
+        def bisect_score(raw: Table, bidx: int, base):
+            """Dispatch failed twice: isolate poison rows on slices of the
+            unpadded table, quarantine them (sidecar indices mapped back to
+            ORIGINAL batch positions through `base` when a parse shed already
+            renumbered the surviving rows), score the survivors once.
+            Returns (scored_or_None, base mapping for the scored rows)."""
+            from ..resilience import isolate_failing
+
+            def probe(idx):
+                t = pad(raw.slice(np.asarray(idx, np.int64)))
+                scored = model.score(table=t)
+                import jax
+
+                jax.block_until_ready(
+                    {name: c.values for name, c in scored.items()})
+
+            def orig(i: int) -> int:
+                return base[i] if base is not None else i
+
+            good, bad = isolate_failing(raw.nrows, probe)
+            bad_rows = raw.slice(np.asarray([i for i, _ in bad],
+                                            np.int64)).to_rows()
+            qw.quarantine_rows(bad_rows, batch_index=bidx, stage="score",
+                               errors=[e for _, e in bad],
+                               row_indices=[orig(i) for i, _ in bad])
+            if not good:
+                return None, None
+            kept = raw.slice(np.asarray(good, np.int64))
+            scored = model.score(table=pad(kept))
+            if scored.nrows > len(good):
+                scored = scored.slice(np.arange(len(good)))
+            return scored, [orig(i) for i in good]
+
+        def shed_nonfinite(scored: Table, raw, bidx: int, base):
+            """Rows whose scores came back NaN/Inf are poison that parsed:
+            quarantine them (indices mapped to original batch positions via
+            `base`) and keep the finite remainder."""
+            bad_mask = _nonfinite_rows(scored, model.result_features)
+            if not bad_mask.any():
+                return scored
+            bad_idx = np.flatnonzero(bad_mask)
+            src = raw if raw is not None and raw.nrows == scored.nrows else scored
+            qw.quarantine_rows(src.slice(bad_idx).to_rows(), batch_index=bidx,
+                               stage="nonfinite",
+                               row_indices=[int(base[i]) if base is not None
+                                            else int(i) for i in bad_idx])
+            return scored.slice(np.flatnonzero(~bad_mask))
+
+        def quarantine_deadline_batch(raw: Table, bidx: int, base, e2) -> None:
+            """A double deadline breach is a wedged DEVICE, not data poison:
+            bisect probes (which run without a deadline) could hang forever,
+            so the whole batch quarantines as one deadline casualty. The
+            row-content fetch itself touches the wedged device (to_rows is a
+            blocking D2H), so it too runs under the deadline — placeholders
+            beat a hung run."""
+            from ..resilience.policy import call_with_deadline
+
+            try:
+                payload = call_with_deadline(
+                    raw.to_rows, deadline_s=policy.deadline_s,
+                    site="stream:quarantine_fetch")
+            except Exception:  # noqa: BLE001 — wedged fetch
+                payload = ["<unfetchable: device wedged>"] * raw.nrows
+            qw.quarantine_rows(payload, batch_index=bidx, stage="deadline",
+                               errors=[e2] * raw.nrows,
+                               row_indices=[base[i] if base is not None else i
+                                            for i in range(raw.nrows)])
+
+        def note_dispatch_retry(err) -> None:
+            """Whole-batch dispatch retries must be observable, never silent
+            (the layer's own design rule): event + counter per retry."""
+            from .. import obs
+
+            obs.add_event("resilience:retry", site="stream:dispatch",
+                          error=f"{type(err).__name__}: {err}"[:200])
+            obs.default_registry().counter(
+                "resilience_retries_total",
+                help="transient-error retries per site",
+                labels={"site": "stream:dispatch"}).inc()
+
+        def bisect_and_shed(raw, bidx, base):
+            scored, scored_base = bisect_score(raw, bidx, base)
+            if scored is None:
+                return None  # every row poisoned: nothing to write
+            scored = shed_nonfinite(scored, None, bidx, scored_base)
+            counts["rows"] += scored.nrows
+            return scored
 
         def compute(item):
-            n, table = item
-            scored = model.score(table=table)
+            n, table, ctx = item
+            bidx, raw, base = ctx
+            try:
+                scored = dispatch(table)
+            except Exception as e1:  # noqa: BLE001 — classified below
+                from ..resilience import TRANSIENT_ERRORS, DeadlineExceeded
+
+                data_err = isinstance(
+                    e1, (ValueError, KeyError, TypeError, IndexError))
+                if qw is None:
+                    if policy is None or not isinstance(e1, TRANSIENT_ERRORS):
+                        # every knob at its fail-fast default (or a data
+                        # error): today's behavior, no silent second chance
+                        raise
+                    # transient dispatch failure (deadline breach included)
+                    # with a policy but quarantine OFF: one whole-batch retry
+                    # so a blip doesn't kill the run; a second failure
+                    # propagates — fail fast, never hang, never drop rows
+                    note_dispatch_retry(e1)
+                    scored = dispatch(table)
+                elif data_err:
+                    # deterministic data error: a blind full-batch retry
+                    # would fail identically — straight to row-bisect
+                    return bisect_and_shed(raw, bidx, base)
+                else:
+                    try:
+                        # one whole-batch retry: a transient dispatch failure
+                        # (injected fault budget, recovered device) clears
+                        note_dispatch_retry(e1)
+                        scored = dispatch(table)
+                    except DeadlineExceeded as e2:
+                        quarantine_deadline_batch(raw, bidx, base, e2)
+                        return None
+                    except Exception:  # noqa: BLE001
+                        return bisect_and_shed(raw, bidx, base)
             if scored.nrows > n:
                 scored = scored.slice(np.arange(n))
+            if qw is not None:
+                scored = shed_nonfinite(scored, raw, bidx, base)
             counts["rows"] += scored.nrows
             return scored
 
         def sink(scored):
+            if scored is None:
+                return  # fully-quarantined batch: no part file
             # write_table_csv -> to_rows forces the D2H fetch here, off the
             # dispatch thread: the fetch of batch k overlaps compute of k+1
             write_table_csv(
@@ -542,20 +802,25 @@ class WorkflowRunner:
             def place(item):
                 # producer-thread placement: the batch lands PRE-SHARDED over
                 # the data axis while the device still scores its predecessor
-                n, table = item
+                n, table, ctx = item
                 return n, shard_table_rows(mesh, table,
-                                           self.stream_shard_min_rows)
+                                           self.stream_shard_min_rows), ctx
 
         counts["written"] = 0
+        # reader opens (io_guard sites) already sit under the run-wide
+        # ambient policy scope installed by run()'s dispatch wrapper
         run_pipeline(batches, prepare, compute, sink if loc else None,
                      prefetch=self.stream_prefetch,
                      sink_depth=self.stream_sink_depth, stats=stats,
-                     place=place)
+                     place=place, policy=policy)
         mark("streaming_score")
+        if qw is not None:
+            qw.close()
         return RunResult("streaming_score", write_location=loc,
                          n_rows=counts["rows"], batches=stats.batches,
                          pipeline=stats.to_dict(),
-                         monitor=monitor.report() if monitor else None)
+                         monitor=monitor.report() if monitor else None,
+                         quarantine=qw.summary() if qw else None)
 
     @staticmethod
     def _write_metrics(metrics: Any, location: Optional[str]) -> None:

@@ -690,7 +690,8 @@ class WorkflowModel(WorkflowCore):
     # --- serving (analog of OpWorkflowModelLocal.scoreFunction) -----------------------
     def score_fn(self, result_names: Optional[Sequence[str]] = None,
                  pad_to: Optional[Sequence[int]] = None,
-                 backend: Optional[str] = "auto", mesh=None, monitor=None):
+                 backend: Optional[str] = "auto", mesh=None, monitor=None,
+                 policy=None):
         """Spark-free serving callable: dict -> dict for one record, .batch(rows) for
         many, .table(table) columnar; same stage kernels as training, jit-cached
         (no MLeap-style conversion). backend="auto" (default) routes small
@@ -700,11 +701,15 @@ class WorkflowModel(WorkflowCore):
         batches across chips (serve/scoring.py). `monitor=True` attaches a
         ServingMonitor built from the model's stamped serving_baseline
         (obs/monitor.py): scoring batches fold into drift sketches and
-        threshold crossings raise structured DriftAlerts."""
+        threshold crossings raise structured DriftAlerts. `policy` (a
+        resilience.FaultPolicy) arms per-dispatch deadlines, tunes the
+        device circuit breaker, and enables poison-row quarantine in
+        `.stream()` (docs/robustness.md)."""
         from ..serve.scoring import score_function
 
         return score_function(self, result_names=result_names, pad_to=pad_to,
-                              backend=backend, mesh=mesh, monitor=monitor)
+                              backend=backend, mesh=mesh, monitor=monitor,
+                              policy=policy)
 
     # --- insights (analog of OpWorkflowModel.modelInsights / summaryPretty) -----------
     def model_insights(self, feature: Optional[Feature] = None):
@@ -781,10 +786,49 @@ class WorkflowModel(WorkflowCore):
             from ..obs.monitor import baseline_to_json
 
             manifest["serving_baseline"] = baseline_to_json(self.serving_baseline)
-        with open(target, "w") as fh:
-            json.dump(manifest, fh, indent=1)
+        # ATOMIC save, including RESAVE over an existing model: the arrays
+        # sidecar gets a fresh GENERATION name each save and the manifest
+        # records it under "arrays_file", so the manifest's os.replace is the
+        # single publish point — a crash at any instant leaves the dir
+        # loading either the previous complete model (its own npz still on
+        # disk, still referenced) or the new complete one; a new-npz/old-
+        # manifest mix can never be served because the old manifest never
+        # references the new file. Temp files carry pid AND thread id so
+        # concurrent savers cannot interleave writes; superseded generations
+        # are swept only AFTER the manifest lands (best-effort).
+        import secrets as _secrets
+        import threading as _threading
+
+        suffix = f"tmp.{os.getpid()}.{_threading.get_ident()}"
+        arrays_name = None
         if arrays:
-            _np.savez_compressed(os.path.join(path, self.MANIFEST_ARRAYS), **arrays)
+            arrays_name = f"params-{_secrets.token_hex(8)}.npz"
+            manifest["arrays_file"] = arrays_name
+            npz_target = os.path.join(path, arrays_name)
+            npz_tmp = f"{npz_target}.{suffix}"
+            try:
+                with open(npz_tmp, "wb") as fh:
+                    _np.savez_compressed(fh, **arrays)
+                os.replace(npz_tmp, npz_target)
+            finally:
+                if os.path.exists(npz_tmp):
+                    os.remove(npz_tmp)
+        json_tmp = f"{target}.{suffix}"
+        try:
+            with open(json_tmp, "w") as fh:
+                json.dump(manifest, fh, indent=1)
+            os.replace(json_tmp, target)
+        finally:
+            if os.path.exists(json_tmp):
+                os.remove(json_tmp)
+        for fname in os.listdir(path):
+            if (fname.endswith(".npz") and fname != arrays_name
+                    and (fname.startswith("params-")
+                         or fname == self.MANIFEST_ARRAYS)):
+                try:
+                    os.remove(os.path.join(path, fname))
+                except OSError:
+                    pass  # sweep is best-effort; stale npz is inert debris
 
     @staticmethod
     def load(path: str) -> "WorkflowModel":
@@ -792,7 +836,10 @@ class WorkflowModel(WorkflowCore):
 
         with open(os.path.join(path, WorkflowModel.MANIFEST)) as fh:
             manifest = json.load(fh)
-        npz_path = os.path.join(path, WorkflowModel.MANIFEST_ARRAYS)
+        # generation-named sidecar (atomic resave); legacy bundles carry the
+        # fixed params.npz name and no "arrays_file" key
+        npz_path = os.path.join(
+            path, manifest.get("arrays_file") or WorkflowModel.MANIFEST_ARRAYS)
         arrays = _np.load(npz_path) if os.path.exists(npz_path) else None
         for sj in manifest["stages"]:
             for k, v in sj["params"].items():
