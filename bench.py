@@ -460,6 +460,7 @@ def _run(partial: dict) -> None:
             run_mlp,
             run_monitor_overhead,
             run_resilience_overhead,
+            run_serving_daemon,
             run_streaming_score,
             run_trees,
         )
@@ -498,6 +499,15 @@ def _run(partial: dict) -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         partial["resilience_throughput_retention"] = \
             detail["resilience_overhead"].get("resilience_throughput_retention")
+        # serving daemon: closed-loop concurrent clients through the
+        # adaptive micro-batcher vs the per-call device path (tail latency
+        # is the gated number, not just throughput)
+        try:
+            detail["serving_daemon"] = run_serving_daemon()
+        except Exception as e:  # noqa: BLE001
+            detail["serving_daemon"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        partial["serving_daemon_p50_ms"] = \
+            detail["serving_daemon"].get("daemon_p50_ms")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({
@@ -574,6 +584,13 @@ def _run(partial: dict) -> None:
         s["resilience_throughput_retention"] = \
             ro["resilience_throughput_retention"]
         s["resilience_armed_rows_per_sec"] = ro["armed_rows_per_sec"]
+    if detail.get("serving_daemon", {}).get("daemon_p50_ms") is not None:
+        sd = detail["serving_daemon"]
+        s["serving_daemon_p50_ms"] = sd["daemon_p50_ms"]
+        s["serving_daemon_p99_ms"] = sd["daemon_p99_ms"]
+        s["serving_daemon_rows_per_sec"] = sd["daemon_rows_per_sec"]
+        s["serving_daemon_speedup_p50"] = sd["daemon_speedup_p50"]
+        s["serving_coalesced_rows_per_dispatch"] = sd["mean_rows_per_dispatch"]
     _emit_final(compact)
 
 

@@ -521,6 +521,129 @@ def run_resilience_overhead(n_batches: int = 32, batch: int = 512) -> dict:
     }
 
 
+def run_serving_daemon(n_clients: int = 32, requests_per_client: int = 12,
+                       max_wait_ms: float = 2.0) -> dict:
+    """Serving-daemon lane: closed-loop concurrent single-row clients through
+    the adaptive micro-batcher vs the per-call path (ISSUE-7 acceptance).
+
+    Baseline: `n_clients` threads, each sequentially calling
+    `score_fn(backend=None)` — the pinned device lane — per record, the
+    pre-daemon serving shape where every request pays its own dispatch.
+    Daemon: the same closed-loop clients through an admitted model's
+    `DaemonClient` — concurrent requests coalesce into pow2-padded batches,
+    one dispatch per window. Reports p50/p95/p99 per-request latency and
+    throughput for both, the coalescing shape (dispatches, mean rows per
+    dispatch), and `daemon_speedup_p50` = per-call p50 / daemon p50 (the
+    >=10x acceptance number on device hosts)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.serve import DaemonClient, ServingDaemon
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    schema = {"label": "RealNN", **{f"x{i}": "Real" for i in range(6)},
+              "cat": "PickList"}
+    rng = np.random.default_rng(17)
+
+    def rows(n, labeled=True):
+        out = []
+        for _ in range(n):
+            r = {f"x{i}": float(v)
+                 for i, v in enumerate(rng.normal(size=6))}
+            r["cat"] = "abcd"[int(rng.integers(0, 4))]
+            if labeled:
+                r["label"] = float(rng.random() > 0.5)
+            out.append(r)
+        return out
+
+    fs = features_from_schema(schema, response="label")
+    vec = transmogrify([f for n_, f in fs.items() if n_ != "label"])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    model = (Workflow().set_reader(InMemoryReader(rows(1024)))
+             .set_result_features(pred).train())
+    serving = rows(max(64, n_clients * 2), labeled=False)
+
+    def closed_loop(score_one) -> list:
+        """n_clients threads, each requests_per_client sequential requests;
+        returns every per-request wall time."""
+        lats: list = [None] * (n_clients * requests_per_client)
+        barrier = threading.Barrier(n_clients)
+
+        def client(cid):
+            barrier.wait()
+            for k in range(requests_per_client):
+                rec = serving[(cid * 7 + k) % len(serving)]
+                t0 = time.perf_counter()
+                score_one(rec)
+                lats[cid * requests_per_client + k] = \
+                    time.perf_counter() - t0
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return sorted(lats), wall
+
+    def pct(lats, q):
+        return lats[min(len(lats) - 1, int(q / 100.0 * len(lats)))]
+
+    n_req = n_clients * requests_per_client
+
+    # --- per-call baseline: every request its own device dispatch ---------
+    percall_fn = model.score_fn(backend=None, pad_to=[1])
+    percall_fn.warm([1])
+    percall_lats, percall_wall = closed_loop(percall_fn)
+
+    # --- daemon path: admit (pre-warm buckets) + coalesced dispatches -----
+    mdir = tempfile.mkdtemp(prefix="bench_daemon_model_")
+    try:
+        model.save(mdir, overwrite=True)
+        with ServingDaemon(max_models=2, max_batch=256, bucket_floor=1,
+                           max_wait_ms=max_wait_ms) as daemon:
+            t0 = time.perf_counter()
+            entry = daemon.admit(mdir, name="bench")
+            admit_wall = time.perf_counter() - t0
+            client = DaemonClient(daemon)
+            closed_loop(lambda r: client.score([r], model="bench"))  # warm EMA
+            base_dispatches = entry.batcher.dispatches
+            daemon_lats, daemon_wall = closed_loop(
+                lambda r: client.score([r], model="bench"))
+            bstats = entry.batcher.stats()
+            dispatches = entry.batcher.dispatches - base_dispatches
+            threshold = entry.score_fn.auto_threshold()
+    finally:
+        shutil.rmtree(mdir, ignore_errors=True)
+
+    return {
+        "clients": n_clients, "requests_per_client": requests_per_client,
+        "requests": n_req, "max_wait_ms": max_wait_ms,
+        "admit_warm_s": round(admit_wall, 3),
+        "percall_p50_ms": round(pct(percall_lats, 50) * 1e3, 3),
+        "percall_p95_ms": round(pct(percall_lats, 95) * 1e3, 3),
+        "percall_p99_ms": round(pct(percall_lats, 99) * 1e3, 3),
+        "percall_rows_per_sec": round(n_req / percall_wall),
+        "daemon_p50_ms": round(pct(daemon_lats, 50) * 1e3, 3),
+        "daemon_p95_ms": round(pct(daemon_lats, 95) * 1e3, 3),
+        "daemon_p99_ms": round(pct(daemon_lats, 99) * 1e3, 3),
+        "daemon_rows_per_sec": round(n_req / daemon_wall),
+        "daemon_speedup_p50": round(
+            pct(percall_lats, 50) / max(pct(daemon_lats, 50), 1e-9), 3),
+        "coalesced_dispatches": dispatches,
+        "mean_rows_per_dispatch": round(n_req / max(dispatches, 1), 2),
+        "auto_threshold_rows": threshold,
+        "batcher": bstats,
+    }
+
+
 def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
               max_depth: int = 6, n_bins: int = 64) -> dict:
     """Gradient-boosted trees at data scale: 1M rows x 256 features, n_trees
@@ -575,7 +698,8 @@ def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
 ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp,
        "trees": run_trees, "streaming": run_streaming_score,
        "monitor": run_monitor_overhead,
-       "resilience": run_resilience_overhead}
+       "resilience": run_resilience_overhead,
+       "daemon": run_serving_daemon}
 
 if __name__ == "__main__":
     import sys

@@ -1,0 +1,550 @@
+"""Serving daemon + adaptive micro-batcher (serve/daemon.py, serve/batcher.py).
+
+Pins the ISSUE-7 acceptance surface: N concurrent single-row requests
+coalesce into <= log2(N)+1 dispatches with bit-identical demultiplexing,
+the max-wait deadline fires (and the adaptive lone-client mode drops it),
+shutdown drains mid-flight, admission pre-warm makes steady-state serving
+retrace-free, and a second admitted model neither evicts nor retraces the
+first. Plus the ScoreFunction concurrency hammer and the measured routing
+crossover that replaced the static auto_cpu_threshold constant.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.readers.streaming import StreamClosed
+from transmogrifai_tpu.serve import (
+    DaemonClient,
+    MicroBatcher,
+    ServingDaemon,
+    fingerprint_model_dir,
+    make_http_server,
+    serving_buckets,
+)
+from transmogrifai_tpu.serve.scoring import AUTO_CPU_THRESHOLD
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+KINDS = {"label": "RealNN", "a": "Real", "cat": "PickList"}
+
+
+def _train(seed=5, l2=0.01):
+    rng = np.random.default_rng(seed)
+    rows = [{"label": float(i % 2), "a": float(i % 2) + rng.normal(0, 0.1),
+             "cat": "ab"[i % 2]} for i in range(64)]
+    fs = features_from_schema(KINDS, response="label")
+    pred = LogisticRegression(l2=l2)(
+        fs["label"], transmogrify([fs["a"], fs["cat"]]))
+    model = (Workflow().set_reader(InMemoryReader(rows))
+             .set_result_features(pred).train())
+    return model, pred.name, rows
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _train()
+
+
+@pytest.fixture(scope="module")
+def serving_rows(fitted):
+    _, _, rows = fitted
+    return [{k: v for k, v in r.items() if k != "label"} for r in rows]
+
+
+@pytest.fixture(scope="module")
+def model_dir_a(fitted, tmp_path_factory):
+    model, _, _ = fitted
+    d = tmp_path_factory.mktemp("daemon_model_a")
+    model.save(str(d), overwrite=True)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def model_dir_b(tmp_path_factory):
+    model, _, _ = _train(seed=11, l2=0.5)  # different weights = different fp
+    d = tmp_path_factory.mktemp("daemon_model_b")
+    model.save(str(d), overwrite=True)
+    return str(d)
+
+
+class TestBucketsAndFingerprint:
+    def test_serving_buckets_ladder(self):
+        assert serving_buckets(1, 8) == [1, 2, 4, 8]
+        assert serving_buckets(3, 20) == [4, 8, 16, 32]
+        assert serving_buckets(8, 8) == [8]
+
+    def test_fingerprint_stable_and_content_sensitive(self, model_dir_a,
+                                                      model_dir_b, tmp_path):
+        assert fingerprint_model_dir(model_dir_a) == \
+            fingerprint_model_dir(model_dir_a)
+        assert fingerprint_model_dir(model_dir_a) != \
+            fingerprint_model_dir(model_dir_b)
+        # BYTE sensitivity: a same-size in-place sidecar change (external
+        # sync dropping different arrays into an existing dir) must change
+        # the fingerprint — stale-weight cache hits are silent wrongness
+        import shutil
+
+        clone = tmp_path / "clone"
+        shutil.copytree(model_dir_a, clone)
+        assert fingerprint_model_dir(str(clone)) == \
+            fingerprint_model_dir(model_dir_a)
+        (clone / "extra.npz").write_bytes(b"\x00" * 63 + b"\x01")
+        fp1 = fingerprint_model_dir(str(clone))
+        (clone / "extra.npz").write_bytes(b"\x00" * 64)
+        assert fingerprint_model_dir(str(clone)) != fp1
+
+
+class TestMicroBatcher:
+    def test_exact_fill_coalesces_once_bit_identical(self, fitted,
+                                                     serving_rows):
+        """8 single-row requests with max_batch=8 close ONE window exactly at
+        the fill — and the demuxed responses are bit-identical to
+        score_fn.batch over the same records in the same order (same pad
+        bucket, same lane, same program)."""
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 8))
+        fn.warm()
+        recs = serving_rows[:8]
+        batcher = MicroBatcher(fn, max_batch=8, max_wait_ms=2000.0)
+        try:
+            futs = [batcher.submit([r]) for r in recs]
+            got = [f.result(60) for f in futs]
+        finally:
+            batcher.close()
+        assert batcher.dispatches == 1
+        assert batcher.coalesced_requests == 8
+        expected = fn.batch(recs)
+        assert [g[0] for g in got] == expected  # bitwise: same program shape
+
+    def test_concurrent_singles_bounded_dispatches(self, fitted,
+                                                   serving_rows):
+        """N concurrent single-row clients coalesce into <= log2(N)+1 device
+        dispatches; every response demultiplexes to its caller (parity vs
+        per-row score_fn)."""
+        model, pname, _ = fitted
+        n = 32
+        fn = model.score_fn(pad_to=serving_buckets(1, 64))
+        fn.warm()
+        batcher = MicroBatcher(fn, max_batch=64, max_wait_ms=250.0)
+        results: list = [None] * n
+        barrier = threading.Barrier(n)
+
+        def client(i):
+            barrier.wait()
+            results[i] = batcher.score([serving_rows[i]], timeout=60)[0]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        finally:
+            batcher.close()
+        assert batcher.dispatches <= int(np.log2(n)) + 1
+        for i in range(n):
+            exp = fn(serving_rows[i])
+            got = results[i]
+            assert got[pname]["prediction"] == exp[pname]["prediction"]
+            np.testing.assert_allclose(got[pname]["probability"],
+                                       exp[pname]["probability"], rtol=1e-5)
+
+    def test_max_wait_deadline_fires_then_adaptive_drops_it(self, fitted,
+                                                            serving_rows):
+        """A lone request dispatches when the max-wait deadline fires (not at
+        max_batch fill); once the window-size EMA has learned the lone
+        client, early dispatch drops the wait to ~zero."""
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 8))
+        fn.warm()
+        batcher = MicroBatcher(fn, max_batch=8, max_wait_ms=300.0)
+        try:
+            walls = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                batcher.score([serving_rows[0]], timeout=60)
+                walls.append(time.perf_counter() - t0)
+        finally:
+            batcher.close()
+        assert batcher.dispatches == 3
+        assert walls[0] >= 0.25      # deadline held the first window open
+        assert walls[2] < 0.2        # lone-client mode: wait skipped
+
+    def test_shutdown_drains_mid_flight(self, fitted, serving_rows):
+        """close() mid-flight completes every queued request (no drops, no
+        hangs) and further submits are rejected loudly."""
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 8))
+        fn.warm()
+        batcher = MicroBatcher(fn, max_batch=8, max_wait_ms=100.0)
+        sizes = [1 + (i % 3) for i in range(30)]
+        futs = []
+        start = 0
+        for s in sizes:
+            futs.append(batcher.submit(serving_rows[start:start + s]))
+            start = (start + s) % 40
+        batcher.close()  # mid-flight: most requests still queued
+        for f, s in zip(futs, sizes):
+            out = f.result(60)
+            assert len(out) == s and all(r is not None for r in out)
+        with pytest.raises(StreamClosed):
+            batcher.submit([serving_rows[0]])
+        batcher.close()  # idempotent
+
+    def test_empty_request_resolves_immediately(self, fitted):
+        model, _, _ = fitted
+        batcher = MicroBatcher(model.score_fn(), max_wait_ms=10.0)
+        try:
+            f = batcher.submit([])
+            assert isinstance(f, Future) and f.result(5) == []
+        finally:
+            batcher.close()
+
+    def test_oversized_request_rejected(self, fitted, serving_rows):
+        """A request past max_batch would dispatch at an unwarmed, unpadded
+        shape — rejected at submit, loudly."""
+        model, _, _ = fitted
+        batcher = MicroBatcher(model.score_fn(pad_to=[1, 2, 4]),
+                               max_batch=4, max_wait_ms=10.0)
+        try:
+            with pytest.raises(ValueError, match="exceeds max_batch"):
+                batcher.submit(serving_rows[:5])
+        finally:
+            batcher.close()
+
+    def test_window_never_overshoots_max_batch(self, fitted, serving_rows):
+        """A joining request that would push the window past max_batch is
+        handed back (put_front) for the NEXT window — every dispatch stays
+        within the warmed bucket ladder."""
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 8))
+        fn.warm()
+        batcher = MicroBatcher(fn, max_batch=8, max_wait_ms=250.0)
+        try:
+            futs = [batcher.submit(serving_rows[i * 5:i * 5 + 5])
+                    for i in range(2)]  # 5 + 5 rows: must NOT fuse into 10
+            for f in futs:
+                assert len(f.result(60)) == 5
+        finally:
+            batcher.close()
+        assert batcher.dispatches == 2
+        assert batcher.coalesced_rows == 10
+
+    def test_unexpected_stream_error_restarts_fast(self, fitted,
+                                                   serving_rows):
+        """Without quarantine, a poison request fails ITS future loudly and
+        the batcher restarts a fresh stream promptly — follow-up traffic is
+        served, nothing hangs, and the restart does not stall on the
+        torn-down producer (the on_pipeline_close teardown hook)."""
+        model, pname, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 8))  # no policy
+        fn.warm()
+        batcher = MicroBatcher(fn, max_batch=8, max_wait_ms=20.0)
+        try:
+            bad = batcher.submit([{"a": "not-a-number", "cat": "a"}])
+            with pytest.raises(Exception):
+                bad.result(30)
+            t0 = time.perf_counter()
+            out = batcher.score([serving_rows[0]], timeout=30)
+            recovery = time.perf_counter() - t0
+            assert out[0][pname]["prediction"] in (0.0, 1.0)
+            assert recovery < 3.0  # no 5s close-join stall on restart
+        finally:
+            batcher.close()
+
+
+class TestScoreFunctionConcurrency:
+    def test_hammer_plans_built_once_results_stable(self, fitted,
+                                                    serving_rows,
+                                                    monkeypatch):
+        """8 threads hammering one handle: the lazily-built LocalPlan must
+        construct exactly once per lane (no duplicate jit programs from the
+        get-or-create race) and every result must equal the serial
+        reference bit-for-bit."""
+        from transmogrifai_tpu.serve import local as serve_local
+
+        builds = []
+        real_init = serve_local.LocalPlan.__init__
+
+        def counting_init(self, *a, **kw):
+            builds.append(1)
+            return real_init(self, *a, **kw)
+
+        monkeypatch.setattr(serve_local.LocalPlan, "__init__", counting_init)
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=[1, 2, 4])
+        fn.warm()
+        assert len(builds) == 1  # cpu-default host: one (device) lane
+        sizes = [1, 2, 4]
+        reference = {s: fn.batch(serving_rows[:s]) for s in sizes}
+        errors: list = []
+
+        def hammer(tid):
+            try:
+                for i in range(25):
+                    s = sizes[(tid + i) % len(sizes)]
+                    assert fn.batch(serving_rows[:s]) == reference[s]
+                    fn(serving_rows[tid % 8])
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors
+        assert len(builds) == 1
+        assert len(fn._plans) == 1
+
+    def test_breaker_state_surface(self, fitted):
+        model, _, _ = fitted
+        assert model.score_fn().breaker_state() == "closed"
+        assert model.score_fn(backend="cpu").breaker_state() is None
+
+
+class TestCrossover:
+    def test_static_fallback_while_lanes_cold(self, fitted):
+        model, _, _ = fitted
+        fn = model.score_fn()
+        assert fn.auto_threshold() == AUTO_CPU_THRESHOLD
+        fn2 = model.score_fn(auto_cpu_threshold=31)
+        assert fn2.auto_threshold() == 31
+
+    def test_measured_crossover_from_lane_windows(self, fitted):
+        """device p50 10ms / cpu 1ms-per-row -> crossover 10 rows."""
+        model, _, _ = fitted
+        fn = model.score_fn()
+        fn._lane_lat["device"] = deque([(0.010, 8)] * 8)
+        fn._lane_lat["cpu"] = deque([(0.001, 1)] * 8)
+        assert fn.auto_threshold() == 10
+
+    def test_crossover_drives_routing(self, fitted, serving_rows,
+                                      monkeypatch):
+        """With warm measured lanes the router flips at the measured
+        crossover, not the 256 constant: a 16-row batch takes the device
+        once the device p50 says it pays for itself."""
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 32))
+        fn._lane_lat["device"] = deque([(0.010, 8)] * 8)
+        fn._lane_lat["cpu"] = deque([(0.001, 1)] * 8)
+
+        real_devices = jax.devices
+
+        class _FakeTpu:
+            platform = "tpu"
+
+        def fake_devices(backend=None):
+            if backend is None:
+                return [_FakeTpu()]
+            return real_devices(backend)
+
+        monkeypatch.setattr(jax, "devices", fake_devices)
+        with obs.trace() as tracer:
+            fn.batch(serving_rows[:4])    # 4 < 10 -> cpu
+            fn.batch(serving_rows[:16])   # 16 >= 10 -> device
+        events = [e for e in tracer.root.events
+                  if e["name"] == "serve:routing"]
+        assert [e["backend"] for e in events] == ["cpu", "device"]
+        assert all(e["decided"] == "auto" for e in events)
+
+
+class TestWarm:
+    def test_warm_then_steady_state_compiles_nothing(self, fitted,
+                                                     serving_rows):
+        """Admission-style pre-warm: after warm() every request at any
+        warmed bucket shape (1-row, padded 3-row, exact 8-row) runs under
+        retrace_budget(0)."""
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=[1, 2, 4, 8])
+        report = fn.warm()
+        assert report["buckets"] == [1, 2, 4, 8]
+        assert report["programs"] == 4  # cpu-default host: one lane
+        with obs.retrace_budget(0):
+            fn(serving_rows[0])
+            fn.batch(serving_rows[:3])
+            fn.batch(serving_rows[:8])
+
+    def test_warm_serving_helper_shared_with_admission(self, model_dir_a):
+        from transmogrifai_tpu.workflow.warmup import warm_serving
+
+        report = warm_serving(model_dir_a, floor=1, max_batch=4, log=None)
+        assert report["buckets"] == [1, 2, 4]
+        assert report["lanes"] == ["device"]
+        assert report["model"]
+
+    def test_cli_warmup_serving(self, model_dir_a, capsys):
+        from transmogrifai_tpu.cli.main import main
+
+        rc = main(["warmup", "--serving", model_dir_a,
+                   "--serving-max-batch", "4"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip())
+        assert report["buckets"] == [1, 2, 4]
+
+
+class TestDaemon:
+    def test_admission_cache_hit_by_fingerprint(self, model_dir_a):
+        with ServingDaemon(max_models=2, max_batch=8,
+                           max_wait_ms=20.0) as daemon:
+            e1 = daemon.admit(model_dir_a, name="a")
+            e2 = daemon.admit(model_dir_a)
+            assert e1 is e2  # same content fingerprint = cache hit
+            assert [m["name"] for m in daemon.models()] == ["a"]
+
+    def test_second_model_no_evict_no_retrace(self, model_dir_a,
+                                              model_dir_b, serving_rows):
+        """ISSUE-7 acceptance: admitting a second model neither evicts nor
+        retraces the first — its entry survives and serving it stays
+        compile-free."""
+        with ServingDaemon(max_models=4, max_batch=8,
+                           max_wait_ms=20.0) as daemon:
+            client = DaemonClient(daemon)
+            entry_a = daemon.admit(model_dir_a, name="a")
+            assert client.score([serving_rows[0]], model="a")[0] is not None
+            daemon.admit(model_dir_b, name="b")
+            assert client.score([serving_rows[0]], model="b")[0] is not None
+            assert daemon._resolve("a") is entry_a  # not evicted
+            with obs.retrace_budget(0):  # not retraced either
+                out = client.score(serving_rows[:3], model="a")
+            assert len(out) == 3
+
+    def test_lru_eviction_closes_the_victim(self, model_dir_a, model_dir_b,
+                                            serving_rows):
+        with ServingDaemon(max_models=1, max_batch=8,
+                           max_wait_ms=20.0) as daemon:
+            entry_a = daemon.admit(model_dir_a, name="a")
+            daemon.admit(model_dir_b, name="b")
+            assert [m["name"] for m in daemon.models()] == ["b"]
+            assert entry_a.batcher.closed  # victim drained + closed
+            with pytest.raises(StreamClosed):
+                entry_a.batcher.submit([serving_rows[0]])
+            with pytest.raises(KeyError):
+                daemon.score("a", [serving_rows[0]])
+
+    def test_close_during_admission_refuses_and_drains(self, model_dir_a,
+                                                       monkeypatch):
+        """close() racing a mid-warm admission: the fresh entry must be
+        drained and the admission refused — never a live batcher leaked
+        into a closed daemon's (empty) cache."""
+        daemon = ServingDaemon(max_models=2, max_batch=8, max_wait_ms=20.0)
+        real_warm = None
+        from transmogrifai_tpu.serve.scoring import ScoreFunction
+
+        real_warm = ScoreFunction.warm
+
+        def closing_warm(self_fn, *a, **kw):
+            out = real_warm(self_fn, *a, **kw)
+            daemon.close()  # lands mid-admission, before cache insert
+            return out
+
+        monkeypatch.setattr(ScoreFunction, "warm", closing_warm)
+        with pytest.raises(RuntimeError, match="closed during admission"):
+            daemon.admit(model_dir_a, name="a")
+        assert not daemon.models()
+
+    def test_resolve_rules(self, model_dir_a, model_dir_b, serving_rows):
+        with ServingDaemon(max_models=2, max_batch=8,
+                           max_wait_ms=20.0) as daemon:
+            daemon.admit(model_dir_a, name="a")
+            # single model: name optional; dir path also resolves
+            assert daemon.score(None, [serving_rows[0]])[0] is not None
+            assert daemon.score(model_dir_a, [serving_rows[0]])[0] is not None
+            daemon.admit(model_dir_b, name="b")
+            with pytest.raises(KeyError, match="name required"):
+                daemon.score(None, [serving_rows[0]])
+            with pytest.raises(KeyError, match="not admitted"):
+                daemon.score("nope", [serving_rows[0]])
+
+    def test_poison_request_contained_by_quarantine(self, model_dir_a,
+                                                    serving_rows, tmp_path):
+        """A poison row (unparseable value) comes back as None for ITS
+        position only; the batcher stream survives and keeps serving."""
+        with ServingDaemon(max_models=1, max_batch=8, max_wait_ms=20.0,
+                           quarantine_root=str(tmp_path)) as daemon:
+            client = DaemonClient(daemon)
+            daemon.admit(model_dir_a, name="a")
+            good = serving_rows[0]
+            out = client.score([good, {"a": "not-a-number", "cat": "a"},
+                                good], model="a")
+            assert out[0] is not None and out[2] is not None
+            assert out[1] is None
+            # the stream survived: traffic keeps flowing afterwards
+            assert client.score([good], model="a")[0] is not None
+
+    def test_http_surface(self, model_dir_a, model_dir_b, serving_rows):
+        from transmogrifai_tpu.obs.metrics import parse_prometheus
+
+        daemon = ServingDaemon(max_models=2, max_batch=8, max_wait_ms=20.0)
+        daemon.admit(model_dir_a, name="a")
+        server = make_http_server(daemon, port=0)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{port}"
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=30) as resp:
+                return resp.status, resp.read()
+
+        try:
+            status, body = post("/v1/score",
+                                {"model": "a", "records": serving_rows[:2]})
+            assert status == 200 and len(body["results"]) == 2
+            assert body["model"] == "a"
+
+            status, raw = get("/healthz")
+            health = json.loads(raw)
+            assert status == 200 and health["status"] == "ok"
+            assert [m["name"] for m in health["models"]] == ["a"]
+            assert health["models"][0]["breaker"] == "closed"
+
+            status, body = post("/v1/models", {"path": model_dir_b,
+                                               "name": "b"})
+            assert status == 200 and body["name"] == "b"
+            status, raw = get("/v1/models")
+            assert {m["name"] for m in json.loads(raw)["models"]} == \
+                {"a", "b"}
+
+            status, raw = get("/metrics")
+            fams = parse_prometheus(raw.decode())
+            assert "serve_queue_wait_seconds" in fams
+            assert "serve_coalesced_batch_size" in fams
+            assert "serve_latency_seconds" in fams
+            assert "serve_models_loaded" in fams
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("/v1/score", {"model": "a"})  # no records
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("/v1/score", {"model": "nope", "records": []})
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/nope")
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+            daemon.close()
+        assert not daemon.models()  # closed daemon released its cache

@@ -237,6 +237,9 @@ def test_serve_routing_counter_and_latency_histogram():
     fn = model.score_fn(backend="cpu")
     fn.batch([{"x0": 0.1, "x1": -0.2}] * 4)
     assert routing.value == before + 1
-    lat = reg.histogram("serve_latency_seconds", labels={"backend": "cpu"})
+    # latency series are per (backend, model): two served models must not
+    # merge their percentiles into one line
+    lat = reg.histogram("serve_latency_seconds",
+                        labels={"backend": "cpu", "model": model.uid})
     assert lat.count >= 1 and lat.percentile(50) > 0
     M.parse_prometheus(reg.to_prometheus())

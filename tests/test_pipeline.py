@@ -597,3 +597,61 @@ def test_score_fn_stream_matches_batch(tmp_path):
     got = list(fn.stream(iter(batches), prefetch=2))
     assert got == want
     assert list(fn.stream(iter(batches), prefetch=0)) == want
+
+
+# --- ClosableQueue (live pipeline source) -----------------------------------------------
+def test_closable_queue_fifo_close_and_drain():
+    from queue import Empty
+
+    from transmogrifai_tpu.readers.pipeline import ClosableQueue
+    from transmogrifai_tpu.readers.streaming import StreamClosed
+
+    q = ClosableQueue(maxsize=8)
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3 and not q.closed
+    assert q.get() == 0
+    q.put_front(99)  # head insert: the requeue hook
+    assert q.get() == 99
+    assert [q.get(), q.get()] == [1, 2]
+    with pytest.raises(Empty):
+        q.get(timeout=0.01)  # idle but open: timeout, not end-of-stream
+    q.put(1)
+    q.put(2)
+    q.close()
+    assert q.closed
+    with pytest.raises(StreamClosed):
+        q.put(7)  # rejected loudly, never silently dropped
+    assert list(q) == [1, 2]  # close drains what was queued first
+    with pytest.raises(StreamClosed):
+        q.get(timeout=0.01)
+    q.close()  # idempotent
+
+
+def test_closable_queue_backpressure_and_prefetcher_source():
+    from transmogrifai_tpu.readers.pipeline import ClosableQueue, Prefetcher
+
+    q = ClosableQueue(maxsize=2)
+    q.put(0)
+    q.put(1)
+    blocked = threading.Event()
+    done = threading.Event()
+
+    def producer():
+        blocked.set()
+        q.put(2)  # blocks on the bound until a consumer drains
+        for i in range(3, 6):
+            q.put(i)
+        q.close()
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    blocked.wait(5)
+    time.sleep(0.05)
+    assert q.qsize() == 2  # the bound held while the producer was blocked
+    # a ClosableQueue is a Prefetcher source: live items flow through fn
+    with Prefetcher(q, lambda x: x * 10, depth=2) as pf:
+        assert list(pf) == [0, 10, 20, 30, 40, 50]
+    assert done.wait(5)
+    t.join(5)

@@ -158,6 +158,70 @@ print(f"chaos smoke ok: {len(inj.events)} faults injected, "
       f"({res.n_rows} rows scored)")
 PY
 
+echo "== serving daemon smoke (op serve over HTTP) =="
+# train+save a tiny model, start the daemon as a real subprocess (ephemeral
+# port, parsed off the ready line), score over HTTP, check /healthz and the
+# /metrics exposition, then SIGTERM and assert a CLEAN shutdown (exit 0) —
+# the daemon must drain, not die (docs/serving.md lifecycle contract)
+python - <<'PY'
+import json, os, re, signal, subprocess, sys, tempfile, urllib.request
+
+import numpy as np
+
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(0)
+rows = [{"label": float(i % 2), "a": float(i % 2) + rng.normal(0, 0.1),
+         "cat": "ab"[i % 2]} for i in range(64)]
+fs = features_from_schema(
+    {"label": "RealNN", "a": "Real", "cat": "PickList"}, response="label")
+pred = LogisticRegression(l2=0.01)(fs["label"], transmogrify([fs["a"], fs["cat"]]))
+model = (Workflow().set_reader(InMemoryReader(rows))
+         .set_result_features(pred).train())
+mdir = tempfile.mkdtemp(prefix="ci_serve_model_")
+model.save(mdir, overwrite=True)
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "transmogrifai_tpu.cli.main", "serve",
+     "--model", f"smoke={mdir}", "--port", "0", "--max-batch", "8"],
+    stderr=subprocess.PIPE, text=True, env=dict(os.environ))
+url = None
+for line in proc.stderr:
+    sys.stderr.write("[op serve] " + line)
+    m = re.search(r"listening on (http://\S+)", line)
+    if m:
+        url = m.group(1)
+        break
+assert url, "op serve never printed its ready line"
+req = urllib.request.Request(
+    url + "/v1/score",
+    data=json.dumps({"model": "smoke",
+                     "records": [{"a": 0.5, "cat": "a"},
+                                 {"a": -0.25, "cat": "b"}]}).encode(),
+    headers={"Content-Type": "application/json"})
+body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+assert len(body["results"]) == 2 and all(body["results"]), body
+health = json.loads(urllib.request.urlopen(url + "/healthz", timeout=30).read())
+assert health["status"] == "ok" and health["models"][0]["breaker"] == "closed"
+prom = urllib.request.urlopen(url + "/metrics", timeout=30).read().decode()
+from transmogrifai_tpu.obs.metrics import parse_prometheus
+fams = parse_prometheus(prom)
+need = {"serve_queue_wait_seconds", "serve_coalesced_batch_size",
+        "serve_latency_seconds", "serve_models_loaded"}
+missing = need - set(fams)
+assert not missing, f"daemon exposition missing families: {sorted(missing)}"
+proc.send_signal(signal.SIGTERM)
+tail = proc.stderr.read()
+rc = proc.wait(timeout=60)
+assert "clean shutdown" in tail and rc == 0, (rc, tail)
+print(f"serving daemon smoke ok: scored 2 rows over HTTP, "
+      f"{len(fams)} metric families, clean shutdown (rc=0)")
+PY
+
 echo "== bench regression gate =="
 # Every scalar in the bench summary is gated, including the streaming_score
 # input-pipeline lane (streaming_score_rows_per_sec, streaming_pipeline_speedup,

@@ -311,6 +311,130 @@ def _cmd_monitor(argv) -> int:
     return 0
 
 
+def _parse_model_spec(spec: str) -> tuple:
+    """'NAME=DIR' -> (name, dir); bare 'DIR' -> (None, dir). A '=' only
+    splits when the left side looks like a name (no path separator)."""
+    name, sep, path = spec.partition("=")
+    if sep and name and "/" not in name and "\\" not in name:
+        return name, path
+    return None, spec
+
+
+def _cmd_serve(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op serve",
+        description="persistent serving daemon: multi-model LRU cache + "
+                    "adaptive micro-batching over a stdlib HTTP/JSON "
+                    "endpoint (docs/serving.md). Admission pre-warms every "
+                    "pow2 pad_to bucket so steady-state serving compiles "
+                    "nothing; concurrent single-row requests coalesce into "
+                    "one device dispatch per window.")
+    ap.add_argument("--model", action="append", default=[],
+                    metavar="[NAME=]DIR",
+                    help="saved model directory to admit at startup "
+                         "(repeatable; NAME= gives the serving alias, "
+                         "default m_<fingerprint>). Models can also be "
+                         "admitted later via POST /v1/models.")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="0 binds an ephemeral port (printed on the ready "
+                         "line)")
+    ap.add_argument("--max-wait-ms", type=float, default=None,
+                    help="coalescing window max-wait before a partial batch "
+                         "dispatches (default 2.0; OpParams.serve_max_wait_ms)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="row ceiling per coalesced dispatch / largest "
+                         "warmed bucket (default 256)")
+    ap.add_argument("--max-models", type=int, default=None,
+                    help="LRU capacity of the model cache (default 4)")
+    ap.add_argument("--bucket-floor", type=int, default=None,
+                    help="smallest warmed pow2 pad_to bucket (default 1)")
+    ap.add_argument("--backend", default="auto",
+                    choices=["auto", "cpu", "device"],
+                    help="serving lane policy: auto (default) routes by the "
+                         "measured CPU/device crossover; cpu/device pin")
+    ap.add_argument("--mesh", default=None, metavar="DATA,MODEL",
+                    help="shard large device-lane batches over this mesh "
+                         "('auto' or 'n_data,n_model')")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="skip the admission bucket pre-warm (first "
+                         "dispatches then pay compiles)")
+    ap.add_argument("--quarantine-dir", default=None, metavar="DIR",
+                    help="root for per-model poison-row sidecars (default: "
+                         "a fresh temp dir; 'off' disables quarantine — a "
+                         "poison request then fails its whole window)")
+    ap.add_argument("--params", default=None,
+                    help="OpParams JSON (file or literal) supplying "
+                         "serve_max_wait_ms/serve_max_batch/"
+                         "serve_bucket_floor/serve_max_models defaults")
+    args = ap.parse_args(argv)
+
+    from transmogrifai_tpu.params import OpParams
+
+    params = OpParams.from_json(args.params) if args.params else OpParams()
+    max_wait_ms = (args.max_wait_ms if args.max_wait_ms is not None
+                   else params.serve_max_wait_ms)
+    max_batch = (args.max_batch if args.max_batch is not None
+                 else params.serve_max_batch)
+    max_models = (args.max_models if args.max_models is not None
+                  else params.serve_max_models)
+    bucket_floor = (args.bucket_floor if args.bucket_floor is not None
+                    else params.serve_bucket_floor)
+    mesh = None
+    if args.mesh is not None:
+        from transmogrifai_tpu.mesh import default_mesh, parse_mesh_shape
+
+        if args.mesh != "auto":
+            parse_mesh_shape(args.mesh)  # fail fast on a malformed layout
+        mesh = default_mesh(None if args.mesh == "auto" else args.mesh)
+    quarantine_root = ("auto" if args.quarantine_dir is None
+                      else None if args.quarantine_dir == "off"
+                      else args.quarantine_dir)
+
+    from transmogrifai_tpu.serve import ServingDaemon, make_http_server
+
+    daemon = ServingDaemon(
+        max_models=max_models, max_wait_ms=max_wait_ms, max_batch=max_batch,
+        bucket_floor=bucket_floor,
+        backend={"auto": "auto", "cpu": "cpu", "device": None}[args.backend],
+        mesh=mesh, warm=not args.no_warm, quarantine_root=quarantine_root)
+    names = []
+    for spec in args.model:
+        name, path = _parse_model_spec(spec)
+        entry = daemon.admit(path, name=name)
+        names.append(entry.name)
+        warm = entry.warm_report or {}
+        print(f"op serve: admitted {entry.name} from {path} "
+              f"(buckets={warm.get('buckets')}, "
+              f"warm {warm.get('wall_s', 0)}s)", file=sys.stderr, flush=True)
+
+    server = make_http_server(daemon, host=args.host, port=args.port)
+    actual_port = server.server_address[1]
+
+    import signal
+    import threading
+
+    def _stop(signum, frame):
+        # shutdown() blocks until serve_forever exits — must run off-thread
+        print(f"op serve: signal {signum}, shutting down", file=sys.stderr,
+              flush=True)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    # the ready line is the startup contract: CI smoke and wrapper scripts
+    # parse the URL off it (port 0 resolves here)
+    print(f"op serve: listening on http://{args.host}:{actual_port} "
+          f"models={names}", file=sys.stderr, flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        daemon.close()
+    print("op serve: clean shutdown", file=sys.stderr, flush=True)
+    return 0
+
+
 def _cmd_warmup(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="op warmup",
@@ -341,7 +465,40 @@ def _cmd_warmup(argv) -> int:
                          "than a single-device one, so warm with the layout "
                          "the real train will use (default: the same "
                          "auto-mesh resolution Workflow.train applies)")
+    ap.add_argument("--serving", default=None, metavar="MODEL_DIR",
+                    help="warm the SERVING shapes of a saved model instead "
+                         "of the training matrix: every pow2 pad_to bucket "
+                         "(--serving-floor .. --serving-max-batch) on every "
+                         "routable lane — the same helper the `op serve` "
+                         "daemon runs at model admission, so deploy-time "
+                         "warmup primes exactly the executables admission "
+                         "will need")
+    ap.add_argument("--serving-floor", type=int, default=1,
+                    help="smallest warmed pow2 serving bucket (default 1)")
+    ap.add_argument("--serving-max-batch", type=int, default=256,
+                    help="largest warmed pow2 serving bucket (default 256)")
+    ap.add_argument("--serving-backend", default="auto",
+                    choices=["auto", "cpu", "device"],
+                    help="serving lane(s) to warm (default auto = every "
+                         "lane the router can choose)")
     args = ap.parse_args(argv)
+    if args.serving is not None:
+        import json
+        from transmogrifai_tpu.workflow.warmup import warm_serving
+
+        mesh = None
+        if args.mesh is not None:
+            from transmogrifai_tpu.mesh import default_mesh
+
+            mesh = default_mesh(None if args.mesh == "auto" else args.mesh)
+        report = warm_serving(
+            args.serving, floor=args.serving_floor,
+            max_batch=args.serving_max_batch,
+            backend={"auto": "auto", "cpu": "cpu",
+                     "device": None}[args.serving_backend],
+            mesh=mesh, log=lambda m: print(m, file=sys.stderr))
+        print(json.dumps(report))
+        return 0
     from transmogrifai_tpu.workflow.warmup import _PROBLEMS, warmup_matrix
 
     splitter = None
@@ -396,7 +553,11 @@ def main(argv=None) -> int:
             "  monitor   serving telemetry: drift report vs the model's "
             "training baseline + metrics export (--model DIR [--scoring CSV] "
             "| --demo) [--prom|--json]\n"
-            "  warmup    pre-seed the compile cache for planned train shapes\n"
+            "  serve     persistent serving daemon: multi-model cache + "
+            "adaptive micro-batching over HTTP/JSON "
+            "(--model [NAME=]DIR --port 8000)\n"
+            "  warmup    pre-seed the compile cache for planned train shapes "
+            "(--serving MODEL_DIR warms the serving buckets)\n"
             "  version   print framework version"
         )
         return 0
@@ -412,6 +573,8 @@ def main(argv=None) -> int:
         return _cmd_lint(rest)
     if cmd == "monitor":
         return _cmd_monitor(rest)
+    if cmd == "serve":
+        return _cmd_serve(rest)
     if cmd == "warmup":
         return _cmd_warmup(rest)
     print(f"op: unknown command {cmd!r}", file=sys.stderr)

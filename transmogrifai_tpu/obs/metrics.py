@@ -316,6 +316,14 @@ class MetricsRegistry:
                          buckets=buckets, reservoir=reservoir)
 
     # --- introspection / reset --------------------------------------------------------
+    def find(self, name: str, labels: Optional[dict] = None) -> Optional[_Metric]:
+        """Look up an instrument WITHOUT creating it (None when absent).
+        Read-side callers — the serving daemon's health surface reading a
+        model's queue-wait percentiles, tests asserting absence — must not
+        materialize empty series just by asking."""
+        with self._lock:
+            return self._metrics.get((name, _freeze_labels(labels)))
+
     def collect(self) -> list[_Metric]:
         with self._lock:
             return sorted(self._metrics.values(),

@@ -70,6 +70,20 @@ class OpParams:
     #: their offending rows there and the run completes with a partial-
     #: success summary instead of dying. None = poison fails the run.
     quarantine_dir: Optional[str] = None
+    #: --- serving daemon (`op serve`; serve/daemon.py, docs/serving.md) ---
+    #: adaptive micro-batcher max-wait (milliseconds): how long the first
+    #: request of a coalescing window waits for company before a partial
+    #: window dispatches (the idle-queue latency bound)
+    serve_max_wait_ms: float = 2.0
+    #: row ceiling a coalescing window closes at (also the largest warmed
+    #: pow2 pad_to bucket)
+    serve_max_batch: int = 256
+    #: smallest pow2 pad_to bucket warmed + padded to — raise it so trickle
+    #: traffic shares one program shape (same policy as stream_bucket_floor)
+    serve_bucket_floor: int = 1
+    #: LRU capacity of the daemon's multi-model cache: models past this are
+    #: evicted least-recently-used (their batchers drained first)
+    serve_max_models: int = 4
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

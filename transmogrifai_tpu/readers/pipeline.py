@@ -40,8 +40,110 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from .. import obs
+from .streaming import StreamClosed
 
 _SENTINEL = object()
+
+
+class ClosableQueue:
+    """Bounded, closable FIFO usable as a LIVE pipeline source.
+
+    The Prefetcher consumes plain iterables; a long-lived serving process
+    needs the dual — a source that concurrent producers feed WHILE the
+    pipeline runs (the serving daemon's request queue is one). Semantics:
+
+    * `put(item)` blocks on a full queue (backpressure, the same contract as
+      the prepare queue) and raises `StreamClosed` after `close()` — a
+      request can be rejected but never silently dropped
+      (readers/streaming.py's QueueStreamingReader close contract).
+    * `get(timeout)` returns the next item, raises `queue.Empty` on timeout,
+      and raises `StreamClosed` once the queue is closed AND drained — so
+      consumers finish in-flight work before observing end-of-stream.
+    * Iterating yields items until closed-and-drained (a Prefetcher source).
+    * `put_front(item)` re-queues at the HEAD, exempt from the bound and the
+      closed check: the requeue hook for a consumer that tears down mid-take
+      and must hand already-admitted work to its replacement.
+    """
+
+    def __init__(self, maxsize: int = 0):
+        from collections import deque
+
+        self._maxsize = int(maxsize)
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def put(self, item: Any, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._not_full:
+            if self._closed:
+                raise StreamClosed("put() after close(): item rejected, "
+                                   "not silently dropped")
+            while self._maxsize and len(self._items) >= self._maxsize:
+                if deadline is None:
+                    self._not_full.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise queue.Full
+                    self._not_full.wait(remaining)
+                if self._closed:
+                    raise StreamClosed("queue closed while put() blocked")
+            self._items.append(item)
+            self._not_empty.notify()
+
+    def put_front(self, item: Any) -> None:
+        with self._not_empty:
+            self._items.appendleft(item)
+            self._not_empty.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._not_empty:
+            while not self._items:
+                if self._closed:
+                    raise StreamClosed("queue closed and drained")
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise queue.Empty
+                    self._not_empty.wait(remaining)
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def get_nowait(self) -> Any:
+        return self.get(timeout=0.0)
+
+    def close(self) -> None:
+        """Idempotent: new puts are rejected; queued items stay consumable."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self.get()
+            except StreamClosed:
+                return
 
 
 @dataclass
@@ -220,7 +322,17 @@ class Prefetcher:
             yield payload
 
     def close(self) -> None:
-        """Stop the producer and drain the queue (idempotent)."""
+        """Stop the producer and drain the queue (idempotent).
+
+        LIVE sources (a serving request queue feeding the pipeline, not a
+        finite iterable) can block indefinitely waiting for work the
+        producer thread will never deliver anywhere — so if the source
+        object defines `on_pipeline_close()`, it is invoked FIRST: the
+        source's contract is to unblock its feeding waits promptly so the
+        join below never has to time out against an idle-blocked producer."""
+        hook = getattr(self._source, "on_pipeline_close", None)
+        if hook is not None:
+            hook()
         self._stop.set()
         while True:
             try:

@@ -82,6 +82,19 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    def snapshot(self) -> dict:
+        """One consistent view of the breaker for health surfaces (the
+        serving daemon's /healthz reports one per cached model)."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "probing": self._probing,
+            }
+
     def _transition(self, to: str) -> None:
         # lock held by the caller
         if self._state == to:

@@ -1,3 +1,15 @@
+from .batcher import MicroBatcher
+from .daemon import (
+    DaemonClient,
+    ServingDaemon,
+    fingerprint_model_dir,
+    make_http_server,
+    serving_buckets,
+)
 from .scoring import ScoreFunction, score_function
 
-__all__ = ["ScoreFunction", "score_function"]
+__all__ = [
+    "DaemonClient", "MicroBatcher", "ScoreFunction", "ServingDaemon",
+    "fingerprint_model_dir", "make_http_server", "score_function",
+    "serving_buckets",
+]

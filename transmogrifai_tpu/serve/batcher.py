@@ -1,0 +1,340 @@
+"""Adaptive micro-batcher: coalesce concurrent serving requests into device batches.
+
+BENCH_r05's sore spot is the shape of per-call serving, not the kernels:
+single-row device scoring pays the full dispatch round trip (~101 ms
+tunneled) per request, so N concurrent single-row callers pay it N times —
+serialized. The fix is the tf.data-service discipline (PAPERS.md arXiv
+2210.14826) applied to the scoring side: decouple request arrival from device
+dispatch with a queue, and coalesce whatever is waiting into ONE pow2-padded
+batch per dispatch. N concurrent single-row requests then cost ~one dispatch,
+and the responses demultiplex back to their callers bit-identically to
+per-row scoring.
+
+Mechanics — everything downstream of the queue is the EXISTING serving stack,
+not a parallel one:
+
+* requests land in a `ClosableQueue` (readers/pipeline.py) as
+  (records, Future) pairs;
+* a coalescing generator drains it into windows: the first request opens a
+  window, further requests join until the **max-wait deadline** fires or the
+  window reaches `max_batch` rows. The window is ADAPTIVE: an EMA of recent
+  window sizes tracks client concurrency, and once the current window has
+  caught up to it with an idle queue, it dispatches EARLY — steady closed-loop
+  traffic pays arrival spread, not the full deadline, and a lone steady
+  client (EMA ~1) pays ~zero added latency. The deadline stays the hard
+  bound for ramp-up and thinning traffic;
+* coalesced windows flow through `ScoreFunction.stream()` — the shared input
+  executor's `Prefetcher(place=)` path — so the host-side table build (and
+  under a mesh the per-shard device placement) of window k+1 overlaps the
+  fused dispatch of window k, and `pad_to` pow2 bucketing bounds the compiled
+  program count;
+* routing stays the ScoreFunction's: a lone window below the measured
+  crossover (`auto_threshold()`) degrades to the in-process CPU plan instead
+  of stalling on a device round trip; big coalesced windows take the device.
+
+Every decision lands on the metrics registry: `serve_queue_wait_seconds{model}`
+(enqueue -> dispatch-start per request), `serve_coalesced_batch_size{model}`
+(rows per dispatch, pow2 buckets), plus a `serve:coalesce` span event — the
+`serve_latency_seconds{backend,model}` SLO histograms come from the
+ScoreFunction underneath.
+
+Failure containment: arm the handle with a `FaultPolicy(quarantine_dir=...)`
+(the daemon does by default) and poison rows are row-bisect quarantined by
+the PR-6 machinery — the affected positions come back as None, the stream
+never dies. Without quarantine, an unexpected stream error fails every
+in-flight Future and the worker restarts a fresh stream; requests a
+torn-down stream's producer had already taken are handed back to the
+replacement via `put_front`, so nothing is silently dropped.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from queue import Empty
+from typing import Optional, Sequence
+
+from .. import obs
+from ..readers.pipeline import ClosableQueue
+from ..readers.streaming import StreamClosed
+
+#: pow2 exposition buckets for the coalesced-batch-size histogram (1..4096)
+_SIZE_BUCKETS = tuple(float(1 << i) for i in range(13))
+
+#: short poll quantum for the coalescing waits: bounds both deadline
+#: overshoot and how long a torn-down stream's producer can linger
+_POLL_S = 0.05
+
+
+class _Pending:
+    """One queued request: its records, the caller's Future, and the enqueue
+    timestamp feeding `serve_queue_wait_seconds`."""
+
+    __slots__ = ("records", "future", "t_enqueue")
+
+    def __init__(self, records, future, t_enqueue):
+        self.records = records
+        self.future = future
+        self.t_enqueue = t_enqueue
+
+
+class _CoalescedSource:
+    """The stream() source object: iterating it runs the batcher's
+    coalescing generator; `on_pipeline_close` (the Prefetcher teardown hook)
+    flags the generation torn so an idle-blocked producer exits within one
+    poll quantum instead of timing out the close join — and without taking
+    any request the REPLACEMENT stream should serve."""
+
+    def __init__(self, batcher: "MicroBatcher", gen: int):
+        self._batcher = batcher
+        self._gen = gen
+
+    def __iter__(self):
+        return self._batcher._coalesced(self._gen)
+
+    def on_pipeline_close(self) -> None:
+        self._batcher._torn.set()
+
+
+class MicroBatcher:
+    """Request-coalescing front end over one ScoreFunction.
+
+    `submit(records)` returns a Future resolving to the same list
+    `score_fn.batch(records)` would return (None entries mark quarantined
+    rows when the handle's policy arms quarantine). `score()` is the
+    blocking convenience. `close()` stops intake, drains every queued
+    request through the pipeline, and joins the worker — shutdown
+    mid-flight loses nothing.
+    """
+
+    def __init__(self, score_fn, *, max_batch: int = 256,
+                 max_wait_ms: float = 2.0, prefetch: int = 2,
+                 queue_depth: int = 4096,
+                 model_label: Optional[str] = None, registry=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._fn = score_fn
+        self._max_batch = int(max_batch)
+        self._max_wait_s = float(max_wait_ms) / 1e3
+        self._prefetch = int(prefetch)
+        self.model_label = str(
+            model_label or getattr(score_fn, "_model_label", "model"))
+        self._requests = ClosableQueue(maxsize=queue_depth)
+        #: FIFO of (generation, demux group), appended by the coalescer
+        #: BEFORE it yields a window and popped by the worker as results
+        #: arrive — stream() is strictly ordered, so the head always matches
+        #: the next result; the generation tag lets the worker discard any
+        #: entry a torn-down producer managed to append post-restart instead
+        #: of demuxing another window's results to its callers
+        self._inflight: deque = deque()
+        #: stream generation: bumped on restart so a torn-down stream's
+        #: producer (briefly still polling) steps aside instead of stealing
+        self._gen = 0
+        #: set by Prefetcher.close() via _CoalescedSource.on_pipeline_close:
+        #: the signal an idle-blocked producer CAN see before the worker
+        #: learns of the teardown (the gen bump necessarily comes later)
+        self._torn = threading.Event()
+        #: EMA of window request counts — the concurrency estimate behind
+        #: early dispatch (None until the first window completes, so ramp-up
+        #: always grants the full deadline)
+        self._ema_group: Optional[float] = None
+        #: totals (read by daemon stats / tests; GIL-atomic int bumps)
+        self.dispatches = 0
+        self.coalesced_requests = 0
+        self.coalesced_rows = 0
+        reg = registry if registry is not None else obs.default_registry()
+        self._wait_hist = reg.histogram(
+            "serve_queue_wait_seconds",
+            help="request time from enqueue to coalesced dispatch start",
+            labels={"model": self.model_label})
+        self._size_hist = reg.histogram(
+            "serve_coalesced_batch_size",
+            help="rows per coalesced serving dispatch",
+            labels={"model": self.model_label}, buckets=_SIZE_BUCKETS)
+        self._worker = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"serve-batcher-{self.model_label}")
+        self._worker.start()
+
+    # --- client surface ---------------------------------------------------------------
+    def submit(self, records: Sequence) -> Future:
+        """Enqueue one request (a list of record dicts); raises StreamClosed
+        after close() and ValueError past `max_batch` rows (an oversized
+        request would dispatch at an unwarmed, unpadded shape — callers
+        split bulk work, or use `score_fn.batch`/`.stream` directly, which
+        is the right tool for it). The Future resolves to the per-record
+        result list."""
+        records = list(records)
+        if len(records) > self._max_batch:
+            raise ValueError(
+                f"request of {len(records)} rows exceeds max_batch="
+                f"{self._max_batch}; split it or use score_fn.batch()")
+        f: Future = Future()
+        if not records:
+            f.set_result([])
+            return f
+        self._requests.put(_Pending(records, f, time.perf_counter()))
+        return f
+
+    def score(self, records: Sequence, timeout: Optional[float] = None):
+        return self.submit(records).result(timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop intake, drain queued requests, join the worker (idempotent)."""
+        self._requests.close()
+        self._worker.join(timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._requests.closed
+
+    def stats(self) -> dict:
+        d = self.dispatches
+        return {
+            "dispatches": d,
+            "coalesced_requests": self.coalesced_requests,
+            "coalesced_rows": self.coalesced_rows,
+            "mean_rows_per_dispatch": round(self.coalesced_rows / d, 3) if d
+            else None,
+            "pending": self._requests.qsize(),
+        }
+
+    # --- coalescer (runs on the Prefetcher's producer thread) -------------------------
+    def _early_dispatch(self, group) -> bool:
+        """True once the window has caught up to the measured concurrency
+        (>= 80% of the window-size EMA) with nothing left queued: every
+        client of a steady closed loop has checked in, so waiting out the
+        deadline would only add latency."""
+        ema = self._ema_group
+        return (ema is not None and len(group) >= 0.8 * ema
+                and self._requests.empty())
+
+    def _stale(self, gen: int) -> bool:
+        """This generation's stream is (being) torn down: either the worker
+        already bumped the generation, or Prefetcher.close() flagged the
+        teardown via the source hook (which happens BEFORE the worker can
+        bump — an idle producer must see it to exit within a poll quantum
+        instead of timing out the close join)."""
+        return self._gen != gen or self._torn.is_set()
+
+    def _coalesced(self, gen: int):
+        """Generator of coalesced record lists — the stream() source. Every
+        blocking wait is a short poll so a stale generation exits promptly."""
+        while True:
+            try:
+                first = self._requests.get(timeout=_POLL_S)
+            except Empty:
+                if self._stale(gen):
+                    return
+                continue
+            except StreamClosed:
+                return
+            group = [first]
+            rows = len(first.records)
+            deadline = time.perf_counter() + self._max_wait_s
+            while rows < self._max_batch and not self._early_dispatch(group):
+                remaining = deadline - time.perf_counter()
+                try:
+                    if remaining > 0:
+                        nxt = self._requests.get(
+                            timeout=min(remaining, _POLL_S))
+                    else:
+                        nxt = self._requests.get_nowait()
+                except StreamClosed:
+                    break  # drain: dispatch what the window holds
+                except Empty:
+                    if remaining <= 0 or self._stale(gen):
+                        break
+                    continue
+                if rows + len(nxt.records) > self._max_batch:
+                    # would overshoot the ceiling (= the largest warmed
+                    # bucket): hand it back head-of-queue for the next
+                    # window rather than dispatch an unwarmed shape
+                    self._requests.put_front(nxt)
+                    break
+                group.append(nxt)
+                rows += len(nxt.records)
+            if self._stale(gen):
+                # torn down mid-window: hand admitted work to the live
+                # producer, head-of-queue, in arrival order
+                for p in reversed(group):
+                    self._requests.put_front(p)
+                return
+            ema = self._ema_group
+            self._ema_group = (float(len(group)) if ema is None
+                               else 0.5 * ema + 0.5 * len(group))
+            now = time.perf_counter()
+            for p in group:
+                self._wait_hist.observe(now - p.t_enqueue)
+            self._size_hist.observe(rows)
+            self.dispatches += 1
+            self.coalesced_requests += len(group)
+            self.coalesced_rows += rows
+            obs.add_event("serve:coalesce", requests=len(group),
+                          rows=int(rows),
+                          waited_ms=round((now - group[0].t_enqueue) * 1e3, 3))
+            self._inflight.append((gen, group))
+            yield [r for p in group for r in p.records]
+
+    # --- worker -----------------------------------------------------------------------
+    def _demux(self, group, rows, error) -> None:
+        if error is not None:
+            for p in group:
+                p.future.set_exception(error)
+            return
+        i = 0
+        for p in group:
+            n = len(p.records)
+            p.future.set_result(rows[i:i + n])
+            i += n
+
+    def _pop_inflight(self, gen: int, error):
+        """Head inflight group of the CURRENT generation. Entries a
+        torn-down producer appended after the restart drain carry the old
+        generation tag — they are failed here, never aligned against the new
+        stream's results (the demux-misalignment guard)."""
+        while self._inflight and self._inflight[0][0] != gen:
+            _, stale_group = self._inflight.popleft()
+            self._demux(stale_group, None,
+                        error or RuntimeError("serving stream restarted"))
+        _, group = self._inflight.popleft()
+        return group
+
+    def _run(self) -> None:
+        last_error = None
+        while True:
+            gen = self._gen
+            self._torn.clear()
+            try:
+                # the SOURCE OBJECT (not a bare generator) rides into the
+                # Prefetcher so close() can reach on_pipeline_close
+                for rows in self._fn.stream(_CoalescedSource(self, gen),
+                                            prefetch=self._prefetch):
+                    self._demux(self._pop_inflight(gen, last_error), rows,
+                                None)
+            except BaseException as e:  # noqa: BLE001 — contained per policy
+                # unexpected stream death (quarantine-armed handles absorb
+                # data poison before it gets here): fail every in-flight
+                # request explicitly — a hung Future is worse than an error —
+                # and restart a fresh stream for the survivors in the queue.
+                # The torn stream's producer saw the teardown via the
+                # on_pipeline_close hook, so it exited without stealing
+                # queued requests; anything it had mid-window came back via
+                # put_front.
+                self._gen += 1
+                last_error = e
+                obs.add_event("serve:batcher_restart",
+                              error=f"{type(e).__name__}: {e}"[:200])
+                obs.default_registry().counter(
+                    "serve_batcher_restarts_total",
+                    help="micro-batcher stream restarts after an unexpected "
+                         "scoring error",
+                    labels={"model": self.model_label}).inc()
+                while self._inflight:
+                    _, group = self._inflight.popleft()
+                    self._demux(group, None, e)
+                if self._requests.closed and self._requests.empty():
+                    return
+                continue
+            return  # clean drain: queue closed and empty

@@ -1,0 +1,439 @@
+"""Persistent serving daemon: a multi-model cache + adaptive micro-batching.
+
+The reference's L5 serving story (PAPER.md: Spark-free `scoreFunction` on a
+plain JVM) taken to real-traffic scale: BENCH_r05 shows every fresh scoring
+process paying ~16.5 s of warmup and every per-call device dispatch ~101 ms —
+costs a long-lived process amortizes once. `op serve` is that process:
+
+* **multi-model cache** — an LRU of loaded `WorkflowModel`s plus their warmed
+  `ScoreFunction` handles and `MicroBatcher`s, keyed by the model DIRECTORY'S
+  CONTENT FINGERPRINT (sha256 of model.json + array sidecars), so re-admitting
+  an unchanged dir is a cache hit and a resaved model is a different entry.
+  Eviction closes the entry's batcher (drains in-flight work) and quarantine
+  sidecar. Each entry carries its own per-model circuit breaker
+  (`serve_device:<label>` series — the PR-6 failover machinery) and its own
+  `serve_latency_seconds{backend,model}` SLO histograms.
+* **admission pre-warm** — `ScoreFunction.warm()` compiles every pow2 pad_to
+  bucket on every routable lane at admit time (throwaway synthetic buffers),
+  so the first coalesced dispatch compiles nothing and `auto_threshold()`
+  starts from measured warm latencies, not the cold constant.
+* **adaptive micro-batching** — serve/batcher.py coalesces concurrent
+  requests into pow2-bucketed device batches through the shared input
+  executor (`Prefetcher(place=)`), with a max-wait deadline so a lone
+  request degrades to the in-process CPU plan.
+
+Surfaces: `DaemonClient` (in-process, the test/bench interface) and a
+stdlib-only HTTP/JSON endpoint (`make_http_server` / `op serve`):
+
+    POST /v1/score   {"model": NAME?, "records": [{...}, ...]}
+                     -> {"model": NAME, "results": [{...}|null, ...]}
+                        (null = row quarantined as poison)
+    POST /v1/models  {"path": DIR, "name": NAME?}      admit/refresh a model
+    GET  /v1/models                                    cache contents
+    GET  /healthz                                      daemon + breaker state
+    GET  /metrics                                      Prometheus exposition
+
+See docs/serving.md for the lifecycle and SLO metric families.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from .. import obs
+from .batcher import MicroBatcher
+from .scoring import score_function
+
+
+def fingerprint_model_dir(path: str) -> str:
+    """Content fingerprint of a saved model bundle: sha256 over the manifest
+    bytes plus the name and BYTES of every arrays sidecar (names alone are
+    not enough: an external sync can drop different same-size arrays into an
+    existing dir without touching model.json). The cache identity — a resave
+    with different fitted params is a different model, the same dir
+    re-admitted is a hit. Admission already pays seconds of warm compile, so
+    hashing the sidecars is noise."""
+    h = hashlib.sha256()
+    with open(os.path.join(path, "model.json"), "rb") as fh:
+        h.update(fh.read())
+    for fname in sorted(os.listdir(path)):
+        if fname.endswith(".npz"):
+            h.update(fname.encode("utf-8"))
+            with open(os.path.join(path, fname), "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+def serving_buckets(floor: int = 1, max_batch: int = 256) -> list[int]:
+    """The pow2 pad_to ladder serving coalesces into: floor, 2*floor, ...,
+    max_batch (both ends rounded up to powers of two — `pow2_bucket` is the
+    same policy the streaming runner uses, so warmed serving shapes and
+    streamed-scoring shapes coincide)."""
+    from ..types.table import pow2_bucket
+
+    lo = pow2_bucket(max(1, int(floor)))
+    hi = pow2_bucket(max(lo, int(max_batch)))
+    out = []
+    b = lo
+    while b <= hi:
+        out.append(b)
+        b <<= 1
+    return out
+
+
+class ModelEntry:
+    """One admitted model: loaded weights + warmed handle + its batcher."""
+
+    __slots__ = ("name", "fingerprint", "path", "model", "score_fn",
+                 "batcher", "admitted_at", "warm_report", "last_used")
+
+    def __init__(self, name, fingerprint, path, model, score_fn, batcher,
+                 warm_report):
+        self.name = name
+        self.fingerprint = fingerprint
+        self.path = path
+        self.model = model
+        self.score_fn = score_fn
+        self.batcher = batcher
+        self.warm_report = warm_report
+        self.admitted_at = time.monotonic()
+        self.last_used = self.admitted_at
+
+    def info(self) -> dict:
+        # read-without-create lookup: an idle model must not materialize
+        # empty series just by being health-checked
+        wait_h = obs.default_registry().find(
+            "serve_queue_wait_seconds", labels={"model": self.name})
+        wait_p50 = wait_h.percentile(50) if wait_h is not None else None
+        return {
+            "name": self.name,
+            "fingerprint": self.fingerprint,
+            "path": self.path,
+            "breaker": self.score_fn.breaker_state(),
+            "auto_threshold": self.score_fn.auto_threshold(),
+            "queue_wait_p50_ms": (round(wait_p50 * 1e3, 3)
+                                  if wait_p50 is not None else None),
+            "admitted_s": round(time.monotonic() - self.admitted_at, 3),
+            "warm": self.warm_report,
+            "batcher": self.batcher.stats(),
+        }
+
+
+class ServingDaemon:
+    """The long-lived scoring process behind `op serve`.
+
+    Thread-safe: the HTTP server's handler threads, the per-model batcher
+    workers, and in-process `DaemonClient` callers all go through here. The
+    cache lock covers only dict operations; model load + bucket warm (seconds
+    of compile) run under a separate admission lock so admitting model B
+    never blocks traffic already flowing to model A.
+    """
+
+    def __init__(self, *, max_models: int = 4, max_wait_ms: float = 2.0,
+                 max_batch: int = 256, bucket_floor: int = 1,
+                 backend: Optional[str] = "auto", mesh=None, policy=None,
+                 warm: bool = True, prefetch: int = 2,
+                 quarantine_root: Optional[str] = "auto"):
+        if max_models < 1:
+            raise ValueError(f"max_models must be >= 1, got {max_models}")
+        self._max_models = int(max_models)
+        self._max_wait_ms = float(max_wait_ms)
+        self._max_batch = int(max_batch)
+        self._buckets = serving_buckets(bucket_floor, max_batch)
+        self._backend = backend
+        self._mesh = mesh
+        self._policy = policy
+        self._warm = bool(warm)
+        self._prefetch = int(prefetch)
+        #: "auto" = a fresh temp dir per daemon: poison rows are quarantined
+        #: (request keeps flowing, bad rows come back None) instead of
+        #: killing the shared stream. None disables; a path pins it.
+        self._quarantine_root = quarantine_root
+        self._lock = threading.Lock()
+        self._admit_lock = threading.Lock()
+        self._cache: "OrderedDict[str, ModelEntry]" = OrderedDict()
+        self._names: dict[str, str] = {}  # alias (name or abspath) -> fp
+        self._started = time.monotonic()
+        self._closed = False
+        reg = obs.default_registry()
+        self._g_loaded = reg.gauge(
+            "serve_models_loaded", help="models resident in the daemon cache")
+        self._c_evicted = reg.counter(
+            "serve_model_evictions_total",
+            help="models evicted from the daemon LRU cache")
+        self._c_admitted = reg.counter(
+            "serve_model_admissions_total",
+            help="model admissions (cache misses) into the daemon")
+
+    # --- admission --------------------------------------------------------------------
+    def admit(self, model_dir: str, name: Optional[str] = None) -> ModelEntry:
+        """Load, warm, and cache a saved model (idempotent per content
+        fingerprint). Returns the live entry; evicts LRU entries past
+        `max_models` — eviction drains the victim's batcher first."""
+        if self._closed:
+            raise RuntimeError("daemon is closed")
+        path = os.path.abspath(model_dir)
+        fp = fingerprint_model_dir(path)
+        with self._lock:
+            entry = self._cache.get(fp)
+            if entry is not None:
+                self._cache.move_to_end(fp)
+                entry.last_used = time.monotonic()
+                if name:
+                    self._names[name] = fp
+                return entry
+        with self._admit_lock:
+            with self._lock:  # lost the admit race? the winner's entry serves
+                entry = self._cache.get(fp)
+                if entry is not None:
+                    self._cache.move_to_end(fp)
+                    if name:
+                        self._names[name] = fp
+                    return entry
+            from ..workflow.workflow import WorkflowModel
+
+            label = name or f"m_{fp[:12]}"
+            with obs.span(f"serve:admit:{label}"):
+                model = WorkflowModel.load(path)
+                policy = self._policy
+                if policy is None and self._quarantine_root is not None:
+                    from ..resilience import FaultPolicy
+
+                    root = self._quarantine_root
+                    if root == "auto":
+                        import tempfile
+
+                        root = tempfile.mkdtemp(prefix="op_serve_q_")
+                        self._quarantine_root = root
+                    policy = FaultPolicy(
+                        quarantine_dir=os.path.join(root, label))
+                fn = score_function(
+                    model, pad_to=self._buckets, backend=self._backend,
+                    mesh=self._mesh, policy=policy, model_label=label)
+                warm_report = fn.warm(self._buckets) if self._warm else None
+                batcher = MicroBatcher(
+                    fn, max_batch=self._max_batch,
+                    max_wait_ms=self._max_wait_ms, prefetch=self._prefetch,
+                    model_label=label)
+            entry = ModelEntry(label, fp, path, model, fn, batcher,
+                               warm_report)
+            evicted: list[ModelEntry] = []
+            with self._lock:
+                closed = self._closed
+                if not closed:
+                    self._cache[fp] = entry
+                    self._names[label] = fp
+                    self._names[path] = fp
+                    while len(self._cache) > self._max_models:
+                        _, old = self._cache.popitem(last=False)
+                        self._names = {k: v for k, v in self._names.items()
+                                       if v != old.fingerprint}
+                        evicted.append(old)
+                    self._g_loaded.set(len(self._cache))
+            if closed:
+                # close() ran while this admission was mid-warm: the cache
+                # is already drained, so inserting now would leak a live
+                # batcher worker (and its quarantine sidecar) past
+                # close()/__exit__ — drain the fresh entry and refuse
+                entry.batcher.close()
+                entry.score_fn.close()
+                raise RuntimeError("daemon closed during admission")
+            self._c_admitted.inc()
+            for old in evicted:
+                self._retire(old)
+            return entry
+
+    def _retire(self, entry: ModelEntry) -> None:
+        self._c_evicted.inc()
+        obs.add_event("serve:evict", model=entry.name,
+                      fingerprint=entry.fingerprint[:12])
+        entry.batcher.close()
+        entry.score_fn.close()
+
+    # --- scoring ----------------------------------------------------------------------
+    def _resolve(self, model: Optional[str]) -> ModelEntry:
+        with self._lock:
+            if model is None:
+                if len(self._cache) == 1:
+                    entry = next(iter(self._cache.values()))
+                    entry.last_used = time.monotonic()
+                    return entry
+                raise KeyError(
+                    "model name required (daemon holds "
+                    f"{len(self._cache)} models)")
+            fp = self._names.get(model) or self._names.get(
+                os.path.abspath(model)) or model
+            entry = self._cache.get(fp)
+            if entry is None:
+                raise KeyError(f"model {model!r} not admitted")
+            self._cache.move_to_end(fp)  # LRU touch
+            entry.last_used = time.monotonic()
+            return entry
+
+    def submit(self, model: Optional[str], records):
+        """Enqueue a request on the named model's batcher -> Future."""
+        return self._resolve(model).batcher.submit(records)
+
+    def score(self, model: Optional[str], records,
+              timeout: Optional[float] = 60.0):
+        return self.submit(model, records).result(timeout)
+
+    # --- introspection / lifecycle ----------------------------------------------------
+    def models(self) -> list[dict]:
+        with self._lock:
+            entries = list(self._cache.values())
+        return [e.info() for e in entries]
+
+    def stats(self) -> dict:
+        models = self.models()
+        return {
+            "status": "ok",
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "max_models": self._max_models,
+            "max_batch": self._max_batch,
+            "max_wait_ms": self._max_wait_ms,
+            "buckets": self._buckets,
+            "models": models,
+        }
+
+    def close(self) -> None:
+        """Drain every batcher and release every handle (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            entries = list(self._cache.values())
+            self._cache.clear()
+            self._names.clear()
+            self._g_loaded.set(0)
+        for e in entries:
+            e.batcher.close()
+            e.score_fn.close()
+
+    def __enter__(self) -> "ServingDaemon":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class DaemonClient:
+    """In-process client with the HTTP surface's semantics — tests and the
+    bench drive the daemon through this without sockets."""
+
+    def __init__(self, daemon: ServingDaemon):
+        self._daemon = daemon
+
+    def admit(self, path: str, name: Optional[str] = None) -> dict:
+        return self._daemon.admit(path, name=name).info()
+
+    def score(self, records, model: Optional[str] = None,
+              timeout: Optional[float] = 60.0) -> list:
+        return self._daemon.score(model, records, timeout=timeout)
+
+    def submit(self, records, model: Optional[str] = None):
+        return self._daemon.submit(model, records)
+
+    def models(self) -> list[dict]:
+        return self._daemon.models()
+
+    def healthz(self) -> dict:
+        return self._daemon.stats()
+
+    def metrics(self) -> str:
+        return obs.default_registry().to_prometheus()
+
+
+# --- HTTP surface (stdlib only) -------------------------------------------------------
+def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
+                     port: int = 8000):
+    """Build (not start) a ThreadingHTTPServer over the daemon. Callers run
+    `server.serve_forever()` (blocking) or on a thread; `server.shutdown()`
+    from another thread stops it. Port 0 binds an ephemeral port —
+    `server.server_address[1]` is the real one."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Server(ThreadingHTTPServer):
+        #: stdlib default listen backlog is 5 — a burst of concurrent
+        #: clients (the daemon's whole reason to exist) overflows it and
+        #: gets connection resets; match the batcher's appetite instead
+        request_queue_size = 128
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "op-serve"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # traffic rides the metrics, not stderr
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, code: int, payload) -> None:
+            self._send(code, json.dumps(payload, default=str).encode("utf-8"))
+
+        def _error(self, code: int, message: str) -> None:
+            self._json(code, {"error": message})
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            try:
+                if self.path == "/healthz":
+                    self._json(200, daemon.stats())
+                elif self.path == "/metrics":
+                    self._send(200,
+                               obs.default_registry().to_prometheus()
+                               .encode("utf-8"),
+                               ctype="text/plain; version=0.0.4")
+                elif self.path == "/v1/models":
+                    self._json(200, {"models": daemon.models()})
+                else:
+                    self._error(404, f"no route {self.path}")
+            except Exception as e:  # noqa: BLE001 — a handler must answer
+                self._error(500, f"{type(e).__name__}: {e}"[:500])
+
+        def do_POST(self):  # noqa: N802
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    body = json.loads(raw.decode("utf-8") or "{}")
+                except ValueError:
+                    return self._error(400, "body is not valid JSON")
+                if not isinstance(body, dict):
+                    return self._error(400, "body must be a JSON object")
+                if self.path == "/v1/models":
+                    if "path" not in body:
+                        return self._error(400, 'missing "path"')
+                    info = daemon.admit(body["path"],
+                                        name=body.get("name")).info()
+                    return self._json(200, info)
+                if self.path in ("/v1/score", "/score"):
+                    records = body.get("records")
+                    if records is None and "record" in body:
+                        records = [body["record"]]
+                    if not isinstance(records, list):
+                        return self._error(400, 'missing "records" list')
+                    entry = daemon._resolve(body.get("model"))
+                    results = entry.batcher.score(records, timeout=60.0)
+                    return self._json(200, {"model": entry.name,
+                                            "results": results})
+                return self._error(404, f"no route {self.path}")
+            except KeyError as e:
+                self._error(404, str(e))
+            except (ValueError, TypeError) as e:
+                self._error(400, f"{type(e).__name__}: {e}"[:500])
+            except Exception as e:  # noqa: BLE001 — a handler must answer
+                self._error(500, f"{type(e).__name__}: {e}"[:500])
+
+    return Server((host, port), Handler)

@@ -20,6 +20,8 @@ Three serving shapes:
 """
 from __future__ import annotations
 
+import threading
+from collections import deque
 from typing import TYPE_CHECKING, Any, Mapping, Optional, Sequence
 
 from ..types import Column, Table
@@ -31,8 +33,18 @@ if TYPE_CHECKING:  # pragma: no cover
 #: batches strictly below this row count route to the CPU columnar plan under
 #: backend="auto": BENCH_r05 measured 101.55 ms single-row on the (tunneled)
 #: device vs 0.307 ms on host CPU-JAX — a device round trip only pays for
-#: itself when the batch amortizes it
+#: itself when the batch amortizes it. This constant is only the COLD
+#: fallback: once both lanes carry `CROSSOVER_MIN_OBS` measured latencies the
+#: router derives the crossover from them (`ScoreFunction.auto_threshold`).
 AUTO_CPU_THRESHOLD = 256
+
+#: observations per lane before the measured crossover replaces the constant
+CROSSOVER_MIN_OBS = 8
+
+#: handle-local (latency, rows) window per lane feeding the crossover — kept
+#: on the handle, NOT read back from the registry, so one model's routing
+#: never keys off another model's (or another test's) numbers
+_LANE_WINDOW = 128
 
 
 class ScoreFunction:
@@ -55,7 +67,8 @@ class ScoreFunction:
                  pad_to: Optional[Sequence[int]] = None,
                  backend: Optional[str] = "auto",
                  auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
-                 mesh=None, monitor=None, policy=None):
+                 mesh=None, monitor=None, policy=None,
+                 model_label: Optional[str] = None):
         self._model = model
         self._result_names = list(result_names) if result_names else [
             f.name for f in model.result_features
@@ -77,12 +90,27 @@ class ScoreFunction:
 
             monitor = ServingMonitor.for_model(model)
         self.monitor = monitor or None
+        #: metric label for this handle's model: daemon admissions pass the
+        #: served model name; the default is the model uid (one bounded
+        #: series per served model)
+        self._model_label = str(model_label or getattr(model, "uid", "model"))
         self._plans: dict = {}  # backend key -> LocalPlan
+        #: guards every lazily-built structure on the handle (plans, cached
+        #: instruments, lane latency windows, the quarantine writer):
+        #: concurrent callers — the serving daemon's batcher worker plus any
+        #: direct batch()/table() traffic — must not race the get-or-create
+        #: paths into duplicate LocalPlans (= duplicate jit programs)
+        self._lock = threading.RLock()
         #: registry instruments cached per backend lane: get-or-create
         #: freezes/sorts labels under the registry lock — measurable at
         #: per-record serving frequency (same policy as ServingMonitor._gauge)
         self._route_counters: dict = {}
         self._lat_hists: dict = {}
+        #: handle-local crossover inputs: {lane: deque[(latency_s, rows)]},
+        #: monotone observation counts, and the cached derived threshold
+        self._lane_lat: dict = {}
+        self._lane_obs: dict = {}
+        self._thr_cache: tuple = (None, 0)
         #: resilience.FaultPolicy: deadline_s arms per-dispatch deadlines on
         #: the device lane, breaker_threshold/cooldown configure the circuit
         #: breaker, quarantine_dir enables poison-row quarantine in stream().
@@ -115,17 +143,18 @@ class ScoreFunction:
 
     def _plan_for(self, backend: Optional[str]):
         key = backend or "default"
-        plan = self._plans.get(key)
-        if plan is None:
-            from .local import LocalPlan
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is None:
+                from .local import LocalPlan
 
-            device = None
-            if backend is not None:
-                import jax
+                device = None
+                if backend is not None:
+                    import jax
 
-                device = jax.devices(backend)[0]
-            plan = self._plans[key] = LocalPlan(
-                self._model.stages, self._result_names, device=device)
+                    device = jax.devices(backend)[0]
+                plan = self._plans[key] = LocalPlan(
+                    self._model.stages, self._result_names, device=device)
         return plan
 
     def _route(self, n_rows: int):
@@ -142,7 +171,7 @@ class ScoreFunction:
 
             default_is_cpu = jax.devices()[0].platform == "cpu"
             backend = ("cpu" if not default_is_cpu
-                       and n_rows < self._auto_cpu_threshold else None)
+                       and n_rows < self.auto_threshold() else None)
             decided = "auto"
             if (backend is None and self._breaker is not None
                     and not self._breaker.allow()):
@@ -154,36 +183,81 @@ class ScoreFunction:
         obs.add_event("serve:routing", backend=backend or "device",
                       rows=int(n_rows), decided=decided)
         key = (backend or "device", decided)
-        c = self._route_counters.get(key)
-        if c is None:
-            c = self._route_counters[key] = obs.default_registry().counter(
-                "serve_routing_total",
-                help="serving batches routed per backend lane",
-                labels={"backend": key[0], "decided": decided})
+        with self._lock:
+            c = self._route_counters.get(key)
+            if c is None:
+                c = self._route_counters[key] = obs.default_registry().counter(
+                    "serve_routing_total",
+                    help="serving batches routed per backend lane",
+                    labels={"backend": key[0], "decided": decided})
         c.inc()
         return self._plan_for(backend), backend
 
+    def auto_threshold(self) -> int:
+        """The routing crossover in rows: batches below it take the CPU plan
+        under backend="auto". Derived from this handle's MEASURED lane
+        latencies — device-lane p50 divided by the CPU lane's per-row cost
+        over a bounded recent window — once both lanes carry
+        `CROSSOVER_MIN_OBS` observations; until then (and whenever the
+        measurements degenerate) the static `auto_cpu_threshold` constant
+        holds. Cached and recomputed every 16 device-lane observations so the
+        per-record routing path never sorts the window."""
+        import math
+
+        with self._lock:
+            dev = self._lane_lat.get("device")
+            cpu = self._lane_lat.get("cpu")
+            if (dev is None or cpu is None or len(dev) < CROSSOVER_MIN_OBS
+                    or len(cpu) < CROSSOVER_MIN_OBS):
+                return self._auto_cpu_threshold
+            thr, at_obs = self._thr_cache
+            n_dev = self._lane_obs.get("device", 0)
+            if thr is not None and n_dev - at_obs < 16:
+                return thr
+            cpu_s = sum(d for d, _ in cpu)
+            cpu_rows = sum(r for _, r in cpu)
+            if cpu_s <= 0.0 or cpu_rows <= 0:
+                return self._auto_cpu_threshold
+            per_row = cpu_s / cpu_rows
+            dev_sorted = sorted(d for d, _ in dev)
+            dev_p50 = dev_sorted[len(dev_sorted) // 2]
+            # a warmed device lane pulls the crossover DOWN (coalesced
+            # micro-batches start paying for the device); a cold/tunneled
+            # one pushes it up past the static default
+            thr = max(1, min(1 << 16, int(math.ceil(dev_p50 / per_row))))
+            self._thr_cache = (thr, n_dev)
+            return thr
+
     def _timed_run(self, plan, table, backend: Optional[str]):
         """plan.run with the per-backend latency histogram
-        (`serve_latency_seconds{backend}`: log buckets + exact p50/p95/p99).
-        The observe is a few µs under one lock — noise against even the
-        sub-ms CPU single-record path. On the device lane this is also where
-        the chaos harness's dispatch faults land and where a configured
-        per-dispatch deadline is enforced."""
+        (`serve_latency_seconds{backend,model}`: log buckets + exact
+        p50/p95/p99). The observe is a few µs under one lock — noise against
+        even the sub-ms CPU single-record path. On the device lane this is
+        also where the chaos harness's dispatch faults land and where a
+        configured per-dispatch deadline is enforced. Each pass also lands in
+        the handle-local lane window that feeds `auto_threshold()`."""
         import time
 
         from .. import obs
 
         t0 = time.perf_counter()
         out = self._dispatch(plan, table, backend)
+        dt = time.perf_counter() - t0
         key = backend or "device"
-        h = self._lat_hists.get(key)
-        if h is None:
-            h = self._lat_hists[key] = obs.default_registry().histogram(
-                "serve_latency_seconds",
-                help="LocalPlan scoring latency per backend lane",
-                labels={"backend": key})
-        h.observe(time.perf_counter() - t0)
+        with self._lock:
+            h = self._lat_hists.get(key)
+            if h is None:
+                h = self._lat_hists[key] = obs.default_registry().histogram(
+                    "serve_latency_seconds",
+                    help="LocalPlan scoring latency per backend lane and "
+                         "served model",
+                    labels={"backend": key, "model": self._model_label})
+            lane = self._lane_lat.get(key)
+            if lane is None:
+                lane = self._lane_lat[key] = deque(maxlen=_LANE_WINDOW)
+            lane.append((dt, _n_rows_of(table)))
+            self._lane_obs[key] = self._lane_obs.get(key, 0) + 1
+        h.observe(dt)
         return out
 
     def _dispatch(self, plan, table, backend: Optional[str]):
@@ -295,6 +369,57 @@ class ScoreFunction:
         sharded = shard_table_rows(self._mesh, Table(dict(table_or_cols)))
         return {n: sharded[n] for n in sharded.names()}
 
+    # --- warmup -------------------------------------------------------------------------
+    def warm(self, buckets: Optional[Sequence[int]] = None,
+             observe: bool = True, log=None) -> dict:
+        """Pre-compile the per-bucket serving executables on every lane the
+        router can choose, so the first real dispatch at any warmed shape
+        compiles nothing (`retrace_budget(0)`-clean steady state from request
+        one). `op warmup --serving` and daemon model admission both call this
+        — the SAME helper, so a deploy-time warmup primes exactly the
+        executables admission will build.
+
+        Each bucket runs twice: a cold pass that traces+compiles against
+        throwaway synthetic buffers (kind-appropriate placeholder values —
+        shapes depend only on the row count and the fitted schema, never on
+        values), then — with `observe=True` — a steady timed pass through the
+        latency histograms, seeding the measured crossover
+        (`auto_threshold()`) with warm per-lane numbers at admission time.
+        Returns {buckets, lanes, programs, wall_s}."""
+        import time
+
+        import jax
+
+        t0 = time.perf_counter()
+        buckets = sorted({int(b) for b in (buckets or self._pad_to or (1,))})
+        rec = {f.name: _placeholder(f.kind) for f in self._predictors}
+        if self._backend == "auto":
+            lanes: list = [None]
+            if jax.devices()[0].platform != "cpu":
+                # the CPU failover/small-batch lane compiles its own programs
+                lanes.append("cpu")
+        else:
+            lanes = [self._backend]
+        for lane in lanes:
+            plan = self._plan_for(lane)
+            for b in buckets:
+                out = plan.run(self._build_table([dict(rec)] * b))
+                jax.block_until_ready([c.values for c in out.values()])
+                if observe:
+                    self._timed_run(plan, self._build_table([dict(rec)] * b),
+                                    lane)
+                if log is not None:
+                    log(f"serving warm: lane={lane or 'device'} rows={b}")
+        return {"buckets": buckets,
+                "lanes": [lane or "device" for lane in lanes],
+                "programs": len(lanes) * len(buckets),
+                "wall_s": round(time.perf_counter() - t0, 3)}
+
+    def breaker_state(self) -> Optional[str]:
+        """Circuit-breaker state of the device lane ("closed"/"open"/
+        "half_open"), or None when no breaker is armed (explicit backends)."""
+        return self._breaker.state if self._breaker is not None else None
+
     # --- single record ------------------------------------------------------------------
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
         return self.batch([record])[0]
@@ -338,10 +463,11 @@ class ScoreFunction:
         pol = self._policy
         if pol is None or not pol.quarantine_dir:
             return None
-        if self._qwriter is None:
-            from ..resilience import QuarantineWriter
+        with self._lock:
+            if self._qwriter is None:
+                from ..resilience import QuarantineWriter
 
-            self._qwriter = QuarantineWriter(pol.quarantine_dir)
+                self._qwriter = QuarantineWriter(pol.quarantine_dir)
         return self._qwriter
 
     def quarantine_summary(self) -> Optional[dict]:
@@ -609,6 +735,17 @@ class ScoreFunction:
         return Table(cols)
 
 
+def _n_rows_of(table_or_cols) -> int:
+    """Row count of a Table or a {name: Column} mapping (the padded count the
+    dispatch actually computed — the honest denominator for per-row cost)."""
+    if isinstance(table_or_cols, Table):
+        return int(table_or_cols.nrows)
+    try:
+        return len(next(iter(table_or_cols.values())))
+    except (StopIteration, AttributeError, TypeError):
+        return 0
+
+
 def _row_nonfinite(row: Mapping[str, Any]) -> bool:
     """True when any float in a result row (including nested prediction
     payloads: prediction scalar, rawPrediction/probability lists) is NaN or
@@ -649,8 +786,10 @@ def score_function(model: "WorkflowModel", result_names: Optional[Sequence[str]]
                   pad_to: Optional[Sequence[int]] = None,
                   backend: Optional[str] = "auto",
                   auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
-                  mesh=None, monitor=None, policy=None) -> ScoreFunction:
+                  mesh=None, monitor=None, policy=None,
+                  model_label: Optional[str] = None) -> ScoreFunction:
     """Build the serving callable (analog of `model.scoreFunction`)."""
     return ScoreFunction(model, result_names=result_names, pad_to=pad_to,
                          backend=backend, auto_cpu_threshold=auto_cpu_threshold,
-                         mesh=mesh, monitor=monitor, policy=policy)
+                         mesh=mesh, monitor=monitor, policy=policy,
+                         model_label=model_label)

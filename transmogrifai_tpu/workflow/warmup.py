@@ -155,6 +155,39 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
             "wall_s": round(time.perf_counter() - t0, 2)}
 
 
+def warm_serving(model_or_dir, buckets: Sequence[int] = None, floor: int = 1,
+                 max_batch: int = 256, backend="auto", mesh=None,
+                 log=print) -> dict:
+    """Warm the SERVING shapes of a fitted model: every pow2 `pad_to` bucket
+    (floor, 2*floor, ..., max_batch) on every lane the serving router can
+    choose — the shapes `op warmup`'s training matrix never touches. This is
+    the SAME `ScoreFunction.warm` helper the serving daemon runs at model
+    admission, so a deploy-time `op warmup --serving DIR` leaves the
+    persistent compile cache primed with exactly the executables admission
+    will build (cold admission then pays tracing + cache reads, not XLA
+    compiles).
+
+    `model_or_dir` is a saved model directory or a WorkflowModel instance.
+    Returns the warm report ({buckets, lanes, programs, wall_s} + model uid).
+    """
+    from ..serve.daemon import serving_buckets
+    from ..utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    if isinstance(model_or_dir, str):
+        from .workflow import WorkflowModel
+
+        model = WorkflowModel.load(model_or_dir)
+    else:
+        model = model_or_dir
+    buckets = (sorted({int(b) for b in buckets}) if buckets
+               else serving_buckets(floor, max_batch))
+    fn = model.score_fn(pad_to=buckets, backend=backend, mesh=mesh)
+    report = fn.warm(buckets, log=(lambda m: log(m)) if log else None)
+    report["model"] = getattr(model, "uid", None)
+    return report
+
+
 def warmup_matrix(problems: Sequence[str] = ("binary",),
                   rows: int = 891,
                   widths: Sequence[int] = (128,),

@@ -691,12 +691,15 @@ class WorkflowModel(WorkflowCore):
     def score_fn(self, result_names: Optional[Sequence[str]] = None,
                  pad_to: Optional[Sequence[int]] = None,
                  backend: Optional[str] = "auto", mesh=None, monitor=None,
-                 policy=None):
+                 policy=None, auto_cpu_threshold: Optional[int] = None):
         """Spark-free serving callable: dict -> dict for one record, .batch(rows) for
         many, .table(table) columnar; same stage kernels as training, jit-cached
         (no MLeap-style conversion). backend="auto" (default) routes small
         batches to the in-process host CPU-JAX plan (sub-ms/record — the
-        reference's local-JVM deployment mode) and large ones to the device;
+        reference's local-JVM deployment mode) and large ones to the device —
+        the small/large crossover starts at `auto_cpu_threshold` (default
+        256) and is re-derived from measured per-lane latencies once both
+        lanes are warm (`ScoreFunction.auto_threshold`);
         backend="cpu"/None pin explicitly. `mesh` row-shards large device-lane
         batches across chips (serve/scoring.py). `monitor=True` attaches a
         ServingMonitor built from the model's stamped serving_baseline
@@ -705,11 +708,13 @@ class WorkflowModel(WorkflowCore):
         resilience.FaultPolicy) arms per-dispatch deadlines, tunes the
         device circuit breaker, and enables poison-row quarantine in
         `.stream()` (docs/robustness.md)."""
-        from ..serve.scoring import score_function
+        from ..serve.scoring import AUTO_CPU_THRESHOLD, score_function
 
-        return score_function(self, result_names=result_names, pad_to=pad_to,
-                              backend=backend, mesh=mesh, monitor=monitor,
-                              policy=policy)
+        return score_function(
+            self, result_names=result_names, pad_to=pad_to, backend=backend,
+            mesh=mesh, monitor=monitor, policy=policy,
+            auto_cpu_threshold=(AUTO_CPU_THRESHOLD if auto_cpu_threshold
+                                is None else auto_cpu_threshold))
 
     # --- insights (analog of OpWorkflowModel.modelInsights / summaryPretty) -----------
     def model_insights(self, feature: Optional[Feature] = None):
