@@ -644,6 +644,180 @@ def run_serving_daemon(n_clients: int = 32, requests_per_client: int = 12,
     }
 
 
+#: cold-start child: load a saved model in a FRESH interpreter, warm the
+#: serving buckets (AOT hydration when artifacts exist, compiles otherwise),
+#: score once, and report wall times + the XLA pipeline event counts for the
+#: warm+score section (the zero-compile acceptance number). argv: model_dir,
+#: json buckets, json records.
+_COLD_START_CHILD = """
+import collections, json, sys, time
+t_all = time.perf_counter()
+from jax._src import monitoring
+events = collections.Counter()
+monitoring.register_event_duration_secs_listener(
+    lambda ev, d, **kw: events.update({ev: 1}))
+from transmogrifai_tpu.workflow.workflow import WorkflowModel
+mdir, buckets, recs = sys.argv[1], json.loads(sys.argv[2]), json.loads(sys.argv[3])
+# backend init happens at daemon construction in the real rollout path,
+# BEFORE any model is admitted (ServingDaemon.admit is what this lane
+# models) — pay it in the import/boot phase for BOTH children so
+# load_to_first_score isolates what the artifacts change
+import jax
+jax.devices()
+import_s = time.perf_counter() - t_all
+t0 = time.perf_counter()
+model = WorkflowModel.load(mdir)
+load_s = time.perf_counter() - t0
+fn = model.score_fn(pad_to=buckets)
+base = dict(events)
+t0 = time.perf_counter()
+rep = fn.warm(buckets)
+warm_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+out = fn.batch(recs)
+first_score_s = time.perf_counter() - t0
+k_lower = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+k_compile = "/jax/core/compile/backend_compile_duration"
+aot = fn.aot_status() or {}
+print("COLDJSON=" + json.dumps({
+    "import_s": round(import_s, 4),
+    "load_s": round(load_s, 4),
+    "warm_s": round(warm_s, 4),
+    "first_score_s": round(first_score_s, 4),
+    "load_to_first_score_s": round(load_s + warm_s + first_score_s, 4),
+    "total_process_s": round(time.perf_counter() - t_all, 4),
+    "warm_score_lower_events": events[k_lower] - base.get(k_lower, 0),
+    "warm_score_compile_events": events[k_compile] - base.get(k_compile, 0),
+    "warmed_programs": rep.get("programs"),
+    "aot_status": aot.get("status"),
+    "aot_executables": aot.get("executables", 0),
+    "results": out,
+}))
+"""
+
+
+def _cold_start_child(model_dir: str, buckets, records, env=None) -> dict:
+    import subprocess
+    import sys as _sys
+
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    proc = subprocess.run(
+        [_sys.executable, "-c", _COLD_START_CHILD, model_dir,
+         json.dumps(buckets), json.dumps(records)],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=600, env=child_env)
+    for line in proc.stdout.splitlines():
+        if line.startswith("COLDJSON="):
+            return json.loads(line[len("COLDJSON="):])
+    raise RuntimeError(
+        f"cold-start child produced no report (rc={proc.returncode}): "
+        f"{proc.stderr[-800:]}")
+
+
+def run_cold_start(max_batch: int = 256, n_score_rows: int = 2) -> dict:
+    """Cold-start lane (ISSUE 8 acceptance): fresh-subprocess `load` + first
+    score, with and without AOT deploy artifacts, on the same host.
+
+    Two bundles of the SAME fitted model: one saved with `aot=True` (the
+    serialized per-lane x per-bucket executables + routing windows), one
+    plain. Each is loaded in a fresh interpreter that warms the full serving
+    ladder and scores once. The no-AOT child runs with every artifact tier
+    disabled (TT_COMPILE_CACHE=0, TT_EXPORT_CACHE=0) — the true
+    nothing-prepared baseline a fresh replica on a fresh host pays. The
+    model is a random-forest pipeline: tree ensembles are the compile-heavy
+    serving family (the realistic rollout pain), and their fitted arrays
+    exercise the npz-sidecar path of the bundle. Gated numbers:
+    `cold_start_speedup` >= 10x and `cold_start_aot_compile_events` == 0
+    (the hydrated warm+first-score section must trigger zero XLA
+    lowers/compiles)."""
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.serve.daemon import serving_buckets
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model.trees import RandomForestClassifier
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(23)
+    schema = {"label": "RealNN", **{f"x{i}": "Real" for i in range(10)},
+              "cat": "PickList", "tier": "PickList", "region": "PickList",
+              "joined": "Date"}
+
+    def make_rows(n, labeled=True):
+        out = []
+        for _ in range(n):
+            r = {f"x{i}": float(v)
+                 for i, v in enumerate(rng.normal(size=10))}
+            r["cat"] = "abcd"[int(rng.integers(0, 4))]
+            r["tier"] = "wxyz"[int(rng.integers(0, 4))]
+            r["region"] = ["north", "south", "east"][int(rng.integers(0, 3))]
+            r["joined"] = int(1.5e9 + rng.integers(0, int(1e8)))
+            if labeled:
+                r["label"] = float(rng.random() > 0.5)
+            out.append(r)
+        return out
+
+    fs = features_from_schema(schema, response="label")
+    vec = transmogrify([f for n_, f in fs.items() if n_ != "label"])
+    pred = RandomForestClassifier(n_trees=40, max_depth=6)(fs["label"], vec)
+    model = (Workflow().set_reader(InMemoryReader(make_rows(512)))
+             .set_result_features(pred).train())
+    buckets = serving_buckets(1, max_batch)
+    records = make_rows(n_score_rows, labeled=False)
+
+    mdir_aot = tempfile.mkdtemp(prefix="bench_cold_aot_")
+    mdir_plain = tempfile.mkdtemp(prefix="bench_cold_plain_")
+    try:
+        # plain bundle FIRST: save(aot=True) sets serving_lane_windows on
+        # the model as an export side effect, and a later plain save would
+        # stamp those measured routing windows into the "nothing-prepared"
+        # baseline manifest
+        model.save(mdir_plain, overwrite=True)
+        t0 = time.perf_counter()
+        model.save(mdir_aot, overwrite=True, aot=True,
+                   aot_buckets=buckets)
+        export_s = time.perf_counter() - t0
+        # min-of-2 per side (symmetric): each child is an independent fresh
+        # process, so the smaller wall is the less-noise estimate — one-shot
+        # numbers on a shared CI host jitter +-10%, which is the gate margin
+        aot_rep = min(
+            (_cold_start_child(mdir_aot, buckets, records)
+             for _ in range(2)),
+            key=lambda r: r["load_to_first_score_s"])
+        noaot_rep = min(
+            (_cold_start_child(
+                mdir_plain, buckets, records,
+                env={"TT_COMPILE_CACHE": "0", "TT_EXPORT_CACHE": "0"})
+             for _ in range(2)),
+            key=lambda r: r["load_to_first_score_s"])
+    finally:
+        shutil.rmtree(mdir_aot, ignore_errors=True)
+        shutil.rmtree(mdir_plain, ignore_errors=True)
+
+    aot_s = aot_rep["load_to_first_score_s"]
+    noaot_s = noaot_rep["load_to_first_score_s"]
+    return {
+        "buckets": buckets,
+        "export_wall_s": round(export_s, 3),
+        "cold_start_aot_s": aot_s,
+        "cold_start_noaot_s": noaot_s,
+        "cold_start_speedup": round(noaot_s / max(aot_s, 1e-9), 2),
+        "cold_start_aot_first_score_s": aot_rep["first_score_s"],
+        "cold_start_aot_compile_events": (
+            aot_rep["warm_score_lower_events"]
+            + aot_rep["warm_score_compile_events"]),
+        "aot_status": aot_rep["aot_status"],
+        "aot_executables": aot_rep["aot_executables"],
+        "results_identical": aot_rep["results"] == noaot_rep["results"],
+        "aot": aot_rep,
+        "noaot": noaot_rep,
+    }
+
+
 def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
               max_depth: int = 6, n_bins: int = 64) -> dict:
     """Gradient-boosted trees at data scale: 1M rows x 256 features, n_trees
@@ -699,7 +873,8 @@ ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp,
        "trees": run_trees, "streaming": run_streaming_score,
        "monitor": run_monitor_overhead,
        "resilience": run_resilience_overhead,
-       "daemon": run_serving_daemon}
+       "daemon": run_serving_daemon,
+       "cold_start": run_cold_start}
 
 if __name__ == "__main__":
     import sys

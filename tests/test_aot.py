@@ -1,0 +1,669 @@
+"""AOT deploy artifacts (serve/aot.py): millisecond cold start for load +
+first score.
+
+Pins the ISSUE-8 acceptance surface: save(aot=True) exports serialized
+per-lane x per-bucket scoring executables keyed by the analyzer's plan
+fingerprint + a compatibility stamp; a FRESH PROCESS loads the bundle and
+reaches a bit-identical first score with zero XLA compiles
+(`retrace_budget(0)`); stale artifacts (jax version stamp, device kind,
+edited npz, corrupt blob) degrade gracefully to the warm compile path with
+the `aot_fallback_total` counter incremented — never an error; daemon
+admission hydrates through the same shared warm helper; and the persisted
+routing-crossover windows seed `auto_threshold()` at load.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.analyze import plan_fingerprint
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.serve import DaemonClient, ServingDaemon
+from transmogrifai_tpu.serve.aot import (
+    AOT_DIR,
+    compat_stamp,
+    export_aot,
+    hydrate,
+    index_path,
+    read_index,
+)
+from transmogrifai_tpu.serve.scoring import AUTO_CPU_THRESHOLD
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.workflow.warmup import warm_serving
+
+KINDS = {"label": "RealNN", "a": "Real", "cat": "PickList"}
+BUCKETS = [1, 2, 4, 8]
+
+
+def _train(seed=5, l2=0.01):
+    rng = np.random.default_rng(seed)
+    rows = [{"label": float(i % 2), "a": float(i % 2) + rng.normal(0, 0.1),
+             "cat": "ab"[i % 2]} for i in range(64)]
+    fs = features_from_schema(KINDS, response="label")
+    pred = LogisticRegression(l2=l2)(
+        fs["label"], transmogrify([fs["a"], fs["cat"]]))
+    model = (Workflow().set_reader(InMemoryReader(rows))
+             .set_result_features(pred).train())
+    return model, rows
+
+
+SERVING = [{"a": 0.5, "cat": "a"}, {"a": 1.5, "cat": "b"},
+           {"a": -0.25, "cat": "a"}]
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _train()
+
+
+@pytest.fixture(scope="module")
+def aot_dir(fitted, tmp_path_factory):
+    model, _ = fitted
+    d = str(tmp_path_factory.mktemp("aot_bundle"))
+    model.save(d, overwrite=True, aot=True, aot_buckets=BUCKETS)
+    return d
+
+
+def _counter_value(name, **labels):
+    m = obs.default_registry().find(name, labels=labels or None)
+    return m.value if m is not None else 0.0
+
+
+def _fresh_load_fn(aot_dir, buckets=None):
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    model = WorkflowModel.load(aot_dir)
+    return model, model.score_fn(pad_to=buckets or BUCKETS)
+
+
+# --- export ---------------------------------------------------------------------------
+def test_export_writes_artifact_set(fitted, aot_dir):
+    model, _ = fitted
+    index = read_index(aot_dir)
+    assert index is not None
+    assert index["plan_fingerprint"] == plan_fingerprint(model.stages)
+    assert index["buckets"] == BUCKETS
+    assert "device" in index["lanes"]
+    for k in ("jax", "jaxlib", "platform", "device_kind", "device_count",
+              "code"):
+        assert index["stamp"][k] == compat_stamp()[k]
+    # one blob per (lane, bucket, fused device step), all present on disk
+    assert index["entries"], "export produced no executables"
+    for e in index["entries"]:
+        assert os.path.exists(os.path.join(aot_dir, AOT_DIR, e["file"]))
+    # the export's timed passes persisted measured routing windows, host-
+    # stamped so a different host class won't adopt them at load
+    assert index["lane_windows"].get("device")
+    manifest = json.load(open(os.path.join(aot_dir, "model.json")))
+    slw = manifest["serving_lane_windows"]
+    assert slw["windows"].get("device")
+    assert slw["platform"] == compat_stamp()["platform"]
+
+
+def test_resave_without_aot_clears_stale_artifacts(fitted, tmp_path):
+    model, _ = fitted
+    d = str(tmp_path / "bundle")
+    model.save(d, aot=True, aot_buckets=[1, 2])
+    assert os.path.isdir(os.path.join(d, AOT_DIR))
+    model.save(d, overwrite=True)  # resave without export
+    assert not os.path.exists(os.path.join(d, AOT_DIR))
+
+
+def test_unfingerprintable_plan_skips_export(fitted, tmp_path, monkeypatch):
+    model, _ = fitted
+    monkeypatch.setattr(
+        type(model.stages[0]), "trace_fingerprint",
+        lambda self: (_ for _ in ()).throw(TypeError("no identity")))
+    report = export_aot(model, str(tmp_path / "x"), buckets=[1])
+    assert report["status"] == "skipped"
+    assert report["reason"] == "unfingerprintable"
+    assert not os.path.exists(index_path(str(tmp_path / "x")))
+
+
+def test_failed_resave_preserves_old_bundle_artifacts(tmp_path):
+    # the artifact sweep runs AFTER the atomic manifest replace: a resave
+    # that dies mid-write leaves the OLD bundle fully intact — manifest AND
+    # its still-valid artifacts (a replica must not silently degrade from
+    # hydrated to full compiles because a later save failed)
+    import json as _json
+
+    model, _ = _train(seed=29)
+    d = str(tmp_path / "bundle")
+    model.save(d, aot=True, aot_buckets=[1, 2])
+    assert os.path.isdir(os.path.join(d, AOT_DIR))
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(_json, "dump",
+                   lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")))
+        with pytest.raises(OSError):
+            model.save(d, overwrite=True)
+    assert os.path.isdir(os.path.join(d, AOT_DIR))
+    _, fn = _fresh_load_fn(d, buckets=[1, 2])
+    assert fn.warm([1, 2])["aot"]["status"] == "hydrated"
+
+
+def test_failed_aot_resave_preserves_old_artifacts(tmp_path):
+    # save(aot=True) stages its export and publishes only after the manifest
+    # replace: a resave dying at the manifest leaves the old bundle AND its
+    # matching artifact generation untouched
+    model, _ = _train(seed=31)
+    d = str(tmp_path / "bundle")
+    model.save(d, aot=True, aot_buckets=[1, 2])
+    old_index = read_index(d)
+    real_replace = os.replace
+    with pytest.MonkeyPatch.context() as mp:
+        def flaky(src, dst, *a, **k):
+            if str(dst).endswith("model.json"):
+                raise OSError("disk full")
+            return real_replace(src, dst, *a, **k)
+
+        mp.setattr(os, "replace", flaky)
+        with pytest.raises(OSError):
+            model.save(d, overwrite=True, aot=True, aot_buckets=[1, 2])
+    assert read_index(d) == old_index
+    _, fn = _fresh_load_fn(d, buckets=[1, 2])
+    assert fn.warm([1, 2])["aot"]["status"] == "hydrated"
+
+
+def test_skipped_export_sweeps_previous_generation(tmp_path, monkeypatch):
+    # an unfingerprintable REsave must still invalidate the old artifact
+    # generation: a skipped export over an old bundle may not leave v1's
+    # blobs next to v2's manifest
+    model, _ = _train(seed=17)
+    d = str(tmp_path / "bundle")
+    model.save(d, aot=True, aot_buckets=[1, 2])
+    assert os.path.isdir(os.path.join(d, AOT_DIR))
+    monkeypatch.setattr(
+        type(model.stages[0]), "trace_fingerprint",
+        lambda self: (_ for _ in ()).throw(TypeError("no identity")))
+    model.save(d, overwrite=True, aot=True, aot_buckets=[1, 2])
+    assert not os.path.exists(os.path.join(d, AOT_DIR))
+
+
+# --- hydration ------------------------------------------------------------------------
+def test_hydrated_warm_compiles_nothing_and_scores_identically(fitted, aot_dir):
+    model, _ = fitted
+    # warm-path reference from the ORIGINAL in-memory model (no artifacts)
+    ref_fn = model.score_fn(pad_to=BUCKETS)
+    ref = ref_fn.batch(SERVING)
+
+    _, fn = _fresh_load_fn(aot_dir)
+    before = _counter_value("aot_hydrated_total", lane="device")
+    with obs.retrace_budget(0):
+        report = fn.warm(BUCKETS)
+        out = fn.batch(SERVING)
+    assert report["programs"] == 0  # nothing compiled
+    assert report["aot"]["status"] == "hydrated"
+    assert report["aot"]["buckets_hydrated"] == BUCKETS
+    assert _counter_value("aot_hydrated_total", lane="device") > before
+    assert out == ref  # bit-identical to the compile path
+    status = fn.aot_status()
+    assert status["status"] == "hydrated"
+    assert status["fallback_compiles"] == 0
+
+
+def test_lane_alias_hydrates_across_backend_spellings(fitted, aot_dir,
+                                                      tmp_path):
+    """Lane matching is by compiled TARGET, not literal label: on a host
+    whose default platform is cpu, an auto export (lane label "device") must
+    hydrate an explicit-cpu handle (lane label "cpu") and vice versa —
+    otherwise a routine `op serve --backend cpu` rollout against an
+    auto-exported bundle silently forfeits the entire cold-start win."""
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("labels only collapse onto one target on a cpu host")
+    model, _ = fitted
+    ref = model.score_fn(pad_to=BUCKETS).batch(SERVING)
+
+    # auto export -> explicit-cpu handle
+    m2, _ = _fresh_load_fn(aot_dir)
+    fn = m2.score_fn(pad_to=BUCKETS, backend="cpu")
+    with obs.retrace_budget(0):
+        report = fn.warm(BUCKETS)
+        assert fn.batch(SERVING) == ref
+    assert report["programs"] == 0
+    assert report["aot"]["status"] == "hydrated"
+
+    # explicit-cpu export -> auto handle
+    d = str(tmp_path / "cpu_export")
+    model.save(d, overwrite=True, aot=True, aot_buckets=BUCKETS,
+               aot_backend="cpu")
+    assert read_index(d)["lanes"] == ["cpu"]
+    m3, fn3 = _fresh_load_fn(d)
+    with obs.retrace_budget(0):
+        report = fn3.warm(BUCKETS)
+        assert fn3.batch(SERVING) == ref
+    assert report["programs"] == 0
+    assert report["aot"]["status"] == "hydrated"
+
+
+def test_export_skips_blob_that_fails_roundtrip(fitted, tmp_path, monkeypatch):
+    """A program that serializes but cannot be deserialized back (the
+    XLA-CPU "Symbols not found" class, seen on save->load->resave program
+    variants) is dropped at EXPORT time: the index only ever advertises
+    blobs a replica can actually load, so hydration on a compatible host
+    reads an honest "partial" instead of degrading by surprise."""
+    import jax.experimental.serialize_executable as se
+
+    model, _ = fitted
+    real = se.deserialize_and_load
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:  # first round-trip check: a bucket-1 step
+            raise RuntimeError("Symbols not found: [ test_fusion ]")
+        return real(*a, **k)
+
+    monkeypatch.setattr(se, "deserialize_and_load", flaky)
+    d = str(tmp_path / "roundtrip")
+    model.save(d, overwrite=True, aot=True, aot_buckets=BUCKETS)
+    index = read_index(d)
+    assert [s["bucket"] for s in index["skipped"]] == [1]
+    pairs = {(e["lane"], e["bucket"]) for e in index["entries"]}
+    assert ("device", 1) not in pairs  # sibling step blobs swept too
+    for e in index["entries"]:
+        assert os.path.exists(os.path.join(d, AOT_DIR, e["file"]))
+    _, fn = _fresh_load_fn(d)
+    rep = fn.warm(BUCKETS)
+    assert rep["aot"]["status"] == "partial"
+    assert rep["aot"]["buckets_hydrated"] == [b for b in BUCKETS if b != 1]
+    assert fn.batch(SERVING)  # never an error
+
+
+def test_hydrate_reports_are_json_serializable(fitted, aot_dir):
+    """hydrate()/warm() reports are public serve API — rollout tooling
+    json-ships them, so no field may be a Python set (the covered pairs
+    travel as [lane_label, bucket] lists)."""
+    _, fn = _fresh_load_fn(aot_dir)
+    rep = hydrate(fn)
+    assert rep["status"] == "hydrated"
+    assert rep["covered"] == sorted(
+        ["device", b] for b in BUCKETS)
+    json.dumps(rep)
+    _, fn2 = _fresh_load_fn(aot_dir)
+    fn2._model._bundle_path = None
+    json.dumps(hydrate(fn2))  # fallback report shape too
+    _, fn3 = _fresh_load_fn(aot_dir)
+    json.dumps(fn3.warm(BUCKETS))
+
+
+def test_unwarmed_shape_falls_back_and_counts(fitted, aot_dir):
+    _, fn = _fresh_load_fn(aot_dir)
+    fn.warm(BUCKETS)
+    before = _counter_value("aot_fallback_compiles_total")
+    out = fn.batch(SERVING * 4)  # 12 rows > largest bucket 8: unwarmed shape
+    assert len(out) == 12 and all(out)
+    assert _counter_value("aot_fallback_compiles_total") > before
+    assert fn.aot_status()["fallback_compiles"] >= 1
+
+
+def test_stale_jax_version_stamp_falls_back(fitted, aot_dir, tmp_path):
+    import shutil
+
+    d = str(tmp_path / "stale_jax")
+    shutil.copytree(aot_dir, d)
+    index = read_index(d)
+    index["stamp"]["jax"] = "0.0.1"
+    json.dump(index, open(index_path(d), "w"))
+    _, fn = _fresh_load_fn(d)
+    before = _counter_value("aot_fallback_total", reason="stamp")
+    report = fn.warm(BUCKETS)
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["reason"] == "stamp"
+    assert report["programs"] > 0  # compiled the full ladder instead
+    assert _counter_value("aot_fallback_total", reason="stamp") == before + 1
+    assert fn.batch(SERVING)  # never an error
+
+
+def test_stale_jaxlib_stamp_falls_back(fitted, aot_dir, tmp_path):
+    # jaxlib (the XLA wire format owner) upgrades independently of jax:
+    # same jax version + different jaxlib must still read as stale
+    import shutil
+
+    d = str(tmp_path / "stale_jaxlib")
+    shutil.copytree(aot_dir, d)
+    index = read_index(d)
+    index["stamp"]["jaxlib"] = "0.0.1"
+    json.dump(index, open(index_path(d), "w"))
+    _, fn = _fresh_load_fn(d)
+    report = fn.warm(BUCKETS)
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["reason"] == "stamp"
+    assert report["programs"] > 0
+
+
+def test_validation_failure_retires_bucket_not_warm(fitted, aot_dir,
+                                                    monkeypatch):
+    # an executable that deserializes but fails at EXECUTION (on async
+    # backends the error surfaces at the result fetch, outside
+    # _AotDispatch's call-time guard): warm must retire the bucket, compile
+    # it instead, and report partial — never raise
+    from transmogrifai_tpu.serve.scoring import ScoreFunction, _n_rows_of
+
+    _, fn = _fresh_load_fn(aot_dir)
+    real = ScoreFunction._timed_run
+    tripped = []
+
+    def flaky(self, plan, table, backend):
+        if not tripped and _n_rows_of(table) == 4:
+            tripped.append(True)
+            raise RuntimeError("async execution error at fetch")
+        return real(self, plan, table, backend)
+
+    monkeypatch.setattr(ScoreFunction, "_timed_run", flaky)
+    before = _counter_value("aot_fallback_total", reason="error")
+    report = fn.warm(BUCKETS)
+    assert tripped
+    assert report["programs"] == 1  # only the retired bucket compiled
+    assert report["aot"]["status"] == "partial"
+    assert 4 not in report["aot"]["buckets_hydrated"]
+    assert set(report["aot"]["buckets_hydrated"]) == {1, 2, 8}
+    assert _counter_value("aot_fallback_total", reason="error") == before + 1
+    # the retired shape serves via the compiled path without ticking the
+    # limping-replica miss counter
+    before_miss = _counter_value("aot_fallback_compiles_total")
+    out = fn.batch(SERVING + SERVING[:1])  # 4 rows
+    assert len(out) == 4 and all(out)
+    assert _counter_value("aot_fallback_compiles_total") == before_miss
+
+
+def test_sync_call_time_failure_demotes_at_admission(fitted, aot_dir,
+                                                     monkeypatch):
+    # the SYNC twin of the async test above: on CPU the failure is caught
+    # inside _AotDispatch.__call__ during the validation pass — warm must
+    # still demote the bucket to the compile path and must NOT tick the
+    # hot-path "limping replica" miss counter for an admission-time event
+    import jax.experimental.serialize_executable as se
+
+    real_dl = se.deserialize_and_load
+
+    def fake(*a, **kw):
+        ex = real_dl(*a, **kw)
+
+        def proxy(cols):
+            if cols and len(cols[0]) == 4:
+                raise RuntimeError("call-time failure")
+            return ex(cols)
+
+        return proxy
+
+    monkeypatch.setattr(se, "deserialize_and_load", fake)
+    _, fn = _fresh_load_fn(aot_dir)
+    before_err = _counter_value("aot_fallback_total", reason="error")
+    before_miss = _counter_value("aot_fallback_compiles_total")
+    report = fn.warm(BUCKETS)
+    assert report["programs"] == 1  # only the failing bucket compiled
+    assert report["aot"]["status"] == "partial"
+    assert set(report["aot"]["buckets_hydrated"]) == {1, 2, 8}
+    assert _counter_value("aot_fallback_total",
+                          reason="error") == before_err + 1
+    assert _counter_value("aot_fallback_compiles_total") == before_miss
+    assert fn.aot_status()["fallback_compiles"] == 0
+    out = fn.batch(SERVING + SERVING[:1])  # 4 rows -> the compiled path
+    assert len(out) == 4 and all(out)
+    assert _counter_value("aot_fallback_compiles_total") == before_miss
+
+
+def test_device_kind_mismatch_falls_back(fitted, aot_dir, tmp_path):
+    import shutil
+
+    d = str(tmp_path / "stale_dev")
+    shutil.copytree(aot_dir, d)
+    index = read_index(d)
+    index["stamp"]["device_kind"] = "TPU v9"
+    json.dump(index, open(index_path(d), "w"))
+    _, fn = _fresh_load_fn(d)
+    report = fn.warm(BUCKETS)
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["reason"] == "stamp"
+
+
+def test_edited_npz_falls_back_on_fingerprint(tmp_path, monkeypatch):
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    # force the LR weights into an npz sidecar so "edited npz" is testable
+    # on a small model (the default threshold is 1024 elements)
+    monkeypatch.setattr(WorkflowModel, "_NPZ_THRESHOLD", 2)
+    model, _ = _train(seed=9)
+    d = str(tmp_path / "bundle")
+    model.save(d, aot=True, aot_buckets=[1, 2])
+    npz_name = json.load(open(os.path.join(d, "model.json")))["arrays_file"]
+    path = os.path.join(d, npz_name)
+    arrays = dict(np.load(path))
+    assert arrays, "expected sidecar arrays"
+    k = sorted(arrays)[0]
+    arrays[k] = arrays[k] + 1.0  # an external sync dropped different weights
+    with open(path, "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+
+    _, fn = _fresh_load_fn(d, buckets=[1, 2])
+    before = _counter_value("aot_fallback_total", reason="fingerprint")
+    report = fn.warm([1, 2])
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["reason"] == "fingerprint"
+    assert _counter_value("aot_fallback_total",
+                          reason="fingerprint") == before + 1
+    assert fn.batch(SERVING)  # serves the edited weights via the warm path
+
+
+def test_corrupt_blob_degrades_per_bucket(fitted, aot_dir, tmp_path):
+    import shutil
+
+    d = str(tmp_path / "corrupt")
+    shutil.copytree(aot_dir, d)
+    index = read_index(d)
+    victim = [e for e in index["entries"] if e["bucket"] == 4][0]
+    with open(os.path.join(d, AOT_DIR, victim["file"]), "wb") as fh:
+        fh.write(b"not an executable")
+    _, fn = _fresh_load_fn(d)
+    before = _counter_value("aot_fallback_total", reason="deserialize")
+    report = fn.warm(BUCKETS)
+    assert report["aot"]["status"] == "partial"
+    assert 4 not in report["aot"]["buckets_hydrated"]
+    assert set(report["aot"]["buckets_hydrated"]) == {1, 2, 8}
+    assert report["programs"] == 1  # only the broken bucket compiled
+    assert _counter_value("aot_fallback_total",
+                          reason="deserialize") == before + 1
+    # steady-state traffic at the COMPILED bucket is healthy, not limping:
+    # warm marked it, so dispatches there must not tick the miss counter
+    before_miss = _counter_value("aot_fallback_compiles_total")
+    out = fn.batch(SERVING + SERVING[:1])  # 4 rows -> the compiled bucket
+    assert len(out) == 4 and all(out)
+    assert _counter_value("aot_fallback_compiles_total") == before_miss
+    assert fn.aot_status()["fallback_compiles"] == 0
+    assert fn.batch(SERVING)
+
+
+def test_every_blob_corrupt_counts_deserialize_once(fitted, tmp_path):
+    model, _ = fitted
+    d = str(tmp_path / "all_corrupt")
+    model.save(d, aot=True, aot_buckets=[2])
+    index = read_index(d)
+    for e in index["entries"]:
+        with open(os.path.join(d, AOT_DIR, e["file"]), "wb") as fh:
+            fh.write(b"garbage")
+    _, fn = _fresh_load_fn(d, buckets=[2])
+    before = _counter_value("aot_fallback_total", reason="deserialize")
+    report = fn.warm([2])
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["reason"] == "deserialize"
+    # one hydration attempt = ONE count (the per-blob tick; no double count
+    # from the final fallback report)
+    assert _counter_value("aot_fallback_total",
+                          reason="deserialize") == before + 1
+    assert fn.batch(SERVING)
+
+
+def test_missing_artifacts_is_quiet_cold_path(fitted, tmp_path):
+    model, _ = fitted
+    d = str(tmp_path / "plain")
+    model.save(d)  # no artifacts
+    _, fn = _fresh_load_fn(d, buckets=[1, 2])
+    report = fn.warm([1, 2])
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["reason"] == "absent"
+    assert report["programs"] > 0
+
+
+def test_mesh_handle_skips_hydration(fitted, aot_dir):
+    from transmogrifai_tpu.mesh import make_mesh
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    model = WorkflowModel.load(aot_dir)
+    fn = model.score_fn(pad_to=BUCKETS, mesh=make_mesh(n_data=8))
+    report = hydrate(fn)
+    assert report["status"] == "fallback"
+    assert report["reason"] == "mesh"
+    # the warm/admission path surfaces the same degrade — counted and
+    # visible in the report//healthz, not silently never attempted
+    fn2 = model.score_fn(pad_to=[8], mesh=make_mesh(n_data=8))
+    wrep = fn2.warm([8])
+    assert wrep["aot"]["status"] == "fallback"
+    assert wrep["aot"]["reason"] == "mesh"
+    assert wrep["programs"] > 0
+
+
+def test_all_buckets_retired_reads_fallback(fitted, aot_dir, monkeypatch):
+    # every hydrated bucket failing validation must demote the handle all
+    # the way to "fallback" — not "partial" with an empty bucket list
+    import jax.experimental.serialize_executable as se
+
+    real_dl = se.deserialize_and_load
+
+    def fake(*a, **kw):
+        real_dl(*a, **kw)  # blob itself is fine; execution is what fails
+
+        def proxy(cols):
+            raise RuntimeError("call-time failure")
+
+        return proxy
+
+    monkeypatch.setattr(se, "deserialize_and_load", fake)
+    _, fn = _fresh_load_fn(aot_dir)
+    report = fn.warm(BUCKETS)
+    assert report["programs"] == len(BUCKETS)
+    assert report["aot"]["status"] == "fallback"
+    assert report["aot"]["buckets_hydrated"] == []
+    assert fn.batch(SERVING)  # never an error
+
+
+# --- routing-window persistence -------------------------------------------------------
+def test_lane_windows_round_trip_seed_auto_threshold(tmp_path):
+    model, _ = _train(seed=13)
+    fn = model.score_fn()
+    # synthetic measurements: device p50 10 ms, cpu 1 ms/row -> crossover 10
+    fn.seed_lane_windows({"device": [[0.010, 64]] * 8,
+                          "cpu": [[0.001, 1]] * 8})
+    assert fn.auto_threshold() == 10
+    model.serving_lane_windows = fn.lane_windows()
+    d = str(tmp_path / "bundle")
+    model.save(d)
+
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    loaded = WorkflowModel.load(d)
+    fn2 = loaded.score_fn()
+    # measured-quality routing from request #1 — not the cold constant
+    assert fn2.auto_threshold() == 10 != AUTO_CPU_THRESHOLD
+    assert fn2.lane_windows()["device"] == [[0.010, 64]] * 8
+
+
+def test_export_seeds_windows_through_manifest(fitted, aot_dir):
+    _, fn = _fresh_load_fn(aot_dir)
+    # before any traffic: the bundle's measured windows are already in place
+    assert fn.lane_windows().get("device")
+
+
+# --- daemon admission + shared warm helper --------------------------------------------
+def test_daemon_admission_hydrates_with_zero_compiles(fitted, aot_dir):
+    model, _ = fitted
+    ref = model.score_fn(pad_to=BUCKETS).batch(SERVING[:2])
+    with ServingDaemon(max_models=2, max_batch=8, bucket_floor=1,
+                       quarantine_root=None) as daemon:
+        with obs.retrace_budget(0):
+            entry = daemon.admit(aot_dir, name="aot")
+        info = entry.info()
+        assert info["aot"]["status"] == "hydrated"
+        assert info["aot"]["buckets_hydrated"] == BUCKETS
+        assert info["aot"]["fallback_compiles"] == 0
+        assert entry.warm_report["programs"] == 0
+        client = DaemonClient(daemon)
+        out = client.score(SERVING[:2], model="aot")
+        assert out == ref
+
+
+def test_daemon_no_aot_flag_forces_compile_path(fitted, aot_dir):
+    with ServingDaemon(max_models=2, max_batch=8, bucket_floor=1,
+                       quarantine_root=None, aot=False) as daemon:
+        entry = daemon.admit(aot_dir, name="cold")
+        assert entry.info()["aot"] is None
+        assert entry.warm_report["programs"] > 0
+
+
+def test_warm_serving_consults_artifact_store(aot_dir):
+    with obs.retrace_budget(0):
+        report = warm_serving(aot_dir, buckets=BUCKETS, log=None)
+    assert report["programs"] == 0
+    assert report["aot"]["status"] == "hydrated"
+
+
+def test_warm_serving_export_flag_writes_artifacts(tmp_path):
+    model, _ = _train(seed=21)
+    d = str(tmp_path / "bundle")
+    model.save(d)
+    assert not os.path.exists(index_path(d))
+    report = warm_serving(d, buckets=[1, 2], log=None, export_aot=True)
+    assert report["status"] == "exported"
+    assert os.path.exists(index_path(d))
+    assert read_index(d)["buckets"] == [1, 2]
+
+
+# --- cross-process round trip ---------------------------------------------------------
+_CHILD = """
+import json, sys
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+mdir, buckets, recs = sys.argv[1], json.loads(sys.argv[2]), json.loads(sys.argv[3])
+model = WorkflowModel.load(mdir)
+fn = model.score_fn(pad_to=buckets)
+with obs.retrace_budget(0):   # raises on ANY trace/lower/compile
+    report = fn.warm(buckets)
+    out = fn.batch(recs)
+hyd = obs.default_registry().find("aot_hydrated_total",
+                                  labels={"lane": "device"})
+print("AOTJSON=" + json.dumps({
+    "programs": report["programs"],
+    "status": report["aot"]["status"],
+    "hydrated_counter": hyd.value if hyd is not None else 0,
+    "results": out,
+}))
+"""
+
+
+def test_cross_process_round_trip_zero_compiles_bit_identical(fitted, aot_dir):
+    model, _ = fitted
+    ref = model.score_fn(pad_to=BUCKETS).batch(SERVING)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, aot_dir, json.dumps(BUCKETS),
+         json.dumps(SERVING)],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = next(line for line in proc.stdout.splitlines()
+                   if line.startswith("AOTJSON="))
+    report = json.loads(payload[len("AOTJSON="):])
+    assert report["status"] == "hydrated"
+    assert report["programs"] == 0
+    assert report["hydrated_counter"] > 0
+    # bit-identical across processes: json round-trips floats losslessly
+    # (repr round-trip), so == is exact
+    assert report["results"] == json.loads(json.dumps(ref))

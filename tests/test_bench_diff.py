@@ -32,6 +32,20 @@ def test_direction_inference():
     # a mid-name "_s" must not flip direction: these are higher-is-better
     assert not bench_diff.lower_is_better("best_score")
     assert not bench_diff.lower_is_better("n_samples_used")
+    # the AOT cold-start lane: wall metrics regress upward, the speedup and
+    # the zero-compile count keep their own directions
+    assert bench_diff.lower_is_better("cold_start_aot_s")
+    assert bench_diff.lower_is_better("cold_start_noaot_s")
+    assert bench_diff.lower_is_better("cold_start_aot_compile_events")
+    assert not bench_diff.lower_is_better("cold_start_speedup")
+
+
+def test_cold_start_compile_events_zero_baseline():
+    # a 0 -> N compile-event slip must flag even though ratio is undefined
+    rows = {r["metric"]: r for r in bench_diff.compare(
+        {"cold_start_aot_compile_events": 0},
+        {"cold_start_aot_compile_events": 3})}
+    assert rows["cold_start_aot_compile_events"]["regressed"]
 
 
 def test_compare_flags_and_tolerates():

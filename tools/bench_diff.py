@@ -26,7 +26,10 @@ from typing import Optional
 #: so a mid-name "_s" (best_score, n_samples_used) cannot flip the direction
 _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 #: name fragments marking "lower is better" anywhere in the name
-_LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99")
+#: (cold_start covers the AOT deploy-artifact lane: every cold_start_* wall
+#: metric regresses upward; cold_start_speedup stays higher-better via the
+#: override list, which is checked first)
+_LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start")
 #: overrides: fragments that look like seconds but are throughput/quality
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",

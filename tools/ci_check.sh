@@ -222,6 +222,62 @@ print(f"serving daemon smoke ok: scored 2 rows over HTTP, "
       f"{len(fams)} metric families, clean shutdown (rc=0)")
 PY
 
+echo "== cold-start smoke (AOT deploy artifacts) =="
+# save a tiny model WITH the AOT artifact set, then load + 2-row score in a
+# FRESH subprocess: the hydration counter must tick and the warm+score
+# section must trigger ZERO XLA compile-pipeline events (retrace_budget(0))
+# — the ISSUE-8 contract that a cold process reaches first score without
+# tracing or compiling anything (docs/performance.md "Cold start")
+python - <<'PY'
+import json, os, subprocess, sys, tempfile
+
+import numpy as np
+
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(0)
+rows = [{"label": float(i % 2), "a": float(i % 2) + rng.normal(0, 0.1),
+         "cat": "ab"[i % 2]} for i in range(64)]
+fs = features_from_schema(
+    {"label": "RealNN", "a": "Real", "cat": "PickList"}, response="label")
+pred = LogisticRegression(l2=0.01)(fs["label"], transmogrify([fs["a"], fs["cat"]]))
+model = (Workflow().set_reader(InMemoryReader(rows))
+         .set_result_features(pred).train())
+mdir = tempfile.mkdtemp(prefix="ci_cold_start_")
+model.save(mdir, overwrite=True, aot=True, aot_buckets=[1, 2, 4])
+
+child = '''
+import json, sys
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.workflow.workflow import WorkflowModel
+model = WorkflowModel.load(sys.argv[1])
+fn = model.score_fn(pad_to=[1, 2, 4])
+with obs.retrace_budget(0):   # raises on ANY trace/lower/compile event
+    report = fn.warm([1, 2, 4])
+    out = fn.batch([{"a": 0.5, "cat": "a"}, {"a": -0.25, "cat": "b"}])
+hyd = obs.default_registry().find("aot_hydrated_total", labels={"lane": "device"})
+print("COLDJSON=" + json.dumps({
+    "status": report["aot"]["status"], "programs": report["programs"],
+    "hydrated_counter": hyd.value if hyd is not None else 0,
+    "n_results": len([r for r in out if r])}))
+'''
+proc = subprocess.run([sys.executable, "-c", child, mdir],
+                      capture_output=True, text=True, timeout=300)
+assert proc.returncode == 0, proc.stderr[-2000:]
+rep = json.loads(next(line for line in proc.stdout.splitlines()
+                      if line.startswith("COLDJSON="))[len("COLDJSON="):])
+assert rep["status"] == "hydrated", rep
+assert rep["hydrated_counter"] > 0, rep
+assert rep["programs"] == 0, rep   # zero compiles: retrace_budget(0) held
+assert rep["n_results"] == 2, rep
+print(f"cold-start smoke ok: hydrated {rep['hydrated_counter']:.0f} "
+      f"executables, 2-row score, zero compile events in a fresh process")
+PY
+
 echo "== bench regression gate =="
 # Every scalar in the bench summary is gated, including the streaming_score
 # input-pipeline lane (streaming_score_rows_per_sec, streaming_pipeline_speedup,

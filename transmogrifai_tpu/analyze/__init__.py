@@ -15,7 +15,7 @@ Wired into `Workflow.train` (errors raise at plan time; `strict=False`
 downgrades), the `op lint` CLI subcommand, and `WorkflowModel.save` (report
 stamped into the model bundle).
 """
-from .analyzer import analyze_model, analyze_plan
+from .analyzer import analyze_model, analyze_plan, plan_fingerprint
 from .diagnostics import (
     AnalysisReport,
     Diagnostic,
@@ -28,5 +28,5 @@ from .rules import PASSES, RULES, PlanContext, check_dag_uniqueness
 __all__ = [
     "AnalysisReport", "Diagnostic", "PASSES", "PlanAnalysisError",
     "PlanContext", "RULES", "RuleInfo", "SEVERITIES", "analyze_model",
-    "analyze_plan", "check_dag_uniqueness",
+    "analyze_plan", "check_dag_uniqueness", "plan_fingerprint",
 ]

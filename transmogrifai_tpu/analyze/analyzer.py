@@ -70,3 +70,27 @@ def analyze_model(model) -> AnalysisReport:
     dag = [[s] for s in model.stages]
     return analyze_plan(model.result_features, dag,
                         raw_features=model.raw_features, fitted=True)
+
+
+def plan_fingerprint(stages: Sequence) -> str:
+    """Content fingerprint of a fitted transform plan: sha256 over every
+    stage's trace-fingerprint entry — the SAME per-stage identity the
+    retrace-hazard rules (OP201-203) and the fused-run program cache key on,
+    so the AOT artifact store (serve/aot.py), the lint verdicts, and the
+    runtime caches can never disagree about what "the same plan" means. Any
+    change to a stage's fitted params (an edited npz, a resave with different
+    weights) changes the fingerprint and invalidates the artifacts.
+
+    Raises TypeError when any stage has no stable trace fingerprint (OP201
+    territory: identity-less callables in params) — such plans cannot key an
+    artifact cache and must not export one.
+    """
+    import hashlib
+
+    from ..workflow.workflow import stage_fingerprint_entry
+
+    h = hashlib.sha256()
+    for s in stages:
+        h.update(stage_fingerprint_entry(s).encode("utf-8"))
+        h.update(b"\x00")
+    return h.hexdigest()

@@ -359,6 +359,11 @@ def _cmd_serve(argv) -> int:
     ap.add_argument("--no-warm", action="store_true",
                     help="skip the admission bucket pre-warm (first "
                          "dispatches then pay compiles)")
+    ap.add_argument("--no-aot", action="store_true",
+                    help="ignore bundle AOT artifacts at admission and "
+                         "force the compile warm path (default: hydrate "
+                         "compatible pre-compiled executables — a cold "
+                         "daemon process then reaches first score in ms)")
     ap.add_argument("--quarantine-dir", default=None, metavar="DIR",
                     help="root for per-model poison-row sidecars (default: "
                          "a fresh temp dir; 'off' disables quarantine — a "
@@ -397,15 +402,18 @@ def _cmd_serve(argv) -> int:
         max_models=max_models, max_wait_ms=max_wait_ms, max_batch=max_batch,
         bucket_floor=bucket_floor,
         backend={"auto": "auto", "cpu": "cpu", "device": None}[args.backend],
-        mesh=mesh, warm=not args.no_warm, quarantine_root=quarantine_root)
+        mesh=mesh, warm=not args.no_warm, quarantine_root=quarantine_root,
+        aot=not args.no_aot)
     names = []
     for spec in args.model:
         name, path = _parse_model_spec(spec)
         entry = daemon.admit(path, name=name)
         names.append(entry.name)
         warm = entry.warm_report or {}
+        aot = (warm.get("aot") or {})
         print(f"op serve: admitted {entry.name} from {path} "
               f"(buckets={warm.get('buckets')}, "
+              f"aot={aot.get('status', 'off')}, "
               f"warm {warm.get('wall_s', 0)}s)", file=sys.stderr, flush=True)
 
     server = make_http_server(daemon, host=args.host, port=args.port)
@@ -481,7 +489,22 @@ def _cmd_warmup(argv) -> int:
                     choices=["auto", "cpu", "device"],
                     help="serving lane(s) to warm (default auto = every "
                          "lane the router can choose)")
+    ap.add_argument("--export-aot", action="store_true",
+                    help="with --serving DIR: WRITE the AOT deploy artifact "
+                         "set into the bundle (DIR/aot/) — pre-compiled "
+                         "serving executables per lane x pow2 bucket plus "
+                         "the measured routing windows, keyed by the plan's "
+                         "trace fingerprints + a device/jax compatibility "
+                         "stamp. Compatible replicas then load + first-score "
+                         "in milliseconds (docs/performance.md cold start)")
+    ap.add_argument("--no-aot", action="store_true",
+                    help="with --serving DIR: skip consulting the bundle's "
+                         "AOT artifacts and force the compile warm path")
     args = ap.parse_args(argv)
+    if args.export_aot and args.serving is None:
+        print("op warmup: --export-aot requires --serving MODEL_DIR",
+              file=sys.stderr)
+        return 2
     if args.serving is not None:
         import json
         from transmogrifai_tpu.workflow.warmup import warm_serving
@@ -496,7 +519,9 @@ def _cmd_warmup(argv) -> int:
             max_batch=args.serving_max_batch,
             backend={"auto": "auto", "cpu": "cpu",
                      "device": None}[args.serving_backend],
-            mesh=mesh, log=lambda m: print(m, file=sys.stderr))
+            mesh=mesh, log=lambda m: print(m, file=sys.stderr),
+            aot=(False if args.no_aot else "auto"),
+            export_aot=args.export_aot)
         print(json.dumps(report))
         return 0
     from transmogrifai_tpu.workflow.warmup import _PROBLEMS, warmup_matrix

@@ -1,3 +1,4 @@
+from .aot import export_aot, hydrate, read_index
 from .batcher import MicroBatcher
 from .daemon import (
     DaemonClient,
@@ -10,6 +11,6 @@ from .scoring import ScoreFunction, score_function
 
 __all__ = [
     "DaemonClient", "MicroBatcher", "ScoreFunction", "ServingDaemon",
-    "fingerprint_model_dir", "make_http_server", "score_function",
-    "serving_buckets",
+    "export_aot", "fingerprint_model_dir", "hydrate", "make_http_server",
+    "read_index", "score_function", "serving_buckets",
 ]

@@ -1,0 +1,570 @@
+"""AOT deploy artifacts: millisecond cold start for model load + first score.
+
+The serving daemon (PR 7) made STEADY-STATE serving compile-free, but a fresh
+PROCESS still pays seconds of trace+lower+compile per model shape before its
+first score — which makes fleet rollout of N autoscaled replicas O(minutes)
+each. This module extends the saved model bundle with an ahead-of-time
+artifact set so a cold process reaches its first score in milliseconds:
+
+* **Tier 1 — exact executables.** Every fused device step of the serving
+  `LocalPlan`, for every routable lane (device / CPU failover) x pow2 pad_to
+  bucket, is lowered, compiled, and serialized with
+  `jax.experimental.serialize_executable` into `<model_dir>/aot/`. A fresh
+  process deserializes (~tens of ms for a whole ladder) and scores with ZERO
+  XLA work — no trace, no lower, no compile (`retrace_budget(0)`-clean from
+  the very first request).
+* **Tier 2 — persistent-cache priming.** Export runs with the persistent
+  compilation cache enabled, so every exported program is also a cache entry:
+  a process that cannot use the exact executables (e.g. jax upgraded) pays
+  tracing + cache reads instead of full compiles.
+* **Tier 3 — the warm path.** Anything stale or missing degrades to today's
+  `ScoreFunction.warm` compile loop with a structured span event and an
+  `aot_fallback_total{reason}` counter — never an error.
+
+Artifacts are keyed by the SAME per-stage trace fingerprints the analyzer's
+retrace rules (OP201-203) and the fused-run program cache use
+(`analyze.plan_fingerprint`), plus a compatibility stamp (jax + jaxlib
+versions, backend platform, device kind, device count, package code hash).
+An edited
+npz, a resave with different weights, a jax upgrade, or a different
+accelerator all change the key and fall back gracefully.
+
+Trust note: tier-1 blobs deserialize via pickle (jax's serialize_executable
+wire format). Load artifacts only from bundles you would already trust to
+`WorkflowModel.load` — a model bundle is code, not data.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import TYPE_CHECKING, Optional, Sequence
+
+from .. import obs
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..workflow.workflow import WorkflowModel
+    from .scoring import ScoreFunction
+
+#: bundle subdirectory holding the artifact set
+AOT_DIR = "aot"
+#: the artifact index (fingerprint, stamp, entries, lane windows)
+AOT_INDEX = "aot_index.json"
+AOT_VERSION = 1
+
+#: bounded label set for aot_fallback_total (cardinality hygiene)
+_FALLBACK_REASONS = ("absent", "corrupt_index", "mesh", "stamp",
+                     "fingerprint", "deserialize", "unfingerprintable",
+                     "error")
+
+_CODE_FP: Optional[str] = None
+
+
+def code_fingerprint() -> str:
+    """sha256 over the CONTENT bytes of every package .py file (not mtimes —
+    deploy replicas check out identical code with arbitrary timestamps, and
+    the stamp must match across them). A code edit changes the hash and
+    invalidates every artifact: a tier-1 blob silently replaying old stage
+    semantics is the one failure mode this module must never have."""
+    global _CODE_FP
+    if _CODE_FP is not None:
+        return _CODE_FP
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            try:
+                h.update(os.path.relpath(p, root).encode("utf-8"))
+                with open(p, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                pass
+    _CODE_FP = h.hexdigest()[:16]
+    return _CODE_FP
+
+
+def compat_stamp() -> dict:
+    """The environment an exact executable is valid in. Serialized compiled
+    programs are bound to (jax/jaxlib wire version, backend, device kind) and
+    to the package source that built the plan; device COUNT matters because a
+    program compiled in a 1-device process carries a different device
+    assignment than one from a forced-8-device test env."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = jaxlib.__version__
+    except Exception:  # pragma: no cover — jaxlib always ships with jax
+        jaxlib_version = ""
+    try:
+        dev = jax.devices()[0]
+        platform, kind = dev.platform, getattr(dev, "device_kind", "")
+    except Exception:  # pragma: no cover — no live backend
+        platform, kind = "unknown", ""
+    return {
+        "jax": jax.__version__,
+        # the wire format of a serialized executable is versioned by
+        # jaxlib/XLA, which upgrades independently of the pure-python jax
+        # package — same jax + newer jaxlib must still read as stale
+        "jaxlib": jaxlib_version,
+        "platform": platform,
+        "device_kind": str(kind),
+        "device_count": int(jax.device_count()),
+        "code": code_fingerprint(),
+    }
+
+
+def _stamp_mismatch(stamp: dict) -> Optional[str]:
+    """First mismatched stamp field against the live process, or None."""
+    live = compat_stamp()
+    for k in ("jax", "jaxlib", "platform", "device_kind", "device_count",
+              "code"):
+        if stamp.get(k) != live.get(k):
+            return k
+    return None
+
+
+def index_path(model_dir: str) -> str:
+    return os.path.join(model_dir, AOT_DIR, AOT_INDEX)
+
+
+def read_index(model_dir: str) -> Optional[dict]:
+    """The bundle's artifact index, or None when absent/unreadable."""
+    try:
+        with open(index_path(model_dir)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _lanes_of(fn: "ScoreFunction") -> list[Optional[str]]:
+    """THE routable-lane derivation: `ScoreFunction.warm`, export, and
+    hydrate all call this one helper, so what warm compiles, what export
+    serializes, and what hydration judges coverage against can never
+    drift apart."""
+    import jax
+
+    if fn._backend == "auto":
+        lanes: list[Optional[str]] = [None]
+        if jax.devices()[0].platform != "cpu":
+            lanes.append("cpu")
+        return lanes
+    return [fn._backend]
+
+
+def _blob_name(lane_label: str, bucket: int, step: int) -> str:
+    return f"{lane_label}_b{bucket}_s{step}.exec"
+
+
+def note_fallback(reason: str, detail: str = "", log=None, *,
+                  count_metric: bool = True) -> None:
+    """ONE fallback occurrence: counter + span event + optional log — the
+    single emission site every degrade path (hydrate, per-blob deserialize,
+    warm's validation retirement) goes through, so the metric name, help
+    text, and reason vocabulary cannot drift apart. `count_metric=False`
+    keeps the event/log but skips the counter for callers whose occurrences
+    were already counted one by one (per-blob deserialize failures)."""
+    if reason not in _FALLBACK_REASONS:
+        reason = "error"
+    if count_metric:
+        obs.default_registry().counter(
+            "aot_fallback_total",
+            help="AOT hydration attempts that degraded to the warm compile path",
+            labels={"reason": reason}).inc()
+    obs.add_event("aot:fallback", reason=reason, detail=detail[:200])
+    if log is not None:
+        log(f"serving aot: fallback ({reason}{': ' + detail if detail else ''})")
+
+
+def _fallback(reason: str, detail: str = "", log=None, *,
+              count_metric: bool = True) -> dict:
+    note_fallback(reason, detail, log, count_metric=count_metric)
+    # "covered" is a list of [lane_label, bucket] pairs (NOT a set): the
+    # report is part of the public serve API and must json.dumps cleanly
+    return {"status": "fallback", "reason": reason, "detail": detail,
+            "buckets_hydrated": [], "executables": 0, "covered": []}
+
+
+# --- export ---------------------------------------------------------------------------
+def publish_aot(path: str, staging: str) -> None:
+    """Swap a staged export into place as `<path>/aot/` — the LAST step of
+    an artifact publish, once the bundle it belongs to is durable (for
+    `WorkflowModel.save(aot=True)`: after the manifest's atomic replace).
+    Until this runs, the previous artifact generation stays intact."""
+    import shutil
+
+    adir = os.path.join(path, AOT_DIR)
+    shutil.rmtree(adir, ignore_errors=True)
+    os.replace(staging, adir)
+
+
+def export_aot(model: "WorkflowModel", path: str, *,
+               buckets: Optional[Sequence[int]] = None, floor: int = 1,
+               max_batch: int = 256, backend: Optional[str] = "auto",
+               log=None, _defer_publish: bool = False) -> dict:
+    """Write the AOT artifact set for `model` into `<path>/aot/`.
+
+    For every routable serving lane x pow2 pad_to bucket, every fused device
+    step of the serving plan is lowered+compiled at the bucket's exact
+    shapes (the same synthetic placeholder buffers `warm` uses — shapes
+    depend only on the fitted schema and the row count, never on values) and
+    serialized. The compiled programs are installed into the handle
+    in-process and each bucket gets one timed pass, so the report carries
+    measured per-lane (latency, rows) windows — the routing-crossover seed a
+    hydrated replica starts from. Export also primes the persistent
+    compilation cache (tier 2).
+
+    The artifact set is built in a staging dir and swapped into place only
+    when complete (`publish_aot`) — a crash mid-export leaves any previous
+    generation untouched. `_defer_publish=True` (the `save(aot=True)` path)
+    skips the swap and returns the staging dir under "staging": the caller
+    publishes after its own durability point, so the old bundle's manifest
+    and its matching artifacts never part ways.
+
+    Returns {status, fingerprint, stamp, lanes, buckets, executables,
+    bytes, lane_windows, wall_s}. Plans whose stages have no stable trace
+    fingerprint (OP201) cannot key an artifact cache: status "skipped",
+    nothing written (an immediate-publish skip still sweeps the previous
+    generation — the new plan invalidated it).
+    """
+    import shutil
+
+    import jax
+    from jax.experimental import serialize_executable as _se
+
+    from ..analyze import plan_fingerprint
+    from ..utils.compile_cache import enable_compile_cache
+    from .daemon import resolve_buckets
+    from .scoring import _placeholder
+
+    t0 = time.perf_counter()
+    try:
+        fingerprint = plan_fingerprint(model.stages)
+    except TypeError as e:
+        if not _defer_publish:
+            # the new plan cannot carry artifacts, so any previous
+            # generation is stale; a deferring caller sweeps at its own
+            # durability point instead
+            shutil.rmtree(os.path.join(path, AOT_DIR), ignore_errors=True)
+        if log is not None:
+            log(f"serving aot: export skipped (unfingerprintable plan: {e})")
+        return {"status": "skipped", "reason": "unfingerprintable",
+                "detail": str(e)[:200]}
+    enable_compile_cache()  # tier 2: every export is also a cache entry
+    buckets = resolve_buckets(buckets, floor, max_batch)
+    fn = model.score_fn(pad_to=buckets, backend=backend)
+    adir = os.path.join(path, f".{AOT_DIR}.staging.{os.getpid()}")
+    # sweep staging debris from CRASHED earlier exports: only dirs whose
+    # owning pid is gone — a concurrent live export into the same bundle
+    # keeps its staging (the pid suffix exists to tell generations apart)
+    try:
+        for fname in os.listdir(path):
+            if not fname.startswith(f".{AOT_DIR}.staging."):
+                continue
+            try:
+                owner = int(fname.rsplit(".", 1)[-1])
+                if owner != os.getpid():
+                    os.kill(owner, 0)
+                    continue  # owner alive: not debris
+            except ValueError:
+                pass  # malformed suffix: treat as debris
+            except PermissionError:
+                continue  # pid exists under another uid: owner alive
+            except OSError:
+                pass  # no such pid: debris
+            shutil.rmtree(os.path.join(path, fname), ignore_errors=True)
+    except OSError:
+        pass
+    os.makedirs(adir, exist_ok=True)
+    rec = {f.name: _placeholder(f.kind) for f in fn._predictors}
+    entries: list[dict] = []
+    skipped: dict[tuple, str] = {}  # (lane_label, bucket) -> reason
+    total_bytes = 0
+    lanes = _lanes_of(fn)
+    try:
+        for lane in lanes:
+            plan = fn._plan_for(lane)
+            label = lane or "device"
+            for b in buckets:
+                table = fn._build_table([dict(rec)] * b)
+
+                def on_device(idx, jit_fn, args, _label=label, _b=b,
+                              _plan=plan):
+                    nonlocal total_bytes
+                    comp = jit_fn.lower(args).compile()
+                    blob = pickle.dumps(_se.serialize(comp))
+                    # round-trip check: some programs serialize but cannot
+                    # be relinked (XLA-CPU "Symbols not found" on certain
+                    # tiny-shape fusions, seen on save->load->resave
+                    # programs). A blob that cannot round-trip HERE can
+                    # never hydrate anywhere — it must not be advertised,
+                    # or a compatible replica reads "hydrated" in the index
+                    # yet degrades at admission.
+                    try:
+                        _se.deserialize_and_load(*pickle.loads(blob))
+                    except Exception as ex:  # noqa: BLE001 — skip the bucket
+                        skipped[(_label, _b)] = (
+                            f"step {idx}: {type(ex).__name__}: {ex}"[:200])
+                    else:
+                        fname = _blob_name(_label, _b, idx)
+                        with open(os.path.join(adir, fname), "wb") as fh:
+                            fh.write(blob)
+                        entries.append({"lane": _label, "bucket": _b,
+                                        "step": idx, "file": fname,
+                                        "bytes": len(blob)})
+                        total_bytes += len(blob)
+                    # the freshly compiled program IS the hydrated
+                    # executable: install it so the timed passes below (and
+                    # any scoring this process does next) run the exact
+                    # tier-1 path
+                    _plan.aot_dispatch(idx, on_fallback=fn._aot_on_fallback
+                                       ).install(_b, comp)
+                    return comp(args)
+
+                out = plan.walk_device_steps(table, on_device)
+                jax.block_until_ready(
+                    [c.values for c in out.values() if c.is_device])
+                if log is not None:
+                    log(f"serving aot: exported lane={label} rows={b}")
+            # one steady timed pass per bucket seeds the measured routing
+            # windows the bundle ships (satellite: a hydrated replica's
+            # auto_threshold starts measured, not from the cold constant)
+            for b in buckets:
+                fn._timed_run(plan, fn._build_table([dict(rec)] * b), lane)
+        if skipped:
+            # a (lane, bucket) needs EVERY step's blob to hydrate: sweep the
+            # sibling blobs of any skipped pair so the index stays an exact
+            # statement of what a replica can load
+            kept = []
+            for e in entries:
+                if (e["lane"], e["bucket"]) in skipped:
+                    total_bytes -= e["bytes"]
+                    try:
+                        os.unlink(os.path.join(adir, e["file"]))
+                    except OSError:
+                        pass
+                else:
+                    kept.append(e)
+            entries = kept
+            if log is not None:
+                for (lab, b), why in sorted(skipped.items()):
+                    log(f"serving aot: export skipped lane={lab} rows={b} "
+                        f"(blob failed round-trip: {why})")
+        index = {
+            "version": AOT_VERSION,
+            "model_uid": getattr(model, "uid", None),
+            "plan_fingerprint": fingerprint,
+            "stamp": compat_stamp(),
+            "backend": backend,
+            "lanes": [lane or "device" for lane in lanes],
+            "buckets": list(buckets),
+            "entries": entries,
+            "skipped": [{"lane": lab, "bucket": b, "detail": why}
+                        for (lab, b), why in sorted(skipped.items())],
+            "lane_windows": fn.lane_windows(),
+        }
+        with open(os.path.join(adir, AOT_INDEX), "w") as fh:
+            json.dump(index, fh, indent=1)
+    except BaseException:
+        # a failed export must not leave staging debris in the bundle;
+        # the previous generation (if any) was never touched
+        shutil.rmtree(adir, ignore_errors=True)
+        raise
+    if not _defer_publish:
+        publish_aot(path, adir)
+    wall = time.perf_counter() - t0
+    reg = obs.default_registry()
+    reg.counter("aot_exports_total",
+                help="AOT artifact sets exported").inc()
+    reg.histogram("aot_export_seconds",
+                  help="wall time of AOT artifact export").observe(wall)
+    obs.add_event("aot:export", fingerprint=fingerprint[:16],
+                  executables=len(entries), skipped=len(skipped),
+                  bytes=total_bytes, wall_s=round(wall, 3))
+    report = {"status": "exported", "fingerprint": fingerprint,
+              "stamp": index["stamp"], "lanes": index["lanes"],
+              "buckets": list(buckets), "executables": len(entries),
+              "skipped": index["skipped"], "bytes": total_bytes,
+              "lane_windows": index["lane_windows"],
+              "wall_s": round(wall, 3)}
+    if _defer_publish:
+        report["staging"] = adir
+    return report
+
+
+# --- hydrate --------------------------------------------------------------------------
+def hydrate(fn: "ScoreFunction", model_dir: Optional[str] = None, *,
+            buckets: Optional[Sequence[int]] = None, log=None) -> dict:
+    """Install the bundle's AOT executables into a serving handle instead of
+    tracing+compiling. Never raises: every failure class (no artifacts,
+    stamp or fingerprint mismatch, corrupt blob) returns a structured
+    fallback report and increments `aot_fallback_total{reason}` — the caller
+    (`ScoreFunction.warm`) compiles whatever hydration did not cover.
+
+    Returns {status: hydrated|partial|fallback, buckets_hydrated,
+    executables, covered: {(lane_label, bucket), ...}, wall_s, ...}; also
+    seeds the handle's routing-crossover windows from the bundle when the
+    handle has no measurements of its own yet.
+    """
+    t0 = time.perf_counter()
+    model_dir = model_dir or getattr(fn._model, "_bundle_path", None)
+    if model_dir is None:
+        return _fallback("absent", "handle's model has no bundle path", log=log)
+    if fn._mesh is not None:
+        # exported programs are single-device; sharded handles keep the
+        # compile path (a partitioned program is a different executable)
+        return _fallback("mesh", log=log)
+    if not os.path.isdir(os.path.join(model_dir, AOT_DIR)):
+        return _fallback("absent", log=log)
+    index = read_index(model_dir)
+    if index is None or not isinstance(index.get("entries"), list):
+        return _fallback("corrupt_index", log=log)
+    mismatch = _stamp_mismatch(index.get("stamp") or {})
+    if mismatch is not None:
+        return _fallback("stamp", mismatch, log=log)
+    from ..analyze import plan_fingerprint
+
+    try:
+        live_fp = plan_fingerprint(fn._model.stages)
+    except TypeError as e:
+        return _fallback("unfingerprintable", str(e)[:200], log=log)
+    if live_fp != index.get("plan_fingerprint"):
+        return _fallback("fingerprint",
+                         "artifacts were built for a different plan", log=log)
+
+    from jax.experimental import serialize_executable as _se
+
+    want_buckets = (sorted({int(b) for b in buckets}) if buckets
+                    else [int(b) for b in index.get("buckets", [])])
+    by_key = {(e["lane"], int(e["bucket"]), int(e["step"])): e
+              for e in index["entries"]}
+    lanes = _lanes_of(fn)
+    # artifacts are keyed by lane LABELS, but validity is decided by the
+    # compiled TARGET: the auto backend's primary lane is labeled "device"
+    # while an explicit backend names its platform ("cpu"), yet on a host
+    # whose default platform IS cpu both label the same compiled programs.
+    # The stamp check above pinned the live default platform to the export
+    # host's, so "device" on either side resolves to stamp["platform"] and
+    # an explicit-cpu handle hydrates an auto export (and vice versa).
+    stamp_platform = str((index.get("stamp") or {}).get("platform", ""))
+
+    def _target(lbl: str) -> str:
+        return stamp_platform if lbl == "device" else lbl
+
+    index_labels = [str(lbl) for lbl in index.get("lanes", [])]
+    by_target: dict[str, str] = {}
+    for lbl in index_labels:
+        by_target.setdefault(_target(lbl), lbl)
+    covered: set = set()
+    loaded_by_lane: dict[str, int] = {}
+    installed: list = []  # (plan, bucket) pairs to unwind on a late error
+    n_loaded = 0
+    n_failed = 0
+    try:
+        for lane in lanes:
+            label = lane or "device"
+            src = (label if label in index_labels
+                   else by_target.get(_target(label)))
+            if src is None:
+                continue
+            plan = fn._plan_for(lane)
+            dsteps = plan.device_step_indices()
+            for b in want_buckets:
+                loaded: list = []
+                ok = True
+                for idx in dsteps:
+                    e = by_key.get((src, b, idx))
+                    if e is None:
+                        ok = False
+                        break
+                    try:
+                        with open(os.path.join(model_dir, AOT_DIR,
+                                               e["file"]), "rb") as fh:
+                            loaded.append(
+                                (idx, _se.deserialize_and_load(
+                                    *pickle.loads(fh.read()))))
+                    except Exception as ex:  # noqa: BLE001 — degrade per bucket
+                        n_failed += 1
+                        note_fallback("deserialize", f"{e['file']}: {ex}")
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                for idx, ex in loaded:
+                    plan.aot_dispatch(
+                        idx, on_fallback=fn._aot_on_fallback).install(b, ex)
+                installed.append((plan, b))
+                n_loaded += len(loaded)
+                loaded_by_lane[label] = (loaded_by_lane.get(label, 0)
+                                         + len(loaded))
+                covered.add((label, b))
+    except Exception as e:  # noqa: BLE001 — hydration must never kill serving
+        # the report says nothing is covered, so nothing may STAY installed:
+        # warm's compile path would otherwise dispatch through unvalidated
+        # blobs (outside the admission guard, where an async failure raises
+        # out of admission) — retire every bucket installed before the error
+        for plan_, b_ in installed:
+            try:
+                plan_.retire_aot(b_)
+            except Exception:  # noqa: BLE001 — unwind is best-effort
+                pass
+        return _fallback("error", f"{type(e).__name__}: {e}"[:200], log=log)
+
+    # routing-crossover seed: a hydrated replica starts from the bundle's
+    # measured per-lane windows instead of the cold static constant (only
+    # when the handle has no live measurements of its own)
+    if index.get("lane_windows") and not fn._lane_obs:
+        fn.seed_lane_windows(index["lane_windows"])
+
+    # coverage is judged against the LIVE routable lanes, not the lanes the
+    # index happens to carry: a bundle exported for one lane admitted on a
+    # host that routes two must read "partial" (warm still compiles the
+    # missing lane), and a bucket counts as hydrated only when EVERY
+    # routable lane loaded it — rollout tooling must never be told a bucket
+    # is covered while device-lane dispatches there pay compiles
+    expected_labels = [lane or "device" for lane in lanes]
+    want = {(lab, b) for lab in expected_labels for b in want_buckets}
+    hydrated_buckets = sorted(
+        b for b in want_buckets
+        if expected_labels and all((lab, b) in covered
+                                   for lab in expected_labels))
+    if covered and want and covered >= want:
+        status = "hydrated"
+    elif covered:
+        status = "partial"
+    elif n_failed:
+        # every per-blob failure already ticked aot_fallback_total{reason=
+        # "deserialize"} in the loop above — emit the event, skip the counter
+        return _fallback("deserialize", "no bucket fully hydrated", log=log,
+                         count_metric=False)
+    else:
+        return _fallback("absent", "no bucket fully hydrated", log=log)
+    wall = time.perf_counter() - t0
+    reg = obs.default_registry()
+    for label, n in sorted(loaded_by_lane.items()):
+        reg.counter(
+            "aot_hydrated_total",
+            help="AOT executables installed from bundle artifacts",
+            labels={"lane": label}).inc(n)
+    reg.histogram("aot_hydrate_seconds",
+                  help="wall time of AOT artifact hydration").observe(wall)
+    obs.add_event("aot:hydrate", status=status,
+                  buckets=len(hydrated_buckets), executables=n_loaded,
+                  wall_s=round(wall, 4))
+    if log is not None:
+        log(f"serving aot: {status} ({n_loaded} executables, "
+            f"buckets {hydrated_buckets}, {wall * 1e3:.1f} ms)")
+    return {"status": status, "fingerprint": live_fp,
+            "buckets_hydrated": hydrated_buckets,
+            "lanes": sorted({lab for lab, _ in covered}),
+            "executables": n_loaded,
+            # list of [lane_label, bucket] pairs, json-serializable (the
+            # report is part of the public serve API)
+            "covered": sorted([lab, b] for lab, b in covered),
+            "wall_s": round(wall, 4)}

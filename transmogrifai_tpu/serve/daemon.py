@@ -70,6 +70,17 @@ def fingerprint_model_dir(path: str) -> str:
     return h.hexdigest()
 
 
+def resolve_buckets(buckets=None, floor: int = 1,
+                    max_batch: int = 256) -> list[int]:
+    """THE bucket-ladder resolution: an explicit ladder is sorted+deduped,
+    else `serving_buckets(floor, max_batch)`. Shared by admission warm,
+    `op warmup --serving`, and AOT export so the three can never derive
+    different ladders for the same knobs."""
+    if buckets:
+        return sorted({int(b) for b in buckets})
+    return serving_buckets(floor, max_batch)
+
+
 def serving_buckets(floor: int = 1, max_batch: int = 256) -> list[int]:
     """The pow2 pad_to ladder serving coalesces into: floor, 2*floor, ...,
     max_batch (both ends rounded up to powers of two — `pow2_bucket` is the
@@ -111,11 +122,19 @@ class ModelEntry:
         wait_h = obs.default_registry().find(
             "serve_queue_wait_seconds", labels={"model": self.name})
         wait_p50 = wait_h.percentile(50) if wait_h is not None else None
+        aot = self.score_fn.aot_status()
         return {
             "name": self.name,
             "fingerprint": self.fingerprint,
             "path": self.path,
             "breaker": self.score_fn.breaker_state(),
+            # rollout tooling verifies a replica actually hydrated: status
+            # ("hydrated"/"partial"/"fallback"), which pow2 buckets came
+            # from artifacts, and how many dispatches missed them since
+            "aot": ({"status": aot.get("status"),
+                     "buckets_hydrated": aot.get("buckets_hydrated", []),
+                     "fallback_compiles": aot.get("fallback_compiles", 0)}
+                    if aot else None),
             "auto_threshold": self.score_fn.auto_threshold(),
             "queue_wait_p50_ms": (round(wait_p50 * 1e3, 3)
                                   if wait_p50 is not None else None),
@@ -139,7 +158,7 @@ class ServingDaemon:
                  max_batch: int = 256, bucket_floor: int = 1,
                  backend: Optional[str] = "auto", mesh=None, policy=None,
                  warm: bool = True, prefetch: int = 2,
-                 quarantine_root: Optional[str] = "auto"):
+                 quarantine_root: Optional[str] = "auto", aot: bool = True):
         if max_models < 1:
             raise ValueError(f"max_models must be >= 1, got {max_models}")
         self._max_models = int(max_models)
@@ -150,6 +169,10 @@ class ServingDaemon:
         self._mesh = mesh
         self._policy = policy
         self._warm = bool(warm)
+        #: consult the bundle's AOT artifact store at admission (serve/
+        #: aot.py): compatible pre-compiled executables hydrate in
+        #: milliseconds with zero XLA work; False forces the compile path
+        self._aot = "auto" if aot else False
         self._prefetch = int(prefetch)
         #: "auto" = a fresh temp dir per daemon: poison rows are quarantined
         #: (request keeps flowing, bad rows come back None) instead of
@@ -216,7 +239,15 @@ class ServingDaemon:
                 fn = score_function(
                     model, pad_to=self._buckets, backend=self._backend,
                     mesh=self._mesh, policy=policy, model_label=label)
-                warm_report = fn.warm(self._buckets) if self._warm else None
+                # the SAME ladder-warm helper `op warmup --serving` uses:
+                # consult the bundle's AOT artifacts first, compile only
+                # what hydration did not cover — a cold DAEMON PROCESS
+                # admitting an AOT bundle reaches first score in ms
+                from ..workflow.warmup import warm_serving_handle
+
+                warm_report = (warm_serving_handle(
+                    fn, buckets=self._buckets, aot=self._aot)
+                    if self._warm else None)
                 batcher = MicroBatcher(
                     fn, max_batch=self._max_batch,
                     max_wait_ms=self._max_wait_ms, prefetch=self._prefetch,

@@ -35,6 +35,59 @@ from .. import obs
 from ..types import Column
 
 
+class _AotDispatch:
+    """Per-fused-step AOT executable table: row count -> a pre-loaded
+    compiled executable (serve/aot.py hydration). Shapes flowing into a
+    serving plan are fully determined by the padded row count (widths come
+    from the fitted schema), so the dispatch key is just `len(rows)` — one
+    dict probe on the hot path, no aval hashing. Any miss (an unwarmed
+    shape) or a loaded executable that fails at call time falls back to the
+    wrapped jit program — correctness is never at stake, only compile time —
+    and reports through `on_fallback` so the handle's `aot_fallback_compiles`
+    counter stays honest."""
+
+    __slots__ = ("jit", "by_rows", "on_fallback")
+
+    def __init__(self, jit_fn: Callable, on_fallback: Optional[Callable] = None):
+        self.jit = jit_fn
+        self.by_rows: dict[int, object] = {}
+        self.on_fallback = on_fallback
+
+    def _rows_of(self, cols: tuple) -> int:
+        return len(cols[0]) if cols else 0
+
+    def install(self, rows: int, loaded) -> None:
+        self.by_rows[int(rows)] = loaded
+
+    def mark_warmed(self, rows: int) -> None:
+        """Record that `rows` was warmed through the JIT path (hydration did
+        not cover it and `warm` compiled it instead): dispatches at that
+        shape are compile-free steady state, NOT misses — they must not tick
+        the fallback counter and read as a limping replica."""
+        self.by_rows.setdefault(int(rows), self.jit)
+
+    def __call__(self, cols: tuple) -> tuple:
+        n = self._rows_of(cols)
+        ex = self.by_rows.get(n)
+        if ex is None:
+            if self.on_fallback is not None:
+                self.on_fallback(n)
+            return self.jit(cols)
+        if ex is self.jit:  # warmed-via-compile shape: normal jit dispatch
+            return self.jit(cols)
+        try:
+            return ex(cols)
+        except Exception:  # noqa: BLE001 — any AOT failure degrades to jit
+            # a deserialized executable unusable at call time (avals drifted,
+            # backend refused it) is permanently retired for this shape and
+            # REPLACED by the jit path, so the retirement counts exactly once
+            # — not on every subsequent dispatch at the shape
+            self.by_rows[n] = self.jit
+            if self.on_fallback is not None:
+                self.on_fallback(n)
+            return self.jit(cols)
+
+
 class LocalPlan:
     """Compiled serving executor over a fitted stage list.
 
@@ -134,6 +187,107 @@ class LocalPlan:
                 else:
                     _, fn, ext_srcs, out_sis = step
                     outs = fn(tuple(get(s) for s in ext_srcs))
+                    for si, c in zip(out_sis, outs):
+                        mid[si] = c
+        out = {n: mid[si] for n, si in self._result_slot.items()}
+        for n in self._passthrough:
+            out[n] = raw_cols[n]
+        return out
+
+    # --- AOT hooks (serve/aot.py) -------------------------------------------------------
+    def device_step_indices(self) -> list[int]:
+        """Positions of the fused device steps in execution order — the
+        programs an AOT artifact set serializes (host steps are plain python
+        and need no artifacts)."""
+        return [i for i, step in enumerate(self._steps) if step[0] == "d"]
+
+    def mark_warmed(self, rows: int) -> None:
+        """Tell every AOT-wrapped fused step that `rows` was compiled via the
+        jit path (no-op on steps without a dispatch wrapper — a plan that was
+        never hydrated keeps zero per-call overhead)."""
+        for step in self._steps:
+            if step[0] == "d" and isinstance(step[1], _AotDispatch):
+                step[1].mark_warmed(rows)
+
+    @contextlib.contextmanager
+    def aot_admission_guard(self):
+        """Scope for warm's admission validation passes: on a SYNC backend a
+        call-time executable failure is caught inside `_AotDispatch.__call__`
+        (which retires the shape and invokes `on_fallback`) — during
+        admission that must read as a validation failure, not a hot-path
+        "limping replica" miss. Temporarily reroutes every wrapped step's
+        `on_fallback` into the yielded list; the caller demotes the bucket
+        when it comes back non-empty. Callbacks are restored on exit."""
+        import threading
+
+        disps = [s[1] for s in self._steps
+                 if s[0] == "d" and isinstance(s[1], _AotDispatch)]
+        fails: list[int] = []
+        saved = [d.on_fallback for d in disps]
+        # scope the reroute to THIS thread: warm() may be re-invoked on a
+        # handle that is already serving, and a concurrent request's
+        # fallback must keep reaching the real counter instead of being
+        # misread as a validation failure of the bucket under test
+        owner = threading.get_ident()
+        for d, cb in zip(disps, saved):
+            def rerouted(rows, _cb=cb):
+                if threading.get_ident() == owner:
+                    fails.append(rows)
+                elif _cb is not None:
+                    _cb(rows)
+            d.on_fallback = rerouted
+        try:
+            yield fails
+        finally:
+            for d, cb in zip(disps, saved):
+                d.on_fallback = cb
+
+    def retire_aot(self, rows: int) -> None:
+        """Replace any installed AOT executable at `rows` with the jit path
+        on every fused step: an admission validation pass found a blob that
+        deserialized but cannot run (serve/scoring.py warm). The shape then
+        compiles like an uncovered bucket — correctness over cold-start."""
+        for step in self._steps:
+            if step[0] == "d" and isinstance(step[1], _AotDispatch):
+                step[1].by_rows[int(rows)] = step[1].jit
+
+    def aot_dispatch(self, idx: int,
+                     on_fallback: Optional[Callable] = None) -> _AotDispatch:
+        """Get-or-wrap the fused step at `idx` in an `_AotDispatch` so
+        pre-compiled executables can be installed per row count. Idempotent;
+        the wrapper keeps the original jit program as its fallback."""
+        kind, fn, ext_srcs, out_sis = self._steps[idx]
+        if kind != "d":
+            raise ValueError(f"step {idx} is a host step, not a fused run")
+        if not isinstance(fn, _AotDispatch):
+            fn = _AotDispatch(fn, on_fallback=on_fallback)
+            self._steps[idx] = (kind, fn, ext_srcs, out_sis)
+        elif on_fallback is not None:
+            fn.on_fallback = on_fallback
+        return fn
+
+    def walk_device_steps(self, raw_cols, on_device: Callable):
+        """Execute the plan while delegating every fused device step to
+        `on_device(step_idx, jit_fn, args_tuple) -> outputs` — the export
+        path's capture hook (serve/aot.py lowers+compiles+serializes each
+        step at the bucket's exact shapes). Host steps run normally; runs
+        under the plan's device context exactly like `run`."""
+        mid: dict[int, Column] = {}
+
+        def get(src):
+            tag, ref = src
+            return raw_cols[ref] if tag == "r" else mid[ref]
+
+        with self._ctx():
+            for idx, step in enumerate(self._steps):
+                if step[0] == "h":
+                    _, fn, srcs, si = step
+                    mid[si] = fn([get(s) for s in srcs])
+                else:
+                    _, fn, ext_srcs, out_sis = step
+                    jit_fn = fn.jit if isinstance(fn, _AotDispatch) else fn
+                    args = tuple(get(s) for s in ext_srcs)
+                    outs = on_device(idx, jit_fn, args)
                     for si, c in zip(out_sis, outs):
                         mid[si] = c
         out = {n: mid[si] for n, si in self._result_slot.items()}

@@ -140,6 +140,17 @@ class ScoreFunction:
         #: stream() batch ordinal, monotone across calls on this handle (the
         #: quarantine sidecar's "batch" field must be unambiguous)
         self._stream_counter = itertools.count()
+        #: AOT hydration state (serve/aot.py): set by warm(aot=)/hydrate;
+        #: None until a hydration was attempted. fallback_compiles counts
+        #: dispatches that missed the hydrated executable table and fell
+        #: back to the jit path (an unwarmed shape on the hot path).
+        self._aot: Optional[dict] = None
+        # routing-crossover seed: a model bundle carrying measured per-lane
+        # (latency, rows) windows (WorkflowModel.save) hands them to every
+        # new handle, so auto_threshold() is measured-quality from request #1
+        persisted = getattr(model, "serving_lane_windows", None)
+        if persisted:
+            self.seed_lane_windows(persisted)
 
     def _plan_for(self, backend: Optional[str]):
         key = backend or "default"
@@ -192,6 +203,55 @@ class ScoreFunction:
                     labels={"backend": key[0], "decided": decided})
         c.inc()
         return self._plan_for(backend), backend
+
+    def lane_windows(self) -> dict:
+        """JSON-able snapshot of the handle-local (latency_s, rows) windows
+        feeding `auto_threshold()` — what `WorkflowModel.save` persists into
+        the bundle so a hydrated replica starts with measured routing."""
+        with self._lock:
+            return {lane: [[float(d), int(r)] for d, r in win]
+                    for lane, win in self._lane_lat.items() if win}
+
+    def seed_lane_windows(self, windows: Optional[Mapping]) -> None:
+        """Pre-populate the per-lane latency windows (the inverse of
+        `lane_windows`): a bundle's persisted measurements — or a previous
+        handle's — become this handle's crossover inputs, so routing
+        decisions are measured-quality before the first live dispatch."""
+        if not windows:
+            return
+        with self._lock:
+            for lane, win in windows.items():
+                if not win:
+                    continue
+                lane = str(lane)
+                dq = self._lane_lat.get(lane)
+                if dq is None:
+                    dq = self._lane_lat[lane] = deque(maxlen=_LANE_WINDOW)
+                for d, r in win:
+                    dq.append((float(d), int(r)))
+                self._lane_obs[lane] = self._lane_obs.get(lane, 0) + len(win)
+            self._thr_cache = (None, 0)
+
+    def _aot_on_fallback(self, rows: int) -> None:
+        """A dispatch missed the hydrated executable table (unwarmed shape
+        or a retired blob) and took the jit path — count it so rollout
+        tooling can tell a fully-hydrated replica from a limping one."""
+        from .. import obs
+
+        with self._lock:
+            if self._aot is not None:
+                self._aot["fallback_compiles"] = (
+                    self._aot.get("fallback_compiles", 0) + 1)
+        obs.default_registry().counter(
+            "aot_fallback_compiles_total",
+            help="serving dispatches that missed the hydrated AOT "
+                 "executable table and fell back to the jit path").inc()
+
+    def aot_status(self) -> Optional[dict]:
+        """Hydration summary for health surfaces: {status, buckets_hydrated,
+        fallback_compiles, ...} once a hydration was attempted, else None."""
+        with self._lock:
+            return dict(self._aot) if self._aot is not None else None
 
     def auto_threshold(self) -> int:
         """The routing crossover in rows: batches below it take the CPU plan
@@ -371,49 +431,166 @@ class ScoreFunction:
 
     # --- warmup -------------------------------------------------------------------------
     def warm(self, buckets: Optional[Sequence[int]] = None,
-             observe: bool = True, log=None) -> dict:
-        """Pre-compile the per-bucket serving executables on every lane the
-        router can choose, so the first real dispatch at any warmed shape
-        compiles nothing (`retrace_budget(0)`-clean steady state from request
-        one). `op warmup --serving` and daemon model admission both call this
-        — the SAME helper, so a deploy-time warmup primes exactly the
-        executables admission will build.
+             observe: bool = True, log=None, aot: object = "auto") -> dict:
+        """Make every per-bucket serving executable on every routable lane
+        ready, so the first real dispatch at any warmed shape compiles
+        nothing (`retrace_budget(0)`-clean steady state from request one).
+        `op warmup --serving` and daemon model admission both land here (via
+        `warmup.warm_serving_handle`) — the SAME helper, so a deploy-time
+        warmup primes exactly the executables admission will build.
 
-        Each bucket runs twice: a cold pass that traces+compiles against
-        throwaway synthetic buffers (kind-appropriate placeholder values —
-        shapes depend only on the row count and the fitted schema, never on
-        values), then — with `observe=True` — a steady timed pass through the
-        latency histograms, seeding the measured crossover
-        (`auto_threshold()`) with warm per-lane numbers at admission time.
-        Returns {buckets, lanes, programs, wall_s}."""
+        AOT-first: with `aot` enabled (default "auto") and the handle's model
+        carrying a saved bundle with compatible artifacts (serve/aot.py), the
+        pre-compiled executables are DESERIALIZED instead of built —
+        milliseconds instead of seconds, zero XLA work — and the bundle's
+        persisted routing windows seed `auto_threshold()`. Buckets/lanes the
+        artifacts do not cover (and every bucket when artifacts are stale,
+        incompatible, or absent) degrade to the compile loop: a cold pass
+        that traces+compiles against throwaway synthetic buffers
+        (kind-appropriate placeholder values — shapes depend only on the row
+        count and the fitted schema, never on values), then — with
+        `observe=True` — a steady timed pass through the latency histograms,
+        seeding the measured crossover with warm per-lane numbers.
+        Returns {buckets, lanes, programs, wall_s} plus "aot" when a
+        hydration was attempted ("programs" counts COMPILED buckets only —
+        0 on a fully hydrated handle)."""
         import time
 
         import jax
 
+        import numpy as _np
+
         t0 = time.perf_counter()
         buckets = sorted({int(b) for b in (buckets or self._pad_to or (1,))})
         rec = {f.name: _placeholder(f.kind) for f in self._predictors}
-        if self._backend == "auto":
-            lanes: list = [None]
-            if jax.devices()[0].platform != "cpu":
-                # the CPU failover/small-batch lane compiles its own programs
-                lanes.append("cpu")
-        else:
-            lanes = [self._backend]
+        # one synthetic table at the largest bucket, sliced per bucket: the
+        # row-wise python table build is measurable against a hydrated warm
+        # (every pass is milliseconds) and identical rows slice exactly
+        big = self._build_table([dict(rec)] * buckets[-1])
+
+        def synth(b: int):
+            return big if b == buckets[-1] else big.slice(_np.arange(b))
+
+        from .aot import _lanes_of
+
+        lanes = _lanes_of(self)  # shared with export/hydrate: never drifts
+        covered: set = set()
+        hyd = None
+        if aot and getattr(self._model, "_bundle_path", None):
+            # meshed handles land in hydrate's own "mesh" fallback — the
+            # degrade is counted and surfaces in the report//healthz instead
+            # of hydration silently never being attempted
+            from .aot import hydrate
+
+            hyd = hydrate(self, buckets=buckets, log=log)
+            covered = {(lab, int(b))
+                       for lab, b in hyd.pop("covered", [])}
+            with self._lock:
+                self._aot = {k: v for k, v in hyd.items()}
+                self._aot.setdefault("fallback_compiles", 0)
+        programs = 0
         for lane in lanes:
             plan = self._plan_for(lane)
             for b in buckets:
-                out = plan.run(self._build_table([dict(rec)] * b))
+                label = lane or "device"
+                if (label, b) in covered:
+                    # hydrated bucket: one validation pass exercises every
+                    # installed executable end to end BEFORE traffic arrives
+                    # (a blob that deserialized but fails at call time is
+                    # retired here, at admission, not on the first live
+                    # request) and — timed — populates the latency
+                    # histograms/windows with numbers from THIS host. The
+                    # programs are pre-compiled, so this is milliseconds.
+                    # block_until_ready: on an async backend the failure
+                    # surfaces at the fetch, not the enqueue — validation
+                    # must materialize the results or it validates nothing.
+                    # The admission guard reroutes sync call-time failures
+                    # (caught+retired inside _AotDispatch) away from the
+                    # hot-path "limping replica" counter into `vfails`.
+                    try:
+                        with plan.aot_admission_guard() as vfails:
+                            if observe:
+                                out = self._timed_run(plan, synth(b), lane)
+                            else:
+                                out = plan.run(synth(b))
+                            jax.block_until_ready(
+                                [c.values for c in out.values()
+                                 if c.is_device])
+                        if vfails:
+                            raise RuntimeError(
+                                "executable retired at call time")
+                        continue
+                    except Exception as e:  # noqa: BLE001 — retire, recompile
+                        # an executable that deserialized but cannot RUN
+                        # (async failures land here via the fetch; sync ones
+                        # via the guard): retire the bucket's blobs, demote
+                        # the handle's status, and fall through to the
+                        # compile path — warm never raises over a bad
+                        # artifact, and /healthz must not keep calling the
+                        # bucket hydrated. Retire on EVERY routable lane,
+                        # not just the failing one: the demotion below is
+                        # handle-wide, and no lane may keep serving this
+                        # bucket from blobs while the report says it is not
+                        # hydrated.
+                        for lane2 in lanes:
+                            label2 = lane2 or "device"
+                            if lane2 != lane and (label2, b) not in covered:
+                                continue
+                            plan2 = self._plan_for(lane2)
+                            plan2.retire_aot(b)
+                            covered.discard((label2, b))
+                            if lanes.index(lane2) < lanes.index(lane):
+                                # that lane's loop already passed: re-cover
+                                # via the compile path now, or its first
+                                # live dispatch at b pays (and counts) a
+                                # hot-path compile
+                                plan2.mark_warmed(b)
+                                out2 = plan2.run(synth(b))
+                                jax.block_until_ready(
+                                    [c.values for c in out2.values()])
+                                programs += 1
+                        with self._lock:
+                            if self._aot is not None:
+                                bh = self._aot.get("buckets_hydrated") or []
+                                bh = [x for x in bh if x != b]
+                                self._aot["buckets_hydrated"] = bh
+                                if not bh:
+                                    # every hydrated bucket retired: the
+                                    # replica is 100% on the compile path
+                                    # and must not read as partially covered
+                                    self._aot["status"] = "fallback"
+                                    self._aot.setdefault("reason", "error")
+                                elif self._aot.get("status") == "hydrated":
+                                    self._aot["status"] = "partial"
+                        from .aot import note_fallback
+
+                        note_fallback(
+                            "error",
+                            f"validation lane={label} rows={b}: "
+                            f"{type(e).__name__}: {e}")
+                        if log is not None:
+                            log(f"serving aot: retired lane={label} rows={b} "
+                                f"(validation failed: {type(e).__name__})")
+                # compiled-not-hydrated shapes are healthy steady state: on a
+                # partially hydrated plan they must not tick the
+                # fallback-compile ("limping replica") counter per dispatch
+                plan.mark_warmed(b)
+                out = plan.run(synth(b))
                 jax.block_until_ready([c.values for c in out.values()])
+                programs += 1
                 if observe:
-                    self._timed_run(plan, self._build_table([dict(rec)] * b),
-                                    lane)
+                    self._timed_run(plan, synth(b), lane)
                 if log is not None:
                     log(f"serving warm: lane={lane or 'device'} rows={b}")
-        return {"buckets": buckets,
-                "lanes": [lane or "device" for lane in lanes],
-                "programs": len(lanes) * len(buckets),
-                "wall_s": round(time.perf_counter() - t0, 3)}
+        report = {"buckets": buckets,
+                  "lanes": [lane or "device" for lane in lanes],
+                  "programs": programs,
+                  "wall_s": round(time.perf_counter() - t0, 3)}
+        if hyd is not None:
+            # the live status, not the raw hydrate report: a bucket retired
+            # by the validation passes above must not read as hydrated
+            report["aot"] = self.aot_status() or hyd
+        return report
 
     def breaker_state(self) -> Optional[str]:
         """Circuit-breaker state of the device lane ("closed"/"open"/

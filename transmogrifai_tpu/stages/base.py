@@ -165,6 +165,12 @@ class Stage:
     def to_json(self) -> dict:
         return {
             "class": type(self).__name__,
+            # defining module: lets a fresh process restore this stage by
+            # importing ONE module instead of walking the whole package
+            # (~200 ms of the cold-start load path; serve/aot.py relies on
+            # load being milliseconds). Old manifests without it still load
+            # via the package-walk fallback below.
+            "module": type(self).__module__,
             "uid": self.uid,
             "operation": self.operation_name,
             "params": _jsonify(self.params),
@@ -174,10 +180,23 @@ class Stage:
     @classmethod
     def from_json(cls, data: dict) -> "Stage":
         klass = STAGE_REGISTRY.get(data["class"])
-        if klass is None:
+        if klass is None and isinstance(data.get("module"), str) \
+                and data["module"].startswith("transmogrifai_tpu."):
             # registration is an import side effect, so a standalone loader
             # (`op monitor --model`, a bare WorkflowModel.load in a fresh
-            # process) may not have imported the defining module yet — walk
+            # process) may not have imported the defining module yet. The
+            # manifest records it: import exactly that module (package-
+            # prefix-guarded) — the milliseconds-not-seconds load path AOT
+            # cold start depends on
+            import importlib
+
+            try:
+                importlib.import_module(data["module"])
+            except Exception:  # noqa: BLE001 — fall through to the walk
+                pass
+            klass = STAGE_REGISTRY.get(data["class"])
+        if klass is None:
+            # legacy manifest (no module record) or a renamed module: walk
             # the package once and retry before declaring the class unknown
             _import_stage_modules()
             klass = STAGE_REGISTRY[data["class"]]

@@ -11,7 +11,6 @@ from __future__ import annotations
 import math
 from typing import Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from ...types import Column, VectorSchema
@@ -20,7 +19,6 @@ from .common import (
     SequenceVectorizer,
     SequenceVectorizerEstimator,
     null_slot,
-    stack_vector,
     value_slot,
 )
 
@@ -68,24 +66,45 @@ class DateToUnitCircleVectorizer(SequenceVectorizer):
                 raise ValueError(f"unknown time period {pd!r}")
         super().__init__(time_periods=list(time_periods), track_nulls=track_nulls)
 
-    def transform_columns(self, cols: Sequence[Column]) -> Column:
+    def make_serving_kernel(self):
+        """Pure-numpy per-call kernel, schema built once: the calendar math
+        was already numpy, but the old transform_columns column-stacked the
+        parts with eager jnp ops — a handful of tiny `broadcast_in_dim`/
+        `concatenate` programs compiling PER BATCH SHAPE on the serving host
+        path, invisible behind a warmed bucket but a real compile (and a
+        hydrated-cold-start compile leak) at any fresh shape."""
         p = self.params
-        parts, slots = [], []
-        for c, f in zip(cols, self.inputs):
-            ms = np.asarray(c.values, np.int64)
-            mask = np.asarray(c.effective_mask())
-            for period in p["time_periods"]:
-                frac = _period_fraction(ms, period)
-                rad = 2.0 * math.pi * frac
-                sin = np.where(mask, np.sin(rad), 0.0).astype(np.float32)
-                cos = np.where(mask, np.cos(rad), 0.0).astype(np.float32)
-                parts.extend([jnp.asarray(sin), jnp.asarray(cos)])
-                slots.append(value_slot(f.name, f.kind.name, descriptor=f"{period}_x"))
-                slots.append(value_slot(f.name, f.kind.name, descriptor=f"{period}_y"))
-            if p["track_nulls"]:
-                parts.append(jnp.asarray(~mask, jnp.float32))
+        periods, track = list(p["time_periods"]), bool(p["track_nulls"])
+        slots: list = []
+        for f in self.inputs:
+            for period in periods:
+                slots.append(value_slot(f.name, f.kind.name,
+                                        descriptor=f"{period}_x"))
+                slots.append(value_slot(f.name, f.kind.name,
+                                        descriptor=f"{period}_y"))
+            if track:
                 slots.append(null_slot(f.name, f.kind.name))
-        return stack_vector(parts, slots)
+        schema = VectorSchema(tuple(slots))
+        from ...types import kind_of
+
+        def kernel(cols: Sequence[Column]) -> Column:
+            mat = np.empty((len(cols[0]), len(slots)), dtype=np.float32)
+            j = 0
+            for c in cols:
+                ms = np.asarray(c.values, np.int64)
+                mask = np.asarray(c.effective_mask())
+                for period in periods:
+                    frac = _period_fraction(ms, period)
+                    rad = 2.0 * math.pi * frac
+                    mat[:, j] = np.where(mask, np.sin(rad), 0.0).astype(np.float32)
+                    mat[:, j + 1] = np.where(mask, np.cos(rad), 0.0).astype(np.float32)
+                    j += 2
+                if track:
+                    mat[:, j] = (~mask).astype(np.float32)
+                    j += 1
+            return Column(kind_of("OPVector"), mat, None, schema=schema)
+
+        return kernel
 
 
 @register_stage
@@ -118,28 +137,36 @@ class DateListVectorizerModel(SequenceVectorizer):
     device_op = False
     accepts = ("DateList", "DateTimeList")
 
-    def transform_columns(self, cols: Sequence[Column]) -> Column:
+    def make_serving_kernel(self):
+        """Pure-numpy per-call kernel, schema built once — same reasoning as
+        DateToUnitCircleVectorizer: the old transform_columns stacked parts
+        with eager jnp ops, compiling tiny concatenate programs per batch
+        shape on the serving host path (a hydrated-cold-start compile leak)."""
         p = self.params
-        ref = p["reference_date_ms"]
-        parts, slots = [], []
-        for c, f in zip(cols, self.inputs):
-            n = len(c)
-            since = np.zeros(n, np.float32)
-            count = np.zeros(n, np.float32)
-            empty = np.zeros(n, np.float32)
-            for i, v in enumerate(c.values):
-                if v:
-                    since[i] = (ref - max(v)) / MS_PER_DAY
-                    count[i] = len(v)
-                else:
-                    empty[i] = 1.0
-            parts.extend([jnp.asarray(since), jnp.asarray(count)])
+        ref, track = p["reference_date_ms"], bool(p["track_nulls"])
+        slots: list = []
+        for f in self.inputs:
             slots.append(value_slot(f.name, f.kind.name, descriptor="daysSinceLast"))
             slots.append(value_slot(f.name, f.kind.name, descriptor="count"))
-            if p["track_nulls"]:
-                parts.append(jnp.asarray(empty))
+            if track:
                 slots.append(null_slot(f.name, f.kind.name))
-        return stack_vector(parts, slots)
+        schema = VectorSchema(tuple(slots))
+        from ...types import kind_of
+
+        per_input = 3 if track else 2
+
+        def kernel(cols: Sequence[Column]) -> Column:
+            mat = np.zeros((len(cols[0]), len(slots)), dtype=np.float32)
+            for j, c in zip(range(0, len(slots), per_input), cols):
+                for i, v in enumerate(c.values):
+                    if v:
+                        mat[i, j] = (ref - max(v)) / MS_PER_DAY
+                        mat[i, j + 1] = len(v)
+                    elif track:
+                        mat[i, j + 2] = 1.0
+            return Column(kind_of("OPVector"), mat, None, schema=schema)
+
+        return kernel
 
 
 @register_stage
@@ -187,31 +214,49 @@ class DateMapToUnitCircleVectorizerModel(SequenceVectorizer):
                          time_periods=list(time_periods), track_nulls=track_nulls,
                          names=list(names), kinds=list(kinds))
 
-    def transform_columns(self, cols: Sequence[Column]) -> Column:
+    def make_serving_kernel(self):
+        """Pure-numpy per-call kernel, schema built once — same reasoning as
+        DateToUnitCircleVectorizer (the old transform_columns stacked parts
+        with eager jnp ops, a per-batch-shape compile leak on the serving
+        host path). A fit that observed no keys yields a zero-width (but
+        well-formed) vector."""
         p = self.params
-        parts, slots = [], []
-        for c, keys, name, kind in zip(cols, p["all_keys"], p["names"], p["kinds"]):
-            n = len(c)
+        all_keys = [list(k) for k in p["all_keys"]]
+        periods, track = list(p["time_periods"]), bool(p["track_nulls"])
+        slots: list = []
+        for keys, name, kind in zip(all_keys, p["names"], p["kinds"]):
             for key in keys:
-                ms = np.zeros(n, np.int64)
-                present = np.zeros(n, bool)
-                for i, m in enumerate(c.values):
-                    v = (m or {}).get(key)
-                    if v is not None:
-                        ms[i] = int(v)
-                        present[i] = True
-                for period in p["time_periods"]:
-                    rad = 2.0 * math.pi * _period_fraction(ms, period)
-                    parts.append(np.where(present, np.sin(rad), 0.0).astype(np.float32))
-                    parts.append(np.where(present, np.cos(rad), 0.0).astype(np.float32))
+                for period in periods:
                     slots.append(value_slot(name, kind, group=key,
                                             descriptor=f"{period}_x"))
                     slots.append(value_slot(name, kind, group=key,
                                             descriptor=f"{period}_y"))
-                if p["track_nulls"]:
-                    parts.append((~present).astype(np.float32))
+                if track:
                     slots.append(null_slot(name, kind, group=key))
-        if not parts:  # no keys observed at fit: empty (but well-formed) vector
-            return Column.vector(jnp.zeros((len(cols[0]), 0), jnp.float32),
-                                 VectorSchema(()))
-        return stack_vector(parts, slots)
+        schema = VectorSchema(tuple(slots))
+        from ...types import kind_of
+
+        def kernel(cols: Sequence[Column]) -> Column:
+            n = len(cols[0])
+            mat = np.zeros((n, len(slots)), dtype=np.float32)
+            j = 0
+            for c, keys in zip(cols, all_keys):
+                for key in keys:
+                    ms = np.zeros(n, np.int64)
+                    present = np.zeros(n, bool)
+                    for i, m in enumerate(c.values):
+                        v = (m or {}).get(key)
+                        if v is not None:
+                            ms[i] = int(v)
+                            present[i] = True
+                    for period in periods:
+                        rad = 2.0 * math.pi * _period_fraction(ms, period)
+                        mat[:, j] = np.where(present, np.sin(rad), 0.0)
+                        mat[:, j + 1] = np.where(present, np.cos(rad), 0.0)
+                        j += 2
+                    if track:
+                        mat[:, j] = (~present).astype(np.float32)
+                        j += 1
+            return Column(kind_of("OPVector"), mat, None, schema=schema)
+
+        return kernel

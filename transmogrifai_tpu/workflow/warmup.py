@@ -155,22 +155,45 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
             "wall_s": round(time.perf_counter() - t0, 2)}
 
 
+def warm_serving_handle(fn, buckets: Sequence[int] = None, floor: int = 1,
+                        max_batch: int = 256, aot="auto", log=None) -> dict:
+    """THE bucket-ladder warm helper — `ServingDaemon.admit` and
+    `warm_serving` (→ `op warmup --serving`) both land here, so the ladder
+    derivation and the artifact-store consultation can never drift apart.
+    Resolves the pow2 serving ladder (explicit `buckets`, else floor ..
+    max_batch through `serving_buckets`), consults the model bundle's AOT
+    artifact store FIRST (serve/aot.py: compatible pre-compiled executables
+    deserialize in milliseconds with zero XLA work), and compiles only the
+    (lane, bucket) pairs hydration did not cover. Returns the
+    `ScoreFunction.warm` report ("programs" = compiled buckets, 0 when fully
+    hydrated; "aot" = the hydration report when one was attempted)."""
+    from ..serve.daemon import resolve_buckets
+
+    return fn.warm(resolve_buckets(buckets, floor, max_batch),
+                   log=log, aot=aot)
+
+
 def warm_serving(model_or_dir, buckets: Sequence[int] = None, floor: int = 1,
                  max_batch: int = 256, backend="auto", mesh=None,
-                 log=print) -> dict:
+                 log=print, aot="auto", export_aot: bool = False) -> dict:
     """Warm the SERVING shapes of a fitted model: every pow2 `pad_to` bucket
     (floor, 2*floor, ..., max_batch) on every lane the serving router can
     choose — the shapes `op warmup`'s training matrix never touches. This is
-    the SAME `ScoreFunction.warm` helper the serving daemon runs at model
+    the SAME `warm_serving_handle` helper the serving daemon runs at model
     admission, so a deploy-time `op warmup --serving DIR` leaves the
     persistent compile cache primed with exactly the executables admission
-    will build (cold admission then pays tracing + cache reads, not XLA
-    compiles).
+    will build — and, when the bundle carries AOT artifacts, hydrates them
+    the way admission will (milliseconds, zero compiles).
+
+    `export_aot=True` instead WRITES the AOT artifact set into the model's
+    bundle directory (serve/aot.py): pre-compiled executables for every
+    lane x bucket plus the measured routing windows, so every later
+    `load` + first score on a compatible host is milliseconds. The export
+    pays the compiles here, at deploy-prep time.
 
     `model_or_dir` is a saved model directory or a WorkflowModel instance.
     Returns the warm report ({buckets, lanes, programs, wall_s} + model uid).
     """
-    from ..serve.daemon import serving_buckets
     from ..utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -180,10 +203,27 @@ def warm_serving(model_or_dir, buckets: Sequence[int] = None, floor: int = 1,
         model = WorkflowModel.load(model_or_dir)
     else:
         model = model_or_dir
-    buckets = (sorted({int(b) for b in buckets}) if buckets
-               else serving_buckets(floor, max_batch))
-    fn = model.score_fn(pad_to=buckets, backend=backend, mesh=mesh)
-    report = fn.warm(buckets, log=(lambda m: log(m)) if log else None)
+    if export_aot:
+        from ..serve.aot import export_aot as _export_aot
+
+        target = (model_or_dir if isinstance(model_or_dir, str)
+                  else getattr(model, "_bundle_path", None))
+        if target is None:
+            raise ValueError(
+                "export_aot needs a saved bundle directory (pass the model "
+                "dir, or save() the model first)")
+        report = _export_aot(model, target, buckets=buckets, floor=floor,
+                             max_batch=max_batch, backend=backend,
+                             log=(lambda m: log(m)) if log else None)
+        report["model"] = getattr(model, "uid", None)
+        return report
+    from ..serve.daemon import resolve_buckets
+
+    resolved = resolve_buckets(buckets, floor, max_batch)
+    fn = model.score_fn(pad_to=resolved, backend=backend, mesh=mesh)
+    report = warm_serving_handle(
+        fn, buckets=resolved, aot=aot,
+        log=(lambda m: log(m)) if log else None)
     report["model"] = getattr(model, "uid", None)
     return report
 

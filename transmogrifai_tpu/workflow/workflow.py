@@ -629,6 +629,16 @@ class WorkflowModel(WorkflowCore):
         #: serving drift monitor (obs/monitor.py) — stamped by train(), saved
         #: under model.json "serving_baseline", restored by load()
         self.serving_baseline: dict = {}
+        #: {lane: [[latency_s, rows], ...]} measured serving-lane latency
+        #: windows (ScoreFunction.lane_windows) — stamped by save(aot=True)'s
+        #: export pass (or set explicitly from a live handle before save),
+        #: persisted under "serving_lane_windows", restored by load() and
+        #: seeded into every new score_fn so the routing crossover is
+        #: measured-quality from request #1
+        self.serving_lane_windows: dict = {}
+        #: absolute path of the bundle this model was loaded from (or last
+        #: saved to) — where score_fn().warm() looks for AOT artifacts
+        self._bundle_path: Optional[str] = None
 
     # --- scoring (analog of OpWorkflowModel.score, scoreFn) ---------------------------
     def transform(self, table: Table, keep_intermediate: bool = False) -> Table:
@@ -732,13 +742,45 @@ class WorkflowModel(WorkflowCore):
     #: checkpoint role: tree ensembles / embeddings as binary arrays, not JSON text)
     _NPZ_THRESHOLD = 1024
 
-    def save(self, path: str, overwrite: bool = False) -> None:
+    def save(self, path: str, overwrite: bool = False, *,
+             aot: bool = False, aot_buckets: Optional[Sequence[int]] = None,
+             aot_floor: int = 1, aot_max_batch: int = 256,
+             aot_backend: Optional[str] = "auto") -> None:
+        """Persist the fitted workflow as a self-contained bundle.
+
+        `aot=True` additionally exports the AOT deploy artifact set
+        (serve/aot.py) into `<path>/aot/`: pre-compiled serving executables
+        for every routable lane x pow2 pad_to bucket (`aot_floor` ..
+        `aot_max_batch`, or an explicit `aot_buckets` ladder), keyed by the
+        plan's trace fingerprints + a device/jax compatibility stamp, plus
+        the measured per-lane routing windows — so `load` + first score in a
+        fresh process on a compatible host costs milliseconds instead of
+        seconds of compile. Export pays those compiles HERE, at save time.
+        """
         import numpy as _np
 
         os.makedirs(path, exist_ok=True)
         target = os.path.join(path, self.MANIFEST)
         if os.path.exists(target) and not overwrite:
             raise FileExistsError(f"{target} exists; pass overwrite=True")
+        aot_staging = None
+        if aot:
+            from ..serve.aot import export_aot
+
+            # deferred publish: the export stages its artifacts and the swap
+            # happens after THIS save's manifest replace — a crash anywhere
+            # in between leaves the old bundle and its matching artifacts
+            # fully intact
+            aot_report = export_aot(
+                self, path, buckets=aot_buckets, floor=aot_floor,
+                max_batch=aot_max_batch, backend=aot_backend,
+                log=lambda m: _logger.info("%s", m), _defer_publish=True)
+            aot_staging = aot_report.get("staging")
+            if aot_report.get("lane_windows"):
+                # the export's timed passes measured real per-lane latencies:
+                # stamp them into the manifest so every loaded handle starts
+                # with a measured routing crossover
+                self.serving_lane_windows = aot_report["lane_windows"]
         from ..graph.json_helper import stage_payload
 
         arrays: dict[str, _np.ndarray] = {}
@@ -791,6 +833,22 @@ class WorkflowModel(WorkflowCore):
             from ..obs.monitor import baseline_to_json
 
             manifest["serving_baseline"] = baseline_to_json(self.serving_baseline)
+        if self.serving_lane_windows:
+            # measured serving-lane latency windows (from the AOT export's
+            # timed passes, or a live handle's lane_windows()): a loaded
+            # model's score_fn seeds auto_threshold() from these. Stamped
+            # with the measuring host class — latencies from a CPU build box
+            # must not steer routing on a TPU serving host (load() gates)
+            from ..serve.aot import compat_stamp
+
+            st = compat_stamp()
+            manifest["serving_lane_windows"] = {
+                "platform": st["platform"],
+                "device_kind": st["device_kind"],
+                "windows": {
+                    lane: [[float(d), int(r)] for d, r in win]
+                    for lane, win in self.serving_lane_windows.items()
+                    if win}}
         # ATOMIC save, including RESAVE over an existing model: the arrays
         # sidecar gets a fresh GENERATION name each save and the manifest
         # records it under "arrays_file", so the manifest's os.replace is the
@@ -834,6 +892,24 @@ class WorkflowModel(WorkflowCore):
                     os.remove(os.path.join(path, fname))
                 except OSError:
                     pass  # sweep is best-effort; stale npz is inert debris
+        # artifact publish point — strictly AFTER the manifest replace, so a
+        # resave that dies mid-write leaves the OLD bundle fully intact,
+        # artifacts included. With a staged export: swap it in; without one
+        # (aot=False, or the export was skipped as unfingerprintable): the
+        # new manifest invalidated any previous generation — sweep it
+        import shutil as _shutil
+
+        from ..serve.aot import AOT_DIR as _AOT_DIR
+
+        if aot_staging:
+            from ..serve.aot import publish_aot
+
+            publish_aot(path, aot_staging)
+        else:
+            _shutil.rmtree(os.path.join(path, _AOT_DIR), ignore_errors=True)
+        # this dir is now the model's bundle: score_fn().warm() in THIS
+        # process can hydrate the just-exported artifacts too
+        self._bundle_path = os.path.abspath(path)
 
     @staticmethod
     def load(path: str) -> "WorkflowModel":
@@ -868,4 +944,20 @@ class WorkflowModel(WorkflowCore):
 
             model.serving_baseline = baseline_from_json(
                 manifest["serving_baseline"])
+        slw = manifest.get("serving_lane_windows") or {}
+        if slw.get("windows"):
+            # only adopt routing windows measured on the SAME host class:
+            # a crossover derived from another platform's latencies would
+            # misroute until live observations flush it
+            from ..serve.aot import compat_stamp
+
+            st = compat_stamp()
+            if (slw.get("platform") == st["platform"]
+                    and slw.get("device_kind") == st["device_kind"]):
+                model.serving_lane_windows = {
+                    lane: [(float(d), int(r)) for d, r in win]
+                    for lane, win in slw["windows"].items()}
+        # remember the bundle dir: score_fn().warm() hydrates AOT artifacts
+        # from here instead of tracing+compiling (serve/aot.py)
+        model._bundle_path = os.path.abspath(path)
         return model
