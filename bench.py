@@ -456,6 +456,7 @@ def _run(partial: dict) -> None:
         from bench_extra import (
             run_boston,
             run_cold_start,
+            run_disagg_ingest,
             run_hist,
             run_iris,
             run_mlp,
@@ -518,6 +519,18 @@ def _run(partial: dict) -> None:
             detail["cold_start"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         partial["cold_start_speedup"] = \
             detail["cold_start"].get("cold_start_speedup")
+        # disaggregated ingest: 0/1/2-worker extraction throughput + the
+        # end-to-end cost of one mid-epoch worker SIGKILL (ISSUE-9; the
+        # fault machinery itself is gated by tests/ci, this lane gates the
+        # numbers)
+        try:
+            detail["disagg_ingest"] = run_disagg_ingest()
+        except Exception as e:  # noqa: BLE001
+            detail["disagg_ingest"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        partial["disagg_two_worker_rows_per_sec"] = \
+            detail["disagg_ingest"].get("two_worker_rows_per_sec")
+        partial["disagg_recovery_s"] = \
+            detail["disagg_ingest"].get("disagg_recovery_s")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({

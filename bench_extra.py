@@ -521,6 +521,100 @@ def run_resilience_overhead(n_batches: int = 32, batch: int = 512) -> dict:
     }
 
 
+def run_disagg_ingest(n_files: int = 8, rows_per_file: int = 2048,
+                      batch: int = 256) -> dict:
+    """Disaggregated-ingest lane (ISSUE-9): pure EXTRACTION throughput of a
+    CSV directory in-process (`CSVStreamingReader`) vs through the ingest
+    service on 1 and 2 worker subprocesses, plus measured recovery time
+    after a mid-epoch worker SIGKILL. Every timed wall starts with the
+    worker fleet already REGISTERED — worker subprocess spawn (~2 s of jax
+    import, a once-per-run constant) must not masquerade as extraction or
+    recovery cost. On a CPU host with small rows the in-process number wins
+    (the service pays socket+JSON per batch); the lane exists to gate the
+    protocol overhead and `disagg_recovery_s` = wall delta of the kill run
+    vs the clean 2-worker run (EOF detection + lease re-grant + shard
+    replay), not to claim a host-local speedup."""
+    import csv as _csv
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.ingest import CsvDirSource, IngestCoordinator
+    from transmogrifai_tpu.readers.streaming import CSVStreamingReader
+    from transmogrifai_tpu.resilience import FaultInjector
+
+    rng = np.random.default_rng(17)
+    stream_dir = tempfile.mkdtemp(prefix="bench_disagg_stream_")
+    fields = [f"x{i}" for i in range(6)] + ["cat"]
+    try:
+        for b in range(n_files):
+            with open(os.path.join(stream_dir, f"b-{b:03d}.csv"), "w",
+                      newline="") as fh:
+                w = _csv.DictWriter(fh, fieldnames=fields)
+                w.writeheader()
+                for _ in range(rows_per_file):
+                    row = {f"x{i}": float(v)
+                           for i, v in enumerate(rng.normal(size=6))}
+                    row["cat"] = "abcd"[int(rng.integers(0, 4))]
+                    w.writerow(row)
+        n_rows = n_files * rows_per_file
+
+        def inprocess() -> float:
+            t0 = time.perf_counter()
+            n = sum(len(b) for b in
+                    CSVStreamingReader(stream_dir, batch_size=batch).stream())
+            wall = time.perf_counter() - t0
+            assert n == n_rows, (n, n_rows)
+            return wall
+
+        def extraction_epoch(workers: int, injector=None) -> float:
+            """One service epoch with `workers` subprocesses registered
+            BEFORE the clock starts."""
+            import contextlib
+
+            coord = IngestCoordinator(
+                CsvDirSource(stream_dir, batch_size=batch),
+                n_shards=max(2, 2 * workers), plan_fp="bench").start()
+            try:
+                ctx = (injector.installed() if injector is not None
+                       else contextlib.nullcontext())
+                with ctx:
+                    coord.spawn_workers(workers)
+                    deadline = time.perf_counter() + 120.0
+                    while (len(coord.stats()["workers"]) < workers
+                           and time.perf_counter() < deadline):
+                        time.sleep(0.02)
+                    t0 = time.perf_counter()
+                    n = sum(len(b) for b in coord.stream())
+                    wall = time.perf_counter() - t0
+                assert n == n_rows, (n, n_rows)
+                return wall
+            finally:
+                coord.close()
+
+        inprocess()  # page the files into cache once
+        inproc_wall = min(inprocess() for _ in range(2))
+        one_wall = extraction_epoch(1)
+        two_wall = extraction_epoch(2)
+        # SIGKILL one of 2 registered workers at shard 1's second batch —
+        # early enough that real work remains to replay
+        kill_wall = extraction_epoch(
+            2, FaultInjector(seed=0, worker_kills=[(1, 1)]))
+        return {
+            "rows": n_rows, "files": n_files, "batch_size": batch,
+            "inprocess_rows_per_sec": round(n_rows / inproc_wall),
+            "one_worker_rows_per_sec": round(n_rows / one_wall),
+            "two_worker_rows_per_sec": round(n_rows / two_wall),
+            "extraction_epoch_clean_s": round(two_wall, 4),
+            # floored at 1 ms: sub-ms deltas are measurement noise, and a
+            # 0.0 baseline would make bench_diff flag ANY later nonzero
+            # jitter as a regression (its zero-baseline rule)
+            "disagg_recovery_s": round(
+                max(0.001, kill_wall - two_wall), 4),
+        }
+    finally:
+        shutil.rmtree(stream_dir, ignore_errors=True)
+
+
 def run_serving_daemon(n_clients: int = 32, requests_per_client: int = 12,
                        max_wait_ms: float = 2.0) -> dict:
     """Serving-daemon lane: closed-loop concurrent single-row clients through
@@ -874,7 +968,8 @@ ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp,
        "monitor": run_monitor_overhead,
        "resilience": run_resilience_overhead,
        "daemon": run_serving_daemon,
-       "cold_start": run_cold_start}
+       "cold_start": run_cold_start,
+       "disagg": run_disagg_ingest}
 
 if __name__ == "__main__":
     import sys

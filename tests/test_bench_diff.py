@@ -38,6 +38,11 @@ def test_direction_inference():
     assert bench_diff.lower_is_better("cold_start_noaot_s")
     assert bench_diff.lower_is_better("cold_start_aot_compile_events")
     assert not bench_diff.lower_is_better("cold_start_speedup")
+    # the disaggregated-ingest lane: extraction throughput is higher-better,
+    # the worker-SIGKILL recovery cost regresses upward
+    assert not bench_diff.lower_is_better("disagg_two_worker_rows_per_sec")
+    assert bench_diff.lower_is_better("disagg_recovery_s")
+    assert bench_diff.lower_is_better("extraction_epoch_clean_s")
 
 
 def test_cold_start_compile_events_zero_baseline():

@@ -548,3 +548,91 @@ class TestDaemon:
             server.server_close()
             daemon.close()
         assert not daemon.models()  # closed daemon released its cache
+
+
+class TestOverloadShedding:
+    """The overload guard (ISSUE-9 satellite): the micro-batcher's request
+    queue is bounded; past the bound submissions shed with `Overloaded` +
+    `serve_shed_total{model}` (HTTP: 429) instead of growing the queue —
+    and every ACCEPTED request still completes."""
+
+    def test_bounded_queue_sheds_and_accepted_work_completes(
+            self, fitted, serving_rows):
+        from transmogrifai_tpu.serve.batcher import Overloaded
+
+        model, _, _ = fitted
+        fn = model.score_fn(pad_to=serving_buckets(1, 2))
+        fn.warm()
+        gate = threading.Event()
+        real_stream = fn.stream
+
+        def gated_stream(source, **kw):
+            for out in real_stream(source, **kw):
+                gate.wait(60.0)
+                yield out
+
+        fn.stream = gated_stream
+        reg = obs.default_registry()
+
+        def shed_count():
+            c = reg.find("serve_shed_total", labels={"model": "shed_hammer"})
+            return c.value if c is not None else 0.0
+
+        before = shed_count()
+        batcher = MicroBatcher(fn, max_batch=1, max_wait_ms=1.0, prefetch=1,
+                               queue_depth=2, model_label="shed_hammer")
+        accepted, shed = [], 0
+        try:
+            # the scorer is gated shut: the queue (depth 2) plus the few
+            # in-flight windows fill, then every further submission sheds
+            for i in range(16):
+                try:
+                    accepted.append(batcher.submit([serving_rows[0]]))
+                except Overloaded:
+                    shed += 1
+                time.sleep(0.02)
+            assert shed > 0, "bounded queue never shed under overload"
+            assert len(accepted) + shed == 16
+            gate.set()
+            results = [f.result(60.0) for f in accepted]
+        finally:
+            gate.set()
+            batcher.close()
+        assert all(r and r[0] for r in results)  # accepted work all served
+        assert shed_count() - before == shed
+
+    def test_http_429_on_overload(self, model_dir_a, serving_rows):
+        from transmogrifai_tpu.serve.batcher import Overloaded
+
+        daemon = ServingDaemon(max_models=1, max_batch=8, queue_depth=1)
+        entry = daemon.admit(model_dir_a, name="a")
+        server = make_http_server(daemon, port=0)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{port}"
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/v1/score", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        try:
+            status, body = post({"model": "a", "records": serving_rows[:2]})
+            assert status == 200
+            # saturate deterministically: make the batcher report overload
+            entry.batcher.score = lambda *a, **kw: (_ for _ in ()).throw(
+                Overloaded("model 'a': request queue full"))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post({"model": "a", "records": serving_rows[:1]})
+            assert ei.value.code == 429
+            assert "queue full" in json.loads(ei.value.read())["error"]
+            del entry.batcher.score  # healthy again: traffic resumes
+            status, _ = post({"model": "a", "records": serving_rows[:1]})
+            assert status == 200
+        finally:
+            server.shutdown()
+            server.server_close()
+            daemon.close()

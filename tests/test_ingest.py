@@ -1,0 +1,614 @@
+"""Disaggregated feature extraction (transmogrifai_tpu/ingest/).
+
+Pins the ISSUE-9 acceptance surface: fault-free runs with the ingest service
+armed are bit-identical to the in-process reader path; a chaos schedule with
+one `worker:kill` (real SIGKILL of a worker subprocess) and one `rpc:drop`
+mid-epoch still completes with byte-identical part files, zero
+consumer-visible errors, and a seed-reproducible event log; torn frames are
+detected by checksum and recovered by lease replay; a wedged holder's lease
+expires and reassigns; a fleetless coordinator degrades to in-process
+fallback extraction. Plus the `ProcessShardedReader` reassembly-parity
+satellite and the materialized-feature cache.
+"""
+import csv
+import json
+import os
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.ingest import (
+    CsvDirSource,
+    FeatureCache,
+    IngestCoordinator,
+    cache_key,
+    transport,
+)
+from transmogrifai_tpu.ingest.cache import data_fingerprint
+from transmogrifai_tpu.ingest.coordinator import IngestError
+from transmogrifai_tpu.ingest.worker import extract_shard
+from transmogrifai_tpu.readers.streaming import CSVStreamingReader
+from transmogrifai_tpu.resilience import FaultInjector, FaultPolicy
+from transmogrifai_tpu.resilience.policy import scoped
+
+SCHEMA = {"label": "RealNN", "x1": "Real", "cat": "PickList"}
+
+
+def _counter(name, labels=None):
+    m = obs.default_registry().find(name, labels=labels)
+    return m.value if m is not None else 0.0
+
+
+def _write_stream_dir(directory, n_files=4, rows_per_file=12, seed=7):
+    os.makedirs(directory, exist_ok=True)
+    rng = random.Random(seed)
+    for b in range(n_files):
+        with open(os.path.join(directory, f"b-{b}.csv"), "w",
+                  newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["x1", "cat"])
+            for i in range(rows_per_file):
+                w.writerow([round(rng.uniform(-1, 1), 4), "abc"[i % 3]])
+    return directory
+
+
+# --- transport --------------------------------------------------------------------------
+class TestTransport:
+    def _pair(self):
+        a, b = socket.socketpair()
+        return a, b
+
+    def test_frame_roundtrip(self):
+        a, b = self._pair()
+        try:
+            payload = {"shard": 1, "seq": 3,
+                       "rows": [{"x": "1.5", "y": None}]}
+            transport.send_frame(a, transport.BATCH, payload)
+            kind, got = transport.recv_frame(b)
+            assert kind == transport.BATCH
+            assert got == payload
+        finally:
+            a.close(), b.close()
+
+    def test_crc_corruption_detected(self):
+        """A bit-flipped payload NEVER parses as data: the checksum catches
+        it and the frame surfaces as FrameError (transient — the lease/
+        replay machinery recovers, not a resend protocol)."""
+        import zlib
+
+        a, b = self._pair()
+        try:
+            body = json.dumps({"shard": 0}).encode()
+            head = transport._HEADER.pack(
+                transport.MAGIC, transport.BATCH, len(body), zlib.crc32(body))
+            corrupt = bytearray(body)
+            corrupt[2] ^= 0x40
+            a.sendall(head + bytes(corrupt))
+            with pytest.raises(transport.FrameError, match="checksum"):
+                transport.recv_frame(b)
+        finally:
+            a.close(), b.close()
+
+    def test_torn_frame_is_connection_error(self):
+        """A frame truncated by a dying peer (header promises more bytes
+        than ever arrive) is a ConnectionError, not a hang and not data."""
+        a, b = self._pair()
+        try:
+            body = json.dumps({"shard": 0, "rows": []}).encode()
+            import zlib
+
+            head = transport._HEADER.pack(
+                transport.MAGIC, transport.BATCH, len(body), zlib.crc32(body))
+            a.sendall(head + body[: len(body) // 2])
+            a.close()
+            with pytest.raises(ConnectionError):
+                transport.recv_frame(b)
+        finally:
+            b.close()
+
+    def test_bad_magic_and_oversized_length_rejected(self):
+        a, b = self._pair()
+        try:
+            a.sendall(b"XX" + b"\x00" * 9)
+            with pytest.raises(transport.FrameError, match="magic"):
+                transport.recv_frame(b)
+        finally:
+            a.close(), b.close()
+
+
+# --- cache ------------------------------------------------------------------------------
+class TestFeatureCache:
+    def test_hit_miss_and_corrupt_entry(self, tmp_path):
+        cache = FeatureCache(str(tmp_path))
+        key = cache_key("fmt:v1", data_fingerprint(b"hello"))
+        assert cache.get(key) is None
+        chunks = [[{"a": "1"}], [{"a": "2"}]]
+        cache.put(key, chunks)
+        assert cache.get(key) == chunks
+        # torn/corrupt entry (external copy died mid-write) reads as a MISS
+        with open(cache._path(key), "w") as fh:
+            fh.write('{"chunks": ')
+        assert cache.get(key) is None
+        assert cache.stats() == {"cache_hits": 1, "cache_misses": 2}
+
+    def test_key_sensitive_to_data_and_format(self):
+        d = data_fingerprint(b"x")
+        assert cache_key("a", d) != cache_key("b", d)
+        assert cache_key("a", d) != cache_key("a", data_fingerprint(b"y"))
+
+
+# --- source spec ------------------------------------------------------------------------
+class TestCsvDirSource:
+    @pytest.mark.parametrize("batch_size", [None, 3, 8])
+    def test_chunks_match_csv_streaming_reader(self, tmp_path, batch_size):
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=3, rows_per_file=7)
+        ref = list(CSVStreamingReader(d, batch_size=batch_size).stream())
+        spec = CsvDirSource(d, batch_size=batch_size)
+        got = []
+        for name in spec.list_files():
+            got.extend(spec.chunks(spec.parse(spec.read_file(name))))
+        assert got == ref
+
+    def test_wire_roundtrip_and_reader_spec(self, tmp_path):
+        from transmogrifai_tpu.ingest import source_from_wire
+
+        d = str(tmp_path / "s")
+        os.makedirs(d)
+        spec = CsvDirSource(d, batch_size=4)
+        clone = source_from_wire(spec.to_wire())
+        assert clone.batch_size == 4
+        assert os.path.samefile(clone.directory, d)
+        # CSVStreamingReader exposes the spec — unless a transform callable
+        # makes its extraction unshippable
+        assert CSVStreamingReader(d, batch_size=4).ingest_spec() is not None
+        assert CSVStreamingReader(
+            d, transform=lambda r: r).ingest_spec() is None
+
+
+# --- coordinator + thread workers -------------------------------------------------------
+class TestCoordinator:
+    @pytest.mark.parametrize("n_shards,n_workers", [(1, 1), (3, 2), (16, 3)])
+    def test_thread_worker_parity(self, tmp_path, n_shards, n_workers):
+        """Any shard count (including shards > files, which leaves some
+        shards empty) reassembles the exact in-process batch sequence."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=5, rows_per_file=9)
+        ref = list(CSVStreamingReader(d, batch_size=4).stream())
+        with IngestCoordinator(CsvDirSource(d, batch_size=4),
+                               n_shards=n_shards, plan_fp="t") as coord:
+            coord.launch_local_workers(n_workers)
+            got = list(coord.stream())
+        assert got == ref
+
+    def test_duplicate_frames_deduped_exactly_once(self, tmp_path):
+        """A replayed batch (same ordinal, delivered twice) is dropped by
+        the consumer: exactly-once at the table level."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=1, rows_per_file=4)
+        ref = list(CSVStreamingReader(d, batch_size=2).stream())
+        before = _counter("ingest_duplicate_batches_total")
+        coord = IngestCoordinator(CsvDirSource(d, batch_size=2),
+                                  n_shards=1, plan_fp="t").start()
+        host, port = coord.address
+        s = socket.create_connection((host, port))
+        try:
+            transport.send_frame(s, transport.HELLO,
+                                 {"worker_id": "fake", "pid": 0})
+            transport.send_frame(s, transport.REQUEST_WORK,
+                                 {"worker_id": "fake"})
+            kind, lease = transport.recv_frame(s)
+            assert kind == transport.LEASE
+            src = CsvDirSource(d, batch_size=2)
+
+            def emit(seq, fi, ci, rows):
+                frame = {"shard": 0, "seq": seq, "file": fi, "chunk": ci,
+                         "plan": "t", "rows": rows}
+                transport.send_frame(s, transport.BATCH, frame)
+                transport.send_frame(s, transport.BATCH, frame)  # replay
+
+            stats = extract_shard(
+                src, lease, emit,
+                lambda fi, nc, co=None: transport.send_frame(
+                    s, transport.FILE_DONE,
+                    {"shard": 0, "file": fi, "chunks": nc, "lease": 1,
+                     "plan": "t"}))
+            transport.send_frame(s, transport.SHARD_DONE,
+                                 {"shard": 0, "lease": lease["lease"],
+                                  "plan": "t", "stats": stats})
+            got = list(coord.stream())
+        finally:
+            s.close()
+            coord.close()
+        assert got == ref
+        assert _counter("ingest_duplicate_batches_total") - before == len(ref)
+
+    def test_torn_frames_recovered_by_lease_replay(self, tmp_path):
+        """Chaos rpc:torn on two ordinals: each torn frame severs the
+        connection (checksum-corrupt = dead peer), the worker reconnects,
+        the lease reassigns, replay fills the hole — output parity holds
+        and the frame errors are counted."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=4, rows_per_file=8)
+        ref = list(CSVStreamingReader(d, batch_size=4).stream())
+        before_torn = _counter("ingest_frame_errors_total",
+                               labels={"kind": "torn"})
+        before_re = _counter("ingest_lease_reassigned_total")
+        inj = FaultInjector(seed=0, rpc_torn=[(0, 0), (1, 1)])
+        with IngestCoordinator(CsvDirSource(d, batch_size=4), n_shards=2,
+                               plan_fp="t") as coord:
+            with inj.installed():
+                coord.launch_local_workers(2)
+                got = list(coord.stream())
+        assert got == ref
+        kinds = [e[0] for e in inj.events]
+        assert kinds.count("rpc_torn") == 2
+        assert _counter("ingest_frame_errors_total",
+                        labels={"kind": "torn"}) - before_torn == 2
+        assert _counter("ingest_lease_reassigned_total") - before_re == 2
+
+    def test_wedged_holder_lease_expires_and_reassigns(self, tmp_path):
+        """A connected-but-silent holder (wedged parse) is caught by
+        heartbeat expiry — not just by connection EOF — and its shard is
+        granted to a live worker."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=2, rows_per_file=6)
+        ref = list(CSVStreamingReader(d, batch_size=3).stream())
+        before = _counter("ingest_lease_expired_total")
+        coord = IngestCoordinator(CsvDirSource(d, batch_size=3), n_shards=1,
+                                  plan_fp="t", lease_timeout_s=0.6,
+                                  self_extract_after_s=60.0).start()
+        host, port = coord.address
+        s = socket.create_connection((host, port))
+        try:
+            transport.send_frame(s, transport.HELLO,
+                                 {"worker_id": "wedged", "pid": 0})
+            transport.send_frame(s, transport.REQUEST_WORK,
+                                 {"worker_id": "wedged"})
+            kind, _ = transport.recv_frame(s)
+            assert kind == transport.LEASE
+            # the wedged worker now goes silent; a healthy worker joins late
+            coord.launch_local_workers(1)
+            got = list(coord.stream())
+        finally:
+            s.close()
+            coord.close()
+        assert got == ref
+        assert _counter("ingest_lease_expired_total") - before == 1
+
+    def test_no_workers_self_extract_fallback(self, tmp_path):
+        """The whole fleet missing: after the grace period the coordinator
+        extracts pending shards in-process — the epoch completes as a slow
+        version of the in-process path, never a wedged run."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=3, rows_per_file=5)
+        ref = list(CSVStreamingReader(d, batch_size=2).stream())
+        before = _counter("ingest_self_extracted_shards_total")
+        with IngestCoordinator(CsvDirSource(d, batch_size=2), n_shards=2,
+                               plan_fp="t",
+                               self_extract_after_s=0.3) as coord:
+            got = list(coord.stream())
+        assert got == ref
+        assert _counter("ingest_self_extracted_shards_total") - before == 2
+
+    def test_worker_error_requeues_once_then_fails_epoch(self, tmp_path):
+        """First worker-reported extraction failure requeues the shard (the
+        holder may be sick); a second independent failure means the DATA is
+        bad — the epoch fails loudly, like the in-process reader would."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=1, rows_per_file=3)
+        coord = IngestCoordinator(CsvDirSource(d, batch_size=2), n_shards=1,
+                                  plan_fp="t",
+                                  self_extract_after_s=60.0).start()
+        host, port = coord.address
+
+        def failing_worker(wid):
+            s = socket.create_connection((host, port))
+            try:
+                transport.send_frame(s, transport.HELLO,
+                                     {"worker_id": wid, "pid": 0})
+                while True:
+                    transport.send_frame(s, transport.REQUEST_WORK,
+                                         {"worker_id": wid})
+                    kind, payload = transport.recv_frame(s)
+                    if kind == transport.LEASE:
+                        transport.send_frame(
+                            s, transport.ERROR,
+                            {"shard": payload["shard"],
+                             "lease": payload["lease"],
+                             "plan": payload["plan"],
+                             "type": "ValueError", "message": "bad bytes"})
+                    elif kind == transport.SHUTDOWN:
+                        return
+                    else:
+                        time.sleep(0.05)
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                s.close()
+
+        t = threading.Thread(target=failing_worker, args=("sick",),
+                             daemon=True)
+        t.start()
+        try:
+            with pytest.raises(IngestError, match="bad bytes"):
+                list(coord.stream())
+        finally:
+            coord.close()
+            t.join(timeout=5.0)
+
+    def test_stale_plan_fingerprint_rejected(self, tmp_path):
+        """Frames carrying another plan's fingerprint (a stale worker from a
+        previous run) are never committed."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=1, rows_per_file=2)
+        ref = list(CSVStreamingReader(d, batch_size=2).stream())
+        before = _counter("ingest_frame_errors_total",
+                          labels={"kind": "plan"})
+        coord = IngestCoordinator(CsvDirSource(d, batch_size=2), n_shards=1,
+                                  plan_fp="current",
+                                  self_extract_after_s=0.3).start()
+        host, port = coord.address
+        s = socket.create_connection((host, port))
+        try:
+            transport.send_frame(s, transport.HELLO,
+                                 {"worker_id": "stale", "pid": 0})
+            transport.send_frame(
+                s, transport.BATCH,
+                {"shard": 0, "seq": 0, "file": 0, "chunk": 0,
+                 "plan": "previous", "rows": [{"x1": "999", "cat": "z"}]})
+            got = list(coord.stream())  # completes via fallback extraction
+        finally:
+            s.close()
+            coord.close()
+        assert got == ref  # the stale row never reached the stream
+        assert _counter("ingest_frame_errors_total",
+                        labels={"kind": "plan"}) - before == 1
+
+    def test_early_exit_unblocks_promptly(self, tmp_path):
+        """request_stop (the LiveSource teardown hook) ends a blocked
+        stream() within a poll quantum — no 5 s join timeouts."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=1, rows_per_file=2)
+        coord = IngestCoordinator(CsvDirSource(d, batch_size=2), n_shards=1,
+                                  plan_fp="t",
+                                  self_extract_after_s=60.0).start()
+        out = []
+
+        def consume():
+            out.extend(coord.stream())
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)  # consumer is now blocked waiting for batches
+        t0 = time.monotonic()
+        coord.request_stop()
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+        assert time.monotonic() - t0 < 1.5
+        coord.close()
+
+
+# --- materialized-feature cache through the service -------------------------------------
+class TestCacheThroughService:
+    def test_second_epoch_hits_cache(self, tmp_path):
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=3, rows_per_file=6)
+        cache_dir = str(tmp_path / "cache")
+        ref = list(CSVStreamingReader(d, batch_size=4).stream())
+        before_h = _counter("ingest_cache_hits_total")
+        before_m = _counter("ingest_cache_misses_total")
+
+        def epoch():
+            with IngestCoordinator(CsvDirSource(d, batch_size=4), n_shards=2,
+                                   plan_fp="t", cache_dir=cache_dir) as c:
+                c.launch_local_workers(1)
+                return list(c.stream())
+
+        assert epoch() == ref
+        misses = _counter("ingest_cache_misses_total") - before_m
+        assert misses == 3  # one per file, first epoch parses everything
+        assert epoch() == ref
+        assert _counter("ingest_cache_hits_total") - before_h == 3
+
+
+# --- runner integration (subprocess workers: the production shape) ----------------------
+def _rows(n, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [{"label": float(i % 2), "x1": float(i % 2) + rng.normal(0, 0.1),
+             "cat": "abc"[int(rng.integers(0, 3))]} for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def trained_runner():
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    fs = features_from_schema(SCHEMA, response="label")
+    pred = LogisticRegression(l2=0.1)(
+        fs["label"], transmogrify([fs["x1"], fs["cat"]]))
+    runner = WorkflowRunner(Workflow().set_result_features(pred),
+                            train_reader=InMemoryReader(_rows(160)))
+    runner.run("train", OpParams())
+    return runner
+
+
+@pytest.fixture(scope="module")
+def stream_dir(tmp_path_factory):
+    return _write_stream_dir(
+        str(tmp_path_factory.mktemp("ingest_stream")), n_files=4,
+        rows_per_file=12)
+
+
+def _stream_run(runner, stream_dir, out_dir, **param_kw):
+    from transmogrifai_tpu.params import OpParams
+
+    runner.streaming_reader = CSVStreamingReader(stream_dir, batch_size=8)
+    res = runner.run("streaming_score",
+                     OpParams(write_location=str(out_dir), **param_kw))
+    parts = {}
+    for fname in sorted(os.listdir(out_dir)):
+        with open(os.path.join(out_dir, fname), "rb") as fh:
+            parts[fname] = fh.read()
+    return res, parts
+
+
+class TestRunnerIntegration:
+    def test_fault_free_remote_bit_identical_to_in_process(
+            self, tmp_path, trained_runner, stream_dir):
+        """THE parity bar: the service armed, zero faults — part files are
+        byte-identical to the in-process reader path."""
+        res0, parts0 = _stream_run(trained_runner, stream_dir,
+                                   tmp_path / "inproc")
+        res1, parts1 = _stream_run(trained_runner, stream_dir,
+                                   tmp_path / "remote", ingest_workers=2)
+        assert parts0 == parts1
+        assert res0.n_rows == res1.n_rows == 48
+
+    def test_chaos_kill_and_drop_byte_identical_and_deterministic(
+            self, tmp_path, trained_runner, stream_dir):
+        """THE acceptance chaos drill: one worker:kill (real SIGKILL of a
+        worker subprocess) and one rpc:drop mid-epoch. The run completes
+        with byte-identical part files vs fault-free, zero consumer-visible
+        errors, exactly 2 lease reassignments, and the same seed reproduces
+        the identical event log (sorted: the two faults land on concurrent
+        shard connections)."""
+        _, parts0 = _stream_run(trained_runner, stream_dir,
+                                tmp_path / "clean")
+
+        def chaos_run(tag):
+            inj = FaultInjector(seed=0, worker_kills=[(1, 1)],
+                                rpc_drops=[(0, 0)])
+            before = _counter("ingest_lease_reassigned_total")
+            with inj.installed():
+                res, parts = _stream_run(trained_runner, stream_dir,
+                                         tmp_path / tag, ingest_workers=2)
+            delta = _counter("ingest_lease_reassigned_total") - before
+            return res, parts, sorted(inj.events), delta
+
+        res1, parts1, ev1, re1 = chaos_run("chaos_a")
+        res2, parts2, ev2, re2 = chaos_run("chaos_b")
+        assert parts1 == parts0 and parts2 == parts0
+        assert res1.n_rows == res2.n_rows == 48
+        assert ev1 == ev2
+        assert [e[0] for e in ev1].count("worker_kill") == 1
+        assert [e[0] for e in ev1].count("rpc_drop") == 1
+        assert re1 == re2 == 2
+        assert res1.quarantine is None  # faults were infrastructural, not data
+
+    def test_remote_ingest_composes_with_quarantine(self, tmp_path,
+                                                    trained_runner,
+                                                    stream_dir):
+        """Consumer-side resilience is unchanged under remote ingest: a
+        poison batch injected into the stream still row-bisect quarantines
+        (rows mode ships parse work downstream of corrupt_batch exactly
+        like the in-process path)."""
+        inj = FaultInjector(seed=0, poison_batches=(1,))
+        with inj.installed():
+            res, parts = _stream_run(
+                trained_runner, stream_dir, tmp_path / "q_out",
+                ingest_workers=2, quarantine_dir=str(tmp_path / "q"),
+                retry_max=2)
+        assert res.n_rows == 47  # 48 - 1 poisoned
+        assert res.quarantine["rows"] == 1
+        assert res.quarantine["by_stage"] == {"parse": 1}
+
+    def test_unshardable_reader_is_loud(self, tmp_path, trained_runner):
+        from transmogrifai_tpu.params import OpParams
+        from transmogrifai_tpu.readers import BatchStreamingReader
+
+        trained_runner.streaming_reader = BatchStreamingReader([_rows(4)])
+        with pytest.raises(ValueError, match="ingest_workers"):
+            trained_runner.run("streaming_score", OpParams(
+                write_location=str(tmp_path / "out"), ingest_workers=2))
+
+
+# --- ProcessShardedReader reassembly parity (satellite) ---------------------------------
+class TestProcessShardParity:
+    @pytest.fixture()
+    def csv_path(self, tmp_path):
+        p = tmp_path / "data.csv"
+        rng = random.Random(3)
+        with open(p, "w", newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["x1", "cat"])
+            for i in range(10):
+                w.writerow([round(rng.uniform(-1, 1), 4), "abc"[i % 3]])
+        return str(p)
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 7, 50])
+    def test_stride_shards_reassemble_bit_identical(self, csv_path, n):
+        """Stride shards at ANY n_processes — including n > rows, where some
+        shards are empty — interleave back to the unsharded table exactly."""
+        from transmogrifai_tpu.graph import features_from_schema
+        from transmogrifai_tpu.readers import CSVReader, ProcessShardedReader
+
+        fs = features_from_schema({"x1": "Real", "cat": "PickList"})
+        feats = [fs["x1"], fs["cat"]]
+        base_rows = CSVReader(csv_path, {"x1": "Real", "cat": "PickList"}) \
+            .generate_table(feats).to_rows()
+        shard_rows = [
+            ProcessShardedReader(
+                CSVReader(csv_path, {"x1": "Real", "cat": "PickList"}),
+                process_index=k, n_processes=n).generate_table(feats).to_rows()
+            for k in range(n)]
+        assert sum(len(s) for s in shard_rows) == len(base_rows)
+        reassembled = [None] * len(base_rows)
+        for k, rows in enumerate(shard_rows):
+            for j, row in enumerate(rows):
+                reassembled[k + j * n] = row
+        assert reassembled == base_rows
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 9])
+    def test_file_stride_reassembles_csv_streaming_reader(self, tmp_path,
+                                                          n_shards):
+        """The ingest service's file-level stride sharding (the streaming
+        analog of ProcessShardedReader) reassembles the exact
+        CSVStreamingReader sequence at any shard count, including shards >
+        files."""
+        d = _write_stream_dir(str(tmp_path / "s"), n_files=4, rows_per_file=5)
+        ref = list(CSVStreamingReader(d, batch_size=2).stream())
+        spec = CsvDirSource(d, batch_size=2)
+        files = spec.list_files()
+        collected = {}
+        for shard in range(n_shards):
+            shard_files = [(i, name) for i, name in enumerate(files)
+                           if i % n_shards == shard]
+            extract_shard(
+                spec, {"files": shard_files, "files_done": {},
+                       "committed": {}},
+                lambda seq, fi, ci, rows: collected.__setitem__(
+                    (fi, ci), rows),
+                lambda fi, nc, co=None: None)
+        got = [collected[k] for k in sorted(collected)]
+        assert got == ref
+
+    def test_wrapped_opens_pick_up_ambient_policy(self, csv_path):
+        """A ProcessShardedReader-wrapped base reader's opens sit under the
+        ambient FaultPolicy: injected transient IO errors are absorbed by
+        retries; without a policy they fail fast."""
+        from transmogrifai_tpu.graph import features_from_schema
+        from transmogrifai_tpu.readers import CSVReader, ProcessShardedReader
+
+        fs = features_from_schema({"x1": "Real", "cat": "PickList"})
+        feats = [fs["x1"], fs["cat"]]
+        before = _counter("resilience_retries_total",
+                          labels={"site": "ingest:open"})
+
+        def sharded():
+            return ProcessShardedReader(
+                CSVReader(csv_path, {"x1": "Real", "cat": "PickList"}),
+                process_index=0, n_processes=2)
+
+        # budget 3: the native tokenizer open, the numpy-columnar fallback,
+        # AND the record-path open all fail — without a policy the wrapped
+        # read is out of options and the error surfaces
+        with FaultInjector(seed=0, io_failures=3).installed():
+            with pytest.raises(OSError):
+                sharded().generate_table(feats)
+        with FaultInjector(seed=0, io_failures=3).installed():
+            with scoped(FaultPolicy(retry_max=4, backoff_base_s=0.0)):
+                table = sharded().generate_table(feats)
+        assert table.nrows == 5
+        assert _counter("resilience_retries_total",
+                        labels={"site": "ingest:open"}) - before >= 1

@@ -28,8 +28,12 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 #: name fragments marking "lower is better" anywhere in the name
 #: (cold_start covers the AOT deploy-artifact lane: every cold_start_* wall
 #: metric regresses upward; cold_start_speedup stays higher-better via the
-#: override list, which is checked first)
-_LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start")
+#: override list, which is checked first; "recovery" covers the disagg-
+#: ingest lane's disagg_recovery_s — what one worker SIGKILL costs — which
+#: must regress upward like any wall metric even if renamed off the _s
+#: suffix)
+_LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
+                 "recovery")
 #: overrides: fragments that look like seconds but are throughput/quality
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",

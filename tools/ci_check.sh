@@ -158,6 +158,91 @@ print(f"chaos smoke ok: {len(inj.events)} faults injected, "
       f"({res.n_rows} rows scored)")
 PY
 
+echo "== disaggregated ingest worker-kill smoke =="
+# streamed scoring with extraction on 2 REAL worker subprocesses
+# (`op run --ingest-workers 2` machinery driven in-process): a seeded
+# chaos schedule SIGKILLs one worker mid-epoch. The run must complete
+# with the same output digest as the fault-free run (lease reassignment +
+# deterministic replay, dedupe by ordinal) and exactly one lease
+# reassignment must be recorded (docs/robustness.md "Distributed ingest
+# failure model").
+python - <<'PY'
+import csv, hashlib, os, random, tempfile
+
+import numpy as np
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.readers.streaming import CSVStreamingReader
+from transmogrifai_tpu.resilience import FaultInjector
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+rng = np.random.default_rng(0)
+rows = [{"label": float(i % 2), "x1": float(i % 2) + rng.normal(0, 0.1),
+         "cat": "abc"[i % 3]} for i in range(160)]
+fs = features_from_schema(
+    {"label": "RealNN", "x1": "Real", "cat": "PickList"}, response="label")
+pred = LogisticRegression(l2=0.1)(fs["label"],
+                                  transmogrify([fs["x1"], fs["cat"]]))
+runner = WorkflowRunner(Workflow().set_result_features(pred),
+                        train_reader=InMemoryReader(rows))
+runner.run("train", OpParams())
+
+work = tempfile.mkdtemp(prefix="ci_disagg_")
+stream_dir = os.path.join(work, "stream")
+os.makedirs(stream_dir)
+r2 = random.Random(7)
+for b in range(4):
+    with open(os.path.join(stream_dir, f"b-{b}.csv"), "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["x1", "cat"])
+        for i in range(16):
+            w.writerow([round(r2.uniform(-1, 1), 4), "abc"[i % 3]])
+
+
+def digest(out_dir):
+    h = hashlib.sha256()
+    for f in sorted(os.listdir(out_dir)):
+        h.update(f.encode())
+        h.update(open(os.path.join(out_dir, f), "rb").read())
+    return h.hexdigest()
+
+
+def run(tag, injector=None, workers=2):
+    import contextlib
+
+    out = os.path.join(work, tag)
+    runner.streaming_reader = CSVStreamingReader(stream_dir, batch_size=8)
+    ctx = injector.installed() if injector is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        res = runner.run("streaming_score", OpParams(
+            write_location=out, ingest_workers=workers))
+    assert res.n_rows == 64, res.n_rows
+    return digest(out)
+
+
+def reassigned():
+    c = obs.default_registry().find("ingest_lease_reassigned_total")
+    return c.value if c is not None else 0.0
+
+
+clean = run("clean")
+before = reassigned()
+inj = FaultInjector(seed=0, worker_kills=[(1, 1)])
+killed = run("killed", inj)
+assert killed == clean, "worker-kill run diverged from fault-free digest"
+kinds = [e[0] for e in inj.events]
+assert kinds == ["worker_kill"], inj.events
+assert reassigned() - before == 1, reassigned() - before
+print(f"disagg ingest smoke ok: 1 worker SIGKILLed mid-epoch, lease "
+      f"reassigned once, output digest identical ({clean[:12]})")
+PY
+
 echo "== serving daemon smoke (op serve over HTTP) =="
 # train+save a tiny model, start the daemon as a real subprocess (ephemeral
 # port, parsed off the ready line), score over HTTP, check /healthz and the

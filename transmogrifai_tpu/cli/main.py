@@ -64,6 +64,18 @@ def _cmd_run(argv) -> int:
                          "parse/scoring or produce non-finite scores are "
                          "row-bisect isolated into DIR/quarantine.jsonl and "
                          "the run completes with a partial-success summary")
+    ap.add_argument("--ingest-workers", type=int, default=None, metavar="N",
+                    help="streaming_score: disaggregate host extraction "
+                         "onto N worker subprocesses leased stride shards "
+                         "by an in-run coordinator; a dead or wedged worker "
+                         "is recovered by lease reassignment + "
+                         "deterministic replay, output stays byte-identical "
+                         "to in-process extraction (docs/robustness.md)")
+    ap.add_argument("--ingest-cache-dir", default=None, metavar="DIR",
+                    help="materialized-feature cache shared by ingest "
+                         "workers across runs (content-fingerprint keyed): "
+                         "grid-search consumers and restarted workers skip "
+                         "re-extraction")
     ap.add_argument("--chaos-seed", type=int, default=None, metavar="SEED",
                     help="chaos drill: run under FaultInjector.default_"
                          "schedule(SEED) — two transient IO errors, one "
@@ -85,6 +97,10 @@ def _cmd_run(argv) -> int:
         params.deadline_s = args.deadline_s
     if args.quarantine_dir is not None:
         params.quarantine_dir = args.quarantine_dir
+    if args.ingest_workers is not None:
+        params.ingest_workers = args.ingest_workers
+    if args.ingest_cache_dir is not None:
+        params.ingest_cache_dir = args.ingest_cache_dir
     if args.mesh is not None:
         from transmogrifai_tpu.mesh import parse_mesh_shape
 
@@ -349,6 +365,11 @@ def _cmd_serve(argv) -> int:
                     help="LRU capacity of the model cache (default 4)")
     ap.add_argument("--bucket-floor", type=int, default=None,
                     help="smallest warmed pow2 pad_to bucket (default 1)")
+    ap.add_argument("--queue-depth", type=int, default=None,
+                    help="bounded per-model request-queue depth: "
+                         "submissions beyond it get HTTP 429 + "
+                         "serve_shed_total instead of unbounded queueing "
+                         "(default 4096; OpParams.serve_queue_depth)")
     ap.add_argument("--backend", default="auto",
                     choices=["auto", "cpu", "device"],
                     help="serving lane policy: auto (default) routes by the "
@@ -385,6 +406,8 @@ def _cmd_serve(argv) -> int:
                   else params.serve_max_models)
     bucket_floor = (args.bucket_floor if args.bucket_floor is not None
                     else params.serve_bucket_floor)
+    queue_depth = (args.queue_depth if args.queue_depth is not None
+                   else params.serve_queue_depth)
     mesh = None
     if args.mesh is not None:
         from transmogrifai_tpu.mesh import default_mesh, parse_mesh_shape
@@ -400,7 +423,7 @@ def _cmd_serve(argv) -> int:
 
     daemon = ServingDaemon(
         max_models=max_models, max_wait_ms=max_wait_ms, max_batch=max_batch,
-        bucket_floor=bucket_floor,
+        bucket_floor=bucket_floor, queue_depth=queue_depth,
         backend={"auto": "auto", "cpu": "cpu", "device": None}[args.backend],
         mesh=mesh, warm=not args.no_warm, quarantine_root=quarantine_root,
         aot=not args.no_aot)
@@ -581,6 +604,9 @@ def main(argv=None) -> int:
             "  serve     persistent serving daemon: multi-model cache + "
             "adaptive micro-batching over HTTP/JSON "
             "(--model [NAME=]DIR --port 8000)\n"
+            "  ingest-worker  disaggregated feature-extraction worker: "
+            "lease stride shards from a run's coordinator and stream "
+            "parsed batches back (--connect HOST:PORT)\n"
             "  warmup    pre-seed the compile cache for planned train shapes "
             "(--serving MODEL_DIR warms the serving buckets)\n"
             "  version   print framework version"
@@ -600,6 +626,10 @@ def main(argv=None) -> int:
         return _cmd_monitor(rest)
     if cmd == "serve":
         return _cmd_serve(rest)
+    if cmd == "ingest-worker":
+        from transmogrifai_tpu.ingest.worker import main as worker_main
+
+        return worker_main(rest)
     if cmd == "warmup":
         return _cmd_warmup(rest)
     print(f"op: unknown command {cmd!r}", file=sys.stderr)

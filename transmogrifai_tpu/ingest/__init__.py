@@ -1,0 +1,33 @@
+"""Disaggregated feature-extraction service (the tf.data-service analog,
+PAPERS.md arXiv 2210.14826): host ingest split from device compute across
+process boundaries, fault-tolerant from day one.
+
+N extraction worker processes (`op ingest-worker`, or in-process threads for
+tests — same socket code path either way) parse their stride shards of the
+source and push batches to the consumer-side `IngestCoordinator` over a
+length-prefixed, CRC-checked frame protocol (transport.py). The coordinator
+hands out shard leases with heartbeat expiry, dedupes batches by ordinal,
+re-orders them into the exact sequence the in-process reader would have
+produced, and plugs into the existing `Prefetcher`/`run_pipeline` input
+executor as a live source — so a fault-free run with the service armed is
+bit-identical to the in-process path, and a SIGKILLed worker mid-epoch
+changes nothing but the `ingest_lease_reassigned_total` counter
+(docs/robustness.md "Distributed ingest failure model").
+"""
+from .cache import FeatureCache, cache_key
+from .coordinator import IngestCoordinator
+from .source import CsvDirSource, source_from_wire
+from .transport import FrameError, recv_frame, send_frame
+from .worker import IngestWorker
+
+__all__ = [
+    "CsvDirSource",
+    "FeatureCache",
+    "FrameError",
+    "IngestCoordinator",
+    "IngestWorker",
+    "cache_key",
+    "recv_frame",
+    "send_frame",
+    "source_from_wire",
+]

@@ -1,0 +1,88 @@
+"""Materialized-feature cache: extraction results keyed by fingerprints.
+
+A worker that already parsed file F for extraction format E never parses it
+again — and neither does any OTHER worker or consumer process pointed at the
+same cache directory: restarted workers resume warm, and a grid search
+scoring the same table N times pays the parse once
+(ROADMAP "materialized-feature cache keyed by plan fingerprint").
+
+Keying: `cache_key(extraction_fp, data_fp)` where `extraction_fp` comes from
+the source spec (payload format + chunking knobs; for vectorized payload
+formats this is where `analyze.plan_fingerprint` slots in) and `data_fp` is
+the sha256 of the file BYTES — content, never (path, mtime), so a synced
+replica with different timestamps still hits and a silently rewritten file
+can never serve stale rows.
+
+Entries are one JSON file per key, written via same-dir temp + `os.replace`
+(the atomic-publish discipline of WorkflowModel.save): a worker SIGKILLed
+mid-write leaves no torn entry, and concurrent writers of the same key are
+idempotent last-write-wins of identical bytes. A corrupt entry (torn by an
+external copy, truncated disk) reads as a MISS, never an error.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+
+def cache_key(extraction_fp: str, data_fp: str) -> str:
+    return hashlib.sha256(
+        f"{extraction_fp}\x00{data_fp}".encode("utf-8")).hexdigest()
+
+
+def data_fingerprint(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class FeatureCache:
+    """Directory-backed extraction cache. `get`/`put` are thread-safe and
+    crash-safe; stats are local tallies the worker reports upstream in its
+    SHARD_DONE frame (the coordinator owns the metrics registry — worker
+    subprocesses have no registry anyone scrapes)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.json")
+
+    def get(self, key: str) -> Optional[list]:
+        try:
+            with open(self._path(key), "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            chunks = doc["chunks"]
+            if not isinstance(chunks, list):
+                raise ValueError("cache entry chunks must be a list")
+        except (OSError, ValueError, KeyError):
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return chunks
+
+    def put(self, key: str, chunks: list) -> None:
+        final = self._path(key)
+        tmp = f"{final}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"chunks": chunks}, fh, separators=(",", ":"))
+            os.replace(tmp, final)
+        except OSError:
+            # cache is an accelerator, never a correctness dependency: a full
+            # disk degrades to re-parsing, not to a dead worker
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"cache_hits": self.hits, "cache_misses": self.misses}

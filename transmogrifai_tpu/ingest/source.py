@@ -1,0 +1,84 @@
+"""Shardable source specs for the ingest service.
+
+A spec is a small, JSON-serializable description of a deterministic batch
+stream — the coordinator ships it to workers inside a LEASE frame, and any
+holder of shard `s` re-derives the IDENTICAL batch sequence from it (the
+property lease reassignment's deterministic replay rests on).
+
+The global stream is defined exactly like the in-process reader it mirrors
+(`CSVStreamingReader`): files in sorted name order; within a file, chunks of
+`batch_size` rows (the whole file as one batch when None), the final chunk
+ragged. The batch ordinal is the pair `(file_index, chunk_index)` — ordinals
+never depend on other files' row counts, so a worker assigns them without
+any cross-worker coordination. Sharding is stride over FILE index
+(`file_index % n_shards == shard`, the `ProcessShardedReader` discipline one
+level up), so a worker parses only its own files. With a power-of-two
+`batch_size`, every transport batch but per-file finals is pow2-sized and
+the consumer's pad buckets collapse to one program shape.
+"""
+from __future__ import annotations
+
+import csv
+import io
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class CsvDirSource:
+    """A directory of CSV files, one deterministic micro-batch stream — the
+    wire-shippable twin of `readers.streaming.CSVStreamingReader` (which
+    gains `ingest_spec()` returning one of these)."""
+
+    directory: str
+    batch_size: Optional[int] = None
+
+    def list_files(self) -> list[str]:
+        """Sorted .csv file names (relative to the directory). The
+        COORDINATOR calls this once per epoch and ships the explicit list in
+        each lease, so every holder works from one frozen listing even if
+        the directory changes mid-epoch."""
+        return sorted(f for f in os.listdir(self.directory)
+                      if f.endswith(".csv"))
+
+    def read_file(self, name: str) -> bytes:
+        with open(os.path.join(self.directory, name), "rb") as fh:
+            return fh.read()
+
+    def parse(self, data: bytes) -> list[dict]:
+        """Byte-for-byte the `CSVStreamingReader` parse: csv.DictReader over
+        the text with newline translation disabled (quoted embedded newlines
+        survive), every row a plain {str: str} dict."""
+        text = io.StringIO(data.decode("utf-8"), newline="")
+        return [dict(r) for r in csv.DictReader(text)]
+
+    def chunks(self, rows: list[dict]) -> list[list[dict]]:
+        if self.batch_size is None:
+            return [rows]
+        bs = int(self.batch_size)
+        return [rows[i:i + bs] for i in range(0, len(rows), bs)]
+
+    # --- wire format ------------------------------------------------------------------
+    def to_wire(self) -> dict:
+        return {"kind": "csv_dir", "directory": os.path.abspath(self.directory),
+                "batch_size": self.batch_size}
+
+    #: part of the extraction fingerprint: bump when the parse or chunking
+    #: semantics change, so stale cache entries can never masquerade as
+    #: current extractions
+    FORMAT_VERSION = "csv_dir:rows:v1"
+
+    def extraction_fingerprint(self) -> str:
+        """What the materialized-feature cache keys on alongside the data
+        fingerprint: the payload format + every knob that changes the parsed
+        output. Deliberately NOT the consumer's plan fingerprint — parsed
+        rows are plan-independent, which is exactly what lets grid-search
+        consumers with different plans share one cache."""
+        return f"{self.FORMAT_VERSION}|batch={self.batch_size}"
+
+
+def source_from_wire(d: dict) -> CsvDirSource:
+    if d.get("kind") != "csv_dir":
+        raise ValueError(f"unknown ingest source kind {d.get('kind')!r}")
+    return CsvDirSource(directory=d["directory"], batch_size=d["batch_size"])

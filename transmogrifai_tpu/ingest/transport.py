@@ -1,0 +1,92 @@
+"""Length-prefixed, CRC-checked frame protocol for the ingest service.
+
+One frame on the wire:
+
+    magic(2) | kind(1) | length(4, big-endian) | crc32(4) | payload(length)
+
+The payload is UTF-8 JSON — ingest batches are parsed CSV record dicts, so
+JSON round-trips them exactly (byte-identity downstream depends on it) and
+keeps the wire format debuggable with `nc`. The CRC covers the payload, so a
+torn or bit-flipped frame is DETECTED, never silently consumed: `recv_frame`
+raises `FrameError` (an `OSError`, hence classified TRANSIENT by
+resilience/policy.py) and the peer treats the connection as dead — recovery
+is the lease/replay machinery's job, not a protocol-level resend. A short
+read (the socket died mid-frame) surfaces the same way as `ConnectionError`.
+
+Frame kinds are one-byte tags; both sides reject unknown tags loudly. The
+protocol is deliberately dumb: no negotiation, no compression, no pipelined
+acks — determinism and detectability over cleverness.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+
+MAGIC = b"\xf7\x01"
+
+#: frame kinds (worker -> coordinator unless noted)
+HELLO = 1         # {worker_id, pid, plan}
+REQUEST_WORK = 2  # {worker_id}
+BATCH = 3         # {shard, seq, file, chunk, plan, rows}
+FILE_DONE = 4     # {shard, file, chunks}
+SHARD_DONE = 5    # {shard, lease, stats}
+HEARTBEAT = 6     # {shard, lease}
+LEASE = 7         # coordinator ->: {shard, n_shards, lease, plan, source,
+                  #                  files, files_done, committed}
+IDLE = 8          # coordinator ->: {poll_s} — no pending shard right now
+SHUTDOWN = 9      # coordinator ->: {} — epoch complete, exit the loop
+ERROR = 10        # {shard, lease, type, message} — extraction failed after
+                  # the worker's own retries (requeue once, then fatal)
+
+_HEADER = struct.Struct(">2sBII")
+
+#: refuse absurd frames before allocating for them (a corrupt length field
+#: must not ask recv for gigabytes)
+MAX_FRAME_BYTES = 64 << 20
+
+
+class FrameError(OSError):
+    """Torn, corrupt, or malformed frame. An OSError on purpose: the fault
+    policy classifies it TRANSIENT, and the connection-level recovery
+    (reconnect + lease reassignment + deterministic replay) owns it."""
+
+
+def send_frame(sock: socket.socket, kind: int, payload: dict) -> None:
+    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    header = _HEADER.pack(MAGIC, kind, len(body), zlib.crc32(body))
+    sock.sendall(header + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, dict]:
+    """Read one frame; returns (kind, payload). Raises `ConnectionError` on a
+    clean or torn close, `FrameError` on a corrupt header/checksum/payload."""
+    head = _recv_exact(sock, _HEADER.size)
+    magic, kind, length, crc = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds {MAX_FRAME_BYTES}")
+    body = _recv_exact(sock, length) if length else b""
+    if zlib.crc32(body) != crc:
+        raise FrameError(
+            f"frame checksum mismatch (kind={kind}, {length} bytes)")
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except ValueError as e:
+        raise FrameError(f"frame payload is not valid JSON: {e}") from e
+    if not isinstance(payload, dict):
+        raise FrameError("frame payload must be a JSON object")
+    return kind, payload

@@ -1,0 +1,254 @@
+"""Ingest extraction worker: lease-driven stride-shard extraction loop.
+
+One worker = one connection to the coordinator (`IngestCoordinator`), run as
+a subprocess (`op ingest-worker --connect HOST:PORT`, spawned by
+`op run --ingest-workers N`) or as an in-process thread for tests — the
+socket code path is identical either way.
+
+Protocol loop: HELLO, then REQUEST_WORK; the coordinator answers LEASE (a
+shard to extract: explicit file list, what is already committed, the plan
+fingerprint), IDLE (poll again later — idle polls double as liveness), or
+SHUTDOWN (epoch complete). Extraction walks the shard's files in order,
+skipping work the lease says is already done, and pushes BATCH / FILE_DONE /
+SHARD_DONE frames. Batch `seq` numbers are the shard-local batch ordinals of
+the DETERMINISTIC extraction sequence — a replacement holder after a lease
+reassignment re-derives the identical ordinals, which is what makes replay
+idempotent (the coordinator dedupes by ordinal) and the chaos schedule
+reproducible (FaultInjector keys ingest faults by (shard, seq)).
+
+Failure posture: file reads retry under the worker's FaultPolicy at the
+`ingest:open` site (same classification as every other reader open); a lost
+or torn connection triggers reconnect-with-backoff and a fresh HELLO — the
+old lease is the coordinator's to revoke and requeue, and anything this
+worker had already delivered stays committed. A data error that survives
+retries is reported upstream (ERROR frame) instead of dying silently: the
+coordinator requeues the shard once for a different holder, then fails the
+epoch loudly — matching the in-process reader's fail-fast contract.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Optional
+
+from ..resilience.policy import FaultPolicy, io_guard, retry_call, scoped
+from . import transport
+from .cache import FeatureCache, cache_key, data_fingerprint
+from .source import source_from_wire
+
+
+def extract_shard(source, lease: dict, emit_batch, emit_file_done,
+                  cache: Optional[FeatureCache] = None,
+                  heartbeat=None) -> dict:
+    """Walk one shard lease deterministically, emitting only uncommitted
+    work. Shared by the worker loop and the coordinator's in-process
+    fallback extraction (`IngestCoordinator._self_extract`) — one
+    implementation of the ordinal assignment, or replay could diverge.
+
+    `lease` carries: `files` ([[file_index, name], ...] in global order),
+    `files_done` ({file_index: n_chunks} fully-committed files — skipped
+    without a read, their chunk counts keep `seq` stable), `committed`
+    ({file_index: [chunk, ...]} partially-committed files — re-parsed, the
+    committed chunks advance `seq` but are not re-sent).
+    Returns extraction stats for the SHARD_DONE frame."""
+    files_done = {int(k): int(v)
+                  for k, v in (lease.get("files_done") or {}).items()}
+    committed = {int(k): set(v)
+                 for k, v in (lease.get("committed") or {}).items()}
+    stats = {"files": 0, "rows": 0, "batches_sent": 0,
+             "cache_hits": 0, "cache_misses": 0}
+    seq = 0
+    for file_index, name in lease["files"]:
+        file_index = int(file_index)
+        known = files_done.get(file_index)
+        if known is not None:
+            seq += known
+            continue
+        if heartbeat is not None:
+            heartbeat()
+        # the open/read retries under the ambient fault policy (and consults
+        # the chaos injector) exactly like CSVStreamingReader's per-file open
+        data = io_guard("ingest:open", lambda n=name: source.read_file(n))
+        if heartbeat is not None:
+            # a second beat between the read and the parse: each is its own
+            # potentially-long phase, and BATCH frames (the implicit beats)
+            # only start once the parse finishes. The holder of a file whose
+            # single read OR parse exceeds lease_timeout_s still expires —
+            # size the timeout above the worst single-file phase
+            # (IngestCoordinator docstring).
+            heartbeat()
+        chunks = None
+        cache_outcome = None
+        if cache is not None:
+            key = cache_key(source.extraction_fingerprint(),
+                            data_fingerprint(data))
+            chunks = cache.get(key)
+            cache_outcome = "hit" if chunks is not None else "miss"
+            stats["cache_hits" if chunks is not None
+                  else "cache_misses"] += 1
+        if chunks is None:
+            chunks = source.chunks(source.parse(data))
+            if cache is not None:
+                cache.put(key, chunks)
+        done = committed.get(file_index, ())
+        for chunk_index, rows in enumerate(chunks):
+            if chunk_index not in done:
+                emit_batch(seq, file_index, chunk_index, rows)
+                stats["batches_sent"] += 1
+                stats["rows"] += len(rows)
+            seq += 1
+        # the cache outcome rides FILE_DONE, not SHARD_DONE: emission cannot
+        # finish until every FILE_DONE is processed, so per-file accounting
+        # can never race the epoch's end the way a trailing summary frame can
+        emit_file_done(file_index, len(chunks), cache_outcome)
+        stats["files"] += 1
+    return stats
+
+
+class IngestWorker:
+    """The blocking worker loop (`run()`); one instance per connection."""
+
+    def __init__(self, address, *, worker_id: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 policy: Optional[FaultPolicy] = None,
+                 poll_s: float = 0.2):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        self.address = (address[0], int(address[1]))
+        self.worker_id = worker_id or f"w-{os.getpid()}-{id(self) & 0xffff:x}"
+        self.cache = FeatureCache(cache_dir) if cache_dir else None
+        #: connect/read retries; seeded-jitter backoff, same policy type as
+        #: every other resilience site
+        self.policy = policy if policy is not None else FaultPolicy(
+            retry_max=5, backoff_base_s=0.05, backoff_cap_s=1.0)
+        self.poll_s = float(poll_s)
+        self._sock: Optional[socket.socket] = None
+        self._stopped = False
+
+    # --- connection management --------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        def attempt():
+            s = socket.create_connection(self.address, timeout=10.0)
+            s.settimeout(None)
+            transport.send_frame(s, transport.HELLO,
+                                 {"worker_id": self.worker_id,
+                                  "pid": os.getpid()})
+            return s
+
+        return retry_call(attempt, policy=self.policy, site="ingest:connect")
+
+    def _send(self, kind: int, payload: dict) -> None:
+        transport.send_frame(self._sock, kind, payload)
+
+    def stop(self) -> None:
+        """Ask the loop to exit at the next control point (thread workers)."""
+        self._stopped = True
+
+    # --- main loop --------------------------------------------------------------------
+    def run(self) -> None:
+        with scoped(self.policy):
+            self._run_loop()
+
+    def _run_loop(self) -> None:
+        self._sock = self._connect()
+        idle_polls = 0
+        while not self._stopped:
+            try:
+                self._send(transport.REQUEST_WORK,
+                           {"worker_id": self.worker_id})
+                reply = transport.recv_frame(self._sock)
+                kind, payload = reply
+                if kind == transport.SHUTDOWN:
+                    return
+                if kind == transport.IDLE:
+                    idle_polls += 1
+                    time.sleep(float(payload.get("poll_s", self.poll_s)))
+                    continue
+                if kind != transport.LEASE:
+                    raise transport.FrameError(
+                        f"unexpected control frame kind {kind}")
+                idle_polls = 0
+                self._extract(payload)
+            except (ConnectionError, transport.FrameError, OSError):
+                # the lease (if any) dies with the connection — the
+                # coordinator requeues it and replay picks up the slack.
+                # Reconnect under the retry policy; exhaustion means the
+                # coordinator is gone for good, so the worker exits.
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                try:
+                    self._sock = self._connect()
+                except (ConnectionError, OSError):
+                    return
+
+    def _extract(self, lease: dict) -> None:
+        shard = int(lease["shard"])
+        lease_id = int(lease["lease"])
+        plan = lease.get("plan")
+        source = source_from_wire(lease["source"])
+
+        def emit_batch(seq, file_index, chunk_index, rows):
+            self._send(transport.BATCH,
+                       {"shard": shard, "seq": seq, "file": file_index,
+                        "chunk": chunk_index, "plan": plan, "rows": rows})
+
+        def emit_file_done(file_index, n_chunks, cache_outcome=None):
+            self._send(transport.FILE_DONE,
+                       {"shard": shard, "file": file_index,
+                        "chunks": n_chunks, "lease": lease_id,
+                        "plan": plan, "cache": cache_outcome})
+
+        def heartbeat():
+            self._send(transport.HEARTBEAT,
+                       {"shard": shard, "lease": lease_id})
+
+        try:
+            stats = extract_shard(source, lease, emit_batch, emit_file_done,
+                                  cache=self.cache, heartbeat=heartbeat)
+        except (ConnectionError, transport.FrameError):
+            raise  # connection-level: the reconnect loop owns it
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            self._send(transport.ERROR,
+                       {"shard": shard, "lease": lease_id, "plan": plan,
+                        "type": type(e).__name__, "message": str(e)[:500]})
+            return
+        self._send(transport.SHARD_DONE,
+                   {"shard": shard, "lease": lease_id, "plan": plan,
+                    "stats": stats})
+
+
+def main(argv=None) -> int:
+    """`op ingest-worker` / `python -m transmogrifai_tpu.ingest.worker`."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="op ingest-worker",
+        description="disaggregated feature-extraction worker: connect to a "
+                    "run's ingest coordinator, lease stride shards, parse "
+                    "them, and stream batches back (docs/robustness.md "
+                    "'Distributed ingest failure model')")
+    ap.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="the coordinator's listening address (printed by "
+                         "`op run --ingest-workers` / IngestCoordinator)")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="materialized-feature cache directory (shared "
+                         "across workers and runs; keyed by extraction "
+                         "format + file-content fingerprints)")
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--retry-max", type=int, default=5,
+                    help="connect/read retries before giving up (default 5)")
+    args = ap.parse_args(argv)
+    worker = IngestWorker(
+        args.connect, worker_id=args.worker_id, cache_dir=args.cache_dir,
+        policy=FaultPolicy(retry_max=args.retry_max, backoff_base_s=0.05,
+                           backoff_cap_s=1.0))
+    worker.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

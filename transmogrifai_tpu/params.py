@@ -70,6 +70,20 @@ class OpParams:
     #: their offending rows there and the run completes with a partial-
     #: success summary instead of dying. None = poison fails the run.
     quarantine_dir: Optional[str] = None
+    #: --- disaggregated ingest (ingest/; docs/robustness.md) ---
+    #: streaming_score: run host-side extraction on N worker SUBPROCESSES
+    #: leased stride shards by an in-run coordinator; batches return over a
+    #: checksummed socket protocol, deduped by ordinal, in the exact order
+    #: the in-process reader yields (byte-identical output — a dead worker's
+    #: lease is reassigned and replayed). 0 = in-process extraction (today's
+    #: path). CLI: `op run --ingest-workers N`. Needs a shardable streaming
+    #: reader (CSVStreamingReader without a transform).
+    ingest_workers: int = 0
+    #: materialized-feature cache directory shared by ingest workers across
+    #: runs (keyed by extraction format + file-content fingerprints):
+    #: restarted workers and grid-search consumers skip re-extraction.
+    #: CLI: `op run --ingest-cache-dir DIR`.
+    ingest_cache_dir: Optional[str] = None
     #: --- serving daemon (`op serve`; serve/daemon.py, docs/serving.md) ---
     #: adaptive micro-batcher max-wait (milliseconds): how long the first
     #: request of a coalescing window waits for company before a partial
@@ -84,6 +98,11 @@ class OpParams:
     #: LRU capacity of the daemon's multi-model cache: models past this are
     #: evicted least-recently-used (their batchers drained first)
     serve_max_models: int = 4
+    #: bounded depth of each model's micro-batcher request queue: submissions
+    #: beyond it are SHED (HTTP 429 + `serve_shed_total{model}`) instead of
+    #: growing the queue — an overloaded daemon stays bounded-latency for
+    #: the requests it does accept
+    serve_queue_depth: int = 4096
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

@@ -146,6 +146,37 @@ class ClosableQueue:
                 return
 
 
+class LiveSource:
+    """Adapter: any remote/live batch stream as a first-class pipeline
+    source. Wraps an iterator factory (e.g. `IngestCoordinator.stream`, a
+    subscription, a socket drain) plus a stop callback, and implements the
+    `on_pipeline_close` hook `Prefetcher.close()` invokes FIRST at teardown
+    — so an early exit unblocks a producer that is waiting inside the remote
+    stream within one poll quantum instead of timing out the close join
+    (the `_CoalescedSource` contract, generalized).
+
+    `transform` optionally rewrites the stream inside the adapter (e.g.
+    `rebatch`) so re-chunking composes WITHOUT losing the close hook — a
+    bare generator wrapped around the source would."""
+
+    def __init__(self, stream_fn: Callable[[], Iterable],
+                 stop_fn: Optional[Callable[[], None]] = None,
+                 transform: Optional[Callable[[Iterable], Iterable]] = None):
+        self._stream_fn = stream_fn
+        self._stop_fn = stop_fn
+        self._transform = transform
+
+    def __iter__(self) -> Iterator[Any]:
+        it = self._stream_fn()
+        if self._transform is not None:
+            it = self._transform(it)
+        return iter(it)
+
+    def on_pipeline_close(self) -> None:
+        if self._stop_fn is not None:
+            self._stop_fn()
+
+
 @dataclass
 class PipelineStats:
     """Aggregated timing of one pipeline run.

@@ -276,6 +276,18 @@ class CSVStreamingReader(StreamingReader):
         self.batch_size = batch_size
         self.transform = transform
 
+    def ingest_spec(self):
+        """Wire-shippable source spec for the disaggregated ingest service
+        (`op run --ingest-workers N`): extraction workers re-derive this
+        reader's EXACT batch sequence from it. None when the reader carries
+        a `transform` callable — arbitrary Python cannot ship to a worker
+        process, and silently dropping it would change the output bytes."""
+        if self.transform is not None:
+            return None
+        from ..ingest.source import CsvDirSource
+
+        return CsvDirSource(self.directory, self.batch_size)
+
     def stream(self) -> Iterator[list[dict]]:
         from ..resilience.policy import io_guard
 

@@ -2,8 +2,11 @@
 
 The thing the resilience test suite and the CI chaos lane drive: inject IO
 errors at reader opens, torn/poison rows into streamed batches, slow batches
-into the pipeline's prepare stage, and device-dispatch failures into the
-serving lane — all on a reproducible schedule derived from a seed and
+into the pipeline's prepare stage, device-dispatch failures into the
+serving lane, and distributed-ingest faults — `worker:kill` (SIGKILL a live
+extraction worker at a seeded batch ordinal), `rpc:drop` (sever a worker
+connection mid-stream), `rpc:torn` (corrupt a frame so the checksum
+rejects it) — all on a reproducible schedule derived from a seed and
 explicit budgets, never wall clock. Two runs with the same injector
 configuration produce the identical `events` log, the identical retry
 sequence, and byte-identical quarantine sidecars (pinned by
@@ -54,7 +57,10 @@ class FaultInjector:
                  poison_batches: Sequence[int] = (),
                  torn_batches: Sequence[int] = (),
                  slow_batches: Sequence[int] = (), slow_s: float = 0.05,
-                 device_failures: int = 0):
+                 device_failures: int = 0,
+                 worker_kills: Sequence = (),
+                 rpc_drops: Sequence = (),
+                 rpc_torn: Sequence = ()):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self.io_rate = float(io_rate)
@@ -64,7 +70,20 @@ class FaultInjector:
         self.poison_batches = frozenset(int(b) for b in poison_batches)
         self.torn_batches = frozenset(int(b) for b in torn_batches)
         self.slow_batches = frozenset(int(b) for b in slow_batches)
-        #: deterministic event log: (kind, site, call_or_batch_index[, row])
+        #: distributed-ingest faults, keyed by (shard, seq) — the shard-local
+        #: BATCH ordinal carried in every ingest frame. Frame seqs are
+        #: deterministic properties of the extraction (a replacement holder
+        #: re-derives the identical ordinals), so keying on them makes the
+        #: schedule reproducible even though frame ARRIVAL order races
+        #: across worker connections. Each scheduled fault fires exactly
+        #: once: a replayed frame cannot re-trigger a consumed entry.
+        self.worker_kills = {(int(s), int(q)) for s, q in worker_kills}
+        self.rpc_drops = {(int(s), int(q)) for s, q in rpc_drops}
+        self.rpc_torn = {(int(s), int(q)) for s, q in rpc_torn}
+        #: deterministic event log: (kind, site, call_or_batch_index[, row]).
+        #: Single-site schedules log in a deterministic order; faults on
+        #: DIFFERENT ingest shards land on concurrent handler threads, so
+        #: multi-shard logs are deterministic as a SET (compare sorted).
         self.events: list[tuple] = []
         self._calls: dict[str, int] = {}
         self._lock = threading.Lock()
@@ -123,6 +142,31 @@ class FaultInjector:
             raise InjectedDispatchError(
                 f"chaos[{self.seed}]: injected dispatch failure at {site} "
                 f"call {idx}")
+
+    def ingest_fault(self, shard: int, seq: int) -> Optional[str]:
+        """Distributed-ingest injection, consulted by the coordinator as it
+        processes each BATCH frame. Returns the fault to apply to THIS frame
+        — "kill" (SIGKILL the sending worker after the frame commits),
+        "drop" (sever the connection before the frame commits), "torn"
+        (treat the frame as checksum-corrupt) — or None. Precedence when one
+        (shard, seq) is scheduled for several: kill > drop > torn."""
+        key = (int(shard), int(seq))
+        with self._lock:
+            if key in self.worker_kills:
+                self.worker_kills.discard(key)
+                fault = ("worker_kill", "worker:kill")
+            elif key in self.rpc_drops:
+                self.rpc_drops.discard(key)
+                fault = ("rpc_drop", "rpc:drop")
+            elif key in self.rpc_torn:
+                self.rpc_torn.discard(key)
+                fault = ("rpc_torn", "rpc:torn")
+            else:
+                return None
+        kind, site = fault
+        self._record(kind, site, int(seq), shard=int(shard))
+        return {"worker_kill": "kill", "rpc_drop": "drop",
+                "rpc_torn": "torn"}[kind]
 
     def slow(self, site: str, index: int) -> None:
         if index in self.slow_batches:
@@ -228,3 +272,10 @@ def corrupt_batch(rows, index: int):
     if inj is not None:
         return inj.corrupt(rows, index)
     return rows
+
+
+def maybe_ingest_fault(shard: int, seq: int) -> Optional[str]:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.ingest_fault(shard, seq)
+    return None

@@ -52,7 +52,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from queue import Empty
+from queue import Empty, Full
 from typing import Optional, Sequence
 
 from .. import obs
@@ -65,6 +65,14 @@ _SIZE_BUCKETS = tuple(float(1 << i) for i in range(13))
 #: short poll quantum for the coalescing waits: bounds both deadline
 #: overshoot and how long a torn-down stream's producer can linger
 _POLL_S = 0.05
+
+
+class Overloaded(RuntimeError):
+    """The batcher's bounded request queue is full: this submission was SHED
+    (never enqueued, never silently dropped). The daemon maps it to HTTP 429
+    — an overloaded replica answers fast with "try elsewhere/later" instead
+    of growing an unbounded queue whose every occupant times out anyway.
+    Counted on `serve_shed_total{model}`."""
 
 
 class _Pending:
@@ -152,6 +160,11 @@ class MicroBatcher:
             "serve_coalesced_batch_size",
             help="rows per coalesced serving dispatch",
             labels={"model": self.model_label}, buckets=_SIZE_BUCKETS)
+        self._shed_counter = reg.counter(
+            "serve_shed_total",
+            help="requests shed (HTTP 429) because the bounded request "
+                 "queue was full",
+            labels={"model": self.model_label})
         self._worker = threading.Thread(
             target=self._run, daemon=True,
             name=f"serve-batcher-{self.model_label}")
@@ -160,11 +173,14 @@ class MicroBatcher:
     # --- client surface ---------------------------------------------------------------
     def submit(self, records: Sequence) -> Future:
         """Enqueue one request (a list of record dicts); raises StreamClosed
-        after close() and ValueError past `max_batch` rows (an oversized
+        after close(), ValueError past `max_batch` rows (an oversized
         request would dispatch at an unwarmed, unpadded shape — callers
         split bulk work, or use `score_fn.batch`/`.stream` directly, which
-        is the right tool for it). The Future resolves to the per-record
-        result list."""
+        is the right tool for it), and `Overloaded` when the bounded
+        request queue (`queue_depth`) is full — the overload guard: beyond
+        the bound the daemon sheds with 429 + `serve_shed_total{model}`
+        rather than queueing without limit. The Future resolves to the
+        per-record result list."""
         records = list(records)
         if len(records) > self._max_batch:
             raise ValueError(
@@ -174,7 +190,16 @@ class MicroBatcher:
         if not records:
             f.set_result([])
             return f
-        self._requests.put(_Pending(records, f, time.perf_counter()))
+        try:
+            self._requests.put(_Pending(records, f, time.perf_counter()),
+                               timeout=0.0)
+        except Full:
+            self._shed_counter.inc()
+            obs.add_event("serve:shed", model=self.model_label,
+                          pending=self._requests.qsize())
+            raise Overloaded(
+                f"model {self.model_label!r}: request queue full "
+                f"({self._requests.qsize()} pending); shedding") from None
         return f
 
     def score(self, records: Sequence, timeout: Optional[float] = None):

@@ -46,7 +46,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from .. import obs
-from .batcher import MicroBatcher
+from .batcher import MicroBatcher, Overloaded
 from .scoring import score_function
 
 
@@ -158,12 +158,16 @@ class ServingDaemon:
                  max_batch: int = 256, bucket_floor: int = 1,
                  backend: Optional[str] = "auto", mesh=None, policy=None,
                  warm: bool = True, prefetch: int = 2,
-                 quarantine_root: Optional[str] = "auto", aot: bool = True):
+                 quarantine_root: Optional[str] = "auto", aot: bool = True,
+                 queue_depth: int = 4096):
         if max_models < 1:
             raise ValueError(f"max_models must be >= 1, got {max_models}")
         self._max_models = int(max_models)
         self._max_wait_ms = float(max_wait_ms)
         self._max_batch = int(max_batch)
+        #: per-model request-queue bound: past it the daemon SHEDS (HTTP
+        #: 429 + serve_shed_total{model}) instead of queueing unboundedly
+        self._queue_depth = int(queue_depth)
         self._buckets = serving_buckets(bucket_floor, max_batch)
         self._backend = backend
         self._mesh = mesh
@@ -251,7 +255,7 @@ class ServingDaemon:
                 batcher = MicroBatcher(
                     fn, max_batch=self._max_batch,
                     max_wait_ms=self._max_wait_ms, prefetch=self._prefetch,
-                    model_label=label)
+                    queue_depth=self._queue_depth, model_label=label)
             entry = ModelEntry(label, fp, path, model, fn, batcher,
                                warm_report)
             evicted: list[ModelEntry] = []
@@ -462,6 +466,10 @@ def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
                 return self._error(404, f"no route {self.path}")
             except KeyError as e:
                 self._error(404, str(e))
+            except Overloaded as e:
+                # the overload guard: a full request queue answers FAST with
+                # "try later", it does not make every queued caller slow
+                self._error(429, str(e)[:500])
             except (ValueError, TypeError) as e:
                 self._error(400, f"{type(e).__name__}: {e}"[:500])
             except Exception as e:  # noqa: BLE001 — a handler must answer

@@ -530,6 +530,50 @@ class WorkflowRunner:
         return RunResult("evaluate", metrics=eval_metrics,
                          metrics_location=params.metrics_location)
 
+    def _remote_ingest_source(self, model: WorkflowModel, params: OpParams):
+        """Stand up the disaggregated ingest service for this run: an
+        `IngestCoordinator` over the streaming reader's shardable spec plus
+        `params.ingest_workers` extraction worker subprocesses. Returns
+        (pipeline source, coordinator) — the source is a
+        `readers.pipeline.LiveSource`, so the Prefetcher teardown hook
+        reaches the coordinator, and `stream_batch_size` re-chunking rides
+        INSIDE the adapter (the close hook survives it). Fault-free output
+        is bit-identical to the in-process reader path; a worker lost
+        mid-epoch is recovered by lease reassignment + deterministic replay
+        (docs/robustness.md 'Distributed ingest failure model')."""
+        spec = getattr(self.streaming_reader, "ingest_spec", lambda: None)()
+        if spec is None:
+            raise ValueError(
+                f"ingest_workers={params.ingest_workers} needs a shardable "
+                f"streaming reader (one with ingest_spec()); "
+                f"{type(self.streaming_reader).__name__} cannot ship its "
+                "extraction to worker processes")
+        from ..ingest import IngestCoordinator
+        from ..readers.pipeline import LiveSource
+
+        try:
+            from ..analyze import plan_fingerprint
+
+            plan_fp = plan_fingerprint(model.stages)
+        except TypeError:
+            plan_fp = "unfingerprintable"
+        coordinator = IngestCoordinator(
+            spec, plan_fp=plan_fp, cache_dir=params.ingest_cache_dir,
+            registry=None)
+        coordinator.start()
+        coordinator.spawn_workers(params.ingest_workers)
+        transform = None
+        if self.stream_batch_size:
+            from ..readers.streaming import rebatch
+
+            def transform(stream, _bs=self.stream_batch_size):
+                return rebatch(
+                    (b.to_rows() if isinstance(b, Table) else b
+                     for b in stream), _bs)
+        source = LiveSource(coordinator.stream, coordinator.request_stop,
+                            transform=transform)
+        return source, coordinator
+
     def _run_streaming_score(self, params: OpParams, mark) -> RunResult:
         """Micro-batch scoring loop (the DStream analog, OpWorkflowRunner.scala:232):
         each batch from the streaming reader is scored with the same jit-cached plan;
@@ -578,14 +622,19 @@ class WorkflowRunner:
         # predictor/response split and kind lookups used to be rebuilt for
         # every batch (pure host-side work on the pipeline's critical path)
         plan = _StreamColumnsPlan(model.raw_features)
-        batches = self.streaming_reader.stream()
-        if self.stream_batch_size:
-            from ..readers.streaming import rebatch
+        coordinator = None
+        if getattr(params, "ingest_workers", 0):
+            batches, coordinator = self._remote_ingest_source(model, params)
+        else:
+            batches = self.streaming_reader.stream()
+            if self.stream_batch_size:
+                from ..readers.streaming import rebatch
 
-            batches = rebatch(
-                (b.to_rows() if isinstance(b, Table) else b for b in batches),
-                self.stream_batch_size,
-            )
+                batches = rebatch(
+                    (b.to_rows() if isinstance(b, Table) else b
+                     for b in batches),
+                    self.stream_batch_size,
+                )
         stats = PipelineStats()
         counts = {"rows": 0, "batches": 0}
         batch_counter = itertools.count()
@@ -809,10 +858,14 @@ class WorkflowRunner:
         counts["written"] = 0
         # reader opens (io_guard sites) already sit under the run-wide
         # ambient policy scope installed by run()'s dispatch wrapper
-        run_pipeline(batches, prepare, compute, sink if loc else None,
-                     prefetch=self.stream_prefetch,
-                     sink_depth=self.stream_sink_depth, stats=stats,
-                     place=place, policy=policy)
+        try:
+            run_pipeline(batches, prepare, compute, sink if loc else None,
+                         prefetch=self.stream_prefetch,
+                         sink_depth=self.stream_sink_depth, stats=stats,
+                         place=place, policy=policy)
+        finally:
+            if coordinator is not None:
+                coordinator.close()
         mark("streaming_score")
         if qw is not None:
             qw.close()
