@@ -952,9 +952,19 @@ def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
     acc = float((predict_gbt_binary(params, X[: 1 << 16])[0]
                  == y[: 1 << 16]).mean())
     m = profiling.mfu(flops, wall)
+    # which split path served (r10): on TPU at this shape the auto gate fuses
+    # split finding into the histogram kernel (pallas_trees.
+    # histogram_split_mxu) — the hist_mfu delta vs the 0.41 BENCH_r05 floor
+    # is attributable to it; TT_SPLIT=twopass forces the old path for A/B
+    import os as _os
+
+    from transmogrifai_tpu.ops.backend import backend_is_tpu as _is_tpu
+
+    split_mode = _os.environ.get("TT_SPLIT") or (
+        "fused" if _is_tpu() else "twopass")
     return {
         "rows": n_rows, "features": d, "trees": n_trees, "depth": max_depth,
-        "bins": n_bins,
+        "bins": n_bins, "split_mode": split_mode,
         "wall_s": round(wall, 3),
         "rows_trees_per_sec": round(n_rows * n_trees / wall),
         "hist_tflops_per_sec": round(flops / wall / 1e12, 2),

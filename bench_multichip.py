@@ -233,6 +233,109 @@ def run_selector_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
     return out
 
 
+def run_sharded_mlp_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
+    """The r10 ZeRO lane: fit_mlp_scan with sharded optimizer state (8x1,
+    `shard_optimizer="auto"`) vs the replicated single-device program — rows/s,
+    MFU where the device peak is known, per-device optimizer-state bytes, and
+    scaling efficiency vs 1x1 (overhead retention on forced host devices)."""
+    from transmogrifai_tpu import profiling
+    from transmogrifai_tpu.ops.mlp import fit_mlp_scan, predict_mlp
+    from transmogrifai_tpu.ops.optimizer import optimizer_state_bytes
+
+    n, d = (1 << 13, 64) if quick else (1 << 15, 256)
+    hidden = (128, 64) if quick else (512, 256)
+    batch = 1 << 10 if quick else 1 << 12
+    epochs = 1
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    sizes = (d, *hidden, 2)
+    n_params = sum(i * o + o for i, o in zip(sizes[:-1], sizes[1:]))
+    flops = sum(6 * i * o for i, o in zip(sizes[:-1], sizes[1:])) * n * epochs
+
+    out = {"rows": n, "width": d, "hidden": list(hidden), "batch": batch,
+           "n_params": n_params, "per_shape": {},
+           "state_bytes_per_device": {}}
+    base = None
+    preds = {}
+    for (nd, nm), mesh in meshes.items():
+        if nm != 1:
+            continue  # the sharded-optimizer lane is data-parallel only
+
+        def fit(mesh=mesh):
+            return fit_mlp_scan(X, y, batch_size=batch, hidden=hidden,
+                                epochs=epochs, mesh=mesh)
+
+        wall = _bench(fit, reps=2 if quick else 3)
+        rows_s = n * epochs / wall
+        key = f"{nd}x{nm}"
+        out["per_shape"][key] = round(rows_s)
+        sharded = mesh is not None and nd > 1
+        out["state_bytes_per_device"][key] = optimizer_state_bytes(
+            n_params, sharded, nd if sharded else 1)
+        m = profiling.mfu(flops, wall)
+        if m is not None:
+            out.setdefault("mfu", {})[key] = round(m, 4)
+        preds[key] = np.asarray(fit()[0][0][:4, 0])  # parity probe slice
+        if (nd, nm) == (1, 1):
+            base = rows_s
+    data_par = out["per_shape"].get("8x1")
+    if base and data_par:
+        out["scaling_efficiency"] = round(_efficiency(
+            data_par, base, 8, forced_host), 4)
+        out["state_bytes_ratio"] = round(
+            out["state_bytes_per_device"]["8x1"]
+            / out["state_bytes_per_device"]["1x1"], 4)
+        if not np.allclose(preds["1x1"], preds["8x1"], rtol=5e-2, atol=5e-3):
+            out["parity_error"] = (
+                f"sharded params diverged: {preds['1x1']} vs {preds['8x1']}")
+    return out
+
+
+def run_gbt_mesh_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
+    """The r10 tree lane: GBT training with every boosting round's
+    per-feature histogram + split work laid over the MODEL axis (1x8) vs the
+    single-device fit. Split decisions must be IDENTICAL across shapes — a
+    mismatch is the SPMD miscompile class and fails the bench loudly. (The
+    fused pallas split kernel engages on real TPU at scale via the TT_SPLIT
+    auto gate; bench_extra.run_trees reports its MFU as gbt_hist_mfu.)"""
+    from transmogrifai_tpu.ops.trees import fit_gbt
+
+    n, d = (1 << 13, 32) if quick else (1 << 15, 64)
+    n_trees, depth, bins = (5, 4, 16) if quick else (10, 5, 32)
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+    kwargs = dict(objective="binary", n_trees=n_trees, max_depth=depth,
+                  n_bins=bins)
+
+    out = {"rows": n, "cols": d, "trees": n_trees, "depth": depth,
+           "per_shape": {}}
+    base = None
+    ref_sf = None
+    for (nd, nm), mesh in meshes.items():
+        if (nd, nm) not in ((1, 1), (1, 8)):
+            continue
+
+        def fit(mesh=mesh):
+            return fit_gbt(X, y, mesh=mesh, **kwargs)
+
+        wall = _bench(fit, reps=2 if quick else 3)
+        out["per_shape"][f"{nd}x{nm}"] = round(n * n_trees / wall)
+        sf = np.asarray(fit().split_feature)
+        if (nd, nm) == (1, 1):
+            base = n * n_trees / wall
+            ref_sf = sf
+        elif not (sf == ref_sf).all():
+            out["parity_error"] = (
+                f"{nd}x{nm}: model-axis split decisions diverged from 1x1")
+    model_par = out["per_shape"].get("1x8")
+    if base and model_par:
+        out["scaling_efficiency"] = round(_efficiency(
+            model_par, base, 8, forced_host), 4)
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -261,6 +364,9 @@ def main() -> None:
     detail["stats"] = run_stats_lane(meshes, ARGS.quick, forced_host)
     detail["scoring"] = run_scoring_lane(meshes, ARGS.quick, forced_host)
     detail["selector"] = run_selector_lane(meshes, ARGS.quick, forced_host)
+    detail["mlp_sharded"] = run_sharded_mlp_lane(meshes, ARGS.quick,
+                                                 forced_host)
+    detail["gbt_mesh"] = run_gbt_mesh_lane(meshes, ARGS.quick, forced_host)
 
     stats_eff = detail["stats"].get("scaling_efficiency")
     scoring_eff = detail["scoring"].get("scaling_efficiency")
@@ -284,11 +390,28 @@ def main() -> None:
             detail["selector"]["per_shape"].get("1x8"),
         "multichip_models_per_sec_4x2":
             detail["selector"]["per_shape"].get("4x2"),
+        "multichip_mlp_sharded_rows_per_sec_8x1":
+            detail["mlp_sharded"]["per_shape"].get("8x1"),
+        "multichip_mlp_sharded_efficiency":
+            detail["mlp_sharded"].get("scaling_efficiency"),
+        "multichip_mlp_sharded_state_bytes_per_device":
+            detail["mlp_sharded"]["state_bytes_per_device"].get("8x1"),
+        "multichip_mlp_state_bytes_ratio":
+            detail["mlp_sharded"].get("state_bytes_ratio"),
+        "multichip_gbt_rows_trees_per_sec_1x8":
+            detail["gbt_mesh"]["per_shape"].get("1x8"),
+        "multichip_gbt_model_axis_efficiency":
+            detail["gbt_mesh"].get("scaling_efficiency"),
         "n_devices": n_devices,
     }
     parity_error = detail["selector"].get("parity_error")
     if parity_error:
         summary["selector_parity_error"] = parity_error
+    for lane in ("mlp_sharded", "gbt_mesh"):
+        err = detail[lane].get("parity_error")
+        if err:
+            summary[f"{lane}_parity_error"] = err
+            parity_error = parity_error or f"{lane}: {err}"
     compact = {"metric": _METRIC, "value": headline, "unit": "ratio",
                "summary": {k: v for k, v in summary.items()
                            if v is not None}}

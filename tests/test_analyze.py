@@ -51,7 +51,7 @@ class TestCatalog:
         # the catalog drives docs/static_analysis.md and `op lint --rules`
         assert {"OP001", "OP101", "OP102", "OP103", "OP104", "OP201", "OP202",
                 "OP203", "OP301", "OP302", "OP401", "OP402", "OP403",
-                "OP404"} \
+                "OP404", "OP405"} \
             == set(RULES)
         for r in RULES.values():
             assert r.title and r.rationale and r.severity in ("error", "warn", "info")
@@ -421,6 +421,45 @@ class TestOP404MeshReplication:
         # a host column consumed only by host stages never rides the mesh
         assert "OP404" not in _codes(
             analyze_plan([self._plan(host=True, device_consumer=False)]))
+
+
+class TestOP405OptimizerStateBudget:
+    """Replicated optimizer state over the per-device HBM budget: the static
+    form of the OOM the sharded optimizer (shard_optimizer on a multi-device
+    mesh) avoids. Budget override via TT_OP405_HBM_BYTES."""
+
+    def _plan(self, **mlp_kw):
+        from transmogrifai_tpu.stages.model import MLPClassifier
+
+        fs = features_from_schema({"y": "RealNN", "a": "Real", "b": "Real"},
+                                  response="y")
+        vec = transmogrify([fs["a"], fs["b"]])
+        return MLPClassifier(**mlp_kw)(fs["y"], vec)
+
+    def test_over_budget_fires(self, monkeypatch):
+        # hidden chain alone: 512*512+512 params ~ 3.15 MB of state > 1 MB
+        monkeypatch.setenv("TT_OP405_HBM_BYTES", str(1 << 20))
+        report = analyze_plan([self._plan(hidden=(512, 512))])
+        diags = report.by_code("OP405")
+        assert diags and diags[0].severity == "warn"
+        assert "optimizer state" in diags[0].message
+        assert "shard_optimizer" in diags[0].hint
+
+    def test_default_budget_clean(self):
+        # a sane config is far under the real per-device budget
+        assert "OP405" not in _codes(analyze_plan([self._plan(hidden=(64,))]))
+
+    def test_pinned_sharding_exempt(self, monkeypatch):
+        monkeypatch.setenv("TT_OP405_HBM_BYTES", str(1 << 20))
+        report = analyze_plan([self._plan(hidden=(512, 512),
+                                          shard_optimizer="on")])
+        assert "OP405" not in _codes(report)
+
+    def test_estimate_is_hidden_chain_lower_bound(self):
+        from transmogrifai_tpu.stages.model import MLPClassifier
+
+        est = MLPClassifier(hidden=(512, 512)).optimizer_state_bytes()
+        assert est == 12 * (512 * 512 + 512 + 512 * 2 + 2)
 
 
 # --- Workflow.train gate: fail at plan time, zero data, zero traces -------------------

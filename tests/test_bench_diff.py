@@ -43,6 +43,18 @@ def test_direction_inference():
     assert not bench_diff.lower_is_better("disagg_two_worker_rows_per_sec")
     assert bench_diff.lower_is_better("disagg_recovery_s")
     assert bench_diff.lower_is_better("extraction_epoch_clean_s")
+    # the sharded-optimizer lane: per-device state bytes (and the
+    # sharded/replicated ratio) regress upward, throughput/efficiency and the
+    # fused-GBT MFU keep higher-is-better
+    assert bench_diff.lower_is_better(
+        "multichip_mlp_sharded_state_bytes_per_device")
+    assert bench_diff.lower_is_better("multichip_mlp_state_bytes_ratio")
+    assert not bench_diff.lower_is_better("multichip_mlp_sharded_efficiency")
+    assert not bench_diff.lower_is_better(
+        "multichip_mlp_sharded_rows_per_sec_8x1")
+    assert not bench_diff.lower_is_better(
+        "multichip_gbt_rows_trees_per_sec_1x8")
+    assert not bench_diff.lower_is_better("gbt_hist_mfu")
 
 
 def test_cold_start_compile_events_zero_baseline():

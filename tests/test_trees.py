@@ -364,6 +364,151 @@ def test_digitize_mxu_matches_compare_scan():
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.parametrize("N,D,n_bins,n_nodes,C", [
+    (300, 7, 8, 4, 1),     # unaligned rows/features, binary channels
+    (513, 12, 16, 1, 1),   # root level
+    (257, 5, 32, 8, 3),    # multiclass channels, deeper level
+    (128, 3, 2, 2, 1),     # minimum candidate bins
+])
+def test_fused_split_kernel_matches_twopass_reference(N, D, n_bins, n_nodes, C):
+    """histogram_split_mxu's per-(node, feature) best (gain, bin) equals the
+    two-pass histogram -> cumsum -> gain -> argmax reference at every
+    supported shape — including the bin tie-break (first max wins)."""
+    from transmogrifai_tpu.ops.pallas_trees import (
+        histogram_mxu,
+        histogram_split_mxu,
+    )
+
+    rng = np.random.default_rng(8)
+    V = 2 * C
+    Xb = jnp.asarray(rng.integers(0, n_bins, (N, D)), jnp.int32)
+    node = jnp.asarray(rng.integers(0, n_nodes, N), jnp.int32)
+    gh = rng.normal(size=(N, V)).astype(np.float32)
+    gh[:, C:] = np.abs(gh[:, C:]) + 0.05  # hessian channels positive
+    gh = jnp.asarray(gh)
+    lam, mcw = 1.0, 2.0
+    eps = 1e-8
+
+    cum = jnp.cumsum(histogram_mxu(gh, Xb, node, n_nodes, n_bins,
+                                   interpret=True), axis=2)
+    GL, HL = cum[..., :C], cum[..., C:]
+    Gt, Ht = GL[:, :1, -1:, :], HL[:, :1, -1:, :]
+    GR, HR = Gt - GL, Ht - HL
+
+    def score(G, H):
+        return (G ** 2 / (H + lam + eps)).sum(-1)
+
+    gain = score(GL, HL) + score(GR, HR) - score(Gt, Ht)
+    valid = ((HL.sum(-1) >= mcw) & (HR.sum(-1) >= mcw)
+             & (jnp.arange(n_bins) < n_bins - 1)[None, None, :])
+    flat = jnp.where(valid, gain, -jnp.inf).reshape(n_nodes, D * n_bins)
+    best = jnp.argmax(flat, axis=1)
+    ref_d, ref_b = best // n_bins, best % n_bins
+
+    g2, b2 = histogram_split_mxu(gh, Xb, node, n_nodes, n_bins, lam, mcw,
+                                 interpret=True)
+    assert g2.shape == b2.shape == (n_nodes, D)
+    got_d = jnp.argmax(g2, axis=1)
+    got_b = jnp.take_along_axis(b2, got_d[:, None], axis=1)[:, 0]
+    np.testing.assert_array_equal(np.asarray(ref_d), np.asarray(got_d))
+    np.testing.assert_array_equal(np.asarray(ref_b), np.asarray(got_b))
+    # gain VALUES may drift at ulp level (sequential in-kernel cumsum vs
+    # jnp.cumsum association); the DECISIONS above are the bitwise contract
+    ref_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    got_gain = jnp.take_along_axis(g2, got_d[:, None], axis=1)[:, 0]
+    np.testing.assert_allclose(np.asarray(ref_gain), np.asarray(got_gain),
+                               rtol=1e-5)
+
+
+def test_grow_tree_fused_split_decisions_bitwise_equal():
+    """TT_SPLIT=fused vs twopass through grow_tree itself: split features,
+    thresholds, leaf values, and routing all bitwise-equal — with a colsample
+    feature mask and a min_child_weight gate in play."""
+    from transmogrifai_tpu.ops.trees import grow_tree
+
+    rng = np.random.default_rng(9)
+    N, D, n_bins = 600, 10, 16
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    edges = quantile_bins(jnp.asarray(X), n_bins)
+    Xb = bin_features(jnp.asarray(X), edges)
+    g = rng.normal(size=(N, 1)).astype(np.float32)
+    h = (np.abs(rng.normal(size=(N, 1))) + 0.1).astype(np.float32)
+    fmask = jnp.asarray(rng.random(D) < 0.7)
+    for depth in (1, 3, 5):
+        # the two-pass reference scores the SAME bf16 histogram backend the
+        # fused kernel accumulates (hist_mode="mxu" — what large TPU fits
+        # use); against a different backend (exact-f32 segsum) candidates
+        # inside the bf16 rounding gap may legitimately tie-flip
+        ref = grow_tree(Xb, edges, jnp.asarray(g), jnp.asarray(h), depth,
+                        1.0, 2.0, 0.0, fmask, split_mode="twopass",
+                        hist_mode="mxu")
+        fus = grow_tree(Xb, edges, jnp.asarray(g), jnp.asarray(h), depth,
+                        1.0, 2.0, 0.0, fmask, split_mode="fused")
+        # decisions (features, thresholds, routing) + leaves: bitwise equal;
+        # feat_gain allclose only (in-kernel sequential cumsum vs jnp.cumsum
+        # association: ulp-level)
+        for i in (0, 1, 2, 3):
+            np.testing.assert_array_equal(np.asarray(ref[i]),
+                                          np.asarray(fus[i]),
+                                          err_msg=f"depth={depth} out={i}")
+        np.testing.assert_allclose(np.asarray(ref[4]), np.asarray(fus[4]),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"depth={depth} feat_gain")
+
+
+def test_fit_gbt_fused_equals_twopass(monkeypatch):
+    """End-to-end boosting under the TT_SPLIT env force: identical ensembles.
+    TT_HIST=mxu pins both sides to the bf16 histogram backend the fused
+    kernel accumulates (the large-TPU-fit configuration)."""
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+    kw = dict(objective="binary", n_trees=4, max_depth=3, n_bins=16)
+    monkeypatch.setenv("TT_HIST", "mxu")
+    monkeypatch.setenv("TT_SPLIT", "twopass")
+    a = fit_gbt(X, y, **kw)
+    monkeypatch.setenv("TT_SPLIT", "fused")
+    b = fit_gbt(X, y, seed=7, **kw)
+    assert bool((a.split_feature == b.split_feature).all())
+    assert bool((a.split_threshold == b.split_threshold).all())
+    np.testing.assert_array_equal(np.asarray(a.leaf_values),
+                                  np.asarray(b.leaf_values))
+
+
+def test_fused_split_respects_l1_gate():
+    """A traced/nonzero reg_alpha bakes a different gain: the fused path must
+    refuse (fall back to two-pass) rather than compute the wrong split."""
+    from transmogrifai_tpu.ops.trees import grow_tree
+
+    rng = np.random.default_rng(12)
+    N, D, n_bins = 200, 4, 8
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    edges = quantile_bins(jnp.asarray(X), n_bins)
+    Xb = bin_features(jnp.asarray(X), edges)
+    g = rng.normal(size=(N, 1)).astype(np.float32)
+    h = (np.abs(rng.normal(size=(N, 1))) + 0.1).astype(np.float32)
+    # forced fused + L1 on: the alpha gate wins and the result matches the
+    # two-pass L1 math exactly
+    a = grow_tree(Xb, edges, jnp.asarray(g), jnp.asarray(h), 2, 1.0, 1.0,
+                  0.0, reg_alpha=0.5, split_mode="fused")
+    b = grow_tree(Xb, edges, jnp.asarray(g), jnp.asarray(h), 2, 1.0, 1.0,
+                  0.0, reg_alpha=0.5, split_mode="twopass")
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_split_mode_env_validation(monkeypatch):
+    from transmogrifai_tpu.ops.trees import grow_tree
+
+    X = jnp.zeros((8, 2), jnp.float32)
+    edges = quantile_bins(X, 4)
+    Xb = bin_features(X, edges)
+    g = jnp.ones((8, 1)); h = jnp.ones((8, 1))
+    monkeypatch.setenv("TT_SPLIT", "sideways")
+    with pytest.raises(ValueError, match="TT_SPLIT"):
+        grow_tree(Xb, edges, g, h, 1, 1.0, 1.0, 0.0)
+
+
 def test_bin_features_ties_go_right():
     # bin = #{edges <= x}: a value exactly ON an edge lands in the bin ABOVE it
     edges = jnp.asarray([[1.0, 2.0, 3.0]], jnp.float32).T.reshape(1, 3)

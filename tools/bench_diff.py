@@ -31,9 +31,11 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 #: override list, which is checked first; "recovery" covers the disagg-
 #: ingest lane's disagg_recovery_s — what one worker SIGKILL costs — which
 #: must regress upward like any wall metric even if renamed off the _s
-#: suffix)
+#: suffix; "state_bytes" covers the sharded-optimizer lane's per-device
+#: optimizer-state footprint and its sharded/replicated ratio — growing
+#: per-device state is the regression the ZeRO sharding exists to prevent)
 _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
-                 "recovery")
+                 "recovery", "state_bytes")
 #: overrides: fragments that look like seconds but are throughput/quality
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",

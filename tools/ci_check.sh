@@ -81,6 +81,42 @@ if [ "${#MC[@]}" -eq 1 ] && [ -s /tmp/_multichip_new.json ]; then
     fi
 fi
 
+echo "== sharded-optimizer smoke (forced 8 devices) =="
+# r10 ZeRO lane: sharded-vs-replicated MLP parity, 1/8 per-device state
+# bytes on the gauge, and a retrace-free steady-state sharded step — the
+# CI form of the tests/test_sharded_optimizer.py acceptance.
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    JAX_PLATFORMS=cpu TT_AUTO_MESH=0 python - <<'PY'
+import numpy as np
+import jax
+
+assert len(jax.devices()) == 8, jax.devices()
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.mesh import make_mesh
+from transmogrifai_tpu.obs import metrics as obs_metrics
+from transmogrifai_tpu.ops.mlp import fit_mlp, predict_mlp
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(250, 12)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+mesh = make_mesh(n_data=8, n_model=1)
+kw = dict(num_classes=2, hidden=(16, 8), max_iter=25)
+rep = fit_mlp(X, y, **kw)
+sh = fit_mlp(X, y, mesh=mesh, **kw)
+for (Wr, _), (Ws, _) in zip(rep, sh):
+    np.testing.assert_allclose(np.asarray(Wr), np.asarray(Ws),
+                               rtol=1e-4, atol=1e-5)
+assert bool((predict_mlp(rep, X)[0] == predict_mlp(sh, X)[0]).all())
+reg = obs_metrics.default_registry()
+b_rep = reg.find("train_optimizer_state_bytes", {"sharded": "0"}).value
+b_sh = reg.find("train_optimizer_state_bytes", {"sharded": "1"}).value
+assert b_sh <= b_rep / 8 + 12, (b_sh, b_rep)
+with obs.retrace_budget(0):  # steady-state sharded fit compiles nothing
+    fit_mlp(X, y, mesh=mesh, **kw)
+print(f"sharded-optimizer smoke ok: state bytes {b_rep:.0f} -> {b_sh:.0f} "
+      f"per device ({b_sh / b_rep:.3f}x), parity + retrace-free")
+PY
+
 echo "== chaos smoke (resilience) =="
 # streamed scoring of titanic-schema traffic under FaultInjector(seed=0):
 # injected transient IO errors must be absorbed by retries, the injected

@@ -87,6 +87,14 @@ OP404 = _rule("OP404", "host column replicated to every mesh device", "info",
               "chip (n_devices x the memory and transfer), while "
               "device-produced columns stay row-sharded — the multi-device "
               "form of OP403")
+OP405 = _rule("OP405", "replicated optimizer state exceeds per-device HBM",
+              "warn",
+              "a model stage's estimated optimizer-state bytes (f32 master "
+              "params + Adam moments, 12 B/param) exceed the per-device HBM "
+              "budget while the state is replicated — the static form of the "
+              "replicated-state OOM the sharded optimizer "
+              "(shard_optimizer='auto' on a multi-device mesh) exists to "
+              "avoid")
 
 
 def make_diag(code: str, message: str, **kw) -> Diagnostic:
@@ -520,6 +528,52 @@ def pass_hygiene(ctx: PlanContext) -> Iterator[Diagnostic]:
                      "work before the first device layer")
 
 
+# --- OP405: replicated optimizer-state budget -----------------------------------------
+
+#: per-device HBM budget OP405 checks against (v5e-class chip minus working
+#: set headroom); override with TT_OP405_HBM_BYTES (tests use tiny budgets)
+OP405_HBM_BYTES_DEFAULT = 12 << 30
+
+
+def pass_optimizer_state(ctx: PlanContext) -> Iterator[Diagnostic]:
+    """OP405: model stages exposing `optimizer_state_bytes()` (a static
+    estimate of replicated f32 master + Adam m/v bytes — MLPClassifier derives
+    a lower bound from its hidden-layer chain) are checked against the
+    per-device HBM budget. Stages that PIN sharding (shard_optimizer="on")
+    are exempt: a pinned eager fit REFUSES to run replicated
+    (resolve_shard_optimizer raises without a multi-device mesh), so the OOM
+    this rule predicts cannot occur — the fit fails fast instead. "auto" is
+    NOT exempt — it silently replicates when no multi-device mesh is attached
+    at train time, which the static analyzer cannot see, so the lint stays
+    conservative (warn, not error)."""
+    import os
+
+    from ..ops.optimizer import shard_pinned
+
+    budget = int(os.environ.get("TT_OP405_HBM_BYTES", OP405_HBM_BYTES_DEFAULT))
+    for s in ctx.stages():
+        if not isinstance(s, Estimator):
+            continue
+        est_fn = getattr(s, "optimizer_state_bytes", None)
+        if not callable(est_fn):
+            continue
+        if shard_pinned(s.params.get("shard_optimizer", "")):
+            continue
+        est = est_fn()
+        if est is None or est <= budget:
+            continue
+        yield make_diag(
+            "OP405",
+            f"{type(s).__name__} holds an estimated {est / (1 << 30):.2f} GiB "
+            f"of replicated optimizer state per device (f32 master params + "
+            f"Adam m/v; lower bound) — over the {budget / (1 << 30):.2f} GiB "
+            "per-device HBM budget: the fit would OOM before the first step",
+            stage_uid=s.uid,
+            hint="train on a multi-device mesh with shard_optimizer='auto' "
+                 "(state shards 1/N per device, ops/optimizer.py), or shrink "
+                 "the hidden layers")
+
+
 def _plain_params(obj):
     """Params -> comparable plain values (callables by qualified name)."""
     if isinstance(obj, dict):
@@ -536,4 +590,5 @@ def _plain_params(obj):
 
 
 #: pass registry, run in order by the analyzer
-PASSES = (pass_uniqueness, pass_kinds, pass_retrace, pass_leakage, pass_hygiene)
+PASSES = (pass_uniqueness, pass_kinds, pass_retrace, pass_leakage,
+          pass_hygiene, pass_optimizer_state)

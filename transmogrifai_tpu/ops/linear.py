@@ -38,14 +38,12 @@ def _weighted(sample_weight, n):
 def _adam_update(theta, m, v, g, t, lr_t,
                  b1=0.9, b2=0.999, eps=1e-8):
     """One Adam step over matching pytrees (tuples) of params/moments/grads.
-    t is the 1-based step for bias correction."""
-    m = jax.tree.map(lambda mi, gi: b1 * mi + (1 - b1) * gi, m, g)
-    v = jax.tree.map(lambda vi, gi: b2 * vi + (1 - b2) * gi ** 2, v, g)
-    theta = jax.tree.map(
-        lambda p, mi, vi: p - lr_t * (mi / (1 - b1 ** t))
-        / (jnp.sqrt(vi / (1 - b2 ** t)) + eps),
-        theta, m, v)
-    return theta, m, v
+    t is the 1-based step for bias correction. Delegates to the ONE shared
+    rule in ops/optimizer.py (also used by the MLP trainers and the sharded-
+    state path) so the solvers can never drift."""
+    from .optimizer import adam_update
+
+    return adam_update(theta, m, v, g, t, lr_t, b1=b1, b2=b2, eps=eps)
 
 
 def _cosine_lr(lr, i, total):

@@ -1,9 +1,22 @@
-"""Multilayer perceptron trainer: fixed-step full-batch Adam, static layer shapes.
+"""Multilayer perceptron trainers: fixed-step Adam, static layer shapes, and
+ZeRO-style sharded optimizer state on a mesh.
 
 Compute core of OpMultilayerPerceptronClassifier (reference core/.../impl/
 classification/OpMultilayerPerceptronClassifier.scala wrapping Spark's MLP with L-BFGS).
 Layer widths are static, so every (fold, grid-point) fit shares one compiled program;
 the forward pass is a chain of MXU matmuls and XLA fuses activations into them.
+
+Sharded optimizer (r10, arXiv 2004.13336 / ops/optimizer.py): every trainer
+here takes `mesh=None, shard_optimizer="auto"`. On a mesh with data axis N > 1
+(and outside the selector's vmap batching) the f32 master params and Adam
+(m, v) live SHARDED 1/N-per-device over the data axis; each step is
+psum_scatter(grads) -> local shard Adam update -> all_gather of compute params
+(bf16 on the minibatch/scan lanes), expressed with `shard_map` so XLA overlaps
+layer k's reduce with layer k+1's update math. Per-device optimizer state
+drops from 12*P to 12*ceil(P/N) bytes — the model-size ceiling becomes the
+MESH's memory, not one chip's. With no mesh (or one device, or "off") every
+entry point runs the EXACT pre-r10 replicated path: same function objects,
+same jit caches, bitwise-identical results.
 """
 from __future__ import annotations
 
@@ -15,9 +28,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .optimizer import (
+    adam_update,
+    flatten_pad,
+    gather_compute,
+    record_state_bytes,
+    resolve_shard_optimizer,
+    unflatten,
+)
+
+
+def _layer_shapes(d: int, hidden: Sequence[int], num_classes: int):
+    sizes = (d, *hidden, num_classes)
+    return ([(i, o) for i, o in zip(sizes[:-1], sizes[1:])],
+            [(o,) for o in sizes[1:]])
+
+
+def _n_params(d: int, hidden: Sequence[int], num_classes: int) -> int:
+    w_shapes, b_shapes = _layer_shapes(d, hidden, num_classes)
+    return sum(i * o for i, o in w_shapes) + sum(o for (o,) in b_shapes)
+
 
 @partial(jax.jit, static_argnames=("num_classes", "hidden", "max_iter", "seed"))
-def fit_mlp(
+def _fit_mlp_replicated(
     X: jnp.ndarray,
     y: jnp.ndarray,
     sample_weight: Optional[jnp.ndarray] = None,
@@ -29,21 +62,14 @@ def fit_mlp(
     l2=0.0,
     seed: int = 0,
 ) -> list:
-    """-> params: list of (W [in, out], b [out]) per layer, softmax head included."""
+    """The single-program full-batch trainer (pre-r10 `fit_mlp` body): f32
+    math end to end, optimizer state replicated on every device."""
     X = jnp.asarray(X, jnp.float32)
     n, d = X.shape
     w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
     wsum = w.sum() + 1e-12
     Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
-    sizes = (d, *hidden, num_classes)
-    keys = jax.random.split(jax.random.PRNGKey(seed), len(sizes) - 1)
-    params = [
-        (
-            jax.random.normal(k, (i, o), jnp.float32) * jnp.sqrt(2.0 / i),
-            jnp.zeros(o, jnp.float32),
-        )
-        for k, i, o in zip(keys, sizes[:-1], sizes[1:])
-    ]
+    params = _mlp_init(d, hidden, num_classes, seed)
 
     def forward(params, X):
         h = X
@@ -63,16 +89,8 @@ def fit_mlp(
     def step(carry, i):
         params, m, v = carry
         g = grad_fn(params)
-        t = i + 1
-        b1, b2, eps = 0.9, 0.999, 1e-8
         lr_t = lr * 0.5 * (1 + jnp.cos(jnp.pi * i / max_iter))
-        m = jax.tree.map(lambda a, b_: b1 * a + (1 - b1) * b_, m, g)
-        v = jax.tree.map(lambda a, b_: b2 * a + (1 - b2) * b_ ** 2, v, g)
-        params = jax.tree.map(
-            lambda p, mm, vv: p
-            - lr_t * (mm / (1 - b1 ** t)) / (jnp.sqrt(vv / (1 - b2 ** t)) + eps),
-            params, m, v,
-        )
+        params, m, v = adam_update(params, m, v, g, i + 1, lr_t)
         return (params, m, v), None
 
     zeros = jax.tree.map(jnp.zeros_like, params)
@@ -81,6 +99,144 @@ def fit_mlp(
         jnp.arange(max_iter),
     )
     return params
+
+
+def fit_mlp(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    *,
+    num_classes: int = 2,
+    hidden: Sequence[int] = (10,),
+    max_iter: int = 200,
+    lr=0.01,
+    l2=0.0,
+    seed: int = 0,
+    mesh=None,
+    shard_optimizer="auto",
+) -> list:
+    """-> params: list of (W [in, out], b [out]) per layer, softmax head included.
+
+    `mesh` + `shard_optimizer="auto"`: on a data axis > 1 the optimizer state
+    shards per ops/optimizer.py (f32 compute-param gathers on this full-batch
+    f32 lane); rows pad to the axis with weight 0, so the weighted loss is
+    exact at any row count. Unmeshed/1-device/vmapped fits run the replicated
+    program unchanged."""
+    hidden = tuple(int(h) for h in hidden)
+    # lr/l2 ride the batched check too: a vmapped hyperparameter axis (the
+    # selector's grid stacks) must keep the replicated program
+    if resolve_shard_optimizer(mesh, shard_optimizer, X, y, sample_weight,
+                               lr, l2):
+        return _fit_mlp_sharded(
+            X, y, sample_weight, num_classes=num_classes, hidden=hidden,
+            max_iter=int(max_iter), lr=lr, l2=l2, seed=int(seed), mesh=mesh)
+    record_state_bytes(_n_params(np.shape(X)[1], hidden, num_classes),
+                       sharded=False)
+    return _fit_mlp_replicated(
+        X, y, sample_weight, num_classes=num_classes, hidden=hidden,
+        max_iter=int(max_iter), lr=lr, l2=l2, seed=int(seed))
+
+
+@functools.lru_cache(maxsize=32)
+def _fullbatch_program_sharded(mesh, num_classes: int, hidden: tuple, d: int,
+                               max_iter: int, seed: int):
+    """The ZeRO full-batch trainer: one jitted shard_map program per
+    (mesh, layer config). lr/l2 ride as traced scalars so hyperparameter
+    changes never recompile; row count keys the inner jit as usual."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..mesh import DATA_AXIS
+
+    n_data = int(mesh.shape[DATA_AXIS])
+    w_shapes, b_shapes = _layer_shapes(d, hidden, num_classes)
+
+    def body(Xl, yl, wl, lr, l2):
+        idx = jax.lax.axis_index(DATA_AXIS)
+        params0 = _mlp_init(d, hidden, num_classes, seed)
+        # every device deterministically computes the tiny full init, then
+        # keeps only its 1/N shard of each flat leaf — no init broadcast
+        shards0 = []
+        for W, b in params0:
+            fw = flatten_pad(W, n_data)
+            fb = flatten_pad(b, n_data)
+            sw = fw.shape[0] // n_data
+            sb = fb.shape[0] // n_data
+            shards0.append((
+                jax.lax.dynamic_slice(fw, (idx * sw,), (sw,)),
+                jax.lax.dynamic_slice(fb, (idx * sb,), (sb,)),
+            ))
+        Y = jax.nn.one_hot(jnp.asarray(yl, jnp.int32), num_classes)
+        wsum = jax.lax.psum(wl.sum(), DATA_AXIS) + 1e-12
+        Xf = jnp.asarray(Xl, jnp.float32)
+
+        def gather_params(shards, dtype):
+            return [
+                (unflatten(gather_compute(sw_, DATA_AXIS, dtype), ws),
+                 unflatten(gather_compute(sb_, DATA_AXIS, jnp.float32), bs))
+                for (sw_, sb_), ws, bs in zip(shards, w_shapes, b_shapes)
+            ]
+
+        def data_loss(shards):
+            params = gather_params(shards, jnp.float32)  # f32 lane
+            h = Xf
+            for W, b in params[:-1]:
+                h = jnp.tanh(h @ W + b)
+            W, b = params[-1]
+            logits = h @ W + b
+            ll = (wl * (jax.nn.log_softmax(logits) * Y).sum(1)).sum() / wsum
+            return -ll
+
+        def step(carry, i):
+            shards, m, v = carry
+            g = jax.grad(data_loss)(shards)  # <- psum_scatter via gather vjp
+            # L2 term applied analytically on the f32 master shard: identical
+            # to the replicated grad of 0.5*l2*sum(W^2) (weights only)
+            g = [(gw + l2 * sw_, gb) for (gw, gb), (sw_, _sb)
+                 in zip(g, shards)]
+            lr_t = lr * 0.5 * (1 + jnp.cos(jnp.pi * i / max_iter))
+            shards, m, v = adam_update(shards, m, v, g, i + 1, lr_t)
+            return (shards, m, v), None
+
+        zeros = jax.tree.map(jnp.zeros_like, shards0)
+        (shards, _, _), _ = jax.lax.scan(
+            step, (shards0, zeros, jax.tree.map(jnp.zeros_like, shards0)),
+            jnp.arange(max_iter))
+        return [
+            (unflatten(jax.lax.all_gather(sw_, DATA_AXIS, tiled=True), ws),
+             unflatten(jax.lax.all_gather(sb_, DATA_AXIS, tiled=True), bs))
+            for (sw_, sb_), ws, bs in zip(shards, w_shapes, b_shapes)
+        ]
+
+    specs = [(P(), P())] * len(w_shapes)
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=specs, check_rep=False))
+
+
+def _fit_mlp_sharded(X, y, sample_weight, *, num_classes, hidden, max_iter,
+                     lr, l2, seed, mesh) -> list:
+    from ..mesh import DATA_AXIS, record_sharded_dispatch, shard_batch
+
+    n_data = int(mesh.shape[DATA_AXIS])
+    X = jnp.asarray(X, jnp.float32)
+    n, d = X.shape
+    w = (jnp.ones(n, jnp.float32) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
+    y = jnp.asarray(y, jnp.float32)
+    pad = (-n) % n_data
+    if pad:  # weight-0 repeat-row-0 padding: exact for the weighted loss
+        X = jnp.concatenate([X, jnp.repeat(X[:1], pad, axis=0)])
+        y = jnp.concatenate([y, jnp.repeat(y[:1], pad)])
+        w = jnp.concatenate([w, jnp.zeros(pad, jnp.float32)])
+    prog = _fullbatch_program_sharded(mesh, int(num_classes), tuple(hidden),
+                                      int(d), int(max_iter), int(seed))
+    record_state_bytes(_n_params(d, hidden, num_classes), sharded=True,
+                       n_shards=n_data)
+    record_sharded_dispatch()
+    return prog(shard_batch(mesh, X), shard_batch(mesh, y),
+                shard_batch(mesh, w), jnp.float32(lr), jnp.float32(l2))
 
 
 def _mlp_init(d: int, hidden: Sequence[int], num_classes: int, seed: int) -> list:
@@ -129,17 +285,12 @@ def _mlp_loss(params: list, X, Y, l2, compute_dtype):
 
 def _adam_update(state: tuple, g, lr):
     """One bias-corrected Adam update on (params, m, v, t) — THE update rule shared
-    by the streamed and in-HBM minibatch trainers (they must never diverge)."""
+    by the streamed and in-HBM minibatch trainers (they must never diverge).
+    Delegates to the shared ops/optimizer.py rule (the one the sharded-state
+    path updates SHARDS with)."""
     params, m, v, t = state
-    b1, b2, eps = 0.9, 0.999, 1e-8
     t = t + 1.0
-    m = jax.tree.map(lambda a, b_: b1 * a + (1 - b1) * b_, m, g)
-    v = jax.tree.map(lambda a, b_: b2 * a + (1 - b2) * b_ ** 2, v, g)
-    params = jax.tree.map(
-        lambda p, mm, vv: p
-        - lr * (mm / (1 - b1 ** t)) / (jnp.sqrt(vv / (1 - b2 ** t)) + eps),
-        params, m, v,
-    )
+    params, m, v = adam_update(params, m, v, g, t, lr)
     return (params, m, v, t)
 
 
@@ -181,6 +332,74 @@ def _window_step(num_classes: int, lr: float, l2: float, compute_dtype):
     return donating_jit(win, donate_argnums=0)
 
 
+@functools.lru_cache(maxsize=64)
+def _minibatch_step_sharded(mesh, num_classes: int, hidden: tuple, d: int,
+                            lr: float, l2: float, compute_dtype):
+    """The ZeRO streamed-chunk step: state = (param/m/v shards, t) with every
+    shard a flat [N * width] array laid P(DATA_AXIS); rows of the chunk ride
+    the data axis. The loss gathers bf16 compute params (gather_compute), its
+    gradient psum_scatters in f32 via the custom vjp, and the Adam update runs
+    on the local shard — per-leaf collectives, so XLA overlaps one layer's
+    reduce with the next layer's update. Donation preserved: state updates in
+    place in HBM exactly like the replicated step."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..mesh import DATA_AXIS
+    from ..utils.sanitize import donating_jit
+
+    w_shapes, b_shapes = _layer_shapes(d, hidden, num_classes)
+
+    def local_step(state, X, y, w):
+        shards, m, v, t = state
+        Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
+        # denominator = real (unpadded) global rows: equals _mlp_loss's .mean()
+        bsum = jax.lax.psum(w.sum(), DATA_AXIS)
+
+        def data_loss(shards):
+            params = [
+                (unflatten(gather_compute(sw, DATA_AXIS, compute_dtype), ws),
+                 unflatten(gather_compute(sb, DATA_AXIS, jnp.float32), bs))
+                for (sw, sb), ws, bs in zip(shards, w_shapes, b_shapes)
+            ]
+            logits = _mlp_forward(params, jnp.asarray(X, jnp.float32),
+                                  compute_dtype)
+            ll = (w * (jax.nn.log_softmax(logits) * Y).sum(1)).sum() / bsum
+            return -ll
+
+        g = jax.grad(data_loss)(shards)
+        g = [(gw + l2 * sw, gb) for (gw, gb), (sw, _sb) in zip(g, shards)]
+        t = t + 1.0
+        shards, m, v = adam_update(shards, m, v, g, t, lr)
+        return (shards, m, v, t)
+
+    state_spec = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P())
+    mapped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_spec, P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=state_spec, check_rep=False)
+    return donating_jit(mapped, donate_argnums=0)
+
+
+def _init_sharded_state(mesh, d: int, hidden, num_classes: int, seed: int):
+    """Sharded (params, m, v, t): flat f32 leaves laid over DATA_AXIS."""
+    from .optimizer import shard_state_leaf
+
+    params = _mlp_init(d, hidden, num_classes, seed)
+    shards = [(shard_state_leaf(mesh, W), shard_state_leaf(mesh, b))
+              for W, b in params]
+    return (shards, jax.tree.map(jnp.zeros_like, shards),
+            jax.tree.map(jnp.zeros_like, shards), jnp.float32(0.0))
+
+
+def _sharded_state_params(state, d: int, hidden, num_classes: int) -> list:
+    """Final full f32 params from sharded state — the state leaves are GLOBAL
+    jax arrays (sharded storage), so this is a slice+reshape, no collective."""
+    w_shapes, b_shapes = _layer_shapes(d, hidden, num_classes)
+    return [(unflatten(sw, ws), unflatten(sb, bs))
+            for (sw, sb), ws, bs in zip(state[0], w_shapes, b_shapes)]
+
+
 def fit_mlp_minibatch(
     chunk_fn,
     n_chunks: int,
@@ -195,6 +414,8 @@ def fit_mlp_minibatch(
     compute_dtype=jnp.bfloat16,
     dispatch_window: int = 1,
     prefetch: int = 2,
+    mesh=None,
+    shard_optimizer="auto",
 ) -> list:
     """Minibatch-SGD (Adam) MLP over streamed chunks — the deep-tabular regime
     (BASELINE.json config 5): data that never sits in HBM at once. `chunk_fn(i)`
@@ -215,11 +436,21 @@ def fit_mlp_minibatch(
 
     Parameter/optimizer state is donated between dispatches (in-place in HBM);
     matmuls run in `compute_dtype` (bf16 = MXU-native; master params/optimizer
-    state stay f32). Multi-chip: shard the batch axis of each chunk over the
-    mesh data axis and the grads psum (the minibatch-SGD-over-ICI path; the
-    single-chip program is unchanged)."""
+    state stay f32). Multi-chip (`mesh`, r10): with `shard_optimizer="auto"`
+    and a data axis N > 1 the master params and Adam moments live sharded 1/N
+    per device, chunk rows shard the data axis (weight-0 pad rows for
+    non-dividing chunks — exact), grads psum_scatter, and bf16 compute params
+    all_gather per layer (ops/optimizer.py). The sharded path dispatches per
+    chunk (`dispatch_window` applies to the replicated path)."""
     from ..readers.pipeline import Prefetcher
 
+    hidden = tuple(int(h) for h in hidden)
+    if resolve_shard_optimizer(mesh, shard_optimizer):
+        return _fit_mlp_minibatch_sharded(
+            chunk_fn, n_chunks, d, num_classes=num_classes, hidden=hidden,
+            epochs=epochs, lr=lr, l2=l2, seed=seed,
+            compute_dtype=compute_dtype, prefetch=prefetch, mesh=mesh)
+    record_state_bytes(_n_params(d, hidden, num_classes), sharded=False)
     params = _mlp_init(d, hidden, num_classes, seed)
     step = _minibatch_step(num_classes, float(lr), float(l2), compute_dtype)
     win = _window_step(num_classes, float(lr), float(l2), compute_dtype)
@@ -254,9 +485,47 @@ def fit_mlp_minibatch(
     return state[0]
 
 
+def _fit_mlp_minibatch_sharded(chunk_fn, n_chunks: int, d: int, *, num_classes,
+                               hidden, epochs, lr, l2, seed, compute_dtype,
+                               prefetch, mesh) -> list:
+    from ..mesh import DATA_AXIS, record_sharded_dispatch, shard_batch
+    from ..readers.pipeline import Prefetcher
+
+    n_data = int(mesh.shape[DATA_AXIS])
+    step = _minibatch_step_sharded(mesh, num_classes, hidden, int(d),
+                                   float(lr), float(l2), compute_dtype)
+    state = _init_sharded_state(mesh, d, hidden, num_classes, seed)
+    record_state_bytes(_n_params(d, hidden, num_classes), sharded=True,
+                       n_shards=n_data)
+    seq = [i for _ in range(epochs) for i in range(n_chunks)]
+
+    def load(i):
+        """Producer-thread work: pad rows to the data axis (weight-0 mask) and
+        land the chunk PRE-SHARDED over DATA_AXIS."""
+        X, y = chunk_fn(i)
+        B = int(np.shape(X)[0])
+        pad = (-B) % n_data
+        w = np.ones(B + pad, np.float32)
+        if pad:
+            w[B:] = 0.0
+            X = jnp.concatenate([jnp.asarray(X),
+                                 jnp.zeros((pad, d), jnp.asarray(X).dtype)])
+            y = jnp.concatenate([jnp.asarray(y, jnp.float32),
+                                 jnp.zeros(pad, jnp.float32)])
+        return (shard_batch(mesh, X), shard_batch(mesh, y),
+                shard_batch(mesh, w))
+
+    with Prefetcher(seq, load, depth=max(1, int(prefetch)),
+                    name="mlp_chunk") as pf:
+        for X, y, w in pf:
+            state = step(state, X, y, w)
+            record_sharded_dispatch()
+    return _sharded_state_params(state, d, hidden, num_classes)
+
+
 @partial(jax.jit, static_argnames=("batch_size", "num_classes", "hidden", "epochs",
                                    "seed", "compute_dtype"))
-def fit_mlp_scan(
+def _fit_mlp_scan_replicated(
     X: jnp.ndarray,
     y: jnp.ndarray,
     *,
@@ -269,25 +538,9 @@ def fit_mlp_scan(
     seed: int = 0,
     compute_dtype=jnp.bfloat16,
 ) -> list:
-    """Whole-training-run-in-one-program minibatch MLP: the data already sits in
-    HBM, so the epochs x steps Adam loop runs as `lax.scan` inside ONE jit — zero
-    host round-trips between steps (the dispatch-bound regime of per-step stepping
-    disappears; on a tunneled device this is the difference between dispatch
-    latency x steps and pure device time). Same update rule as fit_mlp_minibatch;
-    use that one when data streams from host and this one when it fits in HBM.
-
-    Static-shape discipline: the tail `n % batch_size` rows are dropped each
-    epoch (shuffle or pad upstream if every row must be seen); batch_size > n is
-    an error rather than a silent no-op."""
     X = jnp.asarray(X)
     n, d = X.shape
     steps = n // batch_size
-    if steps == 0:
-        raise ValueError(
-            f"batch_size={batch_size} exceeds n={n} rows — zero scan steps would "
-            "silently return the random initialization; lower batch_size (or use "
-            "fit_mlp for full-batch training)"
-        )
     Xb = X[: steps * batch_size].reshape(steps, batch_size, d)
     Yb = jax.nn.one_hot(
         jnp.asarray(y[: steps * batch_size], jnp.int32), num_classes
@@ -310,6 +563,143 @@ def fit_mlp_scan(
     # `epochs` copies of the step and recompile per distinct epoch count)
     carry, _ = jax.lax.scan(epoch, carry, None, length=epochs)
     return carry[0]
+
+
+@functools.lru_cache(maxsize=32)
+def _scan_program_sharded(mesh, num_classes: int, hidden: tuple, d: int,
+                          epochs: int, seed: int, compute_dtype):
+    """Whole-training-run sharded program: the epochs x steps Adam loop runs
+    as lax.scan INSIDE one shard_map-partitioned jit — zero host round-trips
+    between steps AND sharded optimizer state, composed. Batch rows ride
+    DATA_AXIS; each step gathers bf16 compute params and psum_scatters grads
+    exactly like the streamed step."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..mesh import DATA_AXIS
+
+    n_data = int(mesh.shape[DATA_AXIS])
+    w_shapes, b_shapes = _layer_shapes(d, hidden, num_classes)
+
+    def body(Xb, yb, lr, l2):
+        # Xb local [steps, B/n, d]; yb local [steps, B/n]
+        idx = jax.lax.axis_index(DATA_AXIS)
+        B_total = Xb.shape[1] * n_data
+        params0 = _mlp_init(d, hidden, num_classes, seed)
+        shards0 = []
+        for W, b in params0:
+            fw, fb = flatten_pad(W, n_data), flatten_pad(b, n_data)
+            sw, sb = fw.shape[0] // n_data, fb.shape[0] // n_data
+            shards0.append((jax.lax.dynamic_slice(fw, (idx * sw,), (sw,)),
+                            jax.lax.dynamic_slice(fb, (idx * sb,), (sb,))))
+
+        def data_loss(shards, Xc, Yc):
+            params = [
+                (unflatten(gather_compute(sw, DATA_AXIS, compute_dtype), ws),
+                 unflatten(gather_compute(sb, DATA_AXIS, jnp.float32), bs))
+                for (sw, sb), ws, bs in zip(shards, w_shapes, b_shapes)
+            ]
+            logits = _mlp_forward(params, Xc, compute_dtype)
+            ll = (jax.nn.log_softmax(logits) * Yc).sum() / B_total
+            return -ll
+
+        def step(carry, batch):
+            Xc, yc = batch
+            shards, m, v, t = carry
+            Yc = jax.nn.one_hot(jnp.asarray(yc, jnp.int32), num_classes)
+            g = jax.grad(data_loss)(shards, Xc, Yc)
+            g = [(gw + l2 * sw, gb) for (gw, gb), (sw, _sb) in zip(g, shards)]
+            t = t + 1.0
+            shards, m, v = adam_update(shards, m, v, g, t, lr)
+            return (shards, m, v, t), None
+
+        def epoch(carry, _):
+            carry, _ = jax.lax.scan(step, carry, (Xb, yb))
+            return carry, None
+
+        zeros = jax.tree.map(jnp.zeros_like, shards0)
+        carry = (shards0, zeros, jax.tree.map(jnp.zeros_like, shards0),
+                 jnp.float32(0.0))
+        carry, _ = jax.lax.scan(epoch, carry, None, length=epochs)
+        return [
+            (unflatten(jax.lax.all_gather(sw, DATA_AXIS, tiled=True), ws),
+             unflatten(jax.lax.all_gather(sb, DATA_AXIS, tiled=True), bs))
+            for (sw, sb), ws, bs in zip(carry[0], w_shapes, b_shapes)
+        ]
+
+    specs = [(P(), P())] * len(w_shapes)
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS, None), P(None, DATA_AXIS), P(), P()),
+        out_specs=specs, check_rep=False))
+
+
+def fit_mlp_scan(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    *,
+    batch_size: int,
+    num_classes: int = 2,
+    hidden: Sequence[int] = (256, 128),
+    epochs: int = 1,
+    lr=1e-3,
+    l2=0.0,
+    seed: int = 0,
+    compute_dtype=jnp.bfloat16,
+    mesh=None,
+    shard_optimizer="auto",
+) -> list:
+    """Whole-training-run-in-one-program minibatch MLP: the data already sits in
+    HBM, so the epochs x steps Adam loop runs as `lax.scan` inside ONE jit — zero
+    host round-trips between steps (the dispatch-bound regime of per-step stepping
+    disappears; on a tunneled device this is the difference between dispatch
+    latency x steps and pure device time). Same update rule as fit_mlp_minibatch;
+    use that one when data streams from host and this one when it fits in HBM.
+
+    Static-shape discipline: the tail `n % batch_size` rows are dropped each
+    epoch (shuffle or pad upstream if every row must be seen); batch_size > n is
+    an error rather than a silent no-op.
+
+    Multi-chip (r10): with a mesh and `shard_optimizer="auto"`, batch rows
+    shard DATA_AXIS and the optimizer state shards ZeRO-style — one partitioned
+    program, still zero host round-trips. Requires batch_size to divide the
+    data axis (it always does for the pow2 defaults); otherwise the replicated
+    program runs unchanged."""
+    hidden = tuple(int(h) for h in hidden)
+    n, d = np.shape(X)
+    steps = n // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"batch_size={batch_size} exceeds n={n} rows — zero scan steps would "
+            "silently return the random initialization; lower batch_size (or use "
+            "fit_mlp for full-batch training)"
+        )
+    sharded = resolve_shard_optimizer(mesh, shard_optimizer, X, y, lr, l2)
+    if sharded:
+        from ..mesh import DATA_AXIS as _DA
+
+        sharded = batch_size % int(mesh.shape[_DA]) == 0
+    if not sharded:
+        record_state_bytes(_n_params(d, hidden, num_classes), sharded=False)
+        return _fit_mlp_scan_replicated(
+            X, y, batch_size=batch_size, num_classes=num_classes,
+            hidden=hidden, epochs=epochs, lr=lr, l2=l2, seed=seed,
+            compute_dtype=compute_dtype)
+    from ..mesh import DATA_AXIS, record_sharded_dispatch, shard_batch
+
+    n_data = int(mesh.shape[DATA_AXIS])
+    X = jnp.asarray(X)
+    Xb = X[: steps * batch_size].reshape(steps, batch_size, d)
+    yb = jnp.asarray(y, jnp.float32)[: steps * batch_size].reshape(
+        steps, batch_size)
+    prog = _scan_program_sharded(mesh, int(num_classes), hidden, int(d),
+                                 int(epochs), int(seed), compute_dtype)
+    record_state_bytes(_n_params(d, hidden, num_classes), sharded=True,
+                       n_shards=n_data)
+    record_sharded_dispatch()
+    return prog(shard_batch(mesh, Xb, batch_dim=1),
+                shard_batch(mesh, yb, batch_dim=1),
+                jnp.float32(lr), jnp.float32(l2))
 
 
 @jax.jit

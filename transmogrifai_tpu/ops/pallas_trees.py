@@ -15,6 +15,18 @@ features x 64 bins — see docs/performance.md "Tree engine roofline"):
   is a rank-n_bins coupling of (row, feature) with bin — it cannot be expressed
   as fewer/fuller matmuls; see the analysis in docs/performance.md).
 
+- `histogram_split_mxu` (r10) FUSES split finding into the histogram program:
+  the accumulator lives in a VMEM scratch, and on the last row tile the kernel
+  scans candidate bins — cumulative G/H, XGBoost gain, min_child_weight mask,
+  per-feature argmax — while the tiles are still on-chip. Only [n_nodes, D]
+  split stats return to HBM instead of the full [n_nodes, D, bins, 2C]
+  histogram (its writeback + re-read by a second program held the GBT lane at
+  0.41 MFU vs the MLP's 0.74, BENCH_r05). Split decisions are bitwise-equal
+  to the two-pass path scored on the SAME (mxu) histogram backend
+  (ops/trees.grow_tree gates via TT_SPLIT, pinned by test; a different
+  backend's f32-exact histograms can legitimately tie-flip candidates inside
+  the bf16 rounding gap).
+
 - `digitize_mxu` replaces jnp.searchsorted for LARGE binning. XLA lowers
   vmapped searchsorted to a per-element binary-search while_loop with gathers:
   measured 15.8 SECONDS for 1M x 256 on v5e — 2/3 of the whole gbt_scale fit.
@@ -49,13 +61,12 @@ def histogram_mxu_supported(n_rows: int, n_feats: int, n_nodes: int,
     return n_bins <= 127 and n_bins * M * Dp * 4 <= _ACC_BYTES_MAX
 
 
-def _hist_kernel(node_ref, vals_ref, xb_ref, out_ref, *, n_bins, n_nodes, V):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
+def _accumulate_hist(node_ref, vals_ref, xb_ref, acc_ref, *, n_bins, n_nodes,
+                     V):
+    """One row tile's bin-loop MXU accumulation into acc_ref [n_bins*M, Dp] —
+    shared by the histogram-only kernel (acc = the output block) and the fused
+    histogram->split kernel (acc = a VMEM scratch that never leaves the chip).
+    """
     tn = xb_ref.shape[0]
     # A^T [M, TN] built in VMEM, channel-major: rows v*n_nodes + n hold
     # vals[:, v] masked to rows of node n (pad rows carry node -1 -> all-zero)
@@ -68,9 +79,20 @@ def _hist_kernel(node_ref, vals_ref, xb_ref, out_ref, *, n_bins, n_nodes, V):
     M = V * n_nodes
     for b in range(n_bins):
         mask = (xb == b).astype(jnp.bfloat16)
-        out_ref[b * M:(b + 1) * M, :] += jax.lax.dot_general(
+        acc_ref[b * M:(b + 1) * M, :] += jax.lax.dot_general(
             a_t, mask, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+
+def _hist_kernel(node_ref, vals_ref, xb_ref, out_ref, *, n_bins, n_nodes, V):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    _accumulate_hist(node_ref, vals_ref, xb_ref, out_ref,
+                     n_bins=n_bins, n_nodes=n_nodes, V=V)
 
 
 def histogram_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
@@ -111,6 +133,133 @@ def histogram_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
         interpret=interpret,
     )(node_p[None, :], vals_p.T, xb8)
     return out.reshape(n_bins, V, n_nodes, Dp).transpose(2, 3, 0, 1)[:, :D]
+
+
+_SPLIT_EPS = 1e-8  # MUST equal ops/trees._EPS: gains are compared across paths
+
+
+def fused_split_supported(n_rows: int, n_feats: int, n_nodes: int,
+                          n_channels: int, n_bins: int) -> bool:
+    """Static-shape gate for the fused histogram->split kernel: the histogram
+    accumulator (now a VMEM scratch, not an output) must fit the same budget,
+    and there must be at least one candidate bin."""
+    return n_bins >= 2 and histogram_mxu_supported(
+        n_rows, n_feats, n_nodes, n_channels, n_bins)
+
+
+def _hist_split_kernel(node_ref, vals_ref, xb_ref, scal_ref, gain_ref,
+                       bin_ref, acc_ref, *, n_bins, n_nodes, V):
+    """Fused histogram build + split finding: grid steps accumulate row tiles
+    into the VMEM scratch accumulator; the LAST step scans candidate bins
+    while the tiles are still in VMEM and writes only the per-(node, feature)
+    best (gain, bin) back to HBM. The full [nodes, D, bins, 2C] histogram
+    never exists off-chip — the HBM writeback + re-read that held the
+    two-program path to 0.41 MFU (BENCH_r05) disappears.
+
+    The bin scan mirrors ops/trees.grow_tree's two-pass math term for term
+    (inclusive cumulative G/H, XGBoost gain G^2/(H+lam), min_child_weight
+    masking, strict-> update = argmax-first-max tie-breaking), so split
+    DECISIONS are bitwise-equal to the two-pass path scored on the same
+    histogram backend — pinned by test."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _accumulate_hist(node_ref, vals_ref, xb_ref, acc_ref,
+                     n_bins=n_bins, n_nodes=n_nodes, V=V)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _split():
+        C = V // 2  # channels: first C are gradients, last C hessians
+        M = V * n_nodes
+        lam = scal_ref[0, 0]
+        mcw = scal_ref[0, 1]
+
+        def cell(b, v):  # [n_nodes, Dp] histogram slab of (bin b, channel v)
+            return acc_ref[b * M + v * n_nodes:b * M + (v + 1) * n_nodes, :]
+
+        tot = []  # per-node totals per channel (the Gt/Ht of the gain)
+        for v in range(V):
+            t = cell(0, v)
+            for b in range(1, n_bins):
+                t = t + cell(b, v)
+            tot.append(t)
+        sT = sum(tot[c] ** 2 / (tot[C + c] + lam + _SPLIT_EPS)
+                 for c in range(C))
+        cum = [cell(0, v) for v in range(V)]  # inclusive cumsum at bin 0
+        best_gain = jnp.full(cum[0].shape, -jnp.inf, jnp.float32)
+        best_bin = jnp.zeros(cum[0].shape, jnp.int32)
+        for b in range(n_bins - 1):  # last bin is never a valid split
+            if b > 0:
+                cum = [cum[v] + cell(b, v) for v in range(V)]
+            sL = sum(cum[c] ** 2 / (cum[C + c] + lam + _SPLIT_EPS)
+                     for c in range(C))
+            sR = sum((tot[c] - cum[c]) ** 2
+                     / ((tot[C + c] - cum[C + c]) + lam + _SPLIT_EPS)
+                     for c in range(C))
+            hl = sum(cum[C + c] for c in range(C))
+            hr = sum(tot[C + c] - cum[C + c] for c in range(C))
+            g = jnp.where((hl >= mcw) & (hr >= mcw), sL + sR - sT, -jnp.inf)
+            upd = g > best_gain  # strict: first max wins, like argmax
+            best_gain = jnp.where(upd, g, best_gain)
+            best_bin = jnp.where(upd, b, best_bin)
+        gain_ref[:] = best_gain
+        bin_ref[:] = best_bin
+
+
+def histogram_split_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
+                        n_nodes: int, n_bins: int, reg_lambda,
+                        min_child_weight, *,
+                        interpret: bool = False):
+    """Fused per-(node, feature) split finding over vals [N, 2C] (g then h
+    channels) -> (best_gain [n_nodes, D] f32, best_bin [n_nodes, D] int32).
+
+    Same operand discipline as histogram_mxu (bf16 masks/vals, f32
+    accumulation, node -1 row pads, bin -1 feature pads); reg_lambda and
+    min_child_weight ride as TRACED scalars through a tiny SMEM-shaped input,
+    so the selector's hyperparameter values never force a recompile. The
+    feature-mask (colsample) and min_gain gates stay OUTSIDE: both are
+    per-(node, feature) decisions the caller applies to the returned stats.
+    Padded feature columns return gain 0 at hl=hr=0 — callers slice [:, :D]
+    (done here) so they never reach an argmax."""
+    if n_bins > 127:
+        raise ValueError(
+            f"histogram_split_mxu supports n_bins <= 127, got {n_bins}")
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, D = Xb.shape
+    V = vals.shape[1]
+    M = V * n_nodes
+    row_pad = (-N) % ROW_TILE
+    f_pad = (-D) % 128
+    Dp = D + f_pad
+    xb8 = jnp.pad(Xb.astype(jnp.int8), ((0, row_pad), (0, f_pad)),
+                  constant_values=-1)
+    node_p = jnp.pad(node.astype(jnp.int32), (0, row_pad), constant_values=-1)
+    vals_p = jnp.pad(jnp.asarray(vals, jnp.float32), ((0, row_pad), (0, 0)))
+    scal = jnp.stack([jnp.asarray(reg_lambda, jnp.float32),
+                      jnp.asarray(min_child_weight, jnp.float32)]).reshape(1, 2)
+
+    gain, best_bin = pl.pallas_call(
+        functools.partial(_hist_split_kernel, n_bins=n_bins, n_nodes=n_nodes,
+                          V=V),
+        grid=((N + row_pad) // ROW_TILE,),
+        in_specs=[
+            pl.BlockSpec((1, ROW_TILE), lambda i: (0, i)),
+            pl.BlockSpec((V, ROW_TILE), lambda i: (0, i)),
+            pl.BlockSpec((ROW_TILE, Dp), lambda i: (i, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((n_nodes, Dp), lambda i: (0, 0)),
+                   pl.BlockSpec((n_nodes, Dp), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_nodes, Dp), jnp.float32),
+                   jax.ShapeDtypeStruct((n_nodes, Dp), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((n_bins * M, Dp), jnp.float32)],
+        interpret=interpret,
+    )(node_p[None, :], vals_p.T, xb8, scal)
+    return gain[:, :D], best_bin[:, :D]
 
 
 def _digitize_kernel(x_ref, edges_ref, out_ref, *, n_cuts):

@@ -72,13 +72,44 @@ _PALLAS_MIN_ELEMS = 1 << 24
 
 def _is_batched(*xs) -> bool:
     """True when any arg is a vmap tracer — the pallas paths opt out under
-    vmap (the selector's folds x grid batching) and the jnp paths serve."""
-    try:
-        from jax.interpreters.batching import BatchTracer
-    except ImportError:  # moved in newer jax
-        from jax._src.interpreters.batching import BatchTracer
+    vmap (the selector's folds x grid batching) and the jnp paths serve.
+    (Shared rule: ops/optimizer.is_batched.)"""
+    from .optimizer import is_batched
 
-    return any(isinstance(x, BatchTracer) for x in xs)
+    return is_batched(*xs)
+
+
+def _fused_split_supported(n_rows: int, n_feats: int, n_nodes: int,
+                           n_channels: int, n_bins: int) -> bool:
+    from .pallas_trees import fused_split_supported
+
+    return fused_split_supported(n_rows, n_feats, n_nodes, n_channels, n_bins)
+
+
+def _model_axis_constraint(mesh, Xb, edges):
+    """Lay the FEATURE axis of the binned matrix (and its edges) over the mesh
+    MODEL axis: per-(feature, bin) histogram columns and per-feature split
+    scans are independent, so GSPMD partitions every boosting round's
+    histogram + split work across the model axis from this one annotation —
+    the tree-lane counterpart of the MLP state sharding (rows keep whatever
+    DATA_AXIS sharding they arrived with only when the model axis is idle;
+    dual-axis sharding replays the PR-4 SPMD miscompile class, so feature
+    sharding takes precedence here). Returns (Xb, edges, sharded?)."""
+    from ..mesh import MODEL_AXIS
+
+    if mesh is None:
+        return Xb, edges, False
+    n_model = int(mesh.shape[MODEL_AXIS])
+    D = Xb.shape[1]
+    if n_model <= 1 or D % n_model != 0 or _is_batched(Xb, edges):
+        return Xb, edges, False
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    Xb = jax.lax.with_sharding_constraint(
+        Xb, NamedSharding(mesh, P(None, MODEL_AXIS)))
+    edges = jax.lax.with_sharding_constraint(
+        edges, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    return Xb, edges, True
 
 
 def quantile_bins(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
@@ -124,7 +155,8 @@ def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 
 
 def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
-               n_nodes: int, n_bins: int) -> jnp.ndarray:
+               n_nodes: int, n_bins: int, mode: Optional[str] = None
+               ) -> jnp.ndarray:
     """Sum `vals` [N, C] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, C].
 
     Default paths on TPU: the pallas bin-loop MXU kernel (pallas_trees.
@@ -141,8 +173,11 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
 
     NOTE: the mode is read at TRACE time — jit caches bake the chosen path per
     shape, so set TT_HIST before the first fit of a process (changing it later
-    only affects not-yet-compiled shapes)."""
-    mode = os.environ.get("TT_HIST")
+    only affects not-yet-compiled shapes). An explicit `mode` overrides the
+    env (how the mesh model-axis path pins the GSPMD-partitionable jnp
+    decompositions — a pallas_call is opaque to the SPMD partitioner)."""
+    if mode is None:
+        mode = os.environ.get("TT_HIST")
     if mode is None:
         if backend_is_tpu():
             from .pallas_trees import histogram_mxu_supported
@@ -157,7 +192,11 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     if mode == "mxu":
         from .pallas_trees import histogram_mxu
 
-        return histogram_mxu(vals, Xb, node, n_nodes, n_bins)
+        # interpret mode off-TPU: lets a forced TT_HIST=mxu run anywhere —
+        # how the fused-vs-two-pass equality tests pin BOTH paths to the
+        # same (bf16-operand) histogram accumulation on the CPU suite
+        return histogram_mxu(vals, Xb, node, n_nodes, n_bins,
+                             interpret=not backend_is_tpu())
     if mode == "segsum":
         return histogram_segment_sum(vals, Xb, node, n_nodes, n_bins)
     if mode != "binmm":
@@ -233,6 +272,8 @@ def grow_tree(
     min_gain,
     feature_mask: Optional[jnp.ndarray] = None,
     reg_alpha=0.0,
+    hist_mode: Optional[str] = None,
+    split_mode: Optional[str] = None,
 ):
     """Grow one perfect tree level-by-level on binned features.
 
@@ -242,7 +283,24 @@ def grow_tree(
     where leaf_values = -T_alpha(G)/(H + lambda) per leaf, with
     T_alpha(G) = sign(G) * max(|G| - alpha, 0) the xgboost L1 soft-threshold
     (reg_alpha=0 recovers the plain second-order leaf).
-    """
+
+    Split finding runs one of two paths (r10):
+
+    - two-pass (the default off-TPU / under vmap / with L1 on): per-level
+      histogram -> HBM, then cumsum/gain/argmax as a separate program;
+    - FUSED (pallas_trees.histogram_split_mxu; TT_SPLIT=fused|twopass forces,
+      auto picks it for large unbatched TPU fits): gain + per-feature argmax
+      run in the SAME pallas program while the histogram tiles are still in
+      VMEM — only [n_nodes, D] split stats return to HBM, killing the
+      full-histogram writeback + re-read of the two-pass path. Split
+      decisions are bitwise-equal to the two-pass path scored on the SAME
+      histogram backend (TT_HIST=mxu — what large TPU fits use; pinned by
+      test). Against a DIFFERENT backend (e.g. the exact-f32 segment-sum CPU
+      default) candidates within the bf16 rounding gap can legitimately pick
+      a different, equally-scoring split.
+
+    `hist_mode` overrides TT_HIST for the two-pass histogram (the mesh
+    model-axis path pins a partitionable jnp decomposition)."""
     N, D = Xb.shape
     n_bins = edges.shape[1] + 1
     # at-scale TPU fits swap the row-gather routing and scatter leaf sums for
@@ -257,34 +315,67 @@ def grow_tree(
 
     C = g.shape[1]
     gh = jnp.concatenate([g, h], axis=1)  # one fused histogram pass for both
+    smode = split_mode if split_mode is not None else os.environ.get("TT_SPLIT")
+    if smode not in (None, "fused", "twopass"):
+        raise ValueError(f"TT_SPLIT={smode!r}: expected fused | twopass")
+    # the fused kernel bakes the plain G^2/(H+lam) gain: a LITERAL-zero
+    # reg_alpha (fit_gbt's use_l1=False) is the gate, a traced alpha is not
+    fused_ok = (smode != "twopass"
+                and isinstance(reg_alpha, (int, float)) and reg_alpha == 0
+                and n_bins >= 2 and not _is_batched(Xb, g, h))
     for depth in range(max_depth):  # static unroll: shapes differ per level
         n_nodes = 2 ** depth
-        cum = jnp.cumsum(_histogram(gh, Xb, node, n_nodes, n_bins), axis=2)
-        GL, HL = cum[..., :C], cum[..., C:]
-        Gt = GL[:, :1, -1:, :]  # per-node totals (identical across features)
-        Ht = HL[:, :1, -1:, :]
-        GR, HR = Gt - GL, Ht - HL
+        use_fused = fused_ok and (
+            smode == "fused"
+            or (smode is None and big and _fused_split_supported(
+                N, D, n_nodes, 2 * C, n_bins)))
+        if use_fused:
+            from .pallas_trees import histogram_split_mxu
 
-        def score(G, H):
-            Gt_ = _l1_threshold(G, reg_alpha)
-            return (Gt_ ** 2 / (H + reg_lambda + _EPS)).sum(-1)
+            gain_nf, bin_nf = histogram_split_mxu(
+                gh, Xb, node, n_nodes, n_bins, reg_lambda, min_child_weight,
+                interpret=not backend_is_tpu())
+            # colsample mask + min_gain are per-(node, feature) gates: applied
+            # here on the [n_nodes, D] stats, identical to the two-pass masks
+            gain_nf = jnp.where(fmask[None, :], gain_nf, -jnp.inf)
+            best_d_raw = jnp.argmax(gain_nf, axis=1).astype(jnp.int32)
+            best_gain = jnp.take_along_axis(
+                gain_nf, best_d_raw[:, None], axis=1)[:, 0]
+            best_b_raw = jnp.take_along_axis(
+                bin_nf, best_d_raw[:, None], axis=1)[:, 0]
+            do_split = best_gain > min_gain
+            best_d = jnp.where(do_split, best_d_raw, 0).astype(jnp.int32)
+            best_b = jnp.where(do_split, best_b_raw,
+                               n_bins - 1).astype(jnp.int32)
+        else:
+            cum = jnp.cumsum(
+                _histogram(gh, Xb, node, n_nodes, n_bins, mode=hist_mode),
+                axis=2)
+            GL, HL = cum[..., :C], cum[..., C:]
+            Gt = GL[:, :1, -1:, :]  # per-node totals (identical across features)
+            Ht = HL[:, :1, -1:, :]
+            GR, HR = Gt - GL, Ht - HL
 
-        gain = score(GL, HL) + score(GR, HR) - score(Gt, Ht)  # [n_nodes, D, n_bins]
-        hl, hr = HL.sum(-1), HR.sum(-1)
-        valid = (
-            (hl >= min_child_weight)
-            & (hr >= min_child_weight)
-            & fmask[None, :, None]
-            & (jnp.arange(n_bins) < n_bins - 1)[None, None, :]
-        )
-        gain = jnp.where(valid, gain, -jnp.inf)
+            def score(G, H):
+                Gt_ = _l1_threshold(G, reg_alpha)
+                return (Gt_ ** 2 / (H + reg_lambda + _EPS)).sum(-1)
 
-        flat = gain.reshape(n_nodes, D * n_bins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        do_split = best_gain > min_gain
-        best_d = jnp.where(do_split, best // n_bins, 0).astype(jnp.int32)
-        best_b = jnp.where(do_split, best % n_bins, n_bins - 1).astype(jnp.int32)
+            gain = score(GL, HL) + score(GR, HR) - score(Gt, Ht)  # [n_nodes, D, n_bins]
+            hl, hr = HL.sum(-1), HR.sum(-1)
+            valid = (
+                (hl >= min_child_weight)
+                & (hr >= min_child_weight)
+                & fmask[None, :, None]
+                & (jnp.arange(n_bins) < n_bins - 1)[None, None, :]
+            )
+            gain = jnp.where(valid, gain, -jnp.inf)
+
+            flat = gain.reshape(n_nodes, D * n_bins)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            do_split = best_gain > min_gain
+            best_d = jnp.where(do_split, best // n_bins, 0).astype(jnp.int32)
+            best_b = jnp.where(do_split, best % n_bins, n_bins - 1).astype(jnp.int32)
         thresh = jnp.where(
             best_b < n_bins - 1,
             edges[best_d, jnp.clip(best_b, 0, n_bins - 2)],
@@ -378,7 +469,7 @@ def fit_gbt(X, y, sample_weight=None, *, reg_alpha=0.0, **kw):
     jax.jit,
     static_argnames=(
         "objective", "num_classes", "n_trees", "max_depth", "n_bins",
-        "subsample", "colsample", "seed", "use_l1",
+        "subsample", "colsample", "seed", "use_l1", "mesh",
     ),
 )
 def _fit_gbt(
@@ -400,9 +491,15 @@ def _fit_gbt(
     colsample: float = 1.0,
     n_bins: int = 32,
     seed: int = 7,
+    mesh=None,
 ) -> TreeEnsembleParams:
     """Second-order boosting: per round, (g, h) from the current margin, one
-    multi-output tree, margin += leaf values (learning rate folded into leaves)."""
+    multi-output tree, margin += leaf values (learning rate folded into leaves).
+
+    `mesh` (static, r10): with a model axis > 1 that divides D, the binned
+    matrix's feature axis lays over MODEL_AXIS so every round's independent
+    per-feature histogram + split work partitions across it (a partitioned fit
+    is a distinct executable — warm accordingly)."""
     X = jnp.asarray(X, jnp.float32)
     N, D = X.shape
     w = _weights(sample_weight, N)
@@ -415,6 +512,13 @@ def _fit_gbt(
         # (1 GB at 1M x 256 in int32); every level's histogram AND routing pass
         # re-reads it, so narrowing it 4x is a direct HBM-bandwidth win
         Xb = Xb.astype(jnp.int8)
+
+    Xb, edges, model_sharded = _model_axis_constraint(mesh, Xb, edges)
+    # pallas_calls are opaque to the SPMD partitioner: a feature-sharded fit
+    # pins the partitionable jnp decompositions instead
+    hist_mode = (("binmm" if backend_is_tpu() else "segsum")
+                 if model_sharded else None)
+    split_mode = "twopass" if model_sharded else None
 
     if objective == "binary":
         Y = jnp.asarray(y, jnp.float32)[:, None]
@@ -452,6 +556,7 @@ def _fit_gbt(
         sf, st, lv, leaf, fg = grow_tree(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
             fmask, reg_alpha=reg_alpha if use_l1 else 0.0,  # literal 0 -> skip
+            hist_mode=hist_mode, split_mode=split_mode,
         )
         lv = lv * learning_rate
         return F + lv[leaf], (sf, st, lv, fg)
@@ -467,7 +572,7 @@ def _fit_gbt(
     jax.jit,
     static_argnames=(
         "objective", "num_classes", "n_trees", "max_depth", "n_bins",
-        "colsample", "bootstrap", "seed",
+        "colsample", "bootstrap", "seed", "mesh",
     ),
 )
 def fit_forest(
@@ -486,11 +591,14 @@ def fit_forest(
     n_bins: int = 32,
     bootstrap: bool = True,
     seed: int = 7,
+    mesh=None,
 ) -> TreeEnsembleParams:
     """Bagged variance-reduction trees. With g = -Y*w, h = w the second-order leaf
     -G/(H+lambda) is the weighted target mean, and the gain is exactly the weighted
     variance reduction — one grower serves boosting and bagging. Classification
-    targets are one-hot, so leaves hold class distributions (Gini-style splits)."""
+    targets are one-hot, so leaves hold class distributions (Gini-style splits).
+    `mesh`: feature axis over MODEL_AXIS per _fit_gbt — every tree's histogram
+    rounds partition across the model axis."""
     X = jnp.asarray(X, jnp.float32)
     N, D = X.shape
     w = _weights(sample_weight, N)
@@ -498,6 +606,11 @@ def fit_forest(
     Xb = bin_features(X, edges)
     if n_bins <= 127:
         Xb = Xb.astype(jnp.int8)  # see _fit_gbt: 4x less per-level HBM traffic
+
+    Xb, edges, model_sharded = _model_axis_constraint(mesh, Xb, edges)
+    hist_mode = (("binmm" if backend_is_tpu() else "segsum")
+                 if model_sharded else None)
+    split_mode = "twopass" if model_sharded else None
 
     if objective == "classification":
         Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
@@ -518,7 +631,8 @@ def fit_forest(
             jax.random.bernoulli(kcol, colsample, (D,)) if colsample < 1.0 else None
         )
         sf, st, lv, _, fg = grow_tree(
-            Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain, fmask
+            Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
+            fmask, hist_mode=hist_mode, split_mode=split_mode,
         )
         return sf, st, lv, fg
 

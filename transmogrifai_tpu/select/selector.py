@@ -322,6 +322,11 @@ class ModelSelector(PredictorEstimator):
         best = (max if larger else min)(results, key=lambda r: r.metric_mean)
         template = models[best.candidate_index][0]
         best_est = template.with_params(**best.grid_point)
+        # the refit instance carries the selector's mesh so mesh-capable
+        # families (MeshAwareFit: sharded-optimizer MLP, model-axis tree
+        # histograms) refit SHARDED via their fit_kwargs threading — the
+        # search templates stay mesh-free (replicated vmapped programs)
+        best_est.mesh = self.mesh
 
         host_lane = getattr(best_est, "host_fit", False)
         with obs.span("selector:refit"):

@@ -121,6 +121,22 @@ class PredictorEstimator(Estimator):
         return y, X
 
 
+class MeshAwareFit:
+    """Threads the attached device mesh (with_mesh / Workflow.train auto-mesh
+    / the selector's winner refit) into `fit_kwargs()`, for families whose
+    fit_fn ACCEPTS a `mesh` kwarg: the tree trainers' model-axis histogram
+    sharding and the MLP trainers' ZeRO-style sharded optimizer state. The
+    mesh rides fit_kwargs — never self.params — so it is never serialized and
+    never enters a stage fingerprint; search templates (fresh `with_params`
+    instances) carry mesh=None, keeping the vmapped folds x grid programs on
+    the replicated path."""
+
+    def fit_kwargs(self) -> dict:
+        kw = dict(self.params)
+        kw["mesh"] = getattr(self, "mesh", None)
+        return kw
+
+
 class ClassifierEstimator(PredictorEstimator):
     """Predictor base with num_classes inference: 0 in the ctor means 'derive from the
     labels at fit time' (the ModelSelector injects the real count for multiclass)."""

@@ -18,7 +18,8 @@ from ...ops.linear import LinearParams
 from ...ops.mlp import fit_mlp, predict_mlp
 from ...types import Column, kind_of
 from ..base import Estimator, Transformer, register_stage
-from .base import ClassifierEstimator, PredictionModel, PredictorEstimator, host_params
+from .base import (ClassifierEstimator, MeshAwareFit, PredictionModel,
+                   PredictorEstimator, host_params)
 
 
 @register_stage
@@ -68,19 +69,28 @@ class NaiveBayesModel(PredictionModel):
 
 
 @register_stage
-class MLPClassifier(ClassifierEstimator):
+class MLPClassifier(MeshAwareFit, ClassifierEstimator):
     """Feed-forward softmax classifier (OpMultilayerPerceptronClassifier analog);
-    hidden layer widths are static shapes, training is fixed-step full-batch Adam."""
+    hidden layer widths are static shapes, training is fixed-step full-batch Adam.
+
+    `shard_optimizer` (r10): "auto" (default) shards the f32 master params and
+    Adam moments 1/N-per-device over an attached mesh's data axis (ops/mlp.py
+    ZeRO path — psum_scatter grads, local shard update, all_gather compute
+    params), raising the trainable model size past one chip's optimizer-state
+    capacity; unmeshed / 1-device / vmapped-search fits run the replicated
+    program bitwise-unchanged. "off" pins the replicated path (oplint OP405
+    flags configs whose replicated state cannot fit per-device HBM)."""
 
     operation_name = "mlpClassifier"
     vmap_params = ("lr", "l2")
 
     def __init__(self, num_classes: int = 0, hidden: Sequence[int] = (10,),
                  max_iter: int = 200, lr: float = 0.01, l2: float = 0.0,
-                 seed: int = 0):
+                 seed: int = 0, shard_optimizer: str = "auto"):
         super().__init__(num_classes=int(num_classes),
                          hidden=[int(h) for h in hidden], max_iter=int(max_iter),
-                         lr=float(lr), l2=float(l2), seed=int(seed))
+                         lr=float(lr), l2=float(l2), seed=int(seed),
+                         shard_optimizer=str(shard_optimizer))
 
     @staticmethod
     def fit_fn(X, y, sample_weight=None, num_classes=0, hidden=(10,), **kw):
@@ -88,6 +98,19 @@ class MLPClassifier(ClassifierEstimator):
                        hidden=tuple(int(h) for h in hidden), **kw)
 
     predict_fn = staticmethod(predict_mlp)
+
+    def optimizer_state_bytes(self) -> int:
+        """Static LOWER bound on replicated per-device optimizer-state bytes
+        (12 B/param: f32 master + Adam m + v) from the hidden-layer chain
+        alone — the training-matrix width is unknown before vectorization, so
+        the input layer is excluded. The oplint OP405 budget check reads
+        this."""
+        from ...ops.optimizer import optimizer_state_bytes
+
+        hidden = [int(h) for h in self.params["hidden"]]
+        sizes = (*hidden, max(int(self.params["num_classes"]), 2))
+        n_params = sum(i * o + o for i, o in zip(sizes[:-1], sizes[1:]))
+        return optimizer_state_bytes(n_params, sharded=False)
 
     def make_model(self, params):
         layers = host_params([(W, b) for W, b in params])

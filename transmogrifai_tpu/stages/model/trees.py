@@ -26,7 +26,8 @@ from ...ops.trees import (
 )
 from ...select.grids import ParamGridBuilder
 from ..base import register_stage
-from .base import ClassifierEstimator, PredictionModel, PredictorEstimator
+from .base import (ClassifierEstimator, MeshAwareFit, PredictionModel,
+                   PredictorEstimator)
 
 
 def _ensemble_params(stage_params: dict) -> TreeEnsembleParams:
@@ -94,7 +95,7 @@ class _TreeModelBase(PredictionModel):
 
 
 @register_stage
-class RandomForestClassifier(ClassifierEstimator):
+class RandomForestClassifier(MeshAwareFit, ClassifierEstimator):
     """Bagged histogram trees with class-distribution leaves (binary + multiclass)."""
 
     operation_name = "randomForestClassifier"
@@ -131,7 +132,7 @@ class RandomForestClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class RandomForestRegressor(PredictorEstimator):
+class RandomForestRegressor(MeshAwareFit, PredictorEstimator):
     operation_name = "randomForestRegressor"
     vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
 
@@ -164,7 +165,7 @@ class RandomForestRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class DecisionTreeClassifier(ClassifierEstimator):
+class DecisionTreeClassifier(MeshAwareFit, ClassifierEstimator):
     """Single un-bagged tree (n_trees=1, no bootstrap) — OpDecisionTreeClassifier."""
 
     operation_name = "decisionTreeClassifier"
@@ -199,7 +200,7 @@ class DecisionTreeClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class DecisionTreeRegressor(PredictorEstimator):
+class DecisionTreeRegressor(MeshAwareFit, PredictorEstimator):
     operation_name = "decisionTreeRegressor"
     vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
 
@@ -230,7 +231,7 @@ class DecisionTreeRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class GBTClassifier(PredictorEstimator):
+class GBTClassifier(MeshAwareFit, PredictorEstimator):
     """Binary gradient-boosted trees (OpGBTClassifier; Spark GBT is binary-only)."""
 
     operation_name = "gbtClassifier"
@@ -267,7 +268,7 @@ class GBTClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class GBTRegressor(PredictorEstimator):
+class GBTRegressor(MeshAwareFit, PredictorEstimator):
     operation_name = "gbtRegressor"
     vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
 
@@ -302,7 +303,7 @@ class GBTRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class XGBoostClassifier(ClassifierEstimator):
+class XGBoostClassifier(MeshAwareFit, ClassifierEstimator):
     """Second-order boosting with XGBoost-style defaults; multiclass via one
     multi-output softmax tree per round (TPU-friendly multi_strategy, no per-class
     tree loops). Analog of OpXGBoostClassifier.scala:48."""
@@ -366,7 +367,7 @@ class XGBoostClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class XGBoostRegressor(PredictorEstimator):
+class XGBoostRegressor(MeshAwareFit, PredictorEstimator):
     operation_name = "xgboostRegressor"
     vmap_params = ("learning_rate", "reg_lambda", "reg_alpha", "min_child_weight",
                    "min_gain")
