@@ -454,6 +454,7 @@ def _run(partial: dict) -> None:
     if os.environ.get("BENCH_EXTRA", "1") != "0":
         # BASELINE.json configs 2/3/5 + the pallas histogram kernel evidence
         from bench_extra import (
+            run_autopilot,
             run_boston,
             run_cold_start,
             run_disagg_ingest,
@@ -531,6 +532,14 @@ def _run(partial: dict) -> None:
             detail["disagg_ingest"].get("two_worker_rows_per_sec")
         partial["disagg_recovery_s"] = \
             detail["disagg_ingest"].get("disagg_recovery_s")
+        # closed-loop autopilot: drift -> warm retrain -> gate -> hot swap;
+        # time-to-recover-AuPR is the ROADMAP headline for the loop
+        try:
+            detail["autopilot"] = run_autopilot()
+        except Exception as e:  # noqa: BLE001
+            detail["autopilot"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        partial["autopilot_time_to_recover_aupr_s"] = \
+            detail["autopilot"].get("autopilot_time_to_recover_aupr_s")
 
     # full payload first (humans / archaeology) ...
     print(json.dumps({
@@ -614,6 +623,13 @@ def _run(partial: dict) -> None:
         s["serving_daemon_rows_per_sec"] = sd["daemon_rows_per_sec"]
         s["serving_daemon_speedup_p50"] = sd["daemon_speedup_p50"]
         s["serving_coalesced_rows_per_dispatch"] = sd["mean_rows_per_dispatch"]
+    if detail.get("autopilot", {}).get(
+            "autopilot_time_to_recover_aupr_s") is not None:
+        ap = detail["autopilot"]
+        s["autopilot_time_to_recover_aupr_s"] = \
+            ap["autopilot_time_to_recover_aupr_s"]
+        s["autopilot_recovered_aupr"] = ap["autopilot_recovered_aupr"]
+        s["autopilot_drifted_aupr"] = ap["autopilot_drifted_aupr"]
     if detail.get("cold_start", {}).get("cold_start_speedup") is not None:
         cs = detail["cold_start"]
         s["cold_start_aot_s"] = cs["cold_start_aot_s"]

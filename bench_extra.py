@@ -912,6 +912,95 @@ def run_cold_start(max_batch: int = 256, n_score_rows: int = 2) -> dict:
     }
 
 
+def run_autopilot(batch: int = 64, max_steps: int = 12) -> dict:
+    """Closed-loop autopilot lane (ISSUE-11; the ROADMAP headline metric):
+    a seeded drifting event stream against a single-LR daemon — drift fires
+    on the monitor, the sustained breach triggers a warm-started retrain
+    through the aggregate reader, the champion/challenger gate promotes, and
+    the alias hot-swaps with zero request errors. Reports
+    `autopilot_time_to_recover_aupr_s`: wall seconds from the drift onset
+    until the SERVED model's AuPR on a fresh current-regime holdout is back
+    (the promotion instant — the swap is what restores quality), split into
+    detection vs retrain+gate+swap. Direction rules: every time_to/_s metric
+    regresses upward (tools/bench_diff.py), the AuPR values downward."""
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.obs.monitor import DriftThresholds
+    from transmogrifai_tpu.serve import (
+        Autopilot,
+        AutopilotConfig,
+        DaemonClient,
+        DriftScenario,
+        ServingDaemon,
+    )
+    from transmogrifai_tpu.serve.autopilot import default_evaluator
+
+    work = tempfile.mkdtemp(prefix="bench_autopilot_")
+    try:
+        sc = DriftScenario(seed=0, batch=batch)
+        champion = sc.make_workflow().train()
+        champ_dir = f"{work}/champion"
+        champion.save(champ_dir, overwrite=True)
+        base_aupr = float(champion.evaluate(
+            default_evaluator(champion), reader=sc.holdout_reader()).AuPR)
+        daemon = ServingDaemon(
+            max_models=3, max_batch=batch, bucket_floor=batch,
+            monitor={"window_batches": 4, "check_every": 1,
+                     "max_rows_per_batch": None,
+                     "thresholds": DriftThresholds(min_rows=batch,
+                                                   max_js_divergence=0.2)})
+        client = DaemonClient(daemon)
+        with daemon:
+            daemon.admit(champ_dir, name="live")
+            pilot = Autopilot(
+                daemon, "live", workflow_factory=sc.make_workflow,
+                holdout=sc.holdout_reader, workdir=f"{work}/candidates",
+                config=AutopilotConfig(breach_checks=2))
+
+            def pump(n=2):
+                for _ in range(n):
+                    out = client.score(sc.serving_batch(), model="live")
+                    assert len(out) == batch and all(
+                        r is not None for r in out), "request error"
+
+            pump(2)
+            pilot.step()  # steady baseline poll
+            drifted_aupr = None
+            t_drift = time.perf_counter()
+            sc.shift_mu()
+            t_detect = t_promote = None
+            for _ in range(max_steps):
+                pump(2)
+                d = pilot.step()
+                if t_detect is None and d["drifted"]:
+                    t_detect = time.perf_counter()
+                    drifted_aupr = float(champion.evaluate(
+                        default_evaluator(champion),
+                        reader=sc.holdout_reader()).AuPR)
+                if d["action"] == "promoted":
+                    t_promote = time.perf_counter()
+                    break
+            assert t_promote is not None, "autopilot never promoted"
+            served = daemon._resolve("live").model
+            recovered_aupr = float(served.evaluate(
+                default_evaluator(served), reader=sc.holdout_reader()).AuPR)
+            pump(1)  # the swapped model serves (zero errors asserted above)
+        return {
+            "batch_size": batch,
+            "autopilot_time_to_recover_aupr_s": round(
+                t_promote - t_drift, 3),
+            "autopilot_detect_s": round(t_detect - t_drift, 3),
+            "autopilot_retrain_gate_swap_s": round(t_promote - t_detect, 3),
+            "autopilot_base_aupr": round(base_aupr, 4),
+            "autopilot_drifted_aupr": round(drifted_aupr, 4),
+            "autopilot_recovered_aupr": round(recovered_aupr, 4),
+            "autopilot_promotions": pilot.promotions,
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
               max_depth: int = 6, n_bins: int = 64) -> dict:
     """Gradient-boosted trees at data scale: 1M rows x 256 features, n_trees

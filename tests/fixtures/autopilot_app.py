@@ -1,0 +1,39 @@
+"""`op autopilot --app tests.fixtures.autopilot_app:make_autopilot` fixture:
+a fully wired loop over the seeded DriftScenario — a single-LR champion
+admitted under the "live" alias on a monitored in-process daemon. The CLI
+test drives it with --max-steps; nothing drifts unless the test shifts the
+scenario first."""
+import tempfile
+
+from transmogrifai_tpu.obs.monitor import DriftThresholds
+from transmogrifai_tpu.serve import (
+    Autopilot,
+    AutopilotConfig,
+    DriftScenario,
+    ServingDaemon,
+)
+
+BATCH = 64
+
+#: the most recent wiring, for tests that want to pump traffic or shift
+#: the regime around the CLI invocation
+LAST: dict = {}
+
+
+def make_autopilot() -> Autopilot:
+    sc = DriftScenario(seed=0, batch=BATCH)
+    champion = sc.make_workflow().train()
+    work = tempfile.mkdtemp(prefix="autopilot_app_")
+    champion.save(f"{work}/champion", overwrite=True)
+    daemon = ServingDaemon(
+        max_models=3, max_batch=BATCH, bucket_floor=BATCH,
+        monitor={"window_batches": 4, "check_every": 1,
+                 "max_rows_per_batch": None,
+                 "thresholds": DriftThresholds(min_rows=BATCH,
+                                               max_js_divergence=0.2)})
+    daemon.admit(f"{work}/champion", name="live")
+    pilot = Autopilot(daemon, "live", workflow_factory=sc.make_workflow,
+                      holdout=sc.holdout_reader, workdir=f"{work}/candidates",
+                      config=AutopilotConfig(breach_checks=2))
+    LAST.update(scenario=sc, daemon=daemon, pilot=pilot, workdir=work)
+    return pilot

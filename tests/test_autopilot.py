@@ -1,0 +1,505 @@
+"""Closed-loop autopilot (serve/autopilot.py) + daemon hot-swap machinery.
+
+Pins the ISSUE-11 acceptance surface: a sustained drift breach triggers a
+warm-started retrain, the champion/challenger gate promotes only a better
+candidate, the hot swap is an alias repoint with zero request errors and no
+unwarmed-shape compiles on the hot path, every chaos-injected failure mode
+(retrain crash, torn save, swap-time device fault) leaves the champion
+serving, and the whole observe->retrain->gate->swap loop replays
+byte-identically from the same seed.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.obs.monitor import DriftThresholds
+from transmogrifai_tpu.resilience import FaultInjector
+from transmogrifai_tpu.serve import (
+    Autopilot,
+    AutopilotConfig,
+    DaemonClient,
+    DriftScenario,
+    ServingDaemon,
+    make_http_server,
+)
+
+BATCH = 64
+
+MONITOR = {
+    "window_batches": 4, "check_every": 1, "max_rows_per_batch": None,
+    "thresholds": DriftThresholds(min_rows=BATCH, max_js_divergence=0.2),
+}
+
+
+def make_loop(tmp_path, seed=0, config=None, monitor=None, daemon_kw=None):
+    """One wired loop: champion trained at mu=0, admitted under the alias
+    "live" on a monitored daemon, autopilot watching it."""
+    sc = DriftScenario(seed=seed, batch=BATCH)
+    champion = sc.make_workflow().train()
+    mdir = str(tmp_path / "champion")
+    champion.save(mdir, overwrite=True)
+    daemon = ServingDaemon(**{
+        "max_models": 3, "max_batch": BATCH, "bucket_floor": BATCH,
+        "monitor": monitor or MONITOR, **(daemon_kw or {})})
+    daemon.admit(mdir, name="live")
+    pilot = Autopilot(
+        daemon, "live", workflow_factory=sc.make_workflow,
+        holdout=sc.holdout_reader, workdir=str(tmp_path / "work"),
+        config=config or AutopilotConfig(breach_checks=2))
+    return sc, daemon, pilot
+
+
+def pump(daemon, sc, n=2):
+    """Drive n serving batches through the daemon's alias; every row must
+    come back scored (the zero-request-errors assertion, applied at every
+    call site)."""
+    client = DaemonClient(daemon)
+    for _ in range(n):
+        out = client.score(sc.serving_batch(), model="live")
+        assert len(out) == BATCH and all(r is not None for r in out), \
+            "request errors across the loop"
+
+
+def drive_to_promotion(sc, daemon, pilot):
+    """The canonical episode: steady -> drift -> sustained breach ->
+    promotion. Returns the per-step decisions."""
+    decisions = []
+    pump(daemon, sc, 2)
+    decisions.append(pilot.step())          # steady: observe
+    sc.shift_mu()
+    pump(daemon, sc, 2)
+    decisions.append(pilot.step())          # drifted: streak 1
+    pump(daemon, sc, 2)
+    decisions.append(pilot.step())          # drifted: streak 2 -> act
+    return decisions
+
+
+class TestLoop:
+    def test_promotes_on_sustained_breach_only(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            decisions = drive_to_promotion(sc, daemon, pilot)
+            assert [d["action"] for d in decisions] == \
+                ["observe", "observe", "promoted"]
+            assert decisions[1]["drifted"] and decisions[1]["streak"] == 1
+            gate = decisions[2]["gate"]
+            # the drifted concept collapses the champion's ranking; the
+            # warm-started retrain recovers it
+            assert gate["challenger"] > 0.9 > gate["champion"]
+            assert pilot.promotions == 1
+            # the alias now resolves to the promoted fingerprint; the old
+            # champion stays resident (the rollback target)
+            assert daemon.aliases()["live"] == pilot.history[-1]["fingerprint"]
+            assert len(daemon.models()) == 2
+            # post-swap traffic is in-distribution for the NEW baseline
+            pump(daemon, sc, 2)
+            after = pilot.step()
+            assert after["action"] == "observe" and not after["drifted"]
+
+    def test_swap_serves_without_hot_path_compiles(self, tmp_path):
+        """The first post-swap request hits admission-warmed executables:
+        zero trace/lower/compile events on the serving path."""
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            drive_to_promotion(sc, daemon, pilot)
+            with obs.retrace_budget(0):
+                pump(daemon, sc, 2)
+
+    def test_zero_errors_under_concurrent_traffic_during_swap(self, tmp_path):
+        """Requests hammering the alias from worker threads while the act
+        step retrains + swaps: every single one succeeds."""
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            pump(daemon, sc, 2)
+            pilot.step()
+            sc.shift_mu()
+            pump(daemon, sc, 2)
+            pilot.step()
+            pump(daemon, sc, 2)
+            client = DaemonClient(daemon)
+            errors, done = [], threading.Event()
+
+            def hammer():
+                while not done.is_set():
+                    try:
+                        out = client.score(sc.serving_batch(8), model="live")
+                        if len(out) != 8 or any(r is None for r in out):
+                            errors.append("bad result")
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                decision = pilot.step()   # retrain + gate + swap under fire
+            finally:
+                done.set()
+                for t in threads:
+                    t.join()
+            assert decision["action"] == "promoted"
+            assert errors == []
+
+    def test_gate_rejects_non_improving_candidate(self, tmp_path):
+        """An impossible promotion margin: the candidate gates out, the
+        champion keeps serving, nothing was swapped."""
+        sc, daemon, pilot = make_loop(
+            tmp_path, config=AutopilotConfig(breach_checks=2,
+                                             promotion_margin=2.0))
+        with daemon:
+            fp_before = daemon.aliases()["live"]
+            decisions = drive_to_promotion(sc, daemon, pilot)
+            assert decisions[-1]["action"] == "rejected"
+            assert daemon.aliases()["live"] == fp_before
+            assert pilot.promotions == 0
+            pump(daemon, sc, 1)  # champion still serving
+
+    def test_lint_gate_rejects_error_plans(self, tmp_path):
+        """A candidate whose analysis report carries errors never reaches
+        the serving path, however well it would score."""
+        sc, daemon, pilot = make_loop(tmp_path)
+
+        class _BadReport:
+            has_errors = True
+
+            class _D:
+                code = "OP999"
+            errors = [_D()]
+
+        real_factory = pilot._workflow_factory
+
+        def tainted_factory():
+            wf = real_factory()
+            real_train = wf.train
+
+            def train(*a, **kw):
+                model = real_train(*a, **kw)
+                model.analysis_report = _BadReport()
+                return model
+
+            wf.train = train
+            return wf
+
+        pilot._workflow_factory = tainted_factory
+        with daemon:
+            fp_before = daemon.aliases()["live"]
+            decisions = drive_to_promotion(sc, daemon, pilot)
+            assert decisions[-1]["action"] == "lint_rejected"
+            assert decisions[-1]["codes"] == ["OP999"]
+            assert daemon.aliases()["live"] == fp_before
+
+    def test_rollback_repoints_to_previous_champion(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            fp_before = daemon.aliases()["live"]
+            drive_to_promotion(sc, daemon, pilot)
+            assert daemon.aliases()["live"] != fp_before
+            restored = pilot.rollback()
+            assert restored == fp_before
+            assert daemon.aliases()["live"] == fp_before
+            assert pilot.rollbacks == 1
+            pump(daemon, sc, 1)  # the restored champion serves immediately
+            assert pilot.rollback() is None  # nothing left to roll back
+
+    def test_demoted_monitor_episode_resolves(self, tmp_path):
+        """Promotion resolves the demoted champion's drift episode: the
+        drift:cleared counter ticks (no traffic will ever clear it
+        naturally)."""
+        reg = obs.default_registry()
+
+        def cleared_total():
+            return sum(m.value for m in reg.collect()
+                       if m.name == "serving_drift_cleared_total")
+
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            before = cleared_total()
+            drive_to_promotion(sc, daemon, pilot)
+            assert cleared_total() > before
+
+
+class TestChaos:
+    def test_retrain_crash_leaves_champion_serving(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            fp_before = daemon.aliases()["live"]
+            pump(daemon, sc, 2)
+            pilot.step()
+            sc.shift_mu()
+            pump(daemon, sc, 2)
+            pilot.step()
+            pump(daemon, sc, 2)
+            inj = FaultInjector(seed=0, fail_sites={"autopilot:retrain": 1})
+            with inj.installed():
+                decision = pilot.step()
+            assert decision["action"] == "retrain_failed"
+            assert [e[0] for e in inj.events] == ["site_fault"]
+            assert daemon.aliases()["live"] == fp_before
+            pump(daemon, sc, 2)  # zero request errors: champion serving
+            # the loop re-arms: the breach must SUSTAIN again, then the
+            # fault-free retrain promotes
+            pilot.step()
+            pump(daemon, sc, 2)
+            decision = pilot.step()
+            assert decision["action"] == "promoted"
+
+    def test_torn_save_leaves_champion_serving(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            fp_before = daemon.aliases()["live"]
+            pump(daemon, sc, 2)
+            pilot.step()
+            sc.shift_mu()
+            pump(daemon, sc, 2)
+            pilot.step()
+            pump(daemon, sc, 2)
+            inj = FaultInjector(seed=1, fail_sites={"autopilot:save": 1})
+            with inj.installed():
+                decision = pilot.step()
+            assert decision["action"] == "save_failed"
+            assert daemon.aliases()["live"] == fp_before
+            assert pilot.promotions == 0
+            pump(daemon, sc, 2)
+
+    def test_swap_time_device_fault_zero_request_errors(self, tmp_path):
+        """Chaos device faults at serve:dispatch DURING the promotion step,
+        with traffic in flight: the breaker/failover machinery absorbs them
+        — every request succeeds against some valid model."""
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            pump(daemon, sc, 2)
+            pilot.step()
+            sc.shift_mu()
+            pump(daemon, sc, 2)
+            pilot.step()
+            pump(daemon, sc, 2)
+            client = DaemonClient(daemon)
+            errors, done = [], threading.Event()
+
+            def hammer():
+                while not done.is_set():
+                    try:
+                        out = client.score(sc.serving_batch(8), model="live")
+                        if any(r is None for r in out):
+                            errors.append("bad result")
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+            t = threading.Thread(target=hammer)
+            inj = FaultInjector(seed=2, device_failures=3)
+            with inj.installed():
+                t.start()
+                try:
+                    decision = pilot.step()
+                finally:
+                    done.set()
+                    t.join()
+            assert decision["action"] == "promoted"
+            assert errors == []
+
+    def test_same_seed_replays_byte_identical(self, tmp_path):
+        """Two independent loops from the same seed produce the identical
+        structured event log — observe, gate numbers, promotion, all of it."""
+        def run(base):
+            sc, daemon, pilot = make_loop(base)
+            with daemon:
+                drive_to_promotion(sc, daemon, pilot)
+                pump(daemon, sc, 2)
+                pilot.step()
+            return pilot.events
+
+        a = run(tmp_path / "a")
+        b = run(tmp_path / "b")
+        assert a == b
+        assert any(e[1] == "promoted" for e in a)
+
+
+class TestDaemonSwap:
+    def test_repoint_requires_resident_target(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            with pytest.raises(KeyError):
+                daemon.repoint("live", "deadbeef" * 8)
+
+    def test_swap_retire_old_drains_previous(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        pilot.config.retire_old = True
+        with daemon:
+            drive_to_promotion(sc, daemon, pilot)
+            assert len(daemon.models()) == 1  # demoted champion retired
+            pump(daemon, sc, 1)
+
+    def test_failed_swap_admission_leaves_alias(self, tmp_path):
+        """A torn bundle on disk (no manifest): swap raises before the
+        alias moves."""
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            fp = daemon.aliases()["live"]
+            torn = tmp_path / "torn"
+            torn.mkdir()
+            (torn / "params-zz.npz").write_bytes(b"\x00" * 16)
+            with pytest.raises(Exception):
+                daemon.swap("live", str(torn))
+            assert daemon.aliases()["live"] == fp
+            pump(daemon, sc, 1)
+
+
+class TestHttpBodyCap:
+    def test_oversized_post_413_and_counted(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        server = make_http_server(daemon, port=0, max_body_bytes=1024)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}/v1/score"
+        try:
+            with daemon:
+                big = json.dumps({"model": "live",
+                                  "records": [{"a": 0.1, "cat": "a"}] * 512})
+                req = urllib.request.Request(
+                    url, data=big.encode(),
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=30)
+                assert ei.value.code == 413
+                rej = obs.default_registry().find(
+                    "serve_rejected_total", labels={"reason": "too_large"})
+                assert rej is not None and rej.value >= 1
+                # a right-sized request still flows
+                ok = json.dumps({"model": "live",
+                                 "records": [{"a": 0.1, "cat": "a"}]})
+                req = urllib.request.Request(
+                    url, data=ok.encode(),
+                    headers={"Content-Type": "application/json"})
+                body = json.loads(urllib.request.urlopen(
+                    req, timeout=60).read())
+                assert len(body["results"]) == 1
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+
+    def test_bad_content_length_rejected(self, tmp_path):
+        sc, daemon, pilot = make_loop(tmp_path)
+        server = make_http_server(daemon, port=0, max_body_bytes=1024)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        port = server.server_address[1]
+        try:
+            with daemon:
+                import http.client
+
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                conn.putrequest("POST", "/v1/score")
+                conn.putheader("Content-Length", "not-a-number")
+                conn.endheaders()
+                resp = conn.getresponse()
+                assert resp.status == 411
+                conn.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+
+
+class TestCli:
+    def test_op_autopilot_runs_and_reports(self, capsys):
+        """`op autopilot --app ... --max-steps 2` polls twice against steady
+        traffic and reports zero promotions (the wall-clock loop surface)."""
+        import json as _json
+
+        from transmogrifai_tpu.cli.main import main as cli_main
+
+        from tests.fixtures import autopilot_app
+
+        rc = cli_main(["autopilot",
+                       "--app", "tests.fixtures.autopilot_app:make_autopilot",
+                       "--max-steps", "2", "--poll-s", "0.01", "--json"])
+        try:
+            assert rc == 0
+            out = capsys.readouterr().out
+            report = _json.loads(out)
+            assert report["steps"] == 2 and report["promotions"] == 0
+            assert [e[1] for e in report["events"]] == ["observe", "observe"]
+        finally:
+            autopilot_app.LAST["daemon"].close()
+
+
+class TestRollbackToken:
+    def test_failed_rollback_keeps_history(self, tmp_path):
+        """retire_old=True released the previous champion: rollback raises
+        (nothing resident to repoint at) but the history entry SURVIVES for
+        inspection/retry — the token is not destroyed by the failure."""
+        sc, daemon, pilot = make_loop(tmp_path)
+        pilot.config.retire_old = True
+        with daemon:
+            drive_to_promotion(sc, daemon, pilot)
+            assert len(pilot.history) == 1
+            with pytest.raises(KeyError):
+                pilot.rollback()
+            assert len(pilot.history) == 1  # token intact
+            assert pilot.rollbacks == 0
+            pump(daemon, sc, 1)  # promoted model still serving
+
+
+class TestCapacityPressure:
+    def test_swap_at_capacity_one_zero_request_errors(self, tmp_path):
+        """max_models=1: the alias's current target is protected from LRU
+        eviction during the swap admission (the cache briefly overshoots),
+        so mid-swap requests never find a dangling alias; the post-repoint
+        trim then reclaims the demoted champion."""
+        sc, daemon, pilot = make_loop(tmp_path,
+                                      daemon_kw={"max_models": 1})
+        with daemon:
+            pump(daemon, sc, 2)
+            pilot.step()
+            sc.shift_mu()
+            pump(daemon, sc, 2)
+            pilot.step()
+            pump(daemon, sc, 2)
+            client = DaemonClient(daemon)
+            errors, done = [], threading.Event()
+
+            def hammer():
+                while not done.is_set():
+                    try:
+                        out = client.score(sc.serving_batch(8), model="live")
+                        if any(r is None for r in out):
+                            errors.append("bad result")
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                decision = pilot.step()
+            finally:
+                done.set()
+                for t in threads:
+                    t.join()
+            assert decision["action"] == "promoted"
+            assert errors == []
+            # capacity enforced after the repoint: only the new champion
+            assert len(daemon.models()) == 1
+            pump(daemon, sc, 1)
+
+    def test_unresolvable_alias_contained(self, tmp_path, model=None):
+        """An alias stripped by outside eviction degrades to an observable
+        'alias_unresolved' decision — the loop never crashes or acts."""
+        sc, daemon, pilot = make_loop(tmp_path)
+        with daemon:
+            pump(daemon, sc, 1)
+            with daemon._lock:  # simulate outside eviction stripping it
+                daemon._names.pop("live")
+            d = pilot.step()
+            assert d["action"] == "alias_unresolved"
+            # _retrain_and_gate is contained too (worker-thread survival)
+            out = pilot._retrain_and_gate()
+            assert out["action"] == "retrain_failed"
+            assert pilot._streak == 0  # debounce re-armed by the finally

@@ -55,6 +55,14 @@ def test_direction_inference():
     assert not bench_diff.lower_is_better(
         "multichip_gbt_rows_trees_per_sec_1x8")
     assert not bench_diff.lower_is_better("gbt_hist_mfu")
+    # the autopilot lane: "time_to_X" is wall clock even when X is a quality
+    # metric name (the fragment rule must outrank the AuPR override), and the
+    # recovered quality itself stays higher-better
+    assert bench_diff.lower_is_better("autopilot_time_to_recover_aupr_s")
+    assert bench_diff.lower_is_better("time_to_recover_aupr")
+    assert bench_diff.lower_is_better("autopilot_time_to_promote_s")
+    assert not bench_diff.lower_is_better("autopilot_recovered_aupr")
+    assert not bench_diff.lower_is_better("autopilot_drifted_aupr")
 
 
 def test_cold_start_compile_events_zero_baseline():

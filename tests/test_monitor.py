@@ -380,3 +380,76 @@ def test_cli_monitor_fail_on_drift(capsys):
     rc = cli_main(["monitor", "--demo", "--fail-on-drift"])
     assert rc == 3  # the demo drifts by construction
     capsys.readouterr()
+
+
+# --- falling edge: drift:cleared (ISSUE-11 satellite) -----------------------------------
+def test_windowed_monitor_clears_when_traffic_recovers():
+    """The falling edge: a windowed monitor's alert CLEARS once traffic
+    returns in-distribution — drift:cleared counter ticks, the active set
+    empties, and the gauge drops back under threshold. Cumulative sketches
+    would latch for many batches; the window bounds the recovery lag."""
+    model = _train()
+    reg = M.MetricsRegistry()
+    th = DriftThresholds(min_rows=128, max_js_divergence=0.25)
+    mon = ServingMonitor.for_model(model, registry=reg, thresholds=th,
+                                   window_batches=3, check_every=1,
+                                   max_rows_per_batch=None)
+    fn = model.score_fn(backend="cpu", monitor=mon)
+    for seed in (41, 42, 43):  # one full drifted window
+        fn.batch(_rows(200, seed=seed, shift=40.0, labeled=False))
+    assert ("age", "js_divergence") in mon._active
+    assert reg.find("serving_drift_cleared_total",
+                    labels={"feature": "age",
+                            "kind": "js_divergence"}) is None
+    for seed in (51, 52, 53):  # one full recovered window
+        fn.batch(_rows(200, seed=seed, labeled=False))
+    assert mon.report()["active_alerts"] == []
+    cleared = reg.find("serving_drift_cleared_total",
+                       labels={"feature": "age", "kind": "js_divergence"})
+    assert cleared is not None and cleared.value == 1
+    assert reg.gauge("serving_js_divergence",
+                     labels={"feature": "age"}).value <= th.max_js_divergence
+    # re-drift re-arms: the alert can fire again after a clear
+    for seed in (61, 62, 63):
+        fn.batch(_rows(200, seed=seed, shift=40.0, labeled=False))
+    assert ("age", "js_divergence") in mon._active
+    assert reg.counter("serving_drift_alerts_total",
+                       labels={"feature": "age",
+                               "kind": "js_divergence"}).value == 2
+
+
+def test_window_reset_checks_before_dropping_sketches():
+    """A drift episode confined to exactly one window still alerts: the
+    boundary check runs over the full window BEFORE the reset drops it."""
+    model = _train()
+    reg = M.MetricsRegistry()
+    mon = ServingMonitor.for_model(
+        model, registry=reg,
+        thresholds=DriftThresholds(min_rows=128, max_js_divergence=0.25),
+        window_batches=1, check_every=8,  # check throttle >> window
+        max_rows_per_batch=None)
+    fn = model.score_fn(backend="cpu", monitor=mon)
+    fn.batch(_rows(200, seed=71, shift=40.0, labeled=False))
+    assert ("age", "js_divergence") in mon._active
+    assert mon.sketches == {}  # the window reset
+
+
+def test_resolve_active_emits_cleared(monkeypatch):
+    """Explicit resolution (the autopilot demoting a champion) emits the
+    same drift:cleared signal the natural falling edge does."""
+    model = _train()
+    reg = M.MetricsRegistry()
+    mon = ServingMonitor.for_model(
+        model, registry=reg,
+        thresholds=DriftThresholds(min_rows=128, max_js_divergence=0.25))
+    fn = model.score_fn(backend="cpu", monitor=mon)
+    fn.batch(_rows(200, seed=81, shift=40.0, labeled=False))
+    mon.check()
+    assert mon._active
+    resolved = mon.resolve_active(reason="promoted")
+    assert ("age", "js_divergence") in resolved
+    assert mon._active == set()
+    cleared = reg.find("serving_drift_cleared_total",
+                       labels={"feature": "age", "kind": "js_divergence"})
+    assert cleared is not None and cleared.value >= 1
+    assert mon.resolve_active() == []  # idempotent

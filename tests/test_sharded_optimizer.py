@@ -414,3 +414,35 @@ class TestMeshTreeLane:
         a = plain.predict(jnp.asarray(X))[0]
         b = meshed.predict(jnp.asarray(X))[0]
         assert bool((a == b).all())
+
+
+class TestWarmStartPrecedence:
+    def test_sharded_fit_ignores_init_params(self, mesh8, data):
+        """The sharding contract outranks the warm-start optimization: a fit
+        that resolves to the sharded path cold-fits sharded, identical to a
+        sharded fit with no init at all (init_params ignored); the binding
+        shard_optimizer="on" error is likewise unaffected by init_params."""
+        X, y = data
+        kw = dict(num_classes=2, hidden=(16, 8), max_iter=25)
+        cold_sh = fit_mlp(X, y, mesh=mesh8, **kw)
+        bogus = [(np.full_like(np.asarray(W), 7.0), np.asarray(b))
+                 for W, b in fit_mlp(X, y, **kw)]
+        warm_sh = fit_mlp(X, y, mesh=mesh8, init_params=bogus, **kw)
+        _leaves_allclose(cold_sh, warm_sh, rtol=0, atol=0)  # bitwise: ignored
+        with pytest.raises(ValueError, match="shard_optimizer"):
+            # "on" stays binding with init_params riding along
+            fit_mlp(X, y, shard_optimizer="on", init_params=bogus, **kw)
+
+    def test_unmeshed_warm_start_uses_init(self, data):
+        """Without a mesh the init applies: warm params differ from cold at
+        few steps (different start), and a mismatched architecture raises."""
+        X, y = data
+        kw = dict(num_classes=2, hidden=(16, 8), max_iter=5)
+        cold = fit_mlp(X, y, **kw)
+        src = fit_mlp(X, y, num_classes=2, hidden=(16, 8), max_iter=60)
+        warm = fit_mlp(X, y, init_params=src, **kw)
+        assert not np.allclose(np.asarray(cold[0][0]),
+                               np.asarray(warm[0][0]))
+        with pytest.raises(ValueError, match="init_params layer shapes"):
+            fit_mlp(X, y, num_classes=2, hidden=(4,), max_iter=5,
+                    init_params=src)

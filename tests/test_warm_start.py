@@ -1,0 +1,222 @@
+"""Warm-start refit plumbing (stages/model/base.py, ops, selector).
+
+The ISSUE-11 satellite contract, pinned per estimator family: families that
+accept initial params (LogisticRegression, MLPClassifier) produce results
+matching the cold fit at convergence; families that don't (LinearRegression,
+the tree ensembles) SILENTLY fall back to the cold fit — bitwise, since the
+warm kwargs resolve to {} and the very same fit_fn call runs.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.graph import FeatureBuilder, features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import BinaryClassificationModelSelector
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import (
+    LinearRegression,
+    LogisticRegression,
+    MLPClassifier,
+)
+from transmogrifai_tpu.types import Column, Table
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _xy(seed=0, n=200, d=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(
+        np.float32)
+    return X, y
+
+
+def _label_vec_table(X, y):
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.types.vector_schema import SlotInfo, VectorSchema
+
+    schema = VectorSchema(tuple(
+        SlotInfo("w", "Real", descriptor=f"x{i}") for i in range(X.shape[1])))
+    return Table({
+        "label": Column.build("RealNN", [float(v) for v in y]),
+        "vec": Column.vector(jnp.asarray(X), schema=schema),
+    })
+
+
+def _fit(est, table):
+    est(FeatureBuilder("label", "RealNN").as_response(),
+        FeatureBuilder("vec", "OPVector").as_predictor())
+    return est.fit_table(table)
+
+
+class TestLogisticRegression:
+    def test_warm_equals_cold_at_convergence(self):
+        """Newton-IRLS has a unique l2-regularized optimum: warm and cold
+        fits land on the same weights once converged."""
+        X, y = _xy()
+        t = _label_vec_table(X, y)
+        cold = _fit(LogisticRegression(l2=0.01, max_iter=50), t)
+        warm = _fit(LogisticRegression(l2=0.01, max_iter=50)
+                    .with_warm_start(cold), t)
+        np.testing.assert_allclose(
+            np.asarray(warm.params["w"]), np.asarray(cold.params["w"]),
+            rtol=1e-4, atol=1e-5)
+        assert warm.params["b"] == pytest.approx(cold.params["b"], abs=1e-4)
+
+    def test_warm_from_converged_is_fixed_point(self):
+        """One warm Newton step from the optimum stays at the optimum —
+        the 'retrain on near-identical data is almost free' property."""
+        X, y = _xy()
+        t = _label_vec_table(X, y)
+        cold = _fit(LogisticRegression(l2=0.01, max_iter=50), t)
+        warm = _fit(LogisticRegression(l2=0.01, max_iter=2)
+                    .with_warm_start(cold), t)
+        np.testing.assert_allclose(
+            np.asarray(warm.params["w"]), np.asarray(cold.params["w"]),
+            rtol=1e-3, atol=1e-4)
+
+    def test_width_mismatch_silently_cold_fits(self):
+        X, y = _xy(d=6)
+        Xw, yw = _xy(seed=1, d=9)
+        src = _fit(LogisticRegression(max_iter=25), _label_vec_table(X, y))
+        est = LogisticRegression(max_iter=25).with_warm_start(src)
+        assert est.warm_fit_kwargs(9) == {}  # wrong width -> cold
+        cold = _fit(LogisticRegression(max_iter=25),
+                    _label_vec_table(Xw, yw))
+        warm = _fit(est, _label_vec_table(Xw, yw))
+        np.testing.assert_array_equal(np.asarray(warm.params["w"]),
+                                      np.asarray(cold.params["w"]))
+
+    def test_family_mismatch_silently_cold_fits(self):
+        X, y = _xy()
+        t = _label_vec_table(X, y)
+        lin = _fit(LinearRegression(), t)  # linReg params also carry w/b
+        est = LogisticRegression().with_warm_start(lin)
+        assert est.warm_fit_kwargs(X.shape[1]) == {}
+
+
+class TestMLPClassifier:
+    def test_warm_start_applies_and_matches_converged_source(self):
+        """Warm-starting from an already-converged MLP and training further
+        keeps the decision function (the optimizer sits in the same basin);
+        the init kwargs actually applied (not a silent cold fit)."""
+        X, y = _xy(n=240, d=5)
+        t = _label_vec_table(X, y)
+        cold = _fit(MLPClassifier(hidden=(8,), max_iter=300, seed=3), t)
+        est = MLPClassifier(hidden=(8,), max_iter=60, seed=3)
+        est.with_warm_start(cold)
+        assert est.warm_fit_kwargs(X.shape[1])  # non-empty: applied
+        warm = _fit(est, t)
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.ops.mlp import predict_mlp
+
+        params_c = [(jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
+                    for W, b in cold.params["layers"]]
+        params_w = [(jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
+                    for W, b in warm.params["layers"]]
+        pred_c = np.asarray(predict_mlp(params_c, jnp.asarray(X))[0])
+        pred_w = np.asarray(predict_mlp(params_w, jnp.asarray(X))[0])
+        assert (pred_c == pred_w).mean() > 0.97
+
+    def test_architecture_mismatch_silently_cold_fits(self):
+        X, y = _xy(n=200, d=5)
+        t = _label_vec_table(X, y)
+        src = _fit(MLPClassifier(hidden=(8,), max_iter=40), t)
+        est = MLPClassifier(hidden=(16,), max_iter=40)  # different topology
+        est.with_warm_start(src)
+        assert est.warm_fit_kwargs(X.shape[1]) == {}
+        est2 = MLPClassifier(hidden=(8,), max_iter=40)
+        est2.with_warm_start(src)
+        assert est2.warm_fit_kwargs(X.shape[1] + 1) == {}  # width change
+
+
+class TestUnsupportedFamiliesFallBack:
+    @pytest.mark.parametrize("family", ["linreg", "forest", "gbt"])
+    def test_no_warm_start_param_means_cold_fit(self, family):
+        """Families without warm-start support resolve {} warm kwargs —
+        the fit call is the identical cold fit, bitwise."""
+        X, y = _xy(n=160, d=4)
+        t = _label_vec_table(X, y)
+        if family == "linreg":
+            make = lambda: LinearRegression()  # noqa: E731
+        else:
+            from transmogrifai_tpu.stages.model.trees import (
+                GBTClassifier,
+                RandomForestClassifier,
+            )
+
+            make = ((lambda: RandomForestClassifier(n_trees=3, max_depth=3))
+                    if family == "forest"
+                    else (lambda: GBTClassifier(n_trees=3, max_depth=3)))
+        cold_est = make()
+        assert cold_est.warm_start_param is None
+        cold = _fit(cold_est, t)
+        warm_est = make().with_warm_start(cold)
+        assert warm_est.warm_fit_kwargs(X.shape[1]) == {}
+        warm = _fit(warm_est, t)
+        for k, v in cold.params.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(warm.params[k]),
+                                          err_msg=f"{family}:{k}")
+
+
+class TestSelectorAndWorkflow:
+    def test_selector_warm_starts_only_the_winner_refit(self):
+        """with_warm_start on the selector: validation scores are identical
+        to the cold search (the vmapped search never sees the source), and
+        the refit winner matches the cold refit at convergence."""
+        X, y = _xy(n=240, d=5)
+        t = _label_vec_table(X, y)
+
+        def make_sel():
+            return BinaryClassificationModelSelector.with_cross_validation(
+                num_folds=2,
+                models=[(LogisticRegression(max_iter=40),
+                         [{"l2": 0.001}, {"l2": 0.01}])])
+
+        cold_sel = make_sel()
+        cold = _fit(cold_sel, t)
+        warm_sel = make_sel().with_warm_start(cold)
+        warm = _fit(warm_sel, t)
+        cv_cold = [(r.model_name, r.metric_mean)
+                   for r in cold_sel.summary_.validation_results]
+        cv_warm = [(r.model_name, r.metric_mean)
+                   for r in warm_sel.summary_.validation_results]
+        assert cv_cold == cv_warm
+        np.testing.assert_allclose(np.asarray(warm.params["w"]),
+                                   np.asarray(cold.params["w"]),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_workflow_with_warm_start_matches_across_fresh_graphs(self):
+        """Fresh feature graphs re-number uids, so output names shift: the
+        positional fallback still wires the champion's prediction stage
+        into the new graph's estimator."""
+        rng = np.random.default_rng(0)
+        rows = [{"label": float(i % 2), "a": float(i % 2) + rng.normal(0, 0.2),
+                 "cat": "ab"[i % 2]} for i in range(96)]
+
+        def make_wf():
+            fs = features_from_schema(
+                {"label": "RealNN", "a": "Real", "cat": "PickList"},
+                response="label")
+            pred = LogisticRegression(l2=0.01)(
+                fs["label"], transmogrify([fs["a"], fs["cat"]]))
+            return Workflow().set_reader(
+                InMemoryReader(rows)).set_result_features(pred)
+
+        champion = make_wf().train()
+        wf2 = make_wf()
+        wf2.with_warm_start(champion)
+        ests = [s for layer in wf2._dag for s in layer
+                if getattr(s, "warm_start_param", None) is not None]
+        assert ests and all(
+            getattr(e, "_warm_source", None) is not None for e in ests)
+        model2 = wf2.train()
+        champ_stage = next(s for s in champion.stages
+                           if s.operation_name == "logReg")
+        new_stage = next(s for s in model2.stages
+                         if s.operation_name == "logReg")
+        np.testing.assert_allclose(np.asarray(new_stage.params["w"]),
+                                   np.asarray(champ_stage.params["w"]),
+                                   rtol=1e-3, atol=1e-4)

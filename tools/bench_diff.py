@@ -44,6 +44,11 @@ _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
 
 def lower_is_better(name: str) -> bool:
     n = name.lower()
+    # "time_to_X" is wall clock whatever X is — X is usually a QUALITY
+    # metric name (time_to_recover_aupr, the autopilot lane's headline), so
+    # this rule must outrank the quality-fragment overrides below
+    if "time_to" in n:
+        return True
     if any(frag in n for frag in _HIGHER_BETTER):
         return False
     return (any(n.endswith(suf) for suf in _LOWER_SUFFIXES)

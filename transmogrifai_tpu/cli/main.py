@@ -370,6 +370,17 @@ def _cmd_serve(argv) -> int:
                          "submissions beyond it get HTTP 429 + "
                          "serve_shed_total instead of unbounded queueing "
                          "(default 4096; OpParams.serve_queue_depth)")
+    ap.add_argument("--max-body-bytes", type=int, default=None,
+                    help="POST body ceiling in bytes: oversized bodies are "
+                         "answered 413 WITHOUT being read, counted on "
+                         "serve_rejected_total (default 8 MiB; "
+                         "OpParams.serve_max_body_bytes)")
+    ap.add_argument("--monitor", action="store_true",
+                    help="arm per-model drift monitoring: scoring batches "
+                         "fold into drift sketches against each model's "
+                         "stamped serving_baseline (serving_js_divergence/"
+                         "serving_fill_rate gauges + DriftAlerts — what "
+                         "`op autopilot` watches)")
     ap.add_argument("--backend", default="auto",
                     choices=["auto", "cpu", "device"],
                     help="serving lane policy: auto (default) routes by the "
@@ -408,6 +419,8 @@ def _cmd_serve(argv) -> int:
                     else params.serve_bucket_floor)
     queue_depth = (args.queue_depth if args.queue_depth is not None
                    else params.serve_queue_depth)
+    max_body = (args.max_body_bytes if args.max_body_bytes is not None
+                else params.serve_max_body_bytes)
     mesh = None
     if args.mesh is not None:
         from transmogrifai_tpu.mesh import default_mesh, parse_mesh_shape
@@ -426,7 +439,7 @@ def _cmd_serve(argv) -> int:
         bucket_floor=bucket_floor, queue_depth=queue_depth,
         backend={"auto": "auto", "cpu": "cpu", "device": None}[args.backend],
         mesh=mesh, warm=not args.no_warm, quarantine_root=quarantine_root,
-        aot=not args.no_aot)
+        aot=not args.no_aot, monitor=args.monitor)
     names = []
     for spec in args.model:
         name, path = _parse_model_spec(spec)
@@ -439,7 +452,8 @@ def _cmd_serve(argv) -> int:
               f"aot={aot.get('status', 'off')}, "
               f"warm {warm.get('wall_s', 0)}s)", file=sys.stderr, flush=True)
 
-    server = make_http_server(daemon, host=args.host, port=args.port)
+    server = make_http_server(daemon, host=args.host, port=args.port,
+                              max_body_bytes=max_body)
     actual_port = server.server_address[1]
 
     import signal
@@ -463,6 +477,52 @@ def _cmd_serve(argv) -> int:
         server.server_close()
         daemon.close()
     print("op serve: clean shutdown", file=sys.stderr, flush=True)
+    return 0
+
+
+def _cmd_autopilot(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op autopilot",
+        description="closed-loop production serving: poll a daemon's drift "
+                    "gauges, retrain on a sustained breach (warm-started "
+                    "from the champion), gate champion-vs-challenger on a "
+                    "shared holdout, and hot-swap the winner via alias "
+                    "repoint — zero dropped requests (docs/robustness.md "
+                    "'Autopilot failure model')")
+    ap.add_argument("--app", required=True,
+                    help="module:function returning a wired "
+                         "serve.Autopilot (daemon + alias + workflow "
+                         "factory + holdout; function takes no required "
+                         "args)")
+    ap.add_argument("--poll-s", type=float, default=5.0,
+                    help="drift-poll interval in seconds (default 5)")
+    ap.add_argument("--max-steps", type=int, default=None,
+                    help="stop after N polls (default: run until SIGINT)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the structured run report as JSON")
+    args = ap.parse_args(argv)
+
+    mod_name, _, fn_name = args.app.partition(":")
+    if not fn_name:
+        print("op autopilot: --app must be module:function", file=sys.stderr)
+        return 2
+    sys.path.insert(0, ".")
+    pilot = getattr(importlib.import_module(mod_name), fn_name)()
+    import json
+    import signal
+    import threading
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    report = pilot.run(poll_s=args.poll_s, max_steps=args.max_steps,
+                       stop=stop, log=lambda m: print(m, file=sys.stderr))
+    if args.as_json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(f"op autopilot: {report['steps']} step(s), "
+              f"{report['promotions']} promotion(s), "
+              f"{report['rollbacks']} rollback(s)")
     return 0
 
 
@@ -604,6 +664,9 @@ def main(argv=None) -> int:
             "  serve     persistent serving daemon: multi-model cache + "
             "adaptive micro-batching over HTTP/JSON "
             "(--model [NAME=]DIR --port 8000)\n"
+            "  autopilot closed-loop serving: drift-triggered retrain + "
+            "champion/challenger gate + zero-downtime hot swap "
+            "(--app module:fn [--poll-s 5])\n"
             "  ingest-worker  disaggregated feature-extraction worker: "
             "lease stride shards from a run's coordinator and stream "
             "parsed batches back (--connect HOST:PORT)\n"
@@ -626,6 +689,8 @@ def main(argv=None) -> int:
         return _cmd_monitor(rest)
     if cmd == "serve":
         return _cmd_serve(rest)
+    if cmd == "autopilot":
+        return _cmd_autopilot(rest)
     if cmd == "ingest-worker":
         from transmogrifai_tpu.ingest.worker import main as worker_main
 

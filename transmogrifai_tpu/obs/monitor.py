@@ -171,7 +171,8 @@ class ServingMonitor:
                  registry=None, source: str = "serve",
                  kinds: Optional[Mapping[str, Any]] = None,
                  max_rows_per_batch: Optional[int] = MAX_ROWS_PER_BATCH,
-                 check_every: int = CHECK_EVERY):
+                 check_every: int = CHECK_EVERY,
+                 window_batches: Optional[int] = None):
         from .metrics import default_registry
 
         if not baseline:
@@ -185,8 +186,27 @@ class ServingMonitor:
         self.thresholds = thresholds or DriftThresholds()
         self.registry = registry if registry is not None else default_registry()
         self.source = source
+        #: extra metric labels: monitors with a NON-default source (the
+        #: daemon admits one monitor per model, labeled by serving name)
+        #: carry it as a `model` label on every gauge/counter series — two
+        #: co-resident models with the same feature names (exactly the
+        #: autopilot's champion + challenger) must not clobber each other's
+        #: drift signals. The default "serve" source keeps the historical
+        #: label-less series (offline `op monitor`, runner monitors).
+        self._model_labels = ({"model": source}
+                              if source and source != "serve" else {})
         self.max_rows_per_batch = max_rows_per_batch
         self.check_every = max(1, int(check_every))
+        #: sliding-window mode: every N observed batches the per-feature
+        #: sketches reset (after a threshold check over the full window), so
+        #: the JS/fill signals track RECENT traffic. Cumulative sketches
+        #: (None, the default) dilute a past drift episode only slowly —
+        #: fine for offline reports, but a closed-loop consumer (the
+        #: autopilot, a pager) needs the falling edge within a bounded
+        #: number of batches after the traffic actually recovers.
+        self.window_batches = (max(1, int(window_batches))
+                               if window_batches else None)
+        self._batches_in_window = 0
         bins = _bins_of(self.baseline)
         self._rff = RawFeatureFilter(bins=bins)
         #: gauges cached per feature: get-or-create freezes/sorts labels under
@@ -310,7 +330,8 @@ class ServingMonitor:
             metric = ("serving_fill_rate" if kind == "fill"
                       else "serving_js_divergence")
             g = self._gauges[(kind, name)] = self.registry.gauge(
-                metric, help=help_text, labels={"feature": name})
+                metric, help=help_text,
+                labels={"feature": name, **self._model_labels})
         return g
 
     def _observe_cols(self, cols: dict, n: Optional[int],
@@ -349,11 +370,21 @@ class ServingMonitor:
         with self._lock:
             self.batches += 1
             self.rows += folded_rows
+            self._batches_in_window += 1
             due = self.batches % self.check_every == 0
+            window_full = (self.window_batches is not None
+                           and self._batches_in_window >= self.window_batches)
         self._batches_c.inc()
         self._rows_c.inc(folded_rows)
-        if due:
+        if due or window_full:
+            # the check always runs over the FULL window before a reset
+            # drops it: a drift episode confined to one window must still
+            # alert (and a recovery must still clear) off that window's data
             self._check_safe()
+        if window_full:
+            with self._lock:
+                self.sketches.clear()
+                self._batches_in_window = 0
 
     # --- drift decision ---------------------------------------------------------------
     def _feature_state(self, name: str) -> Optional[dict]:
@@ -384,6 +415,7 @@ class ServingMonitor:
 
         th = self.thresholds
         new: list[DriftAlert] = []
+        cleared: list[tuple] = []
         with self._lock:
             for name in self.baseline:
                 st = self._feature_state(name)
@@ -406,15 +438,62 @@ class ServingMonitor:
                         new.append(alert)
                         if len(self.alerts) < self._max_alerts:
                             self.alerts.append(alert)
-                    else:
+                    elif key in self._active:
+                        # the FALLING edge: the feature returned in-
+                        # distribution — without this signal an alert
+                        # latches forever from any consumer's point of view
+                        # (the autopilot would retrain in a loop, a pager
+                        # would never resolve)
                         self._active.discard(key)
+                        gauge_v = (value if kind == "js_divergence"
+                                   else st["serving_fill_rate"])
+                        cleared.append((name, kind, float(value), limit,
+                                        float(gauge_v)))
         for alert in new:
             obs.add_event("drift", **alert.to_json())
             self.registry.counter(
                 "serving_drift_alerts_total",
                 help="structured drift alerts raised past thresholds",
-                labels={"feature": alert.feature, "kind": alert.kind}).inc()
+                labels={"feature": alert.feature, "kind": alert.kind,
+                        **self._model_labels}).inc()
+        for name, kind, value, limit, gauge_v in cleared:
+            obs.add_event("drift:cleared", feature=name, kind=kind,
+                          value=round(value, 6), threshold=limit)
+            self.registry.counter(
+                "serving_drift_cleared_total",
+                help="drift episodes that ended: the feature returned "
+                     "in-distribution after an alert",
+                labels={"feature": name, "kind": kind,
+                        **self._model_labels}).inc()
+            # reset the signal gauge to the recovered value so dashboards
+            # and the autopilot see the edge, not the episode's peak
+            self._gauge("js" if kind == "js_divergence" else "fill",
+                        name).set(gauge_v)
         return new
+
+    def resolve_active(self, reason: str = "resolved") -> list[tuple[str, str]]:
+        """Explicitly clear every active alert, emitting the same
+        `drift:cleared` signal + counter the natural falling edge does (with
+        a `reason` attribute marking it operator/controller-resolved). The
+        autopilot calls this on a DEMOTED champion's monitor after a
+        promotion: no traffic will ever feed that monitor again, so without
+        an explicit resolution its episode would latch forever from any
+        pager's point of view. Returns the (feature, kind) pairs cleared."""
+        from .. import obs
+
+        with self._lock:
+            resolved = sorted(self._active)
+            self._active.clear()
+        for name, kind in resolved:
+            obs.add_event("drift:cleared", feature=name, kind=kind,
+                          reason=reason)
+            self.registry.counter(
+                "serving_drift_cleared_total",
+                help="drift episodes that ended: the feature returned "
+                     "in-distribution after an alert",
+                labels={"feature": name, "kind": kind,
+                        **self._model_labels}).inc()
+        return resolved
 
     # --- reporting --------------------------------------------------------------------
     def report(self) -> dict:

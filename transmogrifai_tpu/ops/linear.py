@@ -83,12 +83,24 @@ def fit_logistic(
     sample_weight: Optional[jnp.ndarray] = None,
     l2: float = 0.0,
     max_iter: int = 25,
+    init: Optional[tuple] = None,
 ) -> LinearParams:
     """Newton-IRLS for binary logistic regression. X [N,D] float32, y [N] in {0,1}.
 
     Each iteration: p = sigmoid(Xw+b); grad = X^T r; H = X^T diag(s) X — both single
     MXU matmuls; when rows are sharded across a mesh these become psum'd partials
-    (the treeAggregate replacement, SURVEY §2.12)."""
+    (the treeAggregate replacement, SURVEY §2.12).
+
+    `init`: optional (w [D], b) warm start — Newton steps FROM the previous
+    champion's weights instead of zero. At convergence the result matches the
+    cold fit (the optimum is unique under l2 >= 0); on near-identical data it
+    converges in a step or two (the autopilot's drift-retrain case). Warm
+    steps are DAMPED (norm cap 2 instead of the cold path's 1e3): a
+    confidently-wrong init — the champion after a concept flip — saturates
+    the sigmoids, the Hessian collapses, and full Newton steps oscillate for
+    hundreds of iterations; capped steps walk straight back to the optimum
+    (and from an already-converged init the steps are ~0, so the damping
+    never binds — the fixed point is preserved, pinned by test)."""
     X = jnp.asarray(X, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     n, d = X.shape
@@ -96,6 +108,9 @@ def fit_logistic(
     wsum = wts.sum()
     Xa = jnp.concatenate([X, jnp.ones((n, 1), jnp.float32)], axis=1)  # bias fold
     lam = jnp.asarray(l2, jnp.float32)
+    # cold fits keep the historical 1e3 divergence guard (bitwise-pinned by
+    # golden digests); warm fits damp to 2.0 — see the docstring
+    step_cap = 1e3 if init is None else 2.0
 
     def step(theta, _):
         z = Xa @ theta
@@ -108,10 +123,16 @@ def fit_logistic(
         delta = ridge_solve(H, grad, fallback=jnp.zeros_like(grad))
         # guard divergence: cap the Newton step norm
         norm = jnp.linalg.norm(delta)
-        delta = jnp.where(norm > 1e3, delta * (1e3 / norm), delta)
+        delta = jnp.where(norm > step_cap, delta * (step_cap / norm), delta)
         return theta - delta, None
 
-    theta0 = jnp.zeros(d + 1, jnp.float32)
+    if init is None:
+        theta0 = jnp.zeros(d + 1, jnp.float32)
+    else:
+        w0, b0 = init
+        theta0 = jnp.concatenate([
+            jnp.asarray(w0, jnp.float32).reshape(-1),
+            jnp.asarray(b0, jnp.float32).reshape(1)])
     theta, _ = jax.lax.scan(step, theta0, None, length=max_iter)
     return LinearParams(w=theta[:-1], b=theta[-1])
 
@@ -125,6 +146,7 @@ def fit_logistic_gd(
     l2: float = 0.0,
     max_iter: int = 300,
     lr: float = 0.5,
+    warm: Optional[tuple] = None,
 ) -> LinearParams:
     """Gradient solver for binary logistic regression, for WIDE feature matrices.
 
@@ -156,7 +178,11 @@ def fit_logistic_gd(
                                    _cosine_lr(lr, i, max_iter))
         return (theta, m, v), None
 
-    w0, b0 = jnp.zeros(d, jnp.float32), jnp.asarray(0.0, jnp.float32)
+    if warm is None:  # `warm` mirrors fit_logistic's init: (w [D], b)
+        w0, b0 = jnp.zeros(d, jnp.float32), jnp.asarray(0.0, jnp.float32)
+    else:
+        w0 = jnp.asarray(warm[0], jnp.float32).reshape(-1)
+        b0 = jnp.asarray(warm[1], jnp.float32).reshape(())
     init = ((w0, b0), (jnp.zeros_like(w0), jnp.zeros_like(b0)),
             (jnp.zeros_like(w0), jnp.zeros_like(b0)))
     (theta, _, _), _ = jax.lax.scan(step, init, jnp.arange(max_iter))

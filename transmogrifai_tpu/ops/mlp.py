@@ -49,27 +49,15 @@ def _n_params(d: int, hidden: Sequence[int], num_classes: int) -> int:
     return sum(i * o for i, o in w_shapes) + sum(o for (o,) in b_shapes)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "hidden", "max_iter", "seed"))
-def _fit_mlp_replicated(
-    X: jnp.ndarray,
-    y: jnp.ndarray,
-    sample_weight: Optional[jnp.ndarray] = None,
-    *,
-    num_classes: int = 2,
-    hidden: Sequence[int] = (10,),
-    max_iter: int = 200,
-    lr=0.01,
-    l2=0.0,
-    seed: int = 0,
-) -> list:
-    """The single-program full-batch trainer (pre-r10 `fit_mlp` body): f32
-    math end to end, optimizer state replicated on every device."""
-    X = jnp.asarray(X, jnp.float32)
-    n, d = X.shape
-    w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+def _adam_fullbatch(X, y, w, params, *, num_classes: int, max_iter: int,
+                    lr, l2) -> list:
+    """THE full-batch Adam training body (forward/loss/step/scan), shared by
+    the seeded cold trainer and the warm-start trainer below so their loss
+    surface and update rule can never drift apart — warm-vs-cold convergence
+    parity is a pinned contract. Traced inline by both jits; the op order is
+    byte-identical to the pre-refactor `_fit_mlp_replicated` body."""
     wsum = w.sum() + 1e-12
     Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
-    params = _mlp_init(d, hidden, num_classes, seed)
 
     def forward(params, X):
         h = X
@@ -101,6 +89,60 @@ def _fit_mlp_replicated(
     return params
 
 
+@partial(jax.jit, static_argnames=("num_classes", "hidden", "max_iter", "seed"))
+def _fit_mlp_replicated(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    *,
+    num_classes: int = 2,
+    hidden: Sequence[int] = (10,),
+    max_iter: int = 200,
+    lr=0.01,
+    l2=0.0,
+    seed: int = 0,
+) -> list:
+    """The single-program full-batch trainer (pre-r10 `fit_mlp` body): f32
+    math end to end, optimizer state replicated on every device."""
+    X = jnp.asarray(X, jnp.float32)
+    n, d = X.shape
+    w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+    params = _mlp_init(d, hidden, num_classes, seed)
+    return _adam_fullbatch(X, y, w, params, num_classes=num_classes,
+                           max_iter=max_iter, lr=lr, l2=l2)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "max_iter"))
+def _fit_mlp_warm(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    sample_weight,
+    init_params,
+    *,
+    num_classes: int = 2,
+    max_iter: int = 200,
+    lr=0.01,
+    l2=0.0,
+) -> list:
+    """Warm-started full-batch trainer: the SAME `_adam_fullbatch` body as
+    `_fit_mlp_replicated` (shared — the loss surface and update rule cannot
+    drift apart), but the initial parameters ride as ARGUMENTS (the previous
+    champion's fitted layers) instead of a seeded random init — the
+    autopilot's drift-retrain path. Layer shapes come from `init_params`, so
+    one compiled program serves every retrain of a given architecture. At
+    convergence (enough steps on the same data) the loss optimum reached
+    matches the cold fit's; on incrementally-drifted data it is reached in
+    far fewer effective steps."""
+    X = jnp.asarray(X, jnp.float32)
+    n, _ = X.shape
+    w = (jnp.ones(n, jnp.float32) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
+    params = [(jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
+              for W, b in init_params]
+    return _adam_fullbatch(X, y, w, params, num_classes=num_classes,
+                           max_iter=max_iter, lr=lr, l2=l2)
+
+
 def fit_mlp(
     X: jnp.ndarray,
     y: jnp.ndarray,
@@ -114,6 +156,7 @@ def fit_mlp(
     seed: int = 0,
     mesh=None,
     shard_optimizer="auto",
+    init_params=None,
 ) -> list:
     """-> params: list of (W [in, out], b [out]) per layer, softmax head included.
 
@@ -121,15 +164,41 @@ def fit_mlp(
     shards per ops/optimizer.py (f32 compute-param gathers on this full-batch
     f32 lane); rows pad to the axis with weight 0, so the weighted loss is
     exact at any row count. Unmeshed/1-device/vmapped fits run the replicated
-    program unchanged."""
+    program unchanged.
+
+    `init_params`: optional list of (W, b) layers to warm-start from (a
+    previous fit of the SAME architecture — the autopilot's drift retrain).
+    Warm starts run the replicated program (`_fit_mlp_warm`); a fit that
+    resolves to the SHARDED optimizer path ignores them and cold-fits
+    sharded instead — the sharding contract (including the binding
+    `shard_optimizer="on"` error for ineligible fits) outranks the
+    warm-start optimization, which is best-effort by definition. Shapes
+    that disagree with (X width, hidden, num_classes) raise at trace time,
+    so a caller warm-starting across a schema change fails loudly, not
+    wrongly."""
     hidden = tuple(int(h) for h in hidden)
     # lr/l2 ride the batched check too: a vmapped hyperparameter axis (the
-    # selector's grid stacks) must keep the replicated program
+    # selector's grid stacks) must keep the replicated program. Resolved
+    # FIRST: "on" must keep raising for ineligible fits, and a sharded fit
+    # must stay sharded (cold), even when init_params ride along.
     if resolve_shard_optimizer(mesh, shard_optimizer, X, y, sample_weight,
                                lr, l2):
         return _fit_mlp_sharded(
             X, y, sample_weight, num_classes=num_classes, hidden=hidden,
             max_iter=int(max_iter), lr=lr, l2=l2, seed=int(seed), mesh=mesh)
+    if init_params is not None:
+        w_shapes, _ = _layer_shapes(np.shape(X)[1], hidden, num_classes)
+        got_w = [tuple(np.shape(W)) for W, _ in init_params]
+        if got_w != w_shapes:
+            raise ValueError(
+                f"init_params layer shapes {got_w} do not match the "
+                f"requested architecture {w_shapes} — warm starts require "
+                "an identical (width, hidden, num_classes) layout")
+        record_state_bytes(_n_params(np.shape(X)[1], hidden, num_classes),
+                           sharded=False)
+        return _fit_mlp_warm(X, y, sample_weight, list(init_params),
+                             num_classes=num_classes, max_iter=int(max_iter),
+                             lr=lr, l2=l2)
     record_state_bytes(_n_params(np.shape(X)[1], hidden, num_classes),
                        sharded=False)
     return _fit_mlp_replicated(

@@ -103,6 +103,11 @@ class OpParams:
     #: growing the queue — an overloaded daemon stays bounded-latency for
     #: the requests it does accept
     serve_queue_depth: int = 4096
+    #: POST body ceiling (bytes) on the daemon's HTTP surface: an oversized
+    #: body is answered 413 WITHOUT being read (`serve_rejected_total`), so
+    #: one request cannot balloon daemon memory. CLI: `op serve
+    #: --max-body-bytes`.
+    serve_max_body_bytes: int = 8 << 20
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

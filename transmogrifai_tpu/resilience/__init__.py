@@ -30,11 +30,13 @@ from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .chaos import (
     FaultInjector,
     InjectedDispatchError,
+    InjectedFault,
     InjectedIOError,
     active,
     corrupt_batch,
     maybe_device,
     maybe_io,
+    maybe_site,
     maybe_slow,
 )
 from .policy import (
@@ -54,9 +56,10 @@ from .quarantine import QuarantineWriter, isolate_failing
 __all__ = [
     "CLOSED", "HALF_OPEN", "OPEN", "TRANSIENT_ERRORS",
     "CircuitBreaker", "DeadlineExceeded", "FaultInjector",
-    "FaultPolicy", "InjectedDispatchError", "InjectedIOError",
-    "QuarantineWriter", "TransientError",
+    "FaultPolicy", "InjectedDispatchError", "InjectedFault",
+    "InjectedIOError", "QuarantineWriter", "TransientError",
     "active", "ambient", "call_with_deadline", "corrupt_batch",
-    "io_guard", "isolate_failing", "maybe_device", "maybe_io", "maybe_slow",
+    "io_guard", "isolate_failing", "maybe_device", "maybe_io",
+    "maybe_site", "maybe_slow",
     "resilient_prepare", "retry_call", "scoped",
 ]

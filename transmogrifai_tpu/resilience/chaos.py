@@ -51,6 +51,13 @@ class InjectedDispatchError(RuntimeError):
     failover path own it, not the retry loop)."""
 
 
+class InjectedFault(RuntimeError):
+    """Chaos-injected failure at a named control-plane site (`fail_sites`):
+    autopilot retrains, candidate saves, swap admissions. Deliberately NOT an
+    OSError — these sites pin whole-step failure handling (rollback, champion
+    keeps serving), not the transient-retry loop."""
+
+
 class FaultInjector:
     def __init__(self, seed: int = 0, *,
                  io_failures: int = 0, io_rate: float = 0.0,
@@ -60,7 +67,8 @@ class FaultInjector:
                  device_failures: int = 0,
                  worker_kills: Sequence = (),
                  rpc_drops: Sequence = (),
-                 rpc_torn: Sequence = ()):
+                 rpc_torn: Sequence = (),
+                 fail_sites: Optional[dict] = None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self.io_rate = float(io_rate)
@@ -80,6 +88,13 @@ class FaultInjector:
         self.worker_kills = {(int(s), int(q)) for s, q in worker_kills}
         self.rpc_drops = {(int(s), int(q)) for s, q in rpc_drops}
         self.rpc_torn = {(int(s), int(q)) for s, q in rpc_torn}
+        #: {site name: transient failure budget} for named control-plane
+        #: sites (`maybe_site`): the first N hook calls at the site raise
+        #: InjectedFault, later calls succeed — the shape the autopilot's
+        #: retrain/save/swap chaos drills use (each budget is its own
+        #: counter, so a retrain crash cannot eat the IO budget)
+        self.fail_sites = {str(k): int(v)
+                           for k, v in (fail_sites or {}).items()}
         #: deterministic event log: (kind, site, call_or_batch_index[, row]).
         #: Single-site schedules log in a deterministic order; faults on
         #: DIFFERENT ingest shards land on concurrent handler threads, so
@@ -142,6 +157,20 @@ class FaultInjector:
             raise InjectedDispatchError(
                 f"chaos[{self.seed}]: injected dispatch failure at {site} "
                 f"call {idx}")
+
+    def site(self, site: str) -> None:
+        """Named control-plane site (`fail_sites` budget): consume one
+        failure if the site has budget left, else pass."""
+        idx = self._next_call(site)
+        with self._lock:
+            budget = self.fail_sites.get(site, 0)
+            fire = budget > 0
+            if fire:
+                self.fail_sites[site] = budget - 1
+        if fire:
+            self._record("site_fault", site, idx)
+            raise InjectedFault(f"chaos[{self.seed}]: injected fault at "
+                                f"{site} call {idx}")
 
     def ingest_fault(self, shard: int, seq: int) -> Optional[str]:
         """Distributed-ingest injection, consulted by the coordinator as it
@@ -259,6 +288,12 @@ def maybe_device(site: str) -> None:
     inj = _ACTIVE
     if inj is not None:
         inj.device(site)
+
+
+def maybe_site(site: str) -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.site(site)
 
 
 def maybe_slow(site: str, index: int) -> None:

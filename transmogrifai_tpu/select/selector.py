@@ -169,6 +169,19 @@ class ModelSelector(PredictorEstimator):
         self.checkpoint_path = path
         return self
 
+    def with_warm_start(self, source) -> "ModelSelector":
+        """Warm-start the WINNER REFIT from `source` (a fitted
+        PredictionModel — e.g. the current champion's prediction stage — or
+        a raw params payload): when the search's winning family supports
+        warm starts AND matches the source's family/shape, the refit's
+        optimizer starts from those parameters instead of cold (the
+        autopilot's drift-retrain contract). The SEARCH itself is untouched
+        — vmapped fold x grid programs stay cold and replicated, so
+        validation scores never depend on the previous champion. Mismatches
+        silently cold-fit. Runtime wiring: never serialized."""
+        self._warm_source = source
+        return self
+
     def config_fingerprint(self):
         """The selector's search configuration lives in attributes, not ctor params;
         warm-start reuse must see all of it (models/grids/metric/validator/splitter)."""
@@ -328,6 +341,15 @@ class ModelSelector(PredictorEstimator):
         # search templates stay mesh-free (replicated vmapped programs)
         best_est.mesh = self.mesh
 
+        # warm-start kwargs resolve against the WINNER: if the autopilot's
+        # champion is an LR model and the fresh search picks a forest, the
+        # mismatch silently cold-fits (warm_fit_kwargs -> {})
+        warm_source = getattr(self, "_warm_source", None)
+        warm_kw = {}
+        if warm_source is not None:
+            best_est._warm_source = warm_source
+            warm_kw = best_est.warm_fit_kwargs(int(X_tr.shape[1]))
+
         host_lane = getattr(best_est, "host_fit", False)
         with obs.span("selector:refit"):
             if host_lane:
@@ -362,7 +384,7 @@ class ModelSelector(PredictorEstimator):
                 # fused predict+metrics programs — forcing it here would add one
                 # ~90ms tunnel round trip purely for phase attribution
                 params = best_est.fit_fn(X_fit, y_fit, sample_weight=w_fit,
-                                         **best_est.fit_kwargs())
+                                         **best_est.fit_kwargs(), **warm_kw)
 
         summary = ModelSelectorSummary(
             validation_type=self.validator.validation_type,

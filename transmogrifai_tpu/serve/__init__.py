@@ -1,4 +1,5 @@
 from .aot import export_aot, hydrate, read_index
+from .autopilot import Autopilot, AutopilotConfig, DriftScenario
 from .batcher import MicroBatcher
 from .daemon import (
     DaemonClient,
@@ -10,7 +11,8 @@ from .daemon import (
 from .scoring import ScoreFunction, score_function
 
 __all__ = [
-    "DaemonClient", "MicroBatcher", "ScoreFunction", "ServingDaemon",
+    "Autopilot", "AutopilotConfig", "DaemonClient", "DriftScenario",
+    "MicroBatcher", "ScoreFunction", "ServingDaemon",
     "export_aot", "fingerprint_model_dir", "hydrate", "make_http_server",
     "read_index", "score_function", "serving_buckets",
 ]

@@ -1,0 +1,596 @@
+"""Closed-loop autopilot: drift-triggered retrain, champion/challenger
+gating, and zero-downtime hot swap.
+
+The continuous-training story of TensorFlow-at-scale (PAPERS.md arXiv
+1605.08695) wired out of pieces this repo already has: the ServingMonitor's
+drift gauges (PR 5), content-fingerprint model admission (PR 7), atomic
+saves + AOT artifacts (PR 6/8), warm-start refit (this PR), and the seeded
+chaos harness (PR 6). The loop:
+
+    observe   the daemon's per-model drift monitor (`serving_js_divergence`
+              / `serving_fill_rate` gauges + active DriftAlerts) — a breach
+              must SUSTAIN across `breach_checks` consecutive polls before
+              anything retrains (one weird batch is not a regime change);
+    retrain   a fresh workflow over fresh data (the aggregate/conditional
+              readers in production; the seeded DriftScenario here), warm-
+              started from the current champion's fitted params where the
+              winning family supports it (`Workflow.with_warm_start`);
+    gate      lint the candidate (`oplint` via analyze_model), then evaluate
+              champion vs challenger on a SHARED holdout: promotion requires
+              beating the champion by `promotion_margin` on the configured
+              metric — a retrain that fails lint, evaluates worse, or
+              crashes is rejected and the champion keeps serving;
+    swap      save the candidate bundle (atomic; optional AOT export) and
+              hot-swap it into the daemon via ALIAS REPOINT
+              (`ServingDaemon.swap`): NAME -> new content fingerprint,
+              in-flight work drains on the old entry, the first request on
+              the new one hits admission-warmed executables. The previous
+              champion stays resident — `rollback()` repoints back in O(1).
+
+Robustness is the contract (docs/robustness.md "Autopilot failure model"):
+every step consults the chaos harness (`autopilot:retrain`,
+`autopilot:save`, swap-time `serve:dispatch` device faults), and each
+failure mode degrades to "the champion keeps serving with zero request
+errors". Every decision lands in `Autopilot.events` — a structured log
+containing NO wall-clock, uids, or fingerprints, so the same seed + the
+same synthetic stream replays the whole loop byte-identically (pinned by
+tests/test_autopilot.py).
+
+`op autopilot` runs the loop against an app-provided wiring; bench_extra's
+`run_autopilot` lane measures time-to-recover-AuPR on a drifting stream.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import obs
+from ..resilience import chaos
+
+_logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutopilotConfig:
+    """When the loop acts, and what promotion requires."""
+
+    #: consecutive drifted polls (any active DriftAlert on the served
+    #: model's monitor) before a retrain triggers — the sustained-breach
+    #: debounce. A failed retrain resets the streak, so the loop re-arms
+    #: instead of hot-looping on a persistent failure.
+    breach_checks: int = 2
+    #: holdout-metric margin the challenger must beat the champion by
+    #: (direction-aware). 0.0 = any strict improvement-or-tie promotes.
+    promotion_margin: float = 0.0
+    #: gate metric: an attribute (or to_json key) of the evaluator's
+    #: metrics object — AuPR for the binary default.
+    metric: str = "AuPR"
+    larger_is_better: bool = True
+    #: evaluator problem type for the default evaluator factory
+    problem_type: str = "binary"
+    #: export AOT deploy artifacts with the candidate bundle (save pays the
+    #: compiles; the swap then hydrates instead of compiling)
+    export_aot: bool = False
+    #: retire (drain + release) the demoted champion after a swap instead
+    #: of keeping it resident as the rollback target
+    retire_old: bool = False
+    #: candidate bundles past the newest N are swept from the workdir
+    #: (rollback targets stay loadable; disk stays bounded)
+    keep_candidates: int = 4
+    #: cap on total promotions (None = unbounded): the CLI's safety rail
+    max_promotions: Optional[int] = None
+
+
+def default_evaluator(model, problem_type: str = "binary"):
+    """Evaluator over a model's OWN feature names (result-feature names
+    carry per-process uids, so champion and challenger each need their own
+    evaluator even though they score the same holdout)."""
+    from ..evaluators import Evaluators
+
+    resp = next(f.name for f in model.raw_features if f.is_response)
+    pred = model.result_features[0].name
+    if problem_type == "binary":
+        return Evaluators.binary_classification(resp, pred)
+    if problem_type == "multiclass":
+        return Evaluators.multi_classification(resp, pred)
+    return Evaluators.regression(resp, pred)
+
+
+class Autopilot:
+    """The controller behind `op autopilot`.
+
+    Wiring:
+      daemon            a ServingDaemon constructed with `monitor=` armed
+                        (the loop reads each entry's ServingMonitor)
+      name              the serving ALIAS the loop owns (requests resolve
+                        through it; promotion repoints it)
+      workflow_factory  () -> Workflow with result features set and a reader
+                        over FRESH data (aggregate/conditional readers in
+                        production). Called once per retrain; the autopilot
+                        applies `with_warm_start(champion)` before training.
+      holdout           the shared gate set: a Table / DataReader carrying
+                        the labeled raw columns, or a callable returning one
+                        (called once per gate — both models score the SAME
+                        object, so the comparison is apples-to-apples)
+      workdir           where candidate bundles are saved
+      evaluator_factory optional (model) -> evaluator override; the default
+                        builds from config.problem_type over the model's
+                        own feature names
+
+    `step()` runs one observe->decide->maybe-act cycle synchronously and
+    returns the structured decision; `run()` loops it on a poll interval
+    with the retrain/gate/swap pipeline on a background thread, so polling
+    (and serving — which lives on the daemon's own threads throughout)
+    never blocks on a training run.
+    """
+
+    def __init__(self, daemon, name: str, *,
+                 workflow_factory: Callable,
+                 holdout,
+                 workdir: str,
+                 config: Optional[AutopilotConfig] = None,
+                 evaluator_factory: Optional[Callable] = None,
+                 registry=None):
+        self._daemon = daemon
+        self._name = name
+        self._workflow_factory = workflow_factory
+        self._holdout = holdout
+        self._workdir = os.path.abspath(workdir)
+        os.makedirs(self._workdir, exist_ok=True)
+        self.config = config or AutopilotConfig()
+        self._evaluator_factory = evaluator_factory or (
+            lambda model: default_evaluator(model, self.config.problem_type))
+        self._registry = (registry if registry is not None
+                          else obs.default_registry())
+        #: structured, replay-deterministic decision log: tuples of
+        #: (step, action, *sorted attrs) — NO wall clock, NO uids, NO
+        #: fingerprints (those vary per process; they ride span events and
+        #: the history instead). Byte-identical across same-seed replays.
+        self.events: list[tuple] = []
+        #: promotion history (most recent last): dicts carrying the real
+        #: fingerprints/dirs for operators + rollback
+        self.history: list[dict] = []
+        self.promotions = 0
+        self.rollbacks = 0
+        self._step_idx = 0
+        self._streak = 0
+        self._candidates = 0
+        self._lock = threading.Lock()
+
+    # --- bookkeeping ------------------------------------------------------------------
+    def _event(self, action: str, **attrs) -> None:
+        ev = (self._step_idx, action) + tuple(sorted(attrs.items()))
+        with self._lock:
+            self.events.append(ev)
+        obs.add_event(f"autopilot:{action}", step=self._step_idx, **attrs)
+
+    def _count_retrain(self, outcome: str) -> None:
+        self._registry.counter(
+            "autopilot_retrains_total",
+            help="autopilot retrain attempts by outcome",
+            labels={"outcome": outcome}).inc()
+
+    def _entry(self):
+        return self._daemon._resolve(self._name)
+
+    def _holdout_kwargs(self) -> dict:
+        hold = self._holdout() if callable(self._holdout) else self._holdout
+        from ..types import Table
+
+        return {"table": hold} if isinstance(hold, Table) else {"reader": hold}
+
+    def _metric_of(self, metrics) -> float:
+        m = getattr(metrics, self.config.metric, None)
+        if m is None and hasattr(metrics, "to_json"):
+            m = metrics.to_json().get(self.config.metric)
+        if m is None:
+            raise KeyError(f"metric {self.config.metric!r} not in "
+                           f"{type(metrics).__name__}")
+        return float(m)
+
+    # --- observe ----------------------------------------------------------------------
+    def drift_state(self) -> dict:
+        """Current drift picture of the served model: active alert keys +
+        the gauges the loop watches. An UNRESOLVABLE alias (the entry was
+        evicted by outside admissions) reports `resolvable: False` instead
+        of raising — the loop must degrade to observing, never crash its
+        own poll thread."""
+        try:
+            entry = self._entry()
+        except KeyError:
+            return {"monitored": False, "resolvable": False, "active": [],
+                    "features": []}
+        mon = entry.score_fn.monitor
+        if mon is None:
+            return {"monitored": False, "resolvable": True, "active": [],
+                    "features": []}
+        rep = mon.report()  # runs a threshold check — never stale
+        return {"monitored": True, "resolvable": True,
+                "active": rep["active_alerts"], "features": rep["features"]}
+
+    # --- the loop body ----------------------------------------------------------------
+    def _poll(self) -> dict:
+        """One observe + debounce decision — THE shared body of step() and
+        run() (one copy of the logic; the returned "act" flag says whether
+        the breach sustained long enough to retrain). Streak mutations run
+        under the lock: run()'s poll thread and its retrain worker (which
+        resets the streak in `_retrain_and_gate`) must not lose updates to
+        each other."""
+        self._step_idx += 1
+        state = self.drift_state()
+        drifted = bool(state["active"])
+        with self._lock:
+            self._streak = self._streak + 1 if drifted else 0
+            streak = self._streak
+        decision = {"step": self._step_idx, "drifted": drifted,
+                    "streak": streak, "action": "observe",
+                    "active": list(state["active"]), "act": False}
+        if not state.get("resolvable", True):
+            # evicted out from under us (outside admissions past
+            # max_models): observable, never actionable
+            decision["action"] = "alias_unresolved"
+            self._event("alias_unresolved")
+            return decision
+        self._event("observe", drifted=drifted, streak=streak,
+                    active=",".join(sorted(state["active"])))
+        if not drifted or streak < self.config.breach_checks:
+            return decision
+        if self.config.max_promotions is not None \
+                and self.promotions >= self.config.max_promotions:
+            decision["action"] = "promotion_cap"
+            return decision
+        decision["act"] = True
+        return decision
+
+    def step(self) -> dict:
+        """One observe->decide->maybe-act cycle, synchronous (the unit the
+        seeded replay pins). Serving traffic flows on the daemon's threads
+        throughout — a retrain inside step() never blocks a request."""
+        decision = self._poll()
+        if decision.pop("act"):
+            decision.update(self._retrain_and_gate())
+        return decision
+
+    def _retrain_and_gate(self) -> dict:
+        cfg = self.config
+        try:
+            try:
+                entry = self._entry()
+            except KeyError as e:
+                # the alias went unresolvable between the poll and the act
+                # (outside eviction): contained like any other step failure
+                # — the finally still re-arms the debounce, run()'s worker
+                # thread survives
+                self._count_retrain("crashed")
+                self._event("retrain_failed", error=type(e).__name__)
+                return {"action": "retrain_failed",
+                        "error": type(e).__name__}
+            champion = entry.model
+            old_fp = entry.fingerprint
+            # -- retrain (chaos site: a crash here must leave the champion
+            # serving and the loop re-armed, nothing else)
+            try:
+                with obs.span("autopilot:retrain"):
+                    chaos.maybe_site("autopilot:retrain")
+                    wf = self._workflow_factory()
+                    wf.with_warm_start(champion)
+                    candidate = wf.train()
+            except Exception as e:  # noqa: BLE001 — contained by contract
+                self._count_retrain("crashed")
+                self._event("retrain_failed", error=type(e).__name__)
+                _logger.warning("autopilot: retrain failed (%s: %s); "
+                                "champion keeps serving", type(e).__name__, e)
+                return {"action": "retrain_failed",
+                        "error": type(e).__name__}
+
+            # -- gate 1: static lint (a plan the analyzer rejects must not
+            # reach the serving path, however well it scored)
+            from ..analyze import analyze_model
+
+            report = (candidate.analysis_report
+                      if candidate.analysis_report is not None
+                      else analyze_model(candidate))
+            if report.has_errors:
+                self._count_retrain("lint_rejected")
+                codes = sorted({d.code for d in report.errors})
+                self._event("lint_rejected", codes=",".join(codes))
+                return {"action": "lint_rejected", "codes": codes}
+
+            # -- gate 2: champion vs challenger on the SHARED holdout
+            try:
+                hk = self._holdout_kwargs()
+                champ_metric = self._metric_of(champion.evaluate(
+                    self._evaluator_factory(champion), **hk))
+                chall_metric = self._metric_of(candidate.evaluate(
+                    self._evaluator_factory(candidate), **hk))
+            except Exception as e:  # noqa: BLE001 — a broken gate must not swap
+                self._count_retrain("eval_failed")
+                self._event("eval_failed", error=type(e).__name__)
+                return {"action": "eval_failed", "error": type(e).__name__}
+            if cfg.larger_is_better:
+                promote = chall_metric >= champ_metric + cfg.promotion_margin
+            else:
+                promote = chall_metric <= champ_metric - cfg.promotion_margin
+            gate = {"champion": round(champ_metric, 6),
+                    "challenger": round(chall_metric, 6),
+                    "metric": cfg.metric, "margin": cfg.promotion_margin}
+            self._event("gate", champion=round(champ_metric, 6),
+                        challenger=round(chall_metric, 6),
+                        metric=cfg.metric, promote=promote)
+            if not promote:
+                self._count_retrain("rejected")
+                return {"action": "rejected", "gate": gate}
+
+            # -- save the candidate bundle (atomic publish; the chaos site
+            # models a torn save — anything short of a complete manifest
+            # must fail the swap, not serve garbage)
+            self._candidates += 1
+            cand_dir = os.path.join(self._workdir,
+                                    f"candidate-{self._candidates:04d}")
+            try:
+                with obs.span("autopilot:save"):
+                    os.makedirs(cand_dir, exist_ok=True)
+                    chaos.maybe_site("autopilot:save")
+                    candidate.save(cand_dir, overwrite=True,
+                                   aot=cfg.export_aot)
+            except Exception as e:  # noqa: BLE001
+                self._count_retrain("save_failed")
+                self._event("save_failed", error=type(e).__name__)
+                return {"action": "save_failed", "error": type(e).__name__,
+                        "gate": gate}
+
+            # -- hot swap: admit + alias repoint. Admission failures (torn
+            # bundle on disk, a lost device) raise BEFORE the alias moves.
+            try:
+                with obs.span("autopilot:swap"):
+                    new_entry = self._daemon.swap(
+                        self._name, cand_dir, retire_old=cfg.retire_old)
+            except Exception as e:  # noqa: BLE001
+                self._count_retrain("swap_failed")
+                self._event("swap_failed", error=type(e).__name__)
+                return {"action": "swap_failed", "error": type(e).__name__,
+                        "gate": gate}
+
+            # -- promoted: resolve the drift episode on the DEMOTED model's
+            # monitor (the pager-visible falling edge — nothing will ever
+            # feed that monitor again) and record the rollback token
+            old_mon = entry.score_fn.monitor
+            if old_mon is not None:
+                old_mon.resolve_active(reason="promoted")
+            self._count_retrain("promoted")
+            self.promotions += 1
+            self._event("promoted", challenger=round(chall_metric, 6),
+                        champion=round(champ_metric, 6))
+            self.history.append({
+                "step": self._step_idx, "dir": cand_dir,
+                "fingerprint": new_entry.fingerprint,
+                "previous_fingerprint": old_fp, "gate": gate})
+            self._sweep_candidates()
+            return {"action": "promoted", "gate": gate,
+                    "fingerprint": new_entry.fingerprint, "dir": cand_dir}
+        finally:
+            # acted (or failed): re-arm the debounce — the breach must
+            # SUSTAIN again before the next attempt (under the lock, so
+            # run()'s concurrent poll thread cannot resurrect a stale streak
+            # and hot-loop a failing retrain)
+            with self._lock:
+                self._streak = 0
+
+    def rollback(self) -> Optional[str]:
+        """Demote the current champion: repoint the alias at the PREVIOUS
+        champion (which `swap(retire_old=False)` kept resident and warm).
+        Returns the fingerprint now serving, or None when there is no
+        promotion to roll back. O(alias write) — no load, no compile. A
+        failed repoint (the previous entry was retired/evicted) raises and
+        LEAVES the history entry in place — the rollback token survives for
+        a retry or operator inspection."""
+        with self._lock:
+            if not self.history:
+                return None
+            last = self.history[-1]
+        prev = last["previous_fingerprint"]
+        self._daemon.repoint(self._name, prev)  # may raise: history intact
+        with self._lock:
+            if self.history and self.history[-1] is last:
+                self.history.pop()
+        self.rollbacks += 1
+        self._registry.counter(
+            "autopilot_rollbacks_total",
+            help="alias repoints back to a previous champion").inc()
+        self._event("rollback")
+        return prev
+
+    def _sweep_candidates(self) -> None:
+        """Bound workdir growth: keep the newest `keep_candidates` bundles
+        plus anything the daemon still serves or the history references."""
+        import shutil
+
+        keep = {h["dir"] for h in self.history[-self.config.keep_candidates:]}
+        live = {e["path"] for e in
+                (self._daemon.models() if hasattr(self._daemon, "models")
+                 else [])}
+        dirs = sorted(d for d in os.listdir(self._workdir)
+                      if d.startswith("candidate-"))
+        for d in dirs[:-self.config.keep_candidates or None]:
+            full = os.path.join(self._workdir, d)
+            if full in keep or full in live:
+                continue
+            shutil.rmtree(full, ignore_errors=True)
+
+    # --- the wall-clock loop (CLI) ----------------------------------------------------
+    def run(self, poll_s: float = 5.0, max_steps: Optional[int] = None,
+            stop: Optional[threading.Event] = None,
+            log: Optional[Callable] = None) -> dict:
+        """Poll on an interval until `stop` (or `max_steps`) — the SAME
+        `_poll` body step() uses, with the retrain/gate/swap pipeline on a
+        worker thread so drift polling (and the daemon's serving threads)
+        keep their cadence during a long train; at most one retrain is in
+        flight at a time, and `_retrain_and_gate` resets the streak under
+        the lock, so a failing retrain re-arms the full debounce instead of
+        hot-looping."""
+        stop = stop or threading.Event()
+        steps = 0
+        acted: list = []  # worker decisions, surfaced on the report
+
+        def _act():
+            decision = self._retrain_and_gate()
+            acted.append(decision)
+            if log:
+                log(f"autopilot: {decision['action']}")
+
+        worker: Optional[threading.Thread] = None
+        while not stop.is_set() and (max_steps is None or steps < max_steps):
+            steps += 1
+            decision = self._poll()
+            if log:
+                log(f"autopilot: step {decision['step']} "
+                    f"drifted={decision['drifted']} "
+                    f"streak={decision['streak']}")
+            if decision.pop("act") and (worker is None
+                                        or not worker.is_alive()):
+                worker = threading.Thread(target=_act, daemon=True,
+                                          name="autopilot-retrain")
+                worker.start()
+            stop.wait(poll_s)
+        if worker is not None:
+            worker.join()
+        report = self.report()
+        report["acted"] = acted
+        return report
+
+    def report(self) -> dict:
+        return {
+            "alias": self._name,
+            "steps": self._step_idx,
+            "promotions": self.promotions,
+            "rollbacks": self.rollbacks,
+            "history": list(self.history),
+            "events": [list(e) for e in self.events],
+        }
+
+
+# --- seeded synthetic drifting scenario -------------------------------------------------
+class DriftScenario:
+    """Seeded end-to-end drill for the loop: a drifting event stream, the
+    retrain data it implies, and the shared holdout — everything the
+    autopilot needs, all deterministic in `seed`.
+
+    The world: entities emit events carrying a numeric feature `a` and a
+    categorical `cat`; the outcome (label) follows the CURRENT regime's
+    decision rule over `a`. `shift_mu()` moves the regime BOTH ways a real
+    drift does: covariate shift (`a` recentres at `shift`, so the monitor's
+    JS gauge fires against the training baseline) and concept shift (the
+    label rule's direction inverts around the new centre, so the pre-drift
+    champion's RANKING — hence AuPR — on fresh data collapses; a monotone
+    mean shift alone would leave a ranking metric untouched).
+    `restore_mu()` drifts it back (the falling-edge/recovery drill).
+
+    Retrain data flows through an AggregateReader (the reference's event-
+    reader path): per-entity predictor events aggregate strictly BEFORE the
+    cutoff, the outcome event lands AT/AFTER it — the same leakage-safe
+    rollup a production event store would feed the loop.
+    """
+
+    CUTOFF_MS = 1_000_000
+
+    def __init__(self, seed: int = 0, batch: int = 64, n_train: int = 256,
+                 n_holdout: int = 192, shift: float = 4.0,
+                 label_noise: float = 0.25):
+        self.seed = int(seed)
+        self.batch = int(batch)
+        self.n_train = int(n_train)
+        self.n_holdout = int(n_holdout)
+        self.shift = float(shift)
+        self.label_noise = float(label_noise)
+        self.mu = 0.0
+        self.direction = 1.0
+        self._entity = 0
+        self._serving_rng = np.random.default_rng(self.seed)
+        self._train_rng = np.random.default_rng(self.seed + 1)
+        self._holdout_rng = np.random.default_rng(self.seed + 2)
+
+    # -- regime control
+    def shift_mu(self) -> None:
+        self.mu = self.shift
+        self.direction = -1.0
+
+    def restore_mu(self) -> None:
+        self.mu = 0.0
+        self.direction = 1.0
+
+    # -- the three data surfaces
+    def serving_batch(self, n: Optional[int] = None) -> list:
+        """One batch of UNLABELED serving records at the current regime."""
+        n = self.batch if n is None else int(n)
+        rng = self._serving_rng
+        return [{"a": float(rng.normal(self.mu, 1.0)),
+                 "cat": "ab"[int(rng.integers(0, 2))]} for _ in range(n)]
+
+    def _label(self, a: float, rng) -> float:
+        return float(self.direction * (a - self.mu)
+                     + rng.normal(0.0, self.label_noise) > 0.0)
+
+    def _events(self, n: int, rng) -> list:
+        """Per-entity event pairs: one predictor event before the cutoff,
+        one outcome event after it (what an event store would hold). Field
+        names match the feature names: a LOADED model's features lose their
+        extract lambdas (they don't serialize) and fall back to name-keyed
+        extraction, and the loop evaluates loaded champions too."""
+        out = []
+        for _ in range(n):
+            self._entity += 1
+            key = f"e{self._entity:06d}"
+            a = float(rng.normal(self.mu, 1.0))
+            out.append({"k": key, "t": int(rng.integers(0, self.CUTOFF_MS)),
+                        "a": a, "cat": "ab"[int(rng.integers(0, 2))],
+                        "label": None})
+            out.append({"k": key, "t": self.CUTOFF_MS + 1, "a": None,
+                        "cat": None, "label": self._label(a, rng)})
+        return out
+
+    def _aggregate_reader(self, events: list):
+        from ..readers import InMemoryReader
+        from ..readers.aggregates import AggregateReader
+        from ..aggregators import CutOffTime
+
+        return AggregateReader(
+            InMemoryReader(events, key_fn=lambda r: r["k"]),
+            key_fn=lambda r: r["k"],
+            timestamp_fn=lambda r: r["t"],
+            cutoff=CutOffTime.unix_epoch(self.CUTOFF_MS))
+
+    def make_workflow(self):
+        """Fresh single-LR workflow over FRESH current-regime events (the
+        autopilot's `workflow_factory`). A new feature graph every call —
+        features are single-use wiring."""
+        from ..graph import FeatureBuilder
+        from ..stages.feature import transmogrify
+        from ..stages.model import LogisticRegression
+        from ..workflow import Workflow
+
+        a = FeatureBuilder("a", "Real").extract(
+            lambda r: r.get("a")).as_predictor()
+        cat = FeatureBuilder("cat", "PickList").extract(
+            lambda r: r.get("cat")).as_predictor()
+        label = FeatureBuilder("label", "Real").extract(
+            lambda r: r.get("label")).as_response()
+        pred = LogisticRegression(l2=0.01)(label, transmogrify([a, cat]))
+        wf = Workflow().set_result_features(pred)
+        wf.set_reader(self._aggregate_reader(
+            self._events(self.n_train, self._train_rng)))
+        return wf
+
+    def holdout_reader(self):
+        """Fresh labeled holdout at the CURRENT regime, through the same
+        aggregate-reader path (the autopilot's shared gate set)."""
+        return self._aggregate_reader(
+            self._events(self.n_holdout, self._holdout_rng))
+
+    def train_champion(self):
+        """The initial (pre-drift) champion, trained at mu=0."""
+        return self.make_workflow().train()

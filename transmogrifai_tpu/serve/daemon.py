@@ -159,7 +159,7 @@ class ServingDaemon:
                  backend: Optional[str] = "auto", mesh=None, policy=None,
                  warm: bool = True, prefetch: int = 2,
                  quarantine_root: Optional[str] = "auto", aot: bool = True,
-                 queue_depth: int = 4096):
+                 queue_depth: int = 4096, monitor=False):
         if max_models < 1:
             raise ValueError(f"max_models must be >= 1, got {max_models}")
         self._max_models = int(max_models)
@@ -182,6 +182,12 @@ class ServingDaemon:
         #: (request keeps flowing, bad rows come back None) instead of
         #: killing the shared stream. None disables; a path pins it.
         self._quarantine_root = quarantine_root
+        #: drift monitoring per admitted model: False (off), True (default
+        #: ServingMonitor thresholds), or a dict of ServingMonitor.for_model
+        #: kwargs (thresholds / window_batches / check_every — the autopilot
+        #: arms a windowed monitor this way). Models saved without a
+        #: serving_baseline admit un-monitored either way.
+        self._monitor = monitor
         self._lock = threading.Lock()
         self._admit_lock = threading.Lock()
         self._cache: "OrderedDict[str, ModelEntry]" = OrderedDict()
@@ -198,8 +204,30 @@ class ServingDaemon:
             "serve_model_admissions_total",
             help="model admissions (cache misses) into the daemon")
 
+    def _evict_over_capacity_locked(self, protect: frozenset) -> list:
+        """Pop LRU entries past `max_models`, SKIPPING protected
+        fingerprints (a swap protects the alias's current target so
+        admitting the replacement can never strand the alias mid-swap).
+        When every remaining victim is protected the cache briefly
+        overshoots capacity instead — the swap re-runs this after the
+        repoint, when nothing needs protecting. Caller holds the lock and
+        retires the returned entries OUTSIDE it."""
+        evicted = []
+        while len(self._cache) > self._max_models:
+            victim_fp = next((fp for fp in self._cache
+                              if fp not in protect), None)
+            if victim_fp is None:
+                break  # everything resident is protected: tolerate overshoot
+            old = self._cache.pop(victim_fp)
+            self._names = {k: v for k, v in self._names.items()
+                           if v != old.fingerprint}
+            evicted.append(old)
+        self._g_loaded.set(len(self._cache))
+        return evicted
+
     # --- admission --------------------------------------------------------------------
-    def admit(self, model_dir: str, name: Optional[str] = None) -> ModelEntry:
+    def admit(self, model_dir: str, name: Optional[str] = None,
+              _protect: frozenset = frozenset()) -> ModelEntry:
         """Load, warm, and cache a saved model (idempotent per content
         fingerprint). Returns the live entry; evicts LRU entries past
         `max_models` — eviction drains the victim's batcher first."""
@@ -240,9 +268,18 @@ class ServingDaemon:
                         self._quarantine_root = root
                     policy = FaultPolicy(
                         quarantine_dir=os.path.join(root, label))
+                mon = None
+                if self._monitor and getattr(model, "serving_baseline", None):
+                    from ..obs.monitor import ServingMonitor
+
+                    mon_kw = {"source": label,
+                              **(self._monitor
+                                 if isinstance(self._monitor, dict) else {})}
+                    mon = ServingMonitor.for_model(model, **mon_kw)
                 fn = score_function(
                     model, pad_to=self._buckets, backend=self._backend,
-                    mesh=self._mesh, policy=policy, model_label=label)
+                    mesh=self._mesh, policy=policy, model_label=label,
+                    monitor=mon)
                 # the SAME ladder-warm helper `op warmup --serving` uses:
                 # consult the bundle's AOT artifacts first, compile only
                 # what hydration did not cover — a cold DAEMON PROCESS
@@ -265,12 +302,8 @@ class ServingDaemon:
                     self._cache[fp] = entry
                     self._names[label] = fp
                     self._names[path] = fp
-                    while len(self._cache) > self._max_models:
-                        _, old = self._cache.popitem(last=False)
-                        self._names = {k: v for k, v in self._names.items()
-                                       if v != old.fingerprint}
-                        evicted.append(old)
-                    self._g_loaded.set(len(self._cache))
+                    evicted = self._evict_over_capacity_locked(
+                        frozenset({fp}) | _protect)
             if closed:
                 # close() ran while this admission was mid-warm: the cache
                 # is already drained, so inserting now would leak a live
@@ -290,6 +323,95 @@ class ServingDaemon:
                       fingerprint=entry.fingerprint[:12])
         entry.batcher.close()
         entry.score_fn.close()
+
+    # --- hot swap (alias indirection) -------------------------------------------------
+    def aliases(self) -> dict:
+        """Snapshot of the alias table: {name or abspath: fingerprint}."""
+        with self._lock:
+            return dict(self._names)
+
+    def repoint(self, name: str, fingerprint: str) -> Optional[str]:
+        """Atomically repoint alias `name` at an ALREADY-ADMITTED entry
+        (by fingerprint, or by any alias resolving to one). Returns the
+        fingerprint `name` previously resolved to (None if unbound) — the
+        rollback token. Raises KeyError when the target is not resident:
+        an alias must never dangle, so traffic always reaches a warmed
+        model."""
+        with self._lock:
+            fp = self._names.get(fingerprint, fingerprint)
+            if fp not in self._cache:
+                raise KeyError(f"no admitted model with fingerprint "
+                               f"{fingerprint!r} to repoint {name!r} at")
+            prev = self._names.get(name)
+            self._names[name] = fp
+            self._cache.move_to_end(fp)
+        obs.add_event("serve:repoint", alias=name, to=fp[:12],
+                      prev=(prev or "")[:12])
+        return prev
+
+    def swap(self, name: str, model_dir: str,
+             retire_old: bool = False) -> ModelEntry:
+        """Zero-downtime hot swap: admit (load + full bucket warm / AOT
+        hydrate) the bundle at `model_dir`, then atomically repoint alias
+        `name` at its fingerprint. Requests keep resolving through the alias
+        the whole time — in-flight and queued work on the previous model
+        drains through ITS batcher untouched; only submissions AFTER the
+        repoint land on the new entry, and the first of them hits warmed
+        executables (no unwarmed-shape compiles on the hot path).
+
+        The previous entry stays resident by default — the demotion/rollback
+        target (`repoint(name, old_fp)` restores it instantly). Admission
+        failures (torn bundle, lint-invalid manifest, dead path) raise
+        BEFORE the alias is touched, so a failed swap leaves the champion
+        serving, untouched. `retire_old=True` drains and releases the
+        previous entry once the repoint lands.
+
+        The alias's CURRENT target is PROTECTED from LRU eviction while the
+        replacement admits (at capacity the victim is the next-LRU entry
+        instead; with nothing else evictable the cache briefly overshoots,
+        re-trimmed right after the repoint) — requests resolving the alias
+        mid-swap must always find a live entry. Note the post-repoint trim
+        can claim the demoted champion when it is the LRU entry of a full
+        cache: zero-downtime is unconditional, rollback-target residency is
+        subject to `max_models` pressure like any other entry."""
+        with self._lock:
+            protect = self._names.get(name)
+        entry = self.admit(  # may raise: alias untouched
+            model_dir,
+            _protect=frozenset({protect} if protect else ()))
+        old_fp = None
+        retired: list[ModelEntry] = []
+        with self._lock:
+            old_fp = self._names.get(name)
+            self._names[name] = entry.fingerprint
+            # the alias IS the serving name now: entry.info()/metrics keep
+            # the admission label, resolution works through either
+            if retire_old and old_fp and old_fp != entry.fingerprint \
+                    and old_fp in self._cache:
+                old = self._cache.pop(old_fp)
+                # same discipline as LRU eviction: every alias of the
+                # retired entry goes with it
+                self._names = {k: v for k, v in self._names.items()
+                               if v != old_fp}
+                self._g_loaded.set(len(self._cache))
+                retired.append(old)
+            # the admission-time protection may have left an overshoot:
+            # trim now that the alias points at the new entry (only it
+            # needs protecting)
+            retired.extend(self._evict_over_capacity_locked(
+                frozenset({entry.fingerprint})))
+        obs.add_event("serve:swap", alias=name, to=entry.fingerprint[:12],
+                      prev=(old_fp or "")[:12], retired=bool(retired))
+        obs.default_registry().counter(
+            "serve_swaps_total",
+            help="alias repoints onto a newly admitted model (hot swaps)",
+            labels={"model": name}).inc()
+        for old in retired:
+            # drain AFTER the repoint: close() blocks until the victim's
+            # queued + in-flight futures resolve, and new traffic is already
+            # routing to the replacement
+            self._retire(old)
+        return entry
 
     # --- scoring ----------------------------------------------------------------------
     def _resolve(self, model: Optional[str]) -> ModelEntry:
@@ -386,13 +508,35 @@ class DaemonClient:
 
 
 # --- HTTP surface (stdlib only) -------------------------------------------------------
+#: default POST body ceiling: generous for real scoring traffic (a full
+#: max_batch of fat records is well under 1 MiB) while bounding what one
+#: request can make the daemon buffer in RAM
+MAX_BODY_BYTES = 8 << 20
+
+
 def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
-                     port: int = 8000):
+                     port: int = 8000,
+                     max_body_bytes: int = MAX_BODY_BYTES):
     """Build (not start) a ThreadingHTTPServer over the daemon. Callers run
     `server.serve_forever()` (blocking) or on a thread; `server.shutdown()`
     from another thread stops it. Port 0 binds an ephemeral port —
-    `server.server_address[1]` is the real one."""
+    `server.server_address[1]` is the real one.
+
+    `max_body_bytes` caps what a POST may carry: an oversized (or
+    missing/absurd Content-Length) body is answered 413 WITHOUT reading it —
+    `rfile.read(attacker-chosen length)` would otherwise buffer an arbitrary
+    payload in RAM per handler thread. Rejections land on
+    `serve_rejected_total{reason}`."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    max_body = int(max_body_bytes)
+
+    def _rejected(reason: str):
+        return obs.default_registry().counter(
+            "serve_rejected_total",
+            help="HTTP requests rejected before scoring (oversized or "
+                 "malformed bodies)",
+            labels={"reason": reason})
 
     class Server(ThreadingHTTPServer):
         #: stdlib default listen backlog is 5 — a burst of concurrent
@@ -439,7 +583,24 @@ def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
 
         def do_POST(self):  # noqa: N802
             try:
-                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    _rejected("bad_length").inc()
+                    self.close_connection = True  # body length unknown: can't reuse
+                    return self._error(411, "Content-Length is not an integer")
+                if length < 0:
+                    _rejected("bad_length").inc()
+                    self.close_connection = True
+                    return self._error(411, "negative Content-Length")
+                if length > max_body:
+                    # answered WITHOUT reading the body: the cap exists so a
+                    # single oversized /v1/score cannot balloon daemon RSS
+                    _rejected("too_large").inc()
+                    self.close_connection = True  # unread body poisons keep-alive
+                    return self._error(
+                        413, f"body of {length} bytes exceeds the "
+                             f"{max_body}-byte limit")
                 raw = self.rfile.read(length) if length else b"{}"
                 try:
                     body = json.loads(raw.decode("utf-8") or "{}")

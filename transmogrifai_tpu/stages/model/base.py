@@ -52,6 +52,12 @@ class PredictorEstimator(Estimator):
     #: device mesh slot (None = unmeshed): set explicitly via with_mesh, or
     #: threaded in by Workflow.train's auto-mesh; never serialized
     mesh = None
+    #: fit_fn kwarg accepting an initial-parameter payload (warm-start refit
+    #: — the autopilot's drift retrain). None = this family cold-fits always;
+    #: families that set it also implement `warm_start_init`. Warm starts
+    #: apply ONLY to eager refits (selector winner refit, bare fit_columns):
+    #: vmapped search programs never see them.
+    warm_start_param = None
 
     @staticmethod
     def fit_fn(X, y, sample_weight=None, **hyper):
@@ -75,15 +81,64 @@ class PredictorEstimator(Estimator):
         self.mesh = mesh
         return self
 
+    # --- warm-start refit (the autopilot's drift-retrain contract) --------------------
+    def with_warm_start(self, source) -> "PredictorEstimator":
+        """Seed the next fit from `source` — a fitted PredictionModel of
+        this family (e.g. the current champion's prediction stage) or its
+        raw params payload. Families without warm-start support (or a
+        source of the wrong family/shape) SILENTLY cold-fit: warm starting
+        is an optimization, never a correctness requirement. Runtime wiring
+        like the mesh slot: never serialized, never fingerprinted."""
+        self._warm_source = source
+        return self
+
+    def _warm_source_params(self, source):
+        """params payload of `source` when it is a fitted stage of THIS
+        family (operation_name match), the payload itself otherwise; None on
+        a family mismatch."""
+        if hasattr(source, "operation_name") and hasattr(source, "params"):
+            if source.operation_name != self.operation_name:
+                return None
+            return source.params
+        return source
+
+    def warm_start_init(self, source, n_features: int) -> dict:
+        """fit_fn kwargs warm-starting from `source`, or {} when this family
+        cannot (unsupported, family mismatch, incompatible shape) — the
+        silent cold-fit fallback. Families setting `warm_start_param`
+        override this."""
+        return {}
+
+    def warm_fit_kwargs(self, n_features: int) -> dict:
+        """Resolved warm-start kwargs for an eager fit ({} = cold). Emits a
+        `train:warm_start` span event whenever a source is wired, recording
+        whether it actually applied — the observable difference between
+        'warm-started' and 'silently fell back'."""
+        source = getattr(self, "_warm_source", None)
+        if source is None:
+            return {}
+        kw = {}
+        if self.warm_start_param is not None:
+            try:
+                kw = self.warm_start_init(source, int(n_features)) or {}
+            except Exception:  # noqa: BLE001 — warm start must never fail a fit
+                kw = {}
+        from ... import obs
+
+        obs.add_event("train:warm_start", stage=type(self).__name__,
+                      applied=bool(kw))
+        return kw
+
     def fit_columns(self, cols: Sequence[Column]):
         y, X = self.label_and_matrix(cols)
+        warm = self.warm_fit_kwargs(X.shape[1])
         mesh = getattr(self, "mesh", None)
         if mesh is not None:
             from ...mesh import record_sharded_dispatch, shard_for_training
 
             X, y = shard_for_training(mesh, X, y)
             record_sharded_dispatch()
-        return self.make_model(self.fit_fn(X, y, **self.fit_kwargs()))
+        return self.make_model(self.fit_fn(X, y, **self.fit_kwargs(), **warm))
 
     def with_params(self, **overrides) -> "PredictorEstimator":
         """New un-wired instance of this family with merged ctor params (the grid-point
@@ -145,13 +200,14 @@ class ClassifierEstimator(PredictorEstimator):
         y, X = self.label_and_matrix(cols)
         kw = self.fit_kwargs()
         kw["num_classes"] = kw["num_classes"] or max(int(np.asarray(y).max()) + 1, 2)
+        warm = self.warm_fit_kwargs(X.shape[1])
         mesh = getattr(self, "mesh", None)
         if mesh is not None:
             from ...mesh import record_sharded_dispatch, shard_for_training
 
             X, y = shard_for_training(mesh, X, y)
             record_sharded_dispatch()
-        return self.make_model(self.fit_fn(X, y, **kw))
+        return self.make_model(self.fit_fn(X, y, **kw, **warm))
 
 
 class PredictionModel(Transformer):

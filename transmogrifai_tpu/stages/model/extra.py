@@ -83,6 +83,7 @@ class MLPClassifier(MeshAwareFit, ClassifierEstimator):
 
     operation_name = "mlpClassifier"
     vmap_params = ("lr", "l2")
+    warm_start_param = "init_params"
 
     def __init__(self, num_classes: int = 0, hidden: Sequence[int] = (10,),
                  max_iter: int = 200, lr: float = 0.01, l2: float = 0.0,
@@ -96,6 +97,33 @@ class MLPClassifier(MeshAwareFit, ClassifierEstimator):
     def fit_fn(X, y, sample_weight=None, num_classes=0, hidden=(10,), **kw):
         return fit_mlp(X, y, sample_weight, num_classes=max(int(num_classes), 2),
                        hidden=tuple(int(h) for h in hidden), **kw)
+
+    def warm_start_init(self, source, n_features):
+        """Previous champion's layer list when its architecture matches
+        (input width x hidden chain x classes); {} otherwise — a schema or
+        topology change silently cold-fits with the seeded random init. A
+        fit headed for the SHARDED optimizer path (data axis > 1, sharding
+        not "off") also cold-fits: the sharding contract outranks the
+        warm-start optimization (fit_mlp enforces the same precedence), and
+        returning {} here keeps the `train:warm_start` event honest."""
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None and self.params.get("shard_optimizer") != "off":
+            from ...mesh import DATA_AXIS
+
+            if int(mesh.shape.get(DATA_AXIS, 1)) > 1:
+                return {}
+        p = self._warm_source_params(source)
+        if not isinstance(p, dict) or "layers" not in p:
+            return {}
+        hidden = [int(h) for h in self.params["hidden"]]
+        ncls = max(int(self.params["num_classes"]), 2)
+        sizes = (int(n_features), *hidden, ncls)
+        want = [(i, o) for i, o in zip(sizes[:-1], sizes[1:])]
+        layers = [(np.asarray(W, np.float32), np.asarray(b, np.float32))
+                  for W, b in p["layers"]]
+        if [tuple(W.shape) for W, _ in layers] != want:
+            return {}
+        return {"init_params": layers}
 
     predict_fn = staticmethod(predict_mlp)
 

@@ -42,6 +42,7 @@ class LogisticRegression(PredictorEstimator):
 
     operation_name = "logReg"
     vmap_params = ("l2",)
+    warm_start_param = "init"
     predict_fn = staticmethod(predict_logistic)
 
     def __init__(self, l2: float = 0.0, max_iter: int = 25, solver: str = "auto",
@@ -53,14 +54,27 @@ class LogisticRegression(PredictorEstimator):
 
     @staticmethod
     def fit_fn(X, y, sample_weight=None, l2=0.0, max_iter=25, solver="auto",
-               gd_iters=300):
+               gd_iters=300, init=None):
         if solver == "auto":  # X.shape is static at trace time
             solver = "newton" if X.shape[1] <= WIDE_D_THRESHOLD else "gd"
         if solver == "newton":
             return fit_logistic(X, y, sample_weight=sample_weight, l2=l2,
-                                max_iter=max_iter)
+                                max_iter=max_iter, init=init)
         return fit_logistic_gd(X, y, sample_weight=sample_weight, l2=l2,
-                               max_iter=gd_iters)
+                               max_iter=gd_iters, warm=init)
+
+    def warm_start_init(self, source, n_features):
+        """(w, b) from a fitted logistic model of matching width; {} on any
+        mismatch (cold fit). Newton from the previous optimum re-converges in
+        a step or two on near-identical data, and the final fixed point is
+        the same unique l2-regularized optimum the zero start reaches."""
+        p = self._warm_source_params(source)
+        if not isinstance(p, dict) or "w" not in p or "b" not in p:
+            return {}
+        w = np.asarray(p["w"], np.float32).reshape(-1)
+        if w.shape[0] != int(n_features):
+            return {}
+        return {"init": (w, float(np.asarray(p["b"]).reshape(())))}
 
     def make_model(self, params):
         p = host_params(params)

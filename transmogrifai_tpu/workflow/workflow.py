@@ -260,6 +260,41 @@ class Workflow(WorkflowCore):
         }
         return self
 
+    def with_warm_start(self, model: "WorkflowModel") -> "Workflow":
+        """Warm-start REFIT from a previous model (the autopilot's drift
+        retrain): unlike `with_model_stages` — which grafts fitted
+        transformers and skips refitting entirely — every predictor
+        estimator still refits on THIS train's data, but families that
+        support it (stages/model/base.py `warm_start_param`) start their
+        optimizer from the matching fitted stage's parameters. A
+        ModelSelector warm-starts only its winner refit (the vmapped search
+        stays cold — validation scores never depend on the previous
+        champion); families/shapes that do not match silently cold-fit.
+        Call after `set_result_features` (it walks the DAG).
+
+        Matching: exact output-name first (same-graph retrains), then a
+        positional fallback — output names embed per-process uids, so a
+        FRESH graph built by the same factory (the autopilot's retrain)
+        renames everything; predictor estimators pair with the model's
+        fitted prediction stages in DAG order instead. A wrong pairing is
+        harmless: `warm_start_init` rejects family/shape mismatches and the
+        estimator cold-fits."""
+        from ..stages.model.base import PredictionModel, PredictorEstimator
+
+        by_name = {s.get_output().name: s for s in model.stages}
+        sources = [s for s in model.stages if isinstance(s, PredictionModel)]
+        used: set = set()
+        estimators = [s for layer in getattr(self, "_dag", ())
+                      for s in layer if isinstance(s, PredictorEstimator)]
+        for est in estimators:
+            source = by_name.get(est.get_output().name)
+            if source is None:
+                source = next((s for s in sources if id(s) not in used), None)
+            if source is not None:
+                used.add(id(source))
+                est.with_warm_start(source)
+        return self
+
     def set_result_features(self, *features: Feature) -> "Workflow":
         """Back-trace lineage into the layered DAG (OpWorkflow.scala:85-105)."""
         if not features:
