@@ -462,6 +462,7 @@ def _run(partial: dict) -> None:
             run_iris,
             run_mlp,
             run_monitor_overhead,
+            run_multitenant_ingest,
             run_resilience_overhead,
             run_serving_daemon,
             run_streaming_score,
@@ -532,6 +533,20 @@ def _run(partial: dict) -> None:
             detail["disagg_ingest"].get("two_worker_rows_per_sec")
         partial["disagg_recovery_s"] = \
             detail["disagg_ingest"].get("disagg_recovery_s")
+        # multi-tenant ingest service: columnar-vs-rows wire format, shared
+        # fleet vs per-run fleets, and coordinator crash+restart recovery
+        # (ISSUE-13; chaos determinism is gated by tests/ci, this lane
+        # gates the numbers)
+        try:
+            detail["multitenant_ingest"] = run_multitenant_ingest()
+        except Exception as e:  # noqa: BLE001
+            detail["multitenant_ingest"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        partial["multitenant_colbatch_speedup"] = \
+            detail["multitenant_ingest"].get("multitenant_colbatch_speedup")
+        partial["multitenant_restart_recovery_s"] = \
+            detail["multitenant_ingest"].get(
+                "multitenant_restart_recovery_s")
         # closed-loop autopilot: drift -> warm retrain -> gate -> hot swap;
         # time-to-recover-AuPR is the ROADMAP headline for the loop
         try:

@@ -1,0 +1,476 @@
+"""Multi-tenant ingest service (transmogrifai_tpu/ingest/service.py +
+client.py + frames.py).
+
+Pins the ISSUE-13 acceptance surface: one shared worker fleet serves MANY
+concurrent consumer jobs byte-identically to the in-process reader; a
+SIGKILL'd (or chaos-crashed) coordinator restarts from its atomic
+checkpoint and every consumer rides the restart out through reconnect +
+dedupe cursor with zero errors; one consumer crashing or stalling never
+wedges another job (remote backpressure sheds, never blocks shared
+workers); autoscaling spawns and retires workers without output
+divergence; the columnar frame codec is EXACT (round-trip identity,
+lossless fallback); worker reconnect backoff is a deterministic function
+of (seed, site, attempt); and the `op ingest-serve` CLI boots, serves two
+subprocess-remote consumers, and shuts down clean.
+"""
+import csv
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.ingest import (
+    AutoscaleConfig,
+    CsvDirSource,
+    IngestClient,
+    IngestService,
+    decode_columns,
+    encode_columns,
+    transport,
+)
+from transmogrifai_tpu.ingest.worker import IngestWorker
+from transmogrifai_tpu.resilience import FaultInjector, FaultPolicy
+
+
+def _counter(name, labels=None, registry=None):
+    reg = registry if registry is not None else obs.default_registry()
+    m = reg.find(name, labels=labels)
+    return m.value if m is not None else 0.0
+
+
+def _write_dir(directory, n_files=4, rows_per_file=12, seed=7):
+    os.makedirs(directory, exist_ok=True)
+    rng = random.Random(seed)
+    for b in range(n_files):
+        with open(os.path.join(directory, f"b-{b}.csv"), "w",
+                  newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["x1", "cat"])
+            for i in range(rows_per_file):
+                w.writerow([round(rng.uniform(-1, 1), 4), "abc"[i % 3]])
+    return directory
+
+
+def _expected_rows(spec):
+    rows = []
+    for name in spec.list_files():
+        for chunk in spec.chunks(spec.parse(spec.read_file(name))):
+            rows.extend(chunk)
+    return rows
+
+
+def _drain(client):
+    return [r for batch in client.stream() for r in batch]
+
+
+# --- columnar frames --------------------------------------------------------------------
+class TestColumnarFrames:
+    def test_roundtrip_exact(self):
+        rows = [
+            {"a": "1.5", "b": "", "c": None},
+            {"a": "x,\ny", "b": "héllo", "c": "0"},
+            {"a": None, "b": "zz", "c": ""},
+        ]
+        enc = encode_columns(rows)
+        assert enc is not None
+        meta, buffers = enc
+        assert meta["fields"] == ["a", "b", "c"]
+        assert meta["n"] == 3
+        got = decode_columns(meta, buffers)
+        assert got == rows
+        # key ORDER is part of byte-identity downstream
+        assert [list(r.keys()) for r in got] == [list(r.keys()) for r in rows]
+
+    def test_empty_batch(self):
+        meta, buffers = encode_columns([])
+        assert decode_columns(meta, buffers) == []
+
+    def test_columns_mode(self):
+        rows = [{"a": "1", "b": None}, {"a": "2", "b": "y"}]
+        meta, buffers = encode_columns(rows)
+        fields, values = decode_columns(meta, buffers, mode="columns")
+        assert fields == ["a", "b"]
+        assert values == [["1", "2"], [None, "y"]]
+
+    def test_unrepresentable_falls_back(self):
+        # heterogeneous keys, non-str values, non-dict rows: encoder must
+        # return None (caller sends the legacy row payload), NEVER a lossy
+        # encode
+        assert encode_columns([{"a": "1"}, {"b": "2"}]) is None
+        assert encode_columns([{"a": 1}]) is None
+        assert encode_columns([["a"]]) is None
+        assert encode_columns("rows") is None
+
+    def test_hybrid_transport_roundtrip(self):
+        a, b = socket.socketpair()
+        try:
+            rows = [{"x": "1", "y": None}, {"x": "", "y": "abc"}]
+            meta, buffers = encode_columns(rows)
+            payload = {"shard": 0, "seq": 1, "file": 2, "chunk": 3, **meta}
+            transport.send_frame(a, transport.COLBATCH, payload, buffers)
+            kind, got = transport.recv_frame(b)
+            assert kind == transport.COLBATCH
+            assert got["file"] == 2 and got["fields"] == ["x", "y"]
+            assert decode_columns(got, got["__buffers__"]) == rows
+        finally:
+            a.close(), b.close()
+
+
+# --- shared fleet, many jobs ------------------------------------------------------------
+class TestMultiTenant:
+    def test_two_local_jobs_share_one_fleet(self, tmp_path):
+        d1 = _write_dir(str(tmp_path / "s1"), n_files=3, seed=1)
+        d2 = _write_dir(str(tmp_path / "s2"), n_files=2, seed=2)
+        spec1 = CsvDirSource(d1, batch_size=3)
+        spec2 = CsvDirSource(d2, batch_size=4)
+        svc = IngestService().start()
+        try:
+            svc.register_local_job("a", spec1, n_shards=2)
+            svc.register_local_job("b", spec2, n_shards=2)
+            svc.launch_local_workers(2)
+            out = {}
+
+            def run(jid):
+                out[jid] = [r for b in svc.stream_local(jid) for r in b]
+
+            ts = [threading.Thread(target=run, args=(j,)) for j in "ab"]
+            [t.start() for t in ts]
+            [t.join(timeout=30) for t in ts]
+            assert out["a"] == _expected_rows(spec1)
+            assert out["b"] == _expected_rows(spec2)
+            assert svc.service_stats()["n_jobs"] == 2
+        finally:
+            svc.close()
+
+    def test_remote_client_parity(self, tmp_path):
+        d = _write_dir(str(tmp_path / "s"), n_files=4)
+        spec = CsvDirSource(d, batch_size=3)
+        svc = IngestService().start()
+        try:
+            svc.launch_local_workers(2)
+            client = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                                  n_shards=2)
+            assert _drain(client) == _expected_rows(spec)
+        finally:
+            svc.close()
+
+    def test_two_remote_consumers_chaos_byte_identical(self, tmp_path):
+        """Two concurrent consumer jobs over one fleet, with a worker kill
+        and a torn frame injected mid-epoch: both outputs byte-identical to
+        the in-process reader, zero consumer-visible errors."""
+        d = _write_dir(str(tmp_path / "s"), n_files=4, rows_per_file=10)
+        spec = CsvDirSource(d, batch_size=2)
+        expect = _expected_rows(spec)
+        inj = FaultInjector(11, worker_kills=[(0, 1)], rpc_torn=[(1, 2)])
+        svc = IngestService(lease_timeout_s=1.0,
+                            self_extract_after_s=30.0).start()
+        try:
+            with inj.installed():
+                svc.launch_local_workers(2)
+                out, errs = {}, []
+
+                def run(jid):
+                    try:
+                        out[jid] = _drain(IngestClient(
+                            svc.address, jid, spec, plan_fp="fp",
+                            n_shards=2))
+                    except Exception as e:  # noqa: BLE001 — the assertion
+                        errs.append((jid, e))
+
+                ts = [threading.Thread(target=run, args=(f"j{i}",))
+                      for i in range(2)]
+                [t.start() for t in ts]
+                [t.join(timeout=60) for t in ts]
+            assert errs == []
+            assert out["j0"] == expect
+            assert out["j1"] == expect
+            kinds = {e[0] for e in inj.events}
+            assert "worker_kill" in kinds
+        finally:
+            svc.close()
+
+    def test_crashed_consumer_leaves_other_job_untouched(self, tmp_path):
+        """One consumer's socket dying abruptly mid-stream detaches its job
+        (paused, state intact) and never disturbs the surviving job."""
+        d = _write_dir(str(tmp_path / "s"), n_files=4, rows_per_file=20)
+        spec = CsvDirSource(d, batch_size=2)
+        expect = _expected_rows(spec)
+        svc = IngestService().start()
+        try:
+            svc.launch_local_workers(2)
+            victim = IngestClient(svc.address, "victim", spec,
+                                  plan_fp="fp", n_shards=2)
+            it = victim.stream()
+            next(it)  # registered + first batch delivered
+            victim._sock.close()  # crash: no JOB_CLOSE, just a dead socket
+
+            survivor = IngestClient(svc.address, "survivor", spec,
+                                    plan_fp="fp", n_shards=2)
+            assert _drain(survivor) == expect
+            # the survivor completed and deregistered (JOB_CLOSE on EOF) —
+            # the close frame is processed by the handler thread, so poll
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                stats = svc.service_stats()
+                if "survivor" not in stats["jobs"]:
+                    break
+                time.sleep(0.01)
+            assert "survivor" not in stats["jobs"]
+            # the victim job is still registered, paused, frontier intact —
+            # a reconnecting consumer would resume it. (Extraction may have
+            # finished into the buffer — in-flight leases complete even for
+            # a parked job — but DELIVERY stays frozen where the consumer
+            # died: exactly one batch acked.)
+            assert "victim" in stats["jobs"]
+            assert stats["jobs"]["victim"]["paused"]
+            assert stats["jobs"]["victim"]["acked"] == [0, 1]
+        finally:
+            svc.close()
+
+    def test_slow_consumer_sheds_but_completes(self, tmp_path):
+        """A remote job with a tiny buffer and a dawdling consumer sheds
+        far-ahead batches (never blocking shared workers) yet still
+        completes exactly-once: SHARD_DONE's completeness check requeues
+        the gaps."""
+        d = _write_dir(str(tmp_path / "s"), n_files=4, rows_per_file=10)
+        spec = CsvDirSource(d, batch_size=2)
+        svc = IngestService(max_buffered_batches=2,
+                            inflight_window=1).start()
+        try:
+            svc.launch_local_workers(2)
+            client = IngestClient(svc.address, "slow", spec,
+                                  plan_fp="fp", n_shards=2)
+            rows = []
+            for batch in client.stream():
+                rows.extend(batch)
+                time.sleep(0.01)
+            assert rows == _expected_rows(spec)
+        finally:
+            svc.close()
+
+
+# --- checkpoint / restart ---------------------------------------------------------------
+def _crash_drill(base_dir, seed, registry):
+    """Boot service+fleet with a chaos coord:kill armed, stream one remote
+    job through the crash, restart the service on the SAME port + state
+    dir, and return (rows, injector events, restart counter delta)."""
+    d = _write_dir(os.path.join(base_dir, "s"), n_files=4, rows_per_file=10,
+                   seed=seed)
+    spec = CsvDirSource(d, batch_size=2)
+    state = os.path.join(base_dir, "state")
+    inj = FaultInjector(seed, coord_kills=[(0, 1)])
+    before = _counter("ingest_coordinator_restarts_total",
+                      registry=registry)
+    svc1 = IngestService(state_dir=state, kill_mode="raise",
+                         checkpoint_every_s=0.05, registry=registry)
+    svc1.start()
+    port = svc1.address[1]
+    rows, errs = [], []
+    with inj.installed():
+        svc1.launch_local_workers(2)
+
+        def consume():
+            try:
+                rows.extend(_drain(IngestClient(
+                    ("127.0.0.1", port), "job", spec, plan_fp="fp",
+                    n_shards=2)))
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errs.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        deadline = time.monotonic() + 30
+        while not svc1._crashed and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc1._crashed, "chaos coord:kill never fired"
+        # supervisor: restart on the same port + state dir; svc1's local
+        # worker threads are still alive and re-adopt via their reconnect
+        # loops, exactly like subprocess workers after a real SIGKILL
+        svc2 = IngestService(host="127.0.0.1", port=port, state_dir=state,
+                             registry=registry)
+        svc2.start()
+        t.join(timeout=60)
+        assert not t.is_alive(), "consumer never finished after restart"
+    delta = _counter("ingest_coordinator_restarts_total",
+                     registry=registry) - before
+    svc2.close()
+    svc1.close()
+    assert errs == []
+    return rows, list(inj.events), delta, _expected_rows(spec)
+
+
+class TestCheckpointRestart:
+    def test_crash_restart_byte_identical(self, tmp_path):
+        reg = obs.MetricsRegistry()
+        rows, events, delta, expect = _crash_drill(str(tmp_path), 5, reg)
+        assert rows == expect
+        assert delta == 1
+        assert ("coord_kill", "coord:kill", 1) in [e[:3] for e in events]
+
+    def test_crash_drill_event_log_reproducible(self, tmp_path):
+        """Same seed → same injected-fault event log AND same output bytes:
+        the chaos drill is replayable."""
+        r1 = _crash_drill(str(tmp_path / "a"), 9, obs.MetricsRegistry())
+        r2 = _crash_drill(str(tmp_path / "b"), 9, obs.MetricsRegistry())
+        assert r1[0] == r2[0] == r1[3]
+        assert r1[1] == r2[1]
+
+    def test_checkpoint_atomic_and_clean_restore(self, tmp_path):
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=3)
+        state = str(tmp_path / "state")
+        reg = obs.MetricsRegistry()
+        svc = IngestService(state_dir=state, registry=reg).start()
+        svc.launch_local_workers(1)
+        client = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                              n_shards=1)
+        it = client.stream()
+        next(it)              # partial progress: the acked frontier moved
+        client._sock.close()  # detach WITHOUT JOB_CLOSE — the job persists
+        svc.close()
+        path = os.path.join(state, "ingest_state.json")
+        assert os.path.exists(path)
+        with open(path) as fh:
+            snap = json.load(fh)
+        assert snap["clean"] is True
+        assert "job" in snap["jobs"]
+        assert snap["jobs"]["job"]["files"]
+        # atomic replace: no orphaned temp files
+        assert [f for f in os.listdir(state) if f != "ingest_state.json"] == []
+        # a CLEAN restore is not a restart: the counter must not move, and
+        # the restored job sits paused awaiting its consumer's JOB_OPEN
+        svc2 = IngestService(state_dir=state, registry=reg).start()
+        stats = svc2.service_stats()
+        svc2.close()
+        assert stats["jobs"]["job"]["paused"]
+        assert not stats["jobs"]["job"]["done"]
+        assert _counter("ingest_coordinator_restarts_total",
+                        registry=reg) == 0.0
+
+
+# --- autoscaling ------------------------------------------------------------------------
+class TestAutoscale:
+    def test_spawn_on_queue_wait_then_retire_idle(self, tmp_path):
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=3)
+        reg = obs.MetricsRegistry()
+        spawned = []
+
+        def spawn_fn(svc, n):
+            spawned.extend(svc.launch_local_workers(n))
+
+        svc = IngestService(
+            poll_s=0.05,
+            autoscale=AutoscaleConfig(min_workers=0, max_workers=1,
+                                      scale_up_wait_s=0.1,
+                                      scale_down_idle_s=0.3,
+                                      cooldown_s=0.05),
+            spawn_fn=spawn_fn, registry=reg).start()
+        try:
+            svc.register_local_job("run", spec, n_shards=2)
+            # no fleet: queue wait grows until autoscale spawns one
+            rows = [r for b in svc.stream_local("run") for r in b]
+            assert rows == _expected_rows(spec)
+            assert len(spawned) >= 1
+            assert _counter("ingest_autoscale_total", {"action": "spawn"},
+                            registry=reg) >= 1
+            # fleet idle with the job done: the worker is retired
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and not _counter("ingest_autoscale_total",
+                                    {"action": "retire"}, registry=reg)):
+                time.sleep(0.05)
+            assert _counter("ingest_autoscale_total", {"action": "retire"},
+                            registry=reg) >= 1
+        finally:
+            svc.close()
+
+
+# --- worker reconnect backoff -----------------------------------------------------------
+class TestWorkerReconnect:
+    def test_backoff_is_seeded_policy_schedule(self):
+        """The mid-run reconnect loop sleeps exactly
+        FaultPolicy.backoff_s(seed, 'ingest:reconnect', attempt) — the
+        deterministic fleet-decorrelated schedule, not ad-hoc sleeps."""
+        # a port nothing listens on: bind+close to reserve then free it
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        sleeps = []
+        policy = FaultPolicy(seed=42, backoff_base_s=0.01, backoff_cap_s=0.1)
+        w = IngestWorker(("127.0.0.1", port), policy=policy,
+                         reconnect_max=3, sleep=sleeps.append)
+        with pytest.raises((ConnectionError, OSError)):
+            w._reconnect()
+        expect = [policy.backoff_s("ingest:reconnect", k) for k in range(3)]
+        assert sleeps == expect
+        # decorrelated across fleet members: a different seed, different
+        # schedule
+        assert expect != [FaultPolicy(seed=43, backoff_base_s=0.01,
+                                      backoff_cap_s=0.1)
+                          .backoff_s("ingest:reconnect", k)
+                          for k in range(3)]
+
+
+# --- shared materialized-feature cache --------------------------------------------------
+class TestSharedCache:
+    def test_cache_exactly_once_across_consumers(self, tmp_path):
+        """Two consumers over the same source + one shared cache dir: the
+        first extraction populates the cache (misses == n_files), the
+        second is served from it (hits == n_files) — each lookup counted
+        exactly once."""
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=3)
+        cache = str(tmp_path / "cache")
+        reg = obs.MetricsRegistry()
+        expect = _expected_rows(spec)
+        svc = IngestService(cache_dir=cache, registry=reg).start()
+        try:
+            svc.launch_local_workers(1, cache_dir=cache)
+            for jid in ("first", "second"):
+                client = IngestClient(svc.address, jid, spec,
+                                      plan_fp="fp", n_shards=1,
+                                      registry=reg)
+                assert _drain(client) == expect
+            assert _counter("ingest_cache_misses_total", registry=reg) == 3.0
+            assert _counter("ingest_cache_hits_total", registry=reg) == 3.0
+        finally:
+            svc.close()
+
+
+# --- the CLI ----------------------------------------------------------------------------
+class TestIngestServeCli:
+    def test_serve_boots_and_feeds_a_consumer(self, tmp_path):
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=4)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "transmogrifai_tpu.cli.main",
+             "ingest-serve", "--port", "0",
+             "--state-dir", str(tmp_path / "state"), "--workers", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("ingest-serve ready "), line
+            addr = line.rsplit(" ", 1)[-1]
+            client = IngestClient(addr, "cli-job", spec, plan_fp="fp")
+            assert _drain(client) == _expected_rows(spec)
+            from transmogrifai_tpu.ingest import read_service_stats
+
+            stats = read_service_stats(addr)
+            assert stats["restarts"] == 0
+            # the finished job deregistered itself (JOB_CLOSE on EOF)
+            assert "cli-job" not in stats["jobs"]
+        finally:
+            proc.terminate()
+            proc.wait(timeout=15)
+        assert proc.returncode == 0

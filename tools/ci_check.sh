@@ -279,6 +279,139 @@ print(f"disagg ingest smoke ok: 1 worker SIGKILLed mid-epoch, lease "
       f"reassigned once, output digest identical ({clean[:12]})")
 PY
 
+echo "== multi-tenant ingest coordinator-kill smoke =="
+# a REAL `op ingest-serve` process with a seeded chaos coord:kill
+# (kill_mode=process — an actual SIGKILL of the coordinator pid) serving
+# two concurrent consumer jobs over a 2-subprocess worker fleet launched
+# as external `op ingest-worker`s. The supervisor restarts the service on
+# the SAME port + state dir with --workers 0: the orphaned workers
+# re-adopt, both consumers ride the crash through reconnect + dedupe
+# cursor, and both must match the fault-free baseline digests
+# (docs/robustness.md "Multi-tenant ingest failure model").
+python - <<'PY'
+import csv, hashlib, os, random, re, signal, subprocess, sys, tempfile
+import threading, time
+
+from transmogrifai_tpu.ingest import (CsvDirSource, IngestClient,
+                                      read_service_stats)
+from transmogrifai_tpu.resilience.policy import FaultPolicy
+
+work = tempfile.mkdtemp(prefix="ci_mt_")
+stream_dir = os.path.join(work, "stream")
+os.makedirs(stream_dir)
+r = random.Random(13)
+for b in range(4):
+    with open(os.path.join(stream_dir, f"b-{b}.csv"), "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["x1", "cat"])
+        for i in range(24):
+            w.writerow([round(r.uniform(-1, 1), 4), "abc"[i % 3]])
+spec = CsvDirSource(stream_dir, batch_size=8)
+OP = [sys.executable, "-m", "transmogrifai_tpu.cli.main"]
+
+
+def serve(port, state, chaos=None):
+    cmd = OP + ["ingest-serve", "--host", "127.0.0.1", "--port", str(port),
+                "--state-dir", state]
+    if chaos:
+        cmd += ["--chaos-coord-kill", chaos, "--chaos-seed", "3"]
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True,
+                         env=dict(os.environ))
+    deadline, line = time.time() + 120, ""
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if "ingest-serve ready" in line:
+            break
+    m = re.search(r"ready \S*:(\d+)", line)
+    assert m, f"no ready line from ingest-serve: {line!r}"
+    return p, int(m.group(1))
+
+
+def spawn_workers(port, n):
+    # external fleet with a deep rejoin budget: these processes must
+    # outlive the SIGKILL'd coordinator and re-adopt into its replacement
+    return [subprocess.Popen(
+        OP + ["ingest-worker", "--connect", f"127.0.0.1:{port}",
+              "--worker-id", f"ci-w{i}", "--seed", str(i),
+              "--reconnect-max", "120"],
+        env=dict(os.environ)) for i in range(n)]
+
+
+def drain(port, jid, results):
+    pol = FaultPolicy(retry_max=30, backoff_base_s=0.05, backoff_cap_s=1.0)
+    client = IngestClient(("127.0.0.1", port), jid, spec, plan_fp="ci",
+                          n_shards=2, policy=pol)
+    h, n = hashlib.sha256(), 0
+    for batch in client.stream():
+        for row in batch:
+            h.update(repr(row).encode())
+            n += 1
+    results[jid] = (n, h.hexdigest())
+
+
+def consume_two(port):
+    results = {}
+    ts = [threading.Thread(target=drain, args=(port, f"j{i}", results))
+          for i in (0, 1)]
+    for t in ts:
+        t.start()
+    return ts, results
+
+
+def reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+# fault-free baseline: same fleet shape, no chaos
+p, port = serve(0, os.path.join(work, "st_clean"))
+fleet = spawn_workers(port, 2)
+try:
+    ts, base = consume_two(port)
+    for t in ts:
+        t.join(timeout=180)
+    assert len(base) == 2, base
+finally:
+    p.send_signal(signal.SIGTERM)
+    p.wait(timeout=30)
+    reap(fleet)
+
+# chaos run: the coordinator SIGKILLs ITSELF at (epoch 0, commit seq 2)
+state = os.path.join(work, "st_kill")
+p1, port = serve(0, state, chaos="0:2")
+fleet = spawn_workers(port, 2)
+p2 = None
+try:
+    ts, out = consume_two(port)
+    p1.wait(timeout=120)  # the self-SIGKILL lands mid-stream
+    assert p1.returncode == -signal.SIGKILL, p1.returncode
+    # supervisor restart: same port + state dir, NO workers of its own —
+    # the orphaned external fleet must re-adopt
+    p2, _ = serve(port, state)
+    for t in ts:
+        t.join(timeout=180)
+    assert len(out) == 2, out
+    assert out == base, "post-restart digests diverged from baseline"
+    stats = read_service_stats(("127.0.0.1", port))
+    assert stats["restarts"] == 1, stats
+    assert len(stats["workers"]) == 2, stats  # orphan fleet re-adopted
+finally:
+    if p2 is not None:
+        p2.send_signal(signal.SIGTERM)
+        p2.wait(timeout=30)
+    reap(fleet + [p1])
+print(f"multitenant ingest smoke ok: coordinator SIGKILLed itself "
+      f"mid-stream, restart on port {port} re-adopted 2 workers, both "
+      f"consumers rode through with digests identical to baseline")
+PY
+
 echo "== serving daemon smoke (op serve over HTTP) =="
 # train+save a tiny model, start the daemon as a real subprocess (ephemeral
 # port, parsed off the ready line), score over HTTP, check /healthz and the

@@ -76,6 +76,17 @@ def _cmd_run(argv) -> int:
                          "workers across runs (content-fingerprint keyed): "
                          "grid-search consumers and restarted workers skip "
                          "re-extraction")
+    ap.add_argument("--ingest-connect", default=None, metavar="HOST:PORT",
+                    help="streaming_score: consume extraction from a SHARED "
+                         "multi-tenant ingest service (`op ingest-serve`) "
+                         "instead of spawning a per-run fleet; a service "
+                         "restart mid-run is ridden out by reconnect + "
+                         "dedupe cursor (mutually exclusive with "
+                         "--ingest-workers)")
+    ap.add_argument("--ingest-job", default=None, metavar="NAME",
+                    help="job id this run registers with the shared ingest "
+                         "service (default: pid-derived); name it to resume "
+                         "a crashed consumer's frontier")
     ap.add_argument("--chaos-seed", type=int, default=None, metavar="SEED",
                     help="chaos drill: run under FaultInjector.default_"
                          "schedule(SEED) — two transient IO errors, one "
@@ -101,6 +112,10 @@ def _cmd_run(argv) -> int:
         params.ingest_workers = args.ingest_workers
     if args.ingest_cache_dir is not None:
         params.ingest_cache_dir = args.ingest_cache_dir
+    if args.ingest_connect is not None:
+        params.ingest_connect = args.ingest_connect
+    if args.ingest_job is not None:
+        params.ingest_job = args.ingest_job
     if args.mesh is not None:
         from transmogrifai_tpu.mesh import parse_mesh_shape
 
@@ -645,6 +660,84 @@ def _cmd_warmup(argv) -> int:
     return 0
 
 
+def _cmd_ingest_serve(argv) -> int:
+    """Standalone multi-tenant ingest service: one shared worker fleet
+    serving many concurrent consumer jobs (`op run --ingest-connect`).
+    State checkpoints atomically under --state-dir, so a SIGKILL'd service
+    restarted on the same port + state dir resumes every job
+    byte-identically (docs/robustness.md 'Multi-tenant ingest failure
+    model')."""
+    ap = argparse.ArgumentParser(
+        prog="op ingest-serve",
+        description="shared multi-tenant feature-extraction service")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="bind port (0 = ephemeral; pin it so a restarted "
+                         "service is reachable at the same address)")
+    ap.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="checkpoint directory (lease table + per-job "
+                         "frontiers); restart with the same DIR to resume")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="materialized-feature cache shared by the fleet")
+    ap.add_argument("--workers", type=int, default=0, metavar="N",
+                    help="extraction worker subprocesses to spawn at boot "
+                         "(0 = rely on autoscale / externally launched "
+                         "`op ingest-worker`s)")
+    ap.add_argument("--lease-timeout-s", type=float, default=10.0)
+    ap.add_argument("--self-extract-after-s", type=float, default=15.0)
+    ap.add_argument("--autoscale-max", type=int, default=0, metavar="N",
+                    help="enable queue-wait-driven worker autoscaling up to "
+                         "N subprocesses (0 = fixed fleet)")
+    ap.add_argument("--chaos-coord-kill", default=None,
+                    metavar="EPOCH:SEQ[,EPOCH:SEQ...]",
+                    help="chaos drill: SIGKILL this process when the named "
+                         "(epoch, commit-seq) points are reached — "
+                         "deterministic per seed, for restart drills")
+    ap.add_argument("--chaos-seed", type=int, default=0, metavar="SEED")
+    args = ap.parse_args(argv)
+
+    import contextlib
+    import signal
+    import threading
+
+    from transmogrifai_tpu.ingest import AutoscaleConfig, IngestService
+
+    chaos_ctx = contextlib.nullcontext()
+    if args.chaos_coord_kill:
+        from transmogrifai_tpu.resilience import FaultInjector
+
+        kills = []
+        for part in args.chaos_coord_kill.split(","):
+            epoch, _, seq = part.strip().partition(":")
+            kills.append((int(epoch), int(seq)))
+        chaos_ctx = FaultInjector(args.chaos_seed,
+                                  coord_kills=kills).installed()
+    autoscale = None
+    if args.autoscale_max > 0:
+        autoscale = AutoscaleConfig(max_workers=args.autoscale_max)
+    svc = IngestService(
+        host=args.host, port=args.port, state_dir=args.state_dir,
+        cache_dir=args.cache_dir, lease_timeout_s=args.lease_timeout_s,
+        self_extract_after_s=args.self_extract_after_s,
+        autoscale=autoscale, kill_mode="process")
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    with chaos_ctx:
+        svc.start()
+        if args.workers:
+            svc.spawn_workers(args.workers)
+        host, port = svc.address
+        # the ready line is the supervisor/CI handshake: address first
+        print(f"ingest-serve ready {host}:{port}", flush=True)
+        try:
+            while not stop.is_set():
+                stop.wait(0.25)
+        finally:
+            svc.close()
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     from transmogrifai_tpu import __version__
@@ -670,6 +763,9 @@ def main(argv=None) -> int:
             "  ingest-worker  disaggregated feature-extraction worker: "
             "lease stride shards from a run's coordinator and stream "
             "parsed batches back (--connect HOST:PORT)\n"
+            "  ingest-serve   shared multi-tenant ingest service: one "
+            "worker fleet feeding many concurrent consumer jobs, with "
+            "checkpoint/restart (--port N --state-dir DIR [--workers N])\n"
             "  warmup    pre-seed the compile cache for planned train shapes "
             "(--serving MODEL_DIR warms the serving buckets)\n"
             "  version   print framework version"
@@ -695,6 +791,8 @@ def main(argv=None) -> int:
         from transmogrifai_tpu.ingest.worker import main as worker_main
 
         return worker_main(rest)
+    if cmd == "ingest-serve":
+        return _cmd_ingest_serve(rest)
     if cmd == "warmup":
         return _cmd_warmup(rest)
     print(f"op: unknown command {cmd!r}", file=sys.stderr)

@@ -4,29 +4,51 @@ process boundaries, fault-tolerant from day one.
 
 N extraction worker processes (`op ingest-worker`, or in-process threads for
 tests — same socket code path either way) parse their stride shards of the
-source and push batches to the consumer-side `IngestCoordinator` over a
-length-prefixed, CRC-checked frame protocol (transport.py). The coordinator
-hands out shard leases with heartbeat expiry, dedupes batches by ordinal,
-re-orders them into the exact sequence the in-process reader would have
-produced, and plugs into the existing `Prefetcher`/`run_pipeline` input
-executor as a live source — so a fault-free run with the service armed is
-bit-identical to the in-process path, and a SIGKILLed worker mid-epoch
-changes nothing but the `ingest_lease_reassigned_total` counter
-(docs/robustness.md "Distributed ingest failure model").
+source and push batches — columnar frames by default (frames.py: per-column
+contiguous buffers over the CRC transport) — to the `IngestService`
+(service.py), which hands out shard leases with heartbeat expiry, dedupes
+batches by ordinal, and re-orders them per JOB into the exact sequence the
+in-process reader would have produced.
+
+The service is MULTI-TENANT: one long-lived worker fleet serves many
+concurrent consumer jobs (grid-search folds, simultaneous `op run`s), each
+with its own frontier and bounded delivery buffer, isolated from the
+others' stalls and crashes. Service state (lease table + per-job acked
+frontiers) checkpoints atomically, so a SIGKILL'd coordinator restarts,
+re-adopts reconnecting workers and consumers, and resumes every job
+byte-identically. Worker autoscaling rides the queue-wait signal, degrading
+to in-process self-extraction when the fleet is gone.
+
+Per-run surfaces: `IngestCoordinator` (the single-job facade `op run
+--ingest-workers N` arms — a fault-free run with the service armed is
+bit-identical to the in-process path) and `IngestClient` (the remote
+consumer `op run --ingest-connect HOST:PORT` uses against a standalone
+`op ingest-serve`). docs/robustness.md "Multi-tenant ingest failure model"
+has the full fault matrix.
 """
 from .cache import FeatureCache, cache_key
+from .client import IngestClient, read_service_stats
 from .coordinator import IngestCoordinator
+from .frames import decode_columns, encode_columns
+from .service import AutoscaleConfig, IngestError, IngestService
 from .source import CsvDirSource, source_from_wire
 from .transport import FrameError, recv_frame, send_frame
 from .worker import IngestWorker
 
 __all__ = [
+    "AutoscaleConfig",
     "CsvDirSource",
     "FeatureCache",
     "FrameError",
+    "IngestClient",
     "IngestCoordinator",
+    "IngestError",
+    "IngestService",
     "IngestWorker",
     "cache_key",
+    "decode_columns",
+    "encode_columns",
+    "read_service_stats",
     "recv_frame",
     "send_frame",
     "source_from_wire",

@@ -4,7 +4,12 @@ A worker that already parsed file F for extraction format E never parses it
 again — and neither does any OTHER worker or consumer process pointed at the
 same cache directory: restarted workers resume warm, and a grid search
 scoring the same table N times pays the parse once
-(ROADMAP "materialized-feature cache keyed by plan fingerprint").
+(ROADMAP "materialized-feature cache keyed by plan fingerprint"). Under
+the multi-tenant service the cache is the cross-JOB sharing layer too:
+`op ingest-serve --cache-dir` gives the whole fleet one cache, so N
+concurrent consumer jobs over the same table extract each file once
+(`ingest_cache_{hits,misses}_total` counts exactly that in the
+tests/test_ingest_service.py shared-cache drill).
 
 Keying: `cache_key(extraction_fp, data_fp)` where `extraction_fp` comes from
 the source spec (payload format + chunking knobs; for vectorized payload

@@ -1,0 +1,207 @@
+"""Consumer client for the multi-tenant ingest service.
+
+`IngestClient.stream()` is the remote twin of
+`IngestService.stream_local`: an ordered, exactly-once iterator of row
+batches for one job, byte-identical to the in-process reader path. The
+client owns the two things only the consumer can own:
+
+* **The dedupe cursor.** The service resumes delivery from its CHECKPOINTED
+  acked frontier after a crash, which may lag what this consumer already
+  processed. Every incoming batch below the client's `(file, chunk)` cursor
+  is acknowledged and dropped — exactly-once at the consumer, regardless of
+  how stale the service's checkpoint was.
+* **The reconnect loop.** A dead connection (service crash, torn frame,
+  kicked attachment) triggers reconnect-with-seeded-backoff
+  (`FaultPolicy.backoff_s`, site `ingest:job_connect` — the same
+  deterministic jitter as every other resilience site) and an idempotent
+  JOB_OPEN: the service attaches the surviving job state and replays from
+  its frontier. The consumer sees a pause, never an error.
+
+Acking doubles as flow control: the service's sender stops
+`inflight_window` batches past the acked frontier, so a slow consumer
+backpressures its OWN delivery stream while the shared workers keep
+feeding other jobs (isolation is the service's shedding buffer's problem,
+not this client's).
+"""
+from __future__ import annotations
+
+import socket
+import time
+from typing import Iterator, Optional
+
+from .. import obs
+from ..resilience.policy import FaultPolicy, retry_call
+from . import transport
+from .frames import decode_columns
+from .service import IngestError
+
+
+class IngestClient:
+    """One job's consumer connection. `source` is required for the first
+    registration (the service creates the job from its wire spec) and
+    optional on reattach — passing it is always safe (JOB_OPEN is
+    idempotent)."""
+
+    def __init__(self, address, job_id: str, source=None, *,
+                 plan_fp: Optional[str] = None,
+                 n_shards: Optional[int] = None,
+                 epoch: int = 0,
+                 policy: Optional[FaultPolicy] = None,
+                 registry=None):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        self.address = (address[0], int(address[1]))
+        self.job_id = str(job_id)
+        self.source = source
+        self.plan_fp = plan_fp or "unfingerprintable"
+        self.n_shards = n_shards
+        self.epoch = int(epoch)
+        self.policy = policy if policy is not None else FaultPolicy(
+            retry_max=8, backoff_base_s=0.05, backoff_cap_s=1.0)
+        self._reg = registry if registry is not None else obs.default_registry()
+        #: next-expected (file, chunk): everything below is consumed
+        self.cursor: tuple[int, int] = (0, 0)
+        self.file_chunks: dict[int, int] = {}
+        self.reconnects = 0
+        self._sock: Optional[socket.socket] = None
+        self._stopped = False
+
+    # --- connection management --------------------------------------------------------
+    def _open_payload(self) -> dict:
+        payload = {"job": self.job_id, "plan": self.plan_fp,
+                   "epoch": self.epoch}
+        if self.source is not None:
+            payload["source"] = self.source.to_wire()
+        if self.n_shards:
+            payload["n_shards"] = int(self.n_shards)
+        return payload
+
+    def _connect(self) -> socket.socket:
+        def attempt():
+            s = socket.create_connection(self.address, timeout=10.0)
+            s.settimeout(None)
+            try:
+                transport.send_frame(s, transport.JOB_OPEN,
+                                     self._open_payload())
+                kind, ready = transport.recv_frame(s)
+            except BaseException:
+                s.close()
+                raise
+            if kind == transport.JOB_ERROR:
+                s.close()
+                raise IngestError(f"{ready.get('type')}: "
+                                  f"{ready.get('message')}")
+            if kind != transport.JOB_READY:
+                s.close()
+                raise transport.FrameError(
+                    f"expected JOB_READY, got kind {kind}")
+            return s
+
+        return retry_call(attempt, policy=self.policy,
+                          site="ingest:job_connect")
+
+    def _reconnect(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self.reconnects += 1
+        self._reg.counter("ingest_client_reconnects_total",
+                          help="consumer reconnects to the ingest service "
+                               "(service restart or dead connection)").inc()
+        obs.add_event("ingest:client_reconnect", job=self.job_id,
+                      n=self.reconnects)
+        self._sock = self._connect()
+
+    def _ack(self) -> None:
+        transport.send_frame(self._sock, transport.JOB_ACK,
+                             {"job": self.job_id, "file": self.cursor[0],
+                              "chunk": self.cursor[1]})
+
+    def close(self) -> None:
+        self._stopped = True
+        if self._sock is not None:
+            try:
+                transport.send_frame(self._sock, transport.JOB_CLOSE,
+                                     {"job": self.job_id})
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self) -> "IngestClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- the stream -------------------------------------------------------------------
+    def stream(self) -> Iterator[list]:
+        """Yield this job's batches in exact (file, chunk) order, riding out
+        service restarts and dead connections. Raises IngestError if the
+        job itself failed (the in-process reader's failure, relayed)."""
+        self._sock = self._connect()
+        while not self._stopped:
+            try:
+                kind, payload = transport.recv_frame(self._sock)
+            except (transport.FrameError, ConnectionError, OSError):
+                if self._stopped:
+                    return
+                self._reconnect()  # raises when the retry budget is spent
+                continue
+            if kind == transport.JOB_BATCH:
+                key = (int(payload["file"]), int(payload["chunk"]))
+                if key > self.cursor:
+                    raise transport.FrameError(
+                        f"delivery gap: got {key}, expected {self.cursor}")
+                if key == self.cursor:
+                    if "rows" in payload:
+                        rows = payload["rows"]
+                    else:
+                        rows = decode_columns(payload,
+                                              payload["__buffers__"])
+                    self.cursor = (key[0], key[1] + 1)
+                    self._ack()
+                    yield rows
+                else:
+                    # replayed batch below the cursor (service restarted
+                    # from a stale checkpoint): drop, but still ack so the
+                    # sender's window drains
+                    self._reg.counter(
+                        "ingest_client_duplicates_total",
+                        help="replayed batches dropped by the consumer's "
+                             "cursor after a service restart").inc()
+                    self._ack()
+            elif kind == transport.JOB_FILE_END:
+                f, nc = int(payload["file"]), int(payload["chunks"])
+                self.file_chunks[f] = nc
+                if f >= self.cursor[0]:
+                    self.cursor = (f + 1, 0)
+                self._ack()
+            elif kind == transport.JOB_EOF:
+                self.close()
+                return
+            elif kind == transport.JOB_ERROR:
+                raise IngestError(f"{payload.get('type')}: "
+                                  f"{payload.get('message')}")
+            # any other kind (e.g. a stats reply meant for another caller)
+            # is ignored: the stream only advances on its own frames
+
+
+def read_service_stats(address, timeout: float = 10.0) -> dict:
+    """One-shot SVC_STATS request — the CLI/CI introspection hook."""
+    if isinstance(address, str):
+        host, _, port = address.rpartition(":")
+        address = (host or "127.0.0.1", int(port))
+    with socket.create_connection(address, timeout=timeout) as s:
+        transport.send_frame(s, transport.SVC_STATS, {})
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            kind, payload = transport.recv_frame(s)
+            if kind == transport.SVC_STATS:
+                return payload.get("stats", {})
+    raise TimeoutError("no SVC_STATS reply")

@@ -1,29 +1,27 @@
-"""Consumer-side ingest coordinator: leases, recovery, ordered delivery.
+"""Single-job ingest coordinator: the per-run facade over `IngestService`.
 
-The coordinator owns one extraction epoch over a shardable source. It
+Historically this module WAS the implementation — one coordinator, one
+consumer, one epoch. The lease/replay/reorder machinery now lives in
+`service.py` as a multi-tenant service (many concurrent consumer jobs over
+one shared worker fleet, checkpoint/restart, autoscaling); this class is
+the preserved per-run surface: it embeds a `single_epoch` service, registers
+exactly one LOCAL job, and exposes the original API — `stream()`,
+`spawn_workers(n)` / `launch_local_workers(n)`, `request_stop()`,
+`close()`, `stats()` — unchanged, so `op run --ingest-workers N` and every
+existing caller behave byte-for-byte as before:
 
-* freezes the file listing once and stride-shards it (`file_index %
-  n_shards` — the `ProcessShardedReader` discipline one level up);
-* listens on a TCP socket for extraction workers, hands out **shard leases**
-  with heartbeat expiry, and requeues the lease of any worker that
-  disconnects, dies, or goes quiet — the replacement holder (or the
-  coordinator itself, see below) deterministically re-extracts the shard and
-  already-committed ordinals are skipped server-side and deduped here, so
-  delivery is **exactly-once at the table level**;
-* reassembles arriving batches into the EXACT global order the in-process
-  reader would have produced — `(file_index, chunk_index)` ascending — with
-  a bounded reorder buffer (real backpressure: a handler holding a
-  far-ahead batch blocks until the consumer catches up; the next-needed
-  batch is always admitted, so the bound can never deadlock the stream);
-* degrades to **in-process fallback extraction** when a pending shard finds
-  no holder within a grace period (the whole fleet died, or never showed
-  up): the epoch completes on the consumer's CPU instead of wedging — the
-  service can lose every worker and still be exactly a slow version of the
-  in-process path.
+* the file listing freezes once and stride-shards (`file_index % n_shards`);
+* workers lease shards with heartbeat expiry; dead/disconnected/wedged
+  holders requeue and replay deduplicates by `(file, chunk)` ordinal —
+  exactly-once at the table level;
+* `stream()` reassembles the EXACT in-process batch order with a bounded
+  blocking reorder buffer (a local job's backpressure stalls its own
+  workers — the original semantics, unlike remote jobs' shedding);
+* a fleetless epoch degrades to in-process fallback extraction.
 
-Consumer-visible surface: `stream()` (an iterator of batches — plug it into
-`run_pipeline`/`Prefetcher` via `readers.pipeline.LiveSource`), plus
-`spawn_workers(n)` / `launch_local_workers(n)` and `close()`.
+`single_epoch` keeps the worker-exit contract: once the run's one job
+completes, workers get SHUTDOWN on their next poll instead of idling for
+jobs that will never come.
 
 Failure classification mirrors resilience/policy.py: torn/short/corrupt
 frames are TRANSIENT (the connection is dropped; reconnect + lease replay
@@ -33,69 +31,22 @@ then the epoch fails loudly like the in-process reader would).
 """
 from __future__ import annotations
 
-import os
-import signal
-import socket
-import subprocess
-import sys
-import threading
-import time
-from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
-from .. import obs
-from ..resilience import chaos
-from . import transport
-from .worker import IngestWorker, extract_shard
+# Re-exported for backward compatibility: IngestError was born here and
+# callers (tests, runner) import it from this module.
+from .service import _MAX_AUTO_SHARDS, IngestError, IngestService  # noqa: F401
 
-#: shard-count auto rule: enough shards that one straggler does not halve
-#: the fleet's utilization, never more than the file count
-_MAX_AUTO_SHARDS = 8
-
-
-class IngestError(RuntimeError):
-    """A shard failed extraction on two independent holders — the data (or
-    the source spec) is bad, and the epoch fails the way the in-process
-    reader would."""
-
-
-@dataclass
-class _Lease:
-    shard: int
-    lease_id: int
-    worker_id: str
-    deadline: float
-    #: the _Worker CONNECTION the lease was granted over — revocation on
-    #: disconnect matches on this object, never on worker_id: a worker that
-    #: reconnects (same id, new connection) and takes a fresh lease before
-    #: its old handler finished cleaning up must not have the NEW lease
-    #: revoked along with the old one
-    owner: object = None
-
-
-@dataclass
-class _Worker:
-    worker_id: str
-    pid: int
-    sock: socket.socket
-    live: bool = True
-
-
-@dataclass
-class _ShardState:
-    files: list = field(default_factory=list)   # [(file_index, name), ...]
-    granted: int = 0                            # lease grants so far
-    errors: int = 0                             # worker-reported failures
-    pending_since: Optional[float] = None
+_JOB = "run"
 
 
 class IngestCoordinator:
-    """See the module docstring for the architecture. Sizing note:
-    `lease_timeout_s` must exceed the worst single-file read OR parse time —
-    workers heartbeat between files and between the read and parse phases,
-    and every BATCH frame refreshes the lease, but one monolithic phase has
-    no beat inside it. Too-small a timeout costs duplicate extraction churn
-    (dedupe keeps the output correct), never correctness."""
+    """See the module docstring. Sizing note: `lease_timeout_s` must exceed
+    the worst single-file read OR parse time — workers heartbeat between
+    files and between the read and parse phases, and every BATCH frame
+    refreshes the lease, but one monolithic phase has no beat inside it.
+    Too-small a timeout costs duplicate extraction churn (dedupe keeps the
+    output correct), never correctness."""
 
     def __init__(self, source, *, n_shards: Optional[int] = None,
                  plan_fp: Optional[str] = None,
@@ -106,150 +57,63 @@ class IngestCoordinator:
                  max_buffered_batches: int = 64,
                  poll_s: float = 0.25,
                  registry=None):
-        self.source = source
-        self.plan_fp = plan_fp or "unfingerprintable"
-        self.cache_dir = cache_dir
-        self.lease_timeout_s = float(lease_timeout_s)
-        self.self_extract_after_s = float(self_extract_after_s)
-        self.max_buffered = int(max_buffered_batches)
-        self.poll_s = float(poll_s)
-        self._host, self._port = host, int(port)
-        self._reg = registry if registry is not None else obs.default_registry()
+        self._svc = IngestService(
+            host=host, port=port, cache_dir=cache_dir,
+            lease_timeout_s=lease_timeout_s,
+            self_extract_after_s=self_extract_after_s,
+            poll_s=poll_s, max_buffered_batches=max_buffered_batches,
+            single_epoch=True, registry=registry)
+        self._job = self._svc.register_local_job(
+            _JOB, source, plan_fp=plan_fp, n_shards=n_shards,
+            max_buffered=max_buffered_batches)
 
-        #: frozen once per epoch: the file listing every lease derives from
-        self.files: list[str] = source.list_files()
-        n = len(self.files)
-        self.n_shards = int(n_shards) if n_shards else max(
-            1, min(_MAX_AUTO_SHARDS, n))
-        self._shards: dict[int, _ShardState] = {
-            s: _ShardState() for s in range(self.n_shards)}
-        for i, name in enumerate(self.files):
-            self._shards[i % self.n_shards].files.append((i, name))
+    # --- original attribute surface ---------------------------------------------------
+    @property
+    def source(self):
+        return self._job.source
 
-        # --- shared state (everything below under _cond) ---
-        self._cond = threading.Condition()
-        self._pending: list[int] = list(range(self.n_shards))
-        now = time.monotonic()
-        for st in self._shards.values():
-            st.pending_since = now
-        self._leases: dict[int, _Lease] = {}
-        self._next_lease_id = 0
-        self._shards_done: set[int] = set()
-        self._workers: dict[str, _Worker] = {}
-        self._file_chunks: dict[int, int] = {}
-        self._buffer: dict[tuple[int, int], list] = {}
-        self._committed: set[tuple[int, int]] = set()
-        self._emit_file = 0
-        self._emit_chunk = 0
-        self._error: Optional[BaseException] = None
-        self._closed = False
-        self._stop_requested = False
-        self._self_extracting: set[int] = set()
+    @property
+    def plan_fp(self) -> str:
+        return self._job.plan_fp
 
-        self._server: Optional[socket.socket] = None
-        self._threads: list[threading.Thread] = []
-        self._conns: list[socket.socket] = []
-        self._procs: list[subprocess.Popen] = []
-        self._local_workers: list[IngestWorker] = []
+    @property
+    def files(self) -> list:
+        return self._job.files
 
-    # --- metrics ----------------------------------------------------------------------
-    def _counter(self, name: str, help: str, **labels):
-        return self._reg.counter(name, help=help, labels=labels or None)
+    @property
+    def n_shards(self) -> int:
+        return self._job.n_shards
+
+    @property
+    def cache_dir(self):
+        return self._svc.cache_dir
+
+    @property
+    def service(self) -> IngestService:
+        """The embedded service (escape hatch for multi-job composition)."""
+        return self._svc
 
     # --- lifecycle --------------------------------------------------------------------
     def start(self) -> "IngestCoordinator":
-        if self._server is not None:
-            return self
-        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((self._host, self._port))
-        srv.listen(32)
-        self._server = srv
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="ingest-accept")
-        t.start()
-        self._threads.append(t)
+        self._svc.start()
         return self
 
     @property
-    def address(self) -> tuple[str, int]:
-        if self._server is None:
-            raise RuntimeError("coordinator not started")
-        return self._server.getsockname()
+    def address(self) -> tuple:
+        return self._svc.address
 
     def spawn_workers(self, n: int, cache_dir: Optional[str] = None) -> list:
-        """Launch n extraction worker SUBPROCESSES against this coordinator
-        (the production shape; `launch_local_workers` is the in-process twin
-        for tests). Returns the Popen handles; close() reaps them."""
-        host, port = self.address
-        cache = cache_dir if cache_dir is not None else self.cache_dir
-        for i in range(int(n)):
-            # spawned through the documented CLI surface (`op ingest-worker`)
-            # rather than runpy on the module, so the worker package is
-            # imported exactly once in the child
-            cmd = [sys.executable, "-m", "transmogrifai_tpu.cli.main",
-                   "ingest-worker", "--connect", f"{host}:{port}",
-                   "--worker-id", f"sub-{os.getpid()}-{i}"]
-            if cache:
-                cmd += ["--cache-dir", cache]
-            self._procs.append(subprocess.Popen(cmd, env=dict(os.environ)))
-        return list(self._procs)
+        return self._svc.spawn_workers(n, cache_dir)
 
     def launch_local_workers(self, n: int,
                              cache_dir: Optional[str] = None) -> list:
-        """n worker THREADS over real localhost sockets — the same protocol
-        path as subprocesses, minus the process boundary (unit tests)."""
-        host, port = self.address
-        cache = cache_dir if cache_dir is not None else self.cache_dir
-        out = []
-        for i in range(int(n)):
-            w = IngestWorker((host, port), worker_id=f"thr-{i}",
-                             cache_dir=cache)
-            t = threading.Thread(target=w.run, daemon=True,
-                                 name=f"ingest-worker-{i}")
-            t.start()
-            self._threads.append(t)
-            self._local_workers.append(w)
-            out.append(w)
-        return out
+        return self._svc.launch_local_workers(n, cache_dir)
 
     def request_stop(self) -> None:
-        """Early-exit hook (`LiveSource.on_pipeline_close`): unblock
-        `stream()` promptly; workers are told SHUTDOWN on their next poll."""
-        with self._cond:
-            self._stop_requested = True
-            self._cond.notify_all()
+        self._svc.request_stop()
 
     def close(self) -> None:
-        with self._cond:
-            if self._closed:
-                return
-            self._closed = True
-            self._cond.notify_all()
-        for w in self._local_workers:
-            w.stop()
-        if self._server is not None:
-            try:
-                self._server.close()
-            except OSError:
-                pass
-        for c in list(self._conns):
-            try:
-                c.close()
-            except OSError:
-                pass
-        for p in self._procs:
-            if p.poll() is None:
-                p.terminate()
-        deadline = time.monotonic() + 5.0
-        for p in self._procs:
-            try:
-                p.wait(timeout=max(0.1, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait(timeout=5.0)
-        for t in self._threads:
-            t.join(timeout=2.0)
+        self._svc.close()
 
     def __enter__(self) -> "IngestCoordinator":
         return self.start()
@@ -257,470 +121,13 @@ class IngestCoordinator:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    # --- worker-facing server side ----------------------------------------------------
-    def _accept_loop(self) -> None:
-        while True:
-            try:
-                conn, _ = self._server.accept()
-            except OSError:
-                return  # server socket closed: epoch over
-            self._conns.append(conn)
-            t = threading.Thread(target=self._handle, args=(conn,),
-                                 daemon=True, name="ingest-conn")
-            t.start()
-            self._threads.append(t)
-
-    def _handle(self, conn: socket.socket) -> None:
-        worker: Optional[_Worker] = None
-        try:
-            while True:
-                kind, payload = transport.recv_frame(conn)
-                if kind == transport.HELLO:
-                    worker = self._register(conn, payload)
-                elif kind == transport.REQUEST_WORK:
-                    self._grant_or_idle(conn, worker)
-                elif kind == transport.BATCH:
-                    self._on_batch(conn, worker, payload)
-                elif kind == transport.FILE_DONE:
-                    self._on_file_done(payload)
-                elif kind == transport.SHARD_DONE:
-                    self._on_shard_done(payload)
-                elif kind == transport.HEARTBEAT:
-                    self._refresh_lease(payload)
-                elif kind == transport.ERROR:
-                    self._on_worker_error(payload)
-                else:
-                    raise transport.FrameError(f"unknown frame kind {kind}")
-        except transport.FrameError as e:
-            if not getattr(e, "counted", False):
-                # transport-level corruption (CRC/short/garbage); chaos- and
-                # plan-classified frame errors were already counted by kind
-                self._counter("ingest_frame_errors_total",
-                              "torn/corrupt/protocol frames on ingest "
-                              "connections", kind="frame").inc()
-            obs.add_event("ingest:frame_error", error=str(e)[:200])
-            self._disconnect(conn, worker)
-        except (ConnectionError, OSError):
-            self._disconnect(conn, worker)
-
-    def _register(self, conn: socket.socket, payload: dict) -> _Worker:
-        w = _Worker(worker_id=str(payload.get("worker_id", "?")),
-                    pid=int(payload.get("pid", 0)), sock=conn)
-        with self._cond:
-            self._workers[w.worker_id] = w
-            n_live = sum(1 for x in self._workers.values() if x.live)
-        self._reg.gauge("ingest_workers",
-                        help="extraction workers currently connected"
-                        ).set(n_live)
-        obs.add_event("ingest:worker_join", worker=w.worker_id, pid=w.pid)
-        return w
-
-    def _disconnect(self, conn: socket.socket, worker: Optional[_Worker]
-                    ) -> None:
-        try:
-            conn.close()
-        except OSError:
-            pass
-        with self._cond:
-            if worker is not None:
-                worker.live = False
-                # pop the registry entry only if it is still OURS — a
-                # reconnected incarnation under the same id must survive
-                # the old handler's cleanup
-                if self._workers.get(worker.worker_id) is worker:
-                    self._workers.pop(worker.worker_id, None)
-                self._revoke_worker_leases(worker)
-            n_live = sum(1 for x in self._workers.values() if x.live)
-            self._cond.notify_all()
-        self._reg.gauge("ingest_workers",
-                        help="extraction workers currently connected"
-                        ).set(n_live)
-
-    # --- leases -----------------------------------------------------------------------
-    def _revoke_worker_leases(self, worker: _Worker) -> None:
-        """Under _cond. Requeue every shard granted over the dead CONNECTION
-        (object identity, not worker_id — see _Lease.owner), at the FRONT:
-        the recovered shard is usually the one blocking emission."""
-        for shard, lease in list(self._leases.items()):
-            if lease.owner is worker:
-                del self._leases[shard]
-                self._requeue(shard)
-
-    def _requeue(self, shard: int) -> None:
-        if (shard not in self._shards_done and shard not in self._pending
-                and shard not in self._self_extracting):
-            self._pending.insert(0, shard)
-            self._shards[shard].pending_since = time.monotonic()
-            self._cond.notify_all()
-
-    def _expire_leases(self) -> None:
-        """Under _cond: heartbeat expiry for wedged-but-connected holders
-        (a DEAD holder is caught faster, by its connection EOF)."""
-        now = time.monotonic()
-        for shard, lease in list(self._leases.items()):
-            if now > lease.deadline:
-                del self._leases[shard]
-                self._counter("ingest_lease_expired_total",
-                              "leases revoked on heartbeat expiry "
-                              "(wedged holder)").inc()
-                obs.add_event("ingest:lease_expired", shard=shard,
-                              worker=lease.worker_id)
-                self._requeue(shard)
-
-    def _refresh_lease(self, payload: dict) -> None:
-        with self._cond:
-            lease = self._leases.get(int(payload.get("shard", -1)))
-            if lease is not None and lease.lease_id == int(
-                    payload.get("lease", -1)):
-                lease.deadline = time.monotonic() + self.lease_timeout_s
-
-    def _lease_payload(self, shard: int, lease_id: int) -> dict:
-        """Under _cond: the full replayable work description for a shard —
-        file list plus everything already committed, so a replacement
-        holder re-reads only what is actually missing."""
-        st = self._shards[shard]
-        files_done = {}
-        committed: dict[int, list[int]] = {}
-        for fi, _name in st.files:
-            nc = self._file_chunks.get(fi)
-            done = sorted(c for (f, c) in self._committed if f == fi)
-            if nc is not None and len(done) >= nc:
-                files_done[fi] = nc
-            elif done:
-                committed[fi] = done
-        return {"shard": shard, "n_shards": self.n_shards, "lease": lease_id,
-                "plan": self.plan_fp, "source": self.source.to_wire(),
-                "files": st.files, "files_done": files_done,
-                "committed": committed}
-
-    def _grant_or_idle(self, conn: socket.socket, worker: Optional[_Worker]
-                       ) -> None:
-        with self._cond:
-            self._expire_leases()
-            if self._closed or self._stop_requested or self._epoch_done():
-                reply = (transport.SHUTDOWN, {})
-            elif self._pending:
-                shard = self._pending.pop(0)
-                self._next_lease_id += 1
-                lease_id = self._next_lease_id
-                st = self._shards[shard]
-                if st.granted > 0:
-                    self._counter(
-                        "ingest_lease_reassigned_total",
-                        "shard leases granted after a previous holder "
-                        "died, disconnected, or went quiet").inc()
-                    obs.add_event("ingest:lease_reassigned", shard=shard,
-                                  worker=worker.worker_id if worker else "?")
-                st.granted += 1
-                st.pending_since = None
-                self._leases[shard] = _Lease(
-                    shard=shard, lease_id=lease_id,
-                    worker_id=worker.worker_id if worker else "?",
-                    deadline=time.monotonic() + self.lease_timeout_s,
-                    owner=worker)
-                reply = (transport.LEASE,
-                         self._lease_payload(shard, lease_id))
-            else:
-                reply = (transport.IDLE, {"poll_s": self.poll_s})
-        transport.send_frame(conn, *reply)
-
-    # --- data plane -------------------------------------------------------------------
-    def _check_plan(self, payload: dict, what: str) -> None:
-        """Every STATE-WRITING frame (BATCH, FILE_DONE, SHARD_DONE) must
-        carry this epoch's plan fingerprint: a stale worker from a previous
-        run (same coordinator port reused) must not commit rows, write chunk
-        counts emission trusts, or mark shards done it never extracted."""
-        if payload.get("plan") != self.plan_fp:
-            self._counter("ingest_frame_errors_total",
-                          "torn/corrupt/protocol frames on ingest "
-                          "connections", kind="plan").inc()
-            err = transport.FrameError(
-                f"plan fingerprint mismatch on {what}")
-            err.counted = True
-            raise err
-
-    def _on_batch(self, conn: socket.socket, worker: Optional[_Worker],
-                  payload: dict) -> None:
-        shard = int(payload["shard"])
-        seq = int(payload["seq"])
-        self._check_plan(payload, f"BATCH shard {shard} seq {seq}")
-        fault = chaos.maybe_ingest_fault(shard, seq)
-        if fault == "torn":
-            self._counter("ingest_frame_errors_total",
-                          "torn/corrupt/protocol frames on ingest "
-                          "connections", kind="torn").inc()
-            err = transport.FrameError(
-                f"chaos: torn frame (shard {shard} seq {seq})")
-            err.counted = True
-            raise err
-        if fault == "drop":
-            raise ConnectionError(
-                f"chaos: connection severed (shard {shard} seq {seq})")
-        self._commit(int(payload["file"]), int(payload["chunk"]),
-                     payload["rows"], shard=shard)
-        if fault == "kill":
-            self._kill_worker(worker, conn)
-
-    def _commit(self, file_index: int, chunk: int, rows: list, *,
-                shard: Optional[int] = None) -> None:
-        key = (file_index, chunk)
-        with self._cond:
-            if shard is not None:
-                lease = self._leases.get(shard)
-                if lease is not None:
-                    lease.deadline = time.monotonic() + self.lease_timeout_s
-            if key in self._committed:
-                self._counter("ingest_duplicate_batches_total",
-                              "replayed batches dropped by ordinal dedupe "
-                              "(exactly-once enforcement)").inc()
-                return
-            # bounded reorder buffer: far-ahead batches wait for the
-            # consumer; the NEXT-NEEDED batch is always admitted, so this
-            # backpressure can never deadlock emission
-            while (len(self._buffer) >= self.max_buffered
-                   and key != (self._emit_file, self._emit_chunk)
-                   and not (self._closed or self._stop_requested
-                            or self._error)):
-                self._cond.wait(0.2)
-                if shard is not None:
-                    # a holder parked in backpressure is healthy, not
-                    # wedged: keep its lease fresh for the whole wait, not
-                    # just the deadline stamped at entry
-                    lease = self._leases.get(shard)
-                    if lease is not None:
-                        lease.deadline = (time.monotonic()
-                                          + self.lease_timeout_s)
-            if self._closed or self._stop_requested:
-                return
-            self._committed.add(key)
-            self._buffer[key] = rows
-            self._cond.notify_all()
-        self._counter("ingest_batches_total",
-                      "batches committed from extraction workers").inc()
-        self._counter("ingest_rows_total",
-                      "rows committed from extraction workers"
-                      ).inc(len(rows))
-
-    def _on_file_done(self, payload: dict) -> None:
-        self._check_plan(payload, f"FILE_DONE file {payload.get('file')}")
-        with self._cond:
-            self._file_chunks[int(payload["file"])] = int(payload["chunks"])
-            self._cond.notify_all()
-        outcome = payload.get("cache")
-        if outcome in ("hit", "miss"):
-            name = ("ingest_cache_hits_total" if outcome == "hit"
-                    else "ingest_cache_misses_total")
-            self._counter(name, "materialized-feature cache outcomes (one "
-                                "lookup per extracted file)").inc()
-
-    def _on_shard_done(self, payload: dict) -> None:
-        self._check_plan(payload, f"SHARD_DONE shard {payload.get('shard')}")
-        shard = int(payload["shard"])
-        stats = payload.get("stats") or {}
-        with self._cond:
-            lease = self._leases.get(shard)
-            if lease is not None and lease.lease_id == int(
-                    payload.get("lease", -1)):
-                del self._leases[shard]
-            self._shards_done.add(shard)
-            self._cond.notify_all()
-        obs.add_event("ingest:shard_done", shard=shard,
-                      rows=int(stats.get("rows", 0)),
-                      cache_hits=int(stats.get("cache_hits", 0)))
-
-    def _on_worker_error(self, payload: dict) -> None:
-        self._check_plan(payload, f"ERROR shard {payload.get('shard')}")
-        shard = int(payload["shard"])
-        msg = (f"shard {shard} extraction failed on worker: "
-               f"{payload.get('type')}: {payload.get('message')}")
-        self._counter("ingest_shard_errors_total",
-                      "worker-reported extraction failures").inc()
-        with self._cond:
-            lease = self._leases.get(shard)
-            if lease is not None and lease.lease_id == int(
-                    payload.get("lease", -1)):
-                del self._leases[shard]
-            st = self._shards[shard]
-            st.errors += 1
-            if st.errors >= 2:
-                # two independent holders failed: the data is bad, fail the
-                # epoch the way the in-process reader would
-                self._error = IngestError(msg)
-            else:
-                self._requeue(shard)
-            self._cond.notify_all()
-
-    def _kill_worker(self, worker: Optional[_Worker],
-                     conn: socket.socket) -> None:
-        """Chaos `worker:kill`: SIGKILL the frame's sender (subprocess
-        workers; a thread worker cannot be SIGKILLed, so only its connection
-        dies — the recovery path under test is identical). The connection is
-        ALWAYS severed at the kill ordinal, discarding any frames the dying
-        worker had already flushed into the socket buffer: the contract "the
-        holder died at batch N, everything after N is re-extracted under the
-        reassigned lease" stays deterministic instead of depending on how
-        much the kernel had buffered at SIGKILL time."""
-        if worker is not None and worker.pid and worker.pid != os.getpid():
-            try:
-                os.kill(worker.pid, signal.SIGKILL)
-            except (OSError, ProcessLookupError):
-                pass
-            else:
-                # wait for the death before severing/requeueing: a victim
-                # that notices its dead socket in the ms before the signal
-                # lands could otherwise reconnect, grab the requeued lease,
-                # and orphan it again — recovery still works (a second
-                # reassignment), but the event/counter schedule under test
-                # must be deterministic
-                for p in self._procs:
-                    if p.pid == worker.pid:
-                        try:
-                            p.wait(timeout=10.0)
-                        except subprocess.TimeoutExpired:
-                            pass
-                        break
-                else:
-                    deadline = time.monotonic() + 10.0
-                    while time.monotonic() < deadline:
-                        try:
-                            os.kill(worker.pid, 0)
-                        except ProcessLookupError:
-                            break
-                        time.sleep(0.01)
-        raise ConnectionError("chaos: worker killed at its lease's ordinal; "
-                              "connection severed")
-
     # --- consumer side ----------------------------------------------------------------
-    def _epoch_done(self) -> bool:
-        """Under _cond: every file's chunk count known and every chunk
-        committed (emission may still be draining the buffer)."""
-        if len(self._file_chunks) < len(self.files):
-            return False
-        return all(
-            (fi, c) in self._committed
-            for fi, nc in self._file_chunks.items() for c in range(nc))
-
-    def _next_ready(self):
-        """Under _cond: pop the next in-order batch if present; returns
-        (rows,) or None. Advances the emit cursor across completed files."""
-        while True:
-            if self._emit_file >= len(self.files):
-                return ()
-            nc = self._file_chunks.get(self._emit_file)
-            if nc is not None and self._emit_chunk >= nc:
-                self._emit_file += 1
-                self._emit_chunk = 0
-                continue
-            key = (self._emit_file, self._emit_chunk)
-            if key in self._buffer:
-                rows = self._buffer.pop(key)
-                self._emit_chunk += 1
-                self._cond.notify_all()
-                return (rows,)
-            return None
-
-    def _stalled_shard(self) -> Optional[int]:
-        """Under _cond: the shard owning the next-needed file, IF it has sat
-        pending past the fallback grace period — the signal that nobody is
-        coming for it and the coordinator should extract it inline."""
-        if self._emit_file >= len(self.files):
-            return None
-        shard = self._emit_file % self.n_shards
-        st = self._shards[shard]
-        if (shard in self._pending and st.pending_since is not None
-                and time.monotonic() - st.pending_since
-                >= self.self_extract_after_s):
-            return shard
-        return None
-
-    def _start_self_extract(self, shard: int) -> None:
-        """Kick off in-process fallback extraction of one shard on its OWN
-        thread — never the consumer's: the fallback obeys the same reorder-
-        buffer backpressure as any worker, so it needs the consumer free to
-        keep draining (running it inline would deadlock the pair)."""
-        with self._cond:
-            if shard not in self._pending:
-                return
-            self._pending.remove(shard)
-            self._self_extracting.add(shard)
-            self._shards[shard].granted += 1
-            lease = self._lease_payload(shard, lease_id=-1)
-        t = threading.Thread(target=self._self_extract, args=(shard, lease),
-                             daemon=True, name=f"ingest-fallback-{shard}")
-        t.start()
-        self._threads.append(t)
-
-    def _self_extract(self, shard: int, lease: dict) -> None:
-        """Fallback extraction body, through the SAME extract_shard code the
-        workers run — ordinals and payload bytes cannot diverge from a
-        worker's."""
-        self._counter("ingest_self_extracted_shards_total",
-                      "shards the coordinator extracted in-process after "
-                      "no worker claimed them within the grace period"
-                      ).inc()
-        obs.add_event("ingest:self_extract", shard=shard)
-        from ..ingest.cache import FeatureCache
-
-        cache = FeatureCache(self.cache_dir) if self.cache_dir else None
-        try:
-            stats = extract_shard(
-                self.source, lease,
-                lambda seq, fi, ci, rows: self._commit(fi, ci, rows),
-                lambda fi, nc, cache_outcome=None: self._on_file_done(
-                    {"file": fi, "chunks": nc, "plan": self.plan_fp,
-                     "cache": cache_outcome}),
-                cache=cache)
-            self._on_shard_done({"shard": shard, "lease": -1,
-                                 "plan": self.plan_fp, "stats": stats})
-        except Exception as e:  # noqa: BLE001 — epoch-fatal, like in-process
-            with self._cond:
-                self._error = e
-                self._cond.notify_all()
-        finally:
-            with self._cond:
-                self._self_extracting.discard(shard)
-
     def stream(self) -> Iterator[list]:
-        """Ordered, exactly-once batch stream for this epoch. Blocks for
-        late batches; runs lease expiry and the fallback-extraction check
-        from its wait loop (no dedicated reaper thread)."""
-        if self._server is None:
-            self.start()
-        while True:
-            fallback_shard = None
-            with self._cond:
-                while True:
-                    if self._error is not None:
-                        raise self._error
-                    if self._closed or self._stop_requested:
-                        return
-                    ready = self._next_ready()
-                    if ready == ():
-                        return  # every file fully emitted
-                    if ready is not None:
-                        rows = ready[0]
-                        break
-                    self._expire_leases()
-                    fallback_shard = self._stalled_shard()
-                    if fallback_shard is not None:
-                        break
-                    self._cond.wait(self.poll_s)
-            if fallback_shard is not None:
-                self._start_self_extract(fallback_shard)
-                continue
-            yield rows
+        return self._svc.stream_local(_JOB)
 
     # --- introspection ----------------------------------------------------------------
     def stats(self) -> dict:
-        with self._cond:
-            return {
-                "n_files": len(self.files),
-                "n_shards": self.n_shards,
-                "shards_done": len(self._shards_done),
-                "pending": list(self._pending),
-                "leases": {s: lease.worker_id
-                           for s, lease in self._leases.items()},
-                "workers": sorted(self._workers),
-                "committed": len(self._committed),
-                "buffered": len(self._buffer),
-            }
+        s = self._svc.job_stats(_JOB)
+        return {k: s[k] for k in ("n_files", "n_shards", "shards_done",
+                                  "pending", "leases", "workers",
+                                  "committed", "buffered")}

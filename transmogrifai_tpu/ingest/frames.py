@@ -1,0 +1,116 @@
+"""Columnar frame payloads: per-column contiguous buffers on the wire.
+
+The row-oriented BATCH payload (a JSON list of {field: value} dicts) pays
+per-ROW costs three times over: the field names are serialized once per row,
+the JSON parser allocates one dict per row, and every value is an individual
+heap object before the consumer even starts building Columns. Profiling the
+disaggregated path (ROADMAP "columnar zero-copy frame payloads") shows that
+per-row parse CPU — not the socket — is the bottleneck.
+
+A columnar frame ships the SAME batch as Arrow-style column buffers instead:
+for each field, one char-offset array (uint32, n+1 entries) plus one UTF-8
+data buffer holding every value of that column concatenated. Field names
+travel once in the frame metadata; `None` cells (short CSV rows) ride a
+sparse per-field null-index list. Encoding is `"".join` + one `encode()` per
+column; decoding is one `decode()` + C-level string slicing per column — no
+per-cell JSON tokenization anywhere.
+
+The codec is EXACT: `decode_columns(*encode_columns(rows))` reproduces the
+input rows with identical dict key order, identical `str` values (including
+empty strings and embedded newlines/commas), and `None` exactly where it
+was. Byte-identity of the downstream part files rests on this, and
+tests/test_ingest_service.py pins the round trip. Rows the codec cannot
+represent exactly (heterogeneous keys, non-string values) make
+`encode_columns` return None and the caller falls back to the legacy
+row-JSON payload — never a lossy encode.
+
+Consumers that build Columns directly can ask `decode_columns(...,
+mode="columns")` for `(fields, [values...])` and skip the row-dict
+materialization entirely.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+
+def encode_columns(rows: list) -> Optional[tuple[dict, list[bytes]]]:
+    """Encode a batch of {str: str|None} rows as (meta, buffers) — one
+    offsets buffer + one data buffer per field, in field order. Returns None
+    when the batch is not exactly representable (the caller then sends the
+    legacy row payload)."""
+    if not isinstance(rows, list):
+        return None
+    if not rows:
+        return {"fields": [], "n": 0, "nulls": {}}, []
+    first = rows[0]
+    if not isinstance(first, dict):
+        return None
+    fields = list(first.keys())
+    n = len(rows)
+    for r in rows:
+        if not isinstance(r, dict) or list(r.keys()) != fields:
+            return None
+    meta_nulls: dict[str, list[int]] = {}
+    buffers: list[bytes] = []
+    for ci, f in enumerate(fields):
+        offsets = [0]
+        parts = []
+        nulls = []
+        total = 0
+        for ri, r in enumerate(rows):
+            v = r[f]
+            if v is None:
+                nulls.append(ri)
+            elif isinstance(v, str):
+                parts.append(v)
+                total += len(v)
+            else:
+                return None
+            offsets.append(total)
+        if nulls:
+            meta_nulls[str(ci)] = nulls
+        buffers.append(struct.pack(f"<{n + 1}I", *offsets))
+        buffers.append("".join(parts).encode("utf-8"))
+    return {"fields": fields, "n": n, "nulls": meta_nulls}, buffers
+
+
+def decode_columns(meta: dict, buffers: list, mode: str = "rows"):
+    """Rebuild the batch from (meta, buffers). mode="rows" returns the exact
+    list of row dicts; mode="columns" returns (fields, [per-field value
+    lists]) for consumers that go straight to Columns."""
+    fields = meta["fields"]
+    n = int(meta["n"])
+    nulls = {int(k): frozenset(v) for k, v in (meta.get("nulls") or {}).items()}
+    cols: list[list] = []
+    for ci in range(len(fields)):
+        off_buf = bytes(buffers[2 * ci])
+        data = bytes(buffers[2 * ci + 1]).decode("utf-8")
+        offsets = struct.unpack(f"<{n + 1}I", off_buf)
+        null_rows = nulls.get(ci)
+        if null_rows:
+            vals = [None if ri in null_rows else data[offsets[ri]:offsets[ri + 1]]
+                    for ri in range(n)]
+        else:
+            vals = [data[offsets[ri]:offsets[ri + 1]] for ri in range(n)]
+        cols.append(vals)
+    if mode == "columns":
+        return fields, cols
+    if not fields:
+        return [{} for _ in range(n)]
+    return [dict(zip(fields, vals)) for vals in zip(*cols)]
+
+
+def payload_rows(payload) -> list:
+    """Rows of a stored batch payload — either legacy rows (a list) or an
+    encoded columnar pair (meta, buffers)."""
+    if isinstance(payload, list):
+        return payload
+    meta, buffers = payload
+    return decode_columns(meta, buffers)
+
+
+def payload_nrows(payload) -> int:
+    if isinstance(payload, list):
+        return len(payload)
+    return int(payload[0]["n"])

@@ -1,0 +1,1497 @@
+"""Multi-tenant ingest service: one worker fleet, many consumer jobs.
+
+PR 9's `IngestCoordinator` bound the lease/replay machinery to exactly one
+consumer and one epoch — the coordinator died with the run. This module
+promotes it to a shared SERVICE in the tf.data-service shape (arXiv
+2210.14826): one long-lived lease table and worker fleet serving MANY
+concurrent consumer jobs (grid-search folds, simultaneous `op run`s, the
+serving daemon's monitor), each job with its own frozen file listing,
+reorder/dedupe frontier, and delivery connection. The checkpointed service
+state — not connection liveness — is the source of truth (the TensorFlow
+fault-model position, arXiv 1605.08695 §4.2).
+
+Robustness contract, in order of importance:
+
+* **Coordinator checkpoint/restart.** The lease table and every job's
+  committed frontier checkpoint atomically (temp + `os.replace`, the model-
+  save discipline) on a short cadence. A SIGKILL'd service restarts from the
+  checkpoint, re-adopts reconnecting workers (they retry HELLO under seeded
+  backoff) and consumers (idempotent JOB_OPEN attaches to the restored job),
+  and resumes every job from its acked frontier. The consumer client dedupes
+  by `(file, chunk)` ordinal, so a stale checkpoint only costs re-delivery,
+  never correctness: output stays byte-identical with zero consumer-visible
+  errors. `ingest_coordinator_restarts_total` counts non-clean restores.
+* **Consumer isolation.** Each job has a bounded delivery buffer. LOCAL
+  (in-process) jobs keep the blocking backpressure of the single-job
+  coordinator — a slow consumer slows its own workers. REMOTE jobs must
+  never block a SHARED worker thread, so a full buffer SHEDS far-ahead
+  batches (`ingest_backpressure_shed_total`) instead; the gap is repaired by
+  the SHARD_DONE completeness check, which requeues the shard until every
+  chunk is really committed. A crashed consumer's job is parked (its shards
+  stop granting) and touches nothing belonging to other jobs.
+* **Autoscaling with graceful degradation.** The housekeeping loop watches
+  queue-wait (how long the oldest grantable shard has sat pending) and
+  spawns workers up to `AutoscaleConfig.max_workers`; a sustained-idle fleet
+  retires workers down to `min_workers` (SHUTDOWN on their next poll). If
+  the fleet is gone entirely, the per-job stalled-shard fallback extracts
+  in-process — a job can always finish as a slow version of the in-process
+  reader path.
+
+Chaos: `coord:kill` (FaultInjector.coord_kills, keyed `(epoch, seq)` like
+`worker:kill`) crashes the service at a deterministic batch ordinal —
+`kill_mode="process"` is a real SIGKILL for `op ingest-serve`, the
+in-process mode is an abrupt teardown that skips the clean checkpoint, so
+tests drive the same restore path without a subprocess.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from .. import obs
+from ..resilience import chaos
+from . import transport
+from .frames import payload_nrows, payload_rows
+from .source import source_from_wire
+from .worker import IngestWorker, extract_shard
+
+#: shard-count auto rule: enough shards that one straggler does not halve
+#: the fleet's utilization, never more than the file count
+_MAX_AUTO_SHARDS = 8
+
+_STATE_FILE = "ingest_state.json"
+
+
+def _sever(sock: socket.socket) -> None:
+    """Hard-sever a connection: shutdown(SHUT_RDWR) BEFORE close. A bare
+    close() cannot interrupt another thread blocked in recv()/sendall() on
+    the same socket — the in-flight syscall pins the open file description,
+    so the fd leaks, no FIN is sent, and the PEER blocks forever too.
+    shutdown() tears the TCP stream down immediately regardless of who is
+    parked inside a syscall on it."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class IngestError(RuntimeError):
+    """A shard failed extraction on two independent holders — the data (or
+    the source spec) is bad, and the job fails the way the in-process
+    reader would."""
+
+
+@dataclass
+class AutoscaleConfig:
+    """Queue-wait-driven worker autoscaling knobs (housekeeping loop)."""
+
+    min_workers: int = 0
+    max_workers: int = 4
+    #: oldest grantable pending-shard age that triggers one spawn
+    scale_up_wait_s: float = 1.0
+    #: fleet-wide idle duration (no grantable pending work, no leases)
+    #: before one worker is retired
+    scale_down_idle_s: float = 5.0
+    #: minimum seconds between autoscale actions (spawn storms are worse
+    #: than a briefly-underscaled fleet)
+    cooldown_s: float = 2.0
+
+
+@dataclass
+class _Lease:
+    job_id: str
+    shard: int
+    lease_id: int
+    worker_id: str
+    deadline: float
+    #: the _Worker CONNECTION the lease was granted over — revocation on
+    #: disconnect matches on this object, never on worker_id: a worker that
+    #: reconnects (same id, new connection) and takes a fresh lease before
+    #: its old handler finished cleaning up must not have the NEW lease
+    #: revoked along with the old one
+    owner: object = None
+
+
+@dataclass
+class _Worker:
+    worker_id: str
+    pid: int
+    sock: socket.socket
+    live: bool = True
+    #: autoscale retire flag: answered with SHUTDOWN on the next poll
+    retire: bool = False
+
+
+@dataclass
+class _ShardState:
+    files: list = field(default_factory=list)   # [(file_index, name), ...]
+    granted: int = 0                            # lease grants so far
+    errors: int = 0                             # worker-reported failures
+    pending_since: Optional[float] = None
+
+
+class _Job:
+    """One consumer job: frozen file listing, per-job reorder/dedupe
+    frontier, bounded delivery buffer, and (for remote jobs) the consumer
+    connection + acked frontier the checkpoint persists."""
+
+    def __init__(self, job_id: str, source, *, plan_fp: str, n_shards: int,
+                 files: list, local: bool, max_buffered: int,
+                 epoch: int = 0):
+        self.job_id = job_id
+        self.epoch = int(epoch)
+        self.source = source
+        self.plan_fp = plan_fp
+        self.files = list(files)
+        self.n_shards = int(n_shards)
+        self.shards: dict[int, _ShardState] = {
+            s: _ShardState() for s in range(self.n_shards)}
+        for i, name in enumerate(self.files):
+            self.shards[i % self.n_shards].files.append((i, name))
+        self.file_chunks: dict[int, int] = {}
+        self.committed: set[tuple[int, int]] = set()
+        #: (file, chunk) -> payload; payload is a rows list (legacy BATCH /
+        #: self-extract) or a (meta, buffers) columnar pair (COLBATCH)
+        self.buffer: dict[tuple[int, int], object] = {}
+        self.shards_done: set[int] = set()
+        #: emission cursor: next (file, chunk) to hand to the consumer —
+        #: the local stream's read position, or the remote sender's cursor
+        self.emit: list[int] = [0, 0]
+        #: remote consumer's acked frontier: everything strictly below is
+        #: durable WITH THE CONSUMER — this is what the checkpoint persists
+        self.acked: list[int] = [0, 0]
+        self.error: Optional[BaseException] = None
+        self.error_sent = False
+        self.stop = False
+        self.local = bool(local)
+        self.conn: Optional[socket.socket] = None
+        #: bumped on every attach/detach so a superseded sender thread
+        #: notices and exits even if it holds the same conn object
+        self.conn_gen = 0
+        self.eof_sent = False
+        self.self_extracting: set[int] = set()
+        self.max_buffered = int(max_buffered)
+
+    @property
+    def paused(self) -> bool:
+        """A remote job with no consumer attached: its shards stop granting
+        (no point extracting into a shedding buffer for a dead consumer)."""
+        return (not self.local) and self.conn is None
+
+    def done(self) -> bool:
+        """Every file's chunk count known and every chunk committed
+        (delivery may still be draining the buffer)."""
+        if len(self.file_chunks) < len(self.files):
+            return False
+        return all(
+            (fi, c) in self.committed
+            for fi, nc in self.file_chunks.items() for c in range(nc))
+
+    def shard_complete(self, shard: int) -> bool:
+        """Every chunk of every file in `shard` committed (chunk counts
+        known) — the SHARD_DONE admission test that repairs shed gaps."""
+        for fi, _name in self.shards[shard].files:
+            nc = self.file_chunks.get(fi)
+            if nc is None:
+                return False
+            for c in range(nc):
+                if (fi, c) not in self.committed:
+                    return False
+        return True
+
+
+class IngestService:
+    """See the module docstring for the architecture. Sizing note:
+    `lease_timeout_s` must exceed the worst single-file read OR parse time —
+    workers heartbeat between files and between the read and parse phases,
+    and every BATCH frame refreshes the lease, but one monolithic phase has
+    no beat inside it. Too-small a timeout costs duplicate extraction churn
+    (dedupe keeps the output correct), never correctness."""
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
+                 state_dir: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 lease_timeout_s: float = 10.0,
+                 self_extract_after_s: float = 15.0,
+                 poll_s: float = 0.25,
+                 checkpoint_every_s: float = 0.25,
+                 max_buffered_batches: int = 64,
+                 inflight_window: int = 32,
+                 autoscale: Optional[AutoscaleConfig] = None,
+                 spawn_fn: Optional[Callable] = None,
+                 single_epoch: bool = False,
+                 kill_mode: str = "raise",
+                 registry=None):
+        self.cache_dir = cache_dir
+        self.state_dir = state_dir
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.self_extract_after_s = float(self_extract_after_s)
+        self.poll_s = float(poll_s)
+        self.checkpoint_every_s = float(checkpoint_every_s)
+        self.max_buffered = int(max_buffered_batches)
+        self.inflight_window = int(inflight_window)
+        self.autoscale = autoscale
+        #: injectable for tests; default spawns `op ingest-worker` subprocesses
+        self._spawn_fn = spawn_fn or (lambda svc, n: svc.spawn_workers(n))
+        #: single_epoch: the IngestCoordinator facade — workers are told
+        #: SHUTDOWN once every registered job is done (the `op run
+        #: --ingest-workers` worker-exit contract). A standalone service
+        #: keeps its fleet alive for future jobs instead.
+        self.single_epoch = bool(single_epoch)
+        #: "process" = real SIGKILL of this pid on coord:kill (ingest-serve);
+        #: anything else = abrupt in-process teardown (tests)
+        self.kill_mode = kill_mode
+        self._host, self._port = host, int(port)
+        self._reg = registry if registry is not None else obs.default_registry()
+
+        # --- shared state (everything below under _cond) ---
+        self._cond = threading.Condition()
+        self._jobs: dict[str, _Job] = {}
+        self._pending: list[tuple[str, int]] = []   # (job_id, shard)
+        self._leases: dict[tuple[str, int], _Lease] = {}
+        self._next_lease_id = 0
+        self._workers: dict[str, _Worker] = {}
+        self._closed = False
+        self._crashed = False
+        self._stop_requested = False
+
+        self._server: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._send_locks: dict[socket.socket, threading.Lock] = {}
+        self._procs: list[subprocess.Popen] = []
+        self._local_workers: list[IngestWorker] = []
+
+        self._restarts = 0
+        self._last_ckpt: Optional[float] = None
+        self._ckpt_lock = threading.Lock()
+        self._as_last = 0.0            # last autoscale action (monotonic)
+        self._as_idle_since: Optional[float] = None
+
+    # --- metrics ----------------------------------------------------------------------
+    def _counter(self, name: str, help: str, **labels):
+        return self._reg.counter(name, help=help, labels=labels or None)
+
+    def _worker_gauges(self, n_live: int) -> None:
+        for name in ("ingest_workers", "ingest_active_workers"):
+            self._reg.gauge(name, help="extraction workers currently "
+                                       "connected").set(n_live)
+
+    def _jobs_gauge(self) -> None:
+        self._reg.gauge("ingest_jobs_active",
+                        help="consumer jobs registered with the ingest "
+                             "service").set(len(self._jobs))
+
+    # --- lifecycle --------------------------------------------------------------------
+    def start(self) -> "IngestService":
+        if self._server is not None:
+            return self
+        self._restore()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._port))
+        srv.listen(64)
+        self._server = srv
+        for target, name in ((self._accept_loop, "ingest-accept"),
+                             (self._housekeeping, "ingest-housekeeping")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._server is None:
+            raise RuntimeError("coordinator not started")
+        return self._server.getsockname()
+
+    def register_local_job(self, job_id: str, source, *,
+                           plan_fp: Optional[str] = None,
+                           n_shards: Optional[int] = None,
+                           max_buffered: Optional[int] = None) -> _Job:
+        """Create an in-process job (the IngestCoordinator facade / embedded
+        use). Freezes the file listing now; consume via `stream_local`."""
+        files = source.list_files()
+        n = len(files)
+        shards = int(n_shards) if n_shards else max(
+            1, min(_MAX_AUTO_SHARDS, n))
+        job = _Job(job_id, source, plan_fp=plan_fp or "unfingerprintable",
+                   n_shards=shards, files=files, local=True,
+                   max_buffered=(max_buffered if max_buffered is not None
+                                 else self.max_buffered))
+        with self._cond:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already registered")
+            self._jobs[job_id] = job
+            now = time.monotonic()
+            for s in range(job.n_shards):
+                job.shards[s].pending_since = now
+                self._pending.append((job_id, s))
+            self._cond.notify_all()
+            self._jobs_gauge()
+        return job
+
+    def spawn_workers(self, n: int, cache_dir: Optional[str] = None) -> list:
+        """Launch n extraction worker SUBPROCESSES against this service
+        (the production shape; `launch_local_workers` is the in-process twin
+        for tests). Returns the Popen handles; close() reaps them."""
+        host, port = self.address
+        cache = cache_dir if cache_dir is not None else self.cache_dir
+        for i in range(int(n)):
+            # spawned through the documented CLI surface (`op ingest-worker`)
+            # rather than runpy on the module, so the worker package is
+            # imported exactly once in the child
+            cmd = [sys.executable, "-m", "transmogrifai_tpu.cli.main",
+                   "ingest-worker", "--connect", f"{host}:{port}",
+                   "--worker-id", f"sub-{os.getpid()}-{len(self._procs)}"]
+            if cache:
+                cmd += ["--cache-dir", cache]
+            self._procs.append(subprocess.Popen(cmd, env=dict(os.environ)))
+        return list(self._procs)
+
+    def launch_local_workers(self, n: int,
+                             cache_dir: Optional[str] = None) -> list:
+        """n worker THREADS over real localhost sockets — the same protocol
+        path as subprocesses, minus the process boundary (unit tests)."""
+        host, port = self.address
+        cache = cache_dir if cache_dir is not None else self.cache_dir
+        out = []
+        for i in range(int(n)):
+            w = IngestWorker((host, port),
+                             worker_id=f"thr-{len(self._local_workers)}",
+                             cache_dir=cache)
+            t = threading.Thread(target=w.run, daemon=True,
+                                 name=f"ingest-worker-{i}")
+            t.start()
+            self._threads.append(t)
+            self._local_workers.append(w)
+            out.append(w)
+        return out
+
+    def request_stop(self) -> None:
+        """Early-exit hook (`LiveSource.on_pipeline_close`): unblock local
+        streams promptly; workers are told SHUTDOWN on their next poll."""
+        with self._cond:
+            self._stop_requested = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if not self._crashed:
+            # the CLEAN checkpoint: a later restart on this state_dir resumes
+            # without counting a coordinator crash
+            self._checkpoint(clean=True)
+        for w in self._local_workers:
+            w.stop()
+        if self._server is not None:
+            _sever(self._server)
+        for c in list(self._conns):
+            _sever(c)
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 5.0
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=5.0)
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+
+    def __enter__(self) -> "IngestService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- chaos: coordinator death -----------------------------------------------------
+    def _crash(self):
+        """`coord:kill` landed: die the way SIGKILL dies — no clean
+        checkpoint, no drain, connections severed mid-stream. The on-disk
+        checkpoint stays whatever the cadence last wrote (clean=False), which
+        is exactly what the restarted service restores from."""
+        if self.kill_mode == "process":
+            os.kill(os.getpid(), signal.SIGKILL)
+        with self._cond:
+            if self._crashed:
+                raise ConnectionError("chaos: coordinator killed")
+            self._crashed = True
+            self._closed = True
+            self._cond.notify_all()
+        if self._server is not None:
+            _sever(self._server)
+        # shutdown-then-close so handler/sender threads parked in recv or
+        # sendall on these sockets wake NOW — SIGKILL kills those threads
+        # with the process, so an in-process crash must tear their streams
+        # down for the same observable effect (peers see EOF immediately).
+        # A connection accepted concurrently with this snapshot is severed
+        # by _accept_loop's post-append _closed check (we set _closed above,
+        # BEFORE taking the snapshot, so one of the two sides always wins).
+        for c in list(self._conns):
+            _sever(c)
+        # local worker threads and subprocess workers are NOT touched: they
+        # must survive the coordinator and re-adopt into its replacement
+        raise ConnectionError("chaos: coordinator killed")
+
+    # --- checkpoint / restore ---------------------------------------------------------
+    def _state_path(self) -> Optional[str]:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir, _STATE_FILE)
+
+    def _snapshot(self) -> dict:
+        """Under _cond: the atomic restart unit — lease table + per-job
+        acked frontiers + the frozen file listings the frontiers index."""
+        jobs = {}
+        for jid, job in self._jobs.items():
+            if job.local:
+                continue  # an in-process consumer dies with the process
+            jobs[jid] = {
+                "epoch": job.epoch,
+                "plan": job.plan_fp,
+                "source": job.source.to_wire(),
+                "n_shards": job.n_shards,
+                "files": job.files,
+                "file_chunks": {str(k): v
+                                for k, v in job.file_chunks.items()},
+                "acked": list(job.acked),
+                "shards": {str(s): {"granted": st.granted,
+                                    "errors": st.errors}
+                           for s, st in job.shards.items()},
+                "leases": {str(s): lease.worker_id
+                           for (j, s), lease in self._leases.items()
+                           if j == jid},
+            }
+        return {"version": 1, "restarts": self._restarts, "jobs": jobs}
+
+    def _checkpoint(self, clean: bool = False) -> None:
+        path = self._state_path()
+        if path is None:
+            return
+        with self._cond:
+            snap = self._snapshot()
+        snap["clean"] = bool(clean)
+        with self._ckpt_lock:
+            os.makedirs(self.state_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(snap, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        self._last_ckpt = time.monotonic()
+        self._reg.gauge("ingest_checkpoint_age_seconds",
+                        help="seconds since the service state last "
+                             "checkpointed").set(0.0)
+
+    def _restore(self) -> None:
+        path = self._state_path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return  # torn checkpoint from a crash mid-replace cannot happen
+            # (os.replace is atomic); an unreadable file means no state
+        self._restarts = int(data.get("restarts", 0))
+        if not data.get("clean", True):
+            self._restarts += 1
+            self._counter("ingest_coordinator_restarts_total",
+                          "ingest service restarts from a non-clean "
+                          "(crashed) checkpoint").inc()
+            obs.add_event("ingest:coordinator_restart",
+                          restarts=self._restarts)
+        for jid, jd in (data.get("jobs") or {}).items():
+            try:
+                source = source_from_wire(jd["source"])
+            except Exception:  # noqa: BLE001 — an unrestorable job is skipped,
+                continue       # its consumer re-registers with a fresh source
+            job = _Job(jid, source, plan_fp=jd.get("plan", "?"),
+                       n_shards=int(jd["n_shards"]), files=jd["files"],
+                       local=False, max_buffered=self.max_buffered,
+                       epoch=int(jd.get("epoch", 0)))
+            job.file_chunks = {int(k): int(v)
+                               for k, v in (jd.get("file_chunks") or
+                                            {}).items()}
+            af, ac = (list(jd.get("acked") or [0, 0]) + [0, 0])[:2]
+            # clamp the frontier to the contiguous prefix of known chunk
+            # counts: a file below the frontier with an unknown count cannot
+            # be reconstructed, so delivery restarts from it (the consumer
+            # client dedupes the overlap)
+            for f in range(int(af)):
+                if f not in job.file_chunks:
+                    af, ac = f, 0
+                    break
+            job.acked = [int(af), int(ac)]
+            job.emit = list(job.acked)
+            for f in range(int(af)):
+                for c in range(job.file_chunks[f]):
+                    job.committed.add((f, c))
+            for c in range(int(ac)):
+                job.committed.add((int(af), c))
+            for s, sd in (jd.get("shards") or {}).items():
+                st = job.shards.get(int(s))
+                if st is not None:
+                    st.granted = int(sd.get("granted", 0))
+                    st.errors = int(sd.get("errors", 0))
+            now = time.monotonic()
+            for s in range(job.n_shards):
+                if job.shard_complete(s):
+                    job.shards_done.add(s)
+                else:
+                    job.shards[s].pending_since = now
+                    self._pending.append((jid, s))
+            self._jobs[jid] = job  # paused (conn=None) until JOB_OPEN
+        self._jobs_gauge()
+
+    # --- worker-facing server side ----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return  # server socket closed: service over
+            self._conns.append(conn)
+            # check _closed AFTER the append: close()/_crash() set the flag
+            # before snapshotting _conns, so a racing connection is severed
+            # either there (appended before the snapshot) or here (appended
+            # after — then this read of _closed sees True). Without this a
+            # worker reconnecting in the crash window becomes a zombie
+            # served by a handler on a "dead" service.
+            if self._closed:
+                _sever(conn)
+                continue
+            self._send_locks[conn] = threading.Lock()
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="ingest-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _send(self, conn: socket.socket, kind: int, payload: dict,
+              buffers=None) -> None:
+        """All frame sends go through the per-connection lock: a consumer
+        connection is written by BOTH its handler thread (JOB_READY, stats)
+        and its sender thread, and interleaved frames are torn frames."""
+        lock = self._send_locks.get(conn)
+        if lock is None:
+            transport.send_frame(conn, kind, payload, buffers)
+            return
+        with lock:
+            transport.send_frame(conn, kind, payload, buffers)
+
+    def _handle(self, conn: socket.socket) -> None:
+        worker: Optional[_Worker] = None
+        consumer_job: Optional[_Job] = None
+        try:
+            while True:
+                kind, payload = transport.recv_frame(conn)
+                if kind == transport.HELLO:
+                    worker = self._register(conn, payload)
+                elif kind == transport.REQUEST_WORK:
+                    self._grant_or_idle(conn, worker)
+                elif kind in (transport.BATCH, transport.COLBATCH):
+                    self._on_batch(conn, worker, kind, payload)
+                elif kind == transport.FILE_DONE:
+                    self._on_file_done(payload)
+                elif kind == transport.SHARD_DONE:
+                    self._on_shard_done(payload)
+                elif kind == transport.HEARTBEAT:
+                    self._refresh_lease(payload)
+                elif kind == transport.ERROR:
+                    self._on_worker_error(payload)
+                elif kind == transport.JOB_OPEN:
+                    consumer_job = self._job_open(conn, payload)
+                elif kind == transport.JOB_ACK:
+                    self._on_ack(payload)
+                elif kind == transport.JOB_CLOSE:
+                    self._job_close(payload)
+                elif kind == transport.SVC_STATS:
+                    self._send(conn, transport.SVC_STATS,
+                               {"stats": self.service_stats()})
+                else:
+                    raise transport.FrameError(f"unknown frame kind {kind}")
+        except transport.FrameError as e:
+            if not getattr(e, "counted", False):
+                # transport-level corruption (CRC/short/garbage); chaos- and
+                # plan-classified frame errors were already counted by kind
+                self._counter("ingest_frame_errors_total",
+                              "torn/corrupt/protocol frames on ingest "
+                              "connections", kind="frame").inc()
+            obs.add_event("ingest:frame_error", error=str(e)[:200])
+            self._disconnect(conn, worker, consumer_job)
+        except (ConnectionError, OSError):
+            self._disconnect(conn, worker, consumer_job)
+
+    def _register(self, conn: socket.socket, payload: dict) -> _Worker:
+        w = _Worker(worker_id=str(payload.get("worker_id", "?")),
+                    pid=int(payload.get("pid", 0)), sock=conn)
+        with self._cond:
+            self._workers[w.worker_id] = w
+            n_live = sum(1 for x in self._workers.values() if x.live)
+        self._worker_gauges(n_live)
+        obs.add_event("ingest:worker_join", worker=w.worker_id, pid=w.pid)
+        return w
+
+    def _disconnect(self, conn: socket.socket, worker: Optional[_Worker],
+                    consumer_job: Optional[_Job] = None) -> None:
+        _sever(conn)
+        self._send_locks.pop(conn, None)
+        with self._cond:
+            if worker is not None:
+                worker.live = False
+                # pop the registry entry only if it is still OURS — a
+                # reconnected incarnation under the same id must survive
+                # the old handler's cleanup
+                if self._workers.get(worker.worker_id) is worker:
+                    self._workers.pop(worker.worker_id, None)
+                self._revoke_worker_leases(worker)
+            if consumer_job is not None and consumer_job.conn is conn:
+                # the consumer died or went away: park the job — leases in
+                # flight finish into the buffer, nothing new grants, and no
+                # other job notices (isolation)
+                consumer_job.conn = None
+                consumer_job.conn_gen += 1
+                obs.add_event("ingest:consumer_detach",
+                              job=consumer_job.job_id)
+            n_live = sum(1 for x in self._workers.values() if x.live)
+            self._cond.notify_all()
+        self._worker_gauges(n_live)
+
+    # --- job resolution ---------------------------------------------------------------
+    def _resolve_job(self, payload: dict) -> Optional[_Job]:
+        """Under _cond. Map a worker frame to its job. Frames without a
+        "job" field (the pre-service worker protocol, still spoken by raw
+        test harnesses) resolve to the sole registered job. Frames for a job
+        that no longer exists (consumer closed while the worker was still
+        extracting) are EXPECTED in a shared fleet and return None — the
+        caller drops them without killing the connection."""
+        jid = payload.get("job")
+        if jid is None:
+            if len(self._jobs) == 1:
+                return next(iter(self._jobs.values()))
+            raise transport.FrameError(
+                f"frame names no job and {len(self._jobs)} are registered")
+        job = self._jobs.get(str(jid))
+        if job is None:
+            self._counter("ingest_stale_job_frames_total",
+                          "worker frames for a job that was already "
+                          "unregistered (consumer closed mid-extraction)"
+                          ).inc()
+        return job
+
+    # --- leases -----------------------------------------------------------------------
+    def _revoke_worker_leases(self, worker: _Worker) -> None:
+        """Under _cond. Requeue every shard granted over the dead CONNECTION
+        (object identity, not worker_id — see _Lease.owner), at the FRONT:
+        the recovered shard is usually the one blocking emission."""
+        for key, lease in list(self._leases.items()):
+            if lease.owner is worker:
+                del self._leases[key]
+                job = self._jobs.get(key[0])
+                if job is not None:
+                    self._requeue(job, key[1])
+
+    def _requeue(self, job: _Job, shard: int, front: bool = True) -> None:
+        key = (job.job_id, shard)
+        if (shard not in job.shards_done and key not in self._pending
+                and shard not in job.self_extracting
+                and not job.stop and not self._closed):
+            if front:
+                self._pending.insert(0, key)
+            else:
+                self._pending.append(key)
+            job.shards[shard].pending_since = time.monotonic()
+            self._cond.notify_all()
+
+    def _expire_leases(self) -> None:
+        """Under _cond: heartbeat expiry for wedged-but-connected holders
+        (a DEAD holder is caught faster, by its connection EOF)."""
+        now = time.monotonic()
+        for key, lease in list(self._leases.items()):
+            if now > lease.deadline:
+                del self._leases[key]
+                self._counter("ingest_lease_expired_total",
+                              "leases revoked on heartbeat expiry "
+                              "(wedged holder)").inc()
+                obs.add_event("ingest:lease_expired", shard=lease.shard,
+                              worker=lease.worker_id)
+                job = self._jobs.get(key[0])
+                if job is not None:
+                    self._requeue(job, key[1])
+
+    def _refresh_lease(self, payload: dict) -> None:
+        with self._cond:
+            job = self._resolve_job(payload)
+            if job is None:
+                return
+            lease = self._leases.get((job.job_id,
+                                      int(payload.get("shard", -1))))
+            if lease is not None and lease.lease_id == int(
+                    payload.get("lease", -1)):
+                lease.deadline = time.monotonic() + self.lease_timeout_s
+
+    def _lease_payload(self, job: _Job, shard: int, lease_id: int) -> dict:
+        """Under _cond: the full replayable work description for a shard —
+        file list plus everything already committed, so a replacement
+        holder re-reads only what is actually missing."""
+        st = job.shards[shard]
+        files_done = {}
+        committed: dict[int, list[int]] = {}
+        for fi, _name in st.files:
+            nc = job.file_chunks.get(fi)
+            done = sorted(c for (f, c) in job.committed if f == fi)
+            if nc is not None and len(done) >= nc:
+                files_done[fi] = nc
+            elif done:
+                committed[fi] = done
+        return {"job": job.job_id, "shard": shard, "n_shards": job.n_shards,
+                "lease": lease_id, "plan": job.plan_fp,
+                "source": job.source.to_wire(),
+                "files": st.files, "files_done": files_done,
+                "committed": committed}
+
+    def _grantable(self, job: Optional[_Job]) -> bool:
+        return (job is not None and not job.paused and not job.stop
+                and job.error is None)
+
+    def _all_jobs_done(self) -> bool:
+        """Under _cond (single-epoch mode only): the facade's SHUTDOWN
+        condition — the run's one job finished its epoch."""
+        return all(j.done() for j in self._jobs.values())
+
+    def _grant_or_idle(self, conn: socket.socket, worker: Optional[_Worker]
+                       ) -> None:
+        with self._cond:
+            if self._crashed:
+                # a SIGKILL'd coordinator cannot send frames — an in-process
+                # crash must not either. Replying SHUTDOWN here would retire
+                # a worker that is supposed to survive the crash and
+                # re-adopt into the replacement service.
+                raise ConnectionError("chaos: coordinator crashed")
+            self._expire_leases()
+            granted = None
+            if (self._closed or self._stop_requested
+                    or (worker is not None and worker.retire)
+                    or (self.single_epoch and self._all_jobs_done())):
+                reply = (transport.SHUTDOWN, {})
+            else:
+                for i, (jid, shard) in enumerate(self._pending):
+                    job = self._jobs.get(jid)
+                    if not self._grantable(job):
+                        continue  # parked/failed jobs keep their queue slot
+                    del self._pending[i]
+                    self._next_lease_id += 1
+                    lease_id = self._next_lease_id
+                    st = job.shards[shard]
+                    if st.granted > 0:
+                        self._counter(
+                            "ingest_lease_reassigned_total",
+                            "shard leases granted after a previous holder "
+                            "died, disconnected, or went quiet").inc()
+                        obs.add_event(
+                            "ingest:lease_reassigned", shard=shard,
+                            worker=worker.worker_id if worker else "?")
+                    st.granted += 1
+                    if st.pending_since is not None:
+                        self._reg.histogram(
+                            "ingest_queue_wait_seconds",
+                            help="seconds a pending shard waited for a "
+                                 "holder (the autoscale signal)").observe(
+                            time.monotonic() - st.pending_since)
+                    st.pending_since = None
+                    self._leases[(jid, shard)] = _Lease(
+                        job_id=jid, shard=shard, lease_id=lease_id,
+                        worker_id=worker.worker_id if worker else "?",
+                        deadline=time.monotonic() + self.lease_timeout_s,
+                        owner=worker)
+                    granted = (transport.LEASE,
+                               self._lease_payload(job, shard, lease_id))
+                    break
+                reply = granted or (transport.IDLE, {"poll_s": self.poll_s})
+        self._send(conn, *reply)
+
+    # --- data plane -------------------------------------------------------------------
+    def _check_plan(self, job: _Job, payload: dict, what: str) -> None:
+        """Every STATE-WRITING frame (BATCH, FILE_DONE, SHARD_DONE) must
+        carry its job's plan fingerprint: a stale worker from a previous
+        run (same service port reused) must not commit rows, write chunk
+        counts emission trusts, or mark shards done it never extracted."""
+        if payload.get("plan") != job.plan_fp:
+            self._counter("ingest_frame_errors_total",
+                          "torn/corrupt/protocol frames on ingest "
+                          "connections", kind="plan").inc()
+            err = transport.FrameError(
+                f"plan fingerprint mismatch on {what}")
+            err.counted = True
+            raise err
+
+    def _on_batch(self, conn: socket.socket, worker: Optional[_Worker],
+                  kind: int, payload: dict) -> None:
+        shard = int(payload["shard"])
+        seq = int(payload["seq"])
+        with self._cond:
+            job = self._resolve_job(payload)
+        if job is None:
+            return  # stale-job frame: dropped, counted in _resolve_job
+        self._check_plan(job, payload, f"BATCH shard {shard} seq {seq}")
+        if chaos.maybe_coord_kill(job.epoch, seq):
+            self._crash()
+        fault = chaos.maybe_ingest_fault(shard, seq)
+        if fault == "torn":
+            self._counter("ingest_frame_errors_total",
+                          "torn/corrupt/protocol frames on ingest "
+                          "connections", kind="torn").inc()
+            err = transport.FrameError(
+                f"chaos: torn frame (shard {shard} seq {seq})")
+            err.counted = True
+            raise err
+        if fault == "drop":
+            raise ConnectionError(
+                f"chaos: connection severed (shard {shard} seq {seq})")
+        if kind == transport.COLBATCH:
+            # store the columnar payload AS buffers: decode happens on the
+            # delivery edge (local stream) or not at all (remote jobs relay
+            # the buffers verbatim to the consumer)
+            meta = {"fields": payload["fields"], "n": payload["n"],
+                    "nulls": payload.get("nulls") or {}}
+            data = (meta, [bytes(b) for b in payload["__buffers__"]])
+        else:
+            data = payload["rows"]
+        self._commit(job, int(payload["file"]), int(payload["chunk"]),
+                     data, shard=shard)
+        if fault == "kill":
+            self._kill_worker(worker, conn)
+
+    def _commit(self, job: _Job, file_index: int, chunk: int, data, *,
+                shard: Optional[int] = None) -> None:
+        key = (file_index, chunk)
+        with self._cond:
+            if shard is not None:
+                lease = self._leases.get((job.job_id, shard))
+                if lease is not None:
+                    lease.deadline = time.monotonic() + self.lease_timeout_s
+            if key in job.committed:
+                self._counter("ingest_duplicate_batches_total",
+                              "replayed batches dropped by ordinal dedupe "
+                              "(exactly-once enforcement)").inc()
+                return
+            if job.local:
+                # bounded reorder buffer: far-ahead batches wait for the
+                # consumer; the NEXT-NEEDED batch is always admitted, so
+                # this backpressure can never deadlock emission
+                while (len(job.buffer) >= job.max_buffered
+                       and key != tuple(job.emit)
+                       and not (self._closed or self._stop_requested
+                                or job.error or job.stop)):
+                    self._cond.wait(0.2)
+                    if shard is not None:
+                        # a holder parked in backpressure is healthy, not
+                        # wedged: keep its lease fresh for the whole wait,
+                        # not just the deadline stamped at entry
+                        lease = self._leases.get((job.job_id, shard))
+                        if lease is not None:
+                            lease.deadline = (time.monotonic()
+                                              + self.lease_timeout_s)
+                if self._closed or self._stop_requested or job.stop:
+                    return
+            elif (len(job.buffer) >= job.max_buffered
+                    and key != tuple(job.emit)):
+                # a REMOTE job must never block a SHARED worker thread:
+                # shed the far-ahead batch (NOT committed — the SHARD_DONE
+                # completeness check requeues the gap once there is room)
+                self._counter("ingest_backpressure_shed_total",
+                              "far-ahead batches shed by a full per-job "
+                              "buffer (slow or detached remote consumer)"
+                              ).inc()
+                return
+            job.committed.add(key)
+            job.buffer[key] = data
+            self._cond.notify_all()
+        self._counter("ingest_batches_total",
+                      "batches committed from extraction workers").inc()
+        self._counter("ingest_rows_total",
+                      "rows committed from extraction workers"
+                      ).inc(payload_nrows(data))
+
+    def _on_file_done(self, payload: dict) -> None:
+        with self._cond:
+            job = self._resolve_job(payload)
+        if job is None:
+            return
+        self._check_plan(job, payload,
+                         f"FILE_DONE file {payload.get('file')}")
+        with self._cond:
+            job.file_chunks[int(payload["file"])] = int(payload["chunks"])
+            self._cond.notify_all()
+        outcome = payload.get("cache")
+        if outcome in ("hit", "miss"):
+            name = ("ingest_cache_hits_total" if outcome == "hit"
+                    else "ingest_cache_misses_total")
+            self._counter(name, "materialized-feature cache outcomes (one "
+                                "lookup per extracted file)").inc()
+
+    def _on_shard_done(self, payload: dict) -> None:
+        with self._cond:
+            job = self._resolve_job(payload)
+        if job is None:
+            return
+        self._check_plan(job, payload,
+                         f"SHARD_DONE shard {payload.get('shard')}")
+        shard = int(payload["shard"])
+        stats = payload.get("stats") or {}
+        with self._cond:
+            lease = self._leases.get((job.job_id, shard))
+            if lease is not None and lease.lease_id == int(
+                    payload.get("lease", -1)):
+                del self._leases[(job.job_id, shard)]
+            if job.shard_complete(shard):
+                job.shards_done.add(shard)
+            else:
+                # the holder extracted everything but some of it was SHED
+                # (full remote buffer): the shard is NOT done — requeue at
+                # the back so replay fills the gaps once there is room
+                self._counter("ingest_shard_requeued_total",
+                              "shards requeued by the SHARD_DONE "
+                              "completeness check (shed gaps)").inc()
+                self._requeue(job, shard, front=False)
+            self._cond.notify_all()
+        obs.add_event("ingest:shard_done", shard=shard,
+                      rows=int(stats.get("rows", 0)),
+                      cache_hits=int(stats.get("cache_hits", 0)))
+
+    def _on_worker_error(self, payload: dict) -> None:
+        with self._cond:
+            job = self._resolve_job(payload)
+        if job is None:
+            return
+        self._check_plan(job, payload,
+                         f"ERROR shard {payload.get('shard')}")
+        shard = int(payload["shard"])
+        msg = (f"shard {shard} extraction failed on worker: "
+               f"{payload.get('type')}: {payload.get('message')}")
+        self._counter("ingest_shard_errors_total",
+                      "worker-reported extraction failures").inc()
+        with self._cond:
+            lease = self._leases.get((job.job_id, shard))
+            if lease is not None and lease.lease_id == int(
+                    payload.get("lease", -1)):
+                del self._leases[(job.job_id, shard)]
+            st = job.shards[shard]
+            st.errors += 1
+            if st.errors >= 2:
+                # two independent holders failed: the data is bad, fail the
+                # JOB the way the in-process reader would — other jobs are
+                # untouched
+                job.error = IngestError(msg)
+            else:
+                self._requeue(job, shard)
+            self._cond.notify_all()
+
+    def _kill_worker(self, worker: Optional[_Worker],
+                     conn: socket.socket) -> None:
+        """Chaos `worker:kill`: SIGKILL the frame's sender (subprocess
+        workers; a thread worker cannot be SIGKILLed, so only its connection
+        dies — the recovery path under test is identical). The connection is
+        ALWAYS severed at the kill ordinal, discarding any frames the dying
+        worker had already flushed into the socket buffer: the contract "the
+        holder died at batch N, everything after N is re-extracted under the
+        reassigned lease" stays deterministic instead of depending on how
+        much the kernel had buffered at SIGKILL time."""
+        if worker is not None and worker.pid and worker.pid != os.getpid():
+            try:
+                os.kill(worker.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+            else:
+                # wait for the death before severing/requeueing: a victim
+                # that notices its dead socket in the ms before the signal
+                # lands could otherwise reconnect, grab the requeued lease,
+                # and orphan it again — recovery still works (a second
+                # reassignment), but the event/counter schedule under test
+                # must be deterministic
+                for p in self._procs:
+                    if p.pid == worker.pid:
+                        try:
+                            p.wait(timeout=10.0)
+                        except subprocess.TimeoutExpired:
+                            pass
+                        break
+                else:
+                    deadline = time.monotonic() + 10.0
+                    while time.monotonic() < deadline:
+                        try:
+                            os.kill(worker.pid, 0)
+                        except ProcessLookupError:
+                            break
+                        time.sleep(0.01)
+        raise ConnectionError("chaos: worker killed at its lease's ordinal; "
+                              "connection severed")
+
+    # --- consumer side: remote jobs ---------------------------------------------------
+    def _job_open(self, conn: socket.socket, payload: dict) -> Optional[_Job]:
+        """Idempotent attach-or-create: a consumer's first JOB_OPEN creates
+        the job; a reconnecting (or post-restart) consumer's JOB_OPEN
+        attaches to the surviving state and delivery resumes from the
+        service's acked frontier (the client dedupes the overlap)."""
+        jid = str(payload.get("job", ""))
+        if not jid:
+            raise transport.FrameError("JOB_OPEN without a job id")
+        with self._cond:
+            job = self._jobs.get(jid)
+            resumed = job is not None
+            if job is None:
+                if "source" not in payload:
+                    self._send(conn, transport.JOB_ERROR,
+                               {"job": jid, "type": "KeyError",
+                                "message": "unknown job and no source spec "
+                                           "to create it from"})
+                    return None
+                source = source_from_wire(payload["source"])
+                files = source.list_files()
+                n_given = payload.get("n_shards")
+                n_shards = int(n_given) if n_given else max(
+                    1, min(_MAX_AUTO_SHARDS, len(files)))
+                job = _Job(jid, source,
+                           plan_fp=str(payload.get("plan",
+                                                   "unfingerprintable")),
+                           n_shards=n_shards, files=files, local=False,
+                           max_buffered=self.max_buffered,
+                           epoch=int(payload.get("epoch", 0)))
+                self._jobs[jid] = job
+                now = time.monotonic()
+                for s in range(job.n_shards):
+                    job.shards[s].pending_since = now
+                    self._pending.append((jid, s))
+                self._jobs_gauge()
+            else:
+                if job.local:
+                    self._send(conn, transport.JOB_ERROR,
+                               {"job": jid, "type": "ValueError",
+                                "message": "job is in-process (local)"})
+                    return None
+                old = job.conn
+                job.conn_gen += 1
+                if old is not None and old is not conn:
+                    _sever(old)  # kick a superseded consumer connection
+                # attach-reset: resume delivery from the acked frontier.
+                # Anything sent-but-unacked was popped from the buffer and
+                # may be lost with the old connection, so the committed set
+                # is REBUILT as {below frontier} + {still buffered}; chunks
+                # that fall out become gaps, and gap shards requeue.
+                job.emit = list(job.acked)
+                frontier = tuple(job.acked)
+                job.committed = ({k for k in job.committed if k < frontier}
+                                 | set(job.buffer))
+                job.eof_sent = False
+                job.error_sent = False
+                for s in list(job.shards_done):
+                    if not job.shard_complete(s):
+                        job.shards_done.discard(s)
+                for s in range(job.n_shards):
+                    if (s not in job.shards_done
+                            and (jid, s) not in self._pending
+                            and (jid, s) not in self._leases
+                            and s not in job.self_extracting):
+                        self._requeue(job, s, front=False)
+            job.conn = conn
+            gen = job.conn_gen
+            self._cond.notify_all()
+        obs.add_event("ingest:job_open", job=jid, resumed=resumed,
+                      epoch=job.epoch)
+        self._send(conn, transport.JOB_READY,
+                   {"job": jid, "resumed": resumed,
+                    "n_files": len(job.files), "epoch": job.epoch})
+        t = threading.Thread(target=self._sender, args=(conn, job, gen),
+                             daemon=True, name=f"ingest-send-{jid}")
+        t.start()
+        self._threads.append(t)
+        return job
+
+    def _on_ack(self, payload: dict) -> None:
+        with self._cond:
+            job = self._jobs.get(str(payload.get("job", "")))
+            if job is None:
+                return
+            cur = (int(payload.get("file", 0)), int(payload.get("chunk", 0)))
+            if cur > tuple(job.acked):
+                job.acked = list(cur)
+                self._cond.notify_all()
+
+    def _job_close(self, payload: dict) -> None:
+        jid = str(payload.get("job", ""))
+        with self._cond:
+            job = self._jobs.pop(jid, None)
+            if job is None:
+                return
+            job.stop = True
+            job.conn_gen += 1           # the sender thread exits
+            self._pending = [(j, s) for (j, s) in self._pending if j != jid]
+            for key in [k for k in self._leases if k[0] == jid]:
+                del self._leases[key]
+            self._cond.notify_all()
+            self._jobs_gauge()
+        obs.add_event("ingest:job_close", job=jid)
+        if self.state_dir:
+            self._checkpoint()
+
+    def _inflight(self, job: _Job) -> int:
+        """Under _cond: batches sent but not yet acked = chunk keys in
+        [acked, emit). Every intermediate file's chunk count is known (the
+        emit cursor only advances past a file once it is), so this is exact
+        — and it self-heals to 0 on attach-reset without a counter to
+        un-skew."""
+        (af, ac), (ef, ec) = tuple(job.acked), tuple(job.emit)
+        if (af, ac) >= (ef, ec):
+            return 0
+        if af == ef:
+            return ec - ac
+        n = job.file_chunks.get(af, ac) - ac
+        for f in range(af + 1, ef):
+            n += job.file_chunks.get(f, 0)
+        return n + ec
+
+    def _next_send(self, job: _Job):
+        """Under _cond: the sender state machine — the next frame to put on
+        the consumer connection, or None (wait). The inflight window is
+        checked BEFORE popping the buffer so a window-blocked batch is never
+        popped-and-parked."""
+        if job.error is not None:
+            if job.error_sent:
+                return None
+            job.error_sent = True
+            return ("error", type(job.error).__name__, str(job.error))
+        ef, ec = job.emit
+        while ef < len(job.files):
+            nc = job.file_chunks.get(ef)
+            if nc is not None and ec >= nc:
+                job.emit = [ef + 1, 0]
+                return ("file_end", ef, nc)
+            if self._inflight(job) >= self.inflight_window:
+                return None
+            key = (ef, ec)
+            if key in job.buffer:
+                data = job.buffer.pop(key)
+                job.emit = [ef, ec + 1]
+                self._cond.notify_all()  # buffer space for parked committers
+                return ("batch", ef, ec, data)
+            return None
+        if not job.eof_sent:
+            job.eof_sent = True
+            return ("eof",)
+        return None
+
+    def _sender(self, conn: socket.socket, job: _Job, gen: int) -> None:
+        """Per-attachment delivery thread: drains the job's reorder buffer
+        onto the consumer connection in exact (file, chunk) order, under the
+        ack-window flow control. Dies silently when superseded (conn_gen
+        moved on) — the replacement attachment has its own sender."""
+        try:
+            while True:
+                with self._cond:
+                    while True:
+                        if (self._closed or job.conn is not conn
+                                or job.conn_gen != gen):
+                            return
+                        act = self._next_send(job)
+                        if act is not None:
+                            break
+                        self._cond.wait(self.poll_s)
+                if act[0] == "batch":
+                    _, f, c, data = act
+                    meta = {"job": job.job_id, "file": f, "chunk": c}
+                    if isinstance(data, tuple):
+                        cmeta, buffers = data
+                        meta.update(fields=cmeta["fields"], n=cmeta["n"],
+                                    nulls=cmeta.get("nulls") or {})
+                        self._send(conn, transport.JOB_BATCH, meta, buffers)
+                    else:
+                        meta["rows"] = data
+                        self._send(conn, transport.JOB_BATCH, meta)
+                elif act[0] == "file_end":
+                    self._send(conn, transport.JOB_FILE_END,
+                               {"job": job.job_id, "file": act[1],
+                                "chunks": act[2]})
+                elif act[0] == "eof":
+                    self._send(conn, transport.JOB_EOF, {"job": job.job_id})
+                    obs.add_event("ingest:job_eof", job=job.job_id)
+                else:  # "error"
+                    self._send(conn, transport.JOB_ERROR,
+                               {"job": job.job_id, "type": act[1],
+                                "message": act[2][:500]})
+        except (ConnectionError, OSError):
+            with self._cond:
+                if job.conn is conn and job.conn_gen == gen:
+                    job.conn = None
+                    job.conn_gen += 1
+                    self._cond.notify_all()
+
+    # --- consumer side: local jobs ----------------------------------------------------
+    def _next_ready(self, job: _Job):
+        """Under _cond: pop the next in-order payload if present; returns
+        (payload,) or None. Advances the emit cursor across completed
+        files. () means every file fully emitted."""
+        while True:
+            if job.emit[0] >= len(job.files):
+                return ()
+            nc = job.file_chunks.get(job.emit[0])
+            if nc is not None and job.emit[1] >= nc:
+                job.emit = [job.emit[0] + 1, 0]
+                continue
+            key = tuple(job.emit)
+            if key in job.buffer:
+                data = job.buffer.pop(key)
+                job.emit = [job.emit[0], job.emit[1] + 1]
+                job.acked = list(job.emit)  # local: consumed == acked
+                self._cond.notify_all()
+                return (data,)
+            return None
+
+    def _stalled_shard(self, job: _Job) -> Optional[int]:
+        """Under _cond: the shard owning the job's next-needed file, IF it
+        has sat pending past the fallback grace period — the signal that
+        nobody is coming for it and the service should extract it inline."""
+        if job.emit[0] >= len(job.files):
+            return None
+        shard = job.emit[0] % job.n_shards
+        st = job.shards[shard]
+        if ((job.job_id, shard) in self._pending
+                and st.pending_since is not None
+                and time.monotonic() - st.pending_since
+                >= self.self_extract_after_s):
+            return shard
+        return None
+
+    def _start_self_extract(self, job: _Job, shard: int) -> None:
+        """Kick off in-process fallback extraction of one shard on its OWN
+        thread — never the consumer's: the fallback obeys the same reorder-
+        buffer backpressure as any worker, so it needs the consumer free to
+        keep draining (running it inline would deadlock the pair)."""
+        with self._cond:
+            key = (job.job_id, shard)
+            if key not in self._pending:
+                return
+            self._pending.remove(key)
+            job.self_extracting.add(shard)
+            job.shards[shard].granted += 1
+            lease = self._lease_payload(job, shard, lease_id=-1)
+        t = threading.Thread(target=self._self_extract,
+                             args=(job, shard, lease),
+                             daemon=True, name=f"ingest-fallback-{shard}")
+        t.start()
+        self._threads.append(t)
+
+    def _self_extract(self, job: _Job, shard: int, lease: dict) -> None:
+        """Fallback extraction body, through the SAME extract_shard code the
+        workers run — ordinals and payload bytes cannot diverge from a
+        worker's."""
+        self._counter("ingest_self_extracted_shards_total",
+                      "shards the coordinator extracted in-process after "
+                      "no worker claimed them within the grace period"
+                      ).inc()
+        obs.add_event("ingest:self_extract", shard=shard, job=job.job_id)
+        from .cache import FeatureCache
+
+        cache = FeatureCache(self.cache_dir) if self.cache_dir else None
+
+        def file_done(fi, nc, cache_outcome=None):
+            self._on_file_done({"job": job.job_id, "file": fi, "chunks": nc,
+                                "plan": job.plan_fp, "cache": cache_outcome})
+
+        try:
+            stats = extract_shard(
+                job.source, lease,
+                lambda seq, fi, ci, rows: self._commit(job, fi, ci, rows),
+                file_done, cache=cache)
+            self._on_shard_done({"job": job.job_id, "shard": shard,
+                                 "lease": -1, "plan": job.plan_fp,
+                                 "stats": stats})
+        except Exception as e:  # noqa: BLE001 — job-fatal, like in-process
+            with self._cond:
+                job.error = e
+                self._cond.notify_all()
+        finally:
+            with self._cond:
+                job.self_extracting.discard(shard)
+
+    def stream_local(self, job_id: str) -> Iterator[list]:
+        """Ordered, exactly-once batch stream for a LOCAL job. Blocks for
+        late batches; runs lease expiry and the fallback-extraction check
+        from its wait loop (the single-job coordinator contract — prompt
+        even without the housekeeping thread)."""
+        if self._server is None:
+            self.start()
+        job = self._jobs[job_id]
+        while True:
+            fallback_shard = None
+            with self._cond:
+                while True:
+                    if job.error is not None:
+                        raise job.error
+                    if self._crashed:
+                        raise ConnectionError("ingest service crashed")
+                    if self._closed or self._stop_requested or job.stop:
+                        return
+                    ready = self._next_ready(job)
+                    if ready == ():
+                        return  # every file fully emitted
+                    if ready is not None:
+                        data = ready[0]
+                        break
+                    self._expire_leases()
+                    fallback_shard = self._stalled_shard(job)
+                    if fallback_shard is not None:
+                        break
+                    self._cond.wait(self.poll_s)
+            if fallback_shard is not None:
+                self._start_self_extract(job, fallback_shard)
+                continue
+            yield payload_rows(data)
+
+    # --- housekeeping -----------------------------------------------------------------
+    def _housekeeping(self) -> None:
+        """The service's background beat: lease expiry, stalled-shard
+        fallback for REMOTE jobs (local jobs run it from their stream loop),
+        autoscaling, the checkpoint cadence, and gauges."""
+        while True:
+            with self._cond:
+                if self._closed or self._crashed:
+                    return
+                self._expire_leases()
+                stalled = []
+                for job in self._jobs.values():
+                    if (not job.local and not job.paused and not job.stop
+                            and job.error is None):
+                        s = self._stalled_shard(job)
+                        if s is not None:
+                            stalled.append((job, s))
+                n_live = sum(1 for w in self._workers.values() if w.live)
+            for job, s in stalled:
+                self._start_self_extract(job, s)
+            self._autoscale_tick()
+            if self.state_dir and not self._crashed:
+                if (self._last_ckpt is None
+                        or time.monotonic() - self._last_ckpt
+                        >= self.checkpoint_every_s):
+                    self._checkpoint()
+            self._worker_gauges(n_live)
+            with self._cond:
+                self._jobs_gauge()
+            if self._last_ckpt is not None:
+                self._reg.gauge(
+                    "ingest_checkpoint_age_seconds",
+                    help="seconds since the service state last "
+                         "checkpointed").set(
+                    round(time.monotonic() - self._last_ckpt, 3))
+            time.sleep(self.poll_s)
+
+    def _autoscale_tick(self) -> None:
+        cfg = self.autoscale
+        if cfg is None:
+            return
+        now = time.monotonic()
+        with self._cond:
+            live = [w for w in self._workers.values()
+                    if w.live and not w.retire]
+            oldest = None
+            busy = bool(self._leases)
+            for jid, s in self._pending:
+                job = self._jobs.get(jid)
+                if not self._grantable(job):
+                    continue
+                busy = True
+                since = job.shards[s].pending_since
+                if since is not None:
+                    age = now - since
+                    if oldest is None or age > oldest:
+                        oldest = age
+        if now - self._as_last < cfg.cooldown_s:
+            return
+        if (oldest is not None and oldest >= cfg.scale_up_wait_s
+                and len(live) < cfg.max_workers):
+            self._as_last = now
+            self._as_idle_since = None
+            self._counter("ingest_autoscale_total",
+                          "autoscale actions on the worker fleet",
+                          action="spawn").inc()
+            obs.add_event("ingest:autoscale", action="spawn",
+                          queue_wait_s=round(oldest, 3), workers=len(live))
+            try:
+                self._spawn_fn(self, 1)
+            except Exception as e:  # noqa: BLE001 — degraded, not fatal:
+                # self-extraction still finishes every job
+                obs.add_event("ingest:autoscale_spawn_failed",
+                              error=str(e)[:200])
+            return
+        if busy:
+            self._as_idle_since = None
+            return
+        if self._as_idle_since is None:
+            self._as_idle_since = now
+            return
+        if (now - self._as_idle_since >= cfg.scale_down_idle_s
+                and len(live) > cfg.min_workers):
+            victim = live[-1]  # most recently registered
+            with self._cond:
+                victim.retire = True
+            self._as_last = now
+            self._as_idle_since = now
+            self._counter("ingest_autoscale_total",
+                          "autoscale actions on the worker fleet",
+                          action="retire").inc()
+            obs.add_event("ingest:autoscale", action="retire",
+                          worker=victim.worker_id)
+
+    # --- introspection ----------------------------------------------------------------
+    def job_stats(self, job_id: str) -> dict:
+        with self._cond:
+            job = self._jobs[job_id]
+            return {
+                "n_files": len(job.files),
+                "n_shards": job.n_shards,
+                "shards_done": len(job.shards_done),
+                "pending": [s for (j, s) in self._pending if j == job_id],
+                "leases": {s: lease.worker_id
+                           for (j, s), lease in self._leases.items()
+                           if j == job_id},
+                "workers": sorted(self._workers),
+                "committed": len(job.committed),
+                "buffered": len(job.buffer),
+                "acked": list(job.acked),
+                "paused": job.paused,
+            }
+
+    def service_stats(self) -> dict:
+        with self._cond:
+            return {
+                "restarts": self._restarts,
+                "n_jobs": len(self._jobs),
+                "jobs": {jid: {"done": job.done(), "paused": job.paused,
+                               "acked": list(job.acked),
+                               "epoch": job.epoch,
+                               "committed": len(job.committed)}
+                         for jid, job in self._jobs.items()},
+                "workers": sorted(w for w, x in self._workers.items()
+                                  if x.live),
+                "pending": len(self._pending),
+                "leases": len(self._leases),
+            }

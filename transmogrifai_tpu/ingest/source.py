@@ -3,7 +3,12 @@
 A spec is a small, JSON-serializable description of a deterministic batch
 stream — the coordinator ships it to workers inside a LEASE frame, and any
 holder of shard `s` re-derives the IDENTICAL batch sequence from it (the
-property lease reassignment's deterministic replay rests on).
+property lease reassignment's deterministic replay rests on). The same
+wire form (`to_wire`/`source_from_wire`) is how a REMOTE consumer
+registers a job with the multi-tenant service: `IngestClient` sends the
+spec in JOB_OPEN, the service freezes the listing server-side, and the
+frozen listing — not the live directory — is what the restart checkpoint
+persists, so a file added mid-job can never shift ordinals.
 
 The global stream is defined exactly like the in-process reader it mirrors
 (`CSVStreamingReader`): files in sorted name order; within a file, chunks of

@@ -4,14 +4,23 @@ One frame on the wire:
 
     magic(2) | kind(1) | length(4, big-endian) | crc32(4) | payload(length)
 
-The payload is UTF-8 JSON — ingest batches are parsed CSV record dicts, so
-JSON round-trips them exactly (byte-identity downstream depends on it) and
-keeps the wire format debuggable with `nc`. The CRC covers the payload, so a
-torn or bit-flipped frame is DETECTED, never silently consumed: `recv_frame`
-raises `FrameError` (an `OSError`, hence classified TRANSIENT by
-resilience/policy.py) and the peer treats the connection as dead — recovery
-is the lease/replay machinery's job, not a protocol-level resend. A short
-read (the socket died mid-frame) surfaces the same way as `ConnectionError`.
+The payload is UTF-8 JSON — control frames and legacy row batches round-trip
+exactly (byte-identity downstream depends on it) and the wire format stays
+debuggable with `nc`. Frame kinds in `BINARY_KINDS` instead carry a HYBRID
+payload — a JSON meta header plus raw binary buffers:
+
+    u32 meta_len | meta_json | u32 n_buffers | (u32 len | bytes)*
+
+which is how columnar batches (frames.py) ship their per-column offset/data
+buffers without base64 or per-cell JSON tokenization. `recv_frame` returns
+them as `(kind, meta)` with the buffers attached under `meta["__buffers__"]`.
+
+The CRC covers the WHOLE payload either way, so a torn or bit-flipped frame
+is DETECTED, never silently consumed: `recv_frame` raises `FrameError` (an
+`OSError`, hence classified TRANSIENT by resilience/policy.py) and the peer
+treats the connection as dead — recovery is the lease/replay machinery's
+job, not a protocol-level resend. A short read (the socket died mid-frame)
+surfaces the same way as `ConnectionError`.
 
 Frame kinds are one-byte tags; both sides reject unknown tags loudly. The
 protocol is deliberately dumb: no negotiation, no compression, no pipelined
@@ -40,7 +49,31 @@ SHUTDOWN = 9      # coordinator ->: {} — epoch complete, exit the loop
 ERROR = 10        # {shard, lease, type, message} — extraction failed after
                   # the worker's own retries (requeue once, then fatal)
 
+#: --- multi-tenant service kinds (service.py / client.py) ---
+COLBATCH = 16     # worker ->: columnar BATCH — meta {job, shard, seq, file,
+                  #            chunk, plan, fields, n, nulls} + buffers
+JOB_OPEN = 17     # consumer ->: {job, source, plan, n_shards?, options?} —
+                  #              idempotent attach-or-create (restart resume)
+JOB_READY = 18    # service ->: {job, resumed, n_files, epoch}
+JOB_BATCH = 19    # service ->: columnar/rows batch for an attached consumer
+                  #             — meta {job, file, chunk, (fields,n,nulls |
+                  #             rows)} + buffers
+JOB_FILE_END = 20 # service ->: {job, file, chunks} — consumer cursor
+                  #             advances to (file+1, 0)
+JOB_EOF = 21      # service ->: {job} — every batch delivered
+JOB_ACK = 22      # consumer ->: {job, file, chunk} — committed frontier
+                  #              (everything BEFORE (file, chunk) is durable
+                  #              with the consumer; checkpointed)
+JOB_ERROR = 23    # service ->: {job, type, message} — the job failed the way
+                  #             the in-process reader would
+JOB_CLOSE = 24    # consumer ->: {job} — unregister (consumer is done)
+SVC_STATS = 25    # consumer ->: {} request / service ->: {stats} reply
+
+#: kinds whose payload is the hybrid meta+buffers layout (module docstring)
+BINARY_KINDS = frozenset({COLBATCH, JOB_BATCH})
+
 _HEADER = struct.Struct(">2sBII")
+_U32 = struct.Struct("<I")
 
 #: refuse absurd frames before allocating for them (a corrupt length field
 #: must not ask recv for gigabytes)
@@ -53,8 +86,23 @@ class FrameError(OSError):
     (reconnect + lease reassignment + deterministic replay) owns it."""
 
 
-def send_frame(sock: socket.socket, kind: int, payload: dict) -> None:
-    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+def send_frame(sock: socket.socket, kind: int, payload: dict,
+               buffers: list = None) -> None:
+    """Send one frame. `buffers` (only for kinds in BINARY_KINDS) are raw
+    byte strings appended after the JSON meta in the hybrid layout; the CRC
+    covers meta and buffers alike."""
+    if kind in BINARY_KINDS:
+        bufs = buffers or []
+        meta = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        parts = [_U32.pack(len(meta)), meta, _U32.pack(len(bufs))]
+        for b in bufs:
+            parts.append(_U32.pack(len(b)))
+            parts.append(bytes(b))
+        body = b"".join(parts)
+    else:
+        if buffers:
+            raise ValueError(f"frame kind {kind} does not carry buffers")
+        body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
     header = _HEADER.pack(MAGIC, kind, len(body), zlib.crc32(body))
     sock.sendall(header + body)
 
@@ -83,6 +131,8 @@ def recv_frame(sock: socket.socket) -> tuple[int, dict]:
     if zlib.crc32(body) != crc:
         raise FrameError(
             f"frame checksum mismatch (kind={kind}, {length} bytes)")
+    if kind in BINARY_KINDS:
+        return kind, _unpack_hybrid(kind, body)
     try:
         payload = json.loads(body.decode("utf-8"))
     except ValueError as e:
@@ -90,3 +140,31 @@ def recv_frame(sock: socket.socket) -> tuple[int, dict]:
     if not isinstance(payload, dict):
         raise FrameError("frame payload must be a JSON object")
     return kind, payload
+
+
+def _unpack_hybrid(kind: int, body: bytes) -> dict:
+    """Split a hybrid binary payload into its meta dict (buffers attached
+    under "__buffers__" as memoryviews over the received body — no copies)."""
+    try:
+        view = memoryview(body)
+        (meta_len,) = _U32.unpack_from(view, 0)
+        pos = _U32.size
+        meta = json.loads(bytes(view[pos:pos + meta_len]).decode("utf-8"))
+        pos += meta_len
+        (n_buf,) = _U32.unpack_from(view, pos)
+        pos += _U32.size
+        buffers = []
+        for _ in range(n_buf):
+            (blen,) = _U32.unpack_from(view, pos)
+            pos += _U32.size
+            buffers.append(view[pos:pos + blen])
+            if pos + blen > len(body):
+                raise ValueError("buffer overruns frame body")
+            pos += blen
+    except (ValueError, struct.error, UnicodeDecodeError) as e:
+        raise FrameError(
+            f"malformed hybrid frame (kind={kind}): {e}") from e
+    if not isinstance(meta, dict):
+        raise FrameError("hybrid frame meta must be a JSON object")
+    meta["__buffers__"] = buffers
+    return meta

@@ -35,6 +35,7 @@ from typing import Optional
 from ..resilience.policy import FaultPolicy, io_guard, retry_call, scoped
 from . import transport
 from .cache import FeatureCache, cache_key, data_fingerprint
+from .frames import encode_columns
 from .source import source_from_wire
 
 
@@ -112,7 +113,10 @@ class IngestWorker:
     def __init__(self, address, *, worker_id: Optional[str] = None,
                  cache_dir: Optional[str] = None,
                  policy: Optional[FaultPolicy] = None,
-                 poll_s: float = 0.2):
+                 poll_s: float = 0.2,
+                 payload: str = "columnar",
+                 reconnect_max: Optional[int] = None,
+                 sleep=time.sleep):
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             address = (host or "127.0.0.1", int(port))
@@ -124,20 +128,48 @@ class IngestWorker:
         self.policy = policy if policy is not None else FaultPolicy(
             retry_max=5, backoff_base_s=0.05, backoff_cap_s=1.0)
         self.poll_s = float(poll_s)
+        #: "columnar" ships COLBATCH frames (per-column contiguous buffers)
+        #: whenever the batch is exactly representable; "rows" forces the
+        #: legacy row-JSON BATCH payload (the bench comparison arm)
+        self.payload = payload
+        #: mid-run reconnect budget — DISTINCT from the first-connect budget:
+        #: a worker that has already served leases should ride out a
+        #: coordinator restart longer than a misconfigured address deserves
+        self.reconnect_max = (int(reconnect_max) if reconnect_max is not None
+                              else max(self.policy.retry_max, 8))
+        self._sleep = sleep
         self._sock: Optional[socket.socket] = None
         self._stopped = False
 
     # --- connection management --------------------------------------------------------
-    def _connect(self) -> socket.socket:
-        def attempt():
-            s = socket.create_connection(self.address, timeout=10.0)
-            s.settimeout(None)
-            transport.send_frame(s, transport.HELLO,
-                                 {"worker_id": self.worker_id,
-                                  "pid": os.getpid()})
-            return s
+    def _hello(self) -> socket.socket:
+        s = socket.create_connection(self.address, timeout=10.0)
+        s.settimeout(None)
+        transport.send_frame(s, transport.HELLO,
+                             {"worker_id": self.worker_id,
+                              "pid": os.getpid()})
+        return s
 
-        return retry_call(attempt, policy=self.policy, site="ingest:connect")
+    def _connect(self) -> socket.socket:
+        return retry_call(self._hello, policy=self.policy,
+                          site="ingest:connect", sleep=self._sleep)
+
+    def _reconnect(self) -> socket.socket:
+        """Mid-run rejoin after a lost connection (coordinator restart, torn
+        frame, chaos sever). Backoff comes from `FaultPolicy.backoff_s` at
+        its own site, so the post-restart rejoin schedule is a deterministic
+        function of (seed, "ingest:reconnect", attempt) — replayable, and
+        decorrelated across a fleet via per-worker seeds."""
+        attempt = 0
+        while True:
+            try:
+                return self._hello()
+            except (ConnectionError, OSError):
+                if self._stopped or attempt >= self.reconnect_max:
+                    raise
+                self._sleep(self.policy.backoff_s("ingest:reconnect",
+                                                  attempt))
+                attempt += 1
 
     def _send(self, kind: int, payload: dict) -> None:
         transport.send_frame(self._sock, kind, payload)
@@ -174,14 +206,16 @@ class IngestWorker:
             except (ConnectionError, transport.FrameError, OSError):
                 # the lease (if any) dies with the connection — the
                 # coordinator requeues it and replay picks up the slack.
-                # Reconnect under the retry policy; exhaustion means the
-                # coordinator is gone for good, so the worker exits.
+                # Reconnect under the seeded-backoff rejoin loop (a
+                # RESTARTED coordinator re-adopts this worker on its fresh
+                # HELLO); exhaustion means the coordinator is gone for
+                # good, so the worker exits.
                 try:
                     self._sock.close()
                 except OSError:
                     pass
                 try:
-                    self._sock = self._connect()
+                    self._sock = self._reconnect()
                 except (ConnectionError, OSError):
                     return
 
@@ -189,22 +223,37 @@ class IngestWorker:
         shard = int(lease["shard"])
         lease_id = int(lease["lease"])
         plan = lease.get("plan")
+        job = lease.get("job")  # absent from a pre-service coordinator
         source = source_from_wire(lease["source"])
 
         def emit_batch(seq, file_index, chunk_index, rows):
-            self._send(transport.BATCH,
-                       {"shard": shard, "seq": seq, "file": file_index,
-                        "chunk": chunk_index, "plan": plan, "rows": rows})
+            # columnar first: per-column contiguous buffers (frames.py) skip
+            # the per-row JSON tokenization that dominates disagg CPU. The
+            # encoder returns None for batches it cannot represent EXACTLY,
+            # and those fall back to the legacy row payload — never lossy.
+            enc = (encode_columns(rows) if self.payload == "columnar"
+                   else None)
+            base = {"job": job, "shard": shard, "seq": seq,
+                    "file": file_index, "chunk": chunk_index, "plan": plan}
+            if enc is not None:
+                meta, buffers = enc
+                base.update(fields=meta["fields"], n=meta["n"],
+                            nulls=meta["nulls"])
+                transport.send_frame(self._sock, transport.COLBATCH,
+                                     base, buffers)
+            else:
+                base["rows"] = rows
+                self._send(transport.BATCH, base)
 
         def emit_file_done(file_index, n_chunks, cache_outcome=None):
             self._send(transport.FILE_DONE,
-                       {"shard": shard, "file": file_index,
+                       {"job": job, "shard": shard, "file": file_index,
                         "chunks": n_chunks, "lease": lease_id,
                         "plan": plan, "cache": cache_outcome})
 
         def heartbeat():
             self._send(transport.HEARTBEAT,
-                       {"shard": shard, "lease": lease_id})
+                       {"job": job, "shard": shard, "lease": lease_id})
 
         try:
             stats = extract_shard(source, lease, emit_batch, emit_file_done,
@@ -213,12 +262,13 @@ class IngestWorker:
             raise  # connection-level: the reconnect loop owns it
         except Exception as e:  # noqa: BLE001 — reported, not swallowed
             self._send(transport.ERROR,
-                       {"shard": shard, "lease": lease_id, "plan": plan,
-                        "type": type(e).__name__, "message": str(e)[:500]})
+                       {"job": job, "shard": shard, "lease": lease_id,
+                        "plan": plan, "type": type(e).__name__,
+                        "message": str(e)[:500]})
             return
         self._send(transport.SHARD_DONE,
-                   {"shard": shard, "lease": lease_id, "plan": plan,
-                    "stats": stats})
+                   {"job": job, "shard": shard, "lease": lease_id,
+                    "plan": plan, "stats": stats})
 
 
 def main(argv=None) -> int:
@@ -241,11 +291,23 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-id", default=None)
     ap.add_argument("--retry-max", type=int, default=5,
                     help="connect/read retries before giving up (default 5)")
+    ap.add_argument("--reconnect-max", type=int, default=None,
+                    help="mid-run rejoin attempts after a lost connection "
+                         "(default max(retry-max, 8)); backoff is the "
+                         "seeded FaultPolicy jitter at ingest:reconnect")
+    ap.add_argument("--payload", choices=("columnar", "rows"),
+                    default="columnar",
+                    help="batch wire payload: columnar COLBATCH buffers "
+                         "(default) or legacy row JSON")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="backoff-jitter seed (per-worker seeds decorrelate "
+                         "a fleet rejoining after a coordinator restart)")
     args = ap.parse_args(argv)
     worker = IngestWorker(
         args.connect, worker_id=args.worker_id, cache_dir=args.cache_dir,
         policy=FaultPolicy(retry_max=args.retry_max, backoff_base_s=0.05,
-                           backoff_cap_s=1.0))
+                           backoff_cap_s=1.0, seed=args.seed),
+        payload=args.payload, reconnect_max=args.reconnect_max)
     worker.run()
     return 0
 

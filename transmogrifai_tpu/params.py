@@ -84,6 +84,17 @@ class OpParams:
     #: restarted workers and grid-search consumers skip re-extraction.
     #: CLI: `op run --ingest-cache-dir DIR`.
     ingest_cache_dir: Optional[str] = None
+    #: streaming_score: consume extraction from a SHARED multi-tenant ingest
+    #: service (`op ingest-serve`) at "HOST:PORT" instead of spawning a
+    #: per-run fleet — many concurrent runs register as independent jobs
+    #: over one worker pool, and a service restart mid-run is ridden out by
+    #: the consumer's reconnect + dedupe cursor (byte-identical output).
+    #: Mutually exclusive with ingest_workers.
+    #: CLI: `op run --ingest-connect HOST:PORT`.
+    ingest_connect: Optional[str] = None
+    #: job id this run registers with the shared service (defaults to a
+    #: pid-derived id; name it to resume a crashed consumer's frontier).
+    ingest_job: Optional[str] = None
     #: --- serving daemon (`op serve`; serve/daemon.py, docs/serving.md) ---
     #: adaptive micro-batcher max-wait (milliseconds): how long the first
     #: request of a coalescing window waits for company before a partial

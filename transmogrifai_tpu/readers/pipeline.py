@@ -148,8 +148,9 @@ class ClosableQueue:
 
 class LiveSource:
     """Adapter: any remote/live batch stream as a first-class pipeline
-    source. Wraps an iterator factory (e.g. `IngestCoordinator.stream`, a
-    subscription, a socket drain) plus a stop callback, and implements the
+    source. Wraps an iterator factory (e.g. `IngestCoordinator.stream`, the
+    multi-tenant `IngestClient.stream` a run joins with `--ingest-connect`,
+    a subscription, a socket drain) plus a stop callback, and implements the
     `on_pipeline_close` hook `Prefetcher.close()` invokes FIRST at teardown
     — so an early exit unblocks a producer that is waiting inside the remote
     stream within one poll quantum instead of timing out the close join

@@ -68,6 +68,7 @@ class FaultInjector:
                  worker_kills: Sequence = (),
                  rpc_drops: Sequence = (),
                  rpc_torn: Sequence = (),
+                 coord_kills: Sequence = (),
                  fail_sites: Optional[dict] = None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
@@ -88,6 +89,11 @@ class FaultInjector:
         self.worker_kills = {(int(s), int(q)) for s, q in worker_kills}
         self.rpc_drops = {(int(s), int(q)) for s, q in rpc_drops}
         self.rpc_torn = {(int(s), int(q)) for s, q in rpc_torn}
+        #: coordinator death, keyed (epoch, seq) — the job's declared epoch
+        #: and the batch ordinal being processed when the coordinator dies
+        #: (`coord:kill` is to the ingest SERVICE what `worker:kill` is to
+        #: one worker: a SIGKILL at a deterministic, replayable coordinate)
+        self.coord_kills = {(int(e), int(q)) for e, q in coord_kills}
         #: {site name: transient failure budget} for named control-plane
         #: sites (`maybe_site`): the first N hook calls at the site raise
         #: InjectedFault, later calls succeed — the shape the autopilot's
@@ -196,6 +202,21 @@ class FaultInjector:
         self._record(kind, site, int(seq), shard=int(shard))
         return {"worker_kill": "kill", "rpc_drop": "drop",
                 "rpc_torn": "torn"}[kind]
+
+    def coord_kill(self, epoch: int, seq: int) -> bool:
+        """Coordinator-death injection, consulted by the ingest service as
+        it processes each BATCH frame (before the frame commits — a killed
+        coordinator never half-applies the triggering batch). Fires exactly
+        once per scheduled (epoch, seq); returns True when the service
+        should die NOW (SIGKILL itself in process mode, abrupt in-process
+        teardown in tests)."""
+        key = (int(epoch), int(seq))
+        with self._lock:
+            if key not in self.coord_kills:
+                return False
+            self.coord_kills.discard(key)
+        self._record("coord_kill", "coord:kill", int(seq), epoch=int(epoch))
+        return True
 
     def slow(self, site: str, index: int) -> None:
         if index in self.slow_batches:
@@ -314,3 +335,10 @@ def maybe_ingest_fault(shard: int, seq: int) -> Optional[str]:
     if inj is not None:
         return inj.ingest_fault(shard, seq)
     return None
+
+
+def maybe_coord_kill(epoch: int, seq: int) -> bool:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.coord_kill(epoch, seq)
+    return False

@@ -574,6 +574,47 @@ class WorkflowRunner:
                             transform=transform)
         return source, coordinator
 
+    def _connected_ingest_source(self, model: WorkflowModel, params: OpParams):
+        """Consume extraction from a SHARED multi-tenant ingest service
+        (`op ingest-serve`) instead of spawning a per-run fleet: register
+        this run as one job at `params.ingest_connect` ("HOST:PORT") via
+        `IngestClient`, which reconnects with seeded backoff and dedupes by
+        a (file, chunk) cursor — a coordinator restart mid-run is ridden
+        out byte-identically. Same (LiveSource, closer) shape as
+        `_remote_ingest_source` so the Prefetcher teardown hook reaches the
+        client."""
+        import os as _os
+
+        spec = getattr(self.streaming_reader, "ingest_spec", lambda: None)()
+        if spec is None:
+            raise ValueError(
+                f"ingest_connect={params.ingest_connect!r} needs a shardable "
+                f"streaming reader (one with ingest_spec()); "
+                f"{type(self.streaming_reader).__name__} cannot describe its "
+                "extraction to a remote service")
+        from ..ingest import IngestClient
+        from ..readers.pipeline import LiveSource
+
+        try:
+            from ..analyze import plan_fingerprint
+
+            plan_fp = plan_fingerprint(model.stages)
+        except TypeError:
+            plan_fp = "unfingerprintable"
+        job_id = params.ingest_job or f"run-{_os.getpid()}"
+        client = IngestClient(params.ingest_connect, job_id, spec,
+                              plan_fp=plan_fp, registry=None)
+        transform = None
+        if self.stream_batch_size:
+            from ..readers.streaming import rebatch
+
+            def transform(stream, _bs=self.stream_batch_size):
+                return rebatch(
+                    (b.to_rows() if isinstance(b, Table) else b
+                     for b in stream), _bs)
+        source = LiveSource(client.stream, client.close, transform=transform)
+        return source, client
+
     def _run_streaming_score(self, params: OpParams, mark) -> RunResult:
         """Micro-batch scoring loop (the DStream analog, OpWorkflowRunner.scala:232):
         each batch from the streaming reader is scored with the same jit-cached plan;
@@ -623,7 +664,14 @@ class WorkflowRunner:
         # every batch (pure host-side work on the pipeline's critical path)
         plan = _StreamColumnsPlan(model.raw_features)
         coordinator = None
-        if getattr(params, "ingest_workers", 0):
+        if (getattr(params, "ingest_workers", 0)
+                and getattr(params, "ingest_connect", None)):
+            raise ValueError(
+                "ingest_workers and ingest_connect are mutually exclusive: "
+                "spawn a per-run fleet OR join a shared service, not both")
+        if getattr(params, "ingest_connect", None):
+            batches, coordinator = self._connected_ingest_source(model, params)
+        elif getattr(params, "ingest_workers", 0):
             batches, coordinator = self._remote_ingest_source(model, params)
         else:
             batches = self.streaming_reader.stream()
