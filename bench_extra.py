@@ -626,7 +626,11 @@ def run_multitenant_ingest(n_files: int = 6, rows_per_file: int = 4096,
     1. Payload format: one remote job drained through workers speaking
        legacy row-list BATCH frames vs columnar COLBATCH frames
        (`multitenant_colbatch_speedup` — the per-column contiguous-buffer
-       encode skips the per-row JSON tax).
+       encode skips the per-row JSON tax). A third arm negotiates zlib
+       column buffers end to end (`colbatch_zlib_rows_per_sec`), and
+       `multitenant_compression_wire_ratio` reports the raw wire-byte
+       shrink of one representative COLBATCH (plain / deflated — the
+       localhost walls can't see bytes, a WAN link would).
     2. Tenancy: TWO consumer jobs through ONE shared 2-worker fleet
        concurrently vs the per-run shape (two sequential services, each
        booting its own fleet inside the timed wall — the cost sharing
@@ -671,12 +675,13 @@ def run_multitenant_ingest(n_files: int = 6, rows_per_file: int = 4096,
                    and time.perf_counter() < deadline):
                 time.sleep(0.02)
 
-        def drain(svc_addr, job_id):
+        def drain(svc_addr, job_id, compression=None):
             client = IngestClient(svc_addr, job_id, spec,
-                                  plan_fp="bench", n_shards=2)
+                                  plan_fp="bench", n_shards=2,
+                                  compression=compression)
             return sum(len(b) for b in client.stream())
 
-        def payload_epoch(payload: str) -> float:
+        def payload_epoch(payload: str, compress: bool = False) -> float:
             """One remote job, 2 manual worker threads pinned to one frame
             format (launch_local_workers always speaks columnar). Workers
             share a feature cache: the warmup epoch populates it, so timed
@@ -687,14 +692,16 @@ def run_multitenant_ingest(n_files: int = 6, rows_per_file: int = 4096,
                 workers = []
                 for i in range(2):
                     w = IngestWorker(svc.address, worker_id=f"bw-{i}",
-                                     payload=payload,
+                                     payload=payload, compress=compress,
                                      cache_dir=os.path.join(state_root,
                                                             "cache"))
                     threading.Thread(target=w.run, daemon=True).start()
                     workers.append(w)
                 wait_workers(svc, 2)
                 t0 = time.perf_counter()
-                n = drain(svc.address, f"pay-{payload}")
+                n = drain(svc.address,
+                          f"pay-{payload}{'-z' if compress else ''}",
+                          compression="zlib" if compress else None)
                 wall = time.perf_counter() - t0
                 assert n == n_rows, (n, n_rows)
                 for w in workers:
@@ -794,7 +801,23 @@ def run_multitenant_ingest(n_files: int = 6, rows_per_file: int = 4096,
 
         payload_epoch("columnar")  # page files into cache once
         col_wall = min(payload_epoch("columnar") for _ in range(2))
+        zlib_wall = min(payload_epoch("columnar", compress=True)
+                        for _ in range(2))
         row_wall = min(payload_epoch("rows") for _ in range(2))
+
+        # raw wire shrink of one representative COLBATCH: the timed walls
+        # above run over loopback where bytes are nearly free, so the ratio
+        # is the durable number (what a real NIC would save)
+        from transmogrifai_tpu.ingest.frames import encode_columns
+        sample = []
+        for _ in range(batch):
+            r = {f"x{i}": repr(float(v))
+                 for i, v in enumerate(rng.normal(size=n_cols))}
+            r["cat"] = "abcd"[int(rng.integers(0, 4))]
+            sample.append(r)
+        plain_bytes = sum(len(b) for b in encode_columns(sample)[1])
+        zlib_bytes = sum(len(b) for b in
+                         encode_columns(sample, compression="zlib")[1])
         shared_wall = shared_epoch()
         per_run_wall = per_run_epoch()
         clean_wall = restart_epoch(kill=False)
@@ -804,6 +827,9 @@ def run_multitenant_ingest(n_files: int = 6, rows_per_file: int = 4096,
             "rows_payload_rows_per_sec": round(n_rows / row_wall),
             "colbatch_rows_per_sec": round(n_rows / col_wall),
             "multitenant_colbatch_speedup": round(row_wall / col_wall, 3),
+            "colbatch_zlib_rows_per_sec": round(n_rows / zlib_wall),
+            "multitenant_compression_wire_ratio": round(
+                plain_bytes / zlib_bytes, 3),
             "shared_fleet_two_jobs_s": round(shared_wall, 4),
             "per_run_two_jobs_s": round(per_run_wall, 4),
             "multitenant_shared_fleet_speedup": round(

@@ -336,6 +336,52 @@ def run_gbt_mesh_lane(meshes: dict, quick: bool, forced_host: bool) -> dict:
     return out
 
 
+def run_gbt_data_axis_lane(meshes: dict, quick: bool,
+                           forced_host: bool) -> dict:
+    """The r14 tree lane: GBT training with the margin/gradient ROWS sharded
+    over the DATA axis inside the fused histogram->split program — each device
+    accumulates a partial histogram over its row shard, a psum over DATA_AXIS
+    merges the stats, and only the [n_nodes, D] split decisions leave the
+    program. Benchmarks 8x1 (pure data) and 4x2 (data x model composed)
+    against the unmeshed single-device fit; split decisions must stay BITWISE
+    identical across shapes (gains are allclose-only — psum order ulp)."""
+    from transmogrifai_tpu.ops.trees import fit_gbt
+
+    n, d = (1 << 13, 32) if quick else (1 << 15, 64)
+    n_trees, depth, bins = (5, 4, 16) if quick else (10, 5, 32)
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+    kwargs = dict(objective="binary", n_trees=n_trees, max_depth=depth,
+                  n_bins=bins)
+
+    out = {"rows": n, "cols": d, "trees": n_trees, "depth": depth,
+           "per_shape": {}}
+    base = None
+    ref_sf = None
+    for (nd, nm), mesh in meshes.items():
+        if (nd, nm) not in ((1, 1), (8, 1), (4, 2)):
+            continue
+
+        def fit(mesh=mesh):
+            return fit_gbt(X, y, mesh=mesh, **kwargs)
+
+        wall = _bench(fit, reps=2 if quick else 3)
+        out["per_shape"][f"{nd}x{nm}"] = round(n * n_trees / wall)
+        sf = np.asarray(fit().split_feature)
+        if (nd, nm) == (1, 1):
+            base = n * n_trees / wall
+            ref_sf = sf
+        elif not (sf == ref_sf).all():
+            out["parity_error"] = (
+                f"{nd}x{nm}: data-axis split decisions diverged from 1x1")
+    data_par = out["per_shape"].get("8x1")
+    if base and data_par:
+        out["scaling_efficiency"] = round(_efficiency(
+            data_par, base, 8, forced_host), 4)
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -367,6 +413,8 @@ def main() -> None:
     detail["mlp_sharded"] = run_sharded_mlp_lane(meshes, ARGS.quick,
                                                  forced_host)
     detail["gbt_mesh"] = run_gbt_mesh_lane(meshes, ARGS.quick, forced_host)
+    detail["gbt_data_axis"] = run_gbt_data_axis_lane(meshes, ARGS.quick,
+                                                     forced_host)
 
     stats_eff = detail["stats"].get("scaling_efficiency")
     scoring_eff = detail["scoring"].get("scaling_efficiency")
@@ -402,12 +450,18 @@ def main() -> None:
             detail["gbt_mesh"]["per_shape"].get("1x8"),
         "multichip_gbt_model_axis_efficiency":
             detail["gbt_mesh"].get("scaling_efficiency"),
+        "multichip_gbt_rows_trees_per_sec_8x1":
+            detail["gbt_data_axis"]["per_shape"].get("8x1"),
+        "multichip_gbt_rows_trees_per_sec_4x2":
+            detail["gbt_data_axis"]["per_shape"].get("4x2"),
+        "gbt_data_axis_efficiency":
+            detail["gbt_data_axis"].get("scaling_efficiency"),
         "n_devices": n_devices,
     }
     parity_error = detail["selector"].get("parity_error")
     if parity_error:
         summary["selector_parity_error"] = parity_error
-    for lane in ("mlp_sharded", "gbt_mesh"):
+    for lane in ("mlp_sharded", "gbt_mesh", "gbt_data_axis"):
         err = detail[lane].get("parity_error")
         if err:
             summary[f"{lane}_parity_error"] = err

@@ -51,7 +51,7 @@ class TestCatalog:
         # the catalog drives docs/static_analysis.md and `op lint --rules`
         assert {"OP001", "OP101", "OP102", "OP103", "OP104", "OP201", "OP202",
                 "OP203", "OP301", "OP302", "OP401", "OP402", "OP403",
-                "OP404", "OP405"} \
+                "OP404", "OP405", "OP406"} \
             == set(RULES)
         for r in RULES.values():
             assert r.title and r.rationale and r.severity in ("error", "warn", "info")
@@ -460,6 +460,75 @@ class TestOP405OptimizerStateBudget:
 
         est = MLPClassifier(hidden=(512, 512)).optimizer_state_bytes()
         assert est == 12 * (512 * 512 + 512 + 512 * 2 + 2)
+
+
+class TestOP406TreeDataAxisMesh:
+    """Tree fits planned on a >1-data-axis mesh whose config trips a fused
+    data-axis split gate (L1 / n_bins < 2 / TT_SPLIT=twopass): the fit
+    silently replicates every row to every device."""
+
+    def _plan(self, est_stage):
+        fs = features_from_schema({"y": "RealNN", "a": "Real", "b": "Real"},
+                                  response="y")
+        vec = transmogrify([fs["a"], fs["b"]])
+        return est_stage(fs["y"], vec)
+
+    def _data_mesh(self, n_data=8, n_model=1):
+        from transmogrifai_tpu.mesh import make_mesh
+
+        return make_mesh(n_data=n_data, n_model=n_model)
+
+    def test_l1_on_data_mesh_fires(self):
+        from transmogrifai_tpu.stages.model import XGBoostClassifier
+
+        est = XGBoostClassifier(reg_alpha=0.5).with_mesh(self._data_mesh())
+        report = analyze_plan([self._plan(est)])
+        diags = report.by_code("OP406")
+        assert diags and diags[0].severity == "warn"
+        assert "reg_alpha" in diags[0].message
+        assert "unmeshed" in diags[0].hint
+
+    def test_tiny_bins_fires(self):
+        from transmogrifai_tpu.stages.model import GBTRegressor
+
+        est = GBTRegressor(n_bins=1).with_mesh(self._data_mesh())
+        diags = analyze_plan([self._plan(est)]).by_code("OP406")
+        assert diags and "n_bins" in diags[0].message
+
+    def test_twopass_override_fires(self, monkeypatch):
+        from transmogrifai_tpu.stages.model import GBTClassifier
+
+        monkeypatch.setenv("TT_SPLIT", "twopass")
+        est = GBTClassifier().with_mesh(self._data_mesh())
+        assert "OP406" in _codes(analyze_plan([self._plan(est)]))
+
+    def test_fused_config_on_data_mesh_clean(self, monkeypatch):
+        from transmogrifai_tpu.stages.model import GBTClassifier
+
+        monkeypatch.delenv("TT_SPLIT", raising=False)
+        monkeypatch.delenv("TT_OP406_ROWS", raising=False)
+        est = GBTClassifier().with_mesh(self._data_mesh())
+        assert "OP406" not in _codes(analyze_plan([self._plan(est)]))
+
+    def test_unmeshed_and_model_axis_clean(self):
+        from transmogrifai_tpu.stages.model import XGBoostClassifier
+
+        est = XGBoostClassifier(reg_alpha=0.5)
+        assert "OP406" not in _codes(analyze_plan([self._plan(est)]))
+        est = XGBoostClassifier(reg_alpha=0.5).with_mesh(
+            self._data_mesh(n_data=1, n_model=8))
+        assert "OP406" not in _codes(analyze_plan([self._plan(est)]))
+
+    def test_rows_hint_flags_non_divisible_sharding(self, monkeypatch):
+        from transmogrifai_tpu.stages.model import GBTClassifier
+
+        monkeypatch.setenv("TT_OP406_ROWS", "1001")
+        est = GBTClassifier().with_mesh(self._data_mesh())
+        diags = analyze_plan([self._plan(est)]).by_code("OP406")
+        assert diags and "weight-0" in diags[0].message
+        monkeypatch.setenv("TT_OP406_ROWS", "1024")
+        est = GBTClassifier().with_mesh(self._data_mesh())
+        assert "OP406" not in _codes(analyze_plan([self._plan(est)]))
 
 
 # --- Workflow.train gate: fail at plan time, zero data, zero traces -------------------

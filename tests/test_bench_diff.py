@@ -63,6 +63,20 @@ def test_direction_inference():
     assert bench_diff.lower_is_better("autopilot_time_to_promote_s")
     assert not bench_diff.lower_is_better("autopilot_recovered_aupr")
     assert not bench_diff.lower_is_better("autopilot_drifted_aupr")
+    # the data-axis sharded GBT lane: the efficiency headline and the
+    # per-shape throughputs are higher-better; a fall back to the replicated
+    # row path shows up as an efficiency collapse, so the direction must not
+    # silently flip if the metric is renamed off the "scaling_" prefix
+    assert not bench_diff.lower_is_better("gbt_data_axis_efficiency")
+    assert not bench_diff.lower_is_better(
+        "multichip_gbt_rows_trees_per_sec_8x1")
+    assert not bench_diff.lower_is_better(
+        "multichip_gbt_rows_trees_per_sec_4x2")
+    # the ingest compression arm: both the zlib end-to-end throughput and
+    # the wire-byte shrink ratio (plain/deflated) are higher-better
+    assert not bench_diff.lower_is_better("colbatch_zlib_rows_per_sec")
+    assert not bench_diff.lower_is_better(
+        "multitenant_compression_wire_ratio")
 
 
 def test_cold_start_compile_events_zero_baseline():

@@ -474,3 +474,127 @@ class TestIngestServeCli:
             proc.terminate()
             proc.wait(timeout=15)
         assert proc.returncode == 0
+
+
+# --- compressed columnar frames ---------------------------------------------------------
+class TestCompressedFrames:
+    def test_zlib_roundtrip_exact(self):
+        rows = [
+            {"a": "1.5", "b": "", "c": None},
+            {"a": "x,\ny", "b": "héllo", "c": "0"},
+            {"a": None, "b": "zz", "c": ""},
+        ]
+        meta, buffers = encode_columns(rows, compression="zlib")
+        assert meta["compression"] == "zlib"
+        assert decode_columns(meta, buffers) == rows
+        # the stamp is self-describing: no out-of-band flag needed to decode
+        plain_meta, plain_buffers = encode_columns(rows)
+        assert "compression" not in plain_meta
+        assert decode_columns(plain_meta, plain_buffers) == rows
+
+    def test_zlib_shrinks_repetitive_batches(self):
+        big = [{"a": "abcabc" * 40, "b": "7" * 30} for _ in range(200)]
+        _, plain = encode_columns(big)
+        _, packed = encode_columns(big, compression="zlib")
+        assert sum(map(len, packed)) < sum(map(len, plain)) / 5
+
+    def test_unknown_compression_rejected(self):
+        with pytest.raises(ValueError):
+            encode_columns([{"a": "1"}], compression="lz4")
+
+    def test_compressed_wire_end_to_end(self, tmp_path):
+        # workers deflate COLBATCH, consumer negotiated zlib JOB_BATCH:
+        # both wire edges carry compressed buffers, rows stay exact
+        d = _write_dir(str(tmp_path / "s"), n_files=4)
+        spec = CsvDirSource(d, batch_size=3)
+        reg = obs.MetricsRegistry()
+        svc = IngestService(registry=reg).start()
+        try:
+            svc.launch_local_workers(2, compress=True)
+            client = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                                  n_shards=2, compression="zlib")
+            assert _drain(client) == _expected_rows(spec)
+            assert _counter("ingest_compressed_batches_total",
+                            {"edge": "worker"}, reg) > 0
+            assert _counter("ingest_compressed_batches_total",
+                            {"edge": "consumer"}, reg) > 0
+        finally:
+            svc.close()
+
+    def test_unnegotiated_consumer_gets_plain_buffers(self, tmp_path):
+        # workers deflate, but the consumer did NOT ask for compression:
+        # the service inflates at the delivery edge (old consumers never
+        # see a stamped frame) and the rows stay exact
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=3)
+        reg = obs.MetricsRegistry()
+        svc = IngestService(registry=reg).start()
+        try:
+            svc.launch_local_workers(2, compress=True)
+            client = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                                  n_shards=2)
+            assert _drain(client) == _expected_rows(spec)
+            assert _counter("ingest_compressed_batches_total",
+                            {"edge": "worker"}, reg) > 0
+            assert _counter("ingest_compressed_batches_total",
+                            {"edge": "consumer"}, reg) == 0
+        finally:
+            svc.close()
+
+
+# --- per-job epochs over the shared cache -----------------------------------------------
+class TestEpochReplay:
+    def test_epoch_replay_byte_identical_no_relist(self, tmp_path):
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=3)
+        cache = str(tmp_path / "cache")
+        reg = obs.MetricsRegistry()
+        svc = IngestService(registry=reg).start()
+        try:
+            svc.launch_local_workers(2, cache_dir=cache)
+            c0 = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                              n_shards=2, epoch=0, close_on_eof=False)
+            first = _drain(c0)
+            assert first == _expected_rows(spec)
+            misses0 = _counter("ingest_cache_misses_total", registry=reg)
+            assert misses0 >= 3  # cold cache: every file was a miss
+
+            # a file added AFTER registration must be invisible to the
+            # replay: the listing froze at job creation and an epoch
+            # re-attach must NOT re-list the source
+            with open(os.path.join(d, "z-late.csv"), "w", newline="") as fh:
+                fh.write("x1,cat\n9.9,z\n")
+
+            c1 = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                              n_shards=2, epoch=1)
+            second = _drain(c1)
+            assert second == first  # byte-identical, late file invisible
+            assert _counter("ingest_epoch_replays_total", registry=reg) == 1
+            # the replay re-parsed NOTHING: every file came back from the
+            # materialized-feature cache
+            assert _counter("ingest_cache_hits_total", registry=reg) >= 3
+            assert _counter("ingest_cache_misses_total",
+                            registry=reg) == misses0
+        finally:
+            svc.close()
+
+    def test_same_epoch_reattach_resumes_not_replays(self, tmp_path):
+        # a reconnect with the SAME epoch is the existing resume path:
+        # frontier preserved, no replay counter
+        d = _write_dir(str(tmp_path / "s"), n_files=3)
+        spec = CsvDirSource(d, batch_size=3)
+        reg = obs.MetricsRegistry()
+        svc = IngestService(registry=reg).start()
+        try:
+            svc.launch_local_workers(2)
+            c0 = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                              n_shards=2, close_on_eof=False)
+            first = _drain(c0)
+            c1 = IngestClient(svc.address, "job", spec, plan_fp="fp",
+                              n_shards=2, epoch=0)
+            # frontier is already at EOF: the re-attach delivers nothing new
+            assert _drain(c1) == []
+            assert _counter("ingest_epoch_replays_total", registry=reg) == 0
+            assert first == _expected_rows(spec)
+        finally:
+            svc.close()

@@ -355,3 +355,105 @@ class TestServingRouting:
         streamed = list(fn.stream(iter(batches)))
         direct = [fn.batch(b) for b in batches]
         assert streamed == direct
+
+
+class TestGBTDataAxis:
+    """r14: GBT/forest rows sharded over DATA_AXIS inside the fused
+    histogram->split program — per-device partial histograms, psum-merged
+    stats, split scan on the merged histogram. Split DECISIONS are pinned
+    BITWISE to the unmeshed fit; gains/leaves are allclose-only (psum
+    order ulp)."""
+
+    def _xy(self, n=1024, d=8, seed=0, weighted=False):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] ** 2
+             + rng.normal(scale=0.1, size=n) > 0.3).astype(np.float32)
+        w = (rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+             if weighted else None)
+        return X, y, w
+
+    def test_split_decisions_bitwise_across_shapes(self):
+        from transmogrifai_tpu.ops.trees import fit_gbt
+
+        X, y, _ = self._xy()
+        kw = dict(objective="binary", n_trees=3, max_depth=3, n_bins=16)
+        ref = fit_gbt(X, y, **kw)
+        for shape in ((8, 1), (4, 2), (1, 8)):
+            got = fit_gbt(X, y, mesh=make_mesh(*shape), **kw)
+            assert (np.asarray(got.split_feature)
+                    == np.asarray(ref.split_feature)).all(), shape
+            assert (np.asarray(got.split_threshold)
+                    == np.asarray(ref.split_threshold)).all(), shape
+            np.testing.assert_allclose(np.asarray(got.leaf_values),
+                                       np.asarray(ref.leaf_values),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_fused_vs_twopass_identity_under_shard_map(self, monkeypatch):
+        """Weighted rows, 1000 rows (does NOT divide 8): the sharded fused
+        program must pick the splits the two-pass backend picks."""
+        from transmogrifai_tpu.ops.trees import fit_gbt
+
+        X, y, w = self._xy(n=1000, weighted=True, seed=2)
+        kw = dict(objective="binary", n_trees=3, max_depth=3, n_bins=16)
+        monkeypatch.setenv("TT_SPLIT", "twopass")
+        ref = fit_gbt(X, y, w, **kw)
+        monkeypatch.delenv("TT_SPLIT")
+        for shape in ((8, 1), (4, 2)):
+            got = fit_gbt(X, y, w, mesh=make_mesh(*shape), **kw)
+            assert (np.asarray(got.split_feature)
+                    == np.asarray(ref.split_feature)).all(), shape
+            assert (np.asarray(got.split_threshold)
+                    == np.asarray(ref.split_threshold)).all(), shape
+
+    def test_multiclass_forced_mxu_kernel(self, monkeypatch):
+        """TT_HIST=mxu forces the double-buffered DMA partial-histogram
+        kernel (interpret mode off-TPU) inside shard_map; multiclass C=3
+        widens the gradient channels and 700 rows do not divide 4."""
+        from transmogrifai_tpu.ops.trees import fit_gbt
+
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(700, 6)).astype(np.float32)
+        y = rng.integers(0, 3, size=700)
+        kw = dict(objective="multiclass", num_classes=3, n_trees=2,
+                  max_depth=2, n_bins=8)
+        monkeypatch.setenv("TT_HIST", "mxu")
+        monkeypatch.setenv("TT_SPLIT", "fused")
+        ref = fit_gbt(X, y, **kw)
+        got = fit_gbt(X, y, mesh=make_mesh(4, 2), **kw)
+        assert (np.asarray(got.split_feature)
+                == np.asarray(ref.split_feature)).all()
+        assert (np.asarray(got.split_threshold)
+                == np.asarray(ref.split_threshold)).all()
+
+    def test_forest_and_single_device_degeneration(self):
+        from transmogrifai_tpu.ops.trees import fit_forest, fit_gbt
+
+        X, y, _ = self._xy(n=512, d=6, seed=3)
+        fkw = dict(objective="classification", num_classes=2, n_trees=2,
+                   max_depth=3, n_bins=8)
+        reff = fit_forest(X, y, **fkw)
+        gotf = fit_forest(X, y, mesh=make_mesh(8, 1), **fkw)
+        assert (np.asarray(gotf.split_feature)
+                == np.asarray(reff.split_feature)).all()
+        # a 1x1 mesh degenerates to the exact pre-PR program: BITWISE equal
+        kw = dict(objective="binary", n_trees=3, max_depth=3, n_bins=16)
+        ref = fit_gbt(X, y, **kw)
+        got1 = fit_gbt(X, y, mesh=make_mesh(1, 1), **kw)
+        assert (np.asarray(got1.leaf_values)
+                == np.asarray(ref.leaf_values)).all()
+
+    def test_sharded_fit_steady_state_no_retrace(self):
+        """Repeat fits at the same shapes reuse the compiled sharded
+        programs — zero steady-state compiles."""
+        from transmogrifai_tpu.ops.trees import fit_gbt
+
+        X, y, _ = self._xy(n=512, d=6, seed=5)
+        mesh = make_mesh(n_data=8, n_model=1)
+        kw = dict(objective="binary", n_trees=2, max_depth=3, n_bins=8)
+        for _ in range(2):  # cold + settle
+            jax.block_until_ready(
+                fit_gbt(X, y, mesh=mesh, **kw).leaf_values)
+        with obs.retrace_budget(0):
+            jax.block_until_ready(
+                fit_gbt(X, y, mesh=mesh, **kw).leaf_values)

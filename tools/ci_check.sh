@@ -55,17 +55,26 @@ python bench_multichip.py --quick --out /tmp/_multichip_new.json \
     > /tmp/_multichip_ci.json.out
 tail -1 /tmp/_multichip_ci.json.out
 # absolute floor (the acceptance criterion): the gated stats/scoring lanes
-# must hold scaling_efficiency >= 0.6 on the 8 forced host devices
+# AND the data-axis sharded GBT lane must hold efficiency >= 0.6 on the 8
+# forced host devices. (Bitwise split-decision parity for the GBT data-axis
+# lane is enforced by bench_multichip itself — any parity_error exits 1
+# before this check runs.) The data-axis key must also be PRESENT: a lane
+# that silently fell back to the replicated row path would emit no number
+# and sail past a None-tolerant check.
 tail -1 /tmp/_multichip_ci.json.out | python -c '
 import json, sys
 doc = json.load(sys.stdin)
 s = doc.get("summary", {})
 bad = {k: s[k] for k in ("multichip_stats_scaling_efficiency",
-                         "multichip_scoring_scaling_efficiency")
+                         "multichip_scoring_scaling_efficiency",
+                         "gbt_data_axis_efficiency")
        if s.get(k) is not None and s[k] < 0.6}
 if bad:
     sys.exit("multichip scaling_efficiency below the 0.6 floor: %s" % bad)
-print("multichip efficiency floor ok: value=%s" % doc.get("value"))
+if s.get("gbt_data_axis_efficiency") is None:
+    sys.exit("gbt_data_axis_efficiency missing from the multichip summary")
+print("multichip efficiency floor ok: value=%s gbt_data_axis=%s"
+      % (doc.get("value"), s.get("gbt_data_axis_efficiency")))
 '
 # relative gate against the newest MULTICHIP record (report-only unless
 # CI_BENCH_STRICT=1, mirroring the BENCH gate below; pre-lane stub records

@@ -95,6 +95,13 @@ OP405 = _rule("OP405", "replicated optimizer state exceeds per-device HBM",
               "replicated-state OOM the sharded optimizer "
               "(shard_optimizer='auto' on a multi-device mesh) exists to "
               "avoid")
+OP406 = _rule("OP406", "data-axis mesh attached but GBT fused split falls "
+              "back", "warn",
+              "a tree-family fit is planned on a mesh with a >1 data axis, "
+              "but its config disables the fused data-axis histogram->split "
+              "program (psum'd partial stats, ops/trees.py) — the fit "
+              "silently runs the replicated single-device row path and the "
+              "data axis buys nothing")
 
 
 def make_diag(code: str, message: str, **kw) -> Diagnostic:
@@ -574,6 +581,81 @@ def pass_optimizer_state(ctx: PlanContext) -> Iterator[Diagnostic]:
                  "the hidden layers")
 
 
+# --- OP406: data-axis mesh vs the GBT fused-split gates -------------------------------
+
+#: tree families whose fit threads the data axis (stages/model/trees.py)
+_OP406_TREE_OPS = frozenset({
+    "gbtClassifier", "gbtRegressor", "xgboostClassifier", "xgboostRegressor",
+    "randomForestClassifier", "randomForestRegressor",
+})
+
+
+def pass_tree_mesh(ctx: PlanContext) -> Iterator[Diagnostic]:
+    """OP406: tree-family estimators with an ATTACHED multi-data-axis mesh
+    whose config trips one of the data-axis gates in `_fit_gbt`/`fit_forest`
+    (ops/trees.py): L1 regularization pins the two-pass split backend,
+    n_bins < 2 leaves nothing to scan, and TT_SPLIT=twopass force-disables
+    the fused program outright. Any of these silently demotes the fit to the
+    replicated row path — every device holds every row, and the data axis
+    the mesh was built for does no work. Optional planning hint
+    TT_OP406_ROWS (the expected training row count) additionally flags
+    non-divisible row sharding: the fit still runs (weight-0 padding), but
+    subsample/bootstrap draws include the pad rows, a documented stochastic
+    difference from the unmeshed fit."""
+    import os
+
+    from ..mesh import data_axis_size
+
+    for s in ctx.stages():
+        if not isinstance(s, Estimator):
+            continue
+        if getattr(s, "operation_name", None) not in _OP406_TREE_OPS:
+            continue
+        mesh = getattr(s, "mesh", None)
+        n_data = data_axis_size(mesh)
+        if n_data <= 1:
+            continue
+        name = type(s).__name__
+        why = None
+        if float(s.params.get("reg_alpha", 0.0) or 0.0) != 0.0:
+            why = (f"reg_alpha={s.params['reg_alpha']} pins the two-pass L1 "
+                   "split backend, which the data-axis program does not "
+                   "speak")
+        elif int(s.params.get("n_bins", 32) or 0) < 2:
+            why = (f"n_bins={s.params.get('n_bins')} leaves no candidate "
+                   "bins to scan, so the fused histogram->split program is "
+                   "unsupported")
+        elif os.environ.get("TT_SPLIT") == "twopass":
+            why = "TT_SPLIT=twopass force-disables the fused split program"
+        if why is not None:
+            yield make_diag(
+                "OP406",
+                f"{name} is planned on a {n_data}-wide data-axis mesh but "
+                f"{why}: the fit replicates every row to every device",
+                stage_uid=s.uid,
+                hint="drop reg_alpha to 0 / raise n_bins to >= 2 / unset "
+                     "TT_SPLIT so the fused data-axis histogram->split "
+                     "program engages, or train this stage unmeshed")
+            continue
+        rows_hint = os.environ.get("TT_OP406_ROWS")
+        if rows_hint:
+            try:
+                n_rows = int(rows_hint)
+            except ValueError:
+                continue
+            if n_rows > 0 and n_rows % n_data:
+                yield make_diag(
+                    "OP406",
+                    f"{name}: the planned {n_rows} training rows do not "
+                    f"divide the {n_data}-wide data axis — the fit pads "
+                    "with weight-0 rows (exact splits), but "
+                    "subsample/bootstrap draws then include the pad rows, "
+                    "a stochastic difference from the unmeshed fit",
+                    stage_uid=s.uid,
+                    hint="pad or trim the training table to a multiple of "
+                         "the data-axis size for draw-identical sampling")
+
+
 def _plain_params(obj):
     """Params -> comparable plain values (callables by qualified name)."""
     if isinstance(obj, dict):
@@ -591,4 +673,4 @@ def _plain_params(obj):
 
 #: pass registry, run in order by the analyzer
 PASSES = (pass_uniqueness, pass_kinds, pass_retrace, pass_leakage,
-          pass_hygiene, pass_optimizer_state)
+          pass_hygiene, pass_optimizer_state, pass_tree_mesh)

@@ -29,7 +29,12 @@ has the full fault matrix.
 from .cache import FeatureCache, cache_key
 from .client import IngestClient, read_service_stats
 from .coordinator import IngestCoordinator
-from .frames import decode_columns, encode_columns
+from .frames import (
+    compress_buffers,
+    decode_columns,
+    decompress_buffers,
+    encode_columns,
+)
 from .service import AutoscaleConfig, IngestError, IngestService
 from .source import CsvDirSource, source_from_wire
 from .transport import FrameError, recv_frame, send_frame
@@ -46,7 +51,9 @@ __all__ = [
     "IngestService",
     "IngestWorker",
     "cache_key",
+    "compress_buffers",
     "decode_columns",
+    "decompress_buffers",
     "encode_columns",
     "read_service_stats",
     "recv_frame",

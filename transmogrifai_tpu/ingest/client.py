@@ -46,6 +46,8 @@ class IngestClient:
                  plan_fp: Optional[str] = None,
                  n_shards: Optional[int] = None,
                  epoch: int = 0,
+                 compression: Optional[str] = None,
+                 close_on_eof: bool = True,
                  policy: Optional[FaultPolicy] = None,
                  registry=None):
         if isinstance(address, str):
@@ -57,6 +59,13 @@ class IngestClient:
         self.plan_fp = plan_fp or "unfingerprintable"
         self.n_shards = n_shards
         self.epoch = int(epoch)
+        #: ask the service to zlib-deflate JOB_BATCH buffers ("zlib") — a
+        #: negotiated wire option; decode is self-describing either way
+        self.compression = compression
+        #: False = DETACH at JOB_EOF (drop the socket, send no JOB_CLOSE):
+        #: the job stays registered with the service so a later JOB_OPEN
+        #: with epoch+1 replays the same frozen listing as a new epoch
+        self.close_on_eof = bool(close_on_eof)
         self.policy = policy if policy is not None else FaultPolicy(
             retry_max=8, backoff_base_s=0.05, backoff_cap_s=1.0)
         self._reg = registry if registry is not None else obs.default_registry()
@@ -75,6 +84,8 @@ class IngestClient:
             payload["source"] = self.source.to_wire()
         if self.n_shards:
             payload["n_shards"] = int(self.n_shards)
+        if self.compression:
+            payload["options"] = {"compression": self.compression}
         return payload
 
     def _connect(self) -> socket.socket:
@@ -133,6 +144,18 @@ class IngestClient:
                 pass
             self._sock = None
 
+    def detach(self) -> None:
+        """Drop the connection WITHOUT unregistering the job (no JOB_CLOSE):
+        the service keeps the job's frozen listing and frontier, so a new
+        client can re-attach — same epoch resumes, epoch+1 replays."""
+        self._stopped = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
     def __enter__(self) -> "IngestClient":
         return self
 
@@ -183,7 +206,10 @@ class IngestClient:
                     self.cursor = (f + 1, 0)
                 self._ack()
             elif kind == transport.JOB_EOF:
-                self.close()
+                if self.close_on_eof:
+                    self.close()
+                else:
+                    self.detach()
                 return
             elif kind == transport.JOB_ERROR:
                 raise IngestError(f"{payload.get('type')}: "

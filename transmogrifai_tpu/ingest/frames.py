@@ -27,22 +27,53 @@ row-JSON payload — never a lossy encode.
 Consumers that build Columns directly can ask `decode_columns(...,
 mode="columns")` for `(fields, [values...])` and skip the row-dict
 materialization entirely.
+
+Compression is per-BUFFER zlib, opt-in and self-describing: an encoder
+asked for `compression="zlib"` deflates every buffer and stamps
+`meta["compression"] = "zlib"`; `decode_columns` inflates whenever the
+stamp is present, so a decoder never needs out-of-band negotiation to read
+a frame. Negotiation exists only to PROTECT old consumers: the service
+sends compressed JOB_BATCH buffers only to consumers whose JOB_OPEN
+`options` asked for them, and inflates stored-compressed payloads for
+everyone else. The codec stays EXACT either way — zlib round-trips bytes,
+so the byte-identity pin above is untouched.
 """
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import Optional
 
+#: the only compression scheme the frame codec speaks (meta["compression"])
+COMPRESSION_ZLIB = "zlib"
 
-def encode_columns(rows: list) -> Optional[tuple[dict, list[bytes]]]:
+
+def compress_buffers(buffers: list, level: int = 6) -> list[bytes]:
+    """Deflate each per-column buffer independently (so a consumer that goes
+    straight to Columns can inflate lazily, column by column)."""
+    return [zlib.compress(bytes(b), level) for b in buffers]
+
+
+def decompress_buffers(buffers: list) -> list[bytes]:
+    return [zlib.decompress(bytes(b)) for b in buffers]
+
+
+def encode_columns(rows: list, *, compression: Optional[str] = None
+                   ) -> Optional[tuple[dict, list[bytes]]]:
     """Encode a batch of {str: str|None} rows as (meta, buffers) — one
     offsets buffer + one data buffer per field, in field order. Returns None
     when the batch is not exactly representable (the caller then sends the
-    legacy row payload)."""
+    legacy row payload). `compression="zlib"` deflates every buffer and
+    stamps the meta so decode is self-describing."""
+    if compression not in (None, COMPRESSION_ZLIB):
+        raise ValueError(f"unknown frame compression {compression!r}")
     if not isinstance(rows, list):
         return None
     if not rows:
-        return {"fields": [], "n": 0, "nulls": {}}, []
+        meta = {"fields": [], "n": 0, "nulls": {}}
+        if compression:
+            meta["compression"] = compression
+        return meta, []
     first = rows[0]
     if not isinstance(first, dict):
         return None
@@ -72,7 +103,11 @@ def encode_columns(rows: list) -> Optional[tuple[dict, list[bytes]]]:
             meta_nulls[str(ci)] = nulls
         buffers.append(struct.pack(f"<{n + 1}I", *offsets))
         buffers.append("".join(parts).encode("utf-8"))
-    return {"fields": fields, "n": n, "nulls": meta_nulls}, buffers
+    meta = {"fields": fields, "n": n, "nulls": meta_nulls}
+    if compression:
+        meta["compression"] = compression
+        buffers = compress_buffers(buffers)
+    return meta, buffers
 
 
 def decode_columns(meta: dict, buffers: list, mode: str = "rows"):
@@ -81,6 +116,8 @@ def decode_columns(meta: dict, buffers: list, mode: str = "rows"):
     lists]) for consumers that go straight to Columns."""
     fields = meta["fields"]
     n = int(meta["n"])
+    if meta.get("compression") == COMPRESSION_ZLIB:
+        buffers = decompress_buffers(buffers)
     nulls = {int(k): frozenset(v) for k, v in (meta.get("nulls") or {}).items()}
     cols: list[list] = []
     for ci in range(len(fields)):

@@ -59,7 +59,12 @@ from typing import Callable, Iterator, Optional
 from .. import obs
 from ..resilience import chaos
 from . import transport
-from .frames import payload_nrows, payload_rows
+from .frames import (
+    compress_buffers,
+    decompress_buffers,
+    payload_nrows,
+    payload_rows,
+)
 from .source import source_from_wire
 from .worker import IngestWorker, extract_shard
 
@@ -180,6 +185,10 @@ class _Job:
         #: bumped on every attach/detach so a superseded sender thread
         #: notices and exits even if it holds the same conn object
         self.conn_gen = 0
+        #: negotiated JOB_BATCH buffer compression ("zlib" or None) — set
+        #: from the consumer's JOB_OPEN options on every attach; stored
+        #: payloads are re/de-flated at the delivery edge to match
+        self.wire_compression: Optional[str] = None
         self.eof_sent = False
         self.self_extracting: set[int] = set()
         self.max_buffered = int(max_buffered)
@@ -362,7 +371,8 @@ class IngestService:
         return list(self._procs)
 
     def launch_local_workers(self, n: int,
-                             cache_dir: Optional[str] = None) -> list:
+                             cache_dir: Optional[str] = None,
+                             compress: bool = False) -> list:
         """n worker THREADS over real localhost sockets — the same protocol
         path as subprocesses, minus the process boundary (unit tests)."""
         host, port = self.address
@@ -371,7 +381,7 @@ class IngestService:
         for i in range(int(n)):
             w = IngestWorker((host, port),
                              worker_id=f"thr-{len(self._local_workers)}",
-                             cache_dir=cache)
+                             cache_dir=cache, compress=compress)
             t = threading.Thread(target=w.run, daemon=True,
                                  name=f"ingest-worker-{i}")
             t.start()
@@ -874,6 +884,14 @@ class IngestService:
             # the buffers verbatim to the consumer)
             meta = {"fields": payload["fields"], "n": payload["n"],
                     "nulls": payload.get("nulls") or {}}
+            if payload.get("compression"):
+                # keep the worker's deflated buffers AS-IS: the delivery
+                # edge (frames.decode_columns / the sender's negotiation)
+                # inflates, so the buffer holds the small form
+                meta["compression"] = payload["compression"]
+                self._counter("ingest_compressed_batches_total",
+                              "zlib-compressed columnar batches crossing "
+                              "an ingest wire edge", edge="worker").inc()
             data = (meta, [bytes(b) for b in payload["__buffers__"]])
         else:
             data = payload["rows"]
@@ -1093,6 +1111,31 @@ class IngestService:
                 job.conn_gen += 1
                 if old is not None and old is not conn:
                     _sever(old)  # kick a superseded consumer connection
+                req_epoch = int(payload.get("epoch", 0))
+                if req_epoch > job.epoch:
+                    # EPOCH REPLAY: re-stream the SAME frozen file listing
+                    # from the start — the listing is NOT re-registered
+                    # (source.list_files() ran exactly once, at job
+                    # creation), and extraction replays through the
+                    # workers' materialized-feature cache, so the second
+                    # pass re-parses nothing and is byte-identical to the
+                    # first (the cache key is content-addressed, the chunk
+                    # ordinals deterministic).
+                    job.epoch = req_epoch
+                    job.acked = [0, 0]
+                    job.emit = [0, 0]
+                    job.committed = set()
+                    job.buffer = {}
+                    job.shards_done = set()
+                    job.file_chunks = {}
+                    job.eof_sent = False
+                    job.error = None
+                    self._counter("ingest_epoch_replays_total",
+                                  "JOB_OPEN re-attaches that replayed an "
+                                  "already-streamed listing as a new "
+                                  "epoch").inc()
+                    obs.add_event("ingest:job_epoch_replay", job=jid,
+                                  epoch=req_epoch)
                 # attach-reset: resume delivery from the acked frontier.
                 # Anything sent-but-unacked was popped from the buffer and
                 # may be lost with the old connection, so the committed set
@@ -1113,6 +1156,11 @@ class IngestService:
                             and (jid, s) not in self._leases
                             and s not in job.self_extracting):
                         self._requeue(job, s, front=False)
+            # per-attach option negotiation: compressed JOB_BATCH buffers go
+            # only to consumers that asked (old consumers keep plain frames)
+            opts = payload.get("options") or {}
+            job.wire_compression = ("zlib" if opts.get("compression")
+                                    == "zlib" else None)
             job.conn = conn
             gen = job.conn_gen
             self._cond.notify_all()
@@ -1223,6 +1271,19 @@ class IngestService:
                         cmeta, buffers = data
                         meta.update(fields=cmeta["fields"], n=cmeta["n"],
                                     nulls=cmeta.get("nulls") or {})
+                        stored = cmeta.get("compression")
+                        want = job.wire_compression
+                        if want and not stored:
+                            buffers = compress_buffers(buffers)
+                        elif stored and not want:
+                            buffers = decompress_buffers(buffers)
+                        if want:
+                            meta["compression"] = want
+                            self._counter(
+                                "ingest_compressed_batches_total",
+                                "zlib-compressed columnar batches crossing "
+                                "an ingest wire edge",
+                                edge="consumer").inc()
                         self._send(conn, transport.JOB_BATCH, meta, buffers)
                     else:
                         meta["rows"] = data

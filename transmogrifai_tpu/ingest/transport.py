@@ -23,8 +23,11 @@ job, not a protocol-level resend. A short read (the socket died mid-frame)
 surfaces the same way as `ConnectionError`.
 
 Frame kinds are one-byte tags; both sides reject unknown tags loudly. The
-protocol is deliberately dumb: no negotiation, no compression, no pipelined
-acks — determinism and detectability over cleverness.
+protocol is deliberately dumb: no pipelined acks, and the FRAMING itself is
+never negotiated or compressed — determinism and detectability over
+cleverness. (Columnar PAYLOAD buffers may be zlib-deflated by the frames.py
+codec, but that is self-describing meta riding inside the payload — this
+layer never looks.)
 """
 from __future__ import annotations
 

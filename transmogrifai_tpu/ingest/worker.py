@@ -115,6 +115,7 @@ class IngestWorker:
                  policy: Optional[FaultPolicy] = None,
                  poll_s: float = 0.2,
                  payload: str = "columnar",
+                 compress: bool = False,
                  reconnect_max: Optional[int] = None,
                  sleep=time.sleep):
         if isinstance(address, str):
@@ -132,6 +133,9 @@ class IngestWorker:
         #: whenever the batch is exactly representable; "rows" forces the
         #: legacy row-JSON BATCH payload (the bench comparison arm)
         self.payload = payload
+        #: zlib-deflate COLBATCH buffers (frames.py codec, self-describing
+        #: meta stamp); trades worker CPU for wire bytes on remote links
+        self.compress = bool(compress)
         #: mid-run reconnect budget — DISTINCT from the first-connect budget:
         #: a worker that has already served leases should ride out a
         #: coordinator restart longer than a misconfigured address deserves
@@ -231,14 +235,17 @@ class IngestWorker:
             # the per-row JSON tokenization that dominates disagg CPU. The
             # encoder returns None for batches it cannot represent EXACTLY,
             # and those fall back to the legacy row payload — never lossy.
-            enc = (encode_columns(rows) if self.payload == "columnar"
-                   else None)
+            enc = (encode_columns(
+                rows, compression="zlib" if self.compress else None)
+                   if self.payload == "columnar" else None)
             base = {"job": job, "shard": shard, "seq": seq,
                     "file": file_index, "chunk": chunk_index, "plan": plan}
             if enc is not None:
                 meta, buffers = enc
                 base.update(fields=meta["fields"], n=meta["n"],
                             nulls=meta["nulls"])
+                if "compression" in meta:
+                    base["compression"] = meta["compression"]
                 transport.send_frame(self._sock, transport.COLBATCH,
                                      base, buffers)
             else:
@@ -299,6 +306,10 @@ def main(argv=None) -> int:
                     default="columnar",
                     help="batch wire payload: columnar COLBATCH buffers "
                          "(default) or legacy row JSON")
+    ap.add_argument("--compress", action="store_true",
+                    help="zlib-deflate the columnar buffers on the wire "
+                         "(self-describing frames.py stamp; trades worker "
+                         "CPU for bytes on remote links)")
     ap.add_argument("--seed", type=int, default=0,
                     help="backoff-jitter seed (per-worker seeds decorrelate "
                          "a fleet rejoining after a coordinator restart)")
@@ -307,7 +318,8 @@ def main(argv=None) -> int:
         args.connect, worker_id=args.worker_id, cache_dir=args.cache_dir,
         policy=FaultPolicy(retry_max=args.retry_max, backoff_base_s=0.05,
                            backoff_cap_s=1.0, seed=args.seed),
-        payload=args.payload, reconnect_max=args.reconnect_max)
+        payload=args.payload, compress=args.compress,
+        reconnect_max=args.reconnect_max)
     worker.run()
     return 0
 

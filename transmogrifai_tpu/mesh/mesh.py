@@ -151,6 +151,33 @@ def auto_mesh(mesh_shape: Union[None, str, Sequence[int]] = None,
     return make_mesh(n_data=n_data, n_model=n_model, devices=devices)
 
 
+def data_axis_size(mesh: Optional[Mesh]) -> int:
+    """Data-axis extent of a possibly-absent mesh (1 = unmeshed) — the gate
+    every data-sharded code path keys on (trees' sharded split finding, the
+    OP406 lint)."""
+    return 1 if mesh is None else int(mesh.shape[DATA_AXIS])
+
+
+def mesh_shard_map(body, mesh: Mesh, in_specs, out_specs):
+    """Version-portable `shard_map` over this mesh with replication checking
+    OFF — the tree lane's sharded split program carries a pallas_call, for
+    which shard_map has no replication rule (check_rep=True raises
+    NotImplementedError); correctness of the replicated outputs is carried by
+    the psum that precedes them. Newer jax renames the flag (check_vma) and
+    promotes shard_map out of jax.experimental — both spellings are tried so
+    the call sites never version-switch."""
+    try:
+        from jax.experimental.shard_map import shard_map as _sm
+    except ImportError:  # jax >= 0.8: promoted to the top-level namespace
+        _sm = jax.shard_map
+    try:
+        return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    except TypeError:
+        return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+
+
 def use_mesh(mesh: Mesh):
     """Version-portable ambient-mesh context: `jax.set_mesh` where it exists
     (jax >= 0.6), falling back to the classic `Mesh` context manager. Only

@@ -147,6 +147,42 @@ def fused_split_supported(n_rows: int, n_feats: int, n_nodes: int,
         n_rows, n_feats, n_nodes, n_channels, n_bins)
 
 
+def _scan_best_split(cell, lam, mcw, *, n_bins, n_nodes, V):
+    """The candidate-bin scan shared by every fused split consumer: `cell(b, v)`
+    reads the [n_nodes, Dp] histogram slab of (bin b, channel v) — from the
+    fused kernel's VMEM scratch, or from a psum-merged histogram under the
+    data-axis shard_map (r14). One arithmetic, one tie-break rule (strict ->
+    update = argmax-first-max), so decisions agree bitwise across all of them
+    when scored on the same histogram values."""
+    C = V // 2  # channels: first C are gradients, last C hessians
+    tot = []  # per-node totals per channel (the Gt/Ht of the gain)
+    for v in range(V):
+        t = cell(0, v)
+        for b in range(1, n_bins):
+            t = t + cell(b, v)
+        tot.append(t)
+    sT = sum(tot[c] ** 2 / (tot[C + c] + lam + _SPLIT_EPS)
+             for c in range(C))
+    cum = [cell(0, v) for v in range(V)]  # inclusive cumsum at bin 0
+    best_gain = jnp.full(cum[0].shape, -jnp.inf, jnp.float32)
+    best_bin = jnp.zeros(cum[0].shape, jnp.int32)
+    for b in range(n_bins - 1):  # last bin is never a valid split
+        if b > 0:
+            cum = [cum[v] + cell(b, v) for v in range(V)]
+        sL = sum(cum[c] ** 2 / (cum[C + c] + lam + _SPLIT_EPS)
+                 for c in range(C))
+        sR = sum((tot[c] - cum[c]) ** 2
+                 / ((tot[C + c] - cum[C + c]) + lam + _SPLIT_EPS)
+                 for c in range(C))
+        hl = sum(cum[C + c] for c in range(C))
+        hr = sum(tot[C + c] - cum[C + c] for c in range(C))
+        g = jnp.where((hl >= mcw) & (hr >= mcw), sL + sR - sT, -jnp.inf)
+        upd = g > best_gain  # strict: first max wins, like argmax
+        best_gain = jnp.where(upd, g, best_gain)
+        best_bin = jnp.where(upd, b, best_bin)
+    return best_gain, best_bin
+
+
 def _hist_split_kernel(node_ref, vals_ref, xb_ref, scal_ref, gain_ref,
                        bin_ref, acc_ref, *, n_bins, n_nodes, V):
     """Fused histogram build + split finding: grid steps accumulate row tiles
@@ -172,7 +208,6 @@ def _hist_split_kernel(node_ref, vals_ref, xb_ref, scal_ref, gain_ref,
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _split():
-        C = V // 2  # channels: first C are gradients, last C hessians
         M = V * n_nodes
         lam = scal_ref[0, 0]
         mcw = scal_ref[0, 1]
@@ -180,31 +215,8 @@ def _hist_split_kernel(node_ref, vals_ref, xb_ref, scal_ref, gain_ref,
         def cell(b, v):  # [n_nodes, Dp] histogram slab of (bin b, channel v)
             return acc_ref[b * M + v * n_nodes:b * M + (v + 1) * n_nodes, :]
 
-        tot = []  # per-node totals per channel (the Gt/Ht of the gain)
-        for v in range(V):
-            t = cell(0, v)
-            for b in range(1, n_bins):
-                t = t + cell(b, v)
-            tot.append(t)
-        sT = sum(tot[c] ** 2 / (tot[C + c] + lam + _SPLIT_EPS)
-                 for c in range(C))
-        cum = [cell(0, v) for v in range(V)]  # inclusive cumsum at bin 0
-        best_gain = jnp.full(cum[0].shape, -jnp.inf, jnp.float32)
-        best_bin = jnp.zeros(cum[0].shape, jnp.int32)
-        for b in range(n_bins - 1):  # last bin is never a valid split
-            if b > 0:
-                cum = [cum[v] + cell(b, v) for v in range(V)]
-            sL = sum(cum[c] ** 2 / (cum[C + c] + lam + _SPLIT_EPS)
-                     for c in range(C))
-            sR = sum((tot[c] - cum[c]) ** 2
-                     / ((tot[C + c] - cum[C + c]) + lam + _SPLIT_EPS)
-                     for c in range(C))
-            hl = sum(cum[C + c] for c in range(C))
-            hr = sum(tot[C + c] - cum[C + c] for c in range(C))
-            g = jnp.where((hl >= mcw) & (hr >= mcw), sL + sR - sT, -jnp.inf)
-            upd = g > best_gain  # strict: first max wins, like argmax
-            best_gain = jnp.where(upd, g, best_gain)
-            best_bin = jnp.where(upd, b, best_bin)
+        best_gain, best_bin = _scan_best_split(
+            cell, lam, mcw, n_bins=n_bins, n_nodes=n_nodes, V=V)
         gain_ref[:] = best_gain
         bin_ref[:] = best_bin
 
@@ -259,6 +271,148 @@ def histogram_split_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
         scratch_shapes=[pltpu.VMEM((n_bins * M, Dp), jnp.float32)],
         interpret=interpret,
     )(node_p[None, :], vals_p.T, xb8, scal)
+    return gain[:, :D], best_bin[:, :D]
+
+
+def _hist_partial_kernel(node_hbm, vals_hbm, xb_hbm, out_ref, *, n_bins,
+                         n_nodes, V, n_tiles):
+    """Per-shard partial histogram with MANUAL double-buffered DMA (r14): the
+    inputs stay in ANY/HBM memory space and row tiles stream through a 2-slot
+    VMEM scratch — tile t+1's copy is IN FLIGHT while tile t runs its bin-loop
+    MXU accumulation, so under the data-axis shard_map round k+1's histogram
+    DMA overlaps round k's compute/split consumption instead of serializing
+    behind it (the automatic-pipelining analog of the gridded kernels, written
+    out by hand because this kernel owns its own tile loop). The accumulator
+    IS the output block [n_bins*V*n_nodes, Dp]: it lives in VMEM for the whole
+    program and is written back once."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+    dp = xb_hbm.shape[1]
+
+    def body(node_buf, vals_buf, xb_buf, sems):
+        def copies(t, slot):
+            return (
+                pltpu.make_async_copy(
+                    node_hbm.at[:, pl.ds(t * ROW_TILE, ROW_TILE)],
+                    node_buf.at[slot], sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    vals_hbm.at[:, pl.ds(t * ROW_TILE, ROW_TILE)],
+                    vals_buf.at[slot], sems.at[slot, 1]),
+                pltpu.make_async_copy(
+                    xb_hbm.at[pl.ds(t * ROW_TILE, ROW_TILE), :],
+                    xb_buf.at[slot], sems.at[slot, 2]),
+            )
+
+        for c in copies(0, 0):  # warm-up: slot 0's DMA starts before the loop
+            c.start()
+
+        def step(t, carry):
+            slot = jax.lax.rem(t, 2)
+
+            @pl.when(t + 1 < n_tiles)
+            def _prefetch():  # next tile -> other slot, overlapping this tile
+                for c in copies(t + 1, jax.lax.rem(t + 1, 2)):
+                    c.start()
+
+            for c in copies(t, slot):
+                c.wait()
+            _accumulate_hist(node_buf.at[slot], vals_buf.at[slot],
+                             xb_buf.at[slot], out_ref,
+                             n_bins=n_bins, n_nodes=n_nodes, V=V)
+            return carry
+
+        jax.lax.fori_loop(0, n_tiles, step, 0)
+
+    pl.run_scoped(body,
+                  node_buf=pltpu.VMEM((2, 1, ROW_TILE), jnp.int32),
+                  vals_buf=pltpu.VMEM((2, V, ROW_TILE), jnp.float32),
+                  xb_buf=pltpu.VMEM((2, ROW_TILE, dp), jnp.int8),
+                  sems=pltpu.SemaphoreType.DMA((2, 3)))
+
+
+def histogram_partial_flat_mxu(vals: jnp.ndarray, Xb: jnp.ndarray,
+                               node: jnp.ndarray, n_nodes: int, n_bins: int, *,
+                               interpret: bool = False) -> jnp.ndarray:
+    """One device's PARTIAL histogram over its row shard, in the flat VMEM
+    layout [n_bins * V * n_nodes, D] f32 (row b*M + v*n_nodes + n = bin b,
+    channel v, node n — the layout `_scan_best_split` cells index). The
+    data-axis sharded split path (ops/trees._data_axis_hist_split) calls this
+    per device inside shard_map, psums the flat stats over DATA_AXIS, and
+    scans the merged histogram with `split_scan_mxu` — only [n_nodes, D]
+    (gain, bin) ever leaves that program. Same operand discipline as
+    histogram_mxu (bf16 masks/vals, f32 accumulation, node -1 row pads,
+    bin -1 feature pads)."""
+    if n_bins > 127:
+        raise ValueError(
+            f"histogram_partial_flat_mxu supports n_bins <= 127, got {n_bins}")
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, D = Xb.shape
+    V = vals.shape[1]
+    M = V * n_nodes
+    row_pad = (-N) % ROW_TILE
+    f_pad = (-D) % 128
+    Dp = D + f_pad
+    xb8 = jnp.pad(Xb.astype(jnp.int8), ((0, row_pad), (0, f_pad)),
+                  constant_values=-1)
+    node_p = jnp.pad(node.astype(jnp.int32), (0, row_pad), constant_values=-1)
+    vals_p = jnp.pad(jnp.asarray(vals, jnp.float32), ((0, row_pad), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_hist_partial_kernel, n_bins=n_bins,
+                          n_nodes=n_nodes, V=V,
+                          n_tiles=(N + row_pad) // ROW_TILE),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
+        out_specs=pl.BlockSpec((n_bins * M, Dp), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_bins * M, Dp), jnp.float32),
+        interpret=interpret,
+    )(node_p[None, :], vals_p.T, xb8)
+    return out[:, :D]
+
+
+def _split_scan_kernel(hist_ref, scal_ref, gain_ref, bin_ref, *, n_bins,
+                       n_nodes, V):
+    M = V * n_nodes
+
+    def cell(b, v):  # [n_nodes, Dp] slab of (bin b, channel v)
+        return hist_ref[b * M + v * n_nodes:b * M + (v + 1) * n_nodes, :]
+
+    best_gain, best_bin = _scan_best_split(
+        cell, scal_ref[0, 0], scal_ref[0, 1],
+        n_bins=n_bins, n_nodes=n_nodes, V=V)
+    gain_ref[:] = best_gain
+    bin_ref[:] = best_bin
+
+
+def split_scan_mxu(hist_flat: jnp.ndarray, n_nodes: int, n_bins: int,
+                   reg_lambda, min_child_weight, *, interpret: bool = False):
+    """Split scan over an ALREADY-MERGED flat histogram [n_bins*V*n_nodes, D]
+    (the psum epilogue of the data-axis sharded path) -> (best_gain
+    [n_nodes, D] f32, best_bin [n_nodes, D] int32). Identical arithmetic and
+    tie-breaking to the fused kernel's last-step scan (`_scan_best_split` is
+    shared), so the sharded path's split decisions match the unmeshed fused
+    path's wherever the merged histograms tie-break identically. Padded
+    feature columns behave as in histogram_split_mxu (gain 0 at hl=hr=0,
+    sliced off here)."""
+    MB, D = hist_flat.shape
+    V = MB // (n_bins * n_nodes)
+    f_pad = (-D) % 128
+    Dp = D + f_pad
+    hp = jnp.pad(jnp.asarray(hist_flat, jnp.float32), ((0, 0), (0, f_pad)))
+    scal = jnp.stack([jnp.asarray(reg_lambda, jnp.float32),
+                      jnp.asarray(min_child_weight, jnp.float32)]).reshape(1, 2)
+    gain, best_bin = pl.pallas_call(
+        functools.partial(_split_scan_kernel, n_bins=n_bins, n_nodes=n_nodes,
+                          V=V),
+        in_specs=[pl.BlockSpec((MB, Dp), lambda: (0, 0)),
+                  pl.BlockSpec((1, 2), lambda: (0, 0))],
+        out_specs=[pl.BlockSpec((n_nodes, Dp), lambda: (0, 0)),
+                   pl.BlockSpec((n_nodes, Dp), lambda: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_nodes, Dp), jnp.float32),
+                   jax.ShapeDtypeStruct((n_nodes, Dp), jnp.int32)],
+        interpret=interpret,
+    )(hp, scal)
     return gain[:, :D], best_bin[:, :D]
 
 

@@ -112,6 +112,93 @@ def _model_axis_constraint(mesh, Xb, edges):
     return Xb, edges, True
 
 
+def _data_axis_hist_split(mesh, gh, Xb, node, n_nodes, n_bins, reg_lambda,
+                          min_child_weight, feature_sharded: bool,
+                          hist_mode: Optional[str] = None):
+    """Data-axis sharded fused split finding (r14): one shard_map over the
+    FULL mesh per tree level. Each device accumulates a partial histogram
+    over its row shard — on TPU via the double-buffered-DMA pallas kernel
+    (pallas_trees.histogram_partial_flat_mxu), off-TPU/forced via the jnp
+    decompositions reshaped to the same flat [n_bins*2C*n_nodes, D_local]
+    VMEM layout — then ONE psum over DATA_AXIS merges the stats over ICI
+    (the in-network aggregate-then-reduce structure, PAPERS.md 1903.06701)
+    and the split scan (pallas_trees.split_scan_mxu, sharing the fused
+    kernel's `_scan_best_split` arithmetic) runs on the merged histogram.
+    Only [n_nodes, D] (gain, best_bin) ever leaves the program, exactly like
+    the unmeshed fused kernel.
+
+    Composes data x model: with `feature_sharded` the feature axis of Xb
+    additionally lays over MODEL_AXIS (the existing _model_axis_constraint
+    placement) and each model group scans only its D/n_model feature slab —
+    the psum stays within each model group's data-axis column.
+
+    shard_map runs with replication checking off (mesh_shard_map): the body
+    carries pallas_calls, for which no replication rule exists; output
+    consistency across the data axis is established by the psum itself."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..mesh import DATA_AXIS, MODEL_AXIS, mesh_shard_map
+    from .pallas_trees import (fused_split_supported,
+                               histogram_partial_flat_mxu, split_scan_mxu)
+
+    N, D = Xb.shape
+    V = gh.shape[1]
+    n_data = int(mesh.shape[DATA_AXIS])
+    n_model = int(mesh.shape[MODEL_AXIS])
+    d_local = D // n_model if feature_sharded else D
+    tpu = backend_is_tpu()
+    mode = hist_mode if hist_mode is not None else os.environ.get("TT_HIST")
+    if mode is None:
+        # same resolution as _histogram, on the PER-DEVICE shard shapes: the
+        # partial-accumulate pallas kernel where its VMEM accumulator fits,
+        # else the partitioner-friendly jnp decompositions
+        if tpu:
+            mode = ("mxu" if fused_split_supported(
+                -(-N // n_data), d_local, n_nodes, V, n_bins) else "binmm")
+        else:
+            mode = "segsum"
+    scal = jnp.stack([jnp.asarray(reg_lambda, jnp.float32),
+                      jnp.asarray(min_child_weight, jnp.float32)]
+                     ).reshape(1, 2)
+
+    def body(gh_l, xb_l, node_l, scal_l):
+        if mode == "mxu":
+            part = histogram_partial_flat_mxu(gh_l, xb_l, node_l, n_nodes,
+                                              n_bins, interpret=not tpu)
+        else:
+            hist4 = (histogram_binmm if mode == "binmm"
+                     else histogram_segment_sum)(
+                gh_l, xb_l, node_l, n_nodes, n_bins)
+            # [n_nodes, d, bins, V] -> the flat layout the scan kernel indexes
+            part = hist4.transpose(2, 3, 0, 1).reshape(
+                n_bins * V * n_nodes, -1)
+        merged = jax.lax.psum(part, DATA_AXIS)
+        return split_scan_mxu(merged, n_nodes, n_bins, scal_l[0, 0],
+                              scal_l[0, 1], interpret=not tpu)
+
+    fspec = MODEL_AXIS if feature_sharded else None
+    fn = mesh_shard_map(
+        body, mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, fspec), P(DATA_AXIS),
+                  P(None, None)),
+        out_specs=(P(None, fspec), P(None, fspec)))
+    return fn(gh, Xb, node, scal)
+
+
+def _pad_rows_weight0(Xb, Y, w, pad: int):
+    """Grow the row axis by `pad` zero-WEIGHT copies of row 0 so it divides
+    the mesh data axis (XLA needs even shards). Weight-0 rows contribute
+    exactly zero gradient/hessian mass — histograms and leaf sums see only
+    real rows — and the repeated bin values introduce no new categories, so
+    split decisions are preserved (gains move by reduction-order ulp at
+    most). Callers compute quantile edges and the objective's base/wsum on
+    the ORIGINAL rows first: those see raw values/weights, not masses."""
+    Xb = jnp.concatenate([Xb, jnp.repeat(Xb[:1], pad, axis=0)])
+    Y = jnp.concatenate([Y, jnp.repeat(Y[:1], pad, axis=0)])
+    w = jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
+    return Xb, Y, w
+
+
 def quantile_bins(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
     """Per-feature quantile bin edges -> [D, n_bins - 1].
 
@@ -274,6 +361,8 @@ def grow_tree(
     reg_alpha=0.0,
     hist_mode: Optional[str] = None,
     split_mode: Optional[str] = None,
+    data_mesh=None,
+    data_feature_sharded: bool = False,
 ):
     """Grow one perfect tree level-by-level on binned features.
 
@@ -300,7 +389,18 @@ def grow_tree(
       a different, equally-scoring split.
 
     `hist_mode` overrides TT_HIST for the two-pass histogram (the mesh
-    model-axis path pins a partitionable jnp decomposition)."""
+    model-axis path pins a partitionable jnp decomposition).
+
+    `data_mesh` (r14): a mesh whose data axis is > 1 routes every level's
+    split finding through the SHARDED fused program (_data_axis_hist_split:
+    per-device partial histograms, one psum over DATA_AXIS, on-device merged
+    scan) under the same eligibility gates as the fused kernel (literal
+    reg_alpha 0, n_bins >= 2, not batched, TT_SPLIT != twopass);
+    `data_feature_sharded` additionally lays the feature axis over
+    MODEL_AXIS inside that program (data x model composition). Callers pass
+    ROW COUNTS divisible by the data axis (weight-0 pad via
+    _pad_rows_weight0). data_mesh=None is byte-for-byte the pre-r14
+    program."""
     N, D = Xb.shape
     n_bins = edges.shape[1] + 1
     # at-scale TPU fits swap the row-gather routing and scatter leaf sums for
@@ -323,18 +423,25 @@ def grow_tree(
     fused_ok = (smode != "twopass"
                 and isinstance(reg_alpha, (int, float)) and reg_alpha == 0
                 and n_bins >= 2 and not _is_batched(Xb, g, h))
+    use_data = data_mesh is not None and fused_ok
     for depth in range(max_depth):  # static unroll: shapes differ per level
         n_nodes = 2 ** depth
-        use_fused = fused_ok and (
+        use_fused = (not use_data) and fused_ok and (
             smode == "fused"
             or (smode is None and big and _fused_split_supported(
                 N, D, n_nodes, 2 * C, n_bins)))
-        if use_fused:
-            from .pallas_trees import histogram_split_mxu
+        if use_data or use_fused:
+            if use_data:
+                gain_nf, bin_nf = _data_axis_hist_split(
+                    data_mesh, gh, Xb, node, n_nodes, n_bins, reg_lambda,
+                    min_child_weight, data_feature_sharded,
+                    hist_mode=hist_mode)
+            else:
+                from .pallas_trees import histogram_split_mxu
 
-            gain_nf, bin_nf = histogram_split_mxu(
-                gh, Xb, node, n_nodes, n_bins, reg_lambda, min_child_weight,
-                interpret=not backend_is_tpu())
+                gain_nf, bin_nf = histogram_split_mxu(
+                    gh, Xb, node, n_nodes, n_bins, reg_lambda,
+                    min_child_weight, interpret=not backend_is_tpu())
             # colsample mask + min_gain are per-(node, feature) gates: applied
             # here on the [n_nodes, D] stats, identical to the two-pass masks
             gain_nf = jnp.where(fmask[None, :], gain_nf, -jnp.inf)
@@ -499,7 +606,20 @@ def _fit_gbt(
     `mesh` (static, r10): with a model axis > 1 that divides D, the binned
     matrix's feature axis lays over MODEL_AXIS so every round's independent
     per-feature histogram + split work partitions across it (a partitioned fit
-    is a distinct executable — warm accordingly)."""
+    is a distinct executable — warm accordingly).
+
+    Data axis (r14): with a data axis > 1 (and the fused-split gates open:
+    literal reg_alpha 0, n_bins >= 2, TT_SPLIT != twopass, not vmapped), the
+    margin/gradient ROWS shard over DATA_AXIS and every level's split finding
+    runs the shard_map'd partial-histogram -> psum -> merged-scan program
+    (_data_axis_hist_split), composing with the model-axis feature sharding
+    on a (data x model) mesh. Non-dividing row counts pad with weight-0
+    copies of row 0 AFTER quantile edges and the objective's base/wsum are
+    computed on the original rows — pad rows carry zero mass, so split
+    DECISIONS match the unmeshed fused path bitwise (gains move by psum-order
+    ulp). NOTE: subsample < 1.0 draws its keep mask over the PADDED row
+    count, so a padded fit's bootstrap differs from the unmeshed fit's —
+    a stochastic, not correctness, difference."""
     X = jnp.asarray(X, jnp.float32)
     N, D = X.shape
     w = _weights(sample_weight, N)
@@ -513,12 +633,20 @@ def _fit_gbt(
         # re-reads it, so narrowing it 4x is a direct HBM-bandwidth win
         Xb = Xb.astype(jnp.int8)
 
+    from ..mesh import data_axis_size
+
+    data_sharded = (data_axis_size(mesh) > 1 and not use_l1 and n_bins >= 2
+                    and os.environ.get("TT_SPLIT") != "twopass"
+                    and not _is_batched(X, y))
     Xb, edges, model_sharded = _model_axis_constraint(mesh, Xb, edges)
-    # pallas_calls are opaque to the SPMD partitioner: a feature-sharded fit
-    # pins the partitionable jnp decompositions instead
+    # pallas_calls are opaque to the SPMD partitioner, so a PURELY
+    # feature-sharded fit pins the partitionable jnp decompositions; the
+    # data-axis path needs no pinning — its pallas programs are
+    # partitioner-visible through shard_map (and it composes the model axis
+    # itself)
     hist_mode = (("binmm" if backend_is_tpu() else "segsum")
-                 if model_sharded else None)
-    split_mode = "twopass" if model_sharded else None
+                 if model_sharded and not data_sharded else None)
+    split_mode = ("twopass" if model_sharded and not data_sharded else None)
 
     if objective == "binary":
         Y = jnp.asarray(y, jnp.float32)[:, None]
@@ -534,6 +662,23 @@ def _fit_gbt(
     else:  # pragma: no cover
         raise ValueError(f"unknown objective {objective!r}")
     C = Y.shape[1]
+
+    if data_sharded:
+        pad = (-N) % data_axis_size(mesh)
+        if pad:
+            Xb, Y, w = _pad_rows_weight0(Xb, Y, w, pad)
+            N = N + pad
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..mesh import DATA_AXIS, MODEL_AXIS
+
+        Xb = jax.lax.with_sharding_constraint(
+            Xb, NamedSharding(mesh, P(
+                DATA_AXIS, MODEL_AXIS if model_sharded else None)))
+        Y = jax.lax.with_sharding_constraint(
+            Y, NamedSharding(mesh, P(DATA_AXIS, None)))
+        w = jax.lax.with_sharding_constraint(
+            w, NamedSharding(mesh, P(DATA_AXIS)))
 
     def grad_hess(F):
         if objective == "binary":
@@ -557,6 +702,8 @@ def _fit_gbt(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
             fmask, reg_alpha=reg_alpha if use_l1 else 0.0,  # literal 0 -> skip
             hist_mode=hist_mode, split_mode=split_mode,
+            data_mesh=mesh if data_sharded else None,
+            data_feature_sharded=model_sharded,
         )
         lv = lv * learning_rate
         return F + lv[leaf], (sf, st, lv, fg)
@@ -598,7 +745,11 @@ def fit_forest(
     variance reduction — one grower serves boosting and bagging. Classification
     targets are one-hot, so leaves hold class distributions (Gini-style splits).
     `mesh`: feature axis over MODEL_AXIS per _fit_gbt — every tree's histogram
-    rounds partition across the model axis."""
+    rounds partition across the model axis, and a data axis > 1 shards the
+    gradient rows through the shard_map'd partial-histogram -> psum ->
+    merged-scan split program (r14, see _fit_gbt; weight-0 row padding for
+    non-dividing counts — NOTE the bootstrap poisson then draws over the
+    padded row count, a stochastic difference from the unmeshed fit)."""
     X = jnp.asarray(X, jnp.float32)
     N, D = X.shape
     w = _weights(sample_weight, N)
@@ -607,16 +758,38 @@ def fit_forest(
     if n_bins <= 127:
         Xb = Xb.astype(jnp.int8)  # see _fit_gbt: 4x less per-level HBM traffic
 
+    from ..mesh import data_axis_size
+
+    data_sharded = (data_axis_size(mesh) > 1 and n_bins >= 2
+                    and os.environ.get("TT_SPLIT") != "twopass"
+                    and not _is_batched(X, y))
     Xb, edges, model_sharded = _model_axis_constraint(mesh, Xb, edges)
     hist_mode = (("binmm" if backend_is_tpu() else "segsum")
-                 if model_sharded else None)
-    split_mode = "twopass" if model_sharded else None
+                 if model_sharded and not data_sharded else None)
+    split_mode = ("twopass" if model_sharded and not data_sharded else None)
 
     if objective == "classification":
         Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
     else:
         Y = jnp.asarray(y, jnp.float32)[:, None]
     C = Y.shape[1]
+
+    if data_sharded:
+        pad = (-N) % data_axis_size(mesh)
+        if pad:
+            Xb, Y, w = _pad_rows_weight0(Xb, Y, w, pad)
+            N = N + pad
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..mesh import DATA_AXIS, MODEL_AXIS
+
+        Xb = jax.lax.with_sharding_constraint(
+            Xb, NamedSharding(mesh, P(
+                DATA_AXIS, MODEL_AXIS if model_sharded else None)))
+        Y = jax.lax.with_sharding_constraint(
+            Y, NamedSharding(mesh, P(DATA_AXIS, None)))
+        w = jax.lax.with_sharding_constraint(
+            w, NamedSharding(mesh, P(DATA_AXIS)))
 
     def one_tree(key):
         krow, kcol = jax.random.split(key)
@@ -633,6 +806,8 @@ def fit_forest(
         sf, st, lv, _, fg = grow_tree(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
             fmask, hist_mode=hist_mode, split_mode=split_mode,
+            data_mesh=mesh if data_sharded else None,
+            data_feature_sharded=model_sharded,
         )
         return sf, st, lv, fg
 

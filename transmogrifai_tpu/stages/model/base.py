@@ -179,8 +179,10 @@ class PredictorEstimator(Estimator):
 class MeshAwareFit:
     """Threads the attached device mesh (with_mesh / Workflow.train auto-mesh
     / the selector's winner refit) into `fit_kwargs()`, for families whose
-    fit_fn ACCEPTS a `mesh` kwarg: the tree trainers' model-axis histogram
-    sharding and the MLP trainers' ZeRO-style sharded optimizer state. The
+    fit_fn ACCEPTS a `mesh` kwarg: the tree trainers' data-axis partial
+    histogram + psum split program (rows over DATA_AXIS, composed with the
+    model-axis feature sharding on a 2-D mesh) and the MLP trainers'
+    ZeRO-style sharded optimizer state. The
     mesh rides fit_kwargs — never self.params — so it is never serialized and
     never enters a stage fingerprint; search templates (fresh `with_params`
     instances) carry mesh=None, keeping the vmapped folds x grid programs on
