@@ -51,7 +51,8 @@ class TestCatalog:
         # the catalog drives docs/static_analysis.md and `op lint --rules`
         assert {"OP001", "OP101", "OP102", "OP103", "OP104", "OP201", "OP202",
                 "OP203", "OP301", "OP302", "OP401", "OP402", "OP403",
-                "OP404", "OP405", "OP406"} \
+                "OP404", "OP405", "OP406", "OP501", "OP502", "OP503",
+                "OP504", "OP505"} \
             == set(RULES)
         for r in RULES.values():
             assert r.title and r.rationale and r.severity in ("error", "warn", "info")
@@ -655,3 +656,116 @@ class TestAnalyzeModel:
         report = analyze_model(model)
         assert not report.has_errors
         assert report.n_stages == len(model.stages)
+
+
+class TestOP5xxResourceModel:
+    """OP501..OP505: the static resource model at a RESOLVED mesh
+    (analyze/shard_model.py). Meshless analysis must never emit OP5xx —
+    that's the historical OP405 territory."""
+
+    def _selector_plan(self, models=None, response="label"):
+        from transmogrifai_tpu.select import ModelSelector, ParamGridBuilder
+        from transmogrifai_tpu.select.splitters import DataSplitter
+        from transmogrifai_tpu.select.validator import CrossValidation
+
+        fs = features_from_schema(
+            {"label": "RealNN", "a": "Real", "b": "Real"}, response="label")
+        if models is None:
+            models = [(LogisticRegression(max_iter=8),
+                       ParamGridBuilder().add("l2", [0.0, 0.1]).build())]
+        sel = ModelSelector(
+            "binary", models=models,
+            validator=CrossValidation(num_folds=3, seed=1),
+            splitter=DataSplitter(reserve_test_fraction=0.1, seed=1))
+        return sel(fs["label"], transmogrify([fs["a"], fs["b"]]))
+
+    def test_meshless_never_emits_op5xx(self, monkeypatch):
+        monkeypatch.setenv("TT_OP501_HBM_BYTES", "1")
+        codes = _codes(analyze_plan([self._selector_plan()], n_rows=1024))
+        assert not any(c.startswith("OP5") for c in codes)
+
+    def test_op501_over_budget_fires(self, monkeypatch):
+        monkeypatch.setenv("TT_OP501_HBM_BYTES", "4096")
+        report = analyze_plan([self._selector_plan()],
+                              mesh_shape=(1, 1), n_rows=4096)
+        diags = report.by_code("OP501")
+        assert diags and diags[0].severity == "error"
+        assert "resident" in diags[0].message
+        assert "TT_OP501_HBM_BYTES" in diags[0].hint
+
+    def test_op501_falls_back_to_op405_budget(self, monkeypatch):
+        monkeypatch.delenv("TT_OP501_HBM_BYTES", raising=False)
+        monkeypatch.setenv("TT_OP405_HBM_BYTES", "4096")
+        report = analyze_plan([self._selector_plan()],
+                              mesh_shape=(1, 1), n_rows=4096)
+        assert report.by_code("OP501")
+
+    def test_op501_default_budget_clean(self):
+        report = analyze_plan([self._selector_plan()],
+                              mesh_shape=(1, 1), n_rows=4096)
+        assert "OP501" not in _codes(report)
+
+    def test_op502_pad_waste_fires(self):
+        # 9 rows on an 8-wide data axis: 7 pad rows / 16 total = 0.44 > 0.25
+        report = analyze_plan([self._selector_plan()],
+                              mesh_shape=(8, 1), n_rows=9)
+        diags = report.by_code("OP502")
+        assert diags and diags[0].severity == "warn"
+
+    def test_op502_divisible_rows_clean(self):
+        report = analyze_plan([self._selector_plan()],
+                              mesh_shape=(8, 1), n_rows=1024)
+        assert "OP502" not in _codes(report)
+
+    def test_op503_comm_dominated_gbt_fires(self):
+        from transmogrifai_tpu.stages.model import GBTClassifier
+
+        fs = features_from_schema(
+            {"y": "RealNN", "a": "Real", "b": "Real"}, response="y")
+        pred = GBTClassifier(n_trees=8)(fs["y"],
+                                        transmogrify([fs["a"], fs["b"]]))
+        # 8 rows over 8 devices: 1 row/device of histogram math vs a full
+        # [bins, 2C, nodes] psum per level — collective time dwarfs compute
+        report = analyze_plan([pred], mesh_shape=(8, 1), n_rows=8)
+        assert report.by_code("OP503")
+        # plenty of rows: the histogram flops dominate, collective hides
+        report = analyze_plan([pred], mesh_shape=(8, 1), n_rows=1 << 22)
+        assert "OP503" not in _codes(report)
+
+    def test_op504_degenerate_axis_fires(self):
+        _, pred = _simple_graph()
+        report = analyze_plan([pred], mesh_shape=(1, 8), n_rows=1024)
+        diags = report.by_code("OP504")
+        assert diags and "model" in diags[0].message
+
+    def test_op504_one_by_one_clean(self):
+        _, pred = _simple_graph()
+        report = analyze_plan([pred], mesh_shape=(1, 1), n_rows=1024)
+        assert "OP504" not in _codes(report)
+
+    def test_op505_pinned_shard_under_vmap_fires(self):
+        from transmogrifai_tpu.select import ParamGridBuilder
+        from transmogrifai_tpu.stages.model import MLPClassifier
+
+        models = [(MLPClassifier(hidden=(8,), shard_optimizer="on"),
+                   ParamGridBuilder().add("lr", [0.01, 0.1]).build())]
+        report = analyze_plan([self._selector_plan(models=models)],
+                              mesh_shape=(8, 1), n_rows=1024)
+        diags = report.by_code("OP505")
+        assert diags and diags[0].severity == "warn"
+        assert "vmap" in diags[0].message
+
+    def test_op505_auto_clean(self):
+        from transmogrifai_tpu.select import ParamGridBuilder
+        from transmogrifai_tpu.stages.model import MLPClassifier
+
+        models = [(MLPClassifier(hidden=(8,)),
+                   ParamGridBuilder().add("lr", [0.01]).build())]
+        report = analyze_plan([self._selector_plan(models=models)],
+                              mesh_shape=(8, 1), n_rows=1024)
+        assert "OP505" not in _codes(report)
+
+    def test_analysis_is_trace_free(self):
+        with obs.retrace_budget(0):
+            analyze_plan([self._selector_plan()], mesh_shape=(8, 1),
+                         n_rows=1 << 20)

@@ -43,6 +43,9 @@ def test_direction_inference():
     assert not bench_diff.lower_is_better("disagg_two_worker_rows_per_sec")
     assert bench_diff.lower_is_better("disagg_recovery_s")
     assert bench_diff.lower_is_better("extraction_epoch_clean_s")
+    # the static-analyzer honesty lane: `op explain`'s prediction error vs
+    # the measured mesh counters must shrink, never grow
+    assert bench_diff.lower_is_better("explain_hbm_rel_error")
     # the sharded-optimizer lane: per-device state bytes (and the
     # sharded/replicated ratio) regress upward, throughput/efficiency and the
     # fused-GBT MFU keep higher-is-better

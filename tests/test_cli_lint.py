@@ -65,3 +65,76 @@ def test_help_lists_lint(capsys):
 
 def test_unknown_command_still_errors(capsys):
     assert main(["lintt"]) == 2
+
+
+def test_lint_mesh_arms_op5xx(capsys, monkeypatch):
+    # meshless lint on the clean app is clean; with --mesh and a synthetic
+    # 1-byte budget the OP501 resource rule must fire through the same CLI
+    monkeypatch.setenv("TT_OP501_HBM_BYTES", "1")
+    assert main(["lint", "--app", "lint_clean_app:make_runner"]) == 0
+    capsys.readouterr()
+    rc = main(["lint", "--app", "lint_clean_app:make_runner",
+               "--mesh", "1,1", "--rows", "1024"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "OP501" in out
+
+
+def test_explain_prints_stage_table(capsys):
+    rc = main(["explain", "--app", "lint_clean_app:make_runner",
+               "--mesh", "4,2", "--rows", "100"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "resource model · mesh 4x2" in out
+    assert "peak resident/device" in out
+    assert "combine" in out
+
+
+def test_explain_json_document(capsys):
+    rc = main(["explain", "--app", "lint_clean_app:make_runner",
+               "--mesh", "2,1", "--rows", "64", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    rm = doc["resource_model"]
+    assert rm["mesh_shape"] == [2, 1] and rm["n_rows"] == 64
+    assert rm["stages"] and all("resident_bytes" in s for s in rm["stages"])
+    assert doc["report"]["version"] == 1
+
+
+def test_explain_op501_gate_exits_nonzero(capsys, monkeypatch):
+    monkeypatch.setenv("TT_OP501_HBM_BYTES", "1")
+    rc = main(["explain", "--app", "lint_clean_app:make_runner",
+               "--mesh", "2,1", "--rows", "1024"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "OP501" in out
+
+
+def test_explain_is_trace_free(capsys):
+    from transmogrifai_tpu import obs
+
+    with obs.retrace_budget(0):
+        rc = main(["explain", "--app", "lint_clean_app:make_runner",
+                   "--mesh", "8,1", "--rows", "1024"])
+    assert rc == 0
+
+
+def test_explain_requires_app(capsys):
+    assert main(["explain"]) == 2
+
+
+def test_help_lists_explain(capsys):
+    main(["--help"])
+    assert "explain" in capsys.readouterr().out
+
+
+def test_explain_titanic_8x1_trace_free(capsys):
+    # the acceptance pin: `op explain` on the titanic example at mesh 8x1
+    # emits the per-stage table with ZERO XLA traces or compiles
+    from transmogrifai_tpu import obs
+
+    with obs.retrace_budget(0):
+        rc = main(["explain", "--app", "examples.titanic:make_runner",
+                   "--mesh", "8,1", "--rows", "1024"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "resource model · mesh 8x1" in out
+    assert "modelSelector" in out and "sanityChecker" in out

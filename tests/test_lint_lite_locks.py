@@ -1,0 +1,97 @@
+"""Lock-discipline lint (`tools/lint_lite.py --locks`, rule L001): an
+instance attribute assigned both inside and outside `with self._lock:` blocks
+is a torn-read hazard. `__init__` and `*_locked` helpers (caller holds the
+lock) are exempt; `# lint: lockfree` suppresses a deliberate lock-free write.
+The repo's own threaded subsystems (serve/, ingest/, readers/pipeline.py)
+must scan clean — that's the CI surface in tools/ci_check.sh."""
+import importlib.util
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mod():
+    spec = importlib.util.spec_from_file_location(
+        "lint_lite", os.path.join(_REPO, "tools", "lint_lite.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint_lite = _mod()
+
+VIOLATION = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items = {**self._items, k: v}
+
+    def clear(self):
+        self._items = {}
+'''
+
+
+def _check(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return lint_lite.check_locks(p)
+
+
+def test_mixed_discipline_fires(tmp_path):
+    problems = _check(tmp_path, VIOLATION)
+    assert len(problems) == 1
+    assert "L001" in problems[0] and "Cache._items" in problems[0]
+
+
+def test_init_writes_are_exempt(tmp_path):
+    # the __init__ assignment alone must not count as the unlocked side
+    src = VIOLATION.replace(
+        "    def clear(self):\n        self._items = {}\n", "")
+    assert _check(tmp_path, src) == []
+
+
+def test_lockfree_comment_suppresses(tmp_path):
+    src = VIOLATION.replace(
+        "    def clear(self):\n        self._items = {}",
+        "    def clear(self):\n"
+        "        self._items = {}  # lint: lockfree")
+    assert _check(tmp_path, src) == []
+
+
+def test_locked_suffix_helper_is_exempt(tmp_path):
+    src = VIOLATION.replace("def clear(self):", "def clear_locked(self):")
+    assert _check(tmp_path, src) == []
+
+
+def test_always_locked_is_clean(tmp_path):
+    src = VIOLATION.replace(
+        "    def clear(self):\n        self._items = {}",
+        "    def clear(self):\n"
+        "        with self._lock:\n"
+        "            self._items = {}")
+    assert _check(tmp_path, src) == []
+
+
+def test_repo_threaded_subsystems_scan_clean():
+    files = lint_lite.iter_py([os.path.join(_REPO, p)
+                               for p in lint_lite.LOCK_SCAN_PATHS])
+    assert files, "lock scan surface is empty — paths moved?"
+    problems = [p for f in files for p in lint_lite.check_locks(f)]
+    assert problems == [], "\n".join(problems)
+
+
+def test_main_locks_flag(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(VIOLATION)
+    rc = lint_lite.main(["--locks", str(p)])
+    out = capsys.readouterr()
+    assert rc == 1 and "L001" in out.out
+    rc = lint_lite.main(["--locks", os.path.join(
+        _REPO, "transmogrifai_tpu", "readers", "pipeline.py")])
+    assert rc == 0

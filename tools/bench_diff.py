@@ -34,8 +34,12 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 #: suffix; "state_bytes" covers the sharded-optimizer lane's per-device
 #: optimizer-state footprint and its sharded/replicated ratio — growing
 #: per-device state is the regression the ZeRO sharding exists to prevent)
+#: "rel_error" covers the static-analyzer honesty lane
+#: (explain_hbm_rel_error: |predicted - measured| / measured per-device
+#: bytes) — a growing prediction error means `op explain` is drifting from
+#: what the mesh counters actually measure
 _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
-                 "recovery", "state_bytes")
+                 "recovery", "state_bytes", "rel_error")
 #: overrides: fragments that look like seconds but are throughput/quality
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",

@@ -8,6 +8,15 @@ re-export surfaces. `tools/ci_check.sh` prefers real ruff when present and
 falls back to this script.
 
     python tools/lint_lite.py [paths...]     # default: the package + tests + tools
+    python tools/lint_lite.py --locks        # lock-discipline scan (L001)
+
+`--locks` runs a separate AST pass over the threaded subsystems (serve/,
+ingest/, readers/pipeline.py): an instance attribute assigned BOTH inside and
+outside `with self._lock:` blocks (any `self.*lock*` context manager) is a
+torn-read hazard — one writer holds the lock, the other doesn't, so the lock
+protects nothing. `__init__` is exempt (pre-publication writes precede any
+reader thread). Suppress a deliberate lock-free write with a trailing
+`# lint: lockfree` comment on the assignment line.
 """
 from __future__ import annotations
 
@@ -16,6 +25,10 @@ import sys
 from pathlib import Path
 
 DEFAULT_PATHS = ("transmogrifai_tpu", "tests", "tools", "examples")
+
+#: subsystems with reader/writer threads — the --locks scan surface
+LOCK_SCAN_PATHS = ("transmogrifai_tpu/serve", "transmogrifai_tpu/ingest",
+                   "transmogrifai_tpu/readers/pipeline.py")
 
 
 def iter_py(paths) -> list[Path]:
@@ -92,15 +105,87 @@ def check_file(path: Path) -> list[str]:
     return problems
 
 
+def _self_attr(node) -> str | None:
+    """`self.x` -> "x" (None for anything else)."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    name = _self_attr(item.context_expr)
+    return name is not None and "lock" in name.lower()
+
+
+def _scan_assigns(node, in_lock: bool, locked: dict, unlocked: dict) -> None:
+    """Record `self.attr = ...` linenos by lock context, recursively."""
+    for child in ast.iter_child_nodes(node):
+        inner = in_lock or (isinstance(child, ast.With)
+                            and any(_is_lock_ctx(it) for it in child.items))
+        if isinstance(child, ast.Assign):
+            targets = child.targets
+        elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+            targets = [child.target]
+        else:
+            targets = []
+        for t in targets:
+            for el in ast.walk(t):
+                attr = _self_attr(el)
+                if attr is not None:
+                    dest = locked if inner else unlocked
+                    dest.setdefault(attr, []).append(child.lineno)
+        _scan_assigns(child, inner, locked, unlocked)
+
+
+def check_locks(path: Path) -> list[str]:
+    """L001: instance attr written both under and outside `with self.*lock*:`."""
+    src = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+    allow = {i + 1 for i, line in enumerate(src.splitlines())
+             if "# lint: lockfree" in line}
+    problems: list[str] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locked: dict[str, list[int]] = {}
+        unlocked: dict[str, list[int]] = {}
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":  # pre-publication: no reader thread yet
+                continue
+            # repo convention: a `*_locked` helper documents that its CALLER
+            # holds the lock — its writes count as locked writes
+            _scan_assigns(fn, fn.name.endswith("_locked"), locked, unlocked)
+        for attr in sorted(set(locked) & set(unlocked)):
+            lines = [ln for ln in unlocked[attr] if ln not in allow]
+            for ln in lines:
+                problems.append(
+                    f"{path}:{ln}: L001 {cls.name}.{attr} assigned here "
+                    f"WITHOUT the lock but under it at line(s) "
+                    f"{sorted(set(locked[attr]))} — torn-read hazard "
+                    f"(suppress with '# lint: lockfree')")
+    return problems
+
+
 def main(argv=None) -> int:
-    paths = (argv or sys.argv[1:]) or DEFAULT_PATHS
+    argv = list(sys.argv[1:] if argv is None else argv)
+    lock_mode = "--locks" in argv
+    if lock_mode:
+        argv.remove("--locks")
+    paths = argv or (LOCK_SCAN_PATHS if lock_mode else DEFAULT_PATHS)
     problems: list[str] = []
     files = iter_py(paths)
     for f in files:
-        problems.extend(check_file(f))
+        problems.extend(check_locks(f) if lock_mode else check_file(f))
     for p in problems:
         print(p)
-    print(f"lint_lite: {len(files)} files, {len(problems)} problem(s)",
+    mode = "locks" if lock_mode else "lint"
+    print(f"lint_lite[{mode}]: {len(files)} files, {len(problems)} problem(s)",
           file=sys.stderr)
     return 1 if problems else 0
 

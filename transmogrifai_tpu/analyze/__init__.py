@@ -4,7 +4,9 @@ The static half of the observability story (obs/ is the runtime half): with
 zero data and zero XLA traces it walks `(result_features, dag)` and emits
 structured Diagnostics — kind/arity abstract interpretation (OP10x), retrace
 hazards that defeat the compile caches (OP20x), label-leakage paths (OP30x),
-and plan hygiene (OP001, OP40x). See docs/static_analysis.md for the catalog.
+plan hygiene (OP001, OP40x), and — given a mesh shape — the static resource
+model (OP50x: per-device HBM, collective traffic, padding waste; shard_model
+and `op explain`). See docs/static_analysis.md for the catalog.
 
     from transmogrifai_tpu.analyze import analyze_plan
     report = analyze_plan([prediction])
@@ -24,9 +26,17 @@ from .diagnostics import (
     SEVERITIES,
 )
 from .rules import PASSES, RULES, PlanContext, check_dag_uniqueness
+from .shard_model import (
+    ResourceModel,
+    StageResource,
+    build_resource_model,
+    explain_mesh_shape,
+)
 
 __all__ = [
     "AnalysisReport", "Diagnostic", "PASSES", "PlanAnalysisError",
-    "PlanContext", "RULES", "RuleInfo", "SEVERITIES", "analyze_model",
-    "analyze_plan", "check_dag_uniqueness", "plan_fingerprint",
+    "PlanContext", "RULES", "ResourceModel", "RuleInfo", "SEVERITIES",
+    "StageResource", "analyze_model", "analyze_plan",
+    "build_resource_model", "check_dag_uniqueness", "explain_mesh_shape",
+    "plan_fingerprint",
 ]

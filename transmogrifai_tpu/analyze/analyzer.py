@@ -16,7 +16,7 @@ from .diagnostics import AnalysisReport, Diagnostic
 from .rules import PASSES, PlanContext
 
 
-def _derive_raw(result_features: Sequence[Feature]) -> tuple[Feature, ...]:
+def derive_raw_features(result_features: Sequence[Feature]) -> tuple[Feature, ...]:
     raw: list[Feature] = []
     seen: set[int] = set()
     for f in result_features:
@@ -27,18 +27,26 @@ def _derive_raw(result_features: Sequence[Feature]) -> tuple[Feature, ...]:
     return tuple(raw)
 
 
+_derive_raw = derive_raw_features
+
+
 def analyze_plan(result_features: Sequence[Feature],
                  dag: Optional[list] = None, *,
                  raw_features: Optional[Sequence[Feature]] = None,
                  workflow_cv: bool = False,
                  fitted: bool = False,
+                 mesh_shape=None,
+                 n_rows: Optional[int] = None,
                  rules: Optional[Sequence[str]] = None) -> AnalysisReport:
     """Run every analysis pass over `(result_features, dag)`.
 
     `dag` defaults to `compute_dag(result_features)`; `raw_features` to the
-    back-traced leaves. `rules` restricts the report to the given codes
-    (after running all passes — passes are cheap, filtering is for callers
-    that only care about one family).
+    back-traced leaves. `mesh_shape` (`(n_data, n_model)`) arms the OP5xx
+    resource passes (rules.pass_resources) with an optional symbolic
+    `n_rows`; meshless analysis keeps the historical OP405 behavior. `rules`
+    restricts the report to the given codes (after running all passes —
+    passes are cheap, filtering is for callers that only care about one
+    family).
     """
     result_features = tuple(result_features)
     if dag is None:
@@ -50,6 +58,9 @@ def analyze_plan(result_features: Sequence[Feature],
         else _derive_raw(result_features),
         workflow_cv=workflow_cv,
         fitted=fitted,
+        mesh_shape=tuple(int(x) for x in mesh_shape)
+        if mesh_shape is not None else None,
+        n_rows=int(n_rows) if n_rows is not None else None,
     )
     diagnostics: list[Diagnostic] = []
     for p in PASSES:

@@ -95,6 +95,35 @@ OP405 = _rule("OP405", "replicated optimizer state exceeds per-device HBM",
               "replicated-state OOM the sharded optimizer "
               "(shard_optimizer='auto' on a multi-device mesh) exists to "
               "avoid")
+OP501 = _rule("OP501", "per-device HBM over budget at the resolved mesh",
+              "error",
+              "the static resource model (analyze/shard_model.py) predicts a "
+              "stage's per-device resident bytes (params + optimizer state "
+              "at the RESOLVED sharding + activations + binned matrices) "
+              "over the HBM budget — generalizes OP405 beyond pinned-'on' "
+              "fits: 'auto' plans are priced at the mesh they will actually "
+              "train on")
+OP502 = _rule("OP502", "padding waste above threshold", "warn",
+              "weight-0 repeat-row padding to a non-dividing data axis, or "
+              "grid-pad clone points to a non-dividing model axis, burn more "
+              "than the configured fraction of the sharded work — resize the "
+              "axis or the batch instead of shipping dead rows")
+OP503 = _rule("OP503", "comm-dominated stage at configured ICI bandwidth",
+              "warn",
+              "the stage's modeled collective payload takes longer on the "
+              "ICI (TT_ICI_GBPS) than its compute takes on the MXU "
+              "(TT_PEAK_TFLOPS) — the mesh axis adds latency, not "
+              "throughput, at this size")
+OP504 = _rule("OP504", "degenerate mesh: claimed axis unused by every stage",
+              "warn",
+              "the mesh declares a >1 axis but every stage's sharding "
+              "resolves replicated on it — devices idle while holding full "
+              "copies; shrink the mesh or make a stage shardable")
+OP505 = _rule("OP505", "shard_optimizer pinned under vmapped search", "warn",
+              "a selector candidate pins shard_optimizer='on', but the "
+              "search vmaps fits over the grid axis where sharding silently "
+              "falls back to replicated state (resolve_shard_optimizer's "
+              "batched check) — the pin only binds the winner refit")
 OP406 = _rule("OP406", "data-axis mesh attached but GBT fused split falls "
               "back", "warn",
               "a tree-family fit is planned on a mesh with a >1 data axis, "
@@ -121,6 +150,11 @@ class PlanContext:
     workflow_cv: bool = False
     #: analyzing a fitted plan (WorkflowModel.save): estimator-only rules skip
     fitted: bool = False
+    #: (n_data, n_model) arming the OP5xx resource passes; None = meshless
+    #: lint (historical OP405-only behavior)
+    mesh_shape: Optional[tuple] = None
+    #: symbolic training row count for the resource model (None = unknown)
+    n_rows: Optional[int] = None
     #: lazily-built feature-id -> consuming cone stages
     _consumers: Optional[dict] = field(default=None, repr=False)
 
@@ -671,6 +705,127 @@ def _plain_params(obj):
     return obj
 
 
+# --- OP501..OP505: static resource model at a resolved mesh ---------------------------
+
+#: OP502 fires when padding exceeds this fraction of the padded work
+OP502_PAD_FRAC_DEFAULT = 0.25
+#: OP503's hardware knobs: ICI link bandwidth (GB/s, per device) and MXU
+#: peak (TFLOP/s, per device) — v5e-class defaults; tune per part
+OP503_ICI_GBPS_DEFAULT = 90.0
+OP503_PEAK_TFLOPS_DEFAULT = 100.0
+
+
+def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
+    """OP501-505: price the plan on `ctx.mesh_shape` via the static resource
+    model (shard_model.build_resource_model — pure host arithmetic, zero
+    traces) and flag what the runtime would only reveal after 16-21 s of
+    compile: per-device HBM blowups at the RESOLVED sharding (OP501, the
+    'auto' blind spot OP405 documents), padding-dominated shards (OP502),
+    comm-bound stages at the configured ICI bandwidth (OP503), meshes no
+    stage can use (OP504), and sharding pins the vmapped search silently
+    ignores (OP505)."""
+    import os
+
+    if ctx.mesh_shape is None:
+        return
+    from ..ops.optimizer import shard_pinned
+    from .shard_model import _fmt_bytes, build_resource_model, pad_row_fraction
+
+    n_data, n_model = ctx.mesh_shape
+    rm = build_resource_model(
+        ctx.result_features, ctx.dag, mesh_shape=ctx.mesh_shape,
+        n_rows=ctx.n_rows, raw_features=ctx.raw_features)
+    budget = int(os.environ.get(
+        "TT_OP501_HBM_BYTES",
+        os.environ.get("TT_OP405_HBM_BYTES", OP405_HBM_BYTES_DEFAULT)))
+    pad_frac_max = float(os.environ.get("TT_OP502_PAD_FRAC",
+                                        OP502_PAD_FRAC_DEFAULT))
+    ici_gbps = float(os.environ.get("TT_ICI_GBPS", OP503_ICI_GBPS_DEFAULT))
+    peak_tflops = float(os.environ.get("TT_PEAK_TFLOPS",
+                                       OP503_PEAK_TFLOPS_DEFAULT))
+
+    for sr in rm.stages:
+        resident = sr.resident_bytes
+        if resident > budget:
+            approx = "" if sr.width_exact else " (width is an upper bound)"
+            yield make_diag(
+                "OP501",
+                f"{sr.name} predicts {_fmt_bytes(resident)} resident "
+                f"per device at mesh {n_data}x{n_model} (params "
+                f"{sr.params_bytes}, opt state {sr.opt_state_bytes}, "
+                f"activations {sr.activation_bytes}, aux {sr.aux_bytes} B) — "
+                f"over the {_fmt_bytes(budget)} budget{approx}",
+                stage_uid=sr.stage_uid,
+                hint="grow the data axis (state and rows shard 1/N), shrink "
+                     "the model, or raise TT_OP501_HBM_BYTES if the part "
+                     "has headroom")
+        row_frac = pad_row_fraction(sr, rm.n_rows)
+        frac = max(row_frac, sr.grid_pad_frac)
+        if frac > pad_frac_max:
+            what = (f"{sr.pad_rows} weight-0 pad rows over {rm.n_rows} real "
+                    f"rows" if row_frac >= sr.grid_pad_frac else
+                    f"{sr.grid_pad} grid-pad clone points over "
+                    f"{sr.grid_points} real points")
+            yield make_diag(
+                "OP502",
+                f"{sr.name} pads {frac:.0%} of its sharded work at mesh "
+                f"{n_data}x{n_model}: {what}",
+                stage_uid=sr.stage_uid,
+                hint="pick an axis size that divides the work, or accept the "
+                     "waste and raise TT_OP502_PAD_FRAC")
+        if sr.collective_bytes and sr.flops:
+            comm_s = sr.collective_bytes / (ici_gbps * 1e9)
+            comp_s = sr.flops / (peak_tflops * 1e12)
+            if comm_s > comp_s:
+                yield make_diag(
+                    "OP503",
+                    f"{sr.name} is comm-dominated at mesh {n_data}x{n_model}: "
+                    f"~{comm_s * 1e3:.2f} ms of collectives "
+                    f"({sr.collective_bytes} B at {ici_gbps:g} GB/s) vs "
+                    f"~{comp_s * 1e3:.2f} ms of compute "
+                    f"({sr.flops} flops at {peak_tflops:g} TFLOP/s)",
+                    stage_uid=sr.stage_uid,
+                    hint="fewer, larger shards: shrink the axis this stage "
+                         "psums over, or grow the per-device work")
+
+    if n_data > 1 or n_model > 1:
+        data_used = any(sr.rows_sharded or sr.opt_sharded for sr in rm.stages)
+        model_used = any(sr.features_sharded or sr.grid_points > 1
+                         for sr in rm.stages)
+        dead = []
+        if n_data > 1 and not data_used:
+            dead.append(f"data={n_data}")
+        if n_model > 1 and not model_used:
+            dead.append(f"model={n_model}")
+        if dead:
+            yield make_diag(
+                "OP504",
+                f"mesh {n_data}x{n_model} claims {' and '.join(dead)} but "
+                "every stage resolves replicated on the axis — the devices "
+                "hold full copies and idle",
+                hint="shrink the mesh to the axes the plan can use, or add "
+                     "a shardable stage (divisible rows/features, "
+                     "shard_optimizer, a model grid)")
+
+    for s in ctx.stages():
+        models = getattr(s, "models", None)
+        if not isinstance(models, (list, tuple)):
+            continue
+        for entry in models:
+            template = entry[0] if isinstance(entry, (list, tuple)) else entry
+            knob = getattr(template, "params", {}).get("shard_optimizer", "")
+            if shard_pinned(knob):
+                yield make_diag(
+                    "OP505",
+                    f"selector candidate {type(template).__name__} pins "
+                    "shard_optimizer='on', but the vmapped grid search "
+                    "replicates its optimizer state per point (batched fits "
+                    "cannot shard_map) — the pin only binds the winner refit",
+                    stage_uid=s.uid,
+                    hint="use shard_optimizer='auto' for search candidates; "
+                         "budget search memory via the grid size instead")
+
+
 #: pass registry, run in order by the analyzer
 PASSES = (pass_uniqueness, pass_kinds, pass_retrace, pass_leakage,
-          pass_hygiene, pass_optimizer_state, pass_tree_mesh)
+          pass_hygiene, pass_optimizer_state, pass_tree_mesh, pass_resources)

@@ -1,0 +1,354 @@
+"""Static sharding & resource model: predict per-device HBM, collective
+traffic, and padding waste from the plan alone — zero data, zero XLA traces.
+
+`build_resource_model` walks the plan DAG exactly like the kind pass
+(rules.pass_kinds' abstract interpretation of `out_kind`), but the abstract
+value is the VECTOR WIDTH each feature would carry at train time instead of
+its kind. Stages participate through two optional protocols:
+
+  - `static_width(in_widths) -> Optional[int]`: the stage's output width
+    given its inputs' widths (None = unknown). Numeric vectorizers, the
+    combiner (bucket padding included) and the sanity checker implement it;
+    a class/property `static_width_exact = False` marks data-dependent
+    widths (vocabulary pivots, remove_bad_features) as upper bounds.
+  - `resource_profile(*, width, n_rows, mesh_shape) -> dict`: byte/flop/
+    collective cost of FITTING the stage at the given design width on the
+    given mesh. Model stages delegate to the cost helpers next to the ops
+    they model (ops/mlp.py, ops/trees.py) so the formulas and the runtime
+    counters (`mesh_collective_bytes_total`, `train_optimizer_state_bytes`)
+    can never drift apart — parity is pinned by test on forced-8-device
+    lanes.
+
+This is the plan-layer port of GSPMD's static sharding propagation
+(arXiv 2105.04663) and Alpa's communication cost model (arXiv 2201.12023):
+sharding decisions (row shards, ZeRO state shards, feature slabs, grid
+layout) are re-derived symbolically from the same gates the runtime uses,
+then priced in bytes. The OP5xx rule family (rules.pass_resources) turns the
+model into diagnostics; `op explain` renders it as a per-stage table.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..stages.base import FeatureGeneratorStage
+
+#: fallback output width for OPVector producers with no static_width
+#: (hashing/text vectorizers — data-dependent vocabularies); override with
+#: TT_EXPLAIN_ASSUME_WIDTH. Marked inexact in the report.
+ASSUME_WIDTH_DEFAULT = 64
+
+#: raw-feature kinds that enter the plan one column wide
+_NUMERIC_KINDS = frozenset(
+    {"Real", "RealNN", "Integral", "Binary", "Currency", "Percent"})
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+def explain_mesh_shape(spec=None) -> tuple:
+    """Resolve a `(n_data, n_model)` shape for analysis — the ONE resolution
+    path `op lint --mesh`, `op explain` and `OpParams.mesh_shape` share.
+    Explicit specs parse via mesh.parse_mesh_shape; None/'auto' mirrors
+    default_mesh(): all visible devices on the data axis, (1, 1) under
+    TT_AUTO_MESH=0 or a single device. Shape-only: no Mesh is built, no
+    device state is touched beyond counting."""
+    from ..mesh import parse_mesh_shape
+
+    shape = parse_mesh_shape(spec)
+    if shape is not None:
+        return (max(1, int(shape[0])), max(1, int(shape[1])))
+    if os.environ.get("TT_AUTO_MESH", "1") == "0":
+        return (1, 1)
+    import jax
+
+    n = len(jax.devices())
+    return (n, 1) if n > 1 else (1, 1)
+
+
+@dataclass
+class StageResource:
+    """One stage's predicted train-time footprint on one device."""
+
+    stage_uid: str
+    name: str
+    operation: str
+    #: design/output vector width the stage sees (None = unknown)
+    width: Optional[int] = None
+    #: False when any contributing width is an upper bound / assumed
+    width_exact: bool = True
+    rows_per_device: Optional[int] = None
+    params_bytes: int = 0
+    opt_state_bytes: int = 0
+    activation_bytes: int = 0
+    #: auxiliary resident tensors (binned GBT matrix, vmapped grid stacks)
+    aux_bytes: int = 0
+    #: modeled ICI payload bytes for one fit (psum/all_gather/psum_scatter)
+    collective_bytes: int = 0
+    #: per-device flops for one fit (0 = not modeled) — OP503's denominator
+    flops: int = 0
+    pad_rows: int = 0
+    grid_points: int = 0
+    grid_pad: int = 0
+    rows_sharded: bool = False
+    opt_sharded: bool = False
+    features_sharded: bool = False
+    notes: tuple = ()
+
+    @property
+    def resident_bytes(self) -> int:
+        return (self.params_bytes + self.opt_state_bytes
+                + self.activation_bytes + self.aux_bytes)
+
+    @property
+    def grid_pad_frac(self) -> float:
+        total = self.grid_points + self.grid_pad
+        return self.grid_pad / total if total else 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "stage_uid": self.stage_uid,
+            "name": self.name,
+            "operation": self.operation,
+            "width": self.width,
+            "width_exact": bool(self.width_exact),
+            "rows_per_device": self.rows_per_device,
+            "resident_bytes": {
+                "params": int(self.params_bytes),
+                "opt_state": int(self.opt_state_bytes),
+                "activations": int(self.activation_bytes),
+                "aux": int(self.aux_bytes),
+                "total": int(self.resident_bytes),
+            },
+            "collective_bytes": int(self.collective_bytes),
+            "flops": int(self.flops),
+            "padding": {"pad_rows": int(self.pad_rows),
+                        "grid_points": int(self.grid_points),
+                        "grid_pad": int(self.grid_pad)},
+            "sharding": {"rows": bool(self.rows_sharded),
+                         "opt_state": bool(self.opt_sharded),
+                         "features": bool(self.features_sharded)},
+            "notes": list(self.notes),
+        }
+
+
+def _fmt_bytes(n: int) -> str:
+    if n <= 0:
+        return "-"
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n}B"
+
+
+@dataclass
+class ResourceModel:
+    """The full per-stage prediction for one (plan, mesh, row count)."""
+
+    mesh_shape: tuple
+    n_rows: Optional[int]
+    stages: list = field(default_factory=list)
+    assumed_width: int = ASSUME_WIDTH_DEFAULT
+
+    @property
+    def peak(self) -> Optional[StageResource]:
+        live = [s for s in self.stages if s.resident_bytes > 0]
+        return max(live, key=lambda s: s.resident_bytes) if live else None
+
+    def totals(self) -> dict:
+        peak = self.peak
+        return {
+            "peak_resident_bytes": int(peak.resident_bytes) if peak else 0,
+            "peak_stage_uid": peak.stage_uid if peak else None,
+            "collective_bytes": int(sum(s.collective_bytes
+                                        for s in self.stages)),
+            "flops": int(sum(s.flops for s in self.stages)),
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "mesh_shape": [int(self.mesh_shape[0]), int(self.mesh_shape[1])],
+            "n_rows": self.n_rows,
+            "assumed_width": int(self.assumed_width),
+            "stages": [s.to_json() for s in self.stages],
+            "totals": self.totals(),
+        }
+
+    def pretty(self) -> str:
+        n_data, n_model = self.mesh_shape
+        rows = "?" if self.n_rows is None else str(self.n_rows)
+        head = (f"resource model · mesh {n_data}x{n_model} "
+                f"(data x model) · rows {rows}")
+        cols = ("stage", "width", "rows/dev", "resident/dev", "coll/fit",
+                "pad", "shard")
+        table = [cols]
+        for s in self.stages:
+            w = "?" if s.width is None else str(s.width)
+            if not s.width_exact and s.width is not None:
+                w = "~" + w
+            pad_bits = []
+            if s.pad_rows:
+                pad_bits.append(f"{s.pad_rows}r")
+            if s.grid_pad:
+                pad_bits.append(f"{s.grid_pad}g")
+            shard = "".join((
+                "R" if s.rows_sharded else "-",
+                "O" if s.opt_sharded else "-",
+                "F" if s.features_sharded else "-",
+            ))
+            table.append((
+                f"{s.operation}[{s.stage_uid[-6:]}]",
+                w,
+                "?" if s.rows_per_device is None else str(s.rows_per_device),
+                _fmt_bytes(s.resident_bytes),
+                _fmt_bytes(s.collective_bytes),
+                "+".join(pad_bits) or "-",
+                shard,
+            ))
+        widths = [max(len(r[i]) for r in table) for i in range(len(cols))]
+        lines = [head, ""]
+        for i, row in enumerate(table):
+            lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        t = self.totals()
+        lines.append("")
+        lines.append(
+            f"peak resident/device: {_fmt_bytes(t['peak_resident_bytes'])}"
+            + (f" ({t['peak_stage_uid']})" if t["peak_stage_uid"] else "")
+            + f" · collective/train: {_fmt_bytes(t['collective_bytes'])}")
+        return "\n".join(lines)
+
+
+def _propagate_widths(stages, raw_features, assume_width: int) -> dict:
+    """id(feature) -> (width, exact). The width analog of pass_kinds'
+    env propagation: raw numeric kinds enter 1 wide, each stage's output
+    width comes from its `static_width` protocol, OPVector producers
+    without one fall back to `assume_width` (inexact)."""
+    env: dict = {}
+    for f in raw_features:
+        k = getattr(getattr(f, "kind", None), "name", None)
+        env[id(f)] = (1, True) if k in _NUMERIC_KINDS else (None, True)
+    for s in stages:
+        out = getattr(s, "_output", None)
+        if out is None:
+            continue
+        in_ws = [env.get(id(p), (None, False)) for p in s.inputs]
+        okind = getattr(getattr(out, "kind", None), "name", None)
+        sw = getattr(s, "static_width", None)
+        width, exact = None, True
+        if callable(sw):
+            try:
+                width = sw([w for w, _ in in_ws])
+            except (TypeError, ValueError):
+                width = None
+            exact = (all(e for _, e in in_ws)
+                     and bool(getattr(s, "static_width_exact", True))
+                     and width is not None)
+        elif okind == "OPVector":
+            width, exact = assume_width, False
+        elif okind in _NUMERIC_KINDS:
+            width, exact = 1, True
+        env[id(out)] = (int(width) if width is not None else None, exact)
+    return env
+
+
+def build_resource_model(
+    result_features: Sequence,
+    dag: Optional[list] = None,
+    *,
+    mesh_shape,
+    n_rows: Optional[int] = None,
+    raw_features: Optional[Sequence] = None,
+    assume_width: Optional[int] = None,
+) -> ResourceModel:
+    """Predict the per-stage train-time footprint of a plan on a mesh.
+
+    Pure host arithmetic over the typed lineage — safe under
+    obs.retrace_budget(0). `n_rows=None` leaves row-dependent terms
+    (activations, binned matrices, row padding) unmodeled rather than
+    guessed."""
+    from ..graph.dag import compute_dag
+
+    if dag is None:
+        dag = compute_dag(result_features)
+    if raw_features is None:
+        from .analyzer import derive_raw_features
+
+        raw_features = derive_raw_features(result_features)
+    if assume_width is None:
+        assume_width = int(os.environ.get("TT_EXPLAIN_ASSUME_WIDTH",
+                                          ASSUME_WIDTH_DEFAULT))
+    n_data, n_model = (max(1, int(mesh_shape[0])), max(1, int(mesh_shape[1])))
+    stages = [s for layer in dag for s in layer
+              if not isinstance(s, FeatureGeneratorStage)]
+    env = _propagate_widths(stages, raw_features, assume_width)
+
+    model = ResourceModel(mesh_shape=(n_data, n_model), n_rows=n_rows,
+                          assumed_width=assume_width)
+    for s in stages:
+        out = getattr(s, "_output", None)
+        in_ws = [env.get(id(p), (None, False)) for p in s.inputs]
+        ow, oexact = env.get(id(out), (None, False)) if out is not None \
+            else (None, False)
+        # model stages see the width of their LAST input (the design vector:
+        # PredictorEstimator wires (response, features)); feature stages are
+        # described by their output width
+        is_model_stage = (callable(getattr(s, "resource_profile", None))
+                          or callable(getattr(s, "optimizer_state_bytes",
+                                              None)))
+        if is_model_stage and in_ws:
+            width, wexact = in_ws[-1]
+        else:
+            width, wexact = ow, oexact
+        sr = StageResource(
+            stage_uid=s.uid,
+            name=type(s).__name__,
+            operation=getattr(s, "operation_name", type(s).__name__),
+            width=width,
+            width_exact=bool(wexact),
+        )
+        # row layout: mesh-aware stages (estimators, stats passes) lay rows
+        # over the data axis — weight-0 padding to the axis per
+        # mesh.shard_rows_padded; pure transformers see the full table
+        mesh_aware = hasattr(s, "mesh")
+        if n_rows is not None:
+            if mesh_aware and n_data > 1:
+                sr.pad_rows = (-int(n_rows)) % n_data
+                sr.rows_per_device = _ceil_div(int(n_rows) + sr.pad_rows,
+                                               n_data)
+                sr.rows_sharded = True
+            else:
+                sr.rows_per_device = int(n_rows)
+        if (sr.rows_per_device is not None and sr.width is not None
+                and sr.activation_bytes == 0):
+            sr.activation_bytes = sr.rows_per_device * sr.width * 4
+        profile = getattr(s, "resource_profile", None)
+        if callable(profile):
+            try:
+                prof = profile(width=width, n_rows=n_rows,
+                               mesh_shape=(n_data, n_model)) or {}
+            except (TypeError, ValueError, KeyError):
+                prof = {"notes": ["resource_profile failed; stage unmodeled"]}
+            for key in ("params_bytes", "opt_state_bytes", "aux_bytes",
+                        "activation_bytes", "collective_bytes", "flops",
+                        "pad_rows", "rows_per_device", "grid_points",
+                        "grid_pad"):
+                if key in prof and prof[key] is not None:
+                    setattr(sr, key, int(prof[key]))
+            for key in ("rows_sharded", "opt_sharded", "features_sharded"):
+                if key in prof:
+                    setattr(sr, key, bool(prof[key]))
+            sr.notes = tuple(prof.get("notes", ()))
+        model.stages.append(sr)
+    return model
+
+
+def pad_row_fraction(sr: StageResource, n_rows: Optional[int]) -> float:
+    """Fraction of the stage's GLOBAL padded rows that are weight-0 clones."""
+    if not sr.pad_rows or not n_rows:
+        return 0.0
+    return sr.pad_rows / (int(n_rows) + sr.pad_rows)

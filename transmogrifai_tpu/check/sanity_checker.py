@@ -184,6 +184,16 @@ class SanityChecker(Estimator):
             raise TypeError(f"SanityChecker features input must be OPVector, got {feat.name}")
         return kind_of("OPVector")
 
+    def static_width(self, in_widths):
+        """`op explain` width hook: pass the vector input's width through —
+        an upper bound when remove_bad_features can drop slots (see
+        static_width_exact)."""
+        return in_widths[-1]
+
+    @property
+    def static_width_exact(self) -> bool:
+        return not self.params.get("remove_bad_features", False)
+
     def is_response_out(self) -> bool:
         return False
 
@@ -443,6 +453,9 @@ class SanityCheckerModel(Transformer):
 
     def out_kind(self, in_kinds):
         return kind_of("OPVector")
+
+    def static_width(self, in_widths):
+        return int(self.params["pad_to"]) or len(self.params["keep_indices"])
 
     def is_response_out(self) -> bool:
         return False

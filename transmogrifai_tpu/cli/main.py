@@ -195,6 +195,83 @@ def _cmd_gen(argv) -> int:
     return 0
 
 
+def _load_app_workflow(app_spec, prog: str):
+    """Resolve `--app module:fn` to a Workflow (shared by lint/explain).
+
+    Returns the workflow, or an int exit code on usage errors (callers
+    propagate it)."""
+    if not app_spec:
+        print(f"{prog}: --app module:fn is required", file=sys.stderr)
+        return 2
+    mod_name, _, fn_name = app_spec.partition(":")
+    if not fn_name:
+        print(f"{prog}: --app must be module:function", file=sys.stderr)
+        return 2
+    sys.path.insert(0, ".")
+    app = getattr(importlib.import_module(mod_name), fn_name)()
+    workflow = getattr(app, "workflow", app)  # WorkflowRunner or bare Workflow
+    if not getattr(workflow, "result_features", ()):
+        print(f"{prog}: the app's workflow has no result features",
+              file=sys.stderr)
+        return 2
+    return workflow
+
+
+def _cmd_explain(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op explain",
+        description="static sharding & resource analysis: predict per-device "
+                    "HBM residency, collective traffic per fit, and padding "
+                    "waste for every stage of an app's plan at a given mesh — "
+                    "pure host arithmetic over the plan DAG, zero data read, "
+                    "zero XLA traces or compiles")
+    ap.add_argument("--app", default=None,
+                    help="module:function returning a WorkflowRunner or a "
+                         "Workflow (function takes no required args)")
+    ap.add_argument("--mesh", default=None, metavar="DATA,MODEL",
+                    help="mesh shape to price the plan at, e.g. 4,2 "
+                         "(default: the ambient device count, data-parallel)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="symbolic training row count (activations and row "
+                         "padding are unpriced without it)")
+    ap.add_argument("--assume-width", type=int, default=None, metavar="W",
+                    help="fallback width for vector stages whose width cannot "
+                         "be derived statically (default 64, env "
+                         "TT_EXPLAIN_ASSUME_WIDTH)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit {resource_model, report} as JSON on stdout")
+    args = ap.parse_args(argv)
+
+    workflow = _load_app_workflow(args.app, "op explain")
+    if isinstance(workflow, int):
+        return workflow
+    from transmogrifai_tpu.analyze import (analyze_plan, build_resource_model,
+                                           explain_mesh_shape)
+
+    mesh_shape = explain_mesh_shape(args.mesh)
+    dag = getattr(workflow, "_dag", None)
+    raw = getattr(workflow, "raw_features", None) or None
+    rm = build_resource_model(
+        workflow.result_features, dag, mesh_shape=mesh_shape,
+        n_rows=args.rows, raw_features=raw, assume_width=args.assume_width)
+    report = analyze_plan(
+        workflow.result_features, dag, raw_features=raw,
+        workflow_cv=getattr(workflow, "_workflow_cv", False),
+        mesh_shape=mesh_shape, n_rows=args.rows,
+        rules=("OP501", "OP502", "OP503", "OP504", "OP505"))
+    if args.as_json:
+        import json
+
+        print(json.dumps({"resource_model": rm.to_json(),
+                          "report": report.to_json()}, indent=1))
+    else:
+        print(rm.pretty())
+        if report.errors or report.warnings:
+            print()
+            print(report.pretty())
+    return 1 if report.has_errors else 0
+
+
 def _cmd_lint(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="op lint",
@@ -209,6 +286,13 @@ def _cmd_lint(argv) -> int:
                     help="emit the structured report as JSON on stdout (for CI)")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--mesh", default=None, metavar="DATA,MODEL",
+                    help="resolve a mesh shape (e.g. 4,2) and arm the OP5xx "
+                         "resource rules; without it lint stays meshless "
+                         "(historical OP405 behavior)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="symbolic row count for the OP5xx resource model "
+                         "(only meaningful with --mesh)")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.analyze import RULES, analyze_plan
@@ -222,24 +306,20 @@ def _cmd_lint(argv) -> int:
             for r in RULES.values():
                 print(f"{r.code}  {r.severity:5s} {r.title} — {r.rationale}")
         return 0
-    if not args.app:
-        print("op lint: --app module:fn is required (or --rules)", file=sys.stderr)
-        return 2
-    mod_name, _, fn_name = args.app.partition(":")
-    if not fn_name:
-        print("op lint: --app must be module:function", file=sys.stderr)
-        return 2
-    sys.path.insert(0, ".")
-    app = getattr(importlib.import_module(mod_name), fn_name)()
-    workflow = getattr(app, "workflow", app)  # WorkflowRunner or bare Workflow
-    if not getattr(workflow, "result_features", ()):
-        print("op lint: the app's workflow has no result features", file=sys.stderr)
-        return 2
+    workflow = _load_app_workflow(args.app, "op lint")
+    if isinstance(workflow, int):
+        return workflow
+    mesh_shape = None
+    if args.mesh:
+        from transmogrifai_tpu.analyze import explain_mesh_shape
+
+        mesh_shape = explain_mesh_shape(args.mesh)
     report = analyze_plan(
         workflow.result_features,
         getattr(workflow, "_dag", None),
         raw_features=getattr(workflow, "raw_features", None) or None,
         workflow_cv=getattr(workflow, "_workflow_cv", False),
+        mesh_shape=mesh_shape, n_rows=args.rows,
     )
     if args.as_json:
         import json
@@ -750,7 +830,10 @@ def main(argv=None) -> int:
             "features|evaluate|streaming_score)\n"
             "  gen       scaffold a project from a CSV (--input --id --response)\n"
             "  lint      statically analyze an app's plan "
-            "(--app module:fn [--json] [--rules])\n"
+            "(--app module:fn [--json] [--rules] [--mesh D,M])\n"
+            "  explain   predict per-device HBM, collective traffic and "
+            "padding waste per stage, before any trace "
+            "(--app module:fn [--mesh D,M] [--rows N] [--json])\n"
             "  monitor   serving telemetry: drift report vs the model's "
             "training baseline + metrics export (--model DIR [--scoring CSV] "
             "| --demo) [--prom|--json]\n"
@@ -781,6 +864,8 @@ def main(argv=None) -> int:
         return _cmd_gen(rest)
     if cmd == "lint":
         return _cmd_lint(rest)
+    if cmd == "explain":
+        return _cmd_explain(rest)
     if cmd == "monitor":
         return _cmd_monitor(rest)
     if cmd == "serve":

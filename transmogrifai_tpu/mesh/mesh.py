@@ -49,6 +49,10 @@ _MESH_COUNTERS = {
     "sharded_dispatches": ("mesh_sharded_dispatches_total",
                            "dispatches of programs over sharded operands "
                            "(psum over ICI)"),
+    "collective_bytes": ("mesh_collective_bytes_total",
+                         "modeled ICI collective payload bytes recorded by "
+                         "sharded fits (psum/all_gather/psum_scatter "
+                         "tensors, Alpa-style byte counting)"),
 }
 
 
@@ -64,7 +68,7 @@ _MESH_STATS_LOCK = threading.Lock()
 #: reset_mesh_stats() baseline: registry counters are monotone by contract,
 #: so "reset" subtracts a remembered floor instead of rewinding them
 _MESH_STATS_BASE = {"transfers": 0.0, "transfer_bytes": 0.0,
-                    "sharded_dispatches": 0.0}
+                    "sharded_dispatches": 0.0, "collective_bytes": 0.0}
 
 
 def record_transfer(arr) -> None:
@@ -76,6 +80,16 @@ def record_sharded_dispatch(n: int = 1) -> None:
     """Count a dispatch of a program running over sharded operands (its
     cross-device reductions lower to psum over ICI)."""
     _counter("sharded_dispatches").inc(int(n))
+
+
+def record_collective(nbytes: int) -> None:
+    """Record the modeled ICI payload of a sharded fit's collectives
+    (logical tensor bytes per psum/all_gather/psum_scatter, summed over the
+    fit). Recorded host-side by the sharded trainers from their RUNTIME
+    shapes, so the static resource model (analyze/shard_model.py) can be
+    held to predicted-vs-measured parity in tests."""
+    if nbytes > 0:
+        _counter("collective_bytes").inc(int(nbytes))
 
 
 def mesh_stats() -> dict:

@@ -32,8 +32,10 @@ from .optimizer import (
     adam_update,
     flatten_pad,
     gather_compute,
+    optimizer_state_bytes,
     record_state_bytes,
     resolve_shard_optimizer,
+    shard_width,
     unflatten,
 )
 
@@ -47,6 +49,74 @@ def _layer_shapes(d: int, hidden: Sequence[int], num_classes: int):
 def _n_params(d: int, hidden: Sequence[int], num_classes: int) -> int:
     w_shapes, b_shapes = _layer_shapes(d, hidden, num_classes)
     return sum(i * o for i, o in w_shapes) + sum(o for (o,) in b_shapes)
+
+
+def mlp_collective_bytes(d: int, hidden: Sequence[int], num_classes: int, *,
+                         n_data: int, max_iter: int) -> int:
+    """Modeled ICI payload of ONE sharded full-batch fit, in logical tensor
+    bytes (the Alpa counting convention, arXiv 2201.12023): per step every
+    layer's flat-padded f32 leaf is all_gathered for the forward pass and
+    its gradient psum_scattered by gather_compute's vjp, and the fitted
+    params all_gather once at the end. Mirrors _fullbatch_program_sharded
+    term-for-term; the static resource model and the runtime
+    `mesh_collective_bytes_total` counter both call THIS function, with
+    independently-derived shapes, so parity tests catch drift in either."""
+    n_data = int(n_data)
+    if n_data <= 1:
+        return 0
+    w_shapes, b_shapes = _layer_shapes(int(d), tuple(hidden),
+                                       int(num_classes))
+    def leaf(size: int) -> int:
+        return n_data * shard_width(size, n_data) * 4  # padded flat f32
+
+    per_step = (sum(leaf(i * o) for i, o in w_shapes)
+                + sum(leaf(o) for (o,) in b_shapes))
+    # gather + scatter per step, one final tiled all_gather of the result
+    return (2 * int(max_iter) + 1) * per_step
+
+
+def mlp_resource_profile(*, d: int, hidden: Sequence[int], num_classes: int,
+                         max_iter: int, n_rows, n_data: int,
+                         shard_optimizer="auto") -> dict:
+    """Static per-device footprint of one fit_mlp call at a mesh data axis of
+    `n_data` — the stage-hook payload behind `op explain` (see
+    analyze/shard_model.py for the key contract). Shares every byte formula
+    with the runtime: optimizer_state_bytes for the ZeRO shard math,
+    mlp_collective_bytes for the ICI payload."""
+    d, num_classes = int(d), max(int(num_classes), 2)
+    hidden = tuple(int(h) for h in hidden)
+    n_data = max(1, int(n_data))
+    P = _n_params(d, hidden, num_classes)
+    knob_off = (shard_optimizer in (False, None)
+                or str(shard_optimizer) in ("off", "0"))
+    sharded = n_data > 1 and not knob_off
+    pad = ((-int(n_rows)) % n_data if (sharded and n_rows) else 0)
+    rows_dev = None
+    if n_rows:
+        rows_dev = (-(-(int(n_rows) + pad) // n_data)
+                    if (sharded or (n_data > 1 and int(n_rows) % n_data == 0))
+                    else int(n_rows))
+    w_sizes = sum(i * o for i, o in _layer_shapes(d, hidden, num_classes)[0])
+    act = (rows_dev * (d + sum(hidden) + num_classes) * 4
+           if rows_dev is not None else 0)
+    return {
+        "params_bytes": 4 * P,
+        "opt_state_bytes": optimizer_state_bytes(
+            P, sharded, n_data if sharded else 1),
+        "activation_bytes": act,
+        "collective_bytes": (mlp_collective_bytes(
+            d, hidden, num_classes, n_data=n_data, max_iter=max_iter)
+            if sharded else 0),
+        "flops": (6 * rows_dev * w_sizes * int(max_iter)
+                  if rows_dev is not None else 0),
+        "pad_rows": pad,
+        "rows_per_device": rows_dev,
+        "rows_sharded": bool(rows_dev is not None and n_data > 1
+                             and rows_dev < int(n_rows)),
+        "opt_sharded": sharded,
+        "notes": (("shard_optimizer=off: state replicates",) if knob_off
+                  and n_data > 1 else ()),
+    }
 
 
 def _adam_fullbatch(X, y, w, params, *, num_classes: int, max_iter: int,
@@ -303,6 +373,10 @@ def _fit_mlp_sharded(X, y, sample_weight, *, num_classes, hidden, max_iter,
                                       int(d), int(max_iter), int(seed))
     record_state_bytes(_n_params(d, hidden, num_classes), sharded=True,
                        n_shards=n_data)
+    from ..mesh import record_collective
+    record_collective(mlp_collective_bytes(d, hidden, num_classes,
+                                           n_data=n_data,
+                                           max_iter=int(max_iter)))
     record_sharded_dispatch()
     return prog(shard_batch(mesh, X), shard_batch(mesh, y),
                 shard_batch(mesh, w), jnp.float32(lr), jnp.float32(l2))

@@ -563,12 +563,112 @@ def _weights(sample_weight, n):
 
 
 # --- gradient boosting (GBT / XGBoost-style, second order) ---------------------------
+def gbt_psum_payload_bytes(*, n_outputs: int, n_trees: int, max_depth: int,
+                           n_bins: int, d_local: int) -> int:
+    """ICI payload of the data-axis fused split program for ONE fit, in
+    logical tensor bytes: each tree level psums one flat
+    [n_bins * 2C * n_nodes, d_local] f32 partial histogram
+    (_data_axis_hist_split's `part`), and levels 0..max_depth-1 sum to
+    2**max_depth - 1 node slots per tree. The static resource model
+    (analyze/shard_model.py) and the runtime `mesh_collective_bytes_total`
+    record both price with THIS function — shapes derived independently, so
+    parity tests catch drift in either."""
+    V = 2 * max(1, int(n_outputs))  # g,h stacked per output column
+    return (int(n_trees) * int(n_bins) * V * ((1 << int(max_depth)) - 1)
+            * int(d_local) * 4)
+
+
+def gbt_data_sharded(*, n_data: int, use_l1: bool, n_bins: int) -> bool:
+    """The _fit_gbt/fit_forest data-axis gate, re-derivable without a fit:
+    >1 data axis, literal-zero L1, something to scan, no twopass override."""
+    return (int(n_data) > 1 and not use_l1 and int(n_bins) >= 2
+            and os.environ.get("TT_SPLIT") != "twopass")
+
+
+def gbt_resource_profile(*, n_rows, d, n_outputs: int, n_trees: int,
+                         max_depth: int, n_bins: int, n_data: int,
+                         n_model: int, use_l1: bool = False) -> dict:
+    """Static per-device footprint of one boosted/bagged fit — the stage-hook
+    payload behind `op explain` (key contract in analyze/shard_model.py).
+    Mirrors _fit_gbt's own resolution order: model-axis feature slabs when
+    n_model divides D, data-axis row shards (weight-0 padded) when the fused
+    gates open, int8 binned matrix under 128 bins."""
+    n_data, n_model = max(1, int(n_data)), max(1, int(n_model))
+    d = int(d) if d else 0
+    model_sharded = n_model > 1 and d > 0 and d % n_model == 0
+    d_local = d // n_model if model_sharded else d
+    data_sharded = gbt_data_sharded(n_data=n_data, use_l1=use_l1,
+                                    n_bins=n_bins)
+    pad = ((-int(n_rows)) % n_data if (data_sharded and n_rows) else 0)
+    rows_dev = None
+    if n_rows:
+        rows_dev = (-(-(int(n_rows) + pad) // n_data) if data_sharded
+                    else int(n_rows))
+    cell = 1 if int(n_bins) <= 127 else 4
+    V = 2 * max(1, int(n_outputs))
+    notes = []
+    if n_data > 1 and not data_sharded:
+        notes.append("data axis unused: fused-split gates closed "
+                     "(L1/bins/TT_SPLIT) — rows replicate (OP406)")
+    if n_model > 1 and not model_sharded:
+        notes.append(f"model axis unused: D={d} not divisible by "
+                     f"{n_model}")
+    flops = 0
+    if rows_dev is not None and d_local:
+        # per level: histogram accumulate over the row shard, then the
+        # merged [bins, V, nodes, d_local] split scan
+        flops = int(n_trees) * int(max_depth) * rows_dev * d_local * V * 2
+        flops += (int(n_trees) * ((1 << int(max_depth)) - 1) * int(n_bins)
+                  * V * d_local * 2)
+    return {
+        "aux_bytes": (rows_dev * d_local * cell
+                      if (rows_dev is not None and d_local) else 0),
+        "activation_bytes": (rows_dev * (d + V) * 4
+                             if (rows_dev is not None and d) else 0),
+        "collective_bytes": (gbt_psum_payload_bytes(
+            n_outputs=n_outputs, n_trees=n_trees, max_depth=max_depth,
+            n_bins=n_bins, d_local=d_local) if (data_sharded and d_local)
+            else 0),
+        "flops": flops,
+        "pad_rows": pad,
+        "rows_per_device": rows_dev,
+        "rows_sharded": data_sharded,
+        "features_sharded": model_sharded,
+        "notes": notes,
+    }
+
+
+def _record_gbt_collectives(X, y, *, use_l1, mesh=None, objective="binary",
+                            num_classes=2, n_trees=50, max_depth=5,
+                            n_bins=32, **_kw) -> None:
+    """Host-side honesty hook: when the fused data-axis program will run,
+    record its psum payload (from the RUNTIME shapes) so mesh_stats() can be
+    compared against the static prediction. Vmapped/batched fits and closed
+    gates record nothing — exactly the fits that psum nothing."""
+    if mesh is None or _is_batched(X, y):
+        return
+    from ..mesh import MODEL_AXIS, data_axis_size, record_collective
+
+    if not gbt_data_sharded(n_data=data_axis_size(mesh), use_l1=use_l1,
+                            n_bins=n_bins):
+        return
+    D = int(jnp.shape(X)[1])
+    n_model = int(mesh.shape[MODEL_AXIS])
+    model_sharded = n_model > 1 and D % n_model == 0
+    d_local = D // n_model if model_sharded else D
+    C = int(num_classes) if objective == "multiclass" else 1
+    record_collective(gbt_psum_payload_bytes(
+        n_outputs=C, n_trees=int(n_trees), max_depth=int(max_depth),
+        n_bins=int(n_bins), d_local=d_local))
+
+
 def fit_gbt(X, y, sample_weight=None, *, reg_alpha=0.0, **kw):
     """Public entry: decides the static use_l1 flag OUTSIDE the jit boundary.
     Inside _fit_gbt a default reg_alpha=0.0 would arrive as a TRACER, defeating
     _l1_threshold's literal-zero skip and taxing every fit with thresholding
     ops it doesn't need."""
     use_l1 = not (isinstance(reg_alpha, (int, float)) and reg_alpha == 0)
+    _record_gbt_collectives(X, y, use_l1=use_l1, **kw)
     return _fit_gbt(X, y, sample_weight, reg_alpha=reg_alpha, use_l1=use_l1, **kw)
 
 

@@ -245,6 +245,64 @@ class ModelSelector(PredictorEstimator):
         stage.uid = data["uid"]
         return stage
 
+    def resource_profile(self, *, width, n_rows, mesh_shape) -> dict:
+        """`op explain` hook (key contract in analyze/shard_model.py): the
+        search vmaps each family's grid over the model axis — grid-padded
+        with CLONE points to divide it — and vmapped fits run REPLICATED
+        (resolve_shard_optimizer's batched check), so the per-point state
+        multiplies by the padded point count and no collective traffic is
+        modeled. The winner refit is a solo fit priced like the standalone
+        stage; the search phase reported here dominates."""
+        n_data, n_model = int(mesh_shape[0]), int(mesh_shape[1])
+        peak = {"params": 0, "opt": 0, "aux": 0, "points": 1, "pad": 0,
+                "name": None}
+        notes = []
+        for template, grid in self.models:
+            points = max(1, len(grid) if grid else 1)
+            pad = (-points) % n_model if n_model > 1 else 0
+            prof = {}
+            hook = getattr(template, "resource_profile", None)
+            if callable(hook):
+                try:
+                    # (1, 1): vmapped fits cannot shard_map — replicated
+                    prof = hook(width=width, n_rows=n_rows,
+                                mesh_shape=(1, 1)) or {}
+                except (TypeError, ValueError, KeyError):
+                    prof = {}
+            elif width:
+                # linear families: f32 weights + bias per point
+                prof = {"params_bytes": 4 * (int(width) + 1)}
+            per = (int(prof.get("params_bytes", 0) or 0)
+                   + int(prof.get("opt_state_bytes", 0) or 0))
+            total = (points + pad) * per + int(prof.get("aux_bytes", 0) or 0)
+            if total >= (peak["points"] + peak["pad"]) * (
+                    peak["params"] + peak["opt"]) + peak["aux"]:
+                peak = {"params": int(prof.get("params_bytes", 0) or 0),
+                        "opt": int(prof.get("opt_state_bytes", 0) or 0),
+                        "aux": int(prof.get("aux_bytes", 0) or 0),
+                        "points": points, "pad": pad,
+                        "name": type(template).__name__}
+            if pad:
+                notes.append(f"{type(template).__name__}: {points} grid "
+                             f"points pad +{pad} clones to divide "
+                             f"model={n_model}")
+        points_all = peak["points"] + peak["pad"]
+        if peak["name"]:
+            notes.append(f"peak family {peak['name']}: x{points_all} vmapped "
+                         "grid points, state replicated per point")
+        return {
+            "params_bytes": points_all * peak["params"],
+            "opt_state_bytes": points_all * peak["opt"],
+            "aux_bytes": peak["aux"],
+            "activation_bytes": (int(n_rows) * int(width) * 4
+                                 if (n_rows and width) else 0),
+            "rows_per_device": int(n_rows) if n_rows else None,
+            "rows_sharded": False,
+            "grid_points": peak["points"],
+            "grid_pad": peak["pad"],
+            "notes": notes,
+        }
+
     # the selector's own fit is the whole search; fit_fn/predict_fn are the winner's
     def fit_columns(self, cols):
         import jax

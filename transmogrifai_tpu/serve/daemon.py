@@ -256,6 +256,18 @@ class ServingDaemon:
             label = name or f"m_{fp[:12]}"
             with obs.span(f"serve:admit:{label}"):
                 model = WorkflowModel.load(path)
+                rm = getattr(model, "resource_model", None)
+                if rm:
+                    # surface the bundle's train-time `op explain` prediction
+                    # on the admit span: operators see the model's expected
+                    # per-device HBM / collective bytes before the first score
+                    t = rm.get("totals") or {}
+                    obs.add_event(
+                        "explain", source="bundle",
+                        mesh="%sx%s" % tuple(rm.get("mesh_shape", (1, 1))),
+                        peak_stage=t.get("peak_stage_uid"),
+                        peak_resident_bytes=t.get("peak_resident_bytes"),
+                        collective_bytes=t.get("collective_bytes"))
                 policy = self._policy
                 if policy is None and self._quarantine_root is not None:
                     from ..resilience import FaultPolicy

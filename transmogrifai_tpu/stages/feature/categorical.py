@@ -56,11 +56,19 @@ class OneHotVectorizer(SequenceVectorizerEstimator):
 
     operation_name = "pivot"
     accepts = _CATEGORICAL_TEXT + ("Binary",)
+    #: static_width is an UPPER bound — vocabularies below top_k pivot fewer
+    #: slots (op explain width hook, analyze/shard_model.py)
+    static_width_exact = False
 
     def __init__(self, top_k: int = 20, min_support: int = 10, clean_text: bool = True,
                  track_nulls: bool = True):
         super().__init__(top_k=top_k, min_support=min_support, clean_text=clean_text,
                          track_nulls=track_nulls)
+
+    def static_width(self, in_widths):
+        per = int(self.params["top_k"]) + 1 + (
+            1 if self.params["track_nulls"] else 0)
+        return per * len(in_widths)
 
     def fit_columns(self, cols: Sequence[Column]):
         p = self.params

@@ -60,6 +60,19 @@ class VectorsCombiner(SequenceVectorizer):
                          fitted_width=int(fitted_width),
                          target_width=int(target_width))
 
+    def static_width(self, in_widths):
+        """`op explain` width hook (analyze/shard_model.py): the same
+        sum -> fitted-width match -> bucket resolution transform_columns
+        applies, minus the data."""
+        if any(w is None for w in in_widths):
+            return None
+        from ...types import bucket_width
+
+        width = sum(int(w) for w in in_widths)
+        if width == self.params["fitted_width"] and self.params["target_width"]:
+            return int(self.params["target_width"])
+        return bucket_width(width) if self.params["pad_to_bucket"] else width
+
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         from ...types import bucket_width
 

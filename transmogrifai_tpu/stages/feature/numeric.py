@@ -28,6 +28,13 @@ from .common import (
 _REAL_KINDS = ("Real", "Currency", "Percent")
 
 
+def _tracked_width(params, in_widths):
+    """static_width shared by the [value, isNull?]-per-input vectorizers —
+    the `op explain` width propagation hook (analyze/shard_model.py)."""
+    return (2 if params["track_nulls"] else 1) * len(in_widths)
+
+
+
 @register_stage
 class RealVectorizer(SequenceVectorizerEstimator):
     """Real/Currency/Percent -> [value(filled), isNull?] per input
@@ -39,6 +46,9 @@ class RealVectorizer(SequenceVectorizerEstimator):
 
     def __init__(self, fill_value: str | float = "mean", track_nulls: bool = True):
         super().__init__(fill_value=fill_value, track_nulls=track_nulls)
+
+    def static_width(self, in_widths):
+        return _tracked_width(self.params, in_widths)
 
     def fit_columns(self, cols: Sequence[Column]):
         if self.params["fill_value"] == "mean":
@@ -74,6 +84,9 @@ class RealVectorizerModel(SequenceVectorizer):
     operation_name = "vecReal"
     device_op = True
 
+    def static_width(self, in_widths):
+        return _tracked_width(self.params, in_widths)
+
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         p = self.params
         parts, slots = [], []
@@ -94,6 +107,9 @@ class RealNNVectorizer(SequenceVectorizer):
     device_op = True
     accepts = ("RealNN",)
 
+    def static_width(self, in_widths):
+        return len(in_widths)
+
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         parts = [jnp.asarray(c.values, jnp.float32) for c in cols]
         slots = [value_slot(f.name, f.kind.name) for f in self.inputs]
@@ -110,6 +126,9 @@ class IntegralVectorizer(SequenceVectorizerEstimator):
 
     def __init__(self, fill_value: str | int = "mode", track_nulls: bool = True):
         super().__init__(fill_value=fill_value, track_nulls=track_nulls)
+
+    def static_width(self, in_widths):
+        return _tracked_width(self.params, in_widths)
 
     def fit_columns(self, cols: Sequence[Column]):
         fills = []
@@ -132,6 +151,9 @@ class IntegralVectorizerModel(SequenceVectorizer):
     operation_name = "vecIntegral"
     # integral columns are host int64; conversion to float32 happens here, then device
     device_op = False
+
+    def static_width(self, in_widths):
+        return _tracked_width(self.params, in_widths)
 
     def make_serving_kernel(self):
         """Pure-numpy kernel + schema built once (serving fast path; the int64
@@ -171,6 +193,9 @@ class BinaryVectorizer(SequenceVectorizer):
 
     def __init__(self, track_nulls: bool = True, fill_value: bool = False):
         super().__init__(track_nulls=track_nulls, fill_value=fill_value)
+
+    def static_width(self, in_widths):
+        return _tracked_width(self.params, in_widths)
 
     def transform_columns(self, cols: Sequence[Column]) -> Column:
         parts, slots = [], []

@@ -140,6 +140,23 @@ class MLPClassifier(MeshAwareFit, ClassifierEstimator):
         n_params = sum(i * o + o for i, o in zip(sizes[:-1], sizes[1:]))
         return optimizer_state_bytes(n_params, sharded=False)
 
+    def resource_profile(self, *, width, n_rows, mesh_shape) -> dict:
+        """Static per-device footprint at a RESOLVED mesh and design width —
+        the `op explain` hook (analyze/shard_model.py). Unlike
+        optimizer_state_bytes (a width-blind lower bound for meshless
+        OP405), this prices the full layer chain including the input layer
+        and the ZeRO sharding the knob would resolve to."""
+        from ...ops.mlp import mlp_resource_profile
+
+        if not width:
+            return {"notes": ["design width unknown: input layer unpriced"]}
+        return mlp_resource_profile(
+            d=int(width), hidden=self.params["hidden"],
+            num_classes=max(int(self.params["num_classes"]), 2),
+            max_iter=int(self.params["max_iter"]), n_rows=n_rows,
+            n_data=int(mesh_shape[0]),
+            shard_optimizer=self.params.get("shard_optimizer", "auto"))
+
     def make_model(self, params):
         layers = host_params([(W, b) for W, b in params])
         return MLPClassifierModel(

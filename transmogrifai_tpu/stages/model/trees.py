@@ -68,6 +68,37 @@ def _params_json(params: TreeEnsembleParams) -> dict:
     return out
 
 
+class _TreeResourceProfile:
+    """`op explain` hook shared by every tree family (key contract in
+    analyze/shard_model.py): boosted and bagged fits share the grower and
+    the data-axis fused-split program, so they share one cost model
+    (ops.trees.gbt_resource_profile). Output-column count mirrors the fit
+    objectives: bagged classification one-hots (C = num_classes), boosting
+    is single-column for binary/regression and C-column for multiclass."""
+
+    #: bagged families one-hot their targets; boosted regress margins
+    _bagged = False
+
+    def _n_output_columns(self) -> int:
+        ncls = int(self.params.get("num_classes", 0) or 0)
+        if self._bagged:
+            return max(ncls, 2) if isinstance(self, ClassifierEstimator) else 1
+        return ncls if ncls > 2 else 1
+
+    def resource_profile(self, *, width, n_rows, mesh_shape) -> dict:
+        from ...ops.trees import gbt_resource_profile
+
+        p = self.params
+        reg_alpha = p.get("reg_alpha", 0.0)
+        return gbt_resource_profile(
+            n_rows=n_rows, d=width, n_outputs=self._n_output_columns(),
+            n_trees=int(p.get("n_trees", 1)), max_depth=int(p["max_depth"]),
+            n_bins=int(p["n_bins"]), n_data=int(mesh_shape[0]),
+            n_model=int(mesh_shape[1]),
+            use_l1=not (isinstance(reg_alpha, (int, float))
+                        and reg_alpha == 0))
+
+
 class _TreeModelBase(PredictionModel):
     """Converts the JSON list params to device TreeEnsembleParams once, eagerly at
     construction — construction always happens OUTSIDE jit (fit or from_json), so the
@@ -95,10 +126,11 @@ class _TreeModelBase(PredictionModel):
 
 
 @register_stage
-class RandomForestClassifier(MeshAwareFit, ClassifierEstimator):
+class RandomForestClassifier(_TreeResourceProfile, MeshAwareFit, ClassifierEstimator):
     """Bagged histogram trees with class-distribution leaves (binary + multiclass)."""
 
     operation_name = "randomForestClassifier"
+    _bagged = True  # one-hot targets: V = 2C in the fused-split psum
     vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
 
     def __init__(self, num_classes: int = 0, n_trees: int = 50, max_depth: int = 6,
@@ -132,8 +164,9 @@ class RandomForestClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class RandomForestRegressor(MeshAwareFit, PredictorEstimator):
+class RandomForestRegressor(_TreeResourceProfile, MeshAwareFit, PredictorEstimator):
     operation_name = "randomForestRegressor"
+    _bagged = True  # one-hot targets: V = 2C in the fused-split psum
     vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
 
     def __init__(self, n_trees: int = 50, max_depth: int = 6,
@@ -165,10 +198,11 @@ class RandomForestRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class DecisionTreeClassifier(MeshAwareFit, ClassifierEstimator):
+class DecisionTreeClassifier(_TreeResourceProfile, MeshAwareFit, ClassifierEstimator):
     """Single un-bagged tree (n_trees=1, no bootstrap) — OpDecisionTreeClassifier."""
 
     operation_name = "decisionTreeClassifier"
+    _bagged = True  # one-hot targets: V = 2C in the fused-split psum
     vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
 
     def __init__(self, num_classes: int = 0, max_depth: int = 6,
@@ -200,8 +234,9 @@ class DecisionTreeClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class DecisionTreeRegressor(MeshAwareFit, PredictorEstimator):
+class DecisionTreeRegressor(_TreeResourceProfile, MeshAwareFit, PredictorEstimator):
     operation_name = "decisionTreeRegressor"
+    _bagged = True  # one-hot targets: V = 2C in the fused-split psum
     vmap_params = ("reg_lambda", "min_child_weight", "min_gain")
 
     def __init__(self, max_depth: int = 6, min_child_weight: float = 10.0,
@@ -231,7 +266,7 @@ class DecisionTreeRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class GBTClassifier(MeshAwareFit, PredictorEstimator):
+class GBTClassifier(_TreeResourceProfile, MeshAwareFit, PredictorEstimator):
     """Binary gradient-boosted trees (OpGBTClassifier; Spark GBT is binary-only)."""
 
     operation_name = "gbtClassifier"
@@ -268,7 +303,7 @@ class GBTClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class GBTRegressor(MeshAwareFit, PredictorEstimator):
+class GBTRegressor(_TreeResourceProfile, MeshAwareFit, PredictorEstimator):
     operation_name = "gbtRegressor"
     vmap_params = ("learning_rate", "reg_lambda", "min_child_weight", "min_gain")
 
@@ -303,7 +338,7 @@ class GBTRegressorModel(_TreeModelBase):
 
 
 @register_stage
-class XGBoostClassifier(MeshAwareFit, ClassifierEstimator):
+class XGBoostClassifier(_TreeResourceProfile, MeshAwareFit, ClassifierEstimator):
     """Second-order boosting with XGBoost-style defaults; multiclass via one
     multi-output softmax tree per round (TPU-friendly multi_strategy, no per-class
     tree loops). Analog of OpXGBoostClassifier.scala:48."""
@@ -367,7 +402,7 @@ class XGBoostClassifierModel(_TreeModelBase):
 
 
 @register_stage
-class XGBoostRegressor(MeshAwareFit, PredictorEstimator):
+class XGBoostRegressor(_TreeResourceProfile, MeshAwareFit, PredictorEstimator):
     operation_name = "xgboostRegressor"
     vmap_params = ("learning_rate", "reg_lambda", "reg_alpha", "min_child_weight",
                    "min_gain")

@@ -417,6 +417,39 @@ class Workflow(WorkflowCore):
                           message=d.message, stage_uid=d.stage_uid)
         return report
 
+    def _explain_gate(self, mesh, strict: bool):
+        """OP5xx re-lint at the RESOLVED mesh, before any data is read or
+        program traced. Plan-time `_analyze` runs meshless (OP405 prices HBM
+        against one device because `mesh="auto"` is unresolved there); once
+        train has the actual Mesh the static resource model
+        (analyze/shard_model.py) prices every stage on the devices the fit
+        will really use. OP501 over-budget is an error under strict; all
+        findings land on the trace as `explain` span events."""
+        from .. import obs
+        from ..analyze import analyze_plan
+        from ..mesh import DATA_AXIS, MODEL_AXIS
+
+        shape = (int(mesh.shape[DATA_AXIS]), int(mesh.shape[MODEL_AXIS]))
+        with obs.span("train:explain"):
+            report = analyze_plan(
+                self.result_features, self._dag,
+                raw_features=self.raw_features,
+                workflow_cv=self._workflow_cv,
+                mesh_shape=shape,
+                rules=("OP501", "OP502", "OP503", "OP504", "OP505"))
+            obs.add_event("explain", mesh="%dx%d" % shape,
+                          errors=len(report.errors),
+                          warnings=len(report.warnings))
+        if report.has_errors and strict:
+            from ..analyze import PlanAnalysisError
+
+            raise PlanAnalysisError(report)
+        for d in report.errors + report.warnings:
+            _logger.warning("op explain %s", d.pretty())
+            obs.add_event("explain", code=d.code, severity=d.severity,
+                          message=d.message, stage_uid=d.stage_uid)
+        return report
+
     def _train_impl(self, table: Optional[Table], sanitize: bool,
                     checkpoint_dir: Optional[str],
                     strict: bool = True, mesh=None) -> "WorkflowModel":
@@ -431,6 +464,10 @@ class Workflow(WorkflowCore):
             from ..mesh import default_mesh
 
             mesh = default_mesh()
+        if mesh is not None:
+            # resolved-mesh resource gate (OP501..OP505): closes the OP405
+            # blind spot where `mesh="auto"` hid the device count at lint time
+            self._explain_gate(mesh, strict)
         data = self._generate_raw()
         if sanitize:
             from ..utils.sanitize import check_stages
@@ -599,6 +636,22 @@ class Workflow(WorkflowCore):
         # plan-time report rides along so save() stamps it without re-analysis
         model.analysis_report = analysis
         model.serving_baseline = serving_baseline
+        try:
+            # static per-stage resource prediction at the mesh this train
+            # resolved and the rows it actually read — pure host arithmetic,
+            # stamped under model.json "resource_model" so serving hosts can
+            # audit placement without re-deriving the plan
+            from ..analyze.shard_model import build_resource_model
+            from ..mesh import DATA_AXIS, MODEL_AXIS
+
+            shape = ((int(mesh.shape[DATA_AXIS]), int(mesh.shape[MODEL_AXIS]))
+                     if mesh is not None else (1, 1))
+            model.resource_model = build_resource_model(
+                self.result_features, self._dag,
+                raw_features=self.raw_features, mesh_shape=shape,
+                n_rows=int(data.nrows)).to_json()
+        except Exception:  # modeling must never fail a completed train
+            _logger.warning("resource model stamp failed", exc_info=True)
         return model
 
 
@@ -660,6 +713,10 @@ class WorkflowModel(WorkflowCore):
         #: AnalysisReport from the producing train (None for loaded models;
         #: save() re-analyzes the fitted plan in that case)
         self.analysis_report = None
+        #: `op explain` resource prediction (ResourceModel.to_json()) at the
+        #: mesh/rows the producing train resolved — stamped by train(), saved
+        #: under model.json "resource_model", restored verbatim by load()
+        self.resource_model = None
         #: {raw feature name: FeatureDistribution} training baselines for the
         #: serving drift monitor (obs/monitor.py) — stamped by train(), saved
         #: under model.json "serving_baseline", restored by load()
@@ -861,6 +918,11 @@ class WorkflowModel(WorkflowCore):
             "blacklisted": [f.name for f in self.blacklisted],
             "stages": stage_payloads,
         }
+        if self.resource_model:
+            # the producing train's static resource prediction (per-device
+            # HBM, collective bytes, padding waste at the resolved mesh) —
+            # serving hosts read this to place the model without a trace
+            manifest["resource_model"] = self.resource_model
         if self.serving_baseline:
             # training feature distributions (fill rate + histogram + bin
             # edges) ride the artifact so a loaded model can drift-monitor
@@ -974,6 +1036,7 @@ class WorkflowModel(WorkflowCore):
             stages=stages,
         )
         model.uid = manifest["uid"]
+        model.resource_model = manifest.get("resource_model")
         if manifest.get("serving_baseline"):
             from ..obs.monitor import baseline_from_json
 
