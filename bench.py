@@ -458,6 +458,7 @@ def _run(partial: dict) -> None:
             run_boston,
             run_cold_start,
             run_disagg_ingest,
+            run_fleet_obs_overhead,
             run_hist,
             run_iris,
             run_mlp,
@@ -494,6 +495,16 @@ def _run(partial: dict) -> None:
             detail["monitor_overhead"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         partial["monitor_throughput_retention"] = \
             detail["monitor_overhead"].get("monitor_throughput_retention")
+        # fleet observability plane on vs off over the same streamed
+        # scoring: tracer + recorder + 4 Hz federation poller must retain
+        # >= 0.97 throughput
+        try:
+            detail["fleet_obs_overhead"] = run_fleet_obs_overhead()
+        except Exception as e:  # noqa: BLE001
+            detail["fleet_obs_overhead"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        partial["fleet_obs_throughput_retention"] = \
+            detail["fleet_obs_overhead"].get("fleet_obs_throughput_retention")
         # runtime fault-tolerance layer armed-vs-off on the same streamed
         # scoring: the fault-free path must retain >= 0.97 throughput
         try:
@@ -625,6 +636,12 @@ def _run(partial: dict) -> None:
         mo = detail["monitor_overhead"]
         s["monitor_throughput_retention"] = mo["monitor_throughput_retention"]
         s["monitored_rows_per_sec"] = mo["monitored_rows_per_sec"]
+    if detail.get("fleet_obs_overhead", {}).get(
+            "fleet_obs_throughput_retention") is not None:
+        fo = detail["fleet_obs_overhead"]
+        s["fleet_obs_throughput_retention"] = \
+            fo["fleet_obs_throughput_retention"]
+        s["fleet_obs_observed_rows_per_sec"] = fo["observed_rows_per_sec"]
     if detail.get("resilience_overhead", {}).get(
             "resilience_throughput_retention") is not None:
         ro = detail["resilience_overhead"]

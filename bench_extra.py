@@ -446,6 +446,111 @@ def run_monitor_overhead(n_batches: int = 32, batch: int = 512) -> dict:
     }
 
 
+def run_fleet_obs_overhead(n_batches: int = 32, batch: int = 512) -> dict:
+    """Fleet-observability overhead lane (ISSUE-16): the same streamed-scoring
+    run bare vs under the FULL fleet plane — an active role-labeled tracer
+    (every span recorded), an armed flight recorder (the `obs.add_event`
+    chokepoint feeds the ring), and a live federation consumer: the local
+    registry attached to a `FleetAggregator` with a background poller running
+    the exact merge at 4 Hz, the load `op top` puts on a process. Reports
+    rows/s for both and `fleet_obs_throughput_retention` = observed/bare
+    (1.0 = free; the acceptance floor is 0.97). Zero dumps must fire — a
+    fault-free run must never trip the recorder."""
+    import shutil
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu import obs
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import BatchStreamingReader, InMemoryReader
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    schema = {"label": "RealNN", **{f"x{i}": "Real" for i in range(6)},
+              "cat": "PickList"}
+    rng = np.random.default_rng(19)
+
+    def rows(n, labeled=True):
+        out = []
+        for _ in range(n):
+            r = {f"x{i}": float(v)
+                 for i, v in enumerate(rng.normal(size=6))}
+            r["cat"] = "abcd"[int(rng.integers(0, 4))]
+            if labeled:
+                r["label"] = float(rng.random() > 0.5)
+            out.append(r)
+        return out
+
+    fs = features_from_schema(schema, response="label")
+    vec = transmogrify([f for n_, f in fs.items() if n_ != "label"])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    wf = Workflow().set_result_features(pred)
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(rows(1024)))
+    runner.run("train", OpParams())
+
+    batches = [rows(batch, labeled=False) for _ in range(n_batches)]
+    n_rows = n_batches * batch
+
+    def score() -> float:
+        out_dir = tempfile.mkdtemp(prefix="bench_fleet_obs_")
+        try:
+            runner.streaming_reader = BatchStreamingReader(
+                [list(b) for b in batches])
+            t0 = time.perf_counter()
+            res = runner.run("streaming_score",
+                             OpParams(write_location=out_dir))
+            wall = time.perf_counter() - t0
+            assert res.n_rows == n_rows
+            return wall
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    def observed() -> tuple[float, int]:
+        rec_dir = tempfile.mkdtemp(prefix="bench_fleet_rec_")
+        agg = obs.FleetAggregator()
+        agg.attach_local("bench", os.getpid(), obs.default_registry())
+        stop = threading.Event()
+
+        def poll():
+            while not stop.wait(0.25):
+                agg.merged()  # the op-top consumer: full exact fold at 4 Hz
+
+        rec = obs.install_recorder(role="bench", out_dir=rec_dir,
+                                   signals=False)
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            with obs.trace(name="bench", role="bench"):
+                wall = score()
+        finally:
+            stop.set()
+            poller.join(timeout=5)
+            obs.uninstall_recorder()
+            shutil.rmtree(rec_dir, ignore_errors=True)
+        return wall, rec.dumps
+
+    score()  # warm: compile the bucket-shape programs once
+    # interleaved best-of-3 per arm: the retention ratio must measure the
+    # instrumentation, not scheduler noise on a shared CI host
+    off_walls, on_walls, dumps = [], [], 0
+    for _ in range(3):
+        off_walls.append(score())
+        wall, d = observed()
+        on_walls.append(wall)
+        dumps += d
+    off_rps = n_rows / min(off_walls)
+    on_rps = n_rows / min(on_walls)
+    return {
+        "rows": n_rows, "batches": n_batches, "batch_size": batch,
+        "bare_rows_per_sec": round(off_rps),
+        "observed_rows_per_sec": round(on_rps),
+        "fleet_obs_throughput_retention": round(on_rps / off_rps, 4),
+        "recorder_dumps_fault_free": dumps,
+    }
+
+
 def run_resilience_overhead(n_batches: int = 32, batch: int = 512) -> dict:
     """Resilience-overhead lane: the same streamed-scoring run with the
     runtime fault-tolerance layer OFF vs ON (`OpParams(retry_max=2,
@@ -1292,6 +1397,7 @@ def run_trees(n_rows: int = 1 << 20, d: int = 256, n_trees: int = 20,
 ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp,
        "trees": run_trees, "streaming": run_streaming_score,
        "monitor": run_monitor_overhead,
+       "fleet_obs": run_fleet_obs_overhead,
        "resilience": run_resilience_overhead,
        "daemon": run_serving_daemon,
        "cold_start": run_cold_start,

@@ -1,0 +1,497 @@
+"""Fleet observability plane (obs/fleet.py, obs/context.py, obs/recorder.py).
+
+Pins the ISSUE-16 acceptance surface: cross-process trace stitching with a
+REAL ingest worker subprocess (the worker's extract span parents under the
+coordinator's lease anchor, one trace_id end to end); metrics federation
+where fleet counters equal the sum of per-process registries EXACTLY and
+fleet p99 matches a single-process oracle; flight-recorder dumps on an
+injected breaker trip, a chaos injection, and SIGQUIT; the daemon's
+/fleet/metrics push/pull HTTP surface; and the `op top` / `op trace-merge` /
+`op monitor --fleet` CLI shells.
+"""
+import csv
+import glob
+import json
+import os
+import random
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.obs import fleet as fleet_mod
+from transmogrifai_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+
+
+def _write_stream_dir(directory, n_files=4, rows_per_file=12, seed=7):
+    os.makedirs(directory, exist_ok=True)
+    rng = random.Random(seed)
+    for b in range(n_files):
+        with open(os.path.join(directory, f"b-{b}.csv"), "w",
+                  newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["x1", "cat"])
+            for i in range(rows_per_file):
+                w.writerow([round(rng.uniform(-1, 1), 4), "abc"[i % 3]])
+    return directory
+
+
+# --- trace context ----------------------------------------------------------------------
+class TestTraceContext:
+    def test_traceparent_roundtrip(self):
+        ctx = obs.TraceContext.new()
+        header = ctx.to_traceparent()
+        assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        back = obs.TraceContext.from_traceparent(header)
+        assert back == ctx
+        # case-insensitive, whitespace-tolerant (W3C receivers lowercase)
+        assert obs.TraceContext.from_traceparent(
+            "  " + header.upper() + "  ") == ctx
+
+    def test_traceparent_malformed_returns_none(self):
+        for bad in (None, "", "00-zz-11-01", "garbage",
+                    "00-" + "a" * 31 + "-" + "b" * 16 + "-01"):
+            assert obs.TraceContext.from_traceparent(bad) is None
+
+    def test_wire_roundtrip_and_validation(self):
+        ctx = obs.TraceContext.new()
+        assert obs.TraceContext.from_wire(ctx.to_wire()) == ctx
+        assert obs.TraceContext.from_wire(None) is None
+        assert obs.TraceContext.from_wire({"trace_id": "xy"}) is None
+        assert obs.TraceContext.from_wire(
+            {"trace_id": "g" * 32, "span_id": "a" * 16}) is None
+
+    def test_current_trace_context_follows_span(self):
+        assert obs.current_trace_context() is None
+        with obs.trace() as t:
+            with obs.span("outer") as sp:
+                ctx = obs.current_trace_context()
+                assert ctx.trace_id == t.trace_id
+                assert ctx.span_id == sp.span_id
+
+    def test_adopt_trace_id(self):
+        with obs.trace() as t:
+            original = t.trace_id
+            t.adopt_trace_id("f" * 32)
+            assert t.trace_id == "f" * 32
+            t.adopt_trace_id(None)  # falsy: last-wins keeps the adopted id
+            assert t.trace_id != original
+
+
+# --- metrics federation -----------------------------------------------------------------
+class TestFederation:
+    def test_counters_sum_exactly(self):
+        regs = [MetricsRegistry() for _ in range(3)]
+        for i, reg in enumerate(regs):
+            reg.counter("work_total", labels={"kind": "a"}).inc(i + 1)
+            reg.counter("work_total", labels={"kind": "b"}).inc(0.5)
+        agg = obs.FleetAggregator()
+        for i, reg in enumerate(regs):
+            agg.ingest("worker", i, reg.snapshot(samples=True))
+        snap = agg.merged().snapshot()
+        assert obs.fleet_totals(snap, "work_total") == pytest.approx(
+            (1 + 2 + 3) + 3 * 0.5)
+
+    def test_fleet_p99_matches_single_process_oracle(self):
+        """The acceptance pin: merged reservoirs are lossless while they fit,
+        so the federated p50/p95/p99 equal one process observing everything."""
+        rng = random.Random(42)
+        observations = [rng.uniform(0.001, 5.0) for _ in range(600)]
+        oracle = MetricsRegistry()
+        oh = oracle.histogram("latency_seconds")
+        shards = [MetricsRegistry() for _ in range(3)]
+        for i, v in enumerate(observations):
+            oh.observe(v)
+            shards[i % 3].histogram("latency_seconds").observe(v)
+        agg = obs.FleetAggregator()
+        for i, reg in enumerate(shards):
+            agg.ingest("serve", i, reg.snapshot(samples=True))
+        merged = agg.merged().snapshot(samples=True)
+        # every per-process series survives the federated merge distinctly
+        assert len(merged["latency_seconds"]["series"]) == 3
+        # the fleet-wide fold (a label-free merge of the same snapshots) has
+        # EXACTLY the oracle's percentiles — lossless reservoir union
+        flat = MetricsRegistry()
+        for reg in shards:
+            flat.merge(reg.snapshot(samples=True))
+        fh = flat.find("latency_seconds")
+        assert fh.count == len(observations)
+        for q in (50, 95, 99):
+            assert fh.percentile(q) == oh.percentile(q)
+
+    def test_merged_idempotent_under_repeated_pushes(self):
+        reg = MetricsRegistry()
+        reg.counter("rows_total").inc(10)
+        agg = obs.FleetAggregator()
+        agg.ingest("w", 1, reg.snapshot(samples=True))
+        agg.ingest("w", 1, reg.snapshot(samples=True))  # latest-wins
+        assert obs.fleet_totals(agg.merged().snapshot(), "rows_total") == 10
+        reg.counter("rows_total").inc(5)
+        agg.ingest("w", 1, reg.snapshot(samples=True))
+        assert obs.fleet_totals(agg.merged().snapshot(), "rows_total") == 15
+
+    def test_attach_local_pull_source(self):
+        reg = MetricsRegistry()
+        reg.counter("pulls_total").inc(1)
+        agg = obs.FleetAggregator()
+        agg.attach_local("run", "me", reg)
+        assert obs.fleet_totals(agg.merged().snapshot(), "pulls_total") == 1
+        reg.counter("pulls_total").inc(2)  # pulled FRESH at every merge
+        assert obs.fleet_totals(agg.merged().snapshot(), "pulls_total") == 3
+        rows = agg.raw_snapshots()
+        assert [(r["role"], r["process"]) for r in rows] == [("run", "me")]
+
+    def test_merged_prometheus_parses_with_no_duplicates(self):
+        regs = [MetricsRegistry() for _ in range(2)]
+        for reg in regs:
+            reg.counter("x_total", labels={"edge": "a"}).inc()
+            reg.histogram("h_seconds").observe(0.1)
+        agg = obs.FleetAggregator()
+        for i, reg in enumerate(regs):
+            agg.ingest("w", i, reg.snapshot(samples=True))
+        parsed = parse_prometheus(agg.to_prometheus())
+        assert parsed
+
+    def test_parse_prometheus_rejects_duplicate_series(self):
+        text = ('a_total{x="1"} 2\n'
+                'a_total{x="1"} 3\n')
+        with pytest.raises(ValueError, match="duplicate series"):
+            parse_prometheus(text)
+        # label ORDER does not make two series distinct
+        text2 = ('a_total{x="1",y="2"} 2\n'
+                 'a_total{y="2",x="1"} 3\n')
+        with pytest.raises(ValueError, match="duplicate series"):
+            parse_prometheus(text2)
+
+    def test_metrics_pusher_interval_and_force(self):
+        reg = MetricsRegistry()
+        reg.counter("n_total").inc(4)
+        sent = []
+        now = [0.0]
+        pusher = obs.MetricsPusher(sent.append, role="w", process=7,
+                                   registry=reg, interval_s=2.0,
+                                   clock=lambda: now[0])
+        assert pusher.maybe_push() is True  # first call pushes
+        assert pusher.maybe_push() is False
+        now[0] = 2.5
+        assert pusher.maybe_push() is True
+        assert pusher.maybe_push(force=True) is True
+        assert len(sent) == 3
+        payload = sent[-1]
+        assert payload["role"] == "w" and payload["process"] == "7"
+        assert payload["snapshot"]["n_total"]["series"][0]["value"] == 4
+
+
+# --- flight recorder --------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_dump_on_breaker_trip(self, tmp_path):
+        from transmogrifai_tpu.resilience.breaker import CircuitBreaker
+
+        reg = MetricsRegistry()
+        obs.install_recorder(role="testproc", out_dir=str(tmp_path),
+                             registry=reg, signals=False)
+        try:
+            reg.counter("work_total").inc(3)  # movement since arming
+            br = CircuitBreaker(threshold=2, name="unit_breaker",
+                                registry=reg)
+            br.record_failure()
+            assert not os.path.exists(tmp_path / "flightrec-testproc.json")
+            br.record_failure()  # threshold: trips OPEN -> dump
+            path = tmp_path / "flightrec-testproc.json"
+            assert path.exists()
+            dump = json.loads(path.read_text())
+            assert dump["reason"] == "breaker_open"
+            assert dump["role"] == "testproc"
+            trip = [e for e in dump["events"]
+                    if e["name"] == "breaker:transition"
+                    and e["attrs"].get("to") == "open"]
+            assert trip, dump["events"]
+            assert dump["metric_deltas"]["work_total"] == 3
+            assert reg.find("flightrec_dumps_total",
+                            labels={"reason": "breaker_open",
+                                    "role": "testproc"}).value == 1
+        finally:
+            obs.uninstall_recorder()
+
+    def test_dump_on_chaos_inject_event(self, tmp_path):
+        obs.install_recorder(role="chaosproc", out_dir=str(tmp_path),
+                             registry=MetricsRegistry(), signals=False)
+        try:
+            # the chokepoint: obs.add_event feeds the recorder with NO tracer
+            assert obs.current() is None
+            obs.add_event("chaos:inject", kind="rpc:drop", site="ingest",
+                          index=3)
+            dump = json.loads(
+                (tmp_path / "flightrec-chaosproc.json").read_text())
+            assert dump["reason"] == "chaos_inject"
+            assert dump["events"][-1]["attrs"]["kind"] == "rpc:drop"
+        finally:
+            obs.uninstall_recorder()
+
+    def test_dump_on_sigquit(self, tmp_path):
+        if not hasattr(signal, "SIGQUIT"):
+            pytest.skip("platform without SIGQUIT")
+        obs.install_recorder(role="sigproc", out_dir=str(tmp_path),
+                             registry=MetricsRegistry(), signals=True)
+        try:
+            obs.add_event("marker", step=1)
+            signal.raise_signal(signal.SIGQUIT)
+            dump = json.loads(
+                (tmp_path / "flightrec-sigproc.json").read_text())
+            assert dump["reason"] == "sigquit"
+            assert any(e["name"] == "marker" for e in dump["events"])
+        finally:
+            obs.uninstall_recorder()
+
+    def test_rate_limit_same_reason(self, tmp_path):
+        rec = obs.FlightRecorder(role="rl", out_dir=str(tmp_path),
+                                 registry=MetricsRegistry())
+        assert rec.dump("chaos_inject") is not None
+        assert rec.dump("chaos_inject") is None  # within the interval
+        assert rec.dump("chaos_inject", force=True) is not None
+        assert rec.dump("breaker_open") is not None  # distinct reason
+
+    def test_ring_is_bounded(self, tmp_path):
+        rec = obs.FlightRecorder(role="cap", out_dir=str(tmp_path),
+                                 capacity=8, registry=MetricsRegistry())
+        for i in range(50):
+            rec.record("tick", {"i": i})
+        path = rec.dump("chaos_inject", force=True)
+        dump = json.loads(open(path).read())
+        assert len(dump["events"]) == 8
+        assert dump["events"][-1]["attrs"]["i"] == 49
+
+    def test_maybe_install_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("TT_FLIGHTREC_DIR", raising=False)
+        assert obs.maybe_install_from_env() is None
+        monkeypatch.setenv("TT_FLIGHTREC_DIR", str(tmp_path))
+        try:
+            rec = obs.maybe_install_from_env(role="envproc")
+            assert rec is not None and obs.active_recorder() is rec
+            # idempotent: a second arm (another runner.run) keeps the ring
+            assert obs.maybe_install_from_env(role="envproc") is rec
+        finally:
+            obs.uninstall_recorder()
+
+
+# --- cross-process stitching (real worker subprocess) -----------------------------------
+class TestStitching:
+    def test_stitch_aligns_clocks_and_links_remote_parents(self, tmp_path):
+        """Pure-payload stitch: two in-memory dumps with skewed anchors."""
+        a = {"traceEvents": [
+                {"ph": "X", "name": "parent", "ts": 0.0, "dur": 50.0,
+                 "pid": 0, "tid": 1, "args": {"span_id": "aa" * 8}}],
+             "metadata": {"trace_id": "11" * 16, "role": "coord",
+                          "pid": 100, "t0_unix": 1000.0}}
+        b = {"traceEvents": [
+                {"ph": "X", "name": "child", "ts": 5.0, "dur": 10.0,
+                 "pid": 0, "tid": 1,
+                 "args": {"span_id": "bb" * 8, "remote_parent": "aa" * 8}}],
+             "metadata": {"trace_id": "11" * 16, "role": "worker",
+                          "pid": 200, "t0_unix": 1000.5}}
+        merged = fleet_mod.stitch_chrome_traces(
+            [a, b], out_path=str(tmp_path / "m.json"))
+        md = merged["metadata"]
+        assert md["trace_ids"] == ["11" * 16]
+        assert md["links"] == 1
+        child = [e for e in merged["traceEvents"]
+                 if e.get("name") == "child"][0]
+        # +0.5 s wall-clock skew re-based onto the earliest anchor
+        assert child["ts"] == pytest.approx(5.0 + 0.5e6)
+        assert child["pid"] == 2 and child["args"]["stitched"] is True
+        flows = [e for e in merged["traceEvents"] if e.get("cat") == "stitch"]
+        assert [f["ph"] for f in flows] == ["s", "f"]
+        assert json.load(open(tmp_path / "m.json"))["metadata"]["links"] == 1
+
+    def test_end_to_end_worker_subprocess_trace_and_metrics(
+            self, tmp_path, monkeypatch):
+        """THE tentpole round trip: coordinator + 2 REAL worker subprocesses.
+        One trace_id spans every process, each worker's ingest:extract span
+        parents under a coordinator lease anchor, and the worker-pushed
+        METRICS snapshots federate to exactly the consumed row count."""
+        from transmogrifai_tpu.ingest import CsvDirSource, IngestCoordinator
+
+        data = _write_stream_dir(str(tmp_path / "data"), n_files=4,
+                                 rows_per_file=12)
+        dumps = tmp_path / "dumps"
+        monkeypatch.setenv("TT_TRACE_DUMP_DIR", str(dumps))
+        monkeypatch.setenv("TT_FLIGHTREC_DIR", str(dumps))
+        rows = 0
+        with obs.trace(name="coordinator", role="coordinator") as t:
+            coord = IngestCoordinator(CsvDirSource(data, batch_size=8),
+                                      n_shards=2)
+            coord.start()
+            procs = coord.spawn_workers(2)
+            for batch in coord.stream():
+                rows += len(batch)
+            for p in procs:
+                assert p.wait(timeout=60) == 0
+            snaps = coord.fleet.raw_snapshots()
+            coord.close()
+        assert rows == 4 * 12
+        coord_dump = str(dumps / "trace-coordinator.json")
+        t.export_chrome(coord_dump)
+
+        # -- federation: worker-pushed totals equal the consumed stream
+        worker_rows = sum(
+            s["value"]
+            for row in snaps if row["role"] == "ingest-worker"
+            for s in (row["snapshot"].get("ingest_worker_rows_total")
+                      or {}).get("series", []))
+        assert worker_rows == rows
+        merged = coord.fleet.merged()
+        assert obs.fleet_totals(merged.snapshot(),
+                                "ingest_worker_rows_total") == rows
+        parse_prometheus(merged.to_prometheus())  # no duplicate series
+
+        # -- stitching: single trace_id, extract spans under lease anchors
+        worker_dumps = sorted(glob.glob(str(dumps / "trace-ingest-worker-*")))
+        assert len(worker_dumps) == 2
+        stitched = fleet_mod.stitch_chrome_traces([coord_dump] + worker_dumps)
+        md = stitched["metadata"]
+        assert md["trace_ids"] == [t.trace_id]
+        assert md["links"] >= 2
+        lease_anchors = {e["args"]["span_id"]
+                         for e in stitched["traceEvents"]
+                         if e.get("name") == "ingest:lease"}
+        extracts = [e for e in stitched["traceEvents"]
+                    if e.get("name") == "ingest:extract"]
+        assert extracts
+        assert all(e["args"]["remote_parent"] in lease_anchors
+                   for e in extracts)
+        roles = {p["role"] for p in md["processes"]}
+        assert roles == {"coordinator", "ingest-worker"}
+
+    def test_export_chrome_stitched_merges_adopted_dumps(self, tmp_path):
+        child = {"traceEvents": [
+                    {"ph": "X", "name": "remote", "ts": 0.0, "dur": 1.0,
+                     "pid": 0, "tid": 1, "args": {"span_id": "cc" * 8}}],
+                 "metadata": {"trace_id": "22" * 16, "role": "w", "pid": 9,
+                              "t0_unix": time.time()}}
+        child_path = tmp_path / "child.json"
+        child_path.write_text(json.dumps(child))
+        with obs.trace(name="root", role="coord") as t:
+            t.adopt_dump(str(child_path))
+            with obs.span("local"):
+                pass
+        out = tmp_path / "stitched.json"
+        t.export_chrome(str(out), stitched=True)
+        md = json.load(open(out))["metadata"]
+        assert md["stitched"] is True
+        assert {p["role"] for p in md["processes"]} == {"coord", "w"}
+
+
+# --- serving daemon HTTP federation ------------------------------------------------------
+class TestDaemonFleetHTTP:
+    def _server(self):
+        from transmogrifai_tpu.serve import ServingDaemon, make_http_server
+
+        daemon = ServingDaemon(warm=False)
+        server = make_http_server(daemon, port=0)
+        th = threading.Thread(target=server.serve_forever, daemon=True)
+        th.start()
+        port = server.server_address[1]
+        return daemon, server, f"http://127.0.0.1:{port}"
+
+    def test_fleet_metrics_push_pull_roundtrip(self):
+        daemon, server, base = self._server()
+        try:
+            remote = MetricsRegistry()
+            remote.counter("replica_rows_total").inc(42)
+            body = json.dumps({
+                "role": "serve-replica", "process": "r1",
+                "snapshot": remote.snapshot(samples=True)}).encode()
+            req = urllib.request.Request(
+                base + "/fleet/metrics", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["ok"] is True
+            with urllib.request.urlopen(base + "/fleet/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            parsed = parse_prometheus(text)
+            assert parsed
+            assert 'role="serve-replica"' in text
+            assert 'replica_rows_total' in text
+            with urllib.request.urlopen(
+                    base + "/fleet/metrics?format=json", timeout=10) as resp:
+                rows = json.loads(resp.read())["snapshots"]
+            by_role = {r["role"] for r in rows}
+            assert "serve-replica" in by_role
+            # the daemon's own registry rides along as a pull source
+            assert any(r["process"] == str(os.getpid()) for r in rows)
+            # rejected pushes
+            bad = urllib.request.Request(
+                base + "/fleet/metrics", data=b'{"role": "x"}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            server.shutdown()
+            server.server_close()
+            daemon.close()
+
+
+# --- CLI shells -------------------------------------------------------------------------
+class TestCli:
+    def test_trace_merge_cli(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.main import main
+
+        for name, role, t0 in (("a.json", "coord", 100.0),
+                               ("b.json", "worker", 100.1)):
+            (tmp_path / name).write_text(json.dumps({
+                "traceEvents": [],
+                "metadata": {"trace_id": "ab" * 16, "role": role,
+                             "pid": 1, "t0_unix": t0}}))
+        out = tmp_path / "merged.json"
+        rc = main(["trace-merge", str(tmp_path / "a.json"),
+                   str(tmp_path / "b.json"), "-o", str(out)])
+        assert rc == 0
+        assert capsys.readouterr().out.strip() == str(out)
+        assert json.load(open(out))["metadata"]["trace_id"] == "ab" * 16
+
+    def test_trace_merge_missing_file_fails(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.main import main
+
+        rc = main(["trace-merge", str(tmp_path / "nope.json")])
+        assert rc == 1
+
+    def test_top_requires_target(self, capsys):
+        from transmogrifai_tpu.cli.main import main
+
+        assert main(["top"]) == 2
+
+    def test_render_top_rates_and_predictions(self):
+        prev = MetricsRegistry()
+        prev.counter("ingest_rows_total",
+                     labels={"role": "w", "process": "1"}).inc(100)
+        cur = MetricsRegistry()
+        cur.counter("ingest_rows_total",
+                    labels={"role": "w", "process": "1"}).inc(300)
+        cur.counter("mesh_collective_bytes_total",
+                    labels={"role": "w", "process": "1"}).inc(900)
+        frame = fleet_mod.render_top(
+            prev.snapshot(), cur.snapshot(), dt_s=2.0,
+            predictions={"hbm_bytes": 0, "collective_bytes": 1000})
+        assert "100.0" in frame  # (300-100)/2 rows/s
+        assert "collective_bytes" in frame and "0.100" in frame  # rel_error
+
+    def test_top_predictions_helper_forms(self):
+        from transmogrifai_tpu.analyze import top_predictions
+
+        t = {"peak_resident_bytes": 10, "collective_bytes": 20}
+        assert top_predictions({"totals": t}) == {
+            "hbm_bytes": 10, "collective_bytes": 20}
+        assert top_predictions(t) == {"hbm_bytes": 10, "collective_bytes": 20}
+        assert top_predictions(None) is None
+        assert top_predictions({"totals": {}}) is None
+
+        class Bundle:
+            resource_model = {"totals": t}
+
+        assert top_predictions(Bundle())["hbm_bytes"] == 10

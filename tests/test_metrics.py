@@ -194,19 +194,28 @@ def test_pipeline_stats_publish_into_registry():
     from transmogrifai_tpu.readers.pipeline import PipelineStats, run_pipeline
 
     reg = M.default_registry()
-    before = reg.counter("pipeline_batches_total").value
+    # published series carry the process's fleet-role label (TT_ROLE/"run")
+    batches_c = reg.counter("pipeline_batches_total",
+                            labels={"role": "run"})
+    before = batches_c.value
     stats = PipelineStats()
     run_pipeline(range(5), lambda x: x + 1, lambda x: x * 2,
                  prefetch=2, stats=stats)
     assert stats.batches == 5
-    assert reg.counter("pipeline_batches_total").value == before + 5
+    assert batches_c.value == before + 5
     # idempotent: publish again is a no-op
     stats.publish()
-    assert reg.counter("pipeline_batches_total").value == before + 5
+    assert batches_c.value == before + 5
     # sync path publishes too
     stats2 = run_pipeline(range(3), None, lambda x: x, prefetch=0)
-    assert reg.counter("pipeline_batches_total").value == before + 8
+    assert batches_c.value == before + 8
     assert stats2.batches == 3
+    # an explicit role overrides the process default
+    stats3 = PipelineStats()
+    stats3.batches = 2
+    stats3.publish(role="serve")
+    assert reg.counter("pipeline_batches_total",
+                       labels={"role": "serve"}).value >= 2
 
 
 def test_serve_routing_counter_and_latency_histogram():

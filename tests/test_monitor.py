@@ -216,7 +216,8 @@ def test_score_fn_stream_folds_on_producer_thread():
                                    max_rows_per_batch=None)
     fn = model.score_fn(backend="cpu", monitor=mon)
     batches = [_rows(64, seed=40 + i, labeled=False) for i in range(8)]
-    pipeline_batches = M.default_registry().counter("pipeline_batches_total")
+    pipeline_batches = M.default_registry().counter(
+        "pipeline_batches_total", labels={"role": "serve"})
     published_before = pipeline_batches.value
     out = list(fn.stream(iter(batches), prefetch=3))
     assert [len(b) for b in out] == [64] * 8

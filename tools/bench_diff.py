@@ -41,6 +41,9 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
                  "recovery", "state_bytes", "rel_error")
 #: overrides: fragments that look like seconds but are throughput/quality
+#: ("retention" covers every *_throughput_retention overhead lane — monitor,
+#: resilience, and fleet_obs: observed/bare rows-per-sec ratios whose floor
+#: is "the instrumented path must stay within a few percent of free")
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",
                   "tflops", "flops", "efficiency", "retention")

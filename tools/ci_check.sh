@@ -447,6 +447,99 @@ print(f"multitenant ingest smoke ok: coordinator SIGKILLed itself "
       f"consumers rode through with digests identical to baseline")
 PY
 
+echo "== fleet observability smoke (trace stitch + metrics federation + flight recorder) =="
+# a coordinator + 2 REAL worker subprocesses under one trace: every process
+# dumps its own Chrome trace (TT_TRACE_DUMP_DIR), workers push METRICS frames
+# that must federate to EXACTLY the consumed row count, the FLEET_METRICS
+# frame serves the raw snapshots over the wire, a real breaker trip dumps the
+# flight recorder (TT_FLIGHTREC_DIR) with the trip event in the ring, and
+# `op trace-merge` stitches the dumps into one timeline with a single
+# trace_id (docs/observability.md "Fleet telemetry")
+python - <<'PY'
+import csv, glob, json, os, random, socket, subprocess, sys, tempfile
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.ingest import CsvDirSource, IngestCoordinator
+from transmogrifai_tpu.ingest import transport
+from transmogrifai_tpu.obs.metrics import parse_prometheus
+from transmogrifai_tpu.resilience.breaker import CircuitBreaker
+
+work = tempfile.mkdtemp(prefix="ci_fleet_")
+stream_dir = os.path.join(work, "stream")
+os.makedirs(stream_dir)
+r = random.Random(7)
+for b in range(4):
+    with open(os.path.join(stream_dir, f"b-{b}.csv"), "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["x1", "cat"])
+        for i in range(12):
+            w.writerow([round(r.uniform(-1, 1), 4), "abc"[i % 3]])
+
+dumps = os.path.join(work, "dumps")
+os.environ["TT_TRACE_DUMP_DIR"] = dumps
+os.environ["TT_FLIGHTREC_DIR"] = dumps
+obs.maybe_install_from_env(role="coordinator")
+
+rows = 0
+with obs.trace(name="coordinator", role="coordinator") as t:
+    coord = IngestCoordinator(CsvDirSource(stream_dir, batch_size=8),
+                              n_shards=2)
+    coord.start()
+    procs = coord.spawn_workers(2)
+    for batch in coord.stream():
+        rows += len(batch)
+    for p in procs:
+        assert p.wait(timeout=120) == 0, "worker exited nonzero"
+
+    # FLEET_METRICS frame: the wire path `op top --connect` uses
+    with socket.create_connection(coord.address, timeout=10) as sock:
+        transport.send_frame(sock, transport.FLEET_METRICS, {})
+        kind, payload = transport.recv_frame(sock)
+    assert kind == transport.FLEET_METRICS, kind
+    wire_rows = sum(
+        s["value"]
+        for row in payload["snapshots"] if row["role"] == "ingest-worker"
+        for s in (row["snapshot"].get("ingest_worker_rows_total")
+                  or {}).get("series", []))
+    assert wire_rows == rows, (wire_rows, rows)
+
+    merged = coord.fleet.merged()
+    assert obs.fleet_totals(merged.snapshot(),
+                            "ingest_worker_rows_total") == rows
+    parse_prometheus(merged.to_prometheus())  # duplicate series fail loudly
+    coord.close()
+assert rows == 48, rows
+
+# a real breaker trip must dump the armed flight recorder
+br = CircuitBreaker(threshold=1, name="ci_fleet_smoke")
+br.record_failure()
+rec_path = os.path.join(dumps, "flightrec-coordinator.json")
+assert os.path.exists(rec_path), "flight recorder never dumped"
+rec = json.load(open(rec_path))
+assert rec["reason"] == "breaker_open", rec["reason"]
+assert any(e["name"] == "breaker:transition"
+           and e["attrs"].get("to") == "open" for e in rec["events"])
+obs.uninstall_recorder()
+
+coord_dump = os.path.join(dumps, "trace-coordinator.json")
+t.export_chrome(coord_dump)
+worker_dumps = sorted(glob.glob(os.path.join(dumps, "trace-ingest-worker-*")))
+assert len(worker_dumps) == 2, worker_dumps
+merged_path = os.path.join(work, "merged.json")
+subprocess.run([sys.executable, "-m", "transmogrifai_tpu.cli.main",
+                "trace-merge", coord_dump, *worker_dumps,
+                "-o", merged_path], check=True, env=dict(os.environ))
+md = json.load(open(merged_path))["metadata"]
+assert md["trace_ids"] == [t.trace_id], md["trace_ids"]  # ONE trace id
+assert md["links"] >= 2, md["links"]
+roles = sorted({p["role"] for p in md["processes"]})
+assert roles == ["coordinator", "ingest-worker"], roles
+del os.environ["TT_TRACE_DUMP_DIR"], os.environ["TT_FLIGHTREC_DIR"]
+print(f"fleet obs smoke ok: {rows} rows over 2 workers federated exactly, "
+      f"1 stitched trace_id, {md['links']} cross-process links, "
+      f"breaker-trip flight record captured")
+PY
+
 echo "== serving daemon smoke (op serve over HTTP) =="
 # train+save a tiny model, start the daemon as a real subprocess (ephemeral
 # port, parsed off the ready line), score over HTTP, check /healthz and the

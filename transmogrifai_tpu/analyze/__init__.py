@@ -31,6 +31,7 @@ from .shard_model import (
     StageResource,
     build_resource_model,
     explain_mesh_shape,
+    top_predictions,
 )
 
 __all__ = [
@@ -38,5 +39,5 @@ __all__ = [
     "PlanContext", "RULES", "ResourceModel", "RuleInfo", "SEVERITIES",
     "StageResource", "analyze_model", "analyze_plan",
     "build_resource_model", "check_dag_uniqueness", "explain_mesh_shape",
-    "plan_fingerprint",
+    "plan_fingerprint", "top_predictions",
 ]

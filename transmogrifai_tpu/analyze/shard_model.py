@@ -223,6 +223,34 @@ class ResourceModel:
         return "\n".join(lines)
 
 
+def top_predictions(source) -> Optional[dict]:
+    """Reduce a resource model to the two fleet-dashboard numbers `op top`
+    tracks live: predicted per-device HBM high-water and per-train collective
+    traffic. Accepts a `ResourceModel`, its `to_json()` dict (or bare totals
+    dict), or a loaded model bundle carrying a `resource_model` attribute —
+    the three forms the prediction survives in between `op explain` and a
+    serving process. Returns None when no usable prediction exists, so
+    `render_top(predictions=...)` can be fed unconditionally."""
+    if source is None:
+        return None
+    if isinstance(source, ResourceModel):
+        t = source.totals()
+    elif isinstance(source, dict):
+        t = source.get("totals", source)
+    else:
+        rm = getattr(source, "resource_model", None)
+        if not isinstance(rm, dict):
+            return None
+        t = rm.get("totals", rm)
+    if not isinstance(t, dict):
+        return None
+    hbm = int(t.get("peak_resident_bytes") or 0)
+    coll = int(t.get("collective_bytes") or 0)
+    if hbm <= 0 and coll <= 0:
+        return None
+    return {"hbm_bytes": hbm, "collective_bytes": coll}
+
+
 def _propagate_widths(stages, raw_features, assume_width: int) -> dict:
     """id(feature) -> (width, exact). The width analog of pass_kinds'
     env propagation: raw numeric kinds enter 1 wide, each stage's output

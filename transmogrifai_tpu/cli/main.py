@@ -141,8 +141,16 @@ def _cmd_run(argv) -> int:
 
         injector = FaultInjector.default_schedule(args.chaos_seed)
         chaos_ctx = injector.installed()
+    import os
+
+    # fleet observability arming rides the environment so one export covers
+    # every process of a launch (spawned ingest workers inherit it):
+    # TT_FLIGHTREC_DIR arms the crash/SIGQUIT flight recorder,
+    # TT_TRACE_DUMP_DIR makes every process export its Chrome dump there for
+    # `op trace-merge`
+    dump_dir = os.environ.get("TT_TRACE_DUMP_DIR")
     with chaos_ctx:
-        if args.trace or args.trace_chrome or args.trace_dir:
+        if args.trace or args.trace_chrome or args.trace_dir or dump_dir:
             from transmogrifai_tpu import obs
 
             # CLI-level tracer wraps the runner's own (inner spans nest under
@@ -157,6 +165,9 @@ def _cmd_run(argv) -> int:
                 tracer.export_chrome(args.trace_chrome)
                 print(f"chrome trace written to {args.trace_chrome}",
                       file=sys.stderr)
+            if dump_dir:
+                tracer.export_chrome(os.path.join(
+                    dump_dir, f"trace-{tracer.role}-{os.getpid()}.json"))
         else:
             result = runner.run(args.run_type, params)
     if injector is not None:
@@ -330,6 +341,47 @@ def _cmd_lint(argv) -> int:
     return 1 if report.has_errors else 0
 
 
+def _fetch_fleet_snapshots(target: str, timeout: float = 5.0) -> list:
+    """Per-process `{"role", "process", "snapshot"}` rows from a fleet
+    endpoint: `http(s)://...` hits a serving daemon's
+    `/fleet/metrics?format=json`; `HOST:PORT` speaks the framed FLEET_METRICS
+    request to an ingest service/coordinator. Both return the same shape, so
+    `op top` and `op monitor --fleet` re-run the exact merge locally."""
+    import json
+
+    if target.startswith("http://") or target.startswith("https://"):
+        from urllib.request import urlopen
+
+        url = target.rstrip("/")
+        if not url.endswith("/fleet/metrics"):
+            url += "/fleet/metrics"
+        with urlopen(url + "?format=json", timeout=timeout) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        return body.get("snapshots") or []
+    import socket
+
+    from transmogrifai_tpu.ingest import transport
+
+    host, _, port = target.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as sock:
+        transport.send_frame(sock, transport.FLEET_METRICS, {})
+        kind, payload = transport.recv_frame(sock)
+    if kind != transport.FLEET_METRICS:
+        raise OSError(f"unexpected reply kind {kind} to FLEET_METRICS")
+    return payload.get("snapshots") or []
+
+
+def _fleet_aggregator(rows):
+    from transmogrifai_tpu import obs
+
+    agg = obs.FleetAggregator()
+    for r in rows:
+        agg.ingest(str(r.get("role") or "?"), str(r.get("process") or "?"),
+                   r.get("snapshot") or {})
+    return agg
+
+
 def _cmd_monitor(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="op monitor",
@@ -362,6 +414,15 @@ def _cmd_monitor(argv) -> int:
                     help="rows observed before alerts arm (default 256)")
     ap.add_argument("--fail-on-drift", action="store_true",
                     help="exit 3 when any drift alert fired (CI gating)")
+    ap.add_argument("--fleet", default=None, metavar="TARGET",
+                    help="federated fleet view instead of a model: TARGET is "
+                         "an ingest service's HOST:PORT (framed FLEET_METRICS "
+                         "request) or a serving daemon's http://HOST:PORT "
+                         "(/fleet/metrics). Prints the merged registry — "
+                         "every process's series under role/process labels, "
+                         "counters summed exactly, fleet percentiles from "
+                         "merged reservoirs — as a table, --prom exposition, "
+                         "or --json snapshots")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.obs.metrics import default_registry
@@ -371,6 +432,29 @@ def _cmd_monitor(argv) -> int:
         demo_monitor,
     )
 
+    if args.fleet:
+        import json
+
+        from transmogrifai_tpu.obs.fleet import render_top
+
+        try:
+            rows = _fetch_fleet_snapshots(args.fleet)
+        except (OSError, ValueError) as e:
+            print(f"op monitor: fleet fetch from {args.fleet} failed: {e}",
+                  file=sys.stderr)
+            return 2
+        agg = _fleet_aggregator(rows)
+        if args.prom:
+            print(agg.to_prometheus(), end="")
+        elif args.as_json:
+            print(json.dumps({"snapshots": rows}, indent=1, default=float))
+        else:
+            snap = agg.snapshot()
+            for p in snap["processes"]:
+                print(f"process: role={p['role']} process={p['process']}")
+            print()
+            print(render_top(None, snap["metrics"], dt_s=1.0))
+        return 0
     if not args.demo and not args.model:
         print("op monitor: --model DIR or --demo is required", file=sys.stderr)
         return 2
@@ -419,6 +503,170 @@ def _cmd_monitor(argv) -> int:
         print(f"op monitor: {len(report['alerts'])} drift alert(s)",
               file=sys.stderr)
         return 3
+    return 0
+
+
+def _cmd_top(argv) -> int:
+    """Live fleet dashboard: poll a fleet endpoint, merge every process's
+    snapshot, render per-role rates + breaker/drift state, optionally with
+    the static resource prediction's live rel_error."""
+    ap = argparse.ArgumentParser(
+        prog="op top",
+        description="live fleet dashboard over the federated metrics plane: "
+                    "per-role rows/s and batch/s, queue-wait p95, breaker "
+                    "states, drift gauges, flight-recorder dumps — plus "
+                    "predicted-vs-measured HBM/collective bytes when an "
+                    "`op explain` resource model is supplied. Keys (curses "
+                    "mode): q quit · p pause · r force refresh.")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="ingest service/coordinator to poll (framed "
+                         "FLEET_METRICS request)")
+    ap.add_argument("--daemon", default=None, metavar="URL",
+                    help="serving daemon to poll (GET /fleet/metrics)")
+    ap.add_argument("--interval-s", type=float, default=2.0,
+                    help="poll/refresh interval (default 2s)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one plain-text frame and exit (CI smoke)")
+    ap.add_argument("--plain", action="store_true",
+                    help="plain-text frames to stdout instead of the curses "
+                         "UI (pipes, logs)")
+    ap.add_argument("--frames", type=int, default=None, metavar="N",
+                    help="exit after N frames (plain/curses)")
+    ap.add_argument("--predictions", default=None, metavar="JSON",
+                    help="resource-model JSON (`op explain --json` output or "
+                         "a bundle's resource_model section): adds the "
+                         "measured-vs-predicted block with live rel_error")
+    args = ap.parse_args(argv)
+    target = args.connect or args.daemon
+    if not target:
+        print("op top: --connect HOST:PORT or --daemon URL is required",
+              file=sys.stderr)
+        return 2
+
+    from transmogrifai_tpu.obs.fleet import render_top
+
+    predictions = None
+    if args.predictions:
+        import json
+
+        from transmogrifai_tpu.analyze import top_predictions
+
+        with open(args.predictions) as fh:
+            predictions = top_predictions(json.load(fh))
+        if predictions is None:
+            print(f"op top: no usable totals in {args.predictions}",
+                  file=sys.stderr)
+
+    def sample():
+        return _fleet_aggregator(
+            _fetch_fleet_snapshots(target)).merged().snapshot(samples=True)
+
+    import time as _time
+
+    def frames():
+        """(frame_text, error) stream at the poll cadence."""
+        prev = None
+        t_prev = None
+        while True:
+            try:
+                cur = sample()
+            except (OSError, ValueError) as e:
+                yield None, f"fleet fetch from {target} failed: {e}"
+                continue
+            now = _time.monotonic()
+            dt = (now - t_prev) if t_prev is not None else args.interval_s
+            yield render_top(prev, cur, dt, predictions=predictions), None
+            prev, t_prev = cur, now
+
+    if args.once or args.plain:
+        n = 1 if args.once else args.frames
+        for i, (frame, err) in enumerate(frames(), start=1):
+            if err:
+                print(f"op top: {err}", file=sys.stderr)
+                return 1
+            print(frame, flush=True)
+            if n is not None and i >= n:
+                return 0
+            _time.sleep(args.interval_s)
+
+    import curses
+
+    def _ui(scr):
+        curses.use_default_colors()
+        scr.nodelay(True)
+        paused = False
+        shown = 0
+        gen = frames()
+        deadline = 0.0
+        while args.frames is None or shown < args.frames:
+            now = _time.monotonic()
+            if not paused and now >= deadline:
+                frame, err = next(gen)
+                deadline = now + args.interval_s
+                shown += 1
+                scr.erase()
+                header = (f"op top · {target} · {args.interval_s:g}s"
+                          f"{' · PAUSED' if paused else ''} · q quit  "
+                          f"p pause  r refresh")
+                body = err or frame
+                for y, line in enumerate([header, ""] + body.split("\n")):
+                    try:
+                        scr.addnstr(y, 0, line, curses.COLS - 1)
+                    except curses.error:
+                        break  # terminal shorter than the frame
+                scr.refresh()
+            try:
+                key = scr.getkey()
+            except curses.error:
+                key = None
+            if key == "q":
+                return
+            if key == "p":
+                paused = not paused
+            if key == "r":
+                deadline = 0.0
+                paused = False
+            _time.sleep(0.05)
+
+    curses.wrapper(_ui)
+    return 0
+
+
+def _cmd_trace_merge(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op trace-merge",
+        description="stitch per-process Chrome-trace dumps (coordinator, "
+                    "ingest workers, serving daemon — the TT_TRACE_DUMP_DIR "
+                    "exports) into ONE distributed timeline: one pid lane "
+                    "per process, wall-clock aligned, remote-parent span "
+                    "links drawn as flow arrows. Load the output at "
+                    "ui.perfetto.dev.")
+    ap.add_argument("traces", nargs="+", metavar="TRACE.json",
+                    help="per-process Chrome-trace dumps (Tracer."
+                         "export_chrome output); order does not matter")
+    ap.add_argument("-o", "--out", default="trace-stitched.json",
+                    metavar="PATH", help="merged output path "
+                                         "(default trace-stitched.json)")
+    args = ap.parse_args(argv)
+
+    from transmogrifai_tpu.obs.fleet import stitch_chrome_traces
+
+    try:
+        merged = stitch_chrome_traces(args.traces, out_path=args.out)
+    except (OSError, ValueError) as e:
+        print(f"op trace-merge: {e}", file=sys.stderr)
+        return 1
+    md = merged["metadata"]
+    roles = [p["role"] for p in md["processes"]]
+    print(f"op trace-merge: stitched {len(roles)} process(es) "
+          f"({', '.join(roles)}) -> {args.out}", file=sys.stderr)
+    print(f"op trace-merge: trace_id={md['trace_id']} "
+          f"links={md['links']}", file=sys.stderr)
+    if len(md["trace_ids"]) > 1:
+        print(f"op trace-merge: WARNING: {len(md['trace_ids'])} distinct "
+              f"trace_ids — context propagation broke somewhere: "
+              f"{md['trace_ids']}", file=sys.stderr)
+    print(args.out)
     return 0
 
 
@@ -562,15 +810,32 @@ def _cmd_serve(argv) -> int:
 
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
+    # fleet arming (see _cmd_run): recorder for crash/SIGQUIT forensics, a
+    # process-lifetime tracer whose dump joins the stitched fleet trace —
+    # also what lets /v1/score adopt a caller's traceparent onto live spans
+    import contextlib
+    import os
+
+    from transmogrifai_tpu import obs
+
+    role = obs.process_role(default="serve")
+    obs.maybe_install_from_env(role=role)
+    dump_dir = os.environ.get("TT_TRACE_DUMP_DIR")
+    trace_ctx = (obs.trace(name="serve", role=role) if dump_dir
+                 else contextlib.nullcontext())
     # the ready line is the startup contract: CI smoke and wrapper scripts
     # parse the URL off it (port 0 resolves here)
     print(f"op serve: listening on http://{args.host}:{actual_port} "
           f"models={names}", file=sys.stderr, flush=True)
-    try:
-        server.serve_forever()
-    finally:
-        server.server_close()
-        daemon.close()
+    with trace_ctx as tracer:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+            daemon.close()
+    if tracer is not None and dump_dir:
+        tracer.export_chrome(os.path.join(
+            dump_dir, f"trace-{role}-{os.getpid()}.json"))
     print("op serve: clean shutdown", file=sys.stderr, flush=True)
     return 0
 
@@ -803,7 +1068,19 @@ def _cmd_ingest_serve(argv) -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
-    with chaos_ctx:
+    # fleet arming (see _cmd_run): flight recorder + a service-lifetime
+    # tracer whose dump anchors the ingest side of `op trace-merge` —
+    # spawned workers inherit both env vars and arm themselves
+    import os
+
+    from transmogrifai_tpu import obs
+
+    role = obs.process_role(default="coordinator")
+    obs.maybe_install_from_env(role=role)
+    dump_dir = os.environ.get("TT_TRACE_DUMP_DIR")
+    trace_ctx = (obs.trace(name="ingest-serve", role=role) if dump_dir
+                 else contextlib.nullcontext())
+    with chaos_ctx, trace_ctx as tracer:
         svc.start()
         if args.workers:
             svc.spawn_workers(args.workers)
@@ -815,6 +1092,9 @@ def _cmd_ingest_serve(argv) -> int:
                 stop.wait(0.25)
         finally:
             svc.close()
+    if tracer is not None and dump_dir:
+        tracer.export_chrome(os.path.join(
+            dump_dir, f"trace-{role}-{os.getpid()}.json"))
     return 0
 
 
@@ -836,7 +1116,13 @@ def main(argv=None) -> int:
             "(--app module:fn [--mesh D,M] [--rows N] [--json])\n"
             "  monitor   serving telemetry: drift report vs the model's "
             "training baseline + metrics export (--model DIR [--scoring CSV] "
-            "| --demo) [--prom|--json]\n"
+            "| --demo | --fleet TARGET) [--prom|--json]\n"
+            "  top       live fleet dashboard: per-role rates, queue waits, "
+            "breaker/drift state, predicted-vs-measured resources "
+            "(--connect HOST:PORT | --daemon URL [--once|--plain])\n"
+            "  trace-merge  stitch per-process Chrome-trace dumps into one "
+            "distributed timeline with cross-process span links "
+            "(TRACE.json... -o merged.json)\n"
             "  serve     persistent serving daemon: multi-model cache + "
             "adaptive micro-batching over HTTP/JSON "
             "(--model [NAME=]DIR --port 8000)\n"
@@ -868,6 +1154,10 @@ def main(argv=None) -> int:
         return _cmd_explain(rest)
     if cmd == "monitor":
         return _cmd_monitor(rest)
+    if cmd == "top":
+        return _cmd_top(rest)
+    if cmd == "trace-merge":
+        return _cmd_trace_merge(rest)
     if cmd == "serve":
         return _cmd_serve(rest)
     if cmd == "autopilot":

@@ -93,6 +93,13 @@ class IngestCoordinator:
         """The embedded service (escape hatch for multi-job composition)."""
         return self._svc
 
+    @property
+    def fleet(self):
+        """The embedded service's FleetAggregator: the coordinator's own
+        registry plus every worker's pushed METRICS snapshot (obs/fleet.py) —
+        what `op monitor --fleet` and `op top` read."""
+        return self._svc.fleet
+
     # --- lifecycle --------------------------------------------------------------------
     def start(self) -> "IngestCoordinator":
         self._svc.start()

@@ -264,6 +264,12 @@ class IngestService:
         self.kill_mode = kill_mode
         self._host, self._port = host, int(port)
         self._reg = registry if registry is not None else obs.default_registry()
+        #: fleet metrics federation (obs/fleet.py): workers push METRICS
+        #: frames, the coordinator's own registry attaches as a pull source,
+        #: and FLEET_METRICS requests read the raw per-process snapshots back
+        self.fleet = obs.FleetAggregator()
+        self.fleet.attach_local("coordinator", os.getpid(),
+                                lambda: self._reg.snapshot(samples=True))
 
         # --- shared state (everything below under _cond) ---
         self._cond = threading.Condition()
@@ -638,6 +644,11 @@ class IngestService:
                 elif kind == transport.SVC_STATS:
                     self._send(conn, transport.SVC_STATS,
                                {"stats": self.service_stats()})
+                elif kind == transport.METRICS:
+                    self._on_metrics(payload)
+                elif kind == transport.FLEET_METRICS:
+                    self._send(conn, transport.FLEET_METRICS,
+                               {"snapshots": self.fleet.raw_snapshots()})
                 else:
                     raise transport.FrameError(f"unknown frame kind {kind}")
         except transport.FrameError as e:
@@ -651,6 +662,17 @@ class IngestService:
             self._disconnect(conn, worker, consumer_job)
         except (ConnectionError, OSError):
             self._disconnect(conn, worker, consumer_job)
+
+    def _on_metrics(self, payload: dict) -> None:
+        """METRICS push from a worker: replace that process's latest snapshot
+        in the aggregator (fire-and-forget — snapshots are cumulative, so a
+        lost push is healed by the next one)."""
+        self.fleet.ingest(str(payload.get("role", "ingest-worker")),
+                          str(payload.get("process", "?")),
+                          payload.get("snapshot") or {})
+        self._counter("ingest_metrics_pushes_total",
+                      "METRICS snapshot frames accepted for federation",
+                      role="coordinator").inc()
 
     def _register(self, conn: socket.socket, payload: dict) -> _Worker:
         w = _Worker(worker_id=str(payload.get("worker_id", "?")),
@@ -774,11 +796,24 @@ class IngestService:
                 files_done[fi] = nc
             elif done:
                 committed[fi] = done
-        return {"job": job.job_id, "shard": shard, "n_shards": job.n_shards,
-                "lease": lease_id, "plan": job.plan_fp,
-                "source": job.source.to_wire(),
-                "files": st.files, "files_done": files_done,
-                "committed": committed}
+        payload = {"job": job.job_id, "shard": shard,
+                   "n_shards": job.n_shards,
+                   "lease": lease_id, "plan": job.plan_fp,
+                   "source": job.source.to_wire(),
+                   "files": st.files, "files_done": files_done,
+                   "committed": committed}
+        # cross-process trace propagation: when the coordinator runs under a
+        # tracer, every lease carries a TraceContext whose span_id anchors an
+        # "ingest:lease" event here — the worker opens its extract span with
+        # this id as remote_parent, and the stitch tool joins the two dumps
+        tracer = obs.current()
+        if tracer is not None:
+            anchor = obs.new_span_id()
+            obs.add_event("ingest:lease", job=job.job_id, shard=shard,
+                          lease=lease_id, span_id=anchor)
+            payload["ctx"] = obs.TraceContext(
+                trace_id=tracer.trace_id, span_id=anchor).to_wire()
+        return payload
 
     def _grantable(self, job: Optional[_Job]) -> bool:
         return (job is not None and not job.paused and not job.stop
@@ -945,11 +980,15 @@ class IngestService:
             job.committed.add(key)
             job.buffer[key] = data
             self._cond.notify_all()
+        # role-labeled edge counters: the federation layer distinguishes the
+        # same series pushed by different processes, so the label scheme must
+        # exist BEFORE fleet merge lands these under /fleet/metrics
         self._counter("ingest_batches_total",
-                      "batches committed from extraction workers").inc()
+                      "batches committed from extraction workers",
+                      role="coordinator").inc()
         self._counter("ingest_rows_total",
-                      "rows committed from extraction workers"
-                      ).inc(payload_nrows(data))
+                      "rows committed from extraction workers",
+                      role="coordinator").inc(payload_nrows(data))
 
     def _on_file_done(self, payload: dict) -> None:
         with self._cond:

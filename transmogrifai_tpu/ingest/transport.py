@@ -72,6 +72,15 @@ JOB_ERROR = 23    # service ->: {job, type, message} — the job failed the way
 JOB_CLOSE = 24    # consumer ->: {job} — unregister (consumer is done)
 SVC_STATS = 25    # consumer ->: {} request / service ->: {stats} reply
 
+#: --- fleet observability kinds (obs/fleet.py) ---
+METRICS = 26      # worker ->: {role, process, snapshot} — periodic registry
+                  #            push for federation (fire-and-forget; the
+                  #            coordinator's FleetAggregator keeps latest)
+FLEET_METRICS = 27  # consumer ->: {} request / service ->:
+                  #            {snapshots: [{role, process, snapshot}]} — the
+                  #            raw per-process snapshots so the requester can
+                  #            merge them exactly (op top / op monitor --fleet)
+
 #: kinds whose payload is the hybrid meta+buffers layout (module docstring)
 BINARY_KINDS = frozenset({COLBATCH, JOB_BATCH})
 

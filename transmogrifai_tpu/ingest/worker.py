@@ -32,6 +32,7 @@ import socket
 import time
 from typing import Optional
 
+from .. import obs
 from ..resilience.policy import FaultPolicy, io_guard, retry_call, scoped
 from . import transport
 from .cache import FeatureCache, cache_key, data_fingerprint
@@ -144,6 +145,12 @@ class IngestWorker:
         self._sleep = sleep
         self._sock: Optional[socket.socket] = None
         self._stopped = False
+        #: fleet metrics federation: periodic registry pushes over the same
+        #: framed socket (METRICS is fire-and-forget, so it shares the
+        #: request/reply connection without perturbing the protocol)
+        self._pusher = obs.MetricsPusher(
+            lambda payload: self._send(transport.METRICS, payload),
+            role="ingest-worker", process=self.worker_id)
 
     # --- connection management --------------------------------------------------------
     def _hello(self) -> socket.socket:
@@ -197,9 +204,16 @@ class IngestWorker:
                 reply = transport.recv_frame(self._sock)
                 kind, payload = reply
                 if kind == transport.SHUTDOWN:
+                    # final snapshot before exiting so fleet totals reflect
+                    # the COMPLETE stream (the exact-sum acceptance check)
+                    try:
+                        self._pusher.push()
+                    except (ConnectionError, OSError):
+                        pass  # coordinator already gone: totals stay stale
                     return
                 if kind == transport.IDLE:
                     idle_polls += 1
+                    self._pusher.maybe_push()
                     time.sleep(float(payload.get("poll_s", self.poll_s)))
                     continue
                 if kind != transport.LEASE:
@@ -207,6 +221,7 @@ class IngestWorker:
                         f"unexpected control frame kind {kind}")
                 idle_polls = 0
                 self._extract(payload)
+                self._pusher.maybe_push()
             except (ConnectionError, transport.FrameError, OSError):
                 # the lease (if any) dies with the connection — the
                 # coordinator requeues it and replay picks up the slack.
@@ -229,6 +244,31 @@ class IngestWorker:
         plan = lease.get("plan")
         job = lease.get("job")  # absent from a pre-service coordinator
         source = source_from_wire(lease["source"])
+        # cross-process trace propagation: the LEASE carries the
+        # coordinator's TraceContext — adopt its trace_id (one run, one
+        # trace) and open the extract span with the lease anchor as remote
+        # parent so stitched exports nest this work under the grant
+        ctx = obs.TraceContext.from_wire(lease.get("ctx"))
+        tracer = obs.current()
+        if ctx is not None and tracer is not None:
+            tracer.adopt_trace_id(ctx.trace_id)
+        with obs.span("ingest:extract",
+                      remote_parent=ctx.span_id if ctx else None) as sp:
+            obs.add_event("ingest:extract_start", shard=shard,
+                          lease=lease_id, worker=self.worker_id)
+            self._extract_leased(lease, ctx, sp, job=job, shard=shard,
+                                 lease_id=lease_id, plan=plan, source=source)
+
+    def _extract_leased(self, lease: dict, ctx, sp, *, job, shard,
+                        lease_id, plan, source) -> None:
+        # the NEXT hop's context: BATCH/SHARD_DONE frames carry this span's
+        # id so the coordinator side can correlate commits back to it
+        wire_ctx = None
+        if ctx is not None:
+            wire_ctx = obs.TraceContext(
+                trace_id=ctx.trace_id,
+                span_id=sp.span_id if sp is not None else ctx.span_id
+            ).to_wire()
 
         def emit_batch(seq, file_index, chunk_index, rows):
             # columnar first: per-column contiguous buffers (frames.py) skip
@@ -240,6 +280,8 @@ class IngestWorker:
                    if self.payload == "columnar" else None)
             base = {"job": job, "shard": shard, "seq": seq,
                     "file": file_index, "chunk": chunk_index, "plan": plan}
+            if wire_ctx is not None:
+                base["ctx"] = wire_ctx
             if enc is not None:
                 meta, buffers = enc
                 base.update(fields=meta["fields"], n=meta["n"],
@@ -273,9 +315,26 @@ class IngestWorker:
                         "plan": plan, "type": type(e).__name__,
                         "message": str(e)[:500]})
             return
-        self._send(transport.SHARD_DONE,
-                   {"job": job, "shard": shard, "lease": lease_id,
-                    "plan": plan, "stats": stats})
+        done = {"job": job, "shard": shard, "lease": lease_id,
+                "plan": plan, "stats": stats}
+        if wire_ctx is not None:
+            done["ctx"] = wire_ctx
+        self._send(transport.SHARD_DONE, done)
+        # worker-side edge counters under the fleet role label scheme: these
+        # are what federation surfaces as this process's contribution (the
+        # coordinator's ingest_rows_total counts COMMITS, which dedupe
+        # replays — both views matter after a chaos run)
+        reg = obs.default_registry()
+        labels = {"role": "ingest-worker"}
+        reg.counter("ingest_worker_rows_total",
+                    help="rows extracted and sent by this worker",
+                    labels=labels).inc(stats["rows"])
+        reg.counter("ingest_worker_batches_total",
+                    help="batches extracted and sent by this worker",
+                    labels=labels).inc(stats["batches_sent"])
+        reg.counter("ingest_worker_shards_total",
+                    help="shard leases completed by this worker",
+                    labels=labels).inc()
 
 
 def main(argv=None) -> int:
@@ -320,7 +379,18 @@ def main(argv=None) -> int:
                            backoff_cap_s=1.0, seed=args.seed),
         payload=args.payload, compress=args.compress,
         reconnect_max=args.reconnect_max)
-    worker.run()
+    # fleet observability arming, both driven by inherited environment so
+    # `TT_FLIGHTREC_DIR=... TT_TRACE_DUMP_DIR=... op run --ingest-workers N`
+    # instruments the whole spawned fleet without per-worker flags
+    obs.maybe_install_from_env(role=f"ingest-worker-{worker.worker_id}")
+    dump_dir = os.environ.get("TT_TRACE_DUMP_DIR")
+    if dump_dir:
+        with obs.trace(name="ingest-worker", role="ingest-worker") as t:
+            worker.run()
+        t.export_chrome(os.path.join(
+            dump_dir, f"trace-ingest-worker-{os.getpid()}.json"))
+    else:
+        worker.run()
     return 0
 
 

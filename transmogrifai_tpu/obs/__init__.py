@@ -23,7 +23,14 @@ from __future__ import annotations
 from contextlib import contextmanager
 from typing import Optional
 
+from .context import TraceContext, new_span_id, new_trace_id, process_role
 from .cost import cached_compiled, compiled_flops, cost_analysis, record_cost
+from .fleet import (
+    FleetAggregator,
+    MetricsPusher,
+    fleet_totals,
+    stitch_chrome_traces,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -32,17 +39,29 @@ from .metrics import (
     default_registry,
     parse_prometheus,
 )
+from .recorder import (
+    FlightRecorder,
+    active_recorder,
+    install_recorder,
+    maybe_install_from_env,
+    uninstall_recorder,
+)
 from .tracer import CompileEvent, PhaseTiming, Span, Tracer
 from .watchdog import RetraceBudget, RetraceBudgetExceeded
 from .watchdog import activate as _activate
 from .watchdog import deactivate as _deactivate
 
 __all__ = [
-    "CompileEvent", "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "PhaseTiming", "RetraceBudget", "RetraceBudgetExceeded",
-    "Span", "Tracer", "add_event", "cached_compiled", "compiled_flops",
-    "cost_analysis", "current", "current_span", "default_registry",
-    "parse_prometheus", "record_cost", "retrace_budget", "span", "trace",
+    "CompileEvent", "Counter", "FleetAggregator", "FlightRecorder", "Gauge",
+    "Histogram", "MetricsPusher", "MetricsRegistry", "PhaseTiming",
+    "RetraceBudget", "RetraceBudgetExceeded", "Span", "TraceContext",
+    "Tracer", "active_recorder", "add_event", "cached_compiled",
+    "compiled_flops", "cost_analysis", "current", "current_span",
+    "current_trace_context", "default_registry", "fleet_totals",
+    "install_recorder", "maybe_install_from_env", "new_span_id",
+    "new_trace_id", "parse_prometheus", "process_role", "record_cost",
+    "retrace_budget", "span", "stitch_chrome_traces", "trace",
+    "uninstall_recorder",
 ]
 
 #: innermost-first stack of active tracers (module-global, shared across
@@ -66,10 +85,13 @@ def current_span() -> Optional[Span]:
 
 
 @contextmanager
-def trace(trace_dir: Optional[str] = None, name: str = "run"):
+def trace(trace_dir: Optional[str] = None, name: str = "run",
+          role: Optional[str] = None):
     """Activate a Tracer for the dynamic extent; optionally also capture an
-    on-disk jax.profiler trace viewable in TensorBoard/XProf (trace_dir)."""
-    tracer = Tracer(trace_dir=trace_dir, name=name)
+    on-disk jax.profiler trace viewable in TensorBoard/XProf (trace_dir).
+    `role` names this process's lane in stitched fleet exports (defaults to
+    the TT_ROLE environment variable / "run")."""
+    tracer = Tracer(trace_dir=trace_dir, name=name, role=role)
     _ACTIVE.append(tracer)
     _activate(tracer, "tracer")
     started_trace = False
@@ -96,21 +118,40 @@ def trace(trace_dir: Optional[str] = None, name: str = "run"):
 def add_event(name: str, **attrs) -> None:
     """Attach a point-in-time annotation to the active tracer's current span
     (e.g. oplint diagnostics downgraded by `train(strict=False)`); no-op
-    without a tracer."""
+    without a tracer. The armed flight recorder (obs.recorder) is fed
+    REGARDLESS of tracer state — breaker transitions, chaos injections, and
+    deadline breaches all flow through here, which is what makes this the
+    recorder's single chokepoint."""
     t = current()
     if t is not None:
         t.add_event(name, **attrs)
+    rec = active_recorder()
+    if rec is not None:
+        rec.record(name, attrs)
 
 
 @contextmanager
-def span(name: str, parent: Optional[Span] = None):
-    """Open a named span on the active tracer; no-op without one."""
+def span(name: str, parent: Optional[Span] = None,
+         remote_parent: Optional[str] = None):
+    """Open a named span on the active tracer; no-op without one.
+    `remote_parent` links the span under a span id from ANOTHER process
+    (arrived as a TraceContext) for stitched exports."""
     t = current()
     if t is None:
         yield None
         return
-    with t.span(name, parent=parent) as sp:
+    with t.span(name, parent=parent, remote_parent=remote_parent) as sp:
         yield sp
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """The (trace_id, current span_id) pair to hand the NEXT hop — stamped
+    into LEASE payloads, traceparent headers, and autopilot retrain spawns.
+    None without an active tracer."""
+    t = current()
+    if t is None:
+        return None
+    return TraceContext(trace_id=t.trace_id, span_id=t.current_span().span_id)
 
 
 def retrace_budget(budget: int = 0, kinds=("lower", "compile"),

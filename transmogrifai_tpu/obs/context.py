@@ -1,0 +1,113 @@
+"""Cross-process trace context: W3C-traceparent-style propagation.
+
+One run of the fleet — coordinator, ingest workers, serving daemon, autopilot
+— is many processes, and a request that crosses the ingest socket or the
+daemon's HTTP surface used to fall off the trace at the boundary. This module
+is the identity layer that keeps it on: a `TraceContext` is (trace_id,
+span_id) where `trace_id` names the whole distributed trace and `span_id`
+names the REMOTE PARENT — the span on the sending side under which the
+receiving process's work logically nests.
+
+Wire forms (both directions of every boundary):
+
+  - HTTP header (daemon `/v1/score`):  `traceparent: 00-<32 hex>-<16 hex>-01`
+    — the W3C Trace Context shape, so external tooling that already speaks
+    traceparent interoperates.
+  - Framed transport (ingest LEASE/BATCH): a `"ctx"` dict
+    `{"trace_id": ..., "span_id": ...}` riding the JSON payload.
+
+Receivers adopt the remote trace_id onto their local tracer
+(`Tracer.adopt_trace_id`) and open their top span with
+`remote_parent=ctx.span_id`; the stitch tool (`obs.fleet.stitch_chrome_traces`
+/ `op trace-merge`) then links the per-process Chrome dumps into one
+end-to-end timeline keyed by the shared trace_id.
+
+Parsing is deliberately forgiving — `from_wire`/`from_traceparent` return
+None on anything malformed rather than raising, because a bad ctx from a
+mismatched peer must never take down a frame handler or an HTTP route.
+"""
+from __future__ import annotations
+
+import binascii
+import os
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = [
+    "TraceContext", "new_span_id", "new_trace_id", "process_role",
+]
+
+#: version 00, sampled flag set — the only traceparent shape we emit
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-(?P<trace>[0-9a-f]{32})-(?P<span>[0-9a-f]{16})-[0-9a-f]{2}$")
+
+_HEX_TRACE_RE = re.compile(r"^[0-9a-f]{32}$")
+_HEX_SPAN_RE = re.compile(r"^[0-9a-f]{16}$")
+
+
+def new_trace_id() -> str:
+    """128-bit random hex trace id (collision-safe across processes without
+    any coordination — the property fleet stitching needs)."""
+    return binascii.hexlify(os.urandom(16)).decode("ascii")
+
+
+def new_span_id() -> str:
+    """64-bit random hex span id."""
+    return binascii.hexlify(os.urandom(8)).decode("ascii")
+
+
+def process_role(default: str = "run") -> str:
+    """This process's fleet role ("coordinator", "ingest-worker", "serve",
+    "run", ...). Spawned subprocesses inherit it via the TT_ROLE environment
+    variable; the entrypoints set it explicitly. Labels every federated
+    metric series and names the flight-recorder dump file."""
+    return os.environ.get("TT_ROLE", default)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """An immutable (trace_id, parent span_id) pair crossing one boundary."""
+
+    trace_id: str
+    span_id: str
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(trace_id=new_trace_id(), span_id=new_span_id())
+
+    def child(self, span_id: Optional[str] = None) -> "TraceContext":
+        """A context for the NEXT hop: same trace, fresh (or given) parent
+        span id — the id of the local span the remote side should nest
+        under."""
+        return TraceContext(trace_id=self.trace_id,
+                            span_id=span_id or new_span_id())
+
+    # --- HTTP header form -------------------------------------------------------------
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, header: Optional[str]) -> Optional["TraceContext"]:
+        if not header or not isinstance(header, str):
+            return None
+        m = _TRACEPARENT_RE.match(header.strip().lower())
+        if not m:
+            return None
+        return cls(trace_id=m.group("trace"), span_id=m.group("span"))
+
+    # --- framed-transport form --------------------------------------------------------
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_wire(cls, obj) -> Optional["TraceContext"]:
+        if not isinstance(obj, dict):
+            return None
+        trace_id = obj.get("trace_id")
+        span_id = obj.get("span_id")
+        if (not isinstance(trace_id, str) or not isinstance(span_id, str)
+                or not _HEX_TRACE_RE.match(trace_id)
+                or not _HEX_SPAN_RE.match(span_id)):
+            return None
+        return cls(trace_id=trace_id, span_id=span_id)

@@ -1,0 +1,372 @@
+"""Fleet observability plane: metrics federation + cross-process trace stitch.
+
+The system is many processes — an ingest coordinator with a worker fleet, the
+serving daemon, autopilot, the training run itself — and each keeps its own
+`MetricsRegistry` and `Tracer`. This module is the layer that makes them ONE
+observable system (the disaggregated-fleet view the tf.data-service story,
+arXiv 2210.14826, argues a data service needs):
+
+  - `FleetAggregator` — latest-snapshot-per-process federation. Workers and
+    serving replicas push `registry.snapshot(samples=True)` over the framed
+    transport (METRICS frame) or HTTP; local registries attach as pull
+    sources. `merged()` folds every snapshot into a fresh registry with
+    `process`/`role` labels via `MetricsRegistry.merge` — counters sum
+    exactly, histogram buckets add exactly, reservoirs union seeded, so fleet
+    p50/p95/p99 are well-defined (equal to a single-process oracle while the
+    combined reservoirs fit). Exposed at `/fleet/metrics` (daemon), the
+    FLEET_METRICS frame (ingest service), `op monitor --fleet`, and `op top`.
+
+  - `MetricsPusher` — the worker-side push cadence: builds METRICS payloads
+    from the local registry on an interval, transport-agnostic (the caller
+    supplies the send callable, so ingest sockets and HTTP POST both work).
+
+  - `stitch_chrome_traces` — joins per-process Chrome dumps into one
+    distributed timeline: one pid lane per process, wall-clock aligned on
+    each dump's `t0_unix` anchor, `remote_parent` span links drawn as flow
+    arrows, single trace_id asserted in the merged metadata. `op trace-merge`
+    and `Tracer.export_chrome(stitched=True)` are thin shells over it.
+
+  - `render_top` — the text body of `op top`: per-role/process rates, queue
+    waits, breaker states, drift gauges, and measured-vs-predicted resource
+    counters (the PR-15 static ResourceModel calibration feed) with a live
+    rel_error column.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Callable, Iterable, Optional, Union
+
+from . import metrics as _metrics
+
+__all__ = [
+    "FleetAggregator", "MetricsPusher", "fleet_totals", "render_top",
+    "stitch_chrome_traces",
+]
+
+
+class FleetAggregator:
+    """Latest-snapshot-per-(role, process) metrics federation.
+
+    Push sources call `ingest()` with a remote registry snapshot (replacing
+    that process's previous one — snapshots are cumulative, so latest-wins is
+    the correct fold); local registries attach once via `attach_local` and
+    are pulled fresh at every `merged()`. Aggregation rebuilds a scratch
+    registry from scratch each time, which keeps the fold exact and
+    idempotent under repeated pushes from a growing stream.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snaps: dict[tuple[str, str], dict] = {}
+        self._pushed_at: dict[tuple[str, str], float] = {}
+        self._locals: dict[tuple[str, str], Callable[[], dict]] = {}
+
+    def attach_local(self, role: str, process: Union[str, int], source) -> None:
+        """Register an in-process pull source: a MetricsRegistry or a zero-arg
+        callable returning a mergeable snapshot."""
+        if hasattr(source, "snapshot"):
+            fn = lambda: source.snapshot(samples=True)  # noqa: E731
+        else:
+            fn = source
+        with self._lock:
+            self._locals[(str(role), str(process))] = fn
+
+    def ingest(self, role: str, process: Union[str, int], snapshot: dict) -> None:
+        """Accept one pushed snapshot (METRICS frame / HTTP POST body)."""
+        if not isinstance(snapshot, dict):
+            return
+        key = (str(role), str(process))
+        with self._lock:
+            self._snaps[key] = snapshot
+            self._pushed_at[key] = time.time()
+
+    def processes(self) -> list[dict]:
+        now = time.time()
+        with self._lock:
+            out = [{"role": r, "process": p, "source": "local"}
+                   for (r, p) in self._locals]
+            out += [{"role": r, "process": p, "source": "push",
+                     "age_s": round(now - self._pushed_at[(r, p)], 3)}
+                    for (r, p) in self._snaps]
+        out.sort(key=lambda d: (d["role"], d["process"]))
+        return out
+
+    def raw_snapshots(self) -> list[dict]:
+        """Every per-process snapshot unmerged (`{"role", "process",
+        "snapshot"}` rows, local sources pulled fresh) — the FLEET_METRICS
+        reply shape, so a remote requester can run the exact merge itself."""
+        with self._lock:
+            pushed = sorted((r, p, s) for (r, p), s in self._snaps.items())
+            locals_ = sorted(self._locals.items())
+        out = [{"role": r, "process": p, "snapshot": s} for r, p, s in pushed]
+        out += [{"role": r, "process": p, "snapshot": fn()}
+                for (r, p), fn in locals_]
+        return out
+
+    def merged(self) -> _metrics.MetricsRegistry:
+        """A fresh registry holding every process's series, distinguished by
+        `process`/`role` labels (no silent collisions — `parse_prometheus`
+        rejects duplicate series, so a bad fold fails loudly in CI)."""
+        with self._lock:
+            pushed = list(self._snaps.items())
+            locals_ = list(self._locals.items())
+        reg = _metrics.MetricsRegistry()
+        for (role, process), snap in sorted(pushed):
+            reg.merge(snap, labels={"role": role, "process": process})
+        for (role, process), fn in sorted(locals_):
+            reg.merge(fn(), labels={"role": role, "process": process})
+        return reg
+
+    def to_prometheus(self) -> str:
+        return self.merged().to_prometheus()
+
+    def snapshot(self) -> dict:
+        """JSON fleet view: who is reporting + the merged metrics."""
+        return {"processes": self.processes(),
+                "metrics": self.merged().snapshot(samples=True)}
+
+
+def fleet_totals(metrics_snapshot: dict, name: str) -> float:
+    """Sum a counter/gauge across every labeled series of the merged
+    snapshot — the fleet-wide total the acceptance check pins against the
+    sum of per-process registries."""
+    fam = metrics_snapshot.get(name) or {}
+    return sum(float(s.get("value", 0.0)) for s in fam.get("series", []))
+
+
+class MetricsPusher:
+    """Interval-driven registry push from a worker/replica process.
+
+    Transport-agnostic: `send` receives the JSON-able payload dict
+    (`{"role", "process", "snapshot"}`) and ships it however the caller's
+    channel works (METRICS frame on the ingest socket, HTTP POST to the
+    daemon's /fleet/metrics). Send failures propagate to the caller, which
+    owns the channel's reconnect policy.
+    """
+
+    def __init__(self, send: Callable[[dict], None], *, role: str,
+                 process: Union[str, int], registry=None,
+                 interval_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._send = send
+        self.role = str(role)
+        self.process = str(process)
+        self._registry = registry
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._last = -math.inf
+        self.pushes = 0
+
+    def _reg(self):
+        return (self._registry if self._registry is not None
+                else _metrics.default_registry())
+
+    def payload(self) -> dict:
+        return {"role": self.role, "process": self.process,
+                "snapshot": self._reg().snapshot(samples=True)}
+
+    def push(self) -> None:
+        self._send(self.payload())
+        self._last = self._clock()
+        self.pushes += 1
+
+    def maybe_push(self, force: bool = False) -> bool:
+        """Push when the interval elapsed (or forced — shutdown paths force a
+        final push so fleet totals reflect the complete stream)."""
+        if force or self._clock() - self._last >= self.interval_s:
+            self.push()
+            return True
+        return False
+
+
+# --- cross-process trace stitching ------------------------------------------------------
+def _load_payload(x) -> dict:
+    if isinstance(x, dict):
+        return x
+    with open(x) as fh:
+        return json.load(fh)
+
+
+def stitch_chrome_traces(inputs: Iterable, out_path: Optional[str] = None) -> dict:
+    """Merge per-process Chrome-trace dumps into one distributed timeline.
+
+    `inputs` mixes in-memory payloads and file paths. Each input becomes its
+    own pid lane (named from the dump's role/pid metadata); timestamps are
+    re-based onto the earliest dump's wall-clock anchor (`t0_unix`) so events
+    from different processes line up; every span carrying a `remote_parent`
+    id that resolves to a span/event in ANOTHER input gains a flow arrow
+    (ph "s"/"f") from parent to child — the visual stitch of ingest→train→
+    serve. The merged metadata reports the root trace_id (the earliest
+    process's) plus every distinct trace_id seen, so "one run, one trace_id"
+    is checkable downstream.
+    """
+    payloads = [_load_payload(x) for x in inputs]
+    if not payloads:
+        raise ValueError("stitch_chrome_traces needs at least one trace dump")
+    metas = [p.get("metadata") or {} for p in payloads]
+    anchors = [m.get("t0_unix") for m in metas]
+    known = [a for a in anchors if isinstance(a, (int, float))]
+    base = min(known) if known else 0.0
+
+    events_out: list[dict] = []
+    span_index: dict[str, tuple[int, int, float]] = {}
+    processes: list[dict] = []
+    for i, (payload, meta) in enumerate(zip(payloads, metas)):
+        pid = i + 1
+        anchor = meta.get("t0_unix")
+        off_us = ((anchor - base) * 1e6
+                  if isinstance(anchor, (int, float)) else 0.0)
+        role = meta.get("role") or f"proc{pid}"
+        processes.append({"pid_lane": pid, "role": role,
+                          "os_pid": meta.get("pid"),
+                          "trace_id": meta.get("trace_id"),
+                          "t0_unix": anchor})
+        events_out.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "args": {"name": f"{role} (pid {meta.get('pid', '?')})"}})
+        for ev in payload.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + off_us, 3)
+            events_out.append(ev)
+            args = ev.get("args") or {}
+            sid = args.get("span_id")
+            if isinstance(sid, str):
+                span_index[sid] = (pid, int(ev.get("tid", 0)),
+                                   float(ev.get("ts", 0.0)))
+
+    flows: list[dict] = []
+    for ev in events_out:
+        args = ev.get("args")
+        rp = args.get("remote_parent") if isinstance(args, dict) else None
+        if not isinstance(rp, str):
+            continue
+        src = span_index.get(rp)
+        if src is None or src[0] == ev.get("pid"):
+            continue
+        src_pid, src_tid, src_ts = src
+        fid = len(flows) // 2 + 1
+        flows.append({"ph": "s", "cat": "stitch", "name": "ctx", "id": fid,
+                      "pid": src_pid, "tid": src_tid, "ts": src_ts})
+        flows.append({"ph": "f", "bp": "e", "cat": "stitch", "name": "ctx",
+                      "id": fid, "pid": ev["pid"],
+                      "tid": int(ev.get("tid", 0)),
+                      "ts": float(ev.get("ts", 0.0))})
+        args["stitched"] = True
+
+    trace_ids: list[str] = []
+    for m in metas:
+        tid = m.get("trace_id")
+        if isinstance(tid, str) and tid not in trace_ids:
+            trace_ids.append(tid)
+    root_meta = min(
+        (m for m in metas if isinstance(m.get("t0_unix"), (int, float))
+         and m.get("trace_id")),
+        key=lambda m: m["t0_unix"], default=metas[0])
+    merged = {
+        "traceEvents": events_out + flows,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "stitched": True,
+            "trace_id": root_meta.get("trace_id"),
+            "trace_ids": trace_ids,
+            "processes": processes,
+            "links": len(flows) // 2,
+        },
+    }
+    if out_path:
+        import os
+
+        os.makedirs(os.path.dirname(os.path.abspath(out_path)) or ".",
+                    exist_ok=True)
+        with open(out_path, "w") as fh:
+            json.dump(merged, fh)
+    return merged
+
+
+# --- op top rendering -------------------------------------------------------------------
+def _per_role(metrics_snapshot: dict) -> dict[tuple[str, str], dict]:
+    """Regroup a merged snapshot by (role, process): {metric_name: series}."""
+    out: dict[tuple[str, str], dict] = {}
+    for name, fam in metrics_snapshot.items():
+        for series in fam.get("series", []):
+            labels = series.get("labels") or {}
+            key = (labels.get("role", "?"), labels.get("process", "?"))
+            row = out.setdefault(key, {})
+            # several same-name series can land on one (role, process) —
+            # e.g. per-edge ingest counters; fold values, keep one histogram
+            if fam.get("kind") == "histogram":
+                row.setdefault(name, series)
+            else:
+                prior = row.get(name, {}).get("value", 0.0) \
+                    if name in row else 0.0
+                row[name] = {"value": prior + float(series.get("value", 0.0)),
+                             "kind": fam.get("kind")}
+    return out
+
+
+def _sum_suffix(row: dict, suffix: str) -> float:
+    return sum(v.get("value", 0.0) for n, v in row.items()
+               if n.endswith(suffix) and "value" in v)
+
+
+_BREAKER_STATES = {0: "closed", 1: "OPEN", 2: "half"}
+
+
+def render_top(prev: Optional[dict], cur: dict, dt_s: float,
+               predictions: Optional[dict] = None) -> str:
+    """Render one `op top` frame from two successive fleet snapshots.
+
+    `prev`/`cur` are `FleetAggregator.snapshot()["metrics"]` dicts (prev may
+    be None on the first poll — rates show as 0). `predictions` is the PR-15
+    static ResourceModel's totals ({"hbm_bytes", "collective_bytes"}); when
+    given, a measured-vs-predicted block with rel_error closes the frame —
+    the calibration feed the `op autotune` roadmap item needs.
+    """
+    prev_roles = _per_role(prev) if prev else {}
+    cur_roles = _per_role(cur)
+    dt = max(float(dt_s), 1e-9)
+    lines = [f"{'ROLE':<14} {'PROC':<10} {'ROWS/S':>10} {'BATCH/S':>9} "
+             f"{'QWAIT p95':>11} {'BREAKER':>8} {'DRIFT':>8} {'DUMPS':>6}"]
+    for key in sorted(cur_roles):
+        row = cur_roles[key]
+        before = prev_roles.get(key, {})
+        rows_rate = (_sum_suffix(row, "_rows_total")
+                     - _sum_suffix(before, "_rows_total")) / dt
+        batch_rate = (_sum_suffix(row, "_batches_total")
+                      - _sum_suffix(before, "_batches_total")) / dt
+        qwait = row.get("ingest_queue_wait_seconds") \
+            or row.get("serve_queue_wait_seconds") or {}
+        q95 = qwait.get("p95")
+        breaker = row.get("breaker_state", {}).get("value")
+        drift = max((v.get("value", 0.0) for n, v in row.items()
+                     if ("js_divergence" in n or "drift" in n)
+                     and "value" in v), default=None)
+        dumps = _sum_suffix(row, "flightrec_dumps_total")
+        lines.append(
+            f"{key[0]:<14.14} {key[1]:<10.10} {rows_rate:>10.1f} "
+            f"{batch_rate:>9.1f} "
+            f"{(f'{q95 * 1e3:.1f}ms' if q95 is not None else '-'):>11} "
+            f"{(_BREAKER_STATES.get(int(breaker), '?') if breaker is not None else '-'):>8} "
+            f"{(f'{drift:.4f}' if drift is not None else '-'):>8} "
+            f"{dumps:>6.0f}")
+    if predictions:
+        measured = {
+            "collective_bytes": fleet_totals(cur, "mesh_collective_bytes_total"),
+            "hbm_bytes": fleet_totals(cur, "train_optimizer_state_bytes"),
+        }
+        lines.append("")
+        lines.append(f"{'RESOURCE':<18} {'PREDICTED':>14} {'MEASURED':>14} "
+                     f"{'rel_error':>10}")
+        for res in ("hbm_bytes", "collective_bytes"):
+            pred = predictions.get(res)
+            meas = measured.get(res, 0.0)
+            if pred is None:
+                continue
+            rel = abs(meas - pred) / pred if pred else math.inf
+            lines.append(f"{res:<18} {pred:>14.3g} {meas:>14.3g} "
+                         f"{rel:>10.3f}")
+    return "\n".join(lines)

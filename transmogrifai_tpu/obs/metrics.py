@@ -43,6 +43,7 @@ import math
 import random
 import re
 import threading
+import zlib
 from typing import Optional, Sequence
 
 __all__ = [
@@ -233,19 +234,25 @@ class Histogram(_Metric):
         idx = min(len(samples) - 1, max(0, math.ceil(q / 100.0 * len(samples)) - 1))
         return samples[idx]
 
-    def snapshot(self) -> dict:
+    def snapshot(self, samples: bool = False) -> dict:
+        """Plain-JSON view. With `samples=True` the snapshot additionally
+        carries the raw internals (`bounds`, per-bucket `raw_counts`, the
+        sorted `reservoir`) that `MetricsRegistry.merge` needs to fold this
+        histogram into another registry EXACTLY — the federation wire shape
+        (METRICS frames, `/fleet/metrics`). The default stays lean because
+        plain snapshots ride AppMetrics and `op monitor --json`."""
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
             mn, mx = self._min, self._max
-            samples = sorted(self._samples)
+            reservoir = sorted(self._samples)
 
         def pct(q: float) -> Optional[float]:
-            if not samples:
+            if not reservoir:
                 return None
-            idx = min(len(samples) - 1,
-                      max(0, math.ceil(q / 100.0 * len(samples)) - 1))
-            return samples[idx]
+            idx = min(len(reservoir) - 1,
+                      max(0, math.ceil(q / 100.0 * len(reservoir)) - 1))
+            return reservoir[idx]
 
         cum = 0
         buckets = {}
@@ -253,13 +260,74 @@ class Histogram(_Metric):
             cum += c
             buckets[f"{b:g}"] = cum
         buckets["+Inf"] = total
-        return {
+        out = {
             "count": total, "sum": round(s, 9),
             "min": None if total == 0 else mn,
             "max": None if total == 0 else mx,
             "p50": pct(50), "p95": pct(95), "p99": pct(99),
             "buckets": buckets,
         }
+        if samples:
+            out["bounds"] = list(self.bounds)
+            out["raw_counts"] = counts
+            out["reservoir"] = reservoir
+        return out
+
+    # --- federation merge -------------------------------------------------------------
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram's state into this one: exact bucket/sum/
+        count addition (requires identical bounds) plus a seeded reservoir
+        union. While the combined reservoirs fit, the union is lossless, so
+        fleet p50/p95/p99 over merged processes equal the single-process
+        oracle over the same observations; beyond the cap a deterministic
+        seeded subsample keeps percentile estimates stable across runs."""
+        if not isinstance(other, Histogram):
+            raise TypeError(f"cannot merge {type(other).__name__} into histogram")
+        # snapshot the other side under ITS lock first, then apply under ours
+        # — never hold both locks (merge in both directions would deadlock)
+        with other._lock:
+            o_counts = list(other._counts)
+            o_sum, o_count = other._sum, other._count
+            o_min, o_max = other._min, other._max
+            o_samples = list(other._samples)
+        self._merge_state(other.bounds, o_counts, o_sum, o_count,
+                          o_min, o_max, o_samples)
+
+    def _merge_state(self, bounds, counts, sum_, count, mn, mx, samples) -> None:
+        """Apply a consistent remote-histogram state (already detached from
+        any lock) into this instrument. Shared by `merge` (live instrument)
+        and `MetricsRegistry.merge` (wire snapshot)."""
+        bounds = tuple(float(b) for b in bounds)
+        if bounds != self.bounds:
+            raise ValueError(
+                f"histogram {self.name!r}: cannot merge mismatched bucket "
+                f"bounds ({len(bounds)} vs {len(self.bounds)} bounds)")
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name!r}: bucket count array length "
+                f"{len(counts)} != {len(self._counts)}")
+        count = int(count)
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._sum += float(sum_)
+            self._count += count
+            if count > 0:
+                if mn is not None:
+                    self._min = min(self._min, float(mn))
+                if mx is not None:
+                    self._max = max(self._max, float(mx))
+            pool = self._samples + [float(v) for v in samples]
+            if len(pool) > self._reservoir_max:
+                # deterministic union past the cap: seed from the name plus
+                # the combined count so repeated merges of the same streams
+                # pick the same subsample (bench/test stability), while
+                # successive pushes from a growing stream still re-sample
+                rng = random.Random(0x5EED
+                                    ^ zlib.crc32(self.name.encode("utf-8"))
+                                    ^ (self._count & 0xFFFFFFFF))
+                pool = rng.sample(pool, self._reservoir_max)
+            self._samples = pool
 
 
 class MetricsRegistry:
@@ -338,16 +406,65 @@ class MetricsRegistry:
             self._help.clear()
 
     # --- export -----------------------------------------------------------------------
-    def snapshot(self) -> dict:
-        """Plain-JSON view: {name: {kind, help, series: [{labels, ...}]}}."""
+    def snapshot(self, samples: bool = False) -> dict:
+        """Plain-JSON view: {name: {kind, help, series: [{labels, ...}]}}.
+        `samples=True` makes histogram series carry their raw internals so the
+        snapshot is losslessly mergeable on the far side (`merge`) — the shape
+        METRICS frames and `/fleet/metrics` federation push over the wire."""
         out: dict[str, dict] = {}
         for m in self.collect():
             entry = out.setdefault(m.name, {
                 "kind": m.kind, "help": self._help.get(m.name, ""),
                 "series": [],
             })
-            entry["series"].append({"labels": dict(m.labels), **m.snapshot()})
+            snap = (m.snapshot(samples=True)
+                    if samples and isinstance(m, Histogram) else m.snapshot())
+            entry["series"].append({"labels": dict(m.labels), **snap})
         return out
+
+    def merge(self, snapshot: dict, labels: Optional[dict] = None) -> None:
+        """Fold a remote registry `snapshot()` into this registry, optionally
+        stamping every folded series with extra labels (the federation layer
+        adds `process`/`role` here so per-process series stay distinguishable
+        after aggregation). Counters add, gauges take the remote level,
+        histograms add bucket counts exactly and union reservoirs seeded
+        (lossless while combined counts fit the reservoir — see
+        `Histogram.merge`). Histogram series without raw internals (a plain
+        `snapshot()`) degrade gracefully: bucket counts are de-cumulated from
+        the exposition buckets and the reservoir contribution is empty."""
+        extra = dict(labels) if labels else {}
+        for name in sorted(snapshot):
+            fam = snapshot[name]
+            kind = fam.get("kind")
+            help_text = fam.get("help", "")
+            for series in fam.get("series", []):
+                lab = dict(series.get("labels") or {})
+                lab.update(extra)
+                lab = lab or None
+                if kind == "counter":
+                    self.counter(name, help=help_text, labels=lab).inc(
+                        float(series.get("value", 0.0)))
+                elif kind == "gauge":
+                    self.gauge(name, help=help_text, labels=lab).set(
+                        float(series.get("value", 0.0)))
+                elif kind == "histogram":
+                    bounds = series.get("bounds")
+                    raw = series.get("raw_counts")
+                    reservoir = series.get("reservoir", [])
+                    if bounds is None or raw is None:
+                        bounds, raw = _decumulate_buckets(
+                            series.get("buckets", {}),
+                            int(series.get("count", 0)))
+                        reservoir = []
+                    h = self.histogram(name, help=help_text, labels=lab,
+                                       buckets=bounds)
+                    h._merge_state(bounds, raw, series.get("sum", 0.0),
+                                   series.get("count", 0),
+                                   series.get("min"), series.get("max"),
+                                   reservoir)
+                else:
+                    raise ValueError(
+                        f"cannot merge metric {name!r} of kind {kind!r}")
 
     def to_prometheus(self) -> str:
         """Text exposition format 0.0.4 (the format every Prometheus scraper
@@ -380,6 +497,21 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _decumulate_buckets(buckets: dict, total: int) -> tuple[list, list]:
+    """Recover (bounds, per-bucket raw counts) from a snapshot's cumulative
+    exposition buckets — the degraded merge path for snapshots that did not
+    ship raw internals. The +Inf slot absorbs total minus the last bound's
+    cumulative count."""
+    bounds = sorted(float(k) for k in buckets if k != "+Inf")
+    raw, prev = [], 0
+    for b in bounds:
+        cum = int(buckets[f"{b:g}"])
+        raw.append(cum - prev)
+        prev = cum
+    raw.append(int(total) - prev)
+    return bounds, raw
+
+
 # --- exposition validity check ----------------------------------------------------------
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
@@ -397,6 +529,7 @@ def parse_prometheus(text: str) -> dict[str, dict]:
     _count/_bucket consistency)."""
     families: dict[str, dict] = {}
     typed: dict[str, str] = {}
+    seen_series: set[tuple] = set()
     for i, line in enumerate(text.splitlines(), 1):
         if not line.strip():
             continue
@@ -424,10 +557,22 @@ def parse_prometheus(text: str) -> dict[str, dict]:
         if not m:
             raise ValueError(f"line {i}: malformed sample: {line!r}")
         labels_body = (m.group("labels") or "{}")[1:-1]
+        pairs: list[str] = []
         if labels_body:
-            for pair in _split_label_pairs(labels_body, i, line):
+            pairs = _split_label_pairs(labels_body, i, line)
+            for pair in pairs:
                 if not _LABEL_PAIR_RE.match(pair):
                     raise ValueError(f"line {i}: malformed label {pair!r}")
+        # duplicate (name, labels) series are a merge bug (two processes'
+        # series folded without distinguishing labels): fail loudly here so
+        # the CI exposition lint catches a bad federation pass. Label ORDER
+        # is normalized — `a="1",b="2"` and `b="2",a="1"` are one series.
+        series_key = (m.group("name"), tuple(sorted(pairs)))
+        if series_key in seen_series:
+            raise ValueError(
+                f"line {i}: duplicate series {m.group('name')}"
+                f"{m.group('labels') or ''}")
+        seen_series.add(series_key)
         raw_v = m.group("value")
         if raw_v not in ("+Inf", "-Inf", "NaN"):
             try:

@@ -1,0 +1,236 @@
+"""Flight recorder: a bounded ring of recent span events, dumped on failure.
+
+Post-mortem chaos debugging used to be log-archaeology: a breaker trips or a
+seeded fault kills a worker mid-shard, and reconstructing "what was the
+process doing in the seconds before" means grepping interleaved stderr. The
+flight recorder makes it data: every process keeps the last N span events
+(`obs.add_event` feeds it whether or not a tracer is active — breaker
+transitions, chaos injections, ingest lease churn, serve shed decisions all
+flow through that one chokepoint) plus the counter deltas since arming, and
+dumps the whole ring as `flightrec-<role>.json` the moment something goes
+wrong:
+
+  - a chaos injection fires (`chaos:inject` — the PR-6 FaultInjector sites),
+  - a circuit breaker trips OPEN (`breaker:transition` with to=open),
+  - a deadline-armed dispatch breaches (`resilience:deadline`),
+  - the process takes SIGQUIT (kill -QUIT <pid>: on-demand snapshot of a
+    wedged-but-alive process),
+  - or an uncaught exception is about to end the process (sys.excepthook).
+
+The ring is a fixed-capacity `collections.deque(maxlen=N)`: appends are
+single bytecode-level operations (no explicit lock on the hot path — the
+"lock-free" in the module's contract), and the dump path copies it wholesale
+under a dump lock. Dumps are atomic (temp + fsync + os.replace) and
+last-write-wins per role, so the file on disk always reflects the most recent
+trigger. `flightrec_dumps_total{reason}` counts every dump on the registry so
+federation surfaces recorder activity fleet-wide.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from . import metrics as _metrics
+from .context import process_role
+
+__all__ = [
+    "FlightRecorder", "active_recorder", "install_recorder",
+    "maybe_install_from_env", "uninstall_recorder",
+]
+
+DEFAULT_CAPACITY = 512
+
+#: minimum seconds between dumps for the SAME reason — a chaos schedule that
+#: fires every batch must not turn the recorder into a disk-write loop; the
+#: ring still retains the newest events for the next dump that does land
+_DUMP_MIN_INTERVAL_S = 0.5
+
+
+def _trigger_reason(name: str, attrs: dict) -> Optional[str]:
+    """Map a span event to a dump reason, or None for ordinary events."""
+    if name == "chaos:inject":
+        return "chaos_inject"
+    if name == "breaker:transition" and attrs.get("to") == "open":
+        return "breaker_open"
+    if name == "resilience:deadline":
+        return "deadline_breach"
+    return None
+
+
+class FlightRecorder:
+    """Per-process bounded event ring with trigger-driven atomic dumps."""
+
+    def __init__(self, role: Optional[str] = None, out_dir: str = ".",
+                 capacity: int = DEFAULT_CAPACITY, registry=None):
+        self.role = role or process_role()
+        self.out_dir = out_dir
+        self._ring: collections.deque = collections.deque(maxlen=int(capacity))
+        self._registry = registry
+        self._armed_at_unix = time.time()
+        self._baseline = self._counter_values()
+        self._dump_lock = threading.Lock()
+        self._last_dump: dict[str, float] = {}  # reason -> monotonic stamp
+        self.dumps = 0
+
+    def _reg(self):
+        return (self._registry if self._registry is not None
+                else _metrics.default_registry())
+
+    # --- hot path ---------------------------------------------------------------------
+    def record(self, name: str, attrs: dict) -> None:
+        """Append one span event to the ring; dump if it is a trigger."""
+        self._ring.append({"t_unix": round(time.time(), 6),
+                           "name": name, "attrs": attrs})
+        reason = _trigger_reason(name, attrs)
+        if reason is not None:
+            self.dump(reason)
+
+    # --- metric deltas ----------------------------------------------------------------
+    def _counter_values(self) -> dict[str, float]:
+        vals: dict[str, float] = {}
+        for m in self._reg().collect():
+            if m.kind == "counter":
+                vals[m.name + _metrics._label_str(m.labels)] = m.value
+        return vals
+
+    def metric_deltas(self) -> dict[str, float]:
+        """Counter movement since arming — the "what was the process actually
+        doing" complement to the event ring (rows committed, batches scored,
+        retries burned between arming and the trigger)."""
+        deltas = {}
+        for key, v in self._counter_values().items():
+            d = v - self._baseline.get(key, 0.0)
+            if d != 0:
+                deltas[key] = round(d, 9)
+        return deltas
+
+    # --- dump -------------------------------------------------------------------------
+    def path(self) -> str:
+        return os.path.join(self.out_dir, f"flightrec-{self.role}.json")
+
+    def dump(self, reason: str, force: bool = False) -> Optional[str]:
+        """Write the ring + metric deltas atomically; returns the path, or
+        None when rate-limited (same reason within the min interval).
+        `force` bypasses the rate limit (SIGQUIT / crash dumps always land)."""
+        now = time.monotonic()
+        with self._dump_lock:
+            last = self._last_dump.get(reason)
+            if not force and last is not None \
+                    and now - last < _DUMP_MIN_INTERVAL_S:
+                return None
+            self._last_dump[reason] = now
+            payload = {
+                "role": self.role,
+                "pid": os.getpid(),
+                "reason": reason,
+                "armed_at_unix": round(self._armed_at_unix, 6),
+                "dumped_at_unix": round(time.time(), 6),
+                "events": list(self._ring),
+                "metric_deltas": self.metric_deltas(),
+                "metrics": self._reg().snapshot(),
+            }
+            path = self.path()
+            os.makedirs(self.out_dir or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, default=str)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            self.dumps += 1
+        # count AFTER the write so the dump's own snapshot doesn't include
+        # the increment it is about to cause
+        self._reg().counter(
+            "flightrec_dumps_total",
+            help="flight-recorder dumps by trigger reason",
+            labels={"reason": reason, "role": self.role}).inc()
+        return path
+
+
+# --- process-global installation --------------------------------------------------------
+_ACTIVE: Optional[FlightRecorder] = None
+_PREV_EXCEPTHOOK = None
+_PREV_SIGQUIT = None
+
+
+def active_recorder() -> Optional[FlightRecorder]:
+    return _ACTIVE
+
+
+def install_recorder(role: Optional[str] = None, out_dir: str = ".",
+                     capacity: int = DEFAULT_CAPACITY, registry=None,
+                     signals: bool = True) -> FlightRecorder:
+    """Arm a process-wide flight recorder: `obs.add_event` starts feeding it,
+    SIGQUIT dumps on demand (main thread only — signal handlers cannot be
+    registered elsewhere), and uncaught exceptions dump before the interpreter
+    reports them. Re-installing replaces the previous recorder."""
+    global _ACTIVE, _PREV_EXCEPTHOOK, _PREV_SIGQUIT
+    rec = FlightRecorder(role=role, out_dir=out_dir, capacity=capacity,
+                         registry=registry)
+    _ACTIVE = rec
+    if signals and _PREV_EXCEPTHOOK is None:
+        _PREV_EXCEPTHOOK = sys.excepthook
+
+        def _hook(exc_type, exc, tb):
+            cur = _ACTIVE
+            if cur is not None:
+                try:
+                    cur._ring.append({
+                        "t_unix": round(time.time(), 6), "name": "crash",
+                        "attrs": {"type": exc_type.__name__, "msg": str(exc)}})
+                    cur.dump("crash", force=True)
+                except Exception:
+                    pass  # a recorder failure must never mask the real crash
+            (_PREV_EXCEPTHOOK or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = _hook
+    if signals and hasattr(signal, "SIGQUIT") \
+            and threading.current_thread() is threading.main_thread():
+        try:
+            def _on_sigquit(signum, frame):
+                cur = _ACTIVE
+                if cur is not None:
+                    cur.dump("sigquit", force=True)
+
+            prev = signal.signal(signal.SIGQUIT, _on_sigquit)
+            if _PREV_SIGQUIT is None:
+                _PREV_SIGQUIT = prev
+        except (ValueError, OSError):
+            pass  # embedded interpreters without signal support
+    return rec
+
+
+def uninstall_recorder() -> None:
+    """Disarm and restore the hooks (test isolation)."""
+    global _ACTIVE, _PREV_EXCEPTHOOK, _PREV_SIGQUIT
+    _ACTIVE = None
+    if _PREV_EXCEPTHOOK is not None:
+        sys.excepthook = _PREV_EXCEPTHOOK
+        _PREV_EXCEPTHOOK = None
+    if _PREV_SIGQUIT is not None and hasattr(signal, "SIGQUIT") \
+            and threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGQUIT, _PREV_SIGQUIT)
+        except (ValueError, OSError):
+            pass
+        _PREV_SIGQUIT = None
+
+
+def maybe_install_from_env(role: Optional[str] = None) -> Optional[FlightRecorder]:
+    """Arm from the TT_FLIGHTREC_DIR environment variable — the one-line hook
+    every entrypoint (op run/serve/ingest-serve, the ingest worker main)
+    calls, so `TT_FLIGHTREC_DIR=/tmp/rec op serve ...` arms the whole fleet
+    (spawned workers inherit the environment)."""
+    out_dir = os.environ.get("TT_FLIGHTREC_DIR")
+    if not out_dir:
+        return None
+    cur = active_recorder()
+    if cur is not None and cur.out_dir == out_dir:
+        return cur  # idempotent: repeated runs keep the armed ring intact
+    return install_recorder(role=role, out_dir=out_dir)

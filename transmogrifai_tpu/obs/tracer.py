@@ -34,6 +34,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from . import context
+
 #: compile-event kinds, in pipeline order: python tracing -> StableHLO lowering
 #: -> XLA backend compile; "cache_hit" marks a persistent-cache executable
 #: retrieval (deserialization — cheap relative to a compile, not free).
@@ -70,7 +72,8 @@ class Span:
     """One node of the trace tree. Created via Tracer.span(); not by hand."""
 
     __slots__ = ("name", "parent", "children", "t0", "t1", "thread",
-                 "compiles", "cost", "mem_delta_bytes", "events")
+                 "compiles", "cost", "mem_delta_bytes", "events",
+                 "span_id", "remote_parent")
 
     def __init__(self, name: str, parent: Optional["Span"] = None):
         self.name = name
@@ -86,6 +89,13 @@ class Span:
         #: plan analyzer's downgraded diagnostics in strict=False trains):
         #: list of {"name": ..., **attrs} dicts
         self.events: list[dict] = []
+        #: process-unique hex id — the cross-process linkage key: a remote
+        #: side that received this span's id as a TraceContext carries it as
+        #: `remote_parent`, and the stitch tool joins the two dumps on it
+        self.span_id = context.new_span_id()
+        #: span_id of the span in ANOTHER process this span logically nests
+        #: under (arrived via LEASE ctx / traceparent header); None locally
+        self.remote_parent: Optional[str] = None
 
     @property
     def wall_s(self) -> float:
@@ -124,10 +134,21 @@ class Tracer:
     reports keep working unchanged.
     """
 
-    def __init__(self, trace_dir: Optional[str] = None, name: str = "run"):
+    def __init__(self, trace_dir: Optional[str] = None, name: str = "run",
+                 role: Optional[str] = None):
         self.trace_dir = trace_dir
         self.root = Span(name)
         self.root.t0 = time.perf_counter()
+        #: wall-clock anchor of root.t0 — perf_counter epochs differ per
+        #: process, so cross-process stitching aligns dumps on this instead
+        self.t0_unix = time.time()
+        #: distributed trace identity; a process that receives a remote
+        #: TraceContext adopts its id so one fleet run shares ONE trace_id
+        self.trace_id = context.new_trace_id()
+        self.role = role or context.process_role()
+        #: Chrome dumps of child processes (ingest workers, daemon) registered
+        #: via adopt_dump(); export_chrome(stitched=True) folds them in
+        self.child_dumps: list[str] = []
         self.phases: dict[str, PhaseTiming] = {}
         self.device_cost: dict[str, dict[str, float]] = {}
         self.compile_events: list[CompileEvent] = []
@@ -135,6 +156,19 @@ class Tracer:
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._mem_fn = _memory_stats_fn()
+
+    def adopt_trace_id(self, trace_id: str) -> None:
+        """Take on a remote trace id (last adoption wins — one fleet run is
+        one trace, so repeated leases from the same coordinator are
+        idempotent here)."""
+        if trace_id:
+            self.trace_id = trace_id
+
+    def adopt_dump(self, path: str) -> None:
+        """Register a child process's Chrome dump for stitched export."""
+        with self._lock:
+            if path not in self.child_dumps:
+                self.child_dumps.append(path)
 
     # --- span stack (per thread) ------------------------------------------------------
     def _stack(self) -> list[Span]:
@@ -148,10 +182,15 @@ class Tracer:
         return st[-1] if st else self.root
 
     @contextmanager
-    def span(self, name: str, parent: Optional[Span] = None):
+    def span(self, name: str, parent: Optional[Span] = None,
+             remote_parent: Optional[str] = None):
         """Open a child span of `parent` (default: the calling thread's
-        innermost open span, falling back to the tracer root)."""
+        innermost open span, falling back to the tracer root).
+        `remote_parent` stamps the span id of a span in ANOTHER process
+        (arrived as a TraceContext) so stitched exports can link it."""
         sp = Span(name, parent=parent or self.current_span())
+        if remote_parent:
+            sp.remote_parent = remote_parent
         with self._lock:
             sp.parent.children.append(sp)
         mem0 = self._mem_fn() if self._mem_fn else None
@@ -262,13 +301,17 @@ class Tracer:
         return out
 
     # --- Chrome trace / Perfetto ------------------------------------------------------
-    def export_chrome(self, path: str) -> str:
-        """Write a Chrome-trace JSON (the `traceEvents` array format Perfetto
-        and chrome://tracing load). Spans become complete ("X") events on their
-        thread's track; compile events become "X" events in a "compile"
-        category; cache hits are instants; span events (`add_event`: oplint
-        diagnostics, serve:routing decisions, drift alerts) become instant
-        ("i") events in an "event" category on the span's thread."""
+    def chrome_payload(self) -> dict:
+        """The Chrome-trace JSON payload (the `traceEvents` array format
+        Perfetto and chrome://tracing load), in memory. Spans become complete
+        ("X") events on their thread's track; compile events become "X" events
+        in a "compile" category; cache hits are instants; span events
+        (`add_event`: oplint diagnostics, serve:routing decisions, drift
+        alerts) become instant ("i") events in an "event" category on the
+        span's thread. Every span carries its `span_id` (and `remote_parent`
+        when set) in args, and a `metadata` block anchors the dump in
+        wall-clock time — together the inputs `obs.fleet.stitch_chrome_traces`
+        needs to join per-process dumps into one distributed timeline."""
         self.finish()
         t_base = self.root.t0
         events: list[dict] = []
@@ -284,12 +327,17 @@ class Tracer:
             return threads[ident]
 
         def walk(sp: Span) -> None:
+            args: dict[str, Any] = {"path": sp.path, "span_id": sp.span_id}
+            if sp.parent is not None:
+                args["parent_span_id"] = sp.parent.span_id
+            if sp.remote_parent:
+                args["remote_parent"] = sp.remote_parent
             events.append({
                 "ph": "X", "name": sp.name, "cat": "span", "pid": 1,
                 "tid": tid_of(sp.thread),
                 "ts": round((sp.t0 - t_base) * 1e6, 3),
                 "dur": round(max(sp.wall_s, 0.0) * 1e6, 3),
-                "args": {"path": sp.path},
+                "args": args,
             })
             for ev in sp.events:
                 # instant events on the span's own thread track: oplint
@@ -323,7 +371,28 @@ class Tracer:
                 base.update({"ph": "i", "s": "t",
                              "ts": round(e.t_s * 1e6, 3)})
             events.append(base)
-        payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {
+                "trace_id": self.trace_id, "role": self.role,
+                "pid": os.getpid(), "name": self.root.name,
+                "t0_unix": round(self.t0_unix, 6),
+            },
+        }
+
+    def export_chrome(self, path: str, stitched: bool = False) -> str:
+        """Write the Chrome-trace JSON to `path`. With `stitched=True`, child
+        process dumps registered via `adopt_dump()` (ingest workers' exports,
+        the daemon's) are merged in — per-process pid lanes, wall-clock
+        aligned, remote-parent links drawn as flow arrows — yielding ONE
+        end-to-end ingest→train→serve timeline (see obs.fleet)."""
+        payload = self.chrome_payload()
+        if stitched:
+            from . import fleet
+
+            with self._lock:
+                dumps = list(self.child_dumps)
+            payload = fleet.stitch_chrome_traces([payload] + dumps)
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
         with open(path, "w") as fh:
             json.dump(payload, fh)

@@ -200,6 +200,11 @@ class PipelineStats:
     sink_stall_s: float = 0.0
     queue_depth: dict[int, int] = field(default_factory=dict)
     bucket_hist: dict[int, int] = field(default_factory=dict)
+    #: fleet-role label for published series; None defers to TT_ROLE/"run"
+    #: at publish time. Set by owners whose role is known statically (the
+    #: serving stream sets "serve") — Prefetcher.close() publishes without
+    #: arguments, so the object itself carries the attribution.
+    role: Optional[str] = None
     #: guard against double publication into the metrics registry: the same
     #: stats object flows through a Prefetcher AND run_pipeline
     _published: bool = field(default=False, repr=False)
@@ -210,31 +215,40 @@ class PipelineStats:
     def observe_bucket(self, size: int) -> None:
         self.bucket_hist[size] = self.bucket_hist.get(size, 0) + 1
 
-    def publish(self, registry=None) -> None:
+    def publish(self, registry=None, role=None) -> None:
         """Fold this run's totals into the unified metrics registry
         (obs/metrics.py): per-stage/stall seconds and batch counts as
         `pipeline_*_total` counters, the final queue depth distribution as a
         gauge of its modal depth. Idempotent per stats object — run_pipeline
-        and ScoreFunction.stream call it once at drain."""
+        and ScoreFunction.stream call it once at drain.
+
+        `role` labels the series with this process's fleet role (defaults to
+        TT_ROLE / "run") so a federated view (`/fleet/metrics`, `op top`)
+        can tell a serving replica's pipeline from an ingest worker's even
+        before the aggregator adds its own process labels."""
         if self._published or self.batches == 0:
             return
         self._published = True
+        from ..obs.context import process_role
         from ..obs.metrics import default_registry
 
         reg = registry if registry is not None else default_registry()
+        labels = {"role": role or self.role or process_role()}
         reg.counter("pipeline_batches_total",
-                    help="batches through the input pipeline").inc(self.batches)
+                    help="batches through the input pipeline",
+                    labels=labels).inc(self.batches)
         for key in ("prepare_s", "compute_s", "sink_s", "host_stall_s",
                     "backpressure_s", "sink_stall_s"):
             reg.counter(f"pipeline_{key[:-2]}_seconds_total",
                         help="input-pipeline stage/stall seconds "
-                             "(PipelineStats aggregate)").inc(getattr(self, key))
+                             "(PipelineStats aggregate)",
+                        labels=labels).inc(getattr(self, key))
         if self.queue_depth:
             modal = max(self.queue_depth, key=self.queue_depth.get)
             reg.gauge("pipeline_queue_depth_modal",
                       help="most frequent prepare-queue depth of the latest "
                            "pipeline run (0 = ingest-bound, max = "
-                           "compute-bound)").set(modal)
+                           "compute-bound)", labels=labels).set(modal)
 
     def to_dict(self) -> dict:
         out = {

@@ -256,7 +256,12 @@ class Autopilot:
             decision.update(self._retrain_and_gate())
         return decision
 
-    def _retrain_and_gate(self) -> dict:
+    def _retrain_and_gate(self, parent=None) -> dict:
+        """`parent` is the span captured on the SPAWNING thread (run()'s poll
+        loop) — span lookup is per-thread, so without it a retrain on the
+        worker thread would parent to the tracer root and the stitched fleet
+        trace would show the retrain floating free of the drift decision
+        that triggered it."""
         cfg = self.config
         try:
             try:
@@ -275,7 +280,7 @@ class Autopilot:
             # -- retrain (chaos site: a crash here must leave the champion
             # serving and the loop re-armed, nothing else)
             try:
-                with obs.span("autopilot:retrain"):
+                with obs.span("autopilot:retrain", parent=parent):
                     chaos.maybe_site("autopilot:retrain")
                     wf = self._workflow_factory()
                     wf.with_warm_start(champion)
@@ -333,7 +338,7 @@ class Autopilot:
             cand_dir = os.path.join(self._workdir,
                                     f"candidate-{self._candidates:04d}")
             try:
-                with obs.span("autopilot:save"):
+                with obs.span("autopilot:save", parent=parent):
                     os.makedirs(cand_dir, exist_ok=True)
                     chaos.maybe_site("autopilot:save")
                     candidate.save(cand_dir, overwrite=True,
@@ -347,7 +352,7 @@ class Autopilot:
             # -- hot swap: admit + alias repoint. Admission failures (torn
             # bundle on disk, a lost device) raise BEFORE the alias moves.
             try:
-                with obs.span("autopilot:swap"):
+                with obs.span("autopilot:swap", parent=parent):
                     new_entry = self._daemon.swap(
                         self._name, cand_dir, retire_old=cfg.retire_old)
             except Exception as e:  # noqa: BLE001
@@ -437,8 +442,8 @@ class Autopilot:
         steps = 0
         acted: list = []  # worker decisions, surfaced on the report
 
-        def _act():
-            decision = self._retrain_and_gate()
+        def _act(parent=None):
+            decision = self._retrain_and_gate(parent=parent)
             acted.append(decision)
             if log:
                 log(f"autopilot: {decision['action']}")
@@ -453,8 +458,11 @@ class Autopilot:
                     f"streak={decision['streak']}")
             if decision.pop("act") and (worker is None
                                         or not worker.is_alive()):
-                worker = threading.Thread(target=_act, daemon=True,
-                                          name="autopilot-retrain")
+                # capture the poll thread's span HERE: the retrain spans on
+                # the worker thread nest under the decision that spawned them
+                worker = threading.Thread(
+                    target=_act, args=(obs.current_span(),), daemon=True,
+                    name="autopilot-retrain")
                 worker.start()
             stop.wait(poll_s)
         if worker is not None:

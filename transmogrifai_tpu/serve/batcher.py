@@ -76,15 +76,19 @@ class Overloaded(RuntimeError):
 
 
 class _Pending:
-    """One queued request: its records, the caller's Future, and the enqueue
-    timestamp feeding `serve_queue_wait_seconds`."""
+    """One queued request: its records, the caller's Future, the enqueue
+    timestamp feeding `serve_queue_wait_seconds`, and the submitting
+    thread's span (span lookup is per-thread — the coalescer's producer
+    thread needs the captured parent to nest its dispatch span under the
+    request that opened the window)."""
 
-    __slots__ = ("records", "future", "t_enqueue")
+    __slots__ = ("records", "future", "t_enqueue", "span")
 
-    def __init__(self, records, future, t_enqueue):
+    def __init__(self, records, future, t_enqueue, span=None):
         self.records = records
         self.future = future
         self.t_enqueue = t_enqueue
+        self.span = span
 
 
 class _CoalescedSource:
@@ -191,8 +195,10 @@ class MicroBatcher:
             f.set_result([])
             return f
         try:
-            self._requests.put(_Pending(records, f, time.perf_counter()),
-                               timeout=0.0)
+            self._requests.put(
+                _Pending(records, f, time.perf_counter(),
+                         span=obs.current_span()),
+                timeout=0.0)
         except Full:
             self._shed_counter.inc()
             obs.add_event("serve:shed", model=self.model_label,
@@ -296,9 +302,14 @@ class MicroBatcher:
             self.dispatches += 1
             self.coalesced_requests += len(group)
             self.coalesced_rows += rows
-            obs.add_event("serve:coalesce", requests=len(group),
-                          rows=int(rows),
-                          waited_ms=round((now - group[0].t_enqueue) * 1e3, 3))
+            # the dispatch span nests under the span of the request that
+            # OPENED the window (captured at submit time): a stitched fleet
+            # trace shows client -> daemon handler -> coalesced dispatch as
+            # one chain even though this runs on the producer thread
+            with obs.span("serve:dispatch", parent=group[0].span):
+                obs.add_event(
+                    "serve:coalesce", requests=len(group), rows=int(rows),
+                    waited_ms=round((now - group[0].t_enqueue) * 1e3, 3))
             self._inflight.append((gen, group))
             yield [r for p in group for r in p.records]
 

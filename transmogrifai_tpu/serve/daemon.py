@@ -195,6 +195,15 @@ class ServingDaemon:
         self._started = time.monotonic()
         self._closed = False
         reg = obs.default_registry()
+        #: federation point for the serving side of the fleet: the daemon's
+        #: own registry is one pull source ("serve" role), and remote
+        #: replicas/sidecars POST {role, process, snapshot} to
+        #: /fleet/metrics. GET /fleet/metrics and `op top --daemon` read the
+        #: merged view.
+        self.fleet = obs.FleetAggregator()
+        self.fleet.attach_local(
+            obs.process_role(default="serve"), os.getpid(),
+            lambda: reg.snapshot(samples=True))
         self._g_loaded = reg.gauge(
             "serve_models_loaded", help="models resident in the daemon cache")
         self._c_evicted = reg.counter(
@@ -518,6 +527,11 @@ class DaemonClient:
     def metrics(self) -> str:
         return obs.default_registry().to_prometheus()
 
+    def fleet_metrics(self) -> str:
+        """Aggregated exposition across every process the daemon's
+        FleetAggregator knows about (role/process labels on each series)."""
+        return self._daemon.fleet.to_prometheus()
+
 
 # --- HTTP surface (stdlib only) -------------------------------------------------------
 #: default POST body ceiling: generous for real scoring traffic (a full
@@ -586,6 +600,19 @@ def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
                                obs.default_registry().to_prometheus()
                                .encode("utf-8"),
                                ctype="text/plain; version=0.0.4")
+                elif self.path.split("?", 1)[0] == "/fleet/metrics":
+                    # merged view across the daemon's own registry plus every
+                    # snapshot POSTed by remote replicas; ?format=json returns
+                    # the raw per-process snapshots for `op top --daemon`
+                    if "format=json" in (self.path.split("?", 1) + [""])[1]:
+                        self._json(200,
+                                   {"snapshots":
+                                    daemon.fleet.raw_snapshots()})
+                    else:
+                        self._send(200,
+                                   daemon.fleet.to_prometheus()
+                                   .encode("utf-8"),
+                                   ctype="text/plain; version=0.0.4")
                 elif self.path == "/v1/models":
                     self._json(200, {"models": daemon.models()})
                 else:
@@ -626,6 +653,18 @@ def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
                     info = daemon.admit(body["path"],
                                         name=body.get("name")).info()
                     return self._json(200, info)
+                if self.path == "/fleet/metrics":
+                    # push leg of metrics federation: a replica/sidecar posts
+                    # its registry snapshot (the METRICS-frame payload shape)
+                    role = body.get("role")
+                    snap = body.get("snapshot")
+                    if not role or not isinstance(snap, dict):
+                        return self._error(
+                            400, 'missing "role" or "snapshot" object')
+                    daemon.fleet.ingest(str(role),
+                                        str(body.get("process") or "remote"),
+                                        snap)
+                    return self._json(200, {"ok": True})
                 if self.path in ("/v1/score", "/score"):
                     records = body.get("records")
                     if records is None and "record" in body:
@@ -633,7 +672,21 @@ def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
                     if not isinstance(records, list):
                         return self._error(400, 'missing "records" list')
                     entry = daemon._resolve(body.get("model"))
-                    results = entry.batcher.score(records, timeout=60.0)
+                    # W3C trace propagation: a caller-sent traceparent header
+                    # adopts the caller's trace_id onto this process's tracer
+                    # and parents the scoring span under the caller's span,
+                    # so `op trace-merge` stitches client -> daemon end to end
+                    ctx = obs.TraceContext.from_traceparent(
+                        self.headers.get("traceparent"))
+                    t = obs.current()
+                    if ctx is not None and t is not None:
+                        t.adopt_trace_id(ctx.trace_id)
+                    with obs.span(
+                            f"serve:http_score:{entry.name}",
+                            remote_parent=(ctx.span_id if ctx else None)):
+                        obs.add_event("serve:http_score", model=entry.name,
+                                      n=len(records))
+                        results = entry.batcher.score(records, timeout=60.0)
                     return self._json(200, {"model": entry.name,
                                             "results": results})
                 return self._error(404, f"no route {self.path}")

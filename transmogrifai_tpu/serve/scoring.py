@@ -790,6 +790,10 @@ class ScoreFunction:
 
             with Prefetcher(batches, prep, depth=prefetch, name="serve_build",
                             place=place, policy=self._policy) as pf:
+                # serving's pipeline series carry role="serve" in the fleet
+                # view regardless of this process's TT_ROLE (a daemon also
+                # hosts training pipelines whose series keep the process role)
+                pf.stats.role = "serve"
                 for item in pf:
                     # bare-Prefetcher use: the consumer owns the batch count
                     # (run_pipeline's loop does this for the runner), so

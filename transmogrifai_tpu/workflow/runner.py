@@ -56,6 +56,11 @@ class AppMetrics:
     #: and latency histograms, drift gauges/alert counters. Cumulative
     #: process-wide totals (the Prometheus contract), not per-run deltas.
     metrics: Optional[dict] = None
+    #: fleet identity: this process's role (TT_ROLE/"run") and, for traced
+    #: runs, the distributed trace_id — the join key that correlates this
+    #: report with the stitched fleet trace and federated metric series
+    role: Optional[str] = None
+    trace_id: Optional[str] = None
 
     @property
     def app_duration_s(self) -> float:
@@ -70,6 +75,10 @@ class AppMetrics:
             ],
             "custom_tags": dict(self.custom_tags),
         }
+        if self.role is not None:
+            out["role"] = self.role
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         if self.profile is not None:
             out["profile"] = self.profile
         if self.trace is not None:
@@ -332,6 +341,12 @@ class WorkflowRunner:
         metrics = AppMetrics(run_type, start_time=time.time(),
                              custom_tags=dict(params.custom_tags))
         phase_t0 = time.time()
+        from .. import obs as _obs
+
+        metrics.role = _obs.process_role()
+        # a fleet launch with TT_FLIGHTREC_DIR exported arms the crash/
+        # SIGQUIT flight recorder for the training process too (idempotent)
+        _obs.maybe_install_from_env(role=metrics.role)
 
         def mark(name: str) -> None:
             nonlocal phase_t0
@@ -379,6 +394,7 @@ class WorkflowRunner:
                     prof_ctx = jax.profiler.trace(trace_dir)
                 with ctx as tracer, prof_ctx:
                     result = dispatch()
+                metrics.trace_id = tracer.trace_id
                 full = tracer.report()
                 # profile keeps the legacy shape; the span tree + compile
                 # attribution ride in the new AppMetrics trace section
