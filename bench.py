@@ -461,6 +461,7 @@ def _run(partial: dict) -> None:
             run_fleet_obs_overhead,
             run_hist,
             run_iris,
+            run_lock_check_overhead,
             run_mlp,
             run_monitor_overhead,
             run_multitenant_ingest,
@@ -514,6 +515,16 @@ def _run(partial: dict) -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         partial["resilience_throughput_retention"] = \
             detail["resilience_overhead"].get("resilience_throughput_retention")
+        # runtime lock-order validator armed-vs-off on the two thread-heavy
+        # serving shapes (queue-fed streaming + daemon closed loop): the
+        # checked-lock wrapper must retain >= 0.97 throughput
+        try:
+            detail["lock_check_overhead"] = run_lock_check_overhead()
+        except Exception as e:  # noqa: BLE001
+            detail["lock_check_overhead"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        partial["lock_check_throughput_retention"] = \
+            detail["lock_check_overhead"].get("lock_check_throughput_retention")
         # serving daemon: closed-loop concurrent clients through the
         # adaptive micro-batcher vs the per-call device path (tail latency
         # is the gated number, not just throughput)
@@ -648,6 +659,12 @@ def _run(partial: dict) -> None:
         s["resilience_throughput_retention"] = \
             ro["resilience_throughput_retention"]
         s["resilience_armed_rows_per_sec"] = ro["armed_rows_per_sec"]
+    if detail.get("lock_check_overhead", {}).get(
+            "lock_check_throughput_retention") is not None:
+        lc = detail["lock_check_overhead"]
+        s["lock_check_throughput_retention"] = \
+            lc["lock_check_throughput_retention"]
+        s["lock_check_armed_rows_per_sec"] = lc["stream_armed_rows_per_sec"]
     if detail.get("serving_daemon", {}).get("daemon_p50_ms") is not None:
         sd = detail["serving_daemon"]
         s["serving_daemon_p50_ms"] = sd["daemon_p50_ms"]

@@ -626,6 +626,187 @@ def run_resilience_overhead(n_batches: int = 32, batch: int = 512) -> dict:
     }
 
 
+def run_lock_check_overhead(n_batches: int = 32, batch: int = 512,
+                            n_clients: int = 16,
+                            requests_per_client: int = 128) -> dict:
+    """Armed lock-order-validator overhead lane (ISSUE-17): the two
+    thread-heavy serving shapes with `TT_LOCK_CHECK=1` vs off.
+
+    Arming is decided when `make_lock(...)` runs, so each arm constructs its
+    OWN lock holders under the right env: (a) streamed scoring fed through a
+    `QueueStreamingReader` (producer thread -> checked put/close lock per
+    batch), (b) the serving daemon's closed-loop concurrent clients (admit
+    lock, batcher queue condition, score-fn RLock on every request). Reports
+    rows/s per arm, `lock_check_throughput_retention` = min of the two
+    armed/off ratios (1.0 = free; the acceptance floor is 0.97), and the
+    armed acquisition count — a zero would mean the lane measured nothing.
+    Armed arms run in raise mode: a single inversion fails the bench loudly
+    instead of shipping a polluted ratio."""
+    import contextlib
+    import shutil
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu.graph import features_from_schema
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers import InMemoryReader, QueueStreamingReader
+    from transmogrifai_tpu.resilience import lockcheck
+    from transmogrifai_tpu.serve import DaemonClient, ServingDaemon
+    from transmogrifai_tpu.stages.feature import transmogrify
+    from transmogrifai_tpu.stages.model import LogisticRegression
+    from transmogrifai_tpu.workflow import Workflow, WorkflowRunner
+
+    @contextlib.contextmanager
+    def env_armed(on: bool):
+        prev = os.environ.get("TT_LOCK_CHECK")
+        try:
+            if on:
+                os.environ["TT_LOCK_CHECK"] = "1"
+            else:
+                os.environ.pop("TT_LOCK_CHECK", None)
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("TT_LOCK_CHECK", None)
+            else:
+                os.environ["TT_LOCK_CHECK"] = prev
+
+    schema = {"label": "RealNN", **{f"x{i}": "Real" for i in range(6)},
+              "cat": "PickList"}
+    rng = np.random.default_rng(23)
+
+    def rows(n, labeled=True):
+        out = []
+        for _ in range(n):
+            r = {f"x{i}": float(v)
+                 for i, v in enumerate(rng.normal(size=6))}
+            r["cat"] = "abcd"[int(rng.integers(0, 4))]
+            if labeled:
+                r["label"] = float(rng.random() > 0.5)
+            out.append(r)
+        return out
+
+    fs = features_from_schema(schema, response="label")
+    vec = transmogrify([f for n_, f in fs.items() if n_ != "label"])
+    pred = LogisticRegression(l2=0.1)(fs["label"], vec)
+    wf = Workflow().set_result_features(pred)
+    runner = WorkflowRunner(wf, train_reader=InMemoryReader(rows(1024)))
+    runner.run("train", OpParams())
+    model = runner._model  # the fitted model the train run cached
+
+    batches = [rows(batch, labeled=False) for _ in range(n_batches)]
+    n_rows = n_batches * batch
+    lockcheck.reset_lockcheck()  # count only THIS lane's armed acquisitions
+
+    # --- shape (a): streamed scoring through a queue-fed reader -----------
+    def stream_score(armed: bool) -> float:
+        out_dir = tempfile.mkdtemp(prefix="bench_lockcheck_")
+        try:
+            with env_armed(armed):
+                reader = QueueStreamingReader(maxsize=4, timeout=30.0)
+
+            def feed():
+                for b in batches:
+                    reader.put(list(b))
+                reader.close()
+
+            producer = threading.Thread(target=feed, daemon=True)
+            runner.streaming_reader = reader
+            t0 = time.perf_counter()
+            producer.start()
+            res = runner.run("streaming_score",
+                             OpParams(write_location=out_dir))
+            wall = time.perf_counter() - t0
+            producer.join(timeout=10)
+            assert res.n_rows == n_rows
+            return wall
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    stream_score(False)  # warm: compile the bucket-shape programs once
+    # interleaved best-of-5 per arm: the retention ratio must measure the
+    # checked-lock wrapper, not scheduler noise on a shared CI host (the
+    # streamed run is short, so this arm needs more reps than the others)
+    s_off, s_on = [], []
+    for _ in range(5):
+        s_off.append(stream_score(False))
+        s_on.append(stream_score(True))
+    stream_off_rps = n_rows / min(s_off)
+    stream_on_rps = n_rows / min(s_on)
+
+    # --- shape (b): daemon closed-loop concurrent single-row clients ------
+    serving = rows(max(64, n_clients * 2), labeled=False)
+    n_req = n_clients * requests_per_client
+
+    def closed_loop(score_one) -> float:
+        barrier = threading.Barrier(n_clients)
+
+        def client(cid):
+            barrier.wait()
+            for k in range(requests_per_client):
+                score_one(serving[(cid * 7 + k) % len(serving)])
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def make_daemon(armed: bool, mdir: str):
+        with env_armed(armed):
+            daemon = ServingDaemon(max_models=2, max_batch=256,
+                                   bucket_floor=1, max_wait_ms=2.0)
+        daemon.admit(mdir, name="bench")
+        client = DaemonClient(daemon)
+        return daemon, (lambda r: client.score([r], model="bench"))
+
+    # both arms live at once, rounds interleaved best-of-3 — back-to-back
+    # daemons would fold EMA-warmup and scheduler drift into the ratio
+    mdir = tempfile.mkdtemp(prefix="bench_lockcheck_model_")
+    try:
+        model.save(mdir, overwrite=True)
+        d_off, score_off = make_daemon(False, mdir)
+        d_on, score_on = make_daemon(True, mdir)
+        with d_off, d_on:
+            closed_loop(score_off)  # warm each batcher's EMA + buckets
+            closed_loop(score_on)
+            d_off_walls, d_on_walls = [], []
+            for i in range(6):
+                # ABBA ordering: host drift within a round cancels instead
+                # of always taxing the second arm
+                first_on = bool(i % 2)
+                for on in (first_on, not first_on):
+                    (d_on_walls if on else d_off_walls).append(
+                        closed_loop(score_on if on else score_off))
+    finally:
+        shutil.rmtree(mdir, ignore_errors=True)
+    daemon_off_rps = n_req / min(d_off_walls)
+    daemon_on_rps = n_req / min(d_on_walls)
+
+    state = lockcheck.lockcheck_state()
+    acquisitions, violations = state["acquisitions"], len(state["violations"])
+    lockcheck.reset_lockcheck()  # don't leak order facts into later lanes
+
+    stream_ret = round(stream_on_rps / stream_off_rps, 4)
+    daemon_ret = round(daemon_on_rps / daemon_off_rps, 4)
+    return {
+        "rows": n_rows, "batches": n_batches, "batch_size": batch,
+        "clients": n_clients, "requests": n_req,
+        "stream_off_rows_per_sec": round(stream_off_rps),
+        "stream_armed_rows_per_sec": round(stream_on_rps),
+        "stream_throughput_retention": stream_ret,
+        "daemon_off_rows_per_sec": round(daemon_off_rps),
+        "daemon_armed_rows_per_sec": round(daemon_on_rps),
+        "daemon_throughput_retention": daemon_ret,
+        "lock_check_throughput_retention": min(stream_ret, daemon_ret),
+        "armed_lock_acquisitions": acquisitions,
+        "lock_order_violations": violations,
+    }
+
+
 def run_disagg_ingest(n_files: int = 8, rows_per_file: int = 2048,
                       batch: int = 256) -> dict:
     """Disaggregated-ingest lane (ISSUE-9): pure EXTRACTION throughput of a
@@ -1399,6 +1580,7 @@ ALL = {"iris": run_iris, "boston": run_boston, "hist": run_hist, "mlp": run_mlp,
        "monitor": run_monitor_overhead,
        "fleet_obs": run_fleet_obs_overhead,
        "resilience": run_resilience_overhead,
+       "lock_check": run_lock_check_overhead,
        "daemon": run_serving_daemon,
        "cold_start": run_cold_start,
        "disagg": run_disagg_ingest,

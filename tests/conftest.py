@@ -92,6 +92,40 @@ def _reset_uids():
     yield
 
 
+#: thread-heavy suites that run with the runtime lock-order validator armed
+#: (TT_LOCK_CHECK=1): every lock built through resilience.make_lock in the
+#: daemon/ingest/pipeline/autopilot stacks checks acquisitions against the
+#: static `op threadlint` order DAG and raises LockOrderError on inversion
+_LOCKCHECK_MODULES = frozenset({
+    "test_daemon", "test_ingest", "test_ingest_service", "test_pipeline",
+    "test_autopilot",
+})
+
+
+@pytest.fixture(scope="session")
+def _lockcheck_static_edges():
+    from transmogrifai_tpu.analyze.threadlint import run_threadlint
+
+    report = run_threadlint()
+    return [(a, b, f"static:{site[0]}:{site[1]}")
+            for (a, b), site in sorted(report.edges.items())]
+
+
+@pytest.fixture(autouse=True)
+def _arm_lockcheck(request, monkeypatch):
+    if request.module.__name__ in _LOCKCHECK_MODULES:
+        from transmogrifai_tpu.resilience import lockcheck
+
+        monkeypatch.setenv("TT_LOCK_CHECK", "1")
+        lockcheck.reset_lockcheck()
+        lockcheck.seed_static_order(
+            request.getfixturevalue("_lockcheck_static_edges"))
+        yield
+        lockcheck.reset_lockcheck()
+    else:
+        yield
+
+
 def import_all_package_modules():
     """Import every transmogrifai_tpu module so every @register_stage lands in
     the registry — shared by the registry-wide sweeps (contracts + outputs)."""

@@ -1,0 +1,48 @@
+"""threadlint fixture: OP601 guarded-field escape — positive and negative."""
+import threading
+
+
+class LeakyCounter:
+    """POSITIVE: _n is written under the lock but read bare elsewhere."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):                      # bare read of the guarded field
+        return self._n
+
+
+class CleanCounter:
+    """NEGATIVE: every access to _n holds the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+
+
+class BlessedCounter:
+    """NEGATIVE: the bare read is pragma'd as deliberate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n  # threadlint: ok OP601 - monotonic int, stale ok

@@ -1,0 +1,64 @@
+"""threadlint fixture: OP602 lock-order inversion — positive and negative."""
+import threading
+
+
+class Inverted:
+    """POSITIVE: transfer() takes _a then _b, audit() takes _b then _a."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def transfer(self):
+        with self._a:
+            with self._b:
+                self.x += 1
+
+    def audit(self):
+        with self._b:
+            with self._a:
+                self.y += 1
+
+
+class Ordered:
+    """NEGATIVE: both paths take _a before _b."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def transfer(self):
+        with self._a:
+            with self._b:
+                self.x += 1
+
+    def audit(self):
+        with self._a:
+            with self._b:
+                self.x -= 1
+
+
+class HelperInverted:
+    """POSITIVE (inter-procedural): the nested acquisition happens in a
+    private helper, so the cycle only exists across the call graph."""
+
+    def __init__(self):
+        self._front = threading.Lock()
+        self._back = threading.Lock()
+        self.n = 0
+
+    def _grab_back(self):
+        with self._back:
+            self.n += 1
+
+    def forward(self):
+        with self._front:
+            self._grab_back()
+
+    def backward(self):
+        with self._back:
+            with self._front:
+                self.n -= 1

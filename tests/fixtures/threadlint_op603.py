@@ -1,0 +1,54 @@
+"""threadlint fixture: OP603 blocking call under a lock — positive/negative."""
+import queue
+import threading
+import time
+
+
+class BlockingUnderLock:
+    """POSITIVE: queue get, long sleep, and join all run inside the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pass
+
+    def take(self):
+        with self._lock:
+            return self._q.get()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(1.0)
+
+    def reap(self):
+        with self._lock:
+            self._worker.join()
+
+
+class BlockingOutsideLock:
+    """NEGATIVE: the same calls, outside any critical section (plus a
+    sub-threshold sleep and a Condition.wait on the held lock)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.Queue()
+        self.ready = False
+
+    def take(self):
+        item = self._q.get()
+        with self._lock:
+            self.ready = True
+        return item
+
+    def pause(self):
+        with self._lock:
+            time.sleep(0.01)          # < 50 ms floor: not blocking
+
+    def await_ready(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(0.1)  # releases the held lock: exempt

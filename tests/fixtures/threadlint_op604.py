@@ -1,0 +1,36 @@
+"""threadlint fixture: OP604 thread-lifecycle hygiene — positive/negative."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class LeakyThreads:
+    """POSITIVE: non-daemon thread with no join path; executor never shut
+    down."""
+
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+
+class TidyThreads:
+    """NEGATIVE: daemon worker, joined worker, and a with-block executor."""
+
+    def __init__(self):
+        self._bg = threading.Thread(target=self._run, daemon=True)
+        self._fg = threading.Thread(target=self._run)
+        self._bg.start()
+        self._fg.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._fg.join()
+
+    def burst(self, jobs):
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            return list(pool.map(str, jobs))

@@ -1,0 +1,25 @@
+"""threadlint fixture: OP605 unsynchronized module globals — pos/negative."""
+import threading
+
+_CACHE: dict = {}                 # POSITIVE: mutated below with no lock held
+_REGISTRY: dict = {}              # NEGATIVE: every mutation holds _REG_LOCK
+_REG_LOCK = threading.Lock()
+
+
+def remember(key, value):
+    _CACHE[key] = value
+
+
+def forget(key):
+    _CACHE.pop(key, None)
+
+
+def register(name, obj):
+    with _REG_LOCK:
+        _REGISTRY[name] = obj
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t

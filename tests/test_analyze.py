@@ -52,7 +52,8 @@ class TestCatalog:
         assert {"OP001", "OP101", "OP102", "OP103", "OP104", "OP201", "OP202",
                 "OP203", "OP301", "OP302", "OP401", "OP402", "OP403",
                 "OP404", "OP405", "OP406", "OP501", "OP502", "OP503",
-                "OP504", "OP505"} \
+                "OP504", "OP505", "OP601", "OP602", "OP603", "OP604",
+                "OP605"} \
             == set(RULES)
         for r in RULES.values():
             assert r.title and r.rationale and r.severity in ("error", "warn", "info")

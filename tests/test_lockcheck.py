@@ -1,0 +1,230 @@
+"""Runtime lock-order validator (resilience/lockcheck.py).
+
+The TT_LOCK_CHECK contract: disarmed locks are plain threading primitives
+with zero bookkeeping; armed locks validate every acquisition against the
+seeded + observed order table, raising (test mode) or dumping the flight
+recorder (production mode) on an ABBA inversion.
+"""
+import threading
+
+import pytest
+
+from transmogrifai_tpu.resilience import lockcheck as lc
+
+
+@pytest.fixture(autouse=True)
+def _clean_tables(monkeypatch):
+    monkeypatch.delenv("TT_LOCK_CHECK", raising=False)
+    lc.reset_lockcheck()
+    yield
+    lc.reset_lockcheck()
+
+
+def _arm(monkeypatch, mode="1"):
+    monkeypatch.setenv("TT_LOCK_CHECK", mode)
+
+
+# --- disarmed ---------------------------------------------------------------
+
+def test_disarmed_returns_plain_primitives_and_records_nothing():
+    lk = lc.make_lock("T.a")
+    assert type(lk) is type(threading.Lock())
+    rl = lc.make_rlock("T.r")
+    assert type(rl) is type(threading.RLock())
+    cond = lc.make_condition("T.c")
+    assert isinstance(cond, threading.Condition)
+    with lk:
+        with rl:
+            pass
+    st = lc.lockcheck_state()
+    assert st["armed"] is None
+    assert st["acquisitions"] == 0
+    assert not st["order_edges"] and not st["violations"]
+
+
+# --- armed: detection -------------------------------------------------------
+
+def test_inversion_raises_and_attributes_both_sites(monkeypatch):
+    _arm(monkeypatch)
+    a, b = lc.make_lock("T.a"), lc.make_lock("T.b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lc.LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    msg = str(ei.value)
+    # both acquisition sites, by file:line, in one message
+    assert msg.count("test_lockcheck.py:") == 2
+    assert "`T.a`" in msg and "`T.b`" in msg
+    assert len(lc.lockcheck_state()["violations"]) == 1
+
+
+def test_clean_nesting_is_silent(monkeypatch):
+    _arm(monkeypatch)
+    a, b, c = (lc.make_lock(f"T.{n}") for n in "abc")
+    for _ in range(3):
+        with a:
+            with b:
+                with c:
+                    pass
+    st = lc.lockcheck_state()
+    assert not st["violations"]
+    assert st["acquisitions"] == 9
+    assert set(st["order_edges"]) == {"T.a -> T.b", "T.a -> T.c",
+                                      "T.b -> T.c"}
+
+
+def test_inversion_detected_across_threads(monkeypatch):
+    """The order table is global: thread 1 establishes a->b, thread 2's
+    b->a trips — the actual deadlock geometry."""
+    _arm(monkeypatch)
+    a, b = lc.make_lock("T.a"), lc.make_lock("T.b")
+    with a:
+        with b:
+            pass
+    caught = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except lc.LockOrderError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    assert len(caught) == 1
+
+
+# --- armed: exemptions ------------------------------------------------------
+
+def test_same_name_locks_exempt(monkeypatch):
+    _arm(monkeypatch)
+    l1, l2 = lc.make_lock("Conn.send"), lc.make_lock("Conn.send")
+    with l1:
+        with l2:
+            pass
+    with l2:
+        with l1:
+            pass
+    assert not lc.lockcheck_state()["violations"]
+
+
+def test_rlock_reentrancy_not_an_order_fact(monkeypatch):
+    _arm(monkeypatch)
+    r = lc.make_rlock("T.r")
+    with r:
+        with r:
+            with r:
+                pass
+    st = lc.lockcheck_state()
+    assert not st["order_edges"] and not st["violations"]
+
+
+def test_condition_wait_releases_in_held_stack(monkeypatch):
+    """A waiter really releases: another lock acquired by the woken thread
+    inside the cond must not order against locks the waiter no longer
+    holds. Regression shape: waiter holds cond, waits (released), notifier
+    takes other->cond — with the stale stack entry that would be a
+    violation."""
+    _arm(monkeypatch)
+    cond = lc.make_condition("T.cond")
+    other = lc.make_lock("T.other")
+    ready = []
+    woke = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait_for(lambda: ready, timeout=5)
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with other:          # other -> cond: legal only because waiter released
+        with cond:
+            ready.append(1)
+            cond.notify_all()
+    assert woke.wait(5)
+    t.join()
+    assert not lc.lockcheck_state()["violations"]
+
+
+# --- armed: seeding and production mode -------------------------------------
+
+def test_seeded_static_order_trips_first_runtime_acquisition(monkeypatch):
+    _arm(monkeypatch)
+    n = lc.seed_static_order([("T.a", "T.b", "static:daemon.py:191")])
+    assert n == 1
+    a, b = lc.make_lock("T.a"), lc.make_lock("T.b")
+    with pytest.raises(lc.LockOrderError) as ei:
+        with b:
+            with a:      # the DAG says a before b: first violation trips
+                pass
+    assert "static:daemon.py:191" in str(ei.value)
+
+
+def test_dump_mode_records_without_raising(monkeypatch):
+    _arm(monkeypatch, mode="dump")
+    a, b = lc.make_lock("T.a"), lc.make_lock("T.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:          # no raise: production keeps serving
+            pass
+    st = lc.lockcheck_state()
+    assert len(st["violations"]) == 1
+    assert st["violations"][0]["held"] == "T.b"
+    assert st["violations"][0]["acquiring"] == "T.a"
+    from transmogrifai_tpu import obs
+
+    snap = obs.default_registry().snapshot()
+    assert "lock_order_violations_total" in snap
+
+
+def test_reset_clears_everything(monkeypatch):
+    _arm(monkeypatch)
+    a, b = lc.make_lock("T.a"), lc.make_lock("T.b")
+    with a:
+        with b:
+            pass
+    assert lc.lockcheck_state()["order_edges"]
+    lc.reset_lockcheck()
+    st = lc.lockcheck_state()
+    assert st["acquisitions"] == 0
+    assert not st["order_edges"] and not st["violations"]
+
+
+# --- armed subsystems end-to-end --------------------------------------------
+
+def test_closable_queue_runs_checked(monkeypatch):
+    _arm(monkeypatch)
+    from transmogrifai_tpu.readers.pipeline import ClosableQueue
+
+    q = ClosableQueue(maxsize=4)
+    out = []
+
+    def consumer():
+        while True:
+            try:
+                out.append(q.get())
+            except Exception:
+                return
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    for i in range(16):
+        q.put(i)
+    q.close()
+    t.join(5)
+    assert out == list(range(16))
+    st = lc.lockcheck_state()
+    assert st["acquisitions"] > 0
+    assert not st["violations"]

@@ -1,0 +1,188 @@
+"""`op threadlint` (OP6xx) — the static concurrency analyzer.
+
+Each rule is pinned against a positive AND a negative fixture module under
+tests/fixtures/threadlint_*.py, plus the package-wide gate: the codebase
+itself must scan clean (zero unsuppressed findings) — the same invariant
+tools/ci_check.sh enforces.
+"""
+import json
+import os
+
+import pytest
+
+from transmogrifai_tpu.analyze.threadlint import (
+    collect_lock_order,
+    load_baseline,
+    run_threadlint,
+    rules_catalog,
+)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _scan(name):
+    return run_threadlint([os.path.join(FIXDIR, name)])
+
+
+def _by_code(report, code):
+    return [d for d in report.diagnostics if d.code == code]
+
+
+# --- OP601: guarded-field escape -------------------------------------------
+
+def test_op601_positive_and_negative():
+    rep = _scan("threadlint_op601.py")
+    findings = _by_code(rep, "OP601")
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "LeakyCounter._n" in msg and "peek" in msg
+    assert "CleanCounter" not in " ".join(d.message for d in rep.diagnostics)
+
+
+def test_op601_pragma_suppresses_and_is_counted():
+    rep = _scan("threadlint_op601.py")
+    # BlessedCounter's bare read is pragma'd: no diagnostic, but counted
+    assert all("BlessedCounter" not in d.message for d in rep.diagnostics)
+    assert rep.suppressed >= 1
+
+
+# --- OP602: lock-order inversion -------------------------------------------
+
+def test_op602_direct_and_interprocedural_cycles():
+    rep = _scan("threadlint_op602.py")
+    findings = _by_code(rep, "OP602")
+    msgs = " ".join(d.message for d in findings)
+    assert len(findings) == 2
+    assert "Inverted._a" in msgs and "Inverted._b" in msgs
+    # the helper cycle only exists across the call graph
+    assert "HelperInverted._front" in msgs and "HelperInverted._back" in msgs
+    assert "Ordered" not in msgs
+
+
+def test_op602_reports_both_sites():
+    rep = _scan("threadlint_op602.py")
+    f = [d for d in _by_code(rep, "OP602") if "Inverted._a" in d.message
+         and "Helper" not in d.message][0]
+    # one site in the anchor, the reverse edge's site in the message
+    assert "reverse edge at" in f.message
+    assert "threadlint_op602.py" in f.message
+
+
+def test_op602_edges_exported():
+    rep = _scan("threadlint_op602.py")
+    pairs = set(rep.edges)
+    assert ("Ordered._a", "Ordered._b") in pairs
+    assert json.dumps(rep.to_json())  # serializable, includes the edge list
+    assert "lock_order_edges" in rep.to_json()
+
+
+# --- OP603: blocking call under a lock -------------------------------------
+
+def test_op603_positive_sites():
+    rep = _scan("threadlint_op603.py")
+    calls = {d.message.split("blocking `")[1].split("`")[0]
+             for d in _by_code(rep, "OP603")}
+    assert calls == {"self._q.get", "time.sleep", "self._worker.join"}
+
+
+def test_op603_exemptions():
+    rep = _scan("threadlint_op603.py")
+    msgs = " ".join(d.message for d in _by_code(rep, "OP603"))
+    # sub-50ms sleep, Condition.wait on the held lock, and get() outside
+    # the critical section are all fine
+    assert "BlockingOutsideLock" not in msgs
+
+
+# --- OP604: thread-lifecycle hygiene ---------------------------------------
+
+def test_op604_leaks_flagged_tidy_quiet():
+    rep = _scan("threadlint_op604.py")
+    msgs = [d.message for d in _by_code(rep, "OP604")]
+    assert len(msgs) == 2
+    assert any("_t" in m and "join" in m for m in msgs)
+    assert any("_pool" in m and "shut" in m for m in msgs)
+    assert all("TidyThreads" not in m for m in msgs)
+
+
+def test_op604_is_warn_severity():
+    rep = _scan("threadlint_op604.py")
+    assert all(d.severity == "warn" for d in _by_code(rep, "OP604"))
+    assert not rep.has_errors
+
+
+# --- OP605: unsynchronized module globals ----------------------------------
+
+def test_op605_unlocked_global_flagged_locked_quiet():
+    rep = _scan("threadlint_op605.py")
+    msgs = [d.message for d in _by_code(rep, "OP605")]
+    assert any("_CACHE" in m for m in msgs)
+    assert all("_REGISTRY" not in m for m in msgs)
+
+
+# --- machinery --------------------------------------------------------------
+
+def test_rules_catalog_covers_all_op6xx():
+    cat = rules_catalog()
+    assert [r.code for r in cat] == ["OP601", "OP602", "OP603", "OP604",
+                                     "OP605"]
+    assert all(r.severity in ("error", "warn") for r in cat)
+
+
+def test_baseline_suppresses_known_findings(tmp_path):
+    rep = _scan("threadlint_op601.py")
+    key = [d for d in rep.diagnostics if d.code == "OP601"][0]
+    # keys are stable: re-running with the finding baselined hides it
+    keys = [f.key for f in rep.findings if not f.suppressed]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"ignore": keys}))
+    rep2 = run_threadlint([os.path.join(FIXDIR, "threadlint_op601.py")],
+                          baseline=load_baseline(str(bl)))
+    assert not _by_code(rep2, "OP601")
+    assert rep2.suppressed > rep.suppressed
+    assert key  # silence unused warning
+
+
+def test_package_scans_clean():
+    """The gate: the codebase has zero unsuppressed OP6xx findings."""
+    rep = run_threadlint()
+    bad = [d for d in rep.diagnostics]
+    assert not bad, "\n".join(d.message for d in bad)
+    assert rep.n_files > 100
+
+
+def test_collect_lock_order_names_static_identities():
+    edges = collect_lock_order()
+    assert ("ServingDaemon._admit_lock", "ServingDaemon._lock") in edges
+    for a, b in edges:
+        assert "." in a and "." in b
+
+
+def test_cli_threadlint_json(capsys):
+    from transmogrifai_tpu.cli.main import main
+
+    rc = main(["threadlint", os.path.join(FIXDIR, "threadlint_op604.py"),
+               "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0  # warnings don't fail the gate
+    assert out["counts"]["warn"] == 2
+
+
+def test_cli_threadlint_exits_nonzero_on_errors(capsys):
+    from transmogrifai_tpu.cli.main import main
+
+    rc = main(["threadlint", os.path.join(FIXDIR, "threadlint_op602.py")])
+    assert rc == 1
+    assert "OP602" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("fixture", [
+    "threadlint_op601.py", "threadlint_op602.py", "threadlint_op603.py",
+    "threadlint_op604.py", "threadlint_op605.py",
+])
+def test_fixtures_importable(fixture):
+    """The fixture modules are real python (the analyzer parsed what the
+    interpreter would run)."""
+    import ast
+
+    with open(os.path.join(FIXDIR, fixture)) as fh:
+        ast.parse(fh.read())

@@ -47,6 +47,12 @@ _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",
                   "tflops", "flops", "efficiency", "retention")
+#: ABSOLUTE floor for every *_throughput_retention lane, checked on the NEW
+#: record alone (the relative diff can't catch a slow multi-PR slide, and a
+#: brand-new retention lane has no old value to diff against): instrumented
+#: paths — monitor, resilience, fleet_obs, lock_check — must keep >= 97% of
+#: bare throughput
+_RETENTION_FLOOR = 0.97
 
 
 def lower_is_better(name: str) -> bool:
@@ -147,11 +153,18 @@ def main(argv=None) -> int:
         print(f"{r['metric']:<{width}}  {r['old']:>12.4g}  ->  "
               f"{r['new']:>12.4g}  {ratio:>8}  ({r['direction']} is better)"
               f"  {flag}")
+    floored = [(k, v) for k, v in sorted(new.items())
+               if k.endswith("_throughput_retention") and v < _RETENTION_FLOOR]
+    for k, v in floored:
+        print(f"bench_diff: {k} = {v:.4f} is below the absolute "
+              f"{_RETENTION_FLOOR} retention floor", file=sys.stderr)
     bad = [r for r in rows if r["regressed"]]
-    if bad:
-        print(f"\nbench_diff: {len(bad)} metric(s) regressed more than "
-              f"{args.threshold:.0%}: "
-              + ", ".join(r["metric"] for r in bad), file=sys.stderr)
+    if bad or floored:
+        names = [r["metric"] for r in bad]
+        names += [k for k, _ in floored if k not in names]
+        print(f"\nbench_diff: {len(names)} metric(s) regressed more than "
+              f"{args.threshold:.0%} or broke an absolute floor: "
+              + ", ".join(names), file=sys.stderr)
         return 1
     print(f"\nbench_diff: ok ({len(rows)} shared metrics within "
           f"{args.threshold:.0%})")

@@ -30,6 +30,13 @@ echo "== lock-discipline lint (report-only) =="
 python tools/lint_lite.py --locks \
     || echo "(lock-discipline findings above are report-only)"
 
+echo "== op threadlint (OP6xx static concurrency) =="
+# the full analyzer: guarded-field escapes, lock-order inversions across the
+# inter-procedural acquisition graph, blocking calls under locks, lifecycle
+# hygiene, unsynced module globals. GATING: any unsuppressed error-severity
+# finding fails CI (deliberate exceptions carry in-source pragmas).
+python -m transmogrifai_tpu.cli.main threadlint
+
 echo "== op explain: example apps (static resource model) =="
 # per-stage HBM/collective/padding prediction at a forced 8x1 mesh — pure
 # host arithmetic, still data-free. Exits nonzero on OP5xx errors at the
@@ -160,7 +167,7 @@ echo "== chaos smoke (resilience) =="
 # model is a fast single-LR workflow over examples.titanic's schema: the
 # full CV selector is minutes of compile on cold CI, and the fault layer
 # under test is identical either way.)
-python - <<'PY'
+TT_LOCK_CHECK=1 python - <<'PY'
 import csv, os, random, tempfile
 
 from examples.titanic import FIELDS, SCHEMA
@@ -237,7 +244,7 @@ echo "== disaggregated ingest worker-kill smoke =="
 # deterministic replay, dedupe by ordinal) and exactly one lease
 # reassignment must be recorded (docs/robustness.md "Distributed ingest
 # failure model").
-python - <<'PY'
+TT_LOCK_CHECK=1 python - <<'PY'
 import csv, hashlib, os, random, tempfile
 
 import numpy as np
@@ -323,7 +330,7 @@ echo "== multi-tenant ingest coordinator-kill smoke =="
 # re-adopt, both consumers ride the crash through reconnect + dedupe
 # cursor, and both must match the fault-free baseline digests
 # (docs/robustness.md "Multi-tenant ingest failure model").
-python - <<'PY'
+TT_LOCK_CHECK=1 python - <<'PY'
 import csv, hashlib, os, random, re, signal, subprocess, sys, tempfile
 import threading, time
 
@@ -545,7 +552,7 @@ echo "== serving daemon smoke (op serve over HTTP) =="
 # port, parsed off the ready line), score over HTTP, check /healthz and the
 # /metrics exposition, then SIGTERM and assert a CLEAN shutdown (exit 0) —
 # the daemon must drain, not die (docs/serving.md lifecycle contract)
-python - <<'PY'
+TT_LOCK_CHECK=1 python - <<'PY'
 import json, os, re, signal, subprocess, sys, tempfile, urllib.request
 
 import numpy as np
@@ -611,7 +618,7 @@ echo "== autopilot smoke (closed-loop drift -> retrain -> hot swap) =="
 # warm-started retrain through the aggregate reader, the gate promotes the
 # challenger, and the alias hot-swaps with ZERO request errors; promotion
 # resolves the demoted champion's episode (drift:cleared lands).
-python - <<'PY'
+TT_LOCK_CHECK=1 python - <<'PY'
 from transmogrifai_tpu import obs
 from transmogrifai_tpu.obs.monitor import DriftThresholds
 from transmogrifai_tpu.serve import (

@@ -26,6 +26,11 @@ from .diagnostics import (
     SEVERITIES,
 )
 from .rules import PASSES, RULES, PlanContext, check_dag_uniqueness
+from .threadlint import (
+    ThreadlintReport,
+    collect_lock_order,
+    run_threadlint,
+)
 from .shard_model import (
     ResourceModel,
     StageResource,
@@ -37,7 +42,8 @@ from .shard_model import (
 __all__ = [
     "AnalysisReport", "Diagnostic", "PASSES", "PlanAnalysisError",
     "PlanContext", "RULES", "ResourceModel", "RuleInfo", "SEVERITIES",
-    "StageResource", "analyze_model", "analyze_plan",
-    "build_resource_model", "check_dag_uniqueness", "explain_mesh_shape",
-    "plan_fingerprint", "top_predictions",
+    "StageResource", "ThreadlintReport", "analyze_model", "analyze_plan",
+    "build_resource_model", "check_dag_uniqueness", "collect_lock_order",
+    "explain_mesh_shape", "plan_fingerprint", "run_threadlint",
+    "top_predictions",
 ]

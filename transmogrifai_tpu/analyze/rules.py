@@ -131,6 +131,35 @@ OP406 = _rule("OP406", "data-axis mesh attached but GBT fused split falls "
               "program (psum'd partial stats, ops/trees.py) — the fit "
               "silently runs the replicated single-device row path and the "
               "data axis buys nothing")
+# OP6xx: the threadlint family (analyze/threadlint.py) — a SOURCE-level
+# concurrency pass over the package itself, not a plan pass. Registered here
+# so `op lint --rules`, `op threadlint --rules`, and docs render one catalog.
+OP601 = _rule("OP601", "guarded field escapes its lock", "error",
+              "an attribute is written under `with self._lock` in one method "
+              "but read or written bare in another method of the same class "
+              "— a torn read/lost update waiting for the right interleaving; "
+              "hold the lock at every access or pragma the deliberate "
+              "lock-free access with a justification")
+OP602 = _rule("OP602", "lock-order inversion", "error",
+              "two locks are acquired in opposite orders on different code "
+              "paths (a cycle in the inter-procedural lock-acquisition "
+              "graph) — the classic ABBA deadlock; pick one global order and "
+              "restructure the offending path")
+OP603 = _rule("OP603", "blocking call while holding a lock", "error",
+              "a queue get/put, socket recv/accept, Future.result, "
+              "Thread.join, subprocess wait, or long sleep runs with a lock "
+              "held — every other thread needing that lock stalls behind "
+              "I/O; move the blocking call outside the critical section")
+OP604 = _rule("OP604", "thread-lifecycle hygiene", "warn",
+              "a non-daemon Thread with no join path outlives its owner (a "
+              "hung interpreter at exit), or an Executor is created without "
+              "shutdown/with-block — leaked workers survive the object that "
+              "spawned them")
+OP605 = _rule("OP605", "unsynchronized module-level mutable state", "warn",
+              "a module-global dict/list/set is mutated from function bodies "
+              "in a threading-aware module without a module-level lock held "
+              "— cross-thread mutation of shared state with no "
+              "happens-before edge")
 
 
 def make_diag(code: str, message: str, **kw) -> Diagnostic:

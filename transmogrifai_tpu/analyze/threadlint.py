@@ -1,0 +1,858 @@
+"""threadlint — OP6xx static concurrency pass over the package source.
+
+The oplint family (OP1xx-OP5xx) checks feature-DAG *plans*; this module turns
+the same pre-execution discipline on the package's own threading code. It
+parses every source file with `ast` — zero imports, zero execution — and
+emits Diagnostics through the same machinery (`Diagnostic`, the `RULES`
+catalog, severity gating):
+
+  OP601  guarded-field escape: an attribute written under ``with self._lock``
+         in one method but read/written bare in another method of the class
+  OP602  lock-order inversion: a cycle in the inter-procedural
+         lock-acquisition graph (the ABBA deadlock, found before it hangs)
+  OP603  blocking call while holding a lock (queue get/put, socket recv,
+         Future.result, Thread.join, subprocess wait, long sleep)
+  OP604  thread-lifecycle hygiene: non-daemon threads with no join path,
+         executors never shut down
+  OP605  module-level mutable state mutated from function bodies in a
+         threading-aware module with no module lock held
+
+Deliberate exceptions are annotated inline::
+
+    self.dispatches += 1  # threadlint: ok OP601 - GIL-atomic int bump
+
+A pragma on the flagged line (or the line above) suppresses that code there;
+for OP601 a pragma on the ``__init__`` line that first assigns the attribute
+suppresses the whole field. ``# lint: lockfree`` (the tools/lint_lite.py
+L001 marker) is honoured as an OP601 suppressor so the two layers share one
+annotation. `--baseline FILE` ignores a recorded set of finding keys.
+
+The acquisition graph doubles as the seed for the runtime validator
+(resilience/lockcheck.py): lock identities are ``ClassName.attr`` /
+``module.NAME`` strings, the same names `make_lock` registers, so
+`collect_lock_order()` hands the runtime checker the statically proposed
+order and the chaos suites validate it under real interleavings.
+
+Surface: ``op threadlint [--json] [--rules] [--baseline FILE] [paths...]``.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .diagnostics import AnalysisReport
+from .rules import RULES, make_diag
+
+__all__ = [
+    "ThreadlintReport", "collect_lock_order", "iter_sources",
+    "load_baseline", "run_threadlint",
+]
+
+_PRAGMA_RE = re.compile(r"#\s*threadlint:\s*ok\s+((?:OP\d{3})(?:\s*,\s*OP\d{3})*|all)")
+_LOCKFREE_RE = re.compile(r"#\s*lint:\s*lockfree\b")
+
+#: constructors whose result is a lock-like guard (threading.* or the
+#: resilience.lockcheck wrappers)
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+               "make_lock", "make_rlock", "make_condition"}
+#: attribute names that read as a guard even when the constructor is not
+#: visible (mirrors tools/lint_lite.py `_is_lock_ctx`)
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|mutex|cond|not_empty|not_full)", re.I)
+#: container methods that mutate the receiver (attr access counts as a write)
+_MUTATORS = {"append", "appendleft", "add", "update", "pop", "popleft",
+             "setdefault", "clear", "extend", "remove", "discard", "insert",
+             "rotate", "sort"}
+#: receiver-agnostic blocking attribute calls
+_BLOCKING_ATTRS = {"result", "recv", "recv_into", "recvfrom", "accept",
+                   "communicate", "getline"}
+#: receiver-name fragments marking a queue (so dict.get stays exempt)
+_QUEUEISH = ("queue", "_q", "inbox", "outbox")
+#: receiver-name fragments marking a joinable thread/process
+_THREADISH = ("thread", "worker", "poller", "prefetch", "writer", "reader",
+              "consumer", "producer", "proc", "server")
+#: time.sleep shorter than this (constant arg) is a spin backoff, not a block
+_SLEEP_FLOOR_S = 0.05
+
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                  "OrderedDict", "Counter"}
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'threading.Lock' for Attribute chains, 'Lock' for Name, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func) or ""
+    return name.split(".")[-1] in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' when node is `self.X`, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _recv_name(node: ast.AST) -> Optional[str]:
+    """Best-effort short name of a call receiver (`self._q` -> '_q')."""
+    attr = _self_attr(node)
+    if attr is not None:
+        return attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-file model
+
+@dataclass
+class _ClassInfo:
+    name: str
+    node: ast.ClassDef
+    locks: set = field(default_factory=set)          # lock attr names
+    cond_alias: dict = field(default_factory=dict)   # cond attr -> lock attr
+    methods: dict = field(default_factory=dict)      # name -> FunctionDef
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    held: frozenset
+    method: str
+    line: int
+
+
+@dataclass
+class _ThreadRec:
+    key: tuple
+    line: int
+    daemon: bool = False
+    joined: bool = False
+    kind: str = "thread"      # thread | executor
+
+
+class _FnWalker(ast.NodeVisitor):
+    """One traversal of a function body with a running held-lock set.
+
+    Collects attribute accesses, lock acquisitions (edges), blocking calls
+    under locks, intra-class call sites, and thread/executor lifecycle events.
+    Nested functions are queued and walked separately with an EMPTY held set:
+    a closure handed to `Thread(target=...)` runs later, on another thread,
+    whatever was held at definition time.
+    """
+
+    def __init__(self, mod: "_Module", cls: Optional[_ClassInfo],
+                 method: str, entry_held: frozenset):
+        self.mod = mod
+        self.cls = cls
+        self.method = method
+        self.held: list = sorted(entry_held)
+        self.nested: list = []
+
+    # -- held-set helpers ---------------------------------------------------
+    def _lock_ids(self, expr: ast.AST) -> list:
+        """Lock identities acquired by `with expr:` (or .acquire())."""
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            known = attr in self.cls.locks or attr in self.cls.cond_alias
+            if known or _LOCKISH_NAME.search(attr):
+                self.cls.locks.add(attr) if not known else None
+                ids = [f"{self.cls.name}.{attr}"]
+                base = self.cls.cond_alias.get(attr)
+                if base:
+                    ids.append(f"{self.cls.name}.{base}")
+                return ids
+        if isinstance(expr, ast.Name) and expr.id in self.mod.locks:
+            return [f"{self.mod.name}.{expr.id}"]
+        return []
+
+    def _acquire(self, ids: list, line: int) -> None:
+        # one with-item's ids are ONE acquisition (a Condition and its
+        # underlying lock) — edges only run from what was already held
+        prior = list(self.held)
+        for lid in ids:
+            for h in prior:
+                if h != lid:
+                    self.mod.edges.setdefault(
+                        (h, lid), (self.mod.rel, line, self.method))
+            self.mod.acquired.setdefault((self._scope(), self.method),
+                                         set()).add(lid)
+            self.held.append(lid)
+
+    def _scope(self) -> str:
+        return self.cls.name if self.cls else ""
+
+    def _heldset(self) -> frozenset:
+        return frozenset(self.held)
+
+    # -- statements ---------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            ids = self._lock_ids(item.context_expr)
+            if ids:
+                self._acquire(ids, node.lineno)
+                acquired.extend(ids)
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lid in acquired:
+            if lid in self.held:
+                self.held.remove(lid)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.nested.append((node, f"{self.method}.{node.name}"))
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass  # runs later; held set unknowable and accesses are tiny
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._store_target(tgt, node)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._store_target(node.target, node)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._store_target(node.target, node)
+        attr = _self_attr(node.target)
+        if attr is not None:  # += reads then writes
+            self._access(attr, write=True, line=node.lineno)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._store_target(tgt, node)
+        self.generic_visit(node)
+
+    def _store_target(self, tgt: ast.AST, stmt: ast.stmt) -> None:
+        attr = _self_attr(tgt)
+        if attr is not None:
+            self._access(attr, write=True, line=stmt.lineno)
+            # thread/executor assigned to an attribute
+            if isinstance(stmt, ast.Assign):
+                self._record_lifecycle(stmt.value, ("attr", self._scope(),
+                                                    attr), stmt.lineno)
+            return
+        if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            base = tgt.value
+            battr = _self_attr(base)
+            if battr is not None:
+                self._access(battr, write=True, line=stmt.lineno)
+                if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"):
+                    self.mod.mark_daemon(("attr", self._scope(), battr))
+            elif isinstance(base, ast.Name):
+                if isinstance(tgt, ast.Subscript):
+                    self.mod.global_mut(base.id, self._heldset(), stmt.lineno)
+                elif tgt.attr == "daemon":
+                    self.mod.mark_daemon(
+                        ("local", f"{self._scope()}.{self.method}", base.id))
+            if isinstance(tgt, ast.Subscript):
+                self.visit(tgt.slice)
+            self.visit(base)
+            return
+        if isinstance(tgt, ast.Name) and isinstance(stmt, ast.Assign):
+            self._record_lifecycle(
+                stmt.value, ("local", f"{self._scope()}.{self.method}",
+                             tgt.id), stmt.lineno)
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._store_target(el, stmt)
+
+    def _record_lifecycle(self, value: ast.AST, key: tuple,
+                          line: int) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        name = (_dotted(value.func) or "").split(".")[-1]
+        if name == "Thread":
+            daemon = any(kw.arg == "daemon"
+                         and isinstance(kw.value, ast.Constant)
+                         and kw.value.value for kw in value.keywords)
+            self.mod.threads[key] = _ThreadRec(key, line, daemon=daemon)
+        elif name.endswith("Executor"):
+            self.mod.threads[key] = _ThreadRec(key, line, kind="executor")
+
+    # -- expressions --------------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._access(attr, write=False, line=node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv, meth = fn.value, fn.attr
+            rattr = _self_attr(recv)
+            # container mutation through a method: a WRITE to the attr
+            if rattr is not None and meth in _MUTATORS:
+                self._access(rattr, write=True, line=node.lineno)
+            if isinstance(recv, ast.Name) and meth in _MUTATORS:
+                self.mod.global_mut(recv.id, self._heldset(), node.lineno)
+            # manual acquire/release on a lock-like receiver
+            ids = self._lock_ids(recv) if meth in ("acquire",
+                                                   "release") else []
+            if ids and meth == "acquire":
+                self._acquire(ids, node.lineno)
+            elif ids and meth == "release":
+                for lid in ids:
+                    if lid in self.held:
+                        self.held.remove(lid)
+            # intra-class call: self._helper(...) — record the held set for
+            # the entry-held fixpoint, and propagate the callee's (previous
+            # round) acquisitions as inter-procedural order edges
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and self.cls is not None and meth in self.cls.methods:
+                self.mod.call_sites.setdefault(
+                    (self.cls.name, meth), []).append(self._heldset())
+                callee_acq = self.mod.acquired_prev.get(
+                    (self._scope(), meth), ())
+                me = (self._scope(), self.method)
+                for lid in callee_acq:
+                    self.mod.acquired.setdefault(me, set()).add(lid)
+                    for h in self.held:
+                        if h != lid:
+                            self.mod.edges.setdefault(
+                                (h, lid),
+                                (self.mod.rel, node.lineno, self.method))
+            # with-less executor hygiene / joins
+            key_candidates = [("attr", self._scope(), rattr)] \
+                if rattr is not None else []
+            if isinstance(recv, ast.Name):
+                key_candidates.append(
+                    ("local", f"{self._scope()}.{self.method}", recv.id))
+            if meth in ("join", "shutdown"):
+                for key in key_candidates:
+                    self.mod.mark_joined(key)
+            if self.held:
+                self._check_blocking(node, recv, meth)
+        else:
+            name = _dotted(fn) or ""
+            if self.held and name in ("subprocess.run", "subprocess.call",
+                                      "subprocess.check_call",
+                                      "subprocess.check_output"):
+                self._blocking(name, node.lineno)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call, recv: ast.AST,
+                        meth: str) -> None:
+        rname = (_recv_name(recv) or "").lower()
+        dotted = _dotted(node.func) or meth
+        if meth in _BLOCKING_ATTRS:
+            self._blocking(dotted, node.lineno)
+        elif meth in ("get", "put") and any(q in rname for q in _QUEUEISH):
+            self._blocking(dotted, node.lineno)
+        elif meth == "join" and (any(t in rname for t in _THREADISH)
+                                 or self._is_known_thread(recv)):
+            self._blocking(dotted, node.lineno)
+        elif meth in ("wait", "wait_for"):
+            # Condition.wait on a HELD lock releases it while waiting — the
+            # one blocking call that is correct (indeed required) under lock
+            if not set(self._lock_ids(recv)) & set(self.held):
+                self._blocking(dotted, node.lineno)
+        elif meth == "sleep":
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, (int, float))
+                    and arg.value < _SLEEP_FLOOR_S):
+                self._blocking(dotted, node.lineno)
+
+    def _is_known_thread(self, recv: ast.AST) -> bool:
+        for key in (("attr", self._scope(), _self_attr(recv)),
+                    ("local", f"{self._scope()}.{self.method}",
+                     recv.id if isinstance(recv, ast.Name) else None)):
+            rec = self.mod.threads.get(key)
+            if rec is not None and rec.kind == "thread":
+                return True
+        return False
+
+    def _blocking(self, call: str, line: int) -> None:
+        self.mod.blocking.append(
+            (self.mod.rel, self._scope(), self.method, call,
+             tuple(sorted(self.held)), line))
+
+    def _access(self, attr: str, write: bool, line: int) -> None:
+        if self.cls is None or attr in self.cls.locks \
+                or attr in self.cls.cond_alias:
+            return
+        self.mod.accesses.setdefault((self.cls.name, attr), []).append(
+            _Access(attr, write, self._heldset(), self.method, line))
+
+
+@dataclass
+class _Module:
+    """Everything one traversal round collects for a single source file."""
+
+    rel: str
+    name: str                                  # module basename (no .py)
+    locks: set = field(default_factory=set)    # module-global lock names
+    mutables: dict = field(default_factory=dict)   # global -> def line
+    uses_threading: bool = False
+    accesses: dict = field(default_factory=dict)   # (cls, attr) -> [_Access]
+    edges: dict = field(default_factory=dict)      # (a, b) -> (rel, ln, meth)
+    acquired: dict = field(default_factory=dict)   # (cls, meth) -> {lock ids}
+    acquired_prev: dict = field(default_factory=dict)  # previous round's
+    call_sites: dict = field(default_factory=dict)  # (cls, meth) -> [heldset]
+    blocking: list = field(default_factory=list)
+    threads: dict = field(default_factory=dict)    # key -> _ThreadRec
+    global_muts: list = field(default_factory=list)  # (name, held, line)
+
+    def mark_daemon(self, key: tuple) -> None:
+        rec = self.threads.get(key)
+        if rec is not None:
+            rec.daemon = True
+
+    def mark_joined(self, key: tuple) -> None:
+        rec = self.threads.get(key)
+        if rec is not None:
+            rec.joined = True
+
+    def global_mut(self, name: str, held: frozenset, line: int) -> None:
+        if name in self.mutables:
+            self.global_muts.append((name, held, line))
+
+
+# ---------------------------------------------------------------------------
+# file-level analysis
+
+def _scan_class(node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node.name, node)
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    for meth in info.methods.values():
+        for stmt in ast.walk(meth):
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+            else:
+                continue
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is None or not _is_lock_ctor(stmt.value):
+                    continue
+                ctor = (_dotted(stmt.value.func) or "").split(".")[-1]
+                if ctor in ("Condition", "make_condition") \
+                        and stmt.value.args:
+                    base = _self_attr(stmt.value.args[0])
+                    if base:
+                        info.cond_alias[attr] = base
+                        continue
+                info.locks.add(attr)
+    return info
+
+
+def _entry_held(cls: _ClassInfo, meth: str, call_sites: dict) -> frozenset:
+    """Entry held-set: `*_locked` helpers run with every class lock held
+    (the repo-wide naming convention, shared with tools/lint_lite.py);
+    private helpers inherit the INTERSECTION of held sets over their
+    intra-class call sites (computed by the previous traversal round)."""
+    if meth.endswith("_locked"):
+        return frozenset(f"{cls.name}.{a}" for a in cls.locks)
+    if meth.startswith("_") and not meth.startswith("__"):
+        sites = call_sites.get((cls.name, meth))
+        if sites:
+            return frozenset.intersection(*sites)
+    return frozenset()
+
+
+#: methods whose bare reads are diagnostics/printing/pre-publication, not races
+_EXEMPT_METHODS = {"__init__", "__repr__", "__str__", "__del__",
+                   "__getstate__", "__setstate__"}
+
+
+def _walk_module(tree: ast.Module, rel: str, name: str,
+                 rounds: int = 3) -> _Module:
+    classes = [_scan_class(n) for n in tree.body
+               if isinstance(n, ast.ClassDef)]
+    mod = _Module(rel=rel, name=name)
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in stmt.names]
+            if "threading" in names or getattr(stmt, "module", "") in (
+                    "threading", "concurrent.futures"):
+                mod.uses_threading = True
+        gtarget = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            gtarget = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            gtarget = stmt.target.id
+        if gtarget is not None:
+            if _is_lock_ctor(stmt.value):
+                mod.locks.add(gtarget)
+            elif isinstance(stmt.value, (ast.Dict, ast.List, ast.Set)) \
+                    or (isinstance(stmt.value, ast.Call)
+                        and (_dotted(stmt.value.func) or "").split(".")[-1]
+                        in _MUTABLE_CTORS):
+                mod.mutables[gtarget] = stmt.lineno
+
+    top_fns = [(None, n, n.name) for n in tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    cls_fns = [(c, m, mname) for c in classes
+               for mname, m in c.methods.items()]
+
+    for _ in range(rounds):
+        prev_sites = mod.call_sites
+        mod.acquired_prev = mod.acquired
+        mod.accesses, mod.edges, mod.acquired = {}, {}, {}
+        mod.call_sites, mod.blocking = {}, []
+        mod.threads, mod.global_muts = {}, []
+        for cls, fn, fname in top_fns + cls_fns:
+            entry = (_entry_held(cls, fname, prev_sites)
+                     if cls is not None else frozenset())
+            queue = [(fn, fname, entry)]
+            while queue:
+                node, qual, held = queue.pop()
+                w = _FnWalker(mod, cls, qual, held)
+                for stmt in node.body:
+                    w.visit(stmt)
+                for sub, subqual in w.nested:
+                    queue.append((sub, subqual, frozenset()))
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+def _pragmas(src: str) -> dict:
+    """line -> set of suppressed codes ('*' = all via `all`).
+
+    A pragma inside a comment block also binds to the first CODE line after
+    the block, so multi-line justifications above the statement work::
+
+        # threadlint: ok OP603 - the enqueue must be atomic with the
+        # closed check (the close contract)
+        self._q.put(batch)
+    """
+    out: dict = {}
+    lines = src.splitlines()
+    for i, line in enumerate(lines, 1):
+        codes: set = set()
+        m = _PRAGMA_RE.search(line)
+        if m:
+            codes = ({"*"} if m.group(1) == "all"
+                     else {c.strip() for c in m.group(1).split(",")})
+        if _LOCKFREE_RE.search(line):
+            codes = codes | {"OP601"}
+        if not codes:
+            continue
+        out.setdefault(i, set()).update(codes)
+        j = i  # skip trailing comment-only lines, bind to the next code line
+        while j < len(lines) and lines[j].lstrip().startswith("#"):
+            j += 1
+        if j < len(lines):
+            out.setdefault(j + 1, set()).update(codes)
+    return out
+
+
+def _suppressed(pragmas: dict, line: int, code: str) -> bool:
+    for ln in (line, line - 1):
+        codes = pragmas.get(ln, ())
+        if code in codes or "*" in codes:
+            return True
+    return False
+
+
+@dataclass
+class _Finding:
+    code: str
+    key: str
+    message: str
+    loc: str            # rel:line
+    line: int
+    hint: str
+    suppressed: bool = False
+
+
+def _op601(mod: _Module, pragmas: dict) -> Iterable[_Finding]:
+    for (cls, attr), accs in sorted(mod.accesses.items()):
+        if attr.startswith("__"):
+            continue
+        locked_writes = [a for a in accs if a.write and a.held
+                         and a.method not in _EXEMPT_METHODS]
+        if not locked_writes:
+            continue
+        bare = [a for a in accs
+                if not a.held and a.method not in _EXEMPT_METHODS]
+        bare = [a for a in bare if not any(
+            lw.method == a.method for lw in locked_writes)]
+        if not bare:
+            continue
+        # attr-level opt-out: pragma on any __init__ assignment line
+        init_lines = [a.line for a in accs
+                      if a.method == "__init__" and a.write]
+        attr_ok = any(_suppressed(pragmas, ln, "OP601") for ln in init_lines)
+        live = [a for a in bare
+                if not _suppressed(pragmas, a.line, "OP601")]
+        sup = attr_ok or not live
+        b = min(live or bare, key=lambda a: a.line)
+        lw = locked_writes[0]
+        guard = sorted(lw.held)[0]
+        others = sorted({f"{a.method}:{a.line}" for a in (live or bare)
+                         if a.method != b.method})
+        also = f" (also bare in {', '.join(others[:4])})" if others else ""
+        yield _Finding(
+            "OP601", f"OP601:{mod.rel}:{cls}.{attr}",
+            f"`{cls}.{attr}` is written under `{guard}` in `{lw.method}` "
+            f"(line {lw.line}) but "
+            f"{'written' if b.write else 'read'} bare in `{b.method}`{also}",
+            f"{mod.rel}:{b.line}", b.line,
+            f"hold `{guard}` here, or annotate the deliberate lock-free "
+            f"access with `# threadlint: ok OP601 - <why>`",
+            suppressed=sup)
+
+
+def _op603(mod: _Module, pragmas: dict) -> Iterable[_Finding]:
+    seen = set()
+    for rel, cls, meth, call, held, line in mod.blocking:
+        key = f"OP603:{rel}:{cls or '<module>'}.{meth}:{call}"
+        if key in seen:
+            continue
+        seen.add(key)
+        where = f"{cls}.{meth}" if cls else meth
+        yield _Finding(
+            "OP603", key,
+            f"`{where}` calls blocking `{call}` while holding "
+            f"{', '.join(f'`{h}`' for h in held)}",
+            f"{rel}:{line}", line,
+            "move the blocking call outside the critical section (snapshot "
+            "state under the lock, block after releasing)",
+            suppressed=_suppressed(pragmas, line, "OP603"))
+
+
+def _op604(mod: _Module, pragmas: dict) -> Iterable[_Finding]:
+    for key, rec in sorted(mod.threads.items(), key=lambda kv: kv[1].line):
+        sup = _suppressed(pragmas, rec.line, "OP604")
+        name = key[2]
+        if rec.kind == "executor" and not rec.joined:
+            yield _Finding(
+                "OP604", f"OP604:{mod.rel}:{name}",
+                f"executor `{name}` is never shut down",
+                f"{mod.rel}:{rec.line}", rec.line,
+                "use `with ThreadPoolExecutor(...) as ex:` or call "
+                "`.shutdown()` on every exit path", suppressed=sup)
+        elif rec.kind == "thread" and not rec.daemon and not rec.joined:
+            yield _Finding(
+                "OP604", f"OP604:{mod.rel}:{name}",
+                f"non-daemon thread `{name}` has no join path — it outlives "
+                f"its owner and hangs interpreter exit",
+                f"{mod.rel}:{rec.line}", rec.line,
+                "pass `daemon=True` or join it in the owner's close()",
+                suppressed=sup)
+
+
+def _op605(mod: _Module, pragmas: dict) -> Iterable[_Finding]:
+    if not mod.uses_threading:
+        return
+    seen = set()
+    for name, held, line in sorted(mod.global_muts, key=lambda t: t[2]):
+        if name in seen or held:
+            continue
+        seen.add(name)
+        sup = (_suppressed(pragmas, line, "OP605")
+               or _suppressed(pragmas, mod.mutables.get(name, -1), "OP605"))
+        yield _Finding(
+            "OP605", f"OP605:{mod.rel}:{name}",
+            f"module global `{name}` mutated without a module lock held in "
+            f"a threading-aware module",
+            f"{mod.rel}:{line}", line,
+            f"guard mutations with a module-level lock, or annotate with "
+            f"`# threadlint: ok OP605 - <why>`", suppressed=sup)
+
+
+def _op602(edges: dict, pragma_by_rel: dict) -> Iterable[_Finding]:
+    """Cycles in the global acquisition graph; one finding per lock pair."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> Optional[list]:
+        seen, stack = {src}, [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    reported = set()
+    for (a, b), (rel, line, meth) in sorted(edges.items()):
+        pair = tuple(sorted((a, b)))
+        if pair in reported:
+            continue
+        back = reaches(b, a)
+        if back is None:
+            continue
+        reported.add(pair)
+        pragmas = pragma_by_rel.get(rel, {})
+        # the first edge of the return path pins the second site
+        site2 = edges.get((back[0], back[1]))
+        other = (f" (reverse edge at {site2[0]}:{site2[1]} in "
+                 f"`{site2[2]}`)" if site2 else "")
+        chain = " -> ".join([a] + back[1:]) if len(back) > 2 \
+            else f"{a} -> {b} and {b} -> {a}"
+        yield _Finding(
+            "OP602", f"OP602:{'<->'.join(pair)}",
+            f"lock-order inversion: `{chain}` acquired in `{meth}`"
+            f"{other} — opposite orders deadlock under contention",
+            f"{rel}:{line}", line,
+            "pick one global acquisition order for these locks and "
+            "restructure the path that violates it",
+            suppressed=_suppressed(pragmas, line, "OP602"))
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+class ThreadlintReport(AnalysisReport):
+    """AnalysisReport over source files instead of plan stages."""
+
+    def __init__(self, diagnostics, n_files: int = 0, suppressed: int = 0,
+                 edges: Optional[dict] = None):
+        super().__init__(diagnostics)
+        self.n_files = n_files
+        self.suppressed = suppressed
+        self.edges = dict(edges or {})
+
+    def to_json(self) -> dict:
+        out = super().to_json()
+        out.pop("n_stages", None)
+        out.pop("n_features", None)
+        out["n_files"] = self.n_files
+        out["suppressed"] = self.suppressed
+        out["lock_order_edges"] = sorted([a, b] for a, b in self.edges)
+        return out
+
+    def pretty(self) -> str:
+        head = (f"threadlint: {self.n_files} file(s), "
+                f"{len(self.edges)} lock-order edge(s) — "
+                f"{len(self.errors)} error(s), {len(self.warnings)} "
+                f"warning(s), {self.suppressed} suppressed")
+        if not self.diagnostics:
+            return head + "\nclean: no findings"
+        return "\n".join([head] + [d.pretty() for d in self.diagnostics])
+
+
+def iter_sources(paths: Optional[Iterable] = None) -> list:
+    """Source files under each path (default: the installed package)."""
+    if not paths:
+        paths = [Path(__file__).resolve().parents[1]]
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def _relname(path: Path) -> str:
+    parts = path.resolve().parts
+    if "transmogrifai_tpu" in parts:
+        i = parts.index("transmogrifai_tpu")
+        return "/".join(parts[i:])
+    try:
+        return str(path.resolve().relative_to(Path.cwd()))
+    except ValueError:
+        return str(path)
+
+
+def load_baseline(path) -> set:
+    with open(path) as fh:
+        doc = json.load(fh)
+    keys = doc.get("ignore", doc) if isinstance(doc, dict) else doc
+    return set(keys)
+
+
+def run_threadlint(paths: Optional[Iterable] = None,
+                   baseline: Optional[set] = None) -> ThreadlintReport:
+    """Run OP601-OP605 over the given files/dirs (default: the package)."""
+    baseline = baseline or set()
+    live: list = []
+    suppressed = 0
+    all_edges: dict = {}
+    pragma_by_rel: dict = {}
+    files = iter_sources(paths)
+    mods = []
+    for path in files:
+        try:
+            src = path.read_text()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError):
+            continue
+        rel = _relname(path)
+        pragmas = _pragmas(src)
+        pragma_by_rel[rel] = pragmas
+        mod = _walk_module(tree, rel, path.stem)
+        mods.append((mod, pragmas))
+        for edge, site in mod.edges.items():
+            all_edges.setdefault(edge, site)
+
+    raw: list = []
+    for mod, pragmas in mods:
+        raw.extend(_op601(mod, pragmas))
+        raw.extend(_op603(mod, pragmas))
+        raw.extend(_op604(mod, pragmas))
+        raw.extend(_op605(mod, pragmas))
+    raw.extend(_op602(all_edges, pragma_by_rel))
+
+    for f in raw:
+        if f.suppressed or f.key in baseline:
+            suppressed += 1
+        else:
+            live.append(f)
+
+    diags = [make_diag(f.code, f.message, stage_uid=f.loc, hint=f.hint)
+             for f in live]
+    report = ThreadlintReport(diags, n_files=len(files),
+                              suppressed=suppressed, edges=all_edges)
+    report.findings = live
+    return report
+
+
+def collect_lock_order(paths: Optional[Iterable] = None) -> list:
+    """The statically observed acquisition order as (first, second) name
+    pairs — `ClassName.attr` / `module.NAME` identities, the same names
+    resilience.lockcheck `make_lock` registers. Seed for the runtime
+    validator: static analysis proposes the order, the armed chaos suites
+    validate it."""
+    report = run_threadlint(paths)
+    return sorted(report.edges)
+
+
+def rules_catalog() -> list:
+    """The OP6xx rows of the shared RULES catalog."""
+    return [RULES[c] for c in sorted(RULES) if c.startswith("OP6")]

@@ -341,6 +341,50 @@ def _cmd_lint(argv) -> int:
     return 1 if report.has_errors else 0
 
 
+def _cmd_threadlint(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op threadlint",
+        description="static concurrency analysis of python source (OP6xx): "
+                    "guarded-field escapes, lock-order inversions, blocking "
+                    "calls under locks, thread-lifecycle hygiene, unsynced "
+                    "module globals; exits nonzero on any unsuppressed "
+                    "error-severity finding")
+    ap.add_argument("paths", nargs="*", metavar="PATH",
+                    help="files or directories to scan (default: the "
+                         "installed transmogrifai_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the structured report as JSON on stdout (for CI)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the OP6xx rule catalog and exit")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="JSON file of finding keys to ignore (a list, or "
+                         "{\"ignore\": [...]}) — adopt-incrementally mode")
+    args = ap.parse_args(argv)
+
+    from transmogrifai_tpu.analyze.threadlint import (
+        load_baseline, run_threadlint, rules_catalog)
+
+    if args.rules:
+        import json
+
+        cat = rules_catalog()
+        if args.as_json:
+            print(json.dumps([r.to_json() for r in cat], indent=1))
+        else:
+            for r in cat:
+                print(f"{r.code}  {r.severity:5s} {r.title} — {r.rationale}")
+        return 0
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    report = run_threadlint(args.paths or None, baseline=baseline)
+    if args.as_json:
+        import json
+
+        print(json.dumps(report.to_json(), indent=1))
+    else:
+        print(report.pretty())
+    return 1 if report.has_errors else 0
+
+
 def _fetch_fleet_snapshots(target: str, timeout: float = 5.0) -> list:
     """Per-process `{"role", "process", "snapshot"}` rows from a fleet
     endpoint: `http(s)://...` hits a serving daemon's
@@ -1111,6 +1155,9 @@ def main(argv=None) -> int:
             "  gen       scaffold a project from a CSV (--input --id --response)\n"
             "  lint      statically analyze an app's plan "
             "(--app module:fn [--json] [--rules] [--mesh D,M])\n"
+            "  threadlint  static concurrency analysis of the codebase "
+            "(OP6xx: guarded-field escapes, lock-order cycles, blocking "
+            "under locks) ([PATH...] [--json] [--rules] [--baseline FILE])\n"
             "  explain   predict per-device HBM, collective traffic and "
             "padding waste per stage, before any trace "
             "(--app module:fn [--mesh D,M] [--rows N] [--json])\n"
@@ -1150,6 +1197,8 @@ def main(argv=None) -> int:
         return _cmd_gen(rest)
     if cmd == "lint":
         return _cmd_lint(rest)
+    if cmd == "threadlint":
+        return _cmd_threadlint(rest)
     if cmd == "explain":
         return _cmd_explain(rest)
     if cmd == "monitor":

@@ -58,6 +58,7 @@ from typing import Callable, Iterator, Optional
 
 from .. import obs
 from ..resilience import chaos
+from ..resilience.lockcheck import make_condition, make_lock
 from . import transport
 from .frames import (
     compress_buffers,
@@ -272,7 +273,7 @@ class IngestService:
                                 lambda: self._reg.snapshot(samples=True))
 
         # --- shared state (everything below under _cond) ---
-        self._cond = threading.Condition()
+        self._cond = make_condition("IngestService._cond")
         self._jobs: dict[str, _Job] = {}
         self._pending: list[tuple[str, int]] = []   # (job_id, shard)
         self._leases: dict[tuple[str, int], _Lease] = {}
@@ -291,7 +292,7 @@ class IngestService:
 
         self._restarts = 0
         self._last_ckpt: Optional[float] = None
-        self._ckpt_lock = threading.Lock()
+        self._ckpt_lock = make_lock("IngestService._ckpt_lock")
         self._as_last = 0.0            # last autoscale action (monotonic)
         self._as_idle_since: Optional[float] = None
 
@@ -408,8 +409,9 @@ class IngestService:
             if self._closed:
                 return
             self._closed = True
+            crashed = self._crashed  # snapshot vs a concurrent _crash()
             self._cond.notify_all()
-        if not self._crashed:
+        if not crashed:
             # the CLEAN checkpoint: a later restart on this state_dir resumes
             # without counting a coordinator crash
             self._checkpoint(clean=True)
@@ -537,48 +539,54 @@ class IngestService:
                           "(crashed) checkpoint").inc()
             obs.add_event("ingest:coordinator_restart",
                           restarts=self._restarts)
-        for jid, jd in (data.get("jobs") or {}).items():
-            try:
-                source = source_from_wire(jd["source"])
-            except Exception:  # noqa: BLE001 — an unrestorable job is skipped,
-                continue       # its consumer re-registers with a fresh source
-            job = _Job(jid, source, plan_fp=jd.get("plan", "?"),
-                       n_shards=int(jd["n_shards"]), files=jd["files"],
-                       local=False, max_buffered=self.max_buffered,
-                       epoch=int(jd.get("epoch", 0)))
-            job.file_chunks = {int(k): int(v)
-                               for k, v in (jd.get("file_chunks") or
-                                            {}).items()}
-            af, ac = (list(jd.get("acked") or [0, 0]) + [0, 0])[:2]
-            # clamp the frontier to the contiguous prefix of known chunk
-            # counts: a file below the frontier with an unknown count cannot
-            # be reconstructed, so delivery restarts from it (the consumer
-            # client dedupes the overlap)
-            for f in range(int(af)):
-                if f not in job.file_chunks:
-                    af, ac = f, 0
-                    break
-            job.acked = [int(af), int(ac)]
-            job.emit = list(job.acked)
-            for f in range(int(af)):
-                for c in range(job.file_chunks[f]):
-                    job.committed.add((f, c))
-            for c in range(int(ac)):
-                job.committed.add((int(af), c))
-            for s, sd in (jd.get("shards") or {}).items():
-                st = job.shards.get(int(s))
-                if st is not None:
-                    st.granted = int(sd.get("granted", 0))
-                    st.errors = int(sd.get("errors", 0))
-            now = time.monotonic()
-            for s in range(job.n_shards):
-                if job.shard_complete(s):
-                    job.shards_done.add(s)
-                else:
-                    job.shards[s].pending_since = now
-                    self._pending.append((jid, s))
-            self._jobs[jid] = job  # paused (conn=None) until JOB_OPEN
-        self._jobs_gauge()
+        # `start()` calls this before the accept/housekeeping threads exist,
+        # but the registry mutations still go under the condvar: the lock
+        # discipline is uniform (threadlint OP601) and the uncontended
+        # acquisition is free
+        with self._cond:
+            for jid, jd in (data.get("jobs") or {}).items():
+                try:
+                    source = source_from_wire(jd["source"])
+                except Exception:  # noqa: BLE001 — an unrestorable job is
+                    continue       # skipped; its consumer re-registers with
+                                   # a fresh source
+                job = _Job(jid, source, plan_fp=jd.get("plan", "?"),
+                           n_shards=int(jd["n_shards"]), files=jd["files"],
+                           local=False, max_buffered=self.max_buffered,
+                           epoch=int(jd.get("epoch", 0)))
+                job.file_chunks = {int(k): int(v)
+                                   for k, v in (jd.get("file_chunks") or
+                                                {}).items()}
+                af, ac = (list(jd.get("acked") or [0, 0]) + [0, 0])[:2]
+                # clamp the frontier to the contiguous prefix of known chunk
+                # counts: a file below the frontier with an unknown count
+                # cannot be reconstructed, so delivery restarts from it (the
+                # consumer client dedupes the overlap)
+                for f in range(int(af)):
+                    if f not in job.file_chunks:
+                        af, ac = f, 0
+                        break
+                job.acked = [int(af), int(ac)]
+                job.emit = list(job.acked)
+                for f in range(int(af)):
+                    for c in range(job.file_chunks[f]):
+                        job.committed.add((f, c))
+                for c in range(int(ac)):
+                    job.committed.add((int(af), c))
+                for s, sd in (jd.get("shards") or {}).items():
+                    st = job.shards.get(int(s))
+                    if st is not None:
+                        st.granted = int(sd.get("granted", 0))
+                        st.errors = int(sd.get("errors", 0))
+                now = time.monotonic()
+                for s in range(job.n_shards):
+                    if job.shard_complete(s):
+                        job.shards_done.add(s)
+                    else:
+                        job.shards[s].pending_since = now
+                        self._pending.append((jid, s))
+                self._jobs[jid] = job  # paused (conn=None) until JOB_OPEN
+            self._jobs_gauge()
 
     # --- worker-facing server side ----------------------------------------------------
     def _accept_loop(self) -> None:
@@ -594,10 +602,12 @@ class IngestService:
             # after — then this read of _closed sees True). Without this a
             # worker reconnecting in the crash window becomes a zombie
             # served by a handler on a "dead" service.
-            if self._closed:
+            if self._closed:  # threadlint: ok OP601 - ordering vs the _conns append (comment above) makes this bare read safe
                 _sever(conn)
                 continue
-            self._send_locks[conn] = threading.Lock()
+            # one per connection, all sharing one order-graph name (the
+            # checker's same-name exemption covers peer send locks)
+            self._send_locks[conn] = make_lock("IngestService._send_lock")
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True, name="ingest-conn")
             t.start()
@@ -1440,7 +1450,8 @@ class IngestService:
         even without the housekeeping thread)."""
         if self._server is None:
             self.start()
-        job = self._jobs[job_id]
+        with self._cond:
+            job = self._jobs[job_id]
         while True:
             fallback_shard = None
             with self._cond:
@@ -1485,10 +1496,11 @@ class IngestService:
                         if s is not None:
                             stalled.append((job, s))
                 n_live = sum(1 for w in self._workers.values() if w.live)
+                crashed = self._crashed
             for job, s in stalled:
                 self._start_self_extract(job, s)
             self._autoscale_tick()
-            if self.state_dir and not self._crashed:
+            if self.state_dir and not crashed:
                 if (self._last_ckpt is None
                         or time.monotonic() - self._last_ckpt
                         >= self.checkpoint_every_s):

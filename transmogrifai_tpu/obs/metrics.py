@@ -412,9 +412,11 @@ class MetricsRegistry:
         snapshot is losslessly mergeable on the far side (`merge`) — the shape
         METRICS frames and `/fleet/metrics` federation push over the wire."""
         out: dict[str, dict] = {}
+        with self._lock:  # one consistent copy vs concurrent _get/reset
+            help_map = dict(self._help)
         for m in self.collect():
             entry = out.setdefault(m.name, {
-                "kind": m.kind, "help": self._help.get(m.name, ""),
+                "kind": m.kind, "help": help_map.get(m.name, ""),
                 "series": [],
             })
             snap = (m.snapshot(samples=True)
@@ -471,10 +473,12 @@ class MetricsRegistry:
         and `promtool check metrics` accepts)."""
         lines: list[str] = []
         seen: set[str] = set()
+        with self._lock:  # one consistent copy vs concurrent _get/reset
+            help_map = dict(self._help)
         for m in self.collect():
             if m.name not in seen:
                 seen.add(m.name)
-                help_text = self._help.get(m.name, "") or m.name
+                help_text = help_map.get(m.name, "") or m.name
                 lines.append(f"# HELP {m.name} {_escape(help_text)}")
                 lines.append(f"# TYPE {m.name} {m.kind}")
             ls = _label_str(m.labels)

@@ -281,17 +281,23 @@ class Tracer:
     def report(self) -> dict:
         """Backward-compatible superset of the old Profiler.report()."""
         self.finish()
+        with self._lock:  # snapshot vs concurrent add_phase/add_cost threads
+            order = list(self._order)
+            phases = {n: (self.phases[n].wall_s, self.phases[n].count)
+                      for n in order}
+            device_cost = {k: dict(v) for k, v in self.device_cost.items()}
         out: dict[str, Any] = {
             "phases": [
-                {"name": n, "wall_s": round(self.phases[n].wall_s, 6),
-                 "count": self.phases[n].count}
-                for n in self._order
+                {"name": n, "wall_s": round(phases[n][0], 6),
+                 "count": phases[n][1]}
+                for n in order
             ],
         }
-        if self.device_cost:
-            total_flops = sum(c.get("flops", 0.0) for c in self.device_cost.values())
+        if device_cost:
+            total_flops = sum(c.get("flops", 0.0)
+                              for c in device_cost.values())
             out["device_cost"] = {
-                "programs": self.device_cost,
+                "programs": device_cost,
                 "total_estimated_flops": total_flops,
             }
         if self.trace_dir:

@@ -221,11 +221,13 @@ class RetraceBudget:
 
     @property
     def count(self) -> int:
-        return len(self.events)
+        with self._lock:
+            return len(self.events)
 
     @property
     def excess(self) -> list[tuple[str, str]]:
-        return self.events[self.budget:]
+        with self._lock:
+            return self.events[self.budget:]
 
     def on_event(self, kind: str, program: str) -> None:
         if kind not in self.kinds:
@@ -244,13 +246,14 @@ class RetraceBudget:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         deactivate(self, "budget")
+        with self._lock:  # late on_event callbacks may still be landing
+            events = list(self.events)
         if exc_type is None and self.action == "raise" \
-                and len(self.events) > self.budget:
-            detail = ", ".join(
-                f"{k}:{p or '?'}" for k, p in self.events[:10])
-            if len(self.events) > 10:
-                detail += f", … ({len(self.events) - 10} more)"
+                and len(events) > self.budget:
+            detail = ", ".join(f"{k}:{p or '?'}" for k, p in events[:10])
+            if len(events) > 10:
+                detail += f", … ({len(events) - 10} more)"
             raise RetraceBudgetExceeded(
-                f"{len(self.events)} compilation event(s) exceeded the "
+                f"{len(events)} compilation event(s) exceeded the "
                 f"retrace budget of {self.budget} (kinds={self.kinds}): "
-                f"{detail}", list(self.events))
+                f"{detail}", events)

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from .. import obs
+from ..resilience.lockcheck import make_condition, make_lock
 from .streaming import StreamClosed
 
 _SENTINEL = object()
@@ -70,9 +71,9 @@ class ClosableQueue:
 
         self._maxsize = int(maxsize)
         self._items: deque = deque()
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._not_full = threading.Condition(self._lock)
+        self._lock = make_lock("ClosableQueue._lock")
+        self._not_empty = make_condition("ClosableQueue._lock", self._lock)
+        self._not_full = make_condition("ClosableQueue._lock", self._lock)
         self._closed = False
 
     @property

@@ -57,8 +57,10 @@ class QueueStreamingReader(StreamingReader):
         import queue
         import threading
 
+        from ..resilience.lockcheck import make_lock
+
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
-        self._lock = threading.Lock()
+        self._lock = make_lock("QueueStreamingReader._lock")
         self._closed = False
         self.timeout = timeout
 
@@ -67,6 +69,9 @@ class QueueStreamingReader(StreamingReader):
             if self._closed:
                 raise StreamClosed(
                     "put() after close(): batch rejected, not silently dropped")
+            # threadlint: ok OP603 - the enqueue MUST be atomic with the
+            # closed check (the documented close contract above); a bounded
+            # queue deliberately backpressures close() until the drain
             self._q.put(batch)
 
     def close(self) -> None:
@@ -74,11 +79,14 @@ class QueueStreamingReader(StreamingReader):
             if self._closed:
                 return
             self._closed = True
+            # threadlint: ok OP603 - sentinel enqueue is part of the same
+            # atomic close step; see the close contract in the class doc
             self._q.put(self._SENTINEL)
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def stream(self) -> Iterator[Any]:
         import queue

@@ -17,6 +17,10 @@ exists in select/checkpoint.py and workflow/phase_checkpoint.py):
 * `FaultInjector` — the deterministic chaos harness that injects IO errors,
   torn/poison rows, slow batches, and device-dispatch failures on a
   reproducible schedule (chaos.py).
+* `make_lock` / `make_rlock` / `make_condition` — named lock factories whose
+  `TT_LOCK_CHECK=1`-armed form validates lock-acquisition order at runtime
+  against the `op threadlint` static graph, raising (tests) or dumping the
+  flight recorder (production) on an ABBA inversion (lockcheck.py).
 
 Everything lands on the PR-5 metrics registry (`resilience_retries_total`,
 `breaker_state`, `quarantined_rows_total`, `resilience_dispatch_seconds`,
@@ -51,15 +55,27 @@ from .policy import (
     retry_call,
     scoped,
 )
+from .lockcheck import (
+    LockOrderError,
+    armed_mode,
+    lockcheck_state,
+    make_condition,
+    make_lock,
+    make_rlock,
+    reset_lockcheck,
+    seed_static_order,
+)
 from .quarantine import QuarantineWriter, isolate_failing
 
 __all__ = [
     "CLOSED", "HALF_OPEN", "OPEN", "TRANSIENT_ERRORS",
     "CircuitBreaker", "DeadlineExceeded", "FaultInjector",
     "FaultPolicy", "InjectedDispatchError", "InjectedFault",
-    "InjectedIOError", "QuarantineWriter", "TransientError",
-    "active", "ambient", "call_with_deadline", "corrupt_batch",
-    "io_guard", "isolate_failing", "maybe_device", "maybe_io",
-    "maybe_site", "maybe_slow",
-    "resilient_prepare", "retry_call", "scoped",
+    "InjectedIOError", "LockOrderError", "QuarantineWriter",
+    "TransientError", "active", "ambient", "armed_mode",
+    "call_with_deadline", "corrupt_batch", "io_guard", "isolate_failing",
+    "lockcheck_state", "make_condition", "make_lock", "make_rlock",
+    "maybe_device", "maybe_io", "maybe_site", "maybe_slow",
+    "reset_lockcheck", "resilient_prepare", "retry_call", "scoped",
+    "seed_static_order",
 ]

@@ -1,0 +1,337 @@
+"""lockcheck — the runtime complement of `op threadlint` (OP602).
+
+The static pass (analyze/threadlint.py) builds the lock-acquisition graph
+from source and proposes a global order; this module validates that order
+under REAL interleavings. Armed with ``TT_LOCK_CHECK=1``, every lock built
+through `make_lock`/`make_rlock`/`make_condition` is wrapped: each thread
+carries its held-lock stack, and every acquisition is checked against the
+(seeded + observed) pairwise order table. Acquiring B while holding A when
+A-after-B is already on record is the ABBA inversion — the deadlock that
+only fires under contention, caught on the first quiet occurrence.
+
+Modes (the env var's value):
+
+  ``TT_LOCK_CHECK=1`` (or ``raise``)  raise `LockOrderError` at the second
+      site, attributing BOTH acquisition sites — the test-suite mode the
+      armed conftest uses for the daemon/ingest/pipeline/autopilot suites.
+  ``TT_LOCK_CHECK=dump`` (or ``warn``)  production mode: record the
+      violation, bump ``lock_order_violations_total``, and dump the flight
+      recorder (obs/recorder.py) so the inversion ships with the event ring
+      that led to it — the process keeps serving.
+
+Disarmed (unset/``0``), `make_lock` returns a plain `threading.Lock`: the
+decision happens once at construction, so the steady-state cost of an
+unarmed fleet is exactly zero — no wrapper, no branch, no bookkeeping.
+
+Lock identities are names, not objects: ``ClassName.attr`` strings matching
+the static analyzer's graph, so `seed_static_order(collect_lock_order())`
+hands the runtime checker the statically proposed DAG. Two locks sharing a
+name (per-instance locks of the same class, e.g. one send lock per ingest
+connection) are exempt from pairwise ordering — that is the address-order
+idiom's territory, not a name-level inversion.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Iterable, Optional, Union
+
+__all__ = [
+    "LockOrderError", "armed_mode", "lockcheck_state", "make_condition",
+    "make_lock", "make_rlock", "reset_lockcheck", "seed_static_order",
+]
+
+
+class LockOrderError(RuntimeError):
+    """A runtime lock-order inversion (armed test mode)."""
+
+
+# --- global order table ----------------------------------------------------
+# (held_name, acquired_name) -> "file:line" of first observation. Reads ride
+# the GIL (plain dict gets on the hot path); writes serialize on _STATE_LOCK.
+_ORDER: dict = {}
+_VIOLATIONS: list = []
+_ACQUIRED_TOTAL = 0          # armed acquisitions ever noted (tests: 0 when
+                             # disarmed — disarmed locks never reach here)
+_STATE_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def armed_mode() -> Optional[str]:
+    """'raise' / 'dump' when TT_LOCK_CHECK arms the checker, else None."""
+    v = os.environ.get("TT_LOCK_CHECK", "").strip().lower()
+    if v in ("", "0", "off", "false", "no"):
+        return None
+    return "dump" if v in ("dump", "warn") else "raise"
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def _site() -> str:
+    """file:line of the nearest caller OUTSIDE this module — the acquisition
+    site the message should attribute, however deep the wrapper path
+    (`with lock:` vs `.acquire()` vs a condition's enter)."""
+    f = sys._getframe(1)
+    here = f.f_code.co_filename
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _note_acquire(lock: "_CheckedLock") -> None:
+    # HOT: runs on every armed acquisition, usually while other threads
+    # contend for the same lock — branch-lean, locals-bound, fast-pathed
+    global _ACQUIRED_TOTAL
+    _ACQUIRED_TOTAL += 1
+    try:
+        stack = _TLS.stack
+    except AttributeError:
+        stack = _TLS.stack = []
+    if not stack:                # outermost lock: nothing to order against
+        stack.append([lock, lock.name, 1])
+        return
+    for ent in stack:
+        if ent[0] is lock:
+            ent[2] += 1          # reentrant (RLock) — no new ordering fact
+            return
+    name = lock.name
+    order = _ORDER
+    for ent in stack:
+        held = ent[1]
+        if held == name:
+            continue             # same-name pair: address-order territory
+        if (name, held) in order:
+            _violate(held, name)
+        elif (held, name) not in order:
+            with _STATE_LOCK:
+                order.setdefault((held, name), _site())
+    stack.append([lock, name, 1])
+
+
+def _note_release(lock: "_CheckedLock") -> None:
+    try:
+        stack = _TLS.stack
+    except AttributeError:
+        return
+    if stack and stack[-1][0] is lock:   # LIFO release: the common case
+        ent = stack[-1]
+        ent[2] -= 1
+        if ent[2] <= 0:
+            del stack[-1]
+        return
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] is lock:
+            stack[i][2] -= 1
+            if stack[i][2] <= 0:
+                del stack[i]
+            return
+
+
+def _violate(held: str, acquiring: str) -> None:
+    first = _ORDER.get((acquiring, held), "?")
+    here = _site()
+    msg = (f"lock-order inversion: acquiring `{acquiring}` at {here} while "
+           f"holding `{held}`, but `{held}` was acquired while holding "
+           f"`{acquiring}` at {first} — opposite orders deadlock under "
+           f"contention")
+    with _STATE_LOCK:
+        _VIOLATIONS.append({"held": held, "acquiring": acquiring,
+                            "site": here, "first_site": first})
+    if armed_mode() == "raise":
+        raise LockOrderError(msg)
+    # production: count it, ship the event ring, keep serving
+    try:
+        from .. import obs
+
+        obs.default_registry().counter(
+            "lock_order_violations_total",
+            help="runtime lock-order inversions observed by lockcheck").inc()
+        obs.add_event("lockcheck:inversion", held=held, acquiring=acquiring,
+                      site=here, first_site=first)
+        rec = obs.active_recorder()
+        if rec is not None:
+            rec.dump("lock_inversion", force=True)
+    except Exception:  # noqa: BLE001 — diagnostics must never take the
+        pass           # process down on top of a concurrency bug
+
+
+# --- instrumented primitives -----------------------------------------------
+
+class _CheckedLock:
+    """threading.Lock/RLock wrapper that feeds the order checker."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner=None):
+        self.name = name
+        self._inner = threading.Lock() if inner is None else inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # check-then-block (lockdep order): an inversion raises BEFORE the
+        # acquire can deadlock, and the held stack never leaks an entry for
+        # a lock the raise prevented us from taking
+        _note_acquire(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            _note_release(self)
+        return ok
+
+    def release(self) -> None:
+        _note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_CheckedLock":
+        # inlined acquire(): one Python frame fewer on the `with` hot path
+        _note_acquire(self)
+        self._inner.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _note_release(self)
+        self._inner.release()
+
+    def __repr__(self) -> str:
+        return f"<_CheckedLock {self.name!r}>"
+
+
+class _CheckedCondition:
+    """Condition over a (checked) lock; `wait` reflects the temporary
+    release in the thread's held stack, so a blocked waiter does not look
+    like it still owns the lock."""
+
+    def __init__(self, name: str, lock=None):
+        if isinstance(lock, _CheckedLock):
+            self._lk = lock
+        else:
+            self._lk = _CheckedLock(name, lock if lock is not None
+                                    else threading.RLock())
+        self.name = name
+        self._cond = threading.Condition(self._lk._inner)
+
+    def acquire(self, *a, **kw) -> bool:
+        return self._lk.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lk.release()
+
+    def __enter__(self) -> "_CheckedCondition":
+        self._lk.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lk.release()
+
+    def _unwind(self) -> Optional[list]:
+        stack = _stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self._lk:
+                ent = stack[i]
+                del stack[i]
+                return ent
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ent = self._unwind()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if ent is not None:
+                _stack().append(ent)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        ent = self._unwind()
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            if ent is not None:
+                _stack().append(ent)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<_CheckedCondition {self.name!r}>"
+
+
+# --- factories (the only API call sites need) ------------------------------
+
+def make_lock(name: str) -> Union[threading.Lock, _CheckedLock]:
+    """A lock named for the order graph (`ClassName.attr`). Disarmed: a
+    plain `threading.Lock` — zero wrapper, zero cost."""
+    if armed_mode() is None:
+        return threading.Lock()
+    return _CheckedLock(name)
+
+
+def make_rlock(name: str) -> Union[threading.RLock, _CheckedLock]:
+    if armed_mode() is None:
+        return threading.RLock()
+    return _CheckedLock(name, threading.RLock())
+
+
+def make_condition(name: str, lock=None):
+    """A condition variable; pass the owning `make_lock` result to share one
+    underlying lock between several conditions (the ClosableQueue shape)."""
+    if armed_mode() is None and not isinstance(lock, _CheckedLock):
+        return threading.Condition(lock)
+    return _CheckedCondition(name, lock)
+
+
+# --- seeding, introspection, reset -----------------------------------------
+
+def seed_static_order(edges: Optional[Iterable] = None) -> int:
+    """Load (first, second) name pairs — by default the static graph from
+    `analyze.collect_lock_order()` — as already-observed order facts, so the
+    FIRST runtime acquisition in the wrong order trips, with the static site
+    as the other half of the attribution. Returns the number of edges."""
+    if edges is None:
+        from ..analyze.threadlint import run_threadlint
+
+        report = run_threadlint()
+        edges = [(a, b, f"static:{site[0]}:{site[1]}")
+                 for (a, b), site in sorted(report.edges.items())]
+    n = 0
+    with _STATE_LOCK:
+        for edge in edges:
+            a, b, site = (edge if len(edge) == 3
+                          else (edge[0], edge[1], "static"))
+            _ORDER.setdefault((a, b), site)
+            n += 1
+    return n
+
+
+def lockcheck_state() -> dict:
+    """Snapshot for tests and the bench lane."""
+    with _STATE_LOCK:
+        return {
+            "armed": armed_mode(),
+            "acquisitions": _ACQUIRED_TOTAL,
+            "order_edges": {f"{a} -> {b}": s
+                            for (a, b), s in sorted(_ORDER.items())},
+            "violations": list(_VIOLATIONS),
+        }
+
+
+def reset_lockcheck() -> None:
+    """Drop observed edges, violations, and counters (test isolation;
+    per-instance locks sharing class-level names make edges from one test
+    leak plausible-but-stale order facts into the next)."""
+    global _ACQUIRED_TOTAL
+    with _STATE_LOCK:
+        _ORDER.clear()
+        _VIOLATIONS.clear()
+        _ACQUIRED_TOTAL = 0

@@ -533,12 +533,17 @@ def _metrics_evaluator(problem_type: str, num_classes: int):
     key = (problem_type, num_classes)
     ev = _EVALUATOR_CACHE.get(key)
     if ev is None:
-        ev = _EVALUATOR_CACHE[key] = {
-            "binary": lambda: Evaluators.binary_classification("label", "pred"),
-            "multiclass": lambda: Evaluators.multi_classification(
-                "label", "pred", num_classes=num_classes),
-            "regression": lambda: Evaluators.regression("label", "pred"),
-        }[problem_type]()
+        with _METRICS_PROGRAM_LOCK:
+            ev = _EVALUATOR_CACHE.get(key)
+            if ev is None:
+                ev = _EVALUATOR_CACHE[key] = {
+                    "binary": lambda: Evaluators.binary_classification(
+                        "label", "pred"),
+                    "multiclass": lambda: Evaluators.multi_classification(
+                        "label", "pred", num_classes=num_classes),
+                    "regression": lambda: Evaluators.regression(
+                        "label", "pred"),
+                }[problem_type]()
     return ev
 
 

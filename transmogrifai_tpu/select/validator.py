@@ -230,6 +230,9 @@ def _build_search_program(key, template, static_items, problem_type, metric,
     from ..utils.export_cache import ExportCachingProgram
 
     fn = ExportCachingProgram(fn, key_material=repr(key))
+    # threadlint: ok OP605 - _SEARCH_PROGRAM_LOCK is held by the only
+    # caller (_search_program's double-checked miss path calls here with
+    # the lock still held)
     _SEARCH_PROGRAM_CACHE[key] = fn
     return fn
 

@@ -51,6 +51,7 @@ import numpy as np
 
 from .. import obs
 from ..resilience import chaos
+from ..resilience.lockcheck import make_lock
 
 _logger = logging.getLogger(__name__)
 
@@ -160,7 +161,7 @@ class Autopilot:
         self._step_idx = 0
         self._streak = 0
         self._candidates = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("Autopilot._lock")
 
     # --- bookkeeping ------------------------------------------------------------------
     def _event(self, action: str, **attrs) -> None:
@@ -371,10 +372,11 @@ class Autopilot:
             self.promotions += 1
             self._event("promoted", challenger=round(chall_metric, 6),
                         champion=round(champ_metric, 6))
-            self.history.append({
-                "step": self._step_idx, "dir": cand_dir,
-                "fingerprint": new_entry.fingerprint,
-                "previous_fingerprint": old_fp, "gate": gate})
+            with self._lock:  # vs rollback()'s concurrent read-then-pop
+                self.history.append({
+                    "step": self._step_idx, "dir": cand_dir,
+                    "fingerprint": new_entry.fingerprint,
+                    "previous_fingerprint": old_fp, "gate": gate})
             self._sweep_candidates()
             return {"action": "promoted", "gate": gate,
                     "fingerprint": new_entry.fingerprint, "dir": cand_dir}
@@ -415,7 +417,9 @@ class Autopilot:
         plus anything the daemon still serves or the history references."""
         import shutil
 
-        keep = {h["dir"] for h in self.history[-self.config.keep_candidates:]}
+        with self._lock:
+            tail = self.history[-self.config.keep_candidates:]
+        keep = {h["dir"] for h in tail}
         live = {e["path"] for e in
                 (self._daemon.models() if hasattr(self._daemon, "models")
                  else [])}
@@ -472,14 +476,15 @@ class Autopilot:
         return report
 
     def report(self) -> dict:
-        return {
-            "alias": self._name,
-            "steps": self._step_idx,
-            "promotions": self.promotions,
-            "rollbacks": self.rollbacks,
-            "history": list(self.history),
-            "events": [list(e) for e in self.events],
-        }
+        with self._lock:  # one consistent view vs the retrain worker thread
+            return {
+                "alias": self._name,
+                "steps": self._step_idx,
+                "promotions": self.promotions,
+                "rollbacks": self.rollbacks,
+                "history": list(self.history),
+                "events": [list(e) for e in self.events],
+            }
 
 
 # --- seeded synthetic drifting scenario -------------------------------------------------

@@ -40,12 +40,12 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 import time
 from collections import OrderedDict
 from typing import Optional
 
 from .. import obs
+from ..resilience.lockcheck import make_lock
 from .batcher import MicroBatcher, Overloaded
 from .scoring import score_function
 
@@ -188,8 +188,8 @@ class ServingDaemon:
         #: arms a windowed monitor this way). Models saved without a
         #: serving_baseline admit un-monitored either way.
         self._monitor = monitor
-        self._lock = threading.Lock()
-        self._admit_lock = threading.Lock()
+        self._lock = make_lock("ServingDaemon._lock")
+        self._admit_lock = make_lock("ServingDaemon._admit_lock")
         self._cache: "OrderedDict[str, ModelEntry]" = OrderedDict()
         self._names: dict[str, str] = {}  # alias (name or abspath) -> fp
         self._started = time.monotonic()
@@ -240,11 +240,11 @@ class ServingDaemon:
         """Load, warm, and cache a saved model (idempotent per content
         fingerprint). Returns the live entry; evicts LRU entries past
         `max_models` — eviction drains the victim's batcher first."""
-        if self._closed:
-            raise RuntimeError("daemon is closed")
         path = os.path.abspath(model_dir)
         fp = fingerprint_model_dir(path)
         with self._lock:
+            if self._closed:
+                raise RuntimeError("daemon is closed")
             entry = self._cache.get(fp)
             if entry is not None:
                 self._cache.move_to_end(fp)
@@ -254,6 +254,8 @@ class ServingDaemon:
                 return entry
         with self._admit_lock:
             with self._lock:  # lost the admit race? the winner's entry serves
+                if self._closed:  # close() may have landed since the fast path
+                    raise RuntimeError("daemon is closed")
                 entry = self._cache.get(fp)
                 if entry is not None:
                     self._cache.move_to_end(fp)

@@ -20,10 +20,10 @@ Three serving shapes:
 """
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import TYPE_CHECKING, Any, Mapping, Optional, Sequence
 
+from ..resilience.lockcheck import make_rlock
 from ..types import Column, Table
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -100,7 +100,7 @@ class ScoreFunction:
         #: concurrent callers — the serving daemon's batcher worker plus any
         #: direct batch()/table() traffic — must not race the get-or-create
         #: paths into duplicate LocalPlans (= duplicate jit programs)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ScoreFunction._lock")
         #: registry instruments cached per backend lane: get-or-create
         #: freezes/sorts labels under the registry lock — measurable at
         #: per-record serving frequency (same policy as ServingMonitor._gauge)
@@ -650,15 +650,19 @@ class ScoreFunction:
     def quarantine_summary(self) -> Optional[dict]:
         """Partial-success summary of rows shed by stream() (None when
         quarantine is off or nothing was quarantined)."""
-        return self._qwriter.summary() if self._qwriter is not None else None
+        with self._lock:  # vs the lazy create in _quarantine_writer
+            qw = self._qwriter
+        return qw.summary() if qw is not None else None
 
     def close(self) -> None:
         """Release the handle's quarantine sidecar file handle (idempotent;
         records already written are flushed per write, so close is about
         descriptor hygiene in long-lived serving processes, not durability).
         """
-        if self._qwriter is not None:
-            self._qwriter.close()
+        with self._lock:
+            qw = self._qwriter
+        if qw is not None:
+            qw.close()
 
     def stream(self, batches, *, prefetch: int = 2):
         """Pipelined batch scoring over an iterable of record batches: the

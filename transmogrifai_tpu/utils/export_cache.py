@@ -89,6 +89,9 @@ class ExportCachingProgram:
     def __init__(self, fn: Callable, key_material: str):
         self._fn = fn
         self._key = key_material
+        # threadlint: ok OP601 - double-checked fast path: the bare dict get
+        # in __call__ is GIL-atomic; a miss re-checks under _LOCK in
+        # _load_or_build, and the fallback store only ever writes self._fn
         self._by_shape: dict[str, Any] = {}
 
     def _cache_size(self):
