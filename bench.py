@@ -468,6 +468,7 @@ def _run(partial: dict) -> None:
             run_resilience_overhead,
             run_serving_daemon,
             run_streaming_score,
+            run_train_cold_start,
             run_trees,
         )
 
@@ -543,6 +544,16 @@ def _run(partial: dict) -> None:
             detail["cold_start"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         partial["cold_start_speedup"] = \
             detail["cold_start"].get("cold_start_speedup")
+        # training-side AOT store: `op warmup` wall cold vs warm over one
+        # shared TT_AOT_CACHE_DIR (ISSUE-18 gate: >= 5x and a zero-compile
+        # hydrated second run)
+        try:
+            detail["train_cold_start"] = run_train_cold_start()
+        except Exception as e:  # noqa: BLE001
+            detail["train_cold_start"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        partial["train_aot_speedup"] = \
+            detail["train_cold_start"].get("train_aot_speedup")
         # disaggregated ingest: 0/1/2-worker extraction throughput + the
         # end-to-end cost of one mid-epoch worker SIGKILL (ISSUE-9; the
         # fault machinery itself is gated by tests/ci, this lane gates the
@@ -685,6 +696,12 @@ def _run(partial: dict) -> None:
         s["cold_start_noaot_s"] = cs["cold_start_noaot_s"]
         s["cold_start_speedup"] = cs["cold_start_speedup"]
         s["cold_start_aot_compile_events"] = cs["cold_start_aot_compile_events"]
+    if detail.get("train_cold_start", {}).get("train_aot_speedup") is not None:
+        tc = detail["train_cold_start"]
+        s["train_warmup_cold_s"] = tc["train_warmup_cold_s"]
+        s["train_warmup_warm_s"] = tc["train_warmup_warm_s"]
+        s["train_aot_speedup"] = tc["train_aot_speedup"]
+        s["train_warmup_warm_compiles"] = tc["train_warmup_warm_compiles"]
     _emit_final(compact)
 
 

@@ -1425,6 +1425,61 @@ def run_cold_start(max_batch: int = 256, n_score_rows: int = 2) -> dict:
     }
 
 
+def run_train_cold_start(rows: int = 64, width: int = 8,
+                         num_folds: int = 2) -> dict:
+    """Training cold-start lane (ISSUE 18): `op warmup` wall with a cold vs
+    warm training AOT store, same host, fresh subprocess each.
+
+    Two identical warmup subprocesses share one TT_AOT_CACHE_DIR and one
+    TT_COMPILE_CACHE_DIR. The first compiles every (family, static-group)
+    training executable and persists serialized blobs; the second must
+    hydrate everything through the warm-cell manifest fast path — zero
+    compiles, wall measured in seconds. Gated numbers: `train_aot_speedup`
+    (cold/warm wall, the ISSUE-18 >= 5x contract) and
+    `train_warmup_warm_compiles` == 0. Children run single-device with
+    XLA_FLAGS stripped: the executable store requires device_count == 1."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="bench_train_cold_")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS")}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "TT_AOT_CACHE_DIR": os.path.join(base, "aot"),
+                "TT_COMPILE_CACHE_DIR": os.path.join(base, "cc")})
+    cmd = [_sys.executable, "-m", "transmogrifai_tpu.cli.main", "warmup",
+           "--problem", "binary", "--rows", str(rows),
+           "--widths", str(width), "--num-folds", str(num_folds)]
+
+    def run_once():
+        t0 = time.perf_counter()
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=900, env=env,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(f"op warmup failed: {proc.stderr[-800:]}")
+        return json.loads(proc.stdout)[0], wall
+
+    try:
+        cold_rep, cold_s = run_once()
+        warm_rep, warm_s = run_once()
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return {
+        "rows": rows, "width": width, "num_folds": num_folds,
+        "train_warmup_cold_s": round(cold_s, 3),
+        "train_warmup_warm_s": round(warm_s, 3),
+        "train_aot_speedup": round(cold_s / max(warm_s, 1e-9), 2),
+        "train_warmup_cold_compiles": cold_rep["cache"]["compile"],
+        "train_warmup_warm_compiles": warm_rep["cache"]["compile"],
+        "train_warmup_warm_hydrated": warm_rep["cache"]["hydrate"],
+        "cold": cold_rep["cache"], "warm": warm_rep["cache"],
+    }
+
+
 def run_autopilot(batch: int = 64, max_steps: int = 12) -> dict:
     """Closed-loop autopilot lane (ISSUE-11; the ROADMAP headline metric):
     a seeded drifting event stream against a single-LR daemon — drift fires

@@ -48,7 +48,7 @@ _SLOW_MODULES = frozenset({
     "test_stage_contracts", "test_stage_outputs", "test_insights",
     "test_trees", "test_workflow", "test_wide_sharding",
     "test_width_bucketing", "test_external_wrapper", "test_serve",
-    "test_daemon", "test_aot",
+    "test_daemon", "test_aot", "test_aot_train",
 })
 
 

@@ -1,0 +1,271 @@
+"""Training-side AOT store: cross-process round trips and bounded fallbacks.
+
+The tier-1 executable store (transmogrifai_tpu/utils/export_cache.py) only
+engages in single-device processes, and this suite's conftest forces 8 fake
+CPU devices — so every store assertion here runs in a SUBPROCESS with
+XLA_FLAGS stripped, mirroring how `op warmup`, CI, and replicas actually
+consume TT_AOT_CACHE_DIR.
+
+Covered contracts:
+  1. Headline round trip — warm the store via a full Workflow.train in one
+     process, train again in a FRESH process under retrace_budget(0,
+     kinds=("compile",)): zero backend compiles, >=1 hydrate, and scores
+     bit-identical to a third process with every cache disabled.
+  2. Degradation — a corrupt blob, a stale compat stamp, and a changed shape
+     each fall back to the compile path (correct results), ticking
+     aot_train_fallback_total{reason} only for the real faults.
+  3. Attribution — warmup's report labels every executable hit|hydrate|compile
+     and the second warmup run hydrates without compiling (the manifest fast
+     path), the `op warmup` < 3 s warm-cache contract.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_env(store: str, cc: str, **extra) -> dict:
+    """Single-device child env: the forced-8-device XLA flag must NOT leak
+    (the store is gated on device_count == 1), nor the TPU relay pool."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS")}
+    env.update({"JAX_PLATFORMS": "cpu", "TT_AUTO_MESH": "0",
+                "TT_AOT_CACHE_DIR": store, "TT_COMPILE_CACHE_DIR": cc})
+    env.update(extra)
+    return env
+
+
+def _run_child(code: str, argv, env, tag: str, timeout=420) -> dict:
+    proc = subprocess.run([sys.executable, "-c", code, *map(str, argv)],
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=_REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith(tag + "="))
+    return json.loads(line[len(tag) + 1:])
+
+
+# --- store-level children (cheap: one tiny fused program) --------------------------
+
+_STORE_CHILD = """
+import json, os, pickle, sys
+import numpy as np
+import jax.numpy as jnp
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.utils import export_cache as ec
+
+rows, doctor = int(sys.argv[1]), sys.argv[2]
+
+def stats(X, w):
+    mu = (X * w[:, None]).sum(0) / w.sum()
+    return mu, jnp.cumsum(jnp.sort(X @ mu))
+
+X = np.linspace(0.0, 1.0, rows * 4, dtype=np.float32).reshape(rows, 4)
+w = np.ones((rows,), np.float32)
+with ec.collect_aot_events() as events:
+    out = ec.exec_cached_call(stats, "testfn|stats", args=(X, w),
+                              label="t:stats", lane="stats")
+reg = obs.default_registry()
+def total(name):
+    return sum(m.value for m in reg.collect() if m.name == name)
+fallback = {dict(m.labels or ()).get("reason", ""): m.value
+            for m in reg.collect() if m.name == "aot_train_fallback_total"}
+print("STOREJSON=" + json.dumps({
+    "events": [{k: e.get(k) for k in ("key", "lane", "outcome", "blob")}
+               for e in events],
+    "seconds_ok": all(isinstance(e.get("seconds"), float) for e in events),
+    "hydrated": total("aot_train_hydrated_total"),
+    "compiled": total("aot_train_compiled_total"),
+    "fallback": fallback,
+    "out": [np.asarray(o).tolist() for o in out],
+}))
+if doctor == "stamp":
+    d = ec.train_aot_dir()
+    for name in os.listdir(d):
+        if not name.endswith(".exec"):
+            continue
+        p = os.path.join(d, name)
+        with open(p, "rb") as fh:
+            doc = pickle.loads(fh.read())
+        doc["stamp"]["jax"] = "0.0.0"
+        with open(p, "wb") as fh:
+            fh.write(pickle.dumps(doc))
+"""
+
+
+@pytest.fixture()
+def dirs(tmp_path):
+    store, cc = tmp_path / "aot", tmp_path / "cc"
+    store.mkdir(), cc.mkdir()
+    return str(store), str(cc)
+
+
+def _store_round(dirs, rows=16, doctor=""):
+    return _run_child(_STORE_CHILD, [rows, doctor],
+                      _child_env(*dirs), "STOREJSON", timeout=240)
+
+
+def test_store_compiles_then_hydrates_bit_identical(dirs):
+    a = _store_round(dirs)
+    assert [e["outcome"] for e in a["events"]] == ["compile"]
+    assert a["compiled"] == 1 and a["hydrated"] == 0 and a["fallback"] == {}
+    assert a["events"][0]["lane"] == "stats"
+    assert a["events"][0]["blob"] and a["seconds_ok"]
+    blobs = [f for f in os.listdir(dirs[0]) if f.endswith(".exec")]
+    assert blobs == [a["events"][0]["blob"]]
+    b = _store_round(dirs)
+    assert [e["outcome"] for e in b["events"]] == ["hydrate"]
+    assert b["hydrated"] == 1 and b["compiled"] == 0 and b["fallback"] == {}
+    # exact equality: the hydrated executable IS the serialized one
+    assert b["out"] == a["out"]
+
+
+def test_corrupt_blob_degrades_to_compile_and_repairs(dirs):
+    a = _store_round(dirs)
+    blob = os.path.join(dirs[0], a["events"][0]["blob"])
+    with open(blob, "wb") as fh:
+        fh.write(b"\\x80garbage not a pickle")
+    b = _store_round(dirs)
+    assert [e["outcome"] for e in b["events"]] == ["compile"]
+    assert b["fallback"] == {"corrupt": 1}
+    assert b["out"] == a["out"]
+    # the bad blob was replaced in place: next round hydrates again
+    c = _store_round(dirs)
+    assert [e["outcome"] for e in c["events"]] == ["hydrate"]
+    assert c["fallback"] == {}
+
+
+def test_stale_stamp_degrades_to_compile(dirs):
+    a = _store_round(dirs, doctor="stamp")
+    b = _store_round(dirs)
+    assert [e["outcome"] for e in b["events"]] == ["compile"]
+    assert b["fallback"] == {"stamp": 1}
+    assert b["out"] == a["out"]
+
+
+def test_shape_change_is_a_clean_miss_not_a_fallback(dirs):
+    _store_round(dirs, rows=16)
+    b = _store_round(dirs, rows=24)
+    assert [e["outcome"] for e in b["events"]] == ["compile"]
+    assert b["fallback"] == {}, "a new shape must not count as degradation"
+    assert len([f for f in os.listdir(dirs[0]) if f.endswith(".exec")]) == 2
+
+
+# --- headline: full Workflow.train round trip --------------------------------------
+
+_TRAIN_CHILD = """
+import json, sys
+import numpy as np
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.check.sanity_checker import SanityChecker
+from transmogrifai_tpu.graph import features_from_schema
+from transmogrifai_tpu.readers import InMemoryReader
+from transmogrifai_tpu.select import ParamGridBuilder
+from transmogrifai_tpu.select.selector import ModelSelector
+from transmogrifai_tpu.select.splitters import DataSplitter
+from transmogrifai_tpu.select.validator import CrossValidation
+from transmogrifai_tpu.stages.feature import transmogrify
+from transmogrifai_tpu.stages.model import LogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+mode = sys.argv[1]  # warm | fresh | cold
+if mode != "cold":
+    # op run / op warmup enable the persistent cache before training; the
+    # fresh child leans on it for the non-store-backed programs (tiny eager
+    # ops, fold plumbing), which classify as cache_hit — not compile
+    from transmogrifai_tpu.utils import enable_compile_cache
+    assert enable_compile_cache()
+rng = np.random.default_rng(7)
+rows = [{"label": float(rng.random() > 0.5), "x": float(rng.normal()),
+         "cat": "v%d" % rng.integers(0, 5)} for _ in range(96)]
+
+def train():
+    fs = features_from_schema({"label": "RealNN", "x": "Real",
+                               "cat": "PickList"}, response="label")
+    vector = transmogrify([fs["x"], fs["cat"]])
+    checked = SanityChecker(min_variance=1e-9)(fs["label"], vector)
+    sel = ModelSelector(
+        "binary",
+        models=[(LogisticRegression(max_iter=10),
+                 ParamGridBuilder().add("l2", [0.0, 0.01]).build())],
+        validator=CrossValidation(num_folds=2, seed=5),
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=5),
+    )
+    pred = sel(fs["label"], checked)
+    table = InMemoryReader(rows).generate_table(list(fs.values()))
+    return Workflow().set_result_features(pred).train(table=table)
+
+if mode == "fresh":
+    # zero backend compiles: every store-backed program hydrates, the rest
+    # is absorbed by the shared persistent compile cache (cache_hit events,
+    # which this budget deliberately does not count)
+    with obs.retrace_budget(0, kinds=("compile",)):
+        model = train()
+else:
+    model = train()
+reg = obs.default_registry()
+def total(name):
+    return sum(m.value for m in reg.collect() if m.name == name)
+scores = model.score_fn(pad_to=[8]).batch(
+    [{"x": 0.25, "cat": "v1"}, {"x": -1.5, "cat": "v3"}])
+print("TRAINJSON=" + json.dumps({
+    "hydrated": total("aot_train_hydrated_total"),
+    "compiled": total("aot_train_compiled_total"),
+    "fallback": total("aot_train_fallback_total"),
+    "scores": scores,
+}))
+"""
+
+
+def test_cross_process_train_zero_compiles_bit_identical(dirs):
+    env = _child_env(*dirs)
+    warm = _run_child(_TRAIN_CHILD, ["warm"], env, "TRAINJSON")
+    assert warm["compiled"] > 0 and warm["fallback"] == 0
+    assert any(f.endswith(".exec") for f in os.listdir(dirs[0]))
+
+    fresh = _run_child(_TRAIN_CHILD, ["fresh"], env, "TRAINJSON")
+    assert fresh["hydrated"] > 0, "fresh process must hydrate from the store"
+    assert fresh["compiled"] == 0 and fresh["fallback"] == 0
+
+    # reference: every cache layer off -> the plain jit path end to end
+    cold = _run_child(
+        _TRAIN_CHILD, ["cold"],
+        _child_env(*dirs, TT_TRAIN_AOT="0", TT_EXPORT_CACHE="0",
+                   TT_COMPILE_CACHE="0"), "TRAINJSON")
+    assert cold["hydrated"] == 0 and cold["compiled"] == 0
+    # json round-trips floats via repr, so == is bit-exact
+    assert fresh["scores"] == cold["scores"]
+    assert warm["scores"] == cold["scores"]
+
+
+# --- warmup attribution + manifest fast path ---------------------------------------
+
+def _run_warmup(env):
+    proc = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.cli.main", "warmup",
+         "--problem", "binary", "--rows", "64", "--widths", "8",
+         "--num-folds", "2"],
+        capture_output=True, text=True, timeout=420, cwd=_REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout)[0]
+
+
+def test_warmup_attributes_executables_and_fast_path_hydrates(dirs):
+    env = _child_env(*dirs)
+    cold = _run_warmup(env)
+    assert cold["cache"]["compile"] > 0 and cold["cache"]["hydrate"] == 0
+    for entry in cold["executables"]:
+        assert set(entry) >= {"key", "lane", "outcome", "seconds"}
+        assert entry["outcome"] in ("hit", "hydrate", "compile")
+        assert entry["lane"] in ("search", "refit", "metrics", "stats")
+    assert cold["aot_store"]["enabled"]
+    assert any(f.startswith("warmcell-") for f in os.listdir(dirs[0]))
+
+    warm = _run_warmup(env)
+    assert warm["cache"]["compile"] == 0
+    assert warm["cache"]["hydrate"] == cold["cache"]["compile"]
+    assert all(e["outcome"] == "hydrate" for e in warm["executables"])

@@ -102,10 +102,27 @@ class TestLoop:
 
     def test_swap_serves_without_hot_path_compiles(self, tmp_path):
         """The first post-swap request hits admission-warmed executables:
-        zero trace/lower/compile events on the serving path."""
+        zero trace/lower/compile events on the serving path. With
+        export_aot on by default the candidate bundle is born with its AOT
+        artifacts, so the swap HYDRATES them (aot_hydrated_total ticks)
+        instead of compiling."""
+        reg = obs.default_registry()
+
+        def hydrated_total():
+            return sum(m.value for m in reg.collect()
+                       if m.name == "aot_hydrated_total")
+
         sc, daemon, pilot = make_loop(tmp_path)
         with daemon:
-            drive_to_promotion(sc, daemon, pilot)
+            before = hydrated_total()
+            decisions = drive_to_promotion(sc, daemon, pilot)
+            assert decisions[-1]["action"] == "promoted"
+            # candidate bundle carries the AOT artifact set (born with it)
+            import os as _os
+
+            cand = _os.path.join(str(tmp_path / "work"), "candidate-0001")
+            assert _os.path.isdir(_os.path.join(cand, "aot"))
+            assert hydrated_total() > before
             with obs.retrace_budget(0):
                 pump(daemon, sc, 2)
 
@@ -257,12 +274,22 @@ class TestChaos:
             pump(daemon, sc, 2)
             pilot.step()
             pump(daemon, sc, 2)
+            reg = obs.default_registry()
+
+            def fallback_total():
+                return sum(m.value for m in reg.collect()
+                           if m.name == "aot_fallback_total")
+
+            fb_before = fallback_total()
             inj = FaultInjector(seed=1, fail_sites={"autopilot:save": 1})
             with inj.installed():
                 decision = pilot.step()
             assert decision["action"] == "save_failed"
             assert daemon.aliases()["live"] == fp_before
             assert pilot.promotions == 0
+            # export_aot is on by default: a failed save/export is a counted
+            # containment event, not an error (aot_fallback_total ticks)
+            assert fallback_total() > fb_before
             pump(daemon, sc, 2)
 
     def test_swap_time_device_fault_zero_request_errors(self, tmp_path):

@@ -38,6 +38,12 @@ def test_direction_inference():
     assert bench_diff.lower_is_better("cold_start_noaot_s")
     assert bench_diff.lower_is_better("cold_start_aot_compile_events")
     assert not bench_diff.lower_is_better("cold_start_speedup")
+    # the training-side AOT lane: warmup walls and the warm-run compile
+    # count regress upward, the cold/warm speedup is higher-better
+    assert bench_diff.lower_is_better("train_warmup_cold_s")
+    assert bench_diff.lower_is_better("train_warmup_warm_s")
+    assert bench_diff.lower_is_better("train_warmup_warm_compiles")
+    assert not bench_diff.lower_is_better("train_aot_speedup")
     # the disaggregated-ingest lane: extraction throughput is higher-better,
     # the worker-SIGKILL recovery cost regresses upward
     assert not bench_diff.lower_is_better("disagg_two_worker_rows_per_sec")

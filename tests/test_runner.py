@@ -646,10 +646,11 @@ def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
     seen = {}
 
     def fake_warmup(problem, rows, width, num_classes=3, models=None,
-                    splitter=None, num_folds=3, seed=0, mesh="auto"):
+                    splitter=None, num_folds=3, seed=0, mesh="auto",
+                    procs=0):
         seen.update(problem=problem, rows=rows, width=width,
                     splitter=type(splitter).__name__ if splitter else None,
-                    num_folds=num_folds, mesh=mesh)
+                    num_folds=num_folds, mesh=mesh, procs=procs)
         return {"problem": problem, "rows": rows, "width": width,
                 "requested_width": width, "wall_s": 0.01}
 
@@ -662,13 +663,18 @@ def test_warmup_command_compiles_search_programs(tmp_path, monkeypatch):
     assert rc == 0
     assert '"regression"' in buf.getvalue()
     assert seen == {"problem": "regression", "rows": 48, "width": 8,
-                    "splitter": "DataCutter", "num_folds": 2, "mesh": "auto"}
+                    "splitter": "DataCutter", "num_folds": 2, "mesh": "auto",
+                    "procs": 0}
 
 
 def test_warmup_solo_fits_cover_every_static_group(monkeypatch):
-    """The warmup's solo-refit loop must run one one-point fit per
+    """The warmup's solo-refit loop must run one FULL-GROUP fit per
     (family, static-grid-group) of the DEFAULT grids — deleting the loop or
-    mis-partitioning the grids must fail here."""
+    mis-partitioning the grids must fail here. Full-group grids are the trace
+    dedup: the solo fit's vmapped search program is keyed and shaped
+    identically to the main fit's, so the solo pass pays only the group's
+    refit + fused metrics programs (a one-point grid would compile a G=1
+    search program no real train can reuse)."""
     from transmogrifai_tpu.select.selector import ModelSelector, default_models
     from transmogrifai_tpu.select.validator import _group_grid
     from transmogrifai_tpu.workflow.warmup import warmup
@@ -691,8 +697,9 @@ def test_warmup_solo_fits_cover_every_static_group(monkeypatch):
     expected = []
     for template, grid in default_models("regression"):
         for _static, _stacks, points in _group_grid(template, grid):
-            expected.append((type(template).__name__, dict(points[0])))
-    got = [(cfg[0][0], dict(cfg[0][1][0])) for cfg in solo]
+            expected.append((type(template).__name__,
+                             [dict(p) for p in points]))
+    got = [(cfg[0][0], [dict(p) for p in cfg[0][1]]) for cfg in solo]
     assert sorted(got, key=str) == sorted(expected, key=str)
-    assert all(len(cfg) == 1 and len(cfg[0][1]) == 1 for cfg in solo), (
-        "solo fits must be single-family, one-point grids")
+    assert all(len(cfg) == 1 for cfg in solo), (
+        "solo fits must be single-family grids")

@@ -38,6 +38,10 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 #: (explain_hbm_rel_error: |predicted - measured| / measured per-device
 #: bytes) — a growing prediction error means `op explain` is drifting from
 #: what the mesh counters actually measure
+#: "warmup" also covers the training-side AOT lane (train_warmup_cold_s /
+#: train_warmup_warm_s walls and train_warmup_warm_compiles, which must
+#: stay 0 on a warm store); train_aot_speedup stays higher-better via the
+#: override list
 _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
                  "recovery", "state_bytes", "rel_error")
 #: overrides: fragments that look like seconds but are throughput/quality

@@ -734,6 +734,44 @@ print(f"cold-start smoke ok: hydrated {rep['hydrated_counter']:.0f} "
       f"executables, 2-row score, zero compile events in a fresh process")
 PY
 
+echo "== train warm-cache smoke (training AOT store) =="
+# two `op warmup` runs sharing one TT_AOT_CACHE_DIR: the first compiles and
+# populates the executable store, the second must hydrate EVERYTHING from it
+# (zero compiles) via the warm-cell manifest fast path, and finish in under
+# a quarter of the cold wall — the ISSUE-18 contract that a warm-cache
+# `op warmup` is seconds, not minutes (docs/performance.md "Training cold
+# start"). Subprocesses run single-device: the store requires it.
+python - <<'PY'
+import json, os, subprocess, sys, tempfile, time
+
+base = tempfile.mkdtemp(prefix="ci_train_warm_")
+env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+env.update({"JAX_PLATFORMS": "cpu",
+            "TT_AOT_CACHE_DIR": os.path.join(base, "aot"),
+            "TT_COMPILE_CACHE_DIR": os.path.join(base, "cc")})
+cmd = [sys.executable, "-m", "transmogrifai_tpu.cli.main", "warmup",
+       "--problem", "binary", "--rows", "64", "--widths", "8",
+       "--num-folds", "2"]
+
+def run():
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=900, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout)[0], time.perf_counter() - t0
+
+cold, cold_s = run()
+assert cold["cache"]["compile"] > 0, cold["cache"]
+warm, warm_s = run()
+assert warm["cache"]["hydrate"] >= 1, warm["cache"]
+assert warm["cache"]["compile"] == 0, warm["cache"]
+assert warm_s < 0.25 * cold_s, (
+    f"warm warmup {warm_s:.1f}s not < 25% of cold {cold_s:.1f}s")
+print(f"train warm-cache smoke ok: cold {cold_s:.1f}s "
+      f"({cold['cache']['compile']} compiles) -> warm {warm_s:.1f}s "
+      f"({warm['cache']['hydrate']} hydrated, 0 compiles)")
+PY
+
 echo "== bench regression gate =="
 # Every scalar in the bench summary is gated, including the streaming_score
 # input-pipeline lane (streaming_score_rows_per_sec, streaming_pipeline_speedup,

@@ -260,11 +260,29 @@ class SanityChecker(Estimator):
         # COLUMN object (the AutoML steady state re-trains fresh graphs on the
         # same table): warm trains build the label one-hot ON DEVICE and the
         # whole fit is ONE device_get.
-        stats = column_stats(Xd, ws)
-        if p["corr_type"] == "spearman":
-            corr = spearman_with_label(Xd, yd)
+        if mesh is None:
+            # single-device stats ride the shared training AOT store: a warm
+            # process hydrates the fused stats/correlation executables instead
+            # of tracing + compiling them (utils/export_cache.py)
+            from ..utils.export_cache import exec_cached_call
+
+            stats = exec_cached_call(column_stats, "sanity|column_stats",
+                                     args=(Xd, ws), label="stats:column_stats",
+                                     lane="stats")
+            if p["corr_type"] == "spearman":
+                corr = exec_cached_call(spearman_with_label, "sanity|spearman",
+                                        args=(Xd, yd),
+                                        label="stats:spearman", lane="stats")
+            else:
+                corr = exec_cached_call(pearson_with_label, "sanity|pearson",
+                                        args=(Xd, yd, ws),
+                                        label="stats:pearson", lane="stats")
         else:
-            corr = pearson_with_label(Xd, yd, ws)
+            stats = column_stats(Xd, ws)
+            if p["corr_type"] == "spearman":
+                corr = spearman_with_label(Xd, yd)
+            else:
+                corr = pearson_with_label(Xd, yd, ws)
 
         groups = schema.groups()
         ind_groups = [

@@ -987,6 +987,11 @@ def _cmd_warmup(argv) -> int:
     ap.add_argument("--no-aot", action="store_true",
                     help="with --serving DIR: skip consulting the bundle's "
                          "AOT artifacts and force the compile warm path")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="fan residual solo-unit compiles across N worker "
+                         "PROCESSES, each priming the shared compile cache "
+                         "and training AOT store (TT_AOT_CACHE_DIR); 0/1 = "
+                         "in-process threads (default)")
     args = ap.parse_args(argv)
     if args.export_aot and args.serving is None:
         print("op warmup: --export-aot requires --serving MODEL_DIR",
@@ -1041,7 +1046,7 @@ def _cmd_warmup(argv) -> int:
                             num_classes=args.num_classes,
                             splitter=splitter, num_folds=args.num_folds,
                             splitter_fraction=splitter_fraction,
-                            mesh_shape=args.mesh,
+                            mesh_shape=args.mesh, procs=args.procs,
                             log=lambda m: print(m, file=sys.stderr))
     import json
 

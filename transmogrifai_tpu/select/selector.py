@@ -441,8 +441,33 @@ class ModelSelector(PredictorEstimator):
                 # no block_until_ready: the refit output flows straight into the
                 # fused predict+metrics programs — forcing it here would add one
                 # ~90ms tunnel round trip purely for phase attribution
-                params = best_est.fit_fn(X_fit, y_fit, sample_weight=w_fit,
-                                         **best_est.fit_kwargs(), **warm_kw)
+                if self.mesh is None:
+                    # single-device refit rides the shared training AOT store:
+                    # static fit hyperparams fold into the blob key, warm-start
+                    # arrays ride as operands; any ineligible kwarg or store
+                    # failure falls back to the plain fit_fn call
+                    from ..stages.base import _jsonify
+                    from ..utils.export_cache import exec_cached_call
+
+                    try:
+                        pcfg = json.dumps(_jsonify(best_est.params),
+                                          sort_keys=True)
+                    except TypeError:
+                        pcfg = repr(sorted(best_est.params.items(),
+                                           key=lambda kv: kv[0]))
+                    params = exec_cached_call(
+                        best_est.fit_fn,
+                        f"refit|{best_est.__class__.__name__}|{pcfg}",
+                        args=(X_fit, y_fit),
+                        kwargs={"sample_weight": w_fit,
+                                **best_est.fit_kwargs(), **warm_kw},
+                        label=f"refit:{best_est.__class__.__name__}",
+                        lane="refit")
+                else:
+                    params = best_est.fit_fn(X_fit, y_fit,
+                                             sample_weight=w_fit,
+                                             **best_est.fit_kwargs(),
+                                             **warm_kw)
 
         summary = ModelSelectorSummary(
             validation_type=self.validator.validation_type,
@@ -588,6 +613,17 @@ def _metrics_program(template, evaluator, problem_type: str, num_classes: int):
                 pred, raw, prob = template.predict_fn(params, X)
                 return evaluator.device_metrics(pred, raw, prob, y)
         fn = jax.jit(prog)
+        # metrics programs ride the shared training AOT store too: a warm
+        # process hydrates the fused predict+metrics executable instead of
+        # tracing + compiling it (utils/export_cache.py; inert under mesh)
+        from ..utils.export_cache import ExportCachingProgram
+
+        fn = ExportCachingProgram(
+            fn,
+            key_material=f"metrics|{template.__class__.__name__}|{cfg}|"
+                         f"{problem_type}|{num_classes}",
+            label=f"metrics:{template.__class__.__name__}",
+            lane="metrics")
         with _METRICS_PROGRAM_LOCK:
             fn = _METRICS_PROGRAM_CACHE.setdefault(key, fn)
             while len(_METRICS_PROGRAM_CACHE) > _METRICS_PROGRAM_CACHE_MAX:

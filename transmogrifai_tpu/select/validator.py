@@ -229,7 +229,9 @@ def _build_search_program(key, template, static_items, problem_type, metric,
     # single-device runs only — mesh/test envs fall through to the jit)
     from ..utils.export_cache import ExportCachingProgram
 
-    fn = ExportCachingProgram(fn, key_material=repr(key))
+    fn = ExportCachingProgram(fn, key_material=repr(key),
+                              label=f"search:{type(template).__name__}",
+                              lane="search")
     # threadlint: ok OP605 - _SEARCH_PROGRAM_LOCK is held by the only
     # caller (_search_program's double-checked miss path calls here with
     # the lock still held)

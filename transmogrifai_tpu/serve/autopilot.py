@@ -75,8 +75,12 @@ class AutopilotConfig:
     #: evaluator problem type for the default evaluator factory
     problem_type: str = "binary"
     #: export AOT deploy artifacts with the candidate bundle (save pays the
-    #: compiles; the swap then hydrates instead of compiling)
-    export_aot: bool = False
+    #: compiles; the swap then hydrates instead of compiling). ON by default:
+    #: retrain candidates are born with their serving artifacts, so a
+    #: promoted challenger's first post-swap score deserializes in
+    #: milliseconds with zero compile events. An export failure degrades to
+    #: save_failed (champion keeps serving, aot_fallback_total counts it).
+    export_aot: bool = True
     #: retire (drain + release) the demoted champion after a swap instead
     #: of keeping it resident as the rollback target
     retire_old: bool = False
@@ -345,6 +349,14 @@ class Autopilot:
                     candidate.save(cand_dir, overwrite=True,
                                    aot=cfg.export_aot)
             except Exception as e:  # noqa: BLE001
+                if cfg.export_aot:
+                    # a failed AOT export is a containment event, not an
+                    # autopilot error: the champion keeps serving and the
+                    # degrade is visible on aot_fallback_total{reason=error}
+                    from .aot import note_fallback
+
+                    note_fallback("error",
+                                  f"candidate save/export: {type(e).__name__}")
                 self._count_retrain("save_failed")
                 self._event("save_failed", error=type(e).__name__)
                 return {"action": "save_failed", "error": type(e).__name__,

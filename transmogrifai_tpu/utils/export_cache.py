@@ -1,30 +1,57 @@
-"""Persistent EXPORTED-program cache: skip per-process jax tracing, not just
-XLA compilation.
+"""Persistent EXPORTED-program + SERIALIZED-EXECUTABLE caches for training.
 
-The persistent compilation cache (compile_cache.py) removes backend_compile
-time, but a fresh process still pays Python TRACING + MLIR lowering for every
-program — measured ~20 s of a 34 s warm-process `op warmup` (the selector's
-folds x grid search programs trace thousands of sub-jaxprs). `jax.export`
-serializes the traced module itself: a warm process deserializes (<10 ms) and
-calls, paying only the compiled-executable retrieval (~1-3 s for a tree search
-program vs ~21 s trace+compile).
+Two artifact tiers, mirroring the serving AOT ladder (serve/aot.py):
 
-Safety: a stale exported blob would silently replay OLD code, so the cache key
-includes a fingerprint of the package's source tree (file sizes + mtimes),
-jax's version, and the target device kind — any source edit invalidates every
-blob. Export is restricted to mesh-less (single-device) programs; sharded
-callers keep the plain jit path. Any failure (unsupported primitive, version
-skew, corrupt blob) falls back to the jit path for the life of the process.
+* **Tier 1 — exact executables** (`TT_AOT_CACHE_DIR`, default
+  `<repo>/.jax_cache/train_aot`). Every training-side program the selector
+  compiles — folds x grid search programs, the winner refit, the fused
+  predict+metrics pass, SanityChecker's fused stats — is lowered, compiled,
+  and serialized with `jax.experimental.serialize_executable` into a
+  content-addressed store keyed by (program key material, argument-aval
+  fingerprint, code fingerprint). A warm process `deserialize_and_load`s and
+  calls with ZERO XLA work — no trace, no lower, no compile. Blobs carry the
+  PR-8 compatibility stamp (jax/jaxlib versions, platform, device kind/count,
+  package code hash) INSIDE the payload, so a stale blob is detected at load,
+  counted on `aot_train_fallback_total{reason}`, and rebuilt in place — never
+  an error. `op warmup`, `Workflow.train`, CI, and replicas all share one
+  store via `TT_AOT_CACHE_DIR`.
+* **Tier 1.5 — exported modules** (`.jaxexp`). The persistent compilation
+  cache (compile_cache.py) removes backend_compile time, but a fresh process
+  still pays Python TRACING + MLIR lowering for every program — measured
+  ~20 s of a 34 s warm-process `op warmup` (the selector's folds x grid
+  search programs trace thousands of sub-jaxprs). `jax.export` serializes
+  the traced module itself: a warm process deserializes (<10 ms) and calls,
+  paying only the compiled-executable retrieval. This tier survives when the
+  exact-executable stamp goes stale (e.g. a jaxlib upgrade).
+
+Safety: a stale blob would silently replay OLD code, so tier-1.5 keys include
+a fingerprint of the package's source tree (file sizes + mtimes) and tier-1
+blobs both key on the code fingerprint and carry the full compat stamp. Both
+tiers are restricted to mesh-less (single-device) programs; sharded callers
+keep the plain jit path. Any failure (unsupported primitive, version skew,
+corrupt blob) falls back to the jit path for the life of the process.
+
+Attribution: inside `collect_aot_events()` every store consultation records
+`{key, lane, outcome: hit|hydrate|compile, seconds}` — the warmup report's
+per-executable breakdown (`op warmup --json`). Counters
+`aot_train_{hydrated,compiled}_total{lane}` and
+`aot_train_fallback_total{reason}` tick unconditionally.
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
+import pickle
 import threading
+import time
 from typing import Any, Callable, Optional
 
 _SRC_FINGERPRINT: Optional[str] = None
 _LOCK = threading.Lock()
+
+#: bounded label set for aot_train_fallback_total (cardinality hygiene)
+_TRAIN_FALLBACK_REASONS = ("stamp", "corrupt", "deserialize", "error")
 
 
 def _source_fingerprint() -> str:
@@ -67,6 +94,22 @@ def _cache_dir() -> Optional[str]:
     return os.path.join(base, "exported")
 
 
+def train_aot_dir() -> Optional[str]:
+    """The shared training executable store, or None when disabled
+    (`TT_TRAIN_AOT=0`). `TT_AOT_CACHE_DIR` points it anywhere — CI and
+    replica fleets share one directory; the default rides next to the
+    persistent compile cache."""
+    if os.environ.get("TT_TRAIN_AOT", "1") == "0":
+        return None
+    explicit = os.environ.get("TT_AOT_CACHE_DIR")
+    if explicit:
+        return explicit
+    base = (os.environ.get("TT_COMPILE_CACHE_DIR")
+            or os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache"))
+    return os.path.join(base, "train_aot")
+
+
 def _aval_fingerprint(args, kwargs=None) -> str:
     import jax
 
@@ -80,15 +123,236 @@ def _aval_fingerprint(args, kwargs=None) -> str:
     ).hexdigest()[:24]
 
 
+# --- attribution + metrics ------------------------------------------------------------
+# one module-global sink: warmup's solo fits run on threads and all of them
+# report into the SAME collection (the per-executable warmup report)
+_EVENTS_LOCK = threading.Lock()
+_EVENT_SINK: Optional[dict] = None
+
+
+@contextlib.contextmanager
+def collect_aot_events():
+    """Collect per-executable store outcomes for the duration of the block.
+    Yields the live event list: `{key, lane, outcome: hit|hydrate|compile,
+    seconds}` per consulted program ("hit" entries are deduped per program x
+    shape — a hot loop must not flood the report)."""
+    global _EVENT_SINK
+    sink = {"events": [], "seen": set()}
+    with _EVENTS_LOCK:
+        prev, _EVENT_SINK = _EVENT_SINK, sink
+    try:
+        yield sink["events"]
+    finally:
+        with _EVENTS_LOCK:
+            _EVENT_SINK = prev
+
+
+def _note_train_event(key: str, lane: str, outcome: str, seconds: float,
+                      blob: Optional[str] = None) -> None:
+    if outcome in ("hydrate", "compile"):
+        from .. import obs
+
+        name = ("aot_train_hydrated_total" if outcome == "hydrate"
+                else "aot_train_compiled_total")
+        obs.default_registry().counter(
+            name,
+            help=("training executables deserialized from the shared AOT "
+                  "store" if outcome == "hydrate" else
+                  "training executables compiled (store miss) and serialized "
+                  "into the shared AOT store"),
+            labels={"lane": lane}).inc()
+    with _EVENTS_LOCK:
+        if _EVENT_SINK is not None:
+            ev = {"key": key, "lane": lane, "outcome": outcome,
+                  "seconds": round(seconds, 4)}
+            if blob:
+                # blob basename rides along so `op warmup` can write its
+                # coverage manifest (the warm-path fast hydrate check)
+                ev["blob"] = os.path.basename(blob)
+            _EVENT_SINK["events"].append(ev)
+
+
+def _note_hit(key: str, lane: str, fp: str) -> None:
+    """An in-process reuse of an already-resolved program — recorded once per
+    (program, shape) per collection, only while a collection is active."""
+    with _EVENTS_LOCK:
+        if _EVENT_SINK is None:
+            return
+        token = (key, fp)
+        if token in _EVENT_SINK["seen"]:
+            return
+        _EVENT_SINK["seen"].add(token)
+        _EVENT_SINK["events"].append(
+            {"key": key, "lane": lane, "outcome": "hit", "seconds": 0.0})
+
+
+def note_train_fallback(reason: str, detail: str = "") -> None:
+    """ONE training-store degrade: counter + span event — the single emission
+    site, so the metric name and reason vocabulary cannot drift."""
+    if reason not in _TRAIN_FALLBACK_REASONS:
+        reason = "error"
+    from .. import obs
+
+    obs.default_registry().counter(
+        "aot_train_fallback_total",
+        help="training AOT blobs that failed to hydrate (stale stamp, "
+             "corrupt payload) and degraded to the compile path",
+        labels={"reason": reason}).inc()
+    obs.add_event("aot_train:fallback", reason=reason, detail=detail[:200])
+
+
+# --- tier-1 blob store ----------------------------------------------------------------
+class _StaleBlob(Exception):
+    """A tier-1 blob that cannot be used: carries the bounded fallback reason."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+        self.detail = detail
+
+
+def _exec_blob_path(key_material: str, fp: str) -> Optional[str]:
+    d = train_aot_dir()
+    if d is None:
+        return None
+    from ..serve.aot import code_fingerprint
+
+    # the code fingerprint rides the DIGEST (an edited package is a clean
+    # miss for new keys) AND the stamp inside the payload (so a blob written
+    # by old code under the same digest — impossible here, but cheap to
+    # verify — still reads as stale, with telemetry)
+    digest = hashlib.sha256(
+        f"exec1|{key_material}|{fp}|{code_fingerprint()}".encode()).hexdigest()
+    return os.path.join(d, f"{digest}.exec")
+
+
+def _store_executable(path: str, comp) -> None:
+    """Serialize + round-trip-check + atomically publish one executable.
+    Raises on any failure; callers treat a failed store as advisory."""
+    from jax.experimental import serialize_executable as _se
+
+    from ..serve.aot import compat_stamp
+
+    blob = pickle.dumps({"v": 1, "stamp": compat_stamp(),
+                         "payload": _se.serialize(comp)})
+    # round-trip check (the serving-export lesson): some programs serialize
+    # but cannot relink (XLA-CPU "Symbols not found" on tiny-shape fusions).
+    # A blob that cannot round-trip here can never hydrate anywhere.
+    _se.deserialize_and_load(*pickle.loads(blob)["payload"])
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+    os.replace(tmp, path)
+
+
+def _load_executable(path: str):
+    """-> loaded Compiled, or raise _StaleBlob with the bounded reason."""
+    from jax.experimental import serialize_executable as _se
+
+    try:
+        with open(path, "rb") as fh:
+            doc = pickle.loads(fh.read())
+    except Exception as e:  # noqa: BLE001 — any unpickle failure is corrupt
+        raise _StaleBlob("corrupt", f"{type(e).__name__}: {e}"[:200])
+    if not isinstance(doc, dict) or "payload" not in doc:
+        raise _StaleBlob("corrupt", "payload missing")
+    from ..serve.aot import _stamp_mismatch
+
+    mismatch = _stamp_mismatch(doc.get("stamp") or {})
+    if mismatch is not None:
+        raise _StaleBlob("stamp", mismatch)
+    try:
+        return _se.deserialize_and_load(*doc["payload"])
+    except Exception as e:  # noqa: BLE001 — relink failures degrade per blob
+        raise _StaleBlob("deserialize", f"{type(e).__name__}: {e}"[:200])
+
+
+def _consult_store(path: Optional[str], label: str, lane: str, build):
+    """THE tier-1 store protocol: hydrate if a compatible blob exists, else
+    `build()` (-> Compiled) and persist. Returns (compiled_or_None, outcome).
+    Stale blobs are counted, unlinked, and rebuilt in place. Never raises for
+    store reasons; a `build()` failure returns (None, None)."""
+    t0 = time.perf_counter()
+    if path is not None and os.path.exists(path):
+        try:
+            comp = _load_executable(path)
+        except _StaleBlob as e:
+            note_train_fallback(e.reason, f"{label}: {e.detail}")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        else:
+            _note_train_event(label, lane, "hydrate",
+                              time.perf_counter() - t0, blob=path)
+            return comp, "hydrate"
+    try:
+        comp = build()
+    except Exception:  # noqa: BLE001 — caller keeps its jit path
+        return None, None
+    stored = None
+    if path is not None:
+        try:
+            _store_executable(path, comp)
+            stored = path
+        except Exception:  # noqa: BLE001 — see retry below
+            # executables RETRIEVED from the persistent compile cache
+            # usually cannot relink after serialize (XLA-CPU "Symbols not
+            # found") — exactly the warm-compile-cache / cold-store state a
+            # first TT_AOT_CACHE_DIR run sees. One recompile with the cache
+            # bypassed yields a linkable executable; without this retry the
+            # store could never populate on a warm-cache host.
+            try:
+                comp2 = _compile_uncached(build)
+                _store_executable(path, comp2)
+                comp = comp2
+                stored = path
+            except Exception:  # noqa: BLE001 — truly unserializable
+                pass
+    _note_train_event(label, lane, "compile", time.perf_counter() - t0,
+                      blob=stored)
+    return comp, "compile"
+
+
+def _compile_uncached(build):
+    """Run `build()` with the persistent compilation cache disabled, forcing
+    a REAL compile (serialize-safe). Disabling the flag alone is not enough:
+    jit keeps an in-process memo of compiled executables, so the rebuild
+    would hand back the same cache-retrieved (unlinkable) object.
+    `jax.clear_caches()` drops that memo first — expensive, but this path
+    only runs on the rare warm-compile-cache/cold-store transition. The flag
+    is process-global, so flips are serialized under a lock; concurrent
+    compiles on other threads at worst skip the cache once — correct, just
+    slower."""
+    import jax
+
+    with _UNCACHED_LOCK:
+        prev = jax.config.jax_enable_compilation_cache
+        jax.clear_caches()
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            return build()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+
+
+_UNCACHED_LOCK = threading.Lock()
+
+
 class ExportCachingProgram:
     """Wrap a jitted program: per (args-avals) shape signature, serve calls
-    from a deserialized exported module when a blob exists; otherwise call the
-    jit path and export+persist in the SAME process so the next process skips
-    tracing. Transparent on any failure."""
+    from the tier-1 serialized executable when a compatible blob exists
+    (zero XLA work), else from a deserialized exported module, else call the
+    jit path and persist BOTH artifact tiers in the SAME process so the next
+    process skips tracing and compiling. Transparent on any failure."""
 
-    def __init__(self, fn: Callable, key_material: str):
+    def __init__(self, fn: Callable, key_material: str,
+                 label: Optional[str] = None, lane: str = "search"):
         self._fn = fn
         self._key = key_material
+        self._label = label or key_material[:48]
+        self._lane = lane
         # threadlint: ok OP601 - double-checked fast path: the bare dict get
         # in __call__ is GIL-atomic; a miss re-checks under _LOCK in
         # _load_or_build, and the fallback store only ever writes self._fn
@@ -112,10 +376,15 @@ class ExportCachingProgram:
         entry = self._by_shape.get(fp)
         if entry is None:
             entry = self._load_or_build(fp, args)
+        elif _EVENT_SINK is not None:
+            _note_hit(self._label, self._lane, fp)
         if entry is self._fn:
             return self._fn(*args)
         try:
-            return entry.call(*args)
+            # exported modules call via .call; tier-1 Compiled is callable
+            if hasattr(entry, "call"):
+                return entry.call(*args)
+            return entry(*args)
         except Exception:
             # deserialized blob unusable at call time: permanent jit fallback
             self._by_shape[fp] = self._fn
@@ -125,34 +394,146 @@ class ExportCachingProgram:
         import jax
 
         if jax.device_count() != 1:
-            # exported modules are single-device; sharded/mesh runs (and the
-            # 8-fake-device CPU test env) keep the plain jit path
+            # exported modules and serialized executables are single-device;
+            # sharded/mesh runs (and the 8-fake-device CPU test env) keep the
+            # plain jit path
             with _LOCK:
                 self._by_shape[fp] = self._fn
             return self._fn
 
+        # tier 1.5: the exported module — load (skips the python trace) or
+        # export+persist (one extra trace at first-ever build, accepted)
         path = self._blob_path(fp)
-        entry: Any = self._fn
+        exported = None
         if path is not None and os.path.exists(path):
             try:
                 with open(path, "rb") as fh:
-                    entry = jax.export.deserialize(fh.read())
+                    exported = jax.export.deserialize(fh.read())
             except Exception:
-                entry = self._fn
+                exported = None
         elif path is not None:
             try:
-                # one extra trace now (the jit call below would trace anyway;
-                # export's trace lands in jit's cache? it does not — accept the
-                # single duplicate trace at first-ever build) and persist
                 exported = jax.export.export(self._fn)(*args)
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 tmp = f"{path}.tmp.{os.getpid()}"
                 with open(tmp, "wb") as fh:
                     fh.write(exported.serialize())
                 os.replace(tmp, path)
-                entry = exported
             except Exception:
-                entry = self._fn
+                exported = None
+
+        # tier 1: the exact executable — hydrate, or compile once (from the
+        # exported module when available: its trace is already paid) and
+        # prime the shared store for every later process
+        entry: Any = exported if exported is not None else self._fn
+        epath = _exec_blob_path(self._key, fp)
+        if epath is not None:
+            def build():
+                src = (jax.jit(exported.call) if exported is not None
+                       else self._fn)
+                return src.lower(*args).compile()
+
+            comp, _outcome = _consult_store(epath, self._label, self._lane,
+                                            build)
+            if comp is not None:
+                entry = comp
         with _LOCK:
             self._by_shape[fp] = entry
         return entry
+
+
+# --- generic exec-cached call (winner refit, SanityChecker stats) ---------------------
+#: per-process memo of resolved executables: (full key, aval fp) -> Compiled
+#: or None (None = this call shape opted out; keep the plain path)
+_CALL_CACHE: dict = {}
+_CALL_LOCK = threading.Lock()
+_PLAIN = (type(None), bool, int, float, str, bytes)
+
+
+def _static_reprable(v) -> bool:
+    """Only plain data may be folded into a blob key by value — an object
+    repr with an address would poison the digest."""
+    if isinstance(v, _PLAIN):
+        return True
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return all(_static_reprable(x) for x in v)
+    if isinstance(v, dict):
+        return all(isinstance(k, _PLAIN) and _static_reprable(x)
+                   for k, x in v.items())
+    return False
+
+
+def exec_cached_call(fn: Callable, key_material: str, args=(), kwargs=None,
+                     label: Optional[str] = None, lane: str = "train"):
+    """Call `fn(*args, **kwargs)` through the tier-1 executable store.
+
+    Positional args must be array pytrees (they ride as traced operands).
+    Kwargs are split automatically: values whose tree leaves are ALL arrays
+    ride as operands; everything else is STATIC — folded into the blob key
+    by value (statics change the compiled program) and closed over at trace
+    time. Single-device only; any ineligibility (mesh, unreprable static,
+    disabled store) falls through to a plain `fn(...)` call — never an
+    error. Used for the winner refit and SanityChecker's fused stats, whose
+    jitted entry points take static hyperparameters the search-program
+    wrapper cannot express."""
+    kwargs = dict(kwargs or {})
+    import jax
+
+    if jax.device_count() != 1 or train_aot_dir() is None:
+        return fn(*args, **kwargs)
+    dyn: dict = {}
+    static: dict = {}
+    for k, v in kwargs.items():
+        leaves = jax.tree_util.tree_leaves(v)
+        if leaves and all(isinstance(x, (jax.Array,)) or hasattr(x, "__array_interface__")
+                          or hasattr(x, "__cuda_array_interface__")
+                          for x in leaves):
+            dyn[k] = v
+        elif _static_reprable(v):
+            static[k] = v
+        else:
+            # a kwarg that is neither an array operand nor plain data (e.g.
+            # a live object): this program cannot key a content-addressed
+            # store faithfully — keep the plain path
+            return fn(*args, **kwargs)
+    names = sorted(dyn)
+    flat = tuple(args) + tuple(dyn[n] for n in names)
+    static_key = repr(sorted(static.items()))
+    full_key = f"call1|{key_material}|static={static_key}|dyn={names}"
+    label = label or key_material
+    fp = _aval_fingerprint(flat)
+    memo_key = (full_key, fp)
+    comp = _CALL_CACHE.get(memo_key, False)
+    if comp is None:  # resolved earlier: this shape keeps the plain path
+        return fn(*args, **kwargs)
+    if comp is not False:
+        if _EVENT_SINK is not None:
+            _note_hit(label, lane, fp)
+        try:
+            return comp(*flat)
+        except Exception:  # noqa: BLE001 — degrade permanently, stay correct
+            with _CALL_LOCK:
+                _CALL_CACHE[memo_key] = None
+            return fn(*args, **kwargs)
+
+    n_args = len(args)
+
+    def call_flat(*fl):
+        return fn(*fl[:n_args],
+                  **{n: v for n, v in zip(names, fl[n_args:])}, **static)
+
+    def build():
+        return jax.jit(call_flat).lower(*flat).compile()
+
+    comp, _outcome = _consult_store(_exec_blob_path(full_key, fp), label,
+                                    lane, build)
+    with _CALL_LOCK:
+        _CALL_CACHE[memo_key] = comp  # None on build failure: plain path
+    if comp is None:
+        return fn(*args, **kwargs)
+    try:
+        return comp(*flat)
+    except Exception:  # noqa: BLE001 — blob unusable at call time
+        with _CALL_LOCK:
+            _CALL_CACHE[memo_key] = None
+        return fn(*args, **kwargs)

@@ -430,6 +430,22 @@ class WorkflowRunner:
             # into the registry (mesh placements, pipeline stalls, serving
             # routing/latency, drift gauges) in one Prometheus-shaped snapshot
             metrics.metrics = obs.default_registry().snapshot() or None
+            # training AOT store hit-rate at a glance: how many executables
+            # this process hydrated from the shared store vs compiled into it
+            # vs degraded (full labeled series stay in metrics.metrics)
+            snap = metrics.metrics or {}
+
+            def _aot_total(name):
+                m = snap.get(name)
+                return sum(s.get("value", 0) for s in m.get("series", ())) \
+                    if isinstance(m, dict) else 0
+
+            aot_train = {k: _aot_total(f"aot_train_{k}_total")
+                         for k in ("hydrated", "compiled", "fallback")}
+            if any(aot_train.values()):
+                if metrics.trace is None:
+                    metrics.trace = {}
+                metrics.trace["aot_train"] = aot_train
             for h in self._end_handlers:
                 h(metrics)
         result.metrics_location = result.metrics_location or params.metrics_location

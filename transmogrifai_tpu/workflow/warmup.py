@@ -17,6 +17,7 @@ when they are custom (holdout/fold row counts enter program shapes).
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Sequence
 
@@ -25,22 +26,12 @@ import numpy as np
 _PROBLEMS = ("binary", "multiclass", "regression")
 
 
-def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
-           num_classes: int = 3, seed: int = 0, models=None,
-           splitter=None, num_folds: int = 3, mesh="auto") -> dict:
-    """Run one full synthetic ModelSelector fit at (rows, bucket_width(width))
-    — compiling (and persisting) every program the same-shaped real train
-    will need. The width rounds through the SAME bucket function real trains
-    pad to (types/vector_schema.bucket_width), so any requested width lands
-    on a shape that will actually be used. Returns {problem, rows, width,
-    requested_width, wall_s}.
-
-    `mesh`: a jax Mesh, a 'n_data,n_model' shape string, None (unmeshed), or
-    "auto" (default) — resolve exactly the way Workflow.train does, so the
-    warmed search/refit/metrics programs carry the SAME shardings the real
-    meshed train will compile (a partitioned program is a different
-    executable; warming only the single-device shapes would leave a mesh
-    train cold)."""
+def _build_warm_state(problem, rows, width, num_classes, seed, models,
+                      splitter, num_folds, mesh):
+    """The deterministic synthetic fixture warmup fits against: returns
+    (selector, table, requested_width, bucketed_width). Extracted so the
+    `--procs` worker processes rebuild the EXACT same selector/table from a
+    tiny JSON spec instead of pickling live objects."""
     import jax.numpy as jnp
 
     from ..graph import FeatureBuilder
@@ -51,15 +42,9 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
     )
     from ..types import Column, Table
     from ..types.vector_schema import SlotInfo, VectorSchema, bucket_width
-    from ..utils.compile_cache import enable_compile_cache
 
     if problem not in _PROBLEMS:
         raise ValueError(f"problem must be one of {_PROBLEMS}, got {problem!r}")
-    enable_compile_cache()
-    if isinstance(mesh, (str, list, tuple)):  # shape spec, not a Mesh object
-        from ..mesh import default_mesh
-
-        mesh = default_mesh(None if mesh == "auto" else mesh)
     requested = int(width)
     width = bucket_width(requested)
     rng = np.random.default_rng(seed)
@@ -80,9 +65,6 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
         y = (X[:, 0] * 2.0 + rng.normal(size=rows)).astype(np.float32)
         selector = RegressionModelSelector.with_cross_validation(
             num_folds=num_folds, models=models, splitter=splitter, seed=seed)
-
-    from .. import obs
-
     label = FeatureBuilder("label", "RealNN").as_response()
     vec = FeatureBuilder("vec", "OPVector").as_predictor()
     selector.mesh = mesh
@@ -93,37 +75,39 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
         "label": Column.build("RealNN", [float(v) for v in y]),
         "vec": Column.vector(jnp.asarray(X), schema=schema),
     })
-    t0 = time.perf_counter()
-    with obs.span(f"warmup:{problem}:search"):
-        selector.fit_table(table)
-    # the fit above compiles every family's SEARCH programs but only the
-    # synthetic winner's REFIT + metrics programs for ONE static grid group —
-    # and the real data's winner can be any (template, static-group) pair: a
-    # cold RF refit alone traced+compiled for ~2s on the first real Titanic
-    # train. Run a one-point solo fit per (candidate, static group): refit
-    # hyperparams outside vmap_params are compile-time statics, so each group
-    # is a distinct refit/metrics program (validator._group_grid is the same
-    # partition the search itself uses). Each solo fit also compiles a G=1
-    # search program no real train reuses — accepted deliberately: going
-    # through the REAL fit path guarantees the warmed refit/metrics programs
-    # are byte-identical to what a real train builds (hand-calling fit_fn +
-    # _metrics_program here would have to mirror the selector's weight/label
-    # plumbing and silently drift).
-    from concurrent.futures import ThreadPoolExecutor
+    return selector, table, requested, width
 
-    from ..select.selector import ModelSelector
+
+def _solo_units(selector):
+    """One unit per (candidate template, static grid group) — the FULL point
+    list of the group, not a single point: a full-group solo grid hits the
+    SAME vmapped search program (key and [K,G] stack shapes) the main fit
+    already compiled, so the solo pass pays only the group's refit + fused
+    metrics programs. The old one-point grids each compiled a G=1 search
+    program no real train could ever reuse — pure waste."""
     from ..select.validator import _group_grid
 
-    # assigned just before the pool runs: the caller-side span the worker
-    # threads' spans nest under (a thread-local stack cannot see across
-    # threads, so the parent is handed over explicitly)
+    return [(template, [dict(p) for p in points])
+            for template, grid in selector.models
+            for _static, _stacks, points in _group_grid(template, grid)]
+
+
+def _run_solo_units(selector, table, units, problem, seed, mesh, obs):
+    """Run solo fits for `units` — threaded: tracing (GIL-bound) overlaps
+    XLA compiles / cache+store retrievals (GIL-released)."""
+    import os as _os
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..graph import FeatureBuilder
+    from ..select.selector import ModelSelector
+
     parent_span = None
 
-    def solo_fit(template, point):
+    def solo_fit(template, grid):
         with obs.span(f"warmup:solo:{type(template).__name__}",
                       parent=parent_span):
             solo = ModelSelector(problem_type=problem, metric=selector.metric,
-                                 models=[(template, [dict(point)])],
+                                 models=[(template, grid)],
                                  validator=selector.validator,
                                  splitter=selector.splitter, seed=seed,
                                  mesh=mesh)
@@ -131,16 +115,8 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
                  FeatureBuilder("vec", "OPVector").as_predictor())
             solo.fit_table(table)
 
-    units = [(template, points[0])
-             for template, grid in selector.models
-             for _static, _stacks, points in _group_grid(template, grid)]
-    # solo fits are independent warm-the-cache work: threads overlap their
-    # tracing (GIL-bound) with each other's XLA compiles / cache retrievals /
-    # device runs (GIL-released) — program caches are lock-protected.
     # TT_PARALLEL_COMPILE=0 serializes here too (same deterministic-compile
     # gate as the validator's overlapped unit compiles)
-    import os as _os
-
     with obs.span(f"warmup:{problem}:solo_fits") as _sp:
         parent_span = _sp
         if (len(units) > 1
@@ -148,11 +124,302 @@ def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
             with ThreadPoolExecutor(min(4, len(units))) as ex:
                 list(ex.map(lambda u: solo_fit(*u), units))
         else:
-            for template, point in units:
-                solo_fit(template, point)
+            for template, grid in units:
+                solo_fit(template, grid)
+
+
+def _spawn_solo_workers(procs, unit_count, problem, rows, width, num_classes,
+                        seed, num_folds, splitter):
+    """Popen one worker per chunk of solo units — each a fresh process that
+    rebuilds the same fixture, runs its units, and primes the SHARED caches
+    (persistent compile cache + training AOT store). Returns
+    [(Popen, [unit indices])]. Caller overlaps them with the main fit."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys
+
+    from ..select.selector import _ctor_args
+
+    spec = {"problem": problem, "rows": int(rows), "width": int(width),
+            "num_classes": int(num_classes), "seed": int(seed),
+            "num_folds": int(num_folds), "splitter": None}
+    if splitter is not None:
+        spec["splitter"] = {"class": type(splitter).__name__,
+                            "args": _ctor_args(splitter)}
+    pkg_parent = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    n = max(1, min(int(procs), unit_count))
+    chunks = [list(range(i, unit_count, n)) for i in range(n)]
+    workers = []
+    for chunk in chunks:
+        if not chunk:
+            continue
+        child_spec = dict(spec, units=chunk)
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             f"import sys; sys.path.insert(0, {pkg_parent!r}); "
+             "from transmogrifai_tpu.workflow.warmup import _solo_child_main; "
+             "_solo_child_main()"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        proc.stdin.write(_json.dumps(child_spec))
+        proc.stdin.close()
+        workers.append((proc, chunk))
+    return workers
+
+
+def _warm_manifest_path(problem, rows, width, num_classes, seed, num_folds,
+                        splitter):
+    """Path of this warm cell's coverage manifest inside the training AOT
+    store, or None when the cell is not manifest-eligible (custom models,
+    unregistered splitter, store disabled). The digest pins everything that
+    determines the cell's executable set — including the package code
+    fingerprint, so an edited tree is a clean miss, never a stale replay."""
+    import hashlib
+    import json as _json
+    import os as _os
+
+    from ..serve.aot import code_fingerprint
+    from ..utils.export_cache import train_aot_dir
+
+    d = train_aot_dir()
+    if d is None:
+        return None
+    if splitter is None:
+        sp_spec = "default"
+    else:
+        from ..select.selector import _SPLITTER_CLASSES, _ctor_args
+
+        if type(splitter).__name__ not in _SPLITTER_CLASSES:
+            return None
+        try:
+            sp_spec = {"class": type(splitter).__name__,
+                       "args": _ctor_args(splitter)}
+        except Exception:  # noqa: BLE001 — unserializable splitter: no cell
+            return None
+    spec = {"problem": problem, "rows": int(rows), "width": int(width),
+            "num_classes": int(num_classes), "seed": int(seed),
+            "num_folds": int(num_folds), "splitter": sp_spec,
+            "models": "default", "code": code_fingerprint()}
+    digest = hashlib.sha256(
+        _json.dumps(spec, sort_keys=True).encode()).hexdigest()
+    return _os.path.join(d, f"warmcell-{digest}.json")
+
+
+def _fast_hydrate(manifest_path):
+    """The warm-cache `op warmup` fast path: hydrate-VERIFY every executable
+    the cell's last full warmup consulted — proof the store covers this
+    shape — without re-running the fits (a warm store makes re-executing
+    RF/GBT search programs pure wasted compute; the cold path's wall is
+    compile-dominated, the warm path's would be execution-dominated).
+    Returns the event list on full coverage, None when anything is missing
+    or stale (caller falls back to the full fit path, which re-warms and
+    rewrites the manifest)."""
+    import json as _json
+    import os as _os
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..utils import export_cache as _ec
+
+    try:
+        with open(manifest_path) as fh:
+            entries = _json.load(fh)["executables"]
+    except Exception:  # noqa: BLE001 — corrupt manifest: full path re-warms
+        try:
+            _os.unlink(manifest_path)
+        except OSError:
+            pass
+        return None
+    d = _ec.train_aot_dir()
+    if not entries or d is None:
+        return None
+    paths = [_os.path.join(d, e["blob"]) for e in entries]
+    if not all(_os.path.exists(p) for p in paths):
+        return None  # evicted/unlinked blob: clean miss, no fallback count
+
+    def check(item):
+        e, path = item
+        t0 = _time.perf_counter()
+        _ec._load_executable(path)  # raises _StaleBlob on stamp/corrupt
+        _ec._note_train_event(e["key"], e["lane"], "hydrate",
+                              _time.perf_counter() - t0, blob=path)
+
+    try:
+        with ThreadPoolExecutor(min(4, len(entries))) as ex:
+            list(ex.map(check, zip(entries, paths)))
+    except _ec._StaleBlob as e:
+        _ec.note_train_fallback(e.reason, f"warm manifest: {e.detail}")
+        return None
+    return True
+
+
+def _solo_child_main():  # pragma: no cover - exercised via subprocess
+    """Entry point of one `--procs` worker: read the JSON spec from stdin,
+    rebuild the fixture, run the assigned solo units, report attribution."""
+    import json as _json
+    import sys
+
+    from .. import obs
+    from ..select.selector import _SPLITTER_CLASSES, _restore_by_ctor
+    from ..utils.compile_cache import enable_compile_cache
+    from ..utils.export_cache import collect_aot_events
+
+    spec = _json.loads(sys.stdin.read())
+    enable_compile_cache()
+    splitter = None
+    if spec.get("splitter"):
+        splitter = _restore_by_ctor(_SPLITTER_CLASSES, spec["splitter"])
+    selector, table, _req, _w = _build_warm_state(
+        spec["problem"], spec["rows"], spec["width"], spec["num_classes"],
+        spec["seed"], None, splitter, spec["num_folds"], None)
+    units = _solo_units(selector)
+    mine = [units[i] for i in spec["units"] if i < len(units)]
+    with collect_aot_events() as events:
+        _run_solo_units(selector, table, mine, spec["problem"], spec["seed"],
+                        None, obs)
+    sys.stdout.write("WARMCHILD=" + _json.dumps({"executables": events})
+                     + "\n")
+
+
+def warmup(problem: str = "binary", rows: int = 891, width: int = 128,
+           num_classes: int = 3, seed: int = 0, models=None,
+           splitter=None, num_folds: int = 3, mesh="auto",
+           procs: int = 0) -> dict:
+    """Run one full synthetic ModelSelector fit at (rows, bucket_width(width))
+    — compiling (and persisting) every program the same-shaped real train
+    will need. The width rounds through the SAME bucket function real trains
+    pad to (types/vector_schema.bucket_width), so any requested width lands
+    on a shape that will actually be used. Returns {problem, rows, width,
+    requested_width, wall_s, executables, cache, aot_store}: `executables`
+    attributes every consulted program as `{key, lane, outcome:
+    hit|hydrate|compile, seconds}` (training AOT store, utils/export_cache.py)
+    and `cache` totals them — an `op_warmup_s` regression is answerable from
+    the report alone.
+
+    `mesh`: a jax Mesh, a 'n_data,n_model' shape string, None (unmeshed), or
+    "auto" (default) — resolve exactly the way Workflow.train does, so the
+    warmed search/refit/metrics programs carry the SAME shardings the real
+    meshed train will compile (a partitioned program is a different
+    executable; warming only the single-device shapes would leave a mesh
+    train cold).
+
+    `procs > 1` fans the residual solo-unit compiles across that many worker
+    PROCESSES (true compile parallelism — threads only overlap tracing with
+    XLA), each priming the shared caches; requires default models, an
+    unmeshed run, and a reconstructible splitter, else it silently uses the
+    in-process thread pool."""
+    from .. import obs
+    from ..select.selector import _SPLITTER_CLASSES
+    from ..utils.compile_cache import enable_compile_cache
+    from ..utils.export_cache import collect_aot_events, train_aot_dir
+
+    enable_compile_cache()
+    if isinstance(mesh, (str, list, tuple)):  # shape spec, not a Mesh object
+        from ..mesh import default_mesh
+
+        mesh = default_mesh(None if mesh == "auto" else mesh)
+    t_start = time.perf_counter()
+    manifest = (_warm_manifest_path(problem, rows, width, num_classes, seed,
+                                    num_folds, splitter)
+                if models is None and mesh is None else None)
+    if manifest is not None and os.path.exists(manifest):
+        with collect_aot_events() as events:
+            covered = _fast_hydrate(manifest)
+        if covered:
+            cache = {"hit": 0, "hydrate": len(events), "compile": 0}
+            store = train_aot_dir()
+            from ..types.vector_schema import bucket_width
+
+            return {"problem": problem, "rows": int(rows),
+                    "width": bucket_width(int(width)),
+                    "requested_width": int(width),
+                    "wall_s": round(time.perf_counter() - t_start, 2),
+                    "executables": list(events), "cache": cache,
+                    "aot_store": {"enabled": store is not None,
+                                  "dir": store}}
+    selector, table, requested, width = _build_warm_state(
+        problem, rows, width, num_classes, seed, models, splitter, num_folds,
+        mesh)
+    units = _solo_units(selector)
+    workers = []
+    if (procs and int(procs) > 1 and len(units) > 1 and models is None
+            and mesh is None
+            and (splitter is None
+                 or type(splitter).__name__ in _SPLITTER_CLASSES)):
+        try:
+            workers = _spawn_solo_workers(procs, len(units), problem, rows,
+                                          requested, num_classes, seed,
+                                          num_folds, splitter)
+        except Exception:  # noqa: BLE001 — fan-out is an optimization only
+            workers = []
+    t0 = time.perf_counter()
+    with collect_aot_events() as events:
+        with obs.span(f"warmup:{problem}:search"):
+            selector.fit_table(table)
+        # the fit above compiles every family's SEARCH programs but only the
+        # synthetic winner's REFIT + metrics programs for ONE static grid
+        # group — and the real data's winner can be any (template,
+        # static-group) pair: a cold RF refit alone traced+compiled for ~2s
+        # on the first real Titanic train. Run a full-group solo fit per
+        # (candidate, static group): refit hyperparams outside vmap_params
+        # are compile-time statics, so each group is a distinct refit/metrics
+        # program (validator._group_grid is the same partition the search
+        # itself uses). Going through the REAL fit path guarantees the warmed
+        # refit/metrics programs are byte-identical to what a real train
+        # builds (hand-calling fit_fn + _metrics_program here would have to
+        # mirror the selector's weight/label plumbing and silently drift).
+        if workers:
+            import json as _json
+
+            done_remote: set = set()
+            for proc, chunk in workers:
+                try:
+                    out, _ = proc.communicate(timeout=900)
+                except Exception:  # noqa: BLE001 — worker death is re-run
+                    proc.kill()
+                    continue
+                for line in (out or "").splitlines():
+                    if line.startswith("WARMCHILD="):
+                        child = _json.loads(line[len("WARMCHILD="):])
+                        events.extend(child.get("executables", []))
+                        done_remote.update(chunk)
+            # any worker that died re-runs its units in-process — fan-out
+            # failure must never leave the cache half-warm
+            residual = [u for i, u in enumerate(units) if i not in done_remote]
+            if residual:
+                _run_solo_units(selector, table, residual, problem, seed,
+                                mesh, obs)
+        else:
+            _run_solo_units(selector, table, units, problem, seed, mesh, obs)
+    cache = {"hit": 0, "hydrate": 0, "compile": 0}
+    for e in events:
+        if e.get("outcome") in cache:
+            cache[e["outcome"]] += 1
+    store = train_aot_dir()
+    if manifest is not None and store is not None:
+        # publish this cell's coverage manifest: blob-backed executables the
+        # full path consulted. The next same-cell warmup hydrate-verifies
+        # these in seconds instead of re-running the fits.
+        blob_entries = [{"key": e["key"], "lane": e["lane"],
+                         "blob": e["blob"]}
+                        for e in events if e.get("blob")]
+        if blob_entries:
+            import json as _json
+
+            tmp = f"{manifest}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as fh:
+                    _json.dump({"executables": blob_entries}, fh)
+                os.replace(tmp, manifest)
+            except OSError:
+                pass
     return {"problem": problem, "rows": int(rows), "width": int(width),
             "requested_width": requested,
-            "wall_s": round(time.perf_counter() - t0, 2)}
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "executables": list(events), "cache": cache,
+            "aot_store": {"enabled": store is not None, "dir": store}}
 
 
 def warm_serving_handle(fn, buckets: Sequence[int] = None, floor: int = 1,
@@ -237,6 +504,7 @@ def warmup_matrix(problems: Sequence[str] = ("binary",),
                   num_folds: int = 3,
                   splitter_fraction=None,
                   mesh_shape=None,
+                  procs: int = 0,
                   log=print) -> list[dict]:
     """Warm every (problem, width) combination; returns the per-cell reports.
 
@@ -257,7 +525,11 @@ def warmup_matrix(problems: Sequence[str] = ("binary",),
         for w in widths:
             rep = warmup(problem=p, rows=rows, width=int(w),
                          num_classes=num_classes, models=models,
-                         splitter=sp, num_folds=num_folds, mesh=mesh)
-            log(f"warmed {p} rows={rows} width={w}: {rep['wall_s']}s")
+                         splitter=sp, num_folds=num_folds, mesh=mesh,
+                         procs=procs)
+            c = rep.get("cache", {})
+            log(f"warmed {p} rows={rows} width={w}: {rep['wall_s']}s "
+                f"(hit={c.get('hit', 0)} hydrate={c.get('hydrate', 0)} "
+                f"compile={c.get('compile', 0)})")
             out.append(rep)
     return out
