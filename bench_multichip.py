@@ -382,6 +382,20 @@ def run_gbt_data_axis_lane(meshes: dict, quick: bool,
     return out
 
 
+def _record_engaged(out: dict) -> dict:
+    """Join keys for `op autotune` trial logs (tune/trials.py candidate
+    labels are mesh/split/knob strings): the mesh shapes this lane actually
+    engaged plus the ambient kernel-knob env the fits resolved into jit
+    static args. With these on every lane, a MULTICHIP record and a tuner
+    trial measured under the same config are joinable by equality."""
+    out["engaged"] = {
+        "mesh_shapes": sorted(out.get("per_shape", {})),
+        "tt_split": os.environ.get("TT_SPLIT", ""),
+        "tt_row_tile": int(os.environ.get("TT_ROW_TILE", "0") or 0),
+    }
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -415,6 +429,9 @@ def main() -> None:
     detail["gbt_mesh"] = run_gbt_mesh_lane(meshes, ARGS.quick, forced_host)
     detail["gbt_data_axis"] = run_gbt_data_axis_lane(meshes, ARGS.quick,
                                                      forced_host)
+    for lane in ("stats", "scoring", "selector", "mlp_sharded", "gbt_mesh",
+                 "gbt_data_axis"):
+        _record_engaged(detail[lane])
 
     stats_eff = detail["stats"].get("scaling_efficiency")
     scoring_eff = detail["scoring"].get("scaling_efficiency")

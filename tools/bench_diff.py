@@ -48,9 +48,18 @@ _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
 #: ("retention" covers every *_throughput_retention overhead lane — monitor,
 #: resilience, and fleet_obs: observed/bare rows-per-sec ratios whose floor
 #: is "the instrumented path must stay within a few percent of free")
+#: ("speedup" also covers the autotune lane's headline autotune_speedup —
+#: tuned/default train throughput, floor 1.0 by construction — and
+#: "rows_per" its autotune_tuned_rows_per_sec; autotune_winner_rel_error
+#: rides the "rel_error" lower-is-better fragment like the explain lane)
 _HIGHER_BETTER = ("per_sec", "per_s", "models_per", "rows_per", "mfu",
                   "accuracy", "auroc", "aupr", "r2", "f1", "speedup",
                   "tflops", "flops", "efficiency", "retention")
+#: configuration OUTCOMES, not performance metrics: the autotune lane
+#: records WHICH knob won (autotune_chosen_bins / autotune_chosen_tile) and
+#: how many knobs the search timed — a different winner or a resized smoke
+#: space is information for the trial-log join, never a regression
+_NEUTRAL_SUBSTR = ("chosen_bins", "chosen_tile", "knobs_measured")
 #: ABSOLUTE floor for every *_throughput_retention lane, checked on the NEW
 #: record alone (the relative diff can't catch a slow multi-PR slide, and a
 #: brand-new retention lane has no old value to diff against): instrumented
@@ -114,8 +123,12 @@ def compare(old: dict[str, float], new: dict[str, float],
     rows = []
     for name in sorted(set(old) & set(new)):
         a, b = old[name], new[name]
-        lower = lower_is_better(name)
         ratio: Optional[float] = (b / a) if a else None
+        if any(frag in name.lower() for frag in _NEUTRAL_SUBSTR):
+            rows.append({"metric": name, "old": a, "new": b, "ratio": ratio,
+                         "direction": "config", "regressed": False})
+            continue
+        lower = lower_is_better(name)
         if a == 0:
             regressed = lower and b > 0
         elif lower:
@@ -154,9 +167,10 @@ def main(argv=None) -> int:
     for r in rows:
         flag = "REGRESSED" if r["regressed"] else ""
         ratio = f"{r['ratio']:.3f}x" if r["ratio"] is not None else "   -  "
+        dirtxt = "config record" if r["direction"] == "config" \
+            else f"{r['direction']} is better"
         print(f"{r['metric']:<{width}}  {r['old']:>12.4g}  ->  "
-              f"{r['new']:>12.4g}  {ratio:>8}  ({r['direction']} is better)"
-              f"  {flag}")
+              f"{r['new']:>12.4g}  {ratio:>8}  ({dirtxt})  {flag}")
     floored = [(k, v) for k, v in sorted(new.items())
                if k.endswith("_throughput_retention") and v < _RETENTION_FLOOR]
     for k, v in floored:

@@ -744,6 +744,19 @@ OP503_ICI_GBPS_DEFAULT = 90.0
 OP503_PEAK_TFLOPS_DEFAULT = 100.0
 
 
+def hbm_budget_bytes() -> int:
+    """The OP501 per-device HBM budget with its env override chain
+    (TT_OP501_HBM_BYTES > TT_OP405_HBM_BYTES > the v5e-class default).
+    Shared by pass_resources and the autotuner's static pruning
+    (tune/ranker.py) so a candidate pruned by the tuner is exactly a
+    candidate the `Workflow.train` explain gate would reject."""
+    import os
+
+    return int(os.environ.get(
+        "TT_OP501_HBM_BYTES",
+        os.environ.get("TT_OP405_HBM_BYTES", OP405_HBM_BYTES_DEFAULT)))
+
+
 def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
     """OP501-505: price the plan on `ctx.mesh_shape` via the static resource
     model (shard_model.build_resource_model — pure host arithmetic, zero
@@ -764,9 +777,7 @@ def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
     rm = build_resource_model(
         ctx.result_features, ctx.dag, mesh_shape=ctx.mesh_shape,
         n_rows=ctx.n_rows, raw_features=ctx.raw_features)
-    budget = int(os.environ.get(
-        "TT_OP501_HBM_BYTES",
-        os.environ.get("TT_OP405_HBM_BYTES", OP405_HBM_BYTES_DEFAULT)))
+    budget = hbm_budget_bytes()
     pad_frac_max = float(os.environ.get("TT_OP502_PAD_FRAC",
                                         OP502_PAD_FRAC_DEFAULT))
     ici_gbps = float(os.environ.get("TT_ICI_GBPS", OP503_ICI_GBPS_DEFAULT))
@@ -787,7 +798,8 @@ def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
                 stage_uid=sr.stage_uid,
                 hint="grow the data axis (state and rows shard 1/N), shrink "
                      "the model, or raise TT_OP501_HBM_BYTES if the part "
-                     "has headroom")
+                     "has headroom — `op autotune` searches mesh shapes "
+                     "with infeasible candidates pruned on this budget")
         row_frac = pad_row_fraction(sr, rm.n_rows)
         frac = max(row_frac, sr.grid_pad_frac)
         if frac > pad_frac_max:
@@ -801,7 +813,8 @@ def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
                 f"{n_data}x{n_model}: {what}",
                 stage_uid=sr.stage_uid,
                 hint="pick an axis size that divides the work, or accept the "
-                     "waste and raise TT_OP502_PAD_FRAC")
+                     "waste and raise TT_OP502_PAD_FRAC — `op autotune` "
+                     "prices the padding into every candidate's score")
         if sr.collective_bytes and sr.flops:
             comm_s = sr.collective_bytes / (ici_gbps * 1e9)
             comp_s = sr.flops / (peak_tflops * 1e12)
@@ -815,7 +828,9 @@ def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
                     f"({sr.flops} flops at {peak_tflops:g} TFLOP/s)",
                     stage_uid=sr.stage_uid,
                     hint="fewer, larger shards: shrink the axis this stage "
-                         "psums over, or grow the per-device work")
+                         "psums over, or grow the per-device work — "
+                         "`op autotune` ranks the alternatives on this "
+                         "same comm-vs-compute model")
 
     if n_data > 1 or n_model > 1:
         data_used = any(sr.rows_sharded or sr.opt_sharded for sr in rm.stages)
@@ -834,7 +849,8 @@ def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
                 "hold full copies and idle",
                 hint="shrink the mesh to the axes the plan can use, or add "
                      "a shardable stage (divisible rows/features, "
-                     "shard_optimizer, a model grid)")
+                     "shard_optimizer, a model grid) — `op autotune` "
+                     "enumerates every usable factorization for you")
 
     for s in ctx.stages():
         models = getattr(s, "models", None)
@@ -852,7 +868,8 @@ def pass_resources(ctx: PlanContext) -> Iterator[Diagnostic]:
                     "cannot shard_map) — the pin only binds the winner refit",
                     stage_uid=s.uid,
                     hint="use shard_optimizer='auto' for search candidates; "
-                         "budget search memory via the grid size instead")
+                         "budget search memory via the grid size instead "
+                         "(`op autotune` searches the knob per-plan)")
 
 
 #: pass registry, run in order by the analyzer

@@ -225,6 +225,11 @@ def _load_app_workflow(app_spec, prog: str):
         print(f"{prog}: the app's workflow has no result features",
               file=sys.stderr)
         return 2
+    # a runner keeps the reader beside the workflow; commands that actually
+    # train (op autotune trials) need it bound on the workflow itself
+    reader = getattr(app, "train_reader", None)
+    if reader is not None and getattr(workflow, "reader", None) is None:
+        workflow.set_reader(reader)
     return workflow
 
 
@@ -249,6 +254,10 @@ def _cmd_explain(argv) -> int:
                     help="fallback width for vector stages whose width cannot "
                          "be derived statically (default 64, env "
                          "TT_EXPLAIN_ASSUME_WIDTH)")
+    ap.add_argument("--suggest", action="store_true",
+                    help="also print the top-3 statically-ranked configs from "
+                         "the autotune search space (zero trials — run "
+                         "`op autotune` to measure them)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit {resource_model, report} as JSON on stdout")
     args = ap.parse_args(argv)
@@ -270,17 +279,122 @@ def _cmd_explain(argv) -> int:
         workflow_cv=getattr(workflow, "_workflow_cv", False),
         mesh_shape=mesh_shape, n_rows=args.rows,
         rules=("OP501", "OP502", "OP503", "OP504", "OP505"))
+    suggestions = []
+    if args.suggest:
+        import jax
+
+        from transmogrifai_tpu.tune import suggest_configs
+
+        suggestions = suggest_configs(
+            workflow.result_features, dag, n_rows=args.rows or 4096,
+            n_devices=len(jax.devices()), raw_features=raw,
+            assume_width=args.assume_width)
     if args.as_json:
         import json
 
-        print(json.dumps({"resource_model": rm.to_json(),
-                          "report": report.to_json()}, indent=1))
+        doc = {"resource_model": rm.to_json(), "report": report.to_json()}
+        if args.suggest:
+            doc["suggest"] = [r.to_json() for r in suggestions]
+        print(json.dumps(doc, indent=1))
     else:
         print(rm.pretty())
         if report.errors or report.warnings:
             print()
             print(report.pretty())
+        if args.suggest:
+            print()
+            print("top statically-ranked configs (predicted; measure with "
+                  "`op autotune`):")
+            for i, r in enumerate(suggestions):
+                print(f"  {i + 1}. {r.candidate.label:36s} "
+                      f"~{r.score_s * 1e3:.3g} ms/train  "
+                      f"hbm {r.hbm_bytes} B/device")
     return 1 if report.has_errors else 0
+
+
+def _cmd_autotune(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="op autotune",
+        description="cost-model-driven configuration search: enumerate mesh "
+                    "shapes, TT_SPLIT, shard_optimizer, and GBT kernel knobs; "
+                    "rank every candidate on the static resource model "
+                    "(HBM-infeasible points pruned on the OP501 budget); "
+                    "measure the top-k through the real train path; regress "
+                    "the measured walls back onto the model constants "
+                    "(calibration.json per device kind); stamp the winner "
+                    "into model.json as tuned_config")
+    ap.add_argument("--app", default=None,
+                    help="module:function returning a WorkflowRunner or a "
+                         "Workflow (called once per trial — must build a "
+                         "fresh workflow each call)")
+    ap.add_argument("--rows", type=int, default=None, required=False,
+                    help="training row count (prices activations/padding and "
+                         "scales rows/s; required)")
+    ap.add_argument("--space", choices=("default", "tiny"), default="default",
+                    help="search space: 'default' is every mesh factorization "
+                         "x split x knob ladders; 'tiny' is the CI smoke "
+                         "space")
+    ap.add_argument("--top-k", type=int, default=5, dest="top_k",
+                    help="measured trials (static rank order, default 5)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="workload seed recorded in the stamp (default 0)")
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="warm re-trains per trial; the best warm wall "
+                         "scores the trial (default 1)")
+    ap.add_argument("--calibration", default=None, metavar="PATH",
+                    help="calibration.json path (default: "
+                         "$TT_AOT_CACHE_DIR/calibration.json)")
+    ap.add_argument("--no-calibrate", action="store_true",
+                    help="do not write calibration.json (replay runs)")
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="save the winning trial's model (with tuned_config "
+                         "stamped) to this bundle dir")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full TuneReport as JSON on stdout")
+    args = ap.parse_args(argv)
+
+    probe = _load_app_workflow(args.app, "op autotune")
+    if isinstance(probe, int):
+        return probe
+    if not args.rows:
+        print("op autotune: --rows N is required (the candidate scores and "
+              "rows/s scale with it)", file=sys.stderr)
+        return 2
+
+    import jax
+
+    from transmogrifai_tpu.tune import ConfigSpace, autotune
+
+    n_devices = len(jax.devices())
+    space = ConfigSpace.tiny(n_devices) if args.space == "tiny" \
+        else ConfigSpace.default(n_devices)
+
+    def factory():
+        wf = _load_app_workflow(args.app, "op autotune")
+        if isinstance(wf, int):  # app broke between trials
+            raise RuntimeError(f"--app {args.app} no longer resolves")
+        return wf
+
+    model, report = autotune(
+        factory, n_rows=args.rows, space=space, top_k=args.top_k,
+        seed=args.seed, repeats=args.repeats,
+        calibration_path=args.calibration,
+        calibrate=not args.no_calibrate,
+        log=(None if args.as_json else print))
+    if args.as_json:
+        import json
+
+        print(json.dumps(report.to_json(), indent=1))
+    if report.winner is None:
+        if not args.as_json:
+            print("op autotune: no trial succeeded", file=sys.stderr)
+        return 1
+    if model is not None and args.out:
+        model.save(args.out)
+        if not args.as_json:
+            print(f"[autotune] saved winner (tuned_config stamped) to "
+                  f"{args.out}")
+    return 0
 
 
 def _cmd_lint(argv) -> int:
@@ -1165,7 +1279,11 @@ def main(argv=None) -> int:
             "under locks) ([PATH...] [--json] [--rules] [--baseline FILE])\n"
             "  explain   predict per-device HBM, collective traffic and "
             "padding waste per stage, before any trace "
-            "(--app module:fn [--mesh D,M] [--rows N] [--json])\n"
+            "(--app module:fn [--mesh D,M] [--rows N] [--suggest] [--json])\n"
+            "  autotune  search mesh/split/kernel-knob configs: rank on the "
+            "static resource model, measure the top-k, calibrate the "
+            "constants, stamp the winner into model.json "
+            "(--app module:fn --rows N [--top-k K] [--out DIR])\n"
             "  monitor   serving telemetry: drift report vs the model's "
             "training baseline + metrics export (--model DIR [--scoring CSV] "
             "| --demo | --fleet TARGET) [--prom|--json]\n"
@@ -1206,6 +1324,8 @@ def main(argv=None) -> int:
         return _cmd_threadlint(rest)
     if cmd == "explain":
         return _cmd_explain(rest)
+    if cmd == "autotune":
+        return _cmd_autotune(rest)
     if cmd == "monitor":
         return _cmd_monitor(rest)
     if cmd == "top":

@@ -42,7 +42,8 @@ from typing import Callable, Iterable, Optional, Union
 from . import metrics as _metrics
 
 __all__ = [
-    "FleetAggregator", "MetricsPusher", "fleet_totals", "render_top",
+    "FleetAggregator", "MetricsPusher", "fleet_totals", "measured_resources",
+    "render_top",
     "stitch_chrome_traces",
 ]
 
@@ -316,6 +317,23 @@ def _sum_suffix(row: dict, suffix: str) -> float:
 _BREAKER_STATES = {0: "closed", 1: "OPEN", 2: "half"}
 
 
+def measured_resources(metrics_snapshot: dict) -> dict:
+    """Fleet-measured counterpart of the static ResourceModel totals.
+
+    Pulls the counters the cost model prices — collective traffic and
+    resident optimizer state — out of a merged metrics snapshot, keyed to
+    match `ResourceModel` totals so callers can diff them directly. Shared
+    by the `op top` measured-vs-predicted block and the `op autotune`
+    calibration feed (a live fleet's counters are calibration rows the
+    tuner did not have to train for)."""
+    return {
+        "collective_bytes": fleet_totals(metrics_snapshot,
+                                         "mesh_collective_bytes_total"),
+        "hbm_bytes": fleet_totals(metrics_snapshot,
+                                  "train_optimizer_state_bytes"),
+    }
+
+
 def render_top(prev: Optional[dict], cur: dict, dt_s: float,
                predictions: Optional[dict] = None) -> str:
     """Render one `op top` frame from two successive fleet snapshots.
@@ -354,10 +372,7 @@ def render_top(prev: Optional[dict], cur: dict, dt_s: float,
             f"{(f'{drift:.4f}' if drift is not None else '-'):>8} "
             f"{dumps:>6.0f}")
     if predictions:
-        measured = {
-            "collective_bytes": fleet_totals(cur, "mesh_collective_bytes_total"),
-            "hbm_bytes": fleet_totals(cur, "train_optimizer_state_bytes"),
-        }
+        measured = measured_resources(cur)
         lines.append("")
         lines.append(f"{'RESOURCE':<18} {'PREDICTED':>14} {'MEASURED':>14} "
                      f"{'rel_error':>10}")

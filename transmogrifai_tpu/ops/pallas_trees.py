@@ -41,24 +41,64 @@ that aggregation layer at data scale.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-#: rows per grid step (VMEM tile height) — measured best among 1024/2048/4096
+#: rows per grid step (VMEM tile height) — the hand-measured default
+#: (best among 1024/2048/4096 on v5e at the gbt_scale shape). Since the
+#: autotune PR this is a real per-call parameter (`row_tile=` on every
+#: kernel below, TT_ROW_TILE env as the process default) so the tuner can
+#: search it instead of trusting one measurement forever.
 ROW_TILE = 2048
+
+#: the ladder `op autotune` searches (tune/space.py); every value must be a
+#: positive multiple of 128 (the tile's lane dimension for the transposed
+#: node/vals operands — see _resolve_row_tile)
+ROW_TILE_CHOICES = (1024, 2048, 4096)
 
 #: VMEM budget for the resident accumulator [n_bins * M, D] f32
 _ACC_BYTES_MAX = 8 << 20
 
 
+def _resolve_row_tile(row_tile: int | None = None) -> int:
+    """Effective rows-per-tile: explicit argument > TT_ROW_TILE env > ROW_TILE.
+
+    Tiles must be positive multiples of 128: ROW_TILE rides as the LANE
+    dimension of the node/vals blocks ((1, tile) / (V, tile)) and the int8
+    sublane dimension of the binned-matrix block — 128 satisfies both
+    alignments on current TPUs."""
+    tile = int(row_tile or os.environ.get("TT_ROW_TILE", 0) or ROW_TILE)
+    if tile <= 0 or tile % 128:
+        raise ValueError(
+            f"row_tile must be a positive multiple of 128, got {tile}")
+    return tile
+
+
 def histogram_mxu_supported(n_rows: int, n_feats: int, n_nodes: int,
-                            n_channels: int, n_bins: int) -> bool:
-    """Static-shape gate: the accumulator must fit VMEM and bins must be int8."""
+                            n_channels: int, n_bins: int,
+                            row_tile: int | None = None) -> bool:
+    """Static-shape gate: the accumulator must fit VMEM and bins must be int8.
+
+    `row_tile` participates so the tuner can prune tile candidates with the
+    same gate the runtime uses: a tile whose streaming buffers (int8 binned
+    block + f32 vals block) would crowd the accumulator out of VMEM is
+    infeasible, not merely slow."""
     M = n_nodes * n_channels
     Dp = (n_feats + 127) // 128 * 128
-    return n_bins <= 127 and n_bins * M * Dp * 4 <= _ACC_BYTES_MAX
+    try:
+        tile = _resolve_row_tile(row_tile)
+    except ValueError:
+        return False
+    # double-buffered worst case: 2 tiles of int8 Xb + f32 vals/node stream
+    # beside the accumulator; each side gets half the ~16 MB VMEM so the
+    # accumulator gate at the default tile is unchanged from before the knob
+    stream_bytes = 2 * tile * (Dp + (n_channels + 1) * 4)
+    return (n_bins <= 127
+            and n_bins * M * Dp * 4 <= _ACC_BYTES_MAX
+            and stream_bytes <= _ACC_BYTES_MAX)
 
 
 def _accumulate_hist(node_ref, vals_ref, xb_ref, acc_ref, *, n_bins, n_nodes,
@@ -96,7 +136,7 @@ def _hist_kernel(node_ref, vals_ref, xb_ref, out_ref, *, n_bins, n_nodes, V):
 
 
 def histogram_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
-                  n_nodes: int, n_bins: int, *,
+                  n_nodes: int, n_bins: int, *, row_tile: int | None = None,
                   interpret: bool = False) -> jnp.ndarray:
     """Sum vals [N, V] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, V].
 
@@ -104,15 +144,17 @@ def histogram_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     accumulation (masks are exact in bf16; vals round at ~2^-9 relative — split
     GAINS see that rounding, leaf VALUES never do, they are refit in f32 by the
     caller). Rows pad with node=-1 (zero mass), features pad with bin -1
-    (matches no bin)."""
+    (matches no bin). `row_tile` picks the VMEM tile height (default
+    TT_ROW_TILE env, then ROW_TILE) — the knob `op autotune` searches."""
     if n_bins > 127:
         # bins ride int8 through HBM; a forced TT_HIST=mxu with wide bins
         # must fail loudly, not silently drop the mass of bins >= 128
         raise ValueError(f"histogram_mxu supports n_bins <= 127, got {n_bins}")
+    tile = _resolve_row_tile(row_tile)
     N, D = Xb.shape
     V = vals.shape[1]
     M = V * n_nodes
-    row_pad = (-N) % ROW_TILE
+    row_pad = (-N) % tile
     f_pad = (-D) % 128
     Dp = D + f_pad
     xb8 = jnp.pad(Xb.astype(jnp.int8), ((0, row_pad), (0, f_pad)),
@@ -122,11 +164,11 @@ def histogram_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
 
     out = pl.pallas_call(
         functools.partial(_hist_kernel, n_bins=n_bins, n_nodes=n_nodes, V=V),
-        grid=((N + row_pad) // ROW_TILE,),
+        grid=((N + row_pad) // tile,),
         in_specs=[
-            pl.BlockSpec((1, ROW_TILE), lambda i: (0, i)),
-            pl.BlockSpec((V, ROW_TILE), lambda i: (0, i)),
-            pl.BlockSpec((ROW_TILE, Dp), lambda i: (i, 0)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((V, tile), lambda i: (0, i)),
+            pl.BlockSpec((tile, Dp), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((n_bins * M, Dp), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((n_bins * M, Dp), jnp.float32),
@@ -139,12 +181,13 @@ _SPLIT_EPS = 1e-8  # MUST equal ops/trees._EPS: gains are compared across paths
 
 
 def fused_split_supported(n_rows: int, n_feats: int, n_nodes: int,
-                          n_channels: int, n_bins: int) -> bool:
+                          n_channels: int, n_bins: int,
+                          row_tile: int | None = None) -> bool:
     """Static-shape gate for the fused histogram->split kernel: the histogram
     accumulator (now a VMEM scratch, not an output) must fit the same budget,
     and there must be at least one candidate bin."""
     return n_bins >= 2 and histogram_mxu_supported(
-        n_rows, n_feats, n_nodes, n_channels, n_bins)
+        n_rows, n_feats, n_nodes, n_channels, n_bins, row_tile)
 
 
 def _scan_best_split(cell, lam, mcw, *, n_bins, n_nodes, V):
@@ -223,7 +266,7 @@ def _hist_split_kernel(node_ref, vals_ref, xb_ref, scal_ref, gain_ref,
 
 def histogram_split_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
                         n_nodes: int, n_bins: int, reg_lambda,
-                        min_child_weight, *,
+                        min_child_weight, *, row_tile: int | None = None,
                         interpret: bool = False):
     """Fused per-(node, feature) split finding over vals [N, 2C] (g then h
     channels) -> (best_gain [n_nodes, D] f32, best_bin [n_nodes, D] int32).
@@ -241,10 +284,11 @@ def histogram_split_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
             f"histogram_split_mxu supports n_bins <= 127, got {n_bins}")
     from jax.experimental.pallas import tpu as pltpu
 
+    tile = _resolve_row_tile(row_tile)
     N, D = Xb.shape
     V = vals.shape[1]
     M = V * n_nodes
-    row_pad = (-N) % ROW_TILE
+    row_pad = (-N) % tile
     f_pad = (-D) % 128
     Dp = D + f_pad
     xb8 = jnp.pad(Xb.astype(jnp.int8), ((0, row_pad), (0, f_pad)),
@@ -257,11 +301,11 @@ def histogram_split_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
     gain, best_bin = pl.pallas_call(
         functools.partial(_hist_split_kernel, n_bins=n_bins, n_nodes=n_nodes,
                           V=V),
-        grid=((N + row_pad) // ROW_TILE,),
+        grid=((N + row_pad) // tile,),
         in_specs=[
-            pl.BlockSpec((1, ROW_TILE), lambda i: (0, i)),
-            pl.BlockSpec((V, ROW_TILE), lambda i: (0, i)),
-            pl.BlockSpec((ROW_TILE, Dp), lambda i: (i, 0)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((V, tile), lambda i: (0, i)),
+            pl.BlockSpec((tile, Dp), lambda i: (i, 0)),
             pl.BlockSpec((1, 2), lambda i: (0, 0)),
         ],
         out_specs=[pl.BlockSpec((n_nodes, Dp), lambda i: (0, 0)),
@@ -275,7 +319,7 @@ def histogram_split_mxu(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
 
 
 def _hist_partial_kernel(node_hbm, vals_hbm, xb_hbm, out_ref, *, n_bins,
-                         n_nodes, V, n_tiles):
+                         n_nodes, V, n_tiles, row_tile):
     """Per-shard partial histogram with MANUAL double-buffered DMA (r14): the
     inputs stay in ANY/HBM memory space and row tiles stream through a 2-slot
     VMEM scratch — tile t+1's copy is IN FLIGHT while tile t runs its bin-loop
@@ -294,13 +338,13 @@ def _hist_partial_kernel(node_hbm, vals_hbm, xb_hbm, out_ref, *, n_bins,
         def copies(t, slot):
             return (
                 pltpu.make_async_copy(
-                    node_hbm.at[:, pl.ds(t * ROW_TILE, ROW_TILE)],
+                    node_hbm.at[:, pl.ds(t * row_tile, row_tile)],
                     node_buf.at[slot], sems.at[slot, 0]),
                 pltpu.make_async_copy(
-                    vals_hbm.at[:, pl.ds(t * ROW_TILE, ROW_TILE)],
+                    vals_hbm.at[:, pl.ds(t * row_tile, row_tile)],
                     vals_buf.at[slot], sems.at[slot, 1]),
                 pltpu.make_async_copy(
-                    xb_hbm.at[pl.ds(t * ROW_TILE, ROW_TILE), :],
+                    xb_hbm.at[pl.ds(t * row_tile, row_tile), :],
                     xb_buf.at[slot], sems.at[slot, 2]),
             )
 
@@ -325,14 +369,15 @@ def _hist_partial_kernel(node_hbm, vals_hbm, xb_hbm, out_ref, *, n_bins,
         jax.lax.fori_loop(0, n_tiles, step, 0)
 
     pl.run_scoped(body,
-                  node_buf=pltpu.VMEM((2, 1, ROW_TILE), jnp.int32),
-                  vals_buf=pltpu.VMEM((2, V, ROW_TILE), jnp.float32),
-                  xb_buf=pltpu.VMEM((2, ROW_TILE, dp), jnp.int8),
+                  node_buf=pltpu.VMEM((2, 1, row_tile), jnp.int32),
+                  vals_buf=pltpu.VMEM((2, V, row_tile), jnp.float32),
+                  xb_buf=pltpu.VMEM((2, row_tile, dp), jnp.int8),
                   sems=pltpu.SemaphoreType.DMA((2, 3)))
 
 
 def histogram_partial_flat_mxu(vals: jnp.ndarray, Xb: jnp.ndarray,
                                node: jnp.ndarray, n_nodes: int, n_bins: int, *,
+                               row_tile: int | None = None,
                                interpret: bool = False) -> jnp.ndarray:
     """One device's PARTIAL histogram over its row shard, in the flat VMEM
     layout [n_bins * V * n_nodes, D] f32 (row b*M + v*n_nodes + n = bin b,
@@ -348,10 +393,11 @@ def histogram_partial_flat_mxu(vals: jnp.ndarray, Xb: jnp.ndarray,
             f"histogram_partial_flat_mxu supports n_bins <= 127, got {n_bins}")
     from jax.experimental.pallas import tpu as pltpu
 
+    tile = _resolve_row_tile(row_tile)
     N, D = Xb.shape
     V = vals.shape[1]
     M = V * n_nodes
-    row_pad = (-N) % ROW_TILE
+    row_pad = (-N) % tile
     f_pad = (-D) % 128
     Dp = D + f_pad
     xb8 = jnp.pad(Xb.astype(jnp.int8), ((0, row_pad), (0, f_pad)),
@@ -362,7 +408,7 @@ def histogram_partial_flat_mxu(vals: jnp.ndarray, Xb: jnp.ndarray,
     out = pl.pallas_call(
         functools.partial(_hist_partial_kernel, n_bins=n_bins,
                           n_nodes=n_nodes, V=V,
-                          n_tiles=(N + row_pad) // ROW_TILE),
+                          n_tiles=(N + row_pad) // tile, row_tile=tile),
         in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
         out_specs=pl.BlockSpec((n_bins * M, Dp), lambda: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((n_bins * M, Dp), jnp.float32),
@@ -425,6 +471,7 @@ def _digitize_kernel(x_ref, edges_ref, out_ref, *, n_cuts):
 
 
 def digitize_mxu(X: jnp.ndarray, edges: jnp.ndarray, *,
+                 row_tile: int | None = None,
                  interpret: bool = False) -> jnp.ndarray:
     """Per-feature digitize: X [N, D] f32 vs edges [D, B-1] -> int32 bins.
 
@@ -432,9 +479,10 @@ def digitize_mxu(X: jnp.ndarray, edges: jnp.ndarray, *,
     x and monotone edges (ties included on both). NaN lands in bin 0 (an
     all-false compare), not the last bin — upstream kernels impute before
     binning, so this is unobservable in practice. One pass over X on the VPU."""
+    tile = _resolve_row_tile(row_tile)
     N, D = X.shape
     n_cuts = edges.shape[1]
-    row_pad = (-N) % ROW_TILE
+    row_pad = (-N) % tile
     f_pad = (-D) % 128
     Xp = jnp.pad(jnp.asarray(X, jnp.float32), ((0, row_pad), (0, f_pad)))
     # padded feature columns: +inf edges -> every x in bin 0
@@ -442,12 +490,12 @@ def digitize_mxu(X: jnp.ndarray, edges: jnp.ndarray, *,
                  constant_values=jnp.inf)  # [B-1, Dp]
     out = pl.pallas_call(
         functools.partial(_digitize_kernel, n_cuts=n_cuts),
-        grid=((N + row_pad) // ROW_TILE,),
+        grid=((N + row_pad) // tile,),
         in_specs=[
-            pl.BlockSpec((ROW_TILE, D + f_pad), lambda i: (i, 0)),
+            pl.BlockSpec((tile, D + f_pad), lambda i: (i, 0)),
             pl.BlockSpec((n_cuts, D + f_pad), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((ROW_TILE, D + f_pad), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((tile, D + f_pad), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((N + row_pad, D + f_pad), jnp.int32),
         interpret=interpret,
     )(Xp, ep)

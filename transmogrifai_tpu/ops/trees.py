@@ -80,10 +80,22 @@ def _is_batched(*xs) -> bool:
 
 
 def _fused_split_supported(n_rows: int, n_feats: int, n_nodes: int,
-                           n_channels: int, n_bins: int) -> bool:
+                           n_channels: int, n_bins: int,
+                           row_tile: Optional[int] = None) -> bool:
     from .pallas_trees import fused_split_supported
 
-    return fused_split_supported(n_rows, n_feats, n_nodes, n_channels, n_bins)
+    return fused_split_supported(n_rows, n_feats, n_nodes, n_channels, n_bins,
+                                 row_tile)
+
+
+def _env_row_tile() -> int:
+    """TT_ROW_TILE resolved to a literal at CALL time (0 = kernel default).
+
+    fit_gbt/fit_forest bake this into their jit static args: two fits that
+    differ only in the env knob must compile two programs, not silently share
+    one cache entry — the property `op autotune`'s measured trials rely on
+    (the kernels themselves read the env only at trace time)."""
+    return int(os.environ.get("TT_ROW_TILE", 0) or 0)
 
 
 def _model_axis_constraint(mesh, Xb, edges):
@@ -114,7 +126,8 @@ def _model_axis_constraint(mesh, Xb, edges):
 
 def _data_axis_hist_split(mesh, gh, Xb, node, n_nodes, n_bins, reg_lambda,
                           min_child_weight, feature_sharded: bool,
-                          hist_mode: Optional[str] = None):
+                          hist_mode: Optional[str] = None,
+                          row_tile: Optional[int] = None):
     """Data-axis sharded fused split finding (r14): one shard_map over the
     FULL mesh per tree level. Each device accumulates a partial histogram
     over its row shard — on TPU via the double-buffered-DMA pallas kernel
@@ -154,7 +167,8 @@ def _data_axis_hist_split(mesh, gh, Xb, node, n_nodes, n_bins, reg_lambda,
         # else the partitioner-friendly jnp decompositions
         if tpu:
             mode = ("mxu" if fused_split_supported(
-                -(-N // n_data), d_local, n_nodes, V, n_bins) else "binmm")
+                -(-N // n_data), d_local, n_nodes, V, n_bins,
+                row_tile) else "binmm")
         else:
             mode = "segsum"
     scal = jnp.stack([jnp.asarray(reg_lambda, jnp.float32),
@@ -164,7 +178,8 @@ def _data_axis_hist_split(mesh, gh, Xb, node, n_nodes, n_bins, reg_lambda,
     def body(gh_l, xb_l, node_l, scal_l):
         if mode == "mxu":
             part = histogram_partial_flat_mxu(gh_l, xb_l, node_l, n_nodes,
-                                              n_bins, interpret=not tpu)
+                                              n_bins, row_tile=row_tile,
+                                              interpret=not tpu)
         else:
             hist4 = (histogram_binmm if mode == "binmm"
                      else histogram_segment_sum)(
@@ -242,8 +257,8 @@ def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 
 
 def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
-               n_nodes: int, n_bins: int, mode: Optional[str] = None
-               ) -> jnp.ndarray:
+               n_nodes: int, n_bins: int, mode: Optional[str] = None,
+               row_tile: Optional[int] = None) -> jnp.ndarray:
     """Sum `vals` [N, C] into per-(node, feature, bin) cells -> [n_nodes, D, n_bins, C].
 
     Default paths on TPU: the pallas bin-loop MXU kernel (pallas_trees.
@@ -272,7 +287,8 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
             big = (Xb.size >= _PALLAS_MIN_ELEMS
                    and not _is_batched(vals, Xb, node)
                    and histogram_mxu_supported(Xb.shape[0], Xb.shape[1],
-                                               n_nodes, vals.shape[1], n_bins))
+                                               n_nodes, vals.shape[1], n_bins,
+                                               row_tile))
             mode = "mxu" if big else "binmm"
         else:
             mode = "segsum"
@@ -283,6 +299,7 @@ def _histogram(vals: jnp.ndarray, Xb: jnp.ndarray, node: jnp.ndarray,
         # how the fused-vs-two-pass equality tests pin BOTH paths to the
         # same (bf16-operand) histogram accumulation on the CPU suite
         return histogram_mxu(vals, Xb, node, n_nodes, n_bins,
+                             row_tile=row_tile,
                              interpret=not backend_is_tpu())
     if mode == "segsum":
         return histogram_segment_sum(vals, Xb, node, n_nodes, n_bins)
@@ -363,6 +380,7 @@ def grow_tree(
     split_mode: Optional[str] = None,
     data_mesh=None,
     data_feature_sharded: bool = False,
+    row_tile: Optional[int] = None,
 ):
     """Grow one perfect tree level-by-level on binned features.
 
@@ -429,19 +447,20 @@ def grow_tree(
         use_fused = (not use_data) and fused_ok and (
             smode == "fused"
             or (smode is None and big and _fused_split_supported(
-                N, D, n_nodes, 2 * C, n_bins)))
+                N, D, n_nodes, 2 * C, n_bins, row_tile)))
         if use_data or use_fused:
             if use_data:
                 gain_nf, bin_nf = _data_axis_hist_split(
                     data_mesh, gh, Xb, node, n_nodes, n_bins, reg_lambda,
                     min_child_weight, data_feature_sharded,
-                    hist_mode=hist_mode)
+                    hist_mode=hist_mode, row_tile=row_tile)
             else:
                 from .pallas_trees import histogram_split_mxu
 
                 gain_nf, bin_nf = histogram_split_mxu(
                     gh, Xb, node, n_nodes, n_bins, reg_lambda,
-                    min_child_weight, interpret=not backend_is_tpu())
+                    min_child_weight, row_tile=row_tile,
+                    interpret=not backend_is_tpu())
             # colsample mask + min_gain are per-(node, feature) gates: applied
             # here on the [n_nodes, D] stats, identical to the two-pass masks
             gain_nf = jnp.where(fmask[None, :], gain_nf, -jnp.inf)
@@ -456,7 +475,8 @@ def grow_tree(
                                n_bins - 1).astype(jnp.int32)
         else:
             cum = jnp.cumsum(
-                _histogram(gh, Xb, node, n_nodes, n_bins, mode=hist_mode),
+                _histogram(gh, Xb, node, n_nodes, n_bins, mode=hist_mode,
+                           row_tile=row_tile),
                 axis=2)
             GL, HL = cum[..., :C], cum[..., C:]
             Gt = GL[:, :1, -1:, :]  # per-node totals (identical across features)
@@ -578,27 +598,35 @@ def gbt_psum_payload_bytes(*, n_outputs: int, n_trees: int, max_depth: int,
             * int(d_local) * 4)
 
 
-def gbt_data_sharded(*, n_data: int, use_l1: bool, n_bins: int) -> bool:
+def gbt_data_sharded(*, n_data: int, use_l1: bool, n_bins: int,
+                     split: Optional[str] = None) -> bool:
     """The _fit_gbt/fit_forest data-axis gate, re-derivable without a fit:
-    >1 data axis, literal-zero L1, something to scan, no twopass override."""
+    >1 data axis, literal-zero L1, something to scan, no twopass override.
+    `split` overrides the TT_SPLIT env (how a tuner candidate's gate is
+    evaluated without mutating the process environment)."""
+    if split is None:
+        split = os.environ.get("TT_SPLIT")
     return (int(n_data) > 1 and not use_l1 and int(n_bins) >= 2
-            and os.environ.get("TT_SPLIT") != "twopass")
+            and split != "twopass")
 
 
 def gbt_resource_profile(*, n_rows, d, n_outputs: int, n_trees: int,
                          max_depth: int, n_bins: int, n_data: int,
-                         n_model: int, use_l1: bool = False) -> dict:
+                         n_model: int, use_l1: bool = False,
+                         split: Optional[str] = None) -> dict:
     """Static per-device footprint of one boosted/bagged fit — the stage-hook
     payload behind `op explain` (key contract in analyze/shard_model.py).
     Mirrors _fit_gbt's own resolution order: model-axis feature slabs when
     n_model divides D, data-axis row shards (weight-0 padded) when the fused
-    gates open, int8 binned matrix under 128 bins."""
+    gates open, int8 binned matrix under 128 bins. `split` overrides the
+    TT_SPLIT env for the data-axis gate (how `op autotune` prices a twopass
+    candidate without touching the environment)."""
     n_data, n_model = max(1, int(n_data)), max(1, int(n_model))
     d = int(d) if d else 0
     model_sharded = n_model > 1 and d > 0 and d % n_model == 0
     d_local = d // n_model if model_sharded else d
     data_sharded = gbt_data_sharded(n_data=n_data, use_l1=use_l1,
-                                    n_bins=n_bins)
+                                    n_bins=n_bins, split=split)
     pad = ((-int(n_rows)) % n_data if (data_sharded and n_rows) else 0)
     rows_dev = None
     if n_rows:
@@ -640,7 +668,7 @@ def gbt_resource_profile(*, n_rows, d, n_outputs: int, n_trees: int,
 
 def _record_gbt_collectives(X, y, *, use_l1, mesh=None, objective="binary",
                             num_classes=2, n_trees=50, max_depth=5,
-                            n_bins=32, **_kw) -> None:
+                            n_bins=32, split=None, **_kw) -> None:
     """Host-side honesty hook: when the fused data-axis program will run,
     record its psum payload (from the RUNTIME shapes) so mesh_stats() can be
     compared against the static prediction. Vmapped/batched fits and closed
@@ -650,7 +678,7 @@ def _record_gbt_collectives(X, y, *, use_l1, mesh=None, objective="binary",
     from ..mesh import MODEL_AXIS, data_axis_size, record_collective
 
     if not gbt_data_sharded(n_data=data_axis_size(mesh), use_l1=use_l1,
-                            n_bins=n_bins):
+                            n_bins=n_bins, split=split):
         return
     D = int(jnp.shape(X)[1])
     n_model = int(mesh.shape[MODEL_AXIS])
@@ -666,8 +694,16 @@ def fit_gbt(X, y, sample_weight=None, *, reg_alpha=0.0, **kw):
     """Public entry: decides the static use_l1 flag OUTSIDE the jit boundary.
     Inside _fit_gbt a default reg_alpha=0.0 would arrive as a TRACER, defeating
     _l1_threshold's literal-zero skip and taxing every fit with thresholding
-    ops it doesn't need."""
+    ops it doesn't need.
+
+    The TT_ROW_TILE / TT_SPLIT env knobs resolve to LITERALS here, outside
+    the jit boundary, so they participate in the jit cache key — `op
+    autotune`'s back-to-back trials with different knob values each compile
+    their own program instead of silently reusing the first one's. Callers
+    (the tuner, tests) may pass `row_tile=`/`split=` explicitly instead."""
     use_l1 = not (isinstance(reg_alpha, (int, float)) and reg_alpha == 0)
+    kw.setdefault("row_tile", _env_row_tile())
+    kw.setdefault("split", os.environ.get("TT_SPLIT"))
     _record_gbt_collectives(X, y, use_l1=use_l1, **kw)
     return _fit_gbt(X, y, sample_weight, reg_alpha=reg_alpha, use_l1=use_l1, **kw)
 
@@ -676,7 +712,8 @@ def fit_gbt(X, y, sample_weight=None, *, reg_alpha=0.0, **kw):
     jax.jit,
     static_argnames=(
         "objective", "num_classes", "n_trees", "max_depth", "n_bins",
-        "subsample", "colsample", "seed", "use_l1", "mesh",
+        "subsample", "colsample", "seed", "use_l1", "mesh", "row_tile",
+        "split",
     ),
 )
 def _fit_gbt(
@@ -699,6 +736,8 @@ def _fit_gbt(
     n_bins: int = 32,
     seed: int = 7,
     mesh=None,
+    row_tile: int = 0,
+    split: Optional[str] = None,
 ) -> TreeEnsembleParams:
     """Second-order boosting: per round, (g, h) from the current margin, one
     multi-output tree, margin += leaf values (learning rate folded into leaves).
@@ -735,8 +774,12 @@ def _fit_gbt(
 
     from ..mesh import data_axis_size
 
+    # `split`/`row_tile` arrive as literals from the fit_gbt wrapper (env or
+    # explicit tuner candidate); a None/0 falls back to the env at trace time
+    env_split = split if split is not None else os.environ.get("TT_SPLIT")
+    rt = row_tile or None
     data_sharded = (data_axis_size(mesh) > 1 and not use_l1 and n_bins >= 2
-                    and os.environ.get("TT_SPLIT") != "twopass"
+                    and env_split != "twopass"
                     and not _is_batched(X, y))
     Xb, edges, model_sharded = _model_axis_constraint(mesh, Xb, edges)
     # pallas_calls are opaque to the SPMD partitioner, so a PURELY
@@ -746,7 +789,8 @@ def _fit_gbt(
     # itself)
     hist_mode = (("binmm" if backend_is_tpu() else "segsum")
                  if model_sharded and not data_sharded else None)
-    split_mode = ("twopass" if model_sharded and not data_sharded else None)
+    split_mode = ("twopass" if model_sharded and not data_sharded
+                  else env_split)
 
     if objective == "binary":
         Y = jnp.asarray(y, jnp.float32)[:, None]
@@ -803,7 +847,7 @@ def _fit_gbt(
             fmask, reg_alpha=reg_alpha if use_l1 else 0.0,  # literal 0 -> skip
             hist_mode=hist_mode, split_mode=split_mode,
             data_mesh=mesh if data_sharded else None,
-            data_feature_sharded=model_sharded,
+            data_feature_sharded=model_sharded, row_tile=rt,
         )
         lv = lv * learning_rate
         return F + lv[leaf], (sf, st, lv, fg)
@@ -815,14 +859,22 @@ def _fit_gbt(
 
 
 # --- bagged forests (RF / single decision tree) --------------------------------------
+def fit_forest(X, y, sample_weight=None, **kw):
+    """Public entry: resolves the TT_ROW_TILE / TT_SPLIT env knobs to
+    literals OUTSIDE the jit boundary so they key the cache — see fit_gbt."""
+    kw.setdefault("row_tile", _env_row_tile())
+    kw.setdefault("split", os.environ.get("TT_SPLIT"))
+    return _fit_forest(X, y, sample_weight, **kw)
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "objective", "num_classes", "n_trees", "max_depth", "n_bins",
-        "colsample", "bootstrap", "seed", "mesh",
+        "colsample", "bootstrap", "seed", "mesh", "row_tile", "split",
     ),
 )
-def fit_forest(
+def _fit_forest(
     X: jnp.ndarray,
     y: jnp.ndarray,
     sample_weight: Optional[jnp.ndarray] = None,
@@ -839,6 +891,8 @@ def fit_forest(
     bootstrap: bool = True,
     seed: int = 7,
     mesh=None,
+    row_tile: int = 0,
+    split: Optional[str] = None,
 ) -> TreeEnsembleParams:
     """Bagged variance-reduction trees. With g = -Y*w, h = w the second-order leaf
     -G/(H+lambda) is the weighted target mean, and the gain is exactly the weighted
@@ -860,13 +914,16 @@ def fit_forest(
 
     from ..mesh import data_axis_size
 
+    env_split = split if split is not None else os.environ.get("TT_SPLIT")
+    rt = row_tile or None
     data_sharded = (data_axis_size(mesh) > 1 and n_bins >= 2
-                    and os.environ.get("TT_SPLIT") != "twopass"
+                    and env_split != "twopass"
                     and not _is_batched(X, y))
     Xb, edges, model_sharded = _model_axis_constraint(mesh, Xb, edges)
     hist_mode = (("binmm" if backend_is_tpu() else "segsum")
                  if model_sharded and not data_sharded else None)
-    split_mode = ("twopass" if model_sharded and not data_sharded else None)
+    split_mode = ("twopass" if model_sharded and not data_sharded
+                  else env_split)
 
     if objective == "classification":
         Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), num_classes)
@@ -907,7 +964,7 @@ def fit_forest(
             Xb, edges, g, h, max_depth, reg_lambda, min_child_weight, min_gain,
             fmask, hist_mode=hist_mode, split_mode=split_mode,
             data_mesh=mesh if data_sharded else None,
-            data_feature_sharded=model_sharded,
+            data_feature_sharded=model_sharded, row_tile=rt,
         )
         return sf, st, lv, fg
 

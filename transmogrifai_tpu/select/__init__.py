@@ -3,7 +3,7 @@
 TPU-native re-design of the reference's selector/tuning packages (SURVEY §2.11c):
 folds x grid-points ride vmap axes of one compiled program instead of a JVM thread
 pool over Spark jobs."""
-from .grids import ParamGridBuilder, RandomParamBuilder
+from .grids import ParamGridBuilder, RandomParamBuilder, pin_grid
 from .selector import (
     BinaryClassificationModelSelector,
     ModelSelector,
@@ -21,7 +21,7 @@ from .validator import (
 )
 
 __all__ = [
-    "ParamGridBuilder", "RandomParamBuilder",
+    "ParamGridBuilder", "RandomParamBuilder", "pin_grid",
     "BinaryClassificationModelSelector", "ModelSelector", "ModelSelectorSummary",
     "MultiClassificationModelSelector", "RegressionModelSelector", "default_models",
     "DataBalancer", "DataCutter", "DataSplitter", "SplitterSummary",

@@ -29,6 +29,25 @@ class ParamGridBuilder:
         return grid
 
 
+def pin_grid(grid: Sequence[dict], **pins) -> list[dict]:
+    """Pin params across an existing grid: every point gets `pins` applied
+    on top, and points that differed only on a pinned axis collapse to one
+    (first occurrence wins — deterministic in grid order). This is how
+    `op autotune` hands a searched knob (n_bins, shard_optimizer) to a
+    selector: the CV search stops spending grid points on an axis the
+    tuner already fixed, instead of silently overriding the tuned value
+    with its own axis."""
+    out: list[dict] = []
+    seen: set = set()
+    for point in grid:
+        p = {**point, **pins}
+        key = tuple(sorted((k, repr(v)) for k, v in p.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(p)
+    return out
+
+
 class RandomParamBuilder:
     """Random-search grid (analog of RandomParamBuilder.scala:52): draw each param
     from a uniform / log-uniform ("exponential") / choice distribution."""

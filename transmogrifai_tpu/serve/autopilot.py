@@ -84,6 +84,12 @@ class AutopilotConfig:
     #: retire (drain + release) the demoted champion after a swap instead
     #: of keeping it resident as the rollback target
     retire_old: bool = False
+    #: retrain under the champion's `op autotune` stamp: re-apply the tuned
+    #: mesh shape, kernel knobs, and env for the challenger's train, so a
+    #: tuned fleet doesn't silently regress to data-sheet defaults on the
+    #: first drift-triggered retrain. A stamp from a different part (or no
+    #: stamp at all) degrades to the untuned path.
+    use_tuned_config: bool = True
     #: candidate bundles past the newest N are swept from the workdir
     #: (rollback targets stay loadable; disk stays bounded)
     keep_candidates: int = 4
@@ -289,7 +295,21 @@ class Autopilot:
                     chaos.maybe_site("autopilot:retrain")
                     wf = self._workflow_factory()
                     wf.with_warm_start(champion)
-                    candidate = wf.train()
+                    # the champion carries its `op autotune` winner: retrain
+                    # under the same mesh/knobs/env so the challenger is
+                    # measured like-for-like against a tuned incumbent
+                    from ..tune import (apply_tuned_config, env_overrides,
+                                        tuned_env)
+
+                    env: dict = {}
+                    tuned = (getattr(champion, "tuned_config", None)
+                             if cfg.use_tuned_config else None)
+                    if tuned and apply_tuned_config(wf, tuned):
+                        env = tuned_env(tuned)
+                        obs.add_event("tuned_config",
+                                      label=str(tuned.get("label", "")))
+                    with env_overrides(**env):
+                        candidate = wf.train()
             except Exception as e:  # noqa: BLE001 — contained by contract
                 self._count_retrain("crashed")
                 self._event("retrain_failed", error=type(e).__name__)

@@ -299,8 +299,20 @@ class ServingDaemon:
                               **(self._monitor
                                  if isinstance(self._monitor, dict) else {})}
                     mon = ServingMonitor.for_model(model, **mon_kw)
+                # a bundle tuned by `op autotune` carries its searched
+                # serving bucket floor; the load() gate already dropped the
+                # stamp if this host is a different part, so a surviving
+                # floor is measured truth for THIS device class
+                buckets = self._buckets
+                tc = getattr(model, "tuned_config", None) or {}
+                tuned_floor = int((tc.get("config") or {})
+                                  .get("serve_floor", 0) or 0)
+                if tuned_floor > 0:
+                    buckets = serving_buckets(tuned_floor, self._max_batch)
+                    obs.add_event("tuned_config", source="bundle",
+                                  serve_floor=tuned_floor)
                 fn = score_function(
-                    model, pad_to=self._buckets, backend=self._backend,
+                    model, pad_to=buckets, backend=self._backend,
                     mesh=self._mesh, policy=policy, model_label=label,
                     monitor=mon)
                 # the SAME ladder-warm helper `op warmup --serving` uses:
@@ -310,7 +322,7 @@ class ServingDaemon:
                 from ..workflow.warmup import warm_serving_handle
 
                 warm_report = (warm_serving_handle(
-                    fn, buckets=self._buckets, aot=self._aot)
+                    fn, buckets=buckets, aot=self._aot)
                     if self._warm else None)
                 batcher = MicroBatcher(
                     fn, max_batch=self._max_batch,

@@ -1,0 +1,27 @@
+"""Cost-model-driven configuration search — the decide-layer on top of
+`op explain`.
+
+The static analyzer (analyze/shard_model.py, OP501-505) *predicts* what a
+plan costs at any mesh; this package *chooses*: enumerate a typed
+ConfigSpace (mesh factorizations, TT_SPLIT, shard_optimizer, GBT kernel
+knobs, batch/prefetch ladders), rank every candidate on the ResourceModel
+with HBM-infeasible points pruned on the OP501 budget, measure the static
+top-k through the real `Workflow.train` path, regress the measured walls
+back onto the model's hardware constants (calibration.json keyed by
+device_kind), and stamp the winner into model.json ("tuned_config") for
+`op warmup`, serving replicas, and the autopilot to inherit.
+"""
+from .calibrate import (Calibration, default_constants, fit_constants,
+                        load_calibration, predict_wall_s, save_calibration)
+from .ranker import RankedCandidate, rank_static, suggest_configs
+from .space import Candidate, ConfigSpace, mesh_factorizations
+from .trials import TrialResult, apply_candidate, env_overrides, run_trials
+from .tuner import TuneReport, apply_tuned_config, autotune, tuned_env
+
+__all__ = [
+    "Calibration", "Candidate", "ConfigSpace", "RankedCandidate",
+    "TrialResult", "TuneReport", "apply_candidate", "apply_tuned_config",
+    "autotune", "default_constants", "env_overrides", "fit_constants",
+    "load_calibration", "mesh_factorizations", "predict_wall_s",
+    "rank_static", "save_calibration", "suggest_configs", "tuned_env",
+]

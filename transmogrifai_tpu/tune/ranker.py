@@ -1,0 +1,266 @@
+"""Static ranking: score every candidate on the PR-15 ResourceModel.
+
+Zero traces, milliseconds per candidate. Per mesh shape the plan is priced
+once (`build_resource_model`, memoized); per candidate the tree-family
+stages are REPRICED at the candidate's kernel knobs through the same
+`gbt_resource_profile` the stage `resource_profile` hooks call — so the
+all-defaults candidate scores byte-identically to what `op explain`
+reports, and a knob candidate's delta is exactly the cost model's opinion
+of that knob.
+
+Pruning is the OP501 machinery verbatim: a candidate whose predicted
+per-device resident bytes exceed `analyze.rules.hbm_budget_bytes()` is
+infeasible (the `Workflow.train` explain gate would raise before the first
+trace), as is a fused-split candidate whose (bins, row_tile) fails the
+VMEM gate in ops/pallas_trees.py — pinning split="fused" bypasses the
+runtime's graceful fallback, so an unsupported tile would OOM VMEM, not
+merely slow down.
+
+The score is
+
+    score_s = comm_s + max(comp_s, mem_s)
+
+summed over stages: collectives on the GBT path synchronize at level
+boundaries (additive), compute and HBM streaming overlap (max). Constants
+come from calibration.json when a record for this part exists, else the
+OP503 data-sheet defaults.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from math import ceil
+from typing import Optional, Sequence
+
+from ..analyze.rules import _OP406_TREE_OPS, hbm_budget_bytes
+from ..analyze.shard_model import build_resource_model
+from .calibrate import default_constants, load_calibration, predict_wall_s
+from .space import Candidate
+
+#: default per-family multiplier on peak_tflops: tree histogram scans hit
+#: the MXU far less densely than matmuls (gbt_hist_mfu 0.41 vs mlp 0.74 in
+#: BENCH_r05) — calibration refines these per part
+FAMILY_EFF_DEFAULT = {"trees": 0.45, "default": 0.75}
+
+
+def _family(operation: str) -> str:
+    return "trees" if operation in _OP406_TREE_OPS else "default"
+
+
+def _eff(constants: dict, family: str) -> float:
+    fam = constants.get("family_eff") or {}
+    return float(fam.get(family, FAMILY_EFF_DEFAULT.get(family, 1.0)))
+
+
+@dataclass
+class RankedCandidate:
+    """One scored point: static counters, predicted seconds, and the prune
+    verdict (None = feasible)."""
+
+    candidate: Candidate
+    score_s: float = float("inf")
+    pruned: Optional[str] = None
+    hbm_bytes: int = 0
+    #: the regression design row calibration fits against
+    counters: dict = field(default_factory=dict)
+
+    @property
+    def feasible(self) -> bool:
+        return self.pruned is None
+
+    def to_json(self) -> dict:
+        return {"candidate": self.candidate.as_dict(),
+                "label": self.candidate.label,
+                "score_s": self.score_s, "pruned": self.pruned,
+                "hbm_bytes": self.hbm_bytes, "counters": dict(self.counters)}
+
+
+def _tree_stages(dag) -> list:
+    """Direct tree-family estimators in the plan (the stages the kernel
+    knobs bind to). Selector grids keep their aggregate pricing — knob
+    deltas inside a vmapped search are second-order."""
+    out = []
+    for layer in dag or ():
+        for s in layer:
+            if getattr(s, "operation_name", None) in _OP406_TREE_OPS \
+                    and isinstance(getattr(s, "params", None), dict) \
+                    and "n_bins" in s.params:
+                out.append(s)
+    return out
+
+
+def _tree_knob_counters(stage, sr, cand: Candidate, n_rows: int) -> dict:
+    """Reprice one tree stage at the candidate's knobs: flops/collective/
+    resident from gbt_resource_profile (the stage hook's own formulas) at
+    the candidate bins + split, plus the row-tile padding factor and the
+    per-level HBM re-read of the binned matrix the base model folds into
+    aux_bytes."""
+    from ..ops.pallas_trees import ROW_TILE
+    from ..ops.trees import gbt_resource_profile
+
+    p = stage.params
+    n_bins = int(cand.n_bins or p.get("n_bins", 32))
+    n_trees = int(p.get("n_trees", 1))
+    max_depth = int(p.get("max_depth", 6))
+    reg_alpha = p.get("reg_alpha", 0.0)
+    use_l1 = not (isinstance(reg_alpha, (int, float)) and reg_alpha == 0)
+    ncls = int(p.get("num_classes", 0) or 0)
+    n_outputs = ncls if ncls > 2 else 1
+    d = int(sr.width or 0)
+    prof = gbt_resource_profile(
+        n_rows=n_rows, d=d, n_outputs=n_outputs, n_trees=n_trees,
+        max_depth=max_depth, n_bins=n_bins, n_data=cand.mesh_shape[0],
+        n_model=cand.mesh_shape[1], use_l1=use_l1,
+        split=cand.split or None)
+
+    rows_dev = max(1, int(prof.get("rows_per_device") or n_rows))
+    tile = int(cand.row_tile or ROW_TILE)
+    tile_factor = (ceil(rows_dev / tile) * tile) / rows_dev
+
+    # every tree level re-streams the resident binned matrix from HBM
+    levels = n_trees * max_depth
+    mem_bytes = int(levels * prof["aux_bytes"] * tile_factor)
+    if (cand.split or "") == "twopass":
+        # the two-pass backend materializes full per-node histograms in HBM
+        # (write + read back) instead of keeping them in VMEM scratch
+        d_local = max(1, d // max(1, cand.mesh_shape[1]))
+        hist = ((1 << max_depth) - 1) * n_bins * 2 * max(1, n_outputs) \
+            * d_local * 4
+        mem_bytes += 2 * n_trees * hist
+
+    return {
+        "flops": int(prof["flops"] * tile_factor),
+        "collective_bytes": int(prof["collective_bytes"]),
+        "mem_bytes": mem_bytes,
+        "resident_bytes": int(prof["aux_bytes"] + prof["activation_bytes"]),
+        "rows_per_device": rows_dev,
+        "d_local": max(1, d // max(1, cand.mesh_shape[1])),
+        "n_bins": n_bins,
+        "n_outputs": n_outputs,
+        "n_trees": n_trees,
+        "max_depth": max_depth,
+    }
+
+
+def rank_static(result_features, dag=None, *, candidates: Sequence[Candidate],
+                n_rows: int, raw_features=None, constants: Optional[dict] = None,
+                assume_width: Optional[int] = None) -> list:
+    """Score every candidate; returns feasible points sorted by
+    (score_s, candidate.key()) followed by pruned points (same order) —
+    a deterministic total order, the trial sequence's spine."""
+    from ..ops.pallas_trees import fused_split_supported
+
+    constants = dict(constants or default_constants())
+    budget = hbm_budget_bytes()
+    trees = _tree_stages(dag)
+    tree_uids = {s.uid for s in trees}
+
+    # Host-platform "devices" (--xla_force_host_platform_device_count)
+    # time-share one machine: a mesh divides per-device WORK but not wall
+    # clock, so wall pricing must charge the TOTAL work across the engaged
+    # devices — replication on a virtual axis burns real cycles, sharding
+    # is wall-neutral, and ties then break toward the smallest mesh via the
+    # candidate key. HBM feasibility keeps the per-device view (residency
+    # is per-process either way). Real accelerator parts keep per-device
+    # pricing: their chips genuinely run in parallel.
+    virt = os.environ.get("TT_TUNE_VIRTUAL_AXES", "")
+    if virt in ("", "auto"):
+        import jax
+
+        virtual_axes = jax.devices()[0].platform == "cpu"
+    else:
+        virtual_axes = virt not in ("0", "false", "no")
+
+    rm_cache: dict = {}
+
+    def plan_at(shape):
+        if shape not in rm_cache:
+            rm = build_resource_model(
+                result_features, dag, mesh_shape=shape, n_rows=n_rows,
+                raw_features=raw_features, assume_width=assume_width)
+            base = {"flops": 0.0, "collective_bytes": 0, "mem_bytes": 0}
+            base_peak = 0
+            tree_srs = {}
+            for sr in rm.stages:
+                if sr.stage_uid in tree_uids:
+                    tree_srs[sr.stage_uid] = sr
+                    continue
+                base["flops"] += sr.flops / _eff(constants,
+                                                _family(sr.operation))
+                base["collective_bytes"] += sr.collective_bytes
+                # one streaming pass over the stage's resident working set
+                base["mem_bytes"] += sr.resident_bytes
+                base_peak = max(base_peak, sr.resident_bytes)
+            rm_cache[shape] = (base, base_peak, tree_srs)
+        return rm_cache[shape]
+
+    out = []
+    for cand in candidates:
+        base, base_peak, tree_srs = plan_at(tuple(cand.mesh_shape))
+        counters = dict(base)
+        peak = base_peak
+        verdict = None
+        for s in trees:
+            sr = tree_srs.get(s.uid)
+            if sr is None:
+                continue
+            tk = _tree_knob_counters(s, sr, cand, n_rows)
+            counters["flops"] += tk["flops"] / _eff(constants, "trees")
+            counters["collective_bytes"] += tk["collective_bytes"]
+            counters["mem_bytes"] += tk["mem_bytes"]
+            peak = max(peak, tk["resident_bytes"] + sr.params_bytes)
+            if cand.split == "fused" and not fused_split_supported(
+                    tk["rows_per_device"], tk["d_local"],
+                    1 << (tk["max_depth"] - 1), 2 * max(2, tk["n_outputs"]),
+                    tk["n_bins"], cand.row_tile or None):
+                verdict = (f"VMEM: fused histogram accumulator/tile over "
+                           f"budget at bins={tk['n_bins']} "
+                           f"tile={cand.row_tile or 'default'} — pinning "
+                           "split=fused would bypass the runtime fallback")
+        if peak > budget:
+            verdict = verdict or (
+                f"OP501: {peak} B resident per device over the {budget} B "
+                "HBM budget — Workflow.train's explain gate rejects this "
+                "mesh")
+        if virtual_axes:
+            n_engaged = cand.mesh_shape[0] * cand.mesh_shape[1]
+            counters["flops"] *= n_engaged
+            counters["mem_bytes"] *= n_engaged
+        rc = RankedCandidate(candidate=cand, hbm_bytes=int(peak),
+                             counters={k: int(v) for k, v in
+                                       counters.items()},
+                             pruned=verdict)
+        if verdict is None:
+            rc.score_s = predict_wall_s(rc.counters, constants)
+        out.append(rc)
+
+    feasible = sorted((r for r in out if r.feasible),
+                      key=lambda r: (r.score_s, r.candidate.key()))
+    pruned = sorted((r for r in out if not r.feasible),
+                    key=lambda r: r.candidate.key())
+    return feasible + pruned
+
+
+def suggest_configs(result_features, dag=None, *, n_rows: int,
+                    n_devices: int, raw_features=None, k: int = 3,
+                    constants: Optional[dict] = None,
+                    assume_width: Optional[int] = None) -> list:
+    """`op explain --suggest`: the top-k statically-ranked configs from the
+    default space — no trials, no traces, pure host arithmetic. With no
+    explicit `constants`, the live part's calibration.json record (when one
+    exists — a prior `op autotune` wrote it) prices the candidates, so the
+    suggestions reflect measured hardware truth."""
+    from .space import ConfigSpace
+
+    if constants is None:
+        from .tuner import _part_stamp
+
+        part = _part_stamp()
+        cal = load_calibration(part["platform"], part["device_kind"])
+        constants = cal.constants() if cal else None
+    ranked = rank_static(
+        result_features, dag,
+        candidates=ConfigSpace.default(n_devices).candidates(n_devices),
+        n_rows=n_rows, raw_features=raw_features, constants=constants,
+        assume_width=assume_width)
+    return [r for r in ranked if r.feasible][:k]

@@ -1,0 +1,175 @@
+"""Typed configuration space for `op autotune`.
+
+A `Candidate` is one fully-resolved point: a mesh factorization plus every
+knob the runtime actually reads — the TT_SPLIT gate, shard_optimizer, the
+GBT kernel knobs (n_bins, histogram row tile), and the batch/prefetch
+ladders. `ConfigSpace` holds per-dimension ladders and enumerates their
+product deterministically (field order, ascending values), so the same
+space + same device count always yields the same candidate list — the
+first half of the replayability contract (tune/trials.py holds the other).
+
+Knob value 0 means "keep the stage/kernel default": the candidate carries
+only deltas, and the all-zeros point at the trivial mesh IS the
+hand-picked default config the bench lane compares against.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import asdict, dataclass, fields
+from typing import Iterator, Optional, Sequence, Tuple
+
+#: mirror of ops/pallas_trees.ROW_TILE_CHOICES (kept literal so the space
+#: module stays importable without pulling jax)
+_ROW_TILE_LADDER = (1024, 2048, 4096)
+
+
+def mesh_factorizations(n_devices: int) -> Tuple[Tuple[int, int], ...]:
+    """Every (data, model) factorization of the visible device count,
+    ascending in data-axis size, plus the trivial 1x1 mesh (the unmeshed
+    default every tuned config must beat). 8 devices -> (1,1) (1,8) (2,4)
+    (4,2) (8,1)."""
+    n = max(1, int(n_devices))
+    shapes = {(1, 1)}
+    for d in range(1, n + 1):
+        if n % d == 0:
+            shapes.add((d, n // d))
+    return tuple(sorted(shapes))
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point of the search space. Frozen + ordered key() so candidate
+    sets sort, dedupe, and replay deterministically."""
+
+    mesh_shape: Tuple[int, int] = (1, 1)
+    #: TT_SPLIT gate for the GBT histogram->split program: "" keeps the
+    #: env/default resolution, "fused"/"twopass" pin it for the trial
+    split: str = ""
+    #: optimizer-state sharding knob applied to every stage exposing it
+    shard_optimizer: str = ""
+    #: GBT histogram bins (0 = keep each stage's configured bins)
+    n_bins: int = 0
+    #: pallas histogram row-tile height (0 = kernel default ROW_TILE)
+    row_tile: int = 0
+    #: ingest stream bucket floor (0 = keep default)
+    stream_bucket_floor: int = 0
+    #: serving pow2 bucket floor (0 = keep default)
+    serve_floor: int = 0
+    #: device prefetch/sink depth (0 = keep default)
+    prefetch_depth: int = 0
+    #: ingest worker count (0 = keep default)
+    ingest_workers: int = 0
+
+    def key(self) -> tuple:
+        """Deterministic total order — the tiebreak everywhere scores tie."""
+        return (tuple(self.mesh_shape), self.split, self.shard_optimizer,
+                self.n_bins, self.row_tile, self.stream_bucket_floor,
+                self.serve_floor, self.prefetch_depth, self.ingest_workers)
+
+    @property
+    def label(self) -> str:
+        d, m = self.mesh_shape
+        bits = [f"{d}x{m}"]
+        if self.split:
+            bits.append(self.split)
+        if self.shard_optimizer:
+            bits.append(f"opt={self.shard_optimizer}")
+        if self.n_bins:
+            bits.append(f"bins{self.n_bins}")
+        if self.row_tile:
+            bits.append(f"tile{self.row_tile}")
+        if self.stream_bucket_floor:
+            bits.append(f"sbf{self.stream_bucket_floor}")
+        if self.serve_floor:
+            bits.append(f"floor{self.serve_floor}")
+        if self.prefetch_depth:
+            bits.append(f"pf{self.prefetch_depth}")
+        if self.ingest_workers:
+            bits.append(f"iw{self.ingest_workers}")
+        return "/".join(bits)
+
+    def as_dict(self) -> dict:
+        doc = asdict(self)
+        doc["mesh_shape"] = list(self.mesh_shape)
+        return doc
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "Candidate":
+        kw = {f.name: doc[f.name] for f in fields(cls) if f.name in doc}
+        if "mesh_shape" in kw:
+            kw["mesh_shape"] = tuple(int(x) for x in kw["mesh_shape"])
+        return cls(**kw)
+
+
+@dataclass(frozen=True)
+class ConfigSpace:
+    """Per-dimension ladders; `candidates()` is their deterministic
+    product. Empty mesh_shapes means "every factorization of the visible
+    devices" (resolved at enumeration time so the space declaration stays
+    host-count independent)."""
+
+    mesh_shapes: Tuple[Tuple[int, int], ...] = ()
+    splits: Tuple[str, ...] = ("fused", "twopass")
+    shard_optimizers: Tuple[str, ...] = ("",)
+    n_bins: Tuple[int, ...] = (0,)
+    row_tiles: Tuple[int, ...] = (0,)
+    stream_bucket_floors: Tuple[int, ...] = (0,)
+    serve_floors: Tuple[int, ...] = (0,)
+    prefetch_depths: Tuple[int, ...] = (0,)
+    ingest_workers: Tuple[int, ...] = (0,)
+
+    @classmethod
+    def default(cls, n_devices: Optional[int] = None) -> "ConfigSpace":
+        """The standing search space: every mesh factorization x the
+        TT_SPLIT gate x the GBT kernel knob ladders. ~100-200 points at 8
+        devices — milliseconds each to rank statically."""
+        shapes = mesh_factorizations(n_devices) if n_devices else ()
+        return cls(mesh_shapes=shapes,
+                   splits=("fused", "twopass"),
+                   shard_optimizers=("", "auto"),
+                   n_bins=(0, 32, 64),
+                   row_tiles=(0,) + _ROW_TILE_LADDER)
+
+    @classmethod
+    def tiny(cls, n_devices: Optional[int] = None) -> "ConfigSpace":
+        """CI-smoke space: small enough that every feasible point can be
+        measured in seconds, but still >= 2 distinct (bins, tile) knob
+        candidates so the kernel-knob search is actually exercised."""
+        shapes = mesh_factorizations(n_devices) if n_devices else ()
+        return cls(mesh_shapes=shapes,
+                   splits=("fused", "twopass"),
+                   n_bins=(0, 32),
+                   row_tiles=(0, 1024))
+
+    def candidates(self, n_devices: Optional[int] = None) -> list:
+        """Deterministic enumeration: mesh (sorted) outermost, then each
+        ladder in field order, values in declaration order."""
+        shapes: Sequence[Tuple[int, int]] = self.mesh_shapes
+        if not shapes:
+            shapes = mesh_factorizations(n_devices or 1)
+        out = []
+        for shape, split, so, bins, tile, sbf, floor, pf, iw in \
+                itertools.product(sorted(set(tuple(s) for s in shapes)),
+                                  self.splits, self.shard_optimizers,
+                                  self.n_bins, self.row_tiles,
+                                  self.stream_bucket_floors,
+                                  self.serve_floors, self.prefetch_depths,
+                                  self.ingest_workers):
+            out.append(Candidate(
+                mesh_shape=shape, split=split, shard_optimizer=so,
+                n_bins=bins, row_tile=tile, stream_bucket_floor=sbf,
+                serve_floor=floor, prefetch_depth=pf, ingest_workers=iw))
+        return out
+
+    def size(self, n_devices: Optional[int] = None) -> int:
+        return len(self.candidates(n_devices))
+
+
+def iter_knob_candidates(space: "ConfigSpace") -> Iterator[Tuple[int, int]]:
+    """The distinct (n_bins, row_tile) pairs a space searches — what the
+    bench lane reports as the knob-search outcome."""
+    seen = set()
+    for bins, tile in itertools.product(space.n_bins, space.row_tiles):
+        if (bins, tile) not in seen:
+            seen.add((bins, tile))
+            yield (bins, tile)

@@ -1,0 +1,251 @@
+"""Measured trials: run the static top-k through the real Workflow.train
+path.
+
+Each trial builds a FRESH workflow from the caller's factory, applies the
+candidate (mesh via make_mesh, stage knobs via params, kernel knobs via
+the TT_SPLIT / TT_ROW_TILE env the fit wrappers resolve into jit static
+args — so two trials differing only in a knob retrace instead of silently
+sharing one compiled program), trains on the same seeded table, and reads
+the wall clock plus the runtime collective counters back.
+
+Replayability contract: the trial SEQUENCE is a pure function of the
+static ranking — the first `top_k` feasible candidates, minus any whose
+static score exceeds `prune_ratio` x the static best. No measured value
+feeds back into which trials run, so the same seed + the same
+calibration.json reproduce the identical sequence (the walls differ, the
+order never does). Repeat trials hydrate executables from the PR-18 AOT
+store, so only the first trial at each distinct static shape compiles.
+"""
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from .ranker import RankedCandidate
+from .space import Candidate
+
+
+@contextmanager
+def env_overrides(**kv):
+    """Set env knobs for one trial, restore exactly on exit. Value None
+    means unset. Keys are real env names (TT_SPLIT, TT_ROW_TILE, ...)."""
+    saved = {k: os.environ.get(k) for k in kv}
+    try:
+        for k, v in kv.items():
+            if v is None or v == "":
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def candidate_env(cand: Candidate) -> dict:
+    """The env knobs a candidate pins (the fit wrappers resolve these into
+    jit static args; empty string = leave the ambient default)."""
+    env = {}
+    if cand.split:
+        env["TT_SPLIT"] = cand.split
+    if cand.row_tile:
+        env["TT_ROW_TILE"] = str(cand.row_tile)
+    if cand.stream_bucket_floor:
+        env["TT_STREAM_BUCKET_FLOOR"] = str(cand.stream_bucket_floor)
+    if cand.prefetch_depth:
+        env["TT_PREFETCH_DEPTH"] = str(cand.prefetch_depth)
+    return env
+
+
+def apply_candidate(workflow, cand: Candidate):
+    """Bind the candidate's stage-level knobs onto a workflow's plan:
+    n_bins on tree-family stages (direct and selector templates),
+    shard_optimizer on every stage exposing the knob; selector grids get
+    the same knobs PINNED (pin_grid) so the CV search doesn't spend grid
+    points re-searching — or silently overriding — an axis the tuner
+    fixed. Returns the workflow (mutated in place — callers pass a fresh
+    factory build per trial)."""
+    from ..analyze.rules import _OP406_TREE_OPS
+    from ..select.grids import pin_grid
+
+    def pins_for(stage) -> dict:
+        p = getattr(stage, "params", None)
+        pins = {}
+        if not isinstance(p, dict):
+            return pins
+        if cand.n_bins and "n_bins" in p \
+                and getattr(stage, "operation_name", None) in _OP406_TREE_OPS:
+            pins["n_bins"] = int(cand.n_bins)
+        if cand.shard_optimizer and "shard_optimizer" in p:
+            pins["shard_optimizer"] = cand.shard_optimizer
+        return pins
+
+    def bind(stage):
+        pins = pins_for(stage)
+        if pins:
+            stage.params.update(pins)
+        return pins
+
+    for layer in getattr(workflow, "_dag", None) or ():
+        for s in layer:
+            bind(s)
+            models = getattr(s, "models", None)
+            if models:
+                s.models = [
+                    (tmpl, pin_grid(grid, **pins) if (pins := bind(tmpl))
+                     else grid)
+                    for tmpl, grid in models]
+    return workflow
+
+
+@dataclass
+class TrialResult:
+    """One measured trial."""
+
+    candidate: Candidate
+    ok: bool = False
+    wall_s: float = 0.0
+    rows_per_sec: float = 0.0
+    collective_bytes: int = 0
+    #: static prediction at trial time (pre-calibration constants)
+    predicted_s: float = 0.0
+    #: static counters (the calibration design row)
+    counters: dict = field(default_factory=dict)
+    error: str = ""
+
+    def to_json(self) -> dict:
+        return {"candidate": self.candidate.as_dict(),
+                "label": self.candidate.label, "ok": self.ok,
+                "wall_s": self.wall_s, "rows_per_sec": self.rows_per_sec,
+                "collective_bytes": self.collective_bytes,
+                "predicted_s": self.predicted_s, "error": self.error}
+
+    def calibration_row(self) -> dict:
+        """The regression row fit_constants consumes: static counters with
+        the MEASURED collective bytes swapped in when the runtime counted
+        any (measured truth beats the model's own estimate)."""
+        row = dict(self.counters)
+        if self.collective_bytes:
+            row["collective_bytes"] = self.collective_bytes
+        row["wall_s"] = self.wall_s
+        return row
+
+
+def select_trials(ranked: Sequence[RankedCandidate], *, top_k: int = 5,
+                  prune_ratio: float = 0.0) -> list:
+    """The deterministic trial list: first top_k feasible candidates in
+    static-rank order; prune_ratio > 0 additionally drops candidates
+    predicted slower than ratio x the static best (static early stopping —
+    a function of the ranking alone, never of a measured wall)."""
+    feasible = [r for r in ranked if r.feasible]
+    if not feasible:
+        return []
+    best = feasible[0].score_s
+    picked = []
+    for r in feasible:
+        if len(picked) >= top_k:
+            break
+        if prune_ratio and best > 0 and r.score_s > prune_ratio * best:
+            break  # ranked order is ascending: everything after is worse
+        picked.append(r)
+    return picked
+
+
+def run_trials(workflow_factory: Callable, ranked: Sequence[RankedCandidate],
+               *, table=None, n_rows: int, top_k: int = 5,
+               prune_ratio: float = 0.0, seed: int = 0, repeats: int = 1,
+               log: Optional[Callable] = None) -> tuple:
+    """Measure the selected trials through Workflow.train. Returns
+    (results, models) — models keyed by candidate.key() so the tuner can
+    stamp and persist the measured winner without refitting.
+
+    Each trial trains `repeats + 1` times (a fresh factory build per
+    train) and records the best WARM wall — the first train pays this
+    config's compiles (amortized by the jit cache and the PR-18 AOT store
+    on repeats), and compile jitter is exactly the noise that would let a
+    slower config win a cold race. A trial that raises (explain-gate
+    rejection, bad knob) records ok=False and the sweep continues. `seed`
+    names the workload the factory builds — it is threaded through for
+    the trial log only; determinism of the sequence comes from the
+    ranking."""
+    from ..mesh import make_mesh, mesh_stats, reset_mesh_stats
+
+    picked = select_trials(ranked, top_k=top_k, prune_ratio=prune_ratio)
+    results, models = [], {}
+    for i, rc in enumerate(picked):
+        cand = rc.candidate
+        tr = TrialResult(candidate=cand, predicted_s=rc.score_s,
+                         counters=dict(rc.counters))
+        if log:
+            log(f"[autotune] trial {i + 1}/{len(picked)} seed={seed} "
+                f"{cand.label}: predicted {rc.score_s * 1e3:.3g} ms")
+        try:
+            walls = []
+            for _rep in range(max(1, repeats) + 1):
+                wf = apply_candidate(workflow_factory(), cand)
+                mesh = make_mesh(*cand.mesh_shape)
+                with env_overrides(**candidate_env(cand)):
+                    reset_mesh_stats()
+                    t0 = time.perf_counter()
+                    model = wf.train(table=table, mesh=mesh)
+                    walls.append(time.perf_counter() - t0)
+                tr.collective_bytes = int(
+                    mesh_stats().get("collective_bytes", 0) or 0)
+            tr.wall_s = min(walls[1:]) if len(walls) > 1 else walls[0]
+            tr.rows_per_sec = n_rows / tr.wall_s if tr.wall_s > 0 else 0.0
+            tr.ok = True
+            models[cand.key()] = model
+        except Exception as exc:  # noqa: BLE001 — a bad candidate is data
+            tr.error = f"{type(exc).__name__}: {exc}"
+            if log:
+                log(f"[autotune]   trial failed: {tr.error}")
+        if log and tr.ok:
+            log(f"[autotune]   measured {tr.wall_s * 1e3:.2f} ms "
+                f"({tr.rows_per_sec:.0f} rows/s, "
+                f"{tr.collective_bytes} collective B)")
+        results.append(tr)
+    return results, models
+
+
+def measure_gbt_knobs(X, y, knobs: Sequence[tuple], *, repeats: int = 2,
+                      fit_kw: Optional[dict] = None,
+                      log: Optional[Callable] = None) -> list:
+    """Kernel-level knob sweep for the bench lane: time fit_gbt directly at
+    each (n_bins, row_tile) pair (0 = default), best-of-`repeats` after a
+    compile warmup per knob. Returns [{n_bins, row_tile, wall_s}] in knob
+    order — the chosen knob is the argmin with the candidate-key tiebreak."""
+    import jax
+
+    from ..ops.trees import fit_gbt
+
+    fit_kw = dict(fit_kw or {})
+    rows = []
+    for n_bins, row_tile in knobs:
+        kw = dict(fit_kw)
+        if n_bins:
+            kw["n_bins"] = int(n_bins)
+        if row_tile:
+            kw["row_tile"] = int(row_tile)
+        try:
+            jax.block_until_ready(fit_gbt(X, y, **kw))  # compile warmup
+            best = float("inf")
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fit_gbt(X, y, **kw))
+                best = min(best, time.perf_counter() - t0)
+            rows.append({"n_bins": n_bins, "row_tile": row_tile,
+                         "wall_s": best})
+            if log:
+                log(f"[autotune] gbt knob bins={n_bins or 'def'} "
+                    f"tile={row_tile or 'def'}: {best * 1e3:.2f} ms")
+        except Exception as exc:  # noqa: BLE001
+            rows.append({"n_bins": n_bins, "row_tile": row_tile,
+                         "wall_s": float("inf"),
+                         "error": f"{type(exc).__name__}: {exc}"})
+    return rows

@@ -1,0 +1,218 @@
+"""`op autotune` orchestration: enumerate -> rank -> measure -> calibrate
+-> stamp.
+
+The five phases close the loop the ROADMAP named: the static analyzer
+predicts, the tuner decides. A search run is a pure function of (workload
+seed, config space, calibration.json): the candidate enumeration and
+static ranking are deterministic, the trial sequence is a function of the
+ranking alone (tune/trials.py), and the winner is chosen by measured wall
+with near-ties (within `winner_margin`) broken by the calibrated static
+score and the candidate key — so re-running with the same seed and the
+same calibration.json reproduces the identical trial sequence and the
+identical `tuned_config` stamp.
+
+The stamp rides model.json exactly like the other device-keyed blocks
+(serving_lane_windows): adopted on load only when the live part matches
+the part that tuned it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .calibrate import (Calibration, default_constants, fit_constants,
+                        load_calibration, predict_wall_s, save_calibration)
+from .ranker import rank_static
+from .space import Candidate, ConfigSpace
+from .trials import apply_candidate, candidate_env, run_trials
+
+
+@dataclass
+class TuneReport:
+    """Everything one search run learned, JSON-able for logs and bench."""
+
+    seed: int = 0
+    space_size: int = 0
+    n_feasible: int = 0
+    n_pruned: int = 0
+    static_top: list = field(default_factory=list)
+    trials: list = field(default_factory=list)
+    winner: Optional[dict] = None
+    calibration: Optional[dict] = None
+    #: |predicted - measured| / measured on the winner, at the POST-run
+    #: calibrated constants — the <= 10% honesty gate
+    winner_rel_error: float = 0.0
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed, "space_size": self.space_size,
+                "n_feasible": self.n_feasible, "n_pruned": self.n_pruned,
+                "static_top": list(self.static_top),
+                "trials": list(self.trials), "winner": self.winner,
+                "calibration": self.calibration,
+                "winner_rel_error": self.winner_rel_error}
+
+
+def _part_stamp() -> dict:
+    from ..serve.aot import compat_stamp
+
+    st = compat_stamp()
+    return {"platform": st["platform"], "device_kind": st["device_kind"]}
+
+
+def select_winner(results, constants: dict, *,
+                  winner_margin: float = 0.05):
+    """Measured winner with a deterministic near-tie rule: every ok trial
+    whose wall is within `winner_margin` of the best is tied; ties break
+    on (calibrated static score, candidate key). Meaningfully different
+    configs differ by far more than the margin, so the measured truth
+    decides; jitter-sized gaps fall back to the deterministic model."""
+    ok = [t for t in results if t.ok and t.wall_s > 0]
+    if not ok:
+        return None
+    best_wall = min(t.wall_s for t in ok)
+    tied = [t for t in ok if t.wall_s <= best_wall * (1.0 + winner_margin)]
+    return min(tied, key=lambda t: (predict_wall_s(t.counters, constants),
+                                    t.candidate.key()))
+
+
+def autotune(workflow_factory: Callable, *, table=None, n_rows: int,
+             space: Optional[ConfigSpace] = None, top_k: int = 5,
+             prune_ratio: float = 0.0, seed: int = 0, repeats: int = 1,
+             winner_margin: float = 0.05,
+             calibration_path: Optional[str] = None,
+             calibrate: bool = True,
+             log: Optional[Callable] = print) -> tuple:
+    """Run the full search. Returns (model, report) — `model` is the
+    measured winner's trained WorkflowModel with `tuned_config` stamped
+    (None when every trial failed). The factory must build a FRESH
+    workflow per call (trials mutate stage params)."""
+    import jax
+
+    part = _part_stamp()
+    n_devices = len(jax.devices())
+    space = space or ConfigSpace.default(n_devices)
+    candidates = space.candidates(n_devices)
+
+    cal = load_calibration(part["platform"], part["device_kind"],
+                           calibration_path)
+    constants = cal.constants() if cal else default_constants()
+
+    # phase 1+2: enumerate and rank statically — zero traces
+    probe = workflow_factory()
+    ranked = rank_static(
+        probe.result_features, getattr(probe, "_dag", None),
+        candidates=candidates, n_rows=n_rows,
+        raw_features=getattr(probe, "raw_features", None),
+        constants=constants)
+    feasible = [r for r in ranked if r.feasible]
+    report = TuneReport(
+        seed=seed, space_size=len(candidates), n_feasible=len(feasible),
+        n_pruned=len(candidates) - len(feasible),
+        static_top=[r.to_json() for r in feasible[:max(top_k, 3)]])
+    if log:
+        log(f"[autotune] {len(candidates)} candidates, "
+            f"{len(feasible)} feasible after OP501/VMEM pruning "
+            f"({'calibrated' if cal else 'data-sheet'} constants)")
+    if not feasible:
+        return None, report
+
+    # phase 3: measure the static top-k through Workflow.train
+    results, models = run_trials(
+        workflow_factory, ranked, table=table, n_rows=n_rows, top_k=top_k,
+        prune_ratio=prune_ratio, seed=seed, repeats=repeats, log=log)
+    report.trials = [t.to_json() for t in results]
+
+    # phase 4: regress measured walls back onto the model constants.
+    # The near-tie tiebreak prices candidates at the run's calibration:
+    # the FRESH fit when calibrating, but the FROZEN loaded constants when
+    # calibrate=False — a replay run must be a pure function of (seed,
+    # calibration.json), and a tiebreak against constants re-fit from this
+    # run's jittered walls would not be
+    new_constants, fit_info = fit_constants(
+        [t.calibration_row() for t in results if t.ok], prior=constants)
+    winner_constants = new_constants if calibrate else constants
+    winner = select_winner(results, winner_constants,
+                           winner_margin=winner_margin)
+    if winner is None:
+        return None, report
+    report.winner_rel_error = abs(
+        predict_wall_s(winner.counters, winner_constants) - winner.wall_s) \
+        / winner.wall_s if winner.wall_s else 0.0
+
+    if calibrate:
+        new_cal = Calibration(
+            platform=part["platform"], device_kind=part["device_kind"],
+            ici_gbps=new_constants["ici_gbps"],
+            peak_tflops=new_constants["peak_tflops"],
+            hbm_gbps=new_constants["hbm_gbps"],
+            family_eff=dict(constants.get("family_eff") or {}),
+            n_trials=fit_info["n"], rel_error=fit_info["rel_error"])
+        path = save_calibration(new_cal, calibration_path)
+        report.calibration = new_cal.to_json()
+        if log:
+            log(f"[autotune] calibrated {part['device_kind']}: "
+                f"peak {new_cal.peak_tflops:.1f} TFLOP/s eff, "
+                f"ici {new_cal.ici_gbps:.1f} GB/s, "
+                f"hbm {new_cal.hbm_gbps:.1f} GB/s -> {path}")
+
+    # phase 5: stamp the winner
+    tuned = {
+        "platform": part["platform"], "device_kind": part["device_kind"],
+        "seed": seed, "config": winner.candidate.as_dict(),
+        "label": winner.candidate.label,
+        "predicted_s": predict_wall_s(winner.counters, winner_constants),
+        "wall_s": winner.wall_s, "rows_per_sec": winner.rows_per_sec,
+    }
+    report.winner = tuned
+    model = models.get(winner.candidate.key())
+    if model is not None:
+        model.tuned_config = tuned
+    if log:
+        log(f"[autotune] winner {winner.candidate.label}: "
+            f"{winner.wall_s * 1e3:.2f} ms measured, predicted error "
+            f"{report.winner_rel_error:.1%}")
+    return model, report
+
+
+# --- inheriting a stamped config ------------------------------------------------------
+
+def tuned_env(tuned: dict) -> dict:
+    """The env knobs a stamped config pins (apply around train/serve with
+    trials.env_overrides, or export process-wide for a replica)."""
+    return candidate_env(Candidate.from_dict(tuned.get("config") or {}))
+
+
+def apply_tuned_config(workflow, tuned: dict, *,
+                       log: Optional[Callable] = None) -> bool:
+    """Bind a stamped config onto a workflow: mesh + stage knobs. Env
+    knobs are NOT set here (process-global) — wrap the train call with
+    `env_overrides(**tuned_env(tuned))`. Returns False (untouched
+    workflow) when the live part or device count cannot honor the stamp."""
+    import jax
+
+    if not isinstance(tuned, dict) or not isinstance(tuned.get("config"),
+                                                     dict):
+        return False
+    part = _part_stamp()
+    if tuned.get("platform") != part["platform"] \
+            or tuned.get("device_kind") != part["device_kind"]:
+        if log:
+            log(f"[autotune] tuned_config is for "
+                f"{tuned.get('platform')}/{tuned.get('device_kind')}, "
+                f"live part is {part['platform']}/{part['device_kind']} — "
+                "ignoring")
+        return False
+    cand = Candidate.from_dict(tuned["config"])
+    d, m = cand.mesh_shape
+    if d * m > len(jax.devices()):
+        if log:
+            log(f"[autotune] tuned mesh {d}x{m} needs {d * m} devices, "
+                f"{len(jax.devices())} visible — ignoring")
+        return False
+    from ..mesh import make_mesh
+
+    workflow.with_mesh(make_mesh(d, m))
+    apply_candidate(workflow, cand)
+    if log:
+        log(f"[autotune] applied tuned_config {cand.label}")
+    return True

@@ -470,6 +470,17 @@ def warm_serving(model_or_dir, buckets: Sequence[int] = None, floor: int = 1,
         model = WorkflowModel.load(model_or_dir)
     else:
         model = model_or_dir
+    # inherit the `op autotune` serving floor when the caller kept the
+    # default ladder: the stamped floor was searched (and survived the
+    # load() part gate), so the warmed buckets match what a tuned
+    # admission will actually build
+    tc = getattr(model, "tuned_config", None) or {}
+    tuned_floor = int((tc.get("config") or {}).get("serve_floor", 0) or 0)
+    if tuned_floor > 0 and not buckets and floor == 1:
+        floor = tuned_floor
+        if log:
+            log(f"[warmup] inheriting tuned serving floor {floor} "
+                "(model.json tuned_config)")
     if export_aot:
         from ..serve.aot import export_aot as _export_aot
 

@@ -728,6 +728,13 @@ class WorkflowModel(WorkflowCore):
         #: seeded into every new score_fn so the routing crossover is
         #: measured-quality from request #1
         self.serving_lane_windows: dict = {}
+        #: `op autotune` winner (tune/tuner.py stamp: platform, device_kind,
+        #: seed, config, measured/predicted seconds) — stamped by the tuner
+        #: on the winning trial's model, saved under model.json
+        #: "tuned_config", adopted on load() only when the live part matches
+        #: the part that tuned it; `op warmup`, serving replicas, and the
+        #: autopilot retrain loop inherit the config from here
+        self.tuned_config: Optional[dict] = None
         #: absolute path of the bundle this model was loaded from (or last
         #: saved to) — where score_fn().warm() looks for AOT artifacts
         self._bundle_path: Optional[str] = None
@@ -946,6 +953,12 @@ class WorkflowModel(WorkflowCore):
                     lane: [[float(d), int(r)] for d, r in win]
                     for lane, win in self.serving_lane_windows.items()
                     if win}}
+        if self.tuned_config:
+            # the autotune winner already carries its own platform/
+            # device_kind stamp (tune/tuner.py) — persisted verbatim so the
+            # load() gate and apply_tuned_config can hold a replica on a
+            # different part to its own defaults
+            manifest["tuned_config"] = self.tuned_config
         # ATOMIC save, including RESAVE over an existing model: the arrays
         # sidecar gets a fresh GENERATION name each save and the manifest
         # records it under "arrays_file", so the manifest's os.replace is the
@@ -1055,6 +1068,17 @@ class WorkflowModel(WorkflowCore):
                 model.serving_lane_windows = {
                     lane: [(float(d), int(r)) for d, r in win]
                     for lane, win in slw["windows"].items()}
+        tc = manifest.get("tuned_config") or None
+        if isinstance(tc, dict) and tc.get("config"):
+            # adopt only on the part that tuned it: a mesh/knob choice
+            # measured on one device class is noise on another (the same
+            # gate serving_lane_windows uses)
+            from ..serve.aot import compat_stamp
+
+            st = compat_stamp()
+            if (tc.get("platform") == st["platform"]
+                    and tc.get("device_kind") == st["device_kind"]):
+                model.tuned_config = tc
         # remember the bundle dir: score_fn().warm() hydrates AOT artifacts
         # from here instead of tracing+compiling (serve/aot.py)
         model._bundle_path = os.path.abspath(path)
