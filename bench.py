@@ -466,6 +466,7 @@ def _run(partial: dict) -> None:
             run_mlp,
             run_monitor_overhead,
             run_multitenant_ingest,
+            run_quality_overhead,
             run_resilience_overhead,
             run_serving_daemon,
             run_streaming_score,
@@ -527,6 +528,18 @@ def _run(partial: dict) -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         partial["lock_check_throughput_retention"] = \
             detail["lock_check_overhead"].get("lock_check_throughput_retention")
+        # model-quality plane cost: composed retention = HTTP /v1/score p50
+        # over (p50 + directly-timed plane hook cost per prediction), which
+        # must stay >= 0.97 (the <= 3% serving contract) — a real armed HTTP
+        # pass (ids over the wire, /v1/feedback joins) rides along for
+        # sanity, and the inline fn.batch ratio as the per-row microscope
+        try:
+            detail["quality_overhead"] = run_quality_overhead()
+        except Exception as e:  # noqa: BLE001
+            detail["quality_overhead"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        partial["quality_throughput_retention"] = \
+            detail["quality_overhead"].get("quality_throughput_retention")
         # serving daemon: closed-loop concurrent clients through the
         # adaptive micro-batcher vs the per-call device path (tail latency
         # is the gated number, not just throughput)
@@ -687,6 +700,14 @@ def _run(partial: dict) -> None:
         s["lock_check_throughput_retention"] = \
             lc["lock_check_throughput_retention"]
         s["lock_check_armed_rows_per_sec"] = lc["stream_armed_rows_per_sec"]
+    if detail.get("quality_overhead", {}).get(
+            "quality_throughput_retention") is not None:
+        qo = detail["quality_overhead"]
+        s["quality_throughput_retention"] = \
+            qo["quality_throughput_retention"]
+        s["quality_inline_retention"] = qo["quality_inline_retention"]
+        s["quality_plane_us_per_prediction"] = \
+            qo["quality_plane_us_per_prediction"]
     if detail.get("serving_daemon", {}).get("daemon_p50_ms") is not None:
         sd = detail["serving_daemon"]
         s["serving_daemon_p50_ms"] = sd["daemon_p50_ms"]

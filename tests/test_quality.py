@@ -1,0 +1,672 @@
+"""Model-quality plane (obs/quality.py, serve/feedback.py) + the autopilot's
+quality trigger tier.
+
+Pins the ISSUE-20 acceptance surface: the quality sketch is a bit-for-bit
+monoid (two-process fleet-merged windowed AuPR/Brier EQUAL a single-process
+oracle, via the serving_quality_scores histogram carrier); the label-feedback
+join is idempotent under duplicates and checkpointable; the audit sink
+publishes atomic segments that replay byte-identically in deterministic mode;
+a seeded concept-flip — features unchanged, labels inverted — fires the
+quality alert while the covariate drift monitor stays silent, and the
+autopilot retrains + promotes on that trigger with zero request errors.
+"""
+import json
+import os
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.monitor import DriftThresholds
+from transmogrifai_tpu.obs.quality import (
+    QUALITY_BINS,
+    QualityMonitor,
+    QualitySketch,
+    QualityThresholds,
+    quality_from_snapshot,
+    sketch_metrics,
+)
+from transmogrifai_tpu.serve import (
+    Autopilot,
+    AutopilotConfig,
+    AuditSink,
+    DaemonClient,
+    DriftScenario,
+    LabelJoiner,
+    QualityPlane,
+    ServingDaemon,
+    extract_score,
+    make_http_server,
+)
+
+BATCH = 64
+
+MONITOR = {
+    "window_batches": 4, "check_every": 1, "max_rows_per_batch": None,
+    "thresholds": DriftThresholds(min_rows=BATCH, max_js_divergence=0.2),
+}
+
+
+def _pairs(n=400, seed=11, separation=2.0):
+    """Seeded (score, label) pairs: labels from a noisy sigmoid-separable
+    score stream. `separation` < 0 inverts the concept (low scores = pos)."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        label = float(rng.random() > 0.5)
+        center = 0.75 if (label > 0.5) == (separation > 0) else 0.25
+        out.append((min(1.0, max(0.0, rng.gauss(center, 0.15))), label))
+    return out
+
+
+# --- the sketch monoid ------------------------------------------------------------------
+class TestSketch:
+    def test_merge_is_exact_and_order_independent(self):
+        """The acceptance pin: shard sketches merged in EITHER order carry
+        the identical integer state as one sketch that saw everything, so
+        every derived metric is equal bit-for-bit, not approximately."""
+        pairs = _pairs(600)
+        oracle = QualitySketch()
+        a, b = QualitySketch(), QualitySketch()
+        for i, (s, y) in enumerate(pairs):
+            oracle.observe(s, y)
+            (a if i % 2 == 0 else b).observe(s, y)
+        ab = a.copy()
+        ab.merge(b)
+        ba = b.copy()
+        ba.merge(a)
+        assert ab.to_json() == ba.to_json() == oracle.to_json()
+        assert sketch_metrics(ab) == sketch_metrics(oracle)
+        assert ab.n == 600 and ab.n_pos + ab.n_neg == 600
+
+    def test_json_roundtrip(self):
+        sk = QualitySketch()
+        sk.observe_many(_pairs(100))
+        back = QualitySketch.from_json(sk.to_json())
+        assert back.to_json() == sk.to_json()
+        assert sketch_metrics(back) == sketch_metrics(sk)
+
+    def test_metrics_track_separation(self):
+        good = QualitySketch()
+        good.observe_many(_pairs(400, separation=2.0))
+        bad = QualitySketch()
+        bad.observe_many(_pairs(400, separation=-2.0))
+        gm, bm = sketch_metrics(good), sketch_metrics(bad)
+        assert gm["AuPR"] > 0.9 > 0.4 > bm["AuPR"]
+        assert gm["AuROC"] > 0.9 > 0.4 > bm["AuROC"]
+        assert gm["BrierScore"] < 0.15 < bm["BrierScore"]
+        assert 0 < len(gm["calibration"]) <= 16
+        assert all(set(c) >= {"lo", "hi", "mean_score", "n"}
+                   for c in gm["calibration"])
+
+    def test_empty_sketch_is_defined(self):
+        m = sketch_metrics(QualitySketch())
+        assert m["n"] == 0 and m["AuPR"] == 0.0 and m["BrierScore"] == 0.0
+
+    def test_binned_close_to_exact_curve(self):
+        """64 bins keep the binned AuPR/AuROC within ~1e-2 of the exact
+        per-sample curve (the evaluators' implementation)."""
+        from transmogrifai_tpu.evaluators.metrics_ops import binary_curve_aucs
+        import numpy as np
+
+        pairs = _pairs(500, seed=4)
+        scores = np.array([s for s, _ in pairs], dtype=np.float64)
+        y = np.array([l for _, l in pairs], dtype=np.float64)
+        auroc, aupr = binary_curve_aucs(scores, y)
+        sk = QualitySketch()
+        sk.observe_many(pairs)
+        m = sketch_metrics(sk)
+        assert m["AuROC"] == pytest.approx(auroc, abs=0.02)
+        assert m["AuPR"] == pytest.approx(aupr, abs=0.02)
+
+
+# --- fleet federation -------------------------------------------------------------------
+class TestFederation:
+    def test_two_process_merge_equals_single_process_oracle(self):
+        """Two registries (two 'processes') each observe half the joined
+        pairs through their own QualityMonitor; the FleetAggregator merge of
+        their serving_quality_scores histograms rebuilds the EXACT sketch —
+        fleet AuPR/AuROC/Brier equal the single-process oracle bit-for-bit
+        (dict equality, no tolerance)."""
+        pairs = _pairs(512, seed=9)
+        oracle_reg = MetricsRegistry()
+        oracle = QualityMonitor(registry=oracle_reg, source="live",
+                                window_pairs=None, check_every=10**9)
+        shard_regs = [MetricsRegistry() for _ in range(2)]
+        shards = [QualityMonitor(registry=r, source="live",
+                                 window_pairs=None, check_every=10**9)
+                  for r in shard_regs]
+        for i, (s, y) in enumerate(pairs):
+            oracle.observe_pair(s, y)
+            shards[i % 2].observe_pair(s, y)
+        agg = obs.FleetAggregator()
+        for i, reg in enumerate(shard_regs):
+            agg.ingest("serve", i, reg.snapshot(samples=True))
+        fleet = quality_from_snapshot(agg.merged().snapshot(samples=True))
+        solo = quality_from_snapshot(oracle_reg.snapshot(samples=True))
+        assert "live" in fleet
+        assert fleet == solo  # EXACT — the federation acceptance pin
+        # and both equal the raw sketch the oracle folded
+        assert fleet["live"] == sketch_metrics(oracle.cumulative)
+        assert fleet["live"]["n"] == 512
+
+    def test_snapshot_without_quality_series_is_empty(self):
+        reg = MetricsRegistry()
+        reg.counter("rows_total").inc(3)
+        assert quality_from_snapshot(reg.snapshot(samples=True)) == {}
+
+
+# --- label-feedback join ----------------------------------------------------------------
+class TestJoiner:
+    def test_join_and_duplicate_idempotence(self):
+        j = LabelJoiner(registry=MetricsRegistry(), model_label="m")
+        j.note("p-1", 0.9)
+        j.note("p-2", 0.2)
+        assert j.feedback("p-1", 1.0) == ("joined", (0.9, 1.0))
+        # a replayed label is counted and IGNORED — never re-folded
+        assert j.feedback("p-1", 0.0) == ("duplicate", None)
+        assert j.feedback("p-1", 1.0) == ("duplicate", None)
+        assert j.feedback("nope", 1.0) == ("unmatched", None)
+        assert j.stats() == {"pending": 1, "done": 1, "received": 4,
+                             "joined": 1, "duplicate": 2, "unmatched": 1,
+                             "expired": 0}
+
+    def test_logical_ttl_expires_by_note_count(self):
+        """TTL is logical (join ATTEMPTS, not wall clock): a pending id
+        expires after ttl_notes subsequent notes — replays age identically."""
+        j = LabelJoiner(ttl_notes=4, max_pending=100,
+                        registry=MetricsRegistry())
+        for i in range(6):
+            j.note(f"p-{i}", 0.5)
+        # p-0 aged out at note 5, p-1 at note 6
+        assert j.feedback("p-0", 1.0)[0] == "unmatched"
+        assert j.feedback("p-2", 1.0)[0] == "joined"
+        assert j.stats()["expired"] == 2
+
+    def test_max_pending_evicts_oldest(self):
+        j = LabelJoiner(max_pending=3, registry=MetricsRegistry())
+        for i in range(5):
+            j.note(f"p-{i}", 0.5)
+        assert j.depth() == 3
+        assert j.feedback("p-0", 1.0)[0] == "unmatched"
+        assert j.feedback("p-4", 1.0)[0] == "joined"
+
+    def test_checkpoint_roundtrip_and_monoid_merge(self):
+        a = LabelJoiner(registry=MetricsRegistry(), model_label="m")
+        a.note("a-1", 0.8)
+        a.note("shared", 0.6)
+        a.feedback("a-1", 1.0)
+        # restart drill: the restored joiner behaves identically
+        restored = LabelJoiner.from_json(a.to_json(),
+                                         registry=MetricsRegistry(),
+                                         model_label="m")
+        assert restored.to_json() == a.to_json()
+        assert restored.feedback("a-1", 1.0)[0] == "duplicate"
+        assert restored.feedback("shared", 0.0)[0] == "joined"
+        # two replicas fold: counters add, a join on EITHER side wins over
+        # the other side's pending (no double-join after merge)
+        b = LabelJoiner(registry=MetricsRegistry(), model_label="m")
+        b.note("shared", 0.6)
+        b.note("b-1", 0.3)
+        b.feedback("shared", 1.0)
+        merged = LabelJoiner.from_json(a.to_json(),
+                                       registry=MetricsRegistry(),
+                                       model_label="m")
+        merged.merge(b)
+        assert merged.feedback("shared", 0.0)[0] == "duplicate"
+        assert merged.feedback("b-1", 1.0)[0] == "joined"
+        assert merged.counters["joined"] == \
+            a.counters["joined"] + b.counters["joined"] + 1
+
+
+# --- audit sink -------------------------------------------------------------------------
+class TestAuditSink:
+    def _run(self, out_dir, n=8, segment_records=4):
+        sink = AuditSink(str(out_dir), "m", fingerprint="fp0",
+                         segment_records=segment_records,
+                         deterministic=True, registry=MetricsRegistry())
+        try:
+            for i in range(n):
+                pid = sink.next_id()
+                sink.emit(pid, (i + 1) / (n + 1))
+            sink.flush()
+        finally:
+            sink.close()
+        return sink.segments()
+
+    def test_deterministic_segments_byte_identical(self, tmp_path):
+        """The satellite fix, pinned: deterministic mode strips wall-clock
+        and randomness, so two identical runs publish byte-identical
+        segment files (chaos-replay diffable)."""
+        segs_a = self._run(tmp_path / "a")
+        segs_b = self._run(tmp_path / "b")
+        assert [os.path.basename(p) for p in segs_a] == \
+            [os.path.basename(p) for p in segs_b] == \
+            ["audit-m-0001.jsonl", "audit-m-0002.jsonl"]
+        for pa, pb in zip(segs_a, segs_b):
+            assert open(pa, "rb").read() == open(pb, "rb").read()
+        recs = [json.loads(ln) for p in segs_a for ln in open(p)]
+        assert len(recs) == 8
+        assert all("ts" not in r for r in recs)  # no wall clock
+        assert recs[0]["fingerprint"] == "fp0"
+        assert recs[0]["id"].endswith("-00000001")
+
+    def test_atomic_publish_leaves_no_temp(self, tmp_path):
+        self._run(tmp_path, n=8)
+        assert all(not f.endswith(".tmp") and ".tmp." not in f
+                   for f in os.listdir(tmp_path))
+
+    def test_sampling_and_counters(self, tmp_path):
+        reg = MetricsRegistry()
+        sink = AuditSink(str(tmp_path), "m", sample_every=4,
+                         deterministic=True, registry=reg)
+        try:
+            accepted = sum(sink.emit(sink.next_id(), 0.5) for _ in range(16))
+            sink.flush()
+        finally:
+            sink.close()
+        assert accepted == 4
+        assert reg.find("audit_records_total",
+                        labels={"model": "m"}).value == 4
+
+
+# --- monitor edge-triggering ------------------------------------------------------------
+class TestMonitor:
+    BASE = {"metric": "AuPR", "value": 0.95, "larger_is_better": True,
+            "problem_type": "binary", "n_holdout": 64}
+
+    def _monitor(self, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("window_pairs", None)
+        kw.setdefault("check_every", 10**9)  # explicit check() only
+        kw.setdefault("thresholds", QualityThresholds(margin=0.1,
+                                                      min_joined=8))
+        return QualityMonitor(self.BASE, source="m", **kw)
+
+    def test_breach_is_edge_triggered_then_clears(self):
+        mon = self._monitor()
+        for s, y in _pairs(64, separation=-2.0):  # inverted concept
+            mon.observe_pair(s, y)
+        fired = mon.check()
+        assert [a.metric for a in fired] == ["AuPR"]
+        assert fired[0].baseline == 0.95 and fired[0].value < 0.85
+        assert mon.active == ["AuPR"]
+        assert mon.check() == []  # edge, not level: no re-fire while active
+        # recovery: enough well-ranked pairs pull the window back over the
+        # breach line -> the episode clears and the counter ticks
+        for s, y in _pairs(2000, seed=5, separation=2.0):
+            mon.observe_pair(s, y)
+        assert mon.check() == [] and mon.active == []
+        assert mon.registry.find(
+            "serving_quality_cleared_total",
+            labels={"metric": "AuPR", "model": "m"}).value == 1
+        assert mon.registry.find(
+            "serving_quality_alerts_total",
+            labels={"metric": "AuPR", "model": "m"}).value == 1
+
+    def test_min_joined_gates_alerting(self):
+        mon = self._monitor()
+        for s, y in _pairs(6, separation=-2.0):  # terrible but tiny
+            mon.observe_pair(s, y)
+        assert mon.check() == [] and mon.active == []
+
+    def test_no_baseline_watches_without_paging(self):
+        reg = MetricsRegistry()
+        mon = QualityMonitor(None, registry=reg, source="m",
+                             window_pairs=None, check_every=10**9)
+        for s, y in _pairs(64, separation=-2.0):
+            mon.observe_pair(s, y)
+        assert mon.check() == []
+        assert reg.find("serving_quality_aupr",
+                        labels={"model": "m"}) is not None
+
+    def test_resolve_active_synthesizes_falling_edge(self):
+        mon = self._monitor()
+        for s, y in _pairs(64, separation=-2.0):
+            mon.observe_pair(s, y)
+        mon.check()
+        assert mon.resolve_active(reason="promoted") == ["AuPR"]
+        assert mon.active == []
+        assert mon.registry.find(
+            "serving_quality_cleared_total",
+            labels={"metric": "AuPR", "model": "m"}).value == 1
+
+    def test_breach_dumps_flight_recorder(self, tmp_path):
+        """quality:breach is a dump trigger: the event ring lands on disk
+        with reason=quality_breach (the post-mortem satellite)."""
+        rec_reg = MetricsRegistry()
+        obs.install_recorder(role="qproc", out_dir=str(tmp_path),
+                             registry=rec_reg, signals=False)
+        try:
+            mon = self._monitor()
+            for s, y in _pairs(64, separation=-2.0):
+                mon.observe_pair(s, y)
+            mon.check()
+            dump = json.loads(
+                (tmp_path / "flightrec-qproc.json").read_text())
+            assert dump["reason"] == "quality_breach"
+            breach = [e for e in dump["events"]
+                      if e["name"] == "quality:breach"]
+            assert breach and breach[-1]["attrs"]["metric"] == "AuPR"
+            assert rec_reg.find(
+                "flightrec_dumps_total",
+                labels={"reason": "quality_breach",
+                        "role": "qproc"}).value == 1
+        finally:
+            obs.uninstall_recorder()
+
+
+# --- score extraction -------------------------------------------------------------------
+class TestExtractScore:
+    def test_classifier_row_uses_positive_probability(self):
+        row = {"pred": {"prediction": 1.0, "probability": [0.2, 0.8]}}
+        assert extract_score(row) == 0.8
+
+    def test_regressor_row_clamps(self):
+        assert extract_score({"pred": 1.7}) == 1.0
+        assert extract_score({"pred": -0.2}) == 0.0
+
+    def test_unreadable_row_is_none(self):
+        assert extract_score({"pred": "abc"}) is None
+        assert extract_score({}) is None
+
+
+# --- quality plane (sink + joiner + monitor) --------------------------------------------
+class TestQualityPlane:
+    def test_score_feedback_loop(self, tmp_path):
+        reg = MetricsRegistry()
+        plane = QualityPlane("m", audit_dir=str(tmp_path),
+                             baseline=TestMonitor.BASE,
+                             window_pairs=None, check_every=8,
+                             deterministic=True, registry=reg)
+        try:
+            pairs = _pairs(32, separation=2.0)
+            rows = [{"pred": {"prediction": y, "probability": [1 - s, s]}}
+                    for s, y in pairs]
+            ids = plane.on_scored(rows)
+            assert len(ids) == 32 and all(i is not None for i in ids)
+            assert len(set(ids)) == 32  # unique, positional
+            counts = plane.on_feedback_many(
+                [{"id": i, "label": y}
+                 for i, (_, y) in zip(ids, pairs)] +
+                [{"id": ids[0], "label": 1.0},      # duplicate
+                 {"id": "ghost", "label": 1.0},     # unmatched
+                 {"label": 1.0}])                   # invalid (no id)
+            assert counts == {"joined": 32, "duplicate": 1,
+                              "unmatched": 1, "invalid": 1}
+            stats = plane.stats()
+            assert stats["join"]["joined"] == 32
+            assert stats["window"]["n"] == 32
+            assert stats["window"]["AuPR"] > 0.9
+        finally:
+            plane.close()
+        assert plane.stats()["audit_segments"] >= 1
+
+    def test_unscoreable_rows_get_none_positionally(self):
+        plane = QualityPlane("m", registry=MetricsRegistry())
+        ids = plane.on_scored([{"pred": 0.5}, {"pred": "junk"},
+                               {"pred": 0.7}])
+        assert ids[0] is not None and ids[1] is None and ids[2] is not None
+
+
+# --- daemon + HTTP surface --------------------------------------------------------------
+class TestDaemonFeedback:
+    def _daemon(self, tmp_path, quality=True):
+        sc = DriftScenario(seed=3, batch=BATCH)
+        champ = sc.train_champion()
+        champ.quality_baseline = dict(TestMonitor.BASE)
+        mdir = str(tmp_path / "champion")
+        champ.save(mdir, overwrite=True)
+        daemon = ServingDaemon(max_models=2, max_batch=BATCH,
+                               bucket_floor=BATCH, quality=quality)
+        daemon.admit(mdir, name="live")
+        return sc, daemon
+
+    def test_http_score_ids_and_feedback_join(self, tmp_path):
+        sc, daemon = self._daemon(tmp_path)
+        server = make_http_server(daemon, port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        port = server.server_address[1]
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+        try:
+            with daemon:
+                records, labels = sc.serving_batch_labeled(BATCH)
+                out = post("/v1/score", {"model": "live",
+                                         "records": records})
+                ids = [r["prediction_id"] for r in out["results"]]
+                assert len(ids) == BATCH and all(ids)
+                body = post("/v1/feedback", {
+                    "model": "live",
+                    "labels": [{"id": i, "label": y}
+                               for i, y in zip(ids, labels)]})
+                assert body["joined"] == BATCH and body["unmatched"] == 0
+                # duplicate replay via the single-label form: idempotent
+                body = post("/v1/feedback", {"model": "live",
+                                             "id": ids[0], "label": 1.0})
+                assert body == {"model": "live", "joined": 0,
+                                "duplicate": 1, "unmatched": 0, "invalid": 0}
+                # the join shows up on /v1/models introspection
+                info = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/models",
+                    timeout=60).read())
+                q = info["models"][0]["quality"]
+                assert q["join"]["joined"] == BATCH
+                assert q["window"]["n"] == BATCH
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+
+    def test_feedback_errors_unknown_model_and_unarmed(self, tmp_path):
+        sc, daemon = self._daemon(tmp_path, quality=False)
+        with daemon:
+            with pytest.raises(KeyError):
+                daemon.feedback("ghost", [{"id": "x", "label": 1.0}])
+            with pytest.raises(ValueError):
+                # admitted without a quality plane: 400, not a silent drop
+                daemon.feedback("live", [{"id": "x", "label": 1.0}])
+
+    def test_quality_off_rows_have_no_ids(self, tmp_path):
+        sc, daemon = self._daemon(tmp_path, quality=False)
+        with daemon:
+            rows = DaemonClient(daemon).score(sc.serving_batch(8),
+                                              model="live")
+            assert all("prediction_id" not in r for r in rows)
+
+
+# --- workflow baseline stamp ------------------------------------------------------------
+class TestBaselineStamp:
+    def _train_selector_model(self):
+        import numpy as np
+
+        from transmogrifai_tpu.graph import features_from_schema
+        from transmogrifai_tpu.readers import InMemoryReader
+        from transmogrifai_tpu.select import (
+            CrossValidation, DataSplitter, ModelSelector, ParamGridBuilder)
+        from transmogrifai_tpu.stages.feature import transmogrify
+        from transmogrifai_tpu.stages.model import LogisticRegression
+        from transmogrifai_tpu.workflow import Workflow
+
+        rng = np.random.default_rng(0)
+        rows = []
+        for _ in range(240):
+            x = float(rng.normal())
+            rows.append({"label": float(x + rng.normal(0, 0.5) > 0),
+                         "x": x})
+        fs = features_from_schema({"label": "RealNN", "x": "Real"},
+                                  response="label")
+        sel = ModelSelector(
+            "binary",
+            models=[(LogisticRegression(max_iter=40),
+                     ParamGridBuilder().add("l2", [0.0]).build())],
+            validator=CrossValidation(num_folds=2, seed=1),
+            splitter=DataSplitter(reserve_test_fraction=0.2, seed=1))
+        pred = sel(fs["label"], transmogrify([fs["x"]]))
+        table = InMemoryReader(rows).generate_table(list(fs.values()))
+        return Workflow().set_result_features(pred).train(table=table)
+
+    def test_train_stamps_and_save_load_roundtrips(self, tmp_path):
+        model = self._train_selector_model()
+        qb = model.quality_baseline
+        assert qb is not None
+        assert qb["metric"] == "AuPR" and qb["larger_is_better"] is True
+        assert qb["problem_type"] == "binary" and qb["n_holdout"] > 0
+        assert 0.0 < qb["value"] <= 1.0
+        model.save(str(tmp_path / "m"), overwrite=True)
+        manifest = json.loads((tmp_path / "m" / "model.json").read_text())
+        assert manifest["quality_baseline"] == qb
+        from transmogrifai_tpu.workflow import WorkflowModel
+
+        loaded = WorkflowModel.load(str(tmp_path / "m"))
+        assert loaded.quality_baseline == qb
+
+    def test_selectorless_model_has_no_stamp(self, tmp_path):
+        sc = DriftScenario(seed=0, batch=8)
+        model = sc.train_champion()
+        assert model.quality_baseline is None
+        model.save(str(tmp_path / "m"), overwrite=True)
+        manifest = json.loads((tmp_path / "m" / "model.json").read_text())
+        assert "quality_baseline" not in manifest
+
+
+# --- the autopilot quality tier ---------------------------------------------------------
+class TestQualityTier:
+    def _loop(self, tmp_path, seed=3):
+        """A monitored + quality-armed loop: covariate drift thresholds LIVE
+        (they must stay silent through the concept flip) and the champion
+        stamped with its pre-flip quality baseline."""
+        sc = DriftScenario(seed=seed, batch=BATCH)
+        champ = sc.train_champion()
+        champ.quality_baseline = {"metric": "AuPR", "value": 0.97,
+                                  "larger_is_better": True,
+                                  "problem_type": "binary",
+                                  "n_holdout": BATCH}
+        mdir = str(tmp_path / "champion")
+        champ.save(mdir, overwrite=True)
+        daemon = ServingDaemon(
+            max_models=3, max_batch=BATCH, bucket_floor=BATCH,
+            monitor=MONITOR,
+            quality={"window_pairs": None, "check_every": BATCH})
+        daemon.admit(mdir, name="live")
+        pilot = Autopilot(
+            daemon, "live", workflow_factory=sc.make_workflow,
+            holdout=sc.holdout_reader, workdir=str(tmp_path / "work"),
+            config=AutopilotConfig(breach_checks=2))
+        return sc, daemon, pilot
+
+    def _feed(self, daemon, sc, n=1):
+        """Score a labeled batch, then POST the delayed truth back against
+        the minted prediction ids. Every row scored = zero request errors."""
+        client = DaemonClient(daemon)
+        for _ in range(n):
+            records, labels = sc.serving_batch_labeled(BATCH)
+            rows = client.score(records, model="live")
+            assert len(rows) == BATCH and all(r is not None for r in rows), \
+                "request errors across the loop"
+            counts = daemon.feedback(
+                "live", [{"id": r["prediction_id"], "label": y}
+                         for r, y in zip(rows, labels)])
+            assert counts["joined"] == BATCH
+
+    def test_concept_flip_triggers_quality_not_drift(self, tmp_path):
+        """THE acceptance drill: the label rule inverts, every feature
+        marginal stays put. The covariate monitor sees nothing; the quality
+        tier breaches on joined feedback, sustains, and the autopilot
+        retrains + promotes — zero request errors throughout."""
+        sc, daemon, pilot = self._loop(tmp_path)
+        with daemon:
+            self._feed(daemon, sc, 1)
+            steady = pilot.step()
+            assert steady["action"] == "observe"
+            assert steady["trigger"] == "none" and not steady["drifted"]
+            sc.flip_concept()
+            self._feed(daemon, sc, 2)
+            d1 = pilot.step()
+            assert d1["action"] == "observe" and d1["streak"] == 1
+            assert d1["quality_active"] == ["AuPR"]
+            assert d1["active"] == []           # covariate monitor SILENT
+            assert d1["trigger"] == "quality"   # the blind spot, covered
+            self._feed(daemon, sc, 1)
+            d2 = pilot.step()                   # streak 2 -> act
+            assert d2["action"] == "promoted"
+            assert d2["trigger"] == "quality" and d2["active"] == []
+            gate = d2["gate"]
+            # the flipped concept collapses the champion's ranking; the
+            # retrain learns the new rule
+            assert gate["challenger"] > 0.9 > gate["champion"]
+            assert daemon.aliases()["live"] == \
+                pilot.history[-1]["fingerprint"]
+            # post-swap traffic serves cleanly on the new champion
+            client = DaemonClient(daemon)
+            out = client.score(sc.serving_batch(BATCH), model="live")
+            assert len(out) == BATCH and all(r is not None for r in out)
+
+    def test_promotion_resolves_demoted_quality_episode(self, tmp_path):
+        """The demoted champion's quality episode cannot clear naturally
+        (no feedback will ever reach it) — promotion synthesizes the
+        falling edge, so serving_quality_cleared_total ticks."""
+        reg = obs.default_registry()
+
+        def cleared_total():
+            return sum(m.value for m in reg.collect()
+                       if m.name == "serving_quality_cleared_total")
+
+        sc, daemon, pilot = self._loop(tmp_path)
+        with daemon:
+            self._feed(daemon, sc, 1)
+            pilot.step()
+            sc.flip_concept()
+            before = cleared_total()
+            self._feed(daemon, sc, 2)
+            pilot.step()
+            self._feed(daemon, sc, 1)
+            assert pilot.step()["action"] == "promoted"
+            assert cleared_total() > before
+
+    def test_quality_trigger_config_off(self, tmp_path):
+        """quality_trigger=False: the plane still measures and exports, but
+        the autopilot never acts on it (operators can watch before arming)."""
+        sc, daemon, pilot = self._loop(tmp_path)
+        pilot.config.quality_trigger = False
+        with daemon:
+            self._feed(daemon, sc, 1)
+            pilot.step()
+            sc.flip_concept()
+            self._feed(daemon, sc, 2)
+            d = pilot.step()
+            assert d["trigger"] == "none" and not d["drifted"]
+            self._feed(daemon, sc, 1)
+            assert pilot.step()["action"] == "observe"
+            assert pilot.promotions == 0
+
+    def test_same_seed_replays_identical_decision_log(self, tmp_path):
+        """The quality tier preserves the loop's replay determinism: two
+        independent concept-flip episodes from one seed produce identical
+        structured event logs."""
+        def run(base):
+            sc, daemon, pilot = self._loop(base)
+            with daemon:
+                self._feed(daemon, sc, 1)
+                pilot.step()
+                sc.flip_concept()
+                self._feed(daemon, sc, 2)
+                pilot.step()
+                self._feed(daemon, sc, 1)
+                pilot.step()
+            return pilot.events
+
+        a = run(tmp_path / "a")
+        b = run(tmp_path / "b")
+        assert a == b
+        assert any(e[1] == "promoted" for e in a)

@@ -42,12 +42,15 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_sec", "_secs", "_seconds")
 #: train_warmup_warm_s walls and train_warmup_warm_compiles, which must
 #: stay 0 on a warm store); train_aot_speedup stays higher-better via the
 #: override list
+#: "us_per" covers the quality lane's quality_plane_us_per_prediction —
+#: the plane's whole per-prediction CPU bill, which must not creep up
 _LOWER_SUBSTR = ("warmup", "latency", "p50", "p95", "p99", "cold_start",
-                 "recovery", "state_bytes", "rel_error")
+                 "recovery", "state_bytes", "rel_error", "us_per")
 #: overrides: fragments that look like seconds but are throughput/quality
 #: ("retention" covers every *_throughput_retention overhead lane — monitor,
-#: resilience, and fleet_obs: observed/bare rows-per-sec ratios whose floor
-#: is "the instrumented path must stay within a few percent of free")
+#: resilience, fleet_obs, and quality: observed/bare rows-per-sec ratios
+#: whose floor is "the instrumented path must stay within a few percent of
+#: free")
 #: ("speedup" also covers the autotune lane's headline autotune_speedup —
 #: tuned/default train throughput, floor 1.0 by construction — and
 #: "rows_per" its autotune_tuned_rows_per_sec; autotune_winner_rel_error
@@ -63,8 +66,8 @@ _NEUTRAL_SUBSTR = ("chosen_bins", "chosen_tile", "knobs_measured")
 #: ABSOLUTE floor for every *_throughput_retention lane, checked on the NEW
 #: record alone (the relative diff can't catch a slow multi-PR slide, and a
 #: brand-new retention lane has no old value to diff against): instrumented
-#: paths — monitor, resilience, fleet_obs, lock_check — must keep >= 97% of
-#: bare throughput
+#: paths — monitor, resilience, fleet_obs, lock_check, quality — must keep
+#: >= 97% of bare throughput
 _RETENTION_FLOOR = 0.97
 
 

@@ -678,6 +678,88 @@ print(f"autopilot smoke ok: {fired:.0f} drift alert(s), challenger "
       f"zero request errors")
 PY
 
+echo "== model-quality smoke (concept flip -> label feedback -> quality trigger) =="
+# the ISSUE-20 blind-spot drill: the label rule inverts while every feature
+# marginal stays exactly where training left it, so the covariate drift
+# monitor must stay SILENT — only delayed label feedback (truth POSTed back
+# against the prediction ids minted at score time) can reveal the regime
+# change. The quality tier breaches on joined feedback, sustains, and the
+# autopilot retrains + promotes on trigger="quality" with ZERO request
+# errors and ZERO covariate alerts throughout.
+TT_LOCK_CHECK=1 python - <<'PY'
+from transmogrifai_tpu.obs.monitor import DriftThresholds
+from transmogrifai_tpu.serve import (
+    Autopilot, AutopilotConfig, DaemonClient, DriftScenario, ServingDaemon)
+
+import tempfile
+
+BATCH = 64
+sc = DriftScenario(seed=3, batch=BATCH)
+champion = sc.train_champion()
+# the scenario's single-LR champion skips the selector (so no auto-stamped
+# baseline from holdout evaluation) — stamp the known pre-flip quality by
+# hand, exactly what `Workflow.train` does for selector models
+champion.quality_baseline = {"metric": "AuPR", "value": 0.97,
+                             "larger_is_better": True,
+                             "problem_type": "binary", "n_holdout": BATCH}
+work = tempfile.mkdtemp(prefix="ci_quality_")
+champion.save(f"{work}/champion", overwrite=True)
+
+daemon = ServingDaemon(
+    max_models=3, max_batch=BATCH, bucket_floor=BATCH,
+    monitor={"window_batches": 4, "check_every": 1,
+             "max_rows_per_batch": None,
+             "thresholds": DriftThresholds(min_rows=BATCH,
+                                           max_js_divergence=0.2)},
+    quality={"window_pairs": None, "check_every": BATCH})
+client = DaemonClient(daemon)
+errors = 0
+with daemon:
+    daemon.admit(f"{work}/champion", name="live")
+    pilot = Autopilot(daemon, "live", workflow_factory=sc.make_workflow,
+                      holdout=sc.holdout_reader,
+                      workdir=f"{work}/candidates",
+                      config=AutopilotConfig(breach_checks=2))
+    joined = 0
+
+    def feed(n=1):
+        global errors, joined
+        for _ in range(n):
+            records, labels = sc.serving_batch_labeled(BATCH)
+            rows = client.score(records, model="live")
+            if len(rows) != BATCH or any(r is None for r in rows):
+                errors += 1
+                continue
+            counts = daemon.feedback(
+                "live", [{"id": r["prediction_id"], "label": y}
+                         for r, y in zip(rows, labels)])
+            joined += counts["joined"]
+
+    feed(1)
+    steady = pilot.step()
+    assert steady["action"] == "observe" and steady["trigger"] == "none"
+    sc.flip_concept()
+    feed(2)
+    d1 = pilot.step()
+    assert d1["quality_active"] == ["AuPR"], d1
+    assert d1["active"] == [], "covariate monitor must stay silent"
+    assert d1["trigger"] == "quality", d1
+    feed(1)
+    d2 = pilot.step()
+    assert d2["action"] == "promoted", d2
+    assert d2["trigger"] == "quality" and d2["active"] == []
+    gate = d2["gate"]
+    assert gate["challenger"] > gate["champion"], gate
+    out = client.score(sc.serving_batch(BATCH), model="live")
+    if len(out) != BATCH or any(r is None for r in out):
+        errors += 1
+assert errors == 0, f"{errors} request error(s) across the loop"
+print(f"model-quality smoke ok: {joined} labels joined, concept flip "
+      f"breached AuPR with the covariate monitor silent, challenger "
+      f"{gate['challenger']:.3f} vs champion {gate['champion']:.3f}, "
+      f"1 promotion, zero request errors")
+PY
+
 echo "== cold-start smoke (AOT deploy artifacts) =="
 # save a tiny model WITH the AOT artifact set, then load + 2-row score in a
 # FRESH subprocess: the hydration counter must tick and the warm+score

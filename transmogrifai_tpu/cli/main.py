@@ -48,6 +48,12 @@ def _cmd_run(argv) -> int:
                          "feature-drift sketches against the model's stamped "
                          "serving_baseline and report per-feature fill-rate/"
                          "JS-divergence + structured drift alerts")
+    ap.add_argument("--audit-dir", default=None, metavar="DIR",
+                    help="score runs: mint a prediction_id output column and "
+                         "land sampled (id, fingerprint, score) audit "
+                         "records as atomic JSONL segments in DIR — the "
+                         "join keys `op feedback` resolves delayed labels "
+                         "against (docs/observability.md)")
     ap.add_argument("--retry-max", type=int, default=None, metavar="N",
                     help="retries (seeded-jitter exponential backoff) for "
                          "transient host-side ingest errors; default 0 = "
@@ -102,6 +108,8 @@ def _cmd_run(argv) -> int:
         params.lenient_lint = True
     if args.monitor:
         params.monitor = True
+    if args.audit_dir is not None:
+        params.audit_dir = args.audit_dir
     if args.retry_max is not None:
         params.retry_max = args.retry_max
     if args.deadline_s is not None:
@@ -581,6 +589,13 @@ def _cmd_monitor(argv) -> int:
                          "counters summed exactly, fleet percentiles from "
                          "merged reservoirs — as a table, --prom exposition, "
                          "or --json snapshots")
+    ap.add_argument("--quality", action="store_true",
+                    help="with --fleet: print only the model-quality "
+                         "section — per-model AuPR/AuROC/Brier recomputed "
+                         "EXACTLY from the fleet-merged "
+                         "serving_quality_scores histograms (bit-for-bit "
+                         "equal to a single-process oracle) plus the "
+                         "feedback join counters")
     args = ap.parse_args(argv)
 
     from transmogrifai_tpu.obs.metrics import default_registry
@@ -602,6 +617,40 @@ def _cmd_monitor(argv) -> int:
                   file=sys.stderr)
             return 2
         agg = _fleet_aggregator(rows)
+        if args.quality:
+            from transmogrifai_tpu.obs.fleet import _per_model_value
+            from transmogrifai_tpu.obs.quality import quality_from_snapshot
+
+            snap = agg.snapshot()["metrics"]
+            quality = quality_from_snapshot(snap)
+            counters = {
+                name: _per_model_value(snap, f"feedback_{name}_total")
+                for name in ("received", "joined", "duplicate", "unmatched",
+                             "expired")}
+            pending = _per_model_value(snap, "feedback_pending")
+            payload = {
+                model: {
+                    **{k: v for k, v in m.items() if k != "calibration"},
+                    "feedback": {
+                        **{name: int(c.get(model, 0))
+                           for name, c in counters.items()},
+                        "pending": int(pending.get(model, 0))},
+                } for model, m in quality.items()}
+            if args.as_json:
+                print(json.dumps(payload, indent=1, default=float))
+            else:
+                if not payload:
+                    print("no serving_quality_scores series in the fleet "
+                          "(daemon not started with --quality, or no "
+                          "feedback joined yet)")
+                for model, m in sorted(payload.items()):
+                    fb = m["feedback"]
+                    print(f"{model}: AuPR={m['AuPR']:.4f} "
+                          f"AuROC={m['AuROC']:.4f} "
+                          f"Brier={m['BrierScore']:.4f} n={m['n']} "
+                          f"(joined={fb['joined']} pending={fb['pending']} "
+                          f"unmatched={fb['unmatched']})")
+            return 0
         if args.prom:
             print(agg.to_prometheus(), end="")
         elif args.as_json:
@@ -661,6 +710,82 @@ def _cmd_monitor(argv) -> int:
         print(f"op monitor: {len(report['alerts'])} drift alert(s)",
               file=sys.stderr)
         return 3
+    return 0
+
+
+def _cmd_feedback(argv) -> int:
+    """Close the quality loop from the command line: POST delayed ground-
+    truth labels (keyed by the prediction ids minted on the score path) to a
+    serving daemon's /v1/feedback."""
+    ap = argparse.ArgumentParser(
+        prog="op feedback",
+        description="send delayed ground-truth labels to a serving daemon: "
+                    "each label is keyed by the prediction_id a scored row "
+                    "carried; joined (score, label) pairs drive the model's "
+                    "online quality metrics and QualityAlerts")
+    ap.add_argument("--connect", required=True, metavar="URL",
+                    help="daemon base URL, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--model", default=None,
+                    help="serving model name/alias (optional when the "
+                         "daemon holds exactly one model)")
+    ap.add_argument("--id", default=None, metavar="PREDICTION_ID",
+                    help="single-label form: the prediction id to label "
+                         "(pair with --label)")
+    ap.add_argument("--label", type=float, default=None, metavar="V",
+                    help="single-label form: the ground-truth label (0/1 "
+                         "for binary)")
+    ap.add_argument("--labels", default=None, metavar="FILE",
+                    help="batch form: JSONL file ('-' = stdin) of "
+                         '{"id": ..., "label": ...} objects — e.g. an audit '
+                         "segment joined with outcomes")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    import json
+
+    labels = []
+    if args.id is not None:
+        if args.label is None:
+            print("op feedback: --id needs --label", file=sys.stderr)
+            return 2
+        labels.append({"id": args.id, "label": args.label})
+    if args.labels:
+        fh = sys.stdin if args.labels == "-" else open(args.labels)
+        try:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    labels.append(json.loads(line))
+        finally:
+            if fh is not sys.stdin:
+                fh.close()
+    if not labels:
+        print("op feedback: nothing to send (--id/--label or --labels FILE)",
+              file=sys.stderr)
+        return 2
+
+    from urllib.error import HTTPError, URLError
+    from urllib.request import Request, urlopen
+
+    body: dict = {"labels": labels}
+    if args.model:
+        body["model"] = args.model
+    req = Request(args.connect.rstrip("/") + "/v1/feedback",
+                  data=json.dumps(body).encode("utf-8"),
+                  headers={"Content-Type": "application/json"})
+    try:
+        with urlopen(req, timeout=args.timeout) as resp:
+            out = json.loads(resp.read().decode("utf-8"))
+    except HTTPError as e:
+        detail = e.read().decode("utf-8", "replace")[:500]
+        print(f"op feedback: daemon answered {e.code}: {detail}",
+              file=sys.stderr)
+        return 1
+    except (URLError, OSError) as e:
+        print(f"op feedback: {args.connect} unreachable: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=1))
     return 0
 
 
@@ -882,6 +1007,18 @@ def _cmd_serve(argv) -> int:
                          "stamped serving_baseline (serving_js_divergence/"
                          "serving_fill_rate gauges + DriftAlerts — what "
                          "`op autopilot` watches)")
+    ap.add_argument("--quality", action="store_true",
+                    help="arm the model-quality plane per admitted model: "
+                         "every result row gains a prediction_id, POST "
+                         "/v1/feedback joins delayed labels against it, and "
+                         "joined pairs drive windowed AuPR/AuROC/Brier "
+                         "gauges + edge-triggered QualityAlerts vs the "
+                         "model's stamped quality_baseline (the autopilot's "
+                         "quality trigger tier)")
+    ap.add_argument("--audit-dir", default=None, metavar="DIR",
+                    help="with --quality (implies it): land sampled "
+                         "prediction-audit records as atomic JSONL segments "
+                         "under DIR (per-model file prefixes)")
     ap.add_argument("--backend", default="auto",
                     choices=["auto", "cpu", "device"],
                     help="serving lane policy: auto (default) routes by the "
@@ -935,12 +1072,15 @@ def _cmd_serve(argv) -> int:
 
     from transmogrifai_tpu.serve import ServingDaemon, make_http_server
 
+    quality = False
+    if args.quality or args.audit_dir:
+        quality = ({"audit_dir": args.audit_dir} if args.audit_dir else True)
     daemon = ServingDaemon(
         max_models=max_models, max_wait_ms=max_wait_ms, max_batch=max_batch,
         bucket_floor=bucket_floor, queue_depth=queue_depth,
         backend={"auto": "auto", "cpu": "cpu", "device": None}[args.backend],
         mesh=mesh, warm=not args.no_warm, quarantine_root=quarantine_root,
-        aot=not args.no_aot, monitor=args.monitor)
+        aot=not args.no_aot, monitor=args.monitor, quality=quality)
     names = []
     for spec in args.model:
         name, path = _parse_model_spec(spec)
@@ -1286,7 +1426,10 @@ def main(argv=None) -> int:
             "(--app module:fn --rows N [--top-k K] [--out DIR])\n"
             "  monitor   serving telemetry: drift report vs the model's "
             "training baseline + metrics export (--model DIR [--scoring CSV] "
-            "| --demo | --fleet TARGET) [--prom|--json]\n"
+            "| --demo | --fleet TARGET [--quality]) [--prom|--json]\n"
+            "  feedback  send delayed ground-truth labels to a serving "
+            "daemon, keyed by prediction_id (--connect URL [--model NAME] "
+            "--id ID --label V | --labels FILE.jsonl)\n"
             "  top       live fleet dashboard: per-role rates, queue waits, "
             "breaker/drift state, predicted-vs-measured resources "
             "(--connect HOST:PORT | --daemon URL [--once|--plain])\n"
@@ -1328,6 +1471,8 @@ def main(argv=None) -> int:
         return _cmd_autotune(rest)
     if cmd == "monitor":
         return _cmd_monitor(rest)
+    if cmd == "feedback":
+        return _cmd_feedback(rest)
     if cmd == "top":
         return _cmd_top(rest)
     if cmd == "trace-merge":

@@ -39,6 +39,14 @@ from .metrics import (
     default_registry,
     parse_prometheus,
 )
+from .quality import (
+    QualityAlert,
+    QualityMonitor,
+    QualitySketch,
+    QualityThresholds,
+    quality_from_snapshot,
+    sketch_metrics,
+)
 from .recorder import (
     FlightRecorder,
     active_recorder,
@@ -54,13 +62,15 @@ from .watchdog import deactivate as _deactivate
 __all__ = [
     "CompileEvent", "Counter", "FleetAggregator", "FlightRecorder", "Gauge",
     "Histogram", "MetricsPusher", "MetricsRegistry", "PhaseTiming",
+    "QualityAlert", "QualityMonitor", "QualitySketch", "QualityThresholds",
     "RetraceBudget", "RetraceBudgetExceeded", "Span", "TraceContext",
     "Tracer", "active_recorder", "add_event", "cached_compiled",
     "compiled_flops", "cost_analysis", "current", "current_span",
     "current_trace_context", "default_registry", "fleet_totals",
     "install_recorder", "maybe_install_from_env", "new_span_id",
-    "new_trace_id", "parse_prometheus", "process_role", "record_cost",
-    "retrace_budget", "span", "stitch_chrome_traces", "trace",
+    "new_trace_id", "parse_prometheus", "process_role",
+    "quality_from_snapshot", "record_cost", "retrace_budget",
+    "sketch_metrics", "span", "stitch_chrome_traces", "trace",
     "uninstall_recorder",
 ]
 

@@ -314,6 +314,16 @@ def _sum_suffix(row: dict, suffix: str) -> float:
                if n.endswith(suffix) and "value" in v)
 
 
+def _per_model_value(metrics_snapshot: Optional[dict], name: str) -> dict:
+    """Fold one metric family's series by `model` label (counters/gauges sum
+    across processes — each replica's joined count or pending depth adds)."""
+    out: dict[str, float] = {}
+    for series in ((metrics_snapshot or {}).get(name) or {}).get("series", []):
+        model = (series.get("labels") or {}).get("model", "serve")
+        out[model] = out.get(model, 0.0) + float(series.get("value", 0.0))
+    return out
+
+
 _BREAKER_STATES = {0: "closed", 1: "OPEN", 2: "half"}
 
 
@@ -371,6 +381,27 @@ def render_top(prev: Optional[dict], cur: dict, dt_s: float,
             f"{(_BREAKER_STATES.get(int(breaker), '?') if breaker is not None else '-'):>8} "
             f"{(f'{drift:.4f}' if drift is not None else '-'):>8} "
             f"{dumps:>6.0f}")
+    from .quality import quality_from_snapshot
+
+    quality = quality_from_snapshot(cur)
+    if quality:
+        # model-quality panel: metrics recomputed from the fleet-merged
+        # score histograms (the exact-federation carrier), join throughput
+        # from counter deltas, pending-join depth from the gauges
+        joined_cur = _per_model_value(cur, "feedback_joined_total")
+        joined_prev = _per_model_value(prev, "feedback_joined_total")
+        pending = _per_model_value(cur, "feedback_pending")
+        lines.append("")
+        lines.append(f"{'MODEL':<14} {'AuPR':>8} {'BRIER':>8} {'PAIRS':>8} "
+                     f"{'JOIN/S':>8} {'PENDING':>8}")
+        for model in sorted(quality):
+            m = quality[model]
+            rate = (joined_cur.get(model, 0.0)
+                    - joined_prev.get(model, 0.0)) / dt
+            lines.append(
+                f"{model:<14.14} {m['AuPR']:>8.4f} "
+                f"{m['BrierScore']:>8.4f} {m['n']:>8d} {rate:>8.1f} "
+                f"{pending.get(model, 0.0):>8.0f}")
     if predictions:
         measured = measured_resources(cur)
         lines.append("")

@@ -210,10 +210,70 @@ class Histogram(_Metric):
             self._counts[lo] += 1
             if len(self._samples) < self._reservoir_max:
                 self._samples.append(v)
-            else:  # Algorithm R: uniform over the whole stream
+            elif self._reservoir_max > 0:  # Algorithm R: uniform over the stream
                 j = self._rng.randrange(self._count)
                 if j < self._reservoir_max:
                     self._samples[j] = v
+
+    def observe_many(self, values) -> None:
+        """Fold a batch of observations under ONE lock acquisition — for
+        hot-path producers that already hold a batch (same per-value
+        semantics as `observe`)."""
+        with self._lock:
+            for v in values:
+                v = float(v)
+                if not math.isfinite(v):
+                    continue
+                self._sum += v
+                self._count += 1
+                if v < self._min:
+                    self._min = v
+                if v > self._max:
+                    self._max = v
+                lo, hi = 0, len(self.bounds)
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if self.bounds[mid] >= v:
+                        hi = mid
+                    else:
+                        lo = mid + 1
+                self._counts[lo] += 1
+                if len(self._samples) < self._reservoir_max:
+                    self._samples.append(v)
+                elif self._reservoir_max > 0:
+                    j = self._rng.randrange(self._count)
+                    if j < self._reservoir_max:
+                        self._samples[j] = v
+
+    def observe_weighted(self, value, count: int) -> None:
+        """Fold `count` identical observations in O(1) — for producers whose
+        values are already binned (the quality plane's sketch centers). Only
+        valid without a reservoir: with sampling armed this falls back to the
+        per-value loop so Algorithm R stays uniform over the stream."""
+        c = int(count)
+        if c <= 0:
+            return
+        if self._reservoir_max > 0:
+            self.observe_many([value] * c)
+            return
+        v = float(value)
+        if not math.isfinite(v):
+            return
+        with self._lock:
+            self._sum += v * c
+            self._count += c
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            lo, hi = 0, len(self.bounds)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self.bounds[mid] >= v:
+                    hi = mid
+                else:
+                    lo = mid + 1
+            self._counts[lo] += c
 
     @property
     def count(self) -> int:

@@ -1,0 +1,621 @@
+"""Online model-quality plane: mergeable (score, label) sketches, windowed
+AuPR/AuROC/Brier, and edge-triggered quality alerts.
+
+The serving stack can see its own latency (the fleet plane) and its input
+distribution (ServingMonitor covariate drift), but it is blind to the only
+thing users care about: whether predictions are still *right*. A concept
+flip — the label rule inverts while the feature marginals stay put — leaves
+every `serving_js_divergence` gauge flat and the autopilot asleep. This
+module is the missing signal:
+
+  sketch   a `QualitySketch` holds INTEGER (pos, neg) counts over K fixed
+           score bins in [0, 1] — nothing else. It is a monoid (merge adds
+           counts), and because the state is integers, merge order can never
+           perturb it: the fleet-merged sketch is the SAME OBJECT the
+           single-process oracle holds, so every derived metric (AuPR,
+           AuROC, Brier, calibration) is bit-for-bit identical. The same
+           discipline FeatureDistribution uses for drift histograms,
+           applied to ground truth.
+  monitor  a `QualityMonitor` folds joined (score, label) pairs (the
+           `LabelJoiner`'s output) into a sliding-window sketch, derives the
+           windowed metrics, exports them as `serving_quality_*` gauges plus
+           one `serving_quality_scores{model, label}` histogram whose bucket
+           bounds ARE the sketch's bin edges — histograms federate exactly
+           through `MetricsRegistry.merge`/`FleetAggregator`, so the gauges
+           are for dashboards and the histogram is the ground truth a
+           remote aggregator recomputes metrics from (`quality_from_
+           snapshot`).
+  alert    train stamps the holdout metric into model.json
+           (`quality_baseline`); `check()` fires an edge-triggered
+           `QualityAlert` when the windowed metric breaches the baseline by
+           `margin`, emits `quality:breach` (a flight-recorder dump
+           trigger), and re-arms on recovery — the same rising/falling-edge
+           contract ServingMonitor keeps for covariate drift. The autopilot
+           reads `active` as its quality trigger tier.
+
+The pure-Python estimators mirror `evaluators/metrics_ops.py` semantics at
+bin granularity: tied scores (one bin = one tied run) contribute a single
+PR/ROC curve point, the PR curve opens at (recall 0, first precision), and
+P/N denominators floor at 1.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "QUALITY_BINS", "QualityAlert", "QualitySketch", "QualityMonitor",
+    "QualityThresholds", "quality_from_snapshot", "sketch_metrics",
+]
+
+#: fixed score-bin resolution of every sketch/histogram in the plane. All
+#: sketches share it so merges are always well-formed; 64 bins keep binned
+#: AuPR within ~1e-3 of the exact-score value on smooth score distributions
+#: while the per-model histogram stays 2 x 64 integers on the wire.
+QUALITY_BINS = 64
+
+
+def _bin_edges(bins: int) -> list[float]:
+    """Histogram bucket bounds matching `_bin_of`: bucket k is
+    (k/bins, (k+1)/bins] under the registry's `bisect_left` placement, so a
+    score histogram observed at BIN CENTERS lands count-for-count on the
+    sketch's bins."""
+    return [(k + 1) / bins for k in range(bins)]
+
+
+def _bin_of(score: float, bins: int) -> int:
+    """clip(int(s * bins), 0, bins - 1) — the same rule as
+    metrics_ops.bin_score_metrics, so offline and online calibration bins
+    line up."""
+    k = int(score * bins)
+    return 0 if k < 0 else (bins - 1 if k >= bins else k)
+
+
+class QualitySketch:
+    """Integer (pos, neg) counts per score bin — the mergeable quality state.
+
+    The whole point is what this class does NOT hold: no float sums, no
+    wall-clock, no reservoir. Float addition is non-associative, so any float
+    in the monoid state would let merge ORDER leak into the fleet-merged
+    metrics; integer counts make `merge` exactly commutative/associative and
+    the derived metrics a pure function of the counts.
+    """
+
+    __slots__ = ("bins", "pos", "neg")
+
+    def __init__(self, bins: int = QUALITY_BINS):
+        self.bins = int(bins)
+        if self.bins < 2:
+            raise ValueError(f"QualitySketch needs >= 2 bins, got {bins}")
+        self.pos = [0] * self.bins
+        self.neg = [0] * self.bins
+
+    # --- fold -------------------------------------------------------------------------
+    def observe(self, score: float, label: float) -> None:
+        k = _bin_of(float(score), self.bins)
+        if float(label) > 0.5:
+            self.pos[k] += 1
+        else:
+            self.neg[k] += 1
+
+    def observe_many(self, pairs: Sequence[tuple]) -> None:
+        for score, label in pairs:
+            self.observe(score, label)
+
+    # --- monoid -----------------------------------------------------------------------
+    def merge(self, other: "QualitySketch") -> None:
+        if other.bins != self.bins:
+            raise ValueError(
+                f"cannot merge QualitySketch({other.bins} bins) into "
+                f"{self.bins} bins — the plane fixes one resolution")
+        for k in range(self.bins):
+            self.pos[k] += other.pos[k]
+            self.neg[k] += other.neg[k]
+
+    def copy(self) -> "QualitySketch":
+        out = QualitySketch(self.bins)
+        out.pos = list(self.pos)
+        out.neg = list(self.neg)
+        return out
+
+    def reset(self) -> None:
+        self.pos = [0] * self.bins
+        self.neg = [0] * self.bins
+
+    # --- (de)serialization (checkpoint + wire) ------------------------------------------
+    def to_json(self) -> dict:
+        return {"version": 1, "bins": self.bins,
+                "pos": list(self.pos), "neg": list(self.neg)}
+
+    @classmethod
+    def from_json(cls, doc: Mapping) -> "QualitySketch":
+        sk = cls(int(doc["bins"]))
+        pos, neg = list(doc["pos"]), list(doc["neg"])
+        if len(pos) != sk.bins or len(neg) != sk.bins:
+            raise ValueError("QualitySketch payload length != bins")
+        sk.pos = [int(c) for c in pos]
+        sk.neg = [int(c) for c in neg]
+        return sk
+
+    @classmethod
+    def from_counts(cls, pos: Sequence[int], neg: Sequence[int],
+                    ) -> "QualitySketch":
+        """Rebuild from two raw per-bin count vectors (the federation path:
+        `serving_quality_scores{label=...}` histogram `raw_counts`)."""
+        if len(pos) != len(neg):
+            raise ValueError("pos/neg count vectors differ in length")
+        sk = cls(len(pos))
+        sk.pos = [int(c) for c in pos]
+        sk.neg = [int(c) for c in neg]
+        return sk
+
+    # --- totals -----------------------------------------------------------------------
+    @property
+    def n(self) -> int:
+        return sum(self.pos) + sum(self.neg)
+
+    @property
+    def n_pos(self) -> int:
+        return sum(self.pos)
+
+    @property
+    def n_neg(self) -> int:
+        return sum(self.neg)
+
+    def metrics(self) -> dict:
+        return sketch_metrics(self)
+
+
+def sketch_metrics(sk: QualitySketch, calibration_bins: int = 10) -> dict:
+    """AuPR / AuROC / Brier / calibration from integer bin counts.
+
+    One bin = one tied-score run, so the curve logic is metrics_ops'
+    boundary-masked sweep with the mask made explicit: descending bins each
+    contribute ONE cumulative (TP, FP) point; trapezoids integrate between
+    them. Every float here is DERIVED from the same integers in the same
+    order, so two sketches with equal counts produce bitwise-equal metrics —
+    the property the fleet-vs-oracle contract pins.
+    """
+    bins = sk.bins
+    P, N = sk.n_pos, sk.n_neg
+    n = P + N
+    out: dict[str, Any] = {"n": n, "n_pos": P, "n_neg": N,
+                           "pos_rate": (P / n) if n else 0.0}
+    if n == 0:
+        out.update({"AuPR": 0.0, "AuROC": 0.5, "BrierScore": 0.0,
+                    "calibration": []})
+        return out
+
+    # --- AuROC: pair-counting over descending bins (exact for binned data;
+    # ties inside a bin count 1/2, metrics_ops' trapezoid does the same)
+    denom_roc = P * N
+    if denom_roc:
+        auc = 0
+        neg_below = N  # negatives in strictly lower bins than the current
+        for k in range(bins - 1, -1, -1):
+            neg_below -= sk.neg[k]
+            auc += 2 * sk.pos[k] * neg_below + sk.pos[k] * sk.neg[k]
+        out["AuROC"] = auc / (2.0 * denom_roc)
+    else:
+        out["AuROC"] = 0.5
+
+    # --- AuPR: threshold sweep high->low; curve starts at (0, first_prec)
+    # like metrics_ops.binary_curve_aucs; P floors at 1 in the denominator
+    tp = 0
+    fp = 0
+    p_den = P if P else 1
+    prev_recall = 0.0
+    prev_prec: Optional[float] = None
+    aupr = 0.0
+    for k in range(bins - 1, -1, -1):
+        if sk.pos[k] == 0 and sk.neg[k] == 0:
+            continue
+        tp += sk.pos[k]
+        fp += sk.neg[k]
+        recall = tp / p_den
+        prec = tp / (tp + fp)
+        if prev_prec is None:
+            prev_prec = prec  # the (recall 0, first precision) opening point
+        aupr += (recall - prev_recall) * (prec + prev_prec) / 2.0
+        prev_recall, prev_prec = recall, prec
+    out["AuPR"] = aupr
+
+    # --- Brier at bin centers: sum over bins of pos*(1-c)^2 + neg*c^2
+    brier = 0.0
+    for k in range(bins):
+        if sk.pos[k] == 0 and sk.neg[k] == 0:
+            continue
+        c = (k + 0.5) / bins
+        brier += sk.pos[k] * (1.0 - c) ** 2 + sk.neg[k] * c ** 2
+    out["BrierScore"] = brier / n
+
+    # --- calibration reliability: coarse bins of (mean predicted, observed)
+    cal = []
+    step = max(1, bins // max(1, calibration_bins))
+    for lo in range(0, bins, step):
+        hi = min(lo + step, bins)
+        cp = sum(sk.pos[lo:hi])
+        cn = sum(sk.neg[lo:hi])
+        if cp + cn == 0:
+            continue
+        centers = 0.0
+        for k in range(lo, hi):
+            centers += (sk.pos[k] + sk.neg[k]) * ((k + 0.5) / bins)
+        cal.append({"lo": lo / bins, "hi": hi / bins,
+                    "n": cp + cn,
+                    "mean_score": centers / (cp + cn),
+                    "pos_rate": cp / (cp + cn)})
+    out["calibration"] = cal
+    return out
+
+
+@dataclass(frozen=True)
+class QualityThresholds:
+    """When a windowed metric becomes an alert.
+
+    `margin` is the direction-aware breach distance from the stamped
+    baseline (AuPR 0.91 at train, margin 0.1 -> alert under 0.81).
+    `min_joined` gates BOTH checks — a three-pair window alerting on noise
+    would page someone at 3 a.m. for a coin flip."""
+
+    margin: float = 0.1
+    min_joined: int = 64
+
+    def to_json(self) -> dict:
+        return {"margin": self.margin, "min_joined": self.min_joined}
+
+
+@dataclass
+class QualityAlert:
+    """One baseline breach, structured for handlers/logs."""
+
+    model: str
+    metric: str
+    value: float
+    baseline: float
+    margin: float
+    joined: int
+    message: str
+
+    def to_json(self) -> dict:
+        return {"model": self.model, "metric": self.metric,
+                "value": round(self.value, 6),
+                "baseline": round(self.baseline, 6),
+                "margin": self.margin, "joined": self.joined,
+                "message": self.message}
+
+
+class QualityMonitor:
+    """Windowed quality tracking + edge-triggered baseline alerts for one
+    served model.
+
+    Thread-safe: `observe_pair` arrives from the feedback route's handler
+    threads while `check`/`report` run on the autopilot's poll thread. The
+    registry carries two faces of the same data:
+
+      serving_quality_scores{model, label}   histogram, bounds = bin edges —
+                                             the EXACT federation carrier
+                                             (cumulative; never windowed)
+      serving_quality_{aupr,auroc,brier}     derived gauges over the current
+                                             window (dashboards, `op top`)
+      serving_quality_joined_pairs           gauge: pairs in the window
+    """
+
+    def __init__(self, baseline: Optional[Mapping] = None,
+                 thresholds: Optional[QualityThresholds] = None,
+                 registry=None, source: str = "serve",
+                 bins: int = QUALITY_BINS,
+                 window_pairs: Optional[int] = 4096,
+                 check_every: int = 64):
+        from .metrics import default_registry
+
+        #: {"metric", "value", "larger_is_better", ...} — Workflow.train's
+        #: `quality_baseline` stamp. None disables alerting (metrics still
+        #: compute and export: a model trained before the stamp existed can
+        #: still be WATCHED, just not paged on).
+        self.baseline = dict(baseline) if baseline else None
+        self.thresholds = thresholds or QualityThresholds()
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        self.source = source
+        self._model_labels = ({"model": source}
+                              if source and source != "serve" else {})
+        #: sliding window: the alerting sketch resets every `window_pairs`
+        #: joined pairs (after a final check over the full window) so the
+        #: signal tracks RECENT truth; the cumulative sketch feeds the
+        #: federation histogram and never resets. None = cumulative only.
+        self.window_pairs = (max(1, int(window_pairs))
+                             if window_pairs else None)
+        self.check_every = max(1, int(check_every))
+        self._lock = threading.Lock()
+        self.window = QualitySketch(bins)
+        self.cumulative = QualitySketch(bins)
+        self.pairs = 0
+        self._pairs_in_window = 0
+        self._active: set[str] = set()
+        self.alerts: list[QualityAlert] = []
+        self._max_alerts = 256
+        edges = _bin_edges(bins)
+        self._hist = {
+            "pos": self.registry.histogram(
+                "serving_quality_scores",
+                help="joined prediction scores by true label — bucket "
+                     "bounds are the quality-sketch bin edges, so "
+                     "fleet-merged buckets rebuild the exact sketch",
+                labels={"label": "pos", **self._model_labels},
+                buckets=edges, reservoir=0),
+            "neg": self.registry.histogram(
+                "serving_quality_scores",
+                help="joined prediction scores by true label — bucket "
+                     "bounds are the quality-sketch bin edges, so "
+                     "fleet-merged buckets rebuild the exact sketch",
+                labels={"label": "neg", **self._model_labels},
+                buckets=edges, reservoir=0),
+        }
+        self._gauges: dict[str, Any] = {}
+
+    @classmethod
+    def for_model(cls, model, thresholds: Optional[QualityThresholds] = None,
+                  registry=None, **kwargs) -> "QualityMonitor":
+        """Build from a WorkflowModel's `quality_baseline` stamp (train
+        stamps it from the selector's holdout metrics; load restores it).
+        A model without the stamp still gets a monitor — unalerted."""
+        baseline = getattr(model, "quality_baseline", None)
+        return cls(baseline, thresholds=thresholds, registry=registry,
+                   **kwargs)
+
+    def _gauge(self, name: str, help_text: str):
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = self.registry.gauge(
+                name, help=help_text, labels=dict(self._model_labels))
+        return g
+
+    # --- fold (feedback-join output; never raises into the caller) ----------------------
+    def observe_pair(self, score: float, label: float) -> None:
+        self.observe_pairs([(score, label)])
+
+    def observe_pairs(self, pairs) -> None:
+        """Fold a batch of joined (score, label) pairs under ONE lock
+        acquisition; a check fires when the batch crosses a `check_every`
+        boundary (for a single pair this is exactly the old per-pair
+        cadence). Never raises into the caller."""
+        try:
+            if not pairs:
+                return
+            bins = self.window.bins
+            # vectorized fold: bin every pair at once (astype truncates
+            # toward zero exactly like `_bin_of`'s int()), then apply the
+            # per-bin count deltas — O(pairs) C work + O(bins) Python work,
+            # so a 512-pair feedback batch costs about what one pair used to
+            arr = np.asarray(pairs, dtype=np.float64)
+            if arr.ndim != 2 or not np.isfinite(arr[:, 0]).all():
+                raise ValueError("malformed (score, label) pairs")
+            k = np.clip((arr[:, 0] * bins).astype(np.int64), 0, bins - 1)
+            pos_mask = arr[:, 1] > 0.5
+            pc = np.bincount(k[pos_mask], minlength=bins)
+            nc = np.bincount(k[~pos_mask], minlength=bins)
+            pos_bins = np.nonzero(pc)[0]
+            neg_bins = np.nonzero(nc)[0]
+            n = int(arr.shape[0])
+            with self._lock:
+                # one k feeds window AND cumulative (same bin count)
+                wp, wn = self.window.pos, self.window.neg
+                cp, cn = self.cumulative.pos, self.cumulative.neg
+                for i in pos_bins:
+                    c = int(pc[i])
+                    wp[i] += c
+                    cp[i] += c
+                for i in neg_bins:
+                    c = int(nc[i])
+                    wn[i] += c
+                    cn[i] += c
+                before = self.pairs
+                self.pairs += n
+                self._pairs_in_window += n
+                due = (self.pairs // self.check_every
+                       > before // self.check_every)
+                window_full = (self.window_pairs is not None
+                               and self._pairs_in_window >= self.window_pairs)
+            # the histogram observes the BIN CENTER, not the raw score: the
+            # bucket a center lands in is exactly the sketch bin, so merged
+            # raw_counts rebuild the sketch count-for-count (weighted fold —
+            # the monitor's histograms carry no reservoir)
+            for i in pos_bins:
+                self._hist["pos"].observe_weighted((int(i) + 0.5) / bins,
+                                                   int(pc[i]))
+            for i in neg_bins:
+                self._hist["neg"].observe_weighted((int(i) + 0.5) / bins,
+                                                   int(nc[i]))
+            if due or window_full:
+                self._check_safe()
+            if window_full:
+                with self._lock:
+                    self.window.reset()
+                    self._pairs_in_window = 0
+        except Exception:
+            self.registry.counter(
+                "serving_quality_errors_total",
+                help="internal quality-monitor failures swallowed off the "
+                     "feedback path").inc()
+
+    def _check_safe(self) -> None:
+        try:
+            self.check()
+        except Exception:
+            self.registry.counter(
+                "serving_quality_errors_total",
+                help="internal quality-monitor failures swallowed off the "
+                     "feedback path").inc()
+
+    # --- decision -----------------------------------------------------------------------
+    def _window_metrics(self) -> dict:
+        with self._lock:
+            sk = self.window.copy()
+        return sketch_metrics(sk)
+
+    def check(self) -> list[QualityAlert]:
+        """Evaluate the windowed metric against the baseline; returns alerts
+        NEWLY fired by this call. Edge-triggered: an episode re-arms only
+        after the metric recovers past the breach line (or `resolve_active`
+        clears it). Also refreshes the derived gauges — check() is the one
+        place window metrics turn into registry levels."""
+        from .. import obs
+
+        m = self._window_metrics()
+        self._gauge("serving_quality_aupr",
+                    "windowed AuPR over joined (score, label) pairs"
+                    ).set(m["AuPR"])
+        self._gauge("serving_quality_auroc",
+                    "windowed AuROC over joined (score, label) pairs"
+                    ).set(m["AuROC"])
+        self._gauge("serving_quality_brier",
+                    "windowed Brier score over joined (score, label) pairs"
+                    ).set(m["BrierScore"])
+        self._gauge("serving_quality_joined_pairs",
+                    "joined (score, label) pairs in the current window"
+                    ).set(m["n"])
+        base = self.baseline
+        th = self.thresholds
+        new: list[QualityAlert] = []
+        cleared: list[tuple] = []
+        if not base or m["n"] < th.min_joined:
+            return new
+        metric = str(base.get("metric", "AuPR"))
+        value = m.get(metric)
+        if value is None:
+            return new
+        baseline_v = float(base.get("value", 0.0))
+        larger = bool(base.get("larger_is_better", True))
+        if larger:
+            breached = value < baseline_v - th.margin
+        else:
+            breached = value > baseline_v + th.margin
+        with self._lock:
+            if breached:
+                if metric not in self._active:
+                    self._active.add(metric)
+                    alert = QualityAlert(
+                        model=self.source, metric=metric, value=float(value),
+                        baseline=baseline_v, margin=th.margin,
+                        joined=int(m["n"]),
+                        message=(f"{self.source}: windowed {metric} "
+                                 f"{value:.4f} breached baseline "
+                                 f"{baseline_v:.4f} by > {th.margin} over "
+                                 f"{m['n']} joined pairs"))
+                    new.append(alert)
+                    if len(self.alerts) < self._max_alerts:
+                        self.alerts.append(alert)
+            elif metric in self._active:
+                self._active.discard(metric)
+                cleared.append((metric, float(value), baseline_v))
+        for alert in new:
+            # `quality:breach` is a flight-recorder dump trigger: the event
+            # ring around a quality regression is exactly what post-mortems
+            # need (what swapped, what drifted, what fed back)
+            obs.add_event("quality:breach", **alert.to_json())
+            self.registry.counter(
+                "serving_quality_alerts_total",
+                help="quality-baseline breaches (edge-triggered)",
+                labels={"metric": alert.metric,
+                        **self._model_labels}).inc()
+        for metric, value, baseline_v in cleared:
+            obs.add_event("quality:cleared", model=self.source,
+                          metric=metric, value=round(value, 6),
+                          baseline=round(baseline_v, 6))
+            self.registry.counter(
+                "serving_quality_cleared_total",
+                help="quality episodes that ended: the windowed metric "
+                     "recovered past the breach line",
+                labels={"metric": metric, **self._model_labels}).inc()
+        return new
+
+    @property
+    def active(self) -> list[str]:
+        with self._lock:
+            return sorted(self._active)
+
+    def resolve_active(self, reason: str = "resolved") -> list[str]:
+        """Explicitly clear active episodes (the autopilot calls this on a
+        DEMOTED champion's monitor — no feedback will ever reach it again,
+        so the falling edge must be synthesized or the episode latches)."""
+        from .. import obs
+
+        with self._lock:
+            resolved = sorted(self._active)
+            self._active.clear()
+        for metric in resolved:
+            obs.add_event("quality:cleared", model=self.source,
+                          metric=metric, reason=reason)
+            self.registry.counter(
+                "serving_quality_cleared_total",
+                help="quality episodes that ended: the windowed metric "
+                     "recovered past the breach line",
+                labels={"metric": metric, **self._model_labels}).inc()
+        return resolved
+
+    # --- reporting ----------------------------------------------------------------------
+    def report(self) -> dict:
+        m = self._window_metrics()
+        with self._lock:
+            return {
+                "source": self.source,
+                "pairs": self.pairs,
+                "window": m,
+                "cumulative_pairs": self.cumulative.n,
+                "baseline": dict(self.baseline) if self.baseline else None,
+                "thresholds": self.thresholds.to_json(),
+                "alerts": [a.to_json() for a in self.alerts],
+                "active_alerts": sorted(self._active),
+            }
+
+
+# --- federation read path ----------------------------------------------------------------
+def quality_from_snapshot(metrics_snapshot: Mapping) -> dict[str, dict]:
+    """Per-model quality metrics recomputed from a (merged) registry
+    snapshot's `serving_quality_scores` histogram series.
+
+    THE shared read path: `op top`'s quality panel, `op monitor --quality`,
+    and the federation test all call this on
+    `FleetAggregator.snapshot()["metrics"]`. Because the histogram's bucket
+    counts merge exactly and the sketch is rebuilt from those integer
+    counts, the result over a fleet equals the single-process oracle
+    bit-for-bit. Series must carry `raw_counts` (snapshot(samples=True) —
+    every federation surface already does)."""
+    fam = metrics_snapshot.get("serving_quality_scores") or {}
+    per_model: dict[str, dict[str, list[int]]] = {}
+    for series in fam.get("series", []):
+        labels = series.get("labels") or {}
+        model = labels.get("model", "serve")
+        side = labels.get("label")
+        raw = series.get("raw_counts")
+        if side not in ("pos", "neg") or raw is None or len(raw) < 3:
+            continue
+        # raw_counts carries one +Inf overflow slot past the real bins; the
+        # monitor observes bin centers (< 1.0 = the last bound) so it is
+        # always 0 — fold it into the top bin anyway rather than drop counts
+        counts = [int(c) for c in raw[:-1]]
+        counts[-1] += int(raw[-1])
+        slot = per_model.setdefault(model, {})
+        if side in slot:  # several processes: merged registries pre-fold by
+            prior = slot[side]  # (role, process) label — fold the rest here
+            if len(prior) != len(counts):
+                continue
+            slot[side] = [a + b for a, b in zip(prior, counts)]
+        else:
+            slot[side] = counts
+    out: dict[str, dict] = {}
+    for model, sides in sorted(per_model.items()):
+        bins = len(sides.get("pos") or sides.get("neg") or [])
+        if not bins:
+            continue
+        pos = sides.get("pos") or [0] * bins
+        neg = sides.get("neg") or [0] * bins
+        if len(pos) != len(neg):
+            continue
+        sk = QualitySketch.from_counts(pos, neg)
+        out[model] = sketch_metrics(sk)
+    return out

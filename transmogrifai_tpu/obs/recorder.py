@@ -60,6 +60,8 @@ def _trigger_reason(name: str, attrs: dict) -> Optional[str]:
         return "breaker_open"
     if name == "resilience:deadline":
         return "deadline_breach"
+    if name == "quality:breach":
+        return "quality_breach"
     return None
 
 

@@ -119,6 +119,13 @@ class OpParams:
     #: one request cannot balloon daemon memory. CLI: `op serve
     #: --max-body-bytes`.
     serve_max_body_bytes: int = 8 << 20
+    #: --- model-quality plane (serve/feedback.py; docs/observability.md) ---
+    #: prediction-audit directory for score runs: every scored row gains a
+    #: `prediction_id` output column, and sampled (id, fingerprint, score)
+    #: records land in atomic JSONL audit segments there — the keys `op
+    #: feedback` later joins delayed labels against. None = no audit.
+    #: CLI: `op run --audit-dir DIR`.
+    audit_dir: Optional[str] = None
     custom_tags: dict[str, str] = field(default_factory=dict)
     custom_params: dict[str, Any] = field(default_factory=dict)
 

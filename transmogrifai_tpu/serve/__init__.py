@@ -8,11 +8,13 @@ from .daemon import (
     make_http_server,
     serving_buckets,
 )
+from .feedback import AuditSink, LabelJoiner, QualityPlane, extract_score
 from .scoring import ScoreFunction, score_function
 
 __all__ = [
-    "Autopilot", "AutopilotConfig", "DaemonClient", "DriftScenario",
-    "MicroBatcher", "ScoreFunction", "ServingDaemon",
-    "export_aot", "fingerprint_model_dir", "hydrate", "make_http_server",
-    "read_index", "score_function", "serving_buckets",
+    "AuditSink", "Autopilot", "AutopilotConfig", "DaemonClient",
+    "DriftScenario", "LabelJoiner", "MicroBatcher", "QualityPlane",
+    "ScoreFunction", "ServingDaemon",
+    "export_aot", "extract_score", "fingerprint_model_dir", "hydrate",
+    "make_http_server", "read_index", "score_function", "serving_buckets",
 ]

@@ -93,6 +93,14 @@ class AutopilotConfig:
     #: candidate bundles past the newest N are swept from the workdir
     #: (rollback targets stay loadable; disk stays bounded)
     keep_candidates: int = 4
+    #: second trigger tier alongside feature drift: active QualityAlerts on
+    #: the served model's quality plane (label-feedback AuPR/Brier breaching
+    #: the stamped holdout baseline — obs/quality.py) count as a breach and
+    #: debounce/retrain exactly like covariate drift. Catches the concept
+    #: flip feature monitoring is structurally blind to: labels invert while
+    #: every feature marginal stays put. Ignored when the daemon was started
+    #: without `quality=`.
+    quality_trigger: bool = True
     #: cap on total promotions (None = unbounded): the CLI's safety rail
     max_promotions: Optional[int] = None
 
@@ -224,6 +232,23 @@ class Autopilot:
         return {"monitored": True, "resolvable": True,
                 "active": rep["active_alerts"], "features": rep["features"]}
 
+    def quality_state(self) -> dict:
+        """Current label-feedback quality picture of the served model: the
+        quality plane's active alert metrics (the second trigger tier).
+        Same degrade contract as `drift_state` — an unresolvable alias or
+        an entry admitted without a quality plane observes as unmonitored,
+        never raises into the poll thread."""
+        try:
+            entry = self._entry()
+        except KeyError:
+            return {"monitored": False, "resolvable": False, "active": []}
+        plane = getattr(entry, "quality", None)
+        if plane is None or not self.config.quality_trigger:
+            return {"monitored": False, "resolvable": True, "active": []}
+        plane.monitor.check()  # refresh the edge state — never stale
+        return {"monitored": True, "resolvable": True,
+                "active": list(plane.monitor.active)}
+
     # --- the loop body ----------------------------------------------------------------
     def _poll(self) -> dict:
         """One observe + debounce decision — THE shared body of step() and
@@ -234,13 +259,23 @@ class Autopilot:
         each other."""
         self._step_idx += 1
         state = self.drift_state()
-        drifted = bool(state["active"])
+        quality = self.quality_state()
+        drift_active = bool(state["active"])
+        quality_active = bool(quality["active"])
+        drifted = drift_active or quality_active
+        #: which tier tripped — the decision log distinguishes a covariate
+        #: breach from a label-feedback quality breach (or both at once)
+        trigger = ("drift+quality" if drift_active and quality_active
+                   else "quality" if quality_active
+                   else "drift" if drift_active else "none")
         with self._lock:
             self._streak = self._streak + 1 if drifted else 0
             streak = self._streak
         decision = {"step": self._step_idx, "drifted": drifted,
                     "streak": streak, "action": "observe",
-                    "active": list(state["active"]), "act": False}
+                    "active": list(state["active"]),
+                    "quality_active": list(quality["active"]),
+                    "trigger": trigger, "act": False}
         if not state.get("resolvable", True):
             # evicted out from under us (outside admissions past
             # max_models): observable, never actionable
@@ -248,7 +283,9 @@ class Autopilot:
             self._event("alias_unresolved")
             return decision
         self._event("observe", drifted=drifted, streak=streak,
-                    active=",".join(sorted(state["active"])))
+                    active=",".join(sorted(state["active"])),
+                    quality=",".join(sorted(quality["active"])),
+                    trigger=trigger)
         if not drifted or streak < self.config.breach_checks:
             return decision
         if self.config.max_promotions is not None \
@@ -400,6 +437,12 @@ class Autopilot:
             old_mon = entry.score_fn.monitor
             if old_mon is not None:
                 old_mon.resolve_active(reason="promoted")
+            # same falling-edge discipline for the quality tier: the demoted
+            # entry's joiner will never see another label, so its breach
+            # episode must be resolved here or it latches forever
+            old_q = getattr(entry, "quality", None)
+            if old_q is not None:
+                old_q.monitor.resolve_active(reason="promoted")
             self._count_retrain("promoted")
             self.promotions += 1
             self._event("promoted", challenger=round(chall_metric, 6),
@@ -568,6 +611,14 @@ class DriftScenario:
         self.mu = 0.0
         self.direction = 1.0
 
+    def flip_concept(self) -> None:
+        """CONCEPT-ONLY drift: the label rule inverts while `mu` (and so
+        every feature marginal) stays exactly where training left it. The
+        covariate monitor sees nothing — by construction — which is the
+        blind spot the quality trigger tier exists to cover: only delayed
+        label feedback can reveal this regime change."""
+        self.direction = -self.direction
+
     # -- the three data surfaces
     def serving_batch(self, n: Optional[int] = None) -> list:
         """One batch of UNLABELED serving records at the current regime."""
@@ -575,6 +626,21 @@ class DriftScenario:
         rng = self._serving_rng
         return [{"a": float(rng.normal(self.mu, 1.0)),
                  "cat": "ab"[int(rng.integers(0, 2))]} for _ in range(n)]
+
+    def serving_batch_labeled(self, n: Optional[int] = None,
+                              ) -> tuple[list, list]:
+        """One serving batch PLUS its ground-truth labels at the current
+        regime — the delayed-feedback drill: score the records now, POST
+        the labels against the minted prediction ids later. Same rng as
+        `serving_batch`, so mixing the two keeps the stream seeded."""
+        n = self.batch if n is None else int(n)
+        rng = self._serving_rng
+        records, labels = [], []
+        for _ in range(n):
+            a = float(rng.normal(self.mu, 1.0))
+            records.append({"a": a, "cat": "ab"[int(rng.integers(0, 2))]})
+            labels.append(self._label(a, rng))
+        return records, labels
 
     def _label(self, a: float, rng) -> float:
         return float(self.direction * (a - self.mu)

@@ -28,6 +28,9 @@ stdlib-only HTTP/JSON endpoint (`make_http_server` / `op serve`):
     POST /v1/score   {"model": NAME?, "records": [{...}, ...]}
                      -> {"model": NAME, "results": [{...}|null, ...]}
                         (null = row quarantined as poison)
+    POST /v1/feedback {"model": NAME?, "labels": [{"id", "label"}, ...]}
+                     -> join-status counts (delayed ground truth keyed by
+                        the prediction_id minted on the score path)
     POST /v1/models  {"path": DIR, "name": NAME?}      admit/refresh a model
     GET  /v1/models                                    cache contents
     GET  /healthz                                      daemon + breaker state
@@ -102,10 +105,11 @@ class ModelEntry:
     """One admitted model: loaded weights + warmed handle + its batcher."""
 
     __slots__ = ("name", "fingerprint", "path", "model", "score_fn",
-                 "batcher", "admitted_at", "warm_report", "last_used")
+                 "batcher", "admitted_at", "warm_report", "last_used",
+                 "quality")
 
     def __init__(self, name, fingerprint, path, model, score_fn, batcher,
-                 warm_report):
+                 warm_report, quality=None):
         self.name = name
         self.fingerprint = fingerprint
         self.path = path
@@ -113,6 +117,7 @@ class ModelEntry:
         self.score_fn = score_fn
         self.batcher = batcher
         self.warm_report = warm_report
+        self.quality = quality  # QualityPlane or None (quality plane off)
         self.admitted_at = time.monotonic()
         self.last_used = self.admitted_at
 
@@ -141,6 +146,8 @@ class ModelEntry:
             "admitted_s": round(time.monotonic() - self.admitted_at, 3),
             "warm": self.warm_report,
             "batcher": self.batcher.stats(),
+            "quality": (self.quality.stats()
+                        if self.quality is not None else None),
         }
 
 
@@ -159,7 +166,7 @@ class ServingDaemon:
                  backend: Optional[str] = "auto", mesh=None, policy=None,
                  warm: bool = True, prefetch: int = 2,
                  quarantine_root: Optional[str] = "auto", aot: bool = True,
-                 queue_depth: int = 4096, monitor=False):
+                 queue_depth: int = 4096, monitor=False, quality=False):
         if max_models < 1:
             raise ValueError(f"max_models must be >= 1, got {max_models}")
         self._max_models = int(max_models)
@@ -188,6 +195,13 @@ class ServingDaemon:
         #: arms a windowed monitor this way). Models saved without a
         #: serving_baseline admit un-monitored either way.
         self._monitor = monitor
+        #: model-quality plane per admitted model (serve/feedback.py): False
+        #: (off), True (defaults: join-only, no audit dir), or a dict of
+        #: QualityPlane kwargs — "audit_dir" lands sampled prediction-audit
+        #: segments, "thresholds"/"window_pairs"/"check_every" tune the
+        #: online QualityMonitor. Armed entries mint a `prediction_id` per
+        #: result row and accept delayed labels on POST /v1/feedback.
+        self._quality = quality
         self._lock = make_lock("ServingDaemon._lock")
         self._admit_lock = make_lock("ServingDaemon._admit_lock")
         self._cache: "OrderedDict[str, ModelEntry]" = OrderedDict()
@@ -299,6 +313,15 @@ class ServingDaemon:
                               **(self._monitor
                                  if isinstance(self._monitor, dict) else {})}
                     mon = ServingMonitor.for_model(model, **mon_kw)
+                plane = None
+                if self._quality:
+                    from .feedback import QualityPlane
+
+                    q_kw = (dict(self._quality)
+                            if isinstance(self._quality, dict) else {})
+                    q_kw.setdefault(
+                        "baseline", getattr(model, "quality_baseline", None))
+                    plane = QualityPlane(label, fingerprint=fp, **q_kw)
                 # a bundle tuned by `op autotune` carries its searched
                 # serving bucket floor; the load() gate already dropped the
                 # stamp if this host is a different part, so a surviving
@@ -314,7 +337,7 @@ class ServingDaemon:
                 fn = score_function(
                     model, pad_to=buckets, backend=self._backend,
                     mesh=self._mesh, policy=policy, model_label=label,
-                    monitor=mon)
+                    monitor=mon, quality=plane)
                 # the SAME ladder-warm helper `op warmup --serving` uses:
                 # consult the bundle's AOT artifacts first, compile only
                 # what hydration did not cover — a cold DAEMON PROCESS
@@ -329,7 +352,7 @@ class ServingDaemon:
                     max_wait_ms=self._max_wait_ms, prefetch=self._prefetch,
                     queue_depth=self._queue_depth, model_label=label)
             entry = ModelEntry(label, fp, path, model, fn, batcher,
-                               warm_report)
+                               warm_report, quality=plane)
             evicted: list[ModelEntry] = []
             with self._lock:
                 closed = self._closed
@@ -346,6 +369,8 @@ class ServingDaemon:
                 # close()/__exit__ — drain the fresh entry and refuse
                 entry.batcher.close()
                 entry.score_fn.close()
+                if entry.quality is not None:
+                    entry.quality.close()
                 raise RuntimeError("daemon closed during admission")
             self._c_admitted.inc()
             for old in evicted:
@@ -358,6 +383,8 @@ class ServingDaemon:
                       fingerprint=entry.fingerprint[:12])
         entry.batcher.close()
         entry.score_fn.close()
+        if entry.quality is not None:
+            entry.quality.close()
 
     # --- hot swap (alias indirection) -------------------------------------------------
     def aliases(self) -> dict:
@@ -476,6 +503,24 @@ class ServingDaemon:
               timeout: Optional[float] = 60.0):
         return self.submit(model, records).result(timeout)
 
+    # --- label feedback (model-quality plane) -----------------------------------------
+    def feedback(self, model: Optional[str], labels) -> dict:
+        """Resolve delayed ground-truth labels against the named model's
+        quality plane: `labels` is [{"id": PREDICTION_ID, "label": 0|1},
+        ...]; joined pairs fold into the model's online QualityMonitor.
+        Returns join-status counts ({"joined", "duplicate", "unmatched",
+        "invalid"}). KeyError for an unknown model; ValueError when the
+        model was admitted without a quality plane (daemon started with
+        quality=False)."""
+        entry = self._resolve(model)
+        if entry.quality is None:
+            raise ValueError(
+                f"model {entry.name!r} has no quality plane "
+                "(daemon started with quality=False)")
+        counts = entry.quality.on_feedback_many(labels)
+        obs.add_event("serve:feedback", model=entry.name, **counts)
+        return {"model": entry.name, **counts}
+
     # --- introspection / lifecycle ----------------------------------------------------
     def models(self) -> list[dict]:
         with self._lock:
@@ -507,6 +552,8 @@ class ServingDaemon:
         for e in entries:
             e.batcher.close()
             e.score_fn.close()
+            if e.quality is not None:
+                e.quality.close()
 
     def __enter__(self) -> "ServingDaemon":
         return self
@@ -531,6 +578,9 @@ class DaemonClient:
 
     def submit(self, records, model: Optional[str] = None):
         return self._daemon.submit(model, records)
+
+    def feedback(self, labels, model: Optional[str] = None) -> dict:
+        return self._daemon.feedback(model, labels)
 
     def models(self) -> list[dict]:
         return self._daemon.models()
@@ -703,6 +753,17 @@ def make_http_server(daemon: ServingDaemon, host: str = "127.0.0.1",
                         results = entry.batcher.score(records, timeout=60.0)
                     return self._json(200, {"model": entry.name,
                                             "results": results})
+                if self.path == "/v1/feedback":
+                    # delayed ground truth keyed by prediction id: joined
+                    # pairs feed the model's online quality metrics
+                    labels = body.get("labels")
+                    if labels is None and "id" in body:
+                        labels = [{"id": body["id"],
+                                   "label": body.get("label")}]
+                    if not isinstance(labels, list):
+                        return self._error(400, 'missing "labels" list')
+                    return self._json(
+                        200, daemon.feedback(body.get("model"), labels))
                 return self._error(404, f"no route {self.path}")
             except KeyError as e:
                 self._error(404, str(e))

@@ -1,0 +1,614 @@
+"""Prediction audit log + delayed label-feedback join — the serving side of
+the model-quality plane.
+
+Scoring is fast and labels are slow: the click, the chargeback, the churn
+event arrive minutes-to-days after the score that should have predicted
+them. Closing the quality loop therefore needs three pieces that this
+module provides, all off the scoring hot path:
+
+  AuditSink     every score (sampled) becomes one bounded JSONL record —
+                prediction id, model fingerprint, score — queued to a drain
+                thread and published in ATOMIC segments (temp +
+                `os.replace`, the QuarantineWriter/workflow.save
+                discipline). A full queue DROPS and counts
+                (`audit_dropped_total`): audit must never apply
+                backpressure to scoring. Deterministic mode strips
+                wall-clock fields and derives stable ids, so chaos-replayed
+                runs produce byte-identical segments.
+  LabelJoiner   a TTL-bounded pending map from prediction id -> score.
+                `POST /v1/feedback` / `op feedback` resolve ids to (score,
+                label) pairs; duplicates are idempotent (a bounded done-set
+                remembers joined ids), expiry is LOGICAL (join attempts,
+                not wall-clock — deterministic under replay). The state is
+                a checkpointable monoid: `to_json`/`from_json` round-trip
+                and `merge` folds two joiners (pending union, done union,
+                counters add).
+  QualityPlane  the per-model composition the daemon arms at `admit()`:
+                id allocation -> audit emit -> pending note on the score
+                path; join -> `QualityMonitor.observe_pair` on the feedback
+                path. One object per ModelEntry, one call site each way.
+
+Prediction ids are `<trace16>-<seq08>`: 16 hex of trace identity (the PR-16
+trace context when one is live, a process-random trace otherwise; a stable
+crc32-derived stamp in deterministic mode) plus a monotone per-sink
+sequence — collision-safe across the fleet without coordination, stable
+under replay when determinism is armed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import zlib
+from collections.abc import Mapping as _MappingABC
+from typing import Any, Mapping, Optional, Sequence
+
+from .. import obs
+from ..obs.quality import QualityMonitor, QualityThresholds
+from ..resilience.lockcheck import make_lock
+
+__all__ = [
+    "AuditSink", "LabelJoiner", "QualityPlane", "extract_score",
+]
+
+#: serialized audit records past this many chars are truncated (repr-style,
+#: like QuarantineWriter): one hostile mega-row must not bloat a segment
+_MAX_RECORD_CHARS = 2048
+
+
+def _trace16(deterministic: bool, label: str) -> str:
+    """The 16-hex trace half of a prediction id. Live trace context wins
+    (ids then JOIN to the distributed trace in `op trace-merge`); otherwise
+    a per-sink random stamp — or, deterministically, crc32 of the model
+    label twice over, so replayed runs mint identical ids."""
+    if deterministic:
+        c = zlib.crc32(label.encode("utf-8"))
+        return f"{c:08x}{c:08x}"
+    ctx = obs.current_trace_context()
+    if ctx is not None and len(ctx.trace_id) >= 16:
+        return ctx.trace_id[:16]
+    from ..obs.context import new_trace_id
+
+    return new_trace_id()[:16]
+
+
+class AuditSink:
+    """Async bounded prediction-audit writer with atomic segment rotation.
+
+    `emit()` is the only hot-path surface: allocate an id, enqueue a record,
+    return. A background drain thread serializes and appends; every
+    `segment_records` records (or on `flush`/`close`) the open segment is
+    PUBLISHED — written complete to `audit-<label>-<nnnn>.jsonl.tmp.<pid>`
+    and `os.replace`d into place, so a reader (or a crash) never sees a torn
+    segment. Queue overflow drops the record and counts it; scoring never
+    blocks on audit I/O.
+    """
+
+    def __init__(self, out_dir: str, model_label: str = "serve", *,
+                 fingerprint: str = "", sample_every: int = 1,
+                 max_queue: int = 4096, segment_records: int = 512,
+                 deterministic: Optional[bool] = None, registry=None):
+        from ..obs.metrics import default_registry
+
+        self.out_dir = os.path.abspath(out_dir)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.model_label = str(model_label)
+        self.fingerprint = str(fingerprint)
+        self.sample_every = max(1, int(sample_every))
+        self.segment_records = max(1, int(segment_records))
+        if deterministic is None:
+            deterministic = bool(os.environ.get("TT_AUDIT_DETERMINISTIC"))
+        self.deterministic = bool(deterministic)
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        self._labels = {"model": self.model_label}
+        self._records_c = self.registry.counter(
+            "audit_records_total",
+            help="prediction audit records accepted into the sink",
+            labels=self._labels)
+        self._dropped_c = self.registry.counter(
+            "audit_dropped_total",
+            help="audit records dropped on queue overflow (audit never "
+                 "backpressures scoring)",
+            labels=self._labels)
+        self._segments_c = self.registry.counter(
+            "audit_segments_total",
+            help="audit segments atomically published",
+            labels=self._labels)
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue(
+            maxsize=max(1, int(max_queue)))
+        self._lock = make_lock("AuditSink._lock")
+        self._trace = _trace16(self.deterministic, self.model_label)
+        self._seq = 0
+        self._seen = 0
+        self._segment_idx = 0
+        self._pending: list[str] = []  # serialized lines awaiting publish
+        self._closed = False
+        self._drain = threading.Thread(target=self._drain_loop, daemon=True,
+                                       name=f"audit-{self.model_label}")
+        self._drain.start()
+
+    # --- hot path -----------------------------------------------------------------------
+    def next_id(self) -> str:
+        return self.next_ids(1)[0]
+
+    def next_ids(self, n: int) -> list[str]:
+        """Allocate a contiguous id block under one lock (batch scoring)."""
+        with self._lock:
+            start = self._seq + 1
+            self._seq += n
+        return [f"{self._trace}-{s:08d}" for s in range(start, start + n)]
+
+    def emit(self, prediction_id: str, score: float,
+             extra: Optional[Mapping] = None) -> bool:
+        """Queue one audit record; True when accepted, False when sampled
+        out or dropped on overflow. Never blocks, never raises."""
+        try:
+            with self._lock:
+                self._seen += 1
+                sampled = (self._seen - 1) % self.sample_every == 0
+            if not sampled:
+                return False
+            rec: dict[str, Any] = {"id": prediction_id,
+                                   "model": self.model_label,
+                                   "fingerprint": self.fingerprint,
+                                   "score": round(float(score), 9)}
+            if extra:
+                rec.update(extra)
+            if not self.deterministic:
+                import time
+
+                rec["ts"] = round(time.time(), 6)
+            try:
+                self._q.put_nowait(rec)
+            except queue.Full:
+                self._dropped_c.inc()
+                return False
+            self._records_c.inc()
+            return True
+        except Exception:
+            return False
+
+    # --- drain thread -------------------------------------------------------------------
+    def _drain_loop(self) -> None:
+        while True:
+            rec = self._q.get()
+            if rec is None:
+                self._publish()
+                return
+            try:
+                line = json.dumps(rec, sort_keys=True, default=str)
+                if len(line) > _MAX_RECORD_CHARS:
+                    line = json.dumps(
+                        {"id": rec.get("id"), "model": rec.get("model"),
+                         "truncated": True}, sort_keys=True)
+                self._pending.append(line)
+                if len(self._pending) >= self.segment_records:
+                    self._publish()
+            except Exception:
+                self._dropped_c.inc()
+
+    def _publish(self) -> Optional[str]:
+        """Atomically land the open segment: the temp file carries every
+        line, `os.replace` is the single publish point (the workflow.save /
+        QuarantineWriter discipline) — a crash mid-write leaves only a temp
+        no reader follows."""
+        if not self._pending:
+            return None
+        self._segment_idx += 1
+        path = os.path.join(
+            self.out_dir,
+            f"audit-{self.model_label}-{self._segment_idx:04d}.jsonl")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write("\n".join(self._pending) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self._pending = []
+        self._segments_c.inc()
+        obs.add_event("audit:segment", model=self.model_label,
+                      path=os.path.basename(path))
+        return path
+
+    # --- lifecycle ----------------------------------------------------------------------
+    def flush(self, timeout: float = 5.0) -> None:
+        """Drain the queue and publish the open segment (tests, shutdown).
+        Waits for the queue to empty, then publishes directly: `_pending`
+        is only touched by the drain thread between `get()`s, so once the
+        queue is empty (drain blocked in `get`) a publish from here cannot
+        race it."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.01)  # let the drain thread finish its in-flight record
+        self._publish()
+
+    def close(self, timeout: float = 5.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._q.put(None, timeout=timeout)
+            self._drain.join(timeout=timeout)
+        except Exception:
+            pass
+
+    def segments(self) -> list[str]:
+        return sorted(
+            os.path.join(self.out_dir, f) for f in os.listdir(self.out_dir)
+            if f.startswith(f"audit-{self.model_label}-")
+            and f.endswith(".jsonl"))
+
+
+class LabelJoiner:
+    """TTL-bounded prediction->label join with idempotent duplicates.
+
+    `note(id, score)` registers a scored prediction; `feedback(id, label)`
+    resolves it to a (score, label) pair exactly once. Three bounded
+    structures, all deterministic:
+
+      pending   id -> (score, age) ordered dict, FIFO-capped at
+                `max_pending` (oldest evicted = expired) and aged by JOIN
+                ATTEMPTS (`ttl_notes`: a pending id expires after that many
+                subsequent notes) — logical time, so replays age identically
+      done      ids already joined, bounded FIFO — a duplicate feedback is
+                counted and IGNORED (idempotence), not re-folded
+      counters  received/joined/duplicate/unmatched/expired — monoid-added
+                by `merge`
+
+    The whole state round-trips through `to_json`/`from_json` and `merge`
+    folds two joiners — the checkpointable monoid the ISSUE's online-
+    learning consumer needs (a restarted replica restores its window; two
+    replicas' windows fold into one).
+    """
+
+    def __init__(self, *, ttl_notes: int = 65536, max_pending: int = 16384,
+                 max_done: int = 65536, registry=None,
+                 model_label: str = "serve"):
+        from ..obs.metrics import default_registry
+
+        self.ttl_notes = max(1, int(ttl_notes))
+        self.max_pending = max(1, int(max_pending))
+        self.max_done = max(1, int(max_done))
+        self.model_label = str(model_label)
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        self._labels = {"model": self.model_label}
+        self._lock = make_lock("LabelJoiner._lock")
+        self._pending: dict[str, tuple[float, int]] = {}  # id -> (score, note_seq)
+        self._done: dict[str, None] = {}  # insertion-ordered set
+        self._note_seq = 0
+        self.counters = {"received": 0, "joined": 0, "duplicate": 0,
+                         "unmatched": 0, "expired": 0}
+        self._c = {k: self.registry.counter(
+            f"feedback_{k}_total",
+            help=f"feedback events: {k}", labels=self._labels)
+            for k in self.counters}
+        self._pending_g = self.registry.gauge(
+            "feedback_pending",
+            help="predictions awaiting a label in the join window",
+            labels=self._labels)
+
+    # --- score path ---------------------------------------------------------------------
+    def note(self, prediction_id: str, score: float) -> None:
+        self.note_many([(prediction_id, score)])
+
+    def note_many(self, pairs: Sequence[tuple]) -> None:
+        """Register a batch of scored predictions under ONE lock acquisition
+        (the scoring hot path calls this once per result batch). The final
+        state is identical to noting one-by-one: pending is FIFO by note
+        sequence, so a single eviction sweep at the batch's final sequence
+        drops exactly the entries the incremental sweeps would have."""
+        with self._lock:
+            seq = self._note_seq
+            pend = self._pending
+            for pid, score in pairs:
+                seq += 1
+                pend[pid if type(pid) is str else str(pid)] = (
+                    score if type(score) is float else float(score), seq)
+            self._note_seq = seq
+            expired = 0
+            # logical TTL: drop pendings noted more than ttl_notes notes ago
+            while self._pending:
+                pid, (_, seq) = next(iter(self._pending.items()))
+                if self._note_seq - seq < self.ttl_notes \
+                        and len(self._pending) <= self.max_pending:
+                    break
+                del self._pending[pid]
+                expired += 1
+            if expired:
+                self.counters["expired"] += expired
+            depth = len(self._pending)
+        if expired:
+            self._c["expired"].inc(expired)
+        self._pending_g.set(depth)
+
+    # --- feedback path ------------------------------------------------------------------
+    def feedback(self, prediction_id: str, label: float,
+                 ) -> tuple[str, Optional[tuple[float, float]]]:
+        """Resolve one delayed label. Returns (status, pair) where status is
+        "joined" | "duplicate" | "unmatched" and pair is the (score, label)
+        tuple on a join (None otherwise)."""
+        counts, pairs = self.feedback_many([(prediction_id, label)])
+        status = next(k for k, v in counts.items() if v)
+        return status, (pairs[0] if pairs else None)
+
+    def feedback_many(self, items: Sequence[tuple],
+                      ) -> tuple[dict, list[tuple[float, float]]]:
+        """Resolve a batch of delayed labels under ONE lock acquisition.
+        Returns ({"joined", "duplicate", "unmatched"} counts, the joined
+        (score, label) pairs in input order)."""
+        joined = duplicate = unmatched = 0
+        pairs: list[tuple[float, float]] = []
+        with self._lock:
+            # hot-loop locals: a joined id can never still be pending (join
+            # pops it; merge() evicts pendings for done ids), so pop-with-
+            # default resolves the common joined case in one dict op
+            pend_pop = self._pending.pop
+            done = self._done
+            append = pairs.append
+            for pid, label in items:
+                if type(pid) is not str:
+                    pid = str(pid)
+                hit = pend_pop(pid, None)
+                if hit is not None:
+                    done[pid] = None
+                    joined += 1
+                    append((hit[0], float(label)))
+                elif pid in done:
+                    duplicate += 1
+                else:
+                    unmatched += 1
+            # batch-final done trim pops the same FIFO heads the per-join
+            # trims would have
+            while len(done) > self.max_done:
+                done.pop(next(iter(done)))
+            self.counters["received"] += len(items)
+            self.counters["joined"] += joined
+            self.counters["duplicate"] += duplicate
+            self.counters["unmatched"] += unmatched
+            depth = len(self._pending)
+        counts = {"joined": joined, "duplicate": duplicate,
+                  "unmatched": unmatched}
+        if items:
+            self._c["received"].inc(len(items))
+            for k, v in counts.items():
+                if v:
+                    self._c[k].inc(v)
+            self._pending_g.set(depth)
+        return counts, pairs
+
+    # --- introspection ------------------------------------------------------------------
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"pending": len(self._pending), "done": len(self._done),
+                    **dict(self.counters)}
+
+    # --- checkpointable monoid ----------------------------------------------------------
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "version": 1,
+                "ttl_notes": self.ttl_notes,
+                "max_pending": self.max_pending,
+                "max_done": self.max_done,
+                "note_seq": self._note_seq,
+                "pending": [[pid, s, seq]
+                            for pid, (s, seq) in self._pending.items()],
+                "done": list(self._done),
+                "counters": dict(self.counters),
+            }
+
+    @classmethod
+    def from_json(cls, doc: Mapping, registry=None,
+                  model_label: Optional[str] = None) -> "LabelJoiner":
+        j = cls(ttl_notes=int(doc.get("ttl_notes", 65536)),
+                max_pending=int(doc.get("max_pending", 16384)),
+                max_done=int(doc.get("max_done", 65536)),
+                registry=registry,
+                model_label=model_label or "serve")
+        j._note_seq = int(doc.get("note_seq", 0))
+        for pid, s, seq in doc.get("pending", []):
+            j._pending[str(pid)] = (float(s), int(seq))
+        for pid in doc.get("done", []):
+            j._done[str(pid)] = None
+        for k, v in (doc.get("counters") or {}).items():
+            if k in j.counters:
+                j.counters[k] = int(v)
+        return j
+
+    def merge(self, other: "LabelJoiner") -> None:
+        """Monoid fold: pending union (an id both sides hold keeps OURS —
+        same id means same score, the sequence differs only by local note
+        order), done union, counters add. Ids joined on EITHER side leave
+        pending, so a merged joiner never double-joins."""
+        with other._lock:
+            o_pending = dict(other._pending)
+            o_done = list(other._done)
+            o_counters = dict(other.counters)
+            o_seq = other._note_seq
+        with self._lock:
+            for pid in o_done:
+                self._done[pid] = None
+                self._pending.pop(pid, None)
+            for pid, (s, seq) in o_pending.items():
+                if pid not in self._done and pid not in self._pending:
+                    self._pending[pid] = (s, seq)
+            while len(self._done) > self.max_done:
+                self._done.pop(next(iter(self._done)))
+            while len(self._pending) > self.max_pending:
+                self._pending.pop(next(iter(self._pending)))
+            for k, v in o_counters.items():
+                if k in self.counters:
+                    self.counters[k] += int(v)
+            self._note_seq = max(self._note_seq, o_seq)
+
+
+# --- score extraction ---------------------------------------------------------------------
+def extract_score(row: Mapping) -> Optional[float]:
+    """A scalar [0, 1] score from one result row (dict of result-feature
+    name -> value). Prediction values are dicts for classifiers
+    ({"prediction": .., "probability": [..]} shapes) and floats for
+    regressors; the quality plane wants P(positive). Returns None for rows
+    it cannot read — the caller skips those (audit must never guess)."""
+    for v in row.values():
+        # `type(v) is dict` first: typing.Mapping isinstance is ~10x the
+        # cost and this runs once per scored row
+        if type(v) is dict or isinstance(v, _MappingABC):
+            prob = v.get("probability")
+            if isinstance(prob, (list, tuple)) and prob:
+                try:
+                    return float(prob[-1])
+                except (TypeError, ValueError):
+                    pass
+            for key in ("prob_1", "p1", "score", "prediction"):
+                p = v.get(key)
+                if isinstance(p, (int, float)):
+                    return min(1.0, max(0.0, float(p)))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            return min(1.0, max(0.0, float(v)))
+    return None
+
+
+class QualityPlane:
+    """Per-model composition of sink + joiner + monitor — ONE object the
+    daemon hangs off a ModelEntry and `op run --audit-dir` arms on a
+    ScoreFunction.
+
+    Score path:    ids = plane.on_scored(rows) — allocates ids, audits,
+                   notes pendings, returns ids positionally (None where the
+                   row carried no readable score).
+    Feedback path: plane.on_feedback(id, label) — joins, folds the pair
+                   into the QualityMonitor, returns the join status.
+    """
+
+    def __init__(self, model_label: str, *, audit_dir: Optional[str] = None,
+                 baseline: Optional[Mapping] = None,
+                 fingerprint: str = "",
+                 thresholds: Optional[QualityThresholds] = None,
+                 sample_every: int = 1,
+                 window_pairs: Optional[int] = 4096,
+                 check_every: int = 64,
+                 ttl_notes: int = 65536, max_pending: int = 16384,
+                 deterministic: Optional[bool] = None, registry=None):
+        self.model_label = str(model_label)
+        self.sink = (AuditSink(audit_dir, model_label,
+                               fingerprint=fingerprint,
+                               sample_every=sample_every,
+                               deterministic=deterministic,
+                               registry=registry)
+                     if audit_dir else None)
+        self.joiner = LabelJoiner(ttl_notes=ttl_notes,
+                                  max_pending=max_pending,
+                                  registry=registry,
+                                  model_label=model_label)
+        self.monitor = QualityMonitor(baseline, thresholds=thresholds,
+                                      registry=registry, source=model_label,
+                                      window_pairs=window_pairs,
+                                      check_every=check_every)
+        self._seq = 0
+        self._lock = make_lock("QualityPlane._lock")
+        self._trace = _trace16(
+            bool(deterministic
+                 or (deterministic is None
+                     and os.environ.get("TT_AUDIT_DETERMINISTIC"))),
+            self.model_label)
+
+    def _next_id(self) -> str:
+        return self._next_ids(1)[0]
+
+    def _next_ids(self, n: int) -> list[str]:
+        if self.sink is not None:
+            return self.sink.next_ids(n)
+        with self._lock:
+            start = self._seq + 1
+            self._seq += n
+        return [f"{self._trace}-{s:08d}" for s in range(start, start + n)]
+
+    # --- score path ---------------------------------------------------------------------
+    def on_scored(self, rows: Sequence[Mapping],
+                  scores: Optional[Sequence[Optional[float]]] = None,
+                  ) -> list[Optional[str]]:
+        """Audit + pending-note a batch of result rows; returns one
+        prediction id (or None) per row, positionally. Never raises into
+        the scoring path — and takes each lock ONCE per batch, not per row
+        (id block allocation + `note_many`)."""
+        ids: list[Optional[str]] = [None] * len(rows)
+        try:
+            idx: list[int] = []
+            vals: list[float] = []
+            for i, row in enumerate(rows):
+                score = (scores[i] if scores is not None
+                         else extract_score(row))
+                if score is not None:
+                    idx.append(i)
+                    vals.append(score)
+            if not idx:
+                return ids
+            pids = self._next_ids(len(idx))
+            for j, i in enumerate(idx):
+                ids[i] = pids[j]
+            if self.sink is not None:
+                for pid, score in zip(pids, vals):
+                    self.sink.emit(pid, score)
+            self.joiner.note_many(list(zip(pids, vals)))
+        except Exception:
+            pass
+        return ids
+
+    # --- feedback path ------------------------------------------------------------------
+    def on_feedback(self, prediction_id: str, label: float) -> str:
+        status, pair = self.joiner.feedback(prediction_id, label)
+        if pair is not None:
+            self.monitor.observe_pair(*pair)
+        return status
+
+    def on_feedback_many(self, labels: Sequence[Mapping]) -> dict:
+        """Batch form for the HTTP route: [{"id": .., "label": ..}, ...] ->
+        status counts. Malformed entries count as `invalid` instead of
+        failing the whole POST; everything well-formed joins and folds
+        under one joiner lock + one monitor lock."""
+        out = {"joined": 0, "duplicate": 0, "unmatched": 0, "invalid": 0}
+        try:
+            # fast path: one comprehension when every entry is well-formed
+            items = [(item["id"], float(item["label"])) for item in labels]
+        except (KeyError, TypeError, ValueError):
+            items = []
+            for item in labels:
+                try:
+                    items.append((item["id"], float(item["label"])))
+                except (KeyError, TypeError, ValueError):
+                    out["invalid"] += 1
+        counts, pairs = self.joiner.feedback_many(items)
+        for k, v in counts.items():
+            out[k] += v
+        if pairs:
+            self.monitor.observe_pairs(pairs)
+        return out
+
+    # --- introspection / lifecycle ------------------------------------------------------
+    def stats(self) -> dict:
+        m = self.monitor.report()
+        return {
+            "model": self.model_label,
+            "join": self.joiner.stats(),
+            "window": {k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in m["window"].items()
+                       if k != "calibration"},
+            "baseline": m["baseline"],
+            "active_alerts": m["active_alerts"],
+            "audit_segments": (len(self.sink.segments())
+                               if self.sink is not None else 0),
+        }
+
+    def close(self) -> None:
+        if self.sink is not None:
+            self.sink.close()

@@ -68,7 +68,7 @@ class ScoreFunction:
                  backend: Optional[str] = "auto",
                  auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
                  mesh=None, monitor=None, policy=None,
-                 model_label: Optional[str] = None):
+                 model_label: Optional[str] = None, quality=None):
         self._model = model
         self._result_names = list(result_names) if result_names else [
             f.name for f in model.result_features
@@ -90,6 +90,12 @@ class ScoreFunction:
 
             monitor = ServingMonitor.for_model(model)
         self.monitor = monitor or None
+        #: model-quality plane (serve/feedback.QualityPlane). When armed,
+        #: every result row from batch()/_rows_out gains a "prediction_id"
+        #: key and is audited + pending-noted for the label-feedback join.
+        #: None (the default) leaves result rows byte-identical to before —
+        #: the plane is strictly opt-in.
+        self.quality = quality or None
         #: metric label for this handle's model: daemon admissions pass the
         #: served model name; the default is the model uid (one bounded
         #: series per served model)
@@ -631,6 +637,13 @@ class ScoreFunction:
         for name in self._result_names:
             for i, v in enumerate(out[name].to_list()[:n]):
                 results[i][name] = v
+        if self.quality is not None:
+            # ids ride IN the row dicts, so they survive the MicroBatcher's
+            # demux slicing and reach each caller positionally intact
+            ids = self.quality.on_scored(results)
+            for row, pid in zip(results, ids):
+                if pid is not None:
+                    row["prediction_id"] = pid
         return results
 
     # --- streaming ----------------------------------------------------------------------
@@ -972,9 +985,10 @@ def score_function(model: "WorkflowModel", result_names: Optional[Sequence[str]]
                   backend: Optional[str] = "auto",
                   auto_cpu_threshold: int = AUTO_CPU_THRESHOLD,
                   mesh=None, monitor=None, policy=None,
-                  model_label: Optional[str] = None) -> ScoreFunction:
+                  model_label: Optional[str] = None,
+                  quality=None) -> ScoreFunction:
     """Build the serving callable (analog of `model.scoreFunction`)."""
     return ScoreFunction(model, result_names=result_names, pad_to=pad_to,
                          backend=backend, auto_cpu_threshold=auto_cpu_threshold,
                          mesh=mesh, monitor=monitor, policy=policy,
-                         model_label=model_label)
+                         model_label=model_label, quality=quality)

@@ -113,6 +113,10 @@ class RunResult:
     #: quarantine.py: sidecar path, row/batch totals, by-stage breakdown) —
     #: None when quarantine is off or nothing was shed
     quarantine: Optional[dict] = None
+    #: prediction-audit summary for audited score runs (params.audit_dir /
+    #: `op run --audit-dir`): records emitted, segments published, the id of
+    #: the first/last audited row — the join keys `op feedback` resolves
+    audit: Optional[dict] = None
 
 
 def write_table_csv(table: Table, path: str) -> None:
@@ -519,6 +523,10 @@ class WorkflowRunner:
             scores = model.transform(raw, keep_intermediate=True)
         mark("score")
         out = model.transform_select(scores)
+        audit_summary = None
+        if params.audit_dir:
+            out, audit_summary = self._audit_scores(model, out, params)
+            mark("audit")
         loc = params.write_location
         from .. import obs
 
@@ -534,7 +542,63 @@ class WorkflowRunner:
             mark("evaluate")
         return RunResult("score", write_location=loc, metrics=eval_metrics,
                          n_rows=out.nrows,
-                         monitor=monitor.report() if monitor else None)
+                         monitor=monitor.report() if monitor else None,
+                         audit=audit_summary)
+
+    def _audit_scores(self, model: WorkflowModel, out: Table,
+                      params: OpParams):
+        """Prediction-audit an offline score run (params.audit_dir): every
+        scored row gains a `prediction_id` column (the join key `op
+        feedback` resolves later) and sampled audit records land in atomic
+        JSONL segments. Returns (table-with-ids, summary)."""
+        import numpy as np
+
+        from ..serve.feedback import QualityPlane
+        from ..types import Column
+
+        scores: Optional[list] = None
+        for name in out.names():
+            col = out[name]
+            if col.kind.storage is Storage.PREDICTION:
+                vals = col.values
+                prob = vals.get("probability") if isinstance(vals, dict) \
+                    else None
+                if prob is not None:
+                    p = np.asarray(prob, np.float64)
+                    if p.ndim == 2 and p.shape[1] >= 2:
+                        scores = [float(v) for v in p[:, -1]]
+                        break
+                pred = np.asarray(vals["prediction"], np.float64) \
+                    if isinstance(vals, dict) else np.asarray(vals, np.float64)
+                scores = [min(1.0, max(0.0, float(v))) for v in pred]
+                break
+        if scores is None:
+            return out, {"error": "no prediction column to audit"}
+        from ..serve.daemon import fingerprint_model_dir
+
+        fp = ""
+        if params.model_location and os.path.isdir(params.model_location):
+            try:
+                fp = fingerprint_model_dir(params.model_location)
+            except Exception:  # noqa: BLE001 — audit must not fail the run
+                fp = ""
+        plane = QualityPlane(
+            "run", audit_dir=params.audit_dir, fingerprint=fp,
+            baseline=getattr(model, "quality_baseline", None))
+        ids = plane.on_scored([{} for _ in scores], scores=scores)
+        plane.sink.flush()
+        plane.close()
+        cols = {name: out[name] for name in out.names()}
+        cols["prediction_id"] = Column.build(
+            "ID", [i or "" for i in ids], device=False)
+        summary = {
+            "dir": os.path.abspath(params.audit_dir),
+            "records": sum(1 for i in ids if i),
+            "segments": len(plane.sink.segments()),
+            "first_id": next((i for i in ids if i), None),
+            "last_id": next((i for i in reversed(ids) if i), None),
+        }
+        return Table(cols), summary
 
     def _run_features(self, params: OpParams, mark) -> RunResult:
         """Compute and persist just the raw features (OpWorkflowRunner.scala:190)."""

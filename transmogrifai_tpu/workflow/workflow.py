@@ -636,6 +636,7 @@ class Workflow(WorkflowCore):
         # plan-time report rides along so save() stamps it without re-analysis
         model.analysis_report = analysis
         model.serving_baseline = serving_baseline
+        model.quality_baseline = _quality_baseline_of(fitted_stages)
         try:
             # static per-stage resource prediction at the mesh this train
             # resolved and the rows it actually read — pure host arithmetic,
@@ -653,6 +654,33 @@ class Workflow(WorkflowCore):
         except Exception:  # modeling must never fail a completed train
             _logger.warning("resource model stamp failed", exc_info=True)
         return model
+
+
+def _quality_baseline_of(fitted_stages) -> Optional[dict]:
+    """The selector's holdout value of its own selection metric, shaped for
+    the online QualityMonitor (obs/quality.py). The stamp is the quality
+    plane's breach baseline: serving compares windowed (score, label)
+    quality against the number the model actually achieved on held-out
+    truth at train time. None when no selector ran or it kept no holdout —
+    a stamp-less model still gets watched, just never paged on."""
+    for s in fitted_stages:
+        summ = getattr(s, "selector_summary", None)
+        if summ is None or summ.holdout_metrics is None:
+            continue
+        try:
+            value = summ.holdout_metrics.to_json().get(summ.metric_name)
+        except Exception:
+            continue
+        if not isinstance(value, (int, float)):
+            continue
+        return {
+            "metric": str(summ.metric_name),
+            "value": float(value),
+            "larger_is_better": bool(summ.larger_is_better),
+            "problem_type": str(summ.problem_type),
+            "n_holdout": int(summ.n_holdout),
+        }
+    return None
 
 
 def _make_fold_matrix_fn(raw_data: Table, records: Sequence[tuple[Stage, Transformer]],
@@ -721,6 +749,14 @@ class WorkflowModel(WorkflowCore):
         #: serving drift monitor (obs/monitor.py) — stamped by train(), saved
         #: under model.json "serving_baseline", restored by load()
         self.serving_baseline: dict = {}
+        #: {"metric", "value", "larger_is_better", "problem_type",
+        #: "n_holdout"} — the selector's HOLDOUT value of its own selection
+        #: metric, stamped by train() when a selector ran with a holdout.
+        #: The breach baseline for the online QualityMonitor
+        #: (obs/quality.py): serving compares windowed label-feedback
+        #: quality against this. Saved under model.json "quality_baseline",
+        #: restored by load(); None when no selector/holdout ran.
+        self.quality_baseline: Optional[dict] = None
         #: {lane: [[latency_s, rows], ...]} measured serving-lane latency
         #: windows (ScoreFunction.lane_windows) — stamped by save(aot=True)'s
         #: export pass (or set explicitly from a live handle before save),
@@ -937,6 +973,10 @@ class WorkflowModel(WorkflowCore):
             from ..obs.monitor import baseline_to_json
 
             manifest["serving_baseline"] = baseline_to_json(self.serving_baseline)
+        if self.quality_baseline:
+            # the holdout-metric stamp the serving quality plane alerts
+            # against (obs/quality.py) — plain scalars, persisted verbatim
+            manifest["quality_baseline"] = dict(self.quality_baseline)
         if self.serving_lane_windows:
             # measured serving-lane latency windows (from the AOT export's
             # timed passes, or a live handle's lane_windows()): a loaded
@@ -1055,6 +1095,9 @@ class WorkflowModel(WorkflowCore):
 
             model.serving_baseline = baseline_from_json(
                 manifest["serving_baseline"])
+        qb = manifest.get("quality_baseline")
+        if isinstance(qb, dict) and qb:
+            model.quality_baseline = dict(qb)
         slw = manifest.get("serving_lane_windows") or {}
         if slw.get("windows"):
             # only adopt routing windows measured on the SAME host class:
